@@ -1,0 +1,35 @@
+"""nvme_strom_tpu — a TPU-native NVMe→HBM streaming framework.
+
+A ground-up re-design of NVMe-Strom's SSD-to-accelerator direct data path for
+TPUs.  Where the reference (``francisxuguoq/nvme-strom``; see SURVEY.md — the
+reference mount was empty, so parity claims trace to SURVEY.md sections rather
+than file:line) is a Linux kernel module that DMAs NVMe blocks straight into
+CUDA BAR1 GPU memory, this framework achieves the same end — *zero host-DRAM
+bounce copies between SSD and accelerator memory* — with a TPU-idiomatic
+stack:
+
+- ``csrc/`` + :mod:`nvme_strom_tpu.io`: a C++ io_uring/O_DIRECT I/O engine
+  (the ``nvme_strom.ko`` equivalent; SURVEY.md §2 "SSD→GPU DMA engine").
+  NVMe DMA lands in locked, aligned host staging buffers owned by the engine.
+- :mod:`nvme_strom_tpu.ops`: the JAX/XLA bridge that turns a completed chunk
+  into a device-resident array with no intermediate Python/framework copy
+  (the ``MAP_GPU_MEMORY`` + ``MEMCPY_SSD2GPU`` equivalent; SURVEY.md §3.1).
+- :mod:`nvme_strom_tpu.formats`: ranged-read planners for TFRecord,
+  WebDataset tar, safetensors and Arrow IPC so *payload* bytes flow through
+  the direct engine.
+- :mod:`nvme_strom_tpu.data`: sharded multi-host dataloaders over a
+  ``jax.sharding.Mesh`` (each host reads its own local NVMe; SURVEY.md §5).
+- :mod:`nvme_strom_tpu.parallel`: lazy sharded weight loading under pjit.
+- :mod:`nvme_strom_tpu.sql`: PG-Strom-style Parquet scan → GROUP BY on TPU
+  (SURVEY.md §3.5).
+
+North star (BASELINE.json): sustained NVMe→HBM GiB/s at ≥90% of raw SSD read
+bandwidth with ``bounce_bytes == 0`` — every byte is memcpy'd by the host CPU
+at most zero times between the NVMe DMA landing and the PCIe transfer to TPU.
+"""
+
+from nvme_strom_tpu.utils.stats import StromStats, global_stats
+
+__version__ = "0.1.0"
+
+__all__ = ["StromStats", "global_stats", "__version__"]

@@ -1,0 +1,4 @@
+from nvme_strom_tpu.utils.stats import StromStats, global_stats
+from nvme_strom_tpu.utils.config import EngineConfig, LoaderConfig
+
+__all__ = ["StromStats", "global_stats", "EngineConfig", "LoaderConfig"]

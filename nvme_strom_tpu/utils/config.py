@@ -1,0 +1,63 @@
+"""Configuration dataclasses — the analogue of NVMe-Strom's module params and
+ioctl arguments (chunk size, number of in-flight requests; SURVEY.md §5
+"Config/flags")."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """strom-io C++ engine knobs.
+
+    ``chunk_bytes`` mirrors the reference benchmark's chunk size argument and
+    ``queue_depth`` its "number of async buffers" (SURVEY.md §3.4).  Chunks
+    must be multiples of the O_DIRECT logical block alignment.  STROM_*
+    environment variables are read at construction time.
+    """
+
+    chunk_bytes: int = field(
+        default_factory=lambda: _env_int("STROM_CHUNK_BYTES", 4 << 20))
+    queue_depth: int = field(
+        default_factory=lambda: _env_int("STROM_QUEUE_DEPTH", 16))
+    alignment: int = field(
+        default_factory=lambda: _env_int("STROM_ALIGNMENT", 4096))
+    buffer_pool_bytes: int = field(
+        default_factory=lambda: _env_int("STROM_POOL_BYTES", 256 << 20))
+    use_io_uring: bool = field(
+        default_factory=lambda: os.environ.get("STROM_IO_URING", "1") != "0")
+    lock_buffers: bool = field(
+        default_factory=lambda: os.environ.get("STROM_MLOCK", "1") != "0")
+    max_retries: int = field(
+        default_factory=lambda: _env_int("STROM_MAX_RETRIES", 2))
+
+    def __post_init__(self):
+        if self.alignment <= 0 or (self.alignment & (self.alignment - 1)):
+            raise ValueError(
+                f"alignment ({self.alignment}) must be a positive power of two"
+            )
+        if self.chunk_bytes <= 0 or self.chunk_bytes % self.alignment:
+            raise ValueError(
+                f"chunk_bytes ({self.chunk_bytes}) must be a positive "
+                f"multiple of alignment ({self.alignment})"
+            )
+
+
+@dataclass(frozen=True)
+class LoaderConfig:
+    """Dataloader knobs: per-host shard selection + device prefetch depth."""
+
+    batch_size: int = 32
+    prefetch: int = 2
+    shuffle_buffer: int = 0
+    drop_remainder: bool = True
+    seed: int = 0

@@ -1,0 +1,104 @@
+"""Transfer statistics — the TPU equivalent of NVMe-Strom's STAT_INFO ioctl.
+
+The reference kernel module exposes counters for DMA'd bytes vs
+page-cache-fallback bytes and request counts via ``STROM_IOCTL__STAT_INFO``
+(SURVEY.md §2 "Stats / debug", §5 "Metrics/logging").  This module is the
+userspace analogue.  The single most important counter is ``bounce_bytes``:
+bytes that were memcpy'd by host CPU between the NVMe DMA completion and the
+host→TPU transfer.  The north star (BASELINE.json) requires it to be zero on
+the direct path.
+
+Semantics of the byte counters:
+
+- ``bytes_direct``   — payload bytes read via O_DIRECT/io_uring straight into
+  engine-owned locked staging buffers (NVMe DMA target == TPU transfer
+  source: no host copy in between).
+- ``bytes_fallback`` — payload bytes that took the buffered-read fallback
+  (page cache involved), the analogue of the reference's page-cache fallback
+  chunks in ``MEMCPY_SSD2GPU`` (SURVEY.md §3.1).
+- ``bounce_bytes``   — bytes additionally memcpy'd on the host after landing
+  (fallback reads count; any Python-side copy counts; the direct path
+  contributes zero).
+- ``bytes_to_device`` — bytes handed to the accelerator via the JAX bridge.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class StromStats:
+    """Mutable counter block. Thread-safe increments; cheap reads."""
+
+    bytes_direct: int = 0
+    bytes_fallback: int = 0
+    bounce_bytes: int = 0
+    bytes_to_device: int = 0
+    bytes_written_direct: int = 0
+    requests_submitted: int = 0
+    requests_completed: int = 0
+    requests_failed: int = 0
+    retries: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _t0: float = field(default_factory=time.monotonic, repr=False)
+
+    def add(self, **deltas: int) -> None:
+        with self._lock:
+            for name, d in deltas.items():
+                setattr(self, name, getattr(self, name) + d)
+
+    def merge_engine(self, engine_stats: dict) -> None:
+        """Fold counters read from the C++ engine into this block."""
+        self.add(
+            bytes_direct=engine_stats.get("bytes_direct", 0),
+            bytes_fallback=engine_stats.get("bytes_fallback", 0),
+            bounce_bytes=engine_stats.get("bounce_bytes", 0),
+            bytes_written_direct=engine_stats.get("bytes_written_direct", 0),
+            requests_submitted=engine_stats.get("requests_submitted", 0),
+            requests_completed=engine_stats.get("requests_completed", 0),
+            requests_failed=engine_stats.get("requests_failed", 0),
+            retries=engine_stats.get("retries", 0),
+        )
+
+    @property
+    def total_payload_bytes(self) -> int:
+        return self.bytes_direct + self.bytes_fallback
+
+    def throughput_gib_s(self) -> float:
+        dt = time.monotonic() - self._t0
+        return (self.total_payload_bytes / (1 << 30)) / dt if dt > 0 else 0.0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "bytes_direct": self.bytes_direct,
+                "bytes_fallback": self.bytes_fallback,
+                "bounce_bytes": self.bounce_bytes,
+                "bytes_to_device": self.bytes_to_device,
+                "bytes_written_direct": self.bytes_written_direct,
+                "requests_submitted": self.requests_submitted,
+                "requests_completed": self.requests_completed,
+                "requests_failed": self.requests_failed,
+                "retries": self.retries,
+            }
+
+    def dump_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def reset(self) -> None:
+        with self._lock:
+            for name in (
+                "bytes_direct", "bytes_fallback", "bounce_bytes",
+                "bytes_to_device", "bytes_written_direct",
+                "requests_submitted", "requests_completed",
+                "requests_failed", "retries",
+            ):
+                setattr(self, name, 0)
+            self._t0 = time.monotonic()
+
+
+global_stats = StromStats()

@@ -1,0 +1,42 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Real TPU hardware is one chip in this environment; multi-chip sharding is
+validated on ``--xla_force_host_platform_device_count=8`` CPU devices, per
+the repo's build contract.  Must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip(f"needs 8 devices, have {len(devs)} "
+                    "(XLA_FLAGS was pre-set or platform override)")
+    return Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "tp"))
+
+
+@pytest.fixture()
+def tmp_data_file(tmp_path):
+    """A 16 MiB file of deterministic bytes on local disk."""
+    import numpy as np
+
+    path = tmp_path / "data.bin"
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, size=16 << 20, dtype=np.uint8).tobytes()
+    path.write_bytes(payload)
+    return path, payload

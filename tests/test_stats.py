@@ -1,0 +1,45 @@
+import json
+import threading
+
+from nvme_strom_tpu.utils.stats import StromStats
+from nvme_strom_tpu.utils.config import EngineConfig
+
+import pytest
+
+
+def test_counters_accumulate():
+    s = StromStats()
+    s.add(bytes_direct=4096, requests_submitted=1)
+    s.add(bytes_fallback=100, bounce_bytes=100)
+    assert s.total_payload_bytes == 4196
+    snap = s.snapshot()
+    assert snap["bytes_direct"] == 4096
+    assert snap["bounce_bytes"] == 100
+    assert json.loads(s.dump_json()) == snap
+
+
+def test_threaded_increments():
+    s = StromStats()
+
+    def worker():
+        for _ in range(1000):
+            s.add(bytes_direct=1)
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert s.bytes_direct == 8000
+
+
+def test_merge_engine_and_reset():
+    s = StromStats()
+    s.merge_engine({"bytes_direct": 10, "requests_completed": 2})
+    assert s.bytes_direct == 10 and s.requests_completed == 2
+    s.reset()
+    assert s.total_payload_bytes == 0
+
+
+def test_engine_config_alignment_check():
+    EngineConfig(chunk_bytes=8192, alignment=4096)
+    with pytest.raises(ValueError):
+        EngineConfig(chunk_bytes=5000, alignment=4096)
