@@ -1,0 +1,809 @@
+/* strom_io.cc — the strom-io engine: NVMe -> locked staging buffers with
+ * zero host-side payload copies.
+ *
+ * This is the TPU build's equivalent of the reference's nvme_strom.c kernel
+ * module (SURVEY.md §2: "SSD→GPU DMA engine", ~1.5-2k LoC of extent walking
+ * + async NVMe command submission).  We cannot load kernel modules on TPU
+ * VMs, so the same property — payload bytes never memcpy'd by the host CPU —
+ * is obtained with io_uring + O_DIRECT: the NVMe controller DMAs file data
+ * straight into this engine's mlock'd, alignment-conformant staging buffers,
+ * which are then handed (by pointer, never by copy) to the JAX bridge as the
+ * source of the host->TPU PCIe transfer.
+ *
+ * Design notes:
+ *  - io_uring is driven by raw syscalls (425/426) — no liburing dependency.
+ *  - A request for an unaligned [offset, len) range reads the enclosing
+ *    aligned span and returns a pointer *into* the buffer (data = buf +
+ *    head_slack): the reference handles the same problem with sector-aligned
+ *    extent chunking in-kernel (SURVEY.md §3.1).
+ *  - Files that reject O_DIRECT (tmpfs/overlayfs) or reads that come back
+ *    EINVAL take the buffered-read fallback, counted in bytes_fallback and
+ *    bounce_bytes — the analogue of the reference's page-cache fallback
+ *    chunks, which are also host-copied (SURVEY.md §3.1 "page-cache
+ *    fallback").
+ *  - Stats counters mirror STROM_IOCTL__STAT_INFO (SURVEY.md §5).
+ */
+
+#include "strom_io.h"
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include <errno.h>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <sys/statfs.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+/* ---------------- raw io_uring plumbing (no liburing) ---------------- */
+
+#ifndef __NR_io_uring_setup
+#define __NR_io_uring_setup 425
+#endif
+#ifndef __NR_io_uring_enter
+#define __NR_io_uring_enter 426
+#endif
+
+struct io_sqring_offsets_ {
+  uint32_t head, tail, ring_mask, ring_entries, flags, dropped, array, resv1;
+  uint64_t resv2;
+};
+struct io_cqring_offsets_ {
+  uint32_t head, tail, ring_mask, ring_entries, overflow, cqes, flags, resv1;
+  uint64_t resv2;
+};
+struct io_uring_params_ {
+  uint32_t sq_entries, cq_entries, flags, sq_thread_cpu, sq_thread_idle;
+  uint32_t features, wq_fd, resv[3];
+  io_sqring_offsets_ sq_off;
+  io_cqring_offsets_ cq_off;
+};
+struct io_uring_sqe_ {
+  uint8_t opcode, flags;
+  uint16_t ioprio;
+  int32_t fd;
+  uint64_t off, addr;
+  uint32_t len, rw_flags;
+  uint64_t user_data;
+  uint16_t buf_index, personality;
+  int32_t splice_fd_in;
+  uint64_t pad2[2];
+};
+struct io_uring_cqe_ {
+  uint64_t user_data;
+  int32_t res;
+  uint32_t flags;
+};
+
+static constexpr uint64_t kOffSqRing = 0ULL;
+static constexpr uint64_t kOffCqRing = 0x8000000ULL;
+static constexpr uint64_t kOffSqes = 0x10000000ULL;
+static constexpr uint32_t kFeatSingleMmap = 1u << 0;
+static constexpr uint32_t kEnterGetevents = 1u << 0;
+static constexpr uint8_t kOpNop = 0, kOpRead = 22, kOpWrite = 23;
+static constexpr uint64_t kShutdownUserData = ~0ULL;
+
+struct Uring {
+  int fd = -1;
+  uint32_t *sq_head = nullptr, *sq_tail = nullptr, *sq_mask = nullptr;
+  uint32_t *sq_array = nullptr;
+  uint32_t *cq_head = nullptr, *cq_tail = nullptr, *cq_mask = nullptr;
+  io_uring_cqe_ *cqes = nullptr;
+  io_uring_sqe_ *sqes = nullptr;
+  void *sq_ring_ptr = nullptr, *cq_ring_ptr = nullptr;
+  size_t sq_ring_sz = 0, cq_ring_sz = 0, sqes_sz = 0;
+  uint32_t sq_entries = 0;
+  bool single_mmap = false;
+  /* SQEs published to the ring but not yet consumed by io_uring_enter
+   * (enter can fail with EINTR/EBUSY after the tail was advanced; the
+   * entry then MUST be submitted by a later enter, never abandoned —
+   * an abandoned SQE would be consumed by the next enter and DMA into
+   * a buffer that has since been reassigned). */
+  std::atomic<uint32_t> unsubmitted{0};
+
+  bool init(uint32_t entries) {
+    io_uring_params_ p;
+    memset(&p, 0, sizeof(p));
+    int r = (int)syscall(__NR_io_uring_setup, entries, &p);
+    if (r < 0) return false;
+    fd = r;
+    sq_entries = p.sq_entries;
+    sq_ring_sz = p.sq_off.array + p.sq_entries * sizeof(uint32_t);
+    cq_ring_sz = p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe_);
+    single_mmap = (p.features & kFeatSingleMmap) != 0;
+    if (single_mmap && cq_ring_sz > sq_ring_sz) sq_ring_sz = cq_ring_sz;
+    sq_ring_ptr = mmap(nullptr, sq_ring_sz, PROT_READ | PROT_WRITE,
+                       MAP_SHARED | MAP_POPULATE, fd, kOffSqRing);
+    if (sq_ring_ptr == MAP_FAILED) { close(fd); fd = -1; return false; }
+    if (single_mmap) {
+      cq_ring_ptr = sq_ring_ptr;
+      cq_ring_sz = sq_ring_sz;
+    } else {
+      cq_ring_ptr = mmap(nullptr, cq_ring_sz, PROT_READ | PROT_WRITE,
+                         MAP_SHARED | MAP_POPULATE, fd, kOffCqRing);
+      if (cq_ring_ptr == MAP_FAILED) { teardown(); return false; }
+    }
+    sqes_sz = p.sq_entries * sizeof(io_uring_sqe_);
+    sqes = (io_uring_sqe_ *)mmap(nullptr, sqes_sz, PROT_READ | PROT_WRITE,
+                                 MAP_SHARED | MAP_POPULATE, fd, kOffSqes);
+    if (sqes == MAP_FAILED) { sqes = nullptr; teardown(); return false; }
+    auto *sqb = (uint8_t *)sq_ring_ptr;
+    sq_head = (uint32_t *)(sqb + p.sq_off.head);
+    sq_tail = (uint32_t *)(sqb + p.sq_off.tail);
+    sq_mask = (uint32_t *)(sqb + p.sq_off.ring_mask);
+    sq_array = (uint32_t *)(sqb + p.sq_off.array);
+    auto *cqb = (uint8_t *)cq_ring_ptr;
+    cq_head = (uint32_t *)(cqb + p.cq_off.head);
+    cq_tail = (uint32_t *)(cqb + p.cq_off.tail);
+    cq_mask = (uint32_t *)(cqb + p.cq_off.ring_mask);
+    cqes = (io_uring_cqe_ *)(cqb + p.cq_off.cqes);
+    return true;
+  }
+
+  void teardown() {
+    if (sqes) munmap(sqes, sqes_sz);
+    if (cq_ring_ptr && cq_ring_ptr != sq_ring_ptr) munmap(cq_ring_ptr, cq_ring_sz);
+    if (sq_ring_ptr) munmap(sq_ring_ptr, sq_ring_sz);
+    if (fd >= 0) close(fd);
+    sqes = nullptr; cq_ring_ptr = sq_ring_ptr = nullptr; fd = -1;
+  }
+
+  /* Push any published-but-unconsumed SQEs into the kernel. Safe to call
+   * from any thread. Returns 0 when the backlog is drained. */
+  int flush() {
+    for (int attempt = 0; attempt < 1000; attempt++) {
+      uint32_t n = unsubmitted.load(std::memory_order_acquire);
+      if (n == 0) return 0;
+      int r = (int)syscall(__NR_io_uring_enter, fd, n, 0, 0, nullptr, 0);
+      if (r > 0) {
+        unsubmitted.fetch_sub((uint32_t)r, std::memory_order_acq_rel);
+        continue;
+      }
+      if (r < 0 && errno != EINTR && errno != EAGAIN && errno != EBUSY)
+        return -errno;
+      if (r == 0 || errno == EAGAIN || errno == EBUSY) usleep(10);
+    }
+    return -EBUSY; /* backlog persists; a later flush will retry it */
+  }
+
+  /* Caller must serialise submissions (engine holds a mutex). Returns 0 or
+   * -errno. The SQE is always published; a transient enter failure leaves
+   * it queued for the next flush rather than failing the request. */
+  int submit(uint8_t opcode, int fd_, uint64_t off, void *addr, uint32_t len,
+             uint64_t user_data) {
+    uint32_t tail = *sq_tail;
+    uint32_t head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
+    if (tail - head >= sq_entries) {
+      /* SQ full: nudge the kernel and spin-wait (bounded by in-flight I/O). */
+      for (int i = 0; i < 100000 && tail - head >= sq_entries; i++) {
+        flush();
+        syscall(__NR_io_uring_enter, fd, 0, 0, 0, nullptr, 0);
+        head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
+      }
+      if (tail - head >= sq_entries) return -EBUSY;
+    }
+    uint32_t idx = tail & *sq_mask;
+    io_uring_sqe_ *sqe = &sqes[idx];
+    memset(sqe, 0, sizeof(*sqe));
+    sqe->opcode = opcode;
+    sqe->fd = fd_;
+    sqe->off = off;
+    sqe->addr = (uint64_t)addr;
+    sqe->len = len;
+    sqe->user_data = user_data;
+    sq_array[idx] = idx;
+    __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
+    unsubmitted.fetch_add(1, std::memory_order_acq_rel);
+    flush();
+    return 0; /* published: the op WILL reach the kernel */
+  }
+
+  /* Blocks for >=1 completion; invokes fn(user_data, res) per CQE.
+   * Returns number consumed, or -errno. */
+  template <typename F>
+  int reap(F &&fn) {
+    if (unsubmitted.load(std::memory_order_acquire) > 0) flush();
+    uint32_t head = *cq_head;
+    uint32_t tail = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
+    if (head == tail) {
+      int r = (int)syscall(__NR_io_uring_enter, fd, 0, 1, kEnterGetevents,
+                           nullptr, 0);
+      if (r < 0 && errno != EINTR) return -errno;
+      tail = __atomic_load_n(cq_tail, __ATOMIC_ACQUIRE);
+    }
+    int n = 0;
+    while (head != tail) {
+      io_uring_cqe_ *cqe = &cqes[head & *cq_mask];
+      fn(cqe->user_data, cqe->res);
+      head++;
+      n++;
+    }
+    __atomic_store_n(cq_head, head, __ATOMIC_RELEASE);
+    return n;
+  }
+};
+
+/* ---------------------------- engine ---------------------------- */
+
+namespace {
+
+inline uint64_t align_down(uint64_t x, uint64_t a) { return x & ~(a - 1); }
+inline uint64_t align_up(uint64_t x, uint64_t a) { return (x + a - 1) & ~(a - 1); }
+
+struct FileEnt {
+  int fd_direct = -1;   /* -1 when the fs refused O_DIRECT */
+  int fd_buffered = -1;
+  int64_t size = 0;
+  bool writable = false;
+};
+
+enum class ReqState { kInflight, kDone };
+
+struct Req {
+  int64_t id = 0;
+  int fh = -1;
+  uint64_t offset = 0, len = 0;        /* caller's request            */
+  uint64_t a_off = 0, a_len = 0;       /* aligned span actually read  */
+  int buf_idx = -1;                    /* -1: zero-copy direct write  */
+  uint8_t *buf = nullptr;              /* base of staging buffer      */
+  const void *wsrc = nullptr;          /* write source (write path)   */
+  bool is_write = false;
+  bool direct = false;                 /* submitted O_DIRECT          */
+  bool was_fallback = false;
+  ReqState state = ReqState::kInflight;
+  int status = 0;                      /* 0 or -errno                 */
+  uint64_t done_len = 0;               /* payload bytes transferred   */
+};
+
+}  // namespace
+
+struct strom_engine {
+  uint32_t queue_depth, n_buffers, alignment;
+  uint64_t buf_bytes;     /* payload capacity */
+  uint64_t buf_cap;       /* buf_bytes + 2*alignment slack */
+  bool use_uring = false;
+  bool locked = false;
+
+  Uring ring;
+  std::thread reaper;
+  std::vector<std::thread> workers;
+  std::deque<Req *> work_q;             /* thread-pool backend queue */
+  bool stopping = false;
+
+  uint8_t *pool = nullptr;
+  size_t pool_sz = 0;
+  std::vector<int> free_bufs;
+  std::deque<Req *> defer_q;            /* submitted, awaiting a buffer */
+
+  std::mutex mu;
+  std::condition_variable cv_done;      /* request completed       */
+  std::condition_variable cv_work;      /* thread-pool work queue  */
+
+  std::unordered_map<int64_t, Req *> reqs;
+  int64_t next_req = 1;
+  std::unordered_map<int, FileEnt> files;
+  int next_fh = 1;
+
+  std::atomic<uint64_t> st_direct{0}, st_fallback{0}, st_bounce{0},
+      st_written{0}, st_sub{0}, st_comp{0}, st_fail{0}, st_retry{0};
+
+  uint8_t *buf_ptr(int idx) { return pool + (uint64_t)idx * buf_cap; }
+
+  /* Synchronous read with the full fallback ladder; used by the thread-pool
+   * backend and by the reaper when an io_uring direct read needs rescue.
+   * Fills req->status/done_len/was_fallback. Caller does NOT hold mu. */
+  void read_sync(Req *r, const FileEnt &fe) {
+    uint64_t avail = r->offset < (uint64_t)fe.size
+                         ? std::min<uint64_t>(r->len, fe.size - r->offset)
+                         : 0;
+    if (avail == 0) { r->status = 0; r->done_len = 0; return; }
+    uint64_t head = r->offset - r->a_off;
+    if (fe.fd_direct >= 0 && r->direct) {
+      uint64_t got = 0;
+      bool ok = true;
+      while (got < r->a_len) {
+        ssize_t n = pread(fe.fd_direct, r->buf + got, r->a_len - got,
+                          (off_t)(r->a_off + got));
+        if (n < 0) { ok = false; break; }
+        if (n == 0) break; /* EOF */
+        got += (uint64_t)n;
+      }
+      if (ok && got >= head + avail) {
+        r->status = 0;
+        r->done_len = avail;
+        st_direct.fetch_add(avail, std::memory_order_relaxed);
+        return;
+      }
+      st_retry.fetch_add(1, std::memory_order_relaxed);
+    }
+    /* Buffered fallback: page cache in the middle -> host copy, counted. */
+    uint64_t got = 0;
+    while (got < avail) {
+      ssize_t n = pread(fe.fd_buffered, r->buf + head + got, avail - got,
+                        (off_t)(r->offset + got));
+      if (n < 0) { r->status = -errno; st_fail.fetch_add(1); return; }
+      if (n == 0) break;
+      got += (uint64_t)n;
+    }
+    r->status = 0;
+    r->done_len = got;
+    r->was_fallback = true;
+    st_fallback.fetch_add(got, std::memory_order_relaxed);
+    st_bounce.fetch_add(got, std::memory_order_relaxed);
+  }
+
+  void write_sync(Req *r, const FileEnt &fe) {
+    const uint8_t *src = r->buf_idx >= 0 ? r->buf : (const uint8_t *)r->wsrc;
+    int fd = (r->direct && fe.fd_direct >= 0) ? fe.fd_direct : fe.fd_buffered;
+    uint64_t put = 0;
+    while (put < r->len) {
+      ssize_t n = pwrite(fd, src + put, r->len - put, (off_t)(r->offset + put));
+      if (n < 0) {
+        if (errno == EINVAL && fd == fe.fd_direct) {
+          fd = fe.fd_buffered;
+          r->was_fallback = true;
+          st_retry.fetch_add(1, std::memory_order_relaxed);
+          continue;
+        }
+        r->status = -errno;
+        st_fail.fetch_add(1);
+        return;
+      }
+      put += (uint64_t)n;
+    }
+    r->status = 0;
+    r->done_len = put;
+    if (!r->was_fallback && r->direct)
+      st_written.fetch_add(put, std::memory_order_relaxed);
+    else
+      st_bounce.fetch_add(put, std::memory_order_relaxed);
+  }
+
+  void complete_locked(Req *r) {
+    r->state = ReqState::kDone;
+    st_comp.fetch_add(1, std::memory_order_relaxed);
+    cv_done.notify_all();
+  }
+
+  void complete(Req *r) {
+    std::lock_guard<std::mutex> g(mu);
+    complete_locked(r);
+  }
+
+  /* Hand a buffer-holding request to the backend. mu must be held.
+   * Submissions never block: if the ring is jammed (practically impossible —
+   * we drain the SQ on every enter) the request fails with -EBUSY. */
+  void dispatch_locked(Req *r) {
+    auto it = files.find(r->fh);
+    if (it == files.end()) {
+      r->status = -EBADF;
+      st_fail.fetch_add(1, std::memory_order_relaxed);
+      complete_locked(r);
+      return;
+    }
+    const FileEnt &fe = it->second;
+    if (use_uring) {
+      int rc;
+      if (r->is_write) {
+        const uint8_t *s = r->buf_idx >= 0 ? r->buf : (const uint8_t *)r->wsrc;
+        rc = ring.submit(kOpWrite, r->direct ? fe.fd_direct : fe.fd_buffered,
+                         r->offset, (void *)s, (uint32_t)r->len,
+                         (uint64_t)r->id);
+      } else {
+        int fd = r->direct ? fe.fd_direct : fe.fd_buffered;
+        uint64_t off = r->direct ? r->a_off : r->offset;
+        uint8_t *dst = r->direct ? r->buf : r->buf + (r->offset - r->a_off);
+        uint32_t rlen = (uint32_t)(r->direct ? r->a_len : r->len);
+        rc = ring.submit(kOpRead, fd, off, dst, rlen, (uint64_t)r->id);
+      }
+      if (rc != 0) {
+        r->status = rc;
+        st_fail.fetch_add(1, std::memory_order_relaxed);
+        complete_locked(r);
+      }
+      return;
+    }
+    work_q.push_back(r);
+    cv_work.notify_one();
+  }
+
+  /* A staging buffer became free (or is free at submit time): either give
+   * it to the oldest deferred request, or return it to the pool.
+   * mu must be held. */
+  void assign_or_free_locked(int buf_idx) {
+    while (!defer_q.empty()) {
+      Req *r = defer_q.front();
+      defer_q.pop_front();
+      r->buf_idx = buf_idx;
+      r->buf = buf_ptr(buf_idx);
+      if (r->is_write) {
+        /* Deferred bounce write: stage the caller bytes now. The wrapper
+         * keeps the source alive until wait(). */
+        memcpy(r->buf, r->wsrc, r->len);
+        st_bounce.fetch_add(r->len, std::memory_order_relaxed);
+      }
+      dispatch_locked(r);
+      return;
+    }
+    free_bufs.push_back(buf_idx);
+  }
+
+  void reaper_loop() {
+    bool stop = false;
+    while (!stop) {
+      ring.reap([&](uint64_t ud, int32_t res) {
+        if (ud == kShutdownUserData) { stop = true; return; }
+        Req *r;
+        FileEnt fe;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = reqs.find((int64_t)ud);
+          if (it == reqs.end()) return;
+          r = it->second;
+          auto fit = files.find(r->fh);
+          if (fit == files.end()) {
+            r->status = -EBADF;
+            complete_locked(r);
+            return;
+          }
+          fe = fit->second;
+        }
+        if (r->is_write) {
+          if (res >= 0 && (uint64_t)res == r->len) {
+            r->status = 0;
+            r->done_len = r->len;
+            if (r->direct)
+              st_written.fetch_add(r->len, std::memory_order_relaxed);
+            else
+              st_bounce.fetch_add(r->len, std::memory_order_relaxed);
+          } else {
+            st_retry.fetch_add(1, std::memory_order_relaxed);
+            write_sync(r, fe); /* rescue: finish/retry synchronously */
+          }
+          complete(r);
+          return;
+        }
+        /* Direct reads were submitted over the aligned span (head bytes of
+         * slack precede the payload); buffered reads were submitted at the
+         * exact offset and return at most `avail`. */
+        uint64_t head = r->direct ? r->offset - r->a_off : 0;
+        uint64_t avail = r->offset < (uint64_t)fe.size
+                             ? std::min<uint64_t>(r->len, fe.size - r->offset)
+                             : 0;
+        if (res >= 0 && (uint64_t)res >= head + avail) {
+          r->status = 0;
+          r->done_len = avail;
+          if (r->direct)
+            st_direct.fetch_add(avail, std::memory_order_relaxed);
+          else {
+            r->was_fallback = true;
+            st_fallback.fetch_add(avail, std::memory_order_relaxed);
+            st_bounce.fetch_add(avail, std::memory_order_relaxed);
+          }
+        } else {
+          /* Short read or error (EINVAL on tmpfs etc.): rescue path. */
+          st_retry.fetch_add(1, std::memory_order_relaxed);
+          r->direct = false;
+          read_sync(r, fe);
+          r->was_fallback = true;
+        }
+        complete(r);
+      });
+    }
+  }
+
+  void worker_loop() {
+    for (;;) {
+      Req *r;
+      FileEnt fe;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [&] { return stopping || !work_q.empty(); });
+        if (stopping && work_q.empty()) return;
+        r = work_q.front();
+        work_q.pop_front();
+        auto fit = files.find(r->fh);
+        if (fit == files.end()) {
+          r->status = -EBADF;
+          complete_locked(r);
+          continue;
+        }
+        fe = fit->second;
+      }
+      if (r->is_write)
+        write_sync(r, fe);
+      else
+        read_sync(r, fe);
+      complete(r);
+    }
+  }
+};
+
+/* ------------------------- public C ABI ------------------------- */
+
+extern "C" {
+
+strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
+                                  uint64_t buf_bytes, uint32_t alignment,
+                                  int use_io_uring, int lock_buffers) {
+  if (!queue_depth || !n_buffers || !buf_bytes || !alignment ||
+      (alignment & (alignment - 1))) {
+    errno = EINVAL;
+    return nullptr;
+  }
+  auto *e = new strom_engine();
+  e->queue_depth = queue_depth;
+  e->n_buffers = n_buffers;
+  e->alignment = alignment;
+  e->buf_bytes = buf_bytes;
+  e->buf_cap = align_up(buf_bytes, alignment) + 2 * (uint64_t)alignment;
+  e->pool_sz = (size_t)e->buf_cap * n_buffers;
+  e->pool = (uint8_t *)mmap(nullptr, e->pool_sz, PROT_READ | PROT_WRITE,
+                            MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+  if (e->pool == MAP_FAILED) { delete e; return nullptr; }
+  /* Pin the pool — the MAP_GPU_MEMORY analogue: the reference pins BAR1
+   * pages so DMA targets never move (SURVEY.md §3.2); we pin staging pages
+   * so neither NVMe DMA nor the TPU transfer hits a fault. Soft-fail. */
+  if (lock_buffers) e->locked = mlock(e->pool, e->pool_sz) == 0;
+  for (int i = (int)n_buffers - 1; i >= 0; i--) e->free_bufs.push_back(i);
+
+  if (use_io_uring && e->ring.init(queue_depth * 2)) {
+    e->use_uring = true;
+    e->reaper = std::thread([e] { e->reaper_loop(); });
+  } else {
+    uint32_t nw = queue_depth < 32 ? queue_depth : 32;
+    for (uint32_t i = 0; i < nw; i++)
+      e->workers.emplace_back([e] { e->worker_loop(); });
+  }
+  return e;
+}
+
+void strom_engine_destroy(strom_engine *e) {
+  if (!e) return;
+  {
+    std::lock_guard<std::mutex> g(e->mu);
+    e->stopping = true;
+    for (Req *r : e->defer_q) {
+      r->status = -ECANCELED;
+      e->complete_locked(r);
+    }
+    e->defer_q.clear();
+    e->cv_work.notify_all();
+  }
+  if (e->use_uring) {
+    {
+      std::lock_guard<std::mutex> g(e->mu);
+      e->ring.submit(kOpNop, -1, 0, nullptr, 0, kShutdownUserData);
+    }
+    if (e->reaper.joinable()) e->reaper.join();
+    e->ring.teardown();
+  }
+  for (auto &w : e->workers)
+    if (w.joinable()) w.join();
+  for (auto &kv : e->files) {
+    if (kv.second.fd_direct >= 0) close(kv.second.fd_direct);
+    if (kv.second.fd_buffered >= 0) close(kv.second.fd_buffered);
+  }
+  for (auto &kv : e->reqs) delete kv.second;
+  if (e->pool) munmap(e->pool, e->pool_sz);
+  delete e;
+}
+
+int strom_check_file(const char *path, strom_file_info *out) {
+  memset(out, 0, sizeof(*out));
+  struct stat st;
+  if (stat(path, &st) != 0) return -errno;
+  out->size = (int64_t)st.st_size;
+  out->block_size = (int32_t)(st.st_blksize ? st.st_blksize : 4096);
+  struct statfs sfs;
+  if (statfs(path, &sfs) == 0) out->fs_magic = (uint64_t)sfs.f_type;
+  int fd = open(path, O_RDONLY | O_DIRECT);
+  if (fd >= 0) {
+    /* Probe an actual aligned read — some filesystems accept the open but
+     * fail reads (the reference probes fs type + blockdev instead,
+     * SURVEY.md §3.3). */
+    void *p = nullptr;
+    if (posix_memalign(&p, 4096, 4096) == 0) {
+      ssize_t n = pread(fd, p, 4096, 0);
+      out->supports_direct = (n >= 0) ? 1 : 0;
+      free(p);
+    }
+    close(fd);
+  }
+  return 0;
+}
+
+int strom_open(strom_engine *e, const char *path, int flags) {
+  int writable = flags & STROM_OPEN_WRITABLE;
+  int oflags = writable ? (O_RDWR | O_CREAT) : O_RDONLY;
+  int fdb = open(path, oflags, 0644);
+  if (fdb < 0) return -errno;
+  int fdd = (flags & STROM_OPEN_NO_DIRECT)
+                ? -1
+                : open(path, oflags | O_DIRECT, 0644);
+  /* fdd == -1 is fine: tmpfs/overlayfs — all I/O takes the fallback path. */
+  struct stat st;
+  if (fstat(fdb, &st) != 0) {
+    int err = -errno;
+    close(fdb);
+    if (fdd >= 0) close(fdd);
+    return err;
+  }
+  std::lock_guard<std::mutex> g(e->mu);
+  int fh = e->next_fh++;
+  FileEnt fe;
+  fe.fd_direct = fdd;
+  fe.fd_buffered = fdb;
+  fe.size = (int64_t)st.st_size;
+  fe.writable = writable != 0;
+  e->files[fh] = fe;
+  return fh;
+}
+
+int strom_close(strom_engine *e, int fh) {
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->files.find(fh);
+  if (it == e->files.end()) return -EBADF;
+  if (it->second.fd_direct >= 0) close(it->second.fd_direct);
+  close(it->second.fd_buffered);
+  e->files.erase(it);
+  return 0;
+}
+
+int64_t strom_file_size(strom_engine *e, int fh) {
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->files.find(fh);
+  return it == e->files.end() ? -EBADF : it->second.size;
+}
+
+int strom_file_is_direct(strom_engine *e, int fh) {
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->files.find(fh);
+  return it == e->files.end() ? -EBADF : (it->second.fd_direct >= 0 ? 1 : 0);
+}
+
+int64_t strom_submit_read(strom_engine *e, int fh, uint64_t offset,
+                          uint64_t len) {
+  if (len > e->buf_bytes) return -EINVAL;
+  Req *r = new Req();
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->files.find(fh);
+  if (it == e->files.end()) { delete r; return -EBADF; }
+  if (e->stopping) { delete r; return -ECANCELED; }
+  /* Refresh size: the file may have grown since open. */
+  struct stat st;
+  if (fstat(it->second.fd_buffered, &st) == 0)
+    it->second.size = (int64_t)st.st_size;
+  const FileEnt &fe = it->second;
+  r->id = e->next_req++;
+  r->fh = fh;
+  r->offset = offset;
+  r->len = len;
+  r->a_off = align_down(offset, e->alignment);
+  r->a_len = align_up(offset + len, e->alignment) - r->a_off;
+  r->direct = fe.fd_direct >= 0;
+  e->reqs[r->id] = r;
+  e->st_sub.fetch_add(1, std::memory_order_relaxed);
+  if (e->free_bufs.empty()) {
+    e->defer_q.push_back(r); /* never block the submitter */
+  } else {
+    r->buf_idx = e->free_bufs.back();
+    e->free_bufs.pop_back();
+    r->buf = e->buf_ptr(r->buf_idx);
+    e->dispatch_locked(r);
+  }
+  return r->id;
+}
+
+int strom_wait(strom_engine *e, int64_t req_id, strom_completion *out) {
+  std::unique_lock<std::mutex> lk(e->mu);
+  auto it = e->reqs.find(req_id);
+  if (it == e->reqs.end()) return -ENOENT;
+  Req *r = it->second;
+  e->cv_done.wait(lk, [&] { return r->state == ReqState::kDone; });
+  if (out) {
+    out->data = r->is_write ? nullptr
+                            : r->buf + (r->offset - r->a_off);
+    out->len = r->done_len;
+    out->status = r->status;
+    out->was_fallback = r->was_fallback ? 1 : 0;
+  }
+  return r->status;
+}
+
+int strom_release(strom_engine *e, int64_t req_id) {
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->reqs.find(req_id);
+  if (it == e->reqs.end()) return -ENOENT;
+  Req *r = it->second;
+  if (r->state != ReqState::kDone) return -EBUSY;
+  if (r->buf_idx >= 0) e->assign_or_free_locked(r->buf_idx);
+  e->reqs.erase(it);
+  delete r;
+  return 0;
+}
+
+int64_t strom_submit_write(strom_engine *e, int fh, uint64_t offset,
+                           const void *src, uint64_t len) {
+  Req *r = new Req();
+  r->is_write = true;
+  std::lock_guard<std::mutex> lk(e->mu);
+  auto it = e->files.find(fh);
+  if (it == e->files.end()) { delete r; return -EBADF; }
+  if (!it->second.writable) { delete r; return -EACCES; }
+  if (e->stopping) { delete r; return -ECANCELED; }
+  const FileEnt &fe = it->second;
+  bool conformant = ((uint64_t)src % e->alignment == 0) &&
+                    (offset % e->alignment == 0) &&
+                    (len % e->alignment == 0) && fe.fd_direct >= 0;
+  r->id = e->next_req++;
+  r->fh = fh;
+  r->offset = offset;
+  r->len = len;
+  r->direct = conformant;
+  r->wsrc = src; /* wrapper keeps src alive until wait() */
+  e->reqs[r->id] = r;
+  e->st_sub.fetch_add(1, std::memory_order_relaxed);
+  if (conformant) {
+    /* zero-copy: O_DIRECT DMA straight from caller memory, no buffer */
+    r->buf_idx = -1;
+    e->dispatch_locked(r);
+    return r->id;
+  }
+  if (len > e->buf_bytes) {
+    e->reqs.erase(r->id);
+    delete r;
+    return -EINVAL;
+  }
+  if (e->free_bufs.empty()) {
+    e->defer_q.push_back(r); /* staged (memcpy'd) when a buffer frees */
+  } else {
+    r->buf_idx = e->free_bufs.back();
+    e->free_bufs.pop_back();
+    r->buf = e->buf_ptr(r->buf_idx);
+    memcpy(r->buf, src, len); /* the one counted bounce */
+    e->st_bounce.fetch_add(len, std::memory_order_relaxed);
+    e->dispatch_locked(r);
+  }
+  return r->id;
+}
+
+void strom_get_stats(strom_engine *e, strom_stats_blk *out) {
+  out->bytes_direct = e->st_direct.load(std::memory_order_relaxed);
+  out->bytes_fallback = e->st_fallback.load(std::memory_order_relaxed);
+  out->bounce_bytes = e->st_bounce.load(std::memory_order_relaxed);
+  out->bytes_written_direct = e->st_written.load(std::memory_order_relaxed);
+  out->requests_submitted = e->st_sub.load(std::memory_order_relaxed);
+  out->requests_completed = e->st_comp.load(std::memory_order_relaxed);
+  out->requests_failed = e->st_fail.load(std::memory_order_relaxed);
+  out->retries = e->st_retry.load(std::memory_order_relaxed);
+}
+
+void strom_drain_stats(strom_engine *e, strom_stats_blk *out) {
+  out->bytes_direct = e->st_direct.exchange(0, std::memory_order_acq_rel);
+  out->bytes_fallback = e->st_fallback.exchange(0, std::memory_order_acq_rel);
+  out->bounce_bytes = e->st_bounce.exchange(0, std::memory_order_acq_rel);
+  out->bytes_written_direct =
+      e->st_written.exchange(0, std::memory_order_acq_rel);
+  out->requests_submitted = e->st_sub.exchange(0, std::memory_order_acq_rel);
+  out->requests_completed = e->st_comp.exchange(0, std::memory_order_acq_rel);
+  out->requests_failed = e->st_fail.exchange(0, std::memory_order_acq_rel);
+  out->retries = e->st_retry.exchange(0, std::memory_order_acq_rel);
+}
+
+void strom_reset_stats(strom_engine *e) {
+  e->st_direct = 0; e->st_fallback = 0; e->st_bounce = 0; e->st_written = 0;
+  e->st_sub = 0; e->st_comp = 0; e->st_fail = 0; e->st_retry = 0;
+}
+
+int strom_backend_is_uring(strom_engine *e) { return e->use_uring ? 1 : 0; }
+
+}  /* extern "C" */

@@ -1,0 +1,122 @@
+/* strom_io.h — C ABI of the strom-io engine.
+ *
+ * This header is the TPU build's analogue of the reference's nvme_strom.h
+ * ioctl ABI (SURVEY.md §1 L2): the stable contract between the native I/O
+ * engine and all userspace consumers (the ctypes wrapper in
+ * nvme_strom_tpu/io/).  Correspondence:
+ *
+ *   STROM_IOCTL__CHECK_FILE        -> strom_check_file()
+ *   STROM_IOCTL__MAP_GPU_MEMORY    -> engine-owned locked buffer pool
+ *                                     (created once in strom_engine_create)
+ *   STROM_IOCTL__MEMCPY_SSD2GPU    -> strom_submit_read()
+ *   STROM_IOCTL__MEMCPY_SSD2GPU_WAIT -> strom_wait()
+ *   STROM_IOCTL__STAT_INFO         -> strom_get_stats()
+ *
+ * All functions return 0 / a non-negative id on success and a negative errno
+ * on failure, mirroring the ioctl convention.
+ */
+#ifndef STROM_IO_H
+#define STROM_IO_H
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct strom_engine strom_engine;
+
+/* Result of strom_check_file — the CHECK_FILE eligibility probe
+ * (SURVEY.md §3.3).  Instead of "is ext4/xfs on NVMe", the TPU-relevant
+ * questions are: does the fs accept O_DIRECT (page-cache bypass possible)
+ * and what alignment does it demand. */
+typedef struct strom_file_info {
+  int64_t  size;           /* file size in bytes */
+  int32_t  supports_direct;/* 1 if O_DIRECT open+read works here */
+  int32_t  block_size;     /* required O_DIRECT alignment (logical block) */
+  uint64_t fs_magic;       /* statfs f_type */
+} strom_file_info;
+
+typedef struct strom_stats_blk {
+  uint64_t bytes_direct;         /* payload read via O_DIRECT (no host copy) */
+  uint64_t bytes_fallback;       /* payload via buffered fallback            */
+  uint64_t bounce_bytes;         /* bytes memcpy'd host-side after landing   */
+  uint64_t bytes_written_direct; /* write path (checkpointing)               */
+  uint64_t requests_submitted;
+  uint64_t requests_completed;
+  uint64_t requests_failed;
+  uint64_t retries;
+} strom_stats_blk;
+
+typedef struct strom_completion {
+  const uint8_t *data;   /* pointer into an engine buffer; valid until
+                            strom_release(req_id). Payload starts here
+                            (alignment head already skipped).            */
+  uint64_t len;          /* payload length actually read                 */
+  int32_t  status;       /* 0 ok; negative errno                         */
+  int32_t  was_fallback; /* 1 if this request took the buffered path     */
+} strom_completion;
+
+/* Create an engine.
+ *   queue_depth  — io_uring SQ depth / worker count for the fallback pool
+ *   n_buffers    — buffers in the staging pool (>= queue_depth recommended)
+ *   buf_bytes    — payload capacity of each buffer (max read size)
+ *   alignment    — O_DIRECT alignment (power of two, >= 512)
+ *   use_io_uring — 0 forces the thread-pool backend
+ *   lock_buffers — mlock the pool (pin pages, as MAP_GPU_MEMORY pins BAR1)
+ * Returns NULL on failure (errno set). */
+strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
+                                  uint64_t buf_bytes, uint32_t alignment,
+                                  int use_io_uring, int lock_buffers);
+void strom_engine_destroy(strom_engine *eng);
+
+/* Engine-independent file eligibility probe (CHECK_FILE analogue). */
+int strom_check_file(const char *path, strom_file_info *out);
+
+/* Open a file for engine I/O. Tries O_DIRECT first; transparently falls
+ * back to buffered (counted per-request). Returns fh >= 0 or -errno.
+ * flags: bit 0 = writable; bit 1 = force buffered I/O (debug/testing knob,
+ * like the reference's module params — SURVEY.md §5 Config/flags). */
+int strom_open(strom_engine *eng, const char *path, int flags);
+#define STROM_OPEN_WRITABLE 1
+#define STROM_OPEN_NO_DIRECT 2
+int strom_close(strom_engine *eng, int fh);
+int64_t strom_file_size(strom_engine *eng, int fh);
+int strom_file_is_direct(strom_engine *eng, int fh);
+
+/* Submit an async read of [offset, offset+len). len must be
+ * <= buf_bytes. Unaligned offset/len are handled by reading the enclosing
+ * aligned span; the completion's data pointer is pre-offset (no copy).
+ * Blocks if no staging buffer is free. Returns req_id >= 0 or -errno. */
+int64_t strom_submit_read(strom_engine *eng, int fh, uint64_t offset,
+                          uint64_t len);
+
+/* Wait until req_id completes; fills *out. The buffer stays owned by the
+ * request until strom_release. */
+int strom_wait(strom_engine *eng, int64_t req_id, strom_completion *out);
+
+/* Return the request's staging buffer to the pool. */
+int strom_release(strom_engine *eng, int64_t req_id);
+
+/* Async write of len bytes from src to [offset, offset+len) (checkpoint /
+ * HBM->NVMe path). If src and offset/len are alignment-conformant the
+ * write is O_DIRECT straight from src (zero copy); otherwise it bounces
+ * through a pool buffer (counted). Returns req_id; wait with strom_wait;
+ * release with strom_release. */
+int64_t strom_submit_write(strom_engine *eng, int fh, uint64_t offset,
+                           const void *src, uint64_t len);
+
+void strom_get_stats(strom_engine *eng, strom_stats_blk *out);
+void strom_reset_stats(strom_engine *eng);
+/* Atomically read-and-zero every counter (per-counter exchange): no
+ * increment can be lost between the read and the reset. */
+void strom_drain_stats(strom_engine *eng, strom_stats_blk *out);
+
+/* Introspection for tests/bench. */
+int strom_backend_is_uring(strom_engine *eng);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* STROM_IO_H */

@@ -1,0 +1,10 @@
+from nvme_strom_tpu.io.engine import (
+    StromEngine,
+    PendingRead,
+    PendingWrite,
+    FileInfo,
+    check_file,
+)
+
+__all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
+           "check_file"]

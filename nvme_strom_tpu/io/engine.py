@@ -1,0 +1,306 @@
+"""ctypes wrapper over the strom-io C++ engine (csrc/strom_io.{h,cc}).
+
+This is the userspace library layer of the stack — the analogue of the thin
+wrappers PG-Strom keeps around the reference's ioctl ABI (SURVEY.md §1 L2/L4).
+Python never touches payload bytes: reads complete into engine-owned locked
+buffers, exposed here as zero-copy numpy views via ``np.ctypeslib.as_array``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats, global_stats
+
+_CSRC = Path(__file__).resolve().parents[2] / "csrc"
+_LIB_PATH = _CSRC / "libstrom_io.so"
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+class _FileInfo(ctypes.Structure):
+    _fields_ = [
+        ("size", ctypes.c_int64),
+        ("supports_direct", ctypes.c_int32),
+        ("block_size", ctypes.c_int32),
+        ("fs_magic", ctypes.c_uint64),
+    ]
+
+
+class _StatsBlk(ctypes.Structure):
+    _fields_ = [(n, ctypes.c_uint64) for n in (
+        "bytes_direct", "bytes_fallback", "bounce_bytes",
+        "bytes_written_direct", "requests_submitted", "requests_completed",
+        "requests_failed", "retries")]
+
+
+class _Completion(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.POINTER(ctypes.c_uint8)),
+        ("len", ctypes.c_uint64),
+        ("status", ctypes.c_int32),
+        ("was_fallback", ctypes.c_int32),
+    ]
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not _LIB_PATH.exists():
+            subprocess.run(["make", "-C", str(_CSRC)], check=True,
+                           capture_output=True)
+        lib = ctypes.CDLL(str(_LIB_PATH), use_errno=True)
+        lib.strom_engine_create.restype = ctypes.c_void_p
+        lib.strom_engine_create.argtypes = [
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_uint32, ctypes.c_int, ctypes.c_int]
+        lib.strom_engine_destroy.argtypes = [ctypes.c_void_p]
+        lib.strom_check_file.argtypes = [ctypes.c_char_p,
+                                         ctypes.POINTER(_FileInfo)]
+        lib.strom_open.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
+        lib.strom_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.strom_file_size.restype = ctypes.c_int64
+        lib.strom_file_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.strom_file_is_direct.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.strom_submit_read.restype = ctypes.c_int64
+        lib.strom_submit_read.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                          ctypes.c_uint64, ctypes.c_uint64]
+        lib.strom_submit_write.restype = ctypes.c_int64
+        lib.strom_submit_write.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                           ctypes.c_uint64, ctypes.c_void_p,
+                                           ctypes.c_uint64]
+        lib.strom_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                   ctypes.POINTER(_Completion)]
+        lib.strom_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.strom_get_stats.argtypes = [ctypes.c_void_p,
+                                        ctypes.POINTER(_StatsBlk)]
+        lib.strom_drain_stats.argtypes = [ctypes.c_void_p,
+                                          ctypes.POINTER(_StatsBlk)]
+        lib.strom_reset_stats.argtypes = [ctypes.c_void_p]
+        lib.strom_backend_is_uring.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+@dataclass(frozen=True)
+class FileInfo:
+    """Result of the CHECK_FILE-analogue eligibility probe (SURVEY.md §3.3)."""
+    size: int
+    supports_direct: bool
+    block_size: int
+    fs_magic: int
+
+
+def check_file(path: os.PathLike | str) -> FileInfo:
+    lib = _load_lib()
+    info = _FileInfo()
+    rc = lib.strom_check_file(str(path).encode(), ctypes.byref(info))
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), str(path))
+    return FileInfo(size=info.size, supports_direct=bool(info.supports_direct),
+                    block_size=info.block_size, fs_magic=info.fs_magic)
+
+
+class PendingRead:
+    """An in-flight read — MEMCPY_SSD2GPU's async DMA task id (SURVEY §3.1).
+
+    ``wait()`` returns a zero-copy numpy view into the engine buffer; the
+    view is valid until ``release()``.
+    """
+
+    def __init__(self, engine: "StromEngine", req_id: int, length: int):
+        self._engine = engine
+        self._req_id = req_id
+        self._length = length
+        self._released = False
+        self._view: Optional[np.ndarray] = None
+        self.was_fallback = False
+
+    def wait(self) -> np.ndarray:
+        if self._view is not None:
+            return self._view
+        comp = _Completion()
+        rc = self._engine._lib.strom_wait(self._engine._h, self._req_id,
+                                          ctypes.byref(comp))
+        if rc < 0:
+            self.release()
+            raise OSError(-rc, os.strerror(-rc))
+        self.was_fallback = bool(comp.was_fallback)
+        n = int(comp.len)
+        if n == 0:
+            self._view = np.empty(0, dtype=np.uint8)
+        else:
+            self._view = np.ctypeslib.as_array(comp.data, shape=(n,))
+        return self._view
+
+    def release(self) -> None:
+        if not self._released:
+            self._engine._lib.strom_release(self._engine._h, self._req_id)
+            self._released = True
+            self._view = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class PendingWrite:
+    def __init__(self, engine: "StromEngine", req_id: int,
+                 keepalive: Optional[np.ndarray]):
+        self._engine = engine
+        self._req_id = req_id
+        self._keepalive = keepalive  # zero-copy source must outlive the I/O
+
+    def wait(self) -> int:
+        comp = _Completion()
+        rc = self._engine._lib.strom_wait(self._engine._h, self._req_id,
+                                          ctypes.byref(comp))
+        n = int(comp.len)
+        self._engine._lib.strom_release(self._engine._h, self._req_id)
+        self._keepalive = None
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return n
+
+
+class StromEngine:
+    """The userspace handle to the strom-io engine.
+
+    One engine owns one io_uring and one locked staging-buffer pool (the
+    MAP_GPU_MEMORY analogue — created once, reused for every transfer).
+    """
+
+    def __init__(self, config: Optional[EngineConfig] = None,
+                 stats: Optional[StromStats] = None):
+        self.config = config or EngineConfig()
+        self.stats = stats if stats is not None else global_stats
+        self._lib = _load_lib()
+        c = self.config
+        n_buffers = max(
+            2, min(64, c.buffer_pool_bytes // max(1, c.chunk_bytes)))
+        self._h = self._lib.strom_engine_create(
+            c.queue_depth, n_buffers, c.chunk_bytes, c.alignment,
+            1 if c.use_io_uring else 0, 1 if c.lock_buffers else 0)
+        if not self._h:
+            raise OSError(ctypes.get_errno(),
+                          "strom_engine_create failed: "
+                          + os.strerror(ctypes.get_errno()))
+        self.n_buffers = n_buffers
+        self._open_fhs: set[int] = set()
+        self._closed = False
+
+    # -- file handles ------------------------------------------------------
+
+    def open(self, path: os.PathLike | str, writable: bool = False,
+             force_buffered: bool = False) -> int:
+        flags = (1 if writable else 0) | (2 if force_buffered else 0)
+        fh = self._lib.strom_open(self._h, str(path).encode(), flags)
+        if fh < 0:
+            raise OSError(-fh, os.strerror(-fh), str(path))
+        self._open_fhs.add(fh)
+        return fh
+
+    def close(self, fh: int) -> None:
+        self._lib.strom_close(self._h, fh)
+        self._open_fhs.discard(fh)
+
+    def file_size(self, fh: int) -> int:
+        n = self._lib.strom_file_size(self._h, fh)
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        return n
+
+    def file_is_direct(self, fh: int) -> bool:
+        return self._lib.strom_file_is_direct(self._h, fh) == 1
+
+    # -- reads -------------------------------------------------------------
+
+    def submit_read(self, fh: int, offset: int, length: int) -> PendingRead:
+        if length > self.config.chunk_bytes:
+            raise ValueError(
+                f"read length {length} exceeds chunk_bytes "
+                f"{self.config.chunk_bytes}; split the range")
+        rid = self._lib.strom_submit_read(self._h, fh, offset, length)
+        if rid < 0:
+            raise OSError(-rid, os.strerror(-rid))
+        return PendingRead(self, rid, length)
+
+    def read(self, fh: int, offset: int, length: int) -> np.ndarray:
+        """Synchronous convenience read returning an *owning* array.
+
+        The copy out of the staging buffer is counted as bounce bytes — use
+        ``submit_read`` + the JAX bridge for the zero-copy path.
+        """
+        with self.submit_read(fh, offset, length) as p:
+            out = p.wait().copy()
+        self.stats.add(bounce_bytes=int(out.nbytes))
+        return out
+
+    # -- writes ------------------------------------------------------------
+
+    def submit_write(self, fh: int, offset: int,
+                     data: np.ndarray) -> PendingWrite:
+        arr = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
+        ptr = arr.ctypes.data_as(ctypes.c_void_p)
+        rid = self._lib.strom_submit_write(self._h, fh, offset, ptr,
+                                           arr.nbytes)
+        if rid < 0:
+            raise OSError(-rid, os.strerror(-rid))
+        return PendingWrite(self, rid, arr)
+
+    # -- stats / lifecycle -------------------------------------------------
+
+    def engine_stats(self) -> dict:
+        blk = _StatsBlk()
+        self._lib.strom_get_stats(self._h, ctypes.byref(blk))
+        return {n: int(getattr(blk, n)) for n, _ in _StatsBlk._fields_}
+
+    def sync_stats(self) -> dict:
+        """Atomically drain engine counters into the Python StromStats block
+        (per-counter exchange in C — no increment can fall between read and
+        reset)."""
+        blk = _StatsBlk()
+        self._lib.strom_drain_stats(self._h, ctypes.byref(blk))
+        snap = {n: int(getattr(blk, n)) for n, _ in _StatsBlk._fields_}
+        self.stats.merge_engine(snap)
+        return snap
+
+    @property
+    def backend(self) -> str:
+        return "io_uring" if self._lib.strom_backend_is_uring(self._h) \
+            else "threadpool"
+
+    def close_all(self) -> None:
+        if self._closed:
+            return
+        self.sync_stats()
+        self._lib.strom_engine_destroy(self._h)
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close_all()
+
+    def __del__(self):
+        try:
+            if not getattr(self, "_closed", True):
+                self._lib.strom_engine_destroy(self._h)
+                self._closed = True
+        except Exception:
+            pass

@@ -41,15 +41,26 @@ class EngineConfig:
         default_factory=lambda: _env_int("STROM_MAX_RETRIES", 2))
 
     def __post_init__(self):
-        if self.alignment <= 0 or (self.alignment & (self.alignment - 1)):
+        if (self.alignment < 512 or self.alignment > (1 << 22)
+                or (self.alignment & (self.alignment - 1))):
             raise ValueError(
-                f"alignment ({self.alignment}) must be a positive power of two"
+                f"alignment ({self.alignment}) must be a power of two in "
+                f"[512, 4MiB] (O_DIRECT logical-block constraint)"
             )
         if self.chunk_bytes <= 0 or self.chunk_bytes % self.alignment:
             raise ValueError(
                 f"chunk_bytes ({self.chunk_bytes}) must be a positive "
                 f"multiple of alignment ({self.alignment})"
             )
+        if not 1 <= self.queue_depth <= 4096:
+            raise ValueError(
+                f"queue_depth ({self.queue_depth}) must be in [1, 4096]")
+        if self.buffer_pool_bytes < self.chunk_bytes:
+            raise ValueError(
+                f"buffer_pool_bytes ({self.buffer_pool_bytes}) must hold at "
+                f"least one chunk ({self.chunk_bytes})")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
 
 
 @dataclass(frozen=True)

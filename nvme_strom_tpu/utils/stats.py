@@ -24,10 +24,15 @@ Semantics of the byte counters:
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import threading
 import time
 from dataclasses import dataclass, field
+
+#: Every public counter on StromStats, derived once from the dataclass —
+#: snapshot/reset/merge iterate this so a new counter needs exactly one edit.
+COUNTER_FIELDS: tuple = ()  # filled in after the class definition
 
 
 @dataclass
@@ -53,16 +58,8 @@ class StromStats:
 
     def merge_engine(self, engine_stats: dict) -> None:
         """Fold counters read from the C++ engine into this block."""
-        self.add(
-            bytes_direct=engine_stats.get("bytes_direct", 0),
-            bytes_fallback=engine_stats.get("bytes_fallback", 0),
-            bounce_bytes=engine_stats.get("bounce_bytes", 0),
-            bytes_written_direct=engine_stats.get("bytes_written_direct", 0),
-            requests_submitted=engine_stats.get("requests_submitted", 0),
-            requests_completed=engine_stats.get("requests_completed", 0),
-            requests_failed=engine_stats.get("requests_failed", 0),
-            retries=engine_stats.get("retries", 0),
-        )
+        self.add(**{k: v for k, v in engine_stats.items()
+                    if k in COUNTER_FIELDS})
 
     @property
     def total_payload_bytes(self) -> int:
@@ -74,31 +71,20 @@ class StromStats:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
-                "bytes_direct": self.bytes_direct,
-                "bytes_fallback": self.bytes_fallback,
-                "bounce_bytes": self.bounce_bytes,
-                "bytes_to_device": self.bytes_to_device,
-                "bytes_written_direct": self.bytes_written_direct,
-                "requests_submitted": self.requests_submitted,
-                "requests_completed": self.requests_completed,
-                "requests_failed": self.requests_failed,
-                "retries": self.retries,
-            }
+            return {name: getattr(self, name) for name in COUNTER_FIELDS}
 
     def dump_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
 
     def reset(self) -> None:
         with self._lock:
-            for name in (
-                "bytes_direct", "bytes_fallback", "bounce_bytes",
-                "bytes_to_device", "bytes_written_direct",
-                "requests_submitted", "requests_completed",
-                "requests_failed", "retries",
-            ):
+            for name in COUNTER_FIELDS:
                 setattr(self, name, 0)
             self._t0 = time.monotonic()
 
+
+COUNTER_FIELDS = tuple(
+    f.name for f in dataclasses.fields(StromStats)
+    if not f.name.startswith("_"))
 
 global_stats = StromStats()

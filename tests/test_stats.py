@@ -43,3 +43,16 @@ def test_engine_config_alignment_check():
     EngineConfig(chunk_bytes=8192, alignment=4096)
     with pytest.raises(ValueError):
         EngineConfig(chunk_bytes=5000, alignment=4096)
+    with pytest.raises(ValueError):
+        EngineConfig(alignment=64)  # below O_DIRECT minimum
+    with pytest.raises(ValueError):
+        EngineConfig(queue_depth=0)
+    with pytest.raises(ValueError):
+        EngineConfig(chunk_bytes=4 << 20, buffer_pool_bytes=1 << 20)
+
+
+def test_counter_fields_single_source():
+    from nvme_strom_tpu.utils.stats import COUNTER_FIELDS
+    s = StromStats()
+    assert set(s.snapshot()) == set(COUNTER_FIELDS)
+    assert "bytes_to_device" in COUNTER_FIELDS
