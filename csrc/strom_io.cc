@@ -363,7 +363,10 @@ struct strom_engine {
     r->done_len = put;
     if (!r->was_fallback && r->direct)
       st_written.fetch_add(put, std::memory_order_relaxed);
-    else
+    else if (r->buf_idx < 0)
+      /* Zero-copy attempt that fell back to buffered: the kernel's
+       * page-cache copy is the bounce. (Staged writes already counted
+       * their bounce at the memcpy into the staging buffer.) */
       st_bounce.fetch_add(put, std::memory_order_relaxed);
   }
 
@@ -462,7 +465,9 @@ struct strom_engine {
             r->done_len = r->len;
             if (r->direct)
               st_written.fetch_add(r->len, std::memory_order_relaxed);
-            else
+            else if (r->buf_idx < 0)
+              /* See write_sync: staged writes counted their bounce at the
+               * staging memcpy already. */
               st_bounce.fetch_add(r->len, std::memory_order_relaxed);
           } else {
             st_retry.fetch_add(1, std::memory_order_relaxed);
@@ -569,7 +574,7 @@ strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
 void strom_engine_destroy(strom_engine *e) {
   if (!e) return;
   {
-    std::lock_guard<std::mutex> g(e->mu);
+    std::unique_lock<std::mutex> lk(e->mu);
     e->stopping = true;
     for (Req *r : e->defer_q) {
       r->status = -ECANCELED;
@@ -577,6 +582,13 @@ void strom_engine_destroy(strom_engine *e) {
     }
     e->defer_q.clear();
     e->cv_work.notify_all();
+    /* Drain: every in-flight request's DMA targets the staging pool — the
+     * pool cannot be unmapped until the kernel is done with it. */
+    e->cv_done.wait(lk, [&] {
+      for (auto &kv : e->reqs)
+        if (kv.second->state != ReqState::kDone) return false;
+      return true;
+    });
   }
   if (e->use_uring) {
     {

@@ -9,6 +9,7 @@ buffers, exposed here as zero-copy numpy views via ``np.ctypeslib.as_array``.
 from __future__ import annotations
 
 import ctypes
+import errno
 import os
 import subprocess
 import threading
@@ -57,7 +58,9 @@ def _load_lib() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not _LIB_PATH.exists():
+        src_mtime = max((_CSRC / n).stat().st_mtime
+                        for n in ("strom_io.cc", "strom_io.h"))
+        if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < src_mtime:
             subprocess.run(["make", "-C", str(_CSRC)], check=True,
                            capture_output=True)
         lib = ctypes.CDLL(str(_LIB_PATH), use_errno=True)
@@ -146,10 +149,16 @@ class PendingRead:
         return self._view
 
     def release(self) -> None:
-        if not self._released:
+        if self._released:
+            return
+        rc = self._engine._lib.strom_release(self._engine._h, self._req_id)
+        if rc == -errno.EBUSY:
+            # Still in flight: the staging buffer is a live DMA target and
+            # must not be recycled yet — wait for completion, then free.
+            self._engine._lib.strom_wait(self._engine._h, self._req_id, None)
             self._engine._lib.strom_release(self._engine._h, self._req_id)
-            self._released = True
-            self._view = None
+        self._released = True
+        self._view = None
 
     def __enter__(self):
         return self

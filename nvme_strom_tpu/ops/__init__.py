@@ -1,0 +1,3 @@
+from nvme_strom_tpu.ops.bridge import DeviceStream, write_from_device
+
+__all__ = ["DeviceStream", "write_from_device"]

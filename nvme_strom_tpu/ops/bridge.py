@@ -1,0 +1,167 @@
+"""JAX bridge: engine staging buffers → device-resident arrays.
+
+This is the consumer half of the reference's hot path (SURVEY.md §3.1): where
+the reference DMAs NVMe blocks into pre-pinned CUDA BAR1 pages and userspace
+then launches kernels on them, we hand the engine's locked staging buffer
+*by pointer* to JAX — ``np.ctypeslib`` views cost zero copies — and let PJRT
+run the host→device PCIe transfer straight out of that buffer.  With
+``depth > 1`` the next chunk's NVMe read overlaps the current chunk's PCIe
+transfer, so the SSD and the PCIe link stay concurrently busy — the same
+pipelining the reference gets from N in-flight DMA requests (SURVEY.md §3.4).
+
+The staging buffer is released back to the pool only after
+``block_until_ready`` confirms the device transfer consumed it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from nvme_strom_tpu.io.engine import StromEngine, PendingRead
+from nvme_strom_tpu.utils.config import EngineConfig
+
+
+def _default_device():
+    import jax
+    return jax.local_devices()[0]
+
+
+class DeviceStream:
+    """Pipelined NVMe→HBM chunk stream over one engine.
+
+    ``depth`` chunks are kept in flight: while chunk *k* rides PCIe to the
+    device, chunks *k+1 … k+depth* are being DMA'd from NVMe into staging
+    buffers.  Yields device-resident arrays.
+    """
+
+    def __init__(self, engine: StromEngine, device=None, depth: int = 3):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.engine = engine
+        self.device = device
+        self.depth = depth
+
+    def _put(self, view: np.ndarray, dtype, shape):
+        import jax
+        dev = self.device or _default_device()
+        arr = view if dtype is None else view.view(dtype)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        if dev.platform == "cpu":
+            # On a host-backed device jax.device_put may ALIAS the numpy
+            # buffer — but the staging buffer is recycled after release().
+            # Materialise a copy; on the CPU backend that host memcpy is a
+            # real bounce and is counted as such. On TPU the PCIe transfer
+            # itself moves the bytes and no host copy exists.
+            arr = np.array(arr)
+            self.engine.stats.add(bounce_bytes=int(view.nbytes))
+        out = jax.device_put(arr, dev)
+        self.engine.stats.add(bytes_to_device=int(view.nbytes))
+        return out
+
+    def stream_file(self, path, chunk_bytes: Optional[int] = None,
+                    dtype=None) -> Iterator:
+        """Yield device arrays of consecutive file chunks (uint8 unless
+        ``dtype`` given; chunk_bytes must then be dtype-size aligned)."""
+        chunk = chunk_bytes or self.engine.config.chunk_bytes
+        if chunk > self.engine.config.chunk_bytes:
+            raise ValueError("chunk_bytes exceeds engine buffer capacity")
+        fh = self.engine.open(path)
+        try:
+            size = self.engine.file_size(fh)
+            offsets = list(range(0, size, chunk))
+            yield from self.stream_ranges(
+                fh, [(o, min(chunk, size - o)) for o in offsets], dtype=dtype)
+        finally:
+            self.engine.close(fh)
+
+    def stream_ranges(self, fh: int, ranges: Sequence[tuple[int, int]],
+                      dtype=None, shapes: Optional[Sequence] = None
+                      ) -> Iterator:
+        """Yield device arrays for arbitrary (offset, length) ranges of an
+        open file — the planner-facing API used by the format readers."""
+        pending: list = []   # (PendingRead, shape)
+        inflight: list = []  # (device_array, PendingRead)
+
+        def drain_one():
+            arr, pr = inflight.pop(0)
+            arr.block_until_ready()  # device owns the bytes now
+            pr.release()
+            return arr
+
+        it = iter(ranges)
+        shapes_it = iter(shapes) if shapes is not None else None
+        try:
+            for i, (off, ln) in enumerate(it):
+                shape = next(shapes_it) if shapes_it is not None else None
+                pending.append((self.engine.submit_read(fh, off, ln), shape))
+                # keep `depth` reads in flight before starting transfers
+                while len(pending) > self.depth:
+                    pr, shp = pending.pop(0)
+                    view = pr.wait()
+                    inflight.append((self._put(view, dtype, shp), pr))
+                    while len(inflight) > self.depth:
+                        yield drain_one()
+            for pr, shp in pending:
+                view = pr.wait()
+                inflight.append((self._put(view, dtype, shp), pr))
+            pending = []
+            while inflight:
+                yield drain_one()
+        finally:
+            for pr, _ in pending:
+                try:
+                    pr.wait()
+                except OSError:
+                    pass
+                pr.release()
+            for _, pr in inflight:
+                pr.release()
+
+    def read_to_device(self, path, dtype=None, shape=None):
+        """Whole file → one device array (concatenated on device, not host).
+
+        Chunks stream independently to the device and are joined with a
+        jitted concatenate there, so no host-side assembly buffer exists.
+        """
+        import jax.numpy as jnp
+        parts = list(self.stream_file(path))  # uint8 chunks on device
+        if not parts:
+            out = jnp.zeros((0,), dtype=jnp.uint8)
+        elif len(parts) == 1:
+            out = parts[0]
+        else:
+            out = jnp.concatenate(parts)
+        if dtype is not None:
+            out = out.view(dtype)  # on-device bitcast, no transfer
+        if shape is not None:
+            out = out.reshape(shape)
+        return out
+
+
+def write_from_device(engine: StromEngine, array, path,
+                      offset: int = 0) -> int:
+    """Device array → NVMe (the checkpoint/inverse path, SURVEY.md §5).
+
+    The device→host transfer lands in one numpy buffer; chunks of it are
+    then submitted as pipelined engine writes (O_DIRECT zero-copy when the
+    chunk is alignment-conformant, bounced + counted otherwise).
+    """
+    host = np.ascontiguousarray(np.asarray(array)).view(np.uint8).reshape(-1)
+    chunk = engine.config.chunk_bytes
+    fh = engine.open(path, writable=True)
+    total = 0
+    try:
+        pend = []
+        for pos in range(0, host.nbytes, chunk):
+            part = host[pos:pos + chunk]
+            pend.append(engine.submit_write(fh, offset + pos, part))
+            if len(pend) >= engine.config.queue_depth:
+                total += pend.pop(0).wait()
+        for p in pend:
+            total += p.wait()
+    finally:
+        engine.close(fh)
+    return total

@@ -186,6 +186,43 @@ def test_oversized_read_rejected(engine, tmp_data_file):
     engine.close(fh)
 
 
+def test_release_before_wait_returns_buffer(engine, tmp_data_file):
+    """release() on an in-flight request must wait then free — not leak.
+    Regression: -EBUSY from strom_release was silently dropped."""
+    path, payload = tmp_data_file
+    fh = engine.open(path)
+    n_cycles = 3 * engine.n_buffers
+    for i in range(n_cycles):
+        p = engine.submit_read(fh, 0, 64 << 10)
+        p.release()  # no wait()
+    # pool must still be fully usable
+    with engine.submit_read(fh, 0, 4096) as p:
+        assert p.wait().tobytes() == payload[:4096]
+    engine.close(fh)
+
+
+def test_destroy_with_inflight_requests(tmp_data_file):
+    """Engine teardown must drain in-flight DMA before unmapping the pool."""
+    path, _ = tmp_data_file
+    for uring in (True, False):
+        e = StromEngine(_cfg(use_io_uring=uring), stats=StromStats())
+        fh = e.open(path)
+        for i in range(8):
+            e.submit_read(fh, i << 20, 1 << 20)  # never waited
+        e.close_all()  # must not crash or hang
+
+
+def test_write_bounce_counted_once(engine, tmp_path):
+    """A staged (unaligned) write counts its payload as bounce exactly once."""
+    path = tmp_path / "w.bin"
+    fh = engine.open(path, writable=True)
+    data = np.arange(1000, dtype=np.uint8)
+    engine.submit_write(fh, 0, data).wait()  # unaligned len -> staged
+    engine.close(fh)
+    snap = engine.engine_stats()
+    assert snap["bounce_bytes"] == 1000
+
+
 def test_bad_handles(engine):
     with pytest.raises(OSError):
         engine.open("/no/such/file")
