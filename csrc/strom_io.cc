@@ -818,4 +818,75 @@ void strom_reset_stats(strom_engine *e) {
 
 int strom_backend_is_uring(strom_engine *e) { return e->use_uring ? 1 : 0; }
 
+/* ---------------- crc32c (Castagnoli) ---------------- */
+
+static uint32_t g_crc_tbl[8][256];
+static bool g_crc_init = false;
+
+static void crc_init_tables() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+    g_crc_tbl[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = g_crc_tbl[0][i];
+    for (int t = 1; t < 8; t++) {
+      c = g_crc_tbl[0][c & 0xFF] ^ (c >> 8);
+      g_crc_tbl[t][i] = c;
+    }
+  }
+  g_crc_init = true;
+}
+
+#if defined(__x86_64__)
+#include <cpuid.h>
+static bool has_sse42() {
+  unsigned a, b, c, d;
+  if (!__get_cpuid(1, &a, &b, &c, &d)) return false;
+  return (c & (1u << 20)) != 0;
+}
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(const uint8_t *p, uint64_t n, uint32_t c) {
+  while (n && ((uintptr_t)p & 7)) { c = __builtin_ia32_crc32qi(c, *p++); n--; }
+  uint64_t c64 = c;
+  while (n >= 8) {
+    c64 = __builtin_ia32_crc32di(c64, *(const uint64_t *)p);
+    p += 8;
+    n -= 8;
+  }
+  c = (uint32_t)c64;
+  while (n--) c = __builtin_ia32_crc32qi(c, *p++);
+  return c;
+}
+#endif
+
+uint32_t strom_crc32c(const void *data, uint64_t len, uint32_t crc) {
+  if (!g_crc_init) crc_init_tables();
+  const uint8_t *p = (const uint8_t *)data;
+  uint32_t c = ~crc;
+#if defined(__x86_64__)
+  static int hw = -1;
+  if (hw < 0) hw = has_sse42() ? 1 : 0;
+  if (hw) return ~crc32c_hw(p, len, c);
+#endif
+  while (len && ((uintptr_t)p & 7)) {
+    c = g_crc_tbl[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+    len--;
+  }
+  while (len >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    w ^= c;
+    c = g_crc_tbl[7][w & 0xFF] ^ g_crc_tbl[6][(w >> 8) & 0xFF] ^
+        g_crc_tbl[5][(w >> 16) & 0xFF] ^ g_crc_tbl[4][(w >> 24) & 0xFF] ^
+        g_crc_tbl[3][(w >> 32) & 0xFF] ^ g_crc_tbl[2][(w >> 40) & 0xFF] ^
+        g_crc_tbl[1][(w >> 48) & 0xFF] ^ g_crc_tbl[0][(w >> 56) & 0xFF];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) c = g_crc_tbl[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+  return ~c;
+}
+
 }  /* extern "C" */

@@ -116,6 +116,11 @@ void strom_drain_stats(strom_engine *eng, strom_stats_blk *out);
 /* Introspection for tests/bench. */
 int strom_backend_is_uring(strom_engine *eng);
 
+/* crc32c (Castagnoli), for TFRecord integrity checks: slice-by-8 software
+ * implementation, hardware SSE4.2 path when the CPU supports it.
+ * `crc` is the running value (0 to start); returns the updated crc. */
+uint32_t strom_crc32c(const void *data, uint64_t len, uint32_t crc);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,4 @@
+from nvme_strom_tpu.data.loader import ShardedLoader
+from nvme_strom_tpu.data.sharding import assign_shards, shuffled_indices
+
+__all__ = ["ShardedLoader", "assign_shards", "shuffled_indices"]

@@ -1,0 +1,235 @@
+"""Sharded dataloader: local-NVMe shards → globally-sharded device batches.
+
+The consumer-facing equivalent of the reference's PG-Strom integration
+(SURVEY.md §3.5): where PG-Strom pulls table blocks through the DMA ioctls
+into GPU scan kernels, this loader pulls WebDataset/TFRecord samples through
+the strom-io engine and assembles them into ``jax.Array``s sharded over a
+``Mesh`` data axis — benchmark config 3 (BASELINE.md).
+
+Pipeline per batch (prefetched in a background thread):
+
+    index shard (headers only) → planned payload ranges → engine direct
+    reads → decode (user fn; raw view for fixed-size records) → host batch
+    → make_array_from_process_local_data → global device array
+
+Every process touches only its own shards (data/sharding.py); the global
+array is assembled without bulk cross-host traffic.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from nvme_strom_tpu.data.sharding import assign_shards, shuffled_indices
+from nvme_strom_tpu.formats.tfrecord import TFRecordIndex
+from nvme_strom_tpu.formats.wds import WdsShardIndex
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.parallel.mesh import batch_sharding
+from nvme_strom_tpu.utils.config import EngineConfig, LoaderConfig
+
+_SENTINEL = object()
+
+
+def _default_decode(parts: dict) -> np.ndarray:
+    """Single-part raw samples → uint8 array (copy: counted by caller)."""
+    if len(parts) != 1:
+        raise ValueError(
+            f"sample has parts {sorted(parts)}; pass decode= to combine")
+    (payload,) = parts.values()
+    return np.frombuffer(payload, dtype=np.uint8)
+
+
+class ShardedLoader:
+    """Iterate globally-sharded batches from per-host local shards.
+
+    Args:
+      shard_paths: ALL shard files of the dataset (same list on all hosts).
+      mesh: jax Mesh; batches are sharded over `axis` (default "dp").
+      global_batch: global batch size (divided across processes).
+      fmt: "wds" or "tfrecord".
+      decode: fn(parts: dict[ext, bytes]) -> np.ndarray | dict of arrays.
+        For tfrecord, parts is {"": payload}.
+      engine: shared StromEngine (one is created if omitted).
+      exts: for wds, restrict to these extensions.
+    """
+
+    def __init__(self, shard_paths: Sequence, mesh, global_batch: int,
+                 fmt: str = "wds",
+                 decode: Optional[Callable] = None,
+                 engine: Optional[StromEngine] = None,
+                 exts: Optional[List[str]] = None,
+                 config: Optional[LoaderConfig] = None,
+                 axis: str = "dp",
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
+        import jax
+        if fmt not in ("wds", "tfrecord"):
+            raise ValueError(f"unknown fmt {fmt!r}")
+        self.mesh = mesh
+        self.axis = axis
+        self.fmt = fmt
+        self.decode = decode or _default_decode
+        self.exts = exts
+        self.config = config or LoaderConfig(batch_size=global_batch)
+        self.global_batch = global_batch
+        pi = jax.process_index() if process_index is None else process_index
+        pc = jax.process_count() if process_count is None else process_count
+        if global_batch % pc:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"{pc} processes")
+        if global_batch % mesh.shape[axis]:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by mesh axis "
+                f"{axis}={mesh.shape[axis]}")
+        self.local_batch = global_batch // pc
+        self.local_shards = assign_shards(shard_paths, pi, pc)
+        self._engine = engine or StromEngine(EngineConfig())
+        self._owns_engine = engine is None
+        self.epoch = 0
+
+    # -- sample iteration (host side) -------------------------------------
+
+    def _index_shard(self, path):
+        if self.fmt == "wds":
+            idx = WdsShardIndex(path)
+            return [
+                {ext: rng for ext, rng in idx.samples[k].items()
+                 if self.exts is None or ext in self.exts}
+                for k in idx.order
+            ]
+        idx = TFRecordIndex(path)
+        return [{"": (idx.offsets[i], idx.lengths[i])}
+                for i in range(len(idx))]
+
+    def _iter_local_samples(self) -> Iterator[np.ndarray]:
+        eng = self._engine
+        order = list(self.local_shards)
+        if self.config.shuffle_buffer:
+            perm = shuffled_indices(len(order), self.config.seed, self.epoch)
+            order = [order[i] for i in perm]
+        for path in order:
+            samples = self._index_shard(path)
+            sample_order = range(len(samples))
+            if self.config.shuffle_buffer:
+                sample_order = shuffled_indices(
+                    len(samples), self.config.seed + 1, self.epoch)
+            fh = eng.open(path)
+            pend: list = []
+            try:
+                depth = max(2, eng.config.queue_depth // 2)
+
+                def finish(entry):
+                    idx_parts, reads = entry
+                    parts = {}
+                    for ext, p in reads.items():
+                        view = p.wait()
+                        parts[ext] = view.tobytes()  # host copy for decode
+                        p.release()
+                    eng.stats.add(bounce_bytes=sum(
+                        len(v) for v in parts.values()))
+                    return self.decode(parts)
+
+                for si in sample_order:
+                    reads = {
+                        ext: eng.submit_read(fh, off, ln)
+                        for ext, (off, ln) in samples[si].items()}
+                    pend.append((si, reads))
+                    if len(pend) >= depth:
+                        yield finish(pend.pop(0))
+                while pend:
+                    yield finish(pend.pop(0))
+            finally:
+                # Drain before close: in-flight reads DMA into pool buffers
+                # and must be waited + released, or the pool leaks and the
+                # engine teardown would race the I/O.
+                for _, reads in pend:
+                    for p in reads.values():
+                        p.release()  # waits if still in flight
+                eng.close(fh)
+
+    # -- batching + device placement ---------------------------------------
+
+    def _host_batches(self) -> Iterator:
+        import jax
+        batch: list = []
+        for sample in self._iter_local_samples():
+            batch.append(sample)
+            if len(batch) == self.local_batch:
+                yield jax.tree.map(lambda *xs: np.stack(xs), *batch)
+                batch = []
+        if batch and not self.config.drop_remainder:
+            raise ValueError(
+                "partial final batch with drop_remainder=False is not "
+                "representable as a fixed global shape; pad your dataset "
+                "or use drop_remainder=True")
+
+    def __iter__(self) -> Iterator:
+        """Yield pytrees of global jax.Arrays sharded over the mesh axis."""
+        import jax
+        sharding = batch_sharding(self.mesh, self.axis)
+        q: queue.Queue = queue.Queue(maxsize=self.config.prefetch)
+        err: list = []
+        stop = threading.Event()
+
+        def put_checked(item) -> bool:
+            """Blocking put that aborts when the consumer went away."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            gen = self._host_batches()
+            try:
+                for hb in gen:
+                    if not put_checked(hb):
+                        break
+            except BaseException as e:  # surfaced in the consumer
+                err.append(e)
+            finally:
+                gen.close()  # runs the sample iterator's drain/close
+                put_checked(_SENTINEL)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                hb = q.get()
+                if hb is _SENTINEL:
+                    if err:
+                        raise err[0]
+                    break
+                global_shape_of = (
+                    lambda x: (self.global_batch,) + x.shape[1:])
+                yield jax.tree.map(
+                    lambda x: jax.make_array_from_process_local_data(
+                        sharding, x, global_shape_of(x)), hb)
+        finally:
+            # Abandoned iterator: unblock and stop the producer, then wait
+            # for it — close() must never race a thread still submitting.
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=30)
+        self.epoch += 1
+
+    def close(self) -> None:
+        if self._owns_engine:
+            self._engine.close_all()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

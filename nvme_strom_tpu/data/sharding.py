@@ -1,0 +1,36 @@
+"""Shard-to-host assignment for multi-host input pipelines.
+
+Each host owns a disjoint subset of shard files and reads them from its OWN
+local NVMe — the cross-host "communication" is only the implicit agreement
+on the assignment (derived from jax process indices), so bulk data never
+crosses hosts (SURVEY.md §5 "Distributed comm backend").
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def assign_shards(paths: Sequence, process_index: int,
+                  process_count: int) -> List:
+    """Deterministic round-robin assignment (sorted for cross-host
+    agreement).  Requires len(paths) >= process_count so no host idles."""
+    if process_count < 1:
+        raise ValueError("process_count must be >= 1")
+    if not 0 <= process_index < process_count:
+        raise ValueError(
+            f"process_index {process_index} not in [0, {process_count})")
+    ordered = sorted(str(p) for p in paths)
+    if len(ordered) < process_count:
+        raise ValueError(
+            f"{len(ordered)} shards < {process_count} processes: "
+            "every host needs at least one local shard")
+    return ordered[process_index::process_count]
+
+
+def shuffled_indices(n: int, seed: int, epoch: int = 0) -> np.ndarray:
+    """Deterministic per-epoch permutation (same on every host)."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+    return rng.permutation(n)

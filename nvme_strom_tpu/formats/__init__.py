@@ -1,0 +1,23 @@
+from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+from nvme_strom_tpu.formats.safetensors import (
+    SafetensorsFile,
+    write_safetensors,
+)
+from nvme_strom_tpu.formats.tfrecord import (
+    TFRecordIndex,
+    read_records,
+    write_tfrecords,
+    crc32c,
+    masked_crc,
+)
+from nvme_strom_tpu.formats.wds import WdsShardIndex, write_wds_shard
+from nvme_strom_tpu.formats.arrow import ArrowFileReader
+
+__all__ = [
+    "PlanEntry", "ReadPlan",
+    "SafetensorsFile", "write_safetensors",
+    "TFRecordIndex", "read_records", "write_tfrecords", "crc32c",
+    "masked_crc",
+    "WdsShardIndex", "write_wds_shard",
+    "ArrowFileReader",
+]

@@ -1,0 +1,176 @@
+"""Apache Arrow IPC file: footer-only planning + zero-copy batch decode.
+
+Backs benchmark config 2 (BASELINE.md: "Apache Arrow column file →
+single-chip DeviceArray") — the PG-Strom Arrow-scan analogue (SURVEY.md
+§3.5).  Strategy:
+
+1. Parse the file footer ourselves (a small flatbuffer at the file tail —
+   ~60 lines of cursor arithmetic, no flatbuffers dependency) to get each
+   record batch's ``(offset, metadata_length, body_length)`` Block.  Only
+   the footer is read with buffered I/O.
+2. Direct-read whole batches (metadata+body) through the engine.
+3. Let pyarrow wrap the engine buffer ZERO-COPY (``pa.py_buffer`` over the
+   numpy view) and decode the record batch — column buffers point into the
+   staging memory; no host memcpy happens.
+4. ``device_put`` individual columns (the host→TPU transfer reads staging
+   memory directly).
+
+File layout: ``ARROW1\\0\\0 | messages... | footer | i32 footer_len | ARROW1``.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+
+_MAGIC = b"ARROW1"
+
+
+class _FlatBuf:
+    """Minimal flatbuffer cursor: just enough for the Arrow Footer table."""
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+
+    def u16(self, pos):
+        return struct.unpack_from("<H", self.buf, pos)[0]
+
+    def i32(self, pos):
+        return struct.unpack_from("<i", self.buf, pos)[0]
+
+    def u32(self, pos):
+        return struct.unpack_from("<I", self.buf, pos)[0]
+
+    def i64(self, pos):
+        return struct.unpack_from("<q", self.buf, pos)[0]
+
+    def root(self) -> int:
+        return self.u32(0)
+
+    def field(self, table: int, field_id: int) -> int:
+        """Absolute position of a table field, or 0 if absent."""
+        vtable = table - self.i32(table)
+        vlen = self.u16(vtable)
+        slot = 4 + 2 * field_id
+        if slot >= vlen:
+            return 0
+        off = self.u16(vtable + slot)
+        return table + off if off else 0
+
+    def vector(self, field_pos: int):
+        """(element_start, length) of a vector field."""
+        vec = field_pos + self.u32(field_pos)
+        return vec + 4, self.u32(vec)
+
+
+def _parse_footer_blocks(footer: bytes) -> List[tuple]:
+    """RecordBatch Blocks from the Footer flatbuffer.
+
+    Footer table fields: 0=version, 1=schema, 2=dictionaries,
+    3=recordBatches.  Block is an inline 24-byte struct:
+    i64 offset, i32 metaDataLength (+4 pad), i64 bodyLength.
+    """
+    fb = _FlatBuf(footer)
+    table = fb.root()
+    field = fb.field(table, 3)
+    if not field:
+        return []
+    start, n = fb.vector(field)
+    blocks = []
+    for i in range(n):
+        base = start + 24 * i
+        blocks.append((fb.i64(base), fb.i32(base + 8), fb.i64(base + 16)))
+    return blocks
+
+
+class ArrowFileReader:
+    """Plan + decode an Arrow IPC file through the direct engine."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        with open(self.path, "rb") as f:
+            head = f.read(8)
+            if head[:6] != _MAGIC:
+                raise ValueError(f"{path}: not an Arrow IPC file")
+            f.seek(-10, 2)
+            tail = f.read(10)
+            if tail[4:] != _MAGIC:
+                raise ValueError(f"{path}: bad trailing magic")
+            (flen,) = struct.unpack("<i", tail[:4])
+            f.seek(-(10 + flen), 2)
+            footer = f.read(flen)
+        self.blocks = _parse_footer_blocks(footer)
+        import pyarrow as pa
+        import pyarrow.ipc as ipc
+        with pa.OSFile(self.path, "rb") as f:
+            self.schema = ipc.open_file(f).schema
+
+    @property
+    def num_batches(self) -> int:
+        return len(self.blocks)
+
+    def plan(self) -> ReadPlan:
+        entries = tuple(
+            PlanEntry(key=f"batch{i}", offset=off, length=mlen + blen,
+                      meta={"metadata_length": mlen, "body_length": blen})
+            for i, (off, mlen, blen) in enumerate(self.blocks))
+        return ReadPlan(self.path, entries)
+
+    def decode_batch(self, view: np.ndarray):
+        """Zero-copy decode of one direct-read batch range."""
+        import pyarrow as pa
+        import pyarrow.ipc as ipc
+        buf = pa.py_buffer(view)  # wraps the staging memory, no copy
+        msg = ipc.read_message(pa.BufferReader(buf))
+        return ipc.read_record_batch(msg, self.schema)
+
+    def read_columns_to_device(self, engine, columns: Optional[List[str]]
+                               = None, device=None, depth: int = 3
+                               ) -> Dict[str, object]:
+        """Config-2 path: stream batches direct (``depth`` reads in flight,
+        so NVMe overlaps decode + PCIe) → zero-copy pyarrow decode →
+        device_put columns via the shared bridge rule → on-device concat."""
+        import jax
+        import jax.numpy as jnp
+        from nvme_strom_tpu.ops.bridge import host_to_device
+        dev = device or jax.local_devices()[0]
+        names = columns or [f.name for f in self.schema]
+        parts: Dict[str, list] = {n: [] for n in names}
+        fh = engine.open(self.path)
+        pend: list = []
+        try:
+            def consume(p):
+                view = p.wait()
+                batch = self.decode_batch(view)
+                put = []
+                for n in names:
+                    col = batch.column(n)
+                    if col.null_count:
+                        raise ValueError(
+                            f"column {n} has nulls; dense scan only")
+                    host = col.to_numpy(zero_copy_only=True)
+                    arr = host_to_device(engine, host, dev)
+                    parts[n].append(arr)
+                    put.append(arr)
+                # transfers must consume staging before release()
+                for arr in put:
+                    arr.block_until_ready()
+                p.release()
+
+            for entry in self.plan().entries:
+                pend.append(
+                    engine.submit_read(fh, entry.offset, entry.length))
+                if len(pend) >= depth:
+                    consume(pend.pop(0))
+            while pend:
+                consume(pend.pop(0))
+        finally:
+            for p in pend:
+                p.release()  # waits if still in flight
+            engine.close(fh)
+        return {n: (v[0] if len(v) == 1 else jnp.concatenate(v))
+                for n, v in parts.items()}

@@ -1,0 +1,48 @@
+"""Read-plan primitives shared by all format readers.
+
+The reference resolves file offsets → filesystem extents → NVMe LBAs inside
+the kernel (SURVEY.md §3.1 "walk filesystem extents").  Userspace cannot (and
+need not) see LBAs; the equivalent planning step here is format-aware: each
+reader turns a file's metadata into a list of payload byte ranges which are
+then read O_DIRECT through the engine and land on device with no host copy.
+Metadata itself (headers, footers, indexes) is tiny and read with ordinary
+buffered I/O — it is not payload and is never counted as such.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class PlanEntry:
+    """One contiguous payload range inside a file."""
+
+    key: str                 # tensor name / record id / sample.ext / column
+    offset: int              # absolute file offset
+    length: int              # bytes
+    dtype: Optional[str] = None   # numpy-style dtype string when known
+    shape: Optional[tuple] = None
+    meta: Any = None         # format-specific extras
+
+
+@dataclass(frozen=True)
+class ReadPlan:
+    path: str
+    entries: tuple
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(e.length for e in self.entries)
+
+    def ranges(self) -> list:
+        return [(e.offset, e.length) for e in self.entries]
+
+    def subset(self, keys: Sequence[str]) -> "ReadPlan":
+        keep = set(keys)
+        entries = tuple(e for e in self.entries if e.key in keep)
+        missing = keep - {e.key for e in entries}
+        if missing:
+            raise KeyError(f"keys not in plan: {sorted(missing)}")
+        return ReadPlan(self.path, entries)

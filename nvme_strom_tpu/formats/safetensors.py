@@ -1,0 +1,131 @@
+"""safetensors: header parsing + ranged-read planning (no deserialization).
+
+Format: ``u64le header_len | header_json | tensor data``, where the JSON maps
+tensor name → {"dtype", "shape", "data_offsets": [begin, end)} with offsets
+relative to the end of the header.  Parsing only touches the header; tensor
+bytes are planned as direct-engine ranges.  This backs benchmark config 4
+(BASELINE.md: "Llama-3 8B safetensors weight shards on NVMe → lazy HBM param
+load") — the read side of the reference's inverse path noted in SURVEY.md §5
+"Checkpoint/resume".
+
+A writer is included so tests and the checkpoint path can produce the format
+without external dependencies.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+
+_DTYPES: Dict[str, str] = {
+    "BOOL": "bool", "U8": "uint8", "I8": "int8",
+    "U16": "uint16", "I16": "int16", "U32": "uint32", "I32": "int32",
+    "U64": "uint64", "I64": "int64",
+    "F16": "float16", "F32": "float32", "F64": "float64",
+    "BF16": "bfloat16",
+}
+_DTYPES_INV = {v: k for k, v in _DTYPES.items()}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+class SafetensorsFile:
+    """Lazily-parsed safetensors header; never reads tensor payloads."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        with open(self.path, "rb") as f:
+            (hlen,) = struct.unpack("<Q", f.read(8))
+            if hlen > 100 << 20:
+                raise ValueError(f"implausible safetensors header: {hlen}")
+            header = json.loads(f.read(hlen))
+        self.data_start = 8 + hlen
+        self.metadata = header.pop("__metadata__", {})
+        self.tensors: Dict[str, dict] = {}
+        for name, info in header.items():
+            begin, end = info["data_offsets"]
+            self.tensors[name] = {
+                "dtype": _DTYPES.get(info["dtype"], info["dtype"].lower()),
+                "shape": tuple(info["shape"]),
+                "offset": self.data_start + begin,
+                "nbytes": end - begin,
+            }
+
+    def keys(self):
+        return self.tensors.keys()
+
+    def plan(self, names: Optional[Sequence[str]] = None) -> ReadPlan:
+        names = list(names) if names is not None else list(self.tensors)
+        entries = []
+        for n in names:
+            t = self.tensors[n]
+            entries.append(PlanEntry(key=n, offset=t["offset"],
+                                     length=t["nbytes"], dtype=t["dtype"],
+                                     shape=t["shape"]))
+        return ReadPlan(self.path, tuple(entries))
+
+    def slice_plan(self, name: str, start_row: int, num_rows: int
+                   ) -> PlanEntry:
+        """Byte range of rows [start_row, start_row+num_rows) of a tensor —
+        rows along axis 0 are contiguous, so a row-shard of a tensor is one
+        contiguous direct read.  This is what lets a pjit'd host read ONLY
+        its local shard of a weight matrix (benchmark config 4)."""
+        t = self.tensors[name]
+        shape = t["shape"]
+        if not shape:
+            raise ValueError(f"{name} is a scalar; cannot row-slice")
+        if start_row < 0 or start_row + num_rows > shape[0]:
+            raise ValueError(
+                f"rows [{start_row}, {start_row + num_rows}) out of bounds "
+                f"for {name} with shape {shape}")
+        row_elems = int(np.prod(shape[1:], dtype=np.int64)) if len(shape) > 1 else 1
+        itemsize = _np_dtype(t["dtype"]).itemsize
+        row_bytes = row_elems * itemsize
+        return PlanEntry(
+            key=name,
+            offset=t["offset"] + start_row * row_bytes,
+            length=num_rows * row_bytes,
+            dtype=t["dtype"],
+            shape=(num_rows,) + tuple(shape[1:]),
+        )
+
+
+def write_safetensors(path, tensors: Dict[str, np.ndarray],
+                      metadata: Optional[dict] = None) -> None:
+    """Minimal safetensors writer (row-major, offsets in insertion order)."""
+    header: Dict[str, dict] = {}
+    if metadata:
+        header["__metadata__"] = {str(k): str(v) for k, v in metadata.items()}
+    blobs = []
+    pos = 0
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        dt = str(arr.dtype)
+        if dt not in _DTYPES_INV:
+            raise TypeError(f"unsupported dtype {dt}")
+        blob = arr.tobytes()
+        header[name] = {
+            "dtype": _DTYPES_INV[dt],
+            "shape": list(arr.shape),
+            "data_offsets": [pos, pos + len(blob)],
+        }
+        blobs.append(blob)
+        pos += len(blob)
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    pad = (-(8 + len(hjson))) % 8  # keep data 8-byte aligned
+    hjson += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for blob in blobs:
+            f.write(blob)

@@ -1,0 +1,148 @@
+"""TFRecord: index scan + ranged-read planning + writer.
+
+Wire format per record: ``u64le length | u32le masked_crc32c(length) |
+payload | u32le masked_crc32c(payload)``.  Indexing scans only the 16-byte
+framing per record (one buffered sequential pass, the analogue of the
+reference's extent walk); payloads are then planned as direct-engine ranges.
+Backs benchmark config 3 (BASELINE.md: ImageNet-1k WebDataset/TFRecord
+shards → infeed dataloader).
+
+crc32c (Castagnoli) is implemented here with a numpy table — no external
+dependency; verification is optional on the hot path (``verify=True`` reads
+payloads through buffered I/O and is for integrity checks, not streaming).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Iterator, Optional
+
+import numpy as np
+
+from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+
+# ---- crc32c: native (SSE4.2 / slice-by-8 in libstrom_io), python fallback
+
+_POLY = 0x82F63B78
+
+
+def _make_table() -> list:
+    tbl = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ _POLY if c & 1 else c >> 1
+        tbl.append(c)
+    return tbl
+
+
+_TABLE = _make_table()
+
+
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    c = ~crc & 0xFFFFFFFF
+    tbl = _TABLE
+    for b in data:
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return ~c & 0xFFFFFFFF
+
+
+def _crc32c_native():
+    try:
+        import ctypes
+        from nvme_strom_tpu.io.engine import _load_lib
+        lib = _load_lib()
+        lib.strom_crc32c.restype = ctypes.c_uint32
+        lib.strom_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                     ctypes.c_uint32]
+
+        def crc(data: bytes, crc0: int = 0) -> int:
+            return int(lib.strom_crc32c(bytes(data), len(data), crc0))
+        return crc
+    except Exception:
+        return None
+
+
+crc32c = _crc32c_native() or _crc32c_py
+
+
+def masked_crc(data: bytes) -> int:
+    c = crc32c(data)
+    return ((c >> 15 | c << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---- reader ----
+
+class TFRecordIndex:
+    """Offsets/lengths of every record in a TFRecord file."""
+
+    def __init__(self, path, verify_framing_crc: bool = False):
+        import os
+        self.path = str(path)
+        self.offsets: list[int] = []   # payload offsets
+        self.lengths: list[int] = []
+        fsize = os.path.getsize(self.path)
+        pos = 0
+        with open(self.path, "rb") as f:
+            while True:
+                hdr = f.read(12)
+                if not hdr:
+                    break
+                if len(hdr) < 12:
+                    raise ValueError(f"truncated framing at {pos}")
+                (ln,), (lcrc,) = struct.unpack("<Q", hdr[:8]), \
+                    struct.unpack("<I", hdr[8:])
+                if verify_framing_crc and masked_crc(hdr[:8]) != lcrc:
+                    raise ValueError(f"length crc mismatch at {pos}")
+                if pos + 12 + ln + 4 > fsize:
+                    raise ValueError(
+                        f"record at {pos} claims {ln} payload bytes but the "
+                        f"file ends at {fsize}: truncated or corrupt shard")
+                self.offsets.append(pos + 12)
+                self.lengths.append(ln)
+                pos += 12 + ln + 4
+                f.seek(pos)
+
+    def __len__(self) -> int:
+        return len(self.offsets)
+
+    def plan(self, indices: Optional[list] = None) -> ReadPlan:
+        idx = indices if indices is not None else range(len(self))
+        entries = tuple(
+            PlanEntry(key=str(i), offset=self.offsets[i],
+                      length=self.lengths[i])
+            for i in idx)
+        return ReadPlan(self.path, entries)
+
+
+def read_records(path, verify: bool = True) -> Iterator[bytes]:
+    """Buffered full read with CRC verification — the integrity-check path
+    (mirrors the reference's ssd2gpu_test pread comparison, SURVEY.md §4)."""
+    with open(path, "rb") as f:
+        pos = 0
+        while True:
+            hdr = f.read(12)
+            if not hdr:
+                return
+            (ln,) = struct.unpack("<Q", hdr[:8])
+            payload = f.read(ln)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            if verify:
+                (lcrc,) = struct.unpack("<I", hdr[8:])
+                if masked_crc(hdr[:8]) != lcrc:
+                    raise ValueError(f"length crc mismatch at {pos}")
+                if masked_crc(payload) != pcrc:
+                    raise ValueError(f"payload crc mismatch at {pos}")
+            pos += 12 + ln + 4
+            yield payload
+
+
+def write_tfrecords(path, payloads) -> None:
+    with open(path, "wb") as f:
+        for p in payloads:
+            p = bytes(p)
+            hdr = struct.pack("<Q", len(p))
+            f.write(hdr)
+            f.write(struct.pack("<I", masked_crc(hdr)))
+            f.write(p)
+            f.write(struct.pack("<I", masked_crc(p)))

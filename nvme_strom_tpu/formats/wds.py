@@ -1,0 +1,75 @@
+"""WebDataset (POSIX tar) sample indexing + ranged-read planning.
+
+A WebDataset shard is an uncompressed tar whose members are grouped into
+samples by basename: ``000123.jpg`` + ``000123.cls`` form sample
+``000123`` with parts ``jpg`` and ``cls``.  The index pass parses only the
+512-byte tar headers; member payloads are planned as direct-engine ranges.
+Backs benchmark config 3 (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import tarfile
+from typing import Dict, List, Optional
+
+from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+
+_BLOCK = 512
+
+
+def _split_key(name: str):
+    """webdataset convention: key = path up to the FIRST dot of the
+    basename; extension = everything after it."""
+    slash = name.rfind("/")
+    dot = name.find(".", slash + 1)
+    if dot < 0:
+        return name, ""
+    return name[:dot], name[dot + 1:]
+
+
+class WdsShardIndex:
+    """Sample → {ext: (offset, length)} map for one tar shard."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self.samples: Dict[str, Dict[str, tuple]] = {}
+        self.order: List[str] = []
+        # tarfile parses headers only; data is skipped via seeks.
+        with tarfile.open(self.path, "r:") as tf:
+            for m in tf:
+                if not m.isfile():
+                    continue
+                key, ext = _split_key(m.name)
+                if key not in self.samples:
+                    self.samples[key] = {}
+                    self.order.append(key)
+                self.samples[key][ext] = (m.offset_data, m.size)
+
+    def __len__(self) -> int:
+        return len(self.order)
+
+    def plan(self, keys: Optional[List[str]] = None,
+             exts: Optional[List[str]] = None) -> ReadPlan:
+        keys = keys if keys is not None else self.order
+        entries = []
+        for k in keys:
+            parts = self.samples[k]
+            for ext, (off, ln) in parts.items():
+                if exts is not None and ext not in exts:
+                    continue
+                entries.append(PlanEntry(key=f"{k}.{ext}", offset=off,
+                                         length=ln))
+        return ReadPlan(self.path, tuple(entries))
+
+
+def write_wds_shard(path, samples: List[Dict[str, bytes]],
+                    keys: Optional[List[str]] = None) -> None:
+    """Write samples (each a {ext: payload} dict) as an uncompressed tar."""
+    import io
+    with tarfile.open(path, "w", format=tarfile.USTAR_FORMAT) as tf:
+        for i, sample in enumerate(samples):
+            key = keys[i] if keys else f"{i:08d}"
+            for ext, payload in sample.items():
+                info = tarfile.TarInfo(name=f"{key}.{ext}")
+                info.size = len(payload)
+                tf.addfile(info, io.BytesIO(payload))

@@ -28,6 +28,24 @@ def _default_device():
     return jax.local_devices()[0]
 
 
+def host_to_device(engine: StromEngine, host: np.ndarray, dev):
+    """``device_put`` with the staging-alias rule and byte accounting.
+
+    On a host-backed device, ``jax.device_put`` may ALIAS the numpy buffer;
+    staging memory is recycled after release(), so a copy is forced (and
+    counted as a bounce). On an accelerator the PCIe transfer itself moves
+    the bytes and no host copy exists.  Single source of truth for every
+    consumer that puts staging-backed views on device.
+    """
+    import jax
+    if dev.platform == "cpu":
+        host = np.array(host)
+        engine.stats.add(bounce_bytes=int(host.nbytes))
+    arr = jax.device_put(host, dev)
+    engine.stats.add(bytes_to_device=int(host.nbytes))
+    return arr
+
+
 class DeviceStream:
     """Pipelined NVMe→HBM chunk stream over one engine.
 
@@ -44,22 +62,11 @@ class DeviceStream:
         self.depth = depth
 
     def _put(self, view: np.ndarray, dtype, shape):
-        import jax
         dev = self.device or _default_device()
         arr = view if dtype is None else view.view(dtype)
         if shape is not None:
             arr = arr.reshape(shape)
-        if dev.platform == "cpu":
-            # On a host-backed device jax.device_put may ALIAS the numpy
-            # buffer — but the staging buffer is recycled after release().
-            # Materialise a copy; on the CPU backend that host memcpy is a
-            # real bounce and is counted as such. On TPU the PCIe transfer
-            # itself moves the bytes and no host copy exists.
-            arr = np.array(arr)
-            self.engine.stats.add(bounce_bytes=int(view.nbytes))
-        out = jax.device_put(arr, dev)
-        self.engine.stats.add(bytes_to_device=int(view.nbytes))
-        return out
+        return host_to_device(self.engine, arr, dev)
 
     def stream_file(self, path, chunk_bytes: Optional[int] = None,
                     dtype=None) -> Iterator:
@@ -153,15 +160,22 @@ def write_from_device(engine: StromEngine, array, path,
     chunk = engine.config.chunk_bytes
     fh = engine.open(path, writable=True)
     total = 0
+    pend: list = []
     try:
-        pend = []
         for pos in range(0, host.nbytes, chunk):
             part = host[pos:pos + chunk]
             pend.append(engine.submit_write(fh, offset + pos, part))
             if len(pend) >= engine.config.queue_depth:
                 total += pend.pop(0).wait()
-        for p in pend:
-            total += p.wait()
+        while pend:
+            total += pend.pop(0).wait()
     finally:
+        # Drain before close: writes still in flight target this fh; closing
+        # it first would EBADF them (or hit a recycled descriptor).
+        for p in pend:
+            try:
+                p.wait()
+            except OSError:
+                pass
         engine.close(fh)
     return total

@@ -1,0 +1,10 @@
+from nvme_strom_tpu.parallel.mesh import (
+    make_mesh,
+    batch_sharding,
+    replicated,
+    process_info,
+    local_batch_slice,
+)
+
+__all__ = ["make_mesh", "batch_sharding", "replicated", "process_info",
+           "local_batch_slice"]

@@ -1,0 +1,74 @@
+"""Device-mesh helpers for multi-chip / multi-host sharding.
+
+The reference has no distributed layer — its transport is PCIe P2P on one
+host (SURVEY.md §2 "Distributed communication backend: NOT PRESENT").  On
+TPU the equivalent scaling story (BASELINE.json's v5p-8 target) is SPMD over
+a ``jax.sharding.Mesh``: every host reads its own local NVMe, arrays are
+assembled per-process with ``make_array_from_process_local_data``, and XLA
+collectives over ICI/DCN do any cross-chip movement.  Bulk data never
+crosses hosts in the input path (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Optional
+
+import numpy as np
+
+
+def make_mesh(axes: Mapping[str, int], devices=None):
+    """Build a Mesh from {axis_name: size}.  A single axis may be -1 to
+    absorb all remaining devices (like a reshape)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices) if devices is not None else jax.devices()
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    wild = [i for i, s in enumerate(sizes) if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = math.prod(s for s in sizes if s != -1)
+    if wild:
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {known}")
+        sizes[wild[0]] = len(devices) // known
+    total = math.prod(sizes)
+    if total > len(devices):
+        raise ValueError(
+            f"mesh {dict(zip(names, sizes))} needs {total} devices, "
+            f"have {len(devices)}")
+    arr = np.array(devices[:total]).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def batch_sharding(mesh, axis: str = "dp"):
+    """NamedSharding that splits axis 0 of a batch across `axis`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P(axis))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def process_info() -> tuple[int, int]:
+    import jax
+    return jax.process_index(), jax.process_count()
+
+
+def local_batch_slice(global_batch: int,
+                      process_index: Optional[int] = None,
+                      process_count: Optional[int] = None) -> slice:
+    """The rows of the global batch this process must provide."""
+    import jax
+    pi = jax.process_index() if process_index is None else process_index
+    pc = jax.process_count() if process_count is None else process_count
+    if global_batch % pc:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by {pc} processes")
+    per = global_batch // pc
+    return slice(pi * per, (pi + 1) * per)

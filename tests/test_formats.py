@@ -1,0 +1,223 @@
+"""Format reader tests: plans must address exactly the payload bytes, and
+payloads read via the planned ranges through the direct engine must equal
+the format's own decode (content-verification discipline, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.formats import (
+    ArrowFileReader,
+    SafetensorsFile,
+    TFRecordIndex,
+    WdsShardIndex,
+    crc32c,
+    masked_crc,
+    read_records,
+    write_safetensors,
+    write_tfrecords,
+    write_wds_shard,
+)
+from nvme_strom_tpu.io import StromEngine
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+
+@pytest.fixture()
+def engine():
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                       buffer_pool_bytes=8 << 20)
+    with StromEngine(cfg, stats=StromStats()) as e:
+        yield e
+
+
+def _read_planned(engine, plan):
+    fh = engine.open(plan.path)
+    out = {}
+    for e in plan.entries:
+        with engine.submit_read(fh, e.offset, e.length) as p:
+            out[e.key] = p.wait().tobytes()
+    engine.close(fh)
+    return out
+
+
+# ---------------- safetensors ----------------
+
+def test_safetensors_roundtrip(engine, tmp_path):
+    rng = np.random.default_rng(0)
+    tensors = {
+        "wte": rng.standard_normal((128, 64)).astype(np.float32),
+        "bias": rng.standard_normal((64,)).astype(np.float16),
+        "ids": np.arange(100, dtype=np.int64),
+    }
+    path = tmp_path / "m.safetensors"
+    write_safetensors(path, tensors, metadata={"fmt": "test"})
+    sf = SafetensorsFile(path)
+    assert set(sf.keys()) == set(tensors)
+    assert sf.metadata == {"fmt": "test"}
+    got = _read_planned(engine, sf.plan())
+    for name, arr in tensors.items():
+        t = sf.tensors[name]
+        assert t["shape"] == arr.shape
+        back = np.frombuffer(got[name], dtype=arr.dtype).reshape(arr.shape)
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_safetensors_bf16(tmp_path):
+    import ml_dtypes
+    arr = np.arange(32, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    path = tmp_path / "b.safetensors"
+    write_safetensors(path, {"x": arr})
+    sf = SafetensorsFile(path)
+    assert sf.tensors["x"]["dtype"] == "bfloat16"
+    raw = open(path, "rb").read()
+    t = sf.tensors["x"]
+    back = np.frombuffer(
+        raw[t["offset"]:t["offset"] + t["nbytes"]],
+        dtype=ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(back, arr)
+
+
+def test_safetensors_row_slice(engine, tmp_path):
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    path = tmp_path / "w.safetensors"
+    write_safetensors(path, {"w": w})
+    sf = SafetensorsFile(path)
+    ent = sf.slice_plan("w", 16, 8)
+    assert ent.shape == (8, 32)
+    fh = engine.open(path)
+    with engine.submit_read(fh, ent.offset, ent.length) as p:
+        back = np.frombuffer(p.wait().tobytes(), dtype=np.float32
+                             ).reshape(8, 32)
+    engine.close(fh)
+    np.testing.assert_array_equal(back, w[16:24])
+
+
+def test_safetensors_slice_bounds(tmp_path):
+    w = np.zeros((4, 4), dtype=np.float32)
+    path = tmp_path / "s.safetensors"
+    write_safetensors(path, {"w": w})
+    sf = SafetensorsFile(path)
+    with pytest.raises(ValueError):
+        sf.slice_plan("w", 2, 3)
+
+
+# ---------------- tfrecord ----------------
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vector: 32 bytes of zeros -> 0x8A9136AA
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+def test_tfrecord_roundtrip(engine, tmp_path):
+    rng = np.random.default_rng(2)
+    payloads = [rng.integers(0, 256, size=int(n), dtype=np.uint8).tobytes()
+                for n in rng.integers(1, 5000, size=20)]
+    path = tmp_path / "d.tfrecord"
+    write_tfrecords(path, payloads)
+    # full decode with crc verification
+    assert list(read_records(path, verify=True)) == payloads
+    # planned ranges through the engine
+    idx = TFRecordIndex(path, verify_framing_crc=True)
+    assert len(idx) == 20
+    got = _read_planned(engine, idx.plan())
+    for i, p in enumerate(payloads):
+        assert got[str(i)] == p
+
+
+def test_tfrecord_partial_plan(tmp_path):
+    write_tfrecords(tmp_path / "x.tfrecord", [b"a" * 10, b"b" * 20, b"c"])
+    idx = TFRecordIndex(tmp_path / "x.tfrecord")
+    plan = idx.plan([2, 0])
+    assert [e.length for e in plan.entries] == [1, 10]
+
+
+def test_tfrecord_corrupt_crc(tmp_path):
+    path = tmp_path / "bad.tfrecord"
+    write_tfrecords(path, [b"hello world"])
+    raw = bytearray(path.read_bytes())
+    raw[14] ^= 0xFF  # flip a payload byte
+    path.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="payload crc"):
+        list(read_records(path, verify=True))
+
+
+# ---------------- webdataset ----------------
+
+def test_wds_roundtrip(engine, tmp_path):
+    rng = np.random.default_rng(3)
+    samples = [{"jpg": rng.bytes(1000 + i * 37), "cls": str(i).encode()}
+               for i in range(12)]
+    path = tmp_path / "shard-000000.tar"
+    write_wds_shard(path, samples)
+    idx = WdsShardIndex(path)
+    assert len(idx) == 12
+    got = _read_planned(engine, idx.plan())
+    for i, s in enumerate(samples):
+        key = f"{i:08d}"
+        assert got[f"{key}.jpg"] == s["jpg"]
+        assert got[f"{key}.cls"] == s["cls"]
+
+
+def test_wds_ext_filter(tmp_path):
+    write_wds_shard(tmp_path / "s.tar", [{"jpg": b"x", "cls": b"0"}])
+    idx = WdsShardIndex(tmp_path / "s.tar")
+    plan = idx.plan(exts=["cls"])
+    assert [e.key for e in plan.entries] == ["00000000.cls"]
+
+
+def test_wds_key_with_dots(tmp_path):
+    """webdataset keys split at the FIRST dot: a.b.c -> key=a ext=b.c"""
+    write_wds_shard(tmp_path / "s.tar", [{"seg.png": b"mask"}], keys=["img1"])
+    idx = WdsShardIndex(tmp_path / "s.tar")
+    assert idx.samples["img1"]["seg.png"] == idx.samples["img1"]["seg.png"]
+    plan = idx.plan()
+    assert plan.entries[0].key == "img1.seg.png"
+
+
+# ---------------- arrow ----------------
+
+def test_arrow_footer_blocks_match_pyarrow(tmp_path):
+    import pyarrow as pa
+    rng = np.random.default_rng(4)
+    path = tmp_path / "t.arrow"
+    batches = [
+        pa.record_batch({
+            "a": rng.standard_normal(1000).astype(np.float32),
+            "b": rng.integers(0, 1 << 30, 1000, dtype=np.int64),
+        }) for _ in range(3)
+    ]
+    with pa.OSFile(str(path), "wb") as f:
+        with pa.ipc.new_file(f, batches[0].schema) as w:
+            for b in batches:
+                w.write_batch(b)
+    r = ArrowFileReader(path)
+    assert r.num_batches == 3
+    assert {f.name for f in r.schema} == {"a", "b"}
+    # planned ranges must decode to the original batches
+    raw = path.read_bytes()
+    for i, e in enumerate(r.plan().entries):
+        view = np.frombuffer(raw, dtype=np.uint8,
+                             count=e.length, offset=e.offset)
+        batch = r.decode_batch(view)
+        assert batch.num_rows == 1000
+        np.testing.assert_array_equal(batch.column("a").to_numpy(),
+                                      batches[i].column("a").to_numpy())
+
+
+def test_arrow_columns_to_device(engine, tmp_path):
+    import pyarrow as pa
+    rng = np.random.default_rng(5)
+    path = tmp_path / "c.arrow"
+    a = rng.standard_normal(5000).astype(np.float32)
+    b = rng.integers(0, 100, 5000, dtype=np.int32)
+    batch = pa.record_batch({"a": a, "b": b})
+    with pa.OSFile(str(path), "wb") as f:
+        with pa.ipc.new_file(f, batch.schema) as w:
+            for lo in range(0, 5000, 1250):
+                w.write_batch(batch.slice(lo, 1250))
+    r = ArrowFileReader(path)
+    cols = r.read_columns_to_device(engine, columns=["a", "b"])
+    np.testing.assert_array_equal(np.asarray(cols["a"]), a)
+    np.testing.assert_array_equal(np.asarray(cols["b"]), b)

@@ -1,0 +1,157 @@
+"""Dataloader tests on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.data import ShardedLoader, assign_shards, shuffled_indices
+from nvme_strom_tpu.formats import write_tfrecords, write_wds_shard
+from nvme_strom_tpu.parallel import make_mesh, local_batch_slice
+from nvme_strom_tpu.utils.config import LoaderConfig
+
+
+def test_assign_shards_partition():
+    paths = [f"s{i:03d}.tar" for i in range(10)]
+    a = assign_shards(paths, 0, 3)
+    b = assign_shards(paths, 1, 3)
+    c = assign_shards(paths, 2, 3)
+    assert sorted(a + b + c) == sorted(paths)
+    assert not (set(a) & set(b) | set(a) & set(c) | set(b) & set(c))
+    with pytest.raises(ValueError):
+        assign_shards(["one.tar"], 0, 2)
+
+
+def test_shuffled_indices_deterministic():
+    p1 = shuffled_indices(100, seed=7, epoch=3)
+    p2 = shuffled_indices(100, seed=7, epoch=3)
+    np.testing.assert_array_equal(p1, p2)
+    assert not np.array_equal(p1, shuffled_indices(100, seed=7, epoch=4))
+
+
+def test_make_mesh_wildcard(mesh8):
+    m = make_mesh({"dp": 2, "tp": -1})
+    assert m.shape == {"dp": 2, "tp": 4}
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 16})
+
+
+def test_local_batch_slice():
+    assert local_batch_slice(32, 1, 4) == slice(8, 16)
+    with pytest.raises(ValueError):
+        local_batch_slice(33, 0, 4)
+
+
+def _make_wds_shards(tmp_path, n_shards=2, per_shard=16, item=64):
+    paths = []
+    expected = {}
+    for s in range(n_shards):
+        samples = []
+        for i in range(per_shard):
+            payload = np.full(item, s * 100 + i, dtype=np.uint8).tobytes()
+            samples.append({"bin": payload})
+            expected[f"{s}/{i}"] = payload
+        p = tmp_path / f"shard-{s:05d}.tar"
+        write_wds_shard(p, samples)
+        paths.append(str(p))
+    return paths, expected
+
+
+def test_wds_loader_batches(mesh8, tmp_path):
+    paths, expected = _make_wds_shards(tmp_path)
+    with ShardedLoader(paths, mesh8, global_batch=8, fmt="wds") as dl:
+        batches = list(dl)
+    assert len(batches) == 4  # 32 samples / batch 8
+    seen = set()
+    for b in batches:
+        assert b.shape == (8, 64)
+        assert b.sharding.spec == __import__("jax").sharding.PartitionSpec("dp")
+        for row in np.asarray(b):
+            seen.add(bytes(row.tobytes()))
+    assert seen == set(expected.values())
+
+
+def test_tfrecord_loader(mesh8, tmp_path):
+    recs = [np.full(32, i, dtype=np.uint8).tobytes() for i in range(24)]
+    p = tmp_path / "d.tfrecord"
+    write_tfrecords(p, recs)
+    with ShardedLoader([str(p)], mesh8, global_batch=8,
+                       fmt="tfrecord") as dl:
+        rows = [bytes(r.tobytes()) for b in dl for r in np.asarray(b)]
+    assert sorted(rows) == sorted(recs)
+
+
+def test_loader_custom_decode(mesh8, tmp_path):
+    samples = [{"x": np.float32(i).tobytes(),
+                "y": np.int32(i * 2).tobytes()} for i in range(16)]
+    p = tmp_path / "s.tar"
+    write_wds_shard(p, samples)
+
+    def decode(parts):
+        return {
+            "x": np.frombuffer(parts["x"], dtype=np.float32),
+            "y": np.frombuffer(parts["y"], dtype=np.int32),
+        }
+
+    with ShardedLoader([str(p)], mesh8, global_batch=8, fmt="wds",
+                       decode=decode) as dl:
+        b = next(iter(dl))
+    assert set(b) == {"x", "y"}
+    assert b["x"].shape == (8, 1)
+    np.testing.assert_array_equal(np.asarray(b["y"]).ravel(),
+                                  np.asarray(b["x"]).ravel() * 2)
+
+
+def test_loader_shuffle_determinism(mesh8, tmp_path):
+    paths, _ = _make_wds_shards(tmp_path, n_shards=1, per_shard=32)
+    cfg = LoaderConfig(batch_size=8, shuffle_buffer=1, seed=5)
+
+    def collect():
+        with ShardedLoader(paths, mesh8, global_batch=8, fmt="wds",
+                           config=cfg) as dl:
+            return [np.asarray(b).copy() for b in dl]
+
+    a, b = collect(), collect()
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    # shuffled order differs from natural order
+    flat = np.concatenate([x[:, 0] for x in a])
+    assert not np.array_equal(flat, np.sort(flat))
+
+
+def test_loader_abandoned_iterator(mesh8, tmp_path):
+    """Breaking out of a batch loop must stop the producer thread and leave
+    the engine reusable (no leaked staging buffers / no use-after-free on
+    close). Regression: producer blocked forever on a full queue."""
+    paths, expected = _make_wds_shards(tmp_path, n_shards=2, per_shard=32)
+    with ShardedLoader(paths, mesh8, global_batch=4, fmt="wds") as dl:
+        for b in dl:
+            break  # abandon mid-epoch with batches still queued
+        # a fresh full epoch on the same loader must see every sample
+        rows = {bytes(r.tobytes()) for b in dl for r in np.asarray(b)}
+    assert rows == set(expected.values())
+
+
+def test_loader_validation(mesh8, tmp_path):
+    paths, _ = _make_wds_shards(tmp_path, n_shards=1)
+    with pytest.raises(ValueError):
+        ShardedLoader(paths, mesh8, global_batch=7, fmt="wds")  # not div dp=2
+    with pytest.raises(ValueError):
+        ShardedLoader(paths, mesh8, global_batch=8, fmt="nope")
+
+
+def test_loader_simulated_two_processes(mesh8, tmp_path):
+    """Multi-host simulation: two 'processes' each load their own shards;
+    their local halves together cover the dataset exactly once."""
+    paths, expected = _make_wds_shards(tmp_path, n_shards=4, per_shard=8)
+    rows = []
+    for pi in range(2):
+        with ShardedLoader(paths, mesh8, global_batch=16, fmt="wds",
+                           process_index=pi, process_count=2) as dl:
+            assert dl.local_batch == 8
+            for _ in dl._host_batches():
+                pass
+            # use the host-batch iterator directly: local rows only
+        with ShardedLoader(paths, mesh8, global_batch=16, fmt="wds",
+                           process_index=pi, process_count=2) as dl:
+            for hb in dl._host_batches():
+                rows.extend(bytes(r.tobytes()) for r in hb)
+    assert sorted(rows) == sorted(expected.values())
