@@ -109,11 +109,12 @@ def write_safetensors(path, tensors: Dict[str, np.ndarray],
     blobs = []
     pos = 0
     for name, arr in tensors.items():
-        arr = np.ascontiguousarray(arr)
+        # NOT ascontiguousarray: it promotes 0-d arrays to shape (1,).
+        arr = np.asarray(arr)
         dt = str(arr.dtype)
         if dt not in _DTYPES_INV:
             raise TypeError(f"unsupported dtype {dt}")
-        blob = arr.tobytes()
+        blob = arr.tobytes()  # C-order bytes regardless of memory layout
         header[name] = {
             "dtype": _DTYPES_INV[dt],
             "shape": list(arr.shape),

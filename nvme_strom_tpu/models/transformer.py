@@ -1,0 +1,176 @@
+"""Flagship model: a Llama-style decoder transformer, pure-JAX functional.
+
+The reference is a storage engine, not a trainer (SURVEY.md §1) — this model
+exists to exercise the framework end-to-end the way PG-Strom exercises the
+reference (SURVEY.md §3.5): its weights are lazily loaded from NVMe
+safetensors shards (parallel/weights.py), its input batches stream from
+WebDataset/TFRecord shards (data/loader.py), and its training step runs
+SPMD over a dp×tp Mesh.  TPU-first choices: bfloat16 activations, einsum
+formulations that XLA tiles onto the MXU, static shapes, no Python control
+flow under jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8         # grouped-query attention when < n_heads
+    d_ff: int = 1408
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: object = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def flagship_config() -> TransformerConfig:
+    return TransformerConfig()
+
+
+def tiny_config() -> TransformerConfig:
+    return TransformerConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                             n_kv_heads=2, d_ff=128, max_seq=64)
+
+
+# ----------------------------- params -----------------------------
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict:
+    """Parameters as a flat {name: array} dict — the same namespace the
+    safetensors lazy loader uses, so checkpoints round-trip by name."""
+    keys = iter(jax.random.split(rng, 4 + 9 * cfg.n_layers))
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(fan_in)).astype(jnp.float32)
+
+    p = {
+        "tok_embed": dense(next(keys), 1.0, (cfg.vocab, cfg.d_model)),
+        "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "lm_head": dense(next(keys), cfg.d_model, (cfg.d_model, cfg.vocab)),
+    }
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        p[L + "attn_norm"] = jnp.ones((cfg.d_model,), jnp.float32)
+        p[L + "wq"] = dense(next(keys), cfg.d_model, (cfg.d_model, nh * hd))
+        p[L + "wk"] = dense(next(keys), cfg.d_model, (cfg.d_model, nkv * hd))
+        p[L + "wv"] = dense(next(keys), cfg.d_model, (cfg.d_model, nkv * hd))
+        p[L + "wo"] = dense(next(keys), nh * hd, (nh * hd, cfg.d_model))
+        p[L + "mlp_norm"] = jnp.ones((cfg.d_model,), jnp.float32)
+        p[L + "w_gate"] = dense(next(keys), cfg.d_model,
+                                (cfg.d_model, cfg.d_ff))
+        p[L + "w_up"] = dense(next(keys), cfg.d_model,
+                              (cfg.d_model, cfg.d_ff))
+        p[L + "w_down"] = dense(next(keys), cfg.d_ff, (cfg.d_ff, cfg.d_model))
+    return p
+
+
+# ----------------------------- layers -----------------------------
+
+def rms_norm(x, weight, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def _rope(q, k, theta):
+    """Rotary position embeddings over the last dim (pairs)."""
+    seq = q.shape[-2]
+    half = q.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def attention(x, p, prefix, cfg: TransformerConfig):
+    b, s, _ = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    q = (x @ p[prefix + "wq"].astype(x.dtype)).reshape(b, s, nh, hd)
+    k = (x @ p[prefix + "wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
+    v = (x @ p[prefix + "wv"].astype(x.dtype)).reshape(b, s, nkv, hd)
+    q = q.transpose(0, 2, 1, 3)   # b h s d
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q, k = _rope(q, k, cfg.rope_theta)
+    if nkv != nh:
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+    return out @ p[prefix + "wo"].astype(x.dtype)
+
+
+def mlp(x, p, prefix):
+    gate = jax.nn.silu(x @ p[prefix + "w_gate"].astype(x.dtype))
+    up = x @ p[prefix + "w_up"].astype(x.dtype)
+    return (gate * up) @ p[prefix + "w_down"].astype(x.dtype)
+
+
+def forward(params: Dict, tokens: jax.Array,
+            cfg: TransformerConfig) -> jax.Array:
+    """tokens (b, s) int32 → logits (b, s, vocab) float32."""
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        x = x + attention(rms_norm(x, params[L + "attn_norm"], cfg.norm_eps),
+                          params, L, cfg)
+        x = x + mlp(rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps),
+                    params, L)
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, tokens, cfg) -> jax.Array:
+    """Next-token cross-entropy (tokens supply both input and target)."""
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+# ----------------------------- training -----------------------------
+
+def make_train_step(cfg: TransformerConfig, optimizer):
+    """Returns step(params, opt_state, tokens) -> (params, opt_state, loss).
+    Pure function — jit/shard it at the call site."""
+
+    import optax
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

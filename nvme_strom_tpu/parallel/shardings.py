@@ -1,0 +1,53 @@
+"""Parameter/batch sharding rules for the flagship transformer.
+
+Megatron-style tensor parallelism expressed as NamedShardings: the SPMD
+partitioner inserts the all-reduces (psum over "tp" after the second matmul
+of attention and MLP) — XLA collectives over ICI, never hand-written
+NCCL-style calls (the TPU-idiomatic answer to the reference's lack of any
+distributed layer, SURVEY.md §2/§5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nvme_strom_tpu.models.transformer import TransformerConfig
+
+
+def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
+    specs = {
+        "tok_embed": P(None, "tp"),     # d_model sharded
+        "final_norm": P(),
+        "lm_head": P(None, "tp"),       # vocab logits sharded
+    }
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        specs[L + "attn_norm"] = P()
+        specs[L + "wq"] = P(None, "tp")   # heads split across tp
+        specs[L + "wk"] = P(None, "tp")
+        specs[L + "wv"] = P(None, "tp")
+        specs[L + "wo"] = P("tp", None)   # row-parallel: psum after
+        specs[L + "mlp_norm"] = P()
+        specs[L + "w_gate"] = P(None, "tp")
+        specs[L + "w_up"] = P(None, "tp")
+        specs[L + "w_down"] = P("tp", None)
+    return specs
+
+
+def param_shardings(cfg: TransformerConfig, mesh) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, spec)
+            for k, spec in param_specs(cfg).items()}
+
+
+def batch_spec() -> P:
+    return P("dp", None)
+
+
+def batch_shardings(mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated_sharding(mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

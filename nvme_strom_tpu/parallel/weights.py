@@ -1,0 +1,302 @@
+"""Lazy sharded weight loading: safetensors on NVMe → per-device HBM shards.
+
+Benchmark config 4 (BASELINE.md: "Llama-3 8B safetensors weight shards on
+NVMe → lazy HBM param load").  The key property: a host reads ONLY the byte
+ranges its addressable devices actually need — a tensor sharded 8-ways over
+rows costs each host 1/8th of the I/O, and a replicated tensor is read once
+per host (not once per device).  Reads are planned with
+``SafetensorsFile.slice_plan`` (rows along axis 0 are contiguous on disk) and
+flow through the direct engine; assembly uses
+``jax.make_array_from_single_device_arrays`` so no host-side concatenation
+of the global tensor ever exists.
+
+This is the read side of the reference's inverse (checkpoint) path noted in
+SURVEY.md §5; the write side is ``ops.bridge.write_from_device`` /
+``save_checkpoint`` below.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Optional, Sequence, Union
+
+import numpy as np
+
+from nvme_strom_tpu.formats.safetensors import (
+    SafetensorsFile,
+    _np_dtype,
+)
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.utils.config import EngineConfig
+
+
+def _normalize_index(idx, shape):
+    """Device index (tuple of slices) → ((r0, r1), tail_slices)."""
+    idx = tuple(idx)
+    full = tuple(slice(0, s) for s in shape)
+    idx = idx + full[len(idx):]
+    if not shape:
+        return (0, 1), ()
+    s0 = idx[0]
+    r0 = 0 if s0.start is None else s0.start
+    r1 = shape[0] if s0.stop is None else s0.stop
+    if s0.step not in (None, 1):
+        raise ValueError("strided axis-0 sharding is not supported")
+    tail = []
+    for d, s in zip(shape[1:], idx[1:]):
+        start = 0 if s.start is None else s.start
+        stop = d if s.stop is None else s.stop
+        if s.step not in (None, 1):
+            raise ValueError("strided sharding is not supported")
+        tail.append(slice(start, stop))
+    return (r0, r1), tuple(tail)
+
+
+class LazyCheckpoint:
+    """Union view over one or more safetensors shard files.
+
+    Accepts a list of ``.safetensors`` paths, a directory containing them,
+    or a HuggingFace-style ``*.index.json``.
+    """
+
+    def __init__(self, source: Union[str, os.PathLike, Sequence]):
+        paths: list[str] = []
+        if isinstance(source, (str, os.PathLike)):
+            src = str(source)
+            if src.endswith(".json"):
+                with open(src) as f:
+                    index = json.load(f)
+                base = os.path.dirname(src)
+                paths = sorted({os.path.join(base, v)
+                                for v in index["weight_map"].values()})
+            elif os.path.isdir(src):
+                paths = sorted(
+                    os.path.join(src, n) for n in os.listdir(src)
+                    if n.endswith(".safetensors"))
+            else:
+                paths = [src]
+        else:
+            paths = [str(p) for p in source]
+        if not paths:
+            raise ValueError(f"no safetensors files in {source!r}")
+        self.files = [SafetensorsFile(p) for p in paths]
+        self._by_name: Dict[str, SafetensorsFile] = {}
+        for sf in self.files:
+            for name in sf.keys():
+                if name in self._by_name:
+                    raise ValueError(f"duplicate tensor {name}")
+                self._by_name[name] = sf
+
+    def keys(self):
+        return self._by_name.keys()
+
+    def shape(self, name) -> tuple:
+        return self._by_name[name].tensors[name]["shape"]
+
+    def dtype(self, name) -> str:
+        return self._by_name[name].tensors[name]["dtype"]
+
+    # ------------------------------------------------------------------
+
+    def load_sharded(self, shardings: Union[Dict, Callable],
+                     engine: Optional[StromEngine] = None,
+                     dtype=None) -> Dict[str, object]:
+        """Load every tensor as a global jax.Array under its sharding.
+
+        ``shardings``: {name: Sharding} or fn(name, shape) -> Sharding.
+        ``dtype``: optional on-device cast applied after placement (the
+        disk bytes stay in the stored dtype; the cast runs on device).
+        """
+        import jax
+
+        own = engine is None
+        eng = engine or StromEngine(EngineConfig())
+        out: Dict[str, object] = {}
+        try:
+            for name in self.keys():
+                get = (shardings.get if isinstance(shardings, dict)
+                       else None)
+                sh = (get(name) if get
+                      else shardings(name, self.shape(name)))
+                if sh is None:
+                    raise KeyError(f"no sharding for tensor {name}")
+                out[name] = self._load_tensor(eng, name, sh)
+            if dtype is not None:
+                cast = jax.jit(lambda x: x.astype(dtype),
+                               out_shardings=None)
+                out = {n: cast(a) for n, a in out.items()}
+            return out
+        finally:
+            if own:
+                eng.close_all()
+
+    def _load_tensor(self, eng: StromEngine, name: str, sharding):
+        import jax
+
+        sf = self._by_name[name]
+        info = sf.tensors[name]
+        gshape = tuple(info["shape"])
+        np_dt = _np_dtype(info["dtype"])
+        idx_map = sharding.addressable_devices_indices_map(gshape)
+
+        # Group devices by ROW SPAN only: rows are contiguous on disk, so a
+        # span is read sequentially once regardless of how many column
+        # groups cut it up afterwards — the whole tensor is read at most
+        # once per host (replicated shards included).  Spans larger than
+        # one staging buffer are split into row-aligned chunks, streamed
+        # with several reads in flight, and re-joined ON DEVICE (no host
+        # assembly buffer for the row-sharded/replicated case).
+        import jax.numpy as jnp
+
+        spans: Dict[tuple, list] = {}
+        for dev, idx in idx_map.items():
+            (r0, r1), tail = _normalize_index(
+                idx if idx is not None else (), gshape)
+            spans.setdefault((r0, r1), []).append((dev, tail))
+
+        from nvme_strom_tpu.ops.bridge import host_to_device
+        fh = eng.open(sf.path)
+        device_arrays = {}
+        try:
+            for (r0, r1), devs in spans.items():
+                parts: Dict[object, list] = {dev: [] for dev, _ in devs}
+                for view in self._stream_span(eng, fh, sf, name, r0, r1,
+                                              np_dt, gshape):
+                    cache: Dict[tuple, np.ndarray] = {}
+                    put = []
+                    for dev, tail in devs:
+                        sub = cache.get(tail)
+                        if sub is None:
+                            sub = view
+                            if tail and any(
+                                    (s.start, s.stop) != (0, d)
+                                    for s, d in zip(tail, gshape[1:])):
+                                sub = view[(slice(None),) + tail]
+                                # strided column shard: host gather copies
+                                sub = np.ascontiguousarray(sub)
+                                eng.stats.add(
+                                    bounce_bytes=int(sub.nbytes))
+                            cache[tail] = sub
+                        arr = host_to_device(eng, sub, dev)
+                        parts[dev].append(arr)
+                        put.append(arr)
+                    for arr in put:  # staging consumed before next yield
+                        arr.block_until_ready()
+                for dev, _ in devs:
+                    ps = parts[dev]
+                    device_arrays[dev] = (
+                        ps[0] if len(ps) == 1 else jnp.concatenate(ps))
+        finally:
+            eng.close(fh)
+
+        arrays = [device_arrays[d] for d in idx_map]
+        return jax.make_array_from_single_device_arrays(
+            gshape, sharding, arrays)
+
+    def _stream_span(self, eng, fh, sf, name, r0, r1, np_dt, gshape):
+        """Yield host views of row-chunks of rows [r0, r1), each at most one
+        staging buffer; pipelined (several reads in flight).  The yielded
+        view is only valid until the next iteration."""
+        if not gshape:
+            ent = sf.plan([name]).entries[0]
+            with eng.submit_read(fh, ent.offset, ent.length) as p:
+                yield p.wait().view(np_dt).reshape(())
+            return
+        info = sf.tensors[name]
+        row_elems = (int(np.prod(gshape[1:], dtype=np.int64))
+                     if len(gshape) > 1 else 1)
+        row_bytes = row_elems * np_dt.itemsize
+        chunk_rows = max(1, eng.config.chunk_bytes // max(1, row_bytes))
+        if row_bytes > eng.config.chunk_bytes:
+            # One row exceeds the staging buffer: assemble rows on host
+            # (counted as bounce — resize the pool to avoid this).
+            for r in range(r0, r1):
+                ent = sf.slice_plan(name, r, 1)
+                buf = np.empty(ent.length, dtype=np.uint8)
+                pos = 0
+                step = eng.config.chunk_bytes
+                pend = [eng.submit_read(fh, ent.offset + o,
+                                        min(step, ent.length - o))
+                        for o in range(0, ent.length, step)]
+                for p in pend:
+                    v = p.wait()
+                    buf[pos:pos + v.nbytes] = v
+                    pos += v.nbytes
+                    p.release()
+                eng.stats.add(bounce_bytes=int(ent.length))
+                yield buf.view(np_dt).reshape((1,) + tuple(gshape[1:]))
+            return
+        depth = max(2, eng.config.queue_depth // 2)
+        pend = []
+        try:
+            for r in range(r0, r1, chunk_rows):
+                n = min(chunk_rows, r1 - r)
+                ent = sf.slice_plan(name, r, n)
+                pend.append((eng.submit_read(fh, ent.offset, ent.length),
+                             ent.shape))
+                if len(pend) >= depth:
+                    p, shp = pend.pop(0)
+                    yield p.wait().view(np_dt).reshape(shp)
+                    p.release()
+            while pend:
+                p, shp = pend.pop(0)
+                yield p.wait().view(np_dt).reshape(shp)
+                p.release()
+        finally:
+            for p, _ in pend:  # abandoned mid-span: drain + free
+                p.release()
+
+
+def save_checkpoint(path, params: Dict[str, object],
+                    engine: Optional[StromEngine] = None) -> None:
+    """Global (possibly sharded) arrays → one safetensors file.
+
+    Each array is gathered to host (the D2H transfer) and its payload is
+    written through the engine's O_DIRECT writer in pipelined chunks —
+    the HBM→NVMe inverse path (SURVEY.md §5 "Checkpoint/resume").  With
+    ``engine=None`` a temporary engine is created.  For multi-host use,
+    gather to one process first (``jax.experimental.multihost_utils``).
+    """
+    import jax
+    from nvme_strom_tpu.ops.bridge import write_from_device
+
+    host = {}
+    for name, arr in params.items():
+        if isinstance(arr, jax.Array) and len(arr.sharding.device_set) > 1:
+            arr = jax.device_get(arr)  # gathers addressable shards
+        host[name] = np.asarray(arr)
+
+    # Serialize the header exactly as write_safetensors would, then send
+    # header + payloads through the engine write path.
+    import json as _json
+    import struct as _struct
+    from nvme_strom_tpu.formats.safetensors import _DTYPES_INV
+    header: Dict[str, dict] = {}
+    pos = 0
+    for name, arr in host.items():
+        dt = str(arr.dtype)
+        if dt not in _DTYPES_INV:
+            raise TypeError(f"unsupported dtype {dt}")
+        header[name] = {"dtype": _DTYPES_INV[dt], "shape": list(arr.shape),
+                        "data_offsets": [pos, pos + arr.nbytes]}
+        pos += arr.nbytes
+    hjson = _json.dumps(header, separators=(",", ":")).encode()
+    hjson += b" " * ((-(8 + len(hjson))) % 8)
+    head = _struct.pack("<Q", len(hjson)) + hjson
+
+    own = engine is None
+    eng = engine or StromEngine(EngineConfig())
+    try:
+        open(path, "wb").close()  # truncate any previous file
+        fh = eng.open(path, writable=True)
+        try:
+            eng.submit_write(fh, 0, np.frombuffer(head, np.uint8)).wait()
+        finally:
+            eng.close(fh)
+        for name, arr in host.items():
+            off = len(head) + header[name]["data_offsets"][0]
+            write_from_device(eng, arr, path, offset=off)
+    finally:
+        if own:
+            eng.close_all()

@@ -1,0 +1,133 @@
+"""Flagship transformer tests: correctness, sharded training, end-to-end
+integration with the lazy weight loader and the dataloader."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nvme_strom_tpu.models.transformer import (
+    TransformerConfig,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    tiny_config,
+)
+from nvme_strom_tpu.parallel.shardings import (
+    batch_shardings,
+    param_shardings,
+)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny_config()
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return init_params(jax.random.key(0), cfg)
+
+
+def test_forward_shapes_and_finite(cfg, params):
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab)
+    logits = jax.jit(lambda p, t: forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.isfinite(logits).all())
+
+
+def test_causality(cfg, params):
+    """Changing a future token must not affect earlier logits."""
+    t1 = jax.random.randint(jax.random.key(2), (1, 16), 0, cfg.vocab)
+    t2 = t1.at[0, 10].set((t1[0, 10] + 1) % cfg.vocab)
+    l1 = forward(params, t1, cfg)
+    l2 = forward(params, t2, cfg)
+    np.testing.assert_allclose(np.asarray(l1[0, :10]),
+                               np.asarray(l2[0, :10]), rtol=1e-5)
+    assert not np.allclose(np.asarray(l1[0, 10:]), np.asarray(l2[0, 10:]))
+
+
+def test_initial_loss_near_uniform(cfg, params):
+    tokens = jax.random.randint(jax.random.key(3), (4, 32), 0, cfg.vocab)
+    loss = float(loss_fn(params, tokens, cfg))
+    assert abs(loss - np.log(cfg.vocab)) < 1.0
+
+
+def test_training_reduces_loss(cfg):
+    import optax
+    params = init_params(jax.random.key(4), cfg)
+    opt = optax.adamw(3e-3)
+    opt_state = opt.init(params)
+    step = jax.jit(make_train_step(cfg, opt))
+    tokens = jax.random.randint(jax.random.key(5), (8, 32), 0, cfg.vocab)
+    first = None
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first - 0.5, (first, float(loss))
+
+
+def test_sharded_train_step_matches_single_device(cfg, mesh8):
+    """dp×tp sharded step must compute the same loss as unsharded."""
+    import optax
+    params = init_params(jax.random.key(6), cfg)
+    opt = optax.sgd(1e-2)
+    tokens = jax.random.randint(jax.random.key(7), (4, 32), 0, cfg.vocab)
+
+    # single-device reference
+    s_params = jax.tree.map(np.array, params)
+    step1 = jax.jit(make_train_step(cfg, opt))
+    _, _, loss_ref = step1(params, opt.init(params), tokens)
+
+    p_sh = param_shardings(cfg, mesh8)
+    b_sh = batch_shardings(mesh8)
+    sharded = {k: jax.device_put(np.asarray(s_params[k]), p_sh[k])
+               for k in s_params}
+    opt_state = opt.init(sharded)
+    stepN = jax.jit(make_train_step(cfg, opt),
+                    in_shardings=(p_sh, None, b_sh),
+                    out_shardings=(p_sh, None, None))
+    new_params, _, loss_sh = stepN(sharded, opt_state,
+                                   jax.device_put(tokens, b_sh))
+    np.testing.assert_allclose(float(loss_ref), float(loss_sh), rtol=1e-4)
+    # updated params remain correctly sharded
+    assert new_params["layers.0.wq"].sharding.spec == p_sh[
+        "layers.0.wq"].spec
+
+
+def test_weights_roundtrip_through_lazy_loader(cfg, mesh8, tmp_path):
+    """init → save safetensors → lazy shard-aware reload → same logits."""
+    from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.parallel.weights import (
+        LazyCheckpoint, save_checkpoint)
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    params = init_params(jax.random.key(8), cfg)
+    path = tmp_path / "model.safetensors"
+    save_checkpoint(path, params)
+    p_sh = param_shardings(cfg, mesh8)
+    with StromEngine(EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                                  buffer_pool_bytes=8 << 20),
+                     stats=StromStats()) as eng:
+        loaded = LazyCheckpoint(path).load_sharded(p_sh, engine=eng)
+    tokens = jax.random.randint(jax.random.key(9), (2, 16), 0, cfg.vocab)
+    ref = forward(params, tokens, cfg)
+    got = forward(loaded, tokens, cfg)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as g
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.ndim == 3 and bool(jnp.isfinite(out).all())
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
