@@ -1,0 +1,5 @@
+from nvme_strom_tpu.sql.parquet import EngineFile, ParquetScanner
+from nvme_strom_tpu.sql.groupby import groupby_aggregate, sql_groupby
+
+__all__ = ["EngineFile", "ParquetScanner", "groupby_aggregate",
+           "sql_groupby"]

@@ -1,0 +1,179 @@
+"""Parquet scan through the strom-io engine.
+
+PG-Strom's Direct SQL pulls PostgreSQL table blocks through the reference's
+DMA path into GPU scan kernels (SURVEY.md §3.5).  The TPU analogue scans
+Parquet: row-group column chunks are read O_DIRECT through the engine and
+decoded to columnar arrays that feed the on-device GROUP BY
+(:mod:`nvme_strom_tpu.sql.groupby`) — benchmark config 5 (BASELINE.md).
+
+``EngineFile`` adapts the engine to a file-like object, so pyarrow's parquet
+reader performs *its own* range reads against O_DIRECT staging buffers —
+every payload byte still flows through the engine (and its stats), while
+all Parquet encodings/compressions keep working.  The handoff to pyarrow is
+one host copy (counted as bounce — decompression/decoding is host compute
+by nature; the reference's page-cache fallback pays the same copy).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, List, Optional
+
+from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+from nvme_strom_tpu.io.engine import StromEngine
+
+
+class EngineFile(io.RawIOBase):
+    """Read-only file-like view over an engine file handle.
+
+    Serves ``read()`` from direct-engine reads (chunked if needed).  Each
+    serviced byte is copied once into the returned bytes object; that copy
+    is counted as a bounce.
+    """
+
+    def __init__(self, engine: StromEngine, path):
+        super().__init__()
+        self.engine = engine
+        self.path = str(path)
+        self._fh = engine.open(path)
+        self._size = engine.file_size(self._fh)
+        self._pos = 0
+
+    # -- io protocol --
+
+    def readable(self) -> bool:
+        return True
+
+    def seekable(self) -> bool:
+        return True
+
+    def seek(self, offset: int, whence: int = io.SEEK_SET) -> int:
+        if whence == io.SEEK_SET:
+            self._pos = offset
+        elif whence == io.SEEK_CUR:
+            self._pos += offset
+        elif whence == io.SEEK_END:
+            self._pos = self._size + offset
+        else:
+            raise ValueError(f"bad whence {whence}")
+        return self._pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def readinto(self, b) -> int:
+        n = min(len(b), self._size - self._pos)
+        if n <= 0:
+            return 0
+        eng = self.engine
+        chunk = eng.config.chunk_bytes
+        # pipelined chunked read of [pos, pos+n)
+        pend = [eng.submit_read(self._fh, self._pos + o, min(chunk, n - o))
+                for o in range(0, n, chunk)]
+        pos = 0
+        mv = memoryview(b)
+        try:
+            while pend:
+                p = pend.pop(0)
+                view = p.wait()
+                mv[pos:pos + view.nbytes] = view  # single handoff copy
+                pos += view.nbytes
+                p.release()
+        finally:
+            for p in pend:  # mid-batch failure: free in-flight buffers
+                p.release()
+        eng.stats.add(bounce_bytes=pos)
+        self._pos += pos
+        return pos
+
+    def close(self) -> None:
+        if not self.closed and getattr(self, "_fh", None) is not None:
+            self.engine.close(self._fh)
+            self._fh = None
+        super().close()
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+
+class ParquetScanner:
+    """Row-group scan planning + engine-backed decode."""
+
+    def __init__(self, path, engine: StromEngine):
+        import pyarrow.parquet as pq
+        self.path = str(path)
+        self.engine = engine
+        # Metadata (footer) via buffered I/O — it is not payload.
+        self.metadata = pq.read_metadata(self.path)
+        self.schema = self.metadata.schema.to_arrow_schema()
+
+    @property
+    def num_row_groups(self) -> int:
+        return self.metadata.num_row_groups
+
+    @property
+    def num_rows(self) -> int:
+        return self.metadata.num_rows
+
+    def plan(self, columns: Optional[List[str]] = None) -> ReadPlan:
+        """Byte ranges of the selected column chunks, per row group —
+        the scan's I/O footprint (what the direct engine will read)."""
+        known = {self.metadata.schema.column(i).name
+                 for i in range(self.metadata.num_columns)}
+        names = columns or sorted(known)
+        missing = set(names) - known
+        if missing:
+            raise KeyError(f"columns not in schema: {sorted(missing)}")
+        entries = []
+        for rg in range(self.metadata.num_row_groups):
+            g = self.metadata.row_group(rg)
+            for ci in range(g.num_columns):
+                col = g.column(ci)
+                name = col.path_in_schema
+                if name not in names:
+                    continue
+                start = col.data_page_offset
+                if (col.dictionary_page_offset is not None
+                        and col.dictionary_page_offset > 0):
+                    start = min(start, col.dictionary_page_offset)
+                entries.append(PlanEntry(
+                    key=f"rg{rg}.{name}", offset=start,
+                    length=col.total_compressed_size,
+                    meta={"row_group": rg, "column": name}))
+        return ReadPlan(self.path, tuple(entries))
+
+    def iter_row_groups(self, columns: Optional[List[str]] = None):
+        """Yield pyarrow Tables, one per row group, decoded from
+        engine-served reads."""
+        import pyarrow.parquet as pq
+        f = EngineFile(self.engine, self.path)
+        try:
+            # Reuse the already-parsed footer so metadata I/O stays
+            # buffered-side and never pollutes the payload counters.
+            pf = pq.ParquetFile(f, metadata=self.metadata, pre_buffer=False)
+            for rg in range(pf.metadata.num_row_groups):
+                yield pf.read_row_group(rg, columns=columns)
+        finally:
+            f.close()
+
+    def read_columns_to_device(self, columns: List[str], device=None,
+                               dtype_map: Optional[Dict] = None):
+        """Scan → device-resident columns (on-device concat of row groups)."""
+        import jax
+        import jax.numpy as jnp
+        from nvme_strom_tpu.ops.bridge import host_to_device
+        dev = device or jax.local_devices()[0]
+        parts: Dict[str, list] = {c: [] for c in columns}
+        for tbl in self.iter_row_groups(columns):
+            for c in columns:
+                col = tbl.column(c)
+                arr = (col.to_numpy(zero_copy_only=False)
+                       if col.null_count == 0 else None)
+                if arr is None:
+                    raise ValueError(f"column {c} has nulls")
+                if dtype_map and c in dtype_map:
+                    arr = arr.astype(dtype_map[c])
+                parts[c].append(host_to_device(self.engine, arr, dev))
+        return {c: (v[0] if len(v) == 1 else jnp.concatenate(v))
+                for c, v in parts.items()}
