@@ -286,6 +286,7 @@ class StromEngine:
         self._lib.strom_drain_stats(self._h, ctypes.byref(blk))
         snap = {n: int(getattr(blk, n)) for n, _ in _StatsBlk._fields_}
         self.stats.merge_engine(snap)
+        self.stats.maybe_export()  # keep strom_stat --watch observers live
         return snap
 
     @property
@@ -299,6 +300,7 @@ class StromEngine:
         self.sync_stats()
         self._lib.strom_engine_destroy(self._h)
         self._closed = True
+        self.stats.maybe_export()
 
     def __enter__(self):
         return self

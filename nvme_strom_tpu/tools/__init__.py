@@ -1,0 +1,8 @@
+"""Userspace utilities — the analogue of the reference's L3 layer
+(SURVEY.md §1/§2: the `ssd2gpu_test` benchmark and the stat CLI).
+
+Run as modules:
+
+    python -m nvme_strom_tpu.tools.ssd2tpu_test <file> [--verify] [...]
+    python -m nvme_strom_tpu.tools.strom_stat [stats.json] [--json]
+"""

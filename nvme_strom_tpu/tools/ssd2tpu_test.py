@@ -1,0 +1,223 @@
+"""ssd2tpu_test — chunked NVMe read benchmark + correctness check.
+
+The TPU build's analogue of the reference's ``ssd2gpu_test`` utility
+(SURVEY.md §2 L3, §3.4): open → CHECK_FILE → map the staging pool →
+chunked async reads with N in flight → throughput report, with optional
+byte-exact verification of every chunk against a plain ``pread`` of the
+same range (the reference's verify mode).
+
+Three destinations, mirroring BASELINE.json's config ladder:
+
+  --dest host    raw NVMe→staging throughput (config 1: SSD→host buffer)
+  --dest device  full NVMe→staging→accelerator pipeline via DeviceStream
+                 (config 2: ssd2tpu path); chunks overlap NVMe DMA with
+                 the host→device transfer exactly like the hot loop.
+  --dest null    submit+wait+release without touching payloads — queue
+                 ceiling probe.
+
+Exit status is non-zero if --verify finds a mismatch or any request fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from nvme_strom_tpu.io.engine import StromEngine, check_file
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats, human_bytes as _human
+
+
+def make_test_file(path: str, size: int) -> None:
+    """Deterministic pseudo-random content (seeded, so verify is stable)."""
+    rng = np.random.default_rng(0xC0FFEE)
+    with open(path, "wb") as f:
+        left = size
+        while left > 0:
+            n = min(left, 64 << 20)
+            f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+            left -= n
+
+
+def run(args: argparse.Namespace) -> int:
+    path = args.file
+    made_temp = False
+    if path is None:
+        path = os.path.join(args.tmpdir or ".", "ssd2tpu_test.bin")
+        print(f"# no file given — generating {_human(args.make_bytes)} "
+              f"test file at {path}", file=sys.stderr)
+        make_test_file(path, args.make_bytes)
+        made_temp = True
+
+    info = check_file(path)
+    print(f"# CHECK_FILE: size={_human(info.size)} "
+          f"O_DIRECT={'yes' if info.supports_direct else 'NO (fallback)'} "
+          f"block={info.block_size} fs_magic={info.fs_magic:#x}",
+          file=sys.stderr)
+
+    cfg = EngineConfig(
+        chunk_bytes=args.chunk_bytes,
+        queue_depth=args.depth,
+        buffer_pool_bytes=max(args.chunk_bytes * (args.depth + 2),
+                              EngineConfig().buffer_pool_bytes),
+        use_io_uring=not args.no_uring,
+    )
+    total_limit = args.total_bytes or info.size
+    total_limit = min(total_limit, info.size)
+
+    rc = 0
+    with StromEngine(cfg, stats=StromStats()) as eng:
+        print(f"# engine: backend={eng.backend} chunk={_human(cfg.chunk_bytes)}"
+              f" depth={cfg.queue_depth} pool={eng.n_buffers} bufs",
+              file=sys.stderr)
+        fh = eng.open(path, force_buffered=args.force_buffered)
+        ranges = [(o, min(cfg.chunk_bytes, total_limit - o))
+                  for o in range(0, total_limit, cfg.chunk_bytes)]
+
+        t0 = time.monotonic()
+        payload = 0
+        n_fallback = 0
+
+        if args.dest == "device":
+            from nvme_strom_tpu.ops.bridge import DeviceStream
+            import jax
+            dev = jax.local_devices()[0]
+            stream = DeviceStream(eng, device=dev, depth=args.depth)
+            digest = hashlib.sha256()
+            for arr in stream.stream_ranges(fh, ranges):
+                payload += arr.nbytes
+                if args.verify:
+                    digest.update(np.asarray(arr).tobytes())
+            dt = time.monotonic() - t0
+            if args.verify:
+                rc |= _verify_whole(path, total_limit, digest)
+        else:
+            pending = []  # (PendingRead, offset, length)
+            digest = hashlib.sha256()
+            ref_f = open(path, "rb") if args.verify_pread else None
+            try:
+                for off, ln in ranges:
+                    pending.append((eng.submit_read(fh, off, ln), off, ln))
+                    if len(pending) >= args.depth:
+                        payload, n_fallback, rc = _drain(
+                            eng, pending, args, digest, ref_f,
+                            payload, n_fallback, rc)
+                while pending:
+                    payload, n_fallback, rc = _drain(
+                        eng, pending, args, digest, ref_f,
+                        payload, n_fallback, rc)
+            finally:
+                if ref_f is not None:
+                    ref_f.close()
+            dt = time.monotonic() - t0
+            if args.verify and args.dest == "host":
+                rc |= _verify_whole(path, total_limit, digest)
+
+        eng.close(fh)
+        eng.sync_stats()
+        snap = eng.stats.snapshot()  # engine + Python-side counters merged
+
+    gib_s = (payload / (1 << 30)) / dt if dt > 0 else 0.0
+    result = {
+        "file": path,
+        "bytes": payload,
+        "seconds": round(dt, 4),
+        "gib_per_s": round(gib_s, 3),
+        "dest": args.dest,
+        "chunk_bytes": cfg.chunk_bytes,
+        "depth": args.depth,
+        "fallback_chunks": n_fallback,
+        "verify": "ok" if (args.verify and rc == 0)
+                  else ("FAILED" if args.verify else "skipped"),
+        "stats": snap,
+    }
+    print(f"# {_human(payload)} in {dt:.3f}s = {gib_s:.3f} GiB/s "
+          f"({n_fallback} fallback chunks)", file=sys.stderr)
+    print(json.dumps(result))
+
+    if made_temp and not args.keep:
+        os.unlink(path)
+    return rc
+
+
+def _drain(eng, pending, args, digest, ref_f, payload, n_fallback, rc):
+    pr, off, ln = pending.pop(0)
+    view = pr.wait()
+    payload += view.nbytes
+    if pr.was_fallback:
+        n_fallback += 1
+    if args.verify:
+        digest.update(view.tobytes())
+        if ref_f is not None:
+            ref_f.seek(off)
+            ref = ref_f.read(ln)
+            if not np.array_equal(np.frombuffer(ref, np.uint8), view):
+                print(f"VERIFY MISMATCH at offset {off} len {ln}",
+                      file=sys.stderr)
+                rc = 1
+    pr.release()
+    return payload, n_fallback, rc
+
+
+def _verify_whole(path: str, limit: int, digest) -> int:
+    """Compare the running digest of engine-read bytes vs a buffered pread
+    sweep of the same range (the reference's DMA-vs-pread check, §4)."""
+    ref = hashlib.sha256()
+    with open(path, "rb") as f:
+        left = limit
+        while left > 0:
+            b = f.read(min(left, 16 << 20))
+            if not b:
+                break
+            ref.update(b)
+            left -= len(b)
+    if ref.digest() != digest.digest():
+        print("VERIFY MISMATCH: sha256(engine bytes) != sha256(pread bytes)",
+              file=sys.stderr)
+        return 1
+    print("# verify: sha256 match vs pread", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ssd2tpu_test",
+        description="NVMe→TPU chunked read benchmark (ssd2gpu_test analogue)")
+    ap.add_argument("file", nargs="?", default=None,
+                    help="file to read (generated if omitted)")
+    ap.add_argument("--chunk-bytes", type=int, default=8 << 20)
+    ap.add_argument("--depth", type=int, default=8,
+                    help="async requests kept in flight")
+    ap.add_argument("--total-bytes", type=int, default=None,
+                    help="stop after this many bytes")
+    ap.add_argument("--dest", choices=("host", "device", "null"),
+                    default="host")
+    ap.add_argument("--verify", action="store_true",
+                    help="sha256-compare engine bytes vs pread")
+    ap.add_argument("--verify-pread", action="store_true",
+                    help="additionally compare every chunk byte-exact")
+    ap.add_argument("--force-buffered", action="store_true",
+                    help="disable O_DIRECT (measure the fallback path)")
+    ap.add_argument("--no-uring", action="store_true",
+                    help="force the thread-pool backend")
+    ap.add_argument("--make-bytes", type=int, default=256 << 20,
+                    help="size of the generated file when no file given")
+    ap.add_argument("--tmpdir", default=None)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the generated test file")
+    args = ap.parse_args(argv)
+    if args.verify_pread:
+        args.verify = True
+    if args.dest == "null":
+        args.verify = False
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

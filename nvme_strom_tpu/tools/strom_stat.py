@@ -1,0 +1,111 @@
+"""strom_stat — print strom-io transfer counters.
+
+Analogue of the reference's stat CLI reading ``STROM_IOCTL__STAT_INFO``
+(SURVEY.md §2 "Stat CLI", §5 "Metrics/logging").  The reference reads
+kernel-module-global counters; our engines are in-process, so engines
+export their counter block to ``$STROM_STATS_EXPORT`` (atomic JSON file,
+written on engine shutdown / sync) and this tool reads that file.
+
+    STROM_STATS_EXPORT=/tmp/strom.json python train.py &
+    python -m nvme_strom_tpu.tools.strom_stat /tmp/strom.json --watch 1
+
+The headline line is the north-star check (BASELINE.json): direct bytes
+with ``bounce_bytes == 0``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from nvme_strom_tpu.utils.stats import human_bytes as _human
+
+_COUNTERS = (
+    "bytes_direct", "bytes_fallback", "bounce_bytes", "bytes_to_device",
+    "bytes_written_direct", "requests_submitted", "requests_completed",
+    "requests_failed", "retries",
+)
+
+
+def load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def render(snap: dict, prev: dict | None = None, dt: float | None = None
+           ) -> str:
+    lines = []
+    exported = snap.get("_exported_at")
+    if exported:
+        age = time.time() - exported
+        lines.append(f"exported {age:.1f}s ago by pid {snap.get('_pid', '?')}")
+    for name in _COUNTERS:
+        v = int(snap.get(name, 0))
+        suffix = ""
+        if prev is not None and dt and name.startswith(("bytes", "bounce")):
+            rate = (v - int(prev.get(name, 0))) / dt
+            suffix = f"   ({_human(rate)}/s)"
+        shown = _human(v) if name.startswith(("bytes", "bounce")) else str(v)
+        lines.append(f"  {name:<22} {shown:>14}{suffix}")
+    direct = int(snap.get("bytes_direct", 0))
+    bounce = int(snap.get("bounce_bytes", 0))
+    if direct and bounce == 0:
+        lines.append("north star: OK — direct path with zero host bounces")
+    elif bounce:
+        pct = 100.0 * bounce / max(1, direct + int(snap.get(
+            "bytes_fallback", 0)))
+        lines.append(f"north star: {_human(bounce)} bounced "
+                     f"({pct:.1f}% of payload)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="strom_stat", description="strom-io counter reader")
+    ap.add_argument("path", nargs="?",
+                    default=os.environ.get("STROM_STATS_EXPORT"),
+                    help="stats export file (default: $STROM_STATS_EXPORT)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="dump raw JSON instead of the table")
+    ap.add_argument("--watch", type=float, default=None, metavar="SECS",
+                    help="re-read and print rates every SECS seconds")
+    args = ap.parse_args(argv)
+
+    if not args.path:
+        print("strom_stat: no stats file — pass a path or set "
+              "STROM_STATS_EXPORT in the producing process", file=sys.stderr)
+        return 2
+    try:
+        snap = load(args.path)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"strom_stat: cannot read {args.path}: {e}", file=sys.stderr)
+        return 2
+
+    if args.watch is None:
+        print(json.dumps(snap, sort_keys=True) if args.as_json
+              else render(snap))
+        return 0
+
+    prev, t_prev = snap, time.monotonic()
+    print(json.dumps(snap, sort_keys=True) if args.as_json else render(snap))
+    try:
+        while True:
+            time.sleep(args.watch)
+            try:
+                snap = load(args.path)
+            except (OSError, json.JSONDecodeError):
+                continue
+            now = time.monotonic()
+            print("---")
+            print(json.dumps(snap, sort_keys=True) if args.as_json
+                  else render(snap, prev, now - t_prev))
+            prev, t_prev = snap, now
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

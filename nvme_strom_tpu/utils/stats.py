@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -82,9 +83,44 @@ class StromStats:
                 setattr(self, name, 0)
             self._t0 = time.monotonic()
 
+    def maybe_export(self) -> None:
+        """Write the counter block to ``$STROM_STATS_EXPORT`` (if set).
+
+        This is how out-of-process observers (the strom_stat CLI, the
+        reference's stat-reader analogue — SURVEY.md §2) see an engine's
+        counters: the reference reads kernel-global state via an ioctl; an
+        in-process engine instead snapshots to a well-known file.  The write
+        is atomic (rename) so readers never see a torn block.
+        """
+        path = os.environ.get("STROM_STATS_EXPORT")
+        if not path:
+            return
+        snap = self.snapshot()
+        snap["_exported_at"] = time.time()
+        snap["_pid"] = os.getpid()
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(snap, f, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
 
 COUNTER_FIELDS = tuple(
     f.name for f in dataclasses.fields(StromStats)
     if not f.name.startswith("_"))
 
 global_stats = StromStats()
+
+
+def human_bytes(n: float) -> str:
+    """1536 → '1.50 KiB'; handles negative deltas (counter resets)."""
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.2f} {unit}"
+        n /= 1024
+    return f"{n:.2f} TiB"

@@ -1,0 +1,100 @@
+"""Tests for the L3 CLI utilities (ssd2tpu_test, strom_stat) —
+the analogues of the reference's benchmark + stat tools (SURVEY.md §2/§3.4).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.tools import ssd2tpu_test, strom_stat
+
+
+@pytest.fixture
+def data_file(tmp_path):
+    path = tmp_path / "payload.bin"
+    rng = np.random.default_rng(7)
+    path.write_bytes(rng.integers(0, 256, 3 * (1 << 20) + 777,
+                                  dtype=np.uint8).tobytes())
+    return path
+
+
+def _run(capsys, argv):
+    rc = ssd2tpu_test.main(argv)
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return rc, json.loads(out)
+
+
+def test_ssd2tpu_host_verify(capsys, data_file):
+    rc, res = _run(capsys, [str(data_file), "--chunk-bytes", str(1 << 20),
+                            "--depth", "3", "--verify"])
+    assert rc == 0
+    assert res["verify"] == "ok"
+    assert res["bytes"] == data_file.stat().st_size
+    assert res["gib_per_s"] > 0
+    assert res["stats"]["requests_failed"] == 0
+
+
+def test_ssd2tpu_chunk_byte_exact(capsys, data_file):
+    rc, res = _run(capsys, [str(data_file), "--chunk-bytes", str(1 << 20),
+                            "--verify-pread", "--depth", "2"])
+    assert rc == 0
+    assert res["verify"] == "ok"
+
+
+def test_ssd2tpu_device_dest(capsys, data_file):
+    rc, res = _run(capsys, [str(data_file), "--dest", "device",
+                            "--chunk-bytes", str(1 << 20), "--verify"])
+    assert rc == 0
+    assert res["verify"] == "ok"
+    assert res["stats"]["bytes_to_device"] >= data_file.stat().st_size
+
+
+def test_ssd2tpu_total_bytes_cap(capsys, data_file):
+    rc, res = _run(capsys, [str(data_file), "--total-bytes", str(1 << 20),
+                            "--chunk-bytes", str(256 << 10)])
+    assert rc == 0
+    assert res["bytes"] == 1 << 20
+
+
+def test_ssd2tpu_generates_file(capsys, tmp_path):
+    rc, res = _run(capsys, ["--make-bytes", str(1 << 20), "--tmpdir",
+                            str(tmp_path), "--verify"])
+    assert rc == 0
+    assert res["verify"] == "ok"
+    assert not os.path.exists(res["file"])  # cleaned up without --keep
+
+
+def test_stats_export_and_strom_stat(capsys, data_file, tmp_path,
+                                     monkeypatch):
+    export = tmp_path / "strom_stats.json"
+    monkeypatch.setenv("STROM_STATS_EXPORT", str(export))
+
+    from nvme_strom_tpu.io.engine import StromEngine
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    with StromEngine(stats=StromStats()) as eng:
+        fh = eng.open(data_file)
+        with eng.submit_read(fh, 0, 4096) as p:
+            assert p.wait().nbytes == 4096
+        eng.close(fh)
+    assert export.exists()
+
+    rc = strom_stat.main([str(export)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "requests_completed" in out
+
+    rc = strom_stat.main([str(export), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    snap = json.loads(out)
+    assert snap["requests_completed"] >= 1
+    assert snap["bounce_bytes"] == 0  # north star on the direct path
+
+
+def test_strom_stat_missing_file(capsys, tmp_path, monkeypatch):
+    monkeypatch.delenv("STROM_STATS_EXPORT", raising=False)
+    assert strom_stat.main([]) == 2
+    assert strom_stat.main([str(tmp_path / "absent.json")]) == 2
