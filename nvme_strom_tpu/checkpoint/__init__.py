@@ -1,0 +1,18 @@
+"""Checkpoint/resume subsystem — the HBM→NVMe inverse of the read path.
+
+The reference has no checkpointing (it is a storage engine, not a trainer);
+SURVEY.md §5 "Checkpoint/resume" flags the inverse path (device→NVMe) as the
+natural extension, with the safetensors lazy load (benchmark config 4) as
+the read side.  This package supplies the trainer-facing layer on top:
+
+- :class:`CheckpointManager` — step-numbered, atomically-renamed checkpoint
+  directories with retention, saving arbitrary pytrees (params + optimizer
+  state + counters) through the engine's O_DIRECT writer and restoring them
+  under pjit shardings without a host-side global assembly.
+"""
+
+from nvme_strom_tpu.checkpoint.manager import (  # noqa: F401
+    CheckpointManager,
+    flatten_with_names,
+    unflatten_from_names,
+)

@@ -1,0 +1,475 @@
+"""Step-numbered checkpointing of training pytrees via the direct engine.
+
+Layout of one checkpoint (``<dir>/step_00000100/``):
+
+    state-00000.safetensors   tensors owned by process 0
+    state-00001.safetensors   … one file per writing process …
+    meta.json                 step, process count, tensor→span index
+
+Every process writes ONLY the row spans its addressable devices hold (the
+write-side mirror of the lazy loader's read-only-your-shard rule,
+parallel/weights.py): bulk checkpoint bytes never cross hosts, matching the
+reference's single-host DMA locality (SURVEY.md §5).  A tensor row-sharded
+over 8 hosts costs each host 1/8th of the write I/O.  Saves are atomic: the
+step directory is staged under a dotted temp name and renamed into place
+only after every payload byte is on disk, so a crashed save can never be
+mistaken for a checkpoint (the failure-recovery story SURVEY.md §5 asks
+for).  Restore places each span straight onto its devices with
+``jax.make_array_from_callback`` — no host-side global tensor is ever
+assembled.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import time
+from typing import Callable, Dict, Optional, Sequence, Union
+
+import numpy as np
+
+from nvme_strom_tpu.formats.safetensors import (
+    SafetensorsFile,
+    _np_dtype,
+    write_safetensors_engine,
+)
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.utils.config import EngineConfig
+
+_STEP_RE = re.compile(r"^step_(\d{8})$")
+
+
+# --------------------------------------------------------------------------
+# pytree <-> flat {name: leaf}
+# --------------------------------------------------------------------------
+
+def _key_to_str(k) -> str:
+    import jax.tree_util as jtu
+
+    if isinstance(k, jtu.DictKey):
+        return str(k.key)
+    if isinstance(k, jtu.SequenceKey):
+        return str(k.idx)
+    if isinstance(k, jtu.GetAttrKey):
+        return str(k.name)
+    if isinstance(k, jtu.FlattenedIndexKey):
+        return str(k.key)
+    return str(k)
+
+
+def flatten_with_names(tree) -> tuple[Dict[str, object], object]:
+    """Pytree → ({path-name: leaf}, treedef).  Names join key-path entries
+    with '|' (tensor names may themselves contain '.' and '/')."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    named = {}
+    for path, leaf in leaves:
+        name = "|".join(_key_to_str(k) for k in path) or "_root"
+        if name in named:
+            raise ValueError(f"duplicate flattened name {name!r}")
+        named[name] = leaf
+    return named, treedef
+
+
+def unflatten_from_names(treedef, named: Dict[str, object], order):
+    import jax
+
+    return jax.tree_util.tree_unflatten(
+        treedef, [named[n] for n in order])
+
+
+# --------------------------------------------------------------------------
+
+def _row_spans(arr) -> Dict[tuple, list]:
+    """Global row spans of a jax.Array: {(r0, r1): [devices]} (rows along
+    axis 0; scalars/0-d treated as one row)."""
+    shape = arr.shape
+    spans: Dict[tuple, list] = {}
+    for dev, idx in arr.sharding.devices_indices_map(shape).items():
+        if not shape:
+            spans.setdefault((0, 1), []).append(dev)
+            continue
+        s0 = tuple(idx)[0] if idx else slice(None)
+        r0 = 0 if s0.start is None else int(s0.start)
+        r1 = shape[0] if s0.stop is None else int(s0.stop)
+        spans.setdefault((r0, r1), []).append(dev)
+    return spans
+
+
+class CheckpointManager:
+    """Save/restore step-numbered training-state checkpoints.
+
+    ``state`` can be any pytree of jax/numpy arrays and Python scalars
+    (params dicts, optax optimizer states, step counters).  Restore takes a
+    ``target`` pytree of the same structure — its leaves supply shapes,
+    dtypes, and (for jax.Array leaves) the shardings to restore under, so a
+    checkpoint written under one mesh can be read back under another.
+    """
+
+    def __init__(self, directory: Union[str, os.PathLike],
+                 max_to_keep: Optional[int] = 3,
+                 engine: Optional[StromEngine] = None):
+        self.directory = str(directory)
+        self.max_to_keep = max_to_keep
+        self._engine = engine
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- introspection -----------------------------------------------------
+
+    def all_steps(self) -> list[int]:
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m and os.path.exists(
+                    os.path.join(self.directory, name, "meta.json")):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:08d}")
+
+    # -- save --------------------------------------------------------------
+
+    def save(self, step: int, state, force: bool = False) -> str:
+        """Write ``state`` as checkpoint ``step``; returns the final path.
+
+        Each process writes its own ``state-{proc}.safetensors`` with the
+        row spans it owns (owner = lowest process index holding the span);
+        process 0 writes the span index.  The temp directory is renamed in
+        only when everything is durable.
+        """
+        import jax
+
+        proc = jax.process_index()
+        final = self.step_dir(step)
+        if os.path.exists(final):
+            if not force:
+                raise FileExistsError(f"checkpoint step {step} exists")
+            if proc == 0:  # single deleter on a shared filesystem
+                shutil.rmtree(final)
+        tmp = os.path.join(self.directory, f".tmp_step_{step:08d}")
+        if proc == 0:
+            if os.path.exists(tmp):
+                shutil.rmtree(tmp)
+            os.makedirs(tmp)
+        self._sync()
+
+        named, _ = flatten_with_names(state)
+        mine: Dict[str, np.ndarray] = {}   # entries this process writes
+        index: Dict[str, dict] = {}        # global span index (proc 0 view)
+        for name, leaf in named.items():
+            if leaf is None:
+                continue
+            spans = self._leaf_spans(leaf)
+            dt = (leaf.dtype if hasattr(leaf, "dtype")
+                  else np.asarray(leaf).dtype)
+            entry = {"shape": list(np.shape(leaf)),
+                     "dtype": str(dt),
+                     "scalar": not isinstance(
+                         leaf, (jax.Array, np.ndarray)),
+                     "spans": []}
+            for (r0, r1), owner, local in spans:
+                fname = f"state-{owner:05d}.safetensors"
+                entry["spans"].append(
+                    {"file": fname, "r0": r0, "r1": r1})
+                if owner == proc and local is not None:
+                    key = name if (r0, r1) == self._full_span(leaf) \
+                        else f"{name}@r{r0}-{r1}"
+                    mine[key] = local
+            index[name] = entry
+
+        eng, own = self._get_engine()
+        try:
+            write_safetensors_engine(
+                os.path.join(tmp, f"state-{proc:05d}.safetensors"), mine,
+                eng, metadata={"step": step, "process": proc})
+        finally:
+            if own:
+                eng.close_all()
+
+        if proc == 0:
+            meta = {"format": 1, "step": step, "time": time.time(),
+                    "process_count": jax.process_count(), "tensors": index}
+            with open(os.path.join(tmp, "meta.json"), "w") as f:
+                json.dump(meta, f)
+        self._sync()  # all payloads durable before the rename
+        if proc == 0:
+            os.replace(tmp, final)
+        self._sync()
+        if proc == 0 and self.max_to_keep:
+            for old in self.all_steps()[:-self.max_to_keep]:
+                shutil.rmtree(self.step_dir(old), ignore_errors=True)
+        return final
+
+    @staticmethod
+    def _full_span(leaf) -> tuple:
+        shape = np.shape(leaf)
+        return (0, shape[0]) if shape else (0, 1)
+
+    def _leaf_spans(self, leaf):
+        """→ [((r0, r1), owner_proc, local_data_or_None), ...].
+
+        For non-jax leaves and single-process runs this is one full span
+        owned by process 0.  ``local_data`` is None when another process
+        owns the span (its bytes are not addressable here).
+        """
+        import jax
+
+        if not isinstance(leaf, jax.Array):
+            arr = np.asarray(leaf)
+            return [(self._full_span(leaf), 0, arr)]
+        spans = _row_spans(leaf)
+        out = []
+        shape = leaf.shape
+        for (r0, r1), devs in sorted(spans.items()):
+            owner = min(d.process_index for d in devs)
+            local = None
+            if owner == jax.process_index():
+                local = self._gather_span(leaf, r0, r1, shape)
+            out.append(((r0, r1), owner, local))
+        return out
+
+    @staticmethod
+    def _gather_span(leaf, r0, r1, shape):
+        """Host np array for rows [r0, r1) from addressable shards."""
+        import jax
+
+        if not shape:
+            return np.asarray(jax.device_get(
+                list(leaf.addressable_shards)[0].data)).reshape(())
+        # Collect shards intersecting the span; verify full column coverage.
+        pieces = {}
+        for shard in leaf.addressable_shards:
+            idx = tuple(shard.index)
+            s0 = idx[0] if idx else slice(None)
+            a = 0 if s0.start is None else int(s0.start)
+            b = shape[0] if s0.stop is None else int(s0.stop)
+            if (a, b) != (r0, r1):
+                continue
+            tail = tuple(
+                (0 if s.start is None else int(s.start),
+                 d if s.stop is None else int(s.stop))
+                for s, d in zip(idx[1:], shape[1:]))
+            pieces[tail] = shard.data
+        if not pieces:
+            raise ValueError("span owner holds no addressable shard "
+                             f"for rows [{r0},{r1})")
+        full_tail = tuple((0, d) for d in shape[1:])
+        if full_tail in pieces or not shape[1:]:
+            return np.asarray(jax.device_get(
+                pieces.get(full_tail, next(iter(pieces.values())))))
+        # Column-sharded span: stitch the column groups host-side (only
+        # happens when the owner process addresses all column pieces, and
+        # only axis 1 may be partial — deeper-axis sharding is resharded
+        # before saving).
+        for tail in pieces:
+            for (c0, c1), d in zip(tail[1:], shape[2:]):
+                if (c0, c1) != (0, d):
+                    raise NotImplementedError(
+                        f"tensor sharded on axis >= 2 ({tail}); reshard "
+                        "before saving")
+        cols = sorted(pieces.items())
+        want = 0
+        for tail, _ in cols:
+            if tail[0][0] != want:
+                raise NotImplementedError(
+                    "cross-host column-sharded tensor: owner does not "
+                    "address all column pieces; reshard before saving")
+            want = tail[0][1]
+        if want != shape[1]:
+            raise NotImplementedError(
+                "cross-host column-sharded tensor: columns under-covered; "
+                "reshard before saving")
+        return np.concatenate(
+            [np.asarray(jax.device_get(v)) for _, v in cols], axis=1)
+
+    # -- restore -----------------------------------------------------------
+
+    def restore(self, target, step: Optional[int] = None,
+                shardings: Union[Dict, Callable, None] = None):
+        """Read checkpoint ``step`` (default: latest) into ``target``'s
+        structure.  Leaf placement: ``shardings`` (dict name→Sharding or
+        fn(name, shape)→Sharding) wins; else a jax.Array target leaf's own
+        sharding; else the array stays a host-resident numpy array."""
+        import jax
+
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}")
+        d = self.step_dir(step)
+        with open(os.path.join(d, "meta.json")) as f:
+            meta = json.load(f)
+
+        named_t, treedef = flatten_with_names(target)
+        files: Dict[str, SafetensorsFile] = {}
+        eng, own = self._get_engine()
+        out: Dict[str, object] = {}
+        try:
+            for name, tleaf in named_t.items():
+                if tleaf is None:
+                    out[name] = None
+                    continue
+                info = meta["tensors"].get(name)
+                if info is None:
+                    raise KeyError(
+                        f"checkpoint step {step} lacks tensor {name!r}")
+                out[name] = self._restore_leaf(
+                    eng, d, files, name, info, tleaf, shardings)
+        finally:
+            if own:
+                eng.close_all()
+        return unflatten_from_names(treedef, out, list(named_t))
+
+    def _restore_leaf(self, eng, cdir, files, name, info, tleaf, shardings):
+        import jax
+        import jax.numpy as jnp
+
+        shape = tuple(info["shape"])
+        np_dt = _np_dtype(info["dtype"])
+        t_shape = tuple(np.shape(tleaf))
+        if t_shape != shape:
+            raise ValueError(f"{name}: checkpoint shape {shape} != "
+                             f"target shape {t_shape}")
+
+        sh = None
+        if shardings is not None:
+            sh = (shardings.get(name) if isinstance(shardings, dict)
+                  else shardings(name, shape))
+        if sh is None and isinstance(tleaf, jax.Array) \
+                and hasattr(tleaf, "sharding"):
+            sh = tleaf.sharding
+
+        read_rows = self._make_row_reader(eng, cdir, files, name, info,
+                                          shape, np_dt)
+        if info.get("scalar"):
+            val = read_rows(0, 1).reshape(())[()]
+            return type(tleaf)(val)
+        if sh is None:
+            host = read_rows(0, shape[0] if shape else 1)
+            host = host.reshape(shape)
+            if isinstance(tleaf, np.ndarray):
+                return host.astype(tleaf.dtype, copy=False)
+            return jnp.asarray(host, dtype=getattr(tleaf, "dtype", None))
+
+        cache: Dict = {}
+
+        def cb(index):
+            key = tuple((s.start, s.stop, s.step) for s in index)
+            got = cache.get(key)
+            if got is None:
+                if shape:
+                    s0 = index[0]
+                    r0 = 0 if s0.start is None else int(s0.start)
+                    r1 = shape[0] if s0.stop is None else int(s0.stop)
+                    got = read_rows(r0, r1).reshape(
+                        (r1 - r0,) + shape[1:])[(slice(None),) + index[1:]]
+                    got = np.ascontiguousarray(got)
+                else:
+                    got = read_rows(0, 1).reshape(())
+                cache[key] = got
+            return got
+
+        arr = jax.make_array_from_callback(shape, sh, cb)
+        tdt = getattr(tleaf, "dtype", None)
+        if tdt is not None and arr.dtype != tdt:
+            arr = jax.jit(lambda x: x.astype(tdt),
+                          out_shardings=sh)(arr)
+        return arr
+
+    def _make_row_reader(self, eng, cdir, files, name, info, shape, np_dt):
+        """Returns read_rows(r0, r1) -> np array of those rows, pulled via
+        direct engine reads from whichever span files cover them."""
+
+        spans = info["spans"]
+
+        def read_rows(r0, r1):
+            if shape and r1 <= r0:  # zero-length tensor/slice
+                return np.empty(0, dtype=np_dt)
+            row_elems = (int(np.prod(shape[1:], dtype=np.int64))
+                         if len(shape) > 1 else 1)
+            parts = []
+            for sp in spans:
+                s0, s1 = sp["r0"], sp["r1"]
+                a, b = max(r0, s0), min(r1, s1)
+                if a >= b and shape:
+                    continue
+                sf = files.get(sp["file"])
+                if sf is None:
+                    sf = SafetensorsFile(os.path.join(cdir, sp["file"]))
+                    files[sp["file"]] = sf
+                key = name if (s0, s1) == ((0, shape[0]) if shape
+                                           else (0, 1)) \
+                    else f"{name}@r{s0}-{s1}"
+                t = sf.tensors[key]
+                if not shape:  # scalar
+                    return self._engine_read(eng, sf.path, t["offset"],
+                                             t["nbytes"]).view(np_dt)
+                item = np_dt.itemsize * row_elems
+                off = t["offset"] + (a - s0) * item
+                parts.append(self._engine_read(
+                    eng, sf.path, off, (b - a) * item))
+                if b >= r1:
+                    break
+            if not parts:
+                raise ValueError(f"{name}: rows [{r0},{r1}) not covered "
+                                 "by any span")
+            flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            return flat.view(np_dt)
+
+        return read_rows
+
+    @staticmethod
+    def _engine_read(eng, path, offset, length) -> np.ndarray:
+        """Owning host array of [offset, offset+len) via chunked direct
+        reads (restore needs the bytes to outlive the staging buffer, so
+        one copy into the result buffer is inherent and counted)."""
+        out = np.empty(length, dtype=np.uint8)
+        fh = eng.open(path)
+        try:
+            chunk = eng.config.chunk_bytes
+            pend = []
+            pos = 0
+            for o in range(0, length, chunk):
+                pend.append((eng.submit_read(fh, offset + o,
+                                             min(chunk, length - o))))
+                if len(pend) >= max(2, eng.config.queue_depth // 2):
+                    p = pend.pop(0)
+                    v = p.wait()
+                    out[pos:pos + v.nbytes] = v
+                    pos += v.nbytes
+                    p.release()
+            while pend:
+                p = pend.pop(0)
+                v = p.wait()
+                out[pos:pos + v.nbytes] = v
+                pos += v.nbytes
+                p.release()
+        finally:
+            eng.close(fh)
+        eng.stats.add(bounce_bytes=int(length))
+        return out
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _get_engine(self) -> tuple[StromEngine, bool]:
+        if self._engine is not None:
+            return self._engine, False
+        return StromEngine(EngineConfig()), True
+
+    @staticmethod
+    def _sync() -> None:
+        """Cross-process barrier (no-op single-process)."""
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("strom_ckpt")

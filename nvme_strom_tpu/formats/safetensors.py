@@ -100,33 +100,80 @@ class SafetensorsFile:
         )
 
 
-def write_safetensors(path, tensors: Dict[str, np.ndarray],
-                      metadata: Optional[dict] = None) -> None:
-    """Minimal safetensors writer (row-major, offsets in insertion order)."""
+def build_header(tensors: Dict[str, np.ndarray],
+                 metadata: Optional[dict] = None) -> tuple[bytes, Dict]:
+    """Serialize the safetensors header for ``tensors`` (insertion order).
+
+    Returns ``(header_bytes, offsets)`` where ``offsets[name]`` is the
+    absolute file offset of that tensor's payload.
+    """
     header: Dict[str, dict] = {}
     if metadata:
         header["__metadata__"] = {str(k): str(v) for k, v in metadata.items()}
-    blobs = []
     pos = 0
     for name, arr in tensors.items():
-        # NOT ascontiguousarray: it promotes 0-d arrays to shape (1,).
         arr = np.asarray(arr)
         dt = str(arr.dtype)
         if dt not in _DTYPES_INV:
             raise TypeError(f"unsupported dtype {dt}")
-        blob = arr.tobytes()  # C-order bytes regardless of memory layout
         header[name] = {
             "dtype": _DTYPES_INV[dt],
             "shape": list(arr.shape),
-            "data_offsets": [pos, pos + len(blob)],
+            "data_offsets": [pos, pos + arr.nbytes],
         }
-        blobs.append(blob)
-        pos += len(blob)
+        pos += arr.nbytes
     hjson = json.dumps(header, separators=(",", ":")).encode()
     pad = (-(8 + len(hjson))) % 8  # keep data 8-byte aligned
     hjson += b" " * pad
+    head = struct.pack("<Q", len(hjson)) + hjson
+    offsets = {name: len(head) + info["data_offsets"][0]
+               for name, info in header.items() if name != "__metadata__"}
+    return head, offsets
+
+
+def write_safetensors(path, tensors: Dict[str, np.ndarray],
+                      metadata: Optional[dict] = None) -> None:
+    """Minimal safetensors writer (row-major, offsets in insertion order)."""
+    head, _ = build_header(tensors, metadata)
     with open(path, "wb") as f:
-        f.write(struct.pack("<Q", len(hjson)))
-        f.write(hjson)
-        for blob in blobs:
-            f.write(blob)
+        f.write(head)
+        for arr in tensors.values():
+            # NOT ascontiguousarray: it promotes 0-d arrays to shape (1,).
+            f.write(np.asarray(arr).tobytes())
+
+
+def write_safetensors_engine(path, tensors: Dict[str, np.ndarray], engine,
+                             metadata: Optional[dict] = None) -> None:
+    """safetensors writer over the engine's O_DIRECT write path — the
+    HBM→NVMe inverse of the DMA read path (SURVEY.md §5 "Checkpoint/
+    resume").  One file handle for the whole file; header and every
+    tensor's chunks flow as pipelined engine writes with
+    ``queue_depth`` in flight (a many-leaf optimizer pytree is one
+    open/close, not one per tensor)."""
+    head, offsets = build_header(tensors, metadata)
+    open(path, "wb").close()  # truncate any previous file
+    fh = engine.open(path, writable=True)
+    chunk = engine.config.chunk_bytes
+    pend: list = []
+    try:
+        pend.append(engine.submit_write(
+            fh, 0, np.frombuffer(head, np.uint8)))
+        for name, arr in tensors.items():
+            host = np.ascontiguousarray(
+                np.asarray(arr)).view(np.uint8).reshape(-1)
+            base = offsets[name]
+            for pos in range(0, host.nbytes, chunk):
+                pend.append(engine.submit_write(
+                    fh, base + pos, host[pos:pos + chunk]))
+                if len(pend) >= engine.config.queue_depth:
+                    pend.pop(0).wait()
+        while pend:
+            pend.pop(0).wait()
+    finally:
+        # Drain before close: in-flight writes target this fh.
+        for p in pend:
+            try:
+                p.wait()
+            except OSError:
+                pass
+        engine.close(fh)

@@ -297,10 +297,9 @@ class StromEngine:
     def close_all(self) -> None:
         if self._closed:
             return
-        self.sync_stats()
+        self.sync_stats()  # drains counters and exports the final snapshot
         self._lib.strom_engine_destroy(self._h)
         self._closed = True
-        self.stats.maybe_export()
 
     def __enter__(self):
         return self

@@ -259,7 +259,7 @@ def save_checkpoint(path, params: Dict[str, object],
     gather to one process first (``jax.experimental.multihost_utils``).
     """
     import jax
-    from nvme_strom_tpu.ops.bridge import write_from_device
+    from nvme_strom_tpu.formats.safetensors import write_safetensors_engine
 
     host = {}
     for name, arr in params.items():
@@ -267,36 +267,10 @@ def save_checkpoint(path, params: Dict[str, object],
             arr = jax.device_get(arr)  # gathers addressable shards
         host[name] = np.asarray(arr)
 
-    # Serialize the header exactly as write_safetensors would, then send
-    # header + payloads through the engine write path.
-    import json as _json
-    import struct as _struct
-    from nvme_strom_tpu.formats.safetensors import _DTYPES_INV
-    header: Dict[str, dict] = {}
-    pos = 0
-    for name, arr in host.items():
-        dt = str(arr.dtype)
-        if dt not in _DTYPES_INV:
-            raise TypeError(f"unsupported dtype {dt}")
-        header[name] = {"dtype": _DTYPES_INV[dt], "shape": list(arr.shape),
-                        "data_offsets": [pos, pos + arr.nbytes]}
-        pos += arr.nbytes
-    hjson = _json.dumps(header, separators=(",", ":")).encode()
-    hjson += b" " * ((-(8 + len(hjson))) % 8)
-    head = _struct.pack("<Q", len(hjson)) + hjson
-
     own = engine is None
     eng = engine or StromEngine(EngineConfig())
     try:
-        open(path, "wb").close()  # truncate any previous file
-        fh = eng.open(path, writable=True)
-        try:
-            eng.submit_write(fh, 0, np.frombuffer(head, np.uint8)).wait()
-        finally:
-            eng.close(fh)
-        for name, arr in host.items():
-            off = len(head) + header[name]["data_offsets"][0]
-            write_from_device(eng, arr, path, offset=off)
+        write_safetensors_engine(path, host, eng)
     finally:
         if own:
             eng.close_all()

@@ -90,10 +90,26 @@ def run(args: argparse.Namespace) -> int:
             dev = jax.local_devices()[0]
             stream = DeviceStream(eng, device=dev, depth=args.depth)
             digest = hashlib.sha256()
-            for arr in stream.stream_ranges(fh, ranges):
-                payload += arr.nbytes
-                if args.verify:
-                    digest.update(np.asarray(arr).tobytes())
+            ref_f = open(path, "rb") if args.verify_pread else None
+            try:
+                # stream_ranges yields in submit order, so chunk i pairs
+                # with ranges[i] for the byte-exact check.
+                for (off, ln), arr in zip(ranges,
+                                          stream.stream_ranges(fh, ranges)):
+                    payload += arr.nbytes
+                    if args.verify:
+                        host = np.asarray(arr)
+                        digest.update(host.tobytes())
+                        if ref_f is not None:
+                            ref_f.seek(off)
+                            ref = np.frombuffer(ref_f.read(ln), np.uint8)
+                            if not np.array_equal(ref, host):
+                                print(f"VERIFY MISMATCH at offset {off} "
+                                      f"len {ln}", file=sys.stderr)
+                                rc = 1
+            finally:
+                if ref_f is not None:
+                    ref_f.close()
             dt = time.monotonic() - t0
             if args.verify:
                 rc |= _verify_whole(path, total_limit, digest)

@@ -25,6 +25,7 @@ Semantics of the byte counters:
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 import os
 import threading
@@ -34,6 +35,8 @@ from dataclasses import dataclass, field
 #: Every public counter on StromStats, derived once from the dataclass —
 #: snapshot/reset/merge iterate this so a new counter needs exactly one edit.
 COUNTER_FIELDS: tuple = ()  # filled in after the class definition
+
+_export_seq = itertools.count()
 
 
 @dataclass
@@ -98,7 +101,10 @@ class StromStats:
         snap = self.snapshot()
         snap["_exported_at"] = time.time()
         snap["_pid"] = os.getpid()
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # pid+thread+sequence: two engines exporting concurrently must not
+        # share a temp file, or the rename publishes torn JSON.
+        tmp = (f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+               f".{next(_export_seq)}")
         try:
             with open(tmp, "w") as f:
                 json.dump(snap, f, sort_keys=True)
@@ -119,8 +125,8 @@ global_stats = StromStats()
 
 def human_bytes(n: float) -> str:
     """1536 → '1.50 KiB'; handles negative deltas (counter resets)."""
-    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
-        if abs(n) < 1024 or unit == "TiB":
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024:
             return f"{n:.2f} {unit}"
         n /= 1024
     return f"{n:.2f} TiB"
