@@ -123,9 +123,17 @@ class CheckpointManager:
         steps = []
         for name in os.listdir(self.directory):
             m = _STEP_RE.match(name)
-            if m and os.path.exists(
-                    os.path.join(self.directory, name, "meta.json")):
-                steps.append(int(m.group(1)))
+            if not m:
+                continue
+            # A step only counts if its meta.json parses — a torn write
+            # from a crashed save must not shadow older intact checkpoints.
+            try:
+                with open(os.path.join(self.directory, name,
+                                       "meta.json")) as f:
+                    json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            steps.append(int(m.group(1)))
         return sorted(steps)
 
     def latest_step(self) -> Optional[int]:
@@ -199,9 +207,18 @@ class CheckpointManager:
                     "process_count": jax.process_count(), "tensors": index}
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump(meta, f)
+                f.flush()
+                os.fsync(f.fileno())
         self._sync()  # all payloads durable before the rename
         if proc == 0:
             os.replace(tmp, final)
+            # fsync the parent so the rename itself is durable — without it
+            # a crash can publish the dir name before meta.json's blocks.
+            dfd = os.open(self.directory, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         self._sync()
         if proc == 0 and self.max_to_keep:
             for old in self.all_steps()[:-self.max_to_keep]:
@@ -352,7 +369,11 @@ class CheckpointManager:
                                           shape, np_dt)
         if info.get("scalar"):
             val = read_rows(0, 1).reshape(())[()]
-            return type(tleaf)(val)
+            if isinstance(tleaf, np.ndarray):
+                return np.asarray(val, dtype=tleaf.dtype).reshape(())
+            if isinstance(tleaf, jax.Array):
+                return jnp.asarray(val, dtype=tleaf.dtype)
+            return type(tleaf)(val)  # python int/float/bool, np scalars
         if sh is None:
             host = read_rows(0, shape[0] if shape else 1)
             host = host.reshape(shape)
@@ -360,23 +381,31 @@ class CheckpointManager:
                 return host.astype(tleaf.dtype, copy=False)
             return jnp.asarray(host, dtype=getattr(tleaf, "dtype", None))
 
-        cache: Dict = {}
+        row_cache: Dict = {}  # keyed by row span only: a P(None, 'tp')
+        # weight is read ONCE and column-sliced per device, not re-read
+        # from NVMe once per column group.
 
         def cb(index):
-            key = tuple((s.start, s.stop, s.step) for s in index)
-            got = cache.get(key)
-            if got is None:
-                if shape:
-                    s0 = index[0]
-                    r0 = 0 if s0.start is None else int(s0.start)
-                    r1 = shape[0] if s0.stop is None else int(s0.stop)
-                    got = read_rows(r0, r1).reshape(
-                        (r1 - r0,) + shape[1:])[(slice(None),) + index[1:]]
-                    got = np.ascontiguousarray(got)
-                else:
-                    got = read_rows(0, 1).reshape(())
-                cache[key] = got
-            return got
+            if not shape:
+                got = row_cache.get(())
+                if got is None:
+                    got = row_cache[()] = read_rows(0, 1).reshape(())
+                return got
+            s0 = index[0]
+            r0 = 0 if s0.start is None else int(s0.start)
+            r1 = shape[0] if s0.stop is None else int(s0.stop)
+            rows = row_cache.get((r0, r1))
+            if rows is None:
+                rows = row_cache[(r0, r1)] = read_rows(r0, r1).reshape(
+                    (r1 - r0,) + shape[1:])
+            tail = index[1:]
+            partial_tail = any(
+                ((0 if s.start is None else int(s.start)),
+                 (d if s.stop is None else int(s.stop))) != (0, d)
+                for s, d in zip(tail, shape[1:]))
+            if partial_tail:
+                return np.ascontiguousarray(rows[(slice(None),) + tail])
+            return rows
 
         arr = jax.make_array_from_callback(shape, sh, cb)
         tdt = getattr(tleaf, "dtype", None)

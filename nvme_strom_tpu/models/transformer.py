@@ -104,7 +104,23 @@ def _rope(q, k, theta):
     return rot(q), rot(k)
 
 
-def attention(x, p, prefix, cfg: TransformerConfig):
+def dense_causal_attention(q, k, v):
+    """softmax(QKᵀ/√d)V with a causal mask; q/k/v (b, h, s, d), same head
+    count (GQA already expanded).  The single-chip default ``attn_fn``."""
+    s, hd = q.shape[-2], q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def attention(x, p, prefix, cfg: TransformerConfig, attn_fn=None):
+    """``attn_fn`` swaps the attention inner block: dense (default), the
+    ring sequence-parallel kernel (parallel/ring_attention.make_ring_attn),
+    or the Pallas flash kernel — all take/return (b, h, s, d)."""
     b, s, _ = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     q = (x @ p[prefix + "wq"].astype(x.dtype)).reshape(b, s, nh, hd)
@@ -118,13 +134,7 @@ def attention(x, p, prefix, cfg: TransformerConfig):
         rep = nh // nkv
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores / np.sqrt(hd)
-    mask = jnp.tril(jnp.ones((s, s), bool))
-    scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
-    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    out = (attn_fn or dense_causal_attention)(q, k, v)
     out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
     return out @ p[prefix + "wo"].astype(x.dtype)
 
@@ -136,22 +146,26 @@ def mlp(x, p, prefix):
 
 
 def forward(params: Dict, tokens: jax.Array,
-            cfg: TransformerConfig) -> jax.Array:
+            cfg: TransformerConfig, attn_fn=None) -> jax.Array:
     """tokens (b, s) int32 → logits (b, s, vocab) float32."""
     x = params["tok_embed"].astype(cfg.dtype)[tokens]
     for i in range(cfg.n_layers):
         L = f"layers.{i}."
         x = x + attention(rms_norm(x, params[L + "attn_norm"], cfg.norm_eps),
-                          params, L, cfg)
+                          params, L, cfg, attn_fn)
         x = x + mlp(rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps),
                     params, L)
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
 
 
-def loss_fn(params, tokens, cfg) -> jax.Array:
-    """Next-token cross-entropy (tokens supply both input and target)."""
-    logits = forward(params, tokens[:, :-1], cfg)
+def loss_fn(params, tokens, cfg, attn_fn=None) -> jax.Array:
+    """Next-token cross-entropy (tokens supply both input and target).
+
+    The full sequence is forwarded and the last logit dropped — identical
+    to forwarding tokens[:, :-1] for a causal model, but keeps the seq dim
+    a multiple of the ``sp`` shard count for ring attention."""
+    logits = forward(params, tokens, cfg, attn_fn)[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
@@ -160,15 +174,16 @@ def loss_fn(params, tokens, cfg) -> jax.Array:
 
 # ----------------------------- training -----------------------------
 
-def make_train_step(cfg: TransformerConfig, optimizer):
+def make_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
     """Returns step(params, opt_state, tokens) -> (params, opt_state, loss).
-    Pure function — jit/shard it at the call site."""
+    Pure function — jit/shard it at the call site.  ``attn_fn`` selects the
+    attention inner block (dense / ring / flash)."""
 
     import optax
 
     def step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, cfg))(params)
+            lambda p: loss_fn(p, tokens, cfg, attn_fn))(params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

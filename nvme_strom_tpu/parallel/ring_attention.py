@@ -1,0 +1,128 @@
+"""Ring attention: sequence/context parallelism over an ``sp`` mesh axis.
+
+Long-context support the TPU way: the sequence dimension is sharded across
+devices, each holding one block of Q/K/V, and K/V blocks rotate around the
+ring with ``lax.ppermute`` (ICI neighbor exchanges — the collective pattern
+XLA maps to the torus) while each device accumulates its block's attention
+output with a numerically-stable online softmax (flash-attention style
+m/l/o accumulation).  Peak memory per device is O(s_local²) per block pair
+instead of O(s²), and the rotation overlaps with the block matmuls.
+
+The reference has no model or parallelism concepts at all (SURVEY.md §2
+"Parallelism strategies: NOT PRESENT") — this module exists because
+long-context sequence parallelism is a first-class requirement of the TPU
+framework build, exercised by the flagship transformer
+(models/transformer.py) and the driver's multi-chip dry run.
+
+Math note: per ring step t, device i holds K/V block j = (i - t) mod n.
+Causality admits j < i fully, j == i with the in-block causal mask, and
+j > i not at all; masking is done in the score domain with a large negative
+and re-applied to the probabilities so fully-masked blocks contribute
+exactly zero.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+_NEG = -1e30  # mask value: finite so exp() underflows instead of NaN-ing
+
+
+def _to_varying(x, axis_names: tuple):
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to="varying")
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis_names)
+    return x  # pre-VMA jax: no cast needed
+
+
+def _ring_block(q, k, v, axis_name: str, n_sp: int, causal: bool,
+                mesh_axes: tuple = ()):
+    """Per-device computation. q/k/v: (b, h, s_blk, d) local blocks."""
+    b, h, s_blk, d = q.shape
+    idx = jax.lax.axis_index(axis_name)
+    scale = 1.0 / np.sqrt(d)
+    q32 = q.astype(jnp.float32) * scale
+    q_pos = idx * s_blk + jnp.arange(s_blk)
+
+    m0 = jnp.full((b, h, s_blk), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, h, s_blk), jnp.float32)
+    o0 = jnp.zeros((b, h, s_blk, d), jnp.float32)
+    # The loop carry becomes varying over every manual mesh axis (it mixes
+    # with q/k/v, which are), so the invariant initial values must be cast
+    # to varying for the new shard_map VMA type system; older jax spells
+    # pcast as pvary, oldest needs nothing.
+    vary = tuple(mesh_axes) or (axis_name,)
+    m0, l0, o0 = (_to_varying(x, vary) for x in (m0, l0, o0))
+    perm = [(i, (i + 1) % n_sp) for i in range(n_sp)]
+
+    def body(t, carry):
+        k_t, v_t, m, l, o = carry
+        j = (idx - t) % n_sp
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_t.astype(jnp.float32))
+        if causal:
+            kv_pos = j * s_blk + jnp.arange(s_blk)
+            mask = kv_pos[None, :] <= q_pos[:, None]
+            s = jnp.where(mask, s, _NEG)
+        m_new = jnp.maximum(m, s.max(-1))
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask, p, 0.0)  # fully-masked rows: exactly zero
+        correction = jnp.exp(m - m_new)
+        l = l * correction + p.sum(-1)
+        o = o * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_t.astype(jnp.float32))
+        # Rotate K/V to the next device (skippable on the last step, but a
+        # uniform body keeps the loop fusible).
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        return k_t, v_t, m_new, l, o
+
+    _, _, _, l, o = jax.lax.fori_loop(0, n_sp, body, (k, v, m0, l0, o0))
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, sp_axis: str = "sp",
+                   dp_axis: str = "dp", tp_axis: str = "tp",
+                   causal: bool = True):
+    """Causal attention with the sequence dim sharded over ``sp_axis``.
+
+    q/k/v: (batch, heads, seq, head_dim) global arrays — batch sharded over
+    ``dp_axis`` (if present in the mesh), heads over ``tp_axis`` (if
+    present), seq over ``sp_axis``.  K/V must already be GQA-expanded to
+    the same head count as Q.  Returns the same layout as q.
+    """
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
+
+    n_sp = mesh.shape[sp_axis]
+    dp = dp_axis if dp_axis in mesh.shape else None
+    tp = tp_axis if tp_axis in mesh.shape else None
+    spec = P(dp, tp, sp_axis, None)
+
+    manual = tuple(a for a in (dp, tp, sp_axis) if a is not None)
+    fn = shard_map(
+        partial(_ring_block, axis_name=sp_axis, n_sp=n_sp, causal=causal,
+                mesh_axes=manual),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def make_ring_attn(mesh, sp_axis: str = "sp", dp_axis: str = "dp",
+                   tp_axis: str = "tp"):
+    """attn_fn(q, k, v) -> out for models/transformer.forward(...,
+    attn_fn=...): the drop-in sequence-parallel replacement for the dense
+    softmax(QKᵀ)V block."""
+
+    def attn_fn(q, k, v):
+        return ring_attention(q, k, v, mesh, sp_axis=sp_axis,
+                              dp_axis=dp_axis, tp_axis=tp_axis)
+
+    return attn_fn

@@ -41,12 +41,16 @@ def param_shardings(cfg: TransformerConfig, mesh) -> Dict[str, NamedSharding]:
             for k, spec in param_specs(cfg).items()}
 
 
-def batch_spec() -> P:
-    return P("dp", None)
+def batch_spec(seq_sharded: bool = False) -> P:
+    """(batch, seq) tokens: batch over dp; seq over sp when ring attention
+    is in play (parallel/ring_attention.py)."""
+    return P("dp", "sp") if seq_sharded else P("dp", None)
 
 
-def batch_shardings(mesh) -> NamedSharding:
-    return NamedSharding(mesh, batch_spec())
+def batch_shardings(mesh, seq_sharded: bool = False) -> NamedSharding:
+    if seq_sharded and "sp" not in mesh.shape:
+        raise ValueError("mesh has no 'sp' axis for sequence sharding")
+    return NamedSharding(mesh, batch_spec(seq_sharded))
 
 
 def replicated_sharding(mesh) -> NamedSharding:

@@ -149,6 +149,28 @@ def test_save_existing_step_raises(tmp_path):
     np.testing.assert_allclose(got["x"], 7.0)
 
 
+def test_scalar_targets_various_types(tmp_path):
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(1, {"step": 7, "lr": 0.25})
+    got = mgr.restore({"step": np.array(0), "lr": jnp.float32(0)})
+    assert got["step"].shape == () and int(got["step"]) == 7
+    assert isinstance(got["lr"], jax.Array) and float(got["lr"]) == 0.25
+    got2 = mgr.restore({"step": 0, "lr": 0.0})
+    assert got2["step"] == 7 and isinstance(got2["step"], int)
+
+
+def test_torn_meta_does_not_shadow_intact_checkpoint(tmp_path):
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(1, {"x": np.ones(3, np.float32)})
+    # Simulate a crash mid-save of step 2: dir exists, meta.json empty.
+    bad = mgr.step_dir(2)
+    os.makedirs(bad)
+    open(os.path.join(bad, "meta.json"), "w").close()
+    assert mgr.all_steps() == [1]
+    got = mgr.restore({"x": np.zeros(3, np.float32)})
+    np.testing.assert_allclose(got["x"], 1.0)
+
+
 def test_zero_length_tensor_roundtrip(tmp_path):
     state = {"empty": np.zeros((0, 5), np.float32),
              "x": np.ones(3, np.float32)}
