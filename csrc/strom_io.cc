@@ -35,12 +35,17 @@
 #include <unordered_map>
 #include <vector>
 
+#include <dirent.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <limits.h>
+#include <stdio.h>
+#include <stdlib.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <sys/statfs.h>
 #include <sys/syscall.h>
+#include <sys/sysmacros.h>
 #include <unistd.h>
 
 /* ---------------- raw io_uring plumbing (no liburing) ---------------- */
@@ -630,6 +635,99 @@ int strom_check_file(const char *path, strom_file_info *out) {
     }
     close(fd);
   }
+  return 0;
+}
+
+/* ---- backing-device topology (CHECK_FILE's blockdev half, §3.3) ---- */
+
+static int sysfs_read_line(const char *path, char *buf, size_t n) {
+  FILE *f = fopen(path, "r");
+  if (!f) return -1;
+  char *got = fgets(buf, (int)n, f);
+  fclose(f);
+  if (!got) return -1;
+  buf[strcspn(buf, "\n")] = 0;
+  return 0;
+}
+
+/* Resolve a sysfs block-device link (/sys/dev/block/M:m or
+ * /sys/class/block/<name>) to the WHOLE-DISK name: partitions step up to
+ * their parent directory, mirroring the reference's partition->blockdev
+ * walk in the CHECK_FILE handler (SURVEY.md §2 "File eligibility"). */
+static int whole_disk_name(const char *sys_link, char *name, size_t n) {
+  char real[PATH_MAX];
+  if (!realpath(sys_link, real)) return -1;
+  char probe[PATH_MAX + 16];
+  snprintf(probe, sizeof(probe), "%s/partition", real);
+  if (access(probe, F_OK) == 0) {
+    char *slash = strrchr(real, '/');
+    if (!slash) return -1;
+    *slash = '\0';
+  }
+  const char *base = strrchr(real, '/');
+  if (!base || !base[1]) return -1;
+  snprintf(name, n, "%s", base + 1);
+  return 0;
+}
+
+static int name_is_nvme(const char *name) {
+  return strncmp(name, "nvme", 4) == 0;
+}
+
+int strom_resolve_device(const char *path, strom_device_info *out) {
+  memset(out, 0, sizeof(*out));
+  out->raid_level = -1;
+  out->rotational = -1;
+  struct stat st;
+  if (stat(path, &st) != 0) return -errno;
+  char link[96];
+  snprintf(link, sizeof(link), "/sys/dev/block/%u:%u",
+           major(st.st_dev), minor(st.st_dev));
+  if (whole_disk_name(link, out->device, sizeof(out->device)) != 0)
+    return 0; /* overlay/tmpfs/network fs: no visible backing blockdev */
+
+  char p[PATH_MAX];
+  char buf[64];
+  snprintf(p, sizeof(p), "/sys/block/%s/queue/rotational", out->device);
+  if (sysfs_read_line(p, buf, sizeof(buf)) == 0)
+    out->rotational = atoi(buf);
+  out->is_nvme = name_is_nvme(out->device);
+
+  snprintf(p, sizeof(p), "/sys/block/%s/md", out->device);
+  if (access(p, F_OK) != 0) {
+    out->nvme_backed = out->is_nvme;
+    return 0;
+  }
+  /* md array: level + member walk (reference: "md-raid0 stripe
+   * resolution", SURVEY.md §2/§3.1). */
+  out->is_raid = 1;
+  snprintf(p, sizeof(p), "/sys/block/%s/md/level", out->device);
+  if (sysfs_read_line(p, buf, sizeof(buf)) == 0 &&
+      strncmp(buf, "raid", 4) == 0)
+    out->raid_level = atoi(buf + 4);
+  snprintf(p, sizeof(p), "/sys/block/%s/slaves", out->device);
+  DIR *d = opendir(p);
+  int all_nvme = 1;
+  if (d) {
+    struct dirent *de;
+    /* Scan EVERY member for the all-NVMe verdict; members[] records only
+     * the first STROM_MAX_RAID_MEMBERS names. */
+    while ((de = readdir(d)) != nullptr) {
+      if (de->d_name[0] == '.') continue;
+      char slink[PATH_MAX];
+      char mname[64];
+      snprintf(slink, sizeof(slink), "/sys/class/block/%.200s", de->d_name);
+      if (whole_disk_name(slink, mname, sizeof(mname)) != 0)
+        snprintf(mname, sizeof(mname), "%.63s", de->d_name);
+      if (out->n_members < STROM_MAX_RAID_MEMBERS)
+        memcpy(out->members[out->n_members], mname, sizeof(mname));
+      out->n_members++;
+      if (!name_is_nvme(mname)) all_nvme = 0;
+    }
+    closedir(d);
+  }
+  out->nvme_backed =
+      (out->raid_level == 0 && out->n_members > 0 && all_nvme) ? 1 : 0;
   return 0;
 }
 

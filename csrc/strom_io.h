@@ -74,6 +74,29 @@ void strom_engine_destroy(strom_engine *eng);
 /* Engine-independent file eligibility probe (CHECK_FILE analogue). */
 int strom_check_file(const char *path, strom_file_info *out);
 
+/* Backing block-device topology of the file at `path` — the other half of
+ * the reference's CHECK_FILE verdict (SURVEY.md §3.3: "blockdev must be
+ * NVMe, or md-raid0 whose members are all NVMe").  Resolved from sysfs:
+ * st_dev -> /sys/dev/block -> partition->parent walk -> md member scan. */
+#define STROM_MAX_RAID_MEMBERS 16
+typedef struct strom_device_info {
+  char    device[64];    /* whole-disk name ("nvme0n1", "md0", "vda");
+                            empty when no backing blockdev is visible
+                            (overlayfs, tmpfs, network fs)              */
+  int32_t is_nvme;       /* whole disk is an NVMe namespace             */
+  int32_t is_raid;       /* device is an md array                       */
+  int32_t raid_level;    /* numeric md level (0 == raid0); -1 unknown   */
+  int32_t n_members;     /* md member count (whole-disk resolved)       */
+  int32_t rotational;    /* /sys/block/<dev>/queue/rotational; -1 unknown */
+  int32_t nvme_backed;   /* the CHECK_FILE verdict: NVMe, or md-raid0
+                            striped over all-NVMe members               */
+  char    members[STROM_MAX_RAID_MEMBERS][64];
+} strom_device_info;
+
+/* Returns 0 (with device[0]=='\0' if unresolvable) or -errno when `path`
+ * itself cannot be stat'ed. */
+int strom_resolve_device(const char *path, strom_device_info *out);
+
 /* Open a file for engine I/O. Tries O_DIRECT first; transparently falls
  * back to buffered (counted per-request). Returns fh >= 0 or -errno.
  * flags: bit 0 = writable; bit 1 = force buffered I/O (debug/testing knob,

@@ -3,8 +3,11 @@ from nvme_strom_tpu.io.engine import (
     PendingRead,
     PendingWrite,
     FileInfo,
+    DeviceInfo,
     check_file,
+    resolve_device,
+    file_eligible,
 )
 
 __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
-           "check_file"]
+           "DeviceInfo", "check_file", "resolve_device", "file_eligible"]

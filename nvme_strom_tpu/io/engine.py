@@ -37,6 +37,22 @@ class _FileInfo(ctypes.Structure):
     ]
 
 
+_MAX_RAID_MEMBERS = 16
+
+
+class _DeviceInfo(ctypes.Structure):
+    _fields_ = [
+        ("device", ctypes.c_char * 64),
+        ("is_nvme", ctypes.c_int32),
+        ("is_raid", ctypes.c_int32),
+        ("raid_level", ctypes.c_int32),
+        ("n_members", ctypes.c_int32),
+        ("rotational", ctypes.c_int32),
+        ("nvme_backed", ctypes.c_int32),
+        ("members", (ctypes.c_char * 64) * _MAX_RAID_MEMBERS),
+    ]
+
+
 class _StatsBlk(ctypes.Structure):
     _fields_ = [(n, ctypes.c_uint64) for n in (
         "bytes_direct", "bytes_fallback", "bounce_bytes",
@@ -71,6 +87,8 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_engine_destroy.argtypes = [ctypes.c_void_p]
         lib.strom_check_file.argtypes = [ctypes.c_char_p,
                                          ctypes.POINTER(_FileInfo)]
+        lib.strom_resolve_device.argtypes = [ctypes.c_char_p,
+                                             ctypes.POINTER(_DeviceInfo)]
         lib.strom_open.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_int]
         lib.strom_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -106,6 +124,21 @@ class FileInfo:
     fs_magic: int
 
 
+@dataclass(frozen=True)
+class DeviceInfo:
+    """Backing block-device topology — the blockdev half of the reference's
+    CHECK_FILE verdict (SURVEY.md §3.3: fs must sit on NVMe, or md-raid0
+    whose members are all NVMe). ``device == ""`` means no backing blockdev
+    is visible (overlayfs/tmpfs/network fs)."""
+    device: str
+    is_nvme: bool
+    is_raid: bool
+    raid_level: int       # numeric md level (0 == raid0); -1 unknown
+    rotational: int       # -1 unknown
+    nvme_backed: bool     # NVMe, or raid0 striped over all-NVMe members
+    members: tuple[str, ...]
+
+
 def check_file(path: os.PathLike | str) -> FileInfo:
     lib = _load_lib()
     info = _FileInfo()
@@ -114,6 +147,31 @@ def check_file(path: os.PathLike | str) -> FileInfo:
         raise OSError(-rc, os.strerror(-rc), str(path))
     return FileInfo(size=info.size, supports_direct=bool(info.supports_direct),
                     block_size=info.block_size, fs_magic=info.fs_magic)
+
+
+def resolve_device(path: os.PathLike | str) -> DeviceInfo:
+    """sysfs walk: st_dev → /sys/dev/block → partition→parent → md members."""
+    lib = _load_lib()
+    info = _DeviceInfo()
+    rc = lib.strom_resolve_device(str(path).encode(), ctypes.byref(info))
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), str(path))
+    members = tuple(info.members[i].value.decode()
+                    for i in range(min(info.n_members, _MAX_RAID_MEMBERS)))
+    return DeviceInfo(device=info.device.decode(),
+                      is_nvme=bool(info.is_nvme), is_raid=bool(info.is_raid),
+                      raid_level=info.raid_level, rotational=info.rotational,
+                      nvme_backed=bool(info.nvme_backed), members=members)
+
+
+def file_eligible(path: os.PathLike | str) -> tuple[bool, FileInfo, DeviceInfo]:
+    """The complete CHECK_FILE analogue: O_DIRECT works AND the file sits on
+    NVMe (or md-raid0 over all-NVMe). Consumers use a False verdict the way
+    the reference's callers use EINVAL/ENOTSUP — fall back to buffered
+    reads (SURVEY.md §3.3)."""
+    fi = check_file(path)
+    di = resolve_device(path)
+    return bool(fi.supports_direct and di.nvme_backed), fi, di
 
 
 class PendingRead:

@@ -29,7 +29,7 @@ import time
 
 import numpy as np
 
-from nvme_strom_tpu.io.engine import StromEngine, check_file
+from nvme_strom_tpu.io.engine import StromEngine, check_file, resolve_device
 from nvme_strom_tpu.utils.config import EngineConfig
 from nvme_strom_tpu.utils.stats import StromStats, human_bytes as _human
 
@@ -60,6 +60,18 @@ def run(args: argparse.Namespace) -> int:
           f"O_DIRECT={'yes' if info.supports_direct else 'NO (fallback)'} "
           f"block={info.block_size} fs_magic={info.fs_magic:#x}",
           file=sys.stderr)
+    dev = resolve_device(path)
+    if dev.device:
+        topo = f"device={dev.device} nvme={'yes' if dev.is_nvme else 'no'}"
+        if dev.is_raid:
+            topo += (f" md-raid{dev.raid_level} "
+                     f"members=[{', '.join(dev.members)}]")
+        topo += (" — NVMe-backed" if dev.nvme_backed
+                 else " — not NVMe-backed")
+        print(f"# {topo}", file=sys.stderr)
+    else:
+        print("# device: no visible backing blockdev (overlay/tmpfs?)",
+              file=sys.stderr)
 
     cfg = EngineConfig(
         chunk_bytes=args.chunk_bytes,

@@ -12,7 +12,8 @@ import os
 import numpy as np
 import pytest
 
-from nvme_strom_tpu.io import StromEngine, check_file
+from nvme_strom_tpu.io import (StromEngine, check_file, file_eligible,
+                               resolve_device)
 from nvme_strom_tpu.utils.config import EngineConfig
 from nvme_strom_tpu.utils.stats import StromStats
 
@@ -43,6 +44,42 @@ def test_check_file(tmp_data_file):
 def test_check_file_missing():
     with pytest.raises(OSError):
         check_file("/no/such/file")
+
+
+def test_resolve_device(tmp_data_file):
+    path, _ = tmp_data_file
+    dev = resolve_device(path)
+    # On a visible blockdev (ext4/xfs) the whole-disk name resolves; on
+    # overlay/tmpfs it is empty — both are valid, but fields must be
+    # internally consistent either way.
+    if dev.device:
+        assert "/" not in dev.device
+        assert dev.rotational in (-1, 0, 1)
+    else:
+        assert not dev.nvme_backed and not dev.is_raid
+    if dev.is_raid:
+        assert len(dev.members) > 0
+    else:
+        assert dev.members == ()
+        # plain device: verdict must equal the NVMe test
+        if dev.device:
+            assert dev.nvme_backed == dev.is_nvme
+    if dev.nvme_backed and dev.is_raid:
+        assert dev.raid_level == 0
+        assert all(m.startswith("nvme") for m in dev.members)
+
+
+def test_resolve_device_missing():
+    with pytest.raises(OSError):
+        resolve_device("/no/such/file")
+
+
+def test_file_eligible_verdict(tmp_data_file):
+    path, _ = tmp_data_file
+    ok, fi, di = file_eligible(path)
+    # the verdict is the AND of the two probes, like the reference's
+    # CHECK_FILE (fs check + blockdev check, SURVEY.md §3.3)
+    assert ok == bool(fi.supports_direct and di.nvme_backed)
 
 
 def test_full_read_matches(engine, tmp_data_file):
