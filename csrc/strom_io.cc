@@ -41,6 +41,9 @@
 #include <limits.h>
 #include <stdio.h>
 #include <stdlib.h>
+#include <linux/fiemap.h>
+#include <linux/fs.h>
+#include <sys/ioctl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <sys/statfs.h>
@@ -729,6 +732,84 @@ int strom_resolve_device(const char *path, strom_device_info *out) {
   out->nvme_backed =
       (out->raid_level == 0 && out->n_members > 0 && all_nvme) ? 1 : 0;
   return 0;
+}
+
+int strom_file_extents(const char *path, strom_extent *out, uint32_t max) {
+  if (max == 0) return 0;
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { int e = -errno; close(fd); return e; }
+  if (st.st_size == 0) { close(fd); return 0; }
+
+#ifdef FS_IOC_FIEMAP
+  size_t sz = sizeof(struct fiemap) + (size_t)max * sizeof(struct fiemap_extent);
+  struct fiemap *fm = (struct fiemap *)calloc(1, sz);
+  if (!fm) { close(fd); return -ENOMEM; }
+  /* Batched walk with an advancing window: a map that does not fit in
+   * `max` entries is an error (-E2BIG), never a silent truncation — the
+   * caller retries with a bigger buffer.  The reference's extent walk has
+   * the same never-drop-the-tail property (it chunks the whole range,
+   * SURVEY.md §3.1). */
+  uint32_t count = 0;
+  uint64_t start = 0;
+  bool supported = true;
+  int err = 0;
+  while (true) {
+    memset(fm, 0, sizeof(struct fiemap));
+    fm->fm_start = start;
+    fm->fm_length = (uint64_t)st.st_size - start;
+    fm->fm_flags = FIEMAP_FLAG_SYNC;
+    fm->fm_extent_count = max - count;
+    if (ioctl(fd, FS_IOC_FIEMAP, fm) != 0) {
+      if (errno == ENOTTY || errno == EOPNOTSUPP) {
+        supported = false; /* fs has no FIEMAP: synthetic fallback below */
+      } else {
+        err = -errno;      /* real I/O error: propagate, do not mask */
+      }
+      break;
+    }
+    uint32_t n = fm->fm_mapped_extents;
+    if (n == 0) break; /* sparse tail hole — map complete */
+    if (n > max - count) n = max - count;
+    bool last = false;
+    for (uint32_t i = 0; i < n; i++) {
+      out[count + i].logical = fm->fm_extents[i].fe_logical;
+      out[count + i].physical = fm->fm_extents[i].fe_physical;
+      out[count + i].length = fm->fm_extents[i].fe_length;
+      out[count + i].flags = fm->fm_extents[i].fe_flags;
+      out[count + i].pad = 0;
+      if (fm->fm_extents[i].fe_flags & FIEMAP_EXTENT_LAST) last = true;
+    }
+    count += n;
+    start = out[count - 1].logical + out[count - 1].length;
+    if (last || start >= (uint64_t)st.st_size) break;
+    if (count == max) { err = -E2BIG; break; } /* more extents than room */
+  }
+  free(fm);
+  if (err != 0) { close(fd); return err; }
+  if (supported) { close(fd); return (int)count; }
+#endif
+  /* No FIEMAP (tmpfs/overlay/proc): one synthetic whole-file extent. */
+  out[0].logical = 0;
+  out[0].physical = 0;
+  out[0].length = (uint64_t)st.st_size;
+  out[0].flags = STROM_EXTENT_SYNTHETIC;
+  out[0].pad = 0;
+  close(fd);
+  return 1;
+}
+
+void strom_get_pool_info(strom_engine *e, strom_pool_info *out) {
+  std::lock_guard<std::mutex> g(e->mu);
+  out->n_buffers = e->n_buffers;
+  out->free_buffers = (uint32_t)e->free_bufs.size();
+  out->buf_bytes = e->buf_bytes;
+  out->pool_bytes = (uint64_t)e->pool_sz;
+  out->locked = e->locked ? 1 : 0;
+  out->queue_depth = (int32_t)e->queue_depth;
+  out->in_flight = (uint32_t)e->reqs.size();
+  out->deferred = (uint32_t)e->defer_q.size();
 }
 
 int strom_open(strom_engine *e, const char *path, int flags) {

@@ -97,6 +97,43 @@ typedef struct strom_device_info {
  * itself cannot be stat'ed. */
 int strom_resolve_device(const char *path, strom_device_info *out);
 
+/* File-offset -> physical-extent map, the analogue of the reference's
+ * in-kernel extent walk that turns (inode, offset, len) into NVMe LBAs
+ * (SURVEY.md §3.1).  Backed by the FIEMAP ioctl; filesystems without
+ * FIEMAP yield one synthetic whole-file extent (physical == 0, flags =
+ * STROM_EXTENT_SYNTHETIC) — the logical analogue of the reference's
+ * page-cache fallback: the range is still readable, just not physically
+ * addressable. */
+#define STROM_EXTENT_SYNTHETIC 0x80000000u
+typedef struct strom_extent {
+  uint64_t logical;   /* byte offset in the file                        */
+  uint64_t physical;  /* byte offset on the backing device (0 unknown)  */
+  uint64_t length;    /* extent length in bytes                         */
+  uint32_t flags;     /* raw fiemap fe_flags (| STROM_EXTENT_SYNTHETIC) */
+  uint32_t pad;
+} strom_extent;
+
+/* Fills up to `max` extents covering [0, file_size). Returns the number
+ * of extents written (>= 0) or -errno. */
+int strom_file_extents(const char *path, strom_extent *out, uint32_t max);
+
+/* Staging-pool introspection — the LIST_GPU_MEMORY / INFO_GPU_MEMORY
+ * analogue (SURVEY.md §2 "GPU memory mapper"): the reference enumerates
+ * pinned GPU mappings; we report the pinned staging pool and its
+ * occupancy. */
+typedef struct strom_pool_info {
+  uint32_t n_buffers;     /* total staging buffers                     */
+  uint32_t free_buffers;  /* currently unassigned                      */
+  uint64_t buf_bytes;     /* payload capacity per buffer               */
+  uint64_t pool_bytes;    /* total mapped bytes incl. alignment slack  */
+  int32_t  locked;        /* 1 if mlock'd (pinned)                     */
+  int32_t  queue_depth;
+  uint32_t in_flight;     /* submitted, not yet released               */
+  uint32_t deferred;      /* submitted, waiting for a free buffer      */
+} strom_pool_info;
+
+void strom_get_pool_info(strom_engine *eng, strom_pool_info *out);
+
 /* Open a file for engine I/O. Tries O_DIRECT first; transparently falls
  * back to buffered (counted per-request). Returns fh >= 0 or -errno.
  * flags: bit 0 = writable; bit 1 = force buffered I/O (debug/testing knob,

@@ -4,10 +4,13 @@ from nvme_strom_tpu.io.engine import (
     PendingWrite,
     FileInfo,
     DeviceInfo,
+    Extent,
     check_file,
     resolve_device,
+    file_extents,
     file_eligible,
 )
 
 __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
-           "DeviceInfo", "check_file", "resolve_device", "file_eligible"]
+           "DeviceInfo", "Extent", "check_file", "resolve_device",
+           "file_extents", "file_eligible"]

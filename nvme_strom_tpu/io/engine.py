@@ -53,6 +53,29 @@ class _DeviceInfo(ctypes.Structure):
     ]
 
 
+class _Extent(ctypes.Structure):
+    _fields_ = [
+        ("logical", ctypes.c_uint64),
+        ("physical", ctypes.c_uint64),
+        ("length", ctypes.c_uint64),
+        ("flags", ctypes.c_uint32),
+        ("pad", ctypes.c_uint32),
+    ]
+
+
+class _PoolInfo(ctypes.Structure):
+    _fields_ = [
+        ("n_buffers", ctypes.c_uint32),
+        ("free_buffers", ctypes.c_uint32),
+        ("buf_bytes", ctypes.c_uint64),
+        ("pool_bytes", ctypes.c_uint64),
+        ("locked", ctypes.c_int32),
+        ("queue_depth", ctypes.c_int32),
+        ("in_flight", ctypes.c_uint32),
+        ("deferred", ctypes.c_uint32),
+    ]
+
+
 class _StatsBlk(ctypes.Structure):
     _fields_ = [(n, ctypes.c_uint64) for n in (
         "bytes_direct", "bytes_fallback", "bounce_bytes",
@@ -89,6 +112,11 @@ def _load_lib() -> ctypes.CDLL:
                                          ctypes.POINTER(_FileInfo)]
         lib.strom_resolve_device.argtypes = [ctypes.c_char_p,
                                              ctypes.POINTER(_DeviceInfo)]
+        lib.strom_file_extents.argtypes = [ctypes.c_char_p,
+                                           ctypes.POINTER(_Extent),
+                                           ctypes.c_uint32]
+        lib.strom_get_pool_info.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(_PoolInfo)]
         lib.strom_open.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_int]
         lib.strom_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -162,6 +190,42 @@ def resolve_device(path: os.PathLike | str) -> DeviceInfo:
                       is_nvme=bool(info.is_nvme), is_raid=bool(info.is_raid),
                       raid_level=info.raid_level, rotational=info.rotational,
                       nvme_backed=bool(info.nvme_backed), members=members)
+
+
+EXTENT_SYNTHETIC = 0x80000000
+
+
+@dataclass(frozen=True)
+class Extent:
+    """One file extent — the analogue of the reference's extent-walk output
+    (file offsets resolved toward physical LBAs, SURVEY.md §3.1).
+    ``synthetic`` extents come from filesystems without FIEMAP: the range is
+    readable but not physically addressable."""
+    logical: int
+    physical: int
+    length: int
+    flags: int
+
+    @property
+    def synthetic(self) -> bool:
+        return bool(self.flags & EXTENT_SYNTHETIC)
+
+
+def file_extents(path: os.PathLike | str, max_extents: int = 1024
+                 ) -> list[Extent]:
+    """Complete extent map of `path`. Grows the buffer on -E2BIG so a
+    heavily fragmented file never yields a silently truncated map."""
+    lib = _load_lib()
+    while True:
+        arr = (_Extent * max_extents)()
+        n = lib.strom_file_extents(str(path).encode(), arr, max_extents)
+        if n == -errno.E2BIG and max_extents < (1 << 22):
+            max_extents *= 4
+            continue
+        if n < 0:
+            raise OSError(-n, os.strerror(-n), str(path))
+        return [Extent(logical=e.logical, physical=e.physical,
+                       length=e.length, flags=e.flags) for e in arr[:n]]
 
 
 def file_eligible(path: os.PathLike | str) -> tuple[bool, FileInfo, DeviceInfo]:
@@ -330,6 +394,13 @@ class StromEngine:
         return PendingWrite(self, rid, arr)
 
     # -- stats / lifecycle -------------------------------------------------
+
+    def pool_info(self) -> dict:
+        """Staging-pool occupancy — LIST/INFO_GPU_MEMORY analogue
+        (SURVEY.md §2 "GPU memory mapper")."""
+        info = _PoolInfo()
+        self._lib.strom_get_pool_info(self._h, ctypes.byref(info))
+        return {n: int(getattr(info, n)) for n, _ in _PoolInfo._fields_}
 
     def engine_stats(self) -> dict:
         blk = _StatsBlk()

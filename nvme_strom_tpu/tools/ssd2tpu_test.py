@@ -29,7 +29,8 @@ import time
 
 import numpy as np
 
-from nvme_strom_tpu.io.engine import StromEngine, check_file, resolve_device
+from nvme_strom_tpu.io.engine import (StromEngine, check_file, file_extents,
+                                      resolve_device)
 from nvme_strom_tpu.utils.config import EngineConfig
 from nvme_strom_tpu.utils.stats import StromStats, human_bytes as _human
 
@@ -72,6 +73,14 @@ def run(args: argparse.Namespace) -> int:
     else:
         print("# device: no visible backing blockdev (overlay/tmpfs?)",
               file=sys.stderr)
+    exts = file_extents(path)
+    if exts and not exts[0].synthetic:
+        print(f"# extents: {len(exts)} "
+              f"(largest {_human(max(e.length for e in exts))}, "
+              f"smallest {_human(min(e.length for e in exts))})",
+              file=sys.stderr)
+    else:
+        print("# extents: not physically mapped (no FIEMAP)", file=sys.stderr)
 
     cfg = EngineConfig(
         chunk_bytes=args.chunk_bytes,

@@ -13,7 +13,7 @@ import numpy as np
 import pytest
 
 from nvme_strom_tpu.io import (StromEngine, check_file, file_eligible,
-                               resolve_device)
+                               file_extents, resolve_device)
 from nvme_strom_tpu.utils.config import EngineConfig
 from nvme_strom_tpu.utils.stats import StromStats
 
@@ -72,6 +72,68 @@ def test_resolve_device(tmp_data_file):
 def test_resolve_device_missing():
     with pytest.raises(OSError):
         resolve_device("/no/such/file")
+
+
+def test_file_extents(tmp_data_file):
+    path, payload = tmp_data_file
+    exts = file_extents(path)
+    assert len(exts) >= 1
+    # extents cover the whole file (FIEMAP rounds up to fs blocks)
+    assert sum(e.length for e in exts) >= len(payload)
+    assert exts[0].logical == 0
+    logicals = [e.logical for e in exts]
+    assert logicals == sorted(logicals)
+    if not exts[0].synthetic:
+        # physically mapped extents carry device addresses
+        assert all(e.physical > 0 for e in exts)
+
+
+def test_file_extents_sparse_no_truncation(tmp_path):
+    """A multi-extent (sparse) file must yield its COMPLETE map even when
+    the initial buffer is too small — the C side returns -E2BIG and the
+    wrapper grows, never silently truncating (reference never drops the
+    extent tail either, SURVEY.md §3.1)."""
+    p = tmp_path / "frag.bin"
+    with open(p, "wb") as f:
+        for i in range(6):
+            f.seek(i * 65536)
+            f.write(b"x" * 4096)
+        f.flush()
+        os.fsync(f.fileno())
+    exts = file_extents(p, max_extents=1)
+    if exts and exts[0].synthetic:
+        pytest.skip("no FIEMAP on this filesystem")
+    assert len(exts) == 6
+    assert [e.logical for e in exts] == [i * 65536 for i in range(6)]
+
+
+def test_file_extents_empty(tmp_path):
+    p = tmp_path / "empty.bin"
+    p.write_bytes(b"")
+    assert file_extents(p) == []
+
+
+def test_file_extents_missing():
+    with pytest.raises(OSError):
+        file_extents("/no/such/file")
+
+
+def test_pool_info(engine, tmp_data_file):
+    path, _ = tmp_data_file
+    info = engine.pool_info()
+    assert info["n_buffers"] == engine.n_buffers
+    assert info["free_buffers"] == info["n_buffers"]
+    assert info["pool_bytes"] >= info["n_buffers"] * info["buf_bytes"]
+    fh = engine.open(path)
+    p = engine.submit_read(fh, 0, 4096)
+    p.wait()
+    held = engine.pool_info()
+    # one buffer is held by the un-released request
+    assert held["free_buffers"] == info["n_buffers"] - 1
+    assert held["in_flight"] == 1
+    p.release()
+    assert engine.pool_info()["free_buffers"] == info["n_buffers"]
+    engine.close(fh)
 
 
 def test_file_eligible_verdict(tmp_data_file):
