@@ -49,6 +49,7 @@
 #include <sys/statfs.h>
 #include <sys/syscall.h>
 #include <sys/sysmacros.h>
+#include <time.h>
 #include <unistd.h>
 
 /* ---------------- raw io_uring plumbing (no liburing) ---------------- */
@@ -255,6 +256,12 @@ struct FileEnt {
 
 enum class ReqState { kInflight, kDone };
 
+inline uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
 struct Req {
   int64_t id = 0;
   int fh = -1;
@@ -269,6 +276,7 @@ struct Req {
   ReqState state = ReqState::kInflight;
   int status = 0;                      /* 0 or -errno                 */
   uint64_t done_len = 0;               /* payload bytes transferred   */
+  uint64_t t_submit = 0, t_complete = 0; /* CLOCK_MONOTONIC ns        */
 };
 
 }  // namespace
@@ -302,6 +310,8 @@ struct strom_engine {
 
   std::atomic<uint64_t> st_direct{0}, st_fallback{0}, st_bounce{0},
       st_written{0}, st_sub{0}, st_comp{0}, st_fail{0}, st_retry{0};
+  std::atomic<uint64_t> lat_read[STROM_LAT_BUCKETS] = {};
+  std::atomic<uint64_t> lat_write[STROM_LAT_BUCKETS] = {};
 
   uint8_t *buf_ptr(int idx) { return pool + (uint64_t)idx * buf_cap; }
 
@@ -380,6 +390,11 @@ struct strom_engine {
 
   void complete_locked(Req *r) {
     r->state = ReqState::kDone;
+    r->t_complete = now_ns();
+    uint64_t lat = r->t_complete - r->t_submit;
+    int b = 63 - __builtin_clzll(lat | 1);
+    (r->is_write ? lat_write : lat_read)[b].fetch_add(
+        1, std::memory_order_relaxed);
     st_comp.fetch_add(1, std::memory_order_relaxed);
     cv_done.notify_all();
   }
@@ -878,6 +893,7 @@ int64_t strom_submit_read(strom_engine *e, int fh, uint64_t offset,
   r->fh = fh;
   r->offset = offset;
   r->len = len;
+  r->t_submit = now_ns();
   r->a_off = align_down(offset, e->alignment);
   r->a_len = align_up(offset + len, e->alignment) - r->a_off;
   r->direct = fe.fd_direct >= 0;
@@ -906,6 +922,8 @@ int strom_wait(strom_engine *e, int64_t req_id, strom_completion *out) {
     out->len = r->done_len;
     out->status = r->status;
     out->was_fallback = r->was_fallback ? 1 : 0;
+    out->submit_ns = r->t_submit;
+    out->complete_ns = r->t_complete;
   }
   return r->status;
 }
@@ -939,6 +957,7 @@ int64_t strom_submit_write(strom_engine *e, int fh, uint64_t offset,
   r->fh = fh;
   r->offset = offset;
   r->len = len;
+  r->t_submit = now_ns();
   r->direct = conformant;
   r->wsrc = src; /* wrapper keeps src alive until wait() */
   e->reqs[r->id] = r;
@@ -993,9 +1012,24 @@ void strom_drain_stats(strom_engine *e, strom_stats_blk *out) {
 void strom_reset_stats(strom_engine *e) {
   e->st_direct = 0; e->st_fallback = 0; e->st_bounce = 0; e->st_written = 0;
   e->st_sub = 0; e->st_comp = 0; e->st_fail = 0; e->st_retry = 0;
+  for (int i = 0; i < STROM_LAT_BUCKETS; i++) {
+    e->lat_read[i].store(0, std::memory_order_relaxed);
+    e->lat_write[i].store(0, std::memory_order_relaxed);
+  }
 }
 
 int strom_backend_is_uring(strom_engine *e) { return e->use_uring ? 1 : 0; }
+
+void strom_get_latency(strom_engine *e,
+                       uint64_t out_read[STROM_LAT_BUCKETS],
+                       uint64_t out_write[STROM_LAT_BUCKETS]) {
+  for (int i = 0; i < STROM_LAT_BUCKETS; i++) {
+    if (out_read)
+      out_read[i] = e->lat_read[i].load(std::memory_order_relaxed);
+    if (out_write)
+      out_write[i] = e->lat_write[i].load(std::memory_order_relaxed);
+  }
+}
 
 /* ---------------- crc32c (Castagnoli) ---------------- */
 

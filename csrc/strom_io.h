@@ -56,7 +56,18 @@ typedef struct strom_completion {
   uint64_t len;          /* payload length actually read                 */
   int32_t  status;       /* 0 ok; negative errno                         */
   int32_t  was_fallback; /* 1 if this request took the buffered path     */
+  uint64_t submit_ns;    /* CLOCK_MONOTONIC at submit                    */
+  uint64_t complete_ns;  /* CLOCK_MONOTONIC at completion                */
 } strom_completion;
+
+/* Per-request latency histograms (submit->complete), log2-ns buckets:
+ * bucket i counts requests with latency in [2^i, 2^(i+1)) ns.  The
+ * reference exposes only aggregate byte/request counters via STAT_INFO
+ * (SURVEY.md §5 Tracing: "minimal") — this is the promised upgrade. */
+#define STROM_LAT_BUCKETS 64
+void strom_get_latency(strom_engine *eng,
+                       uint64_t out_read[STROM_LAT_BUCKETS],
+                       uint64_t out_write[STROM_LAT_BUCKETS]);
 
 /* Create an engine.
  *   queue_depth  — io_uring SQ depth / worker count for the fallback pool

@@ -89,7 +89,12 @@ class _Completion(ctypes.Structure):
         ("len", ctypes.c_uint64),
         ("status", ctypes.c_int32),
         ("was_fallback", ctypes.c_int32),
+        ("submit_ns", ctypes.c_uint64),
+        ("complete_ns", ctypes.c_uint64),
     ]
+
+
+_LAT_BUCKETS = 64
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -117,6 +122,9 @@ def _load_lib() -> ctypes.CDLL:
                                            ctypes.c_uint32]
         lib.strom_get_pool_info.argtypes = [ctypes.c_void_p,
                                             ctypes.POINTER(_PoolInfo)]
+        lib.strom_get_latency.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64)]
         lib.strom_open.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_int]
         lib.strom_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
@@ -263,6 +271,12 @@ class PendingRead:
             self.release()
             raise OSError(-rc, os.strerror(-rc))
         self.was_fallback = bool(comp.was_fallback)
+        tracer = self._engine.tracer
+        if tracer is not None and tracer.enabled:
+            tracer.add_span(
+                "strom.read.fallback" if comp.was_fallback else "strom.read",
+                int(comp.submit_ns), int(comp.complete_ns),
+                bytes=int(comp.len))
         n = int(comp.len)
         if n == 0:
             self._view = np.empty(0, dtype=np.uint8)
@@ -305,6 +319,10 @@ class PendingWrite:
         self._keepalive = None
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
+        tracer = self._engine.tracer
+        if tracer is not None and tracer.enabled:
+            tracer.add_span("strom.write", int(comp.submit_ns),
+                            int(comp.complete_ns), bytes=n)
         return n
 
 
@@ -316,9 +334,12 @@ class StromEngine:
     """
 
     def __init__(self, config: Optional[EngineConfig] = None,
-                 stats: Optional[StromStats] = None):
+                 stats: Optional[StromStats] = None,
+                 tracer: Optional["Tracer"] = None):
+        from nvme_strom_tpu.utils.trace import global_tracer
         self.config = config or EngineConfig()
         self.stats = stats if stats is not None else global_stats
+        self.tracer = tracer if tracer is not None else global_tracer
         self._lib = _load_lib()
         c = self.config
         n_buffers = max(
@@ -332,6 +353,7 @@ class StromEngine:
                           + os.strerror(ctypes.get_errno()))
         self.n_buffers = n_buffers
         self._open_fhs: set[int] = set()
+        self._last_lat_read: list[int] = [0] * _LAT_BUCKETS
         self._closed = False
 
     # -- file handles ------------------------------------------------------
@@ -395,6 +417,22 @@ class StromEngine:
 
     # -- stats / lifecycle -------------------------------------------------
 
+    def latency_histogram(self) -> dict:
+        """Per-request submit→complete latency, log2-ns buckets: entry i of
+        each list counts requests whose latency fell in [2^i, 2^(i+1)) ns.
+        The per-request upgrade over the reference's aggregate-only
+        STAT_INFO counters (SURVEY.md §5 Tracing)."""
+        rd = (ctypes.c_uint64 * _LAT_BUCKETS)()
+        wr = (ctypes.c_uint64 * _LAT_BUCKETS)()
+        self._lib.strom_get_latency(self._h, rd, wr)
+        return {"read": [int(x) for x in rd], "write": [int(x) for x in wr]}
+
+    def latency_percentiles(self, kind: str = "read",
+                            ps=(50, 90, 99)) -> dict:
+        """Approximate percentiles (ns) from the log2 histogram."""
+        from nvme_strom_tpu.utils.stats import percentiles_from_log2_hist
+        return percentiles_from_log2_hist(self.latency_histogram()[kind], ps)
+
     def pool_info(self) -> dict:
         """Staging-pool occupancy — LIST/INFO_GPU_MEMORY analogue
         (SURVEY.md §2 "GPU memory mapper")."""
@@ -415,6 +453,19 @@ class StromEngine:
         self._lib.strom_drain_stats(self._h, ctypes.byref(blk))
         snap = {n: int(getattr(blk, n)) for n, _ in _StatsBlk._fields_}
         self.stats.merge_engine(snap)
+        # Interval percentiles (diff vs the previous sync), matching the
+        # per-interval semantics of the drained counters — a cumulative
+        # histogram would bury a fresh latency regression under hours of
+        # old samples.
+        from nvme_strom_tpu.utils.stats import percentiles_from_log2_hist
+        cur = self.latency_histogram()["read"]
+        interval = [max(0, c - p)  # a reset_stats between syncs clamps to 0
+                    for c, p in zip(cur, self._last_lat_read)]
+        self._last_lat_read = cur
+        pct = percentiles_from_log2_hist(interval, ps=(50, 99))
+        if any(pct.values()):
+            self.stats.set_gauges(lat_read_p50_us=pct[50] / 1000.0,
+                                  lat_read_p99_us=pct[99] / 1000.0)
         self.stats.maybe_export()  # keep strom_stat --watch observers live
         return snap
 

@@ -36,12 +36,18 @@ def host_to_device(engine: StromEngine, host: np.ndarray, dev):
     counted as a bounce). On an accelerator the PCIe transfer itself moves
     the bytes and no host copy exists.  Single source of truth for every
     consumer that puts staging-backed views on device.
+
+    Spans: the dispatch is recorded in the strom tracer AND annotated for
+    the JAX profiler, so chrome://tracing / Perfetto views line up
+    (both clocks are CLOCK_MONOTONIC).
     """
     import jax
     if dev.platform == "cpu":
         host = np.array(host)
         engine.stats.add(bounce_bytes=int(host.nbytes))
-    arr = jax.device_put(host, dev)
+    with jax.profiler.TraceAnnotation("strom.h2d"), \
+            engine.tracer.span("strom.h2d.dispatch", bytes=int(host.nbytes)):
+        arr = jax.device_put(host, dev)
     engine.stats.add(bytes_to_device=int(host.nbytes))
     return arr
 
@@ -94,7 +100,9 @@ class DeviceStream:
 
         def drain_one():
             arr, pr = inflight.pop(0)
-            arr.block_until_ready()  # device owns the bytes now
+            with self.engine.tracer.span("strom.h2d.sync",
+                                         bytes=int(arr.nbytes)):
+                arr.block_until_ready()  # device owns the bytes now
             pr.release()
             return arr
 

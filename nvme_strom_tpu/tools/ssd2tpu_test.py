@@ -73,13 +73,17 @@ def run(args: argparse.Namespace) -> int:
     else:
         print("# device: no visible backing blockdev (overlay/tmpfs?)",
               file=sys.stderr)
-    exts = file_extents(path)
+    try:
+        exts = file_extents(path)
+    except OSError as e:  # diagnostics only — never abort the benchmark
+        print(f"# extents: probe failed ({e.strerror})", file=sys.stderr)
+        exts = []
     if exts and not exts[0].synthetic:
         print(f"# extents: {len(exts)} "
               f"(largest {_human(max(e.length for e in exts))}, "
               f"smallest {_human(min(e.length for e in exts))})",
               file=sys.stderr)
-    else:
+    elif exts:
         print("# extents: not physically mapped (no FIEMAP)", file=sys.stderr)
 
     cfg = EngineConfig(

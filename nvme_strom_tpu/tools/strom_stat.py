@@ -50,6 +50,8 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
             suffix = f"   ({_human(rate)}/s)"
         shown = _human(v) if name.startswith(("bytes", "bounce")) else str(v)
         lines.append(f"  {name:<22} {shown:>14}{suffix}")
+    for name in sorted(k for k in snap if k.startswith("lat_")):
+        lines.append(f"  {name:<22} {snap[name]:>14.1f}")
     direct = int(snap.get("bytes_direct", 0))
     bounce = int(snap.get("bounce_bytes", 0))
     if direct and bounce == 0:

@@ -54,11 +54,18 @@ class StromStats:
     retries: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
+    _gauges: dict = field(default_factory=dict, repr=False)
 
     def add(self, **deltas: int) -> None:
         with self._lock:
             for name, d in deltas.items():
                 setattr(self, name, getattr(self, name) + d)
+
+    def set_gauges(self, **values) -> None:
+        """Point-in-time values (latency percentiles etc.) carried in the
+        export alongside the counters; unlike counters they overwrite."""
+        with self._lock:
+            self._gauges.update(values)
 
     def merge_engine(self, engine_stats: dict) -> None:
         """Fold counters read from the C++ engine into this block."""
@@ -75,7 +82,9 @@ class StromStats:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {name: getattr(self, name) for name in COUNTER_FIELDS}
+            snap = {name: getattr(self, name) for name in COUNTER_FIELDS}
+            snap.update(self._gauges)
+            return snap
 
     def dump_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
@@ -84,6 +93,7 @@ class StromStats:
         with self._lock:
             for name in COUNTER_FIELDS:
                 setattr(self, name, 0)
+            self._gauges.clear()
             self._t0 = time.monotonic()
 
     def maybe_export(self) -> None:
@@ -121,6 +131,32 @@ COUNTER_FIELDS = tuple(
     if not f.name.startswith("_"))
 
 global_stats = StromStats()
+
+
+def percentiles_from_log2_hist(hist: list, ps=(50, 90, 99)) -> dict:
+    """Approximate percentiles from a log2-bucketed histogram.
+
+    ``hist[i]`` counts samples in [2^i, 2^(i+1)); each percentile reports
+    the geometric midpoint of the bucket the rank falls in (~±41% worst
+    case, plenty for latency triage). Returns {p: value} with value 0 when
+    the histogram is empty.
+    """
+    total = sum(hist)
+    out = {}
+    for p in ps:
+        if total == 0:
+            out[p] = 0
+            continue
+        rank = total * p / 100.0
+        acc = 0
+        val = 0
+        for i, c in enumerate(hist):
+            acc += c
+            if acc >= rank and c > 0:
+                val = int((2 ** i) * 1.5)
+                break
+        out[p] = val
+    return out
 
 
 def human_bytes(n: float) -> str:

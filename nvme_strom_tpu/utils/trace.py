@@ -1,0 +1,134 @@
+"""Chrome-trace span recorder — the tracing upgrade promised in SURVEY.md §5.
+
+The reference's observability is aggregate STAT_INFO counters only
+("Tracing/profiling: minimal").  This module records *per-request spans*
+(NVMe read, buffered fallback, host→device transfer, engine write) and
+exports them as a Chrome ``traceEvents`` JSON file loadable in
+``chrome://tracing`` / Perfetto — alongside ``jax.profiler`` traces, since
+both use CLOCK_MONOTONIC timestamps on Linux.
+
+Activation:
+- environment: ``STROM_TRACE=/path/out.trace.json`` — the global tracer
+  enables itself and every engine/stream records into it; the file is
+  written atomically on ``export()`` and at interpreter exit.
+- explicit: ``Tracer()`` handed to consumers, or ``global_tracer.enable()``.
+
+Events carry the engine's own submit/complete CLOCK_MONOTONIC nanoseconds,
+so spans reflect true I/O latency, not Python call timing.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+#: Default in-memory span cap; override per-tracer or with
+#: $STROM_TRACE_MAX_EVENTS.  When full, new spans are DROPPED and counted
+#: (exported as metadata) — an unbounded event list on a multi-hour run
+#: would otherwise grow to OOM.
+DEFAULT_MAX_EVENTS = 1_000_000
+
+
+class Tracer:
+    """Thread-safe span recorder with chrome://tracing export."""
+
+    def __init__(self, path: Optional[str] = None,
+                 max_events: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._path = path
+        self.enabled = path is not None
+        self.max_events = max_events if max_events is not None else int(
+            os.environ.get("STROM_TRACE_MAX_EVENTS", DEFAULT_MAX_EVENTS))
+        self.dropped = 0
+        self._atexit_registered = False
+        if self.enabled:
+            self._register_atexit()
+
+    def _register_atexit(self) -> None:
+        if not self._atexit_registered:
+            atexit.register(self.export)
+            self._atexit_registered = True
+
+    def enable(self, path: str) -> None:
+        self._path = path
+        self.enabled = True
+        self._register_atexit()
+
+    def add_span(self, name: str, begin_ns: int, end_ns: int,
+                 category: str = "strom", **args) -> None:
+        """Record a completed span [begin_ns, end_ns) (CLOCK_MONOTONIC)."""
+        if not self.enabled:
+            return
+        ev = {
+            "name": name,
+            "cat": category,
+            "ph": "X",
+            "ts": begin_ns / 1000.0,                  # chrome wants µs
+            "dur": max(end_ns - begin_ns, 0) / 1000.0,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+        }
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def span(self, name: str, category: str = "strom", **args):
+        """Context manager measuring a Python-side span with the same
+        clock the engine stamps I/O with (CLOCK_MONOTONIC)."""
+        return _SpanCtx(self, name, category, args)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def export(self, path: Optional[str] = None) -> Optional[str]:
+        """Atomically write the trace file; returns the path (None if the
+        tracer is disabled / has nowhere to write)."""
+        path = path or self._path
+        if path is None:
+            return None
+        with self._lock:
+            doc = {"traceEvents": list(self._events),
+                   "displayTimeUnit": "ms"}
+            if self.dropped:
+                doc["metadata"] = {"strom_dropped_events": self.dropped}
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+        return path
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+class _SpanCtx:
+    def __init__(self, tracer: Tracer, name: str, category: str, args: dict):
+        self._tracer = tracer
+        self._name = name
+        self._cat = category
+        self._args = args
+        self._t0 = 0
+
+    def __enter__(self):
+        self._t0 = time.monotonic_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self._tracer.add_span(self._name, self._t0, time.monotonic_ns(),
+                              category=self._cat, **self._args)
+        return False
+
+
+global_tracer = Tracer(os.environ.get("STROM_TRACE") or None)

@@ -1,0 +1,124 @@
+"""Tracing + latency observability (SURVEY.md §5 "Tracing/profiling"):
+per-request spans exported as chrome://tracing JSON, log2 latency
+histograms with percentile summaries — the upgrade over the reference's
+aggregate-only STAT_INFO counters."""
+
+import json
+
+import pytest
+
+from nvme_strom_tpu.io import StromEngine
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats, percentiles_from_log2_hist
+from nvme_strom_tpu.utils.trace import Tracer
+
+
+def _engine(tracer=None):
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=4,
+                       buffer_pool_bytes=8 << 20)
+    return StromEngine(cfg, stats=StromStats(), tracer=tracer)
+
+
+def test_read_spans_recorded(tmp_data_file, tmp_path):
+    path, payload = tmp_data_file
+    out = tmp_path / "trace.json"
+    tracer = Tracer(str(out))
+    with _engine(tracer) as eng:
+        fh = eng.open(path)
+        for off in range(0, len(payload), 1 << 20):
+            n = min(1 << 20, len(payload) - off)
+            with eng.submit_read(fh, off, n) as p:
+                p.wait()
+        eng.close(fh)
+    n_chunks = (len(payload) + (1 << 20) - 1) // (1 << 20)
+    assert len(tracer) == n_chunks
+    assert tracer.export() == str(out)
+    doc = json.loads(out.read_text())
+    evs = doc["traceEvents"]
+    assert len(evs) == n_chunks
+    for ev in evs:
+        assert ev["ph"] == "X"
+        assert ev["name"].startswith("strom.read")
+        assert ev["dur"] >= 0
+        assert ev["args"]["bytes"] > 0
+    # spans are ordered and timestamped on the same clock
+    assert all(e["ts"] > 0 for e in evs)
+
+
+def test_write_spans_recorded(tmp_path):
+    import numpy as np
+    tracer = Tracer(str(tmp_path / "t.json"))
+    with _engine(tracer) as eng:
+        fh = eng.open(tmp_path / "out.bin", writable=True)
+        eng.submit_write(fh, 0, np.zeros(4096, np.uint8)).wait()
+        eng.close(fh)
+    assert len(tracer) == 1
+
+
+def test_disabled_tracer_records_nothing(tmp_data_file):
+    path, payload = tmp_data_file
+    tracer = Tracer()  # no path -> disabled
+    with _engine(tracer) as eng:
+        fh = eng.open(path)
+        with eng.submit_read(fh, 0, 4096) as p:
+            p.wait()
+        eng.close(fh)
+    assert len(tracer) == 0
+    assert tracer.export() is None
+
+
+def test_span_context_manager(tmp_path):
+    tracer = Tracer(str(tmp_path / "t.json"))
+    with tracer.span("unit.work", items=3):
+        pass
+    assert len(tracer) == 1
+    tracer.export()
+    ev = json.loads((tmp_path / "t.json").read_text())["traceEvents"][0]
+    assert ev["name"] == "unit.work" and ev["args"]["items"] == 3
+
+
+def test_latency_histogram_counts_requests(tmp_data_file):
+    path, payload = tmp_data_file
+    with _engine() as eng:
+        fh = eng.open(path)
+        n_reqs = 8
+        for _ in range(n_reqs):
+            with eng.submit_read(fh, 0, 4096) as p:
+                p.wait()
+        hist = eng.latency_histogram()
+        assert sum(hist["read"]) == n_reqs
+        assert sum(hist["write"]) == 0
+        pct = eng.latency_percentiles("read")
+        assert pct[50] > 0 and pct[99] >= pct[50]
+        eng.close(fh)
+
+
+def test_latency_gauges_exported(tmp_data_file, tmp_path, monkeypatch):
+    path, _ = tmp_data_file
+    export = tmp_path / "stats.json"
+    monkeypatch.setenv("STROM_STATS_EXPORT", str(export))
+    with _engine() as eng:
+        fh = eng.open(path)
+        with eng.submit_read(fh, 0, 4096) as p:
+            p.wait()
+        eng.close(fh)
+    snap = json.loads(export.read_text())
+    assert snap["lat_read_p50_us"] > 0
+    assert snap["lat_read_p99_us"] >= snap["lat_read_p50_us"]
+
+
+@pytest.mark.parametrize("hist,expect", [
+    ([0] * 64, {50: 0, 90: 0, 99: 0}),
+    ([0, 0, 4], {50: int(4 * 1.5), 90: int(4 * 1.5), 99: int(4 * 1.5)}),
+])
+def test_percentiles_from_log2_hist(hist, expect):
+    assert percentiles_from_log2_hist(hist, ps=(50, 90, 99)) == expect
+
+
+def test_percentiles_spread():
+    hist = [0] * 64
+    hist[10] = 90   # 90 fast requests ~1µs
+    hist[20] = 10   # 10 slow ~1ms
+    pct = percentiles_from_log2_hist(hist, ps=(50, 99))
+    assert pct[50] == int(2 ** 10 * 1.5)
+    assert pct[99] == int(2 ** 20 * 1.5)
