@@ -1,0 +1,184 @@
+/* stress_test — concurrency stress harness for the strom-io engine.
+ *
+ * SURVEY.md §5 "Race detection": the reference has nothing beyond kernel
+ * lockdep; the promised TPU-build upgrade is TSAN + stress tests for the
+ * C++ engine.  This binary hammers one engine from many threads at once:
+ *
+ *   - reader threads: random-offset reads, each verified against the
+ *     deterministic content pattern (catches buffer-recycling races);
+ *   - a writer thread appending to a scratch file;
+ *   - an observer thread polling stats/pool-info/latency (lock-free
+ *     counter reads racing the hot path);
+ *   - an open/close churn thread (file-table mutation under I/O).
+ *
+ * Build plain (`make stress`) for the functional stress run, or with
+ * ThreadSanitizer (`make stress_tsan`) to turn every data race into a
+ * report.  Exit code 0 = no mismatches, no request failures; TSAN adds
+ * its own non-zero exit on findings.
+ *
+ * Usage: stress_test [iters-per-thread] [n-readers] [tmpdir]
+ */
+
+#include "strom_io.h"
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kFileBytes = 8ull << 20;
+constexpr uint64_t kMaxRead = 256 * 1024;
+
+/* Deterministic byte pattern: content is a pure function of offset, so a
+ * read of any range verifies without a reference buffer. */
+inline uint8_t pat(uint64_t off) {
+  return (uint8_t)((off * 2654435761ull) >> 7);
+}
+
+std::atomic<uint64_t> g_errors{0};
+
+void fail(const char *what) {
+  fprintf(stderr, "stress: FAIL %s\n", what);
+  g_errors.fetch_add(1);
+}
+
+/* xorshift — per-thread deterministic RNG, no libc rand() races. */
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed * 0x9E3779B97F4A7C15ull + 1) {}
+  uint64_t next() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+};
+
+void reader_thread(strom_engine *eng, int fh, int iters, int seed) {
+  Rng rng(seed);
+  for (int i = 0; i < iters; i++) {
+    uint64_t off = rng.next() % (kFileBytes - 1);
+    uint64_t len = 1 + rng.next() % kMaxRead;
+    if (off + len > kFileBytes) len = kFileBytes - off;
+    int64_t id = strom_submit_read(eng, fh, off, len);
+    if (id < 0) { fail("submit_read"); continue; }
+    strom_completion c;
+    if (strom_wait(eng, id, &c) != 0 || c.status != 0) {
+      fail("read status");
+      strom_release(eng, id);
+      continue;
+    }
+    if (c.len != len) fail("short read");
+    for (uint64_t k = 0; k < c.len; k += 997)  /* sparse verify: cheap */
+      if (c.data[k] != pat(off + k)) { fail("payload mismatch"); break; }
+    strom_release(eng, id);
+  }
+}
+
+void writer_thread(strom_engine *eng, const std::string &dir, int iters) {
+  std::string path = dir + "/stress_w.bin";
+  int fh = strom_open(eng, path.c_str(), STROM_OPEN_WRITABLE);
+  if (fh < 0) { fail("open writable"); return; }
+  std::vector<uint8_t> buf(64 * 1024);
+  Rng rng(0xAB07);
+  for (int i = 0; i < iters; i++) {
+    uint64_t off = (rng.next() % 64) * buf.size();
+    for (size_t k = 0; k < buf.size(); k++) buf[k] = pat(off + k);
+    int64_t id = strom_submit_write(eng, fh, off, buf.data(), buf.size());
+    if (id < 0) { fail("submit_write"); continue; }
+    strom_completion c;
+    if (strom_wait(eng, id, &c) != 0) fail("write wait");
+    strom_release(eng, id);
+  }
+  strom_close(eng, fh);
+}
+
+void observer_thread(strom_engine *eng, std::atomic<bool> *stop) {
+  uint64_t rd[STROM_LAT_BUCKETS], wr[STROM_LAT_BUCKETS];
+  while (!stop->load(std::memory_order_acquire)) {
+    strom_stats_blk st;
+    strom_get_stats(eng, &st);
+    if (st.requests_completed > st.requests_submitted)
+      fail("completed > submitted");
+    strom_pool_info pi;
+    strom_get_pool_info(eng, &pi);
+    if (pi.free_buffers > pi.n_buffers) fail("pool accounting");
+    strom_get_latency(eng, rd, wr);
+    usleep(500);
+  }
+}
+
+void churn_thread(strom_engine *eng, const std::string &path, int iters) {
+  for (int i = 0; i < iters; i++) {
+    int fh = strom_open(eng, path.c_str(), 0);
+    if (fh < 0) { fail("churn open"); continue; }
+    int64_t id = strom_submit_read(eng, fh, (uint64_t)i * 4096 % kFileBytes,
+                                   4096);
+    if (id >= 0) {
+      strom_wait(eng, id, nullptr);
+      strom_release(eng, id);
+    }
+    strom_close(eng, fh);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  int iters = argc > 1 ? atoi(argv[1]) : 300;
+  int n_readers = argc > 2 ? atoi(argv[2]) : 6;
+  std::string dir = argc > 3 ? argv[3] : "/tmp";
+
+  std::string path = dir + "/stress_r.bin";
+  FILE *f = fopen(path.c_str(), "wb");
+  if (!f) { perror("fopen"); return 2; }
+  std::vector<uint8_t> chunk(1 << 20);
+  for (uint64_t off = 0; off < kFileBytes; off += chunk.size()) {
+    for (size_t k = 0; k < chunk.size(); k++) chunk[k] = pat(off + k);
+    fwrite(chunk.data(), 1, chunk.size(), f);
+  }
+  fclose(f);
+
+  for (int use_uring = 1; use_uring >= 0; use_uring--) {
+    strom_engine *eng =
+        strom_engine_create(16, 8, kMaxRead + 8192, 4096, use_uring, 1);
+    if (!eng) { perror("engine_create"); return 2; }
+    int fh = strom_open(eng, path.c_str(), 0);
+    if (fh < 0) { fprintf(stderr, "open failed\n"); return 2; }
+
+    std::atomic<bool> stop{false};
+    std::vector<std::thread> ts;
+    for (int r = 0; r < n_readers; r++)
+      ts.emplace_back(reader_thread, eng, fh, iters, r + 1);
+    ts.emplace_back(writer_thread, eng, dir, iters / 2 + 1);
+    ts.emplace_back(churn_thread, eng, path, iters / 2 + 1);
+    std::thread obs(observer_thread, eng, &stop);
+    for (auto &t : ts) t.join();
+    stop.store(true, std::memory_order_release);
+    obs.join();
+
+    strom_stats_blk st;
+    strom_get_stats(eng, &st);
+    fprintf(stderr,
+            "stress[%s]: submitted=%llu completed=%llu failed=%llu "
+            "errors=%llu\n",
+            use_uring ? "io_uring" : "threadpool",
+            (unsigned long long)st.requests_submitted,
+            (unsigned long long)st.requests_completed,
+            (unsigned long long)st.requests_failed,
+            (unsigned long long)g_errors.load());
+    if (st.requests_failed != 0) fail("requests_failed != 0");
+    strom_close(eng, fh);
+    strom_engine_destroy(eng);
+  }
+  unlink(path.c_str());
+  unlink((dir + "/stress_w.bin").c_str());
+  return g_errors.load() == 0 ? 0 : 1;
+}

@@ -391,11 +391,20 @@ struct strom_engine {
   void complete_locked(Req *r) {
     r->state = ReqState::kDone;
     r->t_complete = now_ns();
-    uint64_t lat = r->t_complete - r->t_submit;
-    int b = 63 - __builtin_clzll(lat | 1);
-    (r->is_write ? lat_write : lat_read)[b].fetch_add(
-        1, std::memory_order_relaxed);
-    st_comp.fetch_add(1, std::memory_order_relaxed);
+    if (r->status == 0) {
+      /* Failures are counted in st_fail; bucketing their near-instant
+       * "latency" would drag the p50/p99 gauges toward zero exactly when
+       * the system is misbehaving. */
+      uint64_t lat = r->t_complete - r->t_submit;
+      int b = 63 - __builtin_clzll(lat | 1);
+      (r->is_write ? lat_write : lat_read)[b].fetch_add(
+          1, std::memory_order_relaxed);
+    }
+    /* release: pairs with the acquire load in strom_get_stats so an
+     * observer that sees this completion also sees the corresponding
+     * st_sub increment (which happens-before it via the request's
+     * submit->complete chain). */
+    st_comp.fetch_add(1, std::memory_order_release);
     cv_done.notify_all();
   }
 
@@ -991,8 +1000,12 @@ void strom_get_stats(strom_engine *e, strom_stats_blk *out) {
   out->bytes_fallback = e->st_fallback.load(std::memory_order_relaxed);
   out->bounce_bytes = e->st_bounce.load(std::memory_order_relaxed);
   out->bytes_written_direct = e->st_written.load(std::memory_order_relaxed);
+  /* completed is read BEFORE submitted, acquire paired with the release
+   * increment in complete_locked: any completion the observer sees
+   * implies visibility of its submission, so completed <= submitted
+   * always holds in the snapshot. */
+  out->requests_completed = e->st_comp.load(std::memory_order_acquire);
   out->requests_submitted = e->st_sub.load(std::memory_order_relaxed);
-  out->requests_completed = e->st_comp.load(std::memory_order_relaxed);
   out->requests_failed = e->st_fail.load(std::memory_order_relaxed);
   out->retries = e->st_retry.load(std::memory_order_relaxed);
 }
