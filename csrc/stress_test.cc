@@ -12,7 +12,7 @@
  *   - an open/close churn thread (file-table mutation under I/O).
  *
  * Build plain (`make stress`) for the functional stress run, or with
- * ThreadSanitizer (`make stress_tsan`) to turn every data race into a
+ * ThreadSanitizer (`make tsan`) to turn every data race into a
  * report.  Exit code 0 = no mismatches, no request failures; TSAN adds
  * its own non-zero exit on findings.
  *

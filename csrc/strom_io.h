@@ -61,7 +61,8 @@ typedef struct strom_completion {
 } strom_completion;
 
 /* Per-request latency histograms (submit->complete), log2-ns buckets:
- * bucket i counts requests with latency in [2^i, 2^(i+1)) ns.  The
+ * bucket i counts SUCCESSFUL requests with latency in [2^i, 2^(i+1)) ns
+ * (failed requests are excluded; see requests_failed).  The
  * reference exposes only aggregate byte/request counters via STAT_INFO
  * (SURVEY.md §5 Tracing: "minimal") — this is the promised upgrade. */
 #define STROM_LAT_BUCKETS 64

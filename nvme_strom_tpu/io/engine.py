@@ -419,9 +419,11 @@ class StromEngine:
 
     def latency_histogram(self) -> dict:
         """Per-request submit→complete latency, log2-ns buckets: entry i of
-        each list counts requests whose latency fell in [2^i, 2^(i+1)) ns.
-        The per-request upgrade over the reference's aggregate-only
-        STAT_INFO counters (SURVEY.md §5 Tracing)."""
+        each list counts SUCCESSFUL requests whose latency fell in
+        [2^i, 2^(i+1)) ns (failures are excluded — they complete near-
+        instantly and would drag the percentiles down; count them via
+        requests_failed).  The per-request upgrade over the reference's
+        aggregate-only STAT_INFO counters (SURVEY.md §5 Tracing)."""
         rd = (ctypes.c_uint64 * _LAT_BUCKETS)()
         wr = (ctypes.c_uint64 * _LAT_BUCKETS)()
         self._lib.strom_get_latency(self._h, rd, wr)
