@@ -1,0 +1,127 @@
+"""Mixture-of-experts MLP with expert parallelism over an ``ep`` mesh axis.
+
+The reference has no model-parallel concepts (SURVEY.md §2 "Parallelism
+strategies: NOT PRESENT") — expert parallelism is here because it is a
+first-class requirement of the TPU framework build, exercised by the
+flagship transformer and the driver's multi-chip dry run.
+
+TPU-first design: GShard/Switch-style *dense dispatch*.  Routing is
+expressed as one-hot dispatch/combine tensors contracted with einsum, so
+every shape is static, everything lands on the MXU, and under ``jit`` with
+expert weights sharded ``P("ep", ...)`` the SPMD partitioner inserts the
+all-to-alls over ICI itself — no hand-written NCCL-style exchange (the
+reference has none either; its transport is PCIe P2P DMA, SURVEY.md §5).
+
+Per-token cost is O(k/E) of a dense MLP of the same total width, at the
+price of a fixed per-expert capacity: tokens routed beyond an expert's
+capacity are dropped (contribute zero for that slot), the standard
+static-shape trade XLA needs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_dispatch_combine(router_probs: jax.Array, top_k: int, capacity: int):
+    """Build dense dispatch/combine tensors from router probabilities.
+
+    router_probs: (T, E) float32 softmax output.
+    Returns (dispatch, combine, aux_loss):
+      dispatch (T, E, C) ∈ {0,1} — token t occupies slot c of expert e;
+      combine  (T, E, C) float32 — dispatch scaled by the (renormalised)
+      top-k gate weight, so ``einsum('tec,ecd->td', combine, expert_out)``
+      is the weighted sum over a token's experts;
+      aux_loss — Switch-style load-balancing loss (scalar, f32).
+
+    Slot priority is k-major (every token's first choice is placed before
+    any second choice), position within an expert is token-major cumsum —
+    the GShard ordering.
+    """
+    T, E = router_probs.shape
+    gate_vals, gate_idx = jax.lax.top_k(router_probs, top_k)     # (T, k)
+    gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+
+    mask = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)        # (T, k, E)
+    # Load-balancing aux: fraction of tokens whose top-1 lands on e, times
+    # mean router prob of e, summed — minimised by a uniform router.
+    f = mask[:, 0, :].mean(axis=0)                               # (E,)
+    p = router_probs.mean(axis=0)                                # (E,)
+    aux_loss = E * jnp.sum(f * p)
+
+    mask_kt = mask.transpose(1, 0, 2).reshape(top_k * T, E)      # (kT, E)
+    pos = jnp.cumsum(mask_kt, axis=0) - mask_kt                  # 0-based
+    keep = mask_kt * (pos < capacity)                            # (kT, E)
+    pos_oh = (jax.nn.one_hot(pos.astype(jnp.int32), capacity)
+              * keep[..., None])                                 # (kT, E, C)
+    pos_oh = pos_oh.reshape(top_k, T, E, capacity).transpose(1, 0, 2, 3)
+
+    dispatch = pos_oh.sum(axis=1)                                # (T, E, C)
+    combine = (pos_oh * gate_vals[:, :, None, None]).sum(axis=1)  # (T, E, C)
+    return dispatch, combine, aux_loss
+
+
+def expert_capacity(n_tokens: int, n_experts: int, top_k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert slot count: ceil(k·T/E · factor), ≥ 1."""
+    import math
+    return max(1, math.ceil(n_tokens * top_k / n_experts * capacity_factor))
+
+
+def moe_mlp(x: jax.Array, p: dict, prefix: str, cfg) -> tuple:
+    """MoE SwiGLU MLP block.  x (b, s, d) → (out (b, s, d), aux_loss).
+
+    Params (flat dict, same namespace as the safetensors lazy loader):
+      {prefix}router     (d, E)
+      {prefix}moe_w_gate (E, d, ff)
+      {prefix}moe_w_up   (E, d, ff)
+      {prefix}moe_w_down (E, ff, d)
+    """
+    b, s, d = x.shape
+    T = b * s
+    E, k = cfg.n_experts, cfg.expert_top_k
+    C = expert_capacity(T, E, k, cfg.capacity_factor)
+    xt = x.reshape(T, d)
+
+    logits = (xt.astype(jnp.float32)
+              @ p[prefix + "router"].astype(jnp.float32))        # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    dispatch, combine, aux = moe_dispatch_combine(probs, k, C)
+
+    xd = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)  # (E, C, d)
+    gate = jax.nn.silu(jnp.einsum(
+        "ecd,edf->ecf", xd, p[prefix + "moe_w_gate"].astype(x.dtype)))
+    up = jnp.einsum("ecd,edf->ecf", xd,
+                    p[prefix + "moe_w_up"].astype(x.dtype))
+    h = jnp.einsum("ecf,efd->ecd", gate * up,
+                   p[prefix + "moe_w_down"].astype(x.dtype))      # (E, C, d)
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), h)
+    return out.reshape(b, s, d), aux
+
+
+def init_moe_params(keys, cfg, prefix: str, dense) -> dict:
+    """MoE weights for one layer.  ``keys`` is an iterator of PRNG keys;
+    ``dense`` is the caller's initializer (transformer.dense_init — passed
+    in rather than imported to keep moe.py import-cycle-free)."""
+    E, dm, ff = cfg.n_experts, cfg.d_model, cfg.d_ff
+    return {
+        prefix + "router": dense(next(keys), dm, (dm, E)),
+        prefix + "moe_w_gate": dense(next(keys), dm, (E, dm, ff)),
+        prefix + "moe_w_up": dense(next(keys), dm, (E, dm, ff)),
+        prefix + "moe_w_down": dense(next(keys), ff, (E, ff, dm)),
+    }
+
+
+def moe_param_specs(cfg, layer_prefix: str) -> dict:
+    """PartitionSpecs for one MoE layer: experts over ``ep``, each expert's
+    FFN Megatron-split over ``tp`` (column-parallel gate/up, row-parallel
+    down — the psum over tp is inserted by the partitioner)."""
+    from jax.sharding import PartitionSpec as P
+    L = layer_prefix
+    return {
+        L + "router": P(),
+        L + "moe_w_gate": P("ep", None, "tp"),
+        L + "moe_w_up": P("ep", None, "tp"),
+        L + "moe_w_down": P("ep", "tp", None),
+    }

@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from nvme_strom_tpu.models import moe as _moe
+
 
 @dataclass(frozen=True)
 class TransformerConfig:
@@ -32,10 +34,20 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: object = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
+    # Mixture-of-experts (models/moe.py): 0 experts == dense model.
+    n_experts: int = 0
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
+    moe_every: int = 2            # layer i is MoE iff i % moe_every == rem
 
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    def is_moe_layer(self, i: int) -> bool:
+        return (self.n_experts > 0
+                and i % self.moe_every == self.moe_every - 1)
 
 
 def flagship_config() -> TransformerConfig:
@@ -47,17 +59,27 @@ def tiny_config() -> TransformerConfig:
                              n_kv_heads=2, d_ff=128, max_seq=64)
 
 
+def tiny_moe_config() -> TransformerConfig:
+    return TransformerConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                             n_kv_heads=2, d_ff=128, max_seq=64,
+                             n_experts=4, expert_top_k=2)
+
+
 # ----------------------------- params -----------------------------
+
+def dense_init(key, fan_in, shape):
+    """Scaled-normal init (normal/√fan_in, f32) — the single init scheme
+    for every weight, dense and MoE alike."""
+    return (jax.random.normal(key, shape, jnp.float32)
+            / np.sqrt(fan_in)).astype(jnp.float32)
+
 
 def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict:
     """Parameters as a flat {name: array} dict — the same namespace the
     safetensors lazy loader uses, so checkpoints round-trip by name."""
-    keys = iter(jax.random.split(rng, 4 + 9 * cfg.n_layers))
+    keys = iter(jax.random.split(rng, 4 + 13 * cfg.n_layers))
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-
-    def dense(key, fan_in, shape):
-        return (jax.random.normal(key, shape, jnp.float32)
-                / np.sqrt(fan_in)).astype(jnp.float32)
+    dense = dense_init
 
     p = {
         "tok_embed": dense(next(keys), 1.0, (cfg.vocab, cfg.d_model)),
@@ -72,11 +94,15 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict:
         p[L + "wv"] = dense(next(keys), cfg.d_model, (cfg.d_model, nkv * hd))
         p[L + "wo"] = dense(next(keys), nh * hd, (nh * hd, cfg.d_model))
         p[L + "mlp_norm"] = jnp.ones((cfg.d_model,), jnp.float32)
-        p[L + "w_gate"] = dense(next(keys), cfg.d_model,
-                                (cfg.d_model, cfg.d_ff))
-        p[L + "w_up"] = dense(next(keys), cfg.d_model,
-                              (cfg.d_model, cfg.d_ff))
-        p[L + "w_down"] = dense(next(keys), cfg.d_ff, (cfg.d_ff, cfg.d_model))
+        if cfg.is_moe_layer(i):
+            p.update(_moe.init_moe_params(keys, cfg, L, dense))
+        else:
+            p[L + "w_gate"] = dense(next(keys), cfg.d_model,
+                                    (cfg.d_model, cfg.d_ff))
+            p[L + "w_up"] = dense(next(keys), cfg.d_model,
+                                  (cfg.d_model, cfg.d_ff))
+            p[L + "w_down"] = dense(next(keys), cfg.d_ff,
+                                    (cfg.d_ff, cfg.d_model))
     return p
 
 
@@ -145,18 +171,34 @@ def mlp(x, p, prefix):
     return (gate * up) @ p[prefix + "w_down"].astype(x.dtype)
 
 
-def forward(params: Dict, tokens: jax.Array,
-            cfg: TransformerConfig, attn_fn=None) -> jax.Array:
-    """tokens (b, s) int32 → logits (b, s, vocab) float32."""
+def forward_with_aux(params: Dict, tokens: jax.Array,
+                     cfg: TransformerConfig, attn_fn=None
+                     ) -> tuple[jax.Array, jax.Array]:
+    """tokens (b, s) int32 → (logits (b, s, vocab) f32, aux_loss scalar).
+
+    aux_loss is the summed MoE load-balancing loss (0 for dense models)."""
     x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    aux = jnp.zeros((), jnp.float32)
     for i in range(cfg.n_layers):
         L = f"layers.{i}."
         x = x + attention(rms_norm(x, params[L + "attn_norm"], cfg.norm_eps),
                           params, L, cfg, attn_fn)
-        x = x + mlp(rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps),
-                    params, L)
+        h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
+        if cfg.is_moe_layer(i):
+            h, a = _moe.moe_mlp(h, params, L, cfg)
+            aux = aux + a
+        else:
+            h = mlp(h, params, L)
+        x = x + h
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, aux
+
+
+def forward(params: Dict, tokens: jax.Array,
+            cfg: TransformerConfig, attn_fn=None) -> jax.Array:
+    """tokens (b, s) int32 → logits (b, s, vocab) float32."""
+    return forward_with_aux(params, tokens, cfg, attn_fn)[0]
 
 
 def loss_fn(params, tokens, cfg, attn_fn=None) -> jax.Array:
@@ -165,11 +207,12 @@ def loss_fn(params, tokens, cfg, attn_fn=None) -> jax.Array:
     The full sequence is forwarded and the last logit dropped — identical
     to forwarding tokens[:, :-1] for a causal model, but keeps the seq dim
     a multiple of the ``sp`` shard count for ring attention."""
-    logits = forward(params, tokens, cfg, attn_fn)[:, :-1]
+    logits, aux = forward_with_aux(params, tokens, cfg, attn_fn)
+    logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return -jnp.mean(ll)
+    return -jnp.mean(ll) + cfg.router_aux_coef * aux
 
 
 # ----------------------------- training -----------------------------

@@ -13,6 +13,7 @@ from typing import Dict
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from nvme_strom_tpu.models.moe import moe_param_specs
 from nvme_strom_tpu.models.transformer import TransformerConfig
 
 
@@ -30,14 +31,45 @@ def param_specs(cfg: TransformerConfig) -> Dict[str, P]:
         specs[L + "wv"] = P(None, "tp")
         specs[L + "wo"] = P("tp", None)   # row-parallel: psum after
         specs[L + "mlp_norm"] = P()
-        specs[L + "w_gate"] = P(None, "tp")
-        specs[L + "w_up"] = P(None, "tp")
-        specs[L + "w_down"] = P("tp", None)
+        if cfg.is_moe_layer(i):
+            specs.update(moe_param_specs(cfg, L))
+        else:
+            specs[L + "w_gate"] = P(None, "tp")
+            specs[L + "w_up"] = P(None, "tp")
+            specs[L + "w_down"] = P("tp", None)
     return specs
 
 
+#: The framework's canonical mesh axes.  A spec axis absent from the mesh
+#: means "this parallelism feature is off → replicate" (the pjit idiom);
+#: any OTHER name in a spec is a bug and must fail fast.
+CANONICAL_AXES = frozenset({"dp", "tp", "sp", "pp", "ep"})
+
+
+def prune_spec(spec: P, mesh) -> P:
+    """Drop canonical axis names the mesh doesn't have, so one set of specs
+    serves every mesh shape (dp×tp, dp×tp×sp, dp×ep, …).  Non-canonical
+    names raise — a mesh with axes ('data', 'model') must not silently
+    replicate everything."""
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if keep(a) is not None)
+            return kept if kept else None
+        if entry in mesh.shape:
+            return entry
+        if entry not in CANONICAL_AXES:
+            raise ValueError(
+                f"spec axis {entry!r} is neither in the mesh "
+                f"{dict(mesh.shape)} nor a canonical axis "
+                f"{sorted(CANONICAL_AXES)}")
+        return None
+    return P(*(keep(e) for e in spec))
+
+
 def param_shardings(cfg: TransformerConfig, mesh) -> Dict[str, NamedSharding]:
-    return {k: NamedSharding(mesh, spec)
+    return {k: NamedSharding(mesh, prune_spec(spec, mesh))
             for k, spec in param_specs(cfg).items()}
 
 
