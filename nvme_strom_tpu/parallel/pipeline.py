@@ -1,0 +1,265 @@
+"""Pipeline parallelism: GPipe-style microbatching over a ``pp`` mesh axis.
+
+The reference has no parallelism concepts (SURVEY.md §2 "Parallelism
+strategies: NOT PRESENT") — pipeline parallelism is here because it is a
+first-class requirement of the TPU framework build, composing with the
+dp/tp/sp/ep axes the other ``parallel/`` modules provide.
+
+TPU-first design (the scaling-book "collective pipeline"): the layer stack
+is split into ``pp`` contiguous stages, each device holds its stage's
+weights as a stacked ``(layers_per_stage, ...)`` slice, and activations
+flow stage→stage with ``lax.ppermute`` — a neighbor exchange XLA maps onto
+the ICI torus.  Microbatches keep every stage busy outside the unavoidable
+GPipe warmup/drain bubble of (pp−1) ticks; inside a tick every stage runs
+the same jitted block, so the whole schedule is ONE ``lax.scan`` — static
+shapes, no Python control flow, one compilation.
+
+Tensor parallelism inside the manual region is explicit-collective
+Megatron: wq/wk/wv/w_gate/w_up are column-sharded over ``tp``, wo/w_down
+row-sharded, with a ``lax.psum`` over ``tp`` after each row-parallel
+matmul (the collectives the annotation-based path in
+``parallel/shardings.py`` gets from the SPMD partitioner, written by hand
+because shard_map regions are manual).  Everything works at any axis size,
+including 1, so one step function serves every mesh shape.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nvme_strom_tpu.models.transformer import (
+    TransformerConfig, _rope, dense_causal_attention, rms_norm)
+
+_STACKED = ("attn_norm", "wq", "wk", "wv", "wo",
+            "mlp_norm", "w_gate", "w_up", "w_down")
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    """shard_map without VMA/replication checking (the schedule's masked
+    psum broadcasts are replicated by construction, not by type)."""
+    try:
+        from jax import shard_map as sm  # jax >= 0.8
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+    try:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def split_layer_stack(params: Dict, cfg: TransformerConfig
+                      ) -> tuple[Dict, Dict]:
+    """Flat {name: array} params → (stack, rest).
+
+    ``stack[name]`` has shape (n_layers, *per_layer_shape) — the leading
+    axis is what ``P("pp", ...)`` shards into stages.  ``rest`` holds the
+    unstacked embed/head/final-norm weights applied outside the pipeline.
+    Requires a homogeneous (dense, non-MoE) layer stack.
+    """
+    if any(cfg.is_moe_layer(i) for i in range(cfg.n_layers)):
+        raise ValueError("pipeline requires a homogeneous dense layer "
+                         "stack; MoE layers are not stackable")
+    stack = {n: jnp.stack([params[f"layers.{i}.{n}"]
+                           for i in range(cfg.n_layers)])
+             for n in _STACKED}
+    rest = {k: v for k, v in params.items() if not k.startswith("layers.")}
+    return stack, rest
+
+
+def merge_layer_stack(stack: Dict, rest: Dict) -> Dict:
+    """Inverse of split_layer_stack (checkpoint round-trips by name)."""
+    out = dict(rest)
+    n_layers = next(iter(stack.values())).shape[0]
+    for i in range(n_layers):
+        for n in _STACKED:
+            out[f"layers.{i}.{n}"] = stack[n][i]
+    return out
+
+
+def stacked_specs() -> Dict[str, P]:
+    col = P("pp", None, "tp")   # (L, d, out·/tp) column-parallel
+    row = P("pp", "tp", None)   # (L, in·/tp, d) row-parallel → psum
+    norm = P("pp", None)
+    return {"attn_norm": norm, "wq": col, "wk": col, "wv": col, "wo": row,
+            "mlp_norm": norm, "w_gate": col, "w_up": col, "w_down": row}
+
+
+def stacked_shardings(mesh) -> Dict[str, NamedSharding]:
+    from nvme_strom_tpu.parallel.shardings import prune_spec
+    return {k: NamedSharding(mesh, prune_spec(s, mesh))
+            for k, s in stacked_specs().items()}
+
+
+# ------------------- per-device stage computation -------------------
+
+def _block(x, lp, cfg: TransformerConfig, tp_axis, tp_size: int):
+    """One decoder layer with explicit-psum tensor parallelism.
+    x (b, s, d); lp = per-layer weight dict with tp-local shards.
+    ``tp_axis`` is None when the mesh has no tp axis (no psum needed)."""
+    h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
+    b, s, _ = h.shape
+    hd = cfg.head_dim
+    nh_l = cfg.n_heads // tp_size
+    nkv_l = cfg.n_kv_heads // tp_size
+    q = (h @ lp["wq"].astype(h.dtype)).reshape(b, s, nh_l, hd)
+    k = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, nkv_l, hd)
+    v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, nkv_l, hd)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    q, k = _rope(q, k, cfg.rope_theta)
+    if nkv_l != nh_l:
+        k = jnp.repeat(k, nh_l // nkv_l, axis=1)
+        v = jnp.repeat(v, nh_l // nkv_l, axis=1)
+    a = dense_causal_attention(q, k, v)
+    a = a.transpose(0, 2, 1, 3).reshape(b, s, nh_l * hd)
+    a = a @ lp["wo"].astype(h.dtype)
+    if tp_axis is not None:               # row-parallel reduce over tp
+        a = lax.psum(a, tp_axis)
+    x = x + a
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(h.dtype))
+    up = h @ lp["w_up"].astype(h.dtype)
+    m = (gate * up) @ lp["w_down"].astype(h.dtype)
+    if tp_axis is not None:
+        m = lax.psum(m, tp_axis)
+    # f32 norm weights promote the residual; pin the carry dtype so the
+    # layer scan's carry type is invariant.
+    return (x + m).astype(cfg.dtype)
+
+
+def _pipeline_local(stack, x_mb, *, cfg, pp_axis, tp_axis, n_pp, tp_size,
+                    n_mb):
+    """Per-device pipeline schedule (inside shard_map).
+
+    stack: stage-local weights (L/pp leading axis); x_mb: (n_mb, mb_local,
+    s, d) microbatched activations (every pp rank sees all of them; only
+    stage 0 consumes).  Returns (n_mb, mb_local, s, d) final-stage outputs,
+    value-replicated across pp/tp via a masked psum broadcast.
+    """
+    stage = lax.axis_index(pp_axis) if pp_axis is not None else 0
+
+    def stage_apply(x):
+        def body(c, lp):
+            return _block(c, lp, cfg, tp_axis, tp_size), None
+        x, _ = lax.scan(body, x, stack)
+        return x
+
+    perm = [(i, i + 1) for i in range(n_pp - 1)]
+
+    def tick(carry, t):
+        state, out = carry
+        inp = lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, n_mb - 1), 0, keepdims=False)
+        x = jnp.where(stage == 0, inp, state)
+        y = stage_apply(x)
+        # Last stage writes microbatch t-(pp-1) once the pipe is full.
+        oidx = jnp.clip(t - (n_pp - 1), 0, n_mb - 1)
+        write = jnp.logical_and(stage == n_pp - 1, t >= n_pp - 1)
+        cur = lax.dynamic_index_in_dim(out, oidx, 0, keepdims=False)
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(write, y, cur), oidx, 0)
+        state = lax.ppermute(y, pp_axis, perm) if n_pp > 1 else y
+        return (state, out), None
+
+    carry0 = (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb))
+    (state, out), _ = lax.scan(tick, carry0, jnp.arange(n_mb + n_pp - 1))
+    if pp_axis is not None and n_pp > 1:
+        # broadcast the last stage's outputs to every pp rank
+        out = lax.psum(
+            jnp.where(stage == n_pp - 1, out, jnp.zeros_like(out)), pp_axis)
+    return out
+
+
+# ------------------------- public entry points -------------------------
+
+def _axis_size(mesh, name: str) -> int:
+    return mesh.shape.get(name, 1)
+
+
+def make_pp_forward(cfg: TransformerConfig, mesh, n_microbatches: int,
+                    pp_axis: str = "pp", tp_axis: str = "tp",
+                    dp_axis: str = "dp"):
+    """Returns fwd(stack, rest, tokens) -> logits (B, s, vocab) f32.
+
+    Embedding, final norm and the LM head run outside the shard_map under
+    ordinary sharding annotations; the layer stack runs inside the
+    pipelined manual region.
+    """
+    n_pp = _axis_size(mesh, pp_axis)
+    tp_size = _axis_size(mesh, tp_axis)
+    if cfg.n_layers % n_pp:
+        raise ValueError(f"{cfg.n_layers} layers not divisible into "
+                         f"{n_pp} pipeline stages")
+    if cfg.n_heads % tp_size or cfg.n_kv_heads % tp_size:
+        raise ValueError(f"heads ({cfg.n_heads}/{cfg.n_kv_heads}) not "
+                         f"divisible by tp={tp_size}")
+
+    from nvme_strom_tpu.parallel.shardings import prune_spec
+    specs = {k: prune_spec(s, mesh) for k, s in stacked_specs().items()}
+    x_spec = prune_spec(P(None, dp_axis, None, None), mesh)
+    run = _shard_map(
+        partial(_pipeline_local, cfg=cfg,
+                pp_axis=pp_axis if pp_axis in mesh.shape else None,
+                tp_axis=tp_axis if tp_axis in mesh.shape else None,
+                n_pp=n_pp, tp_size=tp_size, n_mb=n_microbatches),
+        mesh, in_specs=(specs, x_spec), out_specs=x_spec)
+
+    def fwd(stack: Dict, rest: Dict, tokens: jax.Array) -> jax.Array:
+        B, s = tokens.shape
+        if B % n_microbatches:
+            raise ValueError(f"batch {B} not divisible into "
+                             f"{n_microbatches} microbatches")
+        dp_size = _axis_size(mesh, dp_axis)
+        if (B // n_microbatches) % dp_size:
+            raise ValueError(
+                f"microbatch size {B // n_microbatches} not divisible by "
+                f"dp={dp_size}")
+        x = rest["tok_embed"].astype(cfg.dtype)[tokens]
+        x = x.reshape(n_microbatches, B // n_microbatches, s, cfg.d_model)
+        x = run(stack, x)
+        x = x.reshape(B, s, cfg.d_model)
+        x = rms_norm(x, rest["final_norm"], cfg.norm_eps)
+        return (x @ rest["lm_head"].astype(x.dtype)).astype(jnp.float32)
+
+    return fwd
+
+
+def make_pp_loss(cfg, mesh, n_microbatches, **axes):
+    fwd = make_pp_forward(cfg, mesh, n_microbatches, **axes)
+
+    def loss_fn(stack, rest, tokens):
+        logits = fwd(stack, rest, tokens)[:, :-1]
+        targets = tokens[:, 1:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return -jnp.mean(ll)
+
+    return loss_fn
+
+
+def make_pp_train_step(cfg: TransformerConfig, optimizer, mesh,
+                       n_microbatches: int, **axes):
+    """step(stack, rest, opt_state, tokens) -> (stack, rest, opt_state,
+    loss) — the pipelined analogue of transformer.make_train_step; jit it
+    at the call site."""
+    import optax
+
+    loss_fn = make_pp_loss(cfg, mesh, n_microbatches, **axes)
+
+    def step(stack, rest, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+            stack, rest, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state,
+                                              (stack, rest))
+        stack, rest = optax.apply_updates((stack, rest), updates)
+        return stack, rest, opt_state, loss
+
+    return step
