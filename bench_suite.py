@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Per-config benchmark suite: one JSON line per BASELINE.json config.
+
+`bench.py` is the driver-facing headline (sustained NVMe→HBM streaming);
+this suite covers the full config list so every capability row has a
+number:
+
+  1 raw     — raw sequential engine read, payload discarded (ssd2gpu_test
+              analogue, SURVEY.md §3.4)
+  2 arrow   — Arrow column file → single-chip device columns
+  3 loader  — WebDataset shards → sharded dataloader → device batches
+  4 weights — safetensors shards → lazy sharded HBM param load
+  5 sql     — Parquet row-group scan → on-device GROUP BY aggregate
+
+Usage: python bench_suite.py [--config N ... | --all] [--json-only]
+
+Each line: {"metric", "value" (GiB/s payload→device), "unit",
+"vs_baseline" (value / 0.9·min(raw SSD, host→device link) — the
+BASELINE.json north star; ≥1.0 means target met)}.
+
+Env: STROM_SUITE_BYTES (per-config payload, default 256 MiB),
+STROM_BENCH_DIR (scratch dir, default repo root).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import bench  # noqa: E402  (shared helpers: probe_device, make_file, ...)
+
+_log = bench._log
+
+
+def _scratch_dir() -> str:
+    d = os.environ.get("STROM_BENCH_DIR",
+                       os.path.dirname(os.path.abspath(__file__)))
+    sub = os.path.join(d, ".bench_suite")
+    os.makedirs(sub, exist_ok=True)
+    return sub
+
+
+def _suite_bytes() -> int:
+    return int(os.environ.get("STROM_SUITE_BYTES", 256 << 20))
+
+
+def _fresh(tag: str, nbytes: int) -> bool:
+    """Size-aware scratch cache: True if data tagged `tag` must be
+    (re)generated for this nbytes (a .meta sentinel records the size a
+    previous run generated, so changing STROM_SUITE_BYTES regenerates
+    instead of silently benchmarking stale data)."""
+    meta = os.path.join(_scratch_dir(), f".{tag}.meta")
+    try:
+        if int(open(meta).read()) == nbytes:
+            return False
+    except (OSError, ValueError):
+        pass
+    with open(meta, "w") as f:
+        f.write(str(nbytes))
+    return True
+
+
+# --------------------------- data generators ---------------------------
+
+def make_arrow_file(path: str, nbytes: int) -> int:
+    """Multi-batch Arrow IPC file of float32/int32 columns; returns size."""
+    import numpy as np
+    import pyarrow as pa
+    if not _fresh("arrow", nbytes) and os.path.exists(path):
+        return os.path.getsize(path)
+    rows_total = max(1024, nbytes // 12)     # 3 cols × 4 bytes
+    per_batch = max(1024, rows_total // 16)
+    rng = np.random.default_rng(0)
+    schema = pa.schema([("a", pa.float32()), ("b", pa.float32()),
+                        ("k", pa.int32())])
+    with pa.OSFile(path, "wb") as f, pa.ipc.new_file(f, schema) as w:
+        left = rows_total
+        while left > 0:
+            n = min(per_batch, left)
+            w.write_batch(pa.record_batch(
+                [pa.array(rng.standard_normal(n, dtype=np.float32)),
+                 pa.array(rng.standard_normal(n, dtype=np.float32)),
+                 pa.array(rng.integers(0, 64, n, dtype=np.int32))],
+                schema=schema))
+            left -= n
+    return os.path.getsize(path)
+
+
+def make_wds_shards(dirpath: str, nbytes: int, n_shards: int = 4,
+                    item_bytes: int = 1 << 20) -> list:
+    """Tar shards of fixed-size .bin samples; returns shard paths."""
+    import io as _io
+    import tarfile
+    import numpy as np
+    os.makedirs(dirpath, exist_ok=True)
+    per_shard = max(2, nbytes // n_shards // item_bytes)
+    rng = np.random.default_rng(0)
+    regen = _fresh("wds", nbytes)
+    paths = []
+    for s in range(n_shards):
+        p = os.path.join(dirpath, f"shard-{s:04d}.tar")
+        paths.append(p)
+        if os.path.exists(p) and not regen:
+            continue
+        with tarfile.open(p, "w") as tf:
+            for i in range(per_shard):
+                payload = rng.integers(0, 256, item_bytes,
+                                       dtype=np.uint8).tobytes()
+                ti = tarfile.TarInfo(f"{s:04d}{i:05d}.bin")
+                ti.size = item_bytes
+                tf.addfile(ti, _io.BytesIO(payload))
+    return paths
+
+
+def make_safetensors_shards(dirpath: str, nbytes: int,
+                            n_shards: int = 2) -> list:
+    import numpy as np
+    from nvme_strom_tpu.formats import write_safetensors
+    os.makedirs(dirpath, exist_ok=True)
+    per_shard = nbytes // n_shards
+    n_tensors = 4
+    rows = max(64, per_shard // n_tensors // (1024 * 4))
+    rng = np.random.default_rng(0)
+    regen = _fresh("st", nbytes)
+    paths = []
+    for s in range(n_shards):
+        p = os.path.join(dirpath,
+                         f"model-{s + 1:05d}-of-{n_shards:05d}.safetensors")
+        paths.append(p)
+        if os.path.exists(p) and not regen:
+            continue
+        write_safetensors(p, {
+            f"w{s}_{i}": rng.standard_normal(
+                (rows, 1024), dtype=np.float32)
+            for i in range(n_tensors)})
+    return paths
+
+
+def make_parquet_file(path: str, nbytes: int, num_groups: int = 64) -> int:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    if not _fresh("parquet", nbytes) and os.path.exists(path):
+        return os.path.getsize(path)
+    rows = max(4096, nbytes // 8)            # int32 key + float32 value
+    rng = np.random.default_rng(0)
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, num_groups, rows, dtype=np.int32)),
+        "v": pa.array(rng.standard_normal(rows, dtype=np.float32))})
+    pq.write_table(tbl, path, row_group_size=max(4096, rows // 16),
+                   compression="none")
+    return os.path.getsize(path)
+
+
+# ------------------------------ benches --------------------------------
+
+def bench_arrow(engine, nbytes: int, device=None) -> tuple[float, int]:
+    path = os.path.join(_scratch_dir(), "cols.arrow")
+    size = make_arrow_file(path, nbytes)
+    from nvme_strom_tpu.formats.arrow import ArrowFileReader
+    reader = ArrowFileReader(path)
+    best, payload = 0.0, 0
+    for _ in range(2):         # run 1 warms jit/IPC caches
+        t0 = time.monotonic()
+        cols = reader.read_columns_to_device(engine, device=device)
+        for v in cols.values():
+            v.block_until_ready()
+        dt = time.monotonic() - t0
+        payload = sum(int(v.nbytes) for v in cols.values())
+        del cols
+        best = max(best, payload / (1 << 30) / dt)
+    return best, size
+
+
+def bench_loader(engine, nbytes: int, batch: int = 8) -> tuple[float, int]:
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.data.loader import ShardedLoader
+    paths = make_wds_shards(os.path.join(_scratch_dir(), "wds"), nbytes)
+    mesh = Mesh(np.array(jax.local_devices()[:1]).reshape(1), ("dp",))
+    best, n = 0.0, 0
+    with ShardedLoader(paths, mesh, global_batch=batch, fmt="wds",
+                       engine=engine) as loader:
+        for _ in range(2):     # epoch 1 warms jit/placement caches
+            n = 0
+            t0 = time.monotonic()
+            for arr in loader:
+                arr.block_until_ready()
+                n += int(arr.nbytes)
+            dt = time.monotonic() - t0
+            best = max(best, n / (1 << 30) / dt)
+    return best, n
+
+
+def bench_weights(engine, nbytes: int, device=None) -> tuple[float, int]:
+    import jax
+    from jax.sharding import SingleDeviceSharding
+    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+    paths = make_safetensors_shards(
+        os.path.join(_scratch_dir(), "st"), nbytes)
+    ckpt = LazyCheckpoint(paths)
+    dev = device or jax.local_devices()[0]
+    sh = SingleDeviceSharding(dev)
+    best, payload = 0.0, 0
+    for _ in range(2):         # run 1 warms jit/placement caches
+        t0 = time.monotonic()
+        params = ckpt.load_sharded(lambda name, shape: sh, engine=engine)
+        for v in params.values():
+            v.block_until_ready()
+        dt = time.monotonic() - t0
+        payload = sum(int(v.nbytes) for v in params.values())
+        del params
+        best = max(best, payload / (1 << 30) / dt)
+    return best, payload
+
+
+def bench_sql(engine, nbytes: int, num_groups: int = 64,
+              device=None) -> tuple[float, int]:
+    from nvme_strom_tpu.sql.parquet import ParquetScanner
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    path = os.path.join(_scratch_dir(), "table.parquet")
+    size = make_parquet_file(path, nbytes, num_groups)
+    scanner = ParquetScanner(path, engine)
+    rows = scanner.num_rows
+    best = 0.0
+    for _ in range(2):         # run 1 warms the groupby jit
+        t0 = time.monotonic()
+        out = sql_groupby(scanner, "k", "v", num_groups,
+                          aggs=("count", "sum", "mean"), device=device)
+        for v in out.values():
+            v.block_until_ready()
+        dt = time.monotonic() - t0
+        best = max(best, size / (1 << 30) / dt)
+        _log(f"suite: sql scanned {rows} rows ({size >> 20} MiB) "
+             f"in {dt:.3f}s = {rows / dt / 1e6:.1f} Mrows/s")
+    return best, rows
+
+
+# ------------------------------- main ----------------------------------
+
+def run(configs: list[int]) -> list[dict]:
+    from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    nbytes = _suite_bytes()
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        device_ok = False      # explicitly pinned to CPU: skip the probe
+    else:
+        device_ok = bench.probe_device()
+    if not device_ok:
+        bench.force_cpu()
+    dev_tag = "tpu" if device_ok else "cpu-fallback"
+
+    raw_path = os.path.join(_scratch_dir(), "raw.bin")
+    bench.make_file(raw_path, nbytes)
+    stats = StromStats()
+    results = []
+    with StromEngine(EngineConfig(), stats=stats) as engine:
+        _log(f"suite: backend={engine.backend} bytes/config={nbytes >> 20}"
+             f"MiB dev={dev_tag}")
+        raw = bench.bench_raw(engine, raw_path)
+        link = bench.bench_link()
+        ceiling = 0.9 * (min(raw, link) if raw > 0 and link > 0
+                         else max(raw, link, 1.0))
+        _log(f"suite: raw={raw:.3f} GiB/s link={link:.3f} GiB/s "
+             f"target=0.9·min={ceiling:.3f} GiB/s")
+
+        names = {
+            1: ("raw-sequential-read", lambda: (raw, nbytes)),
+            2: ("arrow-to-device", lambda: bench_arrow(engine, nbytes)),
+            3: ("wds-sharded-loader", lambda: bench_loader(engine, nbytes)),
+            4: ("safetensors-lazy-load",
+                lambda: bench_weights(engine, nbytes)),
+            5: ("parquet-groupby-scan", lambda: bench_sql(engine, nbytes)),
+        }
+        for c in configs:
+            label, fn = names[c]
+            val, extra = fn()
+            results.append({
+                "metric": f"config{c}:{label} (dev={dev_tag})",
+                "value": round(val, 3),
+                "unit": "GiB/s",
+                "vs_baseline": round(val / ceiling, 3),
+            })
+            _log(f"suite: config {c} {label}: {val:.3f} GiB/s "
+                 f"({results[-1]['vs_baseline']:.2f}x of target)")
+        engine.sync_stats()
+    _log(f"suite: stats bounce={stats.bounce_bytes} "
+         f"direct={stats.bytes_direct} fallback={stats.bytes_fallback}")
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", type=int, action="append",
+                    choices=range(1, 6))
+    ap.add_argument("--all", action="store_true")
+    args = ap.parse_args()
+    configs = sorted(set(args.config or [])) if args.config else []
+    if args.all or not configs:
+        configs = [1, 2, 3, 4, 5]
+    for line in run(configs):
+        print(json.dumps(line), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,33 @@
+"""bench_suite.py: every BASELINE config runs end-to-end and emits a
+well-formed result (tiny sizes, CPU backend)."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_suite_all_configs(tmp_path):
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               STROM_SUITE_BYTES=str(8 << 20),
+               STROM_BENCH_DIR=str(tmp_path))
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench_suite.py"), "--all"],
+        capture_output=True, text=True, timeout=540, env=env,
+        cwd=str(REPO))
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 5, r.stdout
+    for i, ln in enumerate(lines, start=1):
+        rec = json.loads(ln)
+        assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+        assert rec["metric"].startswith(f"config{i}:")
+        assert rec["value"] > 0
+        assert rec["unit"] == "GiB/s"
+        assert rec["vs_baseline"] > 0
+    # scratch data landed in the requested dir, not the repo
+    assert (tmp_path / ".bench_suite").is_dir()
