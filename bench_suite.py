@@ -49,20 +49,23 @@ def _suite_bytes() -> int:
     return int(os.environ.get("STROM_SUITE_BYTES", 256 << 20))
 
 
-def _fresh(tag: str, nbytes: int) -> bool:
+def _needs_regen(tag: str, nbytes: int) -> bool:
     """Size-aware scratch cache: True if data tagged `tag` must be
-    (re)generated for this nbytes (a .meta sentinel records the size a
-    previous run generated, so changing STROM_SUITE_BYTES regenerates
-    instead of silently benchmarking stale data)."""
+    (re)generated for this nbytes.  The .meta sentinel records the size a
+    previous run FINISHED generating (written by _mark_generated after
+    success), so changing STROM_SUITE_BYTES — or an interrupted
+    generation — regenerates instead of silently benchmarking stale or
+    truncated data."""
     meta = os.path.join(_scratch_dir(), f".{tag}.meta")
     try:
-        if int(open(meta).read()) == nbytes:
-            return False
+        return int(open(meta).read()) != nbytes
     except (OSError, ValueError):
-        pass
-    with open(meta, "w") as f:
+        return True
+
+
+def _mark_generated(tag: str, nbytes: int) -> None:
+    with open(os.path.join(_scratch_dir(), f".{tag}.meta"), "w") as f:
         f.write(str(nbytes))
-    return True
 
 
 # --------------------------- data generators ---------------------------
@@ -71,7 +74,7 @@ def make_arrow_file(path: str, nbytes: int) -> int:
     """Multi-batch Arrow IPC file of float32/int32 columns; returns size."""
     import numpy as np
     import pyarrow as pa
-    if not _fresh("arrow", nbytes) and os.path.exists(path):
+    if not _needs_regen("arrow", nbytes) and os.path.exists(path):
         return os.path.getsize(path)
     rows_total = max(1024, nbytes // 12)     # 3 cols × 4 bytes
     per_batch = max(1024, rows_total // 16)
@@ -88,6 +91,7 @@ def make_arrow_file(path: str, nbytes: int) -> int:
                  pa.array(rng.integers(0, 64, n, dtype=np.int32))],
                 schema=schema))
             left -= n
+    _mark_generated("arrow", nbytes)
     return os.path.getsize(path)
 
 
@@ -100,7 +104,7 @@ def make_wds_shards(dirpath: str, nbytes: int, n_shards: int = 4,
     os.makedirs(dirpath, exist_ok=True)
     per_shard = max(2, nbytes // n_shards // item_bytes)
     rng = np.random.default_rng(0)
-    regen = _fresh("wds", nbytes)
+    regen = _needs_regen("wds", nbytes)
     paths = []
     for s in range(n_shards):
         p = os.path.join(dirpath, f"shard-{s:04d}.tar")
@@ -114,6 +118,7 @@ def make_wds_shards(dirpath: str, nbytes: int, n_shards: int = 4,
                 ti = tarfile.TarInfo(f"{s:04d}{i:05d}.bin")
                 ti.size = item_bytes
                 tf.addfile(ti, _io.BytesIO(payload))
+    _mark_generated("wds", nbytes)
     return paths
 
 
@@ -126,7 +131,7 @@ def make_safetensors_shards(dirpath: str, nbytes: int,
     n_tensors = 4
     rows = max(64, per_shard // n_tensors // (1024 * 4))
     rng = np.random.default_rng(0)
-    regen = _fresh("st", nbytes)
+    regen = _needs_regen("st", nbytes)
     paths = []
     for s in range(n_shards):
         p = os.path.join(dirpath,
@@ -138,6 +143,7 @@ def make_safetensors_shards(dirpath: str, nbytes: int,
             f"w{s}_{i}": rng.standard_normal(
                 (rows, 1024), dtype=np.float32)
             for i in range(n_tensors)})
+    _mark_generated("st", nbytes)
     return paths
 
 
@@ -145,7 +151,7 @@ def make_parquet_file(path: str, nbytes: int, num_groups: int = 64) -> int:
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
-    if not _fresh("parquet", nbytes) and os.path.exists(path):
+    if not _needs_regen("parquet", nbytes) and os.path.exists(path):
         return os.path.getsize(path)
     rows = max(4096, nbytes // 8)            # int32 key + float32 value
     rng = np.random.default_rng(0)
@@ -154,6 +160,7 @@ def make_parquet_file(path: str, nbytes: int, num_groups: int = 64) -> int:
         "v": pa.array(rng.standard_normal(rows, dtype=np.float32))})
     pq.write_table(tbl, path, row_group_size=max(4096, rows // 16),
                    compression="none")
+    _mark_generated("parquet", nbytes)
     return os.path.getsize(path)
 
 

@@ -1,0 +1,171 @@
+"""Autoregressive decoding with a KV cache for the flagship transformer.
+
+The reference is a storage engine with no inference concepts (SURVEY.md
+§1) — this module completes the model family the framework ships: the
+weights land in HBM via the lazy safetensors loader (parallel/weights.py)
+and serve from there.
+
+TPU-first choices: the whole generation loop is ONE ``lax.scan`` under
+jit (static length, no Python control flow); the cache is a pytree of
+preallocated ``(n_layers, batch, n_kv_heads, max_len, head_dim)`` arrays
+updated with ``lax.dynamic_update_slice`` (static shapes, in-place under
+donation); GQA keeps the cache at kv-head width and expands at use; under
+a dp×tp mesh the cache shards over heads like the attention weights, so
+decode runs SPMD with the same annotations as training.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nvme_strom_tpu.models.transformer import (
+    TransformerConfig, attention, expand_gqa, mlp, qkv_project, rms_norm)
+from nvme_strom_tpu.models import moe as _moe
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int) -> Dict:
+    """Empty KV cache.  ``pos`` is the number of valid positions.
+
+    Contract: callers must not push more than ``max_len`` total positions
+    through prefill+decode_step — past that, dynamic_update_slice clamps
+    and silently overwrites the last slot (generate() sizes the cache as
+    prompt_len + max_new_tokens, exactly enough)."""
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_len, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def cache_shardings(mesh, tp_axis: str = "tp", dp_axis: str = "dp"):
+    """Cache sharded like attention: batch over dp, kv heads over tp."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from nvme_strom_tpu.parallel.shardings import prune_spec
+    kv = NamedSharding(mesh, prune_spec(
+        P(None, dp_axis, tp_axis, None, None), mesh))
+    return {"k": kv, "v": kv,
+            "pos": NamedSharding(mesh, prune_spec(P(), mesh))}
+
+
+def _mlp_block(h, p, L, cfg):
+    if cfg.is_moe_layer(int(L.split(".")[1])):
+        out, _ = _moe.moe_mlp(h, p, L, cfg)
+        return out
+    return mlp(h, p, L)
+
+
+def prefill(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
+            cache: Dict) -> tuple[jax.Array, Dict]:
+    """Run the prompt through the model, filling cache[0:seq].
+
+    tokens (b, s) int32 → (last-position logits (b, vocab) f32, cache).
+    """
+    b, s = tokens.shape
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.arange(s, dtype=jnp.float32)
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        h = rms_norm(x, params[L + "attn_norm"], cfg.norm_eps)
+        a, k, v = attention(h, params, L, cfg, positions=positions,
+                            return_kv=True)
+        cache["k"] = lax.dynamic_update_slice(
+            cache["k"], k[None].astype(cfg.dtype), (i, 0, 0, 0, 0))
+        cache["v"] = lax.dynamic_update_slice(
+            cache["v"], v[None].astype(cfg.dtype), (i, 0, 0, 0, 0))
+        x = x + a
+        h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
+        x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
+    cache["pos"] = jnp.asarray(s, jnp.int32)
+    x = rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, cache
+
+
+def decode_step(params: Dict, token: jax.Array, cfg: TransformerConfig,
+                cache: Dict) -> tuple[jax.Array, Dict]:
+    """One incremental step: token (b,) int32 at position cache['pos'].
+
+    Returns (next-token logits (b, vocab) f32, updated cache).
+    Contract: cache['pos'] must be < the cache's max_len (see init_cache).
+    """
+    b = token.shape[0]
+    max_len = cache["k"].shape[3]
+    pos = cache["pos"]
+    x = params["tok_embed"].astype(cfg.dtype)[token[:, None]]  # (b, 1, d)
+    positions = pos.astype(jnp.float32)[None]
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        h = rms_norm(x, params[L + "attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_project(h, params, L, cfg,       # (b, nkv, 1, hd)
+                              positions=positions)
+        cache["k"] = lax.dynamic_update_slice(
+            cache["k"], k[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
+        cache["v"] = lax.dynamic_update_slice(
+            cache["v"], v[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
+        ck = expand_gqa(cache["k"][i], cfg)            # (b, nh, S, hd)
+        cv = expand_gqa(cache["v"][i], cfg)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
+        valid = jnp.arange(max_len) <= pos             # causal by position
+        scores = jnp.where(valid[None, None, None, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        a = jnp.einsum("bhqk,bhkd->bhqd", probs, cv)
+        a = a.transpose(0, 2, 1, 3).reshape(b, 1, -1)
+        x = x + a @ params[L + "wo"].astype(a.dtype)
+        h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
+        x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
+    cache["pos"] = pos + 1
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, cache
+
+
+def _sample(logits, temperature: float, rng):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(
+        rng, logits / jnp.float32(temperature), axis=-1).astype(jnp.int32)
+
+
+def generate(params: Dict, prompt: jax.Array, cfg: TransformerConfig,
+             max_new_tokens: int, temperature: float = 0.0,
+             rng: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None,
+             pad_id: int = 0) -> jax.Array:
+    """Greedy/temperature generation.  prompt (b, s) int32 →
+    (b, max_new_tokens) int32.  The decode loop is one lax.scan; jit this
+    whole function (``static_argnums`` for cfg/max_new_tokens/temperature)
+    or wrap it in a partial.  After ``eos_id`` a sequence emits
+    ``pad_id`` forever (static shapes; no early exit under jit)."""
+    b, s = prompt.shape
+    if rng is None:
+        rng = jax.random.key(0)
+    cache = init_cache(cfg, b, s + max_new_tokens)
+    logits, cache = prefill(params, prompt, cfg, cache)
+    rng, sub = jax.random.split(rng)
+    tok = _sample(logits, temperature, sub)
+    # An eos IS emitted (even as the very first token); only tokens after
+    # it become pad — same semantics at every position.
+    done = (jnp.zeros((b,), bool) if eos_id is None
+            else tok == eos_id)
+
+    def step(carry, _):
+        tok, cache, rng, done = carry
+        logits, cache = decode_step(params, tok, cfg, cache)
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits, temperature, sub)
+        if eos_id is not None:
+            nxt = jnp.where(done, pad_id, nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, cache, rng, done), tok
+
+    (last, cache, rng, done), toks = lax.scan(
+        step, (tok, cache, rng, done), None, length=max_new_tokens - 1)
+    toks = jnp.moveaxis(toks, 0, 1)                    # (b, n-1)
+    return jnp.concatenate([toks, last[:, None]], axis=1)

@@ -113,12 +113,19 @@ def rms_norm(x, weight, eps):
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
 
 
-def _rope(q, k, theta):
-    """Rotary position embeddings over the last dim (pairs)."""
+def _rope(q, k, theta, positions=None):
+    """Rotary position embeddings over the last dim (pairs).
+
+    ``positions``: absolute token positions, shape (seq,); defaults to
+    arange(seq).  The decode path passes the cache write position so an
+    incrementally-generated token gets the same rotation it would in a
+    full forward pass (models/decode.py)."""
     seq = q.shape[-2]
     half = q.shape[-1] // 2
+    if positions is None:
+        positions = jnp.arange(seq, dtype=jnp.float32)
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    ang = jnp.arange(seq, dtype=jnp.float32)[:, None] * freqs[None, :]
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     cos, sin = jnp.cos(ang), jnp.sin(ang)
 
     def rot(x):
@@ -143,26 +150,41 @@ def dense_causal_attention(q, k, v):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def attention(x, p, prefix, cfg: TransformerConfig, attn_fn=None):
-    """``attn_fn`` swaps the attention inner block: dense (default), the
-    ring sequence-parallel kernel (parallel/ring_attention.make_ring_attn),
-    or the Pallas flash kernel — all take/return (b, h, s, d)."""
+def qkv_project(x, p, prefix, cfg: TransformerConfig, positions=None):
+    """Shared QKV projection + RoPE.  Returns q (b, nh, s, hd) and k/v at
+    kv-head width (b, n_kv_heads, s, hd) — pre-GQA-expansion, which is the
+    shape the decode KV cache stores (models/decode.py)."""
     b, s, _ = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
     q = (x @ p[prefix + "wq"].astype(x.dtype)).reshape(b, s, nh, hd)
     k = (x @ p[prefix + "wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
     v = (x @ p[prefix + "wv"].astype(x.dtype)).reshape(b, s, nkv, hd)
-    q = q.transpose(0, 2, 1, 3)   # b h s d
-    k = k.transpose(0, 2, 1, 3)
-    v = v.transpose(0, 2, 1, 3)
-    q, k = _rope(q, k, cfg.rope_theta)
-    if nkv != nh:
-        rep = nh // nkv
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
-    out = (attn_fn or dense_causal_attention)(q, k, v)
-    out = out.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
-    return out @ p[prefix + "wo"].astype(x.dtype)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # b h s d
+    q, k = _rope(q, k, cfg.rope_theta, positions=positions)
+    return q, k, v
+
+
+def expand_gqa(t, cfg: TransformerConfig):
+    """kv-head width → full head width (no-op when nkv == nh)."""
+    if cfg.n_kv_heads != cfg.n_heads:
+        t = jnp.repeat(t, cfg.n_heads // cfg.n_kv_heads, axis=1)
+    return t
+
+
+def attention(x, p, prefix, cfg: TransformerConfig, attn_fn=None,
+              positions=None, return_kv=False):
+    """``attn_fn`` swaps the attention inner block: dense (default), the
+    ring sequence-parallel kernel (parallel/ring_attention.make_ring_attn),
+    or the Pallas flash kernel — all take/return (b, h, s, d).
+    ``return_kv=True`` additionally returns the post-RoPE kv-width k/v for
+    cache prefill."""
+    b, s, _ = x.shape
+    q, k, v = qkv_project(x, p, prefix, cfg, positions=positions)
+    out = (attn_fn or dense_causal_attention)(
+        q, expand_gqa(k, cfg), expand_gqa(v, cfg))
+    out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim)
+    out = out @ p[prefix + "wo"].astype(x.dtype)
+    return (out, k, v) if return_kv else out
 
 
 def mlp(x, p, prefix):
