@@ -177,7 +177,11 @@ def main() -> int:
 
     direct_ok = info.supports_direct
     bounce = stats.bounce_bytes
-    if direct_ok and bounce:
+    if direct_ok and bounce and device_ok:
+        # On the CPU fallback a bounce is EXPECTED: device_put to a
+        # host-backed device may alias the staging buffer, so the bridge
+        # forces (and honestly counts) a copy. Only an accelerator run
+        # with bounces indicates a broken zero-copy path.
         _log(f"bench: WARNING bounce_bytes={bounce} on a direct-capable fs")
     _log(f"bench: bounce_bytes={bounce} bytes_direct={stats.bytes_direct} "
          f"bytes_to_device={stats.bytes_to_device}")

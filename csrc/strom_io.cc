@@ -46,6 +46,7 @@
 #include <sys/ioctl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/uio.h>
 #include <sys/statfs.h>
 #include <sys/syscall.h>
 #include <sys/sysmacros.h>
@@ -59,6 +60,9 @@
 #endif
 #ifndef __NR_io_uring_enter
 #define __NR_io_uring_enter 426
+#endif
+#ifndef __NR_io_uring_register
+#define __NR_io_uring_register 427
 #endif
 
 struct io_sqring_offsets_ {
@@ -98,6 +102,11 @@ static constexpr uint64_t kOffSqes = 0x10000000ULL;
 static constexpr uint32_t kFeatSingleMmap = 1u << 0;
 static constexpr uint32_t kEnterGetevents = 1u << 0;
 static constexpr uint8_t kOpNop = 0, kOpRead = 22, kOpWrite = 23;
+/* Fixed-buffer variants: the kernel pins the staging pool ONCE at
+ * registration instead of get_user_pages()-pinning every I/O — the same
+ * pin-once pattern as the reference's MAP_GPU_MEMORY (SURVEY.md §3.2). */
+static constexpr uint8_t kOpReadFixed = 4, kOpWriteFixed = 5;
+static constexpr uint32_t kRegisterBuffers = 0;
 static constexpr uint64_t kShutdownUserData = ~0ULL;
 
 struct Uring {
@@ -111,6 +120,7 @@ struct Uring {
   size_t sq_ring_sz = 0, cq_ring_sz = 0, sqes_sz = 0;
   uint32_t sq_entries = 0;
   bool single_mmap = false;
+  bool fixed_bufs = false;   /* staging pool registered with the kernel */
   /* SQEs published to the ring but not yet consumed by io_uring_enter
    * (enter can fail with EINTR/EBUSY after the tail was advanced; the
    * entry then MUST be submitted by a later enter, never abandoned —
@@ -157,6 +167,19 @@ struct Uring {
     return true;
   }
 
+  /* Register the staging pool as fixed buffers (one iovec per staging
+   * buffer; SQE buf_index selects one). Soft-fail: EOPNOTSUPP/ENOMEM
+   * (old kernel, RLIMIT_MEMLOCK) just leaves the non-fixed opcodes. */
+  void try_register(uint8_t *pool, uint64_t buf_cap, uint32_t n) {
+    std::vector<struct iovec> iov(n);
+    for (uint32_t i = 0; i < n; i++) {
+      iov[i].iov_base = pool + (uint64_t)i * buf_cap;
+      iov[i].iov_len = buf_cap;
+    }
+    fixed_bufs = syscall(__NR_io_uring_register, fd, kRegisterBuffers,
+                         iov.data(), n) == 0;
+  }
+
   void teardown() {
     if (sqes) munmap(sqes, sqes_sz);
     if (cq_ring_ptr && cq_ring_ptr != sq_ring_ptr) munmap(cq_ring_ptr, cq_ring_sz);
@@ -187,7 +210,7 @@ struct Uring {
    * -errno. The SQE is always published; a transient enter failure leaves
    * it queued for the next flush rather than failing the request. */
   int submit(uint8_t opcode, int fd_, uint64_t off, void *addr, uint32_t len,
-             uint64_t user_data) {
+             uint64_t user_data, uint16_t buf_index = 0) {
     uint32_t tail = *sq_tail;
     uint32_t head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
     if (tail - head >= sq_entries) {
@@ -208,6 +231,7 @@ struct Uring {
     sqe->addr = (uint64_t)addr;
     sqe->len = len;
     sqe->user_data = user_data;
+    sqe->buf_index = buf_index;
     sq_array[idx] = idx;
     __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
     unsubmitted.fetch_add(1, std::memory_order_acq_rel);
@@ -427,17 +451,24 @@ struct strom_engine {
     const FileEnt &fe = it->second;
     if (use_uring) {
       int rc;
+      /* A request holding a staging buffer targets registered memory:
+       * use the fixed-buffer opcode so the kernel skips per-I/O pinning. */
+      bool fixed = ring.fixed_bufs && r->buf_idx >= 0;
       if (r->is_write) {
         const uint8_t *s = r->buf_idx >= 0 ? r->buf : (const uint8_t *)r->wsrc;
-        rc = ring.submit(kOpWrite, r->direct ? fe.fd_direct : fe.fd_buffered,
+        rc = ring.submit(fixed ? kOpWriteFixed : kOpWrite,
+                         r->direct ? fe.fd_direct : fe.fd_buffered,
                          r->offset, (void *)s, (uint32_t)r->len,
-                         (uint64_t)r->id);
+                         (uint64_t)r->id,
+                         fixed ? (uint16_t)r->buf_idx : 0);
       } else {
         int fd = r->direct ? fe.fd_direct : fe.fd_buffered;
         uint64_t off = r->direct ? r->a_off : r->offset;
         uint8_t *dst = r->direct ? r->buf : r->buf + (r->offset - r->a_off);
         uint32_t rlen = (uint32_t)(r->direct ? r->a_len : r->len);
-        rc = ring.submit(kOpRead, fd, off, dst, rlen, (uint64_t)r->id);
+        rc = ring.submit(fixed ? kOpReadFixed : kOpRead, fd, off, dst, rlen,
+                         (uint64_t)r->id,
+                         fixed ? (uint16_t)r->buf_idx : 0);
       }
       if (rc != 0) {
         r->status = rc;
@@ -594,6 +625,7 @@ strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
 
   if (use_io_uring && e->ring.init(queue_depth * 2)) {
     e->use_uring = true;
+    e->ring.try_register(e->pool, e->buf_cap, n_buffers);
     e->reaper = std::thread([e] { e->reaper_loop(); });
   } else {
     uint32_t nw = queue_depth < 32 ? queue_depth : 32;
@@ -834,6 +866,7 @@ void strom_get_pool_info(strom_engine *e, strom_pool_info *out) {
   out->queue_depth = (int32_t)e->queue_depth;
   out->in_flight = (uint32_t)e->reqs.size();
   out->deferred = (uint32_t)e->defer_q.size();
+  out->fixed_bufs = e->ring.fixed_bufs ? 1 : 0;
 }
 
 int strom_open(strom_engine *e, const char *path, int flags) {

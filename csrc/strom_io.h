@@ -142,6 +142,8 @@ typedef struct strom_pool_info {
   int32_t  queue_depth;
   uint32_t in_flight;     /* submitted, not yet released               */
   uint32_t deferred;      /* submitted, waiting for a free buffer      */
+  int32_t  fixed_bufs;    /* 1 if pool registered as io_uring fixed
+                             buffers (pin-once, READ_FIXED/WRITE_FIXED) */
 } strom_pool_info;
 
 void strom_get_pool_info(strom_engine *eng, strom_pool_info *out);

@@ -73,6 +73,7 @@ class _PoolInfo(ctypes.Structure):
         ("queue_depth", ctypes.c_int32),
         ("in_flight", ctypes.c_uint32),
         ("deferred", ctypes.c_uint32),
+        ("fixed_bufs", ctypes.c_int32),
     ]
 
 

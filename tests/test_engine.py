@@ -134,6 +134,11 @@ def test_pool_info(engine, tmp_data_file):
     p.release()
     assert engine.pool_info()["free_buffers"] == info["n_buffers"]
     engine.close(fh)
+    # fixed-buffer registration is reported (1 on io_uring backends with
+    # kernel support; reads above verified content either way)
+    assert info["fixed_bufs"] in (0, 1)
+    if engine.backend != "io_uring":
+        assert info["fixed_bufs"] == 0
 
 
 def test_file_eligible_verdict(tmp_data_file):
