@@ -87,3 +87,19 @@ def batch_shardings(mesh, seq_sharded: bool = False) -> NamedSharding:
 
 def replicated_sharding(mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def replicate_scalars(state, mesh):
+    """device_put every 0-d array leaf of ``state`` as mesh-replicated.
+
+    optax states mirror the params' shardings for mu/nu (zeros_like of
+    sharded arrays) but create bare scalars (count) on the default device;
+    a checkpoint restored under its recorded shardings then mixes
+    single-device scalars with mesh-wide params and jit rejects the
+    device sets.  Replicating scalars at init makes fresh and restored
+    states placement-identical."""
+    import jax
+    rep = replicated_sharding(mesh)
+    return jax.tree.map(
+        lambda l: jax.device_put(l, rep)
+        if getattr(l, "ndim", None) == 0 else l, state)
