@@ -83,9 +83,16 @@ def main(argv=None) -> int:
     shards = sorted(
         os.path.join(data_dir, f) for f in os.listdir(data_dir)
         if f.endswith(".tar"))
+    if not shards:
+        ap.error(f"no .tar shards found under {data_dir}")
+
+    ckpt_dir = args.ckpt_dir or os.path.join(
+        tmp.name if tmp else ".", "ckpt")
+    mgr = CheckpointManager(ckpt_dir, engine=engine)
+    start = mgr.latest_step()
 
     p_sh = param_shardings(cfg, mesh)
-    if args.init_weights:
+    if args.init_weights and start is None:  # a resume overwrites anyway
         params = LazyCheckpoint(args.init_weights).load_sharded(
             p_sh, engine=engine)
         print(f"params: lazy-loaded {len(params)} tensors from "
@@ -102,10 +109,6 @@ def main(argv=None) -> int:
                       out_shardings=(p_sh, None, None),
                       donate_argnums=(0, 1))
 
-    ckpt_dir = args.ckpt_dir or os.path.join(
-        tmp.name if tmp else ".", "ckpt")
-    mgr = CheckpointManager(ckpt_dir, engine=engine)
-    start = mgr.latest_step()
     if start is not None:
         params, opt_state = mgr.restore((params, opt_state))
         print(f"resumed from step {start}")
@@ -116,9 +119,16 @@ def main(argv=None) -> int:
             (payload,) = parts.values()
             return np.frombuffer(payload, dtype=np.int32) % cfg.vocab
         while True:
+            n = 0
             with ShardedLoader(shards, mesh, args.global_batch, fmt="wds",
                                decode=decode, engine=engine) as loader:
-                yield from loader
+                for b in loader:
+                    n += 1
+                    yield b
+            if n == 0:
+                raise RuntimeError(
+                    f"shards under {data_dir} yield zero full batches of "
+                    f"{args.global_batch}")
 
     it = batches()
     t0 = time.monotonic()

@@ -40,6 +40,10 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
     moe_every: int = 2            # layer i is MoE iff i % moe_every == rem
+    # Rematerialize each layer in backward (jax.checkpoint): trades one
+    # extra forward's FLOPs for O(1)-layers activation memory — the HBM
+    # lever for deep configs.
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -201,17 +205,23 @@ def forward_with_aux(params: Dict, tokens: jax.Array,
     aux_loss is the summed MoE load-balancing loss (0 for dense models)."""
     x = params["tok_embed"].astype(cfg.dtype)[tokens]
     aux = jnp.zeros((), jnp.float32)
-    for i in range(cfg.n_layers):
+
+    def one_layer(x, i):
         L = f"layers.{i}."
         x = x + attention(rms_norm(x, params[L + "attn_norm"], cfg.norm_eps),
                           params, L, cfg, attn_fn)
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
         if cfg.is_moe_layer(i):
             h, a = _moe.moe_mlp(h, params, L, cfg)
-            aux = aux + a
         else:
-            h = mlp(h, params, L)
-        x = x + h
+            h, a = mlp(h, params, L), jnp.zeros((), jnp.float32)
+        return x + h, a
+
+    if cfg.remat:
+        one_layer = jax.checkpoint(one_layer, static_argnums=(1,))
+    for i in range(cfg.n_layers):
+        x, a = one_layer(x, i)
+        aux = aux + a
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, aux

@@ -35,6 +35,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from nvme_strom_tpu.models.transformer import (
     TransformerConfig, _rope, dense_causal_attention, rms_norm)
+from nvme_strom_tpu.parallel.ring_attention import _ring_block
 
 _STACKED = ("attn_norm", "wq", "wk", "wv", "wo",
             "mlp_norm", "w_gate", "w_up", "w_down")
@@ -100,10 +101,14 @@ def stacked_shardings(mesh) -> Dict[str, NamedSharding]:
 
 # ------------------- per-device stage computation -------------------
 
-def _block(x, lp, cfg: TransformerConfig, tp_axis, tp_size: int):
-    """One decoder layer with explicit-psum tensor parallelism.
-    x (b, s, d); lp = per-layer weight dict with tp-local shards.
-    ``tp_axis`` is None when the mesh has no tp axis (no psum needed)."""
+def _block(x, lp, cfg: TransformerConfig, tp_axis, tp_size: int,
+           sp_axis=None, sp_size: int = 1):
+    """One decoder layer with explicit-psum tensor parallelism and
+    (optionally) ring-attention sequence parallelism.
+    x (b, s_local, d); lp = per-layer weight dict with tp-local shards.
+    ``tp_axis``/``sp_axis`` are None when the mesh lacks the axis.
+    With sp, the sequence dim is sharded: RoPE uses the shard's absolute
+    positions and attention runs the ppermute ring over ``sp_axis``."""
     h = rms_norm(x, lp["attn_norm"], cfg.norm_eps)
     b, s, _ = h.shape
     hd = cfg.head_dim
@@ -113,11 +118,20 @@ def _block(x, lp, cfg: TransformerConfig, tp_axis, tp_size: int):
     k = (h @ lp["wk"].astype(h.dtype)).reshape(b, s, nkv_l, hd)
     v = (h @ lp["wv"].astype(h.dtype)).reshape(b, s, nkv_l, hd)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    q, k = _rope(q, k, cfg.rope_theta)
+    if sp_axis is not None and sp_size > 1:
+        positions = (lax.axis_index(sp_axis) * s
+                     + jnp.arange(s)).astype(jnp.float32)
+    else:
+        positions = None
+    q, k = _rope(q, k, cfg.rope_theta, positions=positions)
     if nkv_l != nh_l:
         k = jnp.repeat(k, nh_l // nkv_l, axis=1)
         v = jnp.repeat(v, nh_l // nkv_l, axis=1)
-    a = dense_causal_attention(q, k, v)
+    if sp_axis is not None and sp_size > 1:
+        a = _ring_block(q, k, v, axis_name=sp_axis, n_sp=sp_size,
+                        causal=True)
+    else:
+        a = dense_causal_attention(q, k, v)
     a = a.transpose(0, 2, 1, 3).reshape(b, s, nh_l * hd)
     a = a @ lp["wo"].astype(h.dtype)
     if tp_axis is not None:               # row-parallel reduce over tp
@@ -136,7 +150,7 @@ def _block(x, lp, cfg: TransformerConfig, tp_axis, tp_size: int):
 
 
 def _pipeline_local(stack, x_mb, *, cfg, pp_axis, tp_axis, n_pp, tp_size,
-                    n_mb):
+                    n_mb, sp_axis=None, sp_size=1):
     """Per-device pipeline schedule (inside shard_map).
 
     stack: stage-local weights (L/pp leading axis); x_mb: (n_mb, mb_local,
@@ -146,9 +160,13 @@ def _pipeline_local(stack, x_mb, *, cfg, pp_axis, tp_axis, n_pp, tp_size,
     """
     stage = lax.axis_index(pp_axis) if pp_axis is not None else 0
 
+    block = _block
+    if cfg.remat:   # recompute each stage layer in backward (GPipe-style)
+        block = jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
     def stage_apply(x):
         def body(c, lp):
-            return _block(c, lp, cfg, tp_axis, tp_size), None
+            return block(c, lp, cfg, tp_axis, tp_size,
+                         sp_axis, sp_size), None
         x, _ = lax.scan(body, x, stack)
         return x
 
@@ -186,7 +204,7 @@ def _axis_size(mesh, name: str) -> int:
 
 def make_pp_forward(cfg: TransformerConfig, mesh, n_microbatches: int,
                     pp_axis: str = "pp", tp_axis: str = "tp",
-                    dp_axis: str = "dp"):
+                    dp_axis: str = "dp", sp_axis: str = "sp"):
     """Returns fwd(stack, rest, tokens) -> logits (B, s, vocab) f32.
 
     Embedding, final norm and the LM head run outside the shard_map under
@@ -195,6 +213,10 @@ def make_pp_forward(cfg: TransformerConfig, mesh, n_microbatches: int,
     """
     n_pp = _axis_size(mesh, pp_axis)
     tp_size = _axis_size(mesh, tp_axis)
+    sp_size = _axis_size(mesh, sp_axis)
+    if sp_size > 1 and cfg.max_seq % sp_size:
+        raise ValueError(f"seq {cfg.max_seq} not divisible by "
+                         f"sp={sp_size}")
     if cfg.n_layers % n_pp:
         raise ValueError(f"{cfg.n_layers} layers not divisible into "
                          f"{n_pp} pipeline stages")
@@ -204,12 +226,14 @@ def make_pp_forward(cfg: TransformerConfig, mesh, n_microbatches: int,
 
     from nvme_strom_tpu.parallel.shardings import prune_spec
     specs = {k: prune_spec(s, mesh) for k, s in stacked_specs().items()}
-    x_spec = prune_spec(P(None, dp_axis, None, None), mesh)
+    x_spec = prune_spec(P(None, dp_axis, sp_axis, None), mesh)
     run = _shard_map(
         partial(_pipeline_local, cfg=cfg,
                 pp_axis=pp_axis if pp_axis in mesh.shape else None,
                 tp_axis=tp_axis if tp_axis in mesh.shape else None,
-                n_pp=n_pp, tp_size=tp_size, n_mb=n_microbatches),
+                sp_axis=sp_axis if sp_axis in mesh.shape else None,
+                n_pp=n_pp, tp_size=tp_size, sp_size=sp_size,
+                n_mb=n_microbatches),
         mesh, in_specs=(specs, x_spec), out_specs=x_spec)
 
     def fwd(stack: Dict, rest: Dict, tokens: jax.Array) -> jax.Array:

@@ -131,3 +131,24 @@ def test_graft_entry_contract():
 def test_graft_dryrun_multichip():
     import __graft_entry__ as g
     g.dryrun_multichip(8)
+
+
+def test_remat_matches_dense_grads():
+    """cfg.remat trades FLOPs for memory; math must be identical."""
+    import numpy as np
+    from nvme_strom_tpu.models.transformer import (
+        TransformerConfig, init_params, loss_fn, tiny_config)
+
+    cfg = tiny_config()
+    rcfg = TransformerConfig(**{**cfg.__dict__, "remat": True})
+    params = init_params(jax.random.key(0), cfg)
+    tok = jax.random.randint(jax.random.key(1), (4, cfg.max_seq),
+                             0, cfg.vocab)
+    assert float(loss_fn(params, tok, rcfg)) == pytest.approx(
+        float(loss_fn(params, tok, cfg)), rel=1e-5)
+    g1 = jax.grad(lambda p: loss_fn(p, tok, cfg))(params)
+    g2 = jax.grad(lambda p: loss_fn(p, tok, rcfg))(params)
+    for k in g1:
+        np.testing.assert_allclose(np.asarray(g1[k], np.float32),
+                                   np.asarray(g2[k], np.float32),
+                                   atol=1e-5, rtol=1e-3)

@@ -101,3 +101,32 @@ def test_pp_rejects_bad_shapes(setup):
     bad = TransformerConfig(**{**cfg.__dict__, "n_layers": 3})
     with pytest.raises(ValueError, match="stages"):
         make_pp_loss(bad, mesh, n_microbatches=2)
+
+
+def test_pp_remat_matches_reference(setup):
+    from nvme_strom_tpu.models.transformer import TransformerConfig
+
+    cfg, params, tokens, ref = setup
+    rcfg = TransformerConfig(**{**cfg.__dict__, "remat": True})
+    mesh = _mesh((("dp", 2), ("pp", 2)))
+    stack, rest = split_layer_stack(params, rcfg)
+    got = float(jax.jit(make_pp_loss(rcfg, mesh, 2))(stack, rest, tokens))
+    assert got == pytest.approx(ref, rel=2e-2)
+    g = jax.jit(jax.grad(make_pp_loss(rcfg, mesh, 2)))(stack, rest, tokens)
+    assert np.isfinite(np.asarray(g["wq"], np.float32)).all()
+
+
+@pytest.mark.parametrize("axes,n_mb", [
+    ((("pp", 2), ("sp", 2)), 2),
+    ((("pp", 2), ("tp", 2), ("sp", 2)), 4),
+])
+def test_pp_with_ring_attention_matches_reference(setup, axes, n_mb):
+    """sp inside the pipeline: ring attention + offset RoPE per shard."""
+    cfg, params, tokens, ref = setup
+    mesh = _mesh(axes)
+    stack, rest = split_layer_stack(params, cfg)
+    got = float(jax.jit(make_pp_loss(cfg, mesh, n_mb))(stack, rest, tokens))
+    assert got == pytest.approx(ref, rel=2e-2)
+    g = jax.jit(jax.grad(make_pp_loss(cfg, mesh, n_mb)))(
+        stack, rest, tokens)
+    assert np.isfinite(np.asarray(g["wq"], np.float32)).all()
