@@ -55,6 +55,41 @@ def replicated(mesh):
     return NamedSharding(mesh, P())
 
 
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> bool:
+    """Bring up jax's multi-host runtime (call BEFORE any other jax use).
+
+    On TPU pods ``jax.distributed.initialize()`` auto-detects everything;
+    elsewhere pass coordinator/num/id explicitly or via env
+    (STROM_COORDINATOR, STROM_NUM_PROCESSES, STROM_PROCESS_ID).  Returns
+    True when initialization ran, False when skipped (single-process: no
+    coordinator configured and no TPU to auto-detect from).  The rest of
+    the framework only consumes jax.process_index()/process_count(), so a
+    False here simply means single-host operation.
+    """
+    import os
+
+    import jax
+
+    coordinator_address = (coordinator_address
+                           or os.environ.get("STROM_COORDINATOR"))
+    if num_processes is None and "STROM_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["STROM_NUM_PROCESSES"])
+    if process_id is None and "STROM_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["STROM_PROCESS_ID"])
+
+    on_tpu = bool(os.environ.get("TPU_WORKER_HOSTNAMES")
+                  or os.environ.get("TPU_SKYLARK_HOST_BOUNDS")
+                  or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    if coordinator_address is None and not on_tpu:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
 def process_info() -> tuple[int, int]:
     import jax
     return jax.process_index(), jax.process_count()

@@ -162,7 +162,10 @@ def _pipeline_local(stack, x_mb, *, cfg, pp_axis, tp_axis, n_pp, tp_size,
 
     block = _block
     if cfg.remat:   # recompute each stage layer in backward (GPipe-style)
-        block = jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6))
+        # prevent_cse=False: lax.scan already blocks CSE; the default
+        # barriers would only inhibit XLA fusion in the hot path
+        block = jax.checkpoint(_block, static_argnums=(2, 3, 4, 5, 6),
+                               prevent_cse=False)
     def stage_apply(x):
         def body(c, lp):
             return block(c, lp, cfg, tp_axis, tp_size,

@@ -47,3 +47,19 @@ def tmp_data_file(tmp_path):
     payload = rng.integers(0, 256, size=16 << 20, dtype=np.uint8).tobytes()
     path.write_bytes(payload)
     return path, payload
+
+
+def mesh_for(axes):
+    """Mesh from ((name, size), ...), skipping when devices are short.
+    Shared helper for the parallelism suites (pipeline, ulysses, ...)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    sizes = [s for _, s in axes]
+    need = int(np.prod(sizes))
+    if len(devs) < need:
+        pytest.skip(f"needs {need} devices")
+    return Mesh(np.array(devs[:need]).reshape(sizes),
+                tuple(n for n, _ in axes))

@@ -1,0 +1,9 @@
+"""parallel/mesh.py utilities (multi-host bring-up)."""
+
+
+def test_init_distributed_noops_single_host(monkeypatch):
+    from nvme_strom_tpu.parallel.mesh import init_distributed
+    for var in ("STROM_COORDINATOR", "TPU_WORKER_HOSTNAMES",
+                "TPU_SKYLARK_HOST_BOUNDS", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    assert init_distributed() is False   # no coordinator, no TPU: skip

@@ -5,7 +5,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import Mesh
 
 from nvme_strom_tpu.models.transformer import (
     init_params, loss_fn, tiny_config)
@@ -13,14 +12,7 @@ from nvme_strom_tpu.parallel.pipeline import (
     make_pp_loss, make_pp_train_step, merge_layer_stack, split_layer_stack)
 
 
-def _mesh(axes):
-    devs = jax.devices()
-    sizes = [s for _, s in axes]
-    need = int(np.prod(sizes))
-    if len(devs) < need:
-        pytest.skip(f"needs {need} devices")
-    return Mesh(np.array(devs[:need]).reshape(sizes),
-                tuple(n for n, _ in axes))
+from conftest import mesh_for as _mesh
 
 
 @pytest.fixture(scope="module")

@@ -1,0 +1,63 @@
+"""Ulysses all-to-all sequence parallelism (parallel/ulysses.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nvme_strom_tpu.models.transformer import (
+    dense_causal_attention, init_params, loss_fn, tiny_config)
+from nvme_strom_tpu.parallel.ulysses import make_ulysses_attn
+
+
+from conftest import mesh_for as _mesh
+
+
+@pytest.mark.parametrize("axes", [
+    ((("sp", 4),)),
+    ((("dp", 2), ("sp", 2))),
+    ((("dp", 2), ("tp", 2), ("sp", 2))),
+])
+def test_ulysses_matches_dense(axes):
+    mesh = _mesh(axes)
+    b, h, s, d = 2, 4, 32, 16
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, s, d), jnp.float32)
+               for kk in ks)
+    want = dense_causal_attention(q, k, v)
+    got = jax.jit(make_ulysses_attn(mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_rejects_head_poor():
+    mesh = _mesh((("sp", 8),))
+    q = jnp.zeros((1, 4, 32, 8))   # 4 heads < sp=8
+    with pytest.raises(ValueError, match="ring"):
+        make_ulysses_attn(mesh)(q, q, q)
+
+
+def test_ulysses_train_step_matches_unsharded():
+    import optax
+    from nvme_strom_tpu.parallel.shardings import (
+        batch_shardings, param_shardings)
+    from nvme_strom_tpu.models.transformer import make_train_step
+
+    mesh = _mesh((("dp", 2), ("sp", 2)))
+    cfg = tiny_config()        # 4 heads, sp=2 divides
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, cfg.max_seq),
+                                0, cfg.vocab)
+    ref = float(loss_fn(params, tokens, cfg))
+    attn = make_ulysses_attn(mesh)
+    got = float(loss_fn(params, tokens, cfg, attn_fn=attn))
+    assert got == pytest.approx(ref, rel=2e-2)
+
+    p_sh = param_shardings(cfg, mesh)
+    sp = {k: jax.device_put(v, p_sh[k]) for k, v in params.items()}
+    st = jax.device_put(tokens, batch_shardings(mesh, seq_sharded=True))
+    opt = optax.adamw(1e-3)
+    step = jax.jit(make_train_step(cfg, opt, attn_fn=attn))
+    _, _, loss = step(sp, opt.init(sp), st)
+    assert float(loss) == pytest.approx(ref, rel=2e-2)
