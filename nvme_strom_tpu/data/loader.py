@@ -55,6 +55,8 @@ class ShardedLoader:
         For tfrecord, parts is {"": payload}.
       engine: shared StromEngine (one is created if omitted).
       exts: for wds, restrict to these extensions.
+      seq_axis: also shard dim 1 (sequence) of each batch over this mesh
+        axis — the input layout for ring/Ulysses sequence parallelism.
     """
 
     def __init__(self, shard_paths: Sequence, mesh, global_batch: int,
@@ -64,6 +66,7 @@ class ShardedLoader:
                  exts: Optional[List[str]] = None,
                  config: Optional[LoaderConfig] = None,
                  axis: str = "dp",
+                 seq_axis: Optional[str] = None,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None):
         import jax
@@ -71,6 +74,8 @@ class ShardedLoader:
             raise ValueError(f"unknown fmt {fmt!r}")
         self.mesh = mesh
         self.axis = axis
+        self.seq_axis = seq_axis
+        batch_sharding(mesh, axis, seq_axis)   # validate axes early
         self.fmt = fmt
         self.decode = decode or _default_decode
         self.exts = exts
@@ -172,6 +177,12 @@ class ShardedLoader:
         """Yield pytrees of global jax.Arrays sharded over the mesh axis."""
         import jax
         sharding = batch_sharding(self.mesh, self.axis)
+        if self.seq_axis is not None:
+            # long-context batches: samples over `axis`, the sequence dim
+            # over `seq_axis` (ring/Ulysses consume this layout); rank-1
+            # leaves (per-sample scalars) keep the batch-only sharding
+            seq_sharding = batch_sharding(self.mesh, self.axis,
+                                          self.seq_axis)
         q: queue.Queue = queue.Queue(maxsize=self.config.prefetch)
         err: list = []
         stop = threading.Event()
@@ -209,9 +220,13 @@ class ShardedLoader:
                     break
                 global_shape_of = (
                     lambda x: (self.global_batch,) + x.shape[1:])
-                yield jax.tree.map(
-                    lambda x: jax.make_array_from_process_local_data(
-                        sharding, x, global_shape_of(x)), hb)
+                def put(x):
+                    sh = sharding
+                    if self.seq_axis is not None and x.ndim >= 2:
+                        sh = seq_sharding
+                    return jax.make_array_from_process_local_data(
+                        sh, x, global_shape_of(x))
+                yield jax.tree.map(put, hb)
         finally:
             # Abandoned iterator: unblock and stop the producer, then wait
             # for it — close() must never race a thread still submitting.

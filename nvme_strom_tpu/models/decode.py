@@ -87,11 +87,15 @@ def prefill(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
 
 
 def decode_step(params: Dict, token: jax.Array, cfg: TransformerConfig,
-                cache: Dict) -> tuple[jax.Array, Dict]:
+                cache: Dict, cache_attn=None) -> tuple[jax.Array, Dict]:
     """One incremental step: token (b,) int32 at position cache['pos'].
 
     Returns (next-token logits (b, vocab) f32, updated cache).
     Contract: cache['pos'] must be < the cache's max_len (see init_cache).
+    ``cache_attn(q, k_cache, v_cache, pos) -> (b, h, 1, d)`` swaps the
+    attention inner (e.g. ops/decode_attention.make_decode_attn — the
+    fused Pallas kernel); it receives the cache at kv-head width.
+    Default is a masked dense einsum over the GQA-expanded cache.
     """
     b = token.shape[0]
     max_len = cache["k"].shape[3]
@@ -107,15 +111,20 @@ def decode_step(params: Dict, token: jax.Array, cfg: TransformerConfig,
             cache["k"], k[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
         cache["v"] = lax.dynamic_update_slice(
             cache["v"], v[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
-        ck = expand_gqa(cache["k"][i], cfg)            # (b, nh, S, hd)
-        cv = expand_gqa(cache["v"][i], cfg)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck,
-                            preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
-        valid = jnp.arange(max_len) <= pos             # causal by position
-        scores = jnp.where(valid[None, None, None, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-        a = jnp.einsum("bhqk,bhkd->bhqd", probs, cv)
+        if cache_attn is not None:
+            # kv-width cache straight into the kernel: the GQA query
+            # group maps to its kv head inside (no expanded HBM copy)
+            a = cache_attn(q, cache["k"][i], cache["v"][i], pos)
+        else:
+            ck = expand_gqa(cache["k"][i], cfg)        # (b, nh, S, hd)
+            cv = expand_gqa(cache["v"][i], cfg)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck,
+                                preferred_element_type=jnp.float32)
+            scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
+            valid = jnp.arange(max_len) <= pos         # causal by position
+            scores = jnp.where(valid[None, None, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+            a = jnp.einsum("bhqk,bhkd->bhqd", probs, cv)
         a = a.transpose(0, 2, 1, 3).reshape(b, 1, -1)
         x = x + a @ params[L + "wo"].astype(a.dtype)
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
@@ -137,11 +146,12 @@ def generate(params: Dict, prompt: jax.Array, cfg: TransformerConfig,
              max_new_tokens: int, temperature: float = 0.0,
              rng: Optional[jax.Array] = None,
              eos_id: Optional[int] = None,
-             pad_id: int = 0) -> jax.Array:
+             pad_id: int = 0, cache_attn=None) -> jax.Array:
     """Greedy/temperature generation.  prompt (b, s) int32 →
     (b, max_new_tokens) int32.  The decode loop is one lax.scan; jit this
-    whole function (``static_argnums`` for cfg/max_new_tokens/temperature)
-    or wrap it in a partial.  After ``eos_id`` a sequence emits
+    whole function (``static_argnums`` for cfg, max_new_tokens,
+    temperature AND cache_attn — a function is not a jax type) or wrap
+    them all in a partial.  After ``eos_id`` a sequence emits
     ``pad_id`` forever (static shapes; no early exit under jit)."""
     b, s = prompt.shape
     if rng is None:
@@ -157,7 +167,7 @@ def generate(params: Dict, prompt: jax.Array, cfg: TransformerConfig,
 
     def step(carry, _):
         tok, cache, rng, done = carry
-        logits, cache = decode_step(params, tok, cfg, cache)
+        logits, cache = decode_step(params, tok, cfg, cache, cache_attn)
         rng, sub = jax.random.split(rng)
         nxt = _sample(logits, temperature, sub)
         if eos_id is not None:

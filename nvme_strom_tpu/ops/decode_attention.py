@@ -1,0 +1,132 @@
+"""Pallas decode attention: one query token against the KV cache.
+
+The decode hot op (models/decode.py) is memory-bound: every step streams
+the whole (b, kv_heads, S, d) cache from HBM.  This kernel fuses score,
+position-masked online softmax, and the weighted sum into one pass over
+K/V blocks, so the score row never exists in HBM and the cache is read
+exactly once — at kv-head width: the GQA query-head group attends to its
+kv head INSIDE the kernel, so no nh-wide expanded copy of K/V is ever
+materialised.
+
+Memory layout: the sequence dimension lives in the GRID (sequential on a
+TPU core), with the running (m, l, acc) online-softmax state in VMEM
+scratch that persists across the k-block iterations — only one
+(block_k, d) K tile and V tile are resident at a time, so cache length is
+bounded by HBM, not VMEM.  S need not divide block_k; out-of-range block
+tails are masked the same way out-of-position columns are.
+
+Forward-only by design: decoding is inference; the training path uses
+ops/flash_attention.py (which has the custom VJP).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, scale, block_k, n_kb, seq):
+    ki = pl.program_id(2)
+    g = q_ref.shape[2]                                   # query group size
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # (g, d)
+    k = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    # Rows past pos carry zero weight (p == 0), but a padded block tail
+    # may hold NaN/garbage and 0·NaN = NaN — zero those V rows outright.
+    rows_ok = (ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, v.shape, 0)) <= pos_ref[0, 0]
+    v = jnp.where(rows_ok, v, 0.0)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)  # (g, bk)
+    cols = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (g, block_k), 1)
+    # <= pos masks unfilled cache AND any padded tail (pos < seq <= pad)
+    s = jnp.where(cols <= pos_ref[0, 0], s, _NEG_INF)
+
+    m = m_ref[:, 0]
+    l = l_ref[:, 0]
+    m_new = jnp.maximum(m, jnp.max(s, axis=1))
+    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp(m - m_new)
+    m_ref[:, 0] = m_new
+    l_ref[:, 0] = l * alpha + jnp.sum(p, axis=1)
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ki == n_kb - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def decode_attention(q, k, v, pos, *, scale=None, block_k: int = 512,
+                     interpret: bool = None):
+    """q (b, n_heads, 1, d) attends to the kv-width cache k/v
+    (b, n_kv_heads, S, d) at positions [0, pos] (``pos`` = scalar int32
+    index of the newest entry).  n_heads % n_kv_heads == 0; the query
+    group per kv head rides the kernel's second-to-last block dim.
+
+    Returns (b, n_heads, 1, d).  ``interpret`` defaults to True off-TPU so
+    CPU tests run the identical kernel in the Pallas interpreter.
+    """
+    if q.ndim != 4 or q.shape[2] != 1:
+        raise ValueError(f"expected q (b, h, 1, d), got {q.shape}")
+    b, nh, _, d = q.shape
+    _, nkv, S, _ = k.shape
+    if nh % nkv:
+        raise ValueError(f"{nh} query heads not divisible by {nkv} "
+                         "kv heads")
+    g = nh // nkv
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_k = min(block_k, S)
+    n_kb = -(-S // block_k)               # ceil: tail masked, not sliced
+    qg = q.reshape(b, nkv, g, d)
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1, 1)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale),
+                          block_k=block_k, n_kb=n_kb, seq=S),
+        grid=(b, nkv, n_kb),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda bi, hi, ki: (0, 0)),
+            pl.BlockSpec((1, 1, g, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda bi, hi, ki: (bi, hi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),    # running max
+            pltpu.VMEM((g, 1), jnp.float32),    # running denominator
+            pltpu.VMEM((g, d), jnp.float32),    # running accumulator
+        ],
+        interpret=interpret,
+    )(pos_arr, qg, k, v)
+    return out.reshape(b, nh, 1, d)
+
+
+def make_decode_attn(**kw):
+    """cache_attn(q, k_cache, v_cache, pos) for models.decode.decode_step
+    — the fused Pallas replacement for its masked dense einsum.  Receives
+    the cache at kv-head width (no GQA expansion)."""
+    return functools.partial(decode_attention, **kw)

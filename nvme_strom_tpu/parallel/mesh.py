@@ -44,10 +44,16 @@ def make_mesh(axes: Mapping[str, int], devices=None):
     return Mesh(arr, tuple(names))
 
 
-def batch_sharding(mesh, axis: str = "dp"):
-    """NamedSharding that splits axis 0 of a batch across `axis`."""
+def batch_sharding(mesh, axis: str = "dp", seq_axis=None):
+    """NamedSharding splitting dim 0 of a batch across ``axis`` and
+    (optionally) dim 1 across ``seq_axis`` — the input layout for
+    ring/Ulysses sequence parallelism."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    return NamedSharding(mesh, P(axis))
+    if seq_axis is not None and seq_axis not in mesh.shape:
+        raise ValueError(
+            f"mesh has no {seq_axis!r} axis for sequence sharding")
+    spec = P(axis) if seq_axis is None else P(axis, seq_axis)
+    return NamedSharding(mesh, spec)
 
 
 def replicated(mesh):

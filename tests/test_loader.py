@@ -155,3 +155,33 @@ def test_loader_simulated_two_processes(mesh8, tmp_path):
             for hb in dl._host_batches():
                 rows.extend(bytes(r.tobytes()) for r in hb)
     assert sorted(rows) == sorted(expected.values())
+
+
+def test_loader_seq_sharded_batches(tmp_path):
+    """seq_axis shards dim 1 for ring/Ulysses consumers."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.data.loader import ShardedLoader
+
+    paths, expected = _make_wds_shards(tmp_path, n_shards=2, per_shard=8,
+                                       item=64)
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.array(devs[:4]).reshape(2, 2), ("dp", "sp"))
+    seen = []
+    with ShardedLoader(paths, mesh, global_batch=4, fmt="wds",
+                       seq_axis="sp") as loader:
+        for batch in loader:
+            assert batch.shape == (4, 64)
+            spec = batch.sharding.spec
+            assert tuple(spec) == ("dp", "sp")
+            seen.append(np.asarray(batch))
+    got = {bytes(row) for b in seen for row in b}
+    assert got <= {bytes(v) for v in expected.values()}
+    assert len(got) == 16
+
+    with pytest.raises(ValueError, match="no 'sp'"):
+        ShardedLoader(paths, Mesh(np.array(devs[:2]).reshape(2), ("dp",)),
+                      global_batch=4, fmt="wds", seq_axis="sp")
