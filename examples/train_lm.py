@@ -130,7 +130,8 @@ def main(argv=None) -> int:
                     f"shards under {data_dir} yield zero full batches of "
                     f"{args.global_batch}")
 
-    it = batches()
+    from nvme_strom_tpu.data.prefetch import prefetch_to_device
+    it = prefetch_to_device(batches(), size=2)
     t0 = time.monotonic()
     loss = None
     for step in range(start, args.steps):

@@ -1,0 +1,48 @@
+"""Device-side prefetch: keep N batches ahead of the training step.
+
+The loader already overlaps NVMe reads and host batch assembly in a
+producer thread; this last stage pulls ahead of the consumer.  For
+pipelines that already yield jax Arrays (ShardedLoader) the effect is
+dispatch-ahead: placements for batch k+1..k+size are issued while step k
+computes.  For host-array pipelines pass ``device=`` (or a Sharding) and
+non-jax leaves are explicitly ``device_put`` on pull — without it the
+wrapper is lookahead only and moves no bytes itself.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Iterator, Optional
+
+
+def prefetch_to_device(batches: Iterable, size: int = 2,
+                       device=None) -> Iterator:
+    """Yield from ``batches`` while keeping ``size`` items pulled ahead,
+    optionally device_put-ing each batch's non-Array leaves to
+    ``device``."""
+    if size < 1:
+        raise ValueError("size must be >= 1")
+
+    def pull(it):
+        b = next(it)
+        if device is None:
+            return b
+        import jax
+        return jax.tree.map(
+            lambda x: x if isinstance(x, jax.Array)
+            else jax.device_put(x, device), b)
+
+    buf: collections.deque = collections.deque()
+    it = iter(batches)
+    try:
+        for _ in range(size):
+            buf.append(pull(it))
+    except StopIteration:
+        pass
+    while buf:
+        out = buf.popleft()
+        try:
+            buf.append(pull(it))
+        except StopIteration:
+            pass
+        yield out

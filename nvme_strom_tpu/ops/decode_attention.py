@@ -33,7 +33,7 @@ _NEG_INF = -1e30
 
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, scale, block_k, n_kb, seq):
+                   m_ref, l_ref, acc_ref, *, scale, block_k, n_kb):
     ki = pl.program_id(2)
     g = q_ref.shape[2]                                   # query group size
 
@@ -102,7 +102,7 @@ def decode_attention(q, k, v, pos, *, scale=None, block_k: int = 512,
     pos_arr = jnp.asarray(pos, jnp.int32).reshape(1, 1)
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),
-                          block_k=block_k, n_kb=n_kb, seq=S),
+                          block_k=block_k, n_kb=n_kb),
         grid=(b, nkv, n_kb),
         in_specs=[
             pl.BlockSpec((1, 1), lambda bi, hi, ki: (0, 0)),

@@ -28,12 +28,25 @@ import jax.numpy as jnp
 _AGGS = ("count", "sum", "mean", "min", "max")
 
 
-@partial(jax.jit, static_argnames=("num_groups", "aggs", "method"))
+@partial(jax.jit, static_argnames=("num_groups", "aggs", "method",
+                                   "empty_as_nan"))
 def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
                       aggs: Sequence[str] = ("count", "sum", "mean"),
-                      method: str = "matmul") -> Dict[str, jax.Array]:
+                      method: str = "matmul",
+                      mask: jax.Array = None,
+                      empty_as_nan: bool = True) -> Dict[str, jax.Array]:
     """Aggregate ``values`` (N,) or (N, C) by integer ``keys`` (N,) in
-    [0, num_groups). Returns {agg: (num_groups,) or (num_groups, C)}."""
+    [0, num_groups). Returns {agg: (num_groups,) or (num_groups, C)}.
+
+    ``mask`` (N,) bool: rows where False are excluded — the WHERE-clause
+    pushdown.  Static shapes are kept by routing masked rows to a spill
+    group ``num_groups`` that is sliced off the result (no boolean
+    gather, jit-stable).
+
+    Empty groups (count 0): mean/min/max are NaN (SQL-NULL-like).
+    ``empty_as_nan=False`` keeps the raw segment identities (±inf) so
+    partial results stay foldable across row groups (sql_groupby's
+    incremental path)."""
     for a in aggs:
         if a not in _AGGS:
             raise ValueError(f"unknown aggregate {a!r}")
@@ -42,21 +55,27 @@ def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
     squeeze = values.ndim == 1
     vals = values[:, None] if squeeze else values
     vals_f = vals.astype(jnp.float32)
+    G = num_groups
+    if mask is not None:
+        keys = jnp.where(mask, keys, num_groups)   # spill group
+        G = num_groups + 1
 
     if method == "matmul":
         # Segment-sum as a dense (N,G)x(N,C) contraction on the MXU.
         # one_hot entries are exact in any float dtype; values stay f32
         # so sums match the scatter path bit-for-bit-ish.
-        onehot = jax.nn.one_hot(keys, num_groups, dtype=jnp.float32)
+        onehot = jax.nn.one_hot(keys, G, dtype=jnp.float32)
         ones = jnp.ones((vals_f.shape[0], 1), jnp.float32)
         summed = jnp.einsum("ng,nc->gc", onehot, vals_f,
                             preferred_element_type=jnp.float32)
         count = jnp.einsum("ng,nc->gc", onehot, ones,
                            preferred_element_type=jnp.float32)[:, 0]
     else:
-        summed = jax.ops.segment_sum(vals_f, keys, num_groups)
+        summed = jax.ops.segment_sum(vals_f, keys, G)
         count = jax.ops.segment_sum(jnp.ones_like(keys, jnp.float32),
-                                    keys, num_groups)
+                                    keys, G)
+    summed = summed[:num_groups]
+    count = count[:num_groups]
 
     out: Dict[str, jax.Array] = {}
     if "count" in aggs:
@@ -68,26 +87,36 @@ def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
             mean = summed / jnp.maximum(count, 1.0)[:, None]
             mean = jnp.where(count[:, None] > 0, mean, jnp.nan)
             out["mean"] = mean[:, 0] if squeeze else mean
-    if "min" in aggs:
-        m = jax.ops.segment_min(vals_f, keys, num_groups)
-        out["min"] = m[:, 0] if squeeze else m
-    if "max" in aggs:
-        m = jax.ops.segment_max(vals_f, keys, num_groups)
-        out["max"] = m[:, 0] if squeeze else m
+    empty = count == 0
+    for agg, seg in (("min", jax.ops.segment_min),
+                     ("max", jax.ops.segment_max)):
+        if agg in aggs:
+            m = seg(vals_f, keys, G)[:num_groups]
+            if empty_as_nan:
+                m = jnp.where(empty[:, None], jnp.nan, m)
+            out[agg] = m[:, 0] if squeeze else m
     return out
 
 
 def sql_groupby(scanner, key_column: str, value_column: str,
                 num_groups: int, aggs: Sequence[str] = ("count", "sum",
                                                         "mean"),
-                method: str = "matmul", device=None) -> Dict[str, jax.Array]:
+                method: str = "matmul", device=None,
+                where=None, where_columns: Sequence[str] = ()
+                ) -> Dict[str, jax.Array]:
     """End-to-end config-5 query:
 
-        SELECT key, AGG(value) FROM parquet GROUP BY key
+        SELECT key, AGG(value) FROM parquet [WHERE ...] GROUP BY key
 
     Row groups stream through the engine and are aggregated on device
     incrementally — partial sums/counts/min/max fold across row groups, so
     device memory holds one row group of columns at a time, not the table.
+
+    ``where``: jax-traceable predicate ``fn(cols) -> (N,) bool`` receiving
+    {name: device column} for key/value plus every name in
+    ``where_columns`` — the filter runs ON DEVICE (PG-Strom pushes its
+    WHERE clause into the GPU scan the same way, SURVEY.md §3.5); only
+    surviving rows aggregate, only per-group results return to host.
     """
     import numpy as np
     from nvme_strom_tpu.ops.bridge import host_to_device
@@ -95,17 +124,28 @@ def sql_groupby(scanner, key_column: str, value_column: str,
     dev = device or jax.local_devices()[0]
 
     folds = None
-    for tbl in scanner.iter_row_groups([key_column, value_column]):
+    cols_needed = list(dict.fromkeys(
+        [key_column, value_column, *where_columns]))
+    for tbl in scanner.iter_row_groups(cols_needed):
         keys = tbl.column(key_column).to_numpy(zero_copy_only=False)
         vals = tbl.column(value_column).to_numpy(zero_copy_only=False)
         if not np.issubdtype(keys.dtype, np.integer):
             raise TypeError(f"key column {key_column} must be integer")
         kd = host_to_device(scanner.engine, keys.astype(np.int32), dev)
         vd = host_to_device(scanner.engine, vals, dev)
+        mask = None
+        if where is not None:
+            cols = {key_column: kd, value_column: vd}
+            for c in where_columns:
+                if c not in cols:
+                    cols[c] = host_to_device(
+                        scanner.engine,
+                        tbl.column(c).to_numpy(zero_copy_only=False), dev)
+            mask = where(cols)
         part = groupby_aggregate(
             kd, vd, num_groups,
             aggs=tuple(sorted((set(aggs) | {"count", "sum"}) - {"mean"})),
-            method=method)
+            method=method, mask=mask, empty_as_nan=False)  # keep foldable
         folds = part if folds is None else _fold(folds, part)
 
     if folds is None:
@@ -120,10 +160,11 @@ def sql_groupby(scanner, key_column: str, value_column: str,
         cf = count.astype(jnp.float32)
         mean = folds["sum"] / jnp.maximum(cf, 1.0)
         out["mean"] = jnp.where(cf > 0, mean, jnp.nan)
+    empty = count == 0
     if "min" in aggs:
-        out["min"] = folds["min"]
+        out["min"] = jnp.where(empty, jnp.nan, folds["min"])
     if "max" in aggs:
-        out["max"] = folds["max"]
+        out["max"] = jnp.where(empty, jnp.nan, folds["max"])
     return out
 
 

@@ -147,3 +147,98 @@ def test_groupby_bad_args():
         groupby_aggregate(keys, vals, 2, aggs=("median",))
     with pytest.raises(ValueError):
         groupby_aggregate(keys, vals, 2, method="magic")
+
+
+def test_groupby_where_pushdown(tmp_path):
+    """WHERE filter runs on device; masked rows never aggregate."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import jax.numpy as jnp
+    from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.sql.parquet import ParquetScanner
+    from nvme_strom_tpu.sql.groupby import groupby_aggregate, sql_groupby
+
+    rng = np.random.default_rng(3)
+    n, G = 4096, 8
+    keys = rng.integers(0, G, n).astype(np.int32)
+    vals = rng.standard_normal(n).astype(np.float32)
+    path = tmp_path / "t.parquet"
+    pq.write_table(pa.table({"k": keys, "v": vals}), path,
+                   row_group_size=1000)
+
+    keep = vals > 0.25
+    want_count = np.bincount(keys[keep], minlength=G)
+    want_sum = np.bincount(keys[keep], weights=vals[keep], minlength=G)
+
+    with StromEngine() as eng:
+        out = sql_groupby(ParquetScanner(path, eng), "k", "v", G,
+                          aggs=("count", "sum", "min", "max"),
+                          where=lambda c: c["v"] > 0.25)
+    np.testing.assert_array_equal(np.asarray(out["count"]), want_count)
+    np.testing.assert_allclose(np.asarray(out["sum"]), want_sum,
+                               rtol=1e-4, atol=1e-4)
+    for g in range(G):
+        sel = vals[keep][keys[keep] == g]
+        if len(sel):
+            assert np.asarray(out["min"])[g] == pytest.approx(sel.min())
+            assert np.asarray(out["max"])[g] == pytest.approx(sel.max())
+
+    # mask + scatter method parity at the kernel level
+    a = groupby_aggregate(jnp.asarray(keys), jnp.asarray(vals), G,
+                          aggs=("count", "sum"), method="scatter",
+                          mask=jnp.asarray(keep))
+    np.testing.assert_array_equal(np.asarray(a["count"]), want_count)
+
+
+def test_prefetch_to_device_order_and_depth():
+    from nvme_strom_tpu.data.prefetch import prefetch_to_device
+
+    pulled = []
+
+    def gen():
+        for i in range(6):
+            pulled.append(i)
+            yield i
+
+    it = prefetch_to_device(gen(), size=2)
+    first = next(it)
+    assert first == 0
+    assert pulled == [0, 1, 2]      # two ahead of the consumer
+    assert list(it) == [1, 2, 3, 4, 5]
+    assert list(prefetch_to_device(iter([]), size=3)) == []
+
+
+def test_groupby_empty_groups_are_nan(tmp_path):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.sql.parquet import ParquetScanner
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+
+    keys = np.array([0, 0, 2], np.int32)     # group 1, 3 empty
+    vals = np.array([1.0, -5.0, 2.0], np.float32)
+    path = tmp_path / "e.parquet"
+    pq.write_table(pa.table({"k": keys, "v": vals}), path)
+    with StromEngine() as eng:
+        out = sql_groupby(ParquetScanner(path, eng), "k", "v", 4,
+                          aggs=("count", "min", "max", "mean"),
+                          where=lambda c: c["v"] > 0)  # drops the -5 row
+    count = np.asarray(out["count"])
+    np.testing.assert_array_equal(count, [1, 0, 1, 0])
+    for agg in ("min", "max", "mean"):
+        a = np.asarray(out[agg])
+        assert np.isnan(a[[1, 3]]).all(), (agg, a)
+        assert np.isfinite(a[[0, 2]]).all(), (agg, a)
+
+
+def test_prefetch_device_put():
+    import jax
+    import numpy as np
+    from nvme_strom_tpu.data.prefetch import prefetch_to_device
+
+    dev = jax.devices()[0]
+    out = list(prefetch_to_device(
+        [{"x": np.ones(3)}, {"x": np.zeros(3)}], size=2, device=dev))
+    assert all(isinstance(b["x"], jax.Array) for b in out)
