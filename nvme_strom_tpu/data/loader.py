@@ -55,12 +55,11 @@ class ShardedLoader:
         For tfrecord, parts is {"": payload}.
       engine: shared StromEngine (one is created if omitted).
       exts: for wds, restrict to these extensions.
-      seq_axis: also shard dim 1 (sequence) of each rank>=2 batch leaf
-        over this mesh axis — the input layout for ring/Ulysses sequence
-        parallelism.  Every rank>=2 leaf must then have dim 1 divisible
-        by the axis size (rank-1 leaves stay batch-sharded); mixed
-        batches with non-sequence 2D leaves are rejected with a clear
-        error rather than silently mis-sharded.
+      seq_axis: also shard dim 1 of every RANK-2 batch leaf — (batch,
+        seq) token arrays — over this mesh axis: the input layout for
+        ring/Ulysses sequence parallelism.  Leaves of any other rank
+        (per-sample scalars, images, ...) keep the batch-only sharding;
+        a rank-2 leaf whose dim 1 the axis cannot divide raises.
     """
 
     def __init__(self, shard_paths: Sequence, mesh, global_batch: int,
@@ -226,14 +225,15 @@ class ShardedLoader:
                     lambda x: (self.global_batch,) + x.shape[1:])
                 def put(x):
                     sh = sharding
-                    if self.seq_axis is not None and x.ndim >= 2:
+                    # exactly rank 2 == (batch, seq): images and other
+                    # higher-rank leaves are NOT sequences — batch-only
+                    if self.seq_axis is not None and x.ndim == 2:
                         n_sp = self.mesh.shape[self.seq_axis]
                         if x.shape[1] % n_sp:
                             raise ValueError(
                                 f"seq_axis={self.seq_axis!r} (size "
                                 f"{n_sp}) cannot shard batch leaf of "
-                                f"shape {x.shape}: dim 1 not divisible "
-                                "— non-sequence leaves must be rank 1")
+                                f"shape {x.shape}: dim 1 not divisible")
                         sh = seq_sharding
                     return jax.make_array_from_process_local_data(
                         sh, x, global_shape_of(x))

@@ -19,11 +19,18 @@ def prefetch_to_device(batches: Iterable, size: int = 2,
                        device=None) -> Iterator:
     """Yield from ``batches`` while keeping ``size`` items pulled ahead,
     optionally device_put-ing each batch's non-Array leaves to
-    ``device``."""
+    ``device``.  Validates eagerly (plain function returning a
+    generator); closing the returned generator closes the wrapped
+    iterator too, so upstream producer threads wind down deterministically
+    (examples/train_lm.py relies on this before engine teardown)."""
     if size < 1:
         raise ValueError("size must be >= 1")
+    it = iter(batches)
+    return _prefetch_gen(it, size, device)
 
-    def pull(it):
+
+def _prefetch_gen(it, size: int, device) -> Iterator:
+    def pull():
         b = next(it)
         if device is None:
             return b
@@ -33,16 +40,20 @@ def prefetch_to_device(batches: Iterable, size: int = 2,
             else jax.device_put(x, device), b)
 
     buf: collections.deque = collections.deque()
-    it = iter(batches)
     try:
-        for _ in range(size):
-            buf.append(pull(it))
-    except StopIteration:
-        pass
-    while buf:
-        out = buf.popleft()
         try:
-            buf.append(pull(it))
+            for _ in range(size):
+                buf.append(pull())
         except StopIteration:
             pass
-        yield out
+        while buf:
+            out = buf.popleft()
+            try:
+                buf.append(pull())
+            except StopIteration:
+                pass
+            yield out
+    finally:
+        close = getattr(it, "close", None)
+        if close is not None:
+            close()

@@ -1,0 +1,195 @@
+"""Vision transformer — the image-side consumer of the data path.
+
+BASELINE.json's headline config is "ImageNet-1k WebDataset shards →
+v5p-8 infeed dataloader"; this model family closes that loop: WDS image
+shards stream through the strom-io engine (data/loader.py) into a ViT
+classifier training SPMD over a dp×tp mesh.  The reference itself has no
+models (SURVEY.md §1) — its consumer PG-Strom plays this role on GPU.
+
+TPU-first choices mirror models/transformer.py: bf16 activations, einsum
+patchify (a reshape + one matmul the MXU eats — no im2col, no conv
+lowering surprises), static shapes, pre-LN encoder blocks, optional
+per-layer remat.  Params are a flat {name: array} dict in the same
+namespace convention, so the safetensors lazy loader and the checkpoint
+manager work unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nvme_strom_tpu.models.transformer import dense_init
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    d_model: int = 384
+    n_layers: int = 6
+    n_heads: int = 6
+    d_ff: int = 1536
+    n_classes: int = 1000
+    norm_eps: float = 1e-6
+    dtype: object = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def n_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * self.channels
+
+
+def tiny_vit_config() -> ViTConfig:
+    return ViTConfig(image_size=16, patch_size=4, channels=3, d_model=32,
+                     n_layers=2, n_heads=4, d_ff=64, n_classes=10)
+
+
+def init_vit_params(rng: jax.Array, cfg: ViTConfig) -> Dict:
+    keys = iter(jax.random.split(rng, 3 + 6 * cfg.n_layers))
+    dm, ff = cfg.d_model, cfg.d_ff
+    p = {
+        "patch_embed": dense_init(next(keys), cfg.patch_dim,
+                                  (cfg.patch_dim, dm)),
+        "pos_embed": 0.02 * jax.random.normal(
+            next(keys), (cfg.n_patches + 1, dm), jnp.float32),
+        "cls_token": jnp.zeros((dm,), jnp.float32),
+        "final_norm": jnp.ones((dm,), jnp.float32),
+        "final_bias": jnp.zeros((dm,), jnp.float32),
+        "head": dense_init(next(keys), dm, (dm, cfg.n_classes)),
+    }
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        p[L + "attn_norm"] = jnp.ones((dm,), jnp.float32)
+        p[L + "attn_bias"] = jnp.zeros((dm,), jnp.float32)
+        p[L + "wq"] = dense_init(next(keys), dm, (dm, dm))
+        p[L + "wk"] = dense_init(next(keys), dm, (dm, dm))
+        p[L + "wv"] = dense_init(next(keys), dm, (dm, dm))
+        p[L + "wo"] = dense_init(next(keys), dm, (dm, dm))
+        p[L + "mlp_norm"] = jnp.ones((dm,), jnp.float32)
+        p[L + "mlp_bias"] = jnp.zeros((dm,), jnp.float32)
+        p[L + "w_up"] = dense_init(next(keys), dm, (dm, ff))
+        p[L + "w_down"] = dense_init(next(keys), ff, (ff, dm))
+    return p
+
+
+def layer_norm(x, weight, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * weight + bias).astype(x.dtype)
+
+
+def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
+    """(b, H, W, C) → (b, n_patches, p²·C) — pure reshape/transpose, so
+    the patch embedding is ONE big matmul instead of a convolution."""
+    b = images.shape[0]
+    s, p = cfg.image_size, cfg.patch_size
+    n = s // p
+    x = images.reshape(b, n, p, n, p, cfg.channels)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, n * n, cfg.patch_dim)
+
+
+def _attention(x, p, L, cfg):
+    b, s, _ = x.shape
+    hd, nh = cfg.head_dim, cfg.n_heads
+    q = (x @ p[L + "wq"].astype(x.dtype)).reshape(b, s, nh, hd)
+    k = (x @ p[L + "wk"].astype(x.dtype)).reshape(b, s, nh, hd)
+    v = (x @ p[L + "wv"].astype(x.dtype)).reshape(b, s, nh, hd)
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(scores / np.sqrt(hd), axis=-1).astype(x.dtype)
+    o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    o = o.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
+    return o @ p[L + "wo"].astype(x.dtype)
+
+
+def vit_forward(params: Dict, images: jax.Array,
+                cfg: ViTConfig) -> jax.Array:
+    """images (b, H, W, C) any real dtype → logits (b, n_classes) f32."""
+    x = patchify(images.astype(cfg.dtype), cfg)
+    x = x @ params["patch_embed"].astype(cfg.dtype)
+    cls = jnp.broadcast_to(params["cls_token"].astype(cfg.dtype),
+                           (x.shape[0], 1, cfg.d_model))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"].astype(cfg.dtype)[None]
+
+    def one_layer(x, i):
+        L = f"layers.{i}."
+        h = layer_norm(x, params[L + "attn_norm"], params[L + "attn_bias"],
+                       cfg.norm_eps)
+        x = x + _attention(h, params, L, cfg)
+        h = layer_norm(x, params[L + "mlp_norm"], params[L + "mlp_bias"],
+                       cfg.norm_eps)
+        h = jax.nn.gelu(h @ params[L + "w_up"].astype(h.dtype))
+        return (x + h @ params[L + "w_down"].astype(h.dtype)).astype(
+            cfg.dtype), None
+
+    if cfg.remat:
+        one_layer = jax.checkpoint(one_layer, static_argnums=(1,))
+    for i in range(cfg.n_layers):
+        x, _ = one_layer(x, i)
+    x = layer_norm(x[:, 0], params["final_norm"], params["final_bias"],
+                   cfg.norm_eps)
+    return (x @ params["head"].astype(x.dtype)).astype(jnp.float32)
+
+
+def vit_loss(params, images, labels, cfg) -> jax.Array:
+    logits = vit_forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def vit_param_specs(cfg: ViTConfig) -> Dict:
+    """Megatron tp sharding, same scheme as the LM (shardings.py)."""
+    from jax.sharding import PartitionSpec as P
+    specs = {"patch_embed": P(None, "tp"), "pos_embed": P(),
+             "cls_token": P(), "final_norm": P(), "final_bias": P(),
+             "head": P(None, "tp")}
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        specs.update({
+            L + "attn_norm": P(), L + "attn_bias": P(),
+            L + "wq": P(None, "tp"), L + "wk": P(None, "tp"),
+            L + "wv": P(None, "tp"), L + "wo": P("tp", None),
+            L + "mlp_norm": P(), L + "mlp_bias": P(),
+            L + "w_up": P(None, "tp"), L + "w_down": P("tp", None),
+        })
+    return specs
+
+
+def vit_param_shardings(cfg: ViTConfig, mesh) -> Dict:
+    from jax.sharding import NamedSharding
+    from nvme_strom_tpu.parallel.shardings import prune_spec
+    return {k: NamedSharding(mesh, prune_spec(s, mesh))
+            for k, s in vit_param_specs(cfg).items()}
+
+
+def make_vit_train_step(cfg: ViTConfig, optimizer):
+    """step(params, opt_state, images, labels) -> (params, opt_state,
+    loss); jit/shard at the call site."""
+    import optax
+
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: vit_loss(p, images, labels, cfg))(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -242,3 +242,23 @@ def test_prefetch_device_put():
     out = list(prefetch_to_device(
         [{"x": np.ones(3)}, {"x": np.zeros(3)}], size=2, device=dev))
     assert all(isinstance(b["x"], jax.Array) for b in out)
+
+
+def test_prefetch_validates_eagerly_and_closes():
+    from nvme_strom_tpu.data.prefetch import prefetch_to_device
+
+    with pytest.raises(ValueError, match="size"):
+        prefetch_to_device(iter([]), size=0)   # raises at call, not next()
+
+    closed = []
+
+    def gen():
+        try:
+            yield from range(5)
+        finally:
+            closed.append(True)
+
+    it = prefetch_to_device(gen(), size=2)
+    assert next(it) == 0
+    it.close()
+    assert closed == [True]   # wrapped generator closed deterministically
