@@ -189,12 +189,15 @@ def main() -> int:
     ceiling = min(raw, link) if raw > 0 and link > 0 else max(raw, link, 1.0)
     target = 0.9 * ceiling
     dev_tag = "tpu" if device_ok else "cpu-fallback-TUNNEL-DOWN"
+    # vs_baseline is only meaningful against the BASELINE.json north star
+    # (NVMe->HBM on a real TPU).  On CPU fallback raw/link are CPU-derived
+    # numbers and any ratio would misread as "target met" — emit null.
     print(json.dumps({
         "metric": f"NVMe->HBM sustained streaming (dev={dev_tag}, "
                   f"bounce_bytes={bounce})",
         "value": round(hbm, 3),
         "unit": "GiB/s",
-        "vs_baseline": round(hbm / target, 3),
+        "vs_baseline": round(hbm / target, 3) if device_ok else None,
     }), flush=True)
     try:
         os.unlink(path)

@@ -34,6 +34,38 @@ from nvme_strom_tpu.utils.config import EngineConfig, LoaderConfig
 _SENTINEL = object()
 
 
+def _process_span(sharding, global_shape, dim: int, proc: int):
+    """Contiguous [lo, hi) index range this process's addressable devices
+    cover along ``dim`` of the global array.
+
+    The sp mesh axis may span processes (multi-host long context); each
+    process must then hand make_array_from_process_local_data only its
+    own sequence slice.  Raises if the process's shards are
+    non-contiguous along ``dim`` (an sp axis interleaved across hosts —
+    a mesh layout the loader does not support)."""
+    spans = set()
+    size = global_shape[dim]
+    for d, idx in sharding.devices_indices_map(tuple(global_shape)).items():
+        if d.process_index != proc:
+            continue
+        sl = idx[dim]
+        spans.add((sl.start or 0,
+                   size if sl.stop is None else sl.stop))
+    lo = min(s for s, _ in spans)
+    hi = max(e for _, e in spans)
+    covered = sorted(spans)
+    # contiguity: the union of spans must tile [lo, hi) without holes
+    reach = lo
+    for s, e in covered:
+        if s > reach:
+            raise ValueError(
+                f"process {proc} holds non-contiguous spans {covered} "
+                f"along dim {dim}; lay out the mesh so the seq axis is "
+                "contiguous per process")
+        reach = max(reach, e)
+    return lo, hi
+
+
 def _default_decode(parts: dict) -> np.ndarray:
     """Single-part raw samples → uint8 array (copy: counted by caller)."""
     if len(parts) != 1:
@@ -235,6 +267,15 @@ class ShardedLoader:
                                 f"{n_sp}) cannot shard batch leaf of "
                                 f"shape {x.shape}: dim 1 not divisible")
                         sh = seq_sharding
+                        # Multi-host sp: each process generated the FULL
+                        # sequence locally, but make_array_from_process_
+                        # local_data wants only this process's addressable
+                        # span along dim 1 — slice it out.
+                        lo, hi = _process_span(
+                            sh, global_shape_of(x), dim=1,
+                            proc=jax.process_index())
+                        if (hi - lo) != x.shape[1]:
+                            x = x[:, lo:hi]
                     return jax.make_array_from_process_local_data(
                         sh, x, global_shape_of(x))
                 yield jax.tree.map(put, hb)

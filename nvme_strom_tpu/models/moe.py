@@ -16,6 +16,15 @@ Per-token cost is O(k/E) of a dense MLP of the same total width, at the
 price of a fixed per-expert capacity: tokens routed beyond an expert's
 capacity are dropped (contribute zero for that slot), the standard
 static-shape trade XLA needs.
+
+Scalability: dispatch is *grouped* (GShard §3.2 pattern).  Tokens are
+reshaped to (G, S) along the batch-major dim and routed per group with a
+per-group capacity C = ceil(k·S/E·factor), so the dispatch/combine
+tensors are (G, S, E, C) — O(T·k·S·factor) elements, linear in the total
+token count T for a fixed group size S.  The ungrouped form is O(k·T²)
+and melts HBM at flagship scale (round-1 advisor finding, ADVICE.md).
+Groups follow the dp/batch sharding, so routing is local to each dp
+shard and only the expert einsums cross the ep axis.
 """
 
 from __future__ import annotations
@@ -69,6 +78,15 @@ def expert_capacity(n_tokens: int, n_experts: int, top_k: int,
     return max(1, math.ceil(n_tokens * top_k / n_experts * capacity_factor))
 
 
+def moe_group_size(cfg, n_tokens: int, seq: int) -> int:
+    """Routing-group size: cfg.moe_group_size if set and it divides the
+    token count, else one batch row (the dp-local GShard default)."""
+    gs = getattr(cfg, "moe_group_size", 0) or seq
+    if n_tokens % gs:
+        gs = seq                      # batch rows always divide b*s
+    return gs
+
+
 def moe_mlp(x: jax.Array, p: dict, prefix: str, cfg) -> tuple:
     """MoE SwiGLU MLP block.  x (b, s, d) → (out (b, s, d), aux_loss).
 
@@ -77,26 +95,37 @@ def moe_mlp(x: jax.Array, p: dict, prefix: str, cfg) -> tuple:
       {prefix}moe_w_gate (E, d, ff)
       {prefix}moe_w_up   (E, d, ff)
       {prefix}moe_w_down (E, ff, d)
+
+    Routing is per group of S tokens (see module docstring): capacity
+    binds within each group, aux loss is the mean over groups.
     """
     b, s, d = x.shape
     T = b * s
     E, k = cfg.n_experts, cfg.expert_top_k
-    C = expert_capacity(T, E, k, cfg.capacity_factor)
-    xt = x.reshape(T, d)
+    S = moe_group_size(cfg, T, s)
+    G = T // S
+    C = expert_capacity(S, E, k, cfg.capacity_factor)
+    xg = x.reshape(G, S, d)
 
-    logits = (xt.astype(jnp.float32)
-              @ p[prefix + "router"].astype(jnp.float32))        # (T, E)
-    probs = jax.nn.softmax(logits, axis=-1)
-    dispatch, combine, aux = moe_dispatch_combine(probs, k, C)
+    logits = jnp.einsum("gsd,de->gse", xg.astype(jnp.float32),
+                        p[prefix + "router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                       # (G, S, E)
+    dispatch, combine, aux = jax.vmap(
+        lambda pr: moe_dispatch_combine(pr, k, C))(probs)
+    aux = aux.mean()
 
-    xd = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)  # (E, C, d)
+    # (G,S,E,C)·(G,S,d) → (E,G,C,d): experts see G·C slots regardless of
+    # where the group boundary fell; G rides the dp sharding of x.
+    xd = jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), xg)
+    xd = xd.reshape(E, G * C, d)
     gate = jax.nn.silu(jnp.einsum(
         "ecd,edf->ecf", xd, p[prefix + "moe_w_gate"].astype(x.dtype)))
     up = jnp.einsum("ecd,edf->ecf", xd,
                     p[prefix + "moe_w_up"].astype(x.dtype))
     h = jnp.einsum("ecf,efd->ecd", gate * up,
-                   p[prefix + "moe_w_down"].astype(x.dtype))      # (E, C, d)
-    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), h)
+                   p[prefix + "moe_w_down"].astype(x.dtype))
+    h = h.reshape(E, G, C, d)
+    out = jnp.einsum("gsec,egcd->gsd", combine.astype(x.dtype), h)
     return out.reshape(b, s, d), aux
 
 

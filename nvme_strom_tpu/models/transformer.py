@@ -40,6 +40,9 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     router_aux_coef: float = 0.01
     moe_every: int = 2            # layer i is MoE iff i % moe_every == rem
+    # Routing-group size (tokens per GShard group; 0 = one batch row).
+    # Dispatch memory is O(T·k·group·factor) — linear in total tokens.
+    moe_group_size: int = 0
     # Rematerialize each layer in backward (jax.checkpoint): trades one
     # extra forward's FLOPs for O(1)-layers activation memory — the HBM
     # lever for deep configs.

@@ -241,6 +241,11 @@ def make_pp_forward(cfg: TransformerConfig, mesh, n_microbatches: int,
 
     def fwd(stack: Dict, rest: Dict, tokens: jax.Array) -> jax.Array:
         B, s = tokens.shape
+        # Validate against the *actual* sequence, not cfg.max_seq — a
+        # caller with s != max_seq would otherwise pass the constructor
+        # check and die inside shard_map with an opaque partition error.
+        if sp_size > 1 and s % sp_size:
+            raise ValueError(f"seq {s} not divisible by sp={sp_size}")
         if B % n_microbatches:
             raise ValueError(f"batch {B} not divisible into "
                              f"{n_microbatches} microbatches")

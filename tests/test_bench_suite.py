@@ -28,6 +28,8 @@ def test_suite_all_configs(tmp_path):
         assert rec["metric"].startswith(f"config{i}:")
         assert rec["value"] > 0
         assert rec["unit"] == "GiB/s"
-        assert rec["vs_baseline"] > 0
+        # CPU-pinned run: vs_baseline must be null (the north star is
+        # only measurable on a real TPU — round-1 verdict honesty fix)
+        assert rec["vs_baseline"] is None
     # scratch data landed in the requested dir, not the repo
     assert (tmp_path / ".bench_suite").is_dir()

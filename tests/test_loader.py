@@ -185,3 +185,23 @@ def test_loader_seq_sharded_batches(tmp_path):
     with pytest.raises(ValueError, match="no 'sp'"):
         ShardedLoader(paths, Mesh(np.array(devs[:2]).reshape(2), ("dp",)),
                       global_batch=4, fmt="wds", seq_axis="sp")
+
+
+def test_process_span_single_host_full_extent():
+    """Single-process: every sharding covers the full seq extent, and the
+    contiguity check accepts it (multi-host slicing is a no-op here)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from nvme_strom_tpu.data.loader import _process_span
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip("needs 4 devices")
+    mesh = Mesh(np.array(devs[:4]).reshape(2, 2), ("dp", "sp"))
+    sh = NamedSharding(mesh, P("dp", "sp"))
+    lo, hi = _process_span(sh, (4, 64), dim=1, proc=jax.process_index())
+    assert (lo, hi) == (0, 64)
+    # batch dim too
+    lo, hi = _process_span(sh, (4, 64), dim=0, proc=jax.process_index())
+    assert (lo, hi) == (0, 4)

@@ -60,6 +60,41 @@ def test_single_expert_equals_dense_mlp():
                                atol=5e-2, rtol=5e-2)  # bf16 einsum order
 
 
+def test_grouped_dispatch_memory_linear_in_tokens():
+    """Dispatch tensor elements grow linearly with T, not O(T²): doubling
+    the batch doubles (not quadruples) the largest routing intermediate."""
+    from nvme_strom_tpu.models.moe import moe_group_size
+
+    cfg = tiny_moe_config()
+
+    def dispatch_elems(b):
+        T, s = b * cfg.max_seq, cfg.max_seq
+        S = moe_group_size(cfg, T, s)
+        C = expert_capacity(S, cfg.n_experts, cfg.expert_top_k,
+                            cfg.capacity_factor)
+        return (T // S) * S * cfg.n_experts * C
+
+    e1, e2, e4 = dispatch_elems(1), dispatch_elems(2), dispatch_elems(4)
+    assert e2 == 2 * e1 and e4 == 4 * e1
+
+
+def test_grouped_matches_global_with_ample_capacity():
+    """With capacity that never binds, routing per group == routing the
+    whole batch at once (grouping only changes where capacity binds)."""
+    cfg0 = tiny_moe_config()
+    big = type(cfg0)(**{**cfg0.__dict__, "capacity_factor": 4.0,
+                       "moe_every": 1})
+    params = init_params(jax.random.key(5), big)
+    x = jax.random.normal(jax.random.key(6), (4, 8, big.d_model), big.dtype)
+    L = "layers.0."
+    out_rows, _ = moe_mlp(x, params, L, big)                 # S = 8, G = 4
+    whole = type(cfg0)(**{**big.__dict__, "moe_group_size": 32})
+    out_glob, _ = moe_mlp(x, params, L, whole)               # S = 32, G = 1
+    np.testing.assert_allclose(np.asarray(out_rows, np.float32),
+                               np.asarray(out_glob, np.float32),
+                               atol=5e-2, rtol=5e-2)
+
+
 def test_moe_train_step_runs_and_learns():
     import optax
 
