@@ -255,8 +255,10 @@ class ShardedLoader:
                     break
                 global_shape_of = (
                     lambda x: (self.global_batch,) + x.shape[1:])
+                span_cache: dict = {}
                 def put(x):
                     sh = sharding
+                    gshape = global_shape_of(x)
                     # exactly rank 2 == (batch, seq): images and other
                     # higher-rank leaves are NOT sequences — batch-only
                     if self.seq_axis is not None and x.ndim == 2:
@@ -270,14 +272,19 @@ class ShardedLoader:
                         # Multi-host sp: each process generated the FULL
                         # sequence locally, but make_array_from_process_
                         # local_data wants only this process's addressable
-                        # span along dim 1 — slice it out.
-                        lo, hi = _process_span(
-                            sh, global_shape_of(x), dim=1,
-                            proc=jax.process_index())
+                        # span along dim 1 — slice it out.  The global
+                        # shape keeps the full extent; the span depends
+                        # only on (sharding, shape) so it is computed once
+                        # per leaf shape, not per batch.
+                        if gshape not in span_cache:
+                            span_cache[gshape] = _process_span(
+                                sh, gshape, dim=1,
+                                proc=jax.process_index())
+                        lo, hi = span_cache[gshape]
                         if (hi - lo) != x.shape[1]:
                             x = x[:, lo:hi]
                     return jax.make_array_from_process_local_data(
-                        sh, x, global_shape_of(x))
+                        sh, x, gshape)
                 yield jax.tree.map(put, hb)
         finally:
             # Abandoned iterator: unblock and stop the producer, then wait

@@ -79,11 +79,20 @@ def expert_capacity(n_tokens: int, n_experts: int, top_k: int,
 
 
 def moe_group_size(cfg, n_tokens: int, seq: int) -> int:
-    """Routing-group size: cfg.moe_group_size if set and it divides the
-    token count, else one batch row (the dp-local GShard default)."""
-    gs = getattr(cfg, "moe_group_size", 0) or seq
+    """Routing-group size.  Unset (0): one batch row (the dp-local GShard
+    default).  Explicit: must divide the token count — except when it
+    exceeds the whole batch (the decode / tiny-eval case), where a single
+    global group is the natural semantics.  A non-dividing explicit size
+    raises rather than silently changing drop behavior."""
+    gs = getattr(cfg, "moe_group_size", 0)
+    if not gs:
+        return seq                    # batch rows always divide b*s
+    if gs >= n_tokens:
+        return n_tokens
     if n_tokens % gs:
-        gs = seq                      # batch rows always divide b*s
+        raise ValueError(
+            f"moe_group_size={gs} does not divide token count "
+            f"{n_tokens}; pick a divisor or 0 (per-batch-row groups)")
     return gs
 
 
