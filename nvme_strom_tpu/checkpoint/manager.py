@@ -4,17 +4,21 @@ Layout of one checkpoint (``<dir>/step_00000100/``):
 
     state-00000.safetensors   tensors owned by process 0
     state-00001.safetensors   … one file per writing process …
-    meta.json                 step, process count, tensor→span index
+    meta.json                 step, process count, tensor→tile index
 
-Every process writes ONLY the row spans its addressable devices hold (the
-write-side mirror of the lazy loader's read-only-your-shard rule,
+Every process writes ONLY the shard tiles its addressable devices hold
+(the write-side mirror of the lazy loader's read-only-your-shard rule,
 parallel/weights.py): bulk checkpoint bytes never cross hosts, matching the
-reference's single-host DMA locality (SURVEY.md §5).  A tensor row-sharded
-over 8 hosts costs each host 1/8th of the write I/O.  Saves are atomic: the
-step directory is staged under a dotted temp name and renamed into place
+reference's single-host DMA locality (SURVEY.md §5).  A device's shard IS
+its tile — general N-d bounds in meta.json — so ANY sharding topology
+(3-axis tp×pp×sp splits, cross-host column sharding, partial replication)
+saves without host-side stitching, and restore reassembles arbitrary
+target regions from intersecting tiles, so a checkpoint written under one
+mesh restores under a different one.  Saves are atomic: the step
+directory is staged under a dotted temp name and renamed into place
 only after every payload byte is on disk, so a crashed save can never be
 mistaken for a checkpoint (the failure-recovery story SURVEY.md §5 asks
-for).  Restore places each span straight onto its devices with
+for).  Restore places each region straight onto its devices with
 ``jax.make_array_from_callback`` — no host-side global tensor is ever
 assembled.
 """
@@ -83,20 +87,38 @@ def unflatten_from_names(treedef, named: Dict[str, object], order):
 
 # --------------------------------------------------------------------------
 
-def _row_spans(arr) -> Dict[tuple, list]:
-    """Global row spans of a jax.Array: {(r0, r1): [devices]} (rows along
-    axis 0; scalars/0-d treated as one row)."""
+def _norm_index(idx, shape) -> tuple:
+    """Device index (tuple of slices) → concrete ((a0,b0), (a1,b1), …)
+    bounds over ``shape``.  Scalars normalize to ()."""
+    idx = tuple(idx)
+    out = []
+    for s, d in zip(idx, shape):
+        out.append((0 if s.start is None else int(s.start),
+                    d if s.stop is None else int(s.stop)))
+    # devices_indices_map may omit trailing fully-covered dims
+    for d in shape[len(idx):]:
+        out.append((0, d))
+    return tuple(out)
+
+
+def _tiles(arr) -> Dict[tuple, list]:
+    """Distinct shard tiles of a jax.Array: {bounds: [devices]} where
+    bounds is a per-dim (start, stop) tuple — ANY sharding topology
+    (row, column, 3-axis, partial-replication) reduces to its set of
+    distinct tiles, each written verbatim by one owning process."""
     shape = arr.shape
-    spans: Dict[tuple, list] = {}
+    tiles: Dict[tuple, list] = {}
     for dev, idx in arr.sharding.devices_indices_map(shape).items():
-        if not shape:
-            spans.setdefault((0, 1), []).append(dev)
-            continue
-        s0 = tuple(idx)[0] if idx else slice(None)
-        r0 = 0 if s0.start is None else int(s0.start)
-        r1 = shape[0] if s0.stop is None else int(s0.stop)
-        spans.setdefault((r0, r1), []).append(dev)
-    return spans
+        tiles.setdefault(_norm_index(idx, shape), []).append(dev)
+    return tiles
+
+
+def _tile_key(name: str, bounds: tuple, shape: tuple) -> str:
+    """Safetensors entry name for one tile; the untiled (full) tensor
+    keeps its plain name."""
+    if bounds == tuple((0, d) for d in shape):
+        return name
+    return name + "@t" + "x".join(f"{a}-{b}" for a, b in bounds)
 
 
 class CheckpointManager:
@@ -125,12 +147,15 @@ class CheckpointManager:
             m = _STEP_RE.match(name)
             if not m:
                 continue
-            # A step only counts if its meta.json parses — a torn write
-            # from a crashed save must not shadow older intact checkpoints.
+            # A step only counts if its meta.json parses AND its format
+            # is readable — a torn write from a crashed save must not
+            # shadow older intact checkpoints, and latest_step() must
+            # never steer restore() into a format it cannot read.
             try:
                 with open(os.path.join(self.directory, name,
                                        "meta.json")) as f:
-                    json.load(f)
+                    if json.load(f).get("format") != 2:
+                        continue
             except (OSError, json.JSONDecodeError):
                 continue
             steps.append(int(m.group(1)))
@@ -149,9 +174,9 @@ class CheckpointManager:
         """Write ``state`` as checkpoint ``step``; returns the final path.
 
         Each process writes its own ``state-{proc}.safetensors`` with the
-        row spans it owns (owner = lowest process index holding the span);
-        process 0 writes the span index.  The temp directory is renamed in
-        only when everything is durable.
+        shard tiles it owns (owner = lowest process index holding the
+        tile); process 0 writes the tile index in meta.json.  The temp
+        directory is renamed in only when everything is durable.
         """
         import jax
 
@@ -171,26 +196,24 @@ class CheckpointManager:
 
         named, _ = flatten_with_names(state)
         mine: Dict[str, np.ndarray] = {}   # entries this process writes
-        index: Dict[str, dict] = {}        # global span index (proc 0 view)
+        index: Dict[str, dict] = {}        # global tile index (proc 0 view)
         for name, leaf in named.items():
             if leaf is None:
                 continue
-            spans = self._leaf_spans(leaf)
+            tiles = self._leaf_tiles(leaf)
             dt = (leaf.dtype if hasattr(leaf, "dtype")
                   else np.asarray(leaf).dtype)
             entry = {"shape": list(np.shape(leaf)),
                      "dtype": str(dt),
                      "scalar": not isinstance(
                          leaf, (jax.Array, np.ndarray)),
-                     "spans": []}
-            for (r0, r1), owner, local in spans:
+                     "tiles": []}
+            for bounds, owner, local in tiles:
                 fname = f"state-{owner:05d}.safetensors"
-                entry["spans"].append(
-                    {"file": fname, "r0": r0, "r1": r1})
+                entry["tiles"].append(
+                    {"file": fname, "idx": [list(b) for b in bounds]})
                 if owner == proc and local is not None:
-                    key = name if (r0, r1) == self._full_span(leaf) \
-                        else f"{name}@r{r0}-{r1}"
-                    mine[key] = local
+                    mine[_tile_key(name, bounds, np.shape(leaf))] = local
             index[name] = entry
 
         eng, own = self._get_engine()
@@ -203,7 +226,7 @@ class CheckpointManager:
                 eng.close_all()
 
         if proc == 0:
-            meta = {"format": 1, "step": step, "time": time.time(),
+            meta = {"format": 2, "step": step, "time": time.time(),
                     "process_count": jax.process_count(), "tensors": index}
             with open(os.path.join(tmp, "meta.json"), "w") as f:
                 json.dump(meta, f)
@@ -225,87 +248,39 @@ class CheckpointManager:
                 shutil.rmtree(self.step_dir(old), ignore_errors=True)
         return final
 
-    @staticmethod
-    def _full_span(leaf) -> tuple:
-        shape = np.shape(leaf)
-        return (0, shape[0]) if shape else (0, 1)
+    def _leaf_tiles(self, leaf):
+        """→ [(bounds, owner_proc, local_data_or_None), ...].
 
-    def _leaf_spans(self, leaf):
-        """→ [((r0, r1), owner_proc, local_data_or_None), ...].
-
-        For non-jax leaves and single-process runs this is one full span
-        owned by process 0.  ``local_data`` is None when another process
-        owns the span (its bytes are not addressable here).
+        One entry per distinct shard tile; a device's shard IS its tile,
+        so no host-side stitching is ever needed and every sharding
+        topology (any axis count, partial replication, cross-host column
+        splits) saves the same way.  Owner = lowest process index holding
+        the tile; ``local_data`` is None when another process owns it.
+        For non-jax leaves and single-process runs this is one full tile
+        owned by process 0.
         """
         import jax
 
         if not isinstance(leaf, jax.Array):
             arr = np.asarray(leaf)
-            return [(self._full_span(leaf), 0, arr)]
-        spans = _row_spans(leaf)
-        out = []
+            bounds = tuple((0, d) for d in arr.shape)
+            return [(bounds, 0, arr)]
         shape = leaf.shape
-        for (r0, r1), devs in sorted(spans.items()):
-            owner = min(d.process_index for d in devs)
-            local = None
-            if owner == jax.process_index():
-                local = self._gather_span(leaf, r0, r1, shape)
-            out.append(((r0, r1), owner, local))
-        return out
-
-    @staticmethod
-    def _gather_span(leaf, r0, r1, shape):
-        """Host np array for rows [r0, r1) from addressable shards."""
-        import jax
-
-        if not shape:
-            return np.asarray(jax.device_get(
-                list(leaf.addressable_shards)[0].data)).reshape(())
-        # Collect shards intersecting the span; verify full column coverage.
-        pieces = {}
+        local = {}
         for shard in leaf.addressable_shards:
-            idx = tuple(shard.index)
-            s0 = idx[0] if idx else slice(None)
-            a = 0 if s0.start is None else int(s0.start)
-            b = shape[0] if s0.stop is None else int(s0.stop)
-            if (a, b) != (r0, r1):
-                continue
-            tail = tuple(
-                (0 if s.start is None else int(s.start),
-                 d if s.stop is None else int(s.stop))
-                for s, d in zip(idx[1:], shape[1:]))
-            pieces[tail] = shard.data
-        if not pieces:
-            raise ValueError("span owner holds no addressable shard "
-                             f"for rows [{r0},{r1})")
-        full_tail = tuple((0, d) for d in shape[1:])
-        if full_tail in pieces or not shape[1:]:
-            return np.asarray(jax.device_get(
-                pieces.get(full_tail, next(iter(pieces.values())))))
-        # Column-sharded span: stitch the column groups host-side (only
-        # happens when the owner process addresses all column pieces, and
-        # only axis 1 may be partial — deeper-axis sharding is resharded
-        # before saving).
-        for tail in pieces:
-            for (c0, c1), d in zip(tail[1:], shape[2:]):
-                if (c0, c1) != (0, d):
-                    raise NotImplementedError(
-                        f"tensor sharded on axis >= 2 ({tail}); reshard "
-                        "before saving")
-        cols = sorted(pieces.items())
-        want = 0
-        for tail, _ in cols:
-            if tail[0][0] != want:
-                raise NotImplementedError(
-                    "cross-host column-sharded tensor: owner does not "
-                    "address all column pieces; reshard before saving")
-            want = tail[0][1]
-        if want != shape[1]:
-            raise NotImplementedError(
-                "cross-host column-sharded tensor: columns under-covered; "
-                "reshard before saving")
-        return np.concatenate(
-            [np.asarray(jax.device_get(v)) for _, v in cols], axis=1)
+            local[_norm_index(shard.index, shape)] = shard.data
+        out = []
+        for bounds, devs in sorted(_tiles(leaf).items()):
+            owner = min(d.process_index for d in devs)
+            data = None
+            if owner == jax.process_index():
+                if bounds not in local:
+                    raise ValueError(
+                        f"tile owner holds no addressable shard for "
+                        f"{bounds}")
+                data = np.asarray(jax.device_get(local[bounds]))
+            out.append((bounds, owner, data))
+        return out
 
     # -- restore -----------------------------------------------------------
 
@@ -325,6 +300,11 @@ class CheckpointManager:
         d = self.step_dir(step)
         with open(os.path.join(d, "meta.json")) as f:
             meta = json.load(f)
+        if meta.get("format") != 2:
+            raise ValueError(
+                f"checkpoint format {meta.get('format')} unsupported "
+                "(this reader is format 2, the general tile index; "
+                "re-save from the run that wrote it)")
 
         named_t, treedef = flatten_with_names(target)
         files: Dict[str, SafetensorsFile] = {}
@@ -365,47 +345,30 @@ class CheckpointManager:
                 and hasattr(tleaf, "sharding"):
             sh = tleaf.sharding
 
-        read_rows = self._make_row_reader(eng, cdir, files, name, info,
-                                          shape, np_dt)
+        read_region = self._make_region_reader(eng, cdir, files, name,
+                                               info, shape, np_dt)
         if info.get("scalar"):
-            val = read_rows(0, 1).reshape(())[()]
+            val = read_region(()).reshape(())[()]
             if isinstance(tleaf, np.ndarray):
                 return np.asarray(val, dtype=tleaf.dtype).reshape(())
             if isinstance(tleaf, jax.Array):
                 return jnp.asarray(val, dtype=tleaf.dtype)
             return type(tleaf)(val)  # python int/float/bool, np scalars
         if sh is None:
-            host = read_rows(0, shape[0] if shape else 1)
-            host = host.reshape(shape)
+            host = read_region(tuple((0, d) for d in shape))
             if isinstance(tleaf, np.ndarray):
                 return host.astype(tleaf.dtype, copy=False)
             return jnp.asarray(host, dtype=getattr(tleaf, "dtype", None))
 
-        row_cache: Dict = {}  # keyed by row span only: a P(None, 'tp')
-        # weight is read ONCE and column-sliced per device, not re-read
-        # from NVMe once per column group.
+        region_cache: Dict = {}  # partially-replicated shardings ask for
+        # the same region once per replica: read/assemble it ONCE.
 
         def cb(index):
-            if not shape:
-                got = row_cache.get(())
-                if got is None:
-                    got = row_cache[()] = read_rows(0, 1).reshape(())
-                return got
-            s0 = index[0]
-            r0 = 0 if s0.start is None else int(s0.start)
-            r1 = shape[0] if s0.stop is None else int(s0.stop)
-            rows = row_cache.get((r0, r1))
-            if rows is None:
-                rows = row_cache[(r0, r1)] = read_rows(r0, r1).reshape(
-                    (r1 - r0,) + shape[1:])
-            tail = index[1:]
-            partial_tail = any(
-                ((0 if s.start is None else int(s.start)),
-                 (d if s.stop is None else int(s.stop))) != (0, d)
-                for s, d in zip(tail, shape[1:]))
-            if partial_tail:
-                return np.ascontiguousarray(rows[(slice(None),) + tail])
-            return rows
+            bounds = _norm_index(index, shape)
+            got = region_cache.get(bounds)
+            if got is None:
+                got = region_cache[bounds] = read_region(bounds)
+            return got
 
         arr = jax.make_array_from_callback(shape, sh, cb)
         tdt = getattr(tleaf, "dtype", None)
@@ -414,47 +377,87 @@ class CheckpointManager:
                           out_shardings=sh)(arr)
         return arr
 
-    def _make_row_reader(self, eng, cdir, files, name, info, shape, np_dt):
-        """Returns read_rows(r0, r1) -> np array of those rows, pulled via
-        direct engine reads from whichever span files cover them."""
+    def _make_region_reader(self, eng, cdir, files, name, info, shape,
+                            np_dt):
+        """Returns read_region(bounds) -> np array of that region of the
+        global tensor, assembled from whichever stored tiles intersect it
+        (general N-d: restore under ANY target mesh/sharding, including
+        one the checkpoint was not written under).  Whole stored tiles
+        are read once via direct engine reads and cached for the leaf."""
 
-        spans = info["spans"]
+        tiles = [(tuple(tuple(b) for b in t["idx"]), t["file"])
+                 for t in info["tiles"]]
+        tile_cache: Dict = {}
 
-        def read_rows(r0, r1):
-            if shape and r1 <= r0:  # zero-length tensor/slice
-                return np.empty(0, dtype=np_dt)
-            row_elems = (int(np.prod(shape[1:], dtype=np.int64))
-                         if len(shape) > 1 else 1)
-            parts = []
-            for sp in spans:
-                s0, s1 = sp["r0"], sp["r1"]
-                a, b = max(r0, s0), min(r1, s1)
-                if a >= b and shape:
+        def read_tile_rows(bounds, fname, a, b):
+            """Rows [a, b) (tile-local, leading axis) of a stored tile —
+            a contiguous byte range, so a cross-mesh restore that needs a
+            sliver of a tile reads only those rows from NVMe, not the
+            whole tile (parity with the old row-span sub-range reads)."""
+            tshape = tuple(hi - lo for lo, hi in bounds)
+            key = (bounds, a, b)
+            got = tile_cache.get(key)
+            if got is not None:
+                return got
+            whole = tile_cache.get((bounds, 0, tshape[0] if tshape else 1))
+            if whole is not None:
+                return whole[a:b] if tshape else whole
+            sf = files.get(fname)
+            if sf is None:
+                sf = SafetensorsFile(os.path.join(cdir, fname))
+                files[fname] = sf
+            t = sf.tensors[_tile_key(name, bounds, shape)]
+            if not tshape:  # scalar tile
+                flat = self._engine_read(eng, sf.path, t["offset"],
+                                         t["nbytes"]).view(np_dt)
+                got = flat.reshape(())
+            else:
+                row_bytes = (np_dt.itemsize *
+                             int(np.prod(tshape[1:], dtype=np.int64)))
+                flat = self._engine_read(eng, sf.path,
+                                         t["offset"] + a * row_bytes,
+                                         (b - a) * row_bytes).view(np_dt)
+                got = flat.reshape((b - a,) + tshape[1:])
+            tile_cache[key] = got
+            return got
+
+        def read_region(bounds):
+            if not shape:  # scalar: the single () tile
+                return read_tile_rows((), tiles[0][1], 0, 1)
+            rshape = tuple(b - a for a, b in bounds)
+            if 0 in rshape:
+                return np.empty(rshape, dtype=np_dt)
+            out = None
+            covered = 0
+            for tb, fname in tiles:
+                lo = tuple(max(a, ta) for (a, _), (ta, _) in
+                           zip(bounds, tb))
+                hi = tuple(min(b, tb_) for (_, b), (_, tb_) in
+                           zip(bounds, tb))
+                if any(l >= h for l, h in zip(lo, hi)):
                     continue
-                sf = files.get(sp["file"])
-                if sf is None:
-                    sf = SafetensorsFile(os.path.join(cdir, sp["file"]))
-                    files[sp["file"]] = sf
-                key = name if (s0, s1) == ((0, shape[0]) if shape
-                                           else (0, 1)) \
-                    else f"{name}@r{s0}-{s1}"
-                t = sf.tensors[key]
-                if not shape:  # scalar
-                    return self._engine_read(eng, sf.path, t["offset"],
-                                             t["nbytes"]).view(np_dt)
-                item = np_dt.itemsize * row_elems
-                off = t["offset"] + (a - s0) * item
-                parts.append(self._engine_read(
-                    eng, sf.path, off, (b - a) * item))
-                if b >= r1:
-                    break
-            if not parts:
-                raise ValueError(f"{name}: rows [{r0},{r1}) not covered "
-                                 "by any span")
-            flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
-            return flat.view(np_dt)
+                rows = read_tile_rows(tb, fname, lo[0] - tb[0][0],
+                                      hi[0] - tb[0][0])
+                if tb == bounds:  # exact tile: the same-mesh fast path
+                    return rows
+                src = (slice(None),) + tuple(
+                    slice(l - ta, h - ta) for l, h, (ta, _) in
+                    zip(lo[1:], hi[1:], tb[1:]))
+                dst = tuple(slice(l - a, h - a) for l, h, (a, _) in
+                            zip(lo, hi, bounds))
+                if out is None:
+                    out = np.empty(rshape, dtype=np_dt)
+                out[dst] = rows[src]
+                covered += int(np.prod(
+                    [h - l for l, h in zip(lo, hi)], dtype=np.int64))
+            want = int(np.prod(rshape, dtype=np.int64))
+            if out is None or covered < want:
+                raise ValueError(
+                    f"{name}: region {bounds} under-covered by stored "
+                    f"tiles ({covered}/{want} elements)")
+            return out
 
-        return read_rows
+        return read_region
 
     @staticmethod
     def _engine_read(eng, path, offset, length) -> np.ndarray:

@@ -200,3 +200,61 @@ def test_bf16_roundtrip(tmp_path, mesh8):
     assert got["x"].dtype == jnp.bfloat16
     np.testing.assert_array_equal(np.asarray(got["x"], np.float32),
                                   np.asarray(x, np.float32))
+
+
+def test_three_axis_sharded_roundtrip(tmp_path):
+    """VERDICT#5: a tensor sharded on THREE axes under a dp×pp×tp mesh
+    saves tile-wise and restores exactly — no reshard-before-saving."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("dp", "pp", "tp"))
+    sh = NamedSharding(mesh, P("dp", "pp", "tp"))
+    x = jnp.arange(4 * 4 * 8, dtype=jnp.float32).reshape(4, 4, 8)
+    xs = jax.device_put(x, sh)
+
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(1, {"w": xs})
+
+    target = {"w": jax.device_put(jnp.zeros_like(x), sh)}
+    got = mgr.restore(target)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(x))
+    assert got["w"].sharding.is_equivalent_to(sh, 3)
+
+
+def test_three_axis_restore_onto_different_mesh(tmp_path):
+    """Checkpoint written 3-axis-sharded restores under a 2-axis mesh of
+    a different shape: regions are reassembled from intersecting tiles."""
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devs, ("dp", "pp", "tp"))
+    sh = NamedSharding(mesh, P("dp", "pp", "tp"))
+    x = jnp.arange(4 * 4 * 8, dtype=jnp.float32).reshape(4, 4, 8)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(2, {"w": jax.device_put(x, sh)})
+
+    mesh2 = Mesh(np.array(jax.devices()[:4]).reshape(4), ("tp",))
+    sh2 = NamedSharding(mesh2, P(None, "tp"))  # misaligned with tiles
+    got = mgr.restore({"w": jnp.zeros_like(x)},
+                      shardings=lambda name, shape: sh2)
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(x))
+    assert got["w"].sharding.is_equivalent_to(sh2, 3)
+
+
+def test_cross_column_sharded_roundtrip(tmp_path):
+    """Column-only sharding (P(None, 'tp')) — the layout the old row-span
+    design needed host-side stitching for — now saves one tile per
+    column group and restores under a row sharding."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("tp",))
+    sh = NamedSharding(mesh, P(None, "tp"))
+    x = jnp.arange(16 * 32, dtype=jnp.float32).reshape(16, 32)
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(3, {"w": jax.device_put(x, sh)})
+
+    row_sh = NamedSharding(mesh, P("tp", None))
+    got = mgr.restore({"w": jnp.zeros_like(x)},
+                      shardings={"w": row_sh})
+    np.testing.assert_array_equal(np.asarray(got["w"]), np.asarray(x))
