@@ -82,7 +82,8 @@ class ShardedLoader:
       shard_paths: ALL shard files of the dataset (same list on all hosts).
       mesh: jax Mesh; batches are sharded over `axis` (default "dp").
       global_batch: global batch size (divided across processes).
-      fmt: "wds" or "tfrecord".
+      fmt: "wds", "tfrecord", or "fixedrec" (the zero-copy contiguous-
+        batch fast path, formats/fixedrec.py — no decode, no seq_axis).
       decode: fn(parts: dict[ext, bytes]) -> np.ndarray | dict of arrays.
         For tfrecord, parts is {"": payload}.
       engine: shared StromEngine (one is created if omitted).
@@ -105,8 +106,17 @@ class ShardedLoader:
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None):
         import jax
-        if fmt not in ("wds", "tfrecord"):
+        if fmt not in ("wds", "tfrecord", "fixedrec"):
             raise ValueError(f"unknown fmt {fmt!r}")
+        if fmt == "fixedrec":
+            if decode is not None:
+                raise ValueError(
+                    "fixedrec is the zero-copy raw path: records go "
+                    "staging→device untouched; decode on device instead")
+            if seq_axis is not None:
+                raise ValueError(
+                    "fixedrec cannot seq-shard: a device's seq slice of "
+                    "every row is not a contiguous file span")
         self.mesh = mesh
         self.axis = axis
         self.seq_axis = seq_axis
@@ -211,6 +221,9 @@ class ShardedLoader:
     def __iter__(self) -> Iterator:
         """Yield pytrees of global jax.Arrays sharded over the mesh axis."""
         import jax
+        if self.fmt == "fixedrec":
+            yield from self._iter_fixedrec()
+            return
         sharding = batch_sharding(self.mesh, self.axis)
         if self.seq_axis is not None:
             # long-context batches: samples over `axis`, the sequence dim
@@ -296,6 +309,182 @@ class ShardedLoader:
                 except queue.Empty:
                     break
             t.join(timeout=30)
+        self.epoch += 1
+
+    # -- fixedrec: the zero-copy contiguous-batch fast path -----------------
+
+    def _iter_fixedrec(self) -> Iterator:
+        """One epoch of fixedrec batches (VERDICT round 1 #2).
+
+        Per batch, per local device: the device's rows are a CONTIGUOUS
+        span of one shard file (split only at shard/buffer boundaries),
+        so the plan is engine read → staging view (`.view().reshape()`,
+        zero copies) → ``device_put`` of the view → assemble with
+        ``make_array_from_single_device_arrays``.  No Python-side byte
+        copy exists on the accelerator path; record-level shuffling is
+        traded away (shuffle shard order per epoch; randomize record
+        order at dataset-prep time, the ffcv/ArrayRecord recipe).
+
+        Multi-host note: every process must hold the same local record
+        count (equal shards per process) or epochs desynchronize.
+        """
+        import jax
+        from nvme_strom_tpu.formats.fixedrec import FixedRecIndex
+        from nvme_strom_tpu.ops.bridge import host_to_device
+
+        eng = self._engine
+        sharding = batch_sharding(self.mesh, self.axis)
+        order = list(self.local_shards)
+        if self.config.shuffle_buffer:
+            perm = shuffled_indices(len(order), self.config.seed,
+                                    self.epoch)
+            order = [order[i] for i in perm]
+        idxs = [FixedRecIndex(p) for p in order]
+        if not idxs:
+            self.epoch += 1
+            return
+        rec_bytes, dtype = idxs[0].record_bytes, idxs[0].dtype
+        rshape = idxs[0].shape
+        for ix in idxs[1:]:
+            if (ix.record_bytes, ix.dtype, ix.shape) != (rec_bytes, dtype,
+                                                         rshape):
+                raise ValueError(
+                    f"{ix.path}: record layout differs from {idxs[0].path}")
+        max_read = (eng.config.chunk_bytes // rec_bytes) * rec_bytes
+        if max_read == 0:
+            raise ValueError(
+                f"record ({rec_bytes}B) exceeds engine chunk_bytes "
+                f"({eng.config.chunk_bytes}B); raise EngineConfig."
+                "chunk_bytes")
+
+        gshape = (self.global_batch,) + rshape
+        # device → global row span; this process's rows must be one
+        # contiguous block so local record index = global row − lo.
+        dev_spans = {}
+        for d, idx in sharding.devices_indices_map(gshape).items():
+            if d.process_index != jax.process_index():
+                continue
+            s0 = tuple(idx)[0]
+            dev_spans[d] = (0 if s0.start is None else int(s0.start),
+                            gshape[0] if s0.stop is None else int(s0.stop))
+        lo, hi = _process_span(sharding, gshape, dim=0,
+                               proc=jax.process_index())
+        if (hi - lo) != self.local_batch:
+            raise ValueError(
+                f"process rows [{lo},{hi}) != local_batch "
+                f"{self.local_batch}")
+
+        # local record r lives in shard s at record r - base[s]
+        base, total = [], 0
+        for ix in idxs:
+            base.append(total)
+            total += ix.count
+        n_batches = total // self.local_batch
+        if total % self.local_batch and not self.config.drop_remainder:
+            raise ValueError(
+                f"{total} local records do not fill "
+                f"{total // self.local_batch + 1} batches of "
+                f"{self.local_batch}; pad the dataset or set "
+                "drop_remainder=True")
+
+        def pieces(r0, r1):
+            """Local records [r0, r1) → [(shard_i, offset, length), ...]
+            contiguous file ranges, split at shard and buffer bounds."""
+            out = []
+            si = 0
+            while r0 < r1:
+                while base[si] + idxs[si].count <= r0:
+                    si += 1
+                take = min(r1, base[si] + idxs[si].count) - r0
+                off0 = (r0 - base[si]) * rec_bytes
+                nb = take * rec_bytes
+                for o in range(0, nb, max_read):
+                    out.append((si, off0 + o, min(max_read, nb - o)))
+                r0 += take
+            return out
+
+        fhs = [eng.open(p) for p in order]
+        depth = max(1, self.config.prefetch)
+        pending: list = []   # (per-device [(dev, [PendingRead...])])
+
+        def entry_reads(entry):
+            reads = {}   # id → PendingRead (replicas share the reads)
+            for _, prs in entry:
+                for pr in prs:
+                    reads[id(pr)] = pr
+            return list(reads.values())
+
+        def finish(entry):
+            import jax.numpy as jnp
+            per_dev = []
+            try:
+                for dev, prs in entry:
+                    parts = []
+                    for pr in prs:
+                        v = pr.wait()
+                        n = v.nbytes // rec_bytes
+                        parts.append(host_to_device(
+                            eng, v.view(dtype).reshape((n,) + rshape),
+                            dev))
+                    per_dev.append(parts[0] if len(parts) == 1
+                                   else jnp.concatenate(parts))
+                for a in per_dev:
+                    a.block_until_ready()   # device owns the bytes now
+            finally:
+                # exception-safe: a failed wait/transfer must still hand
+                # every staging buffer of this entry back to the pool
+                for pr in entry_reads(entry):
+                    pr.release()
+            return jax.make_array_from_single_device_arrays(
+                gshape, sharding, per_dev)
+
+        # The pool is finite and the engine defers (never errors) reads
+        # past it — releases happen in finish(), so submitting more than
+        # the pool holds before finishing would deadlock.  Bound the
+        # in-flight pieces; a single batch over the pool cannot work.
+        span_list = sorted({sp for sp in dev_spans.values()})
+        batch_pieces = sum(
+            len(pieces((g0 - lo), (g1 - lo))) for g0, g1 in span_list)
+        if batch_pieces > eng.n_buffers:
+            raise ValueError(
+                f"one batch needs {batch_pieces} staging buffers but the "
+                f"pool has {eng.n_buffers}; raise EngineConfig."
+                "chunk_bytes or lower the batch size")
+        inflight = 0
+
+        try:
+            for b in range(n_batches):
+                b0 = b * self.local_batch
+                while pending and inflight + batch_pieces > eng.n_buffers:
+                    entry = pending.pop(0)
+                    inflight -= len(entry_reads(entry))
+                    yield finish(entry)
+                # replicas along non-batch axes share a span: one read
+                # per distinct span, one transfer per device
+                span_reads = {}
+                entry = []
+                for dev, (g0, g1) in dev_spans.items():
+                    key = (g0, g1)
+                    if key not in span_reads:
+                        span_reads[key] = [
+                            eng.submit_read(fhs[si], off, ln)
+                            for si, off, ln in
+                            pieces(b0 + (g0 - lo), b0 + (g1 - lo))]
+                    entry.append((dev, span_reads[key]))
+                pending.append(entry)
+                inflight += len(entry_reads(entry))
+                if len(pending) > depth:
+                    entry = pending.pop(0)
+                    inflight -= len(entry_reads(entry))
+                    yield finish(entry)
+            while pending:
+                yield finish(pending.pop(0))
+        finally:
+            for entry in pending:
+                for pr in entry_reads(entry):
+                    pr.release()
+            for fh in fhs:
+                eng.close(fh)
         self.epoch += 1
 
     def close(self) -> None:

@@ -1,0 +1,110 @@
+"""Fixed-size-record shard format — the zero-bounce loader fast path.
+
+WebDataset/TFRecord interleave per-record headers with payloads, so a
+batch of samples is never one contiguous byte range and the loader must
+touch every payload on the host (SURVEY.md §3.5's "payload never touched
+by host" is unreachable).  This format is the TPU-first fix, following
+the high-throughput-loader lineage (ArrayRecord, ffcv): records of ONE
+fixed byte size packed back-to-back, with a tiny JSON footer — so any
+batch of records is a single contiguous file span that the engine can
+O_DIRECT straight into a staging buffer and PJRT can transfer without a
+host-side copy (VERDICT round 1 #2).
+
+Layout:
+
+    [record 0][record 1]…[record n-1][json meta][8B LE meta len][SFR1]
+
+The footer is read with ordinary buffered I/O (it is tens of bytes and
+read once); payload reads go through the engine.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Iterable, Union
+
+import numpy as np
+
+MAGIC = b"SFR1"
+_TAIL = struct.Struct("<Q4s")   # meta length + magic
+
+
+def write_fixedrec(path: Union[str, os.PathLike],
+                   records: Union[np.ndarray, Iterable[bytes]],
+                   dtype=None, shape=None) -> int:
+    """Write records to ``path``; returns the record count.
+
+    ``records`` is either an (n, *shape) array (dtype/shape recorded so
+    batches decode as arrays with no further parsing) or an iterable of
+    equal-length bytes objects (recorded as uint8 vectors).
+    """
+    if isinstance(records, np.ndarray):
+        if records.ndim < 1:
+            raise ValueError("records array must have a leading dim")
+        dtype = records.dtype
+        shape = records.shape[1:]
+        # memoryview streams straight from the array — no tobytes()
+        # doubling of a multi-GB shard's memory
+        payload = [memoryview(np.ascontiguousarray(records)).cast("B")]
+        count = records.shape[0]
+        rec_bytes = records.dtype.itemsize * int(
+            np.prod(shape, dtype=np.int64)) if shape else \
+            records.dtype.itemsize
+    else:
+        payload = [memoryview(r) for r in records]
+        if not payload:
+            raise ValueError("no records")
+        rec_bytes = payload[0].nbytes
+        if any(r.nbytes != rec_bytes for r in payload):
+            raise ValueError("records must be one fixed size")
+        count = len(payload)
+        if dtype is None:
+            dtype, shape = np.dtype(np.uint8), (rec_bytes,)
+    meta = json.dumps({
+        "record_bytes": rec_bytes, "count": count,
+        "dtype": np.dtype(dtype).str,
+        "shape": list(shape if shape is not None else (rec_bytes,)),
+    }).encode()
+    with open(path, "wb") as f:
+        for p in payload:
+            f.write(p)
+        f.write(meta)
+        f.write(_TAIL.pack(len(meta), MAGIC))
+    return count
+
+
+class FixedRecIndex:
+    """Footer parse of one fixedrec shard: record size/count/dtype/shape.
+    ``span(i, n)`` → the (offset, length) of records [i, i+n) — always
+    one contiguous range, the whole point of the format."""
+
+    def __init__(self, path: Union[str, os.PathLike]):
+        self.path = str(path)
+        with open(self.path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            if size < _TAIL.size:
+                raise ValueError(f"{self.path}: not a fixedrec file")
+            f.seek(size - _TAIL.size)
+            meta_len, magic = _TAIL.unpack(f.read(_TAIL.size))
+            if magic != MAGIC:
+                raise ValueError(f"{self.path}: bad magic {magic!r}")
+            f.seek(size - _TAIL.size - meta_len)
+            meta = json.loads(f.read(meta_len))
+        self.record_bytes = int(meta["record_bytes"])
+        self.count = int(meta["count"])
+        self.dtype = np.dtype(meta["dtype"])
+        self.shape = tuple(meta["shape"])
+        if self.record_bytes * self.count > size - _TAIL.size - meta_len:
+            raise ValueError(f"{self.path}: truncated payload")
+
+    def span(self, i: int, n: int) -> tuple[int, int]:
+        if i < 0 or i + n > self.count:
+            raise IndexError(f"records [{i},{i + n}) out of "
+                             f"[0,{self.count})")
+        return i * self.record_bytes, n * self.record_bytes
+
+    def __len__(self) -> int:
+        return self.count

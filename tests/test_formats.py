@@ -221,3 +221,37 @@ def test_arrow_columns_to_device(engine, tmp_path):
     cols = r.read_columns_to_device(engine, columns=["a", "b"])
     np.testing.assert_array_equal(np.asarray(cols["a"]), a)
     np.testing.assert_array_equal(np.asarray(cols["b"]), b)
+
+
+# ------------------------- fixedrec (zero-copy path) -------------------------
+
+def test_fixedrec_roundtrip_array(tmp_path):
+    from nvme_strom_tpu.formats.fixedrec import FixedRecIndex, write_fixedrec
+
+    rec = np.arange(6 * 4 * 4, dtype=np.int16).reshape(6, 4, 4)
+    p = tmp_path / "a.sfr"
+    assert write_fixedrec(p, rec) == 6
+    ix = FixedRecIndex(p)
+    assert (ix.count, ix.dtype, ix.shape) == (6, np.dtype(np.int16), (4, 4))
+    assert ix.record_bytes == 32
+    off, ln = ix.span(2, 3)
+    with open(p, "rb") as f:
+        f.seek(off)
+        got = np.frombuffer(f.read(ln), np.int16).reshape(3, 4, 4)
+    np.testing.assert_array_equal(got, rec[2:5])
+
+
+def test_fixedrec_bytes_records_and_errors(tmp_path):
+    from nvme_strom_tpu.formats.fixedrec import FixedRecIndex, write_fixedrec
+
+    p = tmp_path / "b.sfr"
+    write_fixedrec(p, [b"abcd", b"efgh"])
+    ix = FixedRecIndex(p)
+    assert ix.record_bytes == 4 and ix.dtype == np.uint8
+    with pytest.raises(IndexError):
+        ix.span(1, 2)
+    with pytest.raises(ValueError, match="fixed size"):
+        write_fixedrec(tmp_path / "c.sfr", [b"ab", b"abc"])
+    (tmp_path / "d.sfr").write_bytes(b"not a fixedrec file....")
+    with pytest.raises(ValueError, match="magic"):
+        FixedRecIndex(tmp_path / "d.sfr")

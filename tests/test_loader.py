@@ -205,3 +205,91 @@ def test_process_span_single_host_full_extent():
     # batch dim too
     lo, hi = _process_span(sh, (4, 64), dim=0, proc=jax.process_index())
     assert (lo, hi) == (0, 4)
+
+
+def _make_fixedrec_shards(tmp_path, n_shards, per_shard, shape=(8, 8),
+                          dtype=np.uint8):
+    from nvme_strom_tpu.formats.fixedrec import write_fixedrec
+
+    rng = np.random.default_rng(7)
+    paths, rows = [], []
+    for s in range(n_shards):
+        rec = rng.integers(0, 255, size=(per_shard,) + shape).astype(dtype)
+        p = tmp_path / f"shard-{s:03d}.sfr"
+        write_fixedrec(p, rec)
+        paths.append(str(p))
+        rows.extend(np.asarray(r) for r in rec)
+    return paths, rows
+
+
+def test_fixedrec_loader_zero_copy_batches(tmp_path):
+    """The VERDICT#2 path: batches come straight from staging views —
+    correct content, correct sharding, and zero Python-side copies (on
+    the CPU backend the only counted bounce is the forced device_put
+    alias-protection copy, exactly one batch's bytes per batch)."""
+    import jax
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.data.loader import ShardedLoader
+    from nvme_strom_tpu.io.engine import StromEngine
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    paths, rows = _make_fixedrec_shards(tmp_path, n_shards=2, per_shard=8)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("dp",))
+    stats = StromStats()
+    eng = StromEngine(EngineConfig(), stats=stats)
+    seen = 0
+    with ShardedLoader(paths, mesh, global_batch=4, fmt="fixedrec",
+                       engine=eng) as loader:
+        for batch in loader:
+            assert batch.shape == (4, 8, 8) and batch.dtype == np.uint8
+            assert tuple(batch.sharding.spec) == ("dp",)
+            np.testing.assert_array_equal(
+                np.asarray(batch),
+                np.stack(rows[seen:seen + 4]))
+            seen += 4
+    assert seen == 16
+    eng.sync_stats()
+    payload = 16 * 64  # every record byte, moved once
+    assert stats.bytes_to_device == payload
+    # CPU backend: host_to_device forces+counts one copy per batch —
+    # nothing else copies (no tobytes, no np.stack). On TPU this is 0.
+    assert stats.bounce_bytes == payload
+    eng.close_all()
+
+
+def test_fixedrec_loader_replicated_and_remainder(tmp_path):
+    import jax
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.data.loader import ShardedLoader
+
+    paths, rows = _make_fixedrec_shards(tmp_path, n_shards=1, per_shard=6)
+    # batch axis dp=2, tp axis replicates: one read per span, one
+    # transfer per device
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "tp"))
+    from nvme_strom_tpu.utils.config import LoaderConfig
+    with ShardedLoader(paths, mesh, global_batch=4, fmt="fixedrec",
+                       config=LoaderConfig(batch_size=4,
+                                           drop_remainder=False)) as ld:
+        with pytest.raises(ValueError, match="drop_remainder"):
+            list(ld)
+    with ShardedLoader(paths, mesh, global_batch=4, fmt="fixedrec") as ld:
+        batches = list(ld)
+    assert len(batches) == 1
+    np.testing.assert_array_equal(np.asarray(batches[0]),
+                                  np.stack(rows[:4]))
+
+
+def test_fixedrec_loader_rejects_decode_and_seq(tmp_path):
+    import jax
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.data.loader import ShardedLoader
+
+    paths, _ = _make_fixedrec_shards(tmp_path, 1, 4)
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("dp",))
+    with pytest.raises(ValueError, match="zero-copy raw path"):
+        ShardedLoader(paths, mesh, 2, fmt="fixedrec",
+                      decode=lambda p: p)
+    mesh2 = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "sp"))
+    with pytest.raises(ValueError, match="seq-shard"):
+        ShardedLoader(paths, mesh2, 2, fmt="fixedrec", seq_axis="sp")

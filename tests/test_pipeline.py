@@ -122,3 +122,91 @@ def test_pp_with_ring_attention_matches_reference(setup, axes, n_mb):
     g = jax.jit(jax.grad(make_pp_loss(cfg, mesh, n_mb)))(
         stack, rest, tokens)
     assert np.isfinite(np.asarray(g["wq"], np.float32)).all()
+
+
+# ---------------- ep×pp: MoE super-layer pipeline (VERDICT#6) ----------------
+
+@pytest.fixture(scope="module")
+def moe_setup():
+    from nvme_strom_tpu.models.transformer import (
+        TransformerConfig, tiny_moe_config)
+    c0 = tiny_moe_config()
+    # 4 layers → 2 super-layers (period 2) so pp=2 divides; ample
+    # capacity and no aux term so the pipelined LM loss is directly
+    # comparable to the single-device reference.
+    cfg = TransformerConfig(**{**c0.__dict__, "n_layers": 4,
+                               "capacity_factor": 4.0,
+                               "router_aux_coef": 0.0})
+    params = init_params(jax.random.key(2), cfg)
+    tokens = jax.random.randint(jax.random.key(3), (8, cfg.max_seq),
+                                0, cfg.vocab)
+    ref = float(loss_fn(params, tokens, cfg))
+    return cfg, params, tokens, ref
+
+
+def test_moe_stack_roundtrip(moe_setup):
+    cfg, params, _, _ = moe_setup
+    stack, rest = split_layer_stack(params, cfg)
+    assert set(stack) == {"dense", "moe"}
+    n_super = cfg.n_layers // cfg.moe_every
+    assert stack["dense"]["wq"].shape[:2] == (n_super, cfg.moe_every - 1)
+    assert stack["moe"]["moe_w_gate"].shape[:2] == (n_super,
+                                                    cfg.n_experts)
+    merged = merge_layer_stack(stack, rest)
+    assert set(merged) == set(params)
+    for k in params:
+        np.testing.assert_array_equal(np.asarray(merged[k]),
+                                      np.asarray(params[k]))
+
+
+@pytest.mark.parametrize("axes,n_mb", [
+    ((("pp", 2), ("ep", 2)), 2),
+    ((("dp", 2), ("pp", 2), ("ep", 2)), 2),
+    ((("pp", 2), ("tp", 2), ("ep", 2)), 4),
+])
+def test_pp_moe_loss_matches_reference(moe_setup, axes, n_mb):
+    cfg, params, tokens, ref = moe_setup
+    mesh = _mesh(axes)
+    stack, rest = split_layer_stack(params, cfg)
+    got = float(jax.jit(make_pp_loss(cfg, mesh, n_mb))(stack, rest,
+                                                       tokens))
+    assert got == pytest.approx(ref, rel=2e-2)
+
+
+def test_pp_moe_train_step_learns(moe_setup):
+    import optax
+    from nvme_strom_tpu.parallel.pipeline import stacked_shardings
+
+    cfg, params, tokens, ref = moe_setup
+    mesh = _mesh((("dp", 2), ("pp", 2), ("ep", 2)))
+    stack, rest = split_layer_stack(params, cfg)
+    s_sh = stacked_shardings(mesh, cfg)
+    stack = jax.tree.map(jax.device_put, stack, s_sh)
+    opt = optax.adamw(1e-2)
+    opt_state = opt.init((stack, rest))
+    step = jax.jit(make_pp_train_step(cfg, opt, mesh, n_microbatches=2))
+    for _ in range(5):
+        stack, rest, opt_state, loss = step(stack, rest, opt_state,
+                                            tokens)
+    assert np.isfinite(float(loss))
+    assert float(loss) < ref
+
+
+def test_pp_moe_aux_loss_matches_reference(moe_setup):
+    """With router_aux_coef != 0, the pipelined loss equals the
+    annotation-path loss_fn: the aux term rides the schedule out
+    (stage-psum, microbatch/dp mean) with identical per-row grouping."""
+    from nvme_strom_tpu.models.transformer import TransformerConfig
+
+    cfg0, params, tokens, _ = moe_setup
+    cfg = TransformerConfig(**{**cfg0.__dict__, "router_aux_coef": 0.01})
+    ref = float(loss_fn(params, tokens, cfg))
+    mesh = _mesh((("dp", 2), ("pp", 2), ("ep", 2)))
+    stack, rest = split_layer_stack(params, cfg)
+    got = float(jax.jit(make_pp_loss(cfg, mesh, 2))(stack, rest, tokens))
+    assert got == pytest.approx(ref, rel=2e-2)
+    # and the aux term is genuinely nonzero
+    cfg_no = TransformerConfig(**{**cfg.__dict__, "router_aux_coef": 0.0})
+    got_no = float(jax.jit(make_pp_loss(cfg_no, mesh, 2))(stack, rest,
+                                                          tokens))
+    assert got > got_no
