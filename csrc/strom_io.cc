@@ -286,6 +286,33 @@ inline uint64_t now_ns() {
   return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
 }
 
+/* Is [offset, offset+len) fully resident in the page cache?  The
+ * reference's kernel module checks this per block and returns resident
+ * blocks to userspace instead of issuing NVMe reads (SURVEY.md §3.1);
+ * here a transient mmap + mincore answers the same question from
+ * userspace without faulting anything in (mmap does not populate).
+ * preadv2(RWF_NOWAIT) would also work but performs the copy during the
+ * probe — under the submit lock that would stall other submitters. */
+static bool span_resident(int fd, uint64_t offset, uint64_t len) {
+  if (len == 0) return false;
+  static const uint64_t pg = (uint64_t)sysconf(_SC_PAGESIZE);
+  uint64_t m_off = align_down(offset, pg);
+  uint64_t m_len = offset + len - m_off;
+  void *m = mmap(nullptr, m_len, PROT_READ, MAP_SHARED, fd, (off_t)m_off);
+  if (m == MAP_FAILED) return false;
+  size_t npg = (size_t)((m_len + pg - 1) / pg);
+  bool all = true;
+  std::vector<unsigned char> vec(npg);
+  if (mincore(m, m_len, vec.data()) != 0) {
+    all = false;
+  } else {
+    for (size_t i = 0; i < npg; i++)
+      if (!(vec[i] & 1)) { all = false; break; }
+  }
+  munmap(m, m_len);
+  return all;
+}
+
 struct Req {
   int64_t id = 0;
   int fh = -1;
@@ -297,6 +324,8 @@ struct Req {
   bool is_write = false;
   bool direct = false;                 /* submitted O_DIRECT          */
   bool was_fallback = false;
+  bool planned_resident = false;       /* submit-time mincore probe chose
+                                          the page-cache path on purpose */
   ReqState state = ReqState::kInflight;
   int status = 0;                      /* 0 or -errno                 */
   uint64_t done_len = 0;               /* payload bytes transferred   */
@@ -333,7 +362,9 @@ struct strom_engine {
   int next_fh = 1;
 
   std::atomic<uint64_t> st_direct{0}, st_fallback{0}, st_bounce{0},
-      st_written{0}, st_sub{0}, st_comp{0}, st_fail{0}, st_retry{0};
+      st_written{0}, st_sub{0}, st_comp{0}, st_fail{0}, st_retry{0},
+      st_resident{0};
+  bool probe_residency = true;   /* STROM_NO_RESIDENCY_PROBE disables */
   std::atomic<uint64_t> lat_read[STROM_LAT_BUCKETS] = {};
   std::atomic<uint64_t> lat_write[STROM_LAT_BUCKETS] = {};
 
@@ -380,6 +411,8 @@ struct strom_engine {
     r->was_fallback = true;
     st_fallback.fetch_add(got, std::memory_order_relaxed);
     st_bounce.fetch_add(got, std::memory_order_relaxed);
+    if (r->planned_resident)
+      st_resident.fetch_add(got, std::memory_order_relaxed);
   }
 
   void write_sync(Req *r, const FileEnt &fe) {
@@ -555,11 +588,18 @@ struct strom_engine {
             r->was_fallback = true;
             st_fallback.fetch_add(avail, std::memory_order_relaxed);
             st_bounce.fetch_add(avail, std::memory_order_relaxed);
+            if (r->planned_resident)
+              st_resident.fetch_add(avail, std::memory_order_relaxed);
           }
         } else {
-          /* Short read or error (EINVAL on tmpfs etc.): rescue path. */
+          /* Short read or error (EINVAL on tmpfs etc.): rescue path.
+           * A rescued read is a RETRY, whatever the original plan —
+           * clear planned_resident so its bytes never count as a
+           * planned page-cache hit (header contract: resident is not
+           * a rescue). */
           st_retry.fetch_add(1, std::memory_order_relaxed);
           r->direct = false;
+          r->planned_resident = false;
           read_sync(r, fe);
           r->was_fallback = true;
         }
@@ -621,6 +661,7 @@ strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
    * pages so DMA targets never move (SURVEY.md §3.2); we pin staging pages
    * so neither NVMe DMA nor the TPU transfer hits a fault. Soft-fail. */
   if (lock_buffers) e->locked = mlock(e->pool, e->pool_sz) == 0;
+  e->probe_residency = getenv("STROM_NO_RESIDENCY_PROBE") == nullptr;
   for (int i = (int)n_buffers - 1; i >= 0; i--) e->free_bufs.push_back(i);
 
   if (use_io_uring && e->ring.init(queue_depth * 2)) {
@@ -922,7 +963,7 @@ int64_t strom_submit_read(strom_engine *e, int fh, uint64_t offset,
                           uint64_t len) {
   if (len > e->buf_bytes) return -EINVAL;
   Req *r = new Req();
-  std::lock_guard<std::mutex> lk(e->mu);
+  std::unique_lock<std::mutex> lk(e->mu);
   auto it = e->files.find(fh);
   if (it == e->files.end()) { delete r; return -EBADF; }
   if (e->stopping) { delete r; return -ECANCELED; }
@@ -930,15 +971,39 @@ int64_t strom_submit_read(strom_engine *e, int fh, uint64_t offset,
   struct stat st;
   if (fstat(it->second.fd_buffered, &st) == 0)
     it->second.size = (int64_t)st.st_size;
-  const FileEnt &fe = it->second;
-  r->id = e->next_req++;
-  r->fh = fh;
   r->offset = offset;
   r->len = len;
-  r->t_submit = now_ns();
   r->a_off = align_down(offset, e->alignment);
   r->a_len = align_up(offset + len, e->alignment) - r->a_off;
-  r->direct = fe.fd_direct >= 0;
+  r->direct = it->second.fd_direct >= 0;
+  /* Residency-aware planning: if every page of the span is already in
+   * the page cache, a buffered read is a memcpy and the NVMe round-trip
+   * pure waste — CHOOSE the cache deliberately.  Counted as
+   * bytes_resident (+fallback+bounce: the host copy is real), never as
+   * a retry/rescue.  The probe's mmap/mincore syscalls run OUTSIDE the
+   * engine lock (on a dup so a concurrent close cannot retarget the fd)
+   * — a cold streaming submitter must not serialize behind them. */
+  if (r->direct && e->probe_residency && offset < (uint64_t)it->second.size) {
+    uint64_t avail =
+        std::min<uint64_t>(len, (uint64_t)it->second.size - offset);
+    int pfd = dup(it->second.fd_buffered);
+    if (pfd >= 0) {
+      lk.unlock();
+      bool resident = span_resident(pfd, offset, avail);
+      close(pfd);
+      lk.lock();
+      it = e->files.find(fh);
+      if (it == e->files.end()) { delete r; return -EBADF; }
+      if (e->stopping) { delete r; return -ECANCELED; }
+      if (resident) {
+        r->direct = false;
+        r->planned_resident = true;
+      }
+    }
+  }
+  r->id = e->next_req++;
+  r->fh = fh;
+  r->t_submit = now_ns();
   e->reqs[r->id] = r;
   e->st_sub.fetch_add(1, std::memory_order_relaxed);
   if (e->free_bufs.empty()) {
@@ -1041,6 +1106,7 @@ void strom_get_stats(strom_engine *e, strom_stats_blk *out) {
   out->requests_submitted = e->st_sub.load(std::memory_order_relaxed);
   out->requests_failed = e->st_fail.load(std::memory_order_relaxed);
   out->retries = e->st_retry.load(std::memory_order_relaxed);
+  out->bytes_resident = e->st_resident.load(std::memory_order_relaxed);
 }
 
 void strom_drain_stats(strom_engine *e, strom_stats_blk *out) {
@@ -1053,11 +1119,13 @@ void strom_drain_stats(strom_engine *e, strom_stats_blk *out) {
   out->requests_completed = e->st_comp.exchange(0, std::memory_order_acq_rel);
   out->requests_failed = e->st_fail.exchange(0, std::memory_order_acq_rel);
   out->retries = e->st_retry.exchange(0, std::memory_order_acq_rel);
+  out->bytes_resident = e->st_resident.exchange(0, std::memory_order_acq_rel);
 }
 
 void strom_reset_stats(strom_engine *e) {
   e->st_direct = 0; e->st_fallback = 0; e->st_bounce = 0; e->st_written = 0;
   e->st_sub = 0; e->st_comp = 0; e->st_fail = 0; e->st_retry = 0;
+  e->st_resident = 0;
   for (int i = 0; i < STROM_LAT_BUCKETS; i++) {
     e->lat_read[i].store(0, std::memory_order_relaxed);
     e->lat_write[i].store(0, std::memory_order_relaxed);

@@ -47,6 +47,12 @@ typedef struct strom_stats_blk {
   uint64_t requests_completed;
   uint64_t requests_failed;
   uint64_t retries;
+  uint64_t bytes_resident;       /* planned page-cache reads: the submit-time
+                                    mincore probe found the span resident and
+                                    CHOSE buffered (the reference's proactive
+                                    resident-block return, SURVEY.md §3.1) —
+                                    a subset of bytes_fallback, and NOT a
+                                    rescue (retries unaffected)              */
 } strom_stats_blk;
 
 typedef struct strom_completion {

@@ -81,7 +81,7 @@ class _StatsBlk(ctypes.Structure):
     _fields_ = [(n, ctypes.c_uint64) for n in (
         "bytes_direct", "bytes_fallback", "bounce_bytes",
         "bytes_written_direct", "requests_submitted", "requests_completed",
-        "requests_failed", "retries")]
+        "requests_failed", "retries", "bytes_resident")]
 
 
 class _Completion(ctypes.Structure):

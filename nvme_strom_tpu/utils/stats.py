@@ -52,6 +52,9 @@ class StromStats:
     requests_completed: int = 0
     requests_failed: int = 0
     retries: int = 0
+    # planned page-cache reads (submit-time residency probe chose the
+    # buffered path; subset of bytes_fallback, never a rescue)
+    bytes_resident: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)

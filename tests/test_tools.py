@@ -91,7 +91,11 @@ def test_stats_export_and_strom_stat(capsys, data_file, tmp_path,
     assert rc == 0
     snap = json.loads(out)
     assert snap["requests_completed"] >= 1
-    assert snap["bounce_bytes"] == 0  # north star on the direct path
+    # North star in the residency-planning regime: every host copy is a
+    # PLANNED page-cache read (the data_file fixture is freshly written,
+    # hence warm) — unplanned bounce stays zero.
+    assert snap["bounce_bytes"] == snap["bytes_resident"]
+    assert snap["retries"] == 0
 
 
 def test_strom_stat_missing_file(capsys, tmp_path, monkeypatch):
