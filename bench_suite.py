@@ -11,12 +11,21 @@ number:
   3 loader  — WebDataset shards → sharded dataloader → device batches
   4 weights — safetensors shards → lazy sharded HBM param load
   5 sql     — Parquet row-group scan → on-device GROUP BY aggregate
+  6 decode  — autoregressive generation, tokens/sec (compute row)
+  7 train   — train-step model-FLOPs utilisation (compute row)
 
 Usage: python bench_suite.py [--config N ... | --all] [--json-only]
 
-Each line: {"metric", "value" (GiB/s payload→device), "unit",
+I/O rows (1–5): {"metric", "value" (GiB/s payload→device), "unit",
 "vs_baseline" (value / 0.9·min(raw SSD, host→device link) — the
-BASELINE.json north star; ≥1.0 means target met)}.
+BASELINE.json north star; ≥1.0 means target met)}.  Discipline per the
+round-1 verdict: run 0 warms jit/IPC caches and is DISCARDED, the page
+cache is evicted before every timed run (cold = NVMe, not DRAM), and the
+reported value is the MEDIAN of the timed runs, never best-of.
+
+Compute rows (6–7) have no BASELINE.json target (the reference is a
+storage engine, SURVEY.md §1) → vs_baseline is always null; they exist so
+the framework's perf claims cover compute, not just I/O.
 
 Env: STROM_SUITE_BYTES (per-config payload, default 256 MiB),
 STROM_BENCH_DIR (scratch dir, default repo root).
@@ -27,6 +36,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import statistics
 import sys
 import time
 
@@ -35,6 +45,27 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import bench  # noqa: E402  (shared helpers: probe_device, make_file, ...)
 
 _log = bench._log
+
+#: timed runs per I/O config AFTER the discarded jit-warmup run
+_RUNS = 3
+
+
+def _steady(evict_paths, timed_fn) -> float:
+    """Warmup + _RUNS cold timed runs → median rate.
+
+    ``timed_fn()`` performs one full pass and returns its rate;
+    ``evict_paths`` are dropped from the page cache before every run so
+    each pass reads the NVMe, not DRAM (freshly generated bench data is
+    100% cache-resident otherwise, and the residency planner would —
+    correctly — serve it from memory)."""
+    rates = []
+    for i in range(_RUNS + 1):
+        for p in evict_paths:
+            bench.evict_file(p)
+        r = timed_fn()
+        if i > 0:          # run 0 warms jit/IPC/placement caches
+            rates.append(r)
+    return statistics.median(rates)
 
 
 def _scratch_dir() -> str:
@@ -171,17 +202,16 @@ def bench_arrow(engine, nbytes: int, device=None) -> tuple[float, int]:
     size = make_arrow_file(path, nbytes)
     from nvme_strom_tpu.formats.arrow import ArrowFileReader
     reader = ArrowFileReader(path)
-    best, payload = 0.0, 0
-    for _ in range(2):         # run 1 warms jit/IPC caches
+
+    def one_pass() -> float:
         t0 = time.monotonic()
         cols = reader.read_columns_to_device(engine, device=device)
         for v in cols.values():
             v.block_until_ready()
         dt = time.monotonic() - t0
-        payload = sum(int(v.nbytes) for v in cols.values())
-        del cols
-        best = max(best, payload / (1 << 30) / dt)
-    return best, size
+        return sum(int(v.nbytes) for v in cols.values()) / (1 << 30) / dt
+
+    return _steady([path], one_pass), size
 
 
 def bench_loader(engine, nbytes: int, batch: int = 8) -> tuple[float, int]:
@@ -191,18 +221,21 @@ def bench_loader(engine, nbytes: int, batch: int = 8) -> tuple[float, int]:
     from nvme_strom_tpu.data.loader import ShardedLoader
     paths = make_wds_shards(os.path.join(_scratch_dir(), "wds"), nbytes)
     mesh = Mesh(np.array(jax.local_devices()[:1]).reshape(1), ("dp",))
-    best, n = 0.0, 0
+    total = [0]
     with ShardedLoader(paths, mesh, global_batch=batch, fmt="wds",
                        engine=engine) as loader:
-        for _ in range(2):     # epoch 1 warms jit/placement caches
+
+        def one_epoch() -> float:
             n = 0
             t0 = time.monotonic()
             for arr in loader:
                 arr.block_until_ready()
                 n += int(arr.nbytes)
-            dt = time.monotonic() - t0
-            best = max(best, n / (1 << 30) / dt)
-    return best, n
+            total[0] = n
+            return n / (1 << 30) / (time.monotonic() - t0)
+
+        rate = _steady(paths, one_epoch)
+    return rate, total[0]
 
 
 def bench_weights(engine, nbytes: int, device=None) -> tuple[float, int]:
@@ -214,17 +247,19 @@ def bench_weights(engine, nbytes: int, device=None) -> tuple[float, int]:
     ckpt = LazyCheckpoint(paths)
     dev = device or jax.local_devices()[0]
     sh = SingleDeviceSharding(dev)
-    best, payload = 0.0, 0
-    for _ in range(2):         # run 1 warms jit/placement caches
+    payload = [0]
+
+    def one_load() -> float:
         t0 = time.monotonic()
         params = ckpt.load_sharded(lambda name, shape: sh, engine=engine)
         for v in params.values():
             v.block_until_ready()
         dt = time.monotonic() - t0
-        payload = sum(int(v.nbytes) for v in params.values())
+        payload[0] = sum(int(v.nbytes) for v in params.values())
         del params
-        best = max(best, payload / (1 << 30) / dt)
-    return best, payload
+        return payload[0] / (1 << 30) / dt
+
+    return _steady(paths, one_load), payload[0]
 
 
 def bench_sql(engine, nbytes: int, num_groups: int = 64,
@@ -235,18 +270,127 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
     size = make_parquet_file(path, nbytes, num_groups)
     scanner = ParquetScanner(path, engine)
     rows = scanner.num_rows
-    best = 0.0
-    for _ in range(2):         # run 1 warms the groupby jit
+
+    def one_scan() -> float:
         t0 = time.monotonic()
         out = sql_groupby(scanner, "k", "v", num_groups,
                           aggs=("count", "sum", "mean"), device=device)
         for v in out.values():
             v.block_until_ready()
         dt = time.monotonic() - t0
-        best = max(best, size / (1 << 30) / dt)
         _log(f"suite: sql scanned {rows} rows ({size >> 20} MiB) "
              f"in {dt:.3f}s = {rows / dt / 1e6:.1f} Mrows/s")
-    return best, rows
+        return size / (1 << 30) / dt
+
+    return _steady([path], one_scan), rows
+
+
+# --------------------------- compute rows ------------------------------
+
+#: per-chip dense bf16 peak FLOP/s (public spec sheets), matched by
+#: substring against ``device_kind``.  MFU needs a denominator; on an
+#: unrecognized device the suite reports achieved TFLOP/s with mfu=null
+#: rather than inventing a peak.
+_TPU_PEAK_BF16 = (("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+                  ("trillium", 918e12), ("v6", 918e12), ("v4", 275e12))
+
+
+def _peak_flops(dev) -> float | None:
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    for key, val in _TPU_PEAK_BF16:
+        if key in kind:
+            return val
+    return None
+
+
+def _matmul_param_count(params) -> int:
+    """Matmul-participating parameter count: every ≥2-d weight except the
+    token embedding (a gather, not a matmul).  6·T·this is the standard
+    fwd+bwd matmul-FLOPs estimate (PaLM appendix B convention)."""
+    return sum(int(v.size) for k, v in params.items()
+               if getattr(v, "ndim", 0) >= 2 and k != "tok_embed")
+
+
+def _tiny_compute() -> bool:
+    """STROM_SUITE_TINY_COMPUTE=1 shrinks the compute rows to CI scale
+    (the CPU-pinned test suite can't push half a TFLOP per step)."""
+    return os.environ.get("STROM_SUITE_TINY_COMPUTE") == "1"
+
+
+def _bench_cfg():
+    """One mid-size config for both compute rows: big enough that the MXU
+    sees real tiles (d=512, 8 layers), small enough to compile in seconds
+    on the tunneled chip."""
+    from nvme_strom_tpu.models.transformer import TransformerConfig
+    if _tiny_compute():
+        return TransformerConfig(vocab=256, d_model=64, n_layers=2,
+                                 n_heads=4, n_kv_heads=2, d_ff=128,
+                                 max_seq=256)
+    return TransformerConfig(vocab=8192, d_model=512, n_layers=8,
+                             n_heads=8, n_kv_heads=4, d_ff=1408,
+                             max_seq=1024)
+
+
+def bench_decode(device=None) -> tuple[float, str]:
+    """Config 6: autoregressive decode throughput.  The whole generation
+    is one jitted lax.scan (models/decode.py), so the number measures
+    on-device steady-state decode, not per-token dispatch."""
+    import functools
+    import jax
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.decode import generate
+    from nvme_strom_tpu.models.transformer import init_params
+    cfg = _bench_cfg()
+    batch, prompt_len, new = (2, 8, 16) if _tiny_compute() else (8, 32, 128)
+    dev = device or jax.devices()[0]
+    params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
+    prompt = jax.device_put(jax.random.randint(
+        jax.random.key(1), (batch, prompt_len), 0, cfg.vocab,
+        dtype=jnp.int32), dev)
+    gen = jax.jit(functools.partial(generate, cfg=cfg, max_new_tokens=new))
+    gen(params, prompt).block_until_ready()          # compile (discarded)
+    rates = []
+    for _ in range(_RUNS):
+        t0 = time.monotonic()
+        gen(params, prompt).block_until_ready()
+        rates.append(batch * new / (time.monotonic() - t0))
+    return statistics.median(rates), f"batch={batch} new={new}"
+
+
+def bench_train(device=None) -> tuple[float, str]:
+    """Config 7: train-step throughput as model TFLOP/s (and MFU when the
+    chip's peak is known).  FLOPs are the 6·T·P matmul estimate plus the
+    12·L·b·s²·d attention term — model FLOPs, not hardware FLOPs, so
+    remat or XLA fusion can't inflate the number."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from nvme_strom_tpu.models.transformer import init_params, make_train_step
+    cfg = _bench_cfg()
+    batch, seq = (2, 64) if _tiny_compute() else (8, 512)
+    dev = device or jax.devices()[0]
+    params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
+    opt = optax.adamw(1e-3)
+    opt_state = jax.device_put(opt.init(params), dev)
+    tokens = jax.device_put(jax.random.randint(
+        jax.random.key(1), (batch, seq), 0, cfg.vocab, dtype=jnp.int32), dev)
+    n_matmul = _matmul_param_count(params)
+    flops_step = (6 * batch * seq * n_matmul
+                  + 12 * cfg.n_layers * batch * seq * seq * cfg.d_model)
+    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    params, opt_state, loss = step(params, opt_state, tokens)  # compile
+    jax.block_until_ready(loss)
+    rates = []
+    for _ in range(_RUNS):
+        t0 = time.monotonic()
+        params, opt_state, loss = step(params, opt_state, tokens)
+        jax.block_until_ready(loss)
+        rates.append(flops_step / (time.monotonic() - t0))
+    flops_sec = statistics.median(rates)
+    peak = _peak_flops(dev)
+    note = (f"mfu={flops_sec / peak:.1%}" if peak
+            else "mfu=null (unknown peak)")
+    return flops_sec / 1e12, f"{note} b={batch} s={seq}"
 
 
 # ------------------------------- main ----------------------------------
@@ -279,31 +423,44 @@ def run(configs: list[int]) -> list[dict]:
         _log(f"suite: raw={raw:.3f} GiB/s link={link:.3f} GiB/s "
              f"target=0.9·min={ceiling:.3f} GiB/s")
 
+        # (label, fn, unit, io_row) — io_row=True rows are GiB/s against
+        # the north-star ceiling; compute rows have no BASELINE.json
+        # target (the reference is a storage engine) → vs_baseline null.
         names = {
-            1: ("raw-sequential-read", lambda: (raw, nbytes)),
-            2: ("arrow-to-device", lambda: bench_arrow(engine, nbytes)),
-            3: ("wds-sharded-loader", lambda: bench_loader(engine, nbytes)),
+            1: ("raw-sequential-read", lambda: (raw, nbytes),
+                "GiB/s", True),
+            2: ("arrow-to-device", lambda: bench_arrow(engine, nbytes),
+                "GiB/s", True),
+            3: ("wds-sharded-loader", lambda: bench_loader(engine, nbytes),
+                "GiB/s", True),
             4: ("safetensors-lazy-load",
-                lambda: bench_weights(engine, nbytes)),
-            5: ("parquet-groupby-scan", lambda: bench_sql(engine, nbytes)),
+                lambda: bench_weights(engine, nbytes), "GiB/s", True),
+            5: ("parquet-groupby-scan", lambda: bench_sql(engine, nbytes),
+                "GiB/s", True),
+            6: ("decode-throughput", bench_decode, "tok/s", False),
+            7: ("train-step-flops", bench_train, "TFLOP/s", False),
         }
         for c in configs:
-            label, fn = names[c]
+            label, fn, unit, io_row = names[c]
             val, extra = fn()
+            tag = f"dev={dev_tag}"
+            if isinstance(extra, str):
+                tag += f", {extra}"
             results.append({
-                "metric": f"config{c}:{label} (dev={dev_tag})",
+                "metric": f"config{c}:{label} ({tag})",
                 "value": round(val, 3),
-                "unit": "GiB/s",
+                "unit": unit,
                 # Ratios against a CPU-derived ceiling are not the north
                 # star — never emit a number a reader could mistake for
                 # "target met" from a CPU-fallback run.
                 "vs_baseline": (round(val / ceiling, 3)
-                                if device_ok else None),
+                                if io_row and device_ok else None),
             })
             ratio = results[-1]["vs_baseline"]
-            _log(f"suite: config {c} {label}: {val:.3f} GiB/s "
+            _log(f"suite: config {c} {label}: {val:.3f} {unit} "
                  + (f"({ratio:.2f}x of target)" if ratio is not None
-                    else "(vs_baseline=null: cpu fallback)"))
+                    else f"(vs_baseline=null: "
+                         f"{'no target' if not io_row else 'cpu fallback'})"))
         engine.sync_stats()
     _log(f"suite: stats bounce={stats.bounce_bytes} "
          f"direct={stats.bytes_direct} fallback={stats.bytes_fallback}")
@@ -313,12 +470,12 @@ def run(configs: list[int]) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 6))
+                    choices=range(1, 8))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = [1, 2, 3, 4, 5]
+        configs = [1, 2, 3, 4, 5, 6, 7]
     for line in run(configs):
         print(json.dumps(line), flush=True)
     return 0

@@ -222,11 +222,16 @@ def _make_fixedrec_shards(tmp_path, n_shards, per_shard, shape=(8, 8),
     return paths, rows
 
 
-def test_fixedrec_loader_zero_copy_batches(tmp_path):
+def test_fixedrec_loader_zero_copy_batches(tmp_path, monkeypatch):
     """The VERDICT#2 path: batches come straight from staging views —
     correct content, correct sharding, and zero Python-side copies (on
     the CPU backend the only counted bounce is the forced device_put
-    alias-protection copy, exactly one batch's bytes per batch)."""
+    alias-protection copy, exactly one batch's bytes per batch).
+
+    The residency probe is disabled: the just-written shards are cache
+    resident, and a planned page-cache read (counted as bounce, by
+    design) would obscure the property under test — that the DIRECT path
+    adds no Python-side copies."""
     import jax
     from jax.sharding import Mesh
     from nvme_strom_tpu.data.loader import ShardedLoader
@@ -234,6 +239,7 @@ def test_fixedrec_loader_zero_copy_batches(tmp_path):
     from nvme_strom_tpu.utils.config import EngineConfig
     from nvme_strom_tpu.utils.stats import StromStats
 
+    monkeypatch.setenv("STROM_NO_RESIDENCY_PROBE", "1")
     paths, rows = _make_fixedrec_shards(tmp_path, n_shards=2, per_shard=8)
     mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("dp",))
     stats = StromStats()
