@@ -80,23 +80,25 @@ def _suite_bytes() -> int:
     return int(os.environ.get("STROM_SUITE_BYTES", 256 << 20))
 
 
-def _needs_regen(tag: str, nbytes: int) -> bool:
-    """Size-aware scratch cache: True if data tagged `tag` must be
-    (re)generated for this nbytes.  The .meta sentinel records the size a
+def _needs_regen(tag: str, nbytes: int, gen: int = 1) -> bool:
+    """Size- and generation-aware scratch cache: True if data tagged
+    `tag` must be (re)generated.  The .meta sentinel records the size a
     previous run FINISHED generating (written by _mark_generated after
     success), so changing STROM_SUITE_BYTES — or an interrupted
     generation — regenerates instead of silently benchmarking stale or
-    truncated data."""
+    truncated data.  ``gen`` is bumped when a generator's OUTPUT format
+    changes (e.g. parquet switching to non-dictionary PLAIN), so an old
+    scratch file can't silently bench the wrong code path."""
     meta = os.path.join(_scratch_dir(), f".{tag}.meta")
     try:
-        return int(open(meta).read()) != nbytes
-    except (OSError, ValueError):
+        return open(meta).read().strip() != f"{nbytes}/g{gen}"
+    except OSError:
         return True
 
 
-def _mark_generated(tag: str, nbytes: int) -> None:
+def _mark_generated(tag: str, nbytes: int, gen: int = 1) -> None:
     with open(os.path.join(_scratch_dir(), f".{tag}.meta"), "w") as f:
-        f.write(str(nbytes))
+        f.write(f"{nbytes}/g{gen}")
 
 
 # --------------------------- data generators ---------------------------
@@ -182,16 +184,18 @@ def make_parquet_file(path: str, nbytes: int, num_groups: int = 64) -> int:
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
-    if not _needs_regen("parquet", nbytes) and os.path.exists(path):
+    if not _needs_regen("parquet", nbytes, gen=2) and os.path.exists(path):
         return os.path.getsize(path)
     rows = max(4096, nbytes // 8)            # int32 key + float32 value
     rng = np.random.default_rng(0)
     tbl = pa.table({
         "k": pa.array(rng.integers(0, num_groups, rows, dtype=np.int32)),
         "v": pa.array(rng.standard_normal(rows, dtype=np.float32))})
+    # PLAIN + uncompressed: the shape PG-Strom-style on-device decode
+    # handles (sql/pq_direct.py) — config 5 measures the direct scan.
     pq.write_table(tbl, path, row_group_size=max(4096, rows // 16),
-                   compression="none")
-    _mark_generated("parquet", nbytes)
+                   compression="none", use_dictionary=False)
+    _mark_generated("parquet", nbytes, gen=2)
     return os.path.getsize(path)
 
 

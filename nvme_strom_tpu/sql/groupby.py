@@ -123,25 +123,55 @@ def sql_groupby(scanner, key_column: str, value_column: str,
 
     dev = device or jax.local_devices()[0]
 
-    folds = None
     cols_needed = list(dict.fromkeys(
         [key_column, value_column, *where_columns]))
-    for tbl in scanner.iter_row_groups(cols_needed):
-        keys = tbl.column(key_column).to_numpy(zero_copy_only=False)
-        vals = tbl.column(value_column).to_numpy(zero_copy_only=False)
-        if not np.issubdtype(keys.dtype, np.integer):
-            raise TypeError(f"key column {key_column} must be integer")
-        kd = host_to_device(scanner.engine, keys.astype(np.int32), dev)
-        vd = host_to_device(scanner.engine, vals, dev)
-        mask = None
-        if where is not None:
-            cols = {key_column: kd, value_column: vd}
-            for c in where_columns:
-                if c not in cols:
-                    cols[c] = host_to_device(
-                        scanner.engine,
-                        tbl.column(c).to_numpy(zero_copy_only=False), dev)
-            mask = where(cols)
+
+    # PG-Strom-style fast path: when every needed column is PLAIN
+    # fixed-width uncompressed, page spans stream O_DIRECT → device and
+    # decode there (pq_direct) — host never touches a payload byte.
+    # Anything else decodes per row group through pyarrow (counted).
+    # A plan failure (not just footer ineligibility) falls back too.
+    direct_plans = None
+    if hasattr(scanner, "direct_reasons"):
+        from nvme_strom_tpu.sql import pq_direct
+        try:
+            direct_plans = pq_direct.plan_columns(scanner, cols_needed)
+        except ValueError:
+            direct_plans = None
+
+    def _iter_device_cols():
+        if direct_plans is not None:
+            from nvme_strom_tpu.sql.pq_direct import (
+                iter_plain_row_groups_to_device)
+            for cols in iter_plain_row_groups_to_device(
+                    scanner, cols_needed, device=dev, plans=direct_plans):
+                if not jnp.issubdtype(cols[key_column].dtype, jnp.integer):
+                    raise TypeError(
+                        f"key column {key_column} must be integer")
+                cols[key_column] = cols[key_column].astype(jnp.int32)
+                yield cols
+        else:
+            for tbl in scanner.iter_row_groups(cols_needed):
+                keys = tbl.column(key_column).to_numpy(
+                    zero_copy_only=False)
+                if not np.issubdtype(keys.dtype, np.integer):
+                    raise TypeError(
+                        f"key column {key_column} must be integer")
+                cols = {key_column: host_to_device(
+                    scanner.engine, keys.astype(np.int32), dev)}
+                for c in cols_needed:
+                    if c != key_column:
+                        cols[c] = host_to_device(
+                            scanner.engine,
+                            tbl.column(c).to_numpy(zero_copy_only=False),
+                            dev)
+                yield cols
+
+    folds = None
+    for cols in _iter_device_cols():
+        kd = cols[key_column]
+        vd = cols[value_column]
+        mask = where(cols) if where is not None else None
         part = groupby_aggregate(
             kd, vd, num_groups,
             aggs=tuple(sorted((set(aggs) | {"count", "sum"}) - {"mean"})),

@@ -157,13 +157,61 @@ class ParquetScanner:
         finally:
             f.close()
 
+    def direct_reasons(self, columns: List[str]) -> Dict[str, Optional[str]]:
+        """Per column: None if EVERY row-group chunk can decode on device
+        (pq_direct fast path), else the first blocking reason."""
+        from nvme_strom_tpu.sql import pq_direct
+        name_to_ci = {self.metadata.schema.column(i).name: i
+                      for i in range(self.metadata.num_columns)}
+        out: Dict[str, Optional[str]] = {}
+        for c in columns:
+            out[c] = None
+            for rg in range(self.metadata.num_row_groups):
+                why = pq_direct.eligible_chunk(self.metadata, rg,
+                                               name_to_ci[c])
+                if why is not None:
+                    out[c] = f"rg{rg}: {why}"
+                    break
+        return out
+
     def read_columns_to_device(self, columns: List[str], device=None,
-                               dtype_map: Optional[Dict] = None):
-        """Scan → device-resident columns (on-device concat of row groups)."""
+                               dtype_map: Optional[Dict] = None,
+                               direct: str = "auto"):
+        """Scan → device-resident columns (on-device concat of row groups).
+
+        ``direct``: "auto" takes the pq_direct page-span path (payload
+        bytes never touched by host, decode = on-device bitcast) whenever
+        every selected column is eligible, else pyarrow; "always"
+        raises on ineligible columns; "never" forces pyarrow.
+        """
         import jax
         import jax.numpy as jnp
         from nvme_strom_tpu.ops.bridge import host_to_device
+        from nvme_strom_tpu.sql import pq_direct
         dev = device or jax.local_devices()[0]
+
+        if direct not in ("auto", "always", "never"):
+            raise ValueError(f"bad direct={direct!r}")
+        if direct != "never":
+            # One metadata walk: plan_columns both validates eligibility
+            # and computes the page spans (a plan failure IS the
+            # fallback signal — e.g. an encoding the footer can't rule
+            # out, like a non-PLAIN page discovered mid-walk).
+            try:
+                plans = pq_direct.plan_columns(self, columns)
+            except ValueError:
+                if direct == "always":
+                    raise
+                plans = None
+            if plans is not None:
+                cols = pq_direct.read_plain_columns_to_device(
+                    self, columns, device=dev, plans=plans)
+                if dtype_map:
+                    cols = {c: (v.astype(dtype_map[c])
+                                if c in dtype_map else v)
+                            for c, v in cols.items()}
+                return cols
+
         parts: Dict[str, list] = {c: [] for c in columns}
         for tbl in self.iter_row_groups(columns):
             for c in columns:

@@ -1,0 +1,403 @@
+"""Direct Parquet column decode: NVMe pages → device, no pyarrow on the hot
+path.
+
+PG-Strom's distinguishing move is decoding table blocks ON the accelerator
+(SURVEY.md §3.5) — the CPU plans, the device decodes.  The Parquet analogue
+for PLAIN-encoded, uncompressed, fixed-width columns:
+
+- host (metadata-class I/O, tiny): parse the footer (already held by the
+  scanner) and each data-page header — a minimal Thrift compact-protocol
+  reader, ~40 bytes per page — to compute the exact byte spans of raw
+  little-endian values inside the file;
+- device: the spans stream through the O_DIRECT engine and DeviceStream
+  (staging → HBM, zero host-side payload copies), and the 'decode' is an
+  on-device bitcast + concatenate.  Optional columns with no nulls carry an
+  RLE definition-level block per page; its length is read host-side (8
+  bytes) and the span simply starts after it.
+
+Everything else — dictionary encoding, compression, nulls, strings, nested
+schemas — falls back to the pyarrow path in :mod:`.parquet`, which decodes
+on host and honestly counts the handoff copy as bounce.
+
+Why not decode dictionary/RLE on device too?  The formats are
+variable-length bitstreams; a Pallas cursor over them would serialize
+(one varint at a time) — exactly what the MXU/VPU are worst at.  The
+fixed-width PLAIN case covers the analytics-heavy numeric columns that
+config 5 (BASELINE.md) measures, with payload bytes never touched by host.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# Parquet physical types that are raw fixed-width little-endian under PLAIN
+_WIDTHS = {"INT32": 4, "INT64": 8, "FLOAT": 4, "DOUBLE": 8}
+_NP_DTYPES = {"INT32": "<i4", "INT64": "<i8", "FLOAT": "<f4",
+              "DOUBLE": "<f8"}
+
+# Thrift compact-protocol wire types
+_CT_STOP = 0
+_CT_BOOL_TRUE = 1
+_CT_BOOL_FALSE = 2
+_CT_BYTE = 3
+_CT_I16 = 4
+_CT_I32 = 5
+_CT_I64 = 6
+_CT_DOUBLE = 7
+_CT_BINARY = 8
+_CT_LIST = 9
+_CT_SET = 10
+_CT_MAP = 11
+_CT_STRUCT = 12
+
+# parquet-format enums
+_PAGE_DATA = 0
+_PAGE_DICTIONARY = 2
+_PAGE_DATA_V2 = 3
+_ENC_PLAIN = 0
+_ENC_RLE = 3
+
+
+class ThriftError(ValueError):
+    """Malformed/truncated Thrift compact data (or not enough bytes read —
+    callers retry with a bigger window before giving up)."""
+
+
+class _Compact:
+    """Just enough of the Thrift compact protocol to read a Parquet
+    PageHeader: varints, zigzag, field headers, and recursive skip.
+    parquet-format/src/main/thrift/parquet.thrift defines the schema; the
+    reference consumes the same metadata via its SQL host code."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def _byte(self) -> int:
+        if self.pos >= len(self.buf):
+            raise ThriftError("truncated")
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            b = self._byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+            if shift > 63:
+                raise ThriftError("varint overflow")
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_field_header(self, last_id: int) -> Tuple[int, int]:
+        """→ (wire_type, field_id); wire_type 0 = stop."""
+        b = self._byte()
+        if b == _CT_STOP:
+            return 0, 0
+        delta, ctype = b >> 4, b & 0x0F
+        fid = last_id + delta if delta else self.zigzag()
+        return ctype, fid
+
+    def skip(self, ctype: int) -> None:
+        if ctype in (_CT_BOOL_TRUE, _CT_BOOL_FALSE):
+            return
+        if ctype == _CT_BYTE:
+            self._byte()
+        elif ctype in (_CT_I16, _CT_I32, _CT_I64):
+            self.varint()
+        elif ctype == _CT_DOUBLE:
+            self.pos += 8
+            if self.pos > len(self.buf):
+                raise ThriftError("truncated")
+        elif ctype == _CT_BINARY:
+            n = self.varint()
+            self.pos += n
+            if self.pos > len(self.buf):
+                raise ThriftError("truncated")
+        elif ctype in (_CT_LIST, _CT_SET):
+            b = self._byte()
+            n, et = b >> 4, b & 0x0F
+            if n == 15:
+                n = self.varint()
+            for _ in range(n):
+                self.skip(et)
+        elif ctype == _CT_MAP:
+            n = self.varint()
+            if n:
+                b = self._byte()
+                kt, vt = b >> 4, b & 0x0F
+                for _ in range(n):
+                    self.skip(kt)
+                    self.skip(vt)
+        elif ctype == _CT_STRUCT:
+            last = 0
+            while True:
+                t, fid = self.read_field_header(last)
+                if t == 0:
+                    return
+                last = fid
+                self.skip(t)
+        else:
+            raise ThriftError(f"bad compact type {ctype}")
+
+
+@dataclass(frozen=True)
+class PageHeader:
+    type: int
+    compressed_size: int
+    uncompressed_size: int
+    num_values: int          # data pages only (0 otherwise)
+    encoding: int            # data pages only (-1 otherwise)
+    header_len: int          # bytes the Thrift header itself occupies
+    # DataPageHeaderV2 states the level-block lengths explicitly (a v1
+    # reader must instead parse RLE length prefixes from the page body)
+    def_levels_len: int = 0
+    rep_levels_len: int = 0
+
+
+def parse_page_header(buf: bytes) -> PageHeader:
+    """Parse a PageHeader at buf[0].  Raises ThriftError if ``buf`` is too
+    short (callers re-read with a larger window)."""
+    c = _Compact(buf)
+    ptype = comp = uncomp = -1
+    num_values, encoding = 0, -1
+    def_len = rep_len = 0
+    last = 0
+    while True:
+        t, fid = c.read_field_header(last)
+        if t == 0:
+            break
+        last = fid
+        if fid == 1 and t == _CT_I32:
+            ptype = c.zigzag()
+        elif fid == 2 and t == _CT_I32:
+            uncomp = c.zigzag()
+        elif fid == 3 and t == _CT_I32:
+            comp = c.zigzag()
+        elif fid in (5, 8) and t == _CT_STRUCT:
+            # DataPageHeader (v1) / DataPageHeaderV2
+            inner_last = 0
+            while True:
+                it, ifid = c.read_field_header(inner_last)
+                if it == 0:
+                    break
+                inner_last = ifid
+                if ifid == 1 and it == _CT_I32:
+                    num_values = c.zigzag()
+                elif ifid == 2 and it == _CT_I32 and fid == 5:
+                    encoding = c.zigzag()
+                elif ifid == 4 and it == _CT_I32 and fid == 8:
+                    encoding = c.zigzag()
+                elif ifid == 5 and it == _CT_I32 and fid == 8:
+                    def_len = c.zigzag()
+                elif ifid == 6 and it == _CT_I32 and fid == 8:
+                    rep_len = c.zigzag()
+                else:
+                    c.skip(it)
+        else:
+            c.skip(t)
+    if ptype < 0 or comp < 0:
+        raise ThriftError("missing required PageHeader fields")
+    return PageHeader(ptype, comp, uncomp, num_values, encoding, c.pos,
+                      def_len, rep_len)
+
+
+@dataclass(frozen=True)
+class ColumnPlan:
+    """Value-byte spans of one column chunk (one row group)."""
+    spans: Tuple[Tuple[int, int], ...]     # (offset, length) into the file
+    num_values: int
+    physical_type: str
+
+
+def eligible_chunk(meta, rg: int, ci: int) -> Optional[str]:
+    """None if the (row group, column) chunk can decode on device, else a
+    human-readable reason for the pyarrow fallback (surfaced in stats)."""
+    col = meta.row_group(rg).column(ci)
+    sc = meta.schema.column(ci)
+    if col.physical_type not in _WIDTHS:
+        return f"physical type {col.physical_type}"
+    if _WIDTHS[col.physical_type] == 8:
+        import jax
+        if not jax.config.jax_enable_x64:
+            # the on-device bitcast would silently truncate i64/f64
+            return (f"{col.physical_type} needs jax_enable_x64 "
+                    f"(bitcast would truncate)")
+    if (col.compression or "UNCOMPRESSED") != "UNCOMPRESSED":
+        return f"compression {col.compression}"
+    encs = set(col.encodings)
+    if not encs <= {"PLAIN", "RLE"}:
+        return f"encodings {sorted(encs)}"
+    if (col.dictionary_page_offset or 0) > 0:
+        return "dictionary page"
+    if sc.max_repetition_level != 0:
+        return "repeated field"
+    if sc.max_definition_level > 0:
+        st = col.statistics
+        if st is None or st.null_count is None:
+            return "no null statistics"
+        if st.null_count != 0:
+            return f"{st.null_count} nulls"
+    return None
+
+
+def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
+    """Walk the chunk's data pages, returning exact value-byte spans.
+
+    ``raw_read(offset, length) -> bytes`` serves page headers and the RLE
+    level-length prefixes — metadata-class reads (≤ ~1 KiB per page, via
+    buffered I/O like the footer), never payload.
+    """
+    col = meta.row_group(rg).column(ci)
+    sc = meta.schema.column(ci)
+    width = _WIDTHS[col.physical_type]
+    has_def = sc.max_definition_level > 0
+    pos = col.data_page_offset
+    end = col.data_page_offset + col.total_compressed_size
+    remaining = col.num_values
+    spans: List[Tuple[int, int]] = []
+    window = 1 << 10
+    while remaining > 0:
+        if pos >= end:
+            raise ValueError(f"page walk ran past chunk end at {pos}")
+        buf = raw_read(pos, min(window, end - pos))
+        while True:
+            try:
+                ph = parse_page_header(buf)
+                break
+            except ThriftError:
+                if len(buf) >= end - pos:
+                    raise
+                buf = raw_read(pos, min(len(buf) * 2, end - pos))
+        if ph.type in (_PAGE_DATA, _PAGE_DATA_V2):
+            if ph.encoding != _ENC_PLAIN:
+                raise ValueError(f"page encoding {ph.encoding} != PLAIN")
+            data_off = pos + ph.header_len
+            if ph.type == _PAGE_DATA_V2:
+                # v2: level lengths are stated in the header itself
+                level_bytes = ph.def_levels_len + ph.rep_levels_len
+            else:
+                level_bytes = 0
+                if has_def:
+                    # v1 page: definition levels = <u32 len><RLE bytes>
+                    (n,) = struct.unpack("<I", raw_read(data_off, 4))
+                    level_bytes = 4 + n
+            val_off = data_off + level_bytes
+            val_len = ph.num_values * width
+            if val_len + level_bytes > ph.compressed_size:
+                raise ValueError(
+                    f"page at {pos}: {ph.num_values} values x {width} + "
+                    f"{level_bytes} level bytes > page size "
+                    f"{ph.compressed_size}")
+            spans.append((val_off, val_len))
+            remaining -= ph.num_values
+        elif ph.type == _PAGE_DICTIONARY:
+            raise ValueError(f"unexpected page type {ph.type}")
+        # INDEX pages are skipped silently
+        pos += ph.header_len + ph.compressed_size
+    return ColumnPlan(tuple(spans), col.num_values, col.physical_type)
+
+
+def plan_columns(scanner, columns: Sequence[str]
+                 ) -> Dict[str, List[ColumnPlan]]:
+    """Page-walk every (row group, column) chunk → value spans.  Raises
+    ValueError naming the first non-eligible chunk — callers wanting a
+    soft answer use :func:`eligible_chunk` first."""
+    import os
+    meta = scanner.metadata
+    name_to_ci = {meta.schema.column(i).name: i
+                  for i in range(meta.num_columns)}
+    with open(scanner.path, "rb") as f:
+        def raw_read(off: int, ln: int) -> bytes:
+            return os.pread(f.fileno(), ln, off)
+
+        plans: Dict[str, List[ColumnPlan]] = {c: [] for c in columns}
+        for rg in range(meta.num_row_groups):
+            for c in columns:
+                ci = name_to_ci[c]
+                why = eligible_chunk(meta, rg, ci)
+                if why is not None:
+                    raise ValueError(
+                        f"rg{rg}.{c} not direct-eligible: {why}")
+                plans[c].append(plan_chunk(meta, rg, ci, raw_read))
+    return plans
+
+
+def _stream_spans(scanner, ds, fh, spans, physical_type):
+    """spans → one device array (on-device concat + bitcast).
+
+    Spans larger than the engine's staging-buffer size are split into
+    chunk-sized sub-ranges first (writers like parquet-mr can emit pages
+    bigger than chunk_bytes; the on-device concat makes the split
+    invisible)."""
+    import jax.numpy as jnp
+    import numpy as np
+    chunk = scanner.engine.config.chunk_bytes
+    ranges = []
+    for off, ln in spans:
+        while ln > chunk:
+            ranges.append((off, chunk))
+            off += chunk
+            ln -= chunk
+        if ln:
+            ranges.append((off, ln))
+    parts = list(ds.stream_ranges(fh, ranges))
+    flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return flat.view(np.dtype(_NP_DTYPES[physical_type]))
+
+
+def read_plain_columns_to_device(scanner, columns: Sequence[str],
+                                 device=None, plans=None
+                                 ) -> Dict[str, "object"]:
+    """Direct scan of the whole file: {name: device array}, row groups
+    concatenated ON DEVICE.  Payload bytes ride O_DIRECT → staging →
+    device; the host reads only headers.  ``plans`` lets callers reuse a
+    prior :func:`plan_columns` walk."""
+    import jax
+    from nvme_strom_tpu.ops.bridge import DeviceStream
+
+    dev = device or jax.local_devices()[0]
+    plans = plans or plan_columns(scanner, columns)
+    ds = DeviceStream(scanner.engine, device=dev,
+                      depth=scanner.engine.config.queue_depth)
+    out = {}
+    fh = scanner.engine.open(scanner.path)
+    try:
+        for c in columns:
+            out[c] = _stream_spans(
+                scanner, ds, fh, (s for p in plans[c] for s in p.spans),
+                plans[c][0].physical_type)
+    finally:
+        scanner.engine.close(fh)
+    return out
+
+
+def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
+                                    device=None, plans=None):
+    """Yield {name: device array} per row group — the incremental form
+    sql_groupby folds over, so device memory holds one row group of
+    columns at a time regardless of table size.  ``plans`` lets callers
+    reuse a prior :func:`plan_columns` walk."""
+    import jax
+    from nvme_strom_tpu.ops.bridge import DeviceStream
+
+    dev = device or jax.local_devices()[0]
+    plans = plans or plan_columns(scanner, columns)
+    ds = DeviceStream(scanner.engine, device=dev,
+                      depth=scanner.engine.config.queue_depth)
+    fh = scanner.engine.open(scanner.path)
+    try:
+        for rg in range(scanner.metadata.num_row_groups):
+            yield {c: _stream_spans(scanner, ds, fh, plans[c][rg].spans,
+                                    plans[c][rg].physical_type)
+                   for c in columns}
+    finally:
+        scanner.engine.close(fh)

@@ -1,0 +1,255 @@
+"""pq_direct: on-device PLAIN Parquet decode vs pyarrow ground truth.
+
+The fast path must (a) bit-match pyarrow on every supported physical
+type and nullability shape, (b) refuse anything it can't decode with a
+reason, and (c) never touch payload bytes on host (accounting test).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.sql import pq_direct
+from nvme_strom_tpu.sql.parquet import ParquetScanner
+from nvme_strom_tpu.utils.stats import StromStats
+
+
+def _write(path, table, **kw):
+    kw.setdefault("compression", "none")
+    kw.setdefault("use_dictionary", False)
+    pq.write_table(table, path, **kw)
+
+
+@pytest.fixture
+def engine():
+    with StromEngine(stats=StromStats()) as eng:
+        yield eng
+
+
+def _mixed_table(rows=5000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "i32": pa.array(rng.integers(-2**31, 2**31 - 1, rows,
+                                     dtype=np.int64).astype(np.int32)),
+        "i64": pa.array(rng.integers(-2**62, 2**62, rows, dtype=np.int64)),
+        "f32": pa.array(rng.standard_normal(rows).astype(np.float32)),
+        "f64": pa.array(rng.standard_normal(rows)),
+    })
+
+
+def test_direct_matches_pyarrow_32bit(tmp_path, engine):
+    path = str(tmp_path / "t.parquet")
+    tbl = _mixed_table()
+    _write(path, tbl, row_group_size=1200)   # several row groups
+    sc = ParquetScanner(path, engine)
+    assert sc.metadata.num_row_groups > 1
+    cols = ["i32", "f32"]
+    assert all(r is None for r in sc.direct_reasons(cols).values())
+    # 64-bit types are ineligible without x64 (bitcast would truncate)
+    r64 = sc.direct_reasons(["i64", "f64"])
+    assert all("x64" in v for v in r64.values())
+    out = sc.read_columns_to_device(cols, direct="always")
+    for c in cols:
+        np.testing.assert_array_equal(np.asarray(out[c]),
+                                      tbl.column(c).to_numpy())
+
+
+def test_direct_matches_pyarrow_64bit_x64_mode(tmp_path):
+    """i64/f64 decode correctly when jax runs in x64 mode (subprocess:
+    the flag must be set before jax initialises)."""
+    import subprocess
+    import sys
+    path = str(tmp_path / "t64.parquet")
+    tbl = _mixed_table(rows=3000, seed=7)
+    _write(path, tbl, row_group_size=1024)
+    code = f"""
+import sys; sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})
+import jax
+jax.config.update("jax_platforms", "cpu")  # axon ignores JAX_PLATFORMS
+import numpy as np
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.sql.parquet import ParquetScanner
+import pyarrow.parquet as pq
+with StromEngine() as eng:
+    sc = ParquetScanner({repr(path)}, eng)
+    out = sc.read_columns_to_device(["i64", "f64"], direct="always")
+    ref = pq.read_table({repr(path)})
+    np.testing.assert_array_equal(np.asarray(out["i64"]),
+                                  ref.column("i64").to_numpy())
+    np.testing.assert_array_equal(np.asarray(out["f64"]),
+                                  ref.column("f64").to_numpy())
+print("ok64")
+"""
+    env = dict(os.environ, JAX_ENABLE_X64="1", JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "ok64" in r.stdout
+
+
+def test_direct_required_fields_no_def_levels(tmp_path, engine):
+    """nullable=False columns carry no definition levels — the span
+    starts right after the page header."""
+    rng = np.random.default_rng(1)
+    schema = pa.schema([pa.field("v", pa.float32(), nullable=False)])
+    vals = rng.standard_normal(3000).astype(np.float32)
+    tbl = pa.table({"v": pa.array(vals)}, schema=schema)
+    path = str(tmp_path / "req.parquet")
+    _write(path, tbl)
+    sc = ParquetScanner(path, engine)
+    assert sc.metadata.schema.column(0).max_definition_level == 0
+    out = sc.read_columns_to_device(["v"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out["v"]), vals)
+
+
+def test_direct_rejects_with_reasons(tmp_path, engine):
+    rng = np.random.default_rng(2)
+    rows = 2000
+
+    # dictionary-encoded
+    p1 = str(tmp_path / "dict.parquet")
+    pq.write_table(pa.table({"v": pa.array(
+        rng.integers(0, 4, rows, dtype=np.int32))}), p1,
+        compression="none", use_dictionary=True)
+    r = ParquetScanner(p1, engine).direct_reasons(["v"])
+    assert r["v"] is not None
+
+    # compressed
+    p2 = str(tmp_path / "snappy.parquet")
+    pq.write_table(pa.table({"v": pa.array(
+        rng.standard_normal(rows).astype(np.float32))}), p2,
+        compression="snappy", use_dictionary=False)
+    r = ParquetScanner(p2, engine).direct_reasons(["v"])
+    assert r["v"] is not None and "compression" in r["v"]
+
+    # nulls present (a real Arrow null — NaN would NOT count)
+    p3 = str(tmp_path / "nulls.parquet")
+    vals = [float(x) for x in rng.standard_normal(rows)]
+    vals[7] = None
+    _write(p3, pa.table({"v": pa.array(vals, type=pa.float32())}))
+    r = ParquetScanner(p3, engine).direct_reasons(["v"])
+    assert r["v"] is not None and "null" in r["v"]
+
+    # unsupported physical type (strings)
+    p4 = str(tmp_path / "str.parquet")
+    _write(p4, pa.table({"v": pa.array(["a"] * rows)}))
+    r = ParquetScanner(p4, engine).direct_reasons(["v"])
+    assert r["v"] is not None
+
+    # direct="always" raises; "auto" still answers correctly
+    sc = ParquetScanner(p3, engine)
+    with pytest.raises(ValueError, match="not direct-eligible"):
+        sc.read_columns_to_device(["v"], direct="always")
+
+
+def test_groupby_direct_equals_pyarrow_path(tmp_path, engine):
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    rng = np.random.default_rng(3)
+    rows, groups = 20000, 32
+    tbl = pa.table({
+        "k": pa.array(rng.integers(0, groups, rows, dtype=np.int32)),
+        "v": pa.array(rng.standard_normal(rows).astype(np.float32))})
+    path = str(tmp_path / "g.parquet")
+    _write(path, tbl, row_group_size=4096)
+    sc = ParquetScanner(path, engine)
+    assert all(r is None for r in sc.direct_reasons(["k", "v"]).values())
+    out = sql_groupby(sc, "k", "v", groups, aggs=("count", "sum", "mean"))
+
+    keys = tbl.column("k").to_numpy()
+    vals = tbl.column("v").to_numpy()
+    exp_count = np.bincount(keys, minlength=groups)
+    exp_sum = np.bincount(keys, weights=vals.astype(np.float64),
+                          minlength=groups)
+    np.testing.assert_array_equal(np.asarray(out["count"]), exp_count)
+    np.testing.assert_allclose(np.asarray(out["sum"]), exp_sum,
+                               rtol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(out["mean"]), exp_sum / np.maximum(exp_count, 1),
+        rtol=2e-4)
+
+
+def test_direct_payload_bytes_never_bounce(tmp_path, monkeypatch):
+    """Direct scan accounting: payload goes engine→device with no
+    Python-side copy; the only counted bounce is the CPU device_put
+    alias-protection copy (zero on an accelerator)."""
+    monkeypatch.setenv("STROM_NO_RESIDENCY_PROBE", "1")
+    rng = np.random.default_rng(4)
+    rows = 8192
+    tbl = pa.table({"v": pa.array(rng.standard_normal(rows)
+                                  .astype(np.float32))})
+    path = str(tmp_path / "acct.parquet")
+    _write(path, tbl)
+
+    stats = StromStats()
+    with StromEngine(stats=stats) as eng:
+        fh = eng.open(path)
+        is_direct = eng.file_is_direct(fh)
+        eng.close(fh)
+        if not is_direct:
+            pytest.skip("fs rejects O_DIRECT")
+        sc = ParquetScanner(path, eng)
+        out = sc.read_columns_to_device(["v"], direct="always")
+        np.testing.assert_array_equal(np.asarray(out["v"]),
+                                      tbl.column("v").to_numpy())
+        eng.sync_stats()
+    payload = rows * 4
+    assert stats.bytes_to_device == payload
+    import jax
+    expected_bounce = (payload if jax.devices()[0].platform == "cpu"
+                       else 0)
+    assert stats.bounce_bytes == expected_bounce
+
+
+def test_direct_v2_data_pages(tmp_path, engine):
+    """DataPageHeaderV2 states level lengths in the header; the direct
+    scan must decode v2 files identically (and not crash 'auto')."""
+    rng = np.random.default_rng(6)
+    vals = rng.standard_normal(6000).astype(np.float32)
+    keys = rng.integers(0, 9, 6000, dtype=np.int32)
+    tbl = pa.table({"k": pa.array(keys), "v": pa.array(vals)})
+    path = str(tmp_path / "v2.parquet")
+    _write(path, tbl, row_group_size=2048, data_page_version="2.0")
+    sc = ParquetScanner(path, engine)
+    out = sc.read_columns_to_device(["k", "v"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out["k"]), keys)
+    np.testing.assert_array_equal(np.asarray(out["v"]), vals)
+
+
+def test_direct_span_larger_than_chunk(tmp_path):
+    """Pages bigger than the engine's staging buffers split into
+    chunk-sized sub-ranges (on-device concat reassembles)."""
+    from nvme_strom_tpu.utils.config import EngineConfig
+    rng = np.random.default_rng(8)
+    vals = rng.standard_normal(100_000).astype(np.float32)  # 400 KB
+    tbl = pa.table({"v": pa.array(vals)})
+    path = str(tmp_path / "big.parquet")
+    _write(path, tbl, data_page_size=1 << 20)   # one big page
+    cfg = EngineConfig(chunk_bytes=64 << 10)    # 64 KiB staging buffers
+    with StromEngine(cfg) as eng:
+        sc = ParquetScanner(path, eng)
+        out = sc.read_columns_to_device(["v"], direct="always")
+        np.testing.assert_array_equal(np.asarray(out["v"]), vals)
+
+
+def test_page_header_parser_roundtrip(tmp_path, engine):
+    """plan_chunk's spans exactly tile the values: total span bytes ==
+    num_values * width for every chunk, and spans are in-file order."""
+    path = str(tmp_path / "p.parquet")
+    tbl = _mixed_table(rows=10000, seed=5)
+    _write(path, tbl, row_group_size=2048, data_page_size=4096)
+    sc = ParquetScanner(path, engine)
+    plans = pq_direct.plan_columns(sc, ["i32", "f32"])
+    meta = sc.metadata
+    for c, per_rg in plans.items():
+        assert len(per_rg) == meta.num_row_groups
+        for rg, plan in enumerate(per_rg):
+            width = pq_direct._WIDTHS[plan.physical_type]
+            assert sum(ln for _, ln in plan.spans) \
+                == plan.num_values * width
+            assert len(plan.spans) > 1   # data_page_size forced paging
+            offs = [o for o, _ in plan.spans]
+            assert offs == sorted(offs)
