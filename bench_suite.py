@@ -13,10 +13,12 @@ number:
   5 sql     — Parquet row-group scan → on-device GROUP BY aggregate
   6 decode  — autoregressive generation, tokens/sec (compute row)
   7 train   — train-step model-FLOPs utilisation (compute row)
+  8 multi   — N concurrent streams through one engine vs serial (the
+              striped-raid0 scaling story's engine-side requirement)
 
 Usage: python bench_suite.py [--config N ... | --all] [--json-only]
 
-I/O rows (1–5): {"metric", "value" (GiB/s payload→device), "unit",
+I/O rows (1–5, 8): {"metric", "value" (GiB/s payload→device), "unit",
 "vs_baseline" (value / 0.9·min(raw SSD, host→device link) — the
 BASELINE.json north star; ≥1.0 means target met)}.  Discipline per the
 round-1 verdict: run 0 warms jit/IPC caches and is DISCARDED, the page
@@ -289,6 +291,67 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
     return _steady([path], one_scan), rows
 
 
+def bench_multistream(engine, nbytes: int,
+                      n_streams: int = 4) -> tuple[float, str]:
+    """Config 8: N concurrent file streams through ONE engine vs the same
+    files read serially.  The reference's striped-raid0 story is multiple
+    NVMe queues busy at once (BASELINE.md 6–10 GB/s over 3–4 SSDs); the
+    engine-side requirement that story rests on is that concurrent
+    streams share the queue without collapsing — scaling ≈1.0 on one SSD
+    (both serial and concurrent saturate the device), >1 only on striped
+    or multi-device rigs."""
+    from concurrent.futures import ThreadPoolExecutor
+    per = max(1 << 20, nbytes // n_streams) & ~4095
+    paths = []
+    for s in range(n_streams):
+        p = os.path.join(_scratch_dir(), f"ms-{s}.bin")
+        bench.make_file(p, per)
+        paths.append(p)
+
+    def read_one(path: str, depth: int) -> None:
+        fh = engine.open(path)
+        try:
+            size = engine.file_size(fh)
+            chunk = engine.config.chunk_bytes
+            pend = []
+            for off in range(0, size, chunk):
+                pend.append(engine.submit_read(
+                    fh, off, min(chunk, size - off)))
+                if len(pend) >= depth:
+                    p = pend.pop(0)
+                    p.wait()
+                    p.release()
+            for p in pend:
+                p.wait()
+                p.release()
+        finally:
+            engine.close(fh)
+
+    # Same TOTAL in-flight budget for both passes (the full queue depth):
+    # serial runs one stream at full depth, concurrent N streams at
+    # depth/N.  A throttled serial baseline would fake >1.0 scaling on a
+    # single SSD, which is exactly the dishonesty this row must not have.
+    full_depth = max(2, engine.config.queue_depth)
+    per_stream_depth = max(2, engine.config.queue_depth // n_streams)
+
+    def serial_pass() -> float:
+        t0 = time.monotonic()
+        for p in paths:
+            read_one(p, full_depth)
+        return n_streams * per / (1 << 30) / (time.monotonic() - t0)
+
+    def concurrent_pass() -> float:
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(n_streams) as ex:
+            list(ex.map(lambda p: read_one(p, per_stream_depth), paths))
+        return n_streams * per / (1 << 30) / (time.monotonic() - t0)
+
+    serial = _steady(paths, serial_pass)
+    conc = _steady(paths, concurrent_pass)
+    scaling = conc / serial if serial > 0 else 0.0
+    return conc, f"streams={n_streams} scaling={scaling:.2f}x vs serial"
+
+
 # --------------------------- compute rows ------------------------------
 
 #: per-chip dense bf16 peak FLOP/s (public spec sheets), matched by
@@ -420,6 +483,14 @@ def run(configs: list[int]) -> list[dict]:
     with StromEngine(EngineConfig(), stats=stats) as engine:
         _log(f"suite: backend={engine.backend} bytes/config={nbytes >> 20}"
              f"MiB dev={dev_tag}")
+        # Backing-device topology: makes a striped (md-raid0) rig — the
+        # reference's 6-10 GB/s configuration — observable in the log.
+        from nvme_strom_tpu.io.engine import resolve_device
+        dinfo = resolve_device(_scratch_dir())
+        _log(f"suite: blockdev={dinfo.device or 'none'} "
+             f"nvme={dinfo.is_nvme} "
+             f"raid_level={dinfo.raid_level if dinfo.is_raid else None} "
+             f"members={list(dinfo.members)}")
         raw = bench.bench_raw(engine, raw_path)
         link = bench.bench_link()
         ceiling = 0.9 * (min(raw, link) if raw > 0 and link > 0
@@ -443,6 +514,8 @@ def run(configs: list[int]) -> list[dict]:
                 "GiB/s", True),
             6: ("decode-throughput", bench_decode, "tok/s", False),
             7: ("train-step-flops", bench_train, "TFLOP/s", False),
+            8: ("multistream-scaling",
+                lambda: bench_multistream(engine, nbytes), "GiB/s", True),
         }
         for c in configs:
             label, fn, unit, io_row = names[c]
@@ -474,12 +547,12 @@ def run(configs: list[int]) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 8))
+                    choices=range(1, 9))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = [1, 2, 3, 4, 5, 6, 7]
+        configs = [1, 2, 3, 4, 5, 6, 7, 8]
     for line in run(configs):
         print(json.dumps(line), flush=True)
     return 0

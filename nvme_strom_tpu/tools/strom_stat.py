@@ -24,10 +24,36 @@ import time
 from nvme_strom_tpu.utils.stats import human_bytes as _human
 
 _COUNTERS = (
-    "bytes_direct", "bytes_fallback", "bounce_bytes", "bytes_to_device",
-    "bytes_written_direct", "requests_submitted", "requests_completed",
-    "requests_failed", "retries",
+    "bytes_direct", "bytes_fallback", "bytes_resident", "bounce_bytes",
+    "bytes_to_device", "bytes_written_direct", "requests_submitted",
+    "requests_completed", "requests_failed", "retries",
 )
+
+
+def render_device(path: str) -> str:
+    """Backing-device topology of ``path`` — the observable form of the
+    reference's md-raid0 member walk (SURVEY.md §2/§3.1): a striped rig
+    shows its members here, so a multi-SSD setup is verifiable from the
+    CLI before any benchmark runs."""
+    from nvme_strom_tpu.io.engine import resolve_device
+    d = resolve_device(path)
+    lines = [f"device topology for {path}:"]
+    if not d.device:
+        lines.append("  no visible backing blockdev "
+                     "(overlay/tmpfs/network fs)")
+        return "\n".join(lines)
+    kind = ("nvme" if d.is_nvme else
+            "rotational" if d.rotational == 1 else "non-nvme")
+    lines.append(f"  blockdev    {d.device} ({kind})")
+    if d.is_raid:
+        lvl = f"raid{d.raid_level}" if d.raid_level >= 0 else "md (unknown)"
+        lines.append(f"  md level    {lvl}, {len(d.members)} members")
+        for m in d.members:
+            tag = "nvme" if m.startswith("nvme") else "non-nvme"
+            lines.append(f"    member    {m} ({tag})")
+    lines.append(f"  direct-DMA eligible (nvme or all-nvme raid0): "
+                 f"{'yes' if d.nvme_backed else 'no'}")
+    return "\n".join(lines)
 
 
 def load(path: str) -> dict:
@@ -74,7 +100,19 @@ def main(argv=None) -> int:
                     help="dump raw JSON instead of the table")
     ap.add_argument("--watch", type=float, default=None, metavar="SECS",
                     help="re-read and print rates every SECS seconds")
+    ap.add_argument("--device", metavar="PATH", default=None,
+                    help="print backing-device topology (md-raid members) "
+                         "for PATH and exit")
     args = ap.parse_args(argv)
+
+    if args.device is not None:
+        try:
+            print(render_device(args.device))
+        except OSError as e:
+            print(f"strom_stat: cannot resolve {args.device}: {e}",
+                  file=sys.stderr)
+            return 2
+        return 0
 
     if not args.path:
         print("strom_stat: no stats file — pass a path or set "

@@ -377,3 +377,52 @@ def test_residency_planned_reads(tmp_path):
         if stats.bytes_resident > warm_resident:
             pytest.skip("page cache not evictable in this environment")
         assert stats.bytes_direct >= len(data)
+
+
+def test_concurrent_streams_one_engine(engine, tmp_path):
+    """Config-8 requirement: N threads streaming distinct files through
+    ONE engine — content-correct, no failures, all bytes accounted."""
+    import threading
+
+    import numpy as np
+
+    n_streams, per = 4, 1 << 20
+    rng = np.random.default_rng(11)
+    payloads, paths = [], []
+    for s in range(n_streams):
+        data = rng.integers(0, 256, per, dtype=np.uint8).tobytes()
+        p = tmp_path / f"s{s}.bin"
+        p.write_bytes(data)
+        payloads.append(data)
+        paths.append(str(p))
+
+    errors = []
+
+    def stream(idx: int) -> None:
+        try:
+            fh = engine.open(paths[idx])
+            got = bytearray()
+            chunk = 256 << 10
+            pend = []
+            for off in range(0, per, chunk):
+                pend.append(engine.submit_read(fh, off, chunk))
+            for p in pend:
+                v = p.wait()
+                got.extend(bytes(v))
+                p.release()
+            engine.close(fh)
+            if bytes(got) != payloads[idx]:
+                errors.append(f"stream {idx}: payload mismatch")
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(f"stream {idx}: {e!r}")
+
+    threads = [threading.Thread(target=stream, args=(i,))
+               for i in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    engine.sync_stats()
+    assert engine.stats.requests_failed == 0
+    assert engine.stats.total_payload_bytes >= n_streams * per

@@ -102,3 +102,16 @@ def test_strom_stat_missing_file(capsys, tmp_path, monkeypatch):
     monkeypatch.delenv("STROM_STATS_EXPORT", raising=False)
     assert strom_stat.main([]) == 2
     assert strom_stat.main([str(tmp_path / "absent.json")]) == 2
+
+
+def test_strom_stat_device_topology(capsys, tmp_path):
+    """--device prints the backing blockdev walk (raid members when
+    striped) — the observable form of the reference's md-raid0 check."""
+    rc = strom_stat.main(["--device", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device topology" in out
+    # Either a real blockdev (with the DMA-eligibility verdict) or an
+    # honest no-blockdev report on overlay/tmpfs.
+    assert ("direct-DMA eligible" in out
+            or "no visible backing blockdev" in out)
