@@ -908,6 +908,8 @@ void strom_get_pool_info(strom_engine *e, strom_pool_info *out) {
   out->in_flight = (uint32_t)e->reqs.size();
   out->deferred = (uint32_t)e->defer_q.size();
   out->fixed_bufs = e->ring.fixed_bufs ? 1 : 0;
+  out->pad = 0;
+  out->pool_base = (uint64_t)(uintptr_t)e->pool;
 }
 
 int strom_open(strom_engine *e, const char *path, int flags) {

@@ -150,6 +150,10 @@ typedef struct strom_pool_info {
   uint32_t deferred;      /* submitted, waiting for a free buffer      */
   int32_t  fixed_bufs;    /* 1 if pool registered as io_uring fixed
                              buffers (pin-once, READ_FIXED/WRITE_FIXED) */
+  uint32_t pad;
+  uint64_t pool_base;     /* staging pool base address: lets callers
+                             PROVE a returned view aliases the pool
+                             (zero-copy up to the device boundary)      */
 } strom_pool_info;
 
 void strom_get_pool_info(strom_engine *eng, strom_pool_info *out);

@@ -74,6 +74,8 @@ class _PoolInfo(ctypes.Structure):
         ("in_flight", ctypes.c_uint32),
         ("deferred", ctypes.c_uint32),
         ("fixed_bufs", ctypes.c_int32),
+        ("pad", ctypes.c_uint32),
+        ("pool_base", ctypes.c_uint64),
     ]
 
 

@@ -115,3 +115,15 @@ def test_strom_stat_device_topology(capsys, tmp_path):
     # honest no-blockdev report on overlay/tmpfs.
     assert ("direct-DMA eligible" in out
             or "no visible backing blockdev" in out)
+
+
+def test_transfer_diag_alias_proof(capsys):
+    """The zero-copy claim's evidence: a wait() view's data pointer lies
+    inside the mlock'd staging pool, 4 KiB-aligned (VERDICT weak #3 —
+    instrumentation for the device boundary)."""
+    from nvme_strom_tpu.tools import transfer_diag
+    res = transfer_diag.run(1 << 20, repeats=2)
+    assert res["view_in_pool"] is True
+    assert res["view_aligned"] is True
+    assert res["verdict"] == "zero-copy to PJRT boundary"
+    assert res["t_staging_s"] > 0 and res["t_copy_heap_s"] > 0
