@@ -32,6 +32,12 @@ class TransformerConfig:
     d_ff: int = 1408
     max_seq: int = 2048
     rope_theta: float = 10000.0
+    # Llama-3.1-style rope scaling: None, or a dict with rope_type
+    # "llama3" and keys factor / low_freq_factor / high_freq_factor /
+    # original_max_position_embeddings (HF config.json "rope_scaling").
+    # Stored canonically as a sorted (key, value) tuple so the frozen
+    # config stays hashable (cfg is a static jit argument for callers).
+    rope_scaling: object = None
     norm_eps: float = 1e-5
     dtype: object = jnp.bfloat16  # activation/compute dtype (MXU-friendly)
     # Mixture-of-experts (models/moe.py): 0 experts == dense model.
@@ -47,6 +53,17 @@ class TransformerConfig:
     # extra forward's FLOPs for O(1)-layers activation memory — the HBM
     # lever for deep configs.
     remat: bool = False
+
+    def __post_init__(self):
+        if isinstance(self.rope_scaling, dict):
+            object.__setattr__(
+                self, "rope_scaling",
+                tuple(sorted(self.rope_scaling.items())))
+
+    @property
+    def rope_scaling_dict(self):
+        """rope_scaling as the dict _rope consumes (None if unset)."""
+        return dict(self.rope_scaling) if self.rope_scaling else None
 
     @property
     def head_dim(self) -> int:
@@ -120,18 +137,43 @@ def rms_norm(x, weight, eps):
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
 
 
-def _rope(q, k, theta, positions=None):
-    """Rotary position embeddings over the last dim (pairs).
+def _llama3_scale_freqs(freqs, scaling: dict):
+    """Llama-3.1 frequency remap (HF ROPE_INIT_FUNCTIONS["llama3"]):
+    long-wavelength components are divided by ``factor``, short ones kept,
+    with a smooth ramp between — extends context without retraining."""
+    factor = float(scaling["factor"])
+    low = float(scaling.get("low_freq_factor", 1.0))
+    high = float(scaling.get("high_freq_factor", 4.0))
+    orig = float(scaling["original_max_position_embeddings"])
+    wavelen = 2.0 * np.pi / freqs
+    smooth = (orig / wavelen - low) / (high - low)
+    smooth = jnp.clip(smooth, 0.0, 1.0)
+    return jnp.where(wavelen > orig / low, freqs / factor,
+                     jnp.where(wavelen < orig / high, freqs,
+                               (1 - smooth) * freqs / factor
+                               + smooth * freqs))
+
+
+def _rope(q, k, theta, positions=None, scaling=None):
+    """Rotary position embeddings, half-split convention (x split into
+    two halves rotated against each other — the same convention as HF
+    Llama's rotate_half, so converted checkpoints need no permutation).
 
     ``positions``: absolute token positions, shape (seq,); defaults to
     arange(seq).  The decode path passes the cache write position so an
     incrementally-generated token gets the same rotation it would in a
-    full forward pass (models/decode.py)."""
+    full forward pass (models/decode.py).  ``scaling``: optional
+    Llama-3.1 rope_scaling dict (see TransformerConfig)."""
     seq = q.shape[-2]
     half = q.shape[-1] // 2
     if positions is None:
         positions = jnp.arange(seq, dtype=jnp.float32)
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        rt = scaling.get("rope_type", scaling.get("type"))
+        if rt != "llama3":
+            raise NotImplementedError(f"rope_scaling type {rt!r}")
+        freqs = _llama3_scale_freqs(freqs, scaling)
     ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
     cos, sin = jnp.cos(ang), jnp.sin(ang)
 
@@ -167,7 +209,8 @@ def qkv_project(x, p, prefix, cfg: TransformerConfig, positions=None):
     k = (x @ p[prefix + "wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
     v = (x @ p[prefix + "wv"].astype(x.dtype)).reshape(b, s, nkv, hd)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # b h s d
-    q, k = _rope(q, k, cfg.rope_theta, positions=positions)
+    q, k = _rope(q, k, cfg.rope_theta, positions=positions,
+                 scaling=cfg.rope_scaling_dict)
     return q, k, v
 
 

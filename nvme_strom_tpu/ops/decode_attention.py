@@ -128,5 +128,13 @@ def decode_attention(q, k, v, pos, *, scale=None, block_k: int = 512,
 def make_decode_attn(**kw):
     """cache_attn(q, k_cache, v_cache, pos) for models.decode.decode_step
     — the fused Pallas replacement for its masked dense einsum.  Receives
-    the cache at kv-head width (no GQA expansion)."""
+    the cache at kv-head width (no GQA expansion).
+
+    When to use (measured on v5e, d=2048 L=8 b=8, steady-state decode
+    with prefill time subtracted): the kernel wins on LONG caches — 3066
+    vs 1813 tok/s at S≈1856 (~1.7x) — because it never materializes the
+    masked (h, S) score row in HBM; XLA's fused einsum wins on short
+    caches (6726 vs 4916 tok/s at S≈160) where per-call kernel overhead
+    dominates.  Rule of thumb: prefer the kernel once the live cache
+    length clears ~1k positions."""
     return functools.partial(decode_attention, **kw)

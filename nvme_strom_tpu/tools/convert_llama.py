@@ -1,0 +1,250 @@
+"""convert_llama — HuggingFace Llama checkpoints → nvme_strom_tpu layout.
+
+BASELINE config 4's story is "Llama-3 8B safetensors weight shards on NVMe
+→ lazy HBM param load"; real shards come from the HF hub with HF naming
+(``model.layers.N.self_attn.q_proj.weight``, (out, in) layout) while
+:mod:`nvme_strom_tpu.models.transformer` names them ``layers.N.wq`` with
+(in, out) layout.  This tool converts once, offline, on host (copies here
+are deliberate and off the hot path); after conversion
+``parallel.weights.LazyCheckpoint`` serves the shards with per-device
+ranged O_DIRECT reads like any native checkpoint.
+
+Semantic parity notes (verified by tests/test_convert_llama.py against
+``transformers``' reference implementation):
+
+- RoPE: both implementations rotate half-split features with
+  ``theta^(-i/half)`` frequencies — identical convention, so NO head-dim
+  permutation is needed (unlike Meta→HF conversions).
+- rms_norm epsilon-inside-rsqrt, SiLU-gated MLP, GQA via head repeat,
+  1/sqrt(head_dim) attention scale: all match.
+- Projection weights transpose (HF nn.Linear stores (out, in)); the token
+  embedding is (vocab, d) on both sides and copies as-is; tied embeddings
+  (``tie_word_embeddings``) materialize an explicit transposed ``lm_head``.
+
+Usage:
+    python -m nvme_strom_tpu.tools.convert_llama HF_DIR OUT_DIR \
+        [--shard-bytes BYTES]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+_LAYER_RULES: Tuple[Tuple[str, str, bool], ...] = (
+    # (HF suffix, our suffix, transpose)
+    ("self_attn.q_proj.weight", "wq", True),
+    ("self_attn.k_proj.weight", "wk", True),
+    ("self_attn.v_proj.weight", "wv", True),
+    ("self_attn.o_proj.weight", "wo", True),
+    ("mlp.gate_proj.weight", "w_gate", True),
+    ("mlp.up_proj.weight", "w_up", True),
+    ("mlp.down_proj.weight", "w_down", True),
+    ("input_layernorm.weight", "attn_norm", False),
+    ("post_attention_layernorm.weight", "mlp_norm", False),
+)
+
+_TOP_RULES: Dict[str, Tuple[str, bool]] = {
+    "model.embed_tokens.weight": ("tok_embed", False),
+    "model.norm.weight": ("final_norm", False),
+    "lm_head.weight": ("lm_head", True),
+}
+
+_LAYER_RE = re.compile(r"^model\.layers\.(\d+)\.(.+)$")
+
+#: non-weight buffers some exports carry — safe to drop silently.  Any
+#: OTHER unmapped tensor is a hard error: a bias or adapter weight we
+#: drop would convert into a complete-looking but numerically wrong model.
+_SKIP_OK_RE = re.compile(r"rotary_emb\.inv_freq$")
+
+
+def map_name(hf_name: str) -> Optional[Tuple[str, bool]]:
+    """HF tensor name → (our name, needs_transpose); None = not mapped
+    (convert() decides whether that's a benign buffer or an error)."""
+    if hf_name in _TOP_RULES:
+        return _TOP_RULES[hf_name]
+    m = _LAYER_RE.match(hf_name)
+    if m:
+        idx, rest = m.group(1), m.group(2)
+        for hf_suffix, ours, tr in _LAYER_RULES:
+            if rest == hf_suffix:
+                return f"layers.{idx}.{ours}", tr
+    return None
+
+
+def config_from_hf(hf_cfg: dict):
+    """HF ``config.json`` → TransformerConfig (dense Llama family).
+
+    Raises on architecture knobs the model does not implement — silently
+    ignoring them (e.g. a non-SiLU activation) would convert into a model
+    with wrong logits."""
+    from nvme_strom_tpu.models.transformer import TransformerConfig
+    act = hf_cfg.get("hidden_act", "silu")
+    if act != "silu":
+        raise ValueError(f"unsupported hidden_act {act!r} (model is "
+                         "SiLU-gated)")
+    for knob in ("attention_bias", "mlp_bias"):
+        if hf_cfg.get(knob):
+            raise ValueError(f"unsupported {knob}=True (model has no "
+                             "bias terms)")
+    derived_hd = hf_cfg["hidden_size"] // hf_cfg["num_attention_heads"]
+    if hf_cfg["hidden_size"] % hf_cfg["num_attention_heads"]:
+        raise ValueError("hidden_size not divisible by num_attention_heads")
+    if hf_cfg.get("head_dim", derived_hd) != derived_hd:
+        # recent HF configs may carry an explicit head_dim decoupled from
+        # hidden_size/n_heads; TransformerConfig derives it, so a
+        # mismatch would only explode later inside qkv_project
+        raise ValueError(
+            f"unsupported explicit head_dim={hf_cfg['head_dim']} "
+            f"(model derives {derived_hd} = hidden_size/num_heads)")
+    scaling = hf_cfg.get("rope_scaling")
+    if scaling is not None:
+        rt = scaling.get("rope_type", scaling.get("type"))
+        if rt != "llama3":
+            raise ValueError(f"unsupported rope_scaling type {rt!r} "
+                             "(only llama3 frequency scaling)")
+        scaling = {k: v for k, v in scaling.items()
+                   if k in ("rope_type", "type", "factor",
+                            "low_freq_factor", "high_freq_factor",
+                            "original_max_position_embeddings")}
+    return TransformerConfig(
+        vocab=hf_cfg["vocab_size"],
+        d_model=hf_cfg["hidden_size"],
+        n_layers=hf_cfg["num_hidden_layers"],
+        n_heads=hf_cfg["num_attention_heads"],
+        n_kv_heads=hf_cfg.get("num_key_value_heads",
+                              hf_cfg["num_attention_heads"]),
+        d_ff=hf_cfg["intermediate_size"],
+        max_seq=hf_cfg.get("max_position_embeddings", 2048),
+        rope_theta=float(hf_cfg.get("rope_theta", 10000.0)),
+        rope_scaling=scaling,
+        norm_eps=float(hf_cfg.get("rms_norm_eps", 1e-5)),
+    )
+
+
+def _iter_hf_tensors(hf_dir: str) -> Iterator[Tuple[str, np.ndarray]]:
+    """Yield (hf_name, np array) across every safetensors shard of the
+    checkpoint.  Shard discovery (dir / index.json / single file) is
+    LazyCheckpoint's — one implementation, shared."""
+    from nvme_strom_tpu.formats.safetensors import _np_dtype
+    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+    idx_path = os.path.join(hf_dir, "model.safetensors.index.json")
+    ckpt = LazyCheckpoint(idx_path if os.path.exists(idx_path) else hf_dir)
+    for sf in ckpt.files:
+        with open(sf.path, "rb") as f:
+            for name in sf.keys():
+                t = sf.tensors[name]
+                f.seek(t["offset"])
+                raw = f.read(t["nbytes"])
+                arr = np.frombuffer(raw, dtype=_np_dtype(t["dtype"]))
+                yield name, arr.reshape(t["shape"])
+
+
+def convert(hf_dir: str, out_dir: str, shard_bytes: int = 1 << 30,
+            ignore_unmapped: bool = False) -> dict:
+    """Convert an HF Llama checkpoint dir → our sharded safetensors +
+    ``strom_config.json``.  Returns a summary dict.
+
+    Unmapped WEIGHT tensors are a hard error (the converted model would
+    be silently wrong); known non-weight buffers (rotary inv_freq) are
+    dropped.  ``ignore_unmapped=True`` downgrades the error to the
+    summary's ``skipped`` list — for callers who know what they're
+    dropping."""
+    from nvme_strom_tpu.formats.safetensors import write_safetensors
+    os.makedirs(out_dir, exist_ok=True)
+    # A rerun with different sharding would leave stale trailing shards
+    # beside the fresh ones — LazyCheckpoint would then see duplicate
+    # tensors and refuse the whole directory. Clear our own output
+    # pattern first (only strom-*: never touch anything else).
+    for stale in os.listdir(out_dir):
+        if re.fullmatch(r"strom-\d{5}\.safetensors", stale):
+            os.unlink(os.path.join(out_dir, stale))
+    with open(os.path.join(hf_dir, "config.json")) as f:
+        hf_cfg = json.load(f)
+    cfg = config_from_hf(hf_cfg)
+
+    pending: Dict[str, np.ndarray] = {}
+    pending_bytes = 0
+    shards = []
+    seen = set()
+    embed: Optional[np.ndarray] = None
+
+    def flush():
+        nonlocal pending, pending_bytes
+        if not pending:
+            return
+        p = os.path.join(out_dir, f"strom-{len(shards):05d}.safetensors")
+        write_safetensors(p, pending)
+        shards.append(p)
+        pending, pending_bytes = {}, 0
+
+    def emit(name: str, arr: np.ndarray):
+        nonlocal pending_bytes
+        pending[name] = arr
+        pending_bytes += arr.nbytes
+        if pending_bytes >= shard_bytes:
+            flush()
+
+    skipped = []
+    for hf_name, arr in _iter_hf_tensors(hf_dir):
+        mapped = map_name(hf_name)
+        if mapped is None:
+            if not (_SKIP_OK_RE.search(hf_name) or ignore_unmapped):
+                raise ValueError(
+                    f"unmapped weight tensor {hf_name!r} — converting "
+                    "without it would produce a numerically wrong model "
+                    "(pass ignore_unmapped=True / --ignore-unmapped to "
+                    "drop it anyway)")
+            skipped.append(hf_name)
+            continue
+        ours, transpose = mapped
+        # bf16 fields load as uint16 views via numpy; keep raw dtype
+        out = np.ascontiguousarray(arr.T) if transpose else arr
+        if ours == "tok_embed":
+            embed = arr
+        seen.add(ours)
+        emit(ours, out)
+
+    if "lm_head" not in seen:
+        if not hf_cfg.get("tie_word_embeddings", False) or embed is None:
+            raise ValueError("checkpoint has no lm_head.weight and "
+                             "tie_word_embeddings is not set")
+        emit("lm_head", np.ascontiguousarray(embed.T))
+        seen.add("lm_head")
+    flush()
+
+    cfg_out = {k: getattr(cfg, k) for k in (
+        "vocab", "d_model", "n_layers", "n_heads", "n_kv_heads", "d_ff",
+        "max_seq", "rope_theta", "norm_eps")}
+    if cfg.rope_scaling:
+        cfg_out["rope_scaling"] = dict(cfg.rope_scaling)
+    with open(os.path.join(out_dir, "strom_config.json"), "w") as f:
+        json.dump(cfg_out, f, indent=1)
+    return {"tensors": len(seen), "shards": len(shards),
+            "skipped": skipped, "config": cfg_out}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="convert_llama",
+        description="HF Llama checkpoint → nvme_strom_tpu safetensors")
+    ap.add_argument("hf_dir")
+    ap.add_argument("out_dir")
+    ap.add_argument("--shard-bytes", type=int, default=1 << 30)
+    ap.add_argument("--ignore-unmapped", action="store_true",
+                    help="drop unmapped weight tensors instead of erroring")
+    args = ap.parse_args(argv)
+    summary = convert(args.hf_dir, args.out_dir, args.shard_bytes,
+                      ignore_unmapped=args.ignore_unmapped)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

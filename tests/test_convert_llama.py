@@ -1,0 +1,163 @@
+"""convert_llama: numerical parity with HuggingFace's Llama.
+
+The strongest possible check for config 4's real-world story: build a tiny
+``transformers`` LlamaForCausalLM, save it as HF safetensors, convert with
+our tool, lazy-load through the engine, and compare logits token-for-token
+with the HF forward pass.  Passing means naming, layout (transposes), RoPE
+convention, GQA, rms_norm, and the SiLU MLP all line up — not just shapes.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from nvme_strom_tpu.tools import convert_llama
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_llama")
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, attention_bias=False, mlp_bias=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def test_map_name_covers_llama_tensors():
+    assert convert_llama.map_name("model.embed_tokens.weight") == (
+        "tok_embed", False)
+    assert convert_llama.map_name(
+        "model.layers.3.self_attn.q_proj.weight") == ("layers.3.wq", True)
+    assert convert_llama.map_name(
+        "model.layers.0.post_attention_layernorm.weight") == (
+        "layers.0.mlp_norm", False)
+    assert convert_llama.map_name("lm_head.weight") == ("lm_head", True)
+    # unknown buffers are skipped, not mis-mapped
+    assert convert_llama.map_name(
+        "model.layers.0.self_attn.rotary_emb.inv_freq") is None
+
+
+def test_convert_and_logit_parity(hf_checkpoint, tmp_path):
+    import jax
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.transformer import TransformerConfig, forward
+    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+
+    hf_dir, model = hf_checkpoint
+    out_dir = str(tmp_path / "strom")
+    summary = convert_llama.convert(hf_dir, out_dir, shard_bytes=64 << 10)
+    assert summary["shards"] >= 2          # shard budget actually splits
+
+    with open(os.path.join(out_dir, "strom_config.json")) as f:
+        cfg = TransformerConfig(dtype=jnp.float32, **json.load(f))
+    assert cfg.n_kv_heads == 2 and cfg.n_layers == 2
+
+    import glob
+    params = LazyCheckpoint(
+        sorted(glob.glob(os.path.join(out_dir, "*.safetensors")))
+    ).load_sharded(lambda name, shape: jax.sharding.SingleDeviceSharding(
+        jax.devices()[0]))
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=(2, 16), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.float().numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+    # f32 end-to-end on both sides: tight tolerance
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_rejects_unsupported_arch(tmp_path):
+    """Bias terms / exotic rope scaling must be a hard error, not a
+    silently wrong conversion."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32, attention_bias=True)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf_bias")
+    model.save_pretrained(d, safe_serialization=True)
+    with pytest.raises(ValueError, match="attention_bias"):
+        convert_llama.convert(d, str(tmp_path / "out"))
+    with pytest.raises(ValueError, match="hidden_act"):
+        convert_llama.config_from_hf({
+            "vocab_size": 64, "hidden_size": 16, "num_hidden_layers": 1,
+            "num_attention_heads": 2, "intermediate_size": 32,
+            "hidden_act": "gelu"})
+    with pytest.raises(ValueError, match="rope_scaling"):
+        convert_llama.config_from_hf({
+            "vocab_size": 64, "hidden_size": 16, "num_hidden_layers": 1,
+            "num_attention_heads": 2, "intermediate_size": 32,
+            "rope_scaling": {"rope_type": "yarn", "factor": 4}})
+
+
+def test_convert_llama3_rope_scaling_parity(tmp_path):
+    """Llama-3.1-style rope_scaling converts AND matches HF logits —
+    the frequency remap in models.transformer._llama3_scale_freqs is
+    checked against transformers' implementation, not just accepted."""
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.transformer import TransformerConfig, forward
+    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+    import glob
+    import jax
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 16})
+    torch.manual_seed(2)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf31")
+    model.save_pretrained(d, safe_serialization=True)
+    out = str(tmp_path / "strom31")
+    convert_llama.convert(d, out)
+    with open(os.path.join(out, "strom_config.json")) as f:
+        scfg = TransformerConfig(dtype=jnp.float32, **json.load(f))
+    assert scfg.rope_scaling is not None
+    params = LazyCheckpoint(
+        sorted(glob.glob(os.path.join(out, "*.safetensors")))
+    ).load_sharded(lambda name, shape: jax.sharding.SingleDeviceSharding(
+        jax.devices()[0]))
+    rng = np.random.default_rng(1)
+    # positions beyond original_max_position_embeddings exercise the
+    # scaled long-wavelength branch
+    tokens = rng.integers(0, 128, size=(1, 48), dtype=np.int64)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(tokens)).logits.float().numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, jnp.int32),
+                              scfg))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_tied_embeddings(tmp_path):
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True)
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    d = str(tmp_path / "hf")
+    model.save_pretrained(d, safe_serialization=True)
+    out = str(tmp_path / "strom")
+    summary = convert_llama.convert(d, out)
+    # lm_head materialized from the tied embedding
+    from nvme_strom_tpu.formats.safetensors import SafetensorsFile
+    names = set()
+    for s in os.listdir(out):
+        if s.endswith(".safetensors"):
+            names |= set(SafetensorsFile(os.path.join(out, s)).keys())
+    assert "lm_head" in names and "tok_embed" in names
+    assert summary["tensors"] == 1 + 1 + 1 + 9  # embed, norm, head, layer
