@@ -40,7 +40,15 @@ def main(argv=None) -> int:
     ap.add_argument("--init-weights", default=None,
                     help="glob of safetensors shards to warm-start from "
                          "(lazy NVMe->HBM load)")
+    ap.add_argument("--from-hf", default=None, metavar="HF_DIR",
+                    help="warm-start from a HuggingFace Llama checkpoint "
+                         "dir: converted once (tools/convert_llama) into "
+                         "--ckpt-dir/hf_converted, model config taken "
+                         "from its config.json")
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seq-len", type=int, default=2048,
+                    help="training sequence length (--from-hf caps the "
+                         "HF max_position_embeddings to this)")
     ap.add_argument("--global-batch", type=int, default=8)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--lr", type=float, default=3e-4)
@@ -66,7 +74,52 @@ def main(argv=None) -> int:
         batch_shardings, param_shardings, replicate_scalars)
     from nvme_strom_tpu.parallel.weights import LazyCheckpoint
 
-    cfg = tiny_config() if args.tiny else flagship_config()
+    if args.from_hf:
+        # Convert ONCE (skipped when a prior run already converted into
+        # this ckpt-dir) and adopt the HF architecture as the config.
+        if args.init_weights or args.tiny:
+            ap.error("--from-hf is mutually exclusive with "
+                     "--init-weights/--tiny (it supplies both weights "
+                     "and model config)")
+        if not args.ckpt_dir:
+            ap.error("--from-hf needs --ckpt-dir: the converted shards "
+                     "are a durable multi-GB artifact")
+        import json as _json
+        from nvme_strom_tpu.tools.convert_llama import convert
+        from nvme_strom_tpu.models.transformer import TransformerConfig
+        import hashlib
+        conv_dir = os.path.join(args.ckpt_dir, "hf_converted")
+        marker = os.path.join(conv_dir, "strom_config.json")
+        src_marker = os.path.join(conv_dir, "source.json")
+        reusable = False
+        if os.path.exists(marker) and os.path.exists(src_marker):
+            with open(src_marker) as f:
+                src = _json.load(f)
+            with open(os.path.join(args.from_hf, "config.json"),
+                      "rb") as f:
+                sha = hashlib.sha256(f.read()).hexdigest()
+            reusable = src.get("config_sha256") == sha
+            if not reusable:
+                ap.error(
+                    f"{conv_dir} holds a conversion of a DIFFERENT "
+                    f"checkpoint ({src.get('hf_dir')}); refusing to mix "
+                    "— use a fresh --ckpt-dir or delete hf_converted/")
+        if reusable:
+            print(f"from-hf: reusing converted shards under {conv_dir}")
+        else:
+            summary = convert(args.from_hf, conv_dir)
+            print(f"from-hf: converted {summary['tensors']} tensors "
+                  f"into {summary['shards']} shard(s) under {conv_dir}")
+        with open(marker) as f:
+            cfg = TransformerConfig(**_json.load(f))
+        if cfg.max_seq > args.seq_len:
+            # HF configs carry max_position_embeddings up to 128k; the
+            # training seq length is a run choice, not the model ceiling
+            import dataclasses
+            cfg = dataclasses.replace(cfg, max_seq=args.seq_len)
+        args.init_weights = conv_dir  # dir form: every shard inside
+    else:
+        cfg = tiny_config() if args.tiny else flagship_config()
     mesh = make_mesh({"dp": -1, "tp": args.tp})
     print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())} "
           f"model: d={cfg.d_model} L={cfg.n_layers} vocab={cfg.vocab}")

@@ -128,19 +128,66 @@ class ShardedLoader:
         self.global_batch = global_batch
         pi = jax.process_index() if process_index is None else process_index
         pc = jax.process_count() if process_count is None else process_count
-        if global_batch % pc:
-            raise ValueError(
-                f"global_batch {global_batch} not divisible by "
-                f"{pc} processes")
         if global_batch % mesh.shape[axis]:
             raise ValueError(
                 f"global_batch {global_batch} not divisible by mesh axis "
                 f"{axis}={mesh.shape[axis]}")
-        self.local_batch = global_batch // pc
-        self.local_shards = assign_shards(shard_paths, pi, pc)
+        # Shard assignment must follow the BATCH-AXIS group, not the
+        # process: when seq_axis spans processes (multi-host long
+        # context), several processes hold seq slices of the SAME global
+        # batch rows — they must read the same shards in the same order,
+        # each slicing its own sequence span at assembly time.  With a
+        # batch axis that spans processes (the common case) every group
+        # is one process and this reduces to plain per-process
+        # round-robin.  Explicit process_index/process_count overrides
+        # (single-process multi-host simulation in tests) keep the plain
+        # behavior — there is no real device→process map to group by.
+        if (seq_axis is not None and process_index is None
+                and process_count is None and pc > 1):
+            group_idx, n_groups = self._batch_groups(mesh, axis, pi)
+        else:
+            group_idx, n_groups = pi, pc
+        if global_batch % n_groups:
+            raise ValueError(
+                f"global_batch {global_batch} not divisible by "
+                f"{n_groups} batch-axis groups")
+        self.local_batch = global_batch // n_groups
+        self.local_shards = assign_shards(shard_paths, group_idx, n_groups)
         self._engine = engine or StromEngine(EngineConfig())
         self._owns_engine = engine is None
         self.epoch = 0
+
+    @staticmethod
+    def _batch_groups(mesh, axis: str, pi: int) -> tuple[int, int]:
+        """(my group index, group count) where a 'group' is the set of
+        processes whose devices cover the same batch-axis blocks.
+
+        sp-peers (processes sharing batch rows, differing only in their
+        sequence slice) land in one group; dp-separated processes land in
+        different groups.  Block membership comes from the mesh's actual
+        device→process map, so any axis order works."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        n_blk = mesh.shape[axis]
+        sh = NamedSharding(mesh, P(axis))
+        blocks: dict[int, set] = {}
+        for d, idx in sh.devices_indices_map((n_blk,)).items():
+            blocks.setdefault(d.process_index, set()).add(
+                idx[0].start or 0)
+        groups = sorted({frozenset(b) for b in blocks.values()},
+                        key=min)
+        # groups must partition the blocks into equal tiles: overlapping
+        # or unequal coverage would assign disjoint shard lists to
+        # processes that feed the SAME global rows (silent data
+        # corruption), or break local_batch = global/n_groups
+        all_blocks = [b for g in groups for b in g]
+        if (len(all_blocks) != len(set(all_blocks))
+                or set(all_blocks) != set(range(n_blk))
+                or len({len(g) for g in groups}) != 1):
+            raise ValueError(
+                f"batch axis {axis!r}: process groups do not tile the "
+                f"axis blocks equally ({[sorted(g) for g in groups]}) — "
+                "unsupported mesh layout")
+        return groups.index(frozenset(blocks[pi])), len(groups)
 
     # -- sample iteration (host side) -------------------------------------
 

@@ -74,6 +74,12 @@ class LazyCheckpoint:
                 paths = sorted(
                     os.path.join(src, n) for n in os.listdir(src)
                     if n.endswith(".safetensors"))
+            elif not os.path.exists(src) and any(c in src for c in "*?["):
+                # glob pattern — only when no file literally has this
+                # name (a real path like "run[1]/model.safetensors" must
+                # never be re-interpreted as a character class)
+                import glob
+                paths = sorted(glob.glob(src))
             else:
                 paths = [src]
         else:

@@ -224,6 +224,15 @@ def convert(hf_dir: str, out_dir: str, shard_bytes: int = 1 << 30,
         "max_seq", "rope_theta", "norm_eps")}
     if cfg.rope_scaling:
         cfg_out["rope_scaling"] = dict(cfg.rope_scaling)
+    # Provenance marker: lets reuse logic (examples/train_lm.py --from-hf)
+    # detect that an existing conversion came from a DIFFERENT source
+    # checkpoint instead of silently serving stale weights.
+    import hashlib
+    with open(os.path.join(hf_dir, "config.json"), "rb") as f:
+        cfg_sha = hashlib.sha256(f.read()).hexdigest()
+    with open(os.path.join(out_dir, "source.json"), "w") as f:
+        json.dump({"hf_dir": os.path.realpath(hf_dir),
+                   "config_sha256": cfg_sha}, f, indent=1)
     with open(os.path.join(out_dir, "strom_config.json"), "w") as f:
         json.dump(cfg_out, f, indent=1)
     return {"tensors": len(seen), "shards": len(shards),

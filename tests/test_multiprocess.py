@@ -1,0 +1,167 @@
+"""True multi-process distributed tests: 2 OS processes, 4 virtual CPU
+devices each, one global 8-device mesh.
+
+Everything else in the suite runs single-process on a virtual mesh; these
+tests exercise what that cannot: jax.distributed bring-up through
+``parallel.mesh.init_distributed``, cross-process collectives, and the
+loader's multi-host assembly path (each process reads only its own
+shards; ``make_array_from_process_local_data`` assembles the global
+batch) — including the per-process sequence slicing that the round-1
+advisor flagged as untested beyond one host.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, "@REPO@")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from nvme_strom_tpu.parallel.mesh import init_distributed
+
+    pid = int(os.environ["STROM_PROCESS_ID"])
+    ok = init_distributed()          # coordinator/num/id via STROM_* env
+    assert ok, "init_distributed skipped despite coordinator env"
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.process_index() == pid
+
+    devs = np.array(jax.devices()).reshape(2, 4)   # dp spans processes
+    mesh = Mesh(devs, ("dp", "tp"))
+
+    # -- cross-process collective: global sum of a dp-sharded array --
+    local = np.full((2, 4), float(pid + 1), np.float32)   # rows 2*pid..
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp", None)), local, (4, 4))
+    total = float(jax.jit(jnp.sum)(arr))
+    assert total == (1 + 2) * 2 * 4, total    # both processes' rows
+
+    # -- loader multi-host path: per-process shards -> global batch --
+    import tempfile
+    from nvme_strom_tpu.data.loader import ShardedLoader
+    from nvme_strom_tpu.formats.fixedrec import write_fixedrec
+    d = os.environ["STROM_TEST_DIR"]
+    rng = np.random.default_rng(7)                 # SAME seed both procs
+    rec = rng.integers(0, 255, size=(8, 4, 8)).astype(np.uint8)
+    # global shard list; each process will read only its own slice
+    paths = []
+    for s in range(2):
+        p = os.path.join(d, f"shard-{s}.sfr")
+        if pid == 0:                               # one writer
+            write_fixedrec(p, rec[s * 4:(s + 1) * 4])
+        paths.append(p)
+    import time
+    while not all(os.path.exists(p) and os.path.getsize(p) for p in paths):
+        time.sleep(0.05)
+    time.sleep(0.2)
+
+    # shard assignment is round-robin over the sorted path list, so
+    # process p owns shard-p = rec[4p:4p+4]; a global batch of 4 takes 2
+    # consecutive records from each process, laid out [proc0 | proc1]
+    # along dim 0 (the dp axis spans the processes in mesh-row order).
+    with ShardedLoader(paths, mesh, global_batch=4, fmt="fixedrec") as ld:
+        n = 0
+        for batch in ld:
+            assert batch.shape == (4, 4, 8), batch.shape
+            for sh in batch.addressable_shards:
+                start = sh.index[0].start or 0
+                data = np.asarray(sh.data)
+                for i in range(data.shape[0]):
+                    g = start + i                  # global batch row
+                    owner = g // 2                 # which process fed it
+                    expect = rec[4 * owner + n * 2 + (g % 2)]
+                    np.testing.assert_array_equal(data[i], expect)
+            n += 1
+    assert n == 2, n
+
+    # -- sp ACROSS processes (multi-host long context): both processes
+    # must read the SAME shards (one batch-axis group), each slicing its
+    # own sequence span at assembly — the round-1 advisor's case, plus
+    # the shard-assignment grouping that makes the data consistent.
+    import tarfile, io as _io
+    rng2 = np.random.default_rng(11)               # SAME seed both procs
+    toks = rng2.integers(0, 1000, size=(8, 8)).astype(np.int32)
+    tok_paths = []
+    for s in range(2):
+        p = os.path.join(d, f"tok-{s}.tar")
+        if pid == 0:
+            with tarfile.open(p, "w") as tf:
+                for i in range(4):
+                    payload = toks[s * 4 + i].tobytes()
+                    ti = tarfile.TarInfo(f"{s}{i:04d}.bin")
+                    ti.size = len(payload)
+                    tf.addfile(ti, _io.BytesIO(payload))
+        tok_paths.append(p)
+    while not all(os.path.exists(p) and os.path.getsize(p)
+                  for p in tok_paths):
+        time.sleep(0.05)
+    time.sleep(0.3)
+
+    mesh_sp = Mesh(devs, ("sp", "dp"))             # sp spans processes
+    with ShardedLoader(tok_paths, mesh_sp, global_batch=4, fmt="wds",
+                       decode=lambda parts: np.frombuffer(
+                           list(parts.values())[0], np.int32),
+                       axis="dp", seq_axis="sp") as ld:
+        assert ld.local_batch == 4                 # ONE group: full batch
+        assert len(ld.local_shards) == 2           # ...and all shards
+        bs = list(ld)
+    assert len(bs) == 2, len(bs)
+    for b, batch in enumerate(bs):
+        assert batch.shape == (4, 8), batch.shape
+        for sh in batch.addressable_shards:
+            r0 = sh.index[0].start or 0
+            c0 = sh.index[1].start or 0
+            data = np.asarray(sh.data)
+            for i in range(data.shape[0]):
+                np.testing.assert_array_equal(
+                    data[i], toks[b * 4 + r0 + i, c0:c0 + data.shape[1]])
+    print(f"proc{pid} OK", flush=True)
+""").replace("@REPO@", str(REPO))
+
+
+def test_two_process_mesh_collective_and_loader(tmp_path):
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            STROM_COORDINATOR=f"127.0.0.1:{port}",
+            STROM_NUM_PROCESSES="2",
+            STROM_PROCESS_ID=str(pid),
+            STROM_TEST_DIR=str(tmp_path),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=220)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc{pid}:\n{out[-3000:]}"
+        assert f"proc{pid} OK" in out

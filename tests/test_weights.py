@@ -149,3 +149,13 @@ def test_duplicate_tensor_rejected(tmp_path):
                       {"x": np.zeros(4, dtype=np.float32)})
     with pytest.raises(ValueError, match="duplicate"):
         LazyCheckpoint(tmp_path)
+
+
+def test_glob_source(ckpt):
+    """A glob pattern resolves to every matching shard (the documented
+    --init-weights form in examples/train_lm.py)."""
+    import os
+    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+    tmp_path, tensors = ckpt
+    lc = LazyCheckpoint(os.path.join(str(tmp_path), "model-*.safetensors"))
+    assert set(lc.keys()) == set(tensors)
