@@ -42,3 +42,22 @@ def test_train_checkpoint_resume(tmp_path):
     assert "step 6" in out2
     losses = [float(m) for m in re.findall(r"loss=([\d.]+)", out1 + out2)]
     assert losses and all(l == l and l < 100 for l in losses)  # finite
+
+
+def test_train_vit_fixedrec(tmp_path):
+    """examples/train_vit.py: the config-3 consumer loop — fixedrec
+    records stream to device and decode THERE (slice + bitcast inside
+    the jitted step)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "train_vit.py"),
+         "--steps", "4", "--global-batch", "8", "--tp", "2",
+         "--image-size", "32", "--classes", "10"],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(REPO))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "step 4" in r.stdout
+    losses = [float(m) for m in re.findall(r"loss=([\d.]+)", r.stdout)]
+    assert losses and all(l == l and l < 100 for l in losses)
+    assert "engine stats" in r.stdout
