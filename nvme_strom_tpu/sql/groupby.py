@@ -98,6 +98,66 @@ def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
     return out
 
 
+def iter_device_columns(scanner, columns: Sequence[str], dev,
+                        require_int: Sequence[str] = ()):
+    """Stream a scanner's row groups as {name: device array} dicts.
+
+    One policy for every on-device SQL consumer (groupby, join): the
+    pq_direct page-span fast path when every column is eligible — a plan
+    failure, not just footer ineligibility, falls back — else the
+    engine-backed pyarrow path with its counted handoff copy.
+    ``require_int`` names must be integer columns; a float key would
+    otherwise truncate into a silently wrong query."""
+    import numpy as np
+    from nvme_strom_tpu.ops.bridge import host_to_device
+    from nvme_strom_tpu.sql import pq_direct
+
+    plans = None
+    if hasattr(scanner, "direct_reasons"):
+        try:
+            plans = pq_direct.plan_columns(scanner, columns)
+        except ValueError:
+            plans = None
+    if plans is not None:
+        for cols in pq_direct.iter_plain_row_groups_to_device(
+                scanner, columns, device=dev, plans=plans):
+            for c in require_int:
+                if not jnp.issubdtype(cols[c].dtype, jnp.integer):
+                    raise TypeError(f"key column {c} must be integer")
+            yield cols
+        return
+    for tbl in scanner.iter_row_groups(list(columns)):
+        host = {c: tbl.column(c).to_numpy(zero_copy_only=False)
+                for c in columns}
+        for c in require_int:
+            if not np.issubdtype(host[c].dtype, np.integer):
+                raise TypeError(f"key column {c} must be integer")
+        yield {c: host_to_device(scanner.engine, host[c], dev)
+               for c in columns}
+
+
+def finalize_folds(folds: Dict[str, jax.Array],
+                   aggs: Sequence[str]) -> Dict[str, jax.Array]:
+    """Foldable partials (count/sum/min/max with raw identities) → the
+    requested aggregates, with SQL-NULL-like NaN for empty groups."""
+    out: Dict[str, jax.Array] = {}
+    count = folds["count"]
+    if "count" in aggs:
+        out["count"] = count
+    if "sum" in aggs:
+        out["sum"] = folds["sum"]
+    if "mean" in aggs:
+        cf = count.astype(jnp.float32)
+        mean = folds["sum"] / jnp.maximum(cf, 1.0)
+        out["mean"] = jnp.where(cf > 0, mean, jnp.nan)
+    empty = count == 0
+    if "min" in aggs:
+        out["min"] = jnp.where(empty, jnp.nan, folds["min"])
+    if "max" in aggs:
+        out["max"] = jnp.where(empty, jnp.nan, folds["max"])
+    return out
+
+
 def sql_groupby(scanner, key_column: str, value_column: str,
                 num_groups: int, aggs: Sequence[str] = ("count", "sum",
                                                         "mean"),
@@ -118,58 +178,15 @@ def sql_groupby(scanner, key_column: str, value_column: str,
     WHERE clause into the GPU scan the same way, SURVEY.md §3.5); only
     surviving rows aggregate, only per-group results return to host.
     """
-    import numpy as np
-    from nvme_strom_tpu.ops.bridge import host_to_device
-
     dev = device or jax.local_devices()[0]
-
     cols_needed = list(dict.fromkeys(
         [key_column, value_column, *where_columns]))
 
-    # PG-Strom-style fast path: when every needed column is PLAIN
-    # fixed-width uncompressed, page spans stream O_DIRECT → device and
-    # decode there (pq_direct) — host never touches a payload byte.
-    # Anything else decodes per row group through pyarrow (counted).
-    # A plan failure (not just footer ineligibility) falls back too.
-    direct_plans = None
-    if hasattr(scanner, "direct_reasons"):
-        from nvme_strom_tpu.sql import pq_direct
-        try:
-            direct_plans = pq_direct.plan_columns(scanner, cols_needed)
-        except ValueError:
-            direct_plans = None
-
-    def _iter_device_cols():
-        if direct_plans is not None:
-            from nvme_strom_tpu.sql.pq_direct import (
-                iter_plain_row_groups_to_device)
-            for cols in iter_plain_row_groups_to_device(
-                    scanner, cols_needed, device=dev, plans=direct_plans):
-                if not jnp.issubdtype(cols[key_column].dtype, jnp.integer):
-                    raise TypeError(
-                        f"key column {key_column} must be integer")
-                cols[key_column] = cols[key_column].astype(jnp.int32)
-                yield cols
-        else:
-            for tbl in scanner.iter_row_groups(cols_needed):
-                keys = tbl.column(key_column).to_numpy(
-                    zero_copy_only=False)
-                if not np.issubdtype(keys.dtype, np.integer):
-                    raise TypeError(
-                        f"key column {key_column} must be integer")
-                cols = {key_column: host_to_device(
-                    scanner.engine, keys.astype(np.int32), dev)}
-                for c in cols_needed:
-                    if c != key_column:
-                        cols[c] = host_to_device(
-                            scanner.engine,
-                            tbl.column(c).to_numpy(zero_copy_only=False),
-                            dev)
-                yield cols
-
     folds = None
-    for cols in _iter_device_cols():
-        kd = cols[key_column]
+    for cols in iter_device_columns(scanner, cols_needed, dev,
+                                    require_int=(key_column,)):
+        kd = cols[key_column].astype(jnp.int32)
+        cols[key_column] = kd
         vd = cols[value_column]
         mask = where(cols) if where is not None else None
         part = groupby_aggregate(
@@ -180,22 +197,7 @@ def sql_groupby(scanner, key_column: str, value_column: str,
 
     if folds is None:
         raise ValueError("empty table")
-    out: Dict[str, jax.Array] = {}
-    count = folds["count"]
-    if "count" in aggs:
-        out["count"] = count
-    if "sum" in aggs:
-        out["sum"] = folds["sum"]
-    if "mean" in aggs:
-        cf = count.astype(jnp.float32)
-        mean = folds["sum"] / jnp.maximum(cf, 1.0)
-        out["mean"] = jnp.where(cf > 0, mean, jnp.nan)
-    empty = count == 0
-    if "min" in aggs:
-        out["min"] = jnp.where(empty, jnp.nan, folds["min"])
-    if "max" in aggs:
-        out["max"] = jnp.where(empty, jnp.nan, folds["max"])
-    return out
+    return finalize_folds(folds, aggs)
 
 
 @jax.jit

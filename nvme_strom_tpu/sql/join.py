@@ -1,0 +1,128 @@
+"""On-device star-schema join: fact rows → dimension attributes.
+
+PG-Strom's Direct SQL path is scan/JOIN/aggregate on the accelerator
+(SURVEY.md §3.5); :mod:`.groupby` covers scan+aggregate, this module adds
+the join.  The supported shape is the warehouse workhorse: a large fact
+table joined to a dimension table on the dimension's UNIQUE key
+(primary-key equi-join), then grouped by a dimension attribute:
+
+    SELECT d.attr, AGG(f.value)
+    FROM fact f JOIN dim d ON f.key = d.key
+    GROUP BY d.attr
+
+TPU-first formulation: a hash table is a pointer-chasing structure the
+accelerator hates; with a unique build side the join is a SORT + binary
+search — ``argsort`` the dimension keys once, ``searchsorted`` every
+fact key into them (both XLA-native, O(n log n) with static shapes),
+gather the attribute.  Unmatched fact rows carry ``found=False`` and
+flow into :func:`groupby_aggregate`'s mask (its WHERE-pushdown path), so
+inner-join semantics cost nothing extra.  Fact row groups stream through
+the engine one at a time (pq_direct when eligible); only the small
+dimension table is device-resident for the query's lifetime.
+
+General M:N joins (non-unique build keys) produce data-dependent output
+cardinality — fundamentally at odds with XLA's static shapes — and are
+out of scope; the host/pyarrow path remains the fallback for those.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def lookup_unique(build_keys: jax.Array, probe_keys: jax.Array):
+    """For each probe key, the index of the matching build row.
+
+    build_keys (M,) UNIQUE integers; probe_keys (N,) integers →
+    (idx (N,) int32 into build rows, found (N,) bool).  Rows with
+    ``found=False`` have an arbitrary (clipped) idx — mask before use.
+    Uniqueness of build_keys is the caller's contract
+    (:func:`check_unique` validates it eagerly on host-sized tables).
+    """
+    order = jnp.argsort(build_keys)
+    skeys = build_keys[order]
+    pos = jnp.searchsorted(skeys, probe_keys)
+    pos = jnp.clip(pos, 0, skeys.shape[0] - 1)
+    found = skeys[pos] == probe_keys
+    return order[pos].astype(jnp.int32), found
+
+
+def check_unique(keys) -> None:
+    """Raise if the build-side keys are empty or not unique (an M:N join
+    the static-shape device path cannot represent; an empty build side
+    would make the clipped gather in lookup_unique undefined)."""
+    import numpy as np
+    k = np.asarray(keys)
+    if k.shape[0] == 0:
+        raise ValueError("join build side (dimension table) is empty")
+    if len(np.unique(k)) != k.shape[0]:
+        raise ValueError(
+            "join build side has duplicate keys — M:N joins are not "
+            "supported on the device path (use the pyarrow fallback)")
+
+
+def star_join_groupby(fact_scanner, fact_key: str, fact_value: str,
+                      dim_scanner, dim_key: str, dim_attr: str,
+                      num_groups: int,
+                      aggs: Sequence[str] = ("count", "sum", "mean"),
+                      method: str = "matmul", device=None,
+                      where=None, where_columns: Sequence[str] = ()
+                      ) -> Dict[str, jax.Array]:
+    """The star query above, end to end on device.
+
+    ``dim_attr`` must be an integer column in [0, num_groups) — the GROUP
+    BY key after the join.  ``where`` (optional) receives the fact
+    columns dict ({fact_key, fact_value, *where_columns}, device arrays)
+    and returns a row mask, composed with the join's found-mask.
+    Returns {agg: (num_groups,)} like :func:`.groupby.sql_groupby`.
+    """
+    from nvme_strom_tpu.sql.groupby import (
+        _fold, finalize_folds, iter_device_columns)
+
+    dev = device or jax.local_devices()[0]
+
+    # Dimension side: small, loaded once, device-resident.
+    dcols = dim_scanner.read_columns_to_device([dim_key, dim_attr],
+                                               device=dev)
+    check_unique(dcols[dim_key])
+    # widest available int for key comparison (int64 needs jax x64 mode;
+    # without it int32 is both sides' storage dtype anyway)
+    kdt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+    dkeys = dcols[dim_key].astype(kdt)
+    dattr = dcols[dim_attr].astype(jnp.int32)
+
+    part_aggs = tuple(sorted((set(aggs) | {"count", "sum"}) - {"mean"}))
+    cols_needed = list(dict.fromkeys(
+        [fact_key, fact_value, *where_columns]))
+    folds = None
+    for cols in iter_device_columns(fact_scanner, cols_needed, dev,
+                                    require_int=(fact_key,)):
+        mask = where(cols) if where is not None else None
+        part = _join_part(dkeys, dattr, cols[fact_key],
+                          cols[fact_value], mask,
+                          num_groups=num_groups, aggs=part_aggs,
+                          method=method)
+        folds = part if folds is None else _fold(folds, part)
+    if folds is None:
+        raise ValueError("empty fact table")
+    return finalize_folds(folds, aggs)
+
+
+@partial(jax.jit, static_argnames=("num_groups", "aggs", "method"))
+def _join_part(dkeys, dattr, fkeys, fvals, mask, *, num_groups, aggs,
+               method):
+    """One fact row group: join → masked partial aggregates.  dkeys and
+    dattr are traced ARGUMENTS (not closure constants), so repeated
+    queries — even against different dimension tables of the same shape
+    — reuse one compilation."""
+    from nvme_strom_tpu.sql.groupby import groupby_aggregate
+    idx, found = lookup_unique(dkeys, fkeys.astype(dkeys.dtype))
+    groups = dattr[idx]
+    m = found if mask is None else (found & mask)
+    return groupby_aggregate(groups, fvals, num_groups, aggs=aggs,
+                             method=method, mask=m, empty_as_nan=False)

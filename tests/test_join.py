@@ -1,0 +1,140 @@
+"""sql/join.py: on-device star-schema join + aggregate vs numpy truth."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.sql.join import (check_unique, lookup_unique,
+                                     star_join_groupby)
+from nvme_strom_tpu.sql.parquet import ParquetScanner
+from nvme_strom_tpu.utils.stats import StromStats
+
+
+@pytest.fixture
+def engine():
+    with StromEngine(stats=StromStats()) as eng:
+        yield eng
+
+
+def _write(path, table):
+    pq.write_table(table, str(path), compression="none",
+                   use_dictionary=False)
+
+
+def test_lookup_unique_basic():
+    import jax.numpy as jnp
+    build = jnp.asarray([40, 10, 30, 20], jnp.int32)
+    probe = jnp.asarray([10, 20, 25, 40, 99], jnp.int32)
+    idx, found = lookup_unique(build, probe)
+    assert list(found) == [True, True, False, True, False]
+    matched = np.asarray(build)[np.asarray(idx)][np.asarray(found)]
+    np.testing.assert_array_equal(matched, [10, 20, 40])
+
+
+def test_check_unique_raises():
+    with pytest.raises(ValueError, match="duplicate"):
+        check_unique(np.array([1, 2, 2, 3]))
+
+
+def _star_tables(tmp_path, rows=20000, n_dim=50, groups=8, seed=0):
+    rng = np.random.default_rng(seed)
+    dim_ids = rng.permutation(1000)[:n_dim].astype(np.int32)  # sparse ids
+    dim_attr = rng.integers(0, groups, n_dim, dtype=np.int32)
+    # ~10% of fact keys match nothing (inner-join drops them)
+    fact_keys = np.where(
+        rng.random(rows) < 0.9,
+        rng.choice(dim_ids, rows),
+        np.int32(2000) + rng.integers(0, 50, rows, dtype=np.int32)
+    ).astype(np.int32)
+    fact_vals = rng.standard_normal(rows).astype(np.float32)
+    fact = tmp_path / "fact.parquet"
+    dim = tmp_path / "dim.parquet"
+    _write(fact, pa.table({"k": pa.array(fact_keys),
+                           "v": pa.array(fact_vals)}))
+    _write(dim, pa.table({"id": pa.array(dim_ids),
+                          "attr": pa.array(dim_attr)}))
+    return fact, dim, fact_keys, fact_vals, dim_ids, dim_attr
+
+
+def _reference(fact_keys, fact_vals, dim_ids, dim_attr, groups,
+               extra_mask=None):
+    id_to_attr = dict(zip(dim_ids.tolist(), dim_attr.tolist()))
+    cnt = np.zeros(groups, np.int64)
+    s = np.zeros(groups, np.float64)
+    for k, v in zip(fact_keys, fact_vals):
+        if extra_mask is not None and not extra_mask(v):
+            continue
+        a = id_to_attr.get(int(k))
+        if a is None:
+            continue
+        cnt[a] += 1
+        s[a] += float(v)
+    return cnt, s
+
+
+def test_star_join_groupby_matches_reference(tmp_path, engine):
+    groups = 8
+    fact, dim, fk, fv, di, da = _star_tables(tmp_path, groups=groups)
+    out = star_join_groupby(
+        ParquetScanner(fact, engine), "k", "v",
+        ParquetScanner(dim, engine), "id", "attr", groups)
+    cnt, s = _reference(fk, fv, di, da, groups)
+    np.testing.assert_array_equal(np.asarray(out["count"]), cnt)
+    np.testing.assert_allclose(np.asarray(out["sum"]), s, rtol=2e-4,
+                               atol=1e-3)
+    mean = np.where(cnt > 0, s / np.maximum(cnt, 1), np.nan)
+    np.testing.assert_allclose(np.asarray(out["mean"]), mean, rtol=2e-4,
+                               atol=1e-3, equal_nan=True)
+
+
+def test_star_join_where_pushdown(tmp_path, engine):
+    groups = 8
+    fact, dim, fk, fv, di, da = _star_tables(tmp_path, groups=groups,
+                                             seed=3)
+    out = star_join_groupby(
+        ParquetScanner(fact, engine), "k", "v",
+        ParquetScanner(dim, engine), "id", "attr", groups,
+        aggs=("count", "sum"),
+        where=lambda c: c["v"] > 0)
+    cnt, s = _reference(fk, fv, di, da, groups,
+                        extra_mask=lambda v: v > 0)
+    np.testing.assert_array_equal(np.asarray(out["count"]), cnt)
+    np.testing.assert_allclose(np.asarray(out["sum"]), s, rtol=2e-4,
+                               atol=1e-3)
+
+
+def test_star_join_duplicate_dim_rejected(tmp_path, engine):
+    rng = np.random.default_rng(4)
+    _write(tmp_path / "fact.parquet", pa.table({
+        "k": pa.array(rng.integers(0, 4, 100, dtype=np.int32)),
+        "v": pa.array(rng.standard_normal(100).astype(np.float32))}))
+    _write(tmp_path / "dim.parquet", pa.table({
+        "id": pa.array(np.array([1, 2, 2, 3], np.int32)),
+        "attr": pa.array(np.array([0, 1, 2, 3], np.int32))}))
+    with pytest.raises(ValueError, match="duplicate"):
+        star_join_groupby(
+            ParquetScanner(tmp_path / "fact.parquet", engine), "k", "v",
+            ParquetScanner(tmp_path / "dim.parquet", engine),
+            "id", "attr", 4)
+
+
+def test_star_join_float_key_rejected(tmp_path, engine):
+    rng = np.random.default_rng(5)
+    _write(tmp_path / "fact.parquet", pa.table({
+        "k": pa.array(rng.random(100).astype(np.float32)),
+        "v": pa.array(rng.standard_normal(100).astype(np.float32))}))
+    _write(tmp_path / "dim.parquet", pa.table({
+        "id": pa.array(np.arange(4, dtype=np.int32)),
+        "attr": pa.array(np.arange(4, dtype=np.int32))}))
+    with pytest.raises(TypeError, match="must be integer"):
+        star_join_groupby(
+            ParquetScanner(tmp_path / "fact.parquet", engine), "k", "v",
+            ParquetScanner(tmp_path / "dim.parquet", engine),
+            "id", "attr", 4)
+
+
+def test_check_unique_empty_rejected():
+    with pytest.raises(ValueError, match="empty"):
+        check_unique(np.array([], np.int32))
