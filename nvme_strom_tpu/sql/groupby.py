@@ -99,7 +99,8 @@ def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
 
 
 def iter_device_columns(scanner, columns: Sequence[str], dev,
-                        require_int: Sequence[str] = ()):
+                        require_int: Sequence[str] = (),
+                        narrow_int32: Sequence[str] = ()):
     """Stream a scanner's row groups as {name: device array} dicts.
 
     One policy for every on-device SQL consumer (groupby, join): the
@@ -107,11 +108,16 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
     failure, not just footer ineligibility, falls back — else the
     engine-backed pyarrow path with its counted handoff copy.
     ``require_int`` names must be integer columns; a float key would
-    otherwise truncate into a silently wrong query."""
+    otherwise truncate into a silently wrong query.  ``narrow_int32``
+    names (implicitly require_int) are delivered as int32 — narrowed on
+    HOST on the fallback path so an int64 key doesn't ship double-width
+    bytes over the link only to be cast on arrival.  Callers that need
+    full-width keys (the join under x64) simply don't list them."""
     import numpy as np
     from nvme_strom_tpu.ops.bridge import host_to_device
     from nvme_strom_tpu.sql import pq_direct
 
+    require_int = tuple(dict.fromkeys([*require_int, *narrow_int32]))
     plans = None
     if hasattr(scanner, "direct_reasons"):
         try:
@@ -124,6 +130,8 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
             for c in require_int:
                 if not jnp.issubdtype(cols[c].dtype, jnp.integer):
                     raise TypeError(f"key column {c} must be integer")
+            for c in narrow_int32:
+                cols[c] = cols[c].astype(jnp.int32)
             yield cols
         return
     for tbl in scanner.iter_row_groups(list(columns)):
@@ -132,6 +140,8 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
         for c in require_int:
             if not np.issubdtype(host[c].dtype, np.integer):
                 raise TypeError(f"key column {c} must be integer")
+        for c in narrow_int32:
+            host[c] = host[c].astype(np.int32)
         yield {c: host_to_device(scanner.engine, host[c], dev)
                for c in columns}
 
@@ -156,6 +166,33 @@ def finalize_folds(folds: Dict[str, jax.Array],
     if "max" in aggs:
         out["max"] = jnp.where(empty, jnp.nan, folds["max"])
     return out
+
+
+@partial(jax.jit, static_argnames=("by", "k", "descending"))
+def _rank_top_k(res, *, by, k, descending):
+    key = res[by].astype(jnp.float32)
+    key = jnp.where(jnp.isnan(key),
+                    -jnp.inf if descending else jnp.inf, key)
+    _, idx = jax.lax.top_k(key if descending else -key, k)
+    out = {c: v[idx] for c, v in res.items()}
+    out["group"] = idx.astype(jnp.int32)
+    return out
+
+
+def top_k_groups(result: Dict[str, jax.Array], by: str, k: int,
+                 descending: bool = True) -> Dict[str, jax.Array]:
+    """ORDER BY <agg> [DESC] LIMIT k over a groupby/join result, on
+    device: ``jax.lax.top_k`` ranks the ``by`` aggregate and every other
+    column (plus the group ids as ``"group"``) is gathered in that order.
+    NaN groups (SQL-NULL empties) always sort last.  Only the k winning
+    rows ever reach the host — the same only-results-return property as
+    the aggregation itself."""
+    if by not in result:
+        raise KeyError(f"{by!r} not in result columns {sorted(result)}")
+    n = result[by].shape[0]
+    if not 1 <= k <= n:
+        raise ValueError(f"k={k} not in [1, {n}]")
+    return _rank_top_k(result, by=by, k=k, descending=descending)
 
 
 def sql_groupby(scanner, key_column: str, value_column: str,
@@ -184,9 +221,8 @@ def sql_groupby(scanner, key_column: str, value_column: str,
 
     folds = None
     for cols in iter_device_columns(scanner, cols_needed, dev,
-                                    require_int=(key_column,)):
-        kd = cols[key_column].astype(jnp.int32)
-        cols[key_column] = kd
+                                    narrow_int32=(key_column,)):
+        kd = cols[key_column]
         vd = cols[value_column]
         mask = where(cols) if where is not None else None
         part = groupby_aggregate(

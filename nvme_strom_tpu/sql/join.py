@@ -89,6 +89,12 @@ def star_join_groupby(fact_scanner, fact_key: str, fact_value: str,
     # Dimension side: small, loaded once, device-resident.
     dcols = dim_scanner.read_columns_to_device([dim_key, dim_attr],
                                                device=dev)
+    for c in (dim_key, dim_attr):
+        if not jnp.issubdtype(dcols[c].dtype, jnp.integer):
+            # astype below would TRUNCATE floats — [1.0, 1.5, 2.0] would
+            # pass check_unique then collapse to duplicate keys
+            raise TypeError(f"dimension column {c} must be integer, "
+                            f"got {dcols[c].dtype}")
     check_unique(dcols[dim_key])
     # widest available int for key comparison (int64 needs jax x64 mode;
     # without it int32 is both sides' storage dtype anyway)

@@ -138,3 +138,20 @@ def test_star_join_float_key_rejected(tmp_path, engine):
 def test_check_unique_empty_rejected():
     with pytest.raises(ValueError, match="empty"):
         check_unique(np.array([], np.int32))
+
+
+def test_star_join_float_dim_rejected(tmp_path, engine):
+    """Float dim keys like [1.0, 1.5, 2.0] would pass uniqueness then
+    truncate into duplicates — must be a TypeError up front."""
+    rng = np.random.default_rng(6)
+    _write(tmp_path / "fact.parquet", pa.table({
+        "k": pa.array(rng.integers(0, 3, 50, dtype=np.int32)),
+        "v": pa.array(rng.standard_normal(50).astype(np.float32))}))
+    _write(tmp_path / "dim.parquet", pa.table({
+        "id": pa.array(np.array([1.0, 1.5, 2.0], np.float32)),
+        "attr": pa.array(np.array([0, 1, 2], np.int32))}))
+    with pytest.raises(TypeError, match="dimension column"):
+        star_join_groupby(
+            ParquetScanner(tmp_path / "fact.parquet", engine), "k", "v",
+            ParquetScanner(tmp_path / "dim.parquet", engine),
+            "id", "attr", 3)

@@ -262,3 +262,23 @@ def test_prefetch_validates_eagerly_and_closes():
     assert next(it) == 0
     it.close()
     assert closed == [True]   # wrapped generator closed deterministically
+
+
+def test_top_k_groups():
+    """ORDER BY sum DESC LIMIT 3 on device; NaN (empty) groups last."""
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.sql.groupby import top_k_groups
+    res = {"sum": jnp.asarray([5.0, np.nan, 9.0, 1.0, 7.0]),
+           "count": jnp.asarray([2, 0, 3, 1, 4], jnp.int32)}
+    top = top_k_groups(res, "sum", 3)
+    np.testing.assert_array_equal(np.asarray(top["group"]), [2, 4, 0])
+    np.testing.assert_allclose(np.asarray(top["sum"]), [9.0, 7.0, 5.0])
+    np.testing.assert_array_equal(np.asarray(top["count"]), [3, 4, 2])
+    bottom = top_k_groups(res, "sum", 2, descending=False)
+    np.testing.assert_array_equal(np.asarray(bottom["group"]), [3, 0])
+    import pytest
+    with pytest.raises(KeyError):
+        top_k_groups(res, "mean", 2)
+    with pytest.raises(ValueError):
+        top_k_groups(res, "sum", 0)
