@@ -33,6 +33,22 @@ def hf_checkpoint(tmp_path_factory):
     return str(d), model
 
 
+def _load_converted(out_dir, dtype=None):
+    """strom_config.json + lazy params from a converted dir (single
+    device) — the boilerplate every parity test needs."""
+    import jax
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.transformer import TransformerConfig
+    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+    with open(os.path.join(out_dir, "strom_config.json")) as f:
+        cfg = TransformerConfig(dtype=dtype or jnp.float32,
+                                **json.load(f))
+    params = LazyCheckpoint(out_dir).load_sharded(
+        lambda name, shape: jax.sharding.SingleDeviceSharding(
+            jax.devices()[0]))
+    return cfg, params
+
+
 def test_map_name_covers_llama_tensors():
     assert convert_llama.map_name("model.embed_tokens.weight") == (
         "tok_embed", False)
@@ -48,25 +64,16 @@ def test_map_name_covers_llama_tensors():
 
 
 def test_convert_and_logit_parity(hf_checkpoint, tmp_path):
-    import jax
     import jax.numpy as jnp
-    from nvme_strom_tpu.models.transformer import TransformerConfig, forward
-    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+    from nvme_strom_tpu.models.transformer import forward
 
     hf_dir, model = hf_checkpoint
     out_dir = str(tmp_path / "strom")
     summary = convert_llama.convert(hf_dir, out_dir, shard_bytes=64 << 10)
     assert summary["shards"] >= 2          # shard budget actually splits
 
-    with open(os.path.join(out_dir, "strom_config.json")) as f:
-        cfg = TransformerConfig(dtype=jnp.float32, **json.load(f))
+    cfg, params = _load_converted(out_dir)
     assert cfg.n_kv_heads == 2 and cfg.n_layers == 2
-
-    import glob
-    params = LazyCheckpoint(
-        sorted(glob.glob(os.path.join(out_dir, "*.safetensors")))
-    ).load_sharded(lambda name, shape: jax.sharding.SingleDeviceSharding(
-        jax.devices()[0]))
 
     rng = np.random.default_rng(0)
     tokens = rng.integers(0, 256, size=(2, 16), dtype=np.int64)
@@ -106,10 +113,7 @@ def test_convert_llama3_rope_scaling_parity(tmp_path):
     the frequency remap in models.transformer._llama3_scale_freqs is
     checked against transformers' implementation, not just accepted."""
     import jax.numpy as jnp
-    from nvme_strom_tpu.models.transformer import TransformerConfig, forward
-    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
-    import glob
-    import jax
+    from nvme_strom_tpu.models.transformer import forward
 
     cfg = transformers.LlamaConfig(
         vocab_size=128, hidden_size=32, intermediate_size=64,
@@ -124,13 +128,8 @@ def test_convert_llama3_rope_scaling_parity(tmp_path):
     model.save_pretrained(d, safe_serialization=True)
     out = str(tmp_path / "strom31")
     convert_llama.convert(d, out)
-    with open(os.path.join(out, "strom_config.json")) as f:
-        scfg = TransformerConfig(dtype=jnp.float32, **json.load(f))
+    scfg, params = _load_converted(out)
     assert scfg.rope_scaling is not None
-    params = LazyCheckpoint(
-        sorted(glob.glob(os.path.join(out, "*.safetensors")))
-    ).load_sharded(lambda name, shape: jax.sharding.SingleDeviceSharding(
-        jax.devices()[0]))
     rng = np.random.default_rng(1)
     # positions beyond original_max_position_embeddings exercise the
     # scaled long-wavelength branch
@@ -161,3 +160,54 @@ def test_convert_tied_embeddings(tmp_path):
             names |= set(SafetensorsFile(os.path.join(out, s)).keys())
     assert "lm_head" in names and "tok_embed" in names
     assert summary["tensors"] == 1 + 1 + 1 + 9  # embed, norm, head, layer
+
+
+def test_greedy_generation_parity(hf_checkpoint, tmp_path):
+    """GENERATION parity (not just one forward): greedy decode through
+    our KV-cache scan must emit the same token ids as transformers'
+    .generate on the converted checkpoint — validates prefill/cache/
+    step rotation end to end."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.decode import generate
+
+    hf_dir, model = hf_checkpoint
+    out_dir = str(tmp_path / "strom_gen")
+    convert_llama.convert(hf_dir, out_dir)
+    cfg, params = _load_converted(out_dir)
+
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, 256, size=(1, 12), dtype=np.int64)
+    new = 16
+    with torch.no_grad():
+        ref = model.generate(
+            torch.from_numpy(prompt), max_new_tokens=new,
+            do_sample=False, use_cache=True,
+            eos_token_id=None,   # random weights may emit the default
+            pad_token_id=0).numpy()[0, prompt.shape[1]:]
+    gen = jax.jit(functools.partial(generate, cfg=cfg,
+                                    max_new_tokens=new))
+    ours = np.asarray(gen(params, jnp.asarray(prompt, jnp.int32))[0])
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_generate_example_cli(hf_checkpoint, tmp_path):
+    """examples/generate.py end to end from an HF checkpoint dir."""
+    import subprocess
+    import sys as _sys
+    from pathlib import Path
+    repo = Path(__file__).resolve().parents[1]
+    hf_dir, _ = hf_checkpoint
+    r = subprocess.run(
+        [_sys.executable, str(repo / "examples" / "generate.py"),
+         "--from-hf", hf_dir, "--out-dir", str(tmp_path / "conv"),
+         "--prompt", "5,6,7", "--new", "8"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(repo))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "output ids:" in r.stdout
+    ids = (r.stdout.split("output ids:")[1].strip().splitlines()[0]
+           .split(","))
+    assert len(ids) == 8 and all(i.strip().isdigit() for i in ids)
