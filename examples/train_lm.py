@@ -192,7 +192,13 @@ def main(argv=None) -> int:
         params, opt_state, loss = step_fn(params, opt_state, tokens)
         if (step + 1) % args.save_every == 0 or step + 1 == args.steps:
             jax.block_until_ready(loss)
-            mgr.save(step + 1, (params, opt_state))
+            if jax.process_count() == 1:
+                # snapshot now (donation-safe numpy copies), NVMe write
+                # overlaps the next steps; errors surface at the next
+                # save/restore/wait
+                mgr.save_async(step + 1, (params, opt_state))
+            else:
+                mgr.save(step + 1, (params, opt_state))
             print(f"step {step + 1}: loss={float(loss):.4f} "
                   f"(checkpointed)")
         elif (step + 1) % 5 == 0:
@@ -203,6 +209,7 @@ def main(argv=None) -> int:
           f"({(args.steps - start) / max(dt, 1e-9):.2f} steps/s)")
 
     it.close()  # drain the loader's prefetch thread BEFORE engine teardown
+    mgr.wait_pending()  # last async save durable (or raising) before exit
     engine.sync_stats()
     s = engine.stats
     print(f"engine stats: direct={s.bytes_direct} "

@@ -137,6 +137,8 @@ class CheckpointManager:
         self.directory = str(directory)
         self.max_to_keep = max_to_keep
         self._engine = engine
+        self._executor = None      # lazy, one IO thread (save_async)
+        self._pending = None
         os.makedirs(self.directory, exist_ok=True)
 
     # -- introspection -----------------------------------------------------
@@ -178,6 +180,61 @@ class CheckpointManager:
         tile); process 0 writes the tile index in meta.json.  The temp
         directory is renamed in only when everything is durable.
         """
+        self.wait_pending()
+        return self._write(step, *self._snapshot(step, state, force))
+
+    def save_async(self, step: int, state, force: bool = False):
+        """Checkpoint without blocking the train loop on the NVMe write.
+
+        The device→host snapshot happens NOW (synchronously — the tiles
+        are plain numpy copies afterwards, so later donation/mutation of
+        ``state`` by the train loop cannot corrupt the checkpoint); the
+        slow half — engine writes, fsyncs, the atomic rename — runs on a
+        background thread.  Returns a ``concurrent.futures.Future``
+        resolving to the final path.  At most one save is in flight:
+        a second save_async (or any save/restore) first waits for the
+        previous one and re-raises its error if it failed.
+        """
+        import atexit
+        import concurrent.futures
+
+        import jax
+
+        if jax.process_count() > 1:
+            # _write's cross-host barriers are jax collectives; running
+            # them on this thread while the main thread dispatches train
+            # -step collectives gives the two hosts different dispatch
+            # orders — a mutual-block hazard, not a slowdown.  Multi-host
+            # async needs a coordination redesign; refuse rather than
+            # deadlock the job.
+            raise NotImplementedError(
+                "save_async is single-host only (background cross-host "
+                "sync would race the train loop's collectives); use "
+                "save() on multi-host runs")
+        self.wait_pending()
+        args = self._snapshot(step, state, force)
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="strom-ckpt")
+            # a failed FINAL save must not vanish when the process exits
+            # without calling wait_pending — surface it at teardown
+            atexit.register(self.wait_pending)
+        self._pending = self._executor.submit(self._write, step, *args)
+        return self._pending
+
+    def wait_pending(self) -> None:
+        """Block until an in-flight save_async (if any) completed;
+        re-raises its failure.  restore() calls this so a restore can
+        never read past a checkpoint that is still being written."""
+        if self._pending is not None:
+            f, self._pending = self._pending, None
+            f.result()
+
+    def _snapshot(self, step: int, state, force: bool):
+        """Phase 1 (synchronous): validate, stage the temp dir, snapshot
+        every owned tile to host numpy.  Cheap relative to the NVMe
+        write (HBM→host runs at link speed) and MUST be synchronous:
+        the snapshot is the checkpoint's consistency point."""
         import jax
 
         proc = jax.process_index()
@@ -215,7 +272,14 @@ class CheckpointManager:
                 if owner == proc and local is not None:
                     mine[_tile_key(name, bounds, np.shape(leaf))] = local
             index[name] = entry
+        return tmp, final, mine, index
 
+    def _write(self, step: int, tmp: str, final: str,
+               mine: Dict[str, np.ndarray], index: Dict[str, dict]) -> str:
+        """Phase 2 (threadable): engine writes, meta, fsync, rename."""
+        import jax
+
+        proc = jax.process_index()
         eng, own = self._get_engine()
         try:
             write_safetensors_engine(
@@ -291,6 +355,8 @@ class CheckpointManager:
         fn(name, shape)→Sharding) wins; else a jax.Array target leaf's own
         sharding; else the array stays a host-resident numpy array."""
         import jax
+
+        self.wait_pending()  # never read past an in-flight async save
 
         if step is None:
             step = self.latest_step()
