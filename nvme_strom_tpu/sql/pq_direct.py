@@ -127,10 +127,18 @@ class _Compact:
             n, et = b >> 4, b & 0x0F
             if n == 15:
                 n = self.varint()
+            # bool elements consume ZERO bytes per skip — an unbounded
+            # count from malformed input would spin forever; any honest
+            # collection needs at least... well, bools need nothing, so
+            # bound by what the buffer could possibly hold
+            if n > len(self.buf) - self.pos:
+                raise ThriftError(f"collection count {n} exceeds buffer")
             for _ in range(n):
                 self.skip(et)
         elif ctype == _CT_MAP:
             n = self.varint()
+            if n > len(self.buf) - self.pos:
+                raise ThriftError(f"map count {n} exceeds buffer")
             if n:
                 b = self._byte()
                 kt, vt = b >> 4, b & 0x0F

@@ -253,3 +253,17 @@ def test_page_header_parser_roundtrip(tmp_path, engine):
             assert len(plan.spans) > 1   # data_page_size forced paging
             offs = [o for o, _ in plan.spans]
             assert offs == sorted(offs)
+
+
+def test_page_header_parser_fuzz():
+    """Malformed/truncated header bytes must raise ThriftError (or parse
+    to a header the walker then validates) — never hang or crash."""
+    rng = np.random.default_rng(12)
+    for ln in (0, 1, 3, 7, 17, 64, 256):
+        for _ in range(200):
+            buf = rng.integers(0, 256, ln, dtype=np.uint8).tobytes()
+            try:
+                ph = pq_direct.parse_page_header(buf)
+                assert ph.header_len <= len(buf)
+            except pq_direct.ThriftError:
+                pass
