@@ -66,10 +66,16 @@ def probe_device(timeout_s: int = 120) -> bool:
         ok = r.returncode == 0
         if not ok:
             _log(f"bench: device probe failed: {r.stderr.strip()[-200:]}")
+            _log(_TPU_EVIDENCE_NOTE)
         return ok
     except subprocess.TimeoutExpired:
         _log("bench: device probe TIMED OUT (tunnel down?) — CPU fallback")
+        _log(_TPU_EVIDENCE_NOTE)
         return False
+
+
+_TPU_EVIDENCE_NOTE = ("bench: on-silicon numbers measured while the "
+                      "tunnel was up are recorded in TPU_RESULTS.md")
 
 
 def force_cpu() -> None:
