@@ -15,6 +15,8 @@ number:
   7 train   — train-step model-FLOPs utilisation (compute row)
   8 multi   — N concurrent streams through one engine vs serial (the
               striped-raid0 scaling story's engine-side requirement)
+  9 ckpt    — checkpoint save bandwidth, durable GiB/s (inverse path;
+              no read-derived ceiling → vs_baseline null)
 
 Usage: python bench_suite.py [--config N ... | --all] [--json-only]
 
@@ -291,6 +293,47 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
     return _steady([path], one_scan), rows
 
 
+def bench_checkpoint_write(engine, nbytes: int) -> tuple[float, str]:
+    """Config 9: the inverse path — checkpoint save bandwidth.  Times
+    CheckpointManager.save end to end (tile snapshot, engine writes,
+    meta fsync, atomic rename) through the suite's shared engine, which
+    is what a training run actually pays.  Every repeat writes a fresh
+    step (no pruning inside the timed window); the tag says whether the
+    payload actually went O_DIRECT (durable past the page cache) or the
+    fs forced buffered writes — a page-cache memcpy number must not wear
+    a 'durable' label.  The read side is config 4."""
+    import shutil
+
+    import numpy as np
+    from nvme_strom_tpu.checkpoint.manager import CheckpointManager
+
+    d = os.path.join(_scratch_dir(), "ckpt_bench")
+    shutil.rmtree(d, ignore_errors=True)
+    n_tensors = 8
+    rows = max(1, nbytes // n_tensors // (1024 * 4))
+    rng = np.random.default_rng(0)
+    state = {f"w{i}": rng.standard_normal((rows, 1024), dtype=np.float32)
+             for i in range(n_tensors)}
+    payload = sum(v.nbytes for v in state.values())
+    mgr = CheckpointManager(d, max_to_keep=None, engine=engine)
+    engine.sync_stats()
+    pre_direct = engine.stats.bytes_written_direct
+    rates = []
+    for step in range(_RUNS + 1):
+        t0 = time.monotonic()
+        mgr.save(step, state)
+        r = payload / (1 << 30) / (time.monotonic() - t0)
+        if step > 0:           # step 0 warms jit/allocator paths
+            rates.append(r)
+    engine.sync_stats()
+    direct_w = engine.stats.bytes_written_direct - pre_direct
+    mode = ("durable O_DIRECT" if direct_w >= payload * _RUNS
+            else "BUFFERED (unaligned spans or fs rejects O_DIRECT; "
+                 "page-cache speed)")
+    shutil.rmtree(d, ignore_errors=True)
+    return statistics.median(rates), f"{payload >> 20}MiB/save, {mode}"
+
+
 def bench_multistream(engine, nbytes: int,
                       n_streams: int = 4) -> tuple[float, str]:
     """Config 8: N concurrent file streams through ONE engine vs the same
@@ -564,6 +607,11 @@ def run(configs: list[int]) -> list[dict]:
             7: ("train-step-flops", bench_train, "TFLOP/s", False),
             8: ("multistream-scaling",
                 lambda: bench_multistream(engine, nbytes), "GiB/s", True),
+            # write bandwidth has no read-derived ceiling: io_row=False
+            # keeps vs_baseline null rather than faking a ratio
+            9: ("checkpoint-write",
+                lambda: bench_checkpoint_write(engine, nbytes),
+                "GiB/s", False),
         }
         for c in configs:
             label, fn, unit, io_row = names[c]
@@ -595,12 +643,12 @@ def run(configs: list[int]) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 9))
+                    choices=range(1, 10))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = [1, 2, 3, 4, 5, 6, 7, 8]
+        configs = [1, 2, 3, 4, 5, 6, 7, 8, 9]
     for line in run(configs):
         print(json.dumps(line), flush=True)
     return 0
