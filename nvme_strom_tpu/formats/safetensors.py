@@ -173,7 +173,7 @@ def write_safetensors_engine(path, tensors: Dict[str, np.ndarray], engine,
     at completion.  Only the final partial chunk takes the buffered
     path.  The file stays 100% standard safetensors."""
     align = engine.config.alignment
-    head, offsets = build_header(tensors, metadata, align=align)
+    head, _ = build_header(tensors, metadata, align=align)
     open(path, "wb").close()  # truncate any previous file
     fh = engine.open(path, writable=True)
     # Direct streaming is safe only when alignment is a whole number of
@@ -189,9 +189,12 @@ def write_safetensors_engine(path, tensors: Dict[str, np.ndarray], engine,
     pend: list = []  # (PendingWrite, scratch_idx or None)
 
     # rotating aligned scratches; a scratch is reusable once its write
-    # completed (wait() below strictly precedes reuse)
-    scratches = [None] * depth
-    free_idx = list(range(depth))
+    # completed (wait() below strictly precedes reuse).  Count capped by
+    # the engine's own buffer pool so host scratch memory is bounded the
+    # same way the staging pool is (depth alone may be configured large).
+    n_scratch = max(2, min(depth, engine.n_buffers))
+    scratches = [None] * n_scratch
+    free_idx = list(range(n_scratch))
 
     def drain_one():
         p, sidx = pend.pop(0)
