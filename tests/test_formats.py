@@ -255,3 +255,41 @@ def test_fixedrec_bytes_records_and_errors(tmp_path):
     (tmp_path / "d.sfr").write_bytes(b"not a fixedrec file....")
     with pytest.raises(ValueError, match="magic"):
         FixedRecIndex(tmp_path / "d.sfr")
+
+
+def test_safetensors_engine_buffered_fs_roundtrip():
+    """tmpfs rejects O_DIRECT → the writer's single (tail) path carries
+    the whole data section buffered; the file must round-trip
+    bit-exactly and stay standard safetensors."""
+    import os
+    import shutil
+    import tempfile
+
+    from nvme_strom_tpu.formats.safetensors import write_safetensors_engine
+
+    if not os.path.isdir("/dev/shm"):
+        pytest.skip("no tmpfs mount")
+    d = tempfile.mkdtemp(dir="/dev/shm")
+    try:
+        path = os.path.join(d, "t.safetensors")
+        rng = np.random.default_rng(9)
+        tensors = {
+            "a": rng.standard_normal((1000, 33)).astype(np.float32),
+            "b": rng.integers(0, 1000, 7777, dtype=np.int64),
+            "scalar": np.float32(3.5).reshape(()),
+        }
+        stats = StromStats()
+        with StromEngine(stats=stats) as eng:
+            write_safetensors_engine(path, tensors, eng)
+            eng.sync_stats()
+        assert stats.bytes_written_direct == 0  # all buffered
+        sf = SafetensorsFile(path)
+        with open(path, "rb") as f:
+            for name, ref in tensors.items():
+                t = sf.tensors[name]
+                f.seek(t["offset"])
+                got = np.frombuffer(f.read(t["nbytes"]),
+                                    dtype=ref.dtype).reshape(t["shape"])
+                np.testing.assert_array_equal(got, ref.reshape(t["shape"]))
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
