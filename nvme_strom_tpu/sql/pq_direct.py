@@ -3,27 +3,37 @@ path.
 
 PG-Strom's distinguishing move is decoding table blocks ON the accelerator
 (SURVEY.md §3.5) — the CPU plans, the device decodes.  The Parquet analogue
-for PLAIN-encoded, uncompressed, fixed-width columns:
+for uncompressed, fixed-width columns, two page shapes:
 
-- host (metadata-class I/O, tiny): parse the footer (already held by the
-  scanner) and each data-page header — a minimal Thrift compact-protocol
-  reader, ~40 bytes per page — to compute the exact byte spans of raw
-  little-endian values inside the file;
-- device: the spans stream through the O_DIRECT engine and DeviceStream
-  (staging → HBM, zero host-side payload copies), and the 'decode' is an
-  on-device bitcast + concatenate.  Optional columns with no nulls carry an
-  RLE definition-level block per page; its length is read host-side (8
-  bytes) and the span simply starts after it.
+- **PLAIN** data pages: host (metadata-class I/O, tiny) parses the footer
+  (already held by the scanner) and each data-page header — a minimal
+  Thrift compact-protocol reader, ~40 bytes per page — to compute the
+  exact byte spans of raw little-endian values inside the file; the spans
+  stream through the O_DIRECT engine and DeviceStream (staging → HBM, zero
+  host-side payload copies), and the 'decode' is an on-device bitcast +
+  concatenate.  Optional columns with no nulls carry an RLE
+  definition-level block per page; its length is read host-side (8 bytes)
+  and the span simply starts after it.
+- **Dictionary-encoded** (PLAIN_DICTIONARY / RLE_DICTIONARY) chunks, the
+  PG-Strom dictionary pattern: the dictionary page's PLAIN values stream
+  O_DIRECT → device exactly like a plain span, the data pages'
+  RLE/bit-packed index stream is read through the engine and expanded
+  host-side with a vectorized numpy decoder (runs are sequential
+  bitstream control flow — host work by nature; the decoded index array
+  is honestly counted as bounce), and the final decode is an on-device
+  ``take(dictionary, indices)`` gather.  Chunks where the writer fell
+  back to PLAIN mid-stream (dictionary overflow) assemble both kinds in
+  page order.
 
-Everything else — dictionary encoding, compression, nulls, strings, nested
-schemas — falls back to the pyarrow path in :mod:`.parquet`, which decodes
-on host and honestly counts the handoff copy as bounce.
+Everything else — compression, nulls, strings, nested schemas — falls
+back to the pyarrow path in :mod:`.parquet`, which decodes on host and
+honestly counts the handoff copy as bounce.
 
-Why not decode dictionary/RLE on device too?  The formats are
-variable-length bitstreams; a Pallas cursor over them would serialize
-(one varint at a time) — exactly what the MXU/VPU are worst at.  The
-fixed-width PLAIN case covers the analytics-heavy numeric columns that
-config 5 (BASELINE.md) measures, with payload bytes never touched by host.
+Why not decode the index bitstream on device too?  RLE runs are
+variable-length sequential control flow; a Pallas cursor over them would
+serialize (one varint at a time) — exactly what the MXU/VPU are worst
+at.  The expensive expansion (indices → values) IS on device: the gather
+reads only index ints host-side, never payload values.
 """
 
 from __future__ import annotations
@@ -57,7 +67,10 @@ _PAGE_DATA = 0
 _PAGE_DICTIONARY = 2
 _PAGE_DATA_V2 = 3
 _ENC_PLAIN = 0
+_ENC_PLAIN_DICTIONARY = 2
 _ENC_RLE = 3
+_ENC_RLE_DICTIONARY = 8
+_DICT_ENCODINGS = (_ENC_PLAIN_DICTIONARY, _ENC_RLE_DICTIONARY)
 
 
 class ThriftError(ValueError):
@@ -162,8 +175,8 @@ class PageHeader:
     type: int
     compressed_size: int
     uncompressed_size: int
-    num_values: int          # data pages only (0 otherwise)
-    encoding: int            # data pages only (-1 otherwise)
+    num_values: int          # data/dictionary pages (0 otherwise)
+    encoding: int            # data/dictionary pages (-1 otherwise)
     header_len: int          # bytes the Thrift header itself occupies
     # DataPageHeaderV2 states the level-block lengths explicitly (a v1
     # reader must instead parse RLE length prefixes from the page body)
@@ -190,8 +203,8 @@ def parse_page_header(buf: bytes) -> PageHeader:
             uncomp = c.zigzag()
         elif fid == 3 and t == _CT_I32:
             comp = c.zigzag()
-        elif fid in (5, 8) and t == _CT_STRUCT:
-            # DataPageHeader (v1) / DataPageHeaderV2
+        elif fid in (5, 7, 8) and t == _CT_STRUCT:
+            # DataPageHeader (v1) / DictionaryPageHeader / DataPageHeaderV2
             inner_last = 0
             while True:
                 it, ifid = c.read_field_header(inner_last)
@@ -200,7 +213,7 @@ def parse_page_header(buf: bytes) -> PageHeader:
                 inner_last = ifid
                 if ifid == 1 and it == _CT_I32:
                     num_values = c.zigzag()
-                elif ifid == 2 and it == _CT_I32 and fid == 5:
+                elif ifid == 2 and it == _CT_I32 and fid in (5, 7):
                     encoding = c.zigzag()
                 elif ifid == 4 and it == _CT_I32 and fid == 8:
                     encoding = c.zigzag()
@@ -219,11 +232,33 @@ def parse_page_header(buf: bytes) -> PageHeader:
 
 
 @dataclass(frozen=True)
+class PagePart:
+    """One data page's decodable payload within a column chunk.
+
+    kind "plain": ``span`` covers raw little-endian values (on-device
+    bitcast).  kind "dict": ``span`` covers the RLE/bit-packed index
+    stream (host-expanded, then on-device gather against the chunk's
+    dictionary); ``bit_width`` is the stream's index width.
+    """
+    kind: str                              # "plain" | "dict"
+    span: Tuple[int, int]                  # (offset, length) into the file
+    num_values: int
+    bit_width: int = 0                     # dict parts only
+
+
+@dataclass(frozen=True)
 class ColumnPlan:
-    """Value-byte spans of one column chunk (one row group)."""
-    spans: Tuple[Tuple[int, int], ...]     # (offset, length) into the file
+    """Decodable page layout of one column chunk (one row group)."""
+    parts: Tuple[PagePart, ...]            # in file/page order
     num_values: int
     physical_type: str
+    dict_span: Optional[Tuple[int, int]] = None   # PLAIN dictionary values
+    dict_count: int = 0
+
+    @property
+    def spans(self) -> Tuple[Tuple[int, int], ...]:
+        """Plain value-byte spans (the pre-dictionary API surface)."""
+        return tuple(p.span for p in self.parts if p.kind == "plain")
 
 
 def eligible_chunk(meta, rg: int, ci: int) -> Optional[str]:
@@ -242,10 +277,8 @@ def eligible_chunk(meta, rg: int, ci: int) -> Optional[str]:
     if (col.compression or "UNCOMPRESSED") != "UNCOMPRESSED":
         return f"compression {col.compression}"
     encs = set(col.encodings)
-    if not encs <= {"PLAIN", "RLE"}:
+    if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}:
         return f"encodings {sorted(encs)}"
-    if (col.dictionary_page_offset or 0) > 0:
-        return "dictionary page"
     if sc.max_repetition_level != 0:
         return "repeated field"
     if sc.max_definition_level > 0:
@@ -269,9 +302,14 @@ def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
     width = _WIDTHS[col.physical_type]
     has_def = sc.max_definition_level > 0
     pos = col.data_page_offset
-    end = col.data_page_offset + col.total_compressed_size
+    if (col.dictionary_page_offset or 0) > 0:
+        # the dictionary page precedes the data pages in the chunk
+        pos = min(pos, col.dictionary_page_offset)
+    end = pos + col.total_compressed_size
     remaining = col.num_values
-    spans: List[Tuple[int, int]] = []
+    parts: List[PagePart] = []
+    dict_span: Optional[Tuple[int, int]] = None
+    dict_count = 0
     window = 1 << 10
     while remaining > 0:
         if pos >= end:
@@ -286,8 +324,14 @@ def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
                     raise
                 buf = raw_read(pos, min(len(buf) * 2, end - pos))
         if ph.type in (_PAGE_DATA, _PAGE_DATA_V2):
-            if ph.encoding != _ENC_PLAIN:
-                raise ValueError(f"page encoding {ph.encoding} != PLAIN")
+            if ph.num_values > remaining:
+                # RLE can legally pack huge claimed counts into a few
+                # bytes — an unbounded count would drive a huge host
+                # allocation in the index decoder (and silently
+                # over-long plain output)
+                raise ValueError(
+                    f"page at {pos}: {ph.num_values} values exceeds "
+                    f"chunk remainder {remaining}")
             data_off = pos + ph.header_len
             if ph.type == _PAGE_DATA_V2:
                 # v2: level lengths are stated in the header itself
@@ -299,19 +343,109 @@ def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
                     (n,) = struct.unpack("<I", raw_read(data_off, 4))
                     level_bytes = 4 + n
             val_off = data_off + level_bytes
-            val_len = ph.num_values * width
-            if val_len + level_bytes > ph.compressed_size:
+            if ph.encoding == _ENC_PLAIN:
+                val_len = ph.num_values * width
+                if val_len + level_bytes > ph.compressed_size:
+                    raise ValueError(
+                        f"page at {pos}: {ph.num_values} values x {width} "
+                        f"+ {level_bytes} level bytes > page size "
+                        f"{ph.compressed_size}")
+                parts.append(PagePart("plain", (val_off, val_len),
+                                      ph.num_values))
+            elif ph.encoding in _DICT_ENCODINGS:
+                if dict_span is None:
+                    raise ValueError(
+                        f"page at {pos}: dict-encoded data page before "
+                        f"any dictionary page")
+                # body after levels: <bit_width: 1 byte><RLE-hybrid runs>
+                (bw,) = raw_read(val_off, 1)
+                if bw > 32:
+                    raise ValueError(f"page at {pos}: bit width {bw} > 32")
+                idx_len = ph.compressed_size - level_bytes - 1
+                if idx_len < 0:
+                    raise ValueError(f"page at {pos}: negative index span")
+                parts.append(PagePart("dict", (val_off + 1, idx_len),
+                                      ph.num_values, bit_width=bw))
+            else:
                 raise ValueError(
-                    f"page at {pos}: {ph.num_values} values x {width} + "
-                    f"{level_bytes} level bytes > page size "
-                    f"{ph.compressed_size}")
-            spans.append((val_off, val_len))
+                    f"page at {pos}: unsupported encoding {ph.encoding}")
             remaining -= ph.num_values
         elif ph.type == _PAGE_DICTIONARY:
-            raise ValueError(f"unexpected page type {ph.type}")
+            if dict_span is not None:
+                raise ValueError(f"second dictionary page at {pos}")
+            if ph.encoding not in (_ENC_PLAIN, _ENC_PLAIN_DICTIONARY):
+                raise ValueError(
+                    f"dictionary page encoding {ph.encoding} not PLAIN")
+            val_len = ph.num_values * width
+            if val_len > ph.compressed_size:
+                raise ValueError(
+                    f"dictionary page at {pos}: {ph.num_values} values x "
+                    f"{width} > page size {ph.compressed_size}")
+            dict_span = (pos + ph.header_len, val_len)
+            dict_count = ph.num_values
         # INDEX pages are skipped silently
         pos += ph.header_len + ph.compressed_size
-    return ColumnPlan(tuple(spans), col.num_values, col.physical_type)
+    return ColumnPlan(tuple(parts), col.num_values, col.physical_type,
+                      dict_span=dict_span, dict_count=dict_count)
+
+
+def decode_rle_hybrid(buf: bytes, bit_width: int, count: int):
+    """Parquet RLE/bit-packed hybrid stream → int32 index array (host).
+
+    The stream is a sequence of runs, each headed by a varint: low bit 1
+    → bit-packed run of ``(header >> 1) * 8`` values (``bit_width`` bits
+    each, LSB-first little-endian — decoded vectorized via
+    ``np.unpackbits``); low bit 0 → RLE run of ``header >> 1`` copies of
+    one ``ceil(bit_width / 8)``-byte value.  The final run may carry
+    padding values past ``count``; they are discarded per the spec.
+    """
+    import numpy as np
+    out = np.empty(count, np.int32)
+    if bit_width == 0:
+        # zero-width indices: a single-entry dictionary, all index 0
+        out[:] = 0
+        return out
+    byte_w = (bit_width + 7) // 8
+    weights = (np.int64(1) << np.arange(bit_width, dtype=np.int64))
+    pos, filled, n = 0, 0, len(buf)
+    while filled < count:
+        header = shift = 0
+        while True:
+            if pos >= n:
+                raise ValueError("truncated RLE stream header")
+            b = buf[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 35:
+                raise ValueError("RLE header varint overflow")
+        if header & 1:                       # bit-packed run
+            groups = header >> 1
+            nbytes = groups * bit_width      # groups of 8 values
+            if pos + nbytes > n:
+                raise ValueError("truncated bit-packed run")
+            bits = np.unpackbits(
+                np.frombuffer(buf, np.uint8, nbytes, pos),
+                bitorder="little")
+            vals = bits.reshape(-1, bit_width).astype(np.int64) @ weights
+            take = min(groups * 8, count - filled)
+            out[filled:filled + take] = vals[:take]
+            filled += take
+            pos += nbytes
+        else:                                # RLE run
+            run = header >> 1
+            if run == 0:
+                raise ValueError("zero-length RLE run")
+            if pos + byte_w > n:
+                raise ValueError("truncated RLE run value")
+            v = int.from_bytes(buf[pos:pos + byte_w], "little")
+            pos += byte_w
+            take = min(run, count - filled)
+            out[filled:filled + take] = v
+            filled += take
+    return out
 
 
 def plan_columns(scanner, columns: Sequence[str]
@@ -358,18 +492,107 @@ def _stream_spans(scanner, ds, fh, spans, physical_type):
         if ln:
             ranges.append((off, ln))
     parts = list(ds.stream_ranges(fh, ranges))
+    if not parts:    # zero-row chunk: no spans to stream
+        return jnp.zeros((0,), dtype=np.dtype(_NP_DTYPES[physical_type]))
     flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     return flat.view(np.dtype(_NP_DTYPES[physical_type]))
+
+
+def _read_span_bytes(engine, fh, off: int, ln: int) -> bytes:
+    """Direct-engine read of a small control-stream span → host bytes.
+
+    ``engine.read`` counts the staging→host copy as bounce — same rule
+    as the pyarrow handoff (`parquet.EngineFile.readinto`): payload-class
+    bytes a host decoder must touch.  Index streams are the small side of
+    a dictionary chunk (≤ ~bit_width/8 bytes per value vs the full value
+    width for the gathered output, which never exists host-side).
+    """
+    eng_chunk = engine.config.chunk_bytes
+    parts = [engine.read(fh, pos, min(eng_chunk, off + ln - pos)).tobytes()
+             for pos in range(off, off + ln, eng_chunk)]
+    return parts[0] if len(parts) == 1 else b"".join(parts)
+
+
+def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
+    """One column chunk → one device array, pages assembled in order.
+
+    Plain pages stream O_DIRECT→device and bitcast there.  Dict-encoded
+    pages: the dictionary's PLAIN values stream the same zero-copy path,
+    index streams are host-expanded (:func:`decode_rle_hybrid`) and the
+    decode is an on-device ``take`` — values never materialize on host.
+    Adjacent dict pages share one gather.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.ops.bridge import host_to_device
+
+    eng = scanner.engine
+    dict_dev = None
+    if any(p.kind == "dict" for p in plan.parts):
+        dict_dev = _stream_spans(scanner, ds, fh, [plan.dict_span],
+                                 plan.physical_type)
+    segs = []            # device arrays in page order
+    pending_idx = []     # decoded index arrays of adjacent dict pages
+    pending_plain = []   # value spans of adjacent plain pages
+
+    def flush_dict():
+        if pending_idx:
+            idx = (pending_idx[0] if len(pending_idx) == 1
+                   else np.concatenate(pending_idx))
+            # jnp.take clips out-of-range indices — a corrupt stream
+            # would yield silently wrong rows; fail loudly instead
+            hi = int(idx.max()) if idx.size else -1
+            if hi >= plan.dict_count or (idx.size and int(idx.min()) < 0):
+                raise ValueError(
+                    f"dictionary index {hi} out of range "
+                    f"[0, {plan.dict_count})")
+            # The decoded array is host-materialized payload-derived
+            # data → counted as bounce.  On CPU host_to_device already
+            # counts this exact buffer via its alias-protection copy.
+            if dev.platform != "cpu":
+                eng.stats.add(bounce_bytes=int(idx.nbytes))
+            segs.append(jnp.take(dict_dev, host_to_device(eng, idx, dev)))
+            pending_idx.clear()
+
+    def flush_plain():
+        if pending_plain:
+            # one pipelined stream over the adjacent spans — per-page
+            # calls would collapse the queue to depth 1
+            segs.append(_stream_spans(scanner, ds, fh, list(pending_plain),
+                                      plan.physical_type))
+            pending_plain.clear()
+
+    for p in plan.parts:
+        if p.kind == "plain":
+            flush_dict()
+            pending_plain.append(p.span)
+        else:
+            flush_plain()
+            raw = _read_span_bytes(eng, fh, *p.span)
+            pending_idx.append(
+                decode_rle_hybrid(raw, p.bit_width, p.num_values))
+    flush_dict()
+    flush_plain()
+    if not segs:     # zero-row chunk
+        return jnp.zeros((0,),
+                         dtype=np.dtype(_NP_DTYPES[plan.physical_type]))
+    return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+
+
+def _plain_only(plans: Sequence[ColumnPlan]) -> bool:
+    return all(p.kind == "plain" for plan in plans for p in plan.parts)
 
 
 def read_plain_columns_to_device(scanner, columns: Sequence[str],
                                  device=None, plans=None
                                  ) -> Dict[str, "object"]:
     """Direct scan of the whole file: {name: device array}, row groups
-    concatenated ON DEVICE.  Payload bytes ride O_DIRECT → staging →
-    device; the host reads only headers.  ``plans`` lets callers reuse a
+    concatenated ON DEVICE.  Payload bytes (PLAIN values and dictionary
+    values) ride O_DIRECT → staging → device; the host reads only
+    headers and dict index streams.  ``plans`` lets callers reuse a
     prior :func:`plan_columns` walk."""
     import jax
+    import jax.numpy as jnp
     from nvme_strom_tpu.ops.bridge import DeviceStream
 
     dev = device or jax.local_devices()[0]
@@ -377,12 +600,27 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
     ds = DeviceStream(scanner.engine, device=dev,
                       depth=scanner.engine.config.queue_depth)
     out = {}
+    meta = scanner.metadata
+    name_to_ci = {meta.schema.column(i).name: i
+                  for i in range(meta.num_columns)}
     fh = scanner.engine.open(scanner.path)
     try:
         for c in columns:
-            out[c] = _stream_spans(
-                scanner, ds, fh, (s for p in plans[c] for s in p.spans),
-                plans[c][0].physical_type)
+            if not plans[c]:   # zero row groups: empty typed column
+                pt = meta.schema.column(name_to_ci[c]).physical_type
+                out[c] = jnp.zeros((0,),
+                                   dtype=np.dtype(_NP_DTYPES[pt]))
+            elif _plain_only(plans[c]):
+                # one pipelined stream across every row group's spans
+                out[c] = _stream_spans(
+                    scanner, ds, fh,
+                    (s for p in plans[c] for s in p.spans),
+                    plans[c][0].physical_type)
+            else:
+                parts = [_assemble_chunk(scanner, ds, fh, plan, dev)
+                         for plan in plans[c]]
+                out[c] = (parts[0] if len(parts) == 1
+                          else jnp.concatenate(parts))
     finally:
         scanner.engine.close(fh)
     return out
@@ -404,8 +642,14 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
     fh = scanner.engine.open(scanner.path)
     try:
         for rg in range(scanner.metadata.num_row_groups):
-            yield {c: _stream_spans(scanner, ds, fh, plans[c][rg].spans,
-                                    plans[c][rg].physical_type)
-                   for c in columns}
+            out = {}
+            for c in columns:
+                plan = plans[c][rg]
+                if _plain_only([plan]):
+                    out[c] = _stream_spans(scanner, ds, fh, plan.spans,
+                                           plan.physical_type)
+                else:
+                    out[c] = _assemble_chunk(scanner, ds, fh, plan, dev)
+            yield out
     finally:
         scanner.engine.close(fh)

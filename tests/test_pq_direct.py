@@ -1,8 +1,9 @@
-"""pq_direct: on-device PLAIN Parquet decode vs pyarrow ground truth.
+"""pq_direct: on-device Parquet decode (PLAIN + dictionary) vs pyarrow.
 
 The fast path must (a) bit-match pyarrow on every supported physical
-type and nullability shape, (b) refuse anything it can't decode with a
-reason, and (c) never touch payload bytes on host (accounting test).
+type, encoding and nullability shape, (b) refuse anything it can't
+decode with a reason, and (c) never touch payload bytes on host
+(accounting tests) — dictionary chunks touch only the index stream.
 """
 
 import os
@@ -110,13 +111,14 @@ def test_direct_rejects_with_reasons(tmp_path, engine):
     rng = np.random.default_rng(2)
     rows = 2000
 
-    # dictionary-encoded
-    p1 = str(tmp_path / "dict.parquet")
+    # delta-encoded (no on-device decode)
+    p1 = str(tmp_path / "delta.parquet")
     pq.write_table(pa.table({"v": pa.array(
-        rng.integers(0, 4, rows, dtype=np.int32))}), p1,
-        compression="none", use_dictionary=True)
+        rng.integers(0, 10**6, rows, dtype=np.int32))}), p1,
+        compression="none", use_dictionary=False,
+        column_encoding={"v": "DELTA_BINARY_PACKED"})
     r = ParquetScanner(p1, engine).direct_reasons(["v"])
-    assert r["v"] is not None
+    assert r["v"] is not None and "encodings" in r["v"]
 
     # compressed
     p2 = str(tmp_path / "snappy.parquet")
@@ -253,6 +255,174 @@ def test_page_header_parser_roundtrip(tmp_path, engine):
             assert len(plan.spans) > 1   # data_page_size forced paging
             offs = [o for o, _ in plan.spans]
             assert offs == sorted(offs)
+
+
+def test_rle_hybrid_decoder_unit():
+    """Hand-crafted RLE/bit-packed hybrid streams decode exactly."""
+    # RLE run: header = count << 1 (low bit 0), then ceil(bw/8)-byte value
+    out = pq_direct.decode_rle_hybrid(bytes([10 << 1, 7]), 3, 10)
+    np.testing.assert_array_equal(out, np.full(10, 7))
+
+    # bit-packed run, bit_width 3: one group of 8 values 0..7
+    # packed LSB-first: 0,1,2,...,7 → 3 bytes 0b10001000 0b11000110 0b11111010
+    vals = np.arange(8)
+    bits = np.zeros(24, np.uint8)
+    for i, v in enumerate(vals):
+        for b in range(3):
+            bits[i * 3 + b] = (v >> b) & 1
+    packed = np.packbits(bits, bitorder="little").tobytes()
+    out = pq_direct.decode_rle_hybrid(bytes([1 << 1 | 1]) + packed, 3, 8)
+    np.testing.assert_array_equal(out, vals)
+
+    # mixed: RLE run of 4 fives, then the bit-packed 0..7, truncated to 10
+    stream = bytes([4 << 1, 5]) + bytes([1 << 1 | 1]) + packed
+    out = pq_direct.decode_rle_hybrid(stream, 3, 10)
+    np.testing.assert_array_equal(out, [5, 5, 5, 5, 0, 1, 2, 3, 4, 5])
+
+    # bit_width 0: single-entry dictionary, indices all zero, no bytes
+    np.testing.assert_array_equal(
+        pq_direct.decode_rle_hybrid(b"", 0, 6), np.zeros(6))
+
+    # wide value: bit_width 17 RLE run uses a 3-byte little-endian value
+    v = 0x1ABCD
+    out = pq_direct.decode_rle_hybrid(
+        bytes([3 << 1]) + v.to_bytes(3, "little"), 17, 3)
+    np.testing.assert_array_equal(out, np.full(3, v))
+
+    # truncation raises, never hangs
+    with pytest.raises(ValueError):
+        pq_direct.decode_rle_hybrid(b"", 3, 5)
+    with pytest.raises(ValueError):
+        pq_direct.decode_rle_hybrid(bytes([1 << 1 | 1]), 3, 8)
+
+
+def test_dict_decode_matches_pyarrow(tmp_path, engine):
+    """Dictionary-encoded chunks decode on device (gather) and bit-match
+    pyarrow across row groups and page boundaries."""
+    rng = np.random.default_rng(21)
+    rows = 20000
+    ki = rng.integers(0, 37, rows)
+    kf = rng.integers(0, 11, rows)
+    fvals = rng.standard_normal(11).astype(np.float32)
+    tbl = pa.table({
+        "i32": pa.array(ki.astype(np.int32)),
+        "f32": pa.array(fvals[kf]),
+    })
+    path = str(tmp_path / "dict.parquet")
+    pq.write_table(tbl, path, compression="none", use_dictionary=True,
+                   row_group_size=6000, data_page_size=4096)
+    sc = ParquetScanner(path, engine)
+    assert all(r is None for r in sc.direct_reasons(["i32", "f32"]).values())
+    plans = pq_direct.plan_columns(sc, ["i32", "f32"])
+    assert any(p.kind == "dict" for plan in plans["i32"]
+               for p in plan.parts)
+    assert all(plan.dict_span is not None for plan in plans["i32"])
+    out = sc.read_columns_to_device(["i32", "f32"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out["i32"]),
+                                  tbl.column("i32").to_numpy())
+    np.testing.assert_array_equal(np.asarray(out["f32"]),
+                                  tbl.column("f32").to_numpy())
+
+
+def test_dict_single_entry_bit_width_zero(tmp_path, engine):
+    """A constant column gets a 1-entry dictionary and bit_width 0."""
+    rows = 3000
+    tbl = pa.table({"v": pa.array(np.full(rows, 42, np.int32))})
+    path = str(tmp_path / "const.parquet")
+    pq.write_table(tbl, path, compression="none", use_dictionary=True)
+    sc = ParquetScanner(path, engine)
+    out = sc.read_columns_to_device(["v"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out["v"]),
+                                  np.full(rows, 42, np.int32))
+
+
+def test_dict_overflow_mixed_plain_pages(tmp_path, engine):
+    """When the writer's dictionary overflows it falls back to PLAIN data
+    pages mid-chunk; the plan carries both kinds and assembly preserves
+    page order."""
+    rng = np.random.default_rng(22)
+    rows = 30000
+    vals = rng.integers(0, 2**30, rows).astype(np.int32)  # high cardinality
+    tbl = pa.table({"v": pa.array(vals)})
+    path = str(tmp_path / "overflow.parquet")
+    pq.write_table(tbl, path, compression="none", use_dictionary=True,
+                   dictionary_pagesize_limit=4096, data_page_size=8192)
+    sc = ParquetScanner(path, engine)
+    plans = pq_direct.plan_columns(sc, ["v"])
+    kinds = {p.kind for plan in plans["v"] for p in plan.parts}
+    assert kinds == {"dict", "plain"}, f"writer did not mix pages: {kinds}"
+    out = sc.read_columns_to_device(["v"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out["v"]), vals)
+
+
+def test_dict_accounting(tmp_path, monkeypatch):
+    """Dictionary scan accounting: device receives dict values + decoded
+    indices; host-touched payload (bounce) is the raw index stream plus
+    the decoded index array (plus CPU-only device_put alias copies of
+    the streamed dictionary values)."""
+    monkeypatch.setenv("STROM_NO_RESIDENCY_PROBE", "1")
+    rng = np.random.default_rng(23)
+    rows = 16384
+    tbl = pa.table({"v": pa.array(rng.integers(0, 50, rows)
+                                  .astype(np.int32))})
+    path = str(tmp_path / "acct_dict.parquet")
+    pq.write_table(tbl, path, compression="none", use_dictionary=True)
+
+    stats = StromStats()
+    with StromEngine(stats=stats) as eng:
+        fh = eng.open(path)
+        is_direct = eng.file_is_direct(fh)
+        eng.close(fh)
+        if not is_direct:
+            pytest.skip("fs rejects O_DIRECT")
+        sc = ParquetScanner(path, eng)
+        plans = pq_direct.plan_columns(sc, ["v"])
+        idx_raw = sum(p.span[1] for plan in plans["v"]
+                      for p in plan.parts if p.kind == "dict")
+        dict_bytes = sum(plan.dict_span[1] for plan in plans["v"])
+        out = sc.read_columns_to_device(["v"], direct="always")
+        np.testing.assert_array_equal(np.asarray(out["v"]),
+                                      tbl.column("v").to_numpy())
+        eng.sync_stats()
+    assert idx_raw > 0 and dict_bytes > 0
+    # device saw the dictionary values plus one int32 index per row
+    assert stats.bytes_to_device == dict_bytes + 4 * rows
+    import jax
+    dict_alias = (dict_bytes if jax.devices()[0].platform == "cpu" else 0)
+    assert stats.bounce_bytes == idx_raw + 4 * rows + dict_alias
+
+
+def test_groupby_on_dict_file(tmp_path, engine):
+    """sql_groupby consumes the dict fast path transparently."""
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    rng = np.random.default_rng(24)
+    rows, groups = 20000, 16
+    keys = rng.integers(0, groups, rows).astype(np.int32)
+    vals = rng.integers(0, 9, rows).astype(np.float32)  # low cardinality
+    tbl = pa.table({"k": pa.array(keys), "v": pa.array(vals)})
+    path = str(tmp_path / "gdict.parquet")
+    pq.write_table(tbl, path, compression="none", use_dictionary=True,
+                   row_group_size=8192)
+    sc = ParquetScanner(path, engine)
+    assert all(r is None for r in sc.direct_reasons(["k", "v"]).values())
+    out = sql_groupby(sc, "k", "v", groups, aggs=("count", "sum"))
+    exp_count = np.bincount(keys, minlength=groups)
+    exp_sum = np.bincount(keys, weights=vals.astype(np.float64),
+                          minlength=groups)
+    np.testing.assert_array_equal(np.asarray(out["count"]), exp_count)
+    np.testing.assert_allclose(np.asarray(out["sum"]), exp_sum, rtol=2e-4)
+
+
+def test_empty_table_direct_scan(tmp_path, engine):
+    """Zero-row files return empty typed columns, not a concat crash."""
+    schema = pa.schema([pa.field("v", pa.float32(), nullable=False)])
+    tbl = pa.table({"v": pa.array([], type=pa.float32())}, schema=schema)
+    path = str(tmp_path / "empty.parquet")
+    _write(path, tbl)
+    sc = ParquetScanner(path, engine)
+    out = sc.read_columns_to_device(["v"], direct="auto")
+    arr = np.asarray(out["v"])
+    assert arr.shape == (0,) and arr.dtype == np.float32
 
 
 def test_page_header_parser_fuzz():
