@@ -52,11 +52,17 @@ def cache_shardings(mesh, tp_axis: str = "tp", dp_axis: str = "dp"):
             "pos": NamedSharding(mesh, prune_spec(P(), mesh))}
 
 
-def _mlp_block(h, p, L, cfg):
+def mlp_block(h, p, L, cfg):
+    """Dense-or-MoE MLP dispatch for one layer — shared by the dense
+    decode path here and the paged decode path (models/kv_offload.py),
+    so layer-kind routing can never diverge between the two."""
     if cfg.is_moe_layer(int(L.split(".")[1])):
         out, _ = _moe.moe_mlp(h, p, L, cfg)
         return out
     return mlp(h, p, L)
+
+
+_mlp_block = mlp_block      # original (private) name, kept for callers
 
 
 def prefill(params: Dict, tokens: jax.Array, cfg: TransformerConfig,

@@ -1,0 +1,408 @@
+"""SSD-backed KV cache: decode beyond HBM via the strom-io engine.
+
+The reference moves file bytes into accelerator memory so consumers can
+work on data larger than the device (SURVEY.md §3.5 — PG-Strom scans
+tables bigger than GPU RAM).  This module applies the same move to the
+inference KV cache: a decode session whose attention history exceeds the
+device budget keeps only a recent window in HBM and spills full pages to
+NVMe through the engine's write path (the checkpoint/inverse direction,
+SURVEY.md §5), streaming them back through DeviceStream for attention.
+
+TPU-first structure:
+
+- the HBM working set is two static-shape arrays
+  ``(n_layers, batch, n_kv_heads, window, head_dim)`` — page eviction is
+  an on-device shift, never a reallocation, so every jitted step reuses
+  one compiled program regardless of total history length;
+- attention over history is **online-softmax accumulation** (the
+  flash-attention recipe) at kv-head width: each NVMe page contributes a
+  partial ``(m, l, acc)`` that combines associatively with the window's
+  partial, so pages stream through one at a time and the full history
+  never co-resides in HBM;
+- GQA queries are grouped to their kv head inside the partial
+  (``(b, n_kv, group, hd)``) — no expanded cache copies anywhere;
+- the page file layout is stride-regular (k block then v block per
+  page, layer-major inside) so a layer's page reads are two contiguous
+  spans the engine can pipeline at queue depth.
+
+Honest accounting: evicted pages ride ``submit_write`` (O_DIRECT when
+aligned, bounced+counted otherwise); streamed pages ride the zero-copy
+read path and count ``bytes_to_device``, exactly like every other
+consumer of the engine.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.models.decode import mlp_block as _mlp_block
+from nvme_strom_tpu.models.transformer import (
+    TransformerConfig, qkv_project, rms_norm)
+from nvme_strom_tpu.ops.bridge import DeviceStream
+
+
+@dataclass(frozen=True)
+class OffloadConfig:
+    """Shape of the HBM window and its NVMe backing file.
+
+    window = ``page_len * window_pages`` recent positions stay in HBM;
+    older history lives in ``path`` in ``page_len``-position pages.
+    """
+    path: str
+    page_len: int = 256
+    window_pages: int = 4
+
+    @property
+    def window(self) -> int:
+        return self.page_len * self.window_pages
+
+
+# ---------------------------------------------------------------------------
+# jitted pieces (cached per shape)
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _append_block(k_win, v_win, k_new, v_new, count):
+    """Write (L,b,nkv,s,hd) new positions at window slot ``count``."""
+    k_win = lax.dynamic_update_slice(k_win, k_new, (0, 0, 0, count, 0))
+    v_win = lax.dynamic_update_slice(v_win, v_new, (0, 0, 0, count, 0))
+    return k_win, v_win
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _append_layer(k_win, v_win, k_new, v_new, layer, count):
+    """Write one layer's (1,b,nkv,1,hd) position at (layer, count)."""
+    k_win = lax.dynamic_update_slice(k_win, k_new, (layer, 0, 0, count, 0))
+    v_win = lax.dynamic_update_slice(v_win, v_new, (layer, 0, 0, count, 0))
+    return k_win, v_win
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=())
+def _evict_pages(k_win, v_win, page_slots: int):
+    """Split off the oldest ``page_slots`` positions; shift the rest down.
+
+    Returns (k_page, v_page, k_win', v_win') — the page arrays are the
+    evicted history (device-resident until the engine write drains them).
+    """
+    L, b, nkv, W, hd = k_win.shape
+    k_page = lax.slice_in_dim(k_win, 0, page_slots, axis=3)
+    v_page = lax.slice_in_dim(v_win, 0, page_slots, axis=3)
+    pad = jnp.zeros((L, b, nkv, page_slots, hd), k_win.dtype)
+    k_win = jnp.concatenate(
+        [lax.slice_in_dim(k_win, page_slots, W, axis=3), pad], axis=3)
+    v_win = jnp.concatenate(
+        [lax.slice_in_dim(v_win, page_slots, W, axis=3), pad], axis=3)
+    return k_page, v_page, k_win, v_win
+
+
+def _grouped(q, n_kv: int):
+    """(b, nh, s, hd) queries → (b, n_kv, g*s, hd) grouped to kv heads."""
+    b, nh, s, hd = q.shape
+    g = nh // n_kv
+    return q.reshape(b, n_kv, g * s, hd)
+
+
+@jax.jit
+def _page_partial(q, k_page, v_page):
+    """Partial attention of grouped queries against one full page.
+
+    q (b, nkv, g, hd); k/v (b, nkv, P, hd) → m (b,nkv,g,1), l, acc."""
+    hd = q.shape[-1]
+    s = jnp.einsum("bkgd,bksd->bkgs", q.astype(jnp.float32),
+                   k_page.astype(jnp.float32)) / jnp.sqrt(jnp.float32(hd))
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bkgs,bksd->bkgd", p, v_page.astype(jnp.float32))
+    return m, l, acc
+
+
+@jax.jit
+def _window_partial(q, k_win_l, v_win_l, count):
+    """Partial over the window's first ``count`` valid positions."""
+    hd = q.shape[-1]
+    W = k_win_l.shape[2]
+    s = jnp.einsum("bkgd,bksd->bkgs", q.astype(jnp.float32),
+                   k_win_l.astype(jnp.float32)) / jnp.sqrt(jnp.float32(hd))
+    s = jnp.where((jnp.arange(W) < count)[None, None, None, :], s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bkgs,bksd->bkgd", p, v_win_l.astype(jnp.float32))
+    return m, l, acc
+
+
+@jax.jit
+def _combine(m1, l1, a1, m2, l2, a2):
+    """Associative online-softmax merge of two partials."""
+    m = jnp.maximum(m1, m2)
+    w1 = jnp.exp(m1 - m)
+    w2 = jnp.exp(m2 - m)
+    return m, l1 * w1 + l2 * w2, a1 * w1 + a2 * w2
+
+
+@jax.jit
+def _finish(m, l, acc):
+    """(b, nkv, rows, hd) partials → normalized attention rows.
+
+    Row index kv*(g*s)+j*s+t equals (kv*g+j)*s+t — i.e. flattened
+    (head, position) row-major — so the caller's reshape to
+    (b, n_heads, s, hd) is exact for any s."""
+    return acc / l
+
+
+class PagedKVCache:
+    """Mutable decode-session KV cache: HBM window + NVMe page tiers.
+
+    The host orchestrates the tier boundary (append/evict/stream) while
+    every tensor op runs jitted on device with static shapes.  Not
+    thread-safe; one instance per decode session.
+    """
+
+    def __init__(self, cfg: TransformerConfig, ocfg: OffloadConfig,
+                 engine: StromEngine, batch: int, device=None):
+        self.cfg = cfg
+        self.ocfg = ocfg
+        self.engine = engine
+        self.batch = batch
+        self.device = device or jax.local_devices()[0]
+        L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        W = ocfg.window
+        shape = (L, batch, nkv, W, hd)
+        self.k_win = jnp.zeros(shape, cfg.dtype)
+        self.v_win = jnp.zeros(shape, cfg.dtype)
+        self.count = 0            # valid positions in the window (host int)
+        self.n_cold = 0           # pages already written to NVMe
+        self._itemsize = jnp.zeros((), cfg.dtype).dtype.itemsize
+        # per-layer bytes of one page of one of k/v
+        self._pb_layer = (batch * nkv * ocfg.page_len * hd * self._itemsize)
+        self._pb_block = self._pb_layer * L     # all layers of k (or v)
+        self._fh = engine.open(ocfg.path, writable=True)
+        self._stream = DeviceStream(engine, device=self.device,
+                                    depth=engine.config.queue_depth)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self.engine.close(self._fh)
+            self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @property
+    def pos(self) -> int:
+        """Total cached positions (cold + window)."""
+        return self.n_cold * self.ocfg.page_len + self.count
+
+    # -- write tier -------------------------------------------------------
+
+    def _page_offsets(self, page: int) -> Tuple[int, int]:
+        """(k_offset, v_offset) of a page's layer-major blocks."""
+        base = page * 2 * self._pb_block
+        return base, base + self._pb_block
+
+    def _write_page(self, k_page, v_page) -> None:
+        """Evicted (L,b,nkv,P,hd) pair → two contiguous engine writes.
+
+        Synchronous: the page may be streamed back by the very next
+        ``attend`` call, so completion is part of eviction."""
+        koff, voff = self._page_offsets(self.n_cold)
+        pend = []
+        for arr, off in ((k_page, koff), (v_page, voff)):
+            host = np.ascontiguousarray(
+                np.asarray(arr)).view(np.uint8).reshape(-1)
+            chunk = self.engine.config.chunk_bytes
+            for p0 in range(0, host.nbytes, chunk):
+                part = host[p0:p0 + chunk]
+                pend.append(
+                    self.engine.submit_write(self._fh, off + p0, part))
+        for p in pend:
+            p.wait()
+        self.n_cold += 1
+
+    def _evict_one(self) -> None:
+        k_page, v_page, self.k_win, self.v_win = _evict_pages(
+            self.k_win, self.v_win, self.ocfg.page_len)
+        self._write_page(k_page, v_page)
+        self.count -= self.ocfg.page_len
+
+    def append(self, k_new, v_new) -> None:
+        """Push (L, b, nkv, s, hd) new positions; evict pages as needed.
+
+        Post-condition: ``count < window`` — at least one free slot, the
+        invariant the per-step append_layer/commit_step cycle relies on.
+        """
+        W = self.ocfg.window
+        s = k_new.shape[3]
+        done = 0
+        while done < s:
+            take = min(W - self.count, s - done)
+            if take > 0:
+                blk_k = lax.slice_in_dim(k_new, done, done + take, axis=3)
+                blk_v = lax.slice_in_dim(v_new, done, done + take, axis=3)
+                self.k_win, self.v_win = _append_block(
+                    self.k_win, self.v_win, blk_k.astype(self.cfg.dtype),
+                    blk_v.astype(self.cfg.dtype),
+                    jnp.asarray(self.count, jnp.int32))
+                self.count += take
+                done += take
+            if self.count == W:
+                self._evict_one()
+
+    def append_layer(self, layer: int, k, v) -> None:
+        """Stage one layer's (b, nkv, 1, hd) position at slot ``count``
+        WITHOUT advancing it — every layer of a step writes the same
+        slot; :meth:`commit_step` advances.  Requires count < window
+        (guaranteed by append/commit_step post-conditions)."""
+        self.k_win, self.v_win = _append_layer(
+            self.k_win, self.v_win, k[None].astype(self.cfg.dtype),
+            v[None].astype(self.cfg.dtype),
+            jnp.asarray(layer, jnp.int32),
+            jnp.asarray(self.count, jnp.int32))
+
+    def commit_step(self) -> None:
+        """Advance past the slot all layers just staged; evict if full."""
+        self.count += 1
+        if self.count == self.ocfg.window:
+            self._evict_one()
+
+    # -- read tier --------------------------------------------------------
+
+    def _iter_layer_pages(self, layer: int):
+        """Stream (k_page, v_page) device pairs for one layer's cold
+        history, pipelined at queue depth across all pages.  Spans
+        larger than the engine's staging buffers split into chunk-sized
+        sub-ranges (mirroring the write side); the on-device concat
+        reassembles each page."""
+        P = self.ocfg.page_len
+        L, b, nkv, _, hd = self.k_win.shape
+        chunk = self.engine.config.chunk_bytes
+        ranges = []         # flat sub-range list, page/k/v-ordered
+        n_sub = []          # sub-ranges per (page, k-or-v) span
+        for page in range(self.n_cold):
+            koff, voff = self._page_offsets(page)
+            for base in (koff + layer * self._pb_layer,
+                         voff + layer * self._pb_layer):
+                before = len(ranges)
+                off, ln = base, self._pb_layer
+                while ln > 0:
+                    take = min(chunk, ln)
+                    ranges.append((off, take))
+                    off += take
+                    ln -= take
+                n_sub.append(len(ranges) - before)
+        it = self._stream.stream_ranges(self._fh, ranges)
+        counts = iter(n_sub)
+
+        def read_span():
+            parts = [next(it) for _ in range(next(counts))]
+            flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+            return flat.view(self.cfg.dtype).reshape(b, nkv, P, hd)
+
+        for _ in range(self.n_cold):
+            yield read_span(), read_span()
+
+    def attend(self, layer: int, q,
+               valid: Optional[int] = None) -> jax.Array:
+        """Full-history attention for one layer's query block.
+
+        q (b, n_heads, s, hd) — every query row attends to the entire
+        cached history (cold pages + ``valid`` window slots, default
+        ``count``), so use this only when all ``s`` queries share that
+        same visible history (s == 1 decode; pass ``valid=count+1``
+        after append_layer so a step's own position is visible to its
+        own query).  Returns (b, n_heads, s, hd).
+        """
+        b, nh, s_q, hd = q.shape
+        qf = _grouped(q, self.cfg.n_kv_heads)
+        m, l, acc = _window_partial(
+            qf, self.k_win[layer], self.v_win[layer],
+            jnp.asarray(self.count if valid is None else valid, jnp.int32))
+        for k_page, v_page in self._iter_layer_pages(layer):
+            pm, pl, pacc = _page_partial(qf, k_page, v_page)
+            m, l, acc = _combine(m, l, acc, pm, pl, pacc)
+        out = _finish(m, l, acc)
+        return out.reshape(b, nh, s_q, hd).astype(self.cfg.dtype)
+
+
+# ---------------------------------------------------------------------------
+# generation on top of the paged cache
+
+
+def offload_decode_step(params: Dict, token, cfg: TransformerConfig,
+                        cache: PagedKVCache):
+    """One decode step against the paged cache (mirrors
+    models/decode.decode_step, with append_layer+attend replacing the
+    dense cache update).  The per-layer host loop is the tier boundary:
+    NVMe streaming happens between jitted segments.  token (b,) int32 →
+    next-token logits (b, vocab) f32."""
+    b = token.shape[0]
+    pos = cache.pos
+    x = params["tok_embed"].astype(cfg.dtype)[token[:, None]]
+    positions = jnp.asarray([pos], jnp.float32)
+    for i in range(cfg.n_layers):
+        Lk = f"layers.{i}."
+        h = rms_norm(x, params[Lk + "attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_project(h, params, Lk, cfg, positions=positions)
+        # layer i's kv lands in the window BEFORE its attention so the
+        # new position is visible to its own query (valid=count+1);
+        # count itself advances once per step in commit_step
+        cache.append_layer(i, k, v)
+        a = cache.attend(i, q, valid=cache.count + 1)
+        a = a.transpose(0, 2, 1, 3).reshape(b, 1, -1)
+        x = x + a @ params[Lk + "wo"].astype(a.dtype)
+        h = rms_norm(x, params[Lk + "mlp_norm"], cfg.norm_eps)
+        x = (x + _mlp_block(h, params, Lk, cfg)).astype(cfg.dtype)
+    cache.commit_step()
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits
+
+
+def offloaded_generate(params: Dict, prompt, cfg: TransformerConfig,
+                       ocfg: OffloadConfig, engine: StromEngine,
+                       max_new_tokens: int,
+                       eos_id: Optional[int] = None,
+                       pad_id: int = 0):
+    """Greedy generation with the SSD-backed cache.
+
+    prompt (b, s) int32 → (b, max_new_tokens) int32.  The prompt is
+    prefilled through the standard dense path (it must fit in HBM once;
+    chunked prefill is the caller's job for extreme prompts) and its KV
+    blocks then seed the paged cache — decode proceeds with a bounded
+    HBM window no matter how many tokens follow.
+    """
+    from nvme_strom_tpu.models import decode as _dec
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, "
+                         f"got {max_new_tokens}")
+    b, s = prompt.shape
+    dense = _dec.init_cache(cfg, b, s)
+    logits, dense = _dec.prefill(params, prompt, cfg, dense)
+    with PagedKVCache(cfg, ocfg, engine, b) as cache:
+        cache.append(dense["k"], dense["v"])
+        del dense
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        done = (jnp.zeros((b,), bool) if eos_id is None else tok == eos_id)
+        out = [tok]
+        for _ in range(max_new_tokens - 1):
+            logits = offload_decode_step(params, tok, cfg, cache)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            if eos_id is not None:
+                nxt = jnp.where(done, pad_id, nxt)
+                done = done | (nxt == eos_id)
+            out.append(nxt)
+            tok = nxt
+        return jnp.stack(out, axis=1)

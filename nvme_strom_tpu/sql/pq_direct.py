@@ -593,6 +593,7 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
     prior :func:`plan_columns` walk."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from nvme_strom_tpu.ops.bridge import DeviceStream
 
     dev = device or jax.local_devices()[0]

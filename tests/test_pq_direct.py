@@ -414,7 +414,9 @@ def test_groupby_on_dict_file(tmp_path, engine):
 
 
 def test_empty_table_direct_scan(tmp_path, engine):
-    """Zero-row files return empty typed columns, not a concat crash."""
+    """Zero-row files return empty typed columns, not a concat crash —
+    both the 1-row-group/0-rows shape write_table emits and the
+    0-row-group shape an unused ParquetWriter emits."""
     schema = pa.schema([pa.field("v", pa.float32(), nullable=False)])
     tbl = pa.table({"v": pa.array([], type=pa.float32())}, schema=schema)
     path = str(tmp_path / "empty.parquet")
@@ -423,6 +425,15 @@ def test_empty_table_direct_scan(tmp_path, engine):
     out = sc.read_columns_to_device(["v"], direct="auto")
     arr = np.asarray(out["v"])
     assert arr.shape == (0,) and arr.dtype == np.float32
+
+    path0 = str(tmp_path / "empty0.parquet")
+    pq.ParquetWriter(path0, schema, compression="none",
+                     use_dictionary=False).close()
+    sc0 = ParquetScanner(path0, engine)
+    assert sc0.metadata.num_row_groups == 0
+    out0 = sc0.read_columns_to_device(["v"], direct="auto")
+    arr0 = np.asarray(out0["v"])
+    assert arr0.shape == (0,) and arr0.dtype == np.float32
 
 
 def test_page_header_parser_fuzz():
