@@ -286,23 +286,16 @@ class PagedKVCache:
         larger than the engine's staging buffers split into chunk-sized
         sub-ranges (mirroring the write side); the on-device concat
         reassembles each page."""
+        from nvme_strom_tpu.ops.bridge import split_ranges
         P = self.ocfg.page_len
         L, b, nkv, _, hd = self.k_win.shape
-        chunk = self.engine.config.chunk_bytes
-        ranges = []         # flat sub-range list, page/k/v-ordered
-        n_sub = []          # sub-ranges per (page, k-or-v) span
+        spans = []          # (page, k-or-v) spans in stream order
         for page in range(self.n_cold):
             koff, voff = self._page_offsets(page)
-            for base in (koff + layer * self._pb_layer,
-                         voff + layer * self._pb_layer):
-                before = len(ranges)
-                off, ln = base, self._pb_layer
-                while ln > 0:
-                    take = min(chunk, ln)
-                    ranges.append((off, take))
-                    off += take
-                    ln -= take
-                n_sub.append(len(ranges) - before)
+            spans.append((koff + layer * self._pb_layer, self._pb_layer))
+            spans.append((voff + layer * self._pb_layer, self._pb_layer))
+        ranges, n_sub = split_ranges(spans,
+                                     self.engine.config.chunk_bytes)
         it = self._stream.stream_ranges(self._fh, ranges)
         counts = iter(n_sub)
 

@@ -28,6 +28,24 @@ def _default_device():
     return jax.local_devices()[0]
 
 
+def split_ranges(spans, chunk: int):
+    """(offset, length) spans → (flat sub-ranges ≤ ``chunk``, per-span
+    sub-range counts).  The one splitting rule every consumer of
+    ``stream_ranges`` shares (engine reads are capped at chunk_bytes);
+    zero-length spans contribute zero sub-ranges but keep their count
+    entry so group boundaries stay aligned."""
+    flat, counts = [], []
+    for off, ln in spans:
+        before = len(flat)
+        while ln > 0:
+            take = min(chunk, ln)
+            flat.append((off, take))
+            off += take
+            ln -= take
+        counts.append(len(flat) - before)
+    return flat, counts
+
+
 def host_to_device(engine: StromEngine, host: np.ndarray, dev):
     """``device_put`` with the staging-alias rule and byte accounting.
 

@@ -70,6 +70,7 @@ _ENC_PLAIN = 0
 _ENC_PLAIN_DICTIONARY = 2
 _ENC_RLE = 3
 _ENC_RLE_DICTIONARY = 8
+_ENC_BYTE_STREAM_SPLIT = 9
 _DICT_ENCODINGS = (_ENC_PLAIN_DICTIONARY, _ENC_RLE_DICTIONARY)
 
 
@@ -238,7 +239,10 @@ class PagePart:
     kind "plain": ``span`` covers raw little-endian values (on-device
     bitcast).  kind "dict": ``span`` covers the RLE/bit-packed index
     stream (host-expanded, then on-device gather against the chunk's
-    dictionary); ``bit_width`` is the stream's index width.
+    dictionary); ``bit_width`` is the stream's index width.  kind
+    "bss": BYTE_STREAM_SPLIT — ``span`` covers the byte-transposed
+    values (decode is an on-device reshape/transpose/bitcast, zero
+    host-touched payload like plain).
     """
     kind: str                              # "plain" | "dict"
     span: Tuple[int, int]                  # (offset, length) into the file
@@ -277,7 +281,8 @@ def eligible_chunk(meta, rg: int, ci: int) -> Optional[str]:
     if (col.compression or "UNCOMPRESSED") != "UNCOMPRESSED":
         return f"compression {col.compression}"
     encs = set(col.encodings)
-    if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}:
+    if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
+                    "BYTE_STREAM_SPLIT"}:
         return f"encodings {sorted(encs)}"
     if sc.max_repetition_level != 0:
         return "repeated field"
@@ -343,14 +348,15 @@ def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
                     (n,) = struct.unpack("<I", raw_read(data_off, 4))
                     level_bytes = 4 + n
             val_off = data_off + level_bytes
-            if ph.encoding == _ENC_PLAIN:
+            if ph.encoding in (_ENC_PLAIN, _ENC_BYTE_STREAM_SPLIT):
                 val_len = ph.num_values * width
                 if val_len + level_bytes > ph.compressed_size:
                     raise ValueError(
                         f"page at {pos}: {ph.num_values} values x {width} "
                         f"+ {level_bytes} level bytes > page size "
                         f"{ph.compressed_size}")
-                parts.append(PagePart("plain", (val_off, val_len),
+                kind = ("plain" if ph.encoding == _ENC_PLAIN else "bss")
+                parts.append(PagePart(kind, (val_off, val_len),
                                       ph.num_values))
             elif ph.encoding in _DICT_ENCODINGS:
                 if dict_span is None:
@@ -482,20 +488,33 @@ def _stream_spans(scanner, ds, fh, spans, physical_type):
     invisible)."""
     import jax.numpy as jnp
     import numpy as np
-    chunk = scanner.engine.config.chunk_bytes
-    ranges = []
-    for off, ln in spans:
-        while ln > chunk:
-            ranges.append((off, chunk))
-            off += chunk
-            ln -= chunk
-        if ln:
-            ranges.append((off, ln))
+    from nvme_strom_tpu.ops.bridge import split_ranges
+    ranges, _ = split_ranges(spans, scanner.engine.config.chunk_bytes)
     parts = list(ds.stream_ranges(fh, ranges))
     if not parts:    # zero-row chunk: no spans to stream
         return jnp.zeros((0,), dtype=np.dtype(_NP_DTYPES[physical_type]))
     flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
     return flat.view(np.dtype(_NP_DTYPES[physical_type]))
+
+
+def _stream_raw_groups(scanner, ds, fh, spans):
+    """spans → one uint8 device array PER SPAN, all spans streamed as a
+    single pipelined range sequence (sub-chunk split like
+    :func:`_stream_spans`, but span boundaries preserved — BSS pages
+    decode per page)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.ops.bridge import split_ranges
+    flat, counts = split_ranges(spans, scanner.engine.config.chunk_bytes)
+    it = ds.stream_ranges(fh, flat)
+    outs = []
+    for n in counts:
+        group = [next(it) for _ in range(n)]
+        if not group:            # zero-length span (0-value page)
+            outs.append(jnp.zeros((0,), dtype=np.uint8))
+        else:
+            outs.append(group[0] if n == 1 else jnp.concatenate(group))
+    return outs
 
 
 def _read_span_bytes(engine, fh, off: int, ln: int) -> bytes:
@@ -534,6 +553,7 @@ def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
     segs = []            # device arrays in page order
     pending_idx = []     # decoded index arrays of adjacent dict pages
     pending_plain = []   # value spans of adjacent plain pages
+    pending_bss = []     # value spans of adjacent BYTE_STREAM_SPLIT pages
 
     def flush_dict():
         if pending_idx:
@@ -562,17 +582,36 @@ def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
                                       plan.physical_type))
             pending_plain.clear()
 
+    def flush_bss():
+        if pending_bss:
+            width = _WIDTHS[plan.physical_type]
+            np_dtype = np.dtype(_NP_DTYPES[plan.physical_type])
+            for raw in _stream_raw_groups(scanner, ds, fh,
+                                          list(pending_bss)):
+                # BYTE_STREAM_SPLIT: page bytes are transposed
+                # (width, n) — undo ON DEVICE, then bitcast
+                n = raw.shape[0] // width
+                segs.append(
+                    raw.reshape(width, n).T.reshape(-1).view(np_dtype))
+            pending_bss.clear()
+
+    flushes = {"plain": (flush_dict, flush_bss),
+               "dict": (flush_plain, flush_bss),
+               "bss": (flush_dict, flush_plain)}
     for p in plan.parts:
+        for fl in flushes[p.kind]:   # close the other kinds' runs
+            fl()
         if p.kind == "plain":
-            flush_dict()
             pending_plain.append(p.span)
+        elif p.kind == "bss":
+            pending_bss.append(p.span)
         else:
-            flush_plain()
             raw = _read_span_bytes(eng, fh, *p.span)
             pending_idx.append(
                 decode_rle_hybrid(raw, p.bit_width, p.num_values))
     flush_dict()
     flush_plain()
+    flush_bss()
     if not segs:     # zero-row chunk
         return jnp.zeros((0,),
                          dtype=np.dtype(_NP_DTYPES[plan.physical_type]))

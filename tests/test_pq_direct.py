@@ -413,6 +413,63 @@ def test_groupby_on_dict_file(tmp_path, engine):
     np.testing.assert_allclose(np.asarray(out["sum"]), exp_sum, rtol=2e-4)
 
 
+def test_byte_stream_split_matches_pyarrow(tmp_path, engine):
+    """BYTE_STREAM_SPLIT columns decode on device (reshape/transpose/
+    bitcast — zero host-touched payload) and bit-match pyarrow."""
+    rng = np.random.default_rng(31)
+    rows = 20000
+    f32 = rng.standard_normal(rows).astype(np.float32)
+    i32 = rng.integers(-2**30, 2**30, rows).astype(np.int32)
+    tbl = pa.table({"f32": pa.array(f32), "i32": pa.array(i32)})
+    path = str(tmp_path / "bss.parquet")
+    try:
+        pq.write_table(tbl, path, compression="none", use_dictionary=False,
+                       column_encoding={"f32": "BYTE_STREAM_SPLIT",
+                                        "i32": "BYTE_STREAM_SPLIT"},
+                       row_group_size=8192, data_page_size=4096)
+    except pa.lib.ArrowNotImplementedError as e:
+        pytest.skip(f"pyarrow cannot write BSS here: {e}")
+    sc = ParquetScanner(path, engine)
+    assert all(r is None
+               for r in sc.direct_reasons(["f32", "i32"]).values())
+    plans = pq_direct.plan_columns(sc, ["f32", "i32"])
+    assert all(p.kind == "bss" for plan in plans["f32"]
+               for p in plan.parts)
+    out = sc.read_columns_to_device(["f32", "i32"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out["f32"]), f32)
+    np.testing.assert_array_equal(np.asarray(out["i32"]), i32)
+
+
+def test_byte_stream_split_payload_never_bounce(tmp_path, monkeypatch):
+    """BSS accounting matches PLAIN: payload engine→device only (the
+    decode permutation runs on device)."""
+    monkeypatch.setenv("STROM_NO_RESIDENCY_PROBE", "1")
+    rng = np.random.default_rng(32)
+    rows = 8192
+    vals = rng.standard_normal(rows).astype(np.float32)
+    path = str(tmp_path / "bss_acct.parquet")
+    pq.write_table(pa.table({"v": pa.array(vals)}), path,
+                   compression="none", use_dictionary=False,
+                   column_encoding={"v": "BYTE_STREAM_SPLIT"})
+    stats = StromStats()
+    with StromEngine(stats=stats) as eng:
+        fh = eng.open(path)
+        is_direct = eng.file_is_direct(fh)
+        eng.close(fh)
+        if not is_direct:
+            pytest.skip("fs rejects O_DIRECT")
+        sc = ParquetScanner(path, eng)
+        out = sc.read_columns_to_device(["v"], direct="always")
+        np.testing.assert_array_equal(np.asarray(out["v"]), vals)
+        eng.sync_stats()
+    payload = rows * 4
+    assert stats.bytes_to_device == payload
+    import jax
+    expected_bounce = (payload if jax.devices()[0].platform == "cpu"
+                       else 0)
+    assert stats.bounce_bytes == expected_bounce
+
+
 def test_empty_table_direct_scan(tmp_path, engine):
     """Zero-row files return empty typed columns, not a concat crash —
     both the 1-row-group/0-rows shape write_table emits and the
