@@ -546,9 +546,10 @@ def bench_kv_offload(engine, device=None) -> tuple[float, str]:
     dense = _dec.init_cache(cfg, batch, plen)
     logits, dense = _dec.prefill(params, prompt, cfg, dense)
     tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    quant = os.environ.get("STROM_KVOFF_QUANT") or None
     ocfg = OffloadConfig(
         path=os.path.join(_scratch_dir(), "kvoff.bin"),
-        page_len=page_len, window_pages=wpages)
+        page_len=page_len, window_pages=wpages, quantize=quant)
     stats = engine.stats
     with PagedKVCache(cfg, ocfg, engine, batch, device=dev) as cache:
         cache.append(dense["k"], dense["v"])
@@ -583,6 +584,8 @@ def bench_kv_offload(engine, device=None) -> tuple[float, str]:
     tag = (f"ctx={plen} window={ocfg.window} cold={cold_frac:.0%} "
            f"stream/tok={streamed / 2**20:.1f}MiB "
            f"direct={direct_share:.0%}")
+    if quant:
+        tag += f" quant={quant}"
     return rate, tag
 
 

@@ -55,10 +55,22 @@ class OffloadConfig:
 
     window = ``page_len * window_pages`` recent positions stay in HBM;
     older history lives in ``path`` in ``page_len``-position pages.
+
+    ``quantize="int8"`` stores cold pages as int8 with one f32
+    absmax scale per (position, kv head) — the NVMe stream per token
+    shrinks ~2x (bf16) / ~4x (f32) at a bounded attention error; the
+    window and all compute stay full precision, dequantization happens
+    on device after the read.
     """
     path: str
     page_len: int = 256
     window_pages: int = 4
+    quantize: Optional[str] = None      # None | "int8"
+
+    def __post_init__(self):
+        if self.quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', "
+                             f"got {self.quantize!r}")
 
     @property
     def window(self) -> int:
@@ -109,8 +121,7 @@ def _grouped(q, n_kv: int):
     return q.reshape(b, n_kv, g * s, hd)
 
 
-@jax.jit
-def _page_partial(q, k_page, v_page):
+def _partial_impl(q, k_page, v_page):
     """Partial attention of grouped queries against one full page.
 
     q (b, nkv, g, hd); k/v (b, nkv, P, hd) → m (b,nkv,g,1), l, acc."""
@@ -122,6 +133,17 @@ def _page_partial(q, k_page, v_page):
     l = jnp.sum(p, axis=-1, keepdims=True)
     acc = jnp.einsum("bkgs,bksd->bkgd", p, v_page.astype(jnp.float32))
     return m, l, acc
+
+
+_page_partial = jax.jit(_partial_impl)
+
+
+@jax.jit
+def _page_partial_q(q, k_q, k_s, v_q, v_s):
+    """int8 page variant: dequant INSIDE the jit so XLA fuses it into
+    the einsum input — no eager f32 page materializes in HBM."""
+    return _partial_impl(q, k_q.astype(jnp.float32) * k_s,
+                         v_q.astype(jnp.float32) * v_s)
 
 
 @jax.jit
@@ -137,6 +159,16 @@ def _window_partial(q, k_win_l, v_win_l, count):
     l = jnp.sum(p, axis=-1, keepdims=True)
     acc = jnp.einsum("bkgs,bksd->bkgd", p, v_win_l.astype(jnp.float32))
     return m, l, acc
+
+
+@jax.jit
+def _quantize_page(x):
+    """(…, P, hd) page → (int8 data, f32 absmax scale over hd)."""
+    xf = x.astype(jnp.float32)
+    m = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = jnp.where(m > 0, m / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
 
 
 @jax.jit
@@ -180,10 +212,17 @@ class PagedKVCache:
         self.v_win = jnp.zeros(shape, cfg.dtype)
         self.count = 0            # valid positions in the window (host int)
         self.n_cold = 0           # pages already written to NVMe
-        self._itemsize = jnp.zeros((), cfg.dtype).dtype.itemsize
-        # per-layer bytes of one page of one of k/v
+        self._quant = ocfg.quantize == "int8"
+        self._itemsize = (1 if self._quant
+                          else jnp.zeros((), cfg.dtype).dtype.itemsize)
+        # per-layer bytes of one page of one of k/v (data, then scales)
         self._pb_layer = (batch * nkv * ocfg.page_len * hd * self._itemsize)
         self._pb_block = self._pb_layer * L     # all layers of k (or v)
+        self._sb_layer = (batch * nkv * ocfg.page_len * 4 if self._quant
+                          else 0)               # f32 absmax scales
+        self._sb_block = self._sb_layer * L
+        # page file stride: [k data][k scales][v data][v scales]
+        self._page_stride = 2 * (self._pb_block + self._sb_block)
         self._fh = engine.open(ocfg.path, writable=True)
         self._stream = DeviceStream(engine, device=self.device,
                                     depth=engine.config.queue_depth)
@@ -208,19 +247,32 @@ class PagedKVCache:
 
     # -- write tier -------------------------------------------------------
 
-    def _page_offsets(self, page: int) -> Tuple[int, int]:
-        """(k_offset, v_offset) of a page's layer-major blocks."""
-        base = page * 2 * self._pb_block
-        return base, base + self._pb_block
+    def _section_offsets(self, page: int) -> Tuple[int, int, int, int]:
+        """(k_data, k_scales, v_data, v_scales) offsets of a page.
+
+        Scale sections have zero size in the unquantized layout, so the
+        k/v data offsets degrade to the two-block stride."""
+        base = page * self._page_stride
+        return (base,
+                base + self._pb_block,
+                base + self._pb_block + self._sb_block,
+                base + 2 * self._pb_block + self._sb_block)
 
     def _write_page(self, k_page, v_page) -> None:
-        """Evicted (L,b,nkv,P,hd) pair → two contiguous engine writes.
+        """Evicted (L,b,nkv,P,hd) pair → contiguous engine writes
+        (int8 data + f32 scale sections when quantizing).
 
         Synchronous: the page may be streamed back by the very next
         ``attend`` call, so completion is part of eviction."""
-        koff, voff = self._page_offsets(self.n_cold)
+        kd, ks, vd, vs = self._section_offsets(self.n_cold)
+        if self._quant:
+            k_q, k_s = _quantize_page(k_page)
+            v_q, v_s = _quantize_page(v_page)
+            sections = ((k_q, kd), (k_s, ks), (v_q, vd), (v_s, vs))
+        else:
+            sections = ((k_page, kd), (v_page, vd))
         pend = []
-        for arr, off in ((k_page, koff), (v_page, voff)):
+        for arr, off in sections:
             host = np.ascontiguousarray(
                 np.asarray(arr)).view(np.uint8).reshape(-1)
             chunk = self.engine.config.chunk_bytes
@@ -289,23 +341,33 @@ class PagedKVCache:
         from nvme_strom_tpu.ops.bridge import split_ranges
         P = self.ocfg.page_len
         L, b, nkv, _, hd = self.k_win.shape
-        spans = []          # (page, k-or-v) spans in stream order
+        spans = []          # per page: k data[, k scales], v data[, v sc.]
         for page in range(self.n_cold):
-            koff, voff = self._page_offsets(page)
-            spans.append((koff + layer * self._pb_layer, self._pb_layer))
-            spans.append((voff + layer * self._pb_layer, self._pb_layer))
+            kd, ks, vd, vs = self._section_offsets(page)
+            for base, ln in ((kd, self._pb_layer), (ks, self._sb_layer),
+                             (vd, self._pb_layer), (vs, self._sb_layer)):
+                if ln:
+                    spans.append((base + layer * ln, ln))
         ranges, n_sub = split_ranges(spans,
                                      self.engine.config.chunk_bytes)
         it = self._stream.stream_ranges(self._fh, ranges)
         counts = iter(n_sub)
 
-        def read_span():
+        def read_flat():
             parts = [next(it) for _ in range(next(counts))]
-            flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-            return flat.view(self.cfg.dtype).reshape(b, nkv, P, hd)
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+        def read_kv():
+            if self._quant:
+                # (data, scale) stay separate: attend feeds them to the
+                # quantized partial, which dequantizes inside its jit
+                data = read_flat().view(jnp.int8).reshape(b, nkv, P, hd)
+                scale = read_flat().view(jnp.float32).reshape(b, nkv, P, 1)
+                return data, scale
+            return read_flat().view(self.cfg.dtype).reshape(b, nkv, P, hd)
 
         for _ in range(self.n_cold):
-            yield read_span(), read_span()
+            yield read_kv(), read_kv()
 
     def attend(self, layer: int, q,
                valid: Optional[int] = None) -> jax.Array:
@@ -323,8 +385,11 @@ class PagedKVCache:
         m, l, acc = _window_partial(
             qf, self.k_win[layer], self.v_win[layer],
             jnp.asarray(self.count if valid is None else valid, jnp.int32))
-        for k_page, v_page in self._iter_layer_pages(layer):
-            pm, pl, pacc = _page_partial(qf, k_page, v_page)
+        for k_item, v_item in self._iter_layer_pages(layer):
+            if self._quant:
+                pm, pl, pacc = _page_partial_q(qf, *k_item, *v_item)
+            else:
+                pm, pl, pacc = _page_partial(qf, k_item, v_item)
             m, l, acc = _combine(m, l, acc, pm, pl, pacc)
         out = _finish(m, l, acc)
         return out.reshape(b, nh, s_q, hd).astype(self.cfg.dtype)
