@@ -219,21 +219,78 @@ def sql_groupby(scanner, key_column: str, value_column: str,
     cols_needed = list(dict.fromkeys(
         [key_column, value_column, *where_columns]))
 
+    def stream():
+        for cols in iter_device_columns(scanner, cols_needed, dev,
+                                        narrow_int32=(key_column,)):
+            yield cols[key_column], cols[value_column], cols
+
+    return _stream_fold(stream(), num_groups, aggs, method, where)
+
+
+def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
+                 method: str, where) -> Dict[str, jax.Array]:
+    """Fold per-row-group partial aggregates into the final result.
+
+    ``stream`` yields (keys, values, cols-for-where) per row group —
+    the one fold protocol both groupby entry points share, so aggregate
+    normalization, masking, and the empty-table contract can't drift.
+    """
     folds = None
-    for cols in iter_device_columns(scanner, cols_needed, dev,
-                                    narrow_int32=(key_column,)):
-        kd = cols[key_column]
-        vd = cols[value_column]
+    for keys, values, cols in stream:
         mask = where(cols) if where is not None else None
         part = groupby_aggregate(
-            kd, vd, num_groups,
+            keys, values, num_groups,
             aggs=tuple(sorted((set(aggs) | {"count", "sum"}) - {"mean"})),
             method=method, mask=mask, empty_as_nan=False)  # keep foldable
         folds = part if folds is None else _fold(folds, part)
-
     if folds is None:
         raise ValueError("empty table")
     return finalize_folds(folds, aggs)
+
+
+def sql_groupby_str(scanner, key_column: str, value_column: str,
+                    aggs: Sequence[str] = ("count", "sum", "mean"),
+                    method: str = "matmul", device=None,
+                    where=None, where_columns: Sequence[str] = ()
+                    ) -> Dict[str, object]:
+    """GROUP BY over a dictionary-encoded STRING key, strings never on
+    device:
+
+        SELECT key, AGG(value) FROM parquet [WHERE ...] GROUP BY key
+
+    The PG-Strom dictionary move (SURVEY.md §3.5): the device groups by
+    the column's int32 dictionary CODE (4 bytes/row however long the
+    strings are); the host maps group ids back to labels from the
+    dictionary pages it already parsed.  Result carries ``"labels"`` —
+    ``labels[g]`` (bytes) names group ``g`` — alongside the aggregate
+    arrays, whose length is the global label count.  ``where``
+    predicates receive the key column as its global CODES plus every
+    ``where_columns`` column.
+    """
+    from nvme_strom_tpu.sql import pq_direct
+    dev = device or jax.local_devices()[0]
+    labels, iter_codes = pq_direct.read_dict_key_column(
+        scanner, key_column, device=dev)
+    num_groups = len(labels)
+    if num_groups == 0:
+        raise ValueError("empty dictionary (no rows?)")
+    # the key column itself streams as codes, never as strings — even
+    # if the caller lists it in where_columns
+    cols_needed = [c for c in dict.fromkeys([value_column,
+                                             *where_columns])
+                   if c != key_column]
+
+    def stream():
+        for cols, codes in zip(
+                iter_device_columns(scanner, cols_needed, dev),
+                iter_codes()):
+            cols[key_column] = codes
+            yield codes, cols[value_column], cols
+
+    out: Dict[str, object] = dict(_stream_fold(stream(), num_groups,
+                                               aggs, method, where))
+    out["labels"] = labels
+    return out
 
 
 @jax.jit

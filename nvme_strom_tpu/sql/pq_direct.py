@@ -295,26 +295,19 @@ def eligible_chunk(meta, rg: int, ci: int) -> Optional[str]:
     return None
 
 
-def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
-    """Walk the chunk's data pages, returning exact value-byte spans.
+def _walk_pages(col, raw_read):
+    """Yield (pos, PageHeader) for every page of a column chunk, until
+    the data pages' value counts cover ``col.num_values``.
 
-    ``raw_read(offset, length) -> bytes`` serves page headers and the RLE
-    level-length prefixes — metadata-class reads (≤ ~1 KiB per page, via
-    buffered I/O like the footer), never payload.
-    """
-    col = meta.row_group(rg).column(ci)
-    sc = meta.schema.column(ci)
-    width = _WIDTHS[col.physical_type]
-    has_def = sc.max_definition_level > 0
+    ``raw_read(offset, length) -> bytes`` serves page headers —
+    metadata-class reads (≤ ~1 KiB per page, via buffered I/O like the
+    footer), never payload."""
     pos = col.data_page_offset
     if (col.dictionary_page_offset or 0) > 0:
         # the dictionary page precedes the data pages in the chunk
         pos = min(pos, col.dictionary_page_offset)
     end = pos + col.total_compressed_size
     remaining = col.num_values
-    parts: List[PagePart] = []
-    dict_span: Optional[Tuple[int, int]] = None
-    dict_count = 0
     window = 1 << 10
     while remaining > 0:
         if pos >= end:
@@ -337,23 +330,69 @@ def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
                 raise ValueError(
                     f"page at {pos}: {ph.num_values} values exceeds "
                     f"chunk remainder {remaining}")
-            data_off = pos + ph.header_len
-            if ph.type == _PAGE_DATA_V2:
-                # v2: level lengths are stated in the header itself
-                level_bytes = ph.def_levels_len + ph.rep_levels_len
-            else:
-                level_bytes = 0
-                if has_def:
-                    # v1 page: definition levels = <u32 len><RLE bytes>
-                    (n,) = struct.unpack("<I", raw_read(data_off, 4))
-                    level_bytes = 4 + n
-            val_off = data_off + level_bytes
+            remaining -= ph.num_values
+        yield pos, ph
+        pos += ph.header_len + ph.compressed_size
+
+
+def _level_bytes(pos, ph, has_def: bool, raw_read) -> int:
+    """Bytes the definition/repetition-level block occupies at the page
+    body's start (v2: stated in the header; v1: ``<u32 len><RLE>``)."""
+    if ph.type == _PAGE_DATA_V2:
+        return ph.def_levels_len + ph.rep_levels_len
+    if has_def:
+        (n,) = struct.unpack("<I", raw_read(pos + ph.header_len, 4))
+        return 4 + n
+    return 0
+
+
+def _index_stream_part(pos, ph, level_bytes: int, raw_read) -> PagePart:
+    """Dict-encoded data-page body → index-stream PagePart.
+
+    Body after levels: ``<bit_width: 1 byte><RLE-hybrid runs>`` — the
+    one layout rule both the numeric and byte-array walks share."""
+    val_off = pos + ph.header_len + level_bytes
+    (bw,) = raw_read(val_off, 1)
+    if bw > 32:
+        raise ValueError(f"page at {pos}: bit width {bw} > 32")
+    idx_len = ph.compressed_size - level_bytes - 1
+    if idx_len < 0:
+        raise ValueError(f"page at {pos}: negative index span")
+    return PagePart("dict", (val_off + 1, idx_len), ph.num_values,
+                    bit_width=bw)
+
+
+def _check_dict_page(pos, ph, already_seen: bool) -> None:
+    """Shared dictionary-page validity rules (one per chunk, PLAIN)."""
+    if already_seen:
+        raise ValueError(f"second dictionary page at {pos}")
+    if ph.encoding not in (_ENC_PLAIN, _ENC_PLAIN_DICTIONARY):
+        raise ValueError(
+            f"dictionary page encoding {ph.encoding} not PLAIN")
+
+
+def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
+    """Walk the chunk's data pages, returning exact value-byte spans.
+
+    ``raw_read`` as in :func:`_walk_pages`; it additionally serves the
+    v1 RLE level-length prefixes (8 bytes per page)."""
+    col = meta.row_group(rg).column(ci)
+    sc = meta.schema.column(ci)
+    width = _WIDTHS[col.physical_type]
+    has_def = sc.max_definition_level > 0
+    parts: List[PagePart] = []
+    dict_span: Optional[Tuple[int, int]] = None
+    dict_count = 0
+    for pos, ph in _walk_pages(col, raw_read):
+        if ph.type in (_PAGE_DATA, _PAGE_DATA_V2):
+            lb = _level_bytes(pos, ph, has_def, raw_read)
             if ph.encoding in (_ENC_PLAIN, _ENC_BYTE_STREAM_SPLIT):
+                val_off = pos + ph.header_len + lb
                 val_len = ph.num_values * width
-                if val_len + level_bytes > ph.compressed_size:
+                if val_len + lb > ph.compressed_size:
                     raise ValueError(
                         f"page at {pos}: {ph.num_values} values x {width} "
-                        f"+ {level_bytes} level bytes > page size "
+                        f"+ {lb} level bytes > page size "
                         f"{ph.compressed_size}")
                 kind = ("plain" if ph.encoding == _ENC_PLAIN else "bss")
                 parts.append(PagePart(kind, (val_off, val_len),
@@ -363,25 +402,12 @@ def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
                     raise ValueError(
                         f"page at {pos}: dict-encoded data page before "
                         f"any dictionary page")
-                # body after levels: <bit_width: 1 byte><RLE-hybrid runs>
-                (bw,) = raw_read(val_off, 1)
-                if bw > 32:
-                    raise ValueError(f"page at {pos}: bit width {bw} > 32")
-                idx_len = ph.compressed_size - level_bytes - 1
-                if idx_len < 0:
-                    raise ValueError(f"page at {pos}: negative index span")
-                parts.append(PagePart("dict", (val_off + 1, idx_len),
-                                      ph.num_values, bit_width=bw))
+                parts.append(_index_stream_part(pos, ph, lb, raw_read))
             else:
                 raise ValueError(
                     f"page at {pos}: unsupported encoding {ph.encoding}")
-            remaining -= ph.num_values
         elif ph.type == _PAGE_DICTIONARY:
-            if dict_span is not None:
-                raise ValueError(f"second dictionary page at {pos}")
-            if ph.encoding not in (_ENC_PLAIN, _ENC_PLAIN_DICTIONARY):
-                raise ValueError(
-                    f"dictionary page encoding {ph.encoding} not PLAIN")
+            _check_dict_page(pos, ph, dict_span is not None)
             val_len = ph.num_values * width
             if val_len > ph.compressed_size:
                 raise ValueError(
@@ -390,7 +416,6 @@ def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
             dict_span = (pos + ph.header_len, val_len)
             dict_count = ph.num_values
         # INDEX pages are skipped silently
-        pos += ph.header_len + ph.compressed_size
     return ColumnPlan(tuple(parts), col.num_values, col.physical_type,
                       dict_span=dict_span, dict_count=dict_count)
 
@@ -517,6 +542,35 @@ def _stream_raw_groups(scanner, ds, fh, spans):
     return outs
 
 
+def _decode_indices(eng, fh, parts, dict_count: int, dev):
+    """Dict-kind PageParts → one validated int32 host index array.
+
+    Applies the module's accounting policy: raw index-stream bytes are
+    counted by the engine read; the decoded array is host-materialized
+    payload-derived data → bounce (on CPU ``host_to_device`` counts that
+    same buffer via its alias-protection copy, so only non-CPU adds it
+    here).  Validation is range-only — ``jnp.take`` would silently clip
+    a corrupt stream into wrong rows."""
+    import numpy as np
+    idx_parts = [
+        decode_rle_hybrid(_read_span_bytes(eng, fh, *p.span),
+                          p.bit_width, p.num_values)
+        for p in parts]
+    if not idx_parts:          # zero-row chunk
+        return np.empty(0, np.int32)
+    idx = (idx_parts[0] if len(idx_parts) == 1
+           else np.concatenate(idx_parts))
+    if idx.size:
+        lo, hi = int(idx.min()), int(idx.max())
+        if lo < 0 or hi >= dict_count:
+            raise ValueError(
+                f"dictionary index {lo if lo < 0 else hi} out of range "
+                f"[0, {dict_count})")
+    if dev.platform != "cpu":
+        eng.stats.add(bounce_bytes=int(idx.nbytes))
+    return idx
+
+
 def _read_span_bytes(engine, fh, off: int, ln: int) -> bytes:
     """Direct-engine read of a small control-stream span → host bytes.
 
@@ -551,28 +605,16 @@ def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
         dict_dev = _stream_spans(scanner, ds, fh, [plan.dict_span],
                                  plan.physical_type)
     segs = []            # device arrays in page order
-    pending_idx = []     # decoded index arrays of adjacent dict pages
+    pending_dict = []    # adjacent dict pages' index-stream parts
     pending_plain = []   # value spans of adjacent plain pages
     pending_bss = []     # value spans of adjacent BYTE_STREAM_SPLIT pages
 
     def flush_dict():
-        if pending_idx:
-            idx = (pending_idx[0] if len(pending_idx) == 1
-                   else np.concatenate(pending_idx))
-            # jnp.take clips out-of-range indices — a corrupt stream
-            # would yield silently wrong rows; fail loudly instead
-            hi = int(idx.max()) if idx.size else -1
-            if hi >= plan.dict_count or (idx.size and int(idx.min()) < 0):
-                raise ValueError(
-                    f"dictionary index {hi} out of range "
-                    f"[0, {plan.dict_count})")
-            # The decoded array is host-materialized payload-derived
-            # data → counted as bounce.  On CPU host_to_device already
-            # counts this exact buffer via its alias-protection copy.
-            if dev.platform != "cpu":
-                eng.stats.add(bounce_bytes=int(idx.nbytes))
+        if pending_dict:
+            idx = _decode_indices(eng, fh, pending_dict,
+                                  plan.dict_count, dev)
             segs.append(jnp.take(dict_dev, host_to_device(eng, idx, dev)))
-            pending_idx.clear()
+            pending_dict.clear()
 
     def flush_plain():
         if pending_plain:
@@ -606,9 +648,7 @@ def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
         elif p.kind == "bss":
             pending_bss.append(p.span)
         else:
-            raw = _read_span_bytes(eng, fh, *p.span)
-            pending_idx.append(
-                decode_rle_hybrid(raw, p.bit_width, p.num_values))
+            pending_dict.append(p)
     flush_dict()
     flush_plain()
     flush_bss()
@@ -664,6 +704,175 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
     finally:
         scanner.engine.close(fh)
     return out
+
+
+# ---------------------------------------------------------------------------
+# dictionary-code scans of BYTE_ARRAY (string) columns
+#
+# PG-Strom's trick for GROUP BY over strings: never materialize the
+# strings on the accelerator — group by the dictionary CODE (an int32)
+# and map codes back to labels on the host, where the dictionary page
+# (tiny, one per chunk) already lives.  Payload economics: the device
+# sees 4 bytes per row regardless of string length.
+
+
+@dataclass(frozen=True)
+class DictCodeChunk:
+    """One chunk of a dictionary-coded BYTE_ARRAY column."""
+    parts: Tuple[PagePart, ...]            # all kind "dict"
+    num_values: int
+    dict_span: Tuple[int, int]             # raw dictionary page body
+    dict_count: int
+
+
+def dict_code_eligible(meta, rg: int, ci: int) -> Optional[str]:
+    """None if the chunk can scan as dictionary codes, else the reason.
+
+    A footer-level check only — a chunk whose writer overflowed to
+    PLAIN BYTE_ARRAY data pages (undetectable from the footer) fails
+    later in :func:`plan_dict_code_chunk`."""
+    col = meta.row_group(rg).column(ci)
+    sc = meta.schema.column(ci)
+    if col.physical_type != "BYTE_ARRAY":
+        return f"physical type {col.physical_type} (need BYTE_ARRAY)"
+    if (col.compression or "UNCOMPRESSED") != "UNCOMPRESSED":
+        return f"compression {col.compression}"
+    encs = set(col.encodings)
+    if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}:
+        return f"encodings {sorted(encs)}"
+    if (col.dictionary_page_offset or 0) <= 0:
+        return "no dictionary page"
+    if sc.max_repetition_level != 0:
+        return "repeated field"
+    if sc.max_definition_level > 0:
+        st = col.statistics
+        if st is None or st.null_count is None:
+            return "no null statistics"
+        if st.null_count != 0:
+            return f"{st.null_count} nulls"
+    return None
+
+
+def plan_dict_code_chunk(meta, rg: int, ci: int, raw_read) -> DictCodeChunk:
+    """Page-walk a BYTE_ARRAY chunk: dictionary page body span + index
+    stream spans.  Raises ValueError on any PLAIN data page (dictionary
+    overflow) — string bytes cannot decode on device."""
+    col = meta.row_group(rg).column(ci)
+    sc = meta.schema.column(ci)
+    has_def = sc.max_definition_level > 0
+    parts: List[PagePart] = []
+    dict_span = None
+    dict_count = 0
+    for pos, ph in _walk_pages(col, raw_read):
+        if ph.type in (_PAGE_DATA, _PAGE_DATA_V2):
+            if ph.encoding not in _DICT_ENCODINGS:
+                raise ValueError(
+                    f"page at {pos}: encoding {ph.encoding} — string "
+                    f"chunk fell back from dictionary (overflow?)")
+            if dict_span is None:
+                raise ValueError(
+                    f"page at {pos}: dict-encoded data page before "
+                    f"any dictionary page")
+            lb = _level_bytes(pos, ph, has_def, raw_read)
+            parts.append(_index_stream_part(pos, ph, lb, raw_read))
+        elif ph.type == _PAGE_DICTIONARY:
+            _check_dict_page(pos, ph, dict_span is not None)
+            # var-len strings: the span is the whole page body; entry
+            # lengths are parsed from it host-side
+            dict_span = (pos + ph.header_len, ph.compressed_size)
+            dict_count = ph.num_values
+    if dict_span is None:
+        raise ValueError(f"rg{rg} col{ci}: no dictionary page")
+    return DictCodeChunk(tuple(parts), col.num_values, dict_span,
+                         dict_count)
+
+
+def parse_byte_array_dict(buf: bytes, count: int) -> List[bytes]:
+    """PLAIN BYTE_ARRAY dictionary page body → label list
+    (``<u32 len><bytes>`` repeated ``count`` times)."""
+    out: List[bytes] = []
+    pos = 0
+    for _ in range(count):
+        if pos + 4 > len(buf):
+            raise ValueError("truncated dictionary page (length prefix)")
+        (n,) = struct.unpack_from("<I", buf, pos)
+        pos += 4
+        if pos + n > len(buf):
+            raise ValueError("truncated dictionary page (entry bytes)")
+        out.append(bytes(buf[pos:pos + n]))
+        pos += n
+    return out
+
+
+def read_dict_key_column(scanner, column: str, device=None):
+    """Prepare a BYTE_ARRAY column for on-device GROUP BY by code.
+
+    Returns ``(labels, iter_codes)``: ``labels`` is the GLOBAL label
+    list (union of every row group's dictionary, first-seen order;
+    bytes objects), ``iter_codes()`` yields one int32 device array of
+    global codes per row group.
+
+    Two-pass: dictionary pages are read first (through the engine,
+    host-touched by design → counted as bounce) so the global label
+    space is known before any data streams — per-row-group dictionaries
+    are remapped to global codes ON DEVICE via a gather.
+    """
+    import jax
+    from nvme_strom_tpu.ops.bridge import host_to_device
+
+    meta = scanner.metadata
+    name_to_ci = {meta.schema.column(i).name: i
+                  for i in range(meta.num_columns)}
+    if column not in name_to_ci:
+        raise KeyError(f"column {column!r} not in schema")
+    ci = name_to_ci[column]
+    import os
+    with open(scanner.path, "rb") as f:
+        def raw_read(off: int, ln: int) -> bytes:
+            return os.pread(f.fileno(), ln, off)
+
+        chunks = []
+        for rg in range(meta.num_row_groups):
+            why = dict_code_eligible(meta, rg, ci)
+            if why is not None:
+                raise ValueError(
+                    f"rg{rg}.{column} not dict-code-eligible: {why}")
+            chunks.append(plan_dict_code_chunk(meta, rg, ci, raw_read))
+
+    dev = device or jax.local_devices()[0]
+    eng = scanner.engine
+    labels: List[bytes] = []
+    gid: Dict[bytes, int] = {}
+    remaps: List["object"] = []       # per-rg int32 device remap arrays
+    import numpy as np
+    fh = eng.open(scanner.path)
+    try:
+        for ch in chunks:
+            body = _read_span_bytes(eng, fh, *ch.dict_span)
+            local = parse_byte_array_dict(body, ch.dict_count)
+            remap = np.empty(max(ch.dict_count, 1), np.int32)
+            for i, lab in enumerate(local):
+                if lab not in gid:
+                    gid[lab] = len(labels)
+                    labels.append(lab)
+                remap[i] = gid[lab]
+            remaps.append(host_to_device(eng, remap, dev))
+    finally:
+        eng.close(fh)
+
+    def iter_codes():
+        import jax.numpy as jnp
+        fh = eng.open(scanner.path)
+        try:
+            for ch, remap_dev in zip(chunks, remaps):
+                idx = _decode_indices(eng, fh, ch.parts, ch.dict_count,
+                                      dev)
+                # local code → global code, on device
+                yield jnp.take(remap_dev, host_to_device(eng, idx, dev))
+        finally:
+            eng.close(fh)
+
+    return labels, iter_codes
 
 
 def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
