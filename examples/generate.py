@@ -50,6 +50,14 @@ def main(argv=None) -> int:
                     help="0 = greedy")
     ap.add_argument("--eos-id", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--offload", default=None, metavar="PAGEFILE",
+                    help="decode with the SSD-backed KV cache spilling "
+                         "pages to this path (greedy only; HBM holds a "
+                         "bounded window, history streams from NVMe)")
+    ap.add_argument("--offload-window", type=int, default=1024,
+                    help="HBM window positions for --offload")
+    ap.add_argument("--offload-quant", choices=["int8"], default=None,
+                    help="quantize cold pages (halves the NVMe stream)")
     args = ap.parse_args(argv)
 
     import jax
@@ -104,22 +112,44 @@ def main(argv=None) -> int:
     print(f"weights: {len(params)} tensors in "
           f"{time.monotonic() - t0:.2f}s", flush=True)
 
-    # long live-cache decodes win with the fused Pallas kernel;
-    # short ones with XLA's einsum (measured crossover ~1k positions)
-    cache_attn = make_decode_attn() if total >= 1024 else None
-
     prompt = jnp.asarray([prompt_ids], jnp.int32)
-    gen = jax.jit(functools.partial(
-        generate, cfg=cfg, max_new_tokens=args.new,
-        temperature=args.temperature, eos_id=args.eos_id,
-        cache_attn=cache_attn))
     rng = jax.random.key(args.seed)
-    out = gen(params, prompt, rng=rng)
-    out.block_until_ready()                      # compile (discarded)
-    t0 = time.monotonic()
-    out = gen(params, prompt, rng=rng)
-    out.block_until_ready()
-    dt = time.monotonic() - t0
+    if args.offload:
+        # bounded-HBM decode: history beyond the window lives on NVMe
+        if args.temperature != 0.0:
+            ap.error("--offload decode is greedy (temperature 0)")
+        from nvme_strom_tpu.models.kv_offload import (
+            OffloadConfig, offloaded_generate)
+        page_len = max(4, args.offload_window // 4)
+        ocfg = OffloadConfig(
+            path=args.offload, page_len=page_len,
+            window_pages=max(1, args.offload_window // page_len),
+            quantize=args.offload_quant)
+        t0 = time.monotonic()
+        out = offloaded_generate(params, prompt, cfg, ocfg, engine,
+                                 args.new, eos_id=args.eos_id)
+        dt = time.monotonic() - t0
+        # single cold run: the time INCLUDES XLA compilation of the
+        # prefill and per-layer segments — not comparable to the dense
+        # branch's warm number (bench_suite config 10 measures warm)
+        print(f"offloaded decode: window={ocfg.window} "
+              f"quant={args.offload_quant or 'off'} "
+              f"(cold timing, includes compile)")
+    else:
+        # long live-cache decodes win with the fused Pallas kernel;
+        # short ones with XLA's einsum (measured crossover ~1k
+        # positions)
+        cache_attn = make_decode_attn() if total >= 1024 else None
+        gen = jax.jit(functools.partial(
+            generate, cfg=cfg, max_new_tokens=args.new,
+            temperature=args.temperature, eos_id=args.eos_id,
+            cache_attn=cache_attn))
+        out = gen(params, prompt, rng=rng)
+        out.block_until_ready()                  # compile (discarded)
+        t0 = time.monotonic()
+        out = gen(params, prompt, rng=rng)
+        out.block_until_ready()
+        dt = time.monotonic() - t0
     ids = [int(t) for t in out[0]]
     print(f"generated {args.new} tokens in {dt:.3f}s "
           f"({args.new / dt:.1f} tok/s)")

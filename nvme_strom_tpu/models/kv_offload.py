@@ -226,13 +226,50 @@ class PagedKVCache:
         self._fh = engine.open(ocfg.path, writable=True)
         self._stream = DeviceStream(engine, device=self.device,
                                     depth=engine.config.queue_depth)
+        # in-flight eviction writes (PendingWrite keeps the host buffer
+        # alive); drained before any read and bounded by _MAX_PENDING
+        self._pending_writes: list = []
+
+    _MAX_PENDING_PAGES = 4
 
     # -- lifecycle --------------------------------------------------------
 
+    def _drain_writes(self, keep: int = 0) -> None:
+        """Complete in-flight eviction writes (oldest first), leaving at
+        most ``keep`` page-writes outstanding.
+
+        Exception-safe: every popped PendingWrite is waited even when an
+        earlier one fails — each holds the only reference keeping its
+        source buffer alive while the engine works from a raw pointer,
+        so dropping one mid-flight would let the engine read freed
+        memory.  The first error re-raises after the batch settles."""
+        first_err: Optional[OSError] = None
+        while len(self._pending_writes) > keep:
+            for p in self._pending_writes.pop(0):
+                try:
+                    p.wait()
+                except OSError as e:
+                    if first_err is None:
+                        first_err = e
+        if first_err is not None:
+            raise first_err
+
+    def flush(self) -> None:
+        """Block until every evicted page's write has completed, so the
+        backing file is fully visible to same-host readers (size
+        checks, handoff to another process).  Completion is not crash
+        durability — no fsync is issued, and non-conformant
+        (unaligned/buffered-fallback) writes may still sit in the page
+        cache; use the checkpoint manager for durable state."""
+        self._drain_writes()
+
     def close(self) -> None:
         if self._fh is not None:
-            self.engine.close(self._fh)
-            self._fh = None
+            try:
+                self._drain_writes()   # writes target this fh
+            finally:
+                self.engine.close(self._fh)
+                self._fh = None
 
     def __enter__(self):
         return self
@@ -262,8 +299,11 @@ class PagedKVCache:
         """Evicted (L,b,nkv,P,hd) pair → contiguous engine writes
         (int8 data + f32 scale sections when quantizing).
 
-        Synchronous: the page may be streamed back by the very next
-        ``attend`` call, so completion is part of eviction."""
+        Asynchronous: the writes overlap whatever compute follows the
+        eviction (bulk prefill seeding writes pages back-to-back);
+        every read path drains first, so a just-evicted page can never
+        be streamed back stale."""
+        self._drain_writes(keep=self._MAX_PENDING_PAGES - 1)
         kd, ks, vd, vs = self._section_offsets(self.n_cold)
         if self._quant:
             k_q, k_s = _quantize_page(k_page)
@@ -280,8 +320,7 @@ class PagedKVCache:
                 part = host[p0:p0 + chunk]
                 pend.append(
                     self.engine.submit_write(self._fh, off + p0, part))
-        for p in pend:
-            p.wait()
+        self._pending_writes.append(pend)
         self.n_cold += 1
 
     def _evict_one(self) -> None:
@@ -339,6 +378,7 @@ class PagedKVCache:
         sub-ranges (mirroring the write side); the on-device concat
         reassembles each page."""
         from nvme_strom_tpu.ops.bridge import split_ranges
+        self._drain_writes()   # a just-evicted page must not read stale
         P = self.ocfg.page_len
         L, b, nkv, _, hd = self.k_win.shape
         spans = []          # per page: k data[, k scales], v data[, v sc.]

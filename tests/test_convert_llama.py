@@ -211,3 +211,16 @@ def test_generate_example_cli(hf_checkpoint, tmp_path):
     ids = (r.stdout.split("output ids:")[1].strip().splitlines()[0]
            .split(","))
     assert len(ids) == 8 and all(i.strip().isdigit() for i in ids)
+
+    # same checkpoint through the SSD-backed cache: identical greedy ids
+    r2 = subprocess.run(
+        [_sys.executable, str(repo / "examples" / "generate.py"),
+         "--weights", str(tmp_path / "conv"),
+         "--prompt", "5,6,7", "--new", "8",
+         "--offload", str(tmp_path / "kv.bin"), "--offload-window", "4"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(repo))
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    ids2 = (r2.stdout.split("output ids:")[1].strip().splitlines()[0]
+            .split(","))
+    assert ids2 == ids

@@ -90,6 +90,7 @@ def test_window_stays_bounded(cfg, engine, tmp_path):
         assert cache.pos == 160
         assert cache.count < ocfg.window      # invariant: a free slot
         import os
+        cache.flush()          # eviction writes are async
         fsize = os.path.getsize(ocfg.path)
         assert fsize == cache.n_cold * cache._page_stride
 
