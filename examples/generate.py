@@ -58,6 +58,9 @@ def main(argv=None) -> int:
                     help="HBM window positions for --offload")
     ap.add_argument("--offload-quant", choices=["int8"], default=None,
                     help="quantize cold pages (halves the NVMe stream)")
+    ap.add_argument("--offload-chunked-prefill", action="store_true",
+                    help="prefill the prompt in page-sized chunks too "
+                         "(bounded HBM for arbitrary prompt lengths)")
     args = ap.parse_args(argv)
 
     import jax
@@ -121,13 +124,18 @@ def main(argv=None) -> int:
         from nvme_strom_tpu.models.kv_offload import (
             OffloadConfig, offloaded_generate)
         page_len = max(4, args.offload_window // 4)
+        window_pages = max(1, args.offload_window // page_len)
+        if args.offload_chunked_prefill and window_pages < 2:
+            ap.error("--offload-chunked-prefill needs --offload-window "
+                     ">= 8 (at least two pages)")
         ocfg = OffloadConfig(
             path=args.offload, page_len=page_len,
-            window_pages=max(1, args.offload_window // page_len),
-            quantize=args.offload_quant)
+            window_pages=window_pages, quantize=args.offload_quant)
         t0 = time.monotonic()
-        out = offloaded_generate(params, prompt, cfg, ocfg, engine,
-                                 args.new, eos_id=args.eos_id)
+        out = offloaded_generate(
+            params, prompt, cfg, ocfg, engine, args.new,
+            eos_id=args.eos_id,
+            chunked_prefill=args.offload_chunked_prefill)
         dt = time.monotonic() - t0
         # single cold run: the time INCLUDES XLA compilation of the
         # prefill and per-layer segments — not comparable to the dense

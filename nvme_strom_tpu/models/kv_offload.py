@@ -121,17 +121,22 @@ def _grouped(q, n_kv: int):
     return q.reshape(b, n_kv, g * s, hd)
 
 
-def _partial_impl(q, k_page, v_page):
-    """Partial attention of grouped queries against one full page.
+def _partial_impl(q, k, v, mask=None):
+    """Online-softmax partial of grouped queries against one key block.
 
-    q (b, nkv, g, hd); k/v (b, nkv, P, hd) → m (b,nkv,g,1), l, acc."""
+    q (b, nkv, rows, hd); k/v (b, nkv, S, hd); optional ``mask``
+    broadcastable to the (b, nkv, rows, S) score shape (False = hidden,
+    -1e30 sentinel) → m (b,nkv,rows,1), l, acc.  The ONE softmax-
+    partial recipe every attention path here shares."""
     hd = q.shape[-1]
     s = jnp.einsum("bkgd,bksd->bkgs", q.astype(jnp.float32),
-                   k_page.astype(jnp.float32)) / jnp.sqrt(jnp.float32(hd))
+                   k.astype(jnp.float32)) / jnp.sqrt(jnp.float32(hd))
+    if mask is not None:
+        s = jnp.where(mask, s, -1e30)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bkgs,bksd->bkgd", p, v_page.astype(jnp.float32))
+    acc = jnp.einsum("bkgs,bksd->bkgd", p, v.astype(jnp.float32))
     return m, l, acc
 
 
@@ -149,16 +154,9 @@ def _page_partial_q(q, k_q, k_s, v_q, v_s):
 @jax.jit
 def _window_partial(q, k_win_l, v_win_l, count):
     """Partial over the window's first ``count`` valid positions."""
-    hd = q.shape[-1]
     W = k_win_l.shape[2]
-    s = jnp.einsum("bkgd,bksd->bkgs", q.astype(jnp.float32),
-                   k_win_l.astype(jnp.float32)) / jnp.sqrt(jnp.float32(hd))
-    s = jnp.where((jnp.arange(W) < count)[None, None, None, :], s, -1e30)
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jnp.einsum("bkgs,bksd->bkgd", p, v_win_l.astype(jnp.float32))
-    return m, l, acc
+    valid = (jnp.arange(W) < count)[None, None, None, :]
+    return _partial_impl(q, k_win_l, v_win_l, mask=valid)
 
 
 @jax.jit
@@ -178,6 +176,19 @@ def _combine(m1, l1, a1, m2, l2, a2):
     w1 = jnp.exp(m1 - m)
     w2 = jnp.exp(m2 - m)
     return m, l1 * w1 + l2 * w2, a1 * w1 + a2 * w2
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _chunk_causal_partial(q, k, v, s_len: int):
+    """Causal partial of a prefill chunk against its OWN k/v.
+
+    q (b, nkv, g*s, hd) grouped rows (row j*s+t ↔ head j, position t);
+    k/v (b, nkv, s, hd).  Row t sees keys 0..t — the intra-chunk half
+    of chunked prefill (history pages/window are the other half)."""
+    rows = q.shape[2]
+    t = jnp.arange(rows) % s_len
+    causal = (t[:, None] >= jnp.arange(s_len)[None, :])[None, None]
+    return _partial_impl(q, k, v, mask=causal)
 
 
 @jax.jit
@@ -353,10 +364,11 @@ class PagedKVCache:
                 self._evict_one()
 
     def append_layer(self, layer: int, k, v) -> None:
-        """Stage one layer's (b, nkv, 1, hd) position at slot ``count``
-        WITHOUT advancing it — every layer of a step writes the same
-        slot; :meth:`commit_step` advances.  Requires count < window
-        (guaranteed by append/commit_step post-conditions)."""
+        """Stage one layer's (b, nkv, s, hd) positions at slot ``count``
+        WITHOUT advancing it — every layer of a step/chunk writes the
+        same slots; :meth:`commit_step` / :meth:`commit_block` advance.
+        Requires count + s <= window (decode: guaranteed by the
+        commit post-conditions; chunks: call :meth:`ensure_room`)."""
         self.k_win, self.v_win = _append_layer(
             self.k_win, self.v_win, k[None].astype(self.cfg.dtype),
             v[None].astype(self.cfg.dtype),
@@ -365,8 +377,31 @@ class PagedKVCache:
 
     def commit_step(self) -> None:
         """Advance past the slot all layers just staged; evict if full."""
-        self.count += 1
-        if self.count == self.ocfg.window:
+        self.commit_block(1)
+
+    def commit_block(self, s: int) -> None:
+        """Advance past ``s`` slots all layers just staged; evict until
+        the invariant count < window holds again."""
+        self.count += s
+        if self.count > self.ocfg.window:
+            raise RuntimeError(
+                f"commit_block({s}) overran the window "
+                f"({self.count} > {self.ocfg.window})")
+        while self.count >= self.ocfg.window:
+            self._evict_one()
+
+    def ensure_room(self, s: int) -> None:
+        """Evict until ``s`` more positions fit in the window.  The
+        evicted slots are pure history (they pre-date the block being
+        staged), so this is always causally safe."""
+        P, W = self.ocfg.page_len, self.ocfg.window
+        if s > W:
+            raise ValueError(f"block of {s} exceeds window {W}")
+        while self.count + s > W:
+            if self.count < P:
+                raise RuntimeError(
+                    f"cannot make room: count={self.count} < page "
+                    f"{P} but {s} more positions requested")
             self._evict_one()
 
     # -- read tier --------------------------------------------------------
@@ -409,6 +444,20 @@ class PagedKVCache:
         for _ in range(self.n_cold):
             yield read_kv(), read_kv()
 
+    def _history_partials(self, layer: int, qf, valid: int):
+        """(m, l, acc) of grouped queries over cold pages + ``valid``
+        window slots — the shared-history half of any attention here."""
+        m, l, acc = _window_partial(
+            qf, self.k_win[layer], self.v_win[layer],
+            jnp.asarray(valid, jnp.int32))
+        for k_item, v_item in self._iter_layer_pages(layer):
+            if self._quant:
+                pm, pl, pacc = _page_partial_q(qf, *k_item, *v_item)
+            else:
+                pm, pl, pacc = _page_partial(qf, k_item, v_item)
+            m, l, acc = _combine(m, l, acc, pm, pl, pacc)
+        return m, l, acc
+
     def attend(self, layer: int, q,
                valid: Optional[int] = None) -> jax.Array:
         """Full-history attention for one layer's query block.
@@ -422,21 +471,54 @@ class PagedKVCache:
         """
         b, nh, s_q, hd = q.shape
         qf = _grouped(q, self.cfg.n_kv_heads)
-        m, l, acc = _window_partial(
-            qf, self.k_win[layer], self.v_win[layer],
-            jnp.asarray(self.count if valid is None else valid, jnp.int32))
-        for k_item, v_item in self._iter_layer_pages(layer):
-            if self._quant:
-                pm, pl, pacc = _page_partial_q(qf, *k_item, *v_item)
-            else:
-                pm, pl, pacc = _page_partial(qf, k_item, v_item)
-            m, l, acc = _combine(m, l, acc, pm, pl, pacc)
+        m, l, acc = self._history_partials(
+            layer, qf, self.count if valid is None else valid)
+        out = _finish(m, l, acc)
+        return out.reshape(b, nh, s_q, hd).astype(self.cfg.dtype)
+
+    def attend_chunk(self, layer: int, q, k_chunk, v_chunk) -> jax.Array:
+        """Chunked-prefill attention: every query row sees the full
+        cached history (shared) PLUS its own chunk causally.
+
+        q (b, n_heads, s, hd); k_chunk/v_chunk (b, nkv, s, hd) are the
+        chunk's OWN projections, not yet appended to the window.
+        Returns (b, n_heads, s, hd)."""
+        b, nh, s_q, hd = q.shape
+        qf = _grouped(q, self.cfg.n_kv_heads)
+        m, l, acc = self._history_partials(layer, qf, self.count)
+        cm, cl, cacc = _chunk_causal_partial(
+            qf, k_chunk.astype(self.cfg.dtype),
+            v_chunk.astype(self.cfg.dtype), s_q)
+        m, l, acc = _combine(m, l, acc, cm, cl, cacc)
         out = _finish(m, l, acc)
         return out.reshape(b, nh, s_q, hd).astype(self.cfg.dtype)
 
 
 # ---------------------------------------------------------------------------
 # generation on top of the paged cache
+
+
+def _layer_forward(params: Dict, i: int, x, cfg: TransformerConfig,
+                   positions, attend):
+    """One transformer layer against the paged cache — the ONE copy of
+    the layer wiring (norms, qkv, wo residual, mlp residual) both the
+    decode step and chunked prefill run.  ``attend(i, q, k, v)`` owns
+    the append/attend ordering and returns (b, nh, s, hd)."""
+    b, s, _ = x.shape
+    Lk = f"layers.{i}."
+    h = rms_norm(x, params[Lk + "attn_norm"], cfg.norm_eps)
+    q, k, v = qkv_project(h, params, Lk, cfg, positions=positions)
+    a = attend(i, q, k, v)
+    a = a.transpose(0, 2, 1, 3).reshape(b, s, -1)
+    x = x + a @ params[Lk + "wo"].astype(a.dtype)
+    h = rms_norm(x, params[Lk + "mlp_norm"], cfg.norm_eps)
+    return (x + _mlp_block(h, params, Lk, cfg)).astype(cfg.dtype)
+
+
+def _final_logits(params: Dict, x_last, cfg: TransformerConfig):
+    x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
+    return (x_last @ params["lm_head"].astype(x_last.dtype)
+            ).astype(jnp.float32)
 
 
 def offload_decode_step(params: Dict, token, cfg: TransformerConfig,
@@ -446,52 +528,91 @@ def offload_decode_step(params: Dict, token, cfg: TransformerConfig,
     dense cache update).  The per-layer host loop is the tier boundary:
     NVMe streaming happens between jitted segments.  token (b,) int32 →
     next-token logits (b, vocab) f32."""
-    b = token.shape[0]
     pos = cache.pos
     x = params["tok_embed"].astype(cfg.dtype)[token[:, None]]
     positions = jnp.asarray([pos], jnp.float32)
-    for i in range(cfg.n_layers):
-        Lk = f"layers.{i}."
-        h = rms_norm(x, params[Lk + "attn_norm"], cfg.norm_eps)
-        q, k, v = qkv_project(h, params, Lk, cfg, positions=positions)
+
+    def attend(i, q, k, v):
         # layer i's kv lands in the window BEFORE its attention so the
         # new position is visible to its own query (valid=count+1);
         # count itself advances once per step in commit_step
         cache.append_layer(i, k, v)
-        a = cache.attend(i, q, valid=cache.count + 1)
-        a = a.transpose(0, 2, 1, 3).reshape(b, 1, -1)
-        x = x + a @ params[Lk + "wo"].astype(a.dtype)
-        h = rms_norm(x, params[Lk + "mlp_norm"], cfg.norm_eps)
-        x = (x + _mlp_block(h, params, Lk, cfg)).astype(cfg.dtype)
+        return cache.attend(i, q, valid=cache.count + 1)
+
+    for i in range(cfg.n_layers):
+        x = _layer_forward(params, i, x, cfg, positions, attend)
     cache.commit_step()
-    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
-    return logits
+    return _final_logits(params, x[:, 0], cfg)
+
+
+def offloaded_prefill(params: Dict, tokens, cfg: TransformerConfig,
+                      cache: PagedKVCache):
+    """Prefill an arbitrary-length prompt with BOUNDED HBM.
+
+    The prompt processes in ``page_len``-sized chunks: each chunk's
+    queries attend to the full cached history (cold pages + window,
+    shared) plus the chunk itself causally, then the chunk's KV joins
+    the window (evicting as needed).  Activation memory is
+    O(batch × page_len × d) regardless of prompt length — the missing
+    half of "decode beyond HBM".  Requires ``window_pages >= 2`` (a
+    chunk and at least one page of history must coexist).
+    Returns last-position logits (b, vocab) f32.
+    """
+    if cache.ocfg.window_pages < 2:
+        raise ValueError("chunked prefill needs window_pages >= 2")
+    b, total = tokens.shape
+    P = cache.ocfg.page_len
+
+    def attend(i, q, k, v):
+        # the chunk attends to history (shared) + itself (causal)
+        # BEFORE its kv joins the window
+        a = cache.attend_chunk(i, q, k, v)
+        cache.append_layer(i, k, v)
+        return a
+
+    x_last = None
+    for c0 in range(0, total, P):
+        chunk = tokens[:, c0:c0 + P]
+        s = chunk.shape[1]
+        cache.ensure_room(s)
+        pos0 = cache.pos
+        x = params["tok_embed"].astype(cfg.dtype)[chunk]
+        positions = jnp.arange(pos0, pos0 + s, dtype=jnp.float32)
+        for i in range(cfg.n_layers):
+            x = _layer_forward(params, i, x, cfg, positions, attend)
+        cache.commit_block(s)
+        x_last = x[:, -1]
+    return _final_logits(params, x_last, cfg)
 
 
 def offloaded_generate(params: Dict, prompt, cfg: TransformerConfig,
                        ocfg: OffloadConfig, engine: StromEngine,
                        max_new_tokens: int,
                        eos_id: Optional[int] = None,
-                       pad_id: int = 0):
+                       pad_id: int = 0,
+                       chunked_prefill: bool = False):
     """Greedy generation with the SSD-backed cache.
 
-    prompt (b, s) int32 → (b, max_new_tokens) int32.  The prompt is
-    prefilled through the standard dense path (it must fit in HBM once;
-    chunked prefill is the caller's job for extreme prompts) and its KV
-    blocks then seed the paged cache — decode proceeds with a bounded
-    HBM window no matter how many tokens follow.
+    prompt (b, s) int32 → (b, max_new_tokens) int32.  By default the
+    prompt prefills through the standard dense path (it must fit in
+    HBM once) and its KV blocks seed the paged cache;
+    ``chunked_prefill=True`` instead runs :func:`offloaded_prefill`,
+    bounding HBM for the prompt too — decode proceeds with a bounded
+    window no matter how long the sequence.
     """
     from nvme_strom_tpu.models import decode as _dec
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, "
                          f"got {max_new_tokens}")
     b, s = prompt.shape
-    dense = _dec.init_cache(cfg, b, s)
-    logits, dense = _dec.prefill(params, prompt, cfg, dense)
     with PagedKVCache(cfg, ocfg, engine, b) as cache:
-        cache.append(dense["k"], dense["v"])
-        del dense
+        if chunked_prefill:
+            logits = offloaded_prefill(params, prompt, cfg, cache)
+        else:
+            dense = _dec.init_cache(cfg, b, s)
+            logits, dense = _dec.prefill(params, prompt, cfg, dense)
+            cache.append(dense["k"], dense["v"])
+            del dense
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         done = (jnp.zeros((b,), bool) if eos_id is None else tok == eos_id)
         out = [tok]
