@@ -55,6 +55,12 @@ def main(argv=None) -> int:
     ap.add_argument("--tiny", action="store_true",
                     help="tiny config (CI/demo) instead of the flagship")
     ap.add_argument("--save-every", type=int, default=10)
+    ap.add_argument("--lora", type=int, default=0, metavar="RANK",
+                    help="train rank-RANK LoRA adapters instead of full "
+                         "weights (base stays frozen; checkpoints hold "
+                         "only adapters + their optimizer state)")
+    ap.add_argument("--lora-alpha", type=float, default=None,
+                    help="LoRA scale numerator (default: RANK)")
     args = ap.parse_args(argv)
 
     import jax
@@ -145,25 +151,59 @@ def main(argv=None) -> int:
     start = mgr.latest_step()
 
     p_sh = param_shardings(cfg, mesh)
-    if args.init_weights and start is None:  # a resume overwrites anyway
+    # Full fine-tune resumes overwrite params from the checkpoint, so
+    # the warm start only matters on a fresh run — but a LoRA resume
+    # restores ONLY adapters, so its frozen base must reload every time.
+    if args.init_weights and (start is None or args.lora):
         params = LazyCheckpoint(args.init_weights).load_sharded(
             p_sh, engine=engine)
         print(f"params: lazy-loaded {len(params)} tensors from "
               f"{args.init_weights}")
     else:
+        # fixed seed: the re-initialized base is identical across runs,
+        # so a LoRA resume without a warm start is still coherent
         params = init_params(jax.random.key(0), cfg)
         params = {k: jax.device_put(v, p_sh[k]) for k, v in params.items()}
 
     optimizer = optax.adamw(args.lr)
-    opt_state = replicate_scalars(optimizer.init(params), mesh)
     b_sh = batch_shardings(mesh)
-    step_fn = jax.jit(make_train_step(cfg, optimizer),
-                      in_shardings=(p_sh, None, b_sh),
-                      out_shardings=(p_sh, None, None),
-                      donate_argnums=(0, 1))
+    if args.lora:
+        # frozen streamed base + tiny trainable adapters: the
+        # checkpoint/optimizer state shrinks to adapter size
+        from nvme_strom_tpu.models.lora import (
+            count_params, lora_init, make_lora_train_step)
+        from jax.sharding import NamedSharding, PartitionSpec
+        alpha = (args.lora_alpha if args.lora_alpha is not None
+                 else float(args.lora))
+        base = params
+        rep = NamedSharding(mesh, PartitionSpec())   # adapters are tiny
+        trainable = jax.device_put(
+            lora_init(jax.random.key(1), base, args.lora), rep)
+        opt_state = jax.device_put(optimizer.init(trainable), rep)
+        _lora_step = jax.jit(
+            make_lora_train_step(cfg, optimizer, alpha=alpha),
+            donate_argnums=(0, 1))
+
+        def step_fn(tr, ost, tokens):
+            return _lora_step(tr, ost, base, tokens)
+        print(f"lora: rank {args.lora} alpha {alpha:g} — "
+              f"{count_params(trainable)} trainable of "
+              f"{count_params(base)} base params")
+    else:
+        trainable = params
+        opt_state = replicate_scalars(optimizer.init(params), mesh)
+        step_fn = jax.jit(make_train_step(cfg, optimizer),
+                          in_shardings=(p_sh, None, b_sh),
+                          out_shardings=(p_sh, None, None),
+                          donate_argnums=(0, 1))
 
     if start is not None:
-        params, opt_state = mgr.restore((params, opt_state))
+        trainable, opt_state = mgr.restore((trainable, opt_state))
+        if args.lora:
+            # restore commits to single-device placements; the adapters
+            # must live replicated beside the tp-sharded base
+            trainable = jax.device_put(trainable, rep)
+            opt_state = jax.device_put(opt_state, rep)
         print(f"resumed from step {start}")
     start = (start or 0)
 
@@ -189,16 +229,16 @@ def main(argv=None) -> int:
     loss = None
     for step in range(start, args.steps):
         tokens = next(it)
-        params, opt_state, loss = step_fn(params, opt_state, tokens)
+        trainable, opt_state, loss = step_fn(trainable, opt_state, tokens)
         if (step + 1) % args.save_every == 0 or step + 1 == args.steps:
             jax.block_until_ready(loss)
             if jax.process_count() == 1:
                 # snapshot now (donation-safe numpy copies), NVMe write
                 # overlaps the next steps; errors surface at the next
                 # save/restore/wait
-                mgr.save_async(step + 1, (params, opt_state))
+                mgr.save_async(step + 1, (trainable, opt_state))
             else:
-                mgr.save(step + 1, (params, opt_state))
+                mgr.save(step + 1, (trainable, opt_state))
             print(f"step {step + 1}: loss={float(loss):.4f} "
                   f"(checkpointed)")
         elif (step + 1) % 5 == 0:

@@ -105,6 +105,13 @@ def _load_lib() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
+        if not _CSRC.is_dir():
+            raise ImportError(
+                f"C++ engine sources not found at {_CSRC} — "
+                "nvme_strom_tpu must run from a source checkout "
+                "(`pip install -e .` or sys.path), not a plain wheel: "
+                "the engine builds csrc/ against the running kernel's "
+                "io_uring support on first import")
         src_mtime = max((_CSRC / n).stat().st_mtime
                         for n in ("strom_io.cc", "strom_io.h"))
         if not _LIB_PATH.exists() or _LIB_PATH.stat().st_mtime < src_mtime:

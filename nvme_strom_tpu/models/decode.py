@@ -141,31 +141,53 @@ def decode_step(params: Dict, token: jax.Array, cfg: TransformerConfig,
     return logits, cache
 
 
-def _sample(logits, temperature: float, rng):
+def _sample(logits, temperature: float, rng,
+            top_k: int = 0, top_p: float = 1.0):
+    """Greedy (temperature 0) or categorical sampling with optional
+    top-k / nucleus (top-p) truncation — all branch-free under jit
+    (the knobs are static python values, so each combination traces
+    its own specialized program)."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(
-        rng, logits / jnp.float32(temperature), axis=-1).astype(jnp.int32)
+    logits = logits / jnp.float32(temperature)
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose cumulative probability reaches top_p
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p          # first token always kept
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(params: Dict, prompt: jax.Array, cfg: TransformerConfig,
              max_new_tokens: int, temperature: float = 0.0,
              rng: Optional[jax.Array] = None,
              eos_id: Optional[int] = None,
-             pad_id: int = 0, cache_attn=None) -> jax.Array:
-    """Greedy/temperature generation.  prompt (b, s) int32 →
-    (b, max_new_tokens) int32.  The decode loop is one lax.scan; jit this
-    whole function (``static_argnums`` for cfg, max_new_tokens,
-    temperature AND cache_attn — a function is not a jax type) or wrap
-    them all in a partial.  After ``eos_id`` a sequence emits
-    ``pad_id`` forever (static shapes; no early exit under jit)."""
+             pad_id: int = 0, cache_attn=None,
+             top_k: int = 0, top_p: float = 1.0) -> jax.Array:
+    """Greedy/temperature generation with optional top-k / top-p
+    truncation.  prompt (b, s) int32 → (b, max_new_tokens) int32.  The
+    decode loop is one lax.scan; jit this whole function
+    (``static_argnums`` for cfg, max_new_tokens, temperature, top_k,
+    top_p AND cache_attn — a function is not a jax type) or wrap them
+    all in a partial.  After ``eos_id`` a sequence emits ``pad_id``
+    forever (static shapes; no early exit under jit)."""
     b, s = prompt.shape
     if rng is None:
         rng = jax.random.key(0)
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(f"bad top_k={top_k} / top_p={top_p}")
     cache = init_cache(cfg, b, s + max_new_tokens)
     logits, cache = prefill(params, prompt, cfg, cache)
     rng, sub = jax.random.split(rng)
-    tok = _sample(logits, temperature, sub)
+    tok = _sample(logits, temperature, sub, top_k, top_p)
     # An eos IS emitted (even as the very first token); only tokens after
     # it become pad — same semantics at every position.
     done = (jnp.zeros((b,), bool) if eos_id is None
@@ -175,7 +197,7 @@ def generate(params: Dict, prompt: jax.Array, cfg: TransformerConfig,
         tok, cache, rng, done = carry
         logits, cache = decode_step(params, tok, cfg, cache, cache_attn)
         rng, sub = jax.random.split(rng)
-        nxt = _sample(logits, temperature, sub)
+        nxt = _sample(logits, temperature, sub, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, pad_id, nxt)
             done = done | (nxt == eos_id)

@@ -1,0 +1,104 @@
+"""LoRA fine-tuning: adapt an NVMe-resident base model with tiny
+trainable factors.
+
+The storage story completes the loop the reference's consumers live by
+(SURVEY.md §3.5 — work on data bigger than you can afford to own): the
+frozen base streams from NVMe through the lazy weight loader once, the
+trainable state (adapters + optimizer moments) is ~``2·rank/d`` of a
+full fine-tune, and adapter checkpoints are kilobytes through the same
+checkpoint manager.
+
+TPU-first shape: adapters apply as an on-the-fly merged delta —
+``W_eff = W + (alpha/rank)·A@B`` — inside the jitted loss.  The A@B
+product is one (d_in, r)x(r, d_out) matmul per target per step (rank
+≤ 64 keeps it negligible next to the forward), XLA fuses the add into
+the consumer matmul, and the existing forward/decode paths run
+UNCHANGED on merged params — no layer rewiring, no divergent code path
+to keep in sync with the dense model.
+
+Gradients flow only to the adapters (`jax.grad` over the adapter
+pytree, base closed over), so optimizer state is adapter-sized — the
+memory win that makes fine-tuning fit next to a streamed base.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from nvme_strom_tpu.models.transformer import (
+    TransformerConfig, loss_fn)
+
+#: attention projections are the canonical LoRA targets (Hu et al.);
+#: mlp matmuls opt in via ``targets=``
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+def lora_init(rng: jax.Array, base_params: Dict, rank: int,
+              targets: Sequence[str] = DEFAULT_TARGETS,
+              dtype=jnp.float32) -> Dict:
+    """Adapters {name: (A, B)} for every base matmul whose leaf name is
+    in ``targets``.  A ~ N(0, 1/rank) (f32), B = 0 — so the adapted
+    model starts EXACTLY equal to the base."""
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    out: Dict[str, Tuple[jax.Array, jax.Array]] = {}
+    names = [n for n in sorted(base_params)
+             if n.rsplit(".", 1)[-1] in targets
+             and base_params[n].ndim == 2]
+    if not names:
+        raise ValueError(f"no base matmuls match targets {targets}")
+    keys = jax.random.split(rng, len(names))
+    for key, n in zip(keys, names):
+        d_in, d_out = base_params[n].shape
+        a = (jax.random.normal(key, (d_in, rank), dtype)
+             / jnp.sqrt(jnp.asarray(rank, dtype)))
+        b = jnp.zeros((rank, d_out), dtype)
+        out[n] = (a, b)
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("alpha",))
+def merge_lora(base_params: Dict, adapters: Dict,
+               alpha: float = 1.0) -> Dict:
+    """Base + scaled adapter deltas → full params (same pytree shape
+    and dtypes as the base, so forward/decode/checkpointing all work
+    unchanged).  scale = alpha / rank."""
+    out = dict(base_params)
+    for n, (a, b) in adapters.items():
+        rank = a.shape[1]
+        delta = (a @ b) * (alpha / rank)
+        out[n] = (base_params[n].astype(jnp.float32)
+                  + delta.astype(jnp.float32)).astype(base_params[n].dtype)
+    return out
+
+
+def lora_loss_fn(adapters: Dict, base_params: Dict, tokens,
+                 cfg: TransformerConfig, alpha: float = 1.0,
+                 attn_fn=None):
+    """Loss of the adapted model — differentiable in ``adapters`` only."""
+    return loss_fn(merge_lora(base_params, adapters, alpha=alpha),
+                   tokens, cfg, attn_fn=attn_fn)
+
+
+def make_lora_train_step(cfg: TransformerConfig, optimizer,
+                         alpha: float = 1.0, attn_fn=None):
+    """step(adapters, opt_state, base_params, tokens) →
+    (adapters, opt_state, loss).  jit with donate_argnums=(0, 1); the
+    base rides through untouched (and unduplicated — XLA aliases it)."""
+    def step(adapters, opt_state, base_params, tokens):
+        loss, grads = jax.value_and_grad(lora_loss_fn)(
+            adapters, base_params, tokens, cfg, alpha=alpha,
+            attn_fn=attn_fn)
+        updates, opt_state = optimizer.update(grads, opt_state, adapters)
+        adapters = optax.apply_updates(adapters, updates)
+        return adapters, opt_state, loss
+    return step
+
+
+def count_params(tree) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))

@@ -172,3 +172,37 @@ def test_pallas_decode_attention_gqa_and_odd_lengths():
         got = decode_attention(q, ck, cv, pos, block_k=32)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=2e-5, rtol=2e-5)
+
+
+def test_sample_top_k_top_p():
+    """Truncation semantics of the sampling helper: top-k keeps only
+    the k best tokens ever; top-p keeps the smallest nucleus reaching
+    p (first token always kept)."""
+    from nvme_strom_tpu.models.decode import _sample
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.07, 0.03]]))
+    rngs = jax.random.split(jax.random.key(0), 200)
+
+    ids_k = {int(_sample(logits, 1.0, r, 2, 1.0)[0]) for r in rngs}
+    assert ids_k <= {0, 1}
+
+    # nucleus 0.6: cum-probs-before are 0, .5, .75... keep {0, 1}
+    ids_p = {int(_sample(logits, 1.0, r, 0, 0.6)[0]) for r in rngs}
+    assert ids_p <= {0, 1}
+
+    # degenerate nucleus keeps exactly the argmax
+    ids_tiny = {int(_sample(logits, 1.0, r, 0, 1e-9)[0]) for r in rngs}
+    assert ids_tiny == {0}
+
+    # temperature 0 ignores the knobs entirely
+    assert int(_sample(logits, 0.0, rngs[0], 3, 0.5)[0]) == 0
+
+
+def test_generate_top_k_matches_greedy_when_k1(setup):
+    """top_k=1 sampling at any temperature reduces to greedy."""
+    from functools import partial as _p
+    cfg, params, prompt = setup
+    greedy = jax.jit(_p(generate, cfg=cfg, max_new_tokens=8))(
+        params, prompt)
+    k1 = jax.jit(_p(generate, cfg=cfg, max_new_tokens=8,
+                    temperature=0.7, top_k=1))(params, prompt)
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))

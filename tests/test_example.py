@@ -44,6 +44,38 @@ def test_train_checkpoint_resume(tmp_path):
     assert losses and all(l == l and l < 100 for l in losses)  # finite
 
 
+def test_train_lora_checkpoint_resume(tmp_path):
+    """--lora trains adapters only, checkpoints them, and resumes."""
+    (tmp_path / "data").mkdir()
+    sys.path.insert(0, str(REPO))
+    from examples.train_lm import _synthesize_shards
+    from nvme_strom_tpu.models.transformer import tiny_config
+    _synthesize_shards(str(tmp_path / "data"), tiny_config(),
+                       n_shards=2, per_shard=8)
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+
+    def run(steps):
+        r = subprocess.run(
+            [sys.executable, str(REPO / "examples" / "train_lm.py"),
+             "--tiny", "--steps", str(steps), "--save-every", "2",
+             "--global-batch", "4", "--tp", "2", "--lora", "4",
+             "--ckpt-dir", str(tmp_path / "ckpt"),
+             "--data-dir", str(tmp_path / "data")],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=str(REPO))
+        assert r.returncode == 0, r.stderr[-2000:]
+        return r.stdout
+
+    out1 = run(4)
+    assert "lora: rank 4" in out1
+    m = re.search(r"(\d+) trainable of (\d+) base", out1)
+    assert m and int(m.group(1)) < int(m.group(2)) // 5
+    out2 = run(6)
+    assert "resumed from step 4" in out2
+    assert "step 6" in out2
+
+
 def test_train_vit_fixedrec(tmp_path):
     """examples/train_vit.py: the config-3 consumer loop — fixedrec
     records stream to device and decode THERE (slice + bitcast inside
