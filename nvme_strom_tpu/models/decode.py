@@ -103,8 +103,12 @@ def decode_step(params: Dict, token: jax.Array, cfg: TransformerConfig,
     fused Pallas kernel); it receives the cache at kv-head width.
     Default is a masked dense einsum over the GQA-expanded cache.
     """
+    if cache_attn is None:
+        # the dense path IS block_step with m=1 — one masked-attention
+        # implementation to maintain
+        logits, cache = block_step(params, token[:, None], cfg, cache)
+        return logits[:, 0], cache
     b = token.shape[0]
-    max_len = cache["k"].shape[3]
     pos = cache["pos"]
     x = params["tok_embed"].astype(cfg.dtype)[token[:, None]]  # (b, 1, d)
     positions = pos.astype(jnp.float32)[None]
@@ -117,26 +121,60 @@ def decode_step(params: Dict, token: jax.Array, cfg: TransformerConfig,
             cache["k"], k[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
         cache["v"] = lax.dynamic_update_slice(
             cache["v"], v[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
-        if cache_attn is not None:
-            # kv-width cache straight into the kernel: the GQA query
-            # group maps to its kv head inside (no expanded HBM copy)
-            a = cache_attn(q, cache["k"][i], cache["v"][i], pos)
-        else:
-            ck = expand_gqa(cache["k"][i], cfg)        # (b, nh, S, hd)
-            cv = expand_gqa(cache["v"][i], cfg)
-            scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck,
-                                preferred_element_type=jnp.float32)
-            scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
-            valid = jnp.arange(max_len) <= pos         # causal by position
-            scores = jnp.where(valid[None, None, None, :], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-            a = jnp.einsum("bhqk,bhkd->bhqd", probs, cv)
+        # kv-width cache straight into the kernel: the GQA query
+        # group maps to its kv head inside (no expanded HBM copy)
+        a = cache_attn(q, cache["k"][i], cache["v"][i], pos)
         a = a.transpose(0, 2, 1, 3).reshape(b, 1, -1)
         x = x + a @ params[L + "wo"].astype(a.dtype)
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
         x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
     cache["pos"] = pos + 1
     x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return logits, cache
+
+
+def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
+               cache: Dict) -> tuple[jax.Array, Dict]:
+    """Multi-token incremental step: tokens (b, m) int32 enter the cache
+    at positions pos..pos+m-1 and every position gets logits.
+
+    Row t of the block attends to the whole cache up to pos+t (causal
+    within the block, full history before it) — the verify forward of
+    speculative decoding, and the general "ingest a block mid-stream"
+    primitive.  Returns (logits (b, m, vocab) f32, cache with
+    pos += m).  Contract: pos + m <= max_len.
+    """
+    b, m = tokens.shape
+    max_len = cache["k"].shape[3]
+    pos = cache["pos"]
+    x = params["tok_embed"].astype(cfg.dtype)[tokens]
+    positions = pos.astype(jnp.float32) + jnp.arange(m, dtype=jnp.float32)
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        h = rms_norm(x, params[L + "attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_project(h, params, L, cfg, positions=positions)
+        cache["k"] = lax.dynamic_update_slice(
+            cache["k"], k[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
+        cache["v"] = lax.dynamic_update_slice(
+            cache["v"], v[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
+        ck = expand_gqa(cache["k"][i], cfg)            # (b, nh, S, hd)
+        cv = expand_gqa(cache["v"][i], cfg)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
+        # row t sees cache positions <= pos + t
+        limit = pos + jnp.arange(m)[:, None]           # (m, 1)
+        valid = jnp.arange(max_len)[None, :] <= limit  # (m, S)
+        scores = jnp.where(valid[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
+        a = jnp.einsum("bhqk,bhkd->bhqd", probs, cv)
+        a = a.transpose(0, 2, 1, 3).reshape(b, m, -1)
+        x = x + a @ params[L + "wo"].astype(a.dtype)
+        h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
+        x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
+    cache["pos"] = pos + m
+    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, cache
 

@@ -1,0 +1,154 @@
+"""Speculative decoding: a small draft model proposes, the target
+verifies k tokens per forward.
+
+Greedy-acceptance speculation: the emitted sequence is PROVABLY
+identical to the target model's own greedy decode — the draft only
+changes how many target forwards it takes to produce it.  The win is
+wall-clock: a verify forward over k+1 positions costs barely more than
+a single-token step (the same weights stream through the MXU; the
+sequence axis just grows), so acceptance rate ~a turns into ~a·k fewer
+target steps.
+
+Host-orchestrated control loop (acceptance counts are data-dependent —
+the anti-pattern for one big jit), with both models' work in jitted
+blocks: the draft's k proposals are one ``lax.scan``, the target's
+verify is one :func:`~nvme_strom_tpu.models.decode.block_step`.
+Cache rewind after partial acceptance is free: positions past ``pos``
+are dead by construction (every mask tests ``<= pos``; later writes
+overwrite in place).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from nvme_strom_tpu.models import decode as _dec
+from nvme_strom_tpu.models.transformer import TransformerConfig
+
+
+@dataclass
+class SpecStats:
+    """Acceptance accounting for one generate call."""
+    target_forwards: int = 0
+    drafted: int = 0
+    accepted: int = 0
+
+    @property
+    def accept_rate(self) -> float:
+        return self.accepted / self.drafted if self.drafted else 0.0
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3), donate_argnums=(1,))
+def _draft_k(params: Dict, cache: Dict, cfg: TransformerConfig, k: int,
+             tok: jax.Array):
+    """k greedy draft steps as one scan → ((b, k) tokens, cache)."""
+    def step(carry, _):
+        tok, cache = carry
+        logits, cache = _dec.decode_step(params, tok, cfg, cache)
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        return (nxt, cache), nxt
+
+    (_, cache), toks = lax.scan(step, (tok, cache), None, length=k)
+    return jnp.moveaxis(toks, 0, 1), cache
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+def _verify(params: Dict, cache: Dict, cfg: TransformerConfig, blk):
+    """Model forward over the block → (greedy picks (b, m), cache)."""
+    logits, cache = _dec.block_step(params, blk, cfg, cache)
+    return jnp.argmax(logits, -1).astype(jnp.int32), cache
+
+
+def _rewind(cache: Dict, pos: int) -> Dict:
+    cache["pos"] = jnp.asarray(pos, jnp.int32)
+    return cache
+
+
+def speculative_generate(draft_params: Dict, target_params: Dict,
+                         prompt: jax.Array, cfg: TransformerConfig,
+                         max_new_tokens: int, k: int = 4,
+                         draft_cfg: Optional[TransformerConfig] = None,
+                         eos_id: Optional[int] = None, pad_id: int = 0,
+                         stats: Optional[SpecStats] = None):
+    """Greedy generation via draft-k/verify — token-identical to
+    ``decode.generate(target_params, ...)`` with temperature 0.
+
+    prompt (1, s) int32 → (1, max_new_tokens) int32.  Batch 1 only:
+    acceptance lengths are per-sequence, and a shared cache position
+    cannot diverge per row.  ``draft_cfg`` defaults to ``cfg`` (same
+    architecture, smaller weights is the usual pairing — e.g. a
+    lower-rank or distilled checkpoint in the same layout).
+    Pass a :class:`SpecStats` to collect acceptance accounting.
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, "
+                         f"got {max_new_tokens}")
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    b, s = prompt.shape
+    if b != 1:
+        raise ValueError(f"speculative decode is batch-1 (got b={b})")
+    dcfg = draft_cfg or cfg
+    st = stats if stats is not None else SpecStats()
+
+    cap = s + max_new_tokens + k + 1
+    t_cache = _dec.init_cache(cfg, b, cap)
+    d_cache = _dec.init_cache(dcfg, b, cap)
+    t_logits, t_cache = _dec.prefill(target_params, prompt, cfg, t_cache)
+    _, d_cache = _dec.prefill(draft_params, prompt, dcfg, d_cache)
+    st.target_forwards += 1
+
+    out = [int(jnp.argmax(t_logits, -1)[0])]
+    while len(out) < max_new_tokens:
+        if eos_id is not None and out[-1] == eos_id:
+            break
+        tok = jnp.asarray([out[-1]], jnp.int32)
+        t_pos = int(t_cache["pos"])
+        d_pos = int(d_cache["pos"])
+
+        kk = min(k, max_new_tokens - len(out))
+        drafts, d_cache = _draft_k(draft_params, d_cache, dcfg, kk, tok)
+        # verify block: [current token, d_1 .. d_kk]; pick row t is the
+        # target's choice AFTER seeing row t — row kk's pick is the
+        # free bonus token when every draft is accepted (kk+1 emitted
+        # per target forward at acceptance 1.0)
+        blk = jnp.concatenate([tok[:, None], drafts], axis=1)
+        picks, t_cache = _verify(target_params, t_cache, cfg, blk)
+        st.target_forwards += 1
+        st.drafted += kk
+
+        # ONE device→host transfer for both arrays, not 2·kk scalars
+        drafts_h, picks_h = jax.device_get((drafts[0], picks[0]))
+        drafts_h, picks_h = drafts_h.tolist(), picks_h.tolist()
+        n_acc = 0
+        while n_acc < kk and picks_h[n_acc] == drafts_h[n_acc]:
+            n_acc += 1
+        st.accepted += n_acc
+        # accepted drafts + the target's row-n_acc pick: the correction
+        # on a mismatch, the bonus on full acceptance — same expression
+        emitted = drafts_h[:n_acc] + [picks_h[n_acc]]
+        out.extend(emitted)
+
+        # invariant: each cache holds every emitted token EXCEPT the
+        # newest (out[-1] enters on the next round's block).  The
+        # target ingested the whole kk+1 block; the draft ingested only
+        # up to d_kk-1, so a full acceptance leaves it one token short
+        # — catch it up by ingesting d_kk (picks discarded)
+        if n_acc == kk:
+            _, d_cache = _verify(draft_params, d_cache, dcfg,
+                                 drafts[:, -1:])
+        t_cache = _rewind(t_cache, t_pos + len(emitted))
+        d_cache = _rewind(d_cache, d_pos + len(emitted))
+
+    out = out[:max_new_tokens]
+    if eos_id is not None and eos_id in out:
+        cut = out.index(eos_id) + 1
+        out = out[:cut] + [pad_id] * (max_new_tokens - cut)
+    out += [pad_id] * (max_new_tokens - len(out))
+    return jnp.asarray([out], jnp.int32)
