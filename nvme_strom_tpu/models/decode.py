@@ -134,6 +134,26 @@ def decode_step(params: Dict, token: jax.Array, cfg: TransformerConfig,
     return logits, cache
 
 
+def cache_attention(q, ck, cv, limit, cfg: TransformerConfig):
+    """Masked attention of an m-row query block over a live KV cache —
+    the ONE dense cache-attention implementation (block_step, and the
+    per-row-position serving step, models/serving.py).
+
+    q (b, nh, m, hd); ck/cv kv-width (b, nkv, S, hd); limit (b, m):
+    row t of batch b attends cache positions <= limit[b, t].
+    Returns (b, nh, m, hd)."""
+    S = ck.shape[2]
+    cke = expand_gqa(ck, cfg)
+    cve = expand_gqa(cv, cfg)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, cke,
+                        preferred_element_type=jnp.float32)
+    scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
+    valid = jnp.arange(S)[None, None, None, :] <= limit[:, None, :, None]
+    scores = jnp.where(valid, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cve.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, cve)
+
+
 def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
                cache: Dict) -> tuple[jax.Array, Dict]:
     """Multi-token incremental step: tokens (b, m) int32 enter the cache
@@ -146,10 +166,11 @@ def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
     pos += m).  Contract: pos + m <= max_len.
     """
     b, m = tokens.shape
-    max_len = cache["k"].shape[3]
     pos = cache["pos"]
     x = params["tok_embed"].astype(cfg.dtype)[tokens]
     positions = pos.astype(jnp.float32) + jnp.arange(m, dtype=jnp.float32)
+    # row t sees cache positions <= pos + t (same limit for every row)
+    limit = jnp.broadcast_to(pos + jnp.arange(m), (b, m))
     for i in range(cfg.n_layers):
         L = f"layers.{i}."
         h = rms_norm(x, params[L + "attn_norm"], cfg.norm_eps)
@@ -158,17 +179,7 @@ def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
             cache["k"], k[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
         cache["v"] = lax.dynamic_update_slice(
             cache["v"], v[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
-        ck = expand_gqa(cache["k"][i], cfg)            # (b, nh, S, hd)
-        cv = expand_gqa(cache["v"][i], cfg)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, ck,
-                            preferred_element_type=jnp.float32)
-        scores = scores / jnp.sqrt(jnp.float32(cfg.head_dim))
-        # row t sees cache positions <= pos + t
-        limit = pos + jnp.arange(m)[:, None]           # (m, 1)
-        valid = jnp.arange(max_len)[None, :] <= limit  # (m, S)
-        scores = jnp.where(valid[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(cv.dtype)
-        a = jnp.einsum("bhqk,bhkd->bhqd", probs, cv)
+        a = cache_attention(q, cache["k"][i], cache["v"][i], limit, cfg)
         a = a.transpose(0, 2, 1, 3).reshape(b, m, -1)
         x = x + a @ params[L + "wo"].astype(a.dtype)
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)

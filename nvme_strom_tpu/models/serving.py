@@ -1,0 +1,205 @@
+"""Continuous batching: a decode server over fixed slots.
+
+Serving completes the inference stack the way PG-Strom completes the
+reference's storage stack (SURVEY.md §3.5 — the consumer that turns a
+data path into a product).  Requests arrive at arbitrary times with
+arbitrary prompt lengths; the server packs them into a fixed-slot
+batch, admits new work the moment a slot frees, and every decode step
+advances EVERY active slot — no head-of-line blocking on the longest
+request.
+
+TPU-first shape: the batch step is one jitted program with static
+shapes.  Per-slot sequence positions are data (a ``(B,)`` vector), not
+shapes: cache writes scatter to per-row positions, attention masks by
+``pos[b]``, RoPE takes per-row positions (transformer._rope's 2-D
+form).  Admission prefills a single request through the standard dense
+prefill and scatters its KV rows into the slot — one compiled step
+program serves every mix of request states.
+
+Greedy decoding; per-request ``max_new`` and ``eos_id``.  Outputs are
+token-identical to running each request alone through
+``decode.generate`` (the equivalence test in tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from nvme_strom_tpu.models import decode as _dec
+from nvme_strom_tpu.models.decode import _mlp_block
+from nvme_strom_tpu.models.transformer import (
+    TransformerConfig, qkv_project, rms_norm)
+
+
+@dataclass
+class _Request:
+    rid: object
+    prompt: List[int]
+    max_new: int
+    eos_id: Optional[int]
+    out: List[int] = field(default_factory=list)
+
+
+@functools.partial(jax.jit, donate_argnums=(1, 2))
+def _scatter_prefill(slot, k_cache, v_cache, k_new, v_new):
+    """Place a prefilled request's (L,1,nkv,s,hd) KV at slot rows."""
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new.astype(k_cache.dtype), (0, slot, 0, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_new.astype(v_cache.dtype), (0, slot, 0, 0, 0))
+    return k_cache, v_cache
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(3, 4))
+def _serve_step(params: Dict, cfg: TransformerConfig, tok,
+                k_cache, v_cache, pos):
+    """One decode step for every slot at its OWN position.
+
+    tok (B,) int32, pos (B,) int32 → (next_tok (B,), k_cache,
+    v_cache).  Free slots compute too, but their frozen-pos writes land
+    in rows the next admission overwrites and the host ignores their
+    outputs — one compiled program for every batch mix.
+    """
+    B = tok.shape[0]
+    rows = jnp.arange(B)
+    x = params["tok_embed"].astype(cfg.dtype)[tok[:, None]]   # (B,1,d)
+    positions = pos.astype(jnp.float32)[:, None]              # (B,1)
+    limit = pos[:, None]                                      # (B,1)
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        h = rms_norm(x, params[L + "attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_project(h, params, L, cfg, positions=positions)
+        # per-row scatter: row b writes its kv at its own pos[b]
+        k_cache = k_cache.at[i, rows, :, pos, :].set(
+            k[:, :, 0].astype(k_cache.dtype))
+        v_cache = v_cache.at[i, rows, :, pos, :].set(
+            v[:, :, 0].astype(v_cache.dtype))
+        a = _dec.cache_attention(q, k_cache[i], v_cache[i], limit, cfg)
+        a = a.transpose(0, 2, 1, 3).reshape(B, 1, -1)
+        x = x + a @ params[L + "wo"].astype(a.dtype)
+        h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
+        x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+    return nxt, k_cache, v_cache
+
+
+class DecodeServer:
+    """Fixed-slot continuous-batching decode server (greedy).
+
+    ``submit`` enqueues; ``step`` admits waiting requests into free
+    slots, advances every active slot one token, and returns requests
+    that finished this step ({request_id: token list}).  ``run``
+    drains everything.
+    """
+
+    def __init__(self, params: Dict, cfg: TransformerConfig,
+                 max_batch: int, max_len: int):
+        self.params = params
+        self.cfg = cfg
+        self.B = max_batch
+        self.max_len = max_len
+        L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        shape = (L, max_batch, nkv, max_len, hd)
+        self.k_cache = jnp.zeros(shape, cfg.dtype)
+        self.v_cache = jnp.zeros(shape, cfg.dtype)
+        self.pos = jnp.zeros((max_batch,), jnp.int32)
+        self.tok = jnp.zeros((max_batch,), jnp.int32)
+        self.slots: List[Optional[_Request]] = [None] * max_batch
+        self.queue: List[_Request] = []
+
+    # -- intake -----------------------------------------------------------
+
+    def submit(self, rid, prompt_ids: List[int], max_new: int,
+               eos_id: Optional[int] = None) -> None:
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if len(prompt_ids) + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {len(prompt_ids)} + max_new {max_new} exceeds "
+                f"server max_len {self.max_len}")
+        in_flight = ({r.rid for r in self.queue}
+                     | {r.rid for r in self.slots if r is not None})
+        if rid in in_flight:
+            # results key on rid — a duplicate would silently clobber
+            raise ValueError(f"request id {rid!r} already in flight")
+        self.queue.append(_Request(rid, list(prompt_ids), max_new,
+                                   eos_id))
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        """Prefill the request alone, scatter its KV into the slot."""
+        s = len(req.prompt)
+        cache = _dec.init_cache(self.cfg, 1, s)
+        prompt = jnp.asarray([req.prompt], jnp.int32)
+        logits, cache = _dec.prefill(self.params, prompt, self.cfg,
+                                     cache)
+        self.k_cache, self.v_cache = _scatter_prefill(
+            jnp.asarray(slot, jnp.int32), self.k_cache, self.v_cache,
+            cache["k"], cache["v"])
+        first = int(jnp.argmax(logits, -1)[0])
+        req.out.append(first)
+        self.slots[slot] = req
+        # pos[slot] = s - nothing decoded past the prompt yet; tok is
+        # the token entering the cache on the next step
+        self.pos = self.pos.at[slot].set(s)
+        self.tok = self.tok.at[slot].set(first)
+
+    def _retire_or_keep(self, slot: int) -> Optional[tuple]:
+        req = self.slots[slot]
+        done_len = len(req.out) >= req.max_new
+        done_eos = req.eos_id is not None and req.out[-1] == req.eos_id
+        if done_len or done_eos:
+            self.slots[slot] = None
+            return req.rid, req.out
+        return None
+
+    # -- serving ----------------------------------------------------------
+
+    @property
+    def idle(self) -> bool:
+        return not self.queue and all(s is None for s in self.slots)
+
+    def step(self) -> Dict[object, List[int]]:
+        """Admit → one batched decode step → retire finished."""
+        finished: Dict[object, List[int]] = {}
+        for slot in range(self.B):
+            if self.slots[slot] is None and self.queue:
+                self._admit(slot, self.queue.pop(0))
+                # a request can complete at admission (max_new == 1 or
+                # instant eos)
+                ret = self._retire_or_keep(slot)
+                if ret:
+                    finished[ret[0]] = ret[1]
+        active_slots = [i for i, r in enumerate(self.slots)
+                        if r is not None]
+        if not active_slots:
+            return finished
+        active = jnp.asarray([r is not None for r in self.slots])
+        nxt, self.k_cache, self.v_cache = _serve_step(
+            self.params, self.cfg, self.tok, self.k_cache,
+            self.v_cache, self.pos)
+        nxt_h = jax.device_get(nxt).tolist()
+        # the step ingested tok at pos for every active slot
+        self.pos = jnp.where(active, self.pos + 1, self.pos)
+        self.tok = nxt
+        for slot in active_slots:
+            self.slots[slot].out.append(nxt_h[slot])
+            ret = self._retire_or_keep(slot)
+            if ret:
+                finished[ret[0]] = ret[1]
+        return finished
+
+    def run(self) -> Dict[object, List[int]]:
+        """Drain the queue: step until every request finishes."""
+        results: Dict[object, List[int]] = {}
+        while not self.idle:
+            results.update(self.step())
+        return results

@@ -159,11 +159,13 @@ def _rope(q, k, theta, positions=None, scaling=None):
     two halves rotated against each other — the same convention as HF
     Llama's rotate_half, so converted checkpoints need no permutation).
 
-    ``positions``: absolute token positions, shape (seq,); defaults to
-    arange(seq).  The decode path passes the cache write position so an
-    incrementally-generated token gets the same rotation it would in a
-    full forward pass (models/decode.py).  ``scaling``: optional
-    Llama-3.1 rope_scaling dict (see TransformerConfig)."""
+    ``positions``: absolute token positions, shape (seq,) — or (b, seq)
+    when rows sit at DIFFERENT positions (continuous batching,
+    models/serving.py); defaults to arange(seq).  The decode path
+    passes the cache write position so an incrementally-generated token
+    gets the same rotation it would in a full forward pass
+    (models/decode.py).  ``scaling``: optional Llama-3.1 rope_scaling
+    dict (see TransformerConfig)."""
     seq = q.shape[-2]
     half = q.shape[-1] // 2
     if positions is None:
@@ -174,8 +176,10 @@ def _rope(q, k, theta, positions=None, scaling=None):
         if rt != "llama3":
             raise NotImplementedError(f"rope_scaling type {rt!r}")
         freqs = _llama3_scale_freqs(freqs, scaling)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    ang = positions.astype(jnp.float32)[..., None] * freqs
     cos, sin = jnp.cos(ang), jnp.sin(ang)
+    if ang.ndim == 3:              # per-row positions: (b, s, half)
+        cos, sin = cos[:, None], sin[:, None]   # broadcast over heads
 
     def rot(x):
         x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
