@@ -66,10 +66,16 @@ _mlp_block = mlp_block      # original (private) name, kept for callers
 
 
 def prefill(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
-            cache: Dict) -> tuple[jax.Array, Dict]:
+            cache: Dict, last: Optional[int] = None) -> tuple[jax.Array,
+                                                              Dict]:
     """Run the prompt through the model, filling cache[0:seq].
 
-    tokens (b, s) int32 → (last-position logits (b, vocab) f32, cache).
+    tokens (b, s) int32 → (logits (b, vocab) f32 at position ``last``
+    (default s-1), cache).  ``last`` serves right-padded prompts
+    (bucketed serving admission): causality keeps positions <= last
+    unaffected by the padding, and the pad rows' cache entries are
+    dead — the consumer overwrites them before its mask ever exposes
+    them.
     """
     b, s = tokens.shape
     x = params["tok_embed"].astype(cfg.dtype)[tokens]
@@ -87,7 +93,8 @@ def prefill(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
         x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
     cache["pos"] = jnp.asarray(s, jnp.int32)
-    x = rms_norm(x[:, -1], params["final_norm"], cfg.norm_eps)
+    x = rms_norm(x[:, s - 1 if last is None else last],
+                 params["final_norm"], cfg.norm_eps)
     logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
     return logits, cache
 

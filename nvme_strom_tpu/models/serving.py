@@ -135,12 +135,23 @@ class DecodeServer:
                                    eos_id))
 
     def _admit(self, slot: int, req: _Request) -> None:
-        """Prefill the request alone, scatter its KV into the slot."""
+        """Prefill the request alone, scatter its KV into the slot.
+
+        The prompt right-pads to a power-of-two bucket so admission
+        compiles once per bucket, not once per prompt length; the pad
+        rows' cache entries are dead (decode overwrites a position
+        before its mask exposes it) and the first-token logits read at
+        the true last position."""
         s = len(req.prompt)
-        cache = _dec.init_cache(self.cfg, 1, s)
-        prompt = jnp.asarray([req.prompt], jnp.int32)
+        bucket = 16
+        while bucket < s:
+            bucket *= 2
+        bucket = min(bucket, self.max_len)
+        cache = _dec.init_cache(self.cfg, 1, bucket)
+        padded = req.prompt + [0] * (bucket - s)
+        prompt = jnp.asarray([padded], jnp.int32)
         logits, cache = _dec.prefill(self.params, prompt, self.cfg,
-                                     cache)
+                                     cache, last=s - 1)
         self.k_cache, self.v_cache = _scatter_prefill(
             jnp.asarray(slot, jnp.int32), self.k_cache, self.v_cache,
             cache["k"], cache["v"])
