@@ -21,6 +21,9 @@ number:
               NVMe (models/kv_offload.py; deliberately storage-bound —
               the capability is decode BEYOND HBM, its cost is the
               stream → vs_baseline null)
+ 11 serve   — continuous-batching aggregate throughput, tokens/sec
+              across mixed-length requests on fixed slots
+              (models/serving.py; compute row → vs_baseline null)
 
 Usage: python bench_suite.py [--config N ... | --all] [--json-only]
 
@@ -589,6 +592,53 @@ def bench_kv_offload(engine, device=None) -> tuple[float, str]:
     return rate, tag
 
 
+def bench_serving(device=None) -> tuple[float, str]:
+    """Config 11: continuous-batching aggregate decode throughput.
+
+    Mixed-length requests keep every slot busy (a freed slot admits the
+    next request mid-flight); the number is total generated tokens over
+    wall-clock from first step to drain, admission prefills included —
+    the end-to-end serving rate, not a per-step best case."""
+    import jax
+    from nvme_strom_tpu.models.serving import DecodeServer
+    from nvme_strom_tpu.models.transformer import init_params
+    cfg = _bench_cfg()
+    if _tiny_compute():
+        slots, n_req, max_len = 2, 4, 64
+        lens = [5, 9, 13, 7]
+        news = [6, 8, 5, 7]
+    else:
+        slots, n_req, max_len = 8, 24, 1536
+        lens = [128 + 61 * (i % 7) for i in range(n_req)]
+        news = [64 + 17 * (i % 5) for i in range(n_req)]
+    dev = device or jax.devices()[0]
+    params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
+
+    def submit_all(srv):
+        import numpy as np
+        rng = np.random.default_rng(1)
+        for i in range(n_req):
+            srv.submit(i, rng.integers(0, cfg.vocab, lens[i]).tolist(),
+                       news[i])
+
+    # warmup run compiles the step + admission buckets (discarded)
+    srv = DecodeServer(params, cfg, max_batch=slots, max_len=max_len)
+    submit_all(srv)
+    srv.run()
+    ts = []
+    for _ in range(_RUNS):
+        srv = DecodeServer(params, cfg, max_batch=slots,
+                           max_len=max_len)
+        submit_all(srv)
+        t0 = time.monotonic()
+        out = srv.run()
+        ts.append(time.monotonic() - t0)
+    total = sum(news)
+    rate = total / statistics.median(ts)
+    return rate, (f"slots={slots} reqs={n_req} "
+                  f"tok/req~{total // n_req}")
+
+
 def bench_train(device=None) -> tuple[float, str]:
     """Config 7: train-step throughput as model TFLOP/s (and MFU when the
     chip's peak is known).  FLOPs are the 6·T·P matmul estimate plus the
@@ -690,6 +740,7 @@ def run(configs: list[int]) -> list[dict]:
             # a GiB/s row, so no north-star ratio applies
             10: ("kv-offload-decode",
                  lambda: bench_kv_offload(engine), "tok/s", False),
+            11: ("serving-throughput", bench_serving, "tok/s", False),
         }
         for c in configs:
             label, fn, unit, io_row = names[c]
@@ -721,12 +772,12 @@ def run(configs: list[int]) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 11))
+                    choices=range(1, 12))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+        configs = list(range(1, 12))
     for line in run(configs):
         print(json.dumps(line), flush=True)
     return 0

@@ -52,6 +52,9 @@ def main(argv=None) -> int:
     ap.add_argument("--global-batch", type=int, default=8)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--accum-steps", type=int, default=1,
+                    help="gradient-accumulation microbatches per step "
+                         "(activation memory of global-batch/N)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny config (CI/demo) instead of the flagship")
     ap.add_argument("--save-every", type=int, default=10)
@@ -181,7 +184,8 @@ def main(argv=None) -> int:
             lora_init(jax.random.key(1), base, args.lora), rep)
         opt_state = jax.device_put(optimizer.init(trainable), rep)
         _lora_step = jax.jit(
-            make_lora_train_step(cfg, optimizer, alpha=alpha),
+            make_lora_train_step(cfg, optimizer, alpha=alpha,
+                                 accum_steps=args.accum_steps),
             donate_argnums=(0, 1))
 
         def step_fn(tr, ost, tokens):
@@ -192,7 +196,8 @@ def main(argv=None) -> int:
     else:
         trainable = params
         opt_state = replicate_scalars(optimizer.init(params), mesh)
-        step_fn = jax.jit(make_train_step(cfg, optimizer),
+        step_fn = jax.jit(make_train_step(cfg, optimizer,
+                                          accum_steps=args.accum_steps),
                           in_shardings=(p_sh, None, b_sh),
                           out_shardings=(p_sh, None, None),
                           donate_argnums=(0, 1))

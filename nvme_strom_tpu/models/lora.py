@@ -86,14 +86,21 @@ def lora_loss_fn(adapters: Dict, base_params: Dict, tokens,
 
 
 def make_lora_train_step(cfg: TransformerConfig, optimizer,
-                         alpha: float = 1.0, attn_fn=None):
+                         alpha: float = 1.0, attn_fn=None,
+                         accum_steps: int = 1):
     """step(adapters, opt_state, base_params, tokens) →
     (adapters, opt_state, loss).  jit with donate_argnums=(0, 1); the
-    base rides through untouched (and unduplicated — XLA aliases it)."""
+    base rides through untouched (and unduplicated — XLA aliases it).
+    ``accum_steps``: gradient accumulation, same semantics as
+    :func:`~nvme_strom_tpu.models.transformer.make_train_step`."""
+    from nvme_strom_tpu.models.transformer import accumulate_grads
+
     def step(adapters, opt_state, base_params, tokens):
-        loss, grads = jax.value_and_grad(lora_loss_fn)(
-            adapters, base_params, tokens, cfg, alpha=alpha,
-            attn_fn=attn_fn)
+        loss, grads = accumulate_grads(
+            lambda mb: jax.value_and_grad(lora_loss_fn)(
+                adapters, base_params, mb, cfg, alpha=alpha,
+                attn_fn=attn_fn),
+            adapters, tokens, accum_steps)
         updates, opt_state = optimizer.update(grads, opt_state, adapters)
         adapters = optax.apply_updates(adapters, updates)
         return adapters, opt_state, loss

@@ -299,18 +299,60 @@ def loss_fn(params, tokens, cfg, attn_fn=None) -> jax.Array:
 
 # ----------------------------- training -----------------------------
 
-def make_train_step(cfg: TransformerConfig, optimizer, attn_fn=None):
+def make_train_step(cfg: TransformerConfig, optimizer, attn_fn=None,
+                    accum_steps: int = 1):
     """Returns step(params, opt_state, tokens) -> (params, opt_state, loss).
     Pure function — jit/shard it at the call site.  ``attn_fn`` selects the
-    attention inner block (dense / ring / flash)."""
+    attention inner block (dense / ring / flash).
+
+    ``accum_steps > 1``: gradient accumulation — tokens (b, s) split
+    into ``accum_steps`` microbatches along b and their gradients
+    averaged in one ``lax.scan`` before the single optimizer update, so
+    the activation footprint is that of b/accum_steps while the update
+    matches the full-batch step exactly (same mean-over-tokens loss).
+    """
 
     import optax
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(p, tokens, cfg, attn_fn))(params)
+        loss, grads = accumulate_grads(
+            lambda mb: jax.value_and_grad(
+                lambda p: loss_fn(p, mb, cfg, attn_fn))(params),
+            params, tokens, accum_steps)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
     return step
+
+
+def accumulate_grads(grad_fn, like, tokens, accum_steps: int):
+    """Microbatched gradient driver shared by the full and LoRA steps.
+
+    ``grad_fn(microbatch) -> (loss, grads)`` with grads shaped
+    ``like``; tokens (b, s) split into ``accum_steps`` row groups, one
+    ``lax.scan`` accumulates in f32, and the mean matches the
+    full-batch value exactly (equal micro sizes)."""
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if accum_steps == 1:
+        return grad_fn(tokens)
+    b = tokens.shape[0]
+    if b % accum_steps:
+        raise ValueError(f"batch {b} not divisible by "
+                         f"accum_steps {accum_steps}")
+    micro = tokens.reshape(accum_steps, b // accum_steps, -1)
+
+    def one(carry, mb):
+        loss_sum, grads = carry
+        l, g = grad_fn(mb)
+        return (loss_sum + l,
+                jax.tree_util.tree_map(jnp.add, grads, g)), None
+
+    zero = jax.tree_util.tree_map(
+        lambda p: jnp.zeros(p.shape, jnp.float32), like)
+    (loss_sum, grads), _ = jax.lax.scan(
+        one, (jnp.zeros((), jnp.float32), zero), micro)
+    inv = jnp.float32(1.0 / accum_steps)
+    return loss_sum * inv, jax.tree_util.tree_map(
+        lambda g: g * inv, grads)

@@ -91,3 +91,26 @@ def test_mlp_targets_opt_in(setup):
     assert any(n.endswith("w_gate") for n in names)
     assert any(n.endswith("w_down") for n in names)
     assert not any(n.endswith("wk") for n in names)
+
+
+def test_lora_gradient_accumulation_matches(setup):
+    """LoRA accum_steps produces the same adapters as full-batch."""
+    cfg, base, tokens = setup
+
+    def run(accum):
+        ad = lora.lora_init(jax.random.key(9), base, rank=4)
+        opt = optax.adam(1e-2)
+        st = opt.init(ad)
+        step = jax.jit(lora.make_lora_train_step(cfg, opt,
+                                                 accum_steps=accum))
+        for _ in range(3):
+            ad, st, loss = step(ad, st, base, tokens)
+        return ad, float(loss)
+
+    a1, l1 = run(1)
+    a2, l2 = run(2)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    for k in a1:
+        for x, y in zip(a1[k], a2[k]):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       atol=1e-6, rtol=1e-5)

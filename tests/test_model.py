@@ -152,3 +152,35 @@ def test_remat_matches_dense_grads():
         np.testing.assert_allclose(np.asarray(g1[k], np.float32),
                                    np.asarray(g2[k], np.float32),
                                    atol=1e-5, rtol=1e-3)
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps microbatching produces the same update as the
+    full-batch step (mean-of-means == full mean at equal micro sizes)."""
+    import optax
+    from nvme_strom_tpu.models.transformer import (
+        TransformerConfig, init_params, make_train_step, tiny_config)
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, cfg.vocab)
+    opt = optax.adamw(1e-3)
+
+    def run(accum):
+        p = jax.tree_util.tree_map(jnp.copy, params)
+        st = opt.init(p)
+        step = jax.jit(make_train_step(cfg, opt, accum_steps=accum))
+        for _ in range(3):
+            p, st, loss = step(p, st, tokens)
+        return p, float(loss)
+
+    p1, l1 = run(1)
+    p4, l4 = run(4)
+    np.testing.assert_allclose(l1, l4, rtol=1e-5)
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p4[k]),
+                                   atol=1e-5, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="divisible"):
+        jax.jit(make_train_step(cfg, opt, accum_steps=3))(
+            params, opt.init(params), tokens)
