@@ -12,6 +12,9 @@ from nvme_strom_tpu.formats.tfrecord import (
 )
 from nvme_strom_tpu.formats.wds import WdsShardIndex, write_wds_shard
 from nvme_strom_tpu.formats.arrow import ArrowFileReader
+from nvme_strom_tpu.formats.npy import (plan_npy, plan_npz,
+                                        read_npy_to_device,
+                                        read_npz_to_device)
 
 __all__ = [
     "PlanEntry", "ReadPlan",
@@ -20,4 +23,5 @@ __all__ = [
     "masked_crc",
     "WdsShardIndex", "write_wds_shard",
     "ArrowFileReader",
+    "plan_npy", "plan_npz", "read_npy_to_device", "read_npz_to_device",
 ]

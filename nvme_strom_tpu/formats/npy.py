@@ -1,0 +1,188 @@
+"""NumPy ``.npy`` / ``.npz``-directory planning: arrays NVMe→HBM direct.
+
+The simplest fixed-layout format there is — one header, one contiguous
+payload — and therefore the purest demonstration of the framework's
+read path (SURVEY.md §3.1): the header is metadata-class buffered I/O,
+the payload spans stream O_DIRECT → staging → device and the "decode"
+is an on-device bitcast + reshape.  Fortran-ordered and object arrays
+fall back with a reason (no on-device transpose surprise, no pickle).
+
+``.npz`` (a zip of .npy members) is planned by walking the zip central
+directory; STORED (uncompressed) members stream direct, DEFLATE
+members are rejected with a reason — compression is host decode by
+nature and numpy's default ``savez`` is uncompressed.
+"""
+
+from __future__ import annotations
+
+import ast
+import struct
+import zipfile
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+
+_MAGIC = b"\x93NUMPY"
+
+
+class _HeaderWindow(ValueError):
+    """Header extends past the read window; ``needed`` bytes suffice."""
+
+    def __init__(self, needed: int):
+        super().__init__(f"header needs {needed} bytes")
+        self.needed = needed
+
+
+def _parse_npy_header(buf: bytes) -> Tuple[dict, int]:
+    """→ (header dict, payload offset).  Raises ValueError on anything
+    that is not a v1/v2/v3 .npy header; _HeaderWindow when the window
+    was simply too small (the format allows headers far beyond 4 KiB —
+    callers re-read with ``needed``)."""
+    if buf[:6] != _MAGIC:
+        raise ValueError("not a .npy file (bad magic)")
+    major = buf[6]
+    if major == 1:
+        (hlen,) = struct.unpack_from("<H", buf, 8)
+        start = 10
+    elif major in (2, 3):
+        (hlen,) = struct.unpack_from("<I", buf, 8)
+        start = 12
+    else:
+        raise ValueError(f"unsupported .npy version {major}")
+    if start + hlen > len(buf):
+        raise _HeaderWindow(start + hlen)
+    header = ast.literal_eval(buf[start:start + hlen].decode("latin1"))
+    return header, start + hlen
+
+
+def plan_npy(path, name: Optional[str] = None,
+             base_offset: int = 0, header_window: int = 4096,
+             read_at=None) -> PlanEntry:
+    """One .npy file (or embedded member at ``base_offset``) → its
+    payload PlanEntry.  ``read_at(off, ln)`` overrides the default
+    buffered open (zip members)."""
+    import os
+
+    if read_at is None:
+        f = open(path, "rb")
+        read_at = lambda off, ln: os.pread(f.fileno(), ln, off)  # noqa
+    else:
+        f = None
+    try:
+        buf = read_at(base_offset, header_window)
+        try:
+            header, payload_off = _parse_npy_header(buf)
+        except _HeaderWindow as hw:
+            buf = read_at(base_offset, hw.needed)
+            header, payload_off = _parse_npy_header(buf)
+    finally:
+        if f is not None:
+            f.close()
+    descr, shape = header["descr"], tuple(header["shape"])
+    if header.get("fortran_order"):
+        raise ValueError("fortran_order arrays need a host transpose — "
+                         "load via numpy instead")
+    dt = np.dtype(descr)
+    if dt.hasobject:
+        raise ValueError("object arrays are pickle payloads, not raw "
+                         "bytes")
+    if dt.names is not None or dt.kind == "V":
+        raise ValueError(f"structured dtype {descr!r} has no on-device "
+                         "representation — load via numpy instead")
+    if dt.byteorder == ">":
+        raise ValueError(f"big-endian dtype {descr!r}: the on-device "
+                         "bitcast is little-endian — byteswap and "
+                         "re-save, or load via numpy")
+    length = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    return PlanEntry(key=name or str(path),
+                     offset=base_offset + payload_off, length=length,
+                     dtype=dt.str, shape=shape)
+
+
+def plan_npz(path) -> ReadPlan:
+    """A .npz archive → one PlanEntry per STORED member.
+
+    The zip central directory (buffered metadata read via zipfile) gives
+    each member's data offset; the member's own .npy header is then
+    parsed in place.  DEFLATE members raise with a reason."""
+    entries = []
+    with zipfile.ZipFile(path) as z, open(path, "rb") as f:
+        import os
+
+        def read_at(off: int, ln: int) -> bytes:
+            return os.pread(f.fileno(), ln, off)
+
+        for info in z.infolist():
+            if info.compress_type != zipfile.ZIP_STORED:
+                raise ValueError(
+                    f"member {info.filename!r} is compressed "
+                    f"(type {info.compress_type}) — host decode; use "
+                    f"np.load or save with np.savez (uncompressed)")
+            # local header: fixed 30 bytes + name + extra
+            lh = read_at(info.header_offset, 30)
+            if lh[:4] != b"PK\x03\x04":
+                raise ValueError(f"bad local header for "
+                                 f"{info.filename!r}")
+            nlen, elen = struct.unpack_from("<HH", lh, 26)
+            data_off = info.header_offset + 30 + nlen + elen
+            name = info.filename
+            if name.endswith(".npy"):
+                name = name[:-4]
+            entries.append(plan_npy(path, name=name,
+                                    base_offset=data_off,
+                                    read_at=read_at))
+    return ReadPlan(str(path), tuple(entries))
+
+
+def read_npy_to_device(engine, path, device=None):
+    """Whole .npy array → device, payload zero-copy through the engine."""
+    out = _read_plan_to_device(engine, path,
+                               ReadPlan(str(path), (plan_npy(path),)),
+                               device)
+    return next(iter(out.values()))
+
+
+def read_npz_to_device(engine, path, device=None,
+                       keys=None) -> Dict[str, object]:
+    """.npz members → {name: device array}, all members pipelined
+    through ONE stream (queue depth stays full across member
+    boundaries — the sql/pq_direct multi-span pattern)."""
+    plan = plan_npz(path)
+    if keys is not None:
+        plan = plan.subset(list(keys))
+    return _read_plan_to_device(engine, path, plan, device)
+
+
+def _read_plan_to_device(engine, path, plan: ReadPlan, device=None):
+    import jax
+    import jax.numpy as jnp
+    from nvme_strom_tpu.ops.bridge import DeviceStream, split_ranges
+    for e in plan.entries:
+        if (np.dtype(e.dtype).itemsize == 8
+                and not jax.config.jax_enable_x64):
+            # the on-device bitcast would silently truncate i64/f64
+            raise ValueError(f"{e.key}: dtype {e.dtype} needs "
+                             f"jax_enable_x64 (bitcast would truncate)")
+    dev = device or jax.local_devices()[0]
+    ds = DeviceStream(engine, device=dev,
+                      depth=engine.config.queue_depth)
+    ranges, counts = split_ranges(plan.ranges(),
+                                  engine.config.chunk_bytes)
+    out: Dict[str, object] = {}
+    fh = engine.open(path)
+    try:
+        it = ds.stream_ranges(fh, ranges)
+        for entry, n in zip(plan.entries, counts):
+            parts = [next(it) for _ in range(n)]
+            if not parts:
+                flat = jnp.zeros((0,), jnp.uint8)
+            else:
+                flat = (parts[0] if len(parts) == 1
+                        else jnp.concatenate(parts))
+            out[entry.key] = flat.view(
+                np.dtype(entry.dtype)).reshape(entry.shape)
+    finally:
+        engine.close(fh)
+    return out

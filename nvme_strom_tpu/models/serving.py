@@ -55,15 +55,18 @@ def _scatter_prefill(slot, k_cache, v_cache, k_new, v_new):
     return k_cache, v_cache
 
 
-@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(3, 4))
+@functools.partial(jax.jit, static_argnums=(1, 6),
+                   donate_argnums=(3, 4))
 def _serve_step(params: Dict, cfg: TransformerConfig, tok,
-                k_cache, v_cache, pos):
+                k_cache, v_cache, pos, cache_attn=None):
     """One decode step for every slot at its OWN position.
 
     tok (B,) int32, pos (B,) int32 → (next_tok (B,), k_cache,
     v_cache).  Free slots compute too, but their frozen-pos writes land
     in rows the next admission overwrites and the host ignores their
-    outputs — one compiled program for every batch mix.
+    outputs — one compiled program for every batch mix.  ``cache_attn``
+    swaps the attention inner for the fused Pallas kernel
+    (ops/decode_attention supports the (B,) per-row pos form).
     """
     B = tok.shape[0]
     rows = jnp.arange(B)
@@ -79,7 +82,11 @@ def _serve_step(params: Dict, cfg: TransformerConfig, tok,
             k[:, :, 0].astype(k_cache.dtype))
         v_cache = v_cache.at[i, rows, :, pos, :].set(
             v[:, :, 0].astype(v_cache.dtype))
-        a = _dec.cache_attention(q, k_cache[i], v_cache[i], limit, cfg)
+        if cache_attn is not None:
+            a = cache_attn(q, k_cache[i], v_cache[i], pos)
+        else:
+            a = _dec.cache_attention(q, k_cache[i], v_cache[i], limit,
+                                     cfg)
         a = a.transpose(0, 2, 1, 3).reshape(B, 1, -1)
         x = x + a @ params[L + "wo"].astype(a.dtype)
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
@@ -100,11 +107,14 @@ class DecodeServer:
     """
 
     def __init__(self, params: Dict, cfg: TransformerConfig,
-                 max_batch: int, max_len: int):
+                 max_batch: int, max_len: int, cache_attn=None):
         self.params = params
         self.cfg = cfg
         self.B = max_batch
         self.max_len = max_len
+        # e.g. ops.decode_attention.make_decode_attn() — the fused
+        # kernel pays off once live caches clear ~1k positions
+        self.cache_attn = cache_attn
         L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
         shape = (L, max_batch, nkv, max_len, hd)
         self.k_cache = jnp.zeros(shape, cfg.dtype)
@@ -196,7 +206,7 @@ class DecodeServer:
         active = jnp.asarray([r is not None for r in self.slots])
         nxt, self.k_cache, self.v_cache = _serve_step(
             self.params, self.cfg, self.tok, self.k_cache,
-            self.v_cache, self.pos)
+            self.v_cache, self.pos, self.cache_attn)
         nxt_h = jax.device_get(nxt).tolist()
         # the step ingested tok at pos for every active slot
         self.pos = jnp.where(active, self.pos + 1, self.pos)

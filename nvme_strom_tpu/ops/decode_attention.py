@@ -77,9 +77,12 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
 def decode_attention(q, k, v, pos, *, scale=None, block_k: int = 512,
                      interpret: bool = None):
     """q (b, n_heads, 1, d) attends to the kv-width cache k/v
-    (b, n_kv_heads, S, d) at positions [0, pos] (``pos`` = scalar int32
-    index of the newest entry).  n_heads % n_kv_heads == 0; the query
-    group per kv head rides the kernel's second-to-last block dim.
+    (b, n_kv_heads, S, d) at positions [0, pos].  ``pos`` is the int32
+    index of the newest entry — a scalar, or a (b,) vector when rows
+    sit at DIFFERENT positions (the continuous-batching serve step,
+    models/serving.py): each grid row then masks by its own bound.
+    n_heads % n_kv_heads == 0; the query group per kv head rides the
+    kernel's second-to-last block dim.
 
     Returns (b, n_heads, 1, d).  ``interpret`` defaults to True off-TPU so
     CPU tests run the identical kernel in the Pallas interpreter.
@@ -99,13 +102,19 @@ def decode_attention(q, k, v, pos, *, scale=None, block_k: int = 512,
     block_k = min(block_k, S)
     n_kb = -(-S // block_k)               # ceil: tail masked, not sliced
     qg = q.reshape(b, nkv, g, d)
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1, 1)
+    pos_arr = jnp.asarray(pos, jnp.int32)
+    if pos_arr.ndim == 0:
+        pos_arr = jnp.broadcast_to(pos_arr, (b,))
+    elif pos_arr.shape != (b,):
+        raise ValueError(f"pos must be scalar or ({b},), "
+                         f"got {pos_arr.shape}")
+    pos_arr = pos_arr.reshape(b, 1)
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=float(scale),
                           block_k=block_k, n_kb=n_kb),
         grid=(b, nkv, n_kb),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bi, hi, ki: (0, 0)),
+            pl.BlockSpec((1, 1), lambda bi, hi, ki: (bi, 0)),
             pl.BlockSpec((1, 1, g, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, ki: (bi, hi, ki, 0)),

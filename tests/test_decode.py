@@ -206,3 +206,30 @@ def test_generate_top_k_matches_greedy_when_k1(setup):
     k1 = jax.jit(_p(generate, cfg=cfg, max_new_tokens=8,
                     temperature=0.7, top_k=1))(params, prompt)
     np.testing.assert_array_equal(np.asarray(k1), np.asarray(greedy))
+
+
+def test_pallas_decode_attention_vector_pos():
+    """(b,) per-row positions mask each row by its own bound — the
+    serving form; matches the dense per-row reference."""
+    from nvme_strom_tpu.models.transformer import expand_gqa
+    from nvme_strom_tpu.ops.decode_attention import decode_attention
+
+    b, nh, nkv, S, d = 3, 4, 2, 50, 16
+    ks = jax.random.split(jax.random.key(21), 3)
+    q = jax.random.normal(ks[0], (b, nh, 1, d), jnp.float32)
+    ck = jax.random.normal(ks[1], (b, nkv, S, d), jnp.float32)
+    cv = jax.random.normal(ks[2], (b, nkv, S, d), jnp.float32)
+    pos = jnp.asarray([0, 17, S - 1], jnp.int32)
+
+    class _C:
+        n_heads, n_kv_heads = nh, nkv
+    cke, cve = expand_gqa(ck, _C), expand_gqa(cv, _C)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, cke) / np.sqrt(d)
+    valid = jnp.arange(S)[None, None, None, :] <= pos[:, None, None, None]
+    scores = jnp.where(valid, scores, -1e30)
+    want = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), cve)
+    got = decode_attention(q, ck, cv, pos, block_k=16)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    with pytest.raises(ValueError, match="pos must be scalar"):
+        decode_attention(q, ck, cv, jnp.zeros((2,), jnp.int32))

@@ -293,3 +293,103 @@ def test_safetensors_engine_buffered_fs_roundtrip():
                 np.testing.assert_array_equal(got, ref.reshape(t["shape"]))
     finally:
         shutil.rmtree(d, ignore_errors=True)
+
+
+class TestNpy:
+    """npy/npz planning: payload spans exact, device arrays bit-match."""
+
+    def test_npy_roundtrip_dtypes(self, tmp_path):
+        from nvme_strom_tpu.formats.npy import (plan_npy,
+                                                read_npy_to_device)
+        from nvme_strom_tpu.io.engine import StromEngine
+        rng = np.random.default_rng(0)
+        arrays = {
+            "f32": rng.standard_normal((33, 7)).astype(np.float32),
+            "i32": rng.integers(-2**30, 2**30, (5, 4, 3)).astype(np.int32),
+            "u8": rng.integers(0, 255, 1000, dtype=np.uint8),
+            "scalar0d": np.ones((), np.float32) * np.float32(3.5),
+        }
+        with StromEngine() as eng:
+            for name, arr in arrays.items():
+                p = str(tmp_path / f"{name}.npy")
+                np.save(p, arr)
+                entry = plan_npy(p)
+                assert entry.length == arr.nbytes
+                assert tuple(entry.shape) == arr.shape
+                got = np.asarray(read_npy_to_device(eng, p))
+                np.testing.assert_array_equal(got, arr)
+            # 8-byte dtypes refuse without x64 (bitcast would truncate);
+            # planning still answers
+            p64 = str(tmp_path / "i64.npy")
+            np.save(p64, rng.integers(-2**40, 2**40, (6,)))
+            assert plan_npy(p64).length == 48
+            with pytest.raises(ValueError, match="x64"):
+                read_npy_to_device(eng, p64)
+
+    def test_npy_rejects_fortran_and_object(self, tmp_path):
+        from nvme_strom_tpu.formats.npy import plan_npy
+        f = np.asfortranarray(np.arange(12.0).reshape(3, 4))
+        pf = str(tmp_path / "f.npy")
+        np.save(pf, f)
+        with pytest.raises(ValueError, match="fortran"):
+            plan_npy(pf)
+        po = str(tmp_path / "o.npy")
+        np.save(po, np.array([{"a": 1}], dtype=object),
+                allow_pickle=True)
+        with pytest.raises(ValueError, match="object"):
+            plan_npy(po)
+
+    def test_npz_members_to_device(self, tmp_path):
+        from nvme_strom_tpu.formats.npy import plan_npz, read_npz_to_device
+        from nvme_strom_tpu.io.engine import StromEngine
+        rng = np.random.default_rng(1)
+        a = rng.standard_normal((16, 16)).astype(np.float32)
+        b = rng.integers(0, 99, 64, dtype=np.int32)
+        p = str(tmp_path / "pack.npz")
+        np.savez(p, weights=a, ids=b)
+        plan = plan_npz(p)
+        assert {e.key for e in plan.entries} == {"weights", "ids"}
+        with StromEngine() as eng:
+            out = read_npz_to_device(eng, p)
+            np.testing.assert_array_equal(np.asarray(out["weights"]), a)
+            np.testing.assert_array_equal(np.asarray(out["ids"]), b)
+            only = read_npz_to_device(eng, p, keys=["ids"])
+            assert set(only) == {"ids"}
+
+    def test_npz_rejects_compressed(self, tmp_path):
+        from nvme_strom_tpu.formats.npy import plan_npz
+        p = str(tmp_path / "c.npz")
+        np.savez_compressed(p, x=np.arange(1000.0))
+        with pytest.raises(ValueError, match="compressed"):
+            plan_npz(p)
+
+    def test_npy_rejects_big_endian_and_structured(self, tmp_path):
+        from nvme_strom_tpu.formats.npy import plan_npy
+        pb = str(tmp_path / "be.npy")
+        np.save(pb, np.arange(10, dtype=np.float32).astype(">f4"))
+        with pytest.raises(ValueError, match="big-endian"):
+            plan_npy(pb)
+        ps = str(tmp_path / "rec.npy")
+        np.save(ps, np.zeros(4, dtype=[("a", "<i4"), ("b", "<f4")]))
+        with pytest.raises(ValueError, match="structured"):
+            plan_npy(ps)
+
+    def test_npy_header_larger_than_window(self, tmp_path):
+        """Huge-descr headers (> 4 KiB) re-read with the right size."""
+        import struct
+        from nvme_strom_tpu.formats.npy import plan_npy
+        arr = np.zeros((2, 3), np.float32)
+        p = str(tmp_path / "bighdr.npy")
+        np.save(p, arr)
+        raw = open(p, "rb").read()
+        # rebuild with a v1 header padded to 8 KiB of trailing spaces
+        hdr_end = 10 + struct.unpack_from("<H", raw, 8)[0]
+        header = raw[10:hdr_end].rstrip(b"\n").rstrip()
+        pad = 8192 - (10 + len(header) + 1)
+        big = (raw[:8] + struct.pack("<H", len(header) + pad + 1)
+               + header + b" " * pad + b"\n" + raw[hdr_end:])
+        open(p, "wb").write(big)
+        np.testing.assert_array_equal(np.load(p), arr)  # still valid
+        entry = plan_npy(p)
+        assert entry.offset == 8192       # 10-byte preamble + 8182 header
+        assert entry.length == arr.nbytes

@@ -102,3 +102,21 @@ def test_validation(setup):
     srv.submit("dup", [1, 2], 4)
     with pytest.raises(ValueError, match="already in flight"):
         srv.submit("dup", [3, 4], 4)
+
+
+def test_serving_with_pallas_kernel_matches_dense(setup):
+    """cache_attn=make_decode_attn() (per-row-pos Pallas kernel, run in
+    the interpreter on CPU) produces the same tokens as the dense step."""
+    from nvme_strom_tpu.ops.decode_attention import make_decode_attn
+    cfg, params = setup
+    rng = np.random.default_rng(5)
+    reqs = {f"p{i}": (rng.integers(0, cfg.vocab, 4 + 3 * i).tolist(), 5)
+            for i in range(3)}
+    outs = {}
+    for attn in (None, make_decode_attn(block_k=16)):
+        srv = DecodeServer(params, cfg, max_batch=3, max_len=32,
+                           cache_attn=attn)
+        for rid, (p, m) in reqs.items():
+            srv.submit(rid, p, m)
+        outs[attn is None] = srv.run()
+    assert outs[True] == outs[False]
