@@ -98,9 +98,58 @@ def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
     return out
 
 
+def _range_mask(cols, where_ranges, where):
+    """AND of the exact range predicates (pruning is only a coarse
+    superset) and the user's ``where`` — on device, like every mask."""
+    m = None
+    for c, lo, hi in where_ranges:
+        x = cols[c]
+        mm = jnp.ones(x.shape, bool)
+        if lo is not None:
+            mm = mm & (x >= lo)
+        if hi is not None:
+            mm = mm & (x <= hi)
+        m = mm if m is None else m & mm
+    if where is not None:
+        w = where(cols)
+        m = w if m is None else m & w
+    return m
+
+
+def _norm_aggs(aggs) -> tuple:
+    """The foldable-aggregate set behind any requested aggs (mean folds
+    from sum/count at the end) — one rule for every fold producer."""
+    return tuple(sorted((set(aggs) | {"count", "sum"}) - {"mean"}))
+
+
+def _validate_query(aggs, method) -> None:
+    """Same aggregate/method validation groupby_aggregate performs —
+    applied at query entry so a typo errors regardless of whether any
+    row group survives pruning."""
+    for a in aggs:
+        if a not in _AGGS:
+            raise ValueError(f"unknown aggregate {a!r}")
+    if method not in ("matmul", "scatter"):
+        raise ValueError(f"unknown method {method!r}")
+
+
+def _zero_folds(num_groups: int, aggs) -> Dict[str, jax.Array]:
+    """Foldable identities for a scan with zero surviving row groups."""
+    aggs_norm = _norm_aggs(aggs)
+    f: Dict[str, jax.Array] = {
+        "count": jnp.zeros((num_groups,), jnp.int32),
+        "sum": jnp.zeros((num_groups,), jnp.float32)}
+    if "min" in aggs_norm:
+        f["min"] = jnp.full((num_groups,), jnp.inf, jnp.float32)
+    if "max" in aggs_norm:
+        f["max"] = jnp.full((num_groups,), -jnp.inf, jnp.float32)
+    return f
+
+
 def iter_device_columns(scanner, columns: Sequence[str], dev,
                         require_int: Sequence[str] = (),
-                        narrow_int32: Sequence[str] = ()):
+                        narrow_int32: Sequence[str] = (),
+                        row_groups=None):
     """Stream a scanner's row groups as {name: device array} dicts.
 
     One policy for every on-device SQL consumer (groupby, join): the
@@ -126,7 +175,8 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
             plans = None
     if plans is not None:
         for cols in pq_direct.iter_plain_row_groups_to_device(
-                scanner, columns, device=dev, plans=plans):
+                scanner, columns, device=dev, plans=plans,
+                row_groups=row_groups):
             for c in require_int:
                 if not jnp.issubdtype(cols[c].dtype, jnp.integer):
                     raise TypeError(f"key column {c} must be integer")
@@ -134,7 +184,8 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
                 cols[c] = cols[c].astype(jnp.int32)
             yield cols
         return
-    for tbl in scanner.iter_row_groups(list(columns)):
+    for tbl in scanner.iter_row_groups(list(columns),
+                                       row_groups=row_groups):
         host = {c: tbl.column(c).to_numpy(zero_copy_only=False)
                 for c in columns}
         for c in require_int:
@@ -199,7 +250,8 @@ def sql_groupby(scanner, key_column: str, value_column: str,
                 num_groups: int, aggs: Sequence[str] = ("count", "sum",
                                                         "mean"),
                 method: str = "matmul", device=None,
-                where=None, where_columns: Sequence[str] = ()
+                where=None, where_columns: Sequence[str] = (),
+                where_ranges: Sequence[tuple] = ()
                 ) -> Dict[str, jax.Array]:
     """End-to-end config-5 query:
 
@@ -214,17 +266,32 @@ def sql_groupby(scanner, key_column: str, value_column: str,
     ``where_columns`` — the filter runs ON DEVICE (PG-Strom pushes its
     WHERE clause into the GPU scan the same way, SURVEY.md §3.5); only
     surviving rows aggregate, only per-group results return to host.
+
+    ``where_ranges``: (column, lo, hi) range predicates (None =
+    unbounded) that ADDITIONALLY prune whole row groups via footer
+    statistics before any payload I/O — chunks the stats provably
+    exclude never leave the SSD — then apply exactly on device.
     """
+    _validate_query(aggs, method)
+    where_ranges = list(where_ranges)   # a generator must not exhaust
     dev = device or jax.local_devices()[0]
+    range_cols = [c for c, _, _ in where_ranges]
     cols_needed = list(dict.fromkeys(
-        [key_column, value_column, *where_columns]))
+        [key_column, value_column, *where_columns, *range_cols]))
+    rgs = (scanner.prune_row_groups(where_ranges) if where_ranges
+           else None)
+    full_where = ((lambda cols: _range_mask(cols, where_ranges, where))
+                  if (where_ranges or where is not None) else None)
+    if rgs is not None and not rgs:    # statistics excluded everything
+        return finalize_folds(_zero_folds(num_groups, aggs), aggs)
 
     def stream():
         for cols in iter_device_columns(scanner, cols_needed, dev,
-                                        narrow_int32=(key_column,)):
+                                        narrow_int32=(key_column,),
+                                        row_groups=rgs):
             yield cols[key_column], cols[value_column], cols
 
-    return _stream_fold(stream(), num_groups, aggs, method, where)
+    return _stream_fold(stream(), num_groups, aggs, method, full_where)
 
 
 def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
@@ -240,7 +307,7 @@ def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
         mask = where(cols) if where is not None else None
         part = groupby_aggregate(
             keys, values, num_groups,
-            aggs=tuple(sorted((set(aggs) | {"count", "sum"}) - {"mean"})),
+            aggs=_norm_aggs(aggs),
             method=method, mask=mask, empty_as_nan=False)  # keep foldable
         folds = part if folds is None else _fold(folds, part)
     if folds is None:
@@ -251,7 +318,8 @@ def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
 def sql_groupby_str(scanner, key_column: str, value_column: str,
                     aggs: Sequence[str] = ("count", "sum", "mean"),
                     method: str = "matmul", device=None,
-                    where=None, where_columns: Sequence[str] = ()
+                    where=None, where_columns: Sequence[str] = (),
+                    where_ranges: Sequence[tuple] = ()
                     ) -> Dict[str, object]:
     """GROUP BY over a dictionary-encoded STRING key, strings never on
     device:
@@ -268,27 +336,47 @@ def sql_groupby_str(scanner, key_column: str, value_column: str,
     ``where_columns`` column.
     """
     from nvme_strom_tpu.sql import pq_direct
+    _validate_query(aggs, method)
+    where_ranges = list(where_ranges)   # a generator must not exhaust
+    if any(c == key_column for c, _, _ in where_ranges):
+        raise ValueError(
+            f"range predicate on string key {key_column!r} would "
+            "compare dictionary codes, not labels — filter labels "
+            "host-side or use a numeric column")
     dev = device or jax.local_devices()[0]
+    rgs = (scanner.prune_row_groups(where_ranges) if where_ranges
+           else None)
     labels, iter_codes = pq_direct.read_dict_key_column(
-        scanner, key_column, device=dev)
+        scanner, key_column, device=dev, row_groups=rgs)
     num_groups = len(labels)
     if num_groups == 0:
         raise ValueError("empty dictionary (no rows?)")
     # the key column itself streams as codes, never as strings — even
     # if the caller lists it in where_columns
+    range_cols = [c for c, _, _ in where_ranges if c != key_column]
     cols_needed = [c for c in dict.fromkeys([value_column,
-                                             *where_columns])
+                                             *where_columns,
+                                             *range_cols])
                    if c != key_column]
+    full_where = ((lambda cols: _range_mask(cols, where_ranges, where))
+                  if (where_ranges or where is not None) else None)
+    if rgs is not None and not rgs:
+        out0: Dict[str, object] = dict(
+            finalize_folds(_zero_folds(num_groups, aggs), aggs))
+        out0["labels"] = labels
+        return out0
 
     def stream():
         for cols, codes in zip(
-                iter_device_columns(scanner, cols_needed, dev),
+                iter_device_columns(scanner, cols_needed, dev,
+                                    row_groups=rgs),
                 iter_codes()):
             cols[key_column] = codes
             yield codes, cols[value_column], cols
 
     out: Dict[str, object] = dict(_stream_fold(stream(), num_groups,
-                                               aggs, method, where))
+                                               aggs, method,
+                                               full_where))
     out["labels"] = labels
     return out
 

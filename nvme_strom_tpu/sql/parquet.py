@@ -143,19 +143,53 @@ class ParquetScanner:
                     meta={"row_group": rg, "column": name}))
         return ReadPlan(self.path, tuple(entries))
 
-    def iter_row_groups(self, columns: Optional[List[str]] = None):
-        """Yield pyarrow Tables, one per row group, decoded from
-        engine-served reads."""
+    def iter_row_groups(self, columns: Optional[List[str]] = None,
+                        row_groups: Optional[List[int]] = None):
+        """Yield pyarrow Tables, one per (selected) row group, decoded
+        from engine-served reads."""
         import pyarrow.parquet as pq
         f = EngineFile(self.engine, self.path)
         try:
             # Reuse the already-parsed footer so metadata I/O stays
             # buffered-side and never pollutes the payload counters.
             pf = pq.ParquetFile(f, metadata=self.metadata, pre_buffer=False)
-            for rg in range(pf.metadata.num_row_groups):
+            groups = (range(pf.metadata.num_row_groups)
+                      if row_groups is None else row_groups)
+            for rg in groups:
                 yield pf.read_row_group(rg, columns=columns)
         finally:
             f.close()
+
+    def prune_row_groups(self, ranges) -> List[int]:
+        """Row groups whose column statistics can satisfy every range.
+
+        ``ranges``: iterable of (column, lo, hi) with None = unbounded.
+        A row group survives unless some range PROVABLY excludes it
+        (stats present and [min, max] disjoint from [lo, hi]) — the
+        PG-Strom/Parquet scan-elimination move: entire chunks never
+        leave the SSD.  Callers still apply the exact predicate on
+        device; pruning is a correct-by-construction superset.
+        """
+        ranges = list(ranges)   # re-iterated per row group
+        name_to_ci = {self.metadata.schema.column(i).name: i
+                      for i in range(self.metadata.num_columns)}
+        keep: List[int] = []
+        for rg in range(self.metadata.num_row_groups):
+            g = self.metadata.row_group(rg)
+            alive = True
+            for col, lo, hi in ranges:
+                if col not in name_to_ci:
+                    raise KeyError(f"column {col!r} not in schema")
+                st = g.column(name_to_ci[col]).statistics
+                if st is None or st.min is None or st.max is None:
+                    continue          # no stats → cannot exclude
+                if ((lo is not None and st.max < lo)
+                        or (hi is not None and st.min > hi)):
+                    alive = False
+                    break
+            if alive:
+                keep.append(rg)
+        return keep
 
     def direct_reasons(self, columns: List[str]) -> Dict[str, Optional[str]]:
         """Per column: None if EVERY row-group chunk can decode on device

@@ -804,13 +804,15 @@ def parse_byte_array_dict(buf: bytes, count: int) -> List[bytes]:
     return out
 
 
-def read_dict_key_column(scanner, column: str, device=None):
+def read_dict_key_column(scanner, column: str, device=None,
+                         row_groups=None):
     """Prepare a BYTE_ARRAY column for on-device GROUP BY by code.
 
     Returns ``(labels, iter_codes)``: ``labels`` is the GLOBAL label
-    list (union of every row group's dictionary, first-seen order;
-    bytes objects), ``iter_codes()`` yields one int32 device array of
-    global codes per row group.
+    list (union of EVERY row group's dictionary, first-seen order;
+    bytes objects — stable across pruned and unpruned queries),
+    ``iter_codes()`` yields one int32 device array of global codes per
+    row group in ``row_groups`` (default: all).
 
     Two-pass: dictionary pages are read first (through the engine,
     host-touched by design → counted as bounce) so the global label
@@ -860,11 +862,15 @@ def read_dict_key_column(scanner, column: str, device=None):
     finally:
         eng.close(fh)
 
+    selected = (range(len(chunks)) if row_groups is None
+                else list(row_groups))
+
     def iter_codes():
         import jax.numpy as jnp
         fh = eng.open(scanner.path)
         try:
-            for ch, remap_dev in zip(chunks, remaps):
+            for rg in selected:
+                ch, remap_dev = chunks[rg], remaps[rg]
                 idx = _decode_indices(eng, fh, ch.parts, ch.dict_count,
                                       dev)
                 # local code → global code, on device
@@ -876,11 +882,14 @@ def read_dict_key_column(scanner, column: str, device=None):
 
 
 def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
-                                    device=None, plans=None):
-    """Yield {name: device array} per row group — the incremental form
-    sql_groupby folds over, so device memory holds one row group of
-    columns at a time regardless of table size.  ``plans`` lets callers
-    reuse a prior :func:`plan_columns` walk."""
+                                    device=None, plans=None,
+                                    row_groups=None):
+    """Yield {name: device array} per (selected) row group — the
+    incremental form sql_groupby folds over, so device memory holds one
+    row group of columns at a time regardless of table size.  ``plans``
+    lets callers reuse a prior :func:`plan_columns` walk;
+    ``row_groups`` restricts to a pruned subset (statistics-based scan
+    elimination — skipped chunks never leave the SSD)."""
     import jax
     from nvme_strom_tpu.ops.bridge import DeviceStream
 
@@ -890,7 +899,9 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
                       depth=scanner.engine.config.queue_depth)
     fh = scanner.engine.open(scanner.path)
     try:
-        for rg in range(scanner.metadata.num_row_groups):
+        groups = (range(scanner.metadata.num_row_groups)
+                  if row_groups is None else row_groups)
+        for rg in groups:
             out = {}
             for c in columns:
                 plan = plans[c][rg]

@@ -282,3 +282,106 @@ def test_top_k_groups():
         top_k_groups(res, "mean", 2)
     with pytest.raises(ValueError):
         top_k_groups(res, "sum", 0)
+
+
+class TestRowGroupPruning:
+    """Statistics-based scan elimination: pruned chunks never read."""
+
+    def _sorted_file(self, tmp_path, engine, rows=40000, groups=16):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from nvme_strom_tpu.sql.parquet import ParquetScanner
+        rng = np.random.default_rng(50)
+        ts = np.sort(rng.integers(0, 10000, rows)).astype(np.int32)
+        k = rng.integers(0, groups, rows).astype(np.int32)
+        v = rng.standard_normal(rows).astype(np.float32)
+        path = str(tmp_path / "sorted.parquet")
+        pq.write_table(pa.table({"ts": pa.array(ts), "k": pa.array(k),
+                                 "v": pa.array(v)}),
+                       path, compression="none", use_dictionary=False,
+                       row_group_size=8192)
+        return ParquetScanner(path, engine), ts, k, v
+
+    def test_prune_row_groups_superset(self, tmp_path, engine):
+        sc, ts, k, v = self._sorted_file(tmp_path, engine)
+        keep = sc.prune_row_groups([("ts", 3000, 4000)])
+        assert 0 < len(keep) < sc.num_row_groups
+        # every row group holding in-range rows survives
+        per = 8192
+        for rg in range(sc.num_row_groups):
+            lo, hi = ts[rg * per], ts[min((rg + 1) * per, len(ts)) - 1]
+            if hi >= 3000 and lo <= 4000:
+                assert rg in keep
+
+    def test_groupby_with_range_matches_full_filter(self, tmp_path,
+                                                    engine):
+        from nvme_strom_tpu.sql.groupby import sql_groupby
+        sc, ts, k, v = self._sorted_file(tmp_path, engine)
+        out = sql_groupby(sc, "k", "v", 16, aggs=("count", "sum"),
+                          where_ranges=[("ts", 3000, 4000)])
+        sel = (ts >= 3000) & (ts <= 4000)
+        exp_count = np.bincount(k[sel], minlength=16)
+        exp_sum = np.bincount(k[sel], weights=v[sel].astype(np.float64),
+                              minlength=16)
+        np.testing.assert_array_equal(np.asarray(out["count"]),
+                                      exp_count)
+        np.testing.assert_allclose(np.asarray(out["sum"]), exp_sum,
+                                   rtol=2e-4)
+
+    def test_pruning_reads_fewer_bytes(self, tmp_path):
+        from nvme_strom_tpu.sql.groupby import sql_groupby
+        from nvme_strom_tpu.io.engine import StromEngine
+        from nvme_strom_tpu.utils.stats import StromStats
+
+        def run(ranges):
+            stats = StromStats()
+            with StromEngine(stats=stats) as eng:
+                sc, ts, k, v = self._sorted_file(tmp_path, eng)
+                sql_groupby(sc, "k", "v", 16, aggs=("count",),
+                            where_ranges=ranges)
+                eng.sync_stats()
+            return stats.bytes_direct + stats.bytes_fallback
+
+        full = run([])
+        pruned = run([("ts", 3000, 4000)])
+        assert pruned < full * 0.6, (pruned, full)
+
+    def test_fully_pruned_returns_empty_groups(self, tmp_path, engine):
+        from nvme_strom_tpu.sql.groupby import sql_groupby
+        sc, ts, k, v = self._sorted_file(tmp_path, engine)
+        out = sql_groupby(sc, "k", "v", 16,
+                          aggs=("count", "sum", "mean", "min"),
+                          where_ranges=[("ts", 50000, 60000)])
+        np.testing.assert_array_equal(np.asarray(out["count"]),
+                                      np.zeros(16, np.int32))
+        assert np.all(np.isnan(np.asarray(out["mean"])))
+        assert np.all(np.isnan(np.asarray(out["min"])))
+
+    def test_string_groupby_with_range(self, tmp_path, engine):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from nvme_strom_tpu.sql.groupby import sql_groupby_str
+        from nvme_strom_tpu.sql.parquet import ParquetScanner
+        rng = np.random.default_rng(51)
+        rows = 20000
+        ts = np.sort(rng.integers(0, 1000, rows)).astype(np.int32)
+        cities = ["ulm", "kyoto", "adelaide"]
+        ki = rng.integers(0, 3, rows)
+        v = rng.standard_normal(rows).astype(np.float32)
+        path = str(tmp_path / "strrange.parquet")
+        pq.write_table(pa.table({
+            "ts": pa.array(ts),
+            "city": pa.array([cities[i] for i in ki]),
+            "v": pa.array(v)}), path, compression="none",
+            use_dictionary=["city"], row_group_size=4096)
+        sc = ParquetScanner(path, engine)
+        out = sql_groupby_str(sc, "city", "v", aggs=("count",),
+                              where_ranges=[("ts", 200, 600)])
+        sel = (ts >= 200) & (ts <= 600)
+        want = {cities[i]: int(((ki == i) & sel).sum())
+                for i in range(3)}
+        for g, lab in enumerate(out["labels"]):
+            assert int(np.asarray(out["count"])[g]) == want[lab.decode()]
+        with pytest.raises(ValueError, match="string key"):
+            sql_groupby_str(sc, "city", "v",
+                            where_ranges=[("city", "a", "m")])
