@@ -404,6 +404,83 @@ class PagedKVCache:
                     f"{P} but {s} more positions requested")
             self._evict_one()
 
+    # -- session persistence ----------------------------------------------
+
+    def save_session(self, directory) -> None:
+        """Persist the session next to its page file: the HBM window
+        (through the engine's write path) + counters.  With the page
+        file (already on NVMe, flushed here) this is the WHOLE decode
+        state — a generation can suspend and resume in another process
+        (the inference analogue of checkpoint/resume, SURVEY.md §5)."""
+        import json
+        import os
+        from nvme_strom_tpu.ops.bridge import write_from_device
+        os.makedirs(directory, exist_ok=True)
+        self.flush()
+        for name, arr in (("k_win.bin", self.k_win),
+                          ("v_win.bin", self.v_win)):
+            path = os.path.join(directory, name)
+            # truncate first: the engine writer opens without O_TRUNC,
+            # and a smaller re-save over a reused directory would
+            # otherwise leave stale trailing bytes that break the load
+            open(path, "wb").close()
+            write_from_device(self.engine, arr, path)
+        meta = {"count": self.count, "n_cold": self.n_cold,
+                "batch": self.batch, "page_len": self.ocfg.page_len,
+                "window_pages": self.ocfg.window_pages,
+                "quantize": self.ocfg.quantize,
+                "page_file": os.path.abspath(self.ocfg.path),
+                # loud mismatch beats a silent same-itemsize bitcast
+                "dtype": jnp.dtype(self.cfg.dtype).name,
+                "window_shape": list(self.k_win.shape)}
+        tmp = os.path.join(directory, "session.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(directory, "session.json"))
+
+    @classmethod
+    def load_session(cls, cfg: TransformerConfig, engine: StromEngine,
+                     directory, device=None) -> "PagedKVCache":
+        """Rebuild a saved session: window streams back through the
+        engine, the page file reattaches in place."""
+        import json
+        import os
+        with open(os.path.join(directory, "session.json")) as f:
+            meta = json.load(f)
+        ocfg = OffloadConfig(path=meta["page_file"],
+                             page_len=meta["page_len"],
+                             window_pages=meta["window_pages"],
+                             quantize=meta["quantize"])
+        if meta.get("dtype") != jnp.dtype(cfg.dtype).name:
+            raise ValueError(
+                f"session saved with dtype {meta.get('dtype')}, "
+                f"cfg has {jnp.dtype(cfg.dtype).name} — a bitcast "
+                f"would silently corrupt the cache")
+        self = cls(cfg, ocfg, engine, meta["batch"], device=device)
+        try:
+            shape = self.k_win.shape
+            if list(shape) != meta.get("window_shape"):
+                raise ValueError(
+                    f"session window shape {meta.get('window_shape')} "
+                    f"does not match cfg's {list(shape)}")
+            # free the constructor's zero windows before streaming the
+            # saved ones — no transient double footprint
+            self.k_win = self.v_win = None
+            for attr, name in (("k_win", "k_win.bin"),
+                               ("v_win", "v_win.bin")):
+                arr = self._stream.read_to_device(
+                    os.path.join(directory, name),
+                    dtype=self.cfg.dtype, shape=shape)
+                setattr(self, attr, arr)
+            self.count = meta["count"]
+            self.n_cold = meta["n_cold"]
+        except BaseException:
+            self.close()     # don't leak the page-file engine handle
+            raise
+        return self
+
     # -- read tier --------------------------------------------------------
 
     def _iter_layer_pages(self, layer: int):

@@ -243,6 +243,56 @@ def test_int8_generate_runs_and_stays_greedy_consistent(cfg, engine,
     assert out.dtype == jnp.int32
 
 
+def test_session_save_resume_identical_continuation(cfg, tmp_path):
+    """A decode suspended mid-generation and resumed in a fresh engine
+    continues with exactly the tokens the uninterrupted run produces."""
+    params = init_params(jax.random.key(12), cfg)
+    prompt = jax.random.randint(jax.random.key(13), (2, 10), 0,
+                                cfg.vocab)
+    ocfg = OffloadConfig(path=str(tmp_path / "kv.bin"), page_len=4,
+                         window_pages=2)
+
+    def steps(cache, tok, n):
+        out = []
+        for _ in range(n):
+            logits = offload_decode_step(params, tok, cfg, cache)
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            out.append(np.asarray(tok))
+        return out, tok
+
+    # uninterrupted reference
+    with StromEngine() as eng:
+        dense = dec.init_cache(cfg, 2, 10)
+        logits, dense = dec.prefill(params, prompt, cfg, dense)
+        tok0 = jnp.argmax(logits, -1).astype(jnp.int32)
+        with PagedKVCache(cfg, ocfg, eng, 2) as cache:
+            cache.append(dense["k"], dense["v"])
+            want, _ = steps(cache, tok0, 10)
+
+    # interrupted run: 5 steps, save, new engine/process state, resume
+    ocfg2 = OffloadConfig(path=str(tmp_path / "kv2.bin"), page_len=4,
+                          window_pages=2)
+    sess = str(tmp_path / "sess")
+    with StromEngine() as eng:
+        dense = dec.init_cache(cfg, 2, 10)
+        logits, dense = dec.prefill(params, prompt, cfg, dense)
+        tok = jnp.argmax(logits, -1).astype(jnp.int32)
+        with PagedKVCache(cfg, ocfg2, eng, 2) as cache:
+            cache.append(dense["k"], dense["v"])
+            got_a, tok = steps(cache, tok, 5)
+            cache.save_session(sess)
+            saved_pos = cache.pos
+    with StromEngine() as eng:
+        cache = PagedKVCache.load_session(cfg, eng, sess)
+        try:
+            assert cache.pos == saved_pos
+            got_b, _ = steps(cache, tok, 5)
+        finally:
+            cache.close()
+    for w, g in zip(want, got_a + got_b):
+        np.testing.assert_array_equal(g, w)
+
+
 def test_offload_engine_accounting(cfg, tmp_path):
     """Evicted pages land in the backing file via engine writes (direct
     when alignment/fs allow, bounced otherwise — tiny test pages are
