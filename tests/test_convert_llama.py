@@ -212,6 +212,20 @@ def test_generate_example_cli(hf_checkpoint, tmp_path):
            .split(","))
     assert len(ids) == 8 and all(i.strip().isdigit() for i in ids)
 
+    # same checkpoint through the serving example: each request's ids
+    # match the solo run's prefix of the same length
+    rs = subprocess.run(
+        [_sys.executable, str(repo / "examples" / "serve.py"),
+         "--weights", str(tmp_path / "conv"), "--slots", "2",
+         "--request", "5,6,7:8", "--request", "9,1:5"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(repo))
+    assert rs.returncode == 0, rs.stderr[-2000:]
+    line = [ln for ln in rs.stdout.splitlines()
+            if ln.startswith("r0:")][0]
+    assert line.split(":", 1)[1].strip().split(",") == ids
+    assert "aggregate" in rs.stdout
+
     # same checkpoint through the SSD-backed cache: identical greedy ids
     r2 = subprocess.run(
         [_sys.executable, str(repo / "examples" / "generate.py"),
