@@ -133,17 +133,47 @@ def _validate_query(aggs, method) -> None:
         raise ValueError(f"unknown method {method!r}")
 
 
-def _zero_folds(num_groups: int, aggs) -> Dict[str, jax.Array]:
-    """Foldable identities for a scan with zero surviving row groups."""
+def _zero_folds(num_groups: int, aggs,
+                n_value_cols: int = 0) -> Dict[str, jax.Array]:
+    """Foldable identities for a scan with zero surviving row groups.
+    ``n_value_cols`` 0 = single (G,) values, else (G, C)."""
     aggs_norm = _norm_aggs(aggs)
+    vshape = ((num_groups,) if n_value_cols == 0
+              else (num_groups, n_value_cols))
     f: Dict[str, jax.Array] = {
         "count": jnp.zeros((num_groups,), jnp.int32),
-        "sum": jnp.zeros((num_groups,), jnp.float32)}
+        "sum": jnp.zeros(vshape, jnp.float32)}
     if "min" in aggs_norm:
-        f["min"] = jnp.full((num_groups,), jnp.inf, jnp.float32)
+        f["min"] = jnp.full(vshape, jnp.inf, jnp.float32)
     if "max" in aggs_norm:
-        f["max"] = jnp.full((num_groups,), -jnp.inf, jnp.float32)
+        f["max"] = jnp.full(vshape, -jnp.inf, jnp.float32)
     return f
+
+
+def _value_cols(value_column):
+    """value_column str | list | tuple → (list of names, single flag).
+
+    Only ORDERED containers: the (G, C) results key columns by
+    position, so a set's arbitrary order would silently misattribute
+    aggregates."""
+    if isinstance(value_column, str):
+        return [value_column], True
+    if not isinstance(value_column, (list, tuple)):
+        raise TypeError(
+            f"value_column must be a str, list or tuple (ordered — "
+            f"results are positional), got {type(value_column).__name__}")
+    vcols = list(value_column)
+    if not vcols:
+        raise ValueError("value_column list must not be empty")
+    return vcols, False
+
+
+def _stack_values(cols, vcols, single):
+    """Materialize the value block for one row group: (N,) for a single
+    column, (N, C) stacked in the caller's order otherwise."""
+    if single:
+        return cols[vcols[0]]
+    return jnp.stack([cols[c] for c in vcols], axis=1)
 
 
 def iter_device_columns(scanner, columns: Sequence[str], dev,
@@ -200,22 +230,30 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
 def finalize_folds(folds: Dict[str, jax.Array],
                    aggs: Sequence[str]) -> Dict[str, jax.Array]:
     """Foldable partials (count/sum/min/max with raw identities) → the
-    requested aggregates, with SQL-NULL-like NaN for empty groups."""
+    requested aggregates, with SQL-NULL-like NaN for empty groups.
+    Value partials may be (G,) or (G, C) (multi-column aggregates);
+    count is always (G,) and broadcasts up."""
     out: Dict[str, jax.Array] = {}
     count = folds["count"]
+
+    def up(x, like):
+        return x[:, None] if like.ndim == 2 else x
+
     if "count" in aggs:
         out["count"] = count
     if "sum" in aggs:
         out["sum"] = folds["sum"]
     if "mean" in aggs:
         cf = count.astype(jnp.float32)
-        mean = folds["sum"] / jnp.maximum(cf, 1.0)
-        out["mean"] = jnp.where(cf > 0, mean, jnp.nan)
+        mean = folds["sum"] / jnp.maximum(up(cf, folds["sum"]), 1.0)
+        out["mean"] = jnp.where(up(cf, mean) > 0, mean, jnp.nan)
     empty = count == 0
     if "min" in aggs:
-        out["min"] = jnp.where(empty, jnp.nan, folds["min"])
+        out["min"] = jnp.where(up(empty, folds["min"]), jnp.nan,
+                               folds["min"])
     if "max" in aggs:
-        out["max"] = jnp.where(empty, jnp.nan, folds["max"])
+        out["max"] = jnp.where(up(empty, folds["max"]), jnp.nan,
+                               folds["max"])
     return out
 
 
@@ -246,7 +284,7 @@ def top_k_groups(result: Dict[str, jax.Array], by: str, k: int,
     return _rank_top_k(result, by=by, k=k, descending=descending)
 
 
-def sql_groupby(scanner, key_column: str, value_column: str,
+def sql_groupby(scanner, key_column: str, value_column,
                 num_groups: int, aggs: Sequence[str] = ("count", "sum",
                                                         "mean"),
                 method: str = "matmul", device=None,
@@ -271,25 +309,33 @@ def sql_groupby(scanner, key_column: str, value_column: str,
     unbounded) that ADDITIONALLY prune whole row groups via footer
     statistics before any payload I/O — chunks the stats provably
     exclude never leave the SSD — then apply exactly on device.
+
+    ``value_column`` may be a LIST of columns: one scan aggregates all
+    of them (``SELECT k, SUM(v1), SUM(v2) ...``) and each value-agg
+    result is (num_groups, n_columns) in the given order.
     """
     _validate_query(aggs, method)
     where_ranges = list(where_ranges)   # a generator must not exhaust
+    vcols, single = _value_cols(value_column)
     dev = device or jax.local_devices()[0]
     range_cols = [c for c, _, _ in where_ranges]
     cols_needed = list(dict.fromkeys(
-        [key_column, value_column, *where_columns, *range_cols]))
+        [key_column, *vcols, *where_columns, *range_cols]))
     rgs = (scanner.prune_row_groups(where_ranges) if where_ranges
            else None)
     full_where = ((lambda cols: _range_mask(cols, where_ranges, where))
                   if (where_ranges or where is not None) else None)
     if rgs is not None and not rgs:    # statistics excluded everything
-        return finalize_folds(_zero_folds(num_groups, aggs), aggs)
+        return finalize_folds(
+            _zero_folds(num_groups, aggs,
+                        0 if single else len(vcols)), aggs)
 
     def stream():
         for cols in iter_device_columns(scanner, cols_needed, dev,
                                         narrow_int32=(key_column,),
                                         row_groups=rgs):
-            yield cols[key_column], cols[value_column], cols
+            yield (cols[key_column],
+                   _stack_values(cols, vcols, single), cols)
 
     return _stream_fold(stream(), num_groups, aggs, method, full_where)
 
@@ -315,7 +361,7 @@ def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
     return finalize_folds(folds, aggs)
 
 
-def sql_groupby_str(scanner, key_column: str, value_column: str,
+def sql_groupby_str(scanner, key_column: str, value_column,
                     aggs: Sequence[str] = ("count", "sum", "mean"),
                     method: str = "matmul", device=None,
                     where=None, where_columns: Sequence[str] = (),
@@ -333,7 +379,9 @@ def sql_groupby_str(scanner, key_column: str, value_column: str,
     ``labels[g]`` (bytes) names group ``g`` — alongside the aggregate
     arrays, whose length is the global label count.  ``where``
     predicates receive the key column as its global CODES plus every
-    ``where_columns`` column.
+    ``where_columns`` column.  ``value_column`` may be a list/tuple of
+    columns — each value-agg result is then (num_groups, n_columns) in
+    the given order.
     """
     from nvme_strom_tpu.sql import pq_direct
     _validate_query(aggs, method)
@@ -346,6 +394,7 @@ def sql_groupby_str(scanner, key_column: str, value_column: str,
     dev = device or jax.local_devices()[0]
     rgs = (scanner.prune_row_groups(where_ranges) if where_ranges
            else None)
+    vcols, single = _value_cols(value_column)
     labels, iter_codes = pq_direct.read_dict_key_column(
         scanner, key_column, device=dev, row_groups=rgs)
     num_groups = len(labels)
@@ -354,15 +403,16 @@ def sql_groupby_str(scanner, key_column: str, value_column: str,
     # the key column itself streams as codes, never as strings — even
     # if the caller lists it in where_columns
     range_cols = [c for c, _, _ in where_ranges if c != key_column]
-    cols_needed = [c for c in dict.fromkeys([value_column,
-                                             *where_columns,
+    cols_needed = [c for c in dict.fromkeys([*vcols, *where_columns,
                                              *range_cols])
                    if c != key_column]
     full_where = ((lambda cols: _range_mask(cols, where_ranges, where))
                   if (where_ranges or where is not None) else None)
     if rgs is not None and not rgs:
         out0: Dict[str, object] = dict(
-            finalize_folds(_zero_folds(num_groups, aggs), aggs))
+            finalize_folds(_zero_folds(num_groups, aggs,
+                                       0 if single else len(vcols)),
+                           aggs))
         out0["labels"] = labels
         return out0
 
@@ -372,7 +422,7 @@ def sql_groupby_str(scanner, key_column: str, value_column: str,
                                     row_groups=rgs),
                 iter_codes()):
             cols[key_column] = codes
-            yield codes, cols[value_column], cols
+            yield codes, _stack_values(cols, vcols, single), cols
 
     out: Dict[str, object] = dict(_stream_fold(stream(), num_groups,
                                                aggs, method,

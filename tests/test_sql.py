@@ -385,3 +385,42 @@ class TestRowGroupPruning:
         with pytest.raises(ValueError, match="string key"):
             sql_groupby_str(sc, "city", "v",
                             where_ranges=[("city", "a", "m")])
+
+
+def test_multi_value_column_groupby(tmp_path, engine):
+    """SELECT k, AGG(v1), AGG(v2) in one scan: (G, C) results in
+    column order, with mean/min NaN semantics intact per column."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    from nvme_strom_tpu.sql.parquet import ParquetScanner
+    rng = np.random.default_rng(60)
+    rows, groups = 20000, 8
+    k = rng.integers(0, groups - 1, rows).astype(np.int32)  # group 7 empty
+    v1 = rng.standard_normal(rows).astype(np.float32)
+    v2 = rng.integers(0, 100, rows).astype(np.float32)
+    path = str(tmp_path / "mv.parquet")
+    pq.write_table(pa.table({"k": pa.array(k), "v1": pa.array(v1),
+                             "v2": pa.array(v2)}), path,
+                   compression="none", use_dictionary=False,
+                   row_group_size=8192)
+    sc = ParquetScanner(path, engine)
+    out = sql_groupby(sc, "k", ["v1", "v2"], groups,
+                      aggs=("count", "sum", "mean", "min"))
+    assert np.asarray(out["sum"]).shape == (groups, 2)
+    for ci, v in enumerate((v1, v2)):
+        exp_sum = np.bincount(k, weights=v.astype(np.float64),
+                              minlength=groups)
+        np.testing.assert_allclose(np.asarray(out["sum"])[:, ci],
+                                   exp_sum, rtol=2e-4)
+        for g in range(groups - 1):
+            np.testing.assert_allclose(
+                np.asarray(out["min"])[g, ci], v[k == g].min(),
+                rtol=1e-5)
+    assert np.all(np.isnan(np.asarray(out["mean"])[groups - 1]))
+    # fully-pruned multi-column shape survives too
+    out0 = sql_groupby(sc, "k", ["v1", "v2"], groups,
+                       aggs=("count", "sum"),
+                       where_ranges=[("v2", 1000, 2000)])
+    assert np.asarray(out0["sum"]).shape == (groups, 2)
+    assert int(np.asarray(out0["count"]).sum()) == 0
