@@ -27,6 +27,7 @@
 #include "strom_io.h"
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstring>
 #include <deque>
@@ -1019,12 +1020,7 @@ int64_t strom_submit_read(strom_engine *e, int fh, uint64_t offset,
   return r->id;
 }
 
-int strom_wait(strom_engine *e, int64_t req_id, strom_completion *out) {
-  std::unique_lock<std::mutex> lk(e->mu);
-  auto it = e->reqs.find(req_id);
-  if (it == e->reqs.end()) return -ENOENT;
-  Req *r = it->second;
-  e->cv_done.wait(lk, [&] { return r->state == ReqState::kDone; });
+static int fill_completion(Req *r, strom_completion *out) {
   if (out) {
     out->data = r->is_write ? nullptr
                             : r->buf + (r->offset - r->a_off);
@@ -1035,6 +1031,32 @@ int strom_wait(strom_engine *e, int64_t req_id, strom_completion *out) {
     out->complete_ns = r->t_complete;
   }
   return r->status;
+}
+
+int strom_wait(strom_engine *e, int64_t req_id, strom_completion *out) {
+  std::unique_lock<std::mutex> lk(e->mu);
+  auto it = e->reqs.find(req_id);
+  if (it == e->reqs.end()) return -ENOENT;
+  Req *r = it->second;
+  e->cv_done.wait(lk, [&] { return r->state == ReqState::kDone; });
+  return fill_completion(r, out);
+}
+
+int strom_wait_timeout(strom_engine *e, int64_t req_id,
+                       strom_completion *out, uint64_t timeout_ns) {
+  /* Hang DETECTION (SURVEY.md §5 failure detection): a stalled device
+   * or wedged backend turns into -ETIMEDOUT the caller can act on
+   * (diagnose, rescue, abort) instead of blocking forever.  The
+   * request stays live — a timed-out wait may be retried. */
+  std::unique_lock<std::mutex> lk(e->mu);
+  auto it = e->reqs.find(req_id);
+  if (it == e->reqs.end()) return -ENOENT;
+  Req *r = it->second;
+  bool done = e->cv_done.wait_for(
+      lk, std::chrono::nanoseconds(timeout_ns),
+      [&] { return r->state == ReqState::kDone; });
+  if (!done) return -ETIMEDOUT;
+  return fill_completion(r, out);
 }
 
 int strom_release(strom_engine *e, int64_t req_id) {

@@ -180,6 +180,12 @@ int64_t strom_submit_read(strom_engine *eng, int fh, uint64_t offset,
  * request until strom_release. */
 int strom_wait(strom_engine *eng, int64_t req_id, strom_completion *out);
 
+/* Bounded wait: -ETIMEDOUT after timeout_ns if the request has not
+ * completed (request stays live; retry or diagnose — the failure-
+ * DETECTION half of the recovery story). */
+int strom_wait_timeout(strom_engine *eng, int64_t req_id,
+                       strom_completion *out, uint64_t timeout_ns);
+
 /* Return the request's staging buffer to the pool. */
 int strom_release(strom_engine *eng, int64_t req_id);
 

@@ -150,6 +150,10 @@ def _load_lib() -> ctypes.CDLL:
                                            ctypes.c_uint64]
         lib.strom_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                    ctypes.POINTER(_Completion)]
+        lib.strom_wait_timeout.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_int64,
+                                           ctypes.POINTER(_Completion),
+                                           ctypes.c_uint64]
         lib.strom_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.strom_get_stats.argtypes = [ctypes.c_void_p,
                                         ctypes.POINTER(_StatsBlk)]
@@ -271,12 +275,20 @@ class PendingRead:
         self._view: Optional[np.ndarray] = None
         self.was_fallback = False
 
-    def wait(self) -> np.ndarray:
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for the completed staging view.
+
+        ``timeout`` (seconds): bounded wait — raises TimeoutError if
+        the request is still in flight after the deadline, WITHOUT
+        releasing it (hang detection: the caller can diagnose, retry
+        the wait, or ``release()`` to abort; the buffer stays a live
+        DMA target until then).
+        """
         if self._view is not None:
             return self._view
         comp = _Completion()
-        rc = self._engine._lib.strom_wait(self._engine._h, self._req_id,
-                                          ctypes.byref(comp))
+        rc = _wait_for_completion(self._engine, self._req_id, comp,
+                                  timeout, "read")
         if rc < 0:
             self.release()
             raise OSError(-rc, os.strerror(-rc))
@@ -313,19 +325,58 @@ class PendingRead:
         self.release()
 
 
+def _wait_for_completion(engine: "StromEngine", req_id: int,
+                         comp, timeout: Optional[float],
+                         what: str) -> int:
+    """strom_wait / strom_wait_timeout dispatch shared by reads and
+    writes.  Raises TimeoutError with the request STILL LIVE (retry the
+    wait or release() to abort)."""
+    if timeout is None:
+        return engine._lib.strom_wait(engine._h, req_id,
+                                      ctypes.byref(comp))
+    if timeout < 0:
+        raise ValueError(f"timeout must be >= 0, got {timeout}")
+    # cap at chrono's int64 nanoseconds — anything longer is forever
+    ns = min(int(timeout * 1e9), (1 << 63) - 1)
+    rc = engine._lib.strom_wait_timeout(engine._h, req_id,
+                                        ctypes.byref(comp), ns)
+    if rc == -errno.ETIMEDOUT:
+        raise TimeoutError(f"{what} {req_id} still in flight after "
+                           f"{timeout}s")
+    return rc
+
+
 class PendingWrite:
     def __init__(self, engine: "StromEngine", req_id: int,
                  keepalive: Optional[np.ndarray]):
         self._engine = engine
         self._req_id = req_id
         self._keepalive = keepalive  # zero-copy source must outlive the I/O
+        self._released = False
 
-    def wait(self) -> int:
+    def release(self) -> None:
+        """Abort/free path (e.g. after a wait timeout): blocks until
+        the write is out of flight, then frees the request — the
+        source buffer and any bounce staging return to the pool."""
+        if self._released:
+            return
+        rc = self._engine._lib.strom_release(self._engine._h,
+                                             self._req_id)
+        if rc == -errno.EBUSY:
+            self._engine._lib.strom_wait(self._engine._h, self._req_id,
+                                         None)
+            self._engine._lib.strom_release(self._engine._h,
+                                            self._req_id)
+        self._released = True
+        self._keepalive = None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
         comp = _Completion()
-        rc = self._engine._lib.strom_wait(self._engine._h, self._req_id,
-                                          ctypes.byref(comp))
+        rc = _wait_for_completion(self._engine, self._req_id, comp,
+                                  timeout, "write")
         n = int(comp.len)
         self._engine._lib.strom_release(self._engine._h, self._req_id)
+        self._released = True
         self._keepalive = None
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))

@@ -426,3 +426,35 @@ def test_concurrent_streams_one_engine(engine, tmp_path):
     engine.sync_stats()
     assert engine.stats.requests_failed == 0
     assert engine.stats.total_payload_bytes >= n_streams * per
+
+
+def test_wait_timeout_detects_stalled_request(tmp_path):
+    """Bounded wait (failure DETECTION): a request that cannot start —
+    staging pool exhausted by unreleased peers — times out with the
+    request still live, and completes once buffers free."""
+    from nvme_strom_tpu.utils.config import EngineConfig
+    path = str(tmp_path / "t.bin")
+    data = np.random.default_rng(0).integers(
+        0, 255, 64 << 10, dtype=np.uint8)
+    with open(path, "wb") as f:
+        f.write(data.tobytes())
+    # pool of exactly 2 staging buffers
+    cfg = EngineConfig(chunk_bytes=16 << 10, queue_depth=2,
+                       buffer_pool_bytes=32 << 10)
+    with StromEngine(cfg) as eng:
+        fh = eng.open(path)
+        hold = [eng.submit_read(fh, 0, 16 << 10),
+                eng.submit_read(fh, 16 << 10, 16 << 10)]
+        for p in hold:
+            p.wait()          # both buffers now owned and NOT released
+        starved = eng.submit_read(fh, 32 << 10, 16 << 10)
+        with pytest.raises(TimeoutError, match="in flight"):
+            starved.wait(timeout=0.25)
+        # request stayed live: freeing a buffer lets it finish
+        hold[0].release()
+        view = starved.wait(timeout=10.0)
+        np.testing.assert_array_equal(
+            np.asarray(view), data[32 << 10:48 << 10])
+        starved.release()
+        hold[1].release()
+        eng.close(fh)
