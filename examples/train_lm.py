@@ -55,6 +55,11 @@ def main(argv=None) -> int:
     ap.add_argument("--accum-steps", type=int, default=1,
                     help="gradient-accumulation microbatches per step "
                          "(activation memory of global-batch/N)")
+    ap.add_argument("--watchdog", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="per-step deadline: a hung step dumps all "
+                         "thread stacks + engine counters to stderr "
+                         "(0 = off)")
     ap.add_argument("--tiny", action="store_true",
                     help="tiny config (CI/demo) instead of the flagship")
     ap.add_argument("--save-every", type=int, default=10)
@@ -228,31 +233,42 @@ def main(argv=None) -> int:
                     f"shards under {data_dir} yield zero full batches of "
                     f"{args.global_batch}")
 
+    from contextlib import nullcontext
     from nvme_strom_tpu.data.prefetch import prefetch_to_device
+    from nvme_strom_tpu.utils.watchdog import StepWatchdog
     it = prefetch_to_device(batches(), size=2)
+    wd = (StepWatchdog(args.watchdog, engine=engine)
+          if args.watchdog > 0 else None)
     t0 = time.monotonic()
     loss = None
     for step in range(start, args.steps):
-        tokens = next(it)
-        trainable, opt_state, loss = step_fn(trainable, opt_state, tokens)
-        if (step + 1) % args.save_every == 0 or step + 1 == args.steps:
-            jax.block_until_ready(loss)
-            if jax.process_count() == 1:
-                # snapshot now (donation-safe numpy copies), NVMe write
-                # overlaps the next steps; errors surface at the next
-                # save/restore/wait
-                mgr.save_async(step + 1, (trainable, opt_state))
-            else:
-                mgr.save(step + 1, (trainable, opt_state))
-            print(f"step {step + 1}: loss={float(loss):.4f} "
-                  f"(checkpointed)")
-        elif (step + 1) % 5 == 0:
-            print(f"step {step + 1}: loss={float(loss):.4f}")
+        # the armed region covers the HOST SYNC POINTS too
+        # (block_until_ready/float(loss)/save) — async dispatch means a
+        # wedged collective usually hangs there, not in step_fn
+        with wd.step(f"step {step}") if wd else nullcontext():
+            tokens = next(it)
+            trainable, opt_state, loss = step_fn(trainable, opt_state,
+                                                 tokens)
+            if (step + 1) % args.save_every == 0 or step + 1 == args.steps:
+                jax.block_until_ready(loss)
+                if jax.process_count() == 1:
+                    # snapshot now (donation-safe numpy copies), NVMe
+                    # write overlaps the next steps; errors surface at
+                    # the next save/restore/wait
+                    mgr.save_async(step + 1, (trainable, opt_state))
+                else:
+                    mgr.save(step + 1, (trainable, opt_state))
+                print(f"step {step + 1}: loss={float(loss):.4f} "
+                      f"(checkpointed)")
+            elif (step + 1) % 5 == 0:
+                print(f"step {step + 1}: loss={float(loss):.4f}")
     jax.block_until_ready(loss)
     dt = time.monotonic() - t0
     print(f"{args.steps - start} steps in {dt:.2f}s "
           f"({(args.steps - start) / max(dt, 1e-9):.2f} steps/s)")
 
+    if wd:
+        wd.close()
     it.close()  # drain the loader's prefetch thread BEFORE engine teardown
     mgr.wait_pending()  # last async save durable (or raising) before exit
     engine.sync_stats()

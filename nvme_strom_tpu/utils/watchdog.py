@@ -1,0 +1,166 @@
+"""Step watchdog: turn a silent training hang into a diagnosis.
+
+The failure-DETECTION half of the recovery story at the training level
+(SURVEY.md §5; the engine level is ``wait(timeout=...)``): long
+distributed jobs die silently — a wedged collective, a stalled input
+pipeline, a hung device — and the only symptom is a step that never
+returns.  The watchdog arms a deadline around each step from a daemon
+thread; if the deadline passes it dumps every Python thread's stack
+plus the engine's counters (the I/O tier is the usual suspect) to
+stderr, then either keeps waiting (default: diagnosis, not policy) or
+kills the process for the job scheduler to restart
+(``on_timeout="abort"``).
+
+    wd = StepWatchdog(deadline_s=120, engine=engine)
+    for batch in loader:
+        with wd.step():
+            params, opt_state, loss = train_step(params, ...)
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import io
+import os
+import sys
+import threading
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class StepWatchdog:
+    """Deadline monitor for an iterative loop.
+
+    ``deadline_s``: wall-clock budget per armed section.
+    ``on_timeout``: "report" (dump diagnostics, keep waiting — fires at
+    most ``max_reports`` times per section) or "abort" (dump, then
+    ``os._exit(124)`` so a supervisor restarts the job; Python-level
+    cleanup CANNOT run — the process is presumed wedged).
+    ``engine``: optional StromEngine whose counters join the dump.
+    """
+
+    def __init__(self, deadline_s: float, engine=None,
+                 on_timeout: str = "report", max_reports: int = 3,
+                 stream=None):
+        if deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
+        if on_timeout not in ("report", "abort"):
+            raise ValueError(f"on_timeout must be 'report' or 'abort', "
+                             f"got {on_timeout!r}")
+        self.deadline_s = deadline_s
+        self.engine = engine
+        self.on_timeout = on_timeout
+        self.max_reports = max_reports
+        self.stream = stream or sys.stderr
+        self.timeouts = 0                 # total deadline overruns seen
+        self._gen = 0                     # increments on arm/disarm
+        self._armed_at: Optional[float] = None
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="strom-watchdog")
+        self._thread.start()
+
+    # -- loop-facing API --------------------------------------------------
+
+    @contextmanager
+    def step(self, label: str = "step"):
+        """Arm the deadline for the enclosed block."""
+        with self._lock:
+            self._gen += 1
+            gen = self._gen
+            self._armed_at = time.monotonic()
+            self._started_at = self._armed_at   # survives re-arms
+            self._label = label
+            self._wake.notify()
+        try:
+            yield
+        finally:
+            with self._lock:
+                if self._gen == gen:
+                    self._armed_at = None
+                self._gen += 1
+                self._wake.notify()
+
+    def close(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._wake.notify()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- monitor side -----------------------------------------------------
+
+    def _run(self) -> None:
+        reports = 0
+        gen_seen = -1
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                if self._armed_at is None:
+                    self._wake.wait()
+                    continue
+                if self._gen != gen_seen:
+                    gen_seen = self._gen
+                    reports = 0
+                elapsed = time.monotonic() - self._armed_at
+                remain = self.deadline_s - elapsed
+                if remain > 0:
+                    self._wake.wait(timeout=remain)
+                    continue
+                label = self._label
+                self.timeouts += 1
+                self._armed_at = time.monotonic()   # re-arm for repeat
+                total = self._armed_at - self._started_at
+                reports += 1
+                do_report = reports <= self.max_reports
+            if do_report:
+                try:
+                    self._dump(label, total)
+                except Exception:        # diagnosis must never kill
+                    pass                 # the monitor itself
+            if self.on_timeout == "abort":
+                try:
+                    self.stream.flush()
+                except Exception:
+                    pass                 # a broken pipe must not
+                os._exit(124)            # prevent the kill
+
+    def _dump(self, label: str, total: float) -> None:
+        w = self.stream
+        print(f"\n=== strom watchdog: {label!r} exceeded "
+              f"{self.deadline_s:.1f}s (running {total:.1f}s total) ===",
+              file=w, flush=True)
+        try:
+            # fastest, signal-safe — but needs a real file descriptor
+            w.fileno()
+            faulthandler.dump_traceback(file=w)
+        except (AttributeError, OSError, ValueError, io.UnsupportedOperation):
+            import traceback
+            for tid, frame in sys._current_frames().items():
+                print(f"Thread {tid}:", file=w)
+                traceback.print_stack(frame, file=w)
+        eng = self.engine
+        if eng is not None:
+            try:
+                eng.sync_stats()
+                s = eng.stats
+                print(f"engine: direct={s.bytes_direct} "
+                      f"fallback={s.bytes_fallback} "
+                      f"bounce={s.bounce_bytes} "
+                      f"submitted={s.requests_submitted} "
+                      f"completed={s.requests_completed} "
+                      f"failed={s.requests_failed} "
+                      f"retries={s.retries}", file=w, flush=True)
+            except Exception as e:       # diagnosis must not crash the job
+                print(f"engine stats unavailable: {e}", file=w,
+                      flush=True)
+        print("=== end watchdog dump ===", file=w, flush=True)

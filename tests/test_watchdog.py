@@ -1,0 +1,72 @@
+"""StepWatchdog (utils/watchdog.py): silent hangs become diagnoses."""
+
+import io
+import time
+
+import pytest
+
+from nvme_strom_tpu.utils.watchdog import StepWatchdog
+
+
+def test_fast_steps_never_fire():
+    buf = io.StringIO()
+    with StepWatchdog(deadline_s=5.0, stream=buf) as wd:
+        for _ in range(20):
+            with wd.step():
+                pass
+    assert wd.timeouts == 0
+    assert buf.getvalue() == ""
+
+
+def test_slow_step_dumps_stacks_and_engine_stats():
+    from nvme_strom_tpu.io import StromEngine
+    buf = io.StringIO()
+    with StromEngine() as eng, \
+            StepWatchdog(deadline_s=0.2, engine=eng, stream=buf) as wd:
+        with wd.step("train"):
+            time.sleep(0.7)
+    out = buf.getvalue()
+    assert wd.timeouts >= 1
+    assert "exceeded" in out and "'train'" in out
+    assert "Thread" in out or "thread" in out       # faulthandler dump
+    assert "engine:" in out and "direct=" in out
+    # the loop recovered — later fast steps stay quiet
+    n = wd.timeouts
+    with wd.step():
+        pass
+    assert wd.timeouts == n
+
+
+def test_report_cap_and_rearm():
+    buf = io.StringIO()
+    with StepWatchdog(deadline_s=0.1, max_reports=2, stream=buf) as wd:
+        with wd.step("spin"):
+            time.sleep(0.65)
+    # fired several times but dumped at most max_reports
+    assert wd.timeouts >= 3
+    assert buf.getvalue().count("end watchdog dump") <= 2
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="deadline"):
+        StepWatchdog(deadline_s=0)
+    with pytest.raises(ValueError, match="on_timeout"):
+        StepWatchdog(deadline_s=1, on_timeout="panic")
+
+
+def test_abort_mode_kills_process():
+    import subprocess
+    import sys
+    code = """
+import time, sys
+sys.path.insert(0, %r)
+from nvme_strom_tpu.utils.watchdog import StepWatchdog
+wd = StepWatchdog(deadline_s=0.2, on_timeout="abort")
+with wd.step("wedged"):
+    time.sleep(30)
+print("UNREACHABLE")
+""" % (str(__import__("pathlib").Path(__file__).resolve().parents[1]),)
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=30)
+    assert r.returncode == 124
+    assert "wedged" in r.stderr and "UNREACHABLE" not in r.stdout
