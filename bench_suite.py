@@ -550,9 +550,11 @@ def bench_kv_offload(engine, device=None) -> tuple[float, str]:
     logits, dense = _dec.prefill(params, prompt, cfg, dense)
     tok = jnp.argmax(logits, -1).astype(jnp.int32)
     quant = os.environ.get("STROM_KVOFF_QUANT") or None
+    host_cache = int(os.environ.get("STROM_KVOFF_HOSTCACHE", "0") or 0)
     ocfg = OffloadConfig(
         path=os.path.join(_scratch_dir(), "kvoff.bin"),
-        page_len=page_len, window_pages=wpages, quantize=quant)
+        page_len=page_len, window_pages=wpages, quantize=quant,
+        host_cache_pages=host_cache)
     stats = engine.stats
     with PagedKVCache(cfg, ocfg, engine, batch, device=dev) as cache:
         cache.append(dense["k"], dense["v"])
@@ -589,6 +591,8 @@ def bench_kv_offload(engine, device=None) -> tuple[float, str]:
            f"direct={direct_share:.0%}")
     if quant:
         tag += f" quant={quant}"
+    if host_cache:
+        tag += f" hostcache={host_cache}p"
     return rate, tag
 
 

@@ -61,11 +61,19 @@ class OffloadConfig:
     shrinks ~2x (bf16) / ~4x (f32) at a bounded attention error; the
     window and all compute stay full precision, dequantization happens
     on device after the read.
+
+    ``host_cache_pages``: a host-DRAM middle tier.  The newest N
+    evicted pages keep their (already materialized) host copies in an
+    LRU; attention serves those pages straight from RAM — no NVMe
+    read — and falls through to the page file past the LRU.  Three
+    tiers total: HBM window / host RAM / NVMe, each overflowing into
+    the next.
     """
     path: str
     page_len: int = 256
     window_pages: int = 4
     quantize: Optional[str] = None      # None | "int8"
+    host_cache_pages: int = 0
 
     def __post_init__(self):
         if self.quantize not in (None, "int8"):
@@ -240,6 +248,12 @@ class PagedKVCache:
         # in-flight eviction writes (PendingWrite keeps the host buffer
         # alive); drained before any read and bounded by _MAX_PENDING
         self._pending_writes: list = []
+        # host-DRAM tier: page index → section host arrays (LRU; the
+        # newest evictions — decode re-reads every cold page per step,
+        # so RAM hits replace NVMe reads wholesale)
+        self._host_cache: "dict" = {}
+        self.host_cache_hits = 0
+        self.host_cache_misses = 0
 
     _MAX_PENDING_PAGES = 4
 
@@ -323,15 +337,24 @@ class PagedKVCache:
         else:
             sections = ((k_page, kd), (v_page, vd))
         pend = []
+        hosts = []
         for arr, off in sections:
             host = np.ascontiguousarray(
                 np.asarray(arr)).view(np.uint8).reshape(-1)
+            hosts.append(host)
             chunk = self.engine.config.chunk_bytes
             for p0 in range(0, host.nbytes, chunk):
                 part = host[p0:p0 + chunk]
                 pend.append(
                     self.engine.submit_write(self._fh, off + p0, part))
         self._pending_writes.append(pend)
+        if self.ocfg.host_cache_pages > 0:
+            # RAM tier: the section buffers already exist host-side —
+            # retaining them costs nothing extra (they double as the
+            # write keepalives) and spares the NVMe round trip
+            self._host_cache[self.n_cold] = hosts
+            while len(self._host_cache) > self.ocfg.host_cache_pages:
+                self._host_cache.pop(next(iter(self._host_cache)))
         self.n_cold += 1
 
     def _evict_one(self) -> None:
@@ -429,6 +452,7 @@ class PagedKVCache:
                 "batch": self.batch, "page_len": self.ocfg.page_len,
                 "window_pages": self.ocfg.window_pages,
                 "quantize": self.ocfg.quantize,
+                "host_cache_pages": self.ocfg.host_cache_pages,
                 "page_file": os.path.abspath(self.ocfg.path),
                 # loud mismatch beats a silent same-itemsize bitcast
                 "dtype": jnp.dtype(self.cfg.dtype).name,
@@ -449,10 +473,11 @@ class PagedKVCache:
         import os
         with open(os.path.join(directory, "session.json")) as f:
             meta = json.load(f)
-        ocfg = OffloadConfig(path=meta["page_file"],
-                             page_len=meta["page_len"],
-                             window_pages=meta["window_pages"],
-                             quantize=meta["quantize"])
+        ocfg = OffloadConfig(
+            path=meta["page_file"], page_len=meta["page_len"],
+            window_pages=meta["window_pages"],
+            quantize=meta["quantize"],
+            host_cache_pages=meta.get("host_cache_pages", 0))
         if meta.get("dtype") != jnp.dtype(cfg.dtype).name:
             raise ValueError(
                 f"session saved with dtype {meta.get('dtype')}, "
@@ -489,12 +514,17 @@ class PagedKVCache:
         larger than the engine's staging buffers split into chunk-sized
         sub-ranges (mirroring the write side); the on-device concat
         reassembles each page."""
-        from nvme_strom_tpu.ops.bridge import split_ranges
+        from nvme_strom_tpu.ops.bridge import host_to_device, split_ranges
         self._drain_writes()   # a just-evicted page must not read stale
         P = self.ocfg.page_len
         L, b, nkv, _, hd = self.k_win.shape
-        spans = []          # per page: k data[, k scales], v data[, v sc.]
+        sec_lens = tuple(ln for ln in (self._pb_layer, self._sb_layer,
+                                       self._pb_layer, self._sb_layer)
+                         if ln)
+        spans = []          # per UNCACHED page: k data[, sc], v data[, sc]
         for page in range(self.n_cold):
+            if page in self._host_cache:
+                continue     # served from the RAM tier, no NVMe read
             kd, ks, vd, vs = self._section_offsets(page)
             for base, ln in ((kd, self._pb_layer), (ks, self._sb_layer),
                              (vd, self._pb_layer), (vs, self._sb_layer)):
@@ -505,21 +535,34 @@ class PagedKVCache:
         it = self._stream.stream_ranges(self._fh, ranges)
         counts = iter(n_sub)
 
-        def read_flat():
+        def stream_flat():
             parts = [next(it) for _ in range(next(counts))]
             return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
-        def read_kv():
+        def read_kv(take):
             if self._quant:
                 # (data, scale) stay separate: attend feeds them to the
                 # quantized partial, which dequantizes inside its jit
-                data = read_flat().view(jnp.int8).reshape(b, nkv, P, hd)
-                scale = read_flat().view(jnp.float32).reshape(b, nkv, P, 1)
+                data = take().view(jnp.int8).reshape(b, nkv, P, hd)
+                scale = take().view(jnp.float32).reshape(b, nkv, P, 1)
                 return data, scale
-            return read_flat().view(self.cfg.dtype).reshape(b, nkv, P, hd)
+            return take().view(self.cfg.dtype).reshape(b, nkv, P, hd)
 
-        for _ in range(self.n_cold):
-            yield read_kv(), read_kv()
+        for page in range(self.n_cold):
+            hosts = self._host_cache.get(page)
+            if hosts is not None:
+                self.host_cache_hits += 1
+                flats = iter([
+                    host_to_device(
+                        self.engine,
+                        sec[layer * ln:(layer + 1) * ln], self.device,
+                        alias_safe=True)   # immutable long-lived buffer
+                    for sec, ln in zip(hosts, sec_lens)])
+                take = lambda: next(flats)     # noqa: E731
+            else:
+                self.host_cache_misses += 1
+                take = stream_flat
+            yield read_kv(take), read_kv(take)
 
     def _history_partials(self, layer: int, qf, valid: int):
         """(m, l, acc) of grouped queries over cold pages + ``valid``

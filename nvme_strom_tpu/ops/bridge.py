@@ -46,7 +46,8 @@ def split_ranges(spans, chunk: int):
     return flat, counts
 
 
-def host_to_device(engine: StromEngine, host: np.ndarray, dev):
+def host_to_device(engine: StromEngine, host: np.ndarray, dev,
+                   alias_safe: bool = False):
     """``device_put`` with the staging-alias rule and byte accounting.
 
     On a host-backed device, ``jax.device_put`` may ALIAS the numpy buffer;
@@ -55,12 +56,16 @@ def host_to_device(engine: StromEngine, host: np.ndarray, dev):
     the bytes and no host copy exists.  Single source of truth for every
     consumer that puts staging-backed views on device.
 
+    ``alias_safe=True``: the source is a long-lived immutable host
+    array (e.g. the KV host-cache tier), never recycled staging memory
+    — aliasing is fine, so no protective copy and no bounce count.
+
     Spans: the dispatch is recorded in the strom tracer AND annotated for
     the JAX profiler, so chrome://tracing / Perfetto views line up
     (both clocks are CLOCK_MONOTONIC).
     """
     import jax
-    if dev.platform == "cpu":
+    if dev.platform == "cpu" and not alias_safe:
         host = np.array(host)
         engine.stats.add(bounce_bytes=int(host.nbytes))
     with jax.profiler.TraceAnnotation("strom.h2d"), \

@@ -243,6 +243,70 @@ def test_int8_generate_runs_and_stays_greedy_consistent(cfg, engine,
     assert out.dtype == jnp.int32
 
 
+def test_host_cache_tier_exact_and_skips_nvme(cfg, tmp_path):
+    """RAM-tier pages attend identically and spare the NVMe reads;
+    pages past the LRU fall through to the page file."""
+    from nvme_strom_tpu.utils.stats import StromStats
+    rng = np.random.default_rng(31)
+    b, S = 2, 27                        # window 8 → 4 cold pages + 3
+    L, nkv, hd, nh = (cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+                      cfg.n_heads)
+    ks = rng.standard_normal((L, b, nkv, S, hd)).astype(np.float32)
+    vs = rng.standard_normal((L, b, nkv, S, hd)).astype(np.float32)
+    q = rng.standard_normal((b, nh, 1, hd)).astype(np.float32)
+    ref = _dense_reference(q, ks[0], vs[0])
+
+    def run(cache_pages):
+        stats = StromStats()
+        ocfg = OffloadConfig(path=str(tmp_path / f"kv{cache_pages}.bin"),
+                             page_len=4, window_pages=2,
+                             host_cache_pages=cache_pages)
+        with StromEngine(stats=stats) as eng, \
+                PagedKVCache(cfg, ocfg, eng, b) as cache:
+            cache.append(jnp.asarray(ks), jnp.asarray(vs))
+            got = np.asarray(cache.attend(0, jnp.asarray(q)))
+            eng.sync_stats()
+            return (got, stats.bytes_direct + stats.bytes_fallback,
+                    cache.host_cache_hits, cache.host_cache_misses,
+                    cache.n_cold)
+
+    got0, read0, h0, m0, n_cold = run(0)
+    np.testing.assert_allclose(got0, ref, atol=1e-5, rtol=1e-5)
+    assert (h0, m0) == (0, n_cold)
+
+    # full cache: every page served from RAM, zero payload reads
+    gotN, readN, hN, mN, _ = run(n_cold)
+    np.testing.assert_allclose(gotN, ref, atol=1e-5, rtol=1e-5)
+    assert hN == n_cold and mN == 0
+    assert readN < read0
+
+    # partial cache: both tiers in one attend, still exact
+    got2, read2, h2, m2, _ = run(2)
+    np.testing.assert_allclose(got2, ref, atol=1e-5, rtol=1e-5)
+    assert h2 == 2 and m2 == n_cold - 2
+    assert readN < read2 < read0
+
+
+def test_host_cache_with_int8(cfg, engine, tmp_path):
+    """RAM tier composes with quantized cold pages."""
+    rng = np.random.default_rng(32)
+    b, S = 1, 23
+    L, nkv, hd, nh = (cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+                      cfg.n_heads)
+    ks = rng.standard_normal((L, b, nkv, S, hd)).astype(np.float32)
+    vs = rng.standard_normal((L, b, nkv, S, hd)).astype(np.float32)
+    q = rng.standard_normal((b, nh, 1, hd)).astype(np.float32)
+    ocfg = OffloadConfig(path=str(tmp_path / "kvq.bin"), page_len=4,
+                         window_pages=2, quantize="int8",
+                         host_cache_pages=2)
+    with PagedKVCache(cfg, ocfg, engine, b) as cache:
+        cache.append(jnp.asarray(ks), jnp.asarray(vs))
+        got = np.asarray(cache.attend(0, jnp.asarray(q)))
+        assert cache.host_cache_hits == 2
+        ref = _dense_reference(q, ks[0], vs[0])
+        np.testing.assert_allclose(got, ref, atol=3e-2, rtol=3e-2)
+
+
 def test_session_save_resume_identical_continuation(cfg, tmp_path):
     """A decode suspended mid-generation and resumed in a fresh engine
     continues with exactly the tokens the uninterrupted run produces."""
