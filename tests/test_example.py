@@ -93,3 +93,35 @@ def test_train_vit_fixedrec(tmp_path):
     losses = [float(m) for m in re.findall(r"loss=([\d.]+)", r.stdout)]
     assert losses and all(l == l and l < 100 for l in losses)
     assert "engine stats" in r.stdout
+
+
+def test_eval_ppl_cli(tmp_path):
+    """examples/eval_ppl.py: npy tokens → finite perplexity ~vocab for
+    an untrained model on uniform-random tokens."""
+    import json
+    import numpy as np
+    sys.path.insert(0, str(REPO))
+    from nvme_strom_tpu.models.transformer import init_params, tiny_config
+    from nvme_strom_tpu.parallel.weights import save_checkpoint
+    import jax
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    wdir = tmp_path / "w"
+    wdir.mkdir()
+    save_checkpoint(str(wdir / "model.safetensors"), params)
+    with open(wdir / "strom_config.json", "w") as f:
+        json.dump({k: v for k, v in cfg.__dict__.items()
+                   if k != "dtype"}, f)
+    rng = np.random.default_rng(0)
+    np.save(tmp_path / "ev.npy",
+            rng.integers(0, cfg.vocab, (12, 32)).astype(np.int32))
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "eval_ppl.py"),
+         "--weights", str(wdir), "--npy", str(tmp_path / "ev.npy"),
+         "--batch", "4"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(REPO))
+    assert r.returncode == 0, r.stderr[-2000:]
+    ppl = float(r.stdout.split("perplexity:")[1].split()[0])
+    # untrained model ≈ uniform over vocab
+    assert 0.5 * cfg.vocab < ppl < 4 * cfg.vocab
