@@ -45,6 +45,16 @@ class _Request:
     out: List[int] = field(default_factory=list)
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_blocks(k_pool, v_pool, blks, k_rows, v_rows):
+    """Admission scatter: (L, n, nkv, bk, hd) prompt rows into pool
+    blocks ``blks`` (n,) — one donated program, no per-block pool
+    copies."""
+    k_pool = k_pool.at[:, blks].set(k_rows.astype(k_pool.dtype))
+    v_pool = v_pool.at[:, blks].set(v_rows.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
 @functools.partial(jax.jit, donate_argnums=(1, 2))
 def _scatter_prefill(slot, k_cache, v_cache, k_new, v_new):
     """Place a prefilled request's (L,1,nkv,s,hd) KV at slot rows."""
@@ -53,6 +63,30 @@ def _scatter_prefill(slot, k_cache, v_cache, k_new, v_new):
     v_cache = jax.lax.dynamic_update_slice(
         v_cache, v_new.astype(v_cache.dtype), (0, slot, 0, 0, 0))
     return k_cache, v_cache
+
+
+def _batched_step_body(params: Dict, cfg: TransformerConfig, tok, pos,
+                       write_and_attend):
+    """Shared per-step transformer wiring of the batched servers.
+
+    ``write_and_attend(i, q, k, v) -> (B, nh, 1, hd)`` owns the cache
+    write + attention for its storage layout (contiguous per-slot rows
+    or a block-table pool)."""
+    B = tok.shape[0]
+    x = params["tok_embed"].astype(cfg.dtype)[tok[:, None]]   # (B,1,d)
+    positions = pos.astype(jnp.float32)[:, None]              # (B,1)
+    for i in range(cfg.n_layers):
+        L = f"layers.{i}."
+        h = rms_norm(x, params[L + "attn_norm"], cfg.norm_eps)
+        q, k, v = qkv_project(h, params, L, cfg, positions=positions)
+        a = write_and_attend(i, q, k, v)
+        a = a.transpose(0, 2, 1, 3).reshape(B, 1, -1)
+        x = x + a @ params[L + "wo"].astype(a.dtype)
+        h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
+        x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
+    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
+    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return jnp.argmax(logits, -1).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 6),
@@ -70,31 +104,46 @@ def _serve_step(params: Dict, cfg: TransformerConfig, tok,
     """
     B = tok.shape[0]
     rows = jnp.arange(B)
-    x = params["tok_embed"].astype(cfg.dtype)[tok[:, None]]   # (B,1,d)
-    positions = pos.astype(jnp.float32)[:, None]              # (B,1)
     limit = pos[:, None]                                      # (B,1)
-    for i in range(cfg.n_layers):
-        L = f"layers.{i}."
-        h = rms_norm(x, params[L + "attn_norm"], cfg.norm_eps)
-        q, k, v = qkv_project(h, params, L, cfg, positions=positions)
+    caches = {"k": k_cache, "v": v_cache}
+
+    def write_and_attend(i, q, k, v):
         # per-row scatter: row b writes its kv at its own pos[b]
-        k_cache = k_cache.at[i, rows, :, pos, :].set(
-            k[:, :, 0].astype(k_cache.dtype))
-        v_cache = v_cache.at[i, rows, :, pos, :].set(
-            v[:, :, 0].astype(v_cache.dtype))
+        caches["k"] = caches["k"].at[i, rows, :, pos, :].set(
+            k[:, :, 0].astype(caches["k"].dtype))
+        caches["v"] = caches["v"].at[i, rows, :, pos, :].set(
+            v[:, :, 0].astype(caches["v"].dtype))
         if cache_attn is not None:
-            a = cache_attn(q, k_cache[i], v_cache[i], pos)
-        else:
-            a = _dec.cache_attention(q, k_cache[i], v_cache[i], limit,
-                                     cfg)
-        a = a.transpose(0, 2, 1, 3).reshape(B, 1, -1)
-        x = x + a @ params[L + "wo"].astype(a.dtype)
-        h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
-        x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
-    x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
-    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
-    return nxt, k_cache, v_cache
+            return cache_attn(q, caches["k"][i], caches["v"][i], pos)
+        return _dec.cache_attention(q, caches["k"][i], caches["v"][i],
+                                    limit, cfg)
+
+    nxt = _batched_step_body(params, cfg, tok, pos, write_and_attend)
+    return nxt, caches["k"], caches["v"]
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(3, 4))
+def _paged_step(params: Dict, cfg: TransformerConfig, tok,
+                k_pool, v_pool, blk, off, table, pos):
+    """One decode step against the shared block pool.
+
+    blk/off (B,) int32: each slot's write target (block id in the pool,
+    row offset inside it); table (B, max_blocks) int32 + pos (B,) feed
+    the paged-attention kernel.  Returns (next_tok, k_pool, v_pool).
+    """
+    from nvme_strom_tpu.ops.paged_attention import paged_attention
+    pools = {"k": k_pool, "v": v_pool}
+
+    def write_and_attend(i, q, k, v):
+        pools["k"] = pools["k"].at[i, blk, :, off, :].set(
+            k[:, :, 0].astype(pools["k"].dtype))
+        pools["v"] = pools["v"].at[i, blk, :, off, :].set(
+            v[:, :, 0].astype(pools["v"].dtype))
+        return paged_attention(q, pools["k"][i], pools["v"][i], table,
+                               pos)
+
+    nxt = _batched_step_body(params, cfg, tok, pos, write_and_attend)
+    return nxt, pools["k"], pools["v"]
 
 
 class DecodeServer:
@@ -115,14 +164,18 @@ class DecodeServer:
         # e.g. ops.decode_attention.make_decode_attn() — the fused
         # kernel pays off once live caches clear ~1k positions
         self.cache_attn = cache_attn
-        L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
-        shape = (L, max_batch, nkv, max_len, hd)
-        self.k_cache = jnp.zeros(shape, cfg.dtype)
-        self.v_cache = jnp.zeros(shape, cfg.dtype)
         self.pos = jnp.zeros((max_batch,), jnp.int32)
         self.tok = jnp.zeros((max_batch,), jnp.int32)
         self.slots: List[Optional[_Request]] = [None] * max_batch
         self.queue: List[_Request] = []
+        self._alloc_storage()
+
+    def _alloc_storage(self) -> None:
+        cfg = self.cfg
+        L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        shape = (L, self.B, nkv, self.max_len, hd)
+        self.k_cache = jnp.zeros(shape, cfg.dtype)
+        self.v_cache = jnp.zeros(shape, cfg.dtype)
 
     # -- intake -----------------------------------------------------------
 
@@ -188,11 +241,25 @@ class DecodeServer:
     def idle(self) -> bool:
         return not self.queue and all(s is None for s in self.slots)
 
+    def _can_admit(self, req: _Request) -> bool:
+        return True            # dense slots carry their own reservation
+
+    def _run_step(self):
+        """Storage-specific batched step → next-token device array."""
+        nxt, self.k_cache, self.v_cache = _serve_step(
+            self.params, self.cfg, self.tok, self.k_cache,
+            self.v_cache, self.pos, self.cache_attn)
+        return nxt
+
+    def _advanced(self, active_slots: List[int]) -> None:
+        """Post-step bookkeeping hook (host-side position mirrors)."""
+
     def step(self) -> Dict[object, List[int]]:
         """Admit → one batched decode step → retire finished."""
         finished: Dict[object, List[int]] = {}
         for slot in range(self.B):
-            if self.slots[slot] is None and self.queue:
+            if (self.slots[slot] is None and self.queue
+                    and self._can_admit(self.queue[0])):
                 self._admit(slot, self.queue.pop(0))
                 # a request can complete at admission (max_new == 1 or
                 # instant eos)
@@ -204,13 +271,12 @@ class DecodeServer:
         if not active_slots:
             return finished
         active = jnp.asarray([r is not None for r in self.slots])
-        nxt, self.k_cache, self.v_cache = _serve_step(
-            self.params, self.cfg, self.tok, self.k_cache,
-            self.v_cache, self.pos, self.cache_attn)
+        nxt = self._run_step()
         nxt_h = jax.device_get(nxt).tolist()
         # the step ingested tok at pos for every active slot
         self.pos = jnp.where(active, self.pos + 1, self.pos)
         self.tok = nxt
+        self._advanced(active_slots)
         for slot in active_slots:
             self.slots[slot].out.append(nxt_h[slot])
             ret = self._retire_or_keep(slot)
@@ -224,3 +290,120 @@ class DecodeServer:
         while not self.idle:
             results.update(self.step())
         return results
+
+
+class PagedDecodeServer(DecodeServer):
+    """Continuous batching over a SHARED block pool (paged attention).
+
+    Capacity is ``total_blocks × block_len`` tokens across ALL slots —
+    sized for expected live tokens, not slots × max_len, so short
+    requests stop paying for the longest one's reservation.  Each
+    request reserves its worst case (``ceil((prompt+max_new)/block)``)
+    at admission, so an admitted request can never starve mid-decode;
+    when the pool is exhausted, requests simply wait in the queue.
+    Attention runs the scalar-prefetch Pallas kernel
+    (ops/paged_attention.py) — the block indirection never materializes
+    a gathered cache copy in HBM.
+    """
+
+    def __init__(self, params: Dict, cfg: TransformerConfig,
+                 max_batch: int, max_len: int, total_blocks: int,
+                 block_len: int = 128):
+        if block_len < 1 or total_blocks < 1:
+            raise ValueError("block_len and total_blocks must be >= 1")
+        self.block_len = block_len
+        self.total_blocks = total_blocks
+        super().__init__(params, cfg, max_batch, max_len)
+        self.max_blocks = -(-max_len // block_len)
+
+    def _alloc_storage(self) -> None:
+        cfg = self.cfg
+        L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        # +1: a sacrificial TRASH block — a free slot still computes a
+        # (masked) step and its frozen-pos write must never land in a
+        # block some live request owns
+        shape = (L, self.total_blocks + 1, nkv, self.block_len, hd)
+        self.k_pool = jnp.zeros(shape, cfg.dtype)
+        self.v_pool = jnp.zeros(shape, cfg.dtype)
+        self._trash = self.total_blocks
+        self.free: List[int] = list(range(self.total_blocks))
+        self.blocks: List[List[int]] = [[] for _ in range(self.B)]
+        self._pos_h: List[int] = [0] * self.B   # host mirror of pos
+        self._table_dev = None                  # cache until blocks move
+
+    def _table(self):
+        """(B, max_blocks) device table, cached until block membership
+        changes; padding entries are 0 — their positions sit past pos
+        and the kernel masks them."""
+        if self._table_dev is None:
+            import numpy as np
+            t = np.zeros((self.B, self.max_blocks), np.int32)
+            for b, blks in enumerate(self.blocks):
+                t[b, :len(blks)] = blks
+            self._table_dev = jnp.asarray(t)
+        return self._table_dev
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        s = len(req.prompt)
+        need = -(-(s + req.max_new) // self.block_len)
+        assert len(self.free) >= need      # step() checked
+        blks = [self.free.pop() for _ in range(need)]
+        self.blocks[slot] = blks
+        self._table_dev = None
+        # dense single-request prefill, then ONE donated jitted scatter
+        # of all prompt blocks (prompt padded up to whole blocks; pad
+        # rows sit past pos and are overwritten before the mask
+        # reaches them)
+        bk = self.block_len
+        n_pb = -(-s // bk)
+        cache = _dec.init_cache(self.cfg, 1, n_pb * bk)
+        padded = req.prompt + [0] * (n_pb * bk - s)
+        logits, cache = _dec.prefill(self.params,
+                                     jnp.asarray([padded], jnp.int32),
+                                     self.cfg, cache, last=s - 1)
+        L, nkv, hd = (self.cfg.n_layers, self.cfg.n_kv_heads,
+                      self.cfg.head_dim)
+        rows_k = cache["k"][:, 0].reshape(L, nkv, n_pb, bk, hd)
+        rows_v = cache["v"][:, 0].reshape(L, nkv, n_pb, bk, hd)
+        self.k_pool, self.v_pool = _scatter_blocks(
+            self.k_pool, self.v_pool,
+            jnp.asarray(blks[:n_pb], jnp.int32),
+            rows_k.transpose(0, 2, 1, 3, 4),
+            rows_v.transpose(0, 2, 1, 3, 4))
+        first = int(jnp.argmax(logits, -1)[0])
+        req.out.append(first)
+        self.slots[slot] = req
+        self.pos = self.pos.at[slot].set(s)
+        self._pos_h[slot] = s
+        self.tok = self.tok.at[slot].set(first)
+
+    def _can_admit(self, req: _Request) -> bool:
+        # submit() bounds prompt+max_new by max_len, so need can never
+        # exceed max_blocks — only pool availability gates admission
+        need = -(-(len(req.prompt) + req.max_new) // self.block_len)
+        return len(self.free) >= need
+
+    def _retire_or_keep(self, slot: int):
+        ret = super()._retire_or_keep(slot)
+        if ret is not None:                 # blocks back to the pool
+            self.free.extend(self.blocks[slot])
+            self.blocks[slot] = []
+            self._table_dev = None
+        return ret
+
+    def _run_step(self):
+        # write targets from the HOST position mirror — no device sync
+        # sits in front of the step launch
+        blk = jnp.asarray(
+            [(self.blocks[b][self._pos_h[b] // self.block_len]
+              if self.blocks[b] else self._trash)
+             for b in range(self.B)], jnp.int32)
+        off = self.pos % self.block_len
+        nxt, self.k_pool, self.v_pool = _paged_step(
+            self.params, self.cfg, self.tok, self.k_pool, self.v_pool,
+            blk, off, self._table(), self.pos)
+        return nxt
+
+    def _advanced(self, active_slots: List[int]) -> None:
+        for slot in active_slots:
+            self._pos_h[slot] += 1

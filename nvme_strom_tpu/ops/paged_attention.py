@@ -1,0 +1,139 @@
+"""Pallas paged attention: decode against a BLOCK-TABLE KV pool.
+
+The serving-memory move (vLLM's PagedAttention, done TPU-style): instead
+of reserving ``max_len`` cache rows per slot, all slots share one pool
+of fixed-size blocks and a per-slot table lists which pool blocks hold
+its history.  Capacity is sized for the TOTAL live tokens, not
+slots × max_len — heterogeneous requests stop paying for the longest
+one's reservation.
+
+Kernel shape: the block table and per-slot positions ride scalar
+prefetch (``pltpu.PrefetchScalarGridSpec``), so each grid step's K/V
+BlockSpec ``index_map`` dereferences ``table[b, j]`` and the DMA fetches
+exactly that pool block — the indirection costs nothing extra over the
+contiguous-cache kernel (ops/decode_attention.py), and no gathered copy
+of the cache ever materializes in HBM.  Everything else is the same
+fused position-masked online softmax at kv-head width.
+
+Padding-table entries may point anywhere (block 0 convention): their
+columns sit past ``pos`` and are masked; their V rows are zeroed before
+use so garbage cannot ride a 0·NaN.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _paged_kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, scale, block_k, n_blocks):
+    bi = pl.program_id(0)
+    ji = pl.program_id(2)
+    g = q_ref.shape[2]
+
+    @pl.when(ji == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # (g, d)
+    k = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    pos = pos_ref[bi]
+    # rows past pos carry zero weight, but padded/foreign blocks may
+    # hold garbage and 0·NaN = NaN — zero those V rows outright
+    rows_ok = (ji * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, v.shape, 0)) <= pos
+    v = jnp.where(rows_ok, v, 0.0)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    cols = ji * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (g, block_k), 1)
+    s = jnp.where(cols <= pos, s, _NEG_INF)
+
+    m = m_ref[:, 0]
+    l = l_ref[:, 0]
+    m_new = jnp.maximum(m, jnp.max(s, axis=1))
+    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp(m - m_new)
+    m_ref[:, 0] = m_new
+    l_ref[:, 0] = l * alpha + jnp.sum(p, axis=1)
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(ji == n_blocks - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, table, pos, *, scale=None,
+                    interpret: bool = None):
+    """q (b, n_heads, 1, d) attends to its block-table history.
+
+    k_pool/v_pool (n_blocks, n_kv_heads, block_k, d): the shared pool.
+    table (b, max_blocks) int32: slot b's sequence lives in pool blocks
+    ``table[b, 0] .. table[b, ·]`` (padding entries arbitrary — they
+    are masked).  pos (b,) int32: index of slot b's newest entry in its
+    OWN coordinate space (block j covers positions
+    [j·block_k, (j+1)·block_k)).
+
+    Returns (b, n_heads, 1, d).  ``interpret`` defaults to True off-TPU.
+    """
+    if q.ndim != 4 or q.shape[2] != 1:
+        raise ValueError(f"expected q (b, h, 1, d), got {q.shape}")
+    b, nh, _, d = q.shape
+    n_pool, nkv, block_k, _ = k_pool.shape
+    if nh % nkv:
+        raise ValueError(f"{nh} query heads not divisible by {nkv} "
+                         "kv heads")
+    if table.shape[0] != b or table.ndim != 2:
+        raise ValueError(f"table must be ({b}, max_blocks), "
+                         f"got {table.shape}")
+    g = nh // nkv
+    max_blocks = table.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qg = q.reshape(b, nkv, g, d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, max_blocks),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d),
+                         lambda bi, hi, ji, tbl, ps: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ji, tbl, ps:
+                         (tbl[bi, ji], hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ji, tbl, ps:
+                         (tbl[bi, ji], hi, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda bi, hi, ji, tbl, ps:
+                               (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=float(scale),
+                          block_k=block_k, n_blocks=max_blocks),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
+        interpret=interpret,
+    )(jnp.asarray(table, jnp.int32), jnp.asarray(pos, jnp.int32),
+      qg, k_pool, v_pool)
+    return out.reshape(b, nh, 1, d)

@@ -104,6 +104,65 @@ def test_validation(setup):
         srv.submit("dup", [3, 4], 4)
 
 
+def test_paged_server_matches_solo(setup):
+    """Block-pool serving (paged-attention kernel) is token-identical
+    to solo generate, with a pool FAR smaller than slots×max_len."""
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    cfg, params = setup
+    rng = np.random.default_rng(7)
+    reqs = {f"b{i}": (rng.integers(0, cfg.vocab, n).tolist(), m)
+            for i, (n, m) in enumerate([(5, 9), (11, 6), (3, 12)])}
+    # worst cases: 14, 17, 15 tokens → 4+5+4 = 13 blocks of 4;
+    # dense reservation would be 3 slots × 64 rows = 48 blocks
+    srv = PagedDecodeServer(params, cfg, max_batch=3, max_len=64,
+                            total_blocks=13, block_len=4)
+    for rid, (p, m) in reqs.items():
+        srv.submit(rid, p, m)
+    got = srv.run()
+    for rid, (p, m) in reqs.items():
+        assert got[rid] == _solo(params, cfg, p, m), rid
+    assert sorted(srv.free) == list(range(13))   # all blocks returned
+
+
+def test_paged_server_queues_on_pool_exhaustion(setup):
+    """Admission control: requests wait for blocks, recycled blocks
+    admit them, everything still matches solo."""
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    cfg, params = setup
+    rng = np.random.default_rng(8)
+    reqs = {f"q{i}": (rng.integers(0, cfg.vocab, 6).tolist(), 6)
+            for i in range(4)}
+    # each request needs ceil(12/4)=3 blocks; pool of 4 → strictly one
+    # in flight even though 2 slots exist
+    srv = PagedDecodeServer(params, cfg, max_batch=2, max_len=32,
+                            total_blocks=4, block_len=4)
+    for rid, (p, m) in reqs.items():
+        srv.submit(rid, p, m)
+    steps = 0
+    got = {}
+    while not srv.idle:
+        got.update(srv.step())
+        active = sum(r is not None for r in srv.slots)
+        assert active <= 1       # pool admits one 3-block request
+        steps += 1
+        assert steps < 200
+    for rid, (p, m) in reqs.items():
+        assert got[rid] == _solo(params, cfg, p, m), rid
+
+
+def test_paged_server_rejects_oversized(setup):
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    cfg, params = setup
+    srv = PagedDecodeServer(params, cfg, max_batch=1, max_len=16,
+                            total_blocks=8, block_len=4)
+    srv.submit("big", [1] * 8, 8)     # needs 4 blocks == max_blocks: ok
+    with pytest.raises(ValueError, match="exceeds"):
+        srv.submit("huge", [1] * 10, 7)   # 17 > max_len
+    with pytest.raises(ValueError, match=">= 1"):
+        PagedDecodeServer(params, cfg, 1, 16, total_blocks=0)
+    srv.run()
+
+
 def test_serving_with_pallas_kernel_matches_dense(setup):
     """cache_attn=make_decode_attn() (per-row-pos Pallas kernel, run in
     the interpreter on CPU) produces the same tokens as the dense step."""
