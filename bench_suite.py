@@ -39,7 +39,9 @@ storage engine, SURVEY.md §1) → vs_baseline is always null; they exist so
 the framework's perf claims cover compute, not just I/O.
 
 Env: STROM_SUITE_BYTES (per-config payload, default 256 MiB),
-STROM_BENCH_DIR (scratch dir, default repo root).
+STROM_BENCH_DIR (scratch dir, default repo root),
+STROM_KVOFF_QUANT=int8 / STROM_KVOFF_HOSTCACHE=N (config-10 variants),
+STROM_SERVE_PAGED=1 (config 11 through the block-pool paged server).
 """
 
 from __future__ import annotations
@@ -604,7 +606,8 @@ def bench_serving(device=None) -> tuple[float, str]:
     wall-clock from first step to drain, admission prefills included —
     the end-to-end serving rate, not a per-step best case."""
     import jax
-    from nvme_strom_tpu.models.serving import DecodeServer
+    from nvme_strom_tpu.models.serving import (DecodeServer,
+                                               PagedDecodeServer)
     from nvme_strom_tpu.models.transformer import init_params
     cfg = _bench_cfg()
     if _tiny_compute():
@@ -617,6 +620,23 @@ def bench_serving(device=None) -> tuple[float, str]:
         news = [64 + 17 * (i % 5) for i in range(n_req)]
     dev = device or jax.devices()[0]
     params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
+    paged = os.environ.get("STROM_SERVE_PAGED") == "1"
+    block_len = 16 if _tiny_compute() else 128
+    # pool sized for the live-token high-water mark: the `slots`
+    # largest concurrent worst cases (the paged design point — far
+    # below slots × max_len)
+    worst = sorted(((l + n) for l, n in zip(lens, news)),
+                   reverse=True)[:slots]
+    total_blocks = sum(-(-w // block_len) for w in worst)
+
+    def make():
+        if paged:
+            return PagedDecodeServer(params, cfg, max_batch=slots,
+                                     max_len=max_len,
+                                     total_blocks=total_blocks,
+                                     block_len=block_len)
+        return DecodeServer(params, cfg, max_batch=slots,
+                            max_len=max_len)
 
     def submit_all(srv):
         import numpy as np
@@ -626,21 +646,24 @@ def bench_serving(device=None) -> tuple[float, str]:
                        news[i])
 
     # warmup run compiles the step + admission buckets (discarded)
-    srv = DecodeServer(params, cfg, max_batch=slots, max_len=max_len)
+    srv = make()
     submit_all(srv)
     srv.run()
     ts = []
     for _ in range(_RUNS):
-        srv = DecodeServer(params, cfg, max_batch=slots,
-                           max_len=max_len)
+        srv = make()
         submit_all(srv)
         t0 = time.monotonic()
         out = srv.run()
         ts.append(time.monotonic() - t0)
     total = sum(news)
     rate = total / statistics.median(ts)
-    return rate, (f"slots={slots} reqs={n_req} "
-                  f"tok/req~{total // n_req}")
+    tag = f"slots={slots} reqs={n_req} tok/req~{total // n_req}"
+    if paged:
+        tag += (f" paged={total_blocks}x{block_len} "
+                f"({total_blocks * block_len * 100 // (slots * max_len)}"
+                f"% of dense)")
+    return rate, tag
 
 
 def bench_train(device=None) -> tuple[float, str]:

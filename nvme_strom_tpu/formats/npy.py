@@ -75,7 +75,10 @@ def plan_npy(path, name: Optional[str] = None,
         try:
             header, payload_off = _parse_npy_header(buf)
         except _HeaderWindow as hw:
-            buf = read_at(base_offset, hw.needed)
+            # clamp the re-read: a corrupt length field must not drive
+            # a multi-GiB allocation (any sane header is far smaller;
+            # a still-short buffer re-raises as a plain ValueError)
+            buf = read_at(base_offset, min(hw.needed, 1 << 26))
             header, payload_off = _parse_npy_header(buf)
     finally:
         if f is not None:

@@ -393,3 +393,26 @@ class TestNpy:
         entry = plan_npy(p)
         assert entry.offset == 8192       # 10-byte preamble + 8182 header
         assert entry.length == arr.nbytes
+
+    def test_npy_header_fuzz(self, tmp_path):
+        """Corrupt/truncated headers raise ValueError — never hang or
+        crash the planner (the thrift-fuzz discipline for npy)."""
+        from nvme_strom_tpu.formats.npy import plan_npy
+        rng = np.random.default_rng(9)
+        good = str(tmp_path / "good.npy")
+        np.save(good, np.zeros(8, np.float32))
+        raw = bytearray(open(good, "rb").read())
+        p = str(tmp_path / "fuzz.npy")
+        for _ in range(300):
+            buf = bytearray(raw)
+            for _ in range(rng.integers(1, 6)):
+                buf[rng.integers(0, len(buf))] = rng.integers(0, 256)
+            open(p, "wb").write(bytes(buf))
+            try:
+                entry = plan_npy(p)
+                assert entry.length >= 0
+            except (ValueError, SyntaxError, KeyError, TypeError,
+                    OverflowError):
+                # NOT MemoryError: a corrupt length field must never
+                # drive an allocation bomb (the planner clamps)
+                pass

@@ -179,3 +179,24 @@ def test_serving_with_pallas_kernel_matches_dense(setup):
             srv.submit(rid, p, m)
         outs[attn is None] = srv.run()
     assert outs[True] == outs[False]
+
+
+def test_moe_model_serves():
+    """Expert-routed models run through both servers (the dense-or-MoE
+    dispatch is shared with decode), matching solo generate."""
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    from nvme_strom_tpu.models.transformer import (
+        TransformerConfig, init_params, tiny_moe_config)
+    mcfg = TransformerConfig(**{**tiny_moe_config().__dict__,
+                                "dtype": jnp.float32})
+    mparams = init_params(jax.random.key(3), mcfg)
+    rng = np.random.default_rng(9)
+    p = rng.integers(0, mcfg.vocab, 6).tolist()
+    want = _solo(mparams, mcfg, p, 6)
+    for make in (lambda: DecodeServer(mparams, mcfg, 2, 32),
+                 lambda: PagedDecodeServer(mparams, mcfg, 2, 32,
+                                           total_blocks=8,
+                                           block_len=4)):
+        srv = make()
+        srv.submit("m", p, 6)
+        assert srv.run()["m"] == want
