@@ -41,11 +41,24 @@ def main(argv=None) -> int:
     ap.add_argument("--pallas", action="store_true",
                     help="use the fused decode-attention kernel "
                          "(wins past ~1k live positions)")
+    ap.add_argument("--paged", type=int, default=0, metavar="BLOCKS",
+                    help="serve from a shared KV pool of BLOCKS blocks "
+                         "(paged attention; capacity = total live "
+                         "tokens, not slots×max-len)")
+    ap.add_argument("--block-len", type=int, default=128,
+                    help="positions per pool block for --paged")
     args = ap.parse_args(argv)
     if not args.request:
         ap.error("at least one --request")
     if args.slots < 1:
         ap.error(f"--slots must be >= 1, got {args.slots}")
+    if args.paged:
+        # pure-argument conditions fail BEFORE the expensive weight load
+        if args.pallas:
+            ap.error("--paged always uses its own paged-attention "
+                     "kernel; drop --pallas")
+        if args.paged < 1 or args.block_len < 1:
+            ap.error("--paged and --block-len must be >= 1")
 
     import jax
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
@@ -84,6 +97,11 @@ def main(argv=None) -> int:
         if len(ids) + max_new > max_len:
             ap.error(f"--request {spec!r}: prompt {len(ids)} + "
                      f"{max_new} exceeds max_len {max_len}")
+        if args.paged and (len(ids) + max_new
+                           > args.paged * args.block_len):
+            ap.error(f"--request {spec!r}: worst case "
+                     f"{len(ids) + max_new} tokens can never fit the "
+                     f"{args.paged}x{args.block_len} pool")
         reqs.append((f"r{i}", ids, max_new))
 
     engine = StromEngine()
@@ -95,12 +113,20 @@ def main(argv=None) -> int:
     print(f"weights: {len(params)} tensors in "
           f"{time.monotonic() - t0:.2f}s", flush=True)
 
-    cache_attn = None
-    if args.pallas:
-        from nvme_strom_tpu.ops.decode_attention import make_decode_attn
-        cache_attn = make_decode_attn()
-    srv = DecodeServer(params, cfg, max_batch=args.slots,
-                       max_len=max_len, cache_attn=cache_attn)
+    if args.paged:
+        from nvme_strom_tpu.models.serving import PagedDecodeServer
+        srv = PagedDecodeServer(params, cfg, max_batch=args.slots,
+                                max_len=max_len,
+                                total_blocks=args.paged,
+                                block_len=args.block_len)
+    else:
+        cache_attn = None
+        if args.pallas:
+            from nvme_strom_tpu.ops.decode_attention import (
+                make_decode_attn)
+            cache_attn = make_decode_attn()
+        srv = DecodeServer(params, cfg, max_batch=args.slots,
+                           max_len=max_len, cache_attn=cache_attn)
     for rid, ids, max_new in reqs:
         srv.submit(rid, ids, max_new, eos_id=args.eos_id)
 

@@ -285,9 +285,20 @@ class DecodeServer:
         return finished
 
     def run(self) -> Dict[object, List[int]]:
-        """Drain the queue: step until every request finishes."""
+        """Drain the queue: step until every request finishes.
+
+        Raises RuntimeError instead of spinning when the queue head can
+        NEVER be admitted (e.g. a paged request whose worst case
+        exceeds the whole pool) and nothing is in flight to free
+        capacity."""
         results: Dict[object, List[int]] = {}
         while not self.idle:
+            if (self.queue and all(s is None for s in self.slots)
+                    and not self._can_admit(self.queue[0])):
+                raise RuntimeError(
+                    f"request {self.queue[0].rid!r} cannot ever be "
+                    f"admitted (needs more capacity than the server "
+                    f"has) and no in-flight work can free any")
             results.update(self.step())
         return results
 
