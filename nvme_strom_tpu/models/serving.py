@@ -241,6 +241,18 @@ class DecodeServer:
     def idle(self) -> bool:
         return not self.queue and all(s is None for s in self.slots)
 
+    def stats(self) -> Dict[str, int]:
+        """Point-in-time serving gauges (the STAT_INFO discipline for
+        the inference tier): slot occupancy, queue depth, and tokens
+        generated by in-flight requests."""
+        return {
+            "slots_total": self.B,
+            "slots_busy": sum(r is not None for r in self.slots),
+            "queued": len(self.queue),
+            "inflight_tokens": sum(len(r.out) for r in self.slots
+                                   if r is not None),
+        }
+
     def _can_admit(self, req: _Request) -> bool:
         return True            # dense slots carry their own reservation
 
@@ -393,6 +405,12 @@ class PagedDecodeServer(DecodeServer):
         # exceed max_blocks — only pool availability gates admission
         need = -(-(len(req.prompt) + req.max_new) // self.block_len)
         return len(self.free) >= need
+
+    def stats(self) -> Dict[str, int]:
+        out = super().stats()
+        out["blocks_total"] = self.total_blocks
+        out["blocks_free"] = len(self.free)
+        return out
 
     def _retire_or_keep(self, slot: int):
         ret = super()._retire_or_keep(slot)

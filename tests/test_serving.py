@@ -200,3 +200,23 @@ def test_moe_model_serves():
         srv = make()
         srv.submit("m", p, 6)
         assert srv.run()["m"] == want
+
+
+def test_server_stats_gauges(setup):
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    cfg, params = setup
+    srv = PagedDecodeServer(params, cfg, max_batch=2, max_len=32,
+                            total_blocks=6, block_len=4)
+    srv.submit("a", [1, 2, 3], 5)      # needs 2 blocks
+    srv.submit("b", [4, 5], 5)         # needs 2 blocks
+    s0 = srv.stats()
+    assert s0 == {"slots_total": 2, "slots_busy": 0, "queued": 2,
+                  "inflight_tokens": 0, "blocks_total": 6,
+                  "blocks_free": 6}
+    srv.step()
+    s1 = srv.stats()
+    assert s1["slots_busy"] == 2 and s1["queued"] == 0
+    assert s1["blocks_free"] == 2 and s1["inflight_tokens"] >= 2
+    srv.run()
+    s2 = srv.stats()
+    assert s2["slots_busy"] == 0 and s2["blocks_free"] == 6
