@@ -99,6 +99,24 @@ def make_file(path: str, nbytes: int) -> None:
     os.sync()
 
 
+def _raw_pass(engine, fh, size: int) -> float:
+    """One pipelined raw-read pass (payload discarded), GiB/s."""
+    chunk = engine.config.chunk_bytes
+    depth = max(2, engine.config.queue_depth // 2)
+    t0 = time.monotonic()
+    pend = []
+    for off in range(0, size, chunk):
+        pend.append(engine.submit_read(fh, off, min(chunk, size - off)))
+        if len(pend) >= depth:
+            p = pend.pop(0)
+            p.wait()
+            p.release()
+    for p in pend:
+        p.wait()
+        p.release()
+    return size / (1 << 30) / (time.monotonic() - t0)
+
+
 def bench_raw(engine, path: str, repeats: int = 3, cold: bool = True) -> float:
     """Raw SSD read bandwidth: pipelined engine reads, payload discarded.
     This is benchmark config 1 (BASELINE.md) and the denominator of the
@@ -109,26 +127,30 @@ def bench_raw(engine, path: str, repeats: int = 3, cold: bool = True) -> float:
     rates = []
     fh = engine.open(path)
     size = engine.file_size(fh)
-    chunk = engine.config.chunk_bytes
-    depth = max(2, engine.config.queue_depth // 2)
     for _ in range(repeats):
         if cold:
             evict_file(path)
-        t0 = time.monotonic()
-        pend = []
-        for off in range(0, size, chunk):
-            pend.append(engine.submit_read(fh, off, min(chunk, size - off)))
-            if len(pend) >= depth:
-                p = pend.pop(0)
-                p.wait()
-                p.release()
-        for p in pend:
-            p.wait()
-            p.release()
-        dt = time.monotonic() - t0
-        rates.append(size / (1 << 30) / dt)
+        rates.append(_raw_pass(engine, fh, size))
     engine.close(fh)
     return statistics.median(rates)
+
+
+def _link_bufs(outstanding: int, chunk_bytes: int):
+    import numpy as np
+    sz = chunk_bytes or (32 << 20)
+    return [np.random.default_rng(i).integers(0, 256, size=sz, dtype=np.uint8)
+            for i in range(outstanding)]
+
+
+def _link_pass(bufs, dev) -> float:
+    """One host→device burst with len(bufs) transfers in flight, GiB/s."""
+    import jax
+    t0 = time.monotonic()
+    arrs = [jax.device_put(b, dev) for b in bufs]
+    for a in arrs:
+        a.block_until_ready()
+    dt = time.monotonic() - t0
+    return sum(b.nbytes for b in bufs) / (1 << 30) / dt
 
 
 def bench_link(repeats: int = 3, outstanding: int = 6,
@@ -141,22 +163,31 @@ def bench_link(repeats: int = 3, outstanding: int = 6,
     6×32MiB transfers while the stream ran 16×4MiB, so the 'ceiling' had
     different concurrency than the thing it capped and NVMe→HBM came out
     above it (physically impossible, flagged by the verdict)."""
-    import numpy as np
     import jax
     dev = jax.devices()[0]
-    sz = chunk_bytes or (32 << 20)
-    bufs = [np.random.default_rng(i).integers(0, 256, size=sz, dtype=np.uint8)
-            for i in range(outstanding)]
+    bufs = _link_bufs(outstanding, chunk_bytes)
     jax.device_put(bufs[0], dev).block_until_ready()  # warmup
-    rates = []
-    for _ in range(repeats):
-        t0 = time.monotonic()
-        arrs = [jax.device_put(b, dev) for b in bufs]
-        for a in arrs:
-            a.block_until_ready()
-        dt = time.monotonic() - t0
-        rates.append(outstanding * sz / (1 << 30) / dt)
-    return statistics.median(rates)
+    return statistics.median(_link_pass(bufs, dev) for _ in range(repeats))
+
+
+def _stream_pass(ds, path: str, size: int) -> float:
+    """One NVMe→HBM streaming pass through a DeviceStream, GiB/s."""
+    t0 = time.monotonic()
+    n = 0
+    for arr in ds.stream_file(path):
+        n += arr.nbytes
+    dt = time.monotonic() - t0
+    assert n == size
+    return size / (1 << 30) / dt
+
+
+def _make_stream(engine, dev):
+    from nvme_strom_tpu.ops import DeviceStream
+    # Full queue depth: on a high-latency link (the axon tunnel) the
+    # pipeline needs enough chunks in flight to cover the bandwidth-delay
+    # product — depth=8 measured 0.10–1.0 GiB/s (latency-exposed, noisy),
+    # depth=16 a stable 1.17 GiB/s at 4MiB chunks on the same medium.
+    return DeviceStream(engine, device=dev, depth=engine.config.queue_depth)
 
 
 def bench_to_device(engine, path: str, repeats: int = 3,
@@ -167,27 +198,55 @@ def bench_to_device(engine, path: str, repeats: int = 3,
     planner then sees non-resident spans and the bytes ride O_DIRECT →
     staging → device (the north-star path).  cold=False leaves the cache
     warm, measuring the planner's deliberate page-cache fast path."""
-    from nvme_strom_tpu.ops import DeviceStream
     import jax
-    dev = jax.devices()[0]
-    # Full queue depth: on a high-latency link (the axon tunnel) the
-    # pipeline needs enough chunks in flight to cover the bandwidth-delay
-    # product — depth=8 measured 0.10–1.0 GiB/s (latency-exposed, noisy),
-    # depth=16 a stable 1.17 GiB/s at 4MiB chunks on the same medium.
-    ds = DeviceStream(engine, device=dev, depth=engine.config.queue_depth)
+    ds = _make_stream(engine, jax.devices()[0])
     size = os.path.getsize(path)
     rates = []
     for _ in range(repeats):
         if cold:
             evict_file(path)
-        t0 = time.monotonic()
-        n = 0
-        for arr in ds.stream_file(path):
-            n += arr.nbytes
-        dt = time.monotonic() - t0
-        assert n == size
-        rates.append(size / (1 << 30) / dt)
+        rates.append(_stream_pass(ds, path, size))
     return statistics.median(rates)
+
+
+def bench_interleaved(engine, path: str, rounds: int = 3) -> dict:
+    """North-star measurement with SAME-MINUTE ceilings.
+
+    The tunnel's bandwidth swings 0.1–1.6 GiB/s minute to minute, so
+    ceilings measured in separate passes let the stream 'beat' its own
+    ceiling (rounds 1 and 2 both hit this).  Here every round runs
+    raw→link→stream back-to-back (seconds apart), the north-star ratio
+    is computed PER ROUND against that round's own ceilings, and the
+    reported ratio is the median of per-round ratios — an apples-to-
+    apples number no matter how much the medium drifts across rounds.
+
+    Returns {"raw", "link", "hbm": medians (GiB/s), "ratio": median of
+    per-round hbm/(0.9·min(raw,link)), "rounds": per-round tuples}.
+    """
+    import jax
+    dev = jax.devices()[0]
+    ds = _make_stream(engine, dev)
+    fh = engine.open(path)
+    size = engine.file_size(fh)
+    bufs = _link_bufs(max(2, engine.config.queue_depth),
+                      engine.config.chunk_bytes)
+    jax.device_put(bufs[0], dev).block_until_ready()  # warmup
+    per = []
+    for i in range(rounds):
+        evict_file(path)
+        raw = _raw_pass(engine, fh, size)
+        link = _link_pass(bufs, dev)
+        evict_file(path)
+        hbm = _stream_pass(ds, path, size)
+        ceiling = min(raw, link)
+        ratio = hbm / (0.9 * ceiling) if ceiling > 0 else 0.0
+        per.append({"raw": raw, "link": link, "hbm": hbm, "ratio": ratio})
+        _log(f"bench: round {i}: raw={raw:.3f} link={link:.3f} "
+             f"hbm={hbm:.3f} GiB/s  ratio={ratio:.3f}")
+    engine.close(fh)
+    med = lambda k: statistics.median(r[k] for r in per)  # noqa: E731
+    return {"raw": med("raw"), "link": med("link"), "hbm": med("hbm"),
+            "ratio": med("ratio"), "rounds": per}
 
 
 def main() -> int:
@@ -210,29 +269,28 @@ def main() -> int:
 
     cfg = EngineConfig()
     stats = StromStats()
-    stream_depth = cfg.queue_depth
     with StromEngine(cfg, stats=stats) as engine:
         _log(f"bench: backend={engine.backend} chunk={cfg.chunk_bytes >> 20}MiB "
              f"depth={cfg.queue_depth} buffers={engine.n_buffers}")
-        raw = bench_raw(engine, path, cold=True)
-        _log(f"bench: raw SSD read (cold, median) = {raw:.3f} GiB/s")
-        # Ceiling with the SAME chunk size and concurrency as the stream.
-        link = bench_link(outstanding=stream_depth,
-                          chunk_bytes=cfg.chunk_bytes)
-        _log(f"bench: host->TPU link (matched {stream_depth}x"
-             f"{cfg.chunk_bytes >> 20}MiB) = {link:.3f} GiB/s")
         import jax
         _log(f"bench: device = {jax.devices()[0]}")
 
         engine.sync_stats()
         pre = dict(stats.snapshot())
-        hbm = bench_to_device(engine, path, cold=True)
+        # Interleaved raw→link→stream rounds: ceilings and stream are
+        # measured seconds apart, the ratio per-round (round-2 verdict
+        # weak #1 — separately-measured ceilings let the stream beat
+        # physics on a drifting medium).
+        inter = bench_interleaved(engine, path, rounds=3)
+        raw, link, hbm = inter["raw"], inter["link"], inter["hbm"]
         engine.sync_stats()
         post = dict(stats.snapshot())
         cold_bounce = post["bounce_bytes"] - pre["bounce_bytes"]
         cold_direct = post["bytes_direct"] - pre["bytes_direct"]
         cold_resident = post["bytes_resident"] - pre["bytes_resident"]
-        _log(f"bench: NVMe->HBM cold (median)     = {hbm:.3f} GiB/s "
+        _log(f"bench: medians raw={raw:.3f} link={link:.3f} "
+             f"NVMe->HBM={hbm:.3f} GiB/s  same-minute ratio="
+             f"{inter['ratio']:.3f} "
              f"[direct={cold_direct} bounce={cold_bounce} "
              f"resident={cold_resident}]")
 
@@ -258,18 +316,19 @@ def main() -> int:
          f"bytes_resident={stats.bytes_resident} "
          f"bytes_to_device={stats.bytes_to_device}")
 
-    ceiling = min(raw, link) if raw > 0 and link > 0 else max(raw, link, 1.0)
-    target = 0.9 * ceiling
     dev_tag = "tpu" if device_ok else "cpu-fallback-TUNNEL-DOWN"
-    # vs_baseline is only meaningful against the BASELINE.json north star
-    # (NVMe->HBM on a real TPU).  On CPU fallback raw/link are CPU-derived
-    # numbers and any ratio would misread as "target met" — emit null.
+    # vs_baseline is the SAME-MINUTE ratio (median over interleaved
+    # rounds of hbm/(0.9·min(raw,link)) within each round), only
+    # meaningful against the BASELINE.json north star (NVMe->HBM on a
+    # real TPU).  On CPU fallback raw/link are CPU-derived numbers and
+    # any ratio would misread as "target met" — emit null.
     print(json.dumps({
         "metric": f"NVMe->HBM sustained streaming (dev={dev_tag}, "
-                  f"bounce_bytes={bounce})",
+                  f"bounce_bytes={bounce}, interleaved raw="
+                  f"{raw:.3f} link={link:.3f} GiB/s)",
         "value": round(hbm, 3),
         "unit": "GiB/s",
-        "vs_baseline": round(hbm / target, 3) if device_ok else None,
+        "vs_baseline": round(inter["ratio"], 3) if device_ok else None,
     }), flush=True)
     try:
         os.unlink(path)

@@ -25,7 +25,8 @@ number:
               across mixed-length requests on fixed slots
               (models/serving.py; compute row → vs_baseline null)
 
-Usage: python bench_suite.py [--config N ... | --all] [--json-only]
+Usage: python bench_suite.py [--config N ... | --all]
+(stdout is already JSON-only — one line per config; logs go to stderr)
 
 I/O rows (1–5, 8): {"metric", "value" (GiB/s payload→device), "unit",
 "vs_baseline" (value / 0.9·min(raw SSD, host→device link) — the

@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""TPU-window watcher: auto-capture hardware evidence when the tunnel is up.
+
+The build box reaches one TPU v5e chip through a tunnel that flaps for
+hours at a time and whose client HANGS (rather than errors) when the
+relay is down.  Rounds 1 and 2 both ended with the driver's bench run
+hitting a dead tunnel, so no *driver-captured* artifact ever contained a
+TPU number — the on-silicon story lived only in hand-recorded notes.
+This watcher closes that loop (round-2 verdict, task #1):
+
+  - every PROBE_INTERVAL seconds, probe ``jax.devices()`` in a THROWAWAY
+    subprocess with a hard timeout (never in-process — a hung client
+    would wedge the watcher itself);
+  - the moment a probe succeeds, run the capture suite — ``bench.py``
+    (north-star stream with interleaved ceiling probes) and
+    ``bench_suite.py`` configs 5/6/7 (SQL scan, decode tok/s, MFU) —
+    each in its own subprocess with a generous timeout so a mid-capture
+    tunnel death loses one step, not the evidence already gathered;
+  - append every JSON result line, timestamped, to the committed ledger
+    ``BENCH_tpu_ledger.jsonl`` and git-commit it immediately, so the
+    evidence survives even if the session dies seconds later.
+
+Probe/window history goes to ``TPU_WINDOWS.jsonl`` (one line per state
+change) so the round's up/down record is itself an artifact.
+
+Usage:
+    python -m nvme_strom_tpu.tools.tpu_watcher [--once] [--interval S]
+
+Runs forever by default (meant for a tmux pane / background process);
+``--once`` does a single probe(+capture) and exits, for tests and manual
+checks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+LEDGER = os.path.join(REPO, "BENCH_tpu_ledger.jsonl")
+WINDOWS = os.path.join(REPO, "TPU_WINDOWS.jsonl")
+
+PROBE_TIMEOUT_S = 75
+PROBE_INTERVAL_S = 240
+#: don't re-run the full capture more often than this while the tunnel
+#: stays up — each capture is ~5-10 min of tunnel traffic, and more
+#: samples per window beat hammering one window continuously.
+CAPTURE_COOLDOWN_S = 2700
+CAPTURE_TIMEOUT_S = 2400
+
+
+def _now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def _log(msg: str) -> None:
+    print(f"[tpu_watcher {_now()}] {msg}", file=sys.stderr, flush=True)
+
+
+def _append(path: str, obj: dict) -> None:
+    with open(path, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+
+
+def probe() -> dict:
+    """One tunnel probe in a throwaway subprocess.  Returns a record with
+    ``up`` plus the device string or the failure mode (timeout vs error)."""
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices()[0]; print(d.platform, d)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+            cwd=REPO)
+        dt = round(time.monotonic() - t0, 1)
+        if r.returncode == 0 and r.stdout.strip().startswith("tpu"):
+            return {"up": True, "device": r.stdout.strip(), "probe_s": dt}
+        return {"up": False, "mode": "error", "probe_s": dt,
+                "detail": (r.stdout + r.stderr).strip()[-200:]}
+    except subprocess.TimeoutExpired:
+        return {"up": False, "mode": "timeout",
+                "probe_s": round(time.monotonic() - t0, 1)}
+
+
+def _run_step(name: str, cmd: list[str], env_extra: dict | None = None,
+              timeout_s: int = CAPTURE_TIMEOUT_S) -> dict:
+    """Run one capture step; harvest every JSON line from its stdout and
+    the tail of its stderr.  A timeout or crash is recorded, not fatal —
+    the tunnel can die mid-step and the other steps' results must land."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.monotonic()
+    rec: dict = {"step": name, "cmd": " ".join(cmd), "ts": _now()}
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, cwd=REPO, env=env)
+        rec["rc"] = r.returncode
+        rec["stderr_tail"] = r.stderr.strip().splitlines()[-12:]
+        results = []
+        for line in r.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    results.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        rec["results"] = results
+    except subprocess.TimeoutExpired as e:
+        rec["rc"] = -1
+        rec["error"] = f"timeout after {timeout_s}s"
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        rec["stdout_tail"] = out.strip().splitlines()[-12:]
+    rec["elapsed_s"] = round(time.monotonic() - t0, 1)
+    return rec
+
+
+def capture(device: str) -> None:
+    """Full evidence capture: north-star bench + compute/SQL suite rows.
+    Each step appends to the ledger and is committed as soon as the whole
+    capture ends (or dies) — evidence first, tidiness second."""
+    _log(f"capture START on {device!r}")
+    steps = [
+        ("bench", [sys.executable, "bench.py"], None),
+        ("suite_5_6_7",
+         [sys.executable, "bench_suite.py", "--config", "5", "--config", "6",
+          "--config", "7"], None),
+    ]
+    for name, cmd, env_extra in steps:
+        rec = _run_step(name, cmd, env_extra)
+        rec["device"] = device
+        _append(LEDGER, rec)
+        n = len(rec.get("results", []))
+        _log(f"capture step {name}: rc={rec.get('rc')} "
+             f"results={n} in {rec['elapsed_s']}s")
+        # If the step found the tunnel already dead, don't burn the
+        # remaining steps' timeouts on it.  bench.py exits 0 on its CPU
+        # fallback — the down marker is in its JSON metric, not the rc.
+        if _looks_down(rec):
+            _log("capture step reports tunnel down; aborting capture")
+            break
+    _commit()
+    _log("capture DONE")
+
+
+def _looks_down(rec: dict) -> bool:
+    """Did this step observe a dead tunnel?  Three signatures: the step's
+    own probe logged a timeout (stderr), a harvested JSON metric is
+    tagged cpu-fallback (bench.py exits 0 on fallback — the marker is in
+    its result line, not the rc), or the step itself timed out."""
+    tail = " ".join(rec.get("stderr_tail", []) or []) + " ".join(
+        rec.get("stdout_tail", []) or [])
+    metrics = " ".join(str(r.get("metric", ""))
+                       for r in rec.get("results", []))
+    return ("TIMED OUT" in tail or "cpu-fallback" in tail
+            or "cpu-fallback" in metrics
+            or rec.get("error", "").startswith("timeout"))
+
+
+def _commit() -> None:
+    """Commit the ledgers so evidence survives a dead session.  Nothing
+    else is staged — the watcher must never sweep up unrelated WIP."""
+    try:
+        subprocess.run(["git", "add", "--", os.path.basename(LEDGER),
+                        os.path.basename(WINDOWS)],
+                       cwd=REPO, capture_output=True, timeout=30)
+        r = subprocess.run(
+            ["git", "commit", "-m",
+             "TPU watcher: captured on-silicon bench evidence",
+             "--", os.path.basename(LEDGER), os.path.basename(WINDOWS)],
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+        if r.returncode == 0:
+            _log("ledger committed")
+        else:
+            _log(f"commit skipped: {r.stdout.strip()[-120:]}")
+    except Exception as e:  # noqa: BLE001 — watcher must not die
+        _log(f"commit failed: {e}")
+
+
+def watch(interval_s: int = PROBE_INTERVAL_S, once: bool = False) -> int:
+    last_state: bool | None = None
+    last_capture: float | None = None  # None = never (monotonic has no epoch)
+    while True:
+        rec = probe()
+        rec["ts"] = _now()
+        up = rec["up"]
+        if up != last_state:
+            _append(WINDOWS, rec)
+            _log(f"state change: {'UP ' + rec.get('device', '') if up else 'DOWN'}")
+            last_state = up
+        else:
+            _log(f"probe: {'up' if up else 'down'} ({rec.get('mode', '')})")
+        if up and (last_capture is None
+                   or time.monotonic() - last_capture > CAPTURE_COOLDOWN_S):
+            last_capture = time.monotonic()
+            capture(rec.get("device", "tpu"))
+        if once:
+            return 0 if up else 1
+        time.sleep(interval_s)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--once", action="store_true",
+                    help="single probe (+capture if up), then exit")
+    ap.add_argument("--interval", type=int, default=PROBE_INTERVAL_S,
+                    help="seconds between probes (default %(default)s)")
+    args = ap.parse_args()
+    _log(f"watching (interval={args.interval}s, probe timeout="
+         f"{PROBE_TIMEOUT_S}s, ledger={os.path.basename(LEDGER)})")
+    return watch(args.interval, args.once)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Watcher tests: JSON harvesting, down-detection, ledger append.
+
+The probe path itself needs the real tunnel (and hangs when it's down),
+so these tests exercise everything AROUND the probe: step execution with
+JSON-line harvesting, the tunnel-death heuristic that aborts a capture,
+and the state-change ledger discipline."""
+
+import json
+import os
+import sys
+
+from nvme_strom_tpu.tools import tpu_watcher as tw
+
+
+def test_run_step_harvests_json_lines(tmp_path):
+    script = tmp_path / "fake_bench.py"
+    script.write_text(
+        "import json, sys\n"
+        "print('noise line')\n"
+        "print(json.dumps({'metric': 'm', 'value': 1.5}))\n"
+        "print('{not json')\n"
+        "print(json.dumps({'metric': 'n', 'value': 2}))\n"
+        "print('done', file=sys.stderr)\n")
+    rec = tw._run_step("fake", [sys.executable, str(script)], timeout_s=60)
+    assert rec["rc"] == 0
+    assert [r["metric"] for r in rec["results"]] == ["m", "n"]
+    assert rec["stderr_tail"] == ["done"]
+    assert rec["elapsed_s"] >= 0
+
+
+def test_run_step_timeout_is_recorded_not_fatal(tmp_path):
+    script = tmp_path / "hang.py"
+    script.write_text("import time\nprint('started', flush=True)\n"
+                      "time.sleep(60)\n")
+    rec = tw._run_step("hang", [sys.executable, str(script)], timeout_s=2)
+    assert rec["rc"] == -1
+    assert rec["error"].startswith("timeout")
+    assert tw._looks_down(rec)
+
+
+def test_looks_down_heuristic():
+    assert tw._looks_down({"stderr_tail": ["bench: device probe TIMED OUT"]})
+    assert tw._looks_down(
+        {"stderr_tail": [], "stdout_tail": ["dev=cpu-fallback-TUNNEL-DOWN"]})
+    # bench.py exits 0 on CPU fallback; the marker lands in the harvested
+    # JSON metric, which must trigger the abort even with rc == 0.
+    assert tw._looks_down(
+        {"rc": 0, "stderr_tail": [],
+         "results": [{"metric": "NVMe->HBM (dev=cpu-fallback-TUNNEL-DOWN)",
+                      "value": 1.0}]})
+    assert not tw._looks_down(
+        {"rc": 0, "stderr_tail": ["bench: device = TPU v5"],
+         "results": [{"metric": "NVMe->HBM (dev=tpu, bounce_bytes=0)"}]})
+
+
+def test_append_is_jsonl(tmp_path):
+    p = tmp_path / "ledger.jsonl"
+    tw._append(str(p), {"a": 1})
+    tw._append(str(p), {"b": 2})
+    lines = [json.loads(x) for x in p.read_text().splitlines()]
+    assert lines == [{"a": 1}, {"b": 2}]
+
+
+def test_probe_failure_modes_shape(monkeypatch):
+    # probe() against a guaranteed-fast-failing interpreter: the record
+    # must carry mode=error (not up) without raising.
+    monkeypatch.setattr(tw, "PROBE_TIMEOUT_S", 30)
+    monkeypatch.setattr(
+        tw.subprocess, "run",
+        lambda *a, **k: type("R", (), {"returncode": 1, "stdout": "",
+                                       "stderr": "boom"})())
+    rec = tw.probe()
+    assert rec == {"up": False, "mode": "error", "probe_s": rec["probe_s"],
+                   "detail": "boom"}
+
+
+def test_ledger_paths_are_repo_root():
+    assert os.path.dirname(tw.LEDGER) == tw.REPO
+    assert os.path.basename(tw.LEDGER) == "BENCH_tpu_ledger.jsonl"
+    assert os.path.isfile(os.path.join(tw.REPO, "bench.py"))
