@@ -63,9 +63,14 @@ def probe_device(timeout_s: int = 120) -> bool:
             [sys.executable, "-c",
              "import jax; d=jax.devices()[0]; print(d.platform)"],
             capture_output=True, text=True, timeout=timeout_s)
-        ok = r.returncode == 0
+        # rc==0 alone is not enough: a jax that silently fell back to the
+        # host platform would exit 0 and the artifact would claim dev=tpu
+        # for a CPU run.
+        ok = r.returncode == 0 and r.stdout.strip() != "cpu"
         if not ok:
-            _log(f"bench: device probe failed: {r.stderr.strip()[-200:]}")
+            _log(f"bench: device probe failed (rc={r.returncode}, "
+                 f"platform={r.stdout.strip()!r}): "
+                 f"{r.stderr.strip()[-200:]}")
             _log(_TPU_EVIDENCE_NOTE)
         return ok
     except subprocess.TimeoutExpired:
@@ -221,7 +226,11 @@ def bench_interleaved(engine, path: str, rounds: int = 3) -> dict:
     apples number no matter how much the medium drifts across rounds.
 
     Returns {"raw", "link", "hbm": medians (GiB/s), "ratio": median of
-    per-round hbm/(0.9·min(raw,link)), "rounds": per-round tuples}.
+    per-round hbm/(0.9·min(raw,link)), "rounds": per-round tuples,
+    "stream_bounce"/"stream_direct"/"stream_resident": byte counters
+    accumulated across the STREAM passes only — the raw passes also push
+    bytes through the engine, so a whole-run stats window would misread
+    raw-pass traffic as the stream's}.
     """
     import jax
     dev = jax.devices()[0]
@@ -232,12 +241,20 @@ def bench_interleaved(engine, path: str, rounds: int = 3) -> dict:
                       engine.config.chunk_bytes)
     jax.device_put(bufs[0], dev).block_until_ready()  # warmup
     per = []
+    stream_delta = {"bounce_bytes": 0, "bytes_direct": 0,
+                    "bytes_resident": 0}
     for i in range(rounds):
         evict_file(path)
         raw = _raw_pass(engine, fh, size)
         link = _link_pass(bufs, dev)
         evict_file(path)
+        engine.sync_stats()
+        pre = dict(engine.stats.snapshot())
         hbm = _stream_pass(ds, path, size)
+        engine.sync_stats()
+        post = dict(engine.stats.snapshot())
+        for k in stream_delta:
+            stream_delta[k] += post[k] - pre[k]
         ceiling = min(raw, link)
         ratio = hbm / (0.9 * ceiling) if ceiling > 0 else 0.0
         per.append({"raw": raw, "link": link, "hbm": hbm, "ratio": ratio})
@@ -246,7 +263,10 @@ def bench_interleaved(engine, path: str, rounds: int = 3) -> dict:
     engine.close(fh)
     med = lambda k: statistics.median(r[k] for r in per)  # noqa: E731
     return {"raw": med("raw"), "link": med("link"), "hbm": med("hbm"),
-            "ratio": med("ratio"), "rounds": per}
+            "ratio": med("ratio"), "rounds": per,
+            "stream_bounce": stream_delta["bounce_bytes"],
+            "stream_direct": stream_delta["bytes_direct"],
+            "stream_resident": stream_delta["bytes_resident"]}
 
 
 def main() -> int:
@@ -275,19 +295,17 @@ def main() -> int:
         import jax
         _log(f"bench: device = {jax.devices()[0]}")
 
-        engine.sync_stats()
-        pre = dict(stats.snapshot())
         # Interleaved raw→link→stream rounds: ceilings and stream are
         # measured seconds apart, the ratio per-round (round-2 verdict
         # weak #1 — separately-measured ceilings let the stream beat
-        # physics on a drifting medium).
+        # physics on a drifting medium).  Byte counters come from the
+        # per-stream-pass windows inside bench_interleaved — a whole-run
+        # window would attribute the raw passes' traffic to the stream.
         inter = bench_interleaved(engine, path, rounds=3)
         raw, link, hbm = inter["raw"], inter["link"], inter["hbm"]
-        engine.sync_stats()
-        post = dict(stats.snapshot())
-        cold_bounce = post["bounce_bytes"] - pre["bounce_bytes"]
-        cold_direct = post["bytes_direct"] - pre["bytes_direct"]
-        cold_resident = post["bytes_resident"] - pre["bytes_resident"]
+        cold_bounce = inter["stream_bounce"]
+        cold_direct = inter["stream_direct"]
+        cold_resident = inter["stream_resident"]
         _log(f"bench: medians raw={raw:.3f} link={link:.3f} "
              f"NVMe->HBM={hbm:.3f} GiB/s  same-minute ratio="
              f"{inter['ratio']:.3f} "

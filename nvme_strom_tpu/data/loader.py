@@ -66,6 +66,27 @@ def _process_span(sharding, global_shape, dim: int, proc: int):
     return lo, hi
 
 
+def _group_blocks(blocks: dict, n_blk: int, pi: int,
+                  axis: str) -> tuple:
+    """Validate and index the process→batch-block map.
+
+    ``blocks`` maps process_index → set of batch-axis block starts that
+    process's devices cover.  Groups must partition the blocks into
+    equal tiles: overlapping or unequal coverage would assign disjoint
+    shard lists to processes that feed the SAME global rows (silent
+    data corruption), or break local_batch = global/n_groups."""
+    groups = sorted({frozenset(b) for b in blocks.values()}, key=min)
+    all_blocks = [b for g in groups for b in g]
+    if (len(all_blocks) != len(set(all_blocks))
+            or set(all_blocks) != set(range(n_blk))
+            or len({len(g) for g in groups}) != 1):
+        raise ValueError(
+            f"batch axis {axis!r}: process groups do not tile the "
+            f"axis blocks equally ({[sorted(g) for g in groups]}) — "
+            "unsupported mesh layout")
+    return groups.index(frozenset(blocks[pi])), len(groups)
+
+
 def _default_decode(parts: dict) -> np.ndarray:
     """Single-part raw samples → uint8 array (copy: counted by caller)."""
     if len(parts) != 1:
@@ -173,21 +194,7 @@ class ShardedLoader:
         for d, idx in sh.devices_indices_map((n_blk,)).items():
             blocks.setdefault(d.process_index, set()).add(
                 idx[0].start or 0)
-        groups = sorted({frozenset(b) for b in blocks.values()},
-                        key=min)
-        # groups must partition the blocks into equal tiles: overlapping
-        # or unequal coverage would assign disjoint shard lists to
-        # processes that feed the SAME global rows (silent data
-        # corruption), or break local_batch = global/n_groups
-        all_blocks = [b for g in groups for b in g]
-        if (len(all_blocks) != len(set(all_blocks))
-                or set(all_blocks) != set(range(n_blk))
-                or len({len(g) for g in groups}) != 1):
-            raise ValueError(
-                f"batch axis {axis!r}: process groups do not tile the "
-                f"axis blocks equally ({[sorted(g) for g in groups]}) — "
-                "unsupported mesh layout")
-        return groups.index(frozenset(blocks[pi])), len(groups)
+        return _group_blocks(blocks, n_blk, pi, axis)
 
     # -- sample iteration (host side) -------------------------------------
 

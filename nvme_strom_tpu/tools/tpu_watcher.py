@@ -88,18 +88,16 @@ def probe() -> dict:
                 "probe_s": round(time.monotonic() - t0, 1)}
 
 
-def _run_step(name: str, cmd: list[str], env_extra: dict | None = None,
+def _run_step(name: str, cmd: list[str],
               timeout_s: int = CAPTURE_TIMEOUT_S) -> dict:
     """Run one capture step; harvest every JSON line from its stdout and
     the tail of its stderr.  A timeout or crash is recorded, not fatal —
     the tunnel can die mid-step and the other steps' results must land."""
-    env = dict(os.environ)
-    env.update(env_extra or {})
     t0 = time.monotonic()
     rec: dict = {"step": name, "cmd": " ".join(cmd), "ts": _now()}
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout_s, cwd=REPO, env=env)
+                           timeout=timeout_s, cwd=REPO)
         rec["rc"] = r.returncode
         rec["stderr_tail"] = r.stderr.strip().splitlines()[-12:]
         results = []
@@ -122,21 +120,29 @@ def _run_step(name: str, cmd: list[str], env_extra: dict | None = None,
     return rec
 
 
-def capture(device: str) -> None:
+def capture(device: str) -> bool:
     """Full evidence capture: north-star bench + compute/SQL suite rows.
-    Each step appends to the ledger and is committed as soon as the whole
-    capture ends (or dies) — evidence first, tidiness second."""
+    Each step appends to the ledger and is COMMITTED IMMEDIATELY — the
+    next step can run for up to CAPTURE_TIMEOUT_S, and a session dying
+    mid-step must not take already-captured evidence with it.
+
+    Returns False when a step observed a dead tunnel (the capture was a
+    dud): the caller then must NOT charge the capture cooldown, or a
+    probe that raced a closing window would block the next real window
+    for CAPTURE_COOLDOWN_S."""
     _log(f"capture START on {device!r}")
+    ok = True
     steps = [
-        ("bench", [sys.executable, "bench.py"], None),
+        ("bench", [sys.executable, "bench.py"]),
         ("suite_5_6_7",
          [sys.executable, "bench_suite.py", "--config", "5", "--config", "6",
-          "--config", "7"], None),
+          "--config", "7"]),
     ]
-    for name, cmd, env_extra in steps:
-        rec = _run_step(name, cmd, env_extra)
+    for name, cmd in steps:
+        rec = _run_step(name, cmd)
         rec["device"] = device
         _append(LEDGER, rec)
+        _commit()
         n = len(rec.get("results", []))
         _log(f"capture step {name}: rc={rec.get('rc')} "
              f"results={n} in {rec['elapsed_s']}s")
@@ -145,9 +151,10 @@ def capture(device: str) -> None:
         # fallback — the down marker is in its JSON metric, not the rc.
         if _looks_down(rec):
             _log("capture step reports tunnel down; aborting capture")
+            ok = False
             break
-    _commit()
-    _log("capture DONE")
+    _log(f"capture DONE (ok={ok})")
+    return ok
 
 
 def _looks_down(rec: dict) -> bool:
@@ -188,19 +195,32 @@ def watch(interval_s: int = PROBE_INTERVAL_S, once: bool = False) -> int:
     last_state: bool | None = None
     last_capture: float | None = None  # None = never (monotonic has no epoch)
     while True:
-        rec = probe()
-        rec["ts"] = _now()
-        up = rec["up"]
-        if up != last_state:
-            _append(WINDOWS, rec)
-            _log(f"state change: {'UP ' + rec.get('device', '') if up else 'DOWN'}")
-            last_state = up
-        else:
-            _log(f"probe: {'up' if up else 'down'} ({rec.get('mode', '')})")
-        if up and (last_capture is None
-                   or time.monotonic() - last_capture > CAPTURE_COOLDOWN_S):
-            last_capture = time.monotonic()
-            capture(rec.get("device", "tpu"))
+        up = False
+        try:
+            rec = probe()
+            rec["ts"] = _now()
+            up = rec["up"]
+            if up != last_state:
+                _append(WINDOWS, rec)
+                _log("state change: "
+                     f"{'UP ' + rec.get('device', '') if up else 'DOWN'}")
+                last_state = up
+            else:
+                _log(f"probe: {'up' if up else 'down'} "
+                     f"({rec.get('mode', '')})")
+            if up and (last_capture is None
+                       or time.monotonic() - last_capture
+                       > CAPTURE_COOLDOWN_S):
+                # Charge the cooldown only for a capture that really ran:
+                # a dud (tunnel died between probe and capture) must not
+                # block the next real window for 45 minutes.
+                if capture(rec.get("device", "tpu")):
+                    last_capture = time.monotonic()
+        except Exception as e:  # noqa: BLE001 — unattended: must survive
+            # transient EIO/disk-full on the ledger append, subprocess
+            # OSErrors, ... — log and keep probing; dying silently in a
+            # background pane loses every later window.
+            _log(f"watch loop error (suppressed): {e!r}")
         if once:
             return 0 if up else 1
         time.sleep(interval_s)
