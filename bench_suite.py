@@ -194,22 +194,26 @@ def make_safetensors_shards(dirpath: str, nbytes: int,
     return paths
 
 
-def make_parquet_file(path: str, nbytes: int, num_groups: int = 64) -> int:
+def make_parquet_file(path: str, nbytes: int, num_groups: int = 64,
+                      compression: str = "none") -> int:
     import numpy as np
     import pyarrow as pa
     import pyarrow.parquet as pq
-    if not _needs_regen("parquet", nbytes, gen=2) and os.path.exists(path):
+    tag = "parquet" if compression == "none" else f"parquet_{compression}"
+    if not _needs_regen(tag, nbytes, gen=2) and os.path.exists(path):
         return os.path.getsize(path)
     rows = max(4096, nbytes // 8)            # int32 key + float32 value
     rng = np.random.default_rng(0)
     tbl = pa.table({
         "k": pa.array(rng.integers(0, num_groups, rows, dtype=np.int32)),
         "v": pa.array(rng.standard_normal(rows, dtype=np.float32))})
-    # PLAIN + uncompressed: the shape PG-Strom-style on-device decode
-    # handles (sql/pq_direct.py) — config 5 measures the direct scan.
+    # PLAIN pages: the shape PG-Strom-style on-device decode handles
+    # (sql/pq_direct.py) — config 5 measures the uncompressed direct
+    # scan, config 12 the compressed one (engine-read compressed spans,
+    # host decompress, device decode).
     pq.write_table(tbl, path, row_group_size=max(4096, rows // 16),
-                   compression="none", use_dictionary=False)
-    _mark_generated("parquet", nbytes, gen=2)
+                   compression=compression, use_dictionary=False)
+    _mark_generated(tag, nbytes, gen=2)
     return os.path.getsize(path)
 
 
@@ -301,6 +305,43 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
         return size / (1 << 30) / dt
 
     return _steady([path], one_scan), rows
+
+
+def bench_sql_zstd(engine, nbytes: int, num_groups: int = 64,
+                   device=None) -> tuple[float, str]:
+    """Config 12: zstd-compressed scan, direct path vs pyarrow fallback
+    on the SAME file (round-2 verdict #4 — real tables are compressed).
+
+    Direct path: compressed page spans ride O_DIRECT, host decompress,
+    on-device bitcast + GROUP BY.  Fallback: pyarrow decodes the table
+    on host.  Reports the direct rate (compressed GiB/s off the SSD)
+    with the fallback rate and speedup in the tag."""
+    from nvme_strom_tpu.sql.parquet import ParquetScanner
+    from nvme_strom_tpu.sql.groupby import groupby_aggregate
+    path = os.path.join(_scratch_dir(), "table_zstd.parquet")
+    size = make_parquet_file(path, nbytes, num_groups,
+                             compression="zstd")
+    scanner = ParquetScanner(path, engine)
+    rows = scanner.num_rows
+
+    def scan(direct: str) -> float:
+        t0 = time.monotonic()
+        cols = scanner.read_columns_to_device(["k", "v"], direct=direct,
+                                              device=device)
+        out = groupby_aggregate(cols["k"], cols["v"], num_groups,
+                                aggs=("count", "sum"))
+        for v in out.values():
+            v.block_until_ready()
+        return time.monotonic() - t0
+
+    dt_direct = _steady([path], lambda: 1.0 / scan("always"))
+    dt_pyarrow = _steady([path], lambda: 1.0 / scan("never"))
+    rate = size / (1 << 30) * dt_direct          # dt_* are 1/seconds
+    speedup = dt_direct / dt_pyarrow
+    _log(f"suite: zstd scan {rows} rows ({size >> 20} MiB compressed): "
+         f"direct={1 / dt_direct:.3f}s pyarrow={1 / dt_pyarrow:.3f}s "
+         f"speedup={speedup:.2f}x")
+    return rate, f"speedup_vs_pyarrow={speedup:.2f}x"
 
 
 def bench_checkpoint_write(engine, nbytes: int) -> tuple[float, str]:
@@ -769,6 +810,11 @@ def run(configs: list[int]) -> list[dict]:
             10: ("kv-offload-decode",
                  lambda: bench_kv_offload(engine), "tok/s", False),
             11: ("serving-throughput", bench_serving, "tok/s", False),
+            # decompression-bound, not link-bound: the speedup vs the
+            # pyarrow fallback (in the tag) is the claim, not a ratio
+            # against the raw-read ceiling
+            12: ("parquet-zstd-scan",
+                 lambda: bench_sql_zstd(engine, nbytes), "GiB/s", False),
         }
         for c in configs:
             label, fn, unit, io_row = names[c]
@@ -800,12 +846,12 @@ def run(configs: list[int]) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 12))
+                    choices=range(1, 13))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = list(range(1, 12))
+        configs = list(range(1, 13))
     for line in run(configs):
         print(json.dumps(line), flush=True)
     return 0

@@ -81,14 +81,27 @@ class DeviceStream:
     ``depth`` chunks are kept in flight: while chunk *k* rides PCIe to the
     device, chunks *k+1 … k+depth* are being DMA'd from NVMe into staging
     buffers.  Yields device-resident arrays.
+
+    ``drain``: "blocking" waits on the OLDEST transfer once ``depth``
+    are in flight (the round-2 behavior); "ready" additionally retires
+    any already-completed head transfers opportunistically
+    (``jax.Array.is_ready``) after every dispatch, so staging buffers
+    recycle the moment the device is done with them instead of waiting
+    for the pipeline to fill — on a high-latency link this keeps the
+    NVMe side of the pipe fed (round-2 verdict: the 0.69 stream
+    efficiency investigation, task #2).
     """
 
-    def __init__(self, engine: StromEngine, device=None, depth: int = 3):
+    def __init__(self, engine: StromEngine, device=None, depth: int = 3,
+                 drain: str = "blocking"):
         if depth < 1:
             raise ValueError("depth must be >= 1")
+        if drain not in ("blocking", "ready"):
+            raise ValueError(f"bad drain={drain!r}")
         self.engine = engine
         self.device = device
         self.depth = depth
+        self.drain = drain
 
     def _put(self, view: np.ndarray, dtype, shape):
         dev = self.device or _default_device()
@@ -129,6 +142,14 @@ class DeviceStream:
             pr.release()
             return arr
 
+        def drain_ready():
+            # retire completed head transfers without blocking: their
+            # staging buffers go back to the pool NOW, so the engine
+            # can keep reading ahead instead of stalling on buffers
+            # still pinned under long-done transfers
+            while inflight and inflight[0][0].is_ready():
+                yield drain_one()
+
         it = iter(ranges)
         shapes_it = iter(shapes) if shapes is not None else None
         try:
@@ -140,6 +161,8 @@ class DeviceStream:
                     pr, shp = pending.pop(0)
                     view = pr.wait()
                     inflight.append((self._put(view, dtype, shp), pr))
+                    if self.drain == "ready":
+                        yield from drain_ready()
                     while len(inflight) > self.depth:
                         yield drain_one()
             for pr, shp in pending:

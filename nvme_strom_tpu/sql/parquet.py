@@ -210,13 +210,20 @@ class ParquetScanner:
 
     def read_columns_to_device(self, columns: List[str], device=None,
                                dtype_map: Optional[Dict] = None,
-                               direct: str = "auto"):
+                               direct: str = "auto",
+                               nulls: str = "forbid"):
         """Scan → device-resident columns (on-device concat of row groups).
 
         ``direct``: "auto" takes the pq_direct page-span path (payload
-        bytes never touched by host, decode = on-device bitcast) whenever
-        every selected column is eligible, else pyarrow; "always"
-        raises on ineligible columns; "never" forces pyarrow.
+        bytes never touched by host except page decompression, decode =
+        on-device bitcast/gather) whenever every selected column is
+        eligible, else pyarrow; "always" raises on ineligible columns;
+        "never" forces pyarrow.
+
+        ``nulls``: "forbid" (default) raises on columns with nulls;
+        "mask" returns ``(values, valid_mask)`` per column — null slots
+        zero-filled, the mask is the truth (both paths agree on this
+        contract).
         """
         import jax
         import jax.numpy as jnp
@@ -226,36 +233,52 @@ class ParquetScanner:
 
         if direct not in ("auto", "always", "never"):
             raise ValueError(f"bad direct={direct!r}")
+        if nulls not in ("forbid", "mask"):
+            raise ValueError(f"bad nulls={nulls!r}")
         if direct != "never":
             # One metadata walk: plan_columns both validates eligibility
             # and computes the page spans (a plan failure IS the
             # fallback signal — e.g. an encoding the footer can't rule
             # out, like a non-PLAIN page discovered mid-walk).
             try:
-                plans = pq_direct.plan_columns(self, columns)
+                plans = pq_direct.plan_columns(
+                    self, columns, allow_nulls=nulls == "mask")
             except ValueError:
                 if direct == "always":
                     raise
                 plans = None
             if plans is not None:
                 cols = pq_direct.read_plain_columns_to_device(
-                    self, columns, device=dev, plans=plans)
+                    self, columns, device=dev, plans=plans, nulls=nulls)
                 if dtype_map:
-                    cols = {c: (v.astype(dtype_map[c])
-                                if c in dtype_map else v)
-                            for c, v in cols.items()}
+                    def cast(c, v):
+                        if c not in dtype_map:
+                            return v
+                        if isinstance(v, tuple):
+                            return v[0].astype(dtype_map[c]), v[1]
+                        return v.astype(dtype_map[c])
+                    cols = {c: cast(c, v) for c, v in cols.items()}
                 return cols
 
         parts: Dict[str, list] = {c: [] for c in columns}
+        masks: Dict[str, list] = {c: [] for c in columns}
         for tbl in self.iter_row_groups(columns):
             for c in columns:
-                col = tbl.column(c)
-                arr = (col.to_numpy(zero_copy_only=False)
-                       if col.null_count == 0 else None)
-                if arr is None:
-                    raise ValueError(f"column {c} has nulls")
+                col = tbl.column(c).combine_chunks()
+                if col.null_count and nulls == "forbid":
+                    raise ValueError(
+                        f"column {c} has nulls; pass nulls='mask'")
+                if nulls == "mask":
+                    masks[c].append(host_to_device(
+                        self.engine,
+                        col.is_valid().to_numpy(zero_copy_only=False),
+                        dev))
+                    col = col.fill_null(0)
+                arr = col.to_numpy(zero_copy_only=False)
                 if dtype_map and c in dtype_map:
                     arr = arr.astype(dtype_map[c])
                 parts[c].append(host_to_device(self.engine, arr, dev))
-        return {c: (v[0] if len(v) == 1 else jnp.concatenate(v))
-                for c, v in parts.items()}
+        cat = lambda v: v[0] if len(v) == 1 else jnp.concatenate(v)  # noqa: E731
+        if nulls == "mask":
+            return {c: (cat(parts[c]), cat(masks[c])) for c in columns}
+        return {c: cat(v) for c, v in parts.items()}

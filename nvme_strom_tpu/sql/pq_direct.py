@@ -25,9 +25,28 @@ for uncompressed, fixed-width columns, two page shapes:
   back to PLAIN mid-stream (dictionary overflow) assemble both kinds in
   page order.
 
-Everything else — compression, nulls, strings, nested schemas — falls
-back to the pyarrow path in :mod:`.parquet`, which decodes on host and
-honestly counts the handoff copy as bounce.
+- **Compressed** chunks (SNAPPY / ZSTD / GZIP / BROTLI / LZ4_RAW) stay on
+  the direct path: the compressed page spans ride O_DIRECT through the
+  engine exactly like plain spans (less disk traffic — compressed size),
+  the host decompresses each page body (pyarrow's codec library; the
+  decompressed bytes are honestly counted as bounce — codecs are
+  sequential bitstream control flow, host work by nature), and the value
+  decode (bitcast / dictionary gather) still happens on device.  v2 data
+  pages keep their level blocks uncompressed ahead of the values region
+  (and may mark individual pages ``is_compressed=false``); v1 pages
+  compress levels+values together, so their levels parse from the
+  decompressed body.
+- **Nulls** (``nulls="mask"``): definition levels decode host-side
+  (plan time when raw, decode time inside compressed v1 bodies) into a
+  per-page validity mask; dense non-null values take their normal path
+  (zero-copy stream when uncompressed!) and a cumsum-gather ON DEVICE
+  scatters them to full page length, null slots zero-filled.  Consumers
+  get ``(values, mask)`` pairs.
+
+Everything else — exotic codecs (legacy framed LZ4), strings outside the
+dict-code scan, nested/repeated schemas — falls back to the pyarrow path
+in :mod:`.parquet`, which decodes on host and honestly counts the
+handoff copy as bounce.
 
 Why not decode the index bitstream on device too?  RLE runs are
 variable-length sequential control flow; a Pallas cursor over them would
@@ -183,6 +202,10 @@ class PageHeader:
     # reader must instead parse RLE length prefixes from the page body)
     def_levels_len: int = 0
     rep_levels_len: int = 0
+    # DataPageHeaderV2 field 7: false = the values region is stored RAW
+    # even though the chunk declares a codec (writers skip codecs that
+    # don't pay — pyarrow does this routinely for dict index streams)
+    v2_is_compressed: bool = True
 
 
 def parse_page_header(buf: bytes) -> PageHeader:
@@ -192,6 +215,7 @@ def parse_page_header(buf: bytes) -> PageHeader:
     ptype = comp = uncomp = -1
     num_values, encoding = 0, -1
     def_len = rep_len = 0
+    v2_compressed = True
     last = 0
     while True:
         t, fid = c.read_field_header(last)
@@ -222,6 +246,10 @@ def parse_page_header(buf: bytes) -> PageHeader:
                     def_len = c.zigzag()
                 elif ifid == 6 and it == _CT_I32 and fid == 8:
                     rep_len = c.zigzag()
+                elif (ifid == 7 and fid == 8
+                      and it in (_CT_BOOL_TRUE, _CT_BOOL_FALSE)):
+                    # bool struct fields carry the value in the type nibble
+                    v2_compressed = it == _CT_BOOL_TRUE
                 else:
                     c.skip(it)
         else:
@@ -229,7 +257,7 @@ def parse_page_header(buf: bytes) -> PageHeader:
     if ptype < 0 or comp < 0:
         raise ThriftError("missing required PageHeader fields")
     return PageHeader(ptype, comp, uncomp, num_values, encoding, c.pos,
-                      def_len, rep_len)
+                      def_len, rep_len, v2_compressed)
 
 
 @dataclass(frozen=True)
@@ -243,11 +271,36 @@ class PagePart:
     "bss": BYTE_STREAM_SPLIT — ``span`` covers the byte-transposed
     values (decode is an on-device reshape/transpose/bitcast, zero
     host-touched payload like plain).
+
+    ``codec`` != None: ``span`` covers COMPRESSED bytes — the engine
+    still reads them O_DIRECT, but the host must decompress before the
+    on-device decode (counted as bounce; see module docstring).  v1
+    pages compress levels+values together, so a compressed v1 page with
+    definition levels sets ``inline_levels`` and its levels are parsed
+    from the decompressed body; every other layout resolves its levels
+    at PLAN time into ``mask``/``n_valid``.  ``mask`` (len num_values,
+    True = non-null) is None when every value is present; masked pages
+    scatter their dense values on device.
     """
-    kind: str                              # "plain" | "dict"
+    kind: str                              # "plain" | "dict" | "bss"
     span: Tuple[int, int]                  # (offset, length) into the file
-    num_values: int
-    bit_width: int = 0                     # dict parts only
+    num_values: int                        # values INCLUDING nulls
+    bit_width: int = 0                     # dict parts (-1 = in codec body)
+    codec: Optional[str] = None            # Parquet codec name
+    uncompressed_len: int = 0              # decompressed span length
+    inline_levels: bool = False            # v1+codec: levels in the body
+    max_def: int = 0                       # schema max definition level
+    n_valid: int = -1                      # -1 = num_values (no nulls)
+    mask: Optional[object] = None          # np.bool_ mask, plan-time known
+
+    @property
+    def valid_count(self) -> int:
+        return self.num_values if self.n_valid < 0 else self.n_valid
+
+    @property
+    def is_raw(self) -> bool:
+        """Payload can ride staging→device untouched (no host decode)."""
+        return self.codec is None and self.mask is None
 
 
 @dataclass(frozen=True)
@@ -258,6 +311,8 @@ class ColumnPlan:
     physical_type: str
     dict_span: Optional[Tuple[int, int]] = None   # PLAIN dictionary values
     dict_count: int = 0
+    dict_codec: Optional[str] = None       # dictionary page's codec
+    dict_uncompressed_len: int = 0
 
     @property
     def spans(self) -> Tuple[Tuple[int, int], ...]:
@@ -265,9 +320,49 @@ class ColumnPlan:
         return tuple(p.span for p in self.parts if p.kind == "plain")
 
 
-def eligible_chunk(meta, rg: int, ci: int) -> Optional[str]:
+# Parquet codec name → pyarrow codec name.  pyarrow here is a CODEC
+# LIBRARY only (snappy/zstd/... C++ decompressors) — the page walk,
+# span planning, and value decode stay this module's own.  Legacy
+# hadoop-framed "LZ4" is intentionally absent (ambiguous framing);
+# it falls back to the pyarrow reader path.
+_CODECS = {"SNAPPY": "snappy", "GZIP": "gzip", "ZSTD": "zstd",
+           "BROTLI": "brotli", "LZ4_RAW": "lz4_raw"}
+
+
+def _codec_of(col) -> Optional[str]:
+    """Column chunk's codec name, None when uncompressed."""
+    name = col.compression or "UNCOMPRESSED"
+    return None if name == "UNCOMPRESSED" else name
+
+
+def _codec_available(name: str) -> bool:
+    if name not in _CODECS:
+        return False
+    import pyarrow as pa
+    return pa.Codec.is_available(_CODECS[name])
+
+
+def _decompress(codec: str, buf, out_len: int) -> memoryview:
+    """Host page decompression via the pyarrow codec library.  Returns a
+    memoryview over the codec's output buffer (no extra copy)."""
+    import pyarrow as pa
+    out = pa.Codec(_CODECS[codec]).decompress(bytes(buf), out_len)
+    mv = memoryview(out)
+    if mv.nbytes != out_len:
+        raise ValueError(
+            f"codec {codec}: decompressed {mv.nbytes} bytes, header "
+            f"promised {out_len}")
+    return mv
+
+
+def eligible_chunk(meta, rg: int, ci: int,
+                   allow_nulls: bool = False) -> Optional[str]:
     """None if the (row group, column) chunk can decode on device, else a
-    human-readable reason for the pyarrow fallback (surfaced in stats)."""
+    human-readable reason for the pyarrow fallback (surfaced in stats).
+
+    ``allow_nulls``: chunks with (possible) nulls are eligible — the
+    plan decodes definition levels and decode scatters on device; the
+    caller must consume (values, mask) pairs."""
     col = meta.row_group(rg).column(ci)
     sc = meta.schema.column(ci)
     if col.physical_type not in _WIDTHS:
@@ -278,7 +373,8 @@ def eligible_chunk(meta, rg: int, ci: int) -> Optional[str]:
             # the on-device bitcast would silently truncate i64/f64
             return (f"{col.physical_type} needs jax_enable_x64 "
                     f"(bitcast would truncate)")
-    if (col.compression or "UNCOMPRESSED") != "UNCOMPRESSED":
+    codec = _codec_of(col)
+    if codec is not None and not _codec_available(codec):
         return f"compression {col.compression}"
     encs = set(col.encodings)
     if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
@@ -286,12 +382,12 @@ def eligible_chunk(meta, rg: int, ci: int) -> Optional[str]:
         return f"encodings {sorted(encs)}"
     if sc.max_repetition_level != 0:
         return "repeated field"
-    if sc.max_definition_level > 0:
+    if sc.max_definition_level > 0 and not allow_nulls:
         st = col.statistics
         if st is None or st.null_count is None:
             return "no null statistics"
         if st.null_count != 0:
-            return f"{st.null_count} nulls"
+            return f"{st.null_count} nulls (pass nulls='mask')"
     return None
 
 
@@ -335,22 +431,46 @@ def _walk_pages(col, raw_read):
         pos += ph.header_len + ph.compressed_size
 
 
-def _level_bytes(pos, ph, has_def: bool, raw_read) -> int:
-    """Bytes the definition/repetition-level block occupies at the page
-    body's start (v2: stated in the header; v1: ``<u32 len><RLE>``)."""
+def _plan_levels(pos, ph, max_def: int, raw_read, may_null: bool):
+    """Levels of an UNCOMPRESSED-levels page → (level_bytes, mask|None).
+
+    v2 stores levels uncompressed regardless of the chunk codec; v1
+    callers must only pass pages whose body is raw (a compressed v1
+    page parses its levels from the decompressed body instead —
+    ``inline_levels``).  ``may_null`` False skips the decode (statistics
+    already proved every value present).  mask is None when all valid.
+    """
+    import numpy as np
+    bw = max_def.bit_length()
     if ph.type == _PAGE_DATA_V2:
-        return ph.def_levels_len + ph.rep_levels_len
-    if has_def:
+        lb = ph.def_levels_len + ph.rep_levels_len
+        if not (may_null and ph.def_levels_len):
+            return lb, None
+        buf = raw_read(pos + ph.header_len + ph.rep_levels_len,
+                       ph.def_levels_len)
+        lev = decode_rle_hybrid(buf, bw, ph.num_values)
+    else:
+        if max_def == 0:
+            return 0, None
         (n,) = struct.unpack("<I", raw_read(pos + ph.header_len, 4))
-        return 4 + n
-    return 0
+        lb = 4 + n
+        if not may_null:
+            return lb, None
+        lev = decode_rle_hybrid(raw_read(pos + ph.header_len + 4, n),
+                                bw, ph.num_values)
+    mask = lev == max_def
+    return lb, (None if mask.all() else np.asarray(mask))
 
 
-def _index_stream_part(pos, ph, level_bytes: int, raw_read) -> PagePart:
+def _index_stream_part(pos, ph, level_bytes: int, raw_read,
+                       max_def: int = 0, n_valid: int = -1,
+                       mask=None) -> PagePart:
     """Dict-encoded data-page body → index-stream PagePart.
 
     Body after levels: ``<bit_width: 1 byte><RLE-hybrid runs>`` — the
-    one layout rule both the numeric and byte-array walks share."""
+    one layout rule both the numeric and byte-array walks share.  Only
+    valid for RAW bodies (compressed pages read their bit-width from
+    the decompressed body at decode time)."""
     val_off = pos + ph.header_len + level_bytes
     (bw,) = raw_read(val_off, 1)
     if bw > 32:
@@ -359,7 +479,8 @@ def _index_stream_part(pos, ph, level_bytes: int, raw_read) -> PagePart:
     if idx_len < 0:
         raise ValueError(f"page at {pos}: negative index span")
     return PagePart("dict", (val_off + 1, idx_len), ph.num_values,
-                    bit_width=bw)
+                    bit_width=bw, max_def=max_def, n_valid=n_valid,
+                    mask=mask)
 
 
 def _check_dict_page(pos, ph, already_seen: bool) -> None:
@@ -371,53 +492,117 @@ def _check_dict_page(pos, ph, already_seen: bool) -> None:
             f"dictionary page encoding {ph.encoding} not PLAIN")
 
 
-def plan_chunk(meta, rg: int, ci: int, raw_read) -> ColumnPlan:
+def plan_chunk(meta, rg: int, ci: int, raw_read,
+               allow_nulls: bool = False) -> ColumnPlan:
     """Walk the chunk's data pages, returning exact value-byte spans.
 
     ``raw_read`` as in :func:`_walk_pages`; it additionally serves the
-    v1 RLE level-length prefixes (8 bytes per page)."""
+    v1 RLE level-length prefixes and — when nulls are possible and
+    allowed — the (always-uncompressed-accessible) level blocks, which
+    decode to per-page masks at plan time.  Compressed chunks emit
+    codec-tagged parts whose spans cover the compressed bytes; a
+    compressed v1 page with definition levels defers its level parse to
+    decode time (``inline_levels`` — v1 compresses levels and values
+    together)."""
     col = meta.row_group(rg).column(ci)
     sc = meta.schema.column(ci)
     width = _WIDTHS[col.physical_type]
-    has_def = sc.max_definition_level > 0
+    max_def = sc.max_definition_level
+    codec = _codec_of(col)
+    st = col.statistics
+    # statistics can PROVE the chunk null-free; anything else (nulls
+    # recorded, or no stats at all) must consult the levels
+    may_null = (max_def > 0
+                and (st is None or st.null_count is None
+                     or st.null_count != 0))
+    if may_null and not allow_nulls:
+        raise ValueError(
+            f"rg{rg} col{ci}: possible nulls (pass nulls='mask')")
     parts: List[PagePart] = []
     dict_span: Optional[Tuple[int, int]] = None
     dict_count = 0
+    dict_codec: Optional[str] = None
+    dict_ulen = 0
     for pos, ph in _walk_pages(col, raw_read):
         if ph.type in (_PAGE_DATA, _PAGE_DATA_V2):
-            lb = _level_bytes(pos, ph, has_def, raw_read)
-            if ph.encoding in (_ENC_PLAIN, _ENC_BYTE_STREAM_SPLIT):
-                val_off = pos + ph.header_len + lb
-                val_len = ph.num_values * width
-                if val_len + lb > ph.compressed_size:
-                    raise ValueError(
-                        f"page at {pos}: {ph.num_values} values x {width} "
-                        f"+ {lb} level bytes > page size "
-                        f"{ph.compressed_size}")
-                kind = ("plain" if ph.encoding == _ENC_PLAIN else "bss")
-                parts.append(PagePart(kind, (val_off, val_len),
-                                      ph.num_values))
-            elif ph.encoding in _DICT_ENCODINGS:
-                if dict_span is None:
-                    raise ValueError(
-                        f"page at {pos}: dict-encoded data page before "
-                        f"any dictionary page")
-                parts.append(_index_stream_part(pos, ph, lb, raw_read))
-            else:
+            v2 = ph.type == _PAGE_DATA_V2
+            page_codec = codec
+            if v2 and not ph.v2_is_compressed:
+                page_codec = None
+            if ph.encoding not in (_ENC_PLAIN, _ENC_BYTE_STREAM_SPLIT,
+                                   *_DICT_ENCODINGS):
                 raise ValueError(
                     f"page at {pos}: unsupported encoding {ph.encoding}")
+            kind = {_ENC_PLAIN: "plain",
+                    _ENC_BYTE_STREAM_SPLIT: "bss"}.get(ph.encoding, "dict")
+            if kind == "dict" and dict_span is None:
+                raise ValueError(
+                    f"page at {pos}: dict-encoded data page before "
+                    f"any dictionary page")
+            if page_codec is not None and not v2:
+                # v1: levels+values compressed as one body — the span is
+                # the whole body, levels resolve after decompression
+                # inline_levels whenever the schema has def levels: even
+                # a proven null-free page carries the level block and the
+                # decoder must parse past it (mask collapses to None)
+                parts.append(PagePart(
+                    kind, (pos + ph.header_len, ph.compressed_size),
+                    ph.num_values, bit_width=-1, codec=page_codec,
+                    uncompressed_len=ph.uncompressed_size,
+                    inline_levels=max_def > 0, max_def=max_def))
+                continue
+            # levels are addressable raw: v1-uncompressed in the body,
+            # v2 always uncompressed ahead of the values region
+            lb, mask = _plan_levels(pos, ph, max_def, raw_read, may_null)
+            n_valid = int(mask.sum()) if mask is not None else -1
+            vc = ph.num_values if n_valid < 0 else n_valid
+            val_off = pos + ph.header_len + lb
+            val_len = ph.compressed_size - lb
+            if page_codec is not None:      # compressed v2 values region
+                parts.append(PagePart(
+                    kind, (val_off, val_len), ph.num_values,
+                    bit_width=-1, codec=page_codec,
+                    uncompressed_len=ph.uncompressed_size - lb,
+                    max_def=max_def, n_valid=n_valid, mask=mask))
+                continue
+            if kind in ("plain", "bss"):
+                want = vc * width
+                if want + lb > ph.compressed_size:
+                    raise ValueError(
+                        f"page at {pos}: {vc} values x {width} + {lb} "
+                        f"level bytes > page size {ph.compressed_size}")
+                parts.append(PagePart(kind, (val_off, want),
+                                      ph.num_values, max_def=max_def,
+                                      n_valid=n_valid, mask=mask))
+            else:
+                parts.append(_index_stream_part(
+                    pos, ph, lb, raw_read, max_def=max_def,
+                    n_valid=n_valid, mask=mask))
         elif ph.type == _PAGE_DICTIONARY:
             _check_dict_page(pos, ph, dict_span is not None)
-            val_len = ph.num_values * width
-            if val_len > ph.compressed_size:
-                raise ValueError(
-                    f"dictionary page at {pos}: {ph.num_values} values x "
-                    f"{width} > page size {ph.compressed_size}")
-            dict_span = (pos + ph.header_len, val_len)
+            if codec is not None:
+                dict_span = (pos + ph.header_len, ph.compressed_size)
+                dict_codec = codec
+                dict_ulen = ph.uncompressed_size
+                if ph.num_values * width > ph.uncompressed_size:
+                    raise ValueError(
+                        f"dictionary page at {pos}: {ph.num_values} "
+                        f"values x {width} > uncompressed size "
+                        f"{ph.uncompressed_size}")
+            else:
+                val_len = ph.num_values * width
+                if val_len > ph.compressed_size:
+                    raise ValueError(
+                        f"dictionary page at {pos}: {ph.num_values} "
+                        f"values x {width} > page size "
+                        f"{ph.compressed_size}")
+                dict_span = (pos + ph.header_len, val_len)
             dict_count = ph.num_values
         # INDEX pages are skipped silently
     return ColumnPlan(tuple(parts), col.num_values, col.physical_type,
-                      dict_span=dict_span, dict_count=dict_count)
+                      dict_span=dict_span, dict_count=dict_count,
+                      dict_codec=dict_codec,
+                      dict_uncompressed_len=dict_ulen)
 
 
 def decode_rle_hybrid(buf: bytes, bit_width: int, count: int):
@@ -479,7 +664,8 @@ def decode_rle_hybrid(buf: bytes, bit_width: int, count: int):
     return out
 
 
-def plan_columns(scanner, columns: Sequence[str]
+def plan_columns(scanner, columns: Sequence[str],
+                 allow_nulls: bool = False
                  ) -> Dict[str, List[ColumnPlan]]:
     """Page-walk every (row group, column) chunk → value spans.  Raises
     ValueError naming the first non-eligible chunk — callers wanting a
@@ -496,11 +682,13 @@ def plan_columns(scanner, columns: Sequence[str]
         for rg in range(meta.num_row_groups):
             for c in columns:
                 ci = name_to_ci[c]
-                why = eligible_chunk(meta, rg, ci)
+                why = eligible_chunk(meta, rg, ci,
+                                     allow_nulls=allow_nulls)
                 if why is not None:
                     raise ValueError(
                         f"rg{rg}.{c} not direct-eligible: {why}")
-                plans[c].append(plan_chunk(meta, rg, ci, raw_read))
+                plans[c].append(plan_chunk(meta, rg, ci, raw_read,
+                                           allow_nulls=allow_nulls))
     return plans
 
 
@@ -542,6 +730,37 @@ def _stream_raw_groups(scanner, ds, fh, spans):
     return outs
 
 
+def _index_from_body(body, count: int):
+    """Dict index stream after levels: ``<bit_width byte><RLE runs>`` —
+    the one decode rule every compressed-body consumer shares."""
+    bw = body[0]
+    if bw > 32:
+        raise ValueError(f"bit width {bw} > 32")
+    return decode_rle_hybrid(bytes(body[1:]), bw, count)
+
+
+def _decode_one_index_stream(eng, fh, p: PagePart, dev):
+    """One dict-kind PagePart → int32 host index array, handling raw
+    spans (bit_width known at plan time) and compressed bodies
+    (decompress, parse the v1 inline level block, read bit_width from
+    the body).  Nulls are rejected — callers on this path planned the
+    chunk null-free (masked dict parts go through
+    :func:`_decode_special_part`)."""
+    buf = _read_span_bytes(eng, fh, *p.span)
+    if p.codec is None:
+        return decode_rle_hybrid(buf, p.bit_width, p.valid_count)
+    body = _decompress(p.codec, buf, p.uncompressed_len)
+    if dev.platform != "cpu":
+        eng.stats.add(bounce_bytes=p.uncompressed_len)
+    n_valid = p.valid_count
+    if p.inline_levels:
+        body, mask, n_valid = _inline_levels(body, p)
+        if mask is not None:
+            raise ValueError(
+                "unexpected nulls in a chunk planned null-free")
+    return _index_from_body(body, n_valid)
+
+
 def _decode_indices(eng, fh, parts, dict_count: int, dev):
     """Dict-kind PageParts → one validated int32 host index array.
 
@@ -552,10 +771,8 @@ def _decode_indices(eng, fh, parts, dict_count: int, dev):
     here).  Validation is range-only — ``jnp.take`` would silently clip
     a corrupt stream into wrong rows."""
     import numpy as np
-    idx_parts = [
-        decode_rle_hybrid(_read_span_bytes(eng, fh, *p.span),
-                          p.bit_width, p.num_values)
-        for p in parts]
+    idx_parts = [_decode_one_index_stream(eng, fh, p, dev)
+                 for p in parts]
     if not idx_parts:          # zero-row chunk
         return np.empty(0, np.int32)
     idx = (idx_parts[0] if len(idx_parts) == 1
@@ -586,14 +803,126 @@ def _read_span_bytes(engine, fh, off: int, ln: int) -> bytes:
     return parts[0] if len(parts) == 1 else b"".join(parts)
 
 
-def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
-    """One column chunk → one device array, pages assembled in order.
+def _put_control(eng, arr, dev):
+    """Host-decoded control data (masks, index arrays) → device, with
+    the module's accounting policy: payload-derived host-materialized
+    bytes count as bounce (on CPU ``host_to_device``'s protective copy
+    counts the same buffer, so only non-CPU adds it here)."""
+    from nvme_strom_tpu.ops.bridge import host_to_device
+    if dev.platform != "cpu":
+        eng.stats.add(bounce_bytes=int(arr.nbytes))
+    return host_to_device(eng, arr, dev)
 
-    Plain pages stream O_DIRECT→device and bitcast there.  Dict-encoded
-    pages: the dictionary's PLAIN values stream the same zero-copy path,
-    index streams are host-expanded (:func:`decode_rle_hybrid`) and the
-    decode is an on-device ``take`` — values never materialize on host.
-    Adjacent dict pages share one gather.
+
+def _scatter_masked(vals_dev, mask_np, eng, dev):
+    """Dense non-null values → full-length page output, ON DEVICE.
+
+    positions = cumsum(mask)-1 maps each output slot to its dense
+    source index; null slots read a garbage lane and are zeroed by the
+    where().  Returns (full_values, device_mask)."""
+    import jax.numpy as jnp
+    m = _put_control(eng, mask_np, dev)
+    pos = jnp.cumsum(m) - 1
+    pad = mask_np.shape[0] - vals_dev.shape[0]
+    vp = jnp.pad(vals_dev, (0, pad)) if pad > 0 else vals_dev
+    return jnp.where(m, vp[jnp.clip(pos, 0)], 0), m
+
+
+def _inline_levels(body, p: PagePart):
+    """Parse a compressed v1 page's level block from its decompressed
+    body → (values_view, mask|None, n_valid).  ``<u32 len><RLE def
+    levels>``; all-valid masks collapse to None (stats may have proved
+    it, or the writer padded an optional column with zero nulls)."""
+    import numpy as np
+    (n,) = struct.unpack_from("<I", body, 0)
+    if 4 + n > len(body):
+        raise ValueError("level block overruns decompressed page body")
+    lev = decode_rle_hybrid(bytes(body[4:4 + n]),
+                            p.max_def.bit_length(), p.num_values)
+    mask = np.asarray(lev == p.max_def)
+    vals = body[4 + n:]
+    if mask.all():
+        return vals, None, p.num_values
+    return vals, mask, int(mask.sum())
+
+
+def _decode_special_part(scanner, ds, fh, p: PagePart, plan, dict_dev,
+                         dev):
+    """One non-raw page (codec and/or mask) → (device values, mask).
+
+    Compressed bytes ride the O_DIRECT engine, decompress on host
+    (counted — see module docstring), and decode on device; raw-but-
+    masked pages keep the zero-copy value stream and only the mask is
+    host-decoded.  Returns full-page-length values when masked."""
+    import numpy as np
+    import jax.numpy as jnp
+    eng = scanner.engine
+    width = _WIDTHS[plan.physical_type]
+    np_dtype = np.dtype(_NP_DTYPES[plan.physical_type])
+    mask, n_valid = p.mask, p.valid_count
+
+    if p.codec is not None:
+        raw = _read_span_bytes(eng, fh, *p.span)
+        body = _decompress(p.codec, raw, p.uncompressed_len)
+        if dev.platform != "cpu":
+            eng.stats.add(bounce_bytes=p.uncompressed_len)
+        if p.inline_levels:
+            body, mask, n_valid = _inline_levels(body, p)
+        if p.kind == "dict":
+            idx = _index_from_body(body, n_valid)
+            _check_index_range(idx, plan.dict_count)
+            vals = jnp.take(dict_dev, _put_control(eng, idx, dev))
+        elif p.kind == "bss":
+            u8 = _put_control(eng, np.frombuffer(body, np.uint8,
+                                                 n_valid * width), dev)
+            vals = (u8.reshape(width, n_valid).T.reshape(-1)
+                    .view(np_dtype))
+        else:
+            arr = np.frombuffer(body, np_dtype, n_valid)
+            from nvme_strom_tpu.ops.bridge import host_to_device
+            # decompressed bytes were already counted above; the CPU
+            # protective copy inside host_to_device re-counts there
+            vals = host_to_device(eng, arr, dev)
+    else:
+        # raw values, masked: payload still streams zero-copy
+        if p.kind == "dict":
+            buf = _read_span_bytes(eng, fh, *p.span)
+            idx = decode_rle_hybrid(buf, p.bit_width, n_valid)
+            _check_index_range(idx, plan.dict_count)
+            vals = jnp.take(dict_dev, _put_control(eng, idx, dev))
+        elif p.kind == "bss":
+            (raw,) = _stream_raw_groups(scanner, ds, fh, [p.span])
+            vals = (raw.reshape(width, n_valid).T.reshape(-1)
+                    .view(np_dtype))
+        else:
+            vals = _stream_spans(scanner, ds, fh, [p.span],
+                                 plan.physical_type)
+    if mask is not None:
+        return _scatter_masked(vals, mask, eng, dev)
+    return vals, None
+
+
+def _check_index_range(idx, dict_count: int) -> None:
+    if idx.size:
+        lo, hi = int(idx.min()), int(idx.max())
+        if lo < 0 or hi >= dict_count:
+            raise ValueError(
+                f"dictionary index {lo if lo < 0 else hi} out of range "
+                f"[0, {dict_count})")
+
+
+def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
+    """One column chunk → (device array, device mask | None), pages
+    assembled in order.
+
+    Raw plain pages stream O_DIRECT→device and bitcast there.  Raw
+    dict-encoded pages: the dictionary's PLAIN values stream the same
+    zero-copy path, index streams are host-expanded
+    (:func:`decode_rle_hybrid`) and the decode is an on-device ``take``
+    — values never materialize on host; adjacent dict pages share one
+    gather.  Compressed and/or null-masked pages go through
+    :func:`_decode_special_part` (host decompress / mask scatter).  The
+    mask is None when every value in the chunk is present.
     """
     import jax.numpy as jnp
     import numpy as np
@@ -602,26 +931,39 @@ def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
     eng = scanner.engine
     dict_dev = None
     if any(p.kind == "dict" for p in plan.parts):
-        dict_dev = _stream_spans(scanner, ds, fh, [plan.dict_span],
-                                 plan.physical_type)
-    segs = []            # device arrays in page order
-    pending_dict = []    # adjacent dict pages' index-stream parts
-    pending_plain = []   # value spans of adjacent plain pages
-    pending_bss = []     # value spans of adjacent BYTE_STREAM_SPLIT pages
+        if plan.dict_codec is not None:
+            raw = _read_span_bytes(eng, fh, *plan.dict_span)
+            body = _decompress(plan.dict_codec, raw,
+                               plan.dict_uncompressed_len)
+            if dev.platform != "cpu":
+                eng.stats.add(bounce_bytes=plan.dict_uncompressed_len)
+            arr = np.frombuffer(body,
+                                np.dtype(_NP_DTYPES[plan.physical_type]),
+                                plan.dict_count)
+            dict_dev = host_to_device(eng, arr, dev)
+        else:
+            dict_dev = _stream_spans(scanner, ds, fh, [plan.dict_span],
+                                     plan.physical_type)
+    segs = []            # (device array, mask | None) in page order
+    pending_dict = []    # adjacent RAW dict pages' index-stream parts
+    pending_plain = []   # value spans of adjacent RAW plain pages
+    pending_bss = []     # value spans of adjacent RAW bss pages
 
     def flush_dict():
         if pending_dict:
             idx = _decode_indices(eng, fh, pending_dict,
                                   plan.dict_count, dev)
-            segs.append(jnp.take(dict_dev, host_to_device(eng, idx, dev)))
+            segs.append((jnp.take(dict_dev,
+                                  host_to_device(eng, idx, dev)), None))
             pending_dict.clear()
 
     def flush_plain():
         if pending_plain:
             # one pipelined stream over the adjacent spans — per-page
             # calls would collapse the queue to depth 1
-            segs.append(_stream_spans(scanner, ds, fh, list(pending_plain),
-                                      plan.physical_type))
+            segs.append((_stream_spans(scanner, ds, fh,
+                                       list(pending_plain),
+                                       plan.physical_type), None))
             pending_plain.clear()
 
     def flush_bss():
@@ -633,14 +975,24 @@ def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
                 # BYTE_STREAM_SPLIT: page bytes are transposed
                 # (width, n) — undo ON DEVICE, then bitcast
                 n = raw.shape[0] // width
-                segs.append(
-                    raw.reshape(width, n).T.reshape(-1).view(np_dtype))
+                segs.append((raw.reshape(width, n).T.reshape(-1)
+                             .view(np_dtype), None))
             pending_bss.clear()
+
+    def flush_all():
+        flush_dict()
+        flush_plain()
+        flush_bss()
 
     flushes = {"plain": (flush_dict, flush_bss),
                "dict": (flush_plain, flush_bss),
                "bss": (flush_dict, flush_plain)}
     for p in plan.parts:
+        if not p.is_raw:
+            flush_all()          # page order is the output order
+            segs.append(_decode_special_part(scanner, ds, fh, p, plan,
+                                             dict_dev, dev))
+            continue
         for fl in flushes[p.kind]:   # close the other kinds' runs
             fl()
         if p.kind == "plain":
@@ -649,34 +1001,70 @@ def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
             pending_bss.append(p.span)
         else:
             pending_dict.append(p)
-    flush_dict()
-    flush_plain()
-    flush_bss()
+    flush_all()
+    np_dtype = np.dtype(_NP_DTYPES[plan.physical_type])
     if not segs:     # zero-row chunk
-        return jnp.zeros((0,),
-                         dtype=np.dtype(_NP_DTYPES[plan.physical_type]))
-    return segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        return jnp.zeros((0,), dtype=np_dtype), None
+    vals = (segs[0][0] if len(segs) == 1
+            else jnp.concatenate([s[0] for s in segs]))
+    if all(m is None for _, m in segs):
+        return vals, None
+    mask = jnp.concatenate([
+        m if m is not None else jnp.ones((a.shape[0],), bool)
+        for a, m in segs])
+    return vals, mask
 
 
 def _plain_only(plans: Sequence[ColumnPlan]) -> bool:
-    return all(p.kind == "plain" for plan in plans for p in plan.parts)
+    return all(p.kind == "plain" and p.is_raw
+               for plan in plans for p in plan.parts)
+
+
+def _join_chunks(chunks, nulls: str, column: str):
+    """[(values, mask|None)] per row group → column output per the
+    ``nulls`` policy: "forbid" raises on any real mask (statistics lied
+    or the caller forgot to opt in), "mask" returns (values, mask) with
+    all-valid chunks contributing ones."""
+    import jax.numpy as jnp
+    vals = (chunks[0][0] if len(chunks) == 1
+            else jnp.concatenate([c[0] for c in chunks]))
+    if nulls == "forbid":
+        if any(m is not None for _, m in chunks):
+            raise ValueError(
+                f"column {column!r} has nulls; pass nulls='mask'")
+        return vals
+    mask = (jnp.ones((vals.shape[0],), bool)
+            if all(m is None for _, m in chunks)
+            else jnp.concatenate([
+                m if m is not None else jnp.ones((a.shape[0],), bool)
+                for a, m in chunks]))
+    return vals, mask
 
 
 def read_plain_columns_to_device(scanner, columns: Sequence[str],
-                                 device=None, plans=None
+                                 device=None, plans=None,
+                                 nulls: str = "forbid"
                                  ) -> Dict[str, "object"]:
     """Direct scan of the whole file: {name: device array}, row groups
     concatenated ON DEVICE.  Payload bytes (PLAIN values and dictionary
     values) ride O_DIRECT → staging → device; the host reads only
-    headers and dict index streams.  ``plans`` lets callers reuse a
-    prior :func:`plan_columns` walk."""
+    headers, dict index streams, level blocks, and — for compressed
+    chunks — the page bodies it must decompress (counted as bounce).
+    ``plans`` lets callers reuse a prior :func:`plan_columns` walk.
+
+    ``nulls``: "forbid" (default) raises if any chunk holds nulls;
+    "mask" returns ``(values, valid_mask)`` pairs — null slots are
+    zero-filled, the mask is the truth."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from nvme_strom_tpu.ops.bridge import DeviceStream
 
+    if nulls not in ("forbid", "mask"):
+        raise ValueError(f"bad nulls={nulls!r}")
     dev = device or jax.local_devices()[0]
-    plans = plans or plan_columns(scanner, columns)
+    plans = plans or plan_columns(scanner, columns,
+                                  allow_nulls=nulls == "mask")
     ds = DeviceStream(scanner.engine, device=dev,
                       depth=scanner.engine.config.queue_depth)
     out = {}
@@ -688,19 +1076,19 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
         for c in columns:
             if not plans[c]:   # zero row groups: empty typed column
                 pt = meta.schema.column(name_to_ci[c]).physical_type
-                out[c] = jnp.zeros((0,),
-                                   dtype=np.dtype(_NP_DTYPES[pt]))
-            elif _plain_only(plans[c]):
+                empty = jnp.zeros((0,), dtype=np.dtype(_NP_DTYPES[pt]))
+                out[c] = (empty if nulls == "forbid"
+                          else (empty, jnp.zeros((0,), bool)))
+            elif _plain_only(plans[c]) and nulls == "forbid":
                 # one pipelined stream across every row group's spans
                 out[c] = _stream_spans(
                     scanner, ds, fh,
                     (s for p in plans[c] for s in p.spans),
                     plans[c][0].physical_type)
             else:
-                parts = [_assemble_chunk(scanner, ds, fh, plan, dev)
-                         for plan in plans[c]]
-                out[c] = (parts[0] if len(parts) == 1
-                          else jnp.concatenate(parts))
+                chunks = [_assemble_chunk(scanner, ds, fh, plan, dev)
+                          for plan in plans[c]]
+                out[c] = _join_chunks(chunks, nulls, c)
     finally:
         scanner.engine.close(fh)
     return out
@@ -723,6 +1111,8 @@ class DictCodeChunk:
     num_values: int
     dict_span: Tuple[int, int]             # raw dictionary page body
     dict_count: int
+    dict_codec: Optional[str] = None
+    dict_uncompressed_len: int = 0
 
 
 def dict_code_eligible(meta, rg: int, ci: int) -> Optional[str]:
@@ -735,7 +1125,8 @@ def dict_code_eligible(meta, rg: int, ci: int) -> Optional[str]:
     sc = meta.schema.column(ci)
     if col.physical_type != "BYTE_ARRAY":
         return f"physical type {col.physical_type} (need BYTE_ARRAY)"
-    if (col.compression or "UNCOMPRESSED") != "UNCOMPRESSED":
+    codec = _codec_of(col)
+    if codec is not None and not _codec_available(codec):
         return f"compression {col.compression}"
     encs = set(col.encodings)
     if not encs <= {"PLAIN", "RLE", "PLAIN_DICTIONARY", "RLE_DICTIONARY"}:
@@ -755,14 +1146,18 @@ def dict_code_eligible(meta, rg: int, ci: int) -> Optional[str]:
 
 def plan_dict_code_chunk(meta, rg: int, ci: int, raw_read) -> DictCodeChunk:
     """Page-walk a BYTE_ARRAY chunk: dictionary page body span + index
-    stream spans.  Raises ValueError on any PLAIN data page (dictionary
-    overflow) — string bytes cannot decode on device."""
+    stream spans (codec-tagged when the chunk is compressed).  Raises
+    ValueError on any PLAIN data page (dictionary overflow) — string
+    bytes cannot decode on device."""
     col = meta.row_group(rg).column(ci)
     sc = meta.schema.column(ci)
-    has_def = sc.max_definition_level > 0
+    max_def = sc.max_definition_level
+    codec = _codec_of(col)
     parts: List[PagePart] = []
     dict_span = None
     dict_count = 0
+    dict_codec: Optional[str] = None
+    dict_ulen = 0
     for pos, ph in _walk_pages(col, raw_read):
         if ph.type in (_PAGE_DATA, _PAGE_DATA_V2):
             if ph.encoding not in _DICT_ENCODINGS:
@@ -773,18 +1168,44 @@ def plan_dict_code_chunk(meta, rg: int, ci: int, raw_read) -> DictCodeChunk:
                 raise ValueError(
                     f"page at {pos}: dict-encoded data page before "
                     f"any dictionary page")
-            lb = _level_bytes(pos, ph, has_def, raw_read)
-            parts.append(_index_stream_part(pos, ph, lb, raw_read))
+            v2 = ph.type == _PAGE_DATA_V2
+            page_codec = codec
+            if v2 and not ph.v2_is_compressed:
+                page_codec = None
+            if page_codec is not None and not v2:
+                # v1: levels+values in one compressed body
+                parts.append(PagePart(
+                    "dict", (pos + ph.header_len, ph.compressed_size),
+                    ph.num_values, bit_width=-1, codec=page_codec,
+                    uncompressed_len=ph.uncompressed_size,
+                    inline_levels=max_def > 0, max_def=max_def))
+                continue
+            # eligibility proved the chunk null-free → no masks
+            lb, _ = _plan_levels(pos, ph, max_def, raw_read, False)
+            if page_codec is not None:      # compressed v2 values
+                parts.append(PagePart(
+                    "dict",
+                    (pos + ph.header_len + lb, ph.compressed_size - lb),
+                    ph.num_values, bit_width=-1, codec=page_codec,
+                    uncompressed_len=ph.uncompressed_size - lb,
+                    max_def=max_def))
+            else:
+                parts.append(_index_stream_part(pos, ph, lb, raw_read,
+                                                max_def=max_def))
         elif ph.type == _PAGE_DICTIONARY:
             _check_dict_page(pos, ph, dict_span is not None)
             # var-len strings: the span is the whole page body; entry
             # lengths are parsed from it host-side
             dict_span = (pos + ph.header_len, ph.compressed_size)
             dict_count = ph.num_values
+            if codec is not None:
+                dict_codec = codec
+                dict_ulen = ph.uncompressed_size
     if dict_span is None:
         raise ValueError(f"rg{rg} col{ci}: no dictionary page")
     return DictCodeChunk(tuple(parts), col.num_values, dict_span,
-                         dict_count)
+                         dict_count, dict_codec=dict_codec,
+                         dict_uncompressed_len=dict_ulen)
 
 
 def parse_byte_array_dict(buf: bytes, count: int) -> List[bytes]:
@@ -851,6 +1272,10 @@ def read_dict_key_column(scanner, column: str, device=None,
     try:
         for ch in chunks:
             body = _read_span_bytes(eng, fh, *ch.dict_span)
+            if ch.dict_codec is not None:
+                body = _decompress(ch.dict_codec, body,
+                                   ch.dict_uncompressed_len)
+                eng.stats.add(bounce_bytes=ch.dict_uncompressed_len)
             local = parse_byte_array_dict(body, ch.dict_count)
             remap = np.empty(max(ch.dict_count, 1), np.int32)
             for i, lab in enumerate(local):
@@ -883,18 +1308,24 @@ def read_dict_key_column(scanner, column: str, device=None,
 
 def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
                                     device=None, plans=None,
-                                    row_groups=None):
+                                    row_groups=None,
+                                    nulls: str = "forbid"):
     """Yield {name: device array} per (selected) row group — the
     incremental form sql_groupby folds over, so device memory holds one
     row group of columns at a time regardless of table size.  ``plans``
     lets callers reuse a prior :func:`plan_columns` walk;
     ``row_groups`` restricts to a pruned subset (statistics-based scan
-    elimination — skipped chunks never leave the SSD)."""
+    elimination — skipped chunks never leave the SSD).  ``nulls`` as in
+    :func:`read_plain_columns_to_device` ("mask" yields (values, mask)
+    pairs per column)."""
     import jax
     from nvme_strom_tpu.ops.bridge import DeviceStream
 
+    if nulls not in ("forbid", "mask"):
+        raise ValueError(f"bad nulls={nulls!r}")
     dev = device or jax.local_devices()[0]
-    plans = plans or plan_columns(scanner, columns)
+    plans = plans or plan_columns(scanner, columns,
+                                  allow_nulls=nulls == "mask")
     ds = DeviceStream(scanner.engine, device=dev,
                       depth=scanner.engine.config.queue_depth)
     fh = scanner.engine.open(scanner.path)
@@ -905,11 +1336,13 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
             out = {}
             for c in columns:
                 plan = plans[c][rg]
-                if _plain_only([plan]):
+                if _plain_only([plan]) and nulls == "forbid":
                     out[c] = _stream_spans(scanner, ds, fh, plan.spans,
                                            plan.physical_type)
                 else:
-                    out[c] = _assemble_chunk(scanner, ds, fh, plan, dev)
+                    out[c] = _join_chunks(
+                        [_assemble_chunk(scanner, ds, fh, plan, dev)],
+                        nulls, c)
             yield out
     finally:
         scanner.engine.close(fh)

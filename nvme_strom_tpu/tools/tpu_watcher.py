@@ -52,6 +52,8 @@ PROBE_INTERVAL_S = 240
 #: samples per window beat hammering one window continuously.
 CAPTURE_COOLDOWN_S = 2700
 CAPTURE_TIMEOUT_S = 2400
+#: retry delay after an incomplete capture (tunnel died or step timed out)
+DUD_RETRY_S = 600
 
 
 def _now() -> str:
@@ -132,14 +134,27 @@ def capture(device: str) -> bool:
     for CAPTURE_COOLDOWN_S."""
     _log(f"capture START on {device!r}")
     ok = True
+    # One subprocess per config: a mid-window tunnel death (or one slow
+    # compile) loses that step alone — round-3 lesson: a combined
+    # 5+6+7 suite step burned its whole 2400s timeout and landed
+    # nothing.  Ordered by evidence value per minute: the headline
+    # stream bench, the stream-efficiency probe (verdict task #2), then
+    # compute rows (decode, MFU), then SQL scans.
     steps = [
-        ("bench", [sys.executable, "bench.py"]),
-        ("suite_5_6_7",
-         [sys.executable, "bench_suite.py", "--config", "5", "--config", "6",
-          "--config", "7"]),
+        ("bench", [sys.executable, "bench.py"], 900),
+        ("stream_probe",
+         [sys.executable, "-m", "nvme_strom_tpu.tools.stream_probe"], 1500),
+        ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
+         1200),
+        ("suite_7", [sys.executable, "bench_suite.py", "--config", "7"],
+         1500),
+        ("suite_5", [sys.executable, "bench_suite.py", "--config", "5"],
+         900),
+        ("suite_12", [sys.executable, "bench_suite.py", "--config", "12"],
+         900),
     ]
-    for name, cmd in steps:
-        rec = _run_step(name, cmd)
+    for name, cmd, timeout_s in steps:
+        rec = _run_step(name, cmd, timeout_s=timeout_s)
         rec["device"] = device
         _append(LEDGER, rec)
         _commit()
@@ -149,26 +164,34 @@ def capture(device: str) -> bool:
         # If the step found the tunnel already dead, don't burn the
         # remaining steps' timeouts on it.  bench.py exits 0 on its CPU
         # fallback — the down marker is in its JSON metric, not the rc.
+        # A step TIMEOUT is ambiguous (slow tunnel compile vs mid-step
+        # death): keep going — the next step's own device gate answers
+        # in seconds if the tunnel is gone.
         if _looks_down(rec):
             _log("capture step reports tunnel down; aborting capture")
             ok = False
             break
+        if rec.get("error", "").startswith("timeout"):
+            _log(f"capture step {name} timed out (slow or dead); "
+                 "continuing to next step")
+            ok = False          # incomplete capture: don't charge cooldown
     _log(f"capture DONE (ok={ok})")
     return ok
 
 
 def _looks_down(rec: dict) -> bool:
-    """Did this step observe a dead tunnel?  Three signatures: the step's
-    own probe logged a timeout (stderr), a harvested JSON metric is
+    """Did this step observe a dead tunnel?  Two signatures: the step's
+    own probe logged a timeout (stderr), or a harvested JSON metric is
     tagged cpu-fallback (bench.py exits 0 on fallback — the marker is in
-    its result line, not the rc), or the step itself timed out."""
+    its result line, not the rc)."""
     tail = " ".join(rec.get("stderr_tail", []) or []) + " ".join(
         rec.get("stdout_tail", []) or [])
     metrics = " ".join(str(r.get("metric", ""))
                        for r in rec.get("results", []))
     return ("TIMED OUT" in tail or "cpu-fallback" in tail
             or "cpu-fallback" in metrics
-            or rec.get("error", "").startswith("timeout"))
+            or '"probe": "down"' in " ".join(
+                json.dumps(r) for r in rec.get("results", [])))
 
 
 def _commit() -> None:
@@ -211,11 +234,14 @@ def watch(interval_s: int = PROBE_INTERVAL_S, once: bool = False) -> int:
             if up and (last_capture is None
                        or time.monotonic() - last_capture
                        > CAPTURE_COOLDOWN_S):
-                # Charge the cooldown only for a capture that really ran:
-                # a dud (tunnel died between probe and capture) must not
-                # block the next real window for 45 minutes.
-                if capture(rec.get("device", "tpu")):
-                    last_capture = time.monotonic()
+                # Charge the full cooldown only for a complete capture.
+                # A dud (tunnel died mid-capture, or a step timed out)
+                # retries after DUD_RETRY_S instead: soon enough to
+                # catch the window reopening, long enough not to hammer
+                # a half-up tunnel with hour-long capture restarts.
+                full = capture(rec.get("device", "tpu"))
+                last_capture = time.monotonic() - (
+                    0 if full else CAPTURE_COOLDOWN_S - DUD_RETRY_S)
         except Exception as e:  # noqa: BLE001 — unattended: must survive
             # transient EIO/disk-full on the ledger append, subprocess
             # OSErrors, ... — log and keep probing; dying silently in a

@@ -115,3 +115,25 @@ def test_write_from_device_larger_than_chunk(engine, tmp_path):
     n = write_from_device(engine, data, path)
     assert n == 5 << 20
     assert path.read_bytes() == np.asarray(data).tobytes()
+
+
+def test_stream_ready_drain_matches_blocking(engine, tmp_data_file):
+    """drain='ready' (opportunistic is_ready retirement) must yield the
+    identical ordered byte stream as the blocking policy — it only
+    changes WHEN staging buffers recycle, never what comes out."""
+    path, payload = tmp_data_file
+    for depth in (1, 2, 5):
+        ds = DeviceStream(engine, depth=depth, drain="ready")
+        got = b"".join(np.asarray(c).tobytes()
+                       for c in ds.stream_file(path))
+        assert got == payload
+    # arbitrary ranges keep order too
+    fh = engine.open(path)
+    ranges = [(4096, 8192), (0, 100), (1 << 20, 65536), (77, 4000)]
+    ds = DeviceStream(engine, depth=3, drain="ready")
+    outs = list(ds.stream_ranges(fh, ranges))
+    engine.close(fh)
+    for (off, ln), out in zip(ranges, outs):
+        assert np.asarray(out).tobytes() == payload[off:off + ln]
+    with pytest.raises(ValueError, match="drain"):
+        DeviceStream(engine, drain="bogus")

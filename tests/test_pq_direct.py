@@ -120,15 +120,16 @@ def test_direct_rejects_with_reasons(tmp_path, engine):
     r = ParquetScanner(p1, engine).direct_reasons(["v"])
     assert r["v"] is not None and "encodings" in r["v"]
 
-    # compressed
+    # compressed chunks are now direct-eligible (host decompress leg)
     p2 = str(tmp_path / "snappy.parquet")
     pq.write_table(pa.table({"v": pa.array(
         rng.standard_normal(rows).astype(np.float32))}), p2,
         compression="snappy", use_dictionary=False)
     r = ParquetScanner(p2, engine).direct_reasons(["v"])
-    assert r["v"] is not None and "compression" in r["v"]
+    assert r["v"] is None
 
-    # nulls present (a real Arrow null — NaN would NOT count)
+    # nulls present (a real Arrow null — NaN would NOT count): rejected
+    # unless the caller opts into nulls="mask"
     p3 = str(tmp_path / "nulls.parquet")
     vals = [float(x) for x in rng.standard_normal(rows)]
     vals[7] = None
@@ -571,3 +572,122 @@ def test_page_header_parser_fuzz():
                 assert ph.header_len <= len(buf)
             except pq_direct.ThriftError:
                 pass
+
+
+# -- compressed chunks + null masks on the direct path (VERDICT r2 #4) ------
+
+
+@pytest.mark.parametrize("comp", ["snappy", "zstd", "gzip"])
+@pytest.mark.parametrize("ver", ["1.0", "2.0"])
+@pytest.mark.parametrize("use_dict", [False, True])
+def test_compressed_direct_matches_pyarrow(tmp_path, engine, comp, ver,
+                                           use_dict):
+    """Compressed chunks stay on the direct path (engine-read compressed
+    spans, host decompress, on-device decode) and bit-match pyarrow for
+    plain and dictionary encodings, v1 and v2 data pages."""
+    rng = np.random.default_rng(11)
+    rows = 9000
+    i32 = rng.integers(0, 50, rows).astype(np.int32)   # dict-friendly
+    f32 = rng.standard_normal(rows).astype(np.float32)
+    path = str(tmp_path / "c.parquet")
+    pq.write_table(pa.table({"i32": pa.array(i32), "f32": pa.array(f32)}),
+                   path, compression=comp, use_dictionary=use_dict,
+                   data_page_version=ver, row_group_size=4000)
+    sc = ParquetScanner(path, engine)
+    assert sc.direct_reasons(["i32", "f32"]) == {"i32": None, "f32": None}
+    out = sc.read_columns_to_device(["i32", "f32"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out["i32"]), i32)
+    np.testing.assert_array_equal(np.asarray(out["f32"]), f32)
+
+
+@pytest.mark.parametrize("comp", ["none", "zstd"])
+@pytest.mark.parametrize("ver", ["1.0", "2.0"])
+@pytest.mark.parametrize("use_dict", [False, True])
+def test_null_mask_direct_matches_pyarrow(tmp_path, engine, comp, ver,
+                                          use_dict):
+    """nulls='mask': definition levels decode to a validity mask, dense
+    values scatter on device, null slots zero-fill — across page
+    versions, codecs, and encodings."""
+    rng = np.random.default_rng(12)
+    rows = 7000
+    base = rng.integers(0, 40, rows).astype(np.int32)
+    nm = rng.random(rows) < 0.2
+    vals = base.astype(object)
+    vals[nm] = None
+    path = str(tmp_path / "n.parquet")
+    pq.write_table(pa.table({"v": pa.array(list(vals), pa.int32())}),
+                   path, compression=comp, use_dictionary=use_dict,
+                   data_page_version=ver, row_group_size=3000)
+    sc = ParquetScanner(path, engine)
+    v, m = sc.read_columns_to_device(["v"], direct="always",
+                                     nulls="mask")["v"]
+    v, m = np.asarray(v), np.asarray(m)
+    np.testing.assert_array_equal(m, ~nm)
+    np.testing.assert_array_equal(v[m], base[~nm])
+    assert (v[~m] == 0).all()
+    # default mode refuses the same column with a pointer to the fix
+    with pytest.raises(ValueError, match="null"):
+        sc.read_columns_to_device(["v"], direct="always")
+
+
+def test_null_mask_pyarrow_fallback_parity(tmp_path, engine):
+    """The pyarrow fallback honours the same (values, mask) contract so
+    consumers never care which path served them."""
+    rng = np.random.default_rng(13)
+    rows = 3000
+    base = rng.standard_normal(rows).astype(np.float32)
+    nm = rng.random(rows) < 0.15
+    vals = base.astype(object)
+    vals[nm] = None
+    path = str(tmp_path / "fb.parquet")
+    _write(path, pa.table({"v": pa.array(list(vals), pa.float32())}))
+    sc = ParquetScanner(path, engine)
+    direct = sc.read_columns_to_device(["v"], direct="always",
+                                       nulls="mask")["v"]
+    fallb = sc.read_columns_to_device(["v"], direct="never",
+                                      nulls="mask")["v"]
+    for v, m in (direct, fallb):
+        v, m = np.asarray(v), np.asarray(m)
+        np.testing.assert_array_equal(m, ~nm)
+        np.testing.assert_array_equal(v[m], base[~nm])
+        assert (v[~m] == 0).all()
+
+
+def test_all_null_and_leading_null_pages(tmp_path, engine):
+    """Degenerate shapes: a column that is entirely null, and pages that
+    START with nulls (exercises the clip(pos,0) guard in the on-device
+    scatter)."""
+    rows = 2000
+    alln = pa.array([None] * rows, pa.int32())
+    lead = pa.array([None] * 100 + list(range(rows - 100)), pa.int32())
+    path = str(tmp_path / "d.parquet")
+    _write(path, pa.table({"alln": alln, "lead": lead}))
+    sc = ParquetScanner(path, engine)
+    out = sc.read_columns_to_device(["alln", "lead"], direct="always",
+                                    nulls="mask")
+    v, m = (np.asarray(x) for x in out["alln"])
+    assert not m.any() and (v == 0).all() and v.shape == (rows,)
+    v, m = (np.asarray(x) for x in out["lead"])
+    assert not m[:100].any() and m[100:].all()
+    np.testing.assert_array_equal(v[100:], np.arange(rows - 100))
+
+
+def test_compressed_bounce_is_bounded(tmp_path, engine):
+    """Accounting: the compressed direct path may bounce (decompression
+    is host work) but the bounce must stay within ~compressed+payload
+    bytes — not the pyarrow path's whole-table materializations."""
+    rng = np.random.default_rng(14)
+    rows = 50000
+    f32 = rng.standard_normal(rows).astype(np.float32)
+    path = str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"v": pa.array(f32)}), path,
+                   compression="zstd", use_dictionary=False)
+    sc = ParquetScanner(path, engine)
+    pre = engine.stats.snapshot()["bounce_bytes"]
+    out = sc.read_columns_to_device(["v"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out["v"]), f32)
+    dbounce = engine.stats.snapshot()["bounce_bytes"] - pre
+    payload = rows * 4
+    # CPU test device: engine-read compressed bytes + decompressed body
+    # + host_to_device protective copy — bound it at 3x payload
+    assert 0 < dbounce <= 3 * payload + (1 << 16)

@@ -35,7 +35,10 @@ def test_run_step_timeout_is_recorded_not_fatal(tmp_path):
     rec = tw._run_step("hang", [sys.executable, str(script)], timeout_s=2)
     assert rec["rc"] == -1
     assert rec["error"].startswith("timeout")
-    assert tw._looks_down(rec)
+    # a timeout alone is AMBIGUOUS (slow compile vs dead tunnel): it must
+    # not read as down — capture() instead marks the run incomplete and
+    # lets the next step's own device gate decide
+    assert not tw._looks_down(rec)
 
 
 def test_looks_down_heuristic():
