@@ -1,0 +1,164 @@
+"""On-device RLE/bit-packed hybrid index decode (round-2 verdict #5).
+
+The Parquet dictionary index stream is a sequence of runs: RLE runs
+(``count × one value``) and bit-packed runs (``groups × 8`` values of
+``bit_width`` bits, LSB-first).  Round 2 expanded the WHOLE stream on
+host (``pq_direct.decode_rle_hybrid``) and counted the expanded int32
+array as bounce — 4 bytes/value of host-touched payload.  But only the
+run HEADERS are sequential control flow; the run bodies are not:
+
+- an RLE run is two scalars — ``jnp.full(count, value)`` materializes
+  it on DEVICE, zero host bytes;
+- a bit-packed run is a fixed-width bitstream — exactly the shape the
+  VPU unpacks with shifts/masks: ship the RAW bytes (bit_width/8 per
+  value instead of 4) and decode there.
+
+So the host walk shrinks to varint header parsing (~2 bytes per run),
+and payload-class host traffic drops from ``4·count`` bytes to the raw
+index-stream bytes the engine read anyway.
+
+Bit-unpack math, vectorized over a ``(groups, bit_width)`` uint8 array
+(one row = 8 values):
+
+    bit b of output value v lives at stream bit ``v·bw + b`` →
+    byte ``(v·bw + b) >> 3``, shift ``(v·bw + b) & 7``.
+
+The gather/shift/mask/dot runs under jit with ``bit_width`` static and
+the group count padded to the next power of two (bounded compile
+cache: one program per (bw, log2 groups) pair, not per page size).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+#: give up on streams with more runs than this — a low-cardinality
+#: column alternating RLE/packed every few values would launch hundreds
+#: of tiny device ops; host decode is faster there and its bounce is
+#: small (the stream is small).  High-cardinality columns — where the
+#: expanded-index bounce actually hurts — pack thousands of values per
+#: run and stay far under it.
+MAX_SEGMENTS = 256
+
+#: bit widths above this leave the device path (1 << bw weights must
+#: fit int32; a >16M-entry dictionary has no business being gathered)
+MAX_BIT_WIDTH = 24
+
+
+def split_rle_hybrid(buf, bit_width: int, count: int
+                     ) -> Optional[List[Tuple]]:
+    """Parse run headers only → segment list, or None when the device
+    path shouldn't be used (too many runs / oversized bit width).
+
+    Segments: ``("rle", take, value)`` or ``("packed", start, nbytes,
+    groups, take)`` with ``take`` = values this run contributes after
+    discarding the final run's spec-legal padding."""
+    if bit_width == 0 or bit_width > MAX_BIT_WIDTH:
+        return None
+    byte_w = (bit_width + 7) // 8
+    segs: List[Tuple] = []
+    pos, filled, n = 0, 0, len(buf)
+    while filled < count:
+        if len(segs) >= MAX_SEGMENTS:
+            return None
+        header = shift = 0
+        while True:
+            if pos >= n:
+                raise ValueError("truncated RLE stream header")
+            b = buf[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 35:
+                raise ValueError("RLE header varint overflow")
+        if header & 1:                       # bit-packed run
+            groups = header >> 1
+            nbytes = groups * bit_width
+            if pos + nbytes > n:
+                raise ValueError("truncated bit-packed run")
+            take = min(groups * 8, count - filled)
+            segs.append(("packed", pos, nbytes, groups, take))
+            pos += nbytes
+            filled += take
+        else:                                # RLE run
+            run = header >> 1
+            if run == 0:
+                raise ValueError("zero-length RLE run")
+            if pos + byte_w > n:
+                raise ValueError("truncated RLE run value")
+            v = int.from_bytes(buf[pos:pos + byte_w], "little")
+            pos += byte_w
+            take = min(run, count - filled)
+            segs.append(("rle", take, v))
+            filled += take
+    return segs
+
+
+@functools.lru_cache(maxsize=1)
+def _unpack_groups():
+    """Jitted (groups*bit_width,) uint8 → (groups*8,) int32, LSB-first.
+    Lazy so importing this module never touches a jax backend."""
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnames=("bit_width", "groups"))
+    def unpack(u8, bit_width: int, groups: int):
+        rows = u8.reshape(groups, bit_width)
+        bit_idx = np.arange(8 * bit_width)
+        byte_of = jnp.asarray(bit_idx >> 3)
+        shift = jnp.asarray((bit_idx & 7).astype(np.uint8))
+        bits = (rows[:, byte_of] >> shift) & 1      # (groups, 8*bw)
+        weights = jnp.asarray(
+            (1 << np.arange(bit_width, dtype=np.int32)))
+        return jnp.einsum(
+            "gvb,b->gv",
+            bits.reshape(groups, 8, bit_width).astype(np.int32),
+            weights, preferred_element_type=np.int32).reshape(-1)
+
+    return unpack
+
+
+def _pow2_pad(groups: int) -> int:
+    p = 1
+    while p < groups:
+        p *= 2
+    return p
+
+
+def rle_hybrid_to_device(buf, bit_width: int, count: int, dev,
+                         engine=None) -> Optional["object"]:
+    """Index stream → int32 DEVICE array, or None → caller host-decodes.
+
+    Host work: header parse + one padded device_put per packed run
+    (byte counting: the put is ``bytes_to_device``; on CPU the bridge's
+    protective copy counts bounce as usual — on an accelerator no
+    expanded index array ever exists host-side).  RLE runs are
+    ``jnp.full`` on device."""
+    import jax.numpy as jnp
+    from nvme_strom_tpu.ops.bridge import host_to_device
+
+    segs = split_rle_hybrid(buf, bit_width, count)
+    if segs is None:
+        return None
+    if not segs:
+        return jnp.zeros((0,), jnp.int32)
+    parts = []
+    for seg in segs:
+        if seg[0] == "rle":
+            _, take, v = seg
+            parts.append(jnp.full((take,), v, jnp.int32))
+        else:
+            _, start, nbytes, groups, take = seg
+            padded = _pow2_pad(groups)
+            u8 = np.zeros(padded * bit_width, np.uint8)
+            u8[:nbytes] = np.frombuffer(buf, np.uint8, nbytes, start)
+            u8_dev = (host_to_device(engine, u8, dev) if engine is not None
+                      else jnp.asarray(u8))
+            vals = _unpack_groups()(u8_dev, bit_width, padded)
+            parts.append(vals[:take])
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)

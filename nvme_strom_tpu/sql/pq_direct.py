@@ -761,30 +761,54 @@ def _decode_one_index_stream(eng, fh, p: PagePart, dev):
     return _index_from_body(body, n_valid)
 
 
-def _decode_indices(eng, fh, parts, dict_count: int, dev):
-    """Dict-kind PageParts → one validated int32 host index array.
+def _indices_to_device(eng, fh, parts, dict_count: int, dev):
+    """Dict-kind PageParts → one validated int32 DEVICE index array.
 
-    Applies the module's accounting policy: raw index-stream bytes are
-    counted by the engine read; the decoded array is host-materialized
-    payload-derived data → bounce (on CPU ``host_to_device`` counts that
-    same buffer via its alias-protection copy, so only non-CPU adds it
-    here).  Validation is range-only — ``jnp.take`` would silently clip
-    a corrupt stream into wrong rows."""
+    Prefers the on-device bit-unpack (ops/bitunpack.py — round-2
+    verdict #5): the host parses only run headers, bit-packed bytes
+    unpack with shifts/masks on the VPU, RLE runs are ``jnp.full`` —
+    no expanded index array ever exists host-side, so the only
+    payload-class host traffic is the engine read of the raw stream.
+    Each span is read ONCE: pages the device path declines
+    (pathological run counts, bw > 24) host-decode from the same
+    buffer; compressed bodies go through
+    :func:`_decode_one_index_stream`.  Host-expanded arrays keep the
+    module's accounting policy (bounce on non-CPU; the CPU device_put
+    alias copy counts it there).  The range check (corrupt-stream
+    honesty — ``jnp.take`` would silently clip into wrong rows) costs
+    one scalar sync per chunk."""
+    import jax.numpy as jnp
     import numpy as np
-    idx_parts = [_decode_one_index_stream(eng, fh, p, dev)
-                 for p in parts]
-    if not idx_parts:          # zero-row chunk
-        return np.empty(0, np.int32)
-    idx = (idx_parts[0] if len(idx_parts) == 1
-           else np.concatenate(idx_parts))
-    if idx.size:
-        lo, hi = int(idx.min()), int(idx.max())
+    from nvme_strom_tpu.ops.bitunpack import rle_hybrid_to_device
+    from nvme_strom_tpu.ops.bridge import host_to_device
+
+    def put_host_idx(idx):
+        if dev.platform != "cpu":
+            eng.stats.add(bounce_bytes=int(idx.nbytes))
+        return host_to_device(eng, idx, dev)
+
+    dev_parts = []
+    for p in parts:
+        if p.is_raw:
+            buf = _read_span_bytes(eng, fh, *p.span)
+            d = rle_hybrid_to_device(buf, p.bit_width, p.valid_count,
+                                     dev, engine=eng)
+            if d is None:      # device path declined: same buffer, host
+                d = put_host_idx(decode_rle_hybrid(
+                    buf, p.bit_width, p.valid_count))
+        else:
+            d = put_host_idx(_decode_one_index_stream(eng, fh, p, dev))
+        dev_parts.append(d)
+    if not dev_parts:          # zero-row chunk
+        return jnp.zeros((0,), jnp.int32)
+    idx = (dev_parts[0] if len(dev_parts) == 1
+           else jnp.concatenate(dev_parts))
+    if idx.shape[0]:
+        lo, hi = np.asarray(jnp.stack([idx.min(), idx.max()]))
         if lo < 0 or hi >= dict_count:
             raise ValueError(
                 f"dictionary index {lo if lo < 0 else hi} out of range "
                 f"[0, {dict_count})")
-    if dev.platform != "cpu":
-        eng.stats.add(bounce_bytes=int(idx.nbytes))
     return idx
 
 
@@ -951,10 +975,9 @@ def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
 
     def flush_dict():
         if pending_dict:
-            idx = _decode_indices(eng, fh, pending_dict,
-                                  plan.dict_count, dev)
-            segs.append((jnp.take(dict_dev,
-                                  host_to_device(eng, idx, dev)), None))
+            idx = _indices_to_device(eng, fh, pending_dict,
+                                     plan.dict_count, dev)
+            segs.append((jnp.take(dict_dev, idx), None))
             pending_dict.clear()
 
     def flush_plain():
@@ -1296,10 +1319,10 @@ def read_dict_key_column(scanner, column: str, device=None,
         try:
             for rg in selected:
                 ch, remap_dev = chunks[rg], remaps[rg]
-                idx = _decode_indices(eng, fh, ch.parts, ch.dict_count,
-                                      dev)
+                idx = _indices_to_device(eng, fh, ch.parts,
+                                         ch.dict_count, dev)
                 # local code → global code, on device
-                yield jnp.take(remap_dev, host_to_device(eng, idx, dev))
+                yield jnp.take(remap_dev, idx)
         finally:
             eng.close(fh)
 

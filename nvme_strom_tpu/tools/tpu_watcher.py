@@ -11,11 +11,13 @@ This watcher closes that loop (round-2 verdict, task #1):
   - every PROBE_INTERVAL seconds, probe ``jax.devices()`` in a THROWAWAY
     subprocess with a hard timeout (never in-process — a hung client
     would wedge the watcher itself);
-  - the moment a probe succeeds, run the capture suite — ``bench.py``
-    (north-star stream with interleaved ceiling probes) and
-    ``bench_suite.py`` configs 5/6/7 (SQL scan, decode tok/s, MFU) —
-    each in its own subprocess with a generous timeout so a mid-capture
-    tunnel death loses one step, not the evidence already gathered;
+  - the moment a probe succeeds, run the capture steps — ``bench.py``
+    (north-star stream with interleaved ceiling probes), the
+    stream-efficiency probe (``tools/stream_probe.py``), and
+    ``bench_suite.py`` configs 6/7/5/12/13 (decode tok/s, MFU, SQL
+    scans) — ONE subprocess per step with its own timeout, committing
+    after each, so a mid-capture tunnel death loses one step, not the
+    evidence already gathered;
   - append every JSON result line, timestamped, to the committed ledger
     ``BENCH_tpu_ledger.jsonl`` and git-commit it immediately, so the
     evidence survives even if the session dies seconds later.
@@ -151,6 +153,8 @@ def capture(device: str) -> bool:
         ("suite_5", [sys.executable, "bench_suite.py", "--config", "5"],
          900),
         ("suite_12", [sys.executable, "bench_suite.py", "--config", "12"],
+         900),
+        ("suite_13", [sys.executable, "bench_suite.py", "--config", "13"],
          900),
     ]
     for name, cmd, timeout_s in steps:

@@ -1,0 +1,97 @@
+"""ops.bitunpack: on-device RLE/bit-packed index decode vs the host
+reference decoder (pq_direct.decode_rle_hybrid), plus the fallback
+gates that keep pathological streams on the host path."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.ops.bitunpack import (
+    MAX_SEGMENTS, rle_hybrid_to_device, split_rle_hybrid)
+from nvme_strom_tpu.sql.pq_direct import decode_rle_hybrid
+
+
+def _varint(x: int) -> bytes:
+    out = b""
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        out += bytes([b | (0x80 if x else 0)])
+        if not x:
+            return out
+
+
+def encode_hybrid(runs, bw: int) -> bytes:
+    """Reference RLE/bit-packed encoder for tests: runs are
+    ("rle", count, value) or ("packed", values) with len(values) % 8
+    == 0, LSB-first bit packing per the Parquet spec."""
+    byte_w = (bw + 7) // 8
+    s = b""
+    for r in runs:
+        if r[0] == "rle":
+            s += _varint(r[1] << 1) + int(r[2]).to_bytes(byte_w,
+                                                         "little")
+        else:
+            vals = r[1]
+            g = len(vals) // 8
+            s += _varint((g << 1) | 1)
+            by = bytearray(g * bw)
+            i = 0
+            for v in vals:
+                for b in range(bw):
+                    by[i // 8] |= ((v >> b) & 1) << (i % 8)
+                    i += 1
+            s += bytes(by)
+    return s
+
+
+@pytest.mark.parametrize("bw", [1, 2, 3, 5, 7, 8, 11, 13, 16, 20, 24])
+def test_device_unpack_matches_host(bw):
+    import jax
+    rng = np.random.default_rng(bw)
+    dev = jax.devices()[0]
+    hi = 1 << bw
+    runs, total = [], 0
+    for _ in range(6):
+        if rng.random() < 0.5:
+            c = int(rng.integers(1, 50))
+            runs.append(("rle", c, int(rng.integers(0, hi))))
+            total += c
+        else:
+            vals = rng.integers(0, hi,
+                                size=int(rng.integers(1, 6)) * 8).tolist()
+            runs.append(("packed", vals))
+            total += len(vals)
+    buf = encode_hybrid(runs, bw)
+    # exact count, and a short count exercising final-run padding
+    for count in {total, max(1, total - 3)}:
+        ref = decode_rle_hybrid(buf, bw, count)
+        got = rle_hybrid_to_device(buf, bw, count, dev)
+        assert got is not None
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+def test_fallback_gates():
+    import jax
+    dev = jax.devices()[0]
+    # bit width 0 (single-entry dictionary) and > MAX_BIT_WIDTH decline
+    assert rle_hybrid_to_device(b"", 0, 5, dev) is None
+    assert rle_hybrid_to_device(b"\x00" * 10, 30, 5, dev) is None
+    # run-count explosion declines (host decode is faster there)
+    many = encode_hybrid([("rle", 1, 1)] * (MAX_SEGMENTS + 1), 4)
+    assert split_rle_hybrid(many, 4, MAX_SEGMENTS + 1) is None
+    assert rle_hybrid_to_device(many, 4, MAX_SEGMENTS + 1, dev) is None
+
+
+def test_split_rejects_corrupt_streams():
+    with pytest.raises(ValueError, match="truncated"):
+        split_rle_hybrid(b"", 4, 8)                 # no header
+    with pytest.raises(ValueError, match="truncated bit-packed"):
+        split_rle_hybrid(_varint((4 << 1) | 1), 4, 32)   # no body
+    with pytest.raises(ValueError, match="zero-length"):
+        split_rle_hybrid(_varint(0) + b"\x01", 4, 8)
+
+
+def test_zero_count():
+    import jax
+    out = rle_hybrid_to_device(b"", 3, 0, jax.devices()[0])
+    assert out is not None and np.asarray(out).shape == (0,)

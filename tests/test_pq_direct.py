@@ -357,10 +357,11 @@ def test_dict_overflow_mixed_plain_pages(tmp_path, engine):
 
 
 def test_dict_accounting(tmp_path, monkeypatch):
-    """Dictionary scan accounting: device receives dict values + decoded
-    indices; host-touched payload (bounce) is the raw index stream plus
-    the decoded index array (plus CPU-only device_put alias copies of
-    the streamed dictionary values)."""
+    """Dictionary scan accounting with the on-device bit-unpack: the
+    device receives dict values + the RAW (pow2-padded) bit-packed
+    stream — never a 4-bytes-per-row expanded index array.  Host-touched
+    payload (bounce) is the raw index stream the engine read (plus
+    CPU-only device_put alias copies)."""
     monkeypatch.setenv("STROM_NO_RESIDENCY_PROBE", "1")
     rng = np.random.default_rng(23)
     rows = 16384
@@ -369,6 +370,7 @@ def test_dict_accounting(tmp_path, monkeypatch):
     path = str(tmp_path / "acct_dict.parquet")
     pq.write_table(tbl, path, compression="none", use_dictionary=True)
 
+    from nvme_strom_tpu.ops.bitunpack import split_rle_hybrid, _pow2_pad
     stats = StromStats()
     with StromEngine(stats=stats) as eng:
         fh = eng.open(path)
@@ -378,19 +380,34 @@ def test_dict_accounting(tmp_path, monkeypatch):
             pytest.skip("fs rejects O_DIRECT")
         sc = ParquetScanner(path, eng)
         plans = pq_direct.plan_columns(sc, ["v"])
-        idx_raw = sum(p.span[1] for plan in plans["v"]
-                      for p in plan.parts if p.kind == "dict")
+        idx_raw = 0        # raw index-stream bytes (engine-read, host)
+        put_bytes = 0      # pow2-padded packed bytes put to device
+        with open(path, "rb") as f:
+            for plan in plans["v"]:
+                for p in plan.parts:
+                    assert p.kind == "dict"
+                    idx_raw += p.span[1]
+                    f.seek(p.span[0])
+                    segs = split_rle_hybrid(f.read(p.span[1]),
+                                            p.bit_width, p.valid_count)
+                    assert segs is not None   # device path must engage
+                    put_bytes += sum(
+                        _pow2_pad(s[3]) * p.bit_width
+                        for s in segs if s[0] == "packed")
         dict_bytes = sum(plan.dict_span[1] for plan in plans["v"])
         out = sc.read_columns_to_device(["v"], direct="always")
         np.testing.assert_array_equal(np.asarray(out["v"]),
                                       tbl.column("v").to_numpy())
         eng.sync_stats()
     assert idx_raw > 0 and dict_bytes > 0
-    # device saw the dictionary values plus one int32 index per row
-    assert stats.bytes_to_device == dict_bytes + 4 * rows
+    # device saw the dictionary values plus the padded packed stream —
+    # NOT 4 bytes per row (the round-2 contract this replaces)
+    assert stats.bytes_to_device == dict_bytes + put_bytes
+    assert put_bytes < 4 * rows / 3     # bw=6: ~6x smaller than int32
     import jax
-    dict_alias = (dict_bytes if jax.devices()[0].platform == "cpu" else 0)
-    assert stats.bounce_bytes == idx_raw + 4 * rows + dict_alias
+    alias = (dict_bytes + put_bytes
+             if jax.devices()[0].platform == "cpu" else 0)
+    assert stats.bounce_bytes == idx_raw + alias
 
 
 def test_groupby_on_dict_file(tmp_path, engine):
