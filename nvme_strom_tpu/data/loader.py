@@ -127,16 +127,16 @@ class ShardedLoader:
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None):
         import jax
-        if fmt not in ("wds", "tfrecord", "fixedrec"):
+        if fmt not in ("wds", "wds_raw", "tfrecord", "fixedrec"):
             raise ValueError(f"unknown fmt {fmt!r}")
-        if fmt == "fixedrec":
+        if fmt in ("fixedrec", "wds_raw"):
             if decode is not None:
                 raise ValueError(
-                    "fixedrec is the zero-copy raw path: records go "
+                    f"{fmt} is a zero-copy raw path: payload goes "
                     "staging→device untouched; decode on device instead")
             if seq_axis is not None:
                 raise ValueError(
-                    "fixedrec cannot seq-shard: a device's seq slice of "
+                    f"{fmt} cannot seq-shard: a device's seq slice of "
                     "every row is not a contiguous file span")
         self.mesh = mesh
         self.axis = axis
@@ -199,7 +199,7 @@ class ShardedLoader:
     # -- sample iteration (host side) -------------------------------------
 
     def _index_shard(self, path):
-        if self.fmt == "wds":
+        if self.fmt in ("wds", "wds_raw"):
             idx = WdsShardIndex(path)
             return [
                 {ext: rng for ext, rng in idx.samples[k].items()
@@ -277,6 +277,9 @@ class ShardedLoader:
         import jax
         if self.fmt == "fixedrec":
             yield from self._iter_fixedrec()
+            return
+        if self.fmt == "wds_raw":
+            yield from self._iter_wds_raw()
             return
         sharding = batch_sharding(self.mesh, self.axis)
         if self.seq_axis is not None:
@@ -382,17 +385,13 @@ class ShardedLoader:
         Multi-host note: every process must hold the same local record
         count (equal shards per process) or epochs desynchronize.
         """
-        import jax
+        import jax.numpy as jnp
         from nvme_strom_tpu.formats.fixedrec import FixedRecIndex
         from nvme_strom_tpu.ops.bridge import host_to_device
 
         eng = self._engine
         sharding = batch_sharding(self.mesh, self.axis)
-        order = list(self.local_shards)
-        if self.config.shuffle_buffer:
-            perm = shuffled_indices(len(order), self.config.seed,
-                                    self.epoch)
-            order = [order[i] for i in perm]
+        order = self._epoch_shard_order()
         idxs = [FixedRecIndex(p) for p in order]
         if not idxs:
             self.epoch += 1
@@ -412,34 +411,14 @@ class ShardedLoader:
                 "chunk_bytes")
 
         gshape = (self.global_batch,) + rshape
-        # device → global row span; this process's rows must be one
-        # contiguous block so local record index = global row − lo.
-        dev_spans = {}
-        for d, idx in sharding.devices_indices_map(gshape).items():
-            if d.process_index != jax.process_index():
-                continue
-            s0 = tuple(idx)[0]
-            dev_spans[d] = (0 if s0.start is None else int(s0.start),
-                            gshape[0] if s0.stop is None else int(s0.stop))
-        lo, hi = _process_span(sharding, gshape, dim=0,
-                               proc=jax.process_index())
-        if (hi - lo) != self.local_batch:
-            raise ValueError(
-                f"process rows [{lo},{hi}) != local_batch "
-                f"{self.local_batch}")
+        dev_spans, lo = self._device_row_spans(sharding, gshape)
 
         # local record r lives in shard s at record r - base[s]
         base, total = [], 0
         for ix in idxs:
             base.append(total)
             total += ix.count
-        n_batches = total // self.local_batch
-        if total % self.local_batch and not self.config.drop_remainder:
-            raise ValueError(
-                f"{total} local records do not fill "
-                f"{total // self.local_batch + 1} batches of "
-                f"{self.local_batch}; pad the dataset or set "
-                "drop_remainder=True")
+        n_batches = self._count_batches(total)
 
         def pieces(r0, r1):
             """Local records [r0, r1) → [(shard_i, offset, length), ...]
@@ -458,30 +437,117 @@ class ShardedLoader:
             return out
 
         fhs = [eng.open(p) for p in order]
-        depth = max(1, self.config.prefetch)
-        pending: list = []   # (per-device [(dev, [PendingRead...])])
+
+        def plan_reads(r0, r1):
+            return [eng.submit_read(fhs[si], off, ln)
+                    for si, off, ln in pieces(r0, r1)]
+
+        def to_device(dev, prs):
+            parts = []
+            for pr in prs:
+                v = pr.wait()
+                n = v.nbytes // rec_bytes
+                parts.append(host_to_device(
+                    eng, v.view(dtype).reshape((n,) + rshape), dev))
+            return (parts[0] if len(parts) == 1
+                    else jnp.concatenate(parts))
+
+        span_list = sorted({sp for sp in dev_spans.values()})
+        batch_pieces = sum(
+            len(pieces((g0 - lo), (g1 - lo))) for g0, g1 in span_list)
+        yield from self._zero_copy_batches(
+            sharding, gshape, dev_spans, lo, n_batches, batch_pieces,
+            plan_reads, to_device, fhs)
+
+    # -- shared scaffolding of the zero-copy batch paths --------------------
+
+    def _epoch_shard_order(self) -> List:
+        """Per-epoch shard order: shuffled at SHARD granularity only —
+        both zero-copy paths trade record-level shuffling away (shuffle
+        record order at dataset-prep time, the ffcv/ArrayRecord
+        recipe)."""
+        order = list(self.local_shards)
+        if self.config.shuffle_buffer:
+            perm = shuffled_indices(len(order), self.config.seed,
+                                    self.epoch)
+            order = [order[i] for i in perm]
+        return order
+
+    def _count_batches(self, total: int) -> int:
+        n_batches = total // self.local_batch
+        if total % self.local_batch and not self.config.drop_remainder:
+            raise ValueError(
+                f"{total} local records do not fill batches of "
+                f"{self.local_batch}; pad the dataset or set "
+                "drop_remainder=True")
+        return n_batches
+
+    def _device_row_spans(self, sharding, gshape):
+        """device → its contiguous global row span [g0, g1), plus the
+        process's own row base ``lo`` (local record = global row − lo)."""
+        import jax
+        dev_spans = {}
+        for d, idx in sharding.devices_indices_map(gshape).items():
+            if d.process_index != jax.process_index():
+                continue
+            s0 = tuple(idx)[0]
+            dev_spans[d] = (0 if s0.start is None else int(s0.start),
+                            gshape[0] if s0.stop is None
+                            else int(s0.stop))
+        lo, hi = _process_span(sharding, gshape, dim=0,
+                               proc=jax.process_index())
+        if (hi - lo) != self.local_batch:
+            raise ValueError(
+                f"process rows [{lo},{hi}) != local_batch "
+                f"{self.local_batch}")
+        return dev_spans, lo
+
+    def _zero_copy_batches(self, sharding, gshape, dev_spans, lo,
+                           n_batches, batch_pieces, plan_reads,
+                           to_device, fhs) -> Iterator:
+        """Prefetch/backpressure engine shared by fixedrec and wds_raw.
+
+        ``plan_reads(r0, r1)`` submits engine reads for local rows
+        [r0, r1) and returns them as an arbitrarily nested list with
+        PendingReads at the leaves; it is called once per DISTINCT
+        device row span per batch (replicas along non-batch mesh axes
+        share the reads).  ``to_device(dev, reads)`` turns one device's
+        read structure into that device's array (calling ``wait()`` —
+        idempotent — on each read).  Rules enforced here:
+
+        - the pool is finite and the engine defers (never errors) reads
+          past it; releases happen after transfer, so in-flight pieces
+          are bounded by the pool or submission would deadlock;
+        - staging buffers release even when a wait/transfer throws;
+        - ``config.prefetch`` batches are kept in flight.
+
+        Closes ``fhs`` and bumps the epoch on exit."""
+        import jax
+        eng = self._engine
+        if batch_pieces > eng.n_buffers:
+            raise ValueError(
+                f"one batch needs {batch_pieces} staging buffers but "
+                f"the pool has {eng.n_buffers}; raise EngineConfig."
+                "chunk_bytes or lower the batch size")
 
         def entry_reads(entry):
             reads = {}   # id → PendingRead (replicas share the reads)
-            for _, prs in entry:
-                for pr in prs:
-                    reads[id(pr)] = pr
+
+            def walk(x):
+                if isinstance(x, list):
+                    for y in x:
+                        walk(y)
+                else:
+                    reads[id(x)] = x
+            for _, rs in entry:
+                walk(rs)
             return list(reads.values())
 
         def finish(entry):
-            import jax.numpy as jnp
             per_dev = []
             try:
-                for dev, prs in entry:
-                    parts = []
-                    for pr in prs:
-                        v = pr.wait()
-                        n = v.nbytes // rec_bytes
-                        parts.append(host_to_device(
-                            eng, v.view(dtype).reshape((n,) + rshape),
-                            dev))
-                    per_dev.append(parts[0] if len(parts) == 1
-                                   else jnp.concatenate(parts))
+                for dev, rs in entry:
+                    per_dev.append(to_device(dev, rs))
                 for a in per_dev:
                     a.block_until_ready()   # device owns the bytes now
             finally:
@@ -492,20 +558,9 @@ class ShardedLoader:
             return jax.make_array_from_single_device_arrays(
                 gshape, sharding, per_dev)
 
-        # The pool is finite and the engine defers (never errors) reads
-        # past it — releases happen in finish(), so submitting more than
-        # the pool holds before finishing would deadlock.  Bound the
-        # in-flight pieces; a single batch over the pool cannot work.
-        span_list = sorted({sp for sp in dev_spans.values()})
-        batch_pieces = sum(
-            len(pieces((g0 - lo), (g1 - lo))) for g0, g1 in span_list)
-        if batch_pieces > eng.n_buffers:
-            raise ValueError(
-                f"one batch needs {batch_pieces} staging buffers but the "
-                f"pool has {eng.n_buffers}; raise EngineConfig."
-                "chunk_bytes or lower the batch size")
+        depth = max(1, self.config.prefetch)
+        pending: list = []
         inflight = 0
-
         try:
             for b in range(n_batches):
                 b0 = b * self.local_batch
@@ -513,17 +568,13 @@ class ShardedLoader:
                     entry = pending.pop(0)
                     inflight -= len(entry_reads(entry))
                     yield finish(entry)
-                # replicas along non-batch axes share a span: one read
-                # per distinct span, one transfer per device
                 span_reads = {}
                 entry = []
                 for dev, (g0, g1) in dev_spans.items():
                     key = (g0, g1)
                     if key not in span_reads:
-                        span_reads[key] = [
-                            eng.submit_read(fhs[si], off, ln)
-                            for si, off, ln in
-                            pieces(b0 + (g0 - lo), b0 + (g1 - lo))]
+                        span_reads[key] = plan_reads(b0 + (g0 - lo),
+                                                     b0 + (g1 - lo))
                     entry.append((dev, span_reads[key]))
                 pending.append(entry)
                 inflight += len(entry_reads(entry))
@@ -540,6 +591,82 @@ class ShardedLoader:
             for fh in fhs:
                 eng.close(fh)
         self.epoch += 1
+
+    # -- wds_raw: batch-coalesced zero-copy WebDataset path -----------------
+
+    def _iter_wds_raw(self) -> Iterator:
+        """One epoch of raw-member WebDataset batches (VERDICT r2 #6).
+
+        The standard wds path copies every payload to host
+        (``view.tobytes()`` per member) because ``decode`` is arbitrary
+        Python.  But config 3's shards — and any raw-tensor wds dataset
+        — need no host decode at all: each member's bytes go staging →
+        device untouched.  Per batch, per local device: the device's
+        rows' member ranges are engine-read as ONE pipelined sequence
+        (tar headers between members are never read), each staging view
+        is ``device_put`` directly, members concat/stack ON DEVICE, and
+        the global array assembles with
+        ``make_array_from_single_device_arrays`` — the fixedrec recipe
+        applied to tar shards.  Members that need host decode (JPEG…)
+        belong on the standard path; this one requires single-part
+        samples of one common byte length (uint8 output, reshape/cast
+        on device downstream).  Like fixedrec, record-level shuffling
+        is traded away: ``shuffle_buffer`` permutes SHARD order only —
+        randomize record order at dataset-prep time.
+        """
+        import jax.numpy as jnp
+        from nvme_strom_tpu.ops.bridge import host_to_device
+
+        eng = self._engine
+        sharding = batch_sharding(self.mesh, self.axis)
+        order = self._epoch_shard_order()
+        recs: list = []          # (shard_i, offset, length) per record
+        mlen = None
+        for si, path in enumerate(order):
+            for parts in self._index_shard(path):
+                if len(parts) != 1:
+                    raise ValueError(
+                        f"{path}: wds_raw needs single-part samples "
+                        f"(got {sorted(parts)}); restrict with exts= or "
+                        "use the standard wds path")
+                ((off, ln),) = parts.values()
+                if mlen is None:
+                    mlen = ln
+                elif ln != mlen:
+                    raise ValueError(
+                        f"{path}: member length {ln} != {mlen}; wds_raw "
+                        "stacks fixed-size members — variable-size "
+                        "samples need the standard wds path")
+                recs.append((si, off, ln))
+        if mlen is None or not recs:
+            self.epoch += 1
+            return
+        gshape = (self.global_batch, mlen)
+        dev_spans, lo = self._device_row_spans(sharding, gshape)
+        n_batches = self._count_batches(len(recs))
+        chunk = eng.config.chunk_bytes
+        batch_pieces = self.local_batch * -(-mlen // chunk)
+        fhs = [eng.open(p) for p in order]
+
+        def member_reads(si, off, ln):
+            return [eng.submit_read(fhs[si], off + o, min(chunk, ln - o))
+                    for o in range(0, ln, chunk)]
+
+        def plan_reads(r0, r1):
+            return [member_reads(*recs[r]) for r in range(r0, r1)]
+
+        def to_device(dev, groups):
+            members = []
+            for prs in groups:
+                parts = [host_to_device(eng, pr.wait(), dev)
+                         for pr in prs]
+                members.append(parts[0] if len(parts) == 1
+                               else jnp.concatenate(parts))
+            return jnp.stack(members)
+
+        yield from self._zero_copy_batches(
+            sharding, gshape, dev_spans, lo, n_batches, batch_pieces,
+            plan_reads, to_device, fhs)
 
     def close(self) -> None:
         if self._owns_engine:

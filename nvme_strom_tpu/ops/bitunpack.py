@@ -56,7 +56,11 @@ def split_rle_hybrid(buf, bit_width: int, count: int
     Segments: ``("rle", take, value)`` or ``("packed", start, nbytes,
     groups, take)`` with ``take`` = values this run contributes after
     discarding the final run's spec-legal padding."""
-    if bit_width == 0 or bit_width > MAX_BIT_WIDTH:
+    if bit_width == 0:
+        # single-entry dictionary: every index is 0, no stream to parse
+        # — the device answer is one free jnp.zeros
+        return [("rle", count, 0)] if count else []
+    if bit_width > MAX_BIT_WIDTH:
         return None
     byte_w = (bit_width + 7) // 8
     segs: List[Tuple] = []

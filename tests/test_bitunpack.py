@@ -73,8 +73,11 @@ def test_device_unpack_matches_host(bw):
 def test_fallback_gates():
     import jax
     dev = jax.devices()[0]
-    # bit width 0 (single-entry dictionary) and > MAX_BIT_WIDTH decline
-    assert rle_hybrid_to_device(b"", 0, 5, dev) is None
+    # bit width 0 (single-entry dictionary): all-zero indices built
+    # entirely on device — no stream parse, no host expansion
+    out = rle_hybrid_to_device(b"", 0, 5, dev)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros(5, np.int32))
+    # > MAX_BIT_WIDTH declines to the host path
     assert rle_hybrid_to_device(b"\x00" * 10, 30, 5, dev) is None
     # run-count explosion declines (host decode is faster there)
     many = encode_hybrid([("rle", 1, 1)] * (MAX_SEGMENTS + 1), 4)

@@ -366,3 +366,109 @@ def test_group_blocks_rejects_unequal_tiling():
     # unequal group sizes: {0,1} vs {2}
     with pytest.raises(ValueError, match="tile"):
         _group_blocks({0: {0, 1}, 1: {2}}, 3, 0, "dp")
+
+
+# -- wds_raw: the batch-coalesced zero-copy WebDataset path (VERDICT r2 #6) --
+
+
+def _make_raw_wds_shards(tmp_path, n_shards=2, per_shard=8, mlen=4096):
+    from nvme_strom_tpu.formats.wds import write_wds_shard
+    rng = np.random.default_rng(3)
+    paths, rows = [], []
+    for s in range(n_shards):
+        samples = []
+        for i in range(per_shard):
+            payload = rng.integers(0, 256, mlen, dtype=np.uint8)
+            samples.append({"bin": payload.tobytes()})
+            rows.append(payload)
+        p = str(tmp_path / f"raw-{s:03d}.tar")
+        write_wds_shard(p, samples)
+        paths.append(p)
+    return paths, rows
+
+
+def test_wds_raw_batches_match_standard_path(tmp_path):
+    """wds_raw yields the same rows as the standard wds path, assembled
+    device-side with no host payload copy."""
+    import jax
+    from jax.sharding import Mesh
+
+    paths, rows = _make_raw_wds_shards(tmp_path)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(4), ("dp",))
+    with ShardedLoader(paths, mesh, global_batch=4,
+                       fmt="wds_raw") as loader:
+        got = [np.asarray(b) for b in loader]
+    assert len(got) == 4
+    flat = np.concatenate(got)
+    np.testing.assert_array_equal(flat, np.stack(rows))
+    # second epoch works (file handles reopened per epoch)
+    with ShardedLoader(paths, mesh, global_batch=4,
+                       fmt="wds_raw") as loader:
+        assert len(list(loader)) == 4
+
+
+def test_wds_raw_bounce_accounting(tmp_path, monkeypatch):
+    """No host-side payload copy: the only bounce on the CPU test device
+    is device_put's alias-protection copy — exactly payload bytes, not
+    the tobytes()-per-member copy of the standard path (which pays
+    payload twice: tobytes + alias copy)."""
+    monkeypatch.setenv("STROM_NO_RESIDENCY_PROBE", "1")
+    import jax
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.utils.stats import StromStats
+    from nvme_strom_tpu.io.engine import StromEngine
+
+    paths, rows = _make_raw_wds_shards(tmp_path, n_shards=1,
+                                       per_shard=8, mlen=8192)
+    payload = 8 * 8192
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("dp",))
+
+    def run(fmt):
+        stats = StromStats()
+        with StromEngine(stats=stats) as eng:
+            fh = eng.open(paths[0])
+            direct = eng.file_is_direct(fh)
+            eng.close(fh)
+            with ShardedLoader(paths, mesh, global_batch=8, fmt=fmt,
+                               engine=eng) as loader:
+                out = [np.asarray(b).reshape(8, -1) for b in loader]
+            eng.sync_stats()
+        return out, stats.bounce_bytes, direct
+
+    raw_out, raw_bounce, direct = run("wds_raw")
+    std_out, std_bounce, _ = run("wds")
+    np.testing.assert_array_equal(raw_out[0], std_out[0])
+    if not direct:
+        pytest.skip("fs rejects O_DIRECT")
+    # On the CPU test device both paths count payload exactly once, but
+    # from DIFFERENT copies: wds_raw's term is host_to_device's CPU-only
+    # alias-protection copy (vanishes on an accelerator -> bounce 0,
+    # the config-3 claim); the standard path's is the per-member
+    # tobytes() handoff, which an accelerator still pays.
+    assert raw_bounce == payload
+    assert std_bounce == payload
+
+
+def test_wds_raw_validation(tmp_path):
+    import jax
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.formats.wds import write_wds_shard
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("dp",))
+    # multi-part samples are refused
+    p = str(tmp_path / "multi.tar")
+    write_wds_shard(p, [{"a": b"x" * 512, "b": b"y" * 512}])
+    with ShardedLoader([p], mesh, global_batch=2,
+                       fmt="wds_raw") as loader:
+        with pytest.raises(ValueError, match="single-part"):
+            list(loader)
+    # unequal member lengths are refused
+    p2 = str(tmp_path / "uneq.tar")
+    write_wds_shard(p2, [{"bin": b"x" * 512}, {"bin": b"y" * 1024}])
+    with ShardedLoader([p2], mesh, global_batch=2,
+                       fmt="wds_raw") as loader:
+        with pytest.raises(ValueError, match="length"):
+            list(loader)
+    # decode/seq_axis are refused up front
+    with pytest.raises(ValueError, match="zero-copy"):
+        ShardedLoader([p2], mesh, 2, fmt="wds_raw", decode=lambda x: x)
