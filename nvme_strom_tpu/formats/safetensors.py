@@ -264,3 +264,12 @@ def write_safetensors_engine(path, tensors: Dict[str, np.ndarray], engine,
             except OSError:
                 pass
         engine.close(fh)
+    # Direct chunks are durable at completion, but the header/tail (and,
+    # on fs without O_DIRECT, everything) rode the page cache — fsync
+    # closes that gap so callers' commit markers/renames can rely on
+    # "writer returned ⇒ bytes on disk".
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)

@@ -305,8 +305,62 @@ def test_save_async_background_failure_propagates(tmp_path, monkeypatch):
     def boom(step, *args):
         raise RuntimeError("disk on fire")
 
-    monkeypatch.setattr(mgr, "_write", boom)
+    monkeypatch.setattr(mgr, "_write_collective_free", boom)
     mgr.save_async(1, {"w": jnp.ones((4,), jnp.float32)})
     with pytest.raises(RuntimeError, match="disk on fire"):
         mgr.wait_pending()
     mgr.wait_pending()   # drained: second wait is a no-op
+
+
+def test_async_crash_window_restores_previous_step(tmp_path):
+    """The commit point is meta.json + rename.  A save that dies after
+    its data (and marker) but before finalize leaves only the dotted
+    temp dir: all_steps/restore pick the PREVIOUS step; running the
+    finalize half afterwards publishes the new one (VERDICT r2 #7)."""
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.checkpoint.manager import CheckpointManager
+
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    s1 = {"w": jnp.arange(16, dtype=jnp.float32)}
+    mgr.save(1, s1)
+
+    s2 = {"w": jnp.arange(16, dtype=jnp.float32) * 2}
+    tmp, final, mine, index = mgr._snapshot(2, s2, False, barrier=False)
+    # "crash" between data and manifest: data + marker written, no
+    # finalize — exactly what a killed host leaves behind
+    mgr._write_data_and_marker(2, tmp, mine)
+    assert os.path.exists(os.path.join(tmp, "done-00000.json"))
+    assert mgr.all_steps() == [1]
+    out = mgr.restore({"w": jnp.zeros(16, jnp.float32)})
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(s1["w"]))
+    # recovery completes the save: finalize publishes step 2 atomically
+    mgr._finalize(2, tmp, final, index)
+    assert mgr.all_steps() == [1, 2]
+    out = mgr.restore({"w": jnp.zeros(16, jnp.float32)})
+    np.testing.assert_array_equal(np.asarray(out["w"]),
+                                  np.asarray(s2["w"]))
+    # markers were cleaned before the rename
+    assert not any(n.startswith("done-")
+                   for n in os.listdir(mgr.step_dir(2)))
+
+
+def test_finalize_times_out_on_missing_marker(tmp_path, monkeypatch):
+    """Host 0's marker wait fails loudly (never finalizes a torn save)
+    when another host's marker never appears."""
+    import jax.numpy as jnp
+    import pytest as _pytest
+    from nvme_strom_tpu.checkpoint import manager as M
+
+    monkeypatch.setenv("STROM_CKPT_WAIT_S", "0.3")
+    mgr = M.CheckpointManager(tmp_path / "ckpt")
+    s = {"w": jnp.arange(4, dtype=jnp.float32)}
+    tmp, final, mine, index = mgr._snapshot(1, s, False, barrier=False)
+    mgr._write_data_and_marker(1, tmp, mine)
+    # pretend a second host exists whose marker never lands
+    import jax
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    with _pytest.raises(TimeoutError, match="done markers"):
+        mgr._finalize(1, tmp, final, index)
+    assert mgr.all_steps() == []

@@ -133,6 +133,35 @@ _WORKER = textwrap.dedent("""
             for i in range(data.shape[0]):
                 np.testing.assert_array_equal(
                     data[i], toks[b * 4 + r0 + i, c0:c0 + data.shape[1]])
+    # -- collective-free multi-host save_async (round-2 verdict #7):
+    # both processes checkpoint a dp-sharded array in the background
+    # (no jax collectives on the IO thread), host 0 finalizes via the
+    # filesystem marker wait, and restore reads it back under the mesh.
+    from nvme_strom_tpu.checkpoint.manager import CheckpointManager
+    ck = os.path.join(d, "ckpt")
+    mgr = CheckpointManager(ck)
+    w = jax.device_put(
+        jnp.arange(32, dtype=jnp.float32).reshape(8, 4),
+        NamedSharding(mesh, P("dp", None)))
+    fut = mgr.save_async(3, {"w": w, "step": 3})
+    # the train loop would keep stepping here; a collective while the
+    # background write runs must NOT deadlock — prove it with one
+    total2 = float(jax.jit(jnp.sum)(arr))
+    assert total2 == total
+    assert fut.result(timeout=120).endswith("step_00000003")
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("ckpt_done")  # all see the rename
+    got = mgr.restore({"w": jax.device_put(
+        jnp.zeros((8, 4), jnp.float32),
+        NamedSharding(mesh, P("dp", None))), "step": 0})
+    assert int(got["step"]) == 3
+    for sh in got["w"].addressable_shards:
+        r0 = sh.index[0].start or 0
+        np.testing.assert_array_equal(
+            np.asarray(sh.data),
+            np.arange(32, dtype=np.float32).reshape(8, 4)[
+                r0:r0 + sh.data.shape[0]])
+
     print(f"proc{pid} OK", flush=True)
 """).replace("@REPO@", str(REPO))
 
