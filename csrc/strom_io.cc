@@ -812,7 +812,13 @@ int strom_resolve_device(const char *path, strom_device_info *out) {
   if (d) {
     struct dirent *de;
     /* Scan EVERY member for the all-NVMe verdict; members[] records only
-     * the first STROM_MAX_RAID_MEMBERS names. */
+     * the first STROM_MAX_RAID_MEMBERS names — ordered by md SLOT, not
+     * readdir order: raid0 chunk k lives on slot (k mod n), so stripe
+     * attribution (strom_stripe_attr) is only meaningful against the
+     * slot order.  /sys/block/mdX/md/dev-<name>/slot holds it; members
+     * with no readable slot (spares, legacy sysfs) keep scan order
+     * after the slotted ones. */
+    int slots[STROM_MAX_RAID_MEMBERS];
     while ((de = readdir(d)) != nullptr) {
       if (de->d_name[0] == '.') continue;
       char slink[PATH_MAX];
@@ -820,8 +826,26 @@ int strom_resolve_device(const char *path, strom_device_info *out) {
       snprintf(slink, sizeof(slink), "/sys/class/block/%.200s", de->d_name);
       if (whole_disk_name(slink, mname, sizeof(mname)) != 0)
         snprintf(mname, sizeof(mname), "%.63s", de->d_name);
-      if (out->n_members < STROM_MAX_RAID_MEMBERS)
-        memcpy(out->members[out->n_members], mname, sizeof(mname));
+      if (out->n_members < STROM_MAX_RAID_MEMBERS) {
+        int slot = INT32_MAX;  /* unknown slots sort last, stably */
+        char sp[PATH_MAX];
+        snprintf(sp, sizeof(sp), "/sys/block/%s/md/dev-%.200s/slot",
+                 out->device, de->d_name);
+        FILE *sf = fopen(sp, "r");
+        if (sf) {
+          if (fscanf(sf, "%d", &slot) != 1) slot = INT32_MAX;
+          fclose(sf);
+        }
+        int i = out->n_members;
+        while (i > 0 && slots[i - 1] > slot) {  /* insertion sort */
+          slots[i] = slots[i - 1];
+          memcpy(out->members[i], out->members[i - 1],
+                 sizeof(out->members[0]));
+          i--;
+        }
+        slots[i] = slot;
+        memcpy(out->members[i], mname, sizeof(mname));
+      }
       out->n_members++;
       if (!name_is_nvme(mname)) all_nvme = 0;
     }
@@ -896,6 +920,28 @@ int strom_file_extents(const char *path, strom_extent *out, uint32_t max) {
   out[0].pad = 0;
   close(fd);
   return 1;
+}
+
+void strom_stripe_attr(uint64_t phys_off, uint64_t len, uint64_t chunk,
+                       uint32_t n_members, uint64_t *out_bytes) {
+  if (len == 0 || n_members == 0 || chunk == 0) return;
+  if (n_members == 1) { out_bytes[0] += len; return; }
+  const uint64_t period = chunk * (uint64_t)n_members;
+  /* whole stripe periods cover every member equally */
+  const uint64_t full = len / period;
+  if (full) {
+    for (uint32_t m = 0; m < n_members; m++) out_bytes[m] += full * chunk;
+  }
+  /* remainder: walk at most n_members+1 chunk fragments */
+  uint64_t off = phys_off + full * period;
+  uint64_t left = len % period;
+  while (left) {
+    const uint64_t in_chunk = chunk - (off % chunk);
+    const uint64_t take = left < in_chunk ? left : in_chunk;
+    out_bytes[(off / chunk) % n_members] += take;
+    off += take;
+    left -= take;
+  }
 }
 
 void strom_get_pool_info(strom_engine *e, strom_pool_info *out) {

@@ -135,6 +135,18 @@ typedef struct strom_extent {
  * of extents written (>= 0) or -errno. */
 int strom_file_extents(const char *path, strom_extent *out, uint32_t max);
 
+/* md-raid0 stripe attribution: how many bytes of the physical span
+ * [phys_off, phys_off + len) land on each of the n_members striped
+ * devices (stripe chunk `chunk` bytes, member of chunk k = k mod n)?
+ * Adds into out_bytes[0..n_members).  Closed-form over full stripe
+ * periods plus a <= 2*n_members remainder walk — O(members), not
+ * O(len/chunk).  Pure function: the per-member byte counters behind
+ * `strom_stat --device` (the striped-scaling attribution the
+ * reference's 6-10 GB/s md-raid0 claim implies, SURVEY.md §6) are
+ * buildable and testable without raid hardware. */
+void strom_stripe_attr(uint64_t phys_off, uint64_t len, uint64_t chunk,
+                       uint32_t n_members, uint64_t *out_bytes);
+
 /* Staging-pool introspection — the LIST_GPU_MEMORY / INFO_GPU_MEMORY
  * analogue (SURVEY.md §2 "GPU memory mapper"): the reference enumerates
  * pinned GPU mappings; we report the pinned staging pool and its

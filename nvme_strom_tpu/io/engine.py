@@ -8,6 +8,7 @@ buffers, exposed here as zero-copy numpy views via ``np.ctypeslib.as_array``.
 
 from __future__ import annotations
 
+import bisect
 import ctypes
 import errno
 import os
@@ -130,6 +131,10 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_file_extents.argtypes = [ctypes.c_char_p,
                                            ctypes.POINTER(_Extent),
                                            ctypes.c_uint32]
+        lib.strom_stripe_attr.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
+        lib.strom_stripe_attr.restype = None
         lib.strom_get_pool_info.argtypes = [ctypes.c_void_p,
                                             ctypes.POINTER(_PoolInfo)]
         lib.strom_get_latency.argtypes = [
@@ -212,6 +217,26 @@ def resolve_device(path: os.PathLike | str) -> DeviceInfo:
                       is_nvme=bool(info.is_nvme), is_raid=bool(info.is_raid),
                       raid_level=info.raid_level, rotational=info.rotational,
                       nvme_backed=bool(info.nvme_backed), members=members)
+
+
+def stripe_attr(phys_off: int, length: int, chunk: int,
+                n_members: int) -> list:
+    """Per-member byte attribution of physical span [phys_off,
+    phys_off+length) on an md-raid0 of ``n_members`` devices with
+    stripe ``chunk`` (C closed-form; see strom_stripe_attr)."""
+    lib = _load_lib()
+    out = (ctypes.c_uint64 * n_members)()
+    lib.strom_stripe_attr(phys_off, length, chunk, n_members, out)
+    return list(out)
+
+
+def md_chunk_bytes(device: str) -> int:
+    """Stripe chunk of an md device from sysfs (bytes); 0 if unknown."""
+    try:
+        with open(f"/sys/block/{device}/md/chunk_size") as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return 0
 
 
 EXTENT_SYNTHETIC = 0x80000000
@@ -415,6 +440,7 @@ class StromEngine:
         self.n_buffers = n_buffers
         self._open_fhs: set[int] = set()
         self._last_lat_read: list[int] = [0] * _LAT_BUCKETS
+        self._stripe: dict = {}   # fh → (chunk, members, extents)
         self._closed = False
 
     # -- file handles ------------------------------------------------------
@@ -426,11 +452,78 @@ class StromEngine:
         if fh < 0:
             raise OSError(-fh, os.strerror(-fh), str(path))
         self._open_fhs.add(fh)
+        if self.config.stripe_accounting and not writable:
+            self._setup_stripe(fh, path)
         return fh
+
+    def _setup_stripe(self, fh: int, path) -> None:
+        """Per-member attribution geometry for this file (SURVEY.md §6:
+        the reference's striped claim implies knowing which member
+        served which byte).  Real geometry comes from the backing
+        md-raid0 (sysfs chunk + member walk); STROM_STRIPE_SIM=
+        "<chunk_kib>:<n>" imposes synthetic geometry on any device so
+        the attribution path is exercisable without raid hardware.
+        Synthetic (FIEMAP-less) extents attribute by logical offset —
+        best effort, flagged by the extent itself."""
+        sim = os.environ.get("STROM_STRIPE_SIM")
+        if sim:
+            try:
+                chunk_kib, n = sim.split(":")
+                chunk = int(chunk_kib) << 10
+                members = tuple(f"sim{i}" for i in range(int(n)))
+                if chunk <= 0 or not members:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"STROM_STRIPE_SIM={sim!r}: expected "
+                    "'<chunk_kib>:<n_members>' with positive integers")
+            # simulated geometry attributes by LOGICAL offset (one
+            # whole-file pseudo extent with physical == logical):
+            # deterministic regardless of where the fs placed the file
+            extents = [Extent(0, 0, self.file_size(fh), 0)]
+            self._stripe[fh] = (chunk, members, extents, [0])
+            return
+        else:
+            info = resolve_device(path)
+            if not (info.is_raid and info.raid_level == 0
+                    and len(info.members) > 1):
+                return
+            chunk = md_chunk_bytes(info.device)
+            if chunk <= 0:
+                return
+            members = info.members
+            extents = sorted(file_extents(path),
+                             key=lambda e: e.logical)
+        self._stripe[fh] = (chunk, members, extents,
+                            [e.logical for e in extents])
+
+    def _attr_stripe(self, fh: int, offset: int, length: int) -> None:
+        st = self._stripe.get(fh)
+        if st is None:
+            return
+        chunk, members, extents, logicals = st
+        lib = self._lib
+        buf = (ctypes.c_uint64 * len(members))()
+        # extents are sorted by logical: bisect to the first overlap and
+        # stop past the range (fragmented files can map to thousands of
+        # extents; a full scan per submit would dominate the hot path)
+        i = bisect.bisect_right(logicals, offset) - 1
+        for e in extents[max(i, 0):]:
+            if e.logical >= offset + length:
+                break
+            lo = max(offset, e.logical)
+            hi = min(offset + length, e.logical + e.length)
+            if lo >= hi:
+                continue
+            phys = e.physical + (lo - e.logical)
+            lib.strom_stripe_attr(phys, hi - lo, chunk, len(members),
+                                  buf)
+        self.stats.add_member_bytes(members, list(buf))
 
     def close(self, fh: int) -> None:
         self._lib.strom_close(self._h, fh)
         self._open_fhs.discard(fh)
+        self._stripe.pop(fh, None)
 
     def file_size(self, fh: int) -> int:
         n = self._lib.strom_file_size(self._h, fh)
@@ -451,6 +544,8 @@ class StromEngine:
         rid = self._lib.strom_submit_read(self._h, fh, offset, length)
         if rid < 0:
             raise OSError(-rid, os.strerror(-rid))
+        if self._stripe:
+            self._attr_stripe(fh, offset, length)
         return PendingRead(self, rid, length)
 
     def read(self, fh: int, offset: int, length: int) -> np.ndarray:

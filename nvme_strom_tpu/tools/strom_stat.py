@@ -78,6 +78,14 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
         lines.append(f"  {name:<22} {shown:>14}{suffix}")
     for name in sorted(k for k in snap if k.startswith("lat_")):
         lines.append(f"  {name:<22} {snap[name]:>14.1f}")
+    members = snap.get("member_bytes")
+    if members:
+        total = max(1, sum(members.values()))
+        lines.append("  per-member payload (stripe attribution):")
+        for m in sorted(members):
+            v = int(members[m])
+            lines.append(f"    {m:<20} {_human(v):>14}"
+                         f"   ({100.0 * v / total:.1f}%)")
     direct = int(snap.get("bytes_direct", 0))
     bounce = int(snap.get("bounce_bytes", 0))
     if direct and bounce == 0:

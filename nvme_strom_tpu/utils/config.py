@@ -39,6 +39,13 @@ class EngineConfig:
         default_factory=lambda: os.environ.get("STROM_MLOCK", "1") != "0")
     max_retries: int = field(
         default_factory=lambda: _env_int("STROM_MAX_RETRIES", 2))
+    #: attribute read payload to md-raid0 members per stripe geometry
+    #: (per-member counters in stats/strom_stat; small per-submit cost).
+    #: STROM_STRIPE_SIM="<chunk_kib>:<n>" simulates geometry on a
+    #: non-raid device (bench/test evidence without raid hardware).
+    stripe_accounting: bool = field(
+        default_factory=lambda: os.environ.get("STROM_STRIPE_ACCT",
+                                               "0") == "1")
 
     def __post_init__(self):
         if (self.alignment < 512 or self.alignment > (1 << 22)

@@ -58,11 +58,28 @@ class StromStats:
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)
+    # per-raid-member payload attribution (striped-scaling evidence,
+    # SURVEY.md §6): {member name: bytes}; filled only when stripe
+    # accounting is on (EngineConfig.stripe_accounting)
+    _member_bytes: dict = field(default_factory=dict, repr=False)
 
     def add(self, **deltas: int) -> None:
         with self._lock:
             for name, d in deltas.items():
                 setattr(self, name, getattr(self, name) + d)
+
+    def add_member_bytes(self, members, deltas) -> None:
+        """Accumulate per-raid-member payload bytes (parallel lists)."""
+        with self._lock:
+            for m, d in zip(members, deltas):
+                if d:
+                    self._member_bytes[m] = (
+                        self._member_bytes.get(m, 0) + int(d))
+
+    @property
+    def member_bytes(self) -> dict:
+        with self._lock:
+            return dict(self._member_bytes)
 
     def set_gauges(self, **values) -> None:
         """Point-in-time values (latency percentiles etc.) carried in the
@@ -87,6 +104,8 @@ class StromStats:
         with self._lock:
             snap = {name: getattr(self, name) for name in COUNTER_FIELDS}
             snap.update(self._gauges)
+            if self._member_bytes:
+                snap["member_bytes"] = dict(self._member_bytes)
             return snap
 
     def dump_json(self) -> str:
@@ -97,6 +116,7 @@ class StromStats:
             for name in COUNTER_FIELDS:
                 setattr(self, name, 0)
             self._gauges.clear()
+            self._member_bytes.clear()
             self._t0 = time.monotonic()
 
     def maybe_export(self) -> None:

@@ -127,3 +127,13 @@ def test_transfer_diag_alias_proof(capsys):
     assert res["view_aligned"] is True
     assert res["verdict"] == "zero-copy to PJRT boundary"
     assert res["t_staging_s"] > 0 and res["t_copy_heap_s"] > 0
+
+
+def test_strom_stat_renders_member_bytes(capsys):
+    """Per-member attribution shows up in the CLI render with shares."""
+    from nvme_strom_tpu.tools.strom_stat import render
+    out = render({"bytes_direct": 4096, "bounce_bytes": 0,
+                  "member_bytes": {"nvme0n1": 3 << 20, "nvme1n1": 1 << 20}})
+    assert "per-member payload" in out
+    assert "nvme0n1" in out and "75.0%" in out
+    assert "nvme1n1" in out and "25.0%" in out
