@@ -848,23 +848,21 @@ def bench_serving(device=None) -> tuple[float, str]:
     return rate, tag
 
 
-def bench_train(device=None) -> tuple[float, str]:
-    """Config 7: train-step throughput as model TFLOP/s (and MFU when the
-    chip's peak is known).  FLOPs are the 6·T·P matmul estimate plus the
-    12·L·b·s²·d attention term — model FLOPs, not hardware FLOPs, so
-    remat or XLA fusion can't inflate the number."""
+def _train_variant(cfg, batch: int, seq: int, dev,
+                   profile_dir: str | None = None) -> float:
+    """Median model-FLOP/s of one (config, batch) train-step variant;
+    optionally capture a 3-step jax profiler trace while at it."""
     import jax
     import jax.numpy as jnp
     import optax
-    from nvme_strom_tpu.models.transformer import init_params, make_train_step
-    cfg = _bench_cfg()
-    batch, seq = (2, 64) if _tiny_compute() else (8, 1024)
-    dev = device or jax.devices()[0]
+    from nvme_strom_tpu.models.transformer import (init_params,
+                                                   make_train_step)
     params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
     opt = optax.adamw(1e-3)
     opt_state = jax.device_put(opt.init(params), dev)
     tokens = jax.device_put(jax.random.randint(
-        jax.random.key(1), (batch, seq), 0, cfg.vocab, dtype=jnp.int32), dev)
+        jax.random.key(1), (batch, seq), 0, cfg.vocab, dtype=jnp.int32),
+        dev)
     n_matmul = _matmul_param_count(params)
     flops_step = (6 * batch * seq * n_matmul
                   + 12 * cfg.n_layers * batch * seq * seq * cfg.d_model)
@@ -877,11 +875,78 @@ def bench_train(device=None) -> tuple[float, str]:
         params, opt_state, loss = step(params, opt_state, tokens)
         jax.block_until_ready(loss)
         rates.append(flops_step / (time.monotonic() - t0))
-    flops_sec = statistics.median(rates)
+    if profile_dir:
+        # the committed profile breakdown for the MFU story: 3 traced
+        # steps, viewable in TensorBoard/xprof
+        with jax.profiler.trace(profile_dir):
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state,
+                                               tokens)
+            jax.block_until_ready(loss)
+        _log(f"suite: wrote jax profiler trace to {profile_dir}")
+    del params, opt_state
+    return statistics.median(rates)
+
+
+def bench_train(device=None) -> tuple[float, str]:
+    """Config 7: train-step throughput as model TFLOP/s (and MFU when the
+    chip's peak is known).  FLOPs are the 6·T·P matmul estimate plus the
+    12·L·b·s²·d attention term — model FLOPs, not hardware FLOPs, so
+    remat or XLA fusion can't inflate the number.
+
+    STROM_TRAIN_SWEEP="<batch>:<remat>,..." (remat none|dots|full) runs
+    several variants and reports the best, each in the tag — the MFU
+    lever sweep (batch amortizes weight streaming; dots-remat keeps the
+    bigger batch inside HBM at a fraction of full remat's recompute).
+    STROM_PROFILE_DIR captures a 3-step jax profiler trace of the best
+    variant."""
+    import dataclasses
+    import jax
+    cfg = _bench_cfg()
+    batch, seq = (2, 64) if _tiny_compute() else (8, 1024)
+    dev = device or jax.devices()[0]
+    sweep = os.environ.get("STROM_TRAIN_SWEEP", "")
+    variants = []
+    if sweep:
+        for spec in sweep.split(","):
+            spec = spec.strip()
+            if not spec:
+                continue
+            b, _, pol = spec.partition(":")
+            try:
+                variants.append((int(b), pol or "none"))
+            except ValueError:
+                # one typo must not lose the whole (scarce) TPU step
+                _log(f"suite: ignoring bad sweep spec {spec!r} "
+                     "(want '<batch>:<none|dots|full>')")
+    if not variants:
+        variants = [(batch, cfg.remat_policy or "none")]
+    prof = os.environ.get("STROM_PROFILE_DIR")
+    results = []
+    for i, (b, pol) in enumerate(variants):
+        vcfg = dataclasses.replace(cfg, remat_policy=pol, remat=False)
+        try:
+            # trace rides the measuring call of the final variant — no
+            # separate re-compile/re-run just to profile
+            fs = _train_variant(vcfg, b, seq, dev,
+                                profile_dir=(prof if prof and
+                                             i == len(variants) - 1
+                                             else None))
+        except Exception as e:  # noqa: BLE001 — OOM on a sweep point
+            _log(f"suite: train variant b={b} remat={pol} failed: "
+                 f"{type(e).__name__}: {str(e)[:160]}")
+            continue
+        results.append((fs, b, pol))
+        _log(f"suite: train b={b} remat={pol}: {fs / 1e12:.3f} TFLOP/s")
+    if not results:
+        raise RuntimeError("every train variant failed")
+    best = max(results)
     peak = _peak_flops(dev)
-    note = (f"mfu={flops_sec / peak:.1%}" if peak
+    note = (f"mfu={best[0] / peak:.1%}" if peak
             else "mfu=null (unknown peak)")
-    return flops_sec / 1e12, f"{note} b={batch} s={seq}"
+    per = " ".join(f"b{b}/{p}={fs / 1e12:.2f}" for fs, b, p in results)
+    return best[0] / 1e12, (f"{note} b={best[1]} s={seq} "
+                            f"remat={best[2]} [{per}]")
 
 
 # ------------------------------- main ----------------------------------

@@ -53,6 +53,14 @@ class TransformerConfig:
     # extra forward's FLOPs for O(1)-layers activation memory — the HBM
     # lever for deep configs.
     remat: bool = False
+    # Selective remat (round-2 verdict #3: all-or-nothing remat cost ~6
+    # MFU points): "none" keeps every activation, "full" recomputes the
+    # whole layer (== remat=True), "dots" saves matmul outputs and
+    # recomputes only the cheap elementwise/norm ops — most of full
+    # remat's memory win at a fraction of its recompute FLOPs
+    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable).
+    # Takes precedence over ``remat`` when set.
+    remat_policy: str = ""
 
     def __post_init__(self):
         if isinstance(self.rope_scaling, dict):
@@ -267,8 +275,17 @@ def forward_with_aux(params: Dict, tokens: jax.Array,
             h, a = mlp(h, params, L), jnp.zeros((), jnp.float32)
         return x + h, a
 
-    if cfg.remat:
+    policy = cfg.remat_policy or ("full" if cfg.remat else "none")
+    if policy == "full":
         one_layer = jax.checkpoint(one_layer, static_argnums=(1,))
+    elif policy == "dots":
+        one_layer = jax.checkpoint(
+            one_layer, static_argnums=(1,),
+            policy=jax.checkpoint_policies
+            .dots_with_no_batch_dims_saveable)
+    elif policy != "none":
+        raise ValueError(
+            f"remat_policy {policy!r}: expected none|full|dots")
     for i in range(cfg.n_layers):
         x, a = one_layer(x, i)
         aux = aux + a

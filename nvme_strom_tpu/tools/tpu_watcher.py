@@ -93,15 +93,21 @@ def probe() -> dict:
 
 
 def _run_step(name: str, cmd: list[str],
-              timeout_s: int = CAPTURE_TIMEOUT_S) -> dict:
+              timeout_s: int = CAPTURE_TIMEOUT_S,
+              env_extra: dict | None = None) -> dict:
     """Run one capture step; harvest every JSON line from its stdout and
     the tail of its stderr.  A timeout or crash is recorded, not fatal —
     the tunnel can die mid-step and the other steps' results must land."""
     t0 = time.monotonic()
     rec: dict = {"step": name, "cmd": " ".join(cmd), "ts": _now()}
+    env = None
+    if env_extra:
+        env = dict(os.environ)
+        env.update(env_extra)
+        rec["env"] = env_extra
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout_s, cwd=REPO)
+                           timeout=timeout_s, cwd=REPO, env=env)
         rec["rc"] = r.returncode
         rec["stderr_tail"] = r.stderr.strip().splitlines()[-12:]
         results = []
@@ -143,22 +149,30 @@ def capture(device: str) -> bool:
     # stream bench, the stream-efficiency probe (verdict task #2), then
     # compute rows (decode, MFU), then SQL scans.
     steps = [
-        ("bench", [sys.executable, "bench.py"], 900),
+        ("bench", [sys.executable, "bench.py"], 900, None),
         ("stream_probe",
-         [sys.executable, "-m", "nvme_strom_tpu.tools.stream_probe"], 1500),
+         [sys.executable, "-m", "nvme_strom_tpu.tools.stream_probe"],
+         1500, None),
         ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
-         1200),
+         1200, None),
         ("suite_7", [sys.executable, "bench_suite.py", "--config", "7"],
-         1500),
+         1500, None),
+        # the MFU lever sweep (verdict #3): batch amortizes weight
+        # streaming, dots-remat fits the bigger batches — each variant
+        # compiles fresh over the tunnel, so it gets its own step/budget
+        ("suite_7_sweep",
+         [sys.executable, "bench_suite.py", "--config", "7"], 2400,
+         {"STROM_TRAIN_SWEEP": "16:none,32:dots,64:dots"}),
         ("suite_5", [sys.executable, "bench_suite.py", "--config", "5"],
-         900),
+         900, None),
         ("suite_12", [sys.executable, "bench_suite.py", "--config", "12"],
-         900),
+         900, None),
         ("suite_13", [sys.executable, "bench_suite.py", "--config", "13"],
-         900),
+         900, None),
     ]
-    for name, cmd, timeout_s in steps:
-        rec = _run_step(name, cmd, timeout_s=timeout_s)
+    for name, cmd, timeout_s, env_extra in steps:
+        rec = _run_step(name, cmd, timeout_s=timeout_s,
+                        env_extra=env_extra)
         rec["device"] = device
         _append(LEDGER, rec)
         _commit()

@@ -184,3 +184,43 @@ def test_gradient_accumulation_matches_full_batch():
     with pytest.raises(ValueError, match="divisible"):
         jax.jit(make_train_step(cfg, opt, accum_steps=3))(
             params, opt.init(params), tokens)
+
+
+def test_remat_policies_same_loss_and_grads():
+    """remat_policy none/full/dots are pure memory/recompute trades:
+    loss and gradients must be bit-comparable (same program, same
+    math); bogus policies fail loudly."""
+    import dataclasses
+    import jax
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.transformer import (
+        TransformerConfig, init_params, loss_fn)
+
+    cfg = TransformerConfig(vocab=128, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=2, d_ff=64, max_seq=32,
+                            dtype=jnp.float32)
+    params = init_params(jax.random.key(0), cfg)
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab,
+                              dtype=jnp.int32)
+
+    outs = {}
+    for pol in ("none", "full", "dots"):
+        c = dataclasses.replace(cfg, remat_policy=pol)
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: loss_fn(p, toks, c)))(params)
+        outs[pol] = (float(loss), grads)
+    assert outs["none"][0] == outs["full"][0] == outs["dots"][0]
+    for pol in ("full", "dots"):
+        jax.tree.map(
+            lambda a, b: None if (abs(a - b) < 1e-5).all() else
+            (_ for _ in ()).throw(AssertionError(pol)),
+            outs["none"][1], outs[pol][1])
+    # legacy remat=True == policy "full"
+    c = dataclasses.replace(cfg, remat=True)
+    loss, _ = jax.jit(jax.value_and_grad(
+        lambda p: loss_fn(p, toks, c)))(params)
+    assert float(loss) == outs["full"][0]
+    import pytest
+    c = dataclasses.replace(cfg, remat_policy="bogus")
+    with pytest.raises(ValueError, match="remat_policy"):
+        loss_fn(params, toks, c)
