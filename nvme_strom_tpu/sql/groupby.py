@@ -179,7 +179,7 @@ def _stack_values(cols, vcols, single):
 def iter_device_columns(scanner, columns: Sequence[str], dev,
                         require_int: Sequence[str] = (),
                         narrow_int32: Sequence[str] = (),
-                        row_groups=None):
+                        row_groups=None, nulls: str = "forbid"):
     """Stream a scanner's row groups as {name: device array} dicts.
 
     One policy for every on-device SQL consumer (groupby, join): the
@@ -191,40 +191,69 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
     names (implicitly require_int) are delivered as int32 — narrowed on
     HOST on the fallback path so an int64 key doesn't ship double-width
     bytes over the link only to be cast on arrival.  Callers that need
-    full-width keys (the join under x64) simply don't list them."""
+    full-width keys (the join under x64) simply don't list them.
+
+    ``nulls="mask"``: yields ({name: values}, {name: bool mask}) pairs
+    instead — null slots zero-filled, masks all-True for null-free
+    columns; both decode paths honour the same contract."""
     import numpy as np
     from nvme_strom_tpu.ops.bridge import host_to_device
     from nvme_strom_tpu.sql import pq_direct
 
+    if nulls not in ("forbid", "mask"):
+        raise ValueError(f"bad nulls={nulls!r}")
+    masked = nulls == "mask"
     require_int = tuple(dict.fromkeys([*require_int, *narrow_int32]))
+
+    def check_and_narrow(cols, xp):
+        for c in require_int:
+            if not xp.issubdtype(cols[c].dtype, xp.integer):
+                raise TypeError(f"key column {c} must be integer")
+        for c in narrow_int32:
+            cols[c] = cols[c].astype(xp.int32)
+
     plans = None
     if hasattr(scanner, "direct_reasons"):
         try:
-            plans = pq_direct.plan_columns(scanner, columns)
+            plans = pq_direct.plan_columns(scanner, columns,
+                                           allow_nulls=masked)
         except ValueError:
             plans = None
     if plans is not None:
         for cols in pq_direct.iter_plain_row_groups_to_device(
                 scanner, columns, device=dev, plans=plans,
-                row_groups=row_groups):
-            for c in require_int:
-                if not jnp.issubdtype(cols[c].dtype, jnp.integer):
-                    raise TypeError(f"key column {c} must be integer")
-            for c in narrow_int32:
-                cols[c] = cols[c].astype(jnp.int32)
-            yield cols
+                row_groups=row_groups, nulls=nulls):
+            if masked:
+                vals = {c: v for c, (v, _) in cols.items()}
+                masks = {c: m for c, (_, m) in cols.items()}
+                check_and_narrow(vals, jnp)
+                yield vals, masks
+            else:
+                check_and_narrow(cols, jnp)
+                yield cols
         return
     for tbl in scanner.iter_row_groups(list(columns),
                                        row_groups=row_groups):
-        host = {c: tbl.column(c).to_numpy(zero_copy_only=False)
+        host, hmask = {}, {}
+        for c in columns:
+            col = tbl.column(c).combine_chunks()
+            if col.null_count and not masked:
+                raise ValueError(
+                    f"column {c} has nulls; pass nulls='mask'")
+            if masked:
+                hmask[c] = col.is_valid().to_numpy(
+                    zero_copy_only=False)
+                col = col.fill_null(0)
+            host[c] = col.to_numpy(zero_copy_only=False)
+        check_and_narrow(host, np)
+        vals = {c: host_to_device(scanner.engine, host[c], dev)
                 for c in columns}
-        for c in require_int:
-            if not np.issubdtype(host[c].dtype, np.integer):
-                raise TypeError(f"key column {c} must be integer")
-        for c in narrow_int32:
-            host[c] = host[c].astype(np.int32)
-        yield {c: host_to_device(scanner.engine, host[c], dev)
-               for c in columns}
+        if masked:
+            yield vals, {c: host_to_device(scanner.engine, hmask[c],
+                                           dev, alias_safe=True)
+                         for c in columns}
+        else:
+            yield vals
 
 
 def finalize_folds(folds: Dict[str, jax.Array],
@@ -289,8 +318,8 @@ def sql_groupby(scanner, key_column: str, value_column,
                                                         "mean"),
                 method: str = "matmul", device=None,
                 where=None, where_columns: Sequence[str] = (),
-                where_ranges: Sequence[tuple] = ()
-                ) -> Dict[str, jax.Array]:
+                where_ranges: Sequence[tuple] = (),
+                nulls: str = "forbid") -> Dict[str, jax.Array]:
     """End-to-end config-5 query:
 
         SELECT key, AGG(value) FROM parquet [WHERE ...] GROUP BY key
@@ -313,10 +342,25 @@ def sql_groupby(scanner, key_column: str, value_column,
     ``value_column`` may be a LIST of columns: one scan aggregates all
     of them (``SELECT k, SUM(v1), SUM(v2) ...``) and each value-agg
     result is (num_groups, n_columns) in the given order.
+
+    ``nulls="skip"``: SQL NULL semantics over nullable columns — rows
+    with a NULL key are dropped, rows with a NULL value are excluded
+    from the aggregates (what ``SUM``/``COUNT``/``AVG`` do in SQL).
+    Implemented as the same on-device spill-group masking the WHERE
+    pushdown uses, so the scan stays one pass.  Restricted to a single
+    value column (per-column NULL patterns would need per-column
+    counts); the default "forbid" raises on any NULL.
     """
     _validate_query(aggs, method)
+    if nulls not in ("forbid", "skip"):
+        raise ValueError(f"bad nulls={nulls!r}")
     where_ranges = list(where_ranges)   # a generator must not exhaust
     vcols, single = _value_cols(value_column)
+    if nulls == "skip" and not single:
+        raise ValueError(
+            "nulls='skip' supports a single value column (per-column "
+            "NULL patterns would need per-column counts); aggregate "
+            "one nullable column at a time")
     dev = device or jax.local_devices()[0]
     range_cols = [c for c, _, _ in where_ranges]
     cols_needed = list(dict.fromkeys(
@@ -331,11 +375,27 @@ def sql_groupby(scanner, key_column: str, value_column,
                         0 if single else len(vcols)), aggs)
 
     def stream():
-        for cols in iter_device_columns(scanner, cols_needed, dev,
-                                        narrow_int32=(key_column,),
-                                        row_groups=rgs):
-            yield (cols[key_column],
-                   _stack_values(cols, vcols, single), cols)
+        if nulls == "skip":
+            for cols, masks in iter_device_columns(
+                    scanner, cols_needed, dev,
+                    narrow_int32=(key_column,), row_groups=rgs,
+                    nulls="mask"):
+                # AND every referenced column's validity — including
+                # WHERE/range columns: SQL's three-valued logic makes a
+                # NULL comparison unknown, which excludes the row (a
+                # zero-filled NULL would otherwise pass predicates)
+                base = masks[key_column]
+                for c in cols_needed:
+                    if c != key_column:
+                        base = base & masks[c]
+                yield (cols[key_column],
+                       _stack_values(cols, vcols, single), cols, base)
+        else:
+            for cols in iter_device_columns(scanner, cols_needed, dev,
+                                            narrow_int32=(key_column,),
+                                            row_groups=rgs):
+                yield (cols[key_column],
+                       _stack_values(cols, vcols, single), cols, None)
 
     return _stream_fold(stream(), num_groups, aggs, method, full_where)
 
@@ -344,13 +404,17 @@ def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
                  method: str, where) -> Dict[str, jax.Array]:
     """Fold per-row-group partial aggregates into the final result.
 
-    ``stream`` yields (keys, values, cols-for-where) per row group —
-    the one fold protocol both groupby entry points share, so aggregate
-    normalization, masking, and the empty-table contract can't drift.
+    ``stream`` yields (keys, values, cols-for-where, base_mask) per row
+    group — the one fold protocol both groupby entry points share, so
+    aggregate normalization, masking, and the empty-table contract
+    can't drift.  ``base_mask`` (or None) carries NULL-validity; it
+    ANDs with the WHERE mask.
     """
     folds = None
-    for keys, values, cols in stream:
+    for keys, values, cols, base in stream:
         mask = where(cols) if where is not None else None
+        if base is not None:
+            mask = base if mask is None else (mask & base)
         part = groupby_aggregate(
             keys, values, num_groups,
             aggs=_norm_aggs(aggs),
@@ -422,7 +486,7 @@ def sql_groupby_str(scanner, key_column: str, value_column,
                                     row_groups=rgs),
                 iter_codes()):
             cols[key_column] = codes
-            yield codes, _stack_values(cols, vcols, single), cols
+            yield codes, _stack_values(cols, vcols, single), cols, None
 
     out: Dict[str, object] = dict(_stream_fold(stream(), num_groups,
                                                aggs, method,

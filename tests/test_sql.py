@@ -424,3 +424,114 @@ def test_multi_value_column_groupby(tmp_path, engine):
                        where_ranges=[("v2", 1000, 2000)])
     assert np.asarray(out0["sum"]).shape == (groups, 2)
     assert int(np.asarray(out0["count"]).sum()) == 0
+
+
+def test_groupby_nulls_skip_matches_pandas_semantics(tmp_path, engine):
+    """nulls='skip': SQL aggregate semantics over nullable columns —
+    NULL values are excluded from COUNT/SUM/MEAN, NULL keys drop the
+    row; identical on the direct path and the pyarrow fallback."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    rng = np.random.default_rng(31)
+    rows, groups = 12000, 16
+    k = rng.integers(0, groups, rows)
+    v = rng.standard_normal(rows).astype(np.float32)
+    knull = rng.random(rows) < 0.05
+    vnull = rng.random(rows) < 0.15
+    karr = k.astype(object); karr[knull] = None
+    varr = v.astype(object); varr[vnull] = None
+    path = str(tmp_path / "nulls.parquet")
+    pq.write_table(pa.table({"k": pa.array(list(karr), pa.int32()),
+                             "v": pa.array(list(varr), pa.float32())}),
+                   path, compression="none", use_dictionary=False,
+                   row_group_size=4000)
+    sc = ParquetScanner(path, engine)
+    # default mode refuses
+    with pytest.raises(ValueError, match="null"):
+        sql_groupby(sc, "k", "v", groups)
+    out = sql_groupby(sc, "k", "v", groups,
+                      aggs=("count", "sum", "mean"), nulls="skip")
+
+    live = ~knull & ~vnull
+    exp_count = np.bincount(k[live], minlength=groups)
+    exp_sum = np.bincount(k[live], weights=v[live].astype(np.float64),
+                          minlength=groups)
+    np.testing.assert_array_equal(np.asarray(out["count"]), exp_count)
+    np.testing.assert_allclose(np.asarray(out["sum"]), exp_sum,
+                               rtol=2e-4)
+    np.testing.assert_allclose(
+        np.asarray(out["mean"]),
+        exp_sum / np.maximum(exp_count, 1), rtol=2e-4)
+    # multi-column + skip is refused with guidance
+    with pytest.raises(ValueError, match="single value column"):
+        sql_groupby(sc, "k", ["v", "v"], groups, nulls="skip")
+    # WHERE composes with the null mask
+    out2 = sql_groupby(sc, "k", "v", groups, aggs=("count",),
+                       nulls="skip", where=lambda c: c["v"] > 0)
+    live2 = live & (v > 0)
+    np.testing.assert_array_equal(
+        np.asarray(out2["count"]),
+        np.bincount(k[live2], minlength=groups))
+
+
+def test_groupby_nulls_skip_where_column_three_valued(tmp_path, engine):
+    """SQL three-valued logic: a NULL in a WHERE-referenced column makes
+    the predicate unknown, which EXCLUDES the row — a zero-filled NULL
+    must not sneak through a comparison like w < 5."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    rng = np.random.default_rng(33)
+    rows, groups = 8000, 4
+    k = rng.integers(0, groups, rows)
+    v = np.ones(rows, np.float32)
+    w = np.full(rows, 10.0, np.float32)       # every real w fails w < 5
+    wnull = rng.random(rows) < 0.25
+    warr = w.astype(object); warr[wnull] = None
+    path = str(tmp_path / "tv.parquet")
+    pq.write_table(pa.table({"k": pa.array(k.astype(np.int32)),
+                             "v": pa.array(v),
+                             "w": pa.array(list(warr), pa.float32())}),
+                   path, compression="none", use_dictionary=False)
+    sc = ParquetScanner(path, engine)
+    out = sql_groupby(sc, "k", "v", groups, aggs=("count",),
+                      nulls="skip", where=lambda c: c["w"] < 5,
+                      where_columns=("w",))
+    # SQL answer: zero rows survive (non-null w all fail; null w unknown)
+    np.testing.assert_array_equal(np.asarray(out["count"]),
+                                  np.zeros(groups, np.int64))
+
+
+def test_groupby_nulls_skip_pyarrow_fallback_branch(tmp_path, engine,
+                                                    monkeypatch):
+    """The masked PYARROW-fallback branch of iter_device_columns (not
+    just the direct path) honours nulls='skip': force the fallback by
+    making plan_columns fail."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from nvme_strom_tpu.sql import pq_direct
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    rng = np.random.default_rng(34)
+    rows, groups = 6000, 8
+    k = rng.integers(0, groups, rows)
+    v = rng.standard_normal(rows).astype(np.float32)
+    vn = rng.random(rows) < 0.2
+    varr = v.astype(object); varr[vn] = None
+    path = str(tmp_path / "fb.parquet")
+    pq.write_table(pa.table({"k": pa.array(k.astype(np.int32)),
+                             "v": pa.array(list(varr), pa.float32())}),
+                   path, compression="none", use_dictionary=False,
+                   row_group_size=2000)
+    sc = ParquetScanner(path, engine)
+
+    def boom(*a, **kw):
+        raise ValueError("forced fallback")
+    monkeypatch.setattr(pq_direct, "plan_columns", boom)
+    out = sql_groupby(sc, "k", "v", groups, aggs=("count", "sum"),
+                      nulls="skip")
+    exp_c = np.bincount(k[~vn], minlength=groups)
+    exp_s = np.bincount(k[~vn], weights=v[~vn].astype(np.float64),
+                        minlength=groups)
+    np.testing.assert_array_equal(np.asarray(out["count"]), exp_c)
+    np.testing.assert_allclose(np.asarray(out["sum"]), exp_s, rtol=2e-4)
