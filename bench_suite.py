@@ -849,14 +849,23 @@ def bench_serving(device=None) -> tuple[float, str]:
 
 
 def _train_variant(cfg, batch: int, seq: int, dev,
-                   profile_dir: str | None = None) -> float:
-    """Median model-FLOP/s of one (config, batch) train-step variant;
-    optionally capture a 3-step jax profiler trace while at it."""
+                   profile_dir: str | None = None,
+                   attn: str = "dense") -> float:
+    """Median model-FLOP/s of one (config, batch, attn) train-step
+    variant; optionally capture a 3-step jax profiler trace while at
+    it.  ``attn``: "dense" (XLA) or "flash" (the Pallas fused kernel —
+    O(s) memory, the long-context/occupancy lever)."""
     import jax
     import jax.numpy as jnp
     import optax
     from nvme_strom_tpu.models.transformer import (init_params,
                                                    make_train_step)
+    attn_fn = None
+    if attn == "flash":
+        from nvme_strom_tpu.ops.flash_attention import make_flash_attn
+        attn_fn = make_flash_attn()
+    elif attn != "dense":
+        raise ValueError(f"attn {attn!r}: expected dense|flash")
     params = jax.device_put(init_params(jax.random.key(0), cfg), dev)
     opt = optax.adamw(1e-3)
     opt_state = jax.device_put(opt.init(params), dev)
@@ -866,7 +875,8 @@ def _train_variant(cfg, batch: int, seq: int, dev,
     n_matmul = _matmul_param_count(params)
     flops_step = (6 * batch * seq * n_matmul
                   + 12 * cfg.n_layers * batch * seq * seq * cfg.d_model)
-    step = jax.jit(make_train_step(cfg, opt), donate_argnums=(0, 1))
+    step = jax.jit(make_train_step(cfg, opt, attn_fn=attn_fn),
+                   donate_argnums=(0, 1))
     params, opt_state, loss = step(params, opt_state, tokens)  # compile
     jax.block_until_ready(loss)
     rates = []
@@ -894,12 +904,15 @@ def bench_train(device=None) -> tuple[float, str]:
     12·L·b·s²·d attention term — model FLOPs, not hardware FLOPs, so
     remat or XLA fusion can't inflate the number.
 
-    STROM_TRAIN_SWEEP="<batch>:<remat>,..." (remat none|dots|full) runs
-    several variants and reports the best, each in the tag — the MFU
-    lever sweep (batch amortizes weight streaming; dots-remat keeps the
-    bigger batch inside HBM at a fraction of full remat's recompute).
-    STROM_PROFILE_DIR captures a 3-step jax profiler trace of the best
-    variant."""
+    STROM_TRAIN_SWEEP="<batch>:<remat>[:<attn>],..." (remat
+    none|dots|full, attn dense|flash) runs several variants and reports
+    the best, each in the tag — the MFU lever sweep (batch amortizes
+    weight streaming; dots-remat keeps the bigger batch inside HBM at a
+    fraction of full remat's recompute; flash trades XLA's fused dense
+    attention for the Pallas kernel's O(s) memory).
+    STROM_PROFILE_DIR captures a 3-step jax profiler trace of the LAST
+    sweep variant (order the sweep so the variant to profile is last —
+    tracing rides that variant's measuring run, no re-compile)."""
     import dataclasses
     import jax
     cfg = _bench_cfg()
@@ -912,18 +925,22 @@ def bench_train(device=None) -> tuple[float, str]:
             spec = spec.strip()
             if not spec:
                 continue
-            b, _, pol = spec.partition(":")
+            parts = spec.split(":")
             try:
-                variants.append((int(b), pol or "none"))
-            except ValueError:
+                variants.append((int(parts[0]),
+                                 parts[1] if len(parts) > 1 and parts[1]
+                                 else "none",
+                                 parts[2] if len(parts) > 2
+                                 and parts[2] else "dense"))
+            except (ValueError, IndexError):
                 # one typo must not lose the whole (scarce) TPU step
                 _log(f"suite: ignoring bad sweep spec {spec!r} "
-                     "(want '<batch>:<none|dots|full>')")
+                     "(want '<batch>:<none|dots|full>[:<dense|flash>]')")
     if not variants:
-        variants = [(batch, cfg.remat_policy or "none")]
+        variants = [(batch, cfg.remat_policy or "none", "dense")]
     prof = os.environ.get("STROM_PROFILE_DIR")
     results = []
-    for i, (b, pol) in enumerate(variants):
+    for i, (b, pol, attn) in enumerate(variants):
         vcfg = dataclasses.replace(cfg, remat_policy=pol, remat=False)
         try:
             # trace rides the measuring call of the final variant — no
@@ -931,22 +948,24 @@ def bench_train(device=None) -> tuple[float, str]:
             fs = _train_variant(vcfg, b, seq, dev,
                                 profile_dir=(prof if prof and
                                              i == len(variants) - 1
-                                             else None))
+                                             else None), attn=attn)
         except Exception as e:  # noqa: BLE001 — OOM on a sweep point
-            _log(f"suite: train variant b={b} remat={pol} failed: "
-                 f"{type(e).__name__}: {str(e)[:160]}")
+            _log(f"suite: train variant b={b} remat={pol} attn={attn} "
+                 f"failed: {type(e).__name__}: {str(e)[:160]}")
             continue
-        results.append((fs, b, pol))
-        _log(f"suite: train b={b} remat={pol}: {fs / 1e12:.3f} TFLOP/s")
+        results.append((fs, b, pol, attn))
+        _log(f"suite: train b={b} remat={pol} attn={attn}: "
+             f"{fs / 1e12:.3f} TFLOP/s")
     if not results:
         raise RuntimeError("every train variant failed")
     best = max(results)
     peak = _peak_flops(dev)
     note = (f"mfu={best[0] / peak:.1%}" if peak
             else "mfu=null (unknown peak)")
-    per = " ".join(f"b{b}/{p}={fs / 1e12:.2f}" for fs, b, p in results)
+    per = " ".join(f"b{b}/{p}/{a}={fs / 1e12:.2f}"
+                   for fs, b, p, a in results)
     return best[0] / 1e12, (f"{note} b={best[1]} s={seq} "
-                            f"remat={best[2]} [{per}]")
+                            f"remat={best[2]} attn={best[3]} [{per}]")
 
 
 # ------------------------------- main ----------------------------------

@@ -126,6 +126,19 @@ def _run_step(name: str, cmd: list[str],
         if isinstance(out, bytes):
             out = out.decode(errors="replace")
         rec["stdout_tail"] = out.strip().splitlines()[-12:]
+        # measurements already printed before the stall must land in
+        # the ledger — the probes stream one JSON line per result for
+        # exactly this failure mode
+        results = []
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    results.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        if results:
+            rec["results"] = results
     rec["elapsed_s"] = round(time.monotonic() - t0, 1)
     return rec
 
@@ -162,7 +175,11 @@ def capture(device: str) -> bool:
         # compiles fresh over the tunnel, so it gets its own step/budget
         ("suite_7_sweep",
          [sys.executable, "bench_suite.py", "--config", "7"], 2400,
-         {"STROM_TRAIN_SWEEP": "16:none,32:dots,64:dots"}),
+         {"STROM_TRAIN_SWEEP":
+          "16:none,32:dots,64:dots,32:dots:flash"}),
+        ("kernel_probe",
+         [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
+         1200, None),
         ("suite_5", [sys.executable, "bench_suite.py", "--config", "5"],
          900, None),
         ("suite_12", [sys.executable, "bench_suite.py", "--config", "12"],

@@ -81,3 +81,19 @@ def test_ledger_paths_are_repo_root():
     assert os.path.dirname(tw.LEDGER) == tw.REPO
     assert os.path.basename(tw.LEDGER) == "BENCH_tpu_ledger.jsonl"
     assert os.path.isfile(os.path.join(tw.REPO, "bench.py"))
+
+
+def test_run_step_timeout_preserves_streamed_results(tmp_path):
+    """Measurements a probe streamed before stalling must land in the
+    ledger record — a timed-out step loses the stall, not the round's
+    already-printed evidence."""
+    script = tmp_path / "stream_then_hang.py"
+    script.write_text(
+        'import time, sys\n'
+        'print(\'{"metric": "a", "value": 1}\', flush=True)\n'
+        'print(\'{"metric": "b", "value": 2}\', flush=True)\n'
+        'print("ready", file=sys.stderr, flush=True)\n'
+        "time.sleep(60)\n")
+    rec = tw._run_step("s", [sys.executable, str(script)], timeout_s=5)
+    assert rec["error"].startswith("timeout")
+    assert [r["metric"] for r in rec["results"]] == ["a", "b"]
