@@ -69,6 +69,17 @@ def main(argv=None) -> int:
                          "only adapters + their optimizer state)")
     ap.add_argument("--lora-alpha", type=float, default=None,
                     help="LoRA scale numerator (default: RANK)")
+    ap.add_argument("--remat", default="none",
+                    choices=("none", "dots", "full"),
+                    help="rematerialization policy: 'dots' saves matmul "
+                         "outputs and recomputes elementwise ops (most "
+                         "of full remat's memory win at a fraction of "
+                         "its recompute); 'full' recomputes whole "
+                         "layers")
+    ap.add_argument("--flash", action="store_true",
+                    help="use the Pallas fused flash-attention kernel "
+                         "(O(seq) memory) instead of XLA dense "
+                         "attention")
     args = ap.parse_args(argv)
 
     import jax
@@ -134,6 +145,13 @@ def main(argv=None) -> int:
         args.init_weights = conv_dir  # dir form: every shard inside
     else:
         cfg = tiny_config() if args.tiny else flagship_config()
+    if args.remat != "none":
+        import dataclasses
+        cfg = dataclasses.replace(cfg, remat_policy=args.remat)
+    attn_fn = None
+    if args.flash:
+        from nvme_strom_tpu.ops.flash_attention import make_flash_attn
+        attn_fn = make_flash_attn()
     mesh = make_mesh({"dp": -1, "tp": args.tp})
     print(f"mesh: {dict(mesh.shape)} devices={len(jax.devices())} "
           f"model: d={cfg.d_model} L={cfg.n_layers} vocab={cfg.vocab}")
@@ -202,6 +220,7 @@ def main(argv=None) -> int:
         trainable = params
         opt_state = replicate_scalars(optimizer.init(params), mesh)
         step_fn = jax.jit(make_train_step(cfg, optimizer,
+                                          attn_fn=attn_fn,
                                           accum_steps=args.accum_steps),
                           in_shardings=(p_sh, None, b_sh),
                           out_shardings=(p_sh, None, None),
