@@ -197,6 +197,23 @@ def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
     return logits, cache
 
 
+def nucleus_truncate(logits, top_p):
+    """Zero out (to -inf) everything outside the smallest prefix of the
+    sorted distribution whose cumulative probability reaches ``top_p``
+    (the first token is always kept).  ``top_p`` may be a python float
+    or a per-row array (broadcast against logits' leading dims) — the
+    ONE nucleus rule both the static sampler here and the serving
+    per-slot sampler use."""
+    top_p = jnp.asarray(top_p, jnp.float32)
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_p[..., None]
+    cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, -jnp.inf, logits)
+
+
 def _sample(logits, temperature: float, rng,
             top_k: int = 0, top_p: float = 1.0):
     """Greedy (temperature 0) or categorical sampling with optional
@@ -210,15 +227,7 @@ def _sample(logits, temperature: float, rng,
         kth = lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p < 1.0:
-        # nucleus: keep the smallest prefix of the sorted distribution
-        # whose cumulative probability reaches top_p
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        keep = cum - probs < top_p          # first token always kept
-        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
-                         axis=-1, keepdims=True)
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        logits = nucleus_truncate(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 

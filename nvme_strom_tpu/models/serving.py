@@ -16,9 +16,13 @@ form).  Admission prefills a single request through the standard dense
 prefill and scatters its KV rows into the slot — one compiled step
 program serves every mix of request states.
 
-Greedy decoding; per-request ``max_new`` and ``eos_id``.  Outputs are
-token-identical to running each request alone through
-``decode.generate`` (the equivalence test in tests/test_serving.py).
+Per-request decoding params: ``max_new``, ``eos_id``, and sampling —
+``temperature``/``top_p``/``seed`` are per-SLOT vectors (data, like the
+positions), so one compiled step serves any greedy/sampled mix.
+Greedy requests (the default) are token-identical to running each
+alone through ``decode.generate`` (the equivalence test in
+tests/test_serving.py); sampled requests are reproducible per
+(seed, position).
 """
 
 from __future__ import annotations
@@ -42,7 +46,41 @@ class _Request:
     prompt: List[int]
     max_new: int
     eos_id: Optional[int]
+    temperature: float = 0.0      # 0 = greedy
+    top_p: float = 1.0
+    seed: int = 0
     out: List[int] = field(default_factory=list)
+
+
+def _sample_slots(logits, temps, top_ps, seeds, pos):
+    """Per-slot temperature/top-p sampling, all quantities DATA so one
+    compiled program serves any mix of greedy and sampled requests
+    (the per-slot-position trick applied to decoding params).
+
+    logits (B, V) f32; temps/top_ps (B,) f32; seeds (B,) uint32 (per
+    request, from submit); pos (B,) int32 — the step index folds into
+    the key so each step draws fresh randomness, reproducibly per
+    (seed, position).  Rows with temperature <= 0 take argmax exactly
+    (bit-identical to the greedy server)."""
+    greedy = jnp.argmax(logits, -1).astype(jnp.int32)
+
+    def sample(_):
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+        masked = _dec.nucleus_truncate(scaled, top_ps)
+
+        def one(seed, p, row):
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(seed.astype(jnp.uint32)), p)
+            return jax.random.categorical(key, row)
+
+        sampled = jax.vmap(one)(seeds, pos, masked).astype(jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy)
+
+    # all-greedy batches (the default) skip the whole sort/softmax/
+    # PRNG pipeline — one compiled program either way, lax.cond picks
+    # the branch from the live slot params
+    return jax.lax.cond(jnp.any(temps > 0), sample, lambda _: greedy,
+                        None)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -85,14 +123,14 @@ def _batched_step_body(params: Dict, cfg: TransformerConfig, tok, pos,
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
         x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
     x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
-    return jnp.argmax(logits, -1).astype(jnp.int32)
+    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 6),
+@functools.partial(jax.jit, static_argnums=(1, 9),
                    donate_argnums=(3, 4))
 def _serve_step(params: Dict, cfg: TransformerConfig, tok,
-                k_cache, v_cache, pos, cache_attn=None):
+                k_cache, v_cache, pos, temps, top_ps, seeds,
+                cache_attn=None):
     """One decode step for every slot at its OWN position.
 
     tok (B,) int32, pos (B,) int32 → (next_tok (B,), k_cache,
@@ -118,13 +156,16 @@ def _serve_step(params: Dict, cfg: TransformerConfig, tok,
         return _dec.cache_attention(q, caches["k"][i], caches["v"][i],
                                     limit, cfg)
 
-    nxt = _batched_step_body(params, cfg, tok, pos, write_and_attend)
+    logits = _batched_step_body(params, cfg, tok, pos,
+                                write_and_attend)
+    nxt = _sample_slots(logits, temps, top_ps, seeds, pos)
     return nxt, caches["k"], caches["v"]
 
 
 @functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(3, 4))
 def _paged_step(params: Dict, cfg: TransformerConfig, tok,
-                k_pool, v_pool, blk, off, table, pos):
+                k_pool, v_pool, blk, off, table, pos, temps, top_ps,
+                seeds):
     """One decode step against the shared block pool.
 
     blk/off (B,) int32: each slot's write target (block id in the pool,
@@ -142,17 +183,20 @@ def _paged_step(params: Dict, cfg: TransformerConfig, tok,
         return paged_attention(q, pools["k"][i], pools["v"][i], table,
                                pos)
 
-    nxt = _batched_step_body(params, cfg, tok, pos, write_and_attend)
+    logits = _batched_step_body(params, cfg, tok, pos,
+                                write_and_attend)
+    nxt = _sample_slots(logits, temps, top_ps, seeds, pos)
     return nxt, pools["k"], pools["v"]
 
 
 class DecodeServer:
-    """Fixed-slot continuous-batching decode server (greedy).
+    """Fixed-slot continuous-batching decode server.
 
-    ``submit`` enqueues; ``step`` admits waiting requests into free
-    slots, advances every active slot one token, and returns requests
-    that finished this step ({request_id: token list}).  ``run``
-    drains everything.
+    ``submit`` enqueues (optionally with per-request ``temperature``/
+    ``top_p``/``seed`` — greedy by default); ``step`` admits waiting
+    requests into free slots, advances every active slot one token,
+    and returns requests that finished this step ({request_id: token
+    list}).  ``run`` drains everything.
     """
 
     def __init__(self, params: Dict, cfg: TransformerConfig,
@@ -166,6 +210,11 @@ class DecodeServer:
         self.cache_attn = cache_attn
         self.pos = jnp.zeros((max_batch,), jnp.int32)
         self.tok = jnp.zeros((max_batch,), jnp.int32)
+        # per-slot decoding params (DATA, not shapes: any greedy/
+        # sampled mix runs the same compiled step)
+        self.temp = jnp.zeros((max_batch,), jnp.float32)
+        self.topp = jnp.ones((max_batch,), jnp.float32)
+        self.seed = jnp.zeros((max_batch,), jnp.uint32)
         self.slots: List[Optional[_Request]] = [None] * max_batch
         self.queue: List[_Request] = []
         self._alloc_storage()
@@ -180,11 +229,18 @@ class DecodeServer:
     # -- intake -----------------------------------------------------------
 
     def submit(self, rid, prompt_ids: List[int], max_new: int,
-               eos_id: Optional[int] = None) -> None:
+               eos_id: Optional[int] = None,
+               temperature: float = 0.0, top_p: float = 1.0,
+               seed: int = 0) -> None:
         if not prompt_ids:
             raise ValueError("empty prompt")
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{temperature}")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if len(prompt_ids) + max_new > self.max_len:
             raise ValueError(
                 f"prompt {len(prompt_ids)} + max_new {max_new} exceeds "
@@ -195,7 +251,9 @@ class DecodeServer:
             # results key on rid — a duplicate would silently clobber
             raise ValueError(f"request id {rid!r} already in flight")
         self.queue.append(_Request(rid, list(prompt_ids), max_new,
-                                   eos_id))
+                                   eos_id, temperature=temperature,
+                                   top_p=top_p,
+                                   seed=seed & 0xFFFFFFFF))
 
     def _admit(self, slot: int, req: _Request) -> None:
         """Prefill the request alone, scatter its KV into the slot.
@@ -218,13 +276,29 @@ class DecodeServer:
         self.k_cache, self.v_cache = _scatter_prefill(
             jnp.asarray(slot, jnp.int32), self.k_cache, self.v_cache,
             cache["k"], cache["v"])
-        first = int(jnp.argmax(logits, -1)[0])
+        first = self._first_token(logits, req, s)
         req.out.append(first)
         self.slots[slot] = req
+        self._set_slot_params(slot, req)
         # pos[slot] = s - nothing decoded past the prompt yet; tok is
         # the token entering the cache on the next step
         self.pos = self.pos.at[slot].set(s)
         self.tok = self.tok.at[slot].set(first)
+
+    def _first_token(self, logits, req: _Request, s: int) -> int:
+        """The prefill's next token under the request's own sampling
+        params (same sampler, 1-row view; position s-1 folds in so the
+        first draw differs from the next step's)."""
+        return int(_sample_slots(
+            logits, jnp.asarray([req.temperature], jnp.float32),
+            jnp.asarray([req.top_p], jnp.float32),
+            jnp.asarray([req.seed], jnp.uint32),
+            jnp.asarray([s - 1], jnp.int32))[0])
+
+    def _set_slot_params(self, slot: int, req: _Request) -> None:
+        self.temp = self.temp.at[slot].set(req.temperature)
+        self.topp = self.topp.at[slot].set(req.top_p)
+        self.seed = self.seed.at[slot].set(jnp.uint32(req.seed))
 
     def _retire_or_keep(self, slot: int) -> Optional[tuple]:
         req = self.slots[slot]
@@ -260,7 +334,8 @@ class DecodeServer:
         """Storage-specific batched step → next-token device array."""
         nxt, self.k_cache, self.v_cache = _serve_step(
             self.params, self.cfg, self.tok, self.k_cache,
-            self.v_cache, self.pos, self.cache_attn)
+            self.v_cache, self.pos, self.temp, self.topp, self.seed,
+            self.cache_attn)
         return nxt
 
     def _advanced(self, active_slots: List[int]) -> None:
@@ -393,9 +468,10 @@ class PagedDecodeServer(DecodeServer):
             jnp.asarray(blks[:n_pb], jnp.int32),
             rows_k.transpose(0, 2, 1, 3, 4),
             rows_v.transpose(0, 2, 1, 3, 4))
-        first = int(jnp.argmax(logits, -1)[0])
+        first = self._first_token(logits, req, s)
         req.out.append(first)
         self.slots[slot] = req
+        self._set_slot_params(slot, req)
         self.pos = self.pos.at[slot].set(s)
         self._pos_h[slot] = s
         self.tok = self.tok.at[slot].set(first)
@@ -430,7 +506,8 @@ class PagedDecodeServer(DecodeServer):
         off = self.pos % self.block_len
         nxt, self.k_pool, self.v_pool = _paged_step(
             self.params, self.cfg, self.tok, self.k_pool, self.v_pool,
-            blk, off, self._table(), self.pos)
+            blk, off, self._table(), self.pos, self.temp, self.topp,
+            self.seed)
         return nxt
 
     def _advanced(self, active_slots: List[int]) -> None:
