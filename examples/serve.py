@@ -38,6 +38,13 @@ def main(argv=None) -> int:
                     help="per-slot sequence capacity (default: model "
                          "max_seq)")
     ap.add_argument("--eos-id", type=int, default=None)
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature for every request "
+                         "(0 = greedy)")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="nucleus truncation (with --temperature > 0)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base sampling seed; request i uses seed+i")
     ap.add_argument("--pallas", action="store_true",
                     help="use the fused decode-attention kernel "
                          "(wins past ~1k live positions)")
@@ -127,8 +134,10 @@ def main(argv=None) -> int:
             cache_attn = make_decode_attn()
         srv = DecodeServer(params, cfg, max_batch=args.slots,
                            max_len=max_len, cache_attn=cache_attn)
-    for rid, ids, max_new in reqs:
-        srv.submit(rid, ids, max_new, eos_id=args.eos_id)
+    for i, (rid, ids, max_new) in enumerate(reqs):
+        srv.submit(rid, ids, max_new, eos_id=args.eos_id,
+                   temperature=args.temperature, top_p=args.top_p,
+                   seed=args.seed + i)
 
     t0 = time.monotonic()
     results = srv.run()
