@@ -696,6 +696,19 @@ def bench_decode(device=None) -> tuple[float, str]:
 
     short = run_gen(prompt_len, new, None)
     tag = f"batch={batch} new={new}"
+    # int8 weight-only leg: decode is weight-streaming bound, so the
+    # halved weight bytes should show directly (models/quant.py); the
+    # fp params are swapped out so both legs fit side by side
+    from nvme_strom_tpu.models.quant import quantize_weights_int8
+    qparams = jax.device_put(quantize_weights_int8(
+        jax.device_get(params)), dev)
+    fp_params, params = params, qparams
+    int8_rate = run_gen(prompt_len, new, None)
+    params = fp_params
+    if short > 0 and int8_rate > 0:
+        tag += f", int8={int8_rate:.0f}tok/s ({int8_rate / short:.2f}x)"
+    else:   # the 0.0 timing-invalid sentinel must not fabricate a ratio
+        tag += f", int8={int8_rate:.0f}tok/s (ratio n/a)"
     # Long-context leg: TPU only — off-TPU the Pallas kernel runs in the
     # interpreter, where a d=2048 S~1856 scan would take hours.
     if not _tiny_compute() and jax.default_backend() == "tpu":

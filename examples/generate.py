@@ -50,6 +50,9 @@ def main(argv=None) -> int:
                     help="0 = greedy")
     ap.add_argument("--eos-id", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 quantization after load "
+                         "(halved weight streaming; models/quant.py)")
     ap.add_argument("--offload", default=None, metavar="PAGEFILE",
                     help="decode with the SSD-backed KV cache spilling "
                          "pages to this path (greedy only; HBM holds a "
@@ -114,6 +117,13 @@ def main(argv=None) -> int:
         engine=engine)
     print(f"weights: {len(params)} tensors in "
           f"{time.monotonic() - t0:.2f}s", flush=True)
+    if args.int8:
+        from nvme_strom_tpu.models.quant import (quantize_weights_int8,
+                                                 quantized_nbytes)
+        params = quantize_weights_int8(params)
+        q, fp = quantized_nbytes(params)
+        print(f"int8: matmul weights {q >> 20} MiB "
+              f"(vs {fp >> 20} MiB fp32)", flush=True)
 
     prompt = jnp.asarray([prompt_ids], jnp.int32)
     rng = jax.random.key(args.seed)

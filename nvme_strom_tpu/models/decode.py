@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from nvme_strom_tpu.models.transformer import (
+    wmat,
     TransformerConfig, attention, expand_gqa, mlp, qkv_project, rms_norm)
 from nvme_strom_tpu.models import moe as _moe
 
@@ -95,7 +96,7 @@ def prefill(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
     cache["pos"] = jnp.asarray(s, jnp.int32)
     x = rms_norm(x[:, s - 1 if last is None else last],
                  params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = (x @ wmat(params, "lm_head", x.dtype)).astype(jnp.float32)
     return logits, cache
 
 
@@ -132,12 +133,12 @@ def decode_step(params: Dict, token: jax.Array, cfg: TransformerConfig,
         # group maps to its kv head inside (no expanded HBM copy)
         a = cache_attn(q, cache["k"][i], cache["v"][i], pos)
         a = a.transpose(0, 2, 1, 3).reshape(b, 1, -1)
-        x = x + a @ params[L + "wo"].astype(a.dtype)
+        x = x + a @ wmat(params, L + "wo", a.dtype)
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
         x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
     cache["pos"] = pos + 1
     x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = (x @ wmat(params, "lm_head", x.dtype)).astype(jnp.float32)
     return logits, cache
 
 
@@ -188,12 +189,12 @@ def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
             cache["v"], v[None].astype(cfg.dtype), (i, 0, 0, pos, 0))
         a = cache_attention(q, cache["k"][i], cache["v"][i], limit, cfg)
         a = a.transpose(0, 2, 1, 3).reshape(b, m, -1)
-        x = x + a @ params[L + "wo"].astype(a.dtype)
+        x = x + a @ wmat(params, L + "wo", a.dtype)
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
         x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
     cache["pos"] = pos + m
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = (x @ wmat(params, "lm_head", x.dtype)).astype(jnp.float32)
     return logits, cache
 
 

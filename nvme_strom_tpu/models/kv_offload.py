@@ -45,7 +45,7 @@ from jax import lax
 from nvme_strom_tpu.io.engine import StromEngine
 from nvme_strom_tpu.models.decode import mlp_block as _mlp_block
 from nvme_strom_tpu.models.transformer import (
-    TransformerConfig, qkv_project, rms_norm)
+    TransformerConfig, qkv_project, rms_norm, wmat)
 from nvme_strom_tpu.ops.bridge import DeviceStream
 
 
@@ -630,14 +630,14 @@ def _layer_forward(params: Dict, i: int, x, cfg: TransformerConfig,
     q, k, v = qkv_project(h, params, Lk, cfg, positions=positions)
     a = attend(i, q, k, v)
     a = a.transpose(0, 2, 1, 3).reshape(b, s, -1)
-    x = x + a @ params[Lk + "wo"].astype(a.dtype)
+    x = x + a @ wmat(params, Lk + "wo", a.dtype)
     h = rms_norm(x, params[Lk + "mlp_norm"], cfg.norm_eps)
     return (x + _mlp_block(h, params, Lk, cfg)).astype(cfg.dtype)
 
 
 def _final_logits(params: Dict, x_last, cfg: TransformerConfig):
     x_last = rms_norm(x_last, params["final_norm"], cfg.norm_eps)
-    return (x_last @ params["lm_head"].astype(x_last.dtype)
+    return (x_last @ wmat(params, "lm_head", x_last.dtype)
             ).astype(jnp.float32)
 
 

@@ -32,6 +32,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from nvme_strom_tpu.models import transformer as _tr
+
 
 def moe_dispatch_combine(router_probs: jax.Array, top_k: int, capacity: int):
     """Build dense dispatch/combine tensors from router probabilities.
@@ -128,11 +130,11 @@ def moe_mlp(x: jax.Array, p: dict, prefix: str, cfg) -> tuple:
     xd = jnp.einsum("gsec,gsd->egcd", dispatch.astype(x.dtype), xg)
     xd = xd.reshape(E, G * C, d)
     gate = jax.nn.silu(jnp.einsum(
-        "ecd,edf->ecf", xd, p[prefix + "moe_w_gate"].astype(x.dtype)))
+        "ecd,edf->ecf", xd, _tr.wmat(p, prefix + "moe_w_gate", x.dtype)))
     up = jnp.einsum("ecd,edf->ecf", xd,
-                    p[prefix + "moe_w_up"].astype(x.dtype))
+                    _tr.wmat(p, prefix + "moe_w_up", x.dtype))
     h = jnp.einsum("ecf,efd->ecd", gate * up,
-                   p[prefix + "moe_w_down"].astype(x.dtype))
+                   _tr.wmat(p, prefix + "moe_w_down", x.dtype))
     h = h.reshape(E, G, C, d)
     out = jnp.einsum("gsec,egcd->gsd", combine.astype(x.dtype), h)
     return out.reshape(b, s, d), aux

@@ -1,0 +1,81 @@
+"""Weight-only int8 quantization for inference.
+
+Single-sequence decode is WEIGHT-STREAMING bound: every generated token
+reads every matmul weight from HBM once, so halving the weight bytes is
+a direct tokens/sec lever on TPU (and doubles the model size that fits
+a chip).  The scheme is per-output-channel absmax:
+
+    q8    = round(w / scale) ∈ int8,  scale = absmax(w, axis=-2) / 127
+
+stored as ``{"q8": int8, "scale": f32 (d_out,)}`` leaves that
+``transformer.wmat`` dequantizes transparently — the dequant multiply
+fuses into the consuming matmul, so the HBM traffic is the int8 bytes.
+Every inference surface (generate, serving, paged, speculative,
+kv_offload) flows through ``wmat`` and serves quantized params with the
+same compiled-program shapes.
+
+Scope: matmul weights only.  ``tok_embed`` stays fp (it is GATHERED,
+not matmul'd — dequantizing the whole table per step would defeat the
+point), norms are 1-D and tiny, and the router stays fp (its logits
+decide top-k membership; quantization noise there changes routing, not
+just values).  Training on quantized params is unsupported — the
+optimizer would update q8/scale as independent tensors.  Quantize a
+trained/loaded checkpoint, then serve.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+#: weight names (the component after the last ".") quantized by
+#: default — every matmul weight except the embedding table and the
+#: MoE router (see module docstring).  Matching is on the EXACT
+#: trailing component, so suffixes=("w_gate",) selects only the dense
+#: gate, never the MoE expert gates.
+DEFAULT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                    "moe_w_gate", "moe_w_up", "moe_w_down", "lm_head")
+
+
+def _quantize_one(w):
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
+                    keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12)        # all-zero channels
+    q8 = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                  -127, 127).astype(jnp.int8)
+    # scale keeps its broadcast shape (..., 1, d_out) so wmat's dequant
+    # multiply works for 2-D dense and 3-D per-expert weights alike
+    return {"q8": q8, "scale": scale.astype(jnp.float32)}
+
+
+def quantize_weights_int8(params: Dict,
+                          suffixes: Optional[Sequence[str]] = None
+                          ) -> Dict:
+    """{name: array} params → same dict with selected weights replaced
+    by int8 leaves.  ``suffixes``: weight-name endings to quantize
+    (default :data:`DEFAULT_SUFFIXES`).  Already-quantized leaves pass
+    through; 1-D leaves are never touched."""
+    suffixes = tuple(suffixes if suffixes is not None
+                     else DEFAULT_SUFFIXES)
+    out = {}
+    for name, w in params.items():
+        leafname = name.rsplit(".", 1)[-1]
+        if (isinstance(w, dict) or leafname not in suffixes
+                or getattr(w, "ndim", 0) < 2):
+            out[name] = w
+            continue
+        out[name] = jax.jit(_quantize_one)(w)
+    return out
+
+
+def quantized_nbytes(params: Dict) -> tuple:
+    """(bytes of quantized leaves, bytes those leaves would cost in the
+    reference dtype of their scale) — the memory claim, measurable."""
+    q = fp = 0
+    for w in params.values():
+        if isinstance(w, dict):
+            q += int(w["q8"].nbytes + w["scale"].nbytes)
+            fp += int(w["q8"].size * 4)
+    return q, fp

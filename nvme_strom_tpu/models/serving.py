@@ -37,7 +37,7 @@ import jax.numpy as jnp
 from nvme_strom_tpu.models import decode as _dec
 from nvme_strom_tpu.models.decode import _mlp_block
 from nvme_strom_tpu.models.transformer import (
-    TransformerConfig, qkv_project, rms_norm)
+    TransformerConfig, qkv_project, rms_norm, wmat)
 
 
 @dataclass
@@ -119,11 +119,11 @@ def _batched_step_body(params: Dict, cfg: TransformerConfig, tok, pos,
         q, k, v = qkv_project(h, params, L, cfg, positions=positions)
         a = write_and_attend(i, q, k, v)
         a = a.transpose(0, 2, 1, 3).reshape(B, 1, -1)
-        x = x + a @ params[L + "wo"].astype(a.dtype)
+        x = x + a @ wmat(params, L + "wo", a.dtype)
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
         x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
     x = rms_norm(x[:, 0], params["final_norm"], cfg.norm_eps)
-    return (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    return (x @ wmat(params, "lm_head", x.dtype)).astype(jnp.float32)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 9),

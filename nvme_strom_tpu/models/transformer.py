@@ -198,6 +198,23 @@ def _rope(q, k, theta, positions=None, scaling=None):
     return rot(q), rot(k)
 
 
+def wmat(p: Dict, name: str, dtype):
+    """Matmul weight by name, transparently dequantizing int8
+    weight-only leaves.
+
+    A quantized leaf is ``{"q8": int8 (..., d_out), "scale": f32
+    (..., 1, d_out)}`` (models/quant.py) — the dequant multiply is elementwise
+    on the weight and XLA fuses it into the consuming matmul, so the
+    HBM read is the int8 bytes: half of bf16, the lever for
+    weight-streaming-bound decode.  Plain array leaves pass through, so
+    every model path serves quantized and full-precision params with
+    the same code."""
+    w = p[name]
+    if isinstance(w, dict):
+        return w["q8"].astype(dtype) * w["scale"].astype(dtype)
+    return w.astype(dtype)
+
+
 def dense_causal_attention(q, k, v):
     """softmax(QKᵀ/√d)V with a causal mask; q/k/v (b, h, s, d), same head
     count (GQA already expanded).  The single-chip default ``attn_fn``."""
@@ -217,9 +234,9 @@ def qkv_project(x, p, prefix, cfg: TransformerConfig, positions=None):
     shape the decode KV cache stores (models/decode.py)."""
     b, s, _ = x.shape
     hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
-    q = (x @ p[prefix + "wq"].astype(x.dtype)).reshape(b, s, nh, hd)
-    k = (x @ p[prefix + "wk"].astype(x.dtype)).reshape(b, s, nkv, hd)
-    v = (x @ p[prefix + "wv"].astype(x.dtype)).reshape(b, s, nkv, hd)
+    q = (x @ wmat(p, prefix + "wq", x.dtype)).reshape(b, s, nh, hd)
+    k = (x @ wmat(p, prefix + "wk", x.dtype)).reshape(b, s, nkv, hd)
+    v = (x @ wmat(p, prefix + "wv", x.dtype)).reshape(b, s, nkv, hd)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # b h s d
     q, k = _rope(q, k, cfg.rope_theta, positions=positions,
                  scaling=cfg.rope_scaling_dict)
@@ -245,14 +262,14 @@ def attention(x, p, prefix, cfg: TransformerConfig, attn_fn=None,
     out = (attn_fn or dense_causal_attention)(
         q, expand_gqa(k, cfg), expand_gqa(v, cfg))
     out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_heads * cfg.head_dim)
-    out = out @ p[prefix + "wo"].astype(x.dtype)
+    out = out @ wmat(p, prefix + "wo", x.dtype)
     return (out, k, v) if return_kv else out
 
 
 def mlp(x, p, prefix):
-    gate = jax.nn.silu(x @ p[prefix + "w_gate"].astype(x.dtype))
-    up = x @ p[prefix + "w_up"].astype(x.dtype)
-    return (gate * up) @ p[prefix + "w_down"].astype(x.dtype)
+    gate = jax.nn.silu(x @ wmat(p, prefix + "w_gate", x.dtype))
+    up = x @ wmat(p, prefix + "w_up", x.dtype)
+    return (gate * up) @ wmat(p, prefix + "w_down", x.dtype)
 
 
 def forward_with_aux(params: Dict, tokens: jax.Array,
@@ -290,7 +307,7 @@ def forward_with_aux(params: Dict, tokens: jax.Array,
         x, a = one_layer(x, i)
         aux = aux + a
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
-    logits = (x @ params["lm_head"].astype(x.dtype)).astype(jnp.float32)
+    logits = (x @ wmat(params, "lm_head", x.dtype)).astype(jnp.float32)
     return logits, aux
 
 

@@ -1,0 +1,85 @@
+"""Weight-only int8 quantization (models/quant.py): close logits,
+identical program shapes, every inference surface serves it."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.models import decode as dec
+from nvme_strom_tpu.models.quant import (DEFAULT_SUFFIXES,
+                                         quantize_weights_int8,
+                                         quantized_nbytes)
+from nvme_strom_tpu.models.transformer import (
+    TransformerConfig, forward, init_params, tiny_config,
+    tiny_moe_config)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_quantized_logits_close_and_memory_smaller(setup):
+    cfg, params = setup
+    qp = quantize_weights_int8(params)
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    lf = forward(params, toks, cfg)
+    lq = forward(qp, toks, cfg)
+    rel = float(jnp.max(jnp.abs(lf - lq))
+                / (jnp.max(jnp.abs(lf)) + 1e-9))
+    assert rel < 0.05, rel
+    q, fp = quantized_nbytes(qp)
+    assert q * 3 < fp          # ~3.8x smaller than fp32
+    # norms/embeddings untouched; matmul weights all converted
+    assert not isinstance(qp["tok_embed"], dict)
+    assert not isinstance(qp["final_norm"], dict)
+    assert isinstance(qp["lm_head"], dict)
+    assert qp["lm_head"]["q8"].dtype == jnp.int8
+
+
+def test_quantized_moe_forward():
+    cfg = TransformerConfig(**{**tiny_moe_config().__dict__,
+                               "dtype": jnp.float32})
+    params = init_params(jax.random.key(3), cfg)
+    qp = quantize_weights_int8(params)
+    # 3-D per-expert weights quantize with broadcastable scales; the
+    # ROUTER stays fp (quantization noise there changes routing)
+    assert isinstance(qp["layers.1.moe_w_up"], dict)
+    assert not isinstance(qp["layers.1.router"], dict)
+    toks = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    lf = forward(params, toks, cfg)
+    lq = forward(qp, toks, cfg)
+    rel = float(jnp.max(jnp.abs(lf - lq))
+                / (jnp.max(jnp.abs(lf)) + 1e-9))
+    assert rel < 0.08, rel
+
+
+def test_quantized_decode_and_serving(setup):
+    """generate() and the continuous-batching server both run on
+    quantized params; greedy decode is self-consistent between them."""
+    from nvme_strom_tpu.models.serving import DecodeServer
+    cfg, params = setup
+    qp = quantize_weights_int8(params)
+    prompt = [5, 6, 7]
+    gen = np.asarray(dec.generate(
+        qp, jnp.asarray([prompt], jnp.int32), cfg, 8))[0].tolist()
+    srv = DecodeServer(qp, cfg, max_batch=2, max_len=64)
+    srv.submit("r", prompt, max_new=8)
+    assert srv.run()["r"] == gen
+
+
+def test_suffix_selection(setup):
+    cfg, params = setup
+    qp = quantize_weights_int8(params, suffixes=("lm_head",))
+    assert isinstance(qp["lm_head"], dict)
+    assert not isinstance(qp["layers.0.wq"], dict)
+    # idempotent: re-quantizing passes dict leaves through
+    qp2 = quantize_weights_int8(qp)
+    assert qp2["lm_head"] is qp["lm_head"]
+    assert set(DEFAULT_SUFFIXES) >= {"wq", "lm_head", "moe_w_down"}
