@@ -163,7 +163,7 @@ def cache_attention(q, ck, cv, limit, cfg: TransformerConfig):
 
 
 def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
-               cache: Dict) -> tuple[jax.Array, Dict]:
+               cache: Dict, last=None) -> tuple[jax.Array, Dict]:
     """Multi-token incremental step: tokens (b, m) int32 enter the cache
     at positions pos..pos+m-1 and every position gets logits.
 
@@ -172,6 +172,11 @@ def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
     speculative decoding, and the general "ingest a block mid-stream"
     primitive.  Returns (logits (b, m, vocab) f32, cache with
     pos += m).  Contract: pos + m <= max_len.
+
+    ``last``: project lm_head at only this row → logits (b, vocab) —
+    admission-style callers that need one next-token distribution skip
+    m-1 useless vocab projections (a 128k-vocab lm_head over thousands
+    of pad rows is real FLOPs).
     """
     b, m = tokens.shape
     pos = cache["pos"]
@@ -193,6 +198,8 @@ def block_step(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
         h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
         x = (x + _mlp_block(h, params, L, cfg)).astype(cfg.dtype)
     cache["pos"] = pos + m
+    if last is not None:
+        x = x[:, last]
     x = rms_norm(x, params["final_norm"], cfg.norm_eps)
     logits = (x @ wmat(params, "lm_head", x.dtype)).astype(jnp.float32)
     return logits, cache

@@ -50,6 +50,7 @@ class _Request:
     top_p: float = 1.0
     seed: int = 0
     out: List[int] = field(default_factory=list)
+    chain_keys: object = None     # paged prefix-cache memo
 
 
 def _sample_slots(logits, temps, top_ps, seeds, pos):
@@ -91,6 +92,24 @@ def _scatter_blocks(k_pool, v_pool, blks, k_rows, v_rows):
     k_pool = k_pool.at[:, blks].set(k_rows.astype(k_pool.dtype))
     v_pool = v_pool.at[:, blks].set(v_rows.astype(v_pool.dtype))
     return k_pool, v_pool
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _gather_prefix(k_pool, v_pool, blks, total_len: int):
+    """Cached prefix blocks → the head of a dense (L, 1, nkv, S, hd)
+    cache pair, zero-padded to ``total_len`` positions (the suffix
+    block_step writes the rest).  One gather per admission — prefix
+    caching trades this HBM read for the prefix's quadratic prefill
+    compute."""
+    def to_dense(pool):
+        rows = pool[:, blks]                   # (L, c, nkv, bk, hd)
+        L, c, nkv, bk, hd = rows.shape
+        dense = rows.transpose(0, 2, 1, 3, 4).reshape(L, nkv, c * bk,
+                                                      hd)
+        pad = total_len - c * bk
+        dense = jnp.pad(dense, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        return dense[:, None]                  # (L, 1, nkv, S, hd)
+    return to_dense(k_pool), to_dense(v_pool)
 
 
 @functools.partial(jax.jit, donate_argnums=(1, 2))
@@ -402,15 +421,23 @@ class PagedDecodeServer(DecodeServer):
     Attention runs the scalar-prefetch Pallas kernel
     (ops/paged_attention.py) — the block indirection never materializes
     a gathered cache copy in HBM.
+
+    Automatic PREFIX CACHING (``prefix_cache=True``): full prompt
+    blocks register under chain hashes; a request whose prompt shares
+    the chain reuses those blocks read-only and prefills only its
+    suffix — the shared-system-prompt win.  refs==0 entries stay
+    resident as LRU-evictable and are reclaimed under pool pressure
+    before admission refuses.
     """
 
     def __init__(self, params: Dict, cfg: TransformerConfig,
                  max_batch: int, max_len: int, total_blocks: int,
-                 block_len: int = 128):
+                 block_len: int = 128, prefix_cache: bool = True):
         if block_len < 1 or total_blocks < 1:
             raise ValueError("block_len and total_blocks must be >= 1")
         self.block_len = block_len
         self.total_blocks = total_blocks
+        self.prefix_cache = prefix_cache
         super().__init__(params, cfg, max_batch, max_len)
         self.max_blocks = -(-max_len // block_len)
 
@@ -428,6 +455,18 @@ class PagedDecodeServer(DecodeServer):
         self.blocks: List[List[int]] = [[] for _ in range(self.B)]
         self._pos_h: List[int] = [0] * self.B   # host mirror of pos
         self._table_dev = None                  # cache until blocks move
+        # prefix cache (vLLM-style automatic prefix sharing): every FULL
+        # prompt block is registered under its CHAIN hash (the KV of a
+        # block depends on the entire prefix, so key_i = H(key_{i-1},
+        # tokens_i)); a later request whose prompt starts with the same
+        # chain reuses those pool blocks read-only and prefills only its
+        # suffix.  refs==0 entries stay resident as LRU-evictable — the
+        # pool reclaims them under pressure before refusing admission.
+        self._pc: Dict[bytes, dict] = {}        # key -> {blk, refs}
+        self._pc_by_blk: Dict[int, bytes] = {}
+        self._pc_lru: Dict[bytes, None] = {}    # insertion-ordered LRU
+        self._pc_hits = 0
+        self._pc_shared_blocks = 0
 
     def _table(self):
         """(B, max_blocks) device table, cached until block membership
@@ -441,33 +480,134 @@ class PagedDecodeServer(DecodeServer):
             self._table_dev = jnp.asarray(t)
         return self._table_dev
 
+    # -- prefix cache ------------------------------------------------------
+
+    def _chain_keys(self, prompt: List[int]) -> List[bytes]:
+        """Chain hash per FULL prompt block, capped at (s-1)//bk so at
+        least one suffix token always prefills live (the first-token
+        logits must come from a real forward, and decode's first write
+        must never target a shared block)."""
+        import hashlib
+        import numpy as np
+        bk = self.block_len
+        n = (len(prompt) - 1) // bk
+        keys, h = [], b""
+        for i in range(n):
+            chunk = np.asarray(prompt[i * bk:(i + 1) * bk],
+                               np.int32).tobytes()
+            h = hashlib.sha1(h + chunk).digest()
+            keys.append(h)
+        return keys
+
+    def _req_keys(self, req: _Request) -> List[bytes]:
+        """The request's chain keys, hashed ONCE — _can_admit runs per
+        step while a request queues, and per-wait rehashing of a long
+        prompt is O(prompt) host work on the decode path."""
+        if not self.prefix_cache:
+            return []
+        if req.chain_keys is None:
+            req.chain_keys = self._chain_keys(req.prompt)
+        return req.chain_keys
+
+    def _pc_match(self, keys: List[bytes]) -> List[bytes]:
+        """Longest cached chain prefix (keys of matched entries)."""
+        out = []
+        for kx in keys:
+            if kx not in self._pc:
+                break
+            out.append(kx)
+        return out
+
+    def _pc_acquire(self, key: bytes) -> int:
+        e = self._pc[key]
+        e["refs"] += 1
+        self._pc_lru.pop(key, None)     # referenced: not evictable
+        return e["blk"]
+
+    def _pc_register(self, key: bytes, blk: int) -> None:
+        if key in self._pc:             # a concurrent admit won the race
+            return
+        self._pc[key] = {"blk": blk, "refs": 1}
+        self._pc_by_blk[blk] = key
+
+    def _pc_release(self, blk: int) -> bool:
+        """Retiring request drops its ref; True if the block stays
+        cached (evictable) rather than returning to the free list."""
+        key = self._pc_by_blk.get(blk)
+        if key is None:
+            return False
+        e = self._pc[key]
+        e["refs"] -= 1
+        if e["refs"] == 0:
+            self._pc_lru[key] = None    # oldest-first eviction order
+        return True
+
+    def _pc_evict_one(self) -> int:
+        key = next(iter(self._pc_lru))
+        del self._pc_lru[key]
+        blk = self._pc.pop(key)["blk"]
+        del self._pc_by_blk[blk]
+        return blk
+
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Pop n free blocks, evicting LRU refs==0 cache entries when
+        the free list runs short.  (Blocks matched by the in-flight
+        admission were acquired first — refs > 0 keeps them out of the
+        LRU, so eviction can never take them.)"""
+        out = []
+        for _ in range(n):
+            if not self.free:
+                self.free.append(self._pc_evict_one())
+            out.append(self.free.pop())
+        return out
+
     def _admit(self, slot: int, req: _Request) -> None:
         s = len(req.prompt)
-        need = -(-(s + req.max_new) // self.block_len)
-        assert len(self.free) >= need      # step() checked
-        blks = [self.free.pop() for _ in range(need)]
+        bk = self.block_len
+        need = -(-(s + req.max_new) // bk)
+        keys = self._req_keys(req)
+        matched = self._pc_match(keys)
+        c = len(matched)
+        shared = [self._pc_acquire(kx) for kx in matched]
+        new_blks = self._alloc_blocks(need - c)
+        blks = shared + new_blks
         self.blocks[slot] = blks
         self._table_dev = None
-        # dense single-request prefill, then ONE donated jitted scatter
-        # of all prompt blocks (prompt padded up to whole blocks; pad
-        # rows sit past pos and are overwritten before the mask
-        # reaches them)
-        bk = self.block_len
+        if c:
+            self._pc_hits += 1
+            self._pc_shared_blocks += c
+
+        # prefill: gathered cached prefix + one block_step over the
+        # suffix (from an empty cache when nothing matched — block_step
+        # at pos 0 IS the dense prefill); pad rows sit past pos and are
+        # overwritten before the mask reaches them
         n_pb = -(-s // bk)
         cache = _dec.init_cache(self.cfg, 1, n_pb * bk)
-        padded = req.prompt + [0] * (n_pb * bk - s)
-        logits, cache = _dec.prefill(self.params,
-                                     jnp.asarray([padded], jnp.int32),
-                                     self.cfg, cache, last=s - 1)
+        if c:
+            k_d, v_d = _gather_prefix(self.k_pool, self.v_pool,
+                                      jnp.asarray(shared, jnp.int32),
+                                      n_pb * bk)
+            cache["k"], cache["v"] = k_d, v_d
+            cache["pos"] = jnp.asarray(c * bk, jnp.int32)
+        suffix = req.prompt[c * bk:]
+        padded = suffix + [0] * ((n_pb - c) * bk - len(suffix))
+        logits, cache = _dec.block_step(
+            self.params, jnp.asarray([padded], jnp.int32), self.cfg,
+            cache, last=len(suffix) - 1)
         L, nkv, hd = (self.cfg.n_layers, self.cfg.n_kv_heads,
                       self.cfg.head_dim)
-        rows_k = cache["k"][:, 0].reshape(L, nkv, n_pb, bk, hd)
-        rows_v = cache["v"][:, 0].reshape(L, nkv, n_pb, bk, hd)
+        rows_k = (cache["k"][:, 0, :, c * bk:n_pb * bk]
+                  .reshape(L, nkv, n_pb - c, bk, hd))
+        rows_v = (cache["v"][:, 0, :, c * bk:n_pb * bk]
+                  .reshape(L, nkv, n_pb - c, bk, hd))
         self.k_pool, self.v_pool = _scatter_blocks(
             self.k_pool, self.v_pool,
-            jnp.asarray(blks[:n_pb], jnp.int32),
+            jnp.asarray(blks[c:n_pb], jnp.int32),
             rows_k.transpose(0, 2, 1, 3, 4),
             rows_v.transpose(0, 2, 1, 3, 4))
+        # newly computed FULL blocks join the cache for future requests
+        for i in range(c, len(keys)):
+            self._pc_register(keys[i], blks[i])
         first = self._first_token(logits, req, s)
         req.out.append(first)
         self.slots[slot] = req
@@ -478,20 +618,37 @@ class PagedDecodeServer(DecodeServer):
 
     def _can_admit(self, req: _Request) -> bool:
         # submit() bounds prompt+max_new by max_len, so need can never
-        # exceed max_blocks — only pool availability gates admission
+        # exceed max_blocks — only pool availability gates admission.
+        # Capacity counts cached-prefix reuse (matched blocks need no
+        # allocation) and LRU-evictable refs==0 cache entries (the pool
+        # reclaims them before refusing).
         need = -(-(len(req.prompt) + req.max_new) // self.block_len)
-        return len(self.free) >= need
+        if not self.prefix_cache:
+            return len(self.free) >= need
+        matched = set(self._pc_match(self._req_keys(req)))
+        evictable = sum(1 for k in self._pc_lru if k not in matched)
+        return (len(self.free) + evictable
+                >= need - len(matched))
 
     def stats(self) -> Dict[str, int]:
         out = super().stats()
         out["blocks_total"] = self.total_blocks
         out["blocks_free"] = len(self.free)
+        out["prefix_cached_blocks"] = len(self._pc)
+        out["prefix_evictable"] = len(self._pc_lru)
+        out["prefix_hits"] = self._pc_hits
+        out["prefix_shared_blocks"] = self._pc_shared_blocks
         return out
 
     def _retire_or_keep(self, slot: int):
         ret = super()._retire_or_keep(slot)
-        if ret is not None:                 # blocks back to the pool
-            self.free.extend(self.blocks[slot])
+        if ret is not None:
+            # cache-registered blocks drop a ref (staying resident as
+            # evictable when it hits 0 — the next same-prefix request
+            # reuses them); private blocks go straight back to the pool
+            for blk in self.blocks[slot]:
+                if not self._pc_release(blk):
+                    self.free.append(blk)
             self.blocks[slot] = []
             self._table_dev = None
         return ret

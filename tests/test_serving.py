@@ -121,7 +121,11 @@ def test_paged_server_matches_solo(setup):
     got = srv.run()
     for rid, (p, m) in reqs.items():
         assert got[rid] == _solo(params, cfg, p, m), rid
-    assert sorted(srv.free) == list(range(13))   # all blocks returned
+    # every block is either free or resident in the (fully evictable)
+    # prefix cache — none leaked, none still referenced
+    cached = [e["blk"] for e in srv._pc.values()]
+    assert sorted(srv.free + cached) == list(range(13))
+    assert srv.stats()["prefix_evictable"] == len(cached)
 
 
 def test_paged_server_queues_on_pool_exhaustion(setup):
@@ -212,7 +216,9 @@ def test_server_stats_gauges(setup):
     s0 = srv.stats()
     assert s0 == {"slots_total": 2, "slots_busy": 0, "queued": 2,
                   "inflight_tokens": 0, "blocks_total": 6,
-                  "blocks_free": 6}
+                  "blocks_free": 6, "prefix_cached_blocks": 0,
+                  "prefix_evictable": 0, "prefix_hits": 0,
+                  "prefix_shared_blocks": 0}
     srv.step()
     s1 = srv.stats()
     assert s1["slots_busy"] == 2 and s1["queued"] == 0
@@ -281,3 +287,87 @@ def test_submit_sampling_validation(setup):
         srv.submit("b", [1], 2, top_p=0.0)
     with pytest.raises(ValueError, match="top_p"):
         srv.submit("c", [1], 2, top_p=1.5)
+
+
+# -- automatic prefix caching (PagedDecodeServer) ---------------------------
+
+
+def test_prefix_cache_reuses_blocks_and_stays_exact(setup):
+    """Two sequential requests sharing a long prompt prefix: the second
+    admission reuses the cached blocks (stats prove it) and both
+    outputs stay token-identical to solo generate."""
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    cfg, params = setup
+    rng = np.random.default_rng(21)
+    sys_prompt = rng.integers(0, cfg.vocab, 12).tolist()  # 3 full blocks
+    a = sys_prompt + [7, 8]
+    b = sys_prompt + [9]
+    srv = PagedDecodeServer(params, cfg, max_batch=1, max_len=64,
+                            total_blocks=16, block_len=4)
+    srv.submit("a", a, 6)
+    out_a = srv.run()["a"]
+    st = srv.stats()
+    assert st["prefix_hits"] == 0          # nothing cached yet
+    assert st["prefix_cached_blocks"] == 3  # a's full blocks registered
+    srv.submit("b", b, 6)
+    out_b = srv.run()["b"]
+    st = srv.stats()
+    assert st["prefix_hits"] == 1
+    assert st["prefix_shared_blocks"] == 3  # whole shared prefix reused
+    assert out_a == _solo(params, cfg, a, 6)
+    assert out_b == _solo(params, cfg, b, 6)
+
+
+def test_prefix_cache_block_aligned_prompt(setup):
+    """A prompt that is an exact multiple of block_len: the last full
+    block is deliberately NOT shared (suffix >= 1 token must prefill
+    live; decode's first write must never hit a shared block)."""
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    cfg, params = setup
+    rng = np.random.default_rng(22)
+    prompt = rng.integers(0, cfg.vocab, 12).tolist()   # exactly 3 blocks
+    srv = PagedDecodeServer(params, cfg, max_batch=1, max_len=64,
+                            total_blocks=12, block_len=4)
+    srv.submit("a", prompt, 5)
+    out_a = srv.run()["a"]
+    assert srv.stats()["prefix_cached_blocks"] == 2    # (s-1)//bk cap
+    srv.submit("b", prompt, 5)
+    out_b = srv.run()["b"]
+    assert srv.stats()["prefix_shared_blocks"] == 2
+    assert out_a == out_b == _solo(params, cfg, prompt, 5)
+
+
+def test_prefix_cache_eviction_under_pressure(setup):
+    """Pool pressure reclaims refs==0 cached blocks (LRU) before
+    refusing admission; distinct prompts still serve correctly."""
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    cfg, params = setup
+    rng = np.random.default_rng(23)
+    srv = PagedDecodeServer(params, cfg, max_batch=1, max_len=64,
+                            total_blocks=6, block_len=4)
+    outs, refs = {}, {}
+    for i in range(3):        # each needs ceil((9+6)/4)=4 of 6 blocks
+        p = rng.integers(0, cfg.vocab, 9).tolist()
+        srv.submit(f"r{i}", p, 6)
+        outs[f"r{i}"] = srv.run()[f"r{i}"]
+        refs[f"r{i}"] = _solo(params, cfg, p, 6)
+    assert outs == refs
+    st = srv.stats()
+    assert st["prefix_cached_blocks"] <= 6   # eviction kept it bounded
+    assert st["blocks_free"] + st["prefix_cached_blocks"] == 6
+
+
+def test_prefix_cache_off_switch(setup):
+    """prefix_cache=False restores the round-2 behavior: no registry,
+    every block returns to the free list at retirement."""
+    from nvme_strom_tpu.models.serving import PagedDecodeServer
+    cfg, params = setup
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+    srv = PagedDecodeServer(params, cfg, max_batch=1, max_len=64,
+                            total_blocks=8, block_len=4,
+                            prefix_cache=False)
+    srv.submit("a", prompt, 5)
+    out = srv.run()["a"]
+    assert out == _solo(params, cfg, prompt, 5)
+    assert srv.stats()["prefix_cached_blocks"] == 0
+    assert sorted(srv.free) == list(range(8))
