@@ -49,7 +49,10 @@ the framework's perf claims cover compute, not just I/O.
 Env: STROM_SUITE_BYTES (per-config payload, default 256 MiB),
 STROM_BENCH_DIR (scratch dir, default repo root),
 STROM_KVOFF_QUANT=int8 / STROM_KVOFF_HOSTCACHE=N (config-10 variants),
-STROM_SERVE_PAGED=1 (config 11 through the block-pool paged server).
+STROM_SERVE_PAGED=1 (config 11 through the block-pool paged server),
+STROM_SERVE_SHARED_PREFIX=N (config-11 variant: every request shares an
+N-token system prompt — the paged server's prefix caching prefills it
+once; gauges in the tag).
 """
 
 from __future__ import annotations
@@ -820,7 +823,16 @@ def bench_serving(device=None) -> tuple[float, str]:
     # pool sized for the live-token high-water mark: the `slots`
     # largest concurrent worst cases (the paged design point — far
     # below slots × max_len)
-    worst = sorted(((l + n) for l, n in zip(lens, news)),
+    shared_prefix = os.environ.get("STROM_SERVE_SHARED_PREFIX")
+    shared = []
+    if shared_prefix:
+        # config-11 variant: every request shares a system prompt of N
+        # tokens — the paged server's automatic prefix caching prefills
+        # it once and reuses the blocks (tag reports the cache gauges)
+        import numpy as np
+        shared = np.random.default_rng(2).integers(
+            0, cfg.vocab, int(shared_prefix)).tolist()
+    worst = sorted((len(shared) + l + n for l, n in zip(lens, news)),
                    reverse=True)[:slots]
     total_blocks = sum(-(-w // block_len) for w in worst)
 
@@ -837,7 +849,8 @@ def bench_serving(device=None) -> tuple[float, str]:
         import numpy as np
         rng = np.random.default_rng(1)
         for i in range(n_req):
-            srv.submit(i, rng.integers(0, cfg.vocab, lens[i]).tolist(),
+            srv.submit(i, shared
+                       + rng.integers(0, cfg.vocab, lens[i]).tolist(),
                        news[i])
 
     # warmup run compiles the step + admission buckets (discarded)
@@ -858,6 +871,11 @@ def bench_serving(device=None) -> tuple[float, str]:
         tag += (f" paged={total_blocks}x{block_len} "
                 f"({total_blocks * block_len * 100 // (slots * max_len)}"
                 f"% of dense)")
+        if shared:
+            st = srv.stats()
+            tag += (f", shared_prefix={len(shared)}tok "
+                    f"hits={st['prefix_hits']} "
+                    f"reused_blocks={st['prefix_shared_blocks']}")
     return rate, tag
 
 
