@@ -73,6 +73,40 @@ def param_shardings(cfg: TransformerConfig, mesh) -> Dict[str, NamedSharding]:
             for k, spec in param_specs(cfg).items()}
 
 
+def shard_params(params: Dict, cfg: TransformerConfig, mesh) -> Dict:
+    """device_put every param leaf under its name's sharding — incl.
+    int8-quantized leaves (models/quant.py): ``q8`` takes the weight's
+    own spec and the broadcast-shaped ``scale`` takes the spec's
+    OUTPUT-axis slice (its (..., 1, d_out) shape shards along d_out the
+    same way the weight does), so tp-sharded quantized inference just
+    works."""
+    import jax
+
+    sh = param_shardings(cfg, mesh)
+    out = {}
+    for name, w in params.items():
+        if name not in sh:
+            # fail fast like the manual {k: device_put(v, p_sh[k])}
+            # pattern — an unplaced leaf would otherwise surface later
+            # as jit's 'incompatible devices', far from the typo
+            raise KeyError(f"no sharding spec for param {name!r}")
+        s = sh[name]
+        if isinstance(w, dict):
+            spec = tuple(s.spec)
+            # pad the spec to the q8 rank, then scale's rank matches
+            spec = spec + (None,) * (w["q8"].ndim - len(spec))
+            q_sh = NamedSharding(mesh, P(*spec))
+            out[name] = {
+                "q8": jax.device_put(w["q8"], q_sh),
+                "scale": jax.device_put(
+                    w["scale"],
+                    NamedSharding(mesh, P(*spec[:-2], None, spec[-1]))),
+            }
+        else:
+            out[name] = jax.device_put(w, s)
+    return out
+
+
 def batch_spec(seq_sharded: bool = False) -> P:
     """(batch, seq) tokens: batch over dp; seq over sp when ring attention
     is in play (parallel/ring_attention.py)."""

@@ -83,3 +83,26 @@ def test_suffix_selection(setup):
     qp2 = quantize_weights_int8(qp)
     assert qp2["lm_head"] is qp["lm_head"]
     assert set(DEFAULT_SUFFIXES) >= {"wq", "lm_head", "moe_w_down"}
+
+
+def test_quantized_params_shard_over_tp(mesh8):
+    """shard_params places int8 leaves under the weight's spec (q8) and
+    its output-axis slice (scale): tp-sharded quantized forward equals
+    the single-device quantized forward."""
+    import numpy as np
+    from nvme_strom_tpu.parallel.shardings import shard_params
+
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    params = init_params(jax.random.key(0), cfg)
+    qp = quantize_weights_int8(params)
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    want = np.asarray(forward(qp, toks, cfg))
+
+    sharded = shard_params(qp, cfg, mesh8)
+    assert sharded["layers.0.wq"]["q8"].sharding.spec[-1] == "tp"
+    assert sharded["layers.0.wq"]["scale"].sharding.spec[-1] == "tp"
+    got = np.asarray(jax.jit(
+        lambda p, t: forward(p, t, cfg))(sharded, toks))
+    np.testing.assert_allclose(got, want, atol=2e-5)
