@@ -125,3 +125,21 @@ def test_eval_ppl_cli(tmp_path):
     ppl = float(r.stdout.split("perplexity:")[1].split()[0])
     # untrained model ≈ uniform over vocab
     assert 0.5 * cfg.vocab < ppl < 4 * cfg.vocab
+
+
+def test_sql_query_example_runs():
+    """The Direct-SQL demo CLI end to end (synthesized table, range
+    predicate, string GROUP BY + top-k)."""
+    import subprocess
+    import sys
+    from pathlib import Path
+    repo = Path(__file__).resolve().parents[1]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "sql_query.py"),
+         "--rows", "50000", "--where", "w", "100", "5000"],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=str(repo))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "GROUP BY k" in r.stdout
+    assert "top-3 by count" in r.stdout
