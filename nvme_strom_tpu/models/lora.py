@@ -46,15 +46,21 @@ def lora_init(rng: jax.Array, base_params: Dict, rank: int,
     model starts EXACTLY equal to the base."""
     if rank < 1:
         raise ValueError(f"rank must be >= 1, got {rank}")
+
+    def shape_of(w):
+        # int8-quantized leaves (models/quant.py) adapt like any other
+        # matmul: the adapter sees only the weight's shape
+        return w["q8"].shape if isinstance(w, dict) else w.shape
+
     out: Dict[str, Tuple[jax.Array, jax.Array]] = {}
     names = [n for n in sorted(base_params)
              if n.rsplit(".", 1)[-1] in targets
-             and base_params[n].ndim == 2]
+             and len(shape_of(base_params[n])) == 2]
     if not names:
         raise ValueError(f"no base matmuls match targets {targets}")
     keys = jax.random.split(rng, len(names))
     for key, n in zip(keys, names):
-        d_in, d_out = base_params[n].shape
+        d_in, d_out = shape_of(base_params[n])
         a = (jax.random.normal(key, (d_in, rank), dtype)
              / jnp.sqrt(jnp.asarray(rank, dtype)))
         b = jnp.zeros((rank, d_out), dtype)
@@ -67,13 +73,26 @@ def merge_lora(base_params: Dict, adapters: Dict,
                alpha: float = 1.0) -> Dict:
     """Base + scaled adapter deltas → full params (same pytree shape
     and dtypes as the base, so forward/decode/checkpointing all work
-    unchanged).  scale = alpha / rank."""
+    unchanged).  scale = alpha / rank.
+
+    QLoRA-style int8 bases: a quantized target leaf dequantizes, takes
+    the delta, and the merged leaf continues in bfloat16 — the base
+    STAYS int8 at rest (storage, checkpoints, optimizer are
+    adapter-sized; only the transient merged copy is fp).  Adapters are
+    fp either way, so the t=0 adapted model equals the dequantized
+    base exactly."""
+    from nvme_strom_tpu.models.transformer import wmat
     out = dict(base_params)
     for n, (a, b) in adapters.items():
         rank = a.shape[1]
         delta = (a @ b) * (alpha / rank)
-        out[n] = (base_params[n].astype(jnp.float32)
-                  + delta.astype(jnp.float32)).astype(base_params[n].dtype)
+        w = base_params[n]
+        if isinstance(w, dict):
+            out[n] = (wmat(base_params, n, jnp.float32)
+                      + delta.astype(jnp.float32)).astype(jnp.bfloat16)
+        else:
+            out[n] = (w.astype(jnp.float32)
+                      + delta.astype(jnp.float32)).astype(w.dtype)
     return out
 
 

@@ -114,3 +114,34 @@ def test_lora_gradient_accumulation_matches(setup):
         for x, y in zip(a1[k], a2[k]):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),
                                        atol=1e-6, rtol=1e-5)
+
+
+def test_lora_over_int8_base_trains():
+    """QLoRA-style: adapters over an int8-quantized base — t=0 output
+    equals the dequantized base exactly, a few steps reduce the loss,
+    and the base stays int8 throughout (optimizer is adapter-sized)."""
+    import optax
+    from nvme_strom_tpu.models.quant import quantize_weights_int8
+    from nvme_strom_tpu.models.transformer import forward
+
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    base = quantize_weights_int8(init_params(jax.random.key(0), cfg))
+    adapters = lora.lora_init(jax.random.key(1), base, rank=4)
+    assert "layers.0.wq" in adapters          # quantized leaves adapt
+    toks = jax.random.randint(jax.random.key(2), (2, 16), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    # t=0: merged == base (B is zero) — bf16 merge of the dequant
+    l0 = forward(lora.merge_lora(base, adapters), toks, cfg)
+    lb = forward(base, toks, cfg)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(lb),
+                               atol=0.05, rtol=0.05)
+    opt = optax.adam(3e-3)
+    ostate = opt.init(adapters)
+    step = jax.jit(lora.make_lora_train_step(cfg, opt))
+    losses = []
+    for _ in range(6):
+        adapters, ostate, loss = step(adapters, ostate, base, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert isinstance(base["layers.0.wq"], dict)   # base untouched
