@@ -219,13 +219,24 @@ class DecodeServer:
     """
 
     def __init__(self, params: Dict, cfg: TransformerConfig,
-                 max_batch: int, max_len: int, cache_attn=None):
+                 max_batch: int, max_len: int, cache_attn="auto"):
         self.params = params
         self.cfg = cfg
         self.B = max_batch
         self.max_len = max_len
-        # e.g. ops.decode_attention.make_decode_attn() — the fused
-        # kernel pays off once live caches clear ~1k positions
+        # cache_attn: None = XLA dense; a callable (e.g.
+        # ops.decode_attention.make_decode_attn()) = that kernel;
+        # "auto" (default) = the fused Pallas kernel on TPU when
+        # max_len clears the measured ~1k-position crossover
+        # (config-6: XLA wins at S≈160, the kernel is ~1.7x at
+        # S≈1856), dense everywhere else — CPU/virtual-mesh behavior
+        # is unchanged.
+        if cache_attn == "auto":
+            cache_attn = None
+            if max_len >= 1024 and jax.default_backend() == "tpu":
+                from nvme_strom_tpu.ops.decode_attention import (
+                    make_decode_attn)
+                cache_attn = make_decode_attn()
         self.cache_attn = cache_attn
         self.pos = jnp.zeros((max_batch,), jnp.int32)
         self.tok = jnp.zeros((max_batch,), jnp.int32)
@@ -438,7 +449,10 @@ class PagedDecodeServer(DecodeServer):
         self.block_len = block_len
         self.total_blocks = total_blocks
         self.prefix_cache = prefix_cache
-        super().__init__(params, cfg, max_batch, max_len)
+        # cache_attn is the DENSE servers' knob; the paged step always
+        # runs the paged-attention kernel
+        super().__init__(params, cfg, max_batch, max_len,
+                         cache_attn=None)
         self.max_blocks = -(-max_len // block_len)
 
     def _alloc_storage(self) -> None:
