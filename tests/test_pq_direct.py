@@ -708,3 +708,49 @@ def test_compressed_bounce_is_bounded(tmp_path, engine):
     # CPU test device: engine-read compressed bytes + decompressed body
     # + host_to_device protective copy — bound it at 3x payload
     assert 0 < dbounce <= 3 * payload + (1 << 16)
+
+
+def test_direct_fuzz_random_layouts(tmp_path, engine):
+    """Randomized layout fuzz: tiny data pages (multi-page chunks),
+    random row-group sizes, codecs, page versions, dict-vs-plain,
+    nullability — every combination must either bit-match pyarrow via
+    the direct path or be rejected up front (never silently wrong)."""
+    rng = np.random.default_rng(99)
+    for trial in range(12):
+        rows = int(rng.integers(500, 6000))
+        comp = ["none", "snappy", "zstd"][trial % 3]
+        ver = ["1.0", "2.0"][trial % 2]
+        use_dict = bool(trial % 4 < 2)
+        cardinality = int(rng.choice([3, 50, 1 << 20]))  # incl. overflow
+        has_null = trial % 5 == 0
+        base = rng.integers(0, cardinality, rows).astype(np.int32)
+        if has_null:
+            nm = rng.random(rows) < 0.1
+            arr = base.astype(object)
+            arr[nm] = None
+            col = pa.array(list(arr), pa.int32())
+        else:
+            nm = np.zeros(rows, bool)
+            col = pa.array(base)
+        path = str(tmp_path / f"fuzz{trial}.parquet")
+        pq.write_table(
+            pa.table({"v": col}), path,
+            compression=comp, use_dictionary=use_dict,
+            data_page_version=ver,
+            data_page_size=int(rng.integers(512, 8192)),  # tiny pages
+            row_group_size=int(rng.integers(300, rows + 1)))
+        sc = ParquetScanner(path, engine)
+        ref = pq.read_table(path).column("v")
+        if has_null:
+            v, m = sc.read_columns_to_device(["v"], direct="always",
+                                             nulls="mask")["v"]
+            v, m = np.asarray(v), np.asarray(m)
+            np.testing.assert_array_equal(m, ~nm, err_msg=str(trial))
+            np.testing.assert_array_equal(v[m], base[~nm],
+                                          err_msg=str(trial))
+        else:
+            out = sc.read_columns_to_device(["v"], direct="always")
+            np.testing.assert_array_equal(
+                np.asarray(out["v"]), ref.to_numpy(),
+                err_msg=f"trial {trial} comp={comp} ver={ver} "
+                        f"dict={use_dict} card={cardinality}")
