@@ -133,6 +133,41 @@ _WORKER = textwrap.dedent("""
             for i in range(data.shape[0]):
                 np.testing.assert_array_equal(
                     data[i], toks[b * 4 + r0 + i, c0:c0 + data.shape[1]])
+    # -- wds_raw across processes: the batch-coalesced zero-copy tar
+    # path assembles global batches with make_array_from_single_device_
+    # arrays; each process reads only its own shard.
+    rng3 = np.random.default_rng(23)               # SAME seed both procs
+    raw = rng3.integers(0, 255, size=(8, 256)).astype(np.uint8)
+    raw_paths = []
+    for s in range(2):
+        p = os.path.join(d, f"raw-{s}.tar")
+        if pid == 0:
+            with tarfile.open(p, "w") as tf:
+                for i in range(4):
+                    payload = raw[s * 4 + i].tobytes()
+                    ti = tarfile.TarInfo(f"{s}{i:04d}.bin")
+                    ti.size = len(payload)
+                    tf.addfile(ti, _io.BytesIO(payload))
+        raw_paths.append(p)
+    while not all(os.path.exists(p) and os.path.getsize(p)
+                  for p in raw_paths):
+        time.sleep(0.05)
+    time.sleep(0.3)
+    with ShardedLoader(raw_paths, mesh, global_batch=4,
+                       fmt="wds_raw") as ld:
+        bs = list(ld)
+    assert len(bs) == 2, len(bs)
+    for b, batch in enumerate(bs):
+        assert batch.shape == (4, 256), batch.shape
+        for sh in batch.addressable_shards:
+            start = sh.index[0].start or 0
+            data = np.asarray(sh.data)
+            for i in range(data.shape[0]):
+                g = start + i
+                owner = g // 2                     # round-robin shards
+                np.testing.assert_array_equal(
+                    data[i], raw[4 * owner + b * 2 + (g % 2)])
+
     # -- collective-free multi-host save_async (round-2 verdict #7):
     # both processes checkpoint a dp-sharded array in the background
     # (no jax collectives on the IO thread), host 0 finalizes via the
