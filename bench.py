@@ -214,13 +214,55 @@ def _stream_pass(ds, path: str, size: int) -> float:
     return size / (1 << 30) / dt
 
 
+def best_probe_config() -> dict | None:
+    """Highest-ratio (depth/chunk/drain) point the ledgered
+    stream-efficiency probe has measured on silicon — the feedback loop
+    from tools/stream_probe.py to the headline stream.  None when no
+    probe data exists yet."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_tpu_ledger.jsonl")
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("step") != "stream_probe":
+                    continue
+                for r in rec.get("results", []):
+                    if (r.get("probe") in ("depth", "chunk")
+                            and r.get("ratio") is not None):
+                        if best is None or r["ratio"] > best["ratio"]:
+                            best = r
+    except OSError:
+        return None
+    return best
+
+
 def _make_stream(engine, dev):
     from nvme_strom_tpu.ops import DeviceStream
     # Full queue depth: on a high-latency link (the axon tunnel) the
     # pipeline needs enough chunks in flight to cover the bandwidth-delay
     # product — depth=8 measured 0.10–1.0 GiB/s (latency-exposed, noisy),
     # depth=16 a stable 1.17 GiB/s at 4MiB chunks on the same medium.
-    return DeviceStream(engine, device=dev, depth=engine.config.queue_depth)
+    # When the on-silicon probe has measured a better operating point,
+    # adopt it (STROM_BENCH_AUTO_TUNE=0 opts out; the chunk size must
+    # match the engine's buffers, so only depth/drain adapt here —
+    # chunk adapts in main() before the engine is built).
+    depth = engine.config.queue_depth
+    drain = "blocking"
+    if os.environ.get("STROM_BENCH_AUTO_TUNE", "1") != "0":
+        best = best_probe_config()
+        if best:
+            depth = min(int(best.get("depth", depth)),
+                        engine.n_buffers // 2)
+            drain = best.get("drain", "ready")
+            _log(f"bench: probe-tuned stream: depth={depth} "
+                 f"drain={drain} (ledgered ratio {best['ratio']})")
+    return DeviceStream(engine, device=dev, depth=max(2, depth),
+                        drain=drain)
 
 
 def bench_to_device(engine, path: str, repeats: int = 3,
@@ -316,6 +358,16 @@ def main() -> int:
         force_cpu()
 
     cfg = EngineConfig()
+    # chunk size must be baked into the engine's buffer pool: adopt the
+    # probe-tuned chunk here (an explicit STROM_CHUNK_BYTES wins)
+    if (os.environ.get("STROM_BENCH_AUTO_TUNE", "1") != "0"
+            and "STROM_CHUNK_BYTES" not in os.environ):
+        best = best_probe_config()
+        if best and best.get("chunk_mib"):
+            ck = int(best["chunk_mib"]) << 20
+            if ck != cfg.chunk_bytes:
+                _log(f"bench: probe-tuned chunk={best['chunk_mib']}MiB")
+                cfg = EngineConfig(chunk_bytes=ck)
     stats = StromStats()
     with StromEngine(cfg, stats=stats) as engine:
         _log(f"bench: backend={engine.backend} chunk={cfg.chunk_bytes >> 20}MiB "
