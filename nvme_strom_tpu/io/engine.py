@@ -452,11 +452,11 @@ class StromEngine:
         if fh < 0:
             raise OSError(-fh, os.strerror(-fh), str(path))
         self._open_fhs.add(fh)
-        if self.config.stripe_accounting and not writable:
-            self._setup_stripe(fh, path)
+        if self.config.stripe_accounting:
+            self._setup_stripe(fh, path, writable=writable)
         return fh
 
-    def _setup_stripe(self, fh: int, path) -> None:
+    def _setup_stripe(self, fh: int, path, writable: bool = False) -> None:
         """Per-member attribution geometry for this file (SURVEY.md §6:
         the reference's striped claim implies knowing which member
         served which byte).  Real geometry comes from the backing
@@ -478,10 +478,15 @@ class StromEngine:
                     f"STROM_STRIPE_SIM={sim!r}: expected "
                     "'<chunk_kib>:<n_members>' with positive integers")
             # simulated geometry attributes by LOGICAL offset (one
-            # whole-file pseudo extent with physical == logical):
-            # deterministic regardless of where the fs placed the file
-            extents = [Extent(0, 0, self.file_size(fh), 0)]
+            # unbounded pseudo extent with physical == logical):
+            # deterministic regardless of fs placement, and valid for
+            # GROWING files too (the write path)
+            extents = [Extent(0, 0, 1 << 62, 0)]
             self._stripe[fh] = (chunk, members, extents, [0])
+            return
+        if writable:
+            # a real-raid extent map of a growing file is a moving
+            # target — write attribution is sim-geometry only
             return
         else:
             info = resolve_device(path)
@@ -569,6 +574,8 @@ class StromEngine:
                                            arr.nbytes)
         if rid < 0:
             raise OSError(-rid, os.strerror(-rid))
+        if self._stripe:
+            self._attr_stripe(fh, offset, arr.nbytes)
         return PendingWrite(self, rid, arr)
 
     # -- stats / lifecycle -------------------------------------------------

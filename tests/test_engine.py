@@ -529,3 +529,28 @@ def test_engine_stripe_accounting_sim(tmp_path, monkeypatch):
             p.wait()
         eng.close(fh)
     assert stats2.member_bytes == {}
+
+
+def test_engine_stripe_accounting_writes(tmp_path, monkeypatch):
+    """Write-path attribution (checkpoint inverse path on a striped
+    rig): simulated geometry attributes written payload per member by
+    logical offset, valid for growing files."""
+    import numpy as np
+    from nvme_strom_tpu.io.engine import StromEngine
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    monkeypatch.setenv("STROM_STRIPE_ACCT", "1")
+    monkeypatch.setenv("STROM_STRIPE_SIM", "128:2")
+    stats = StromStats()
+    payload = np.random.default_rng(1).integers(
+        0, 256, 1 << 20, dtype=np.uint8)
+    path = tmp_path / "w.bin"
+    with StromEngine(EngineConfig(), stats=stats) as eng:
+        fh = eng.open(path, writable=True)
+        eng.submit_write(fh, 0, payload).wait()
+        eng.submit_write(fh, 1 << 20, payload).wait()
+        eng.close(fh)
+    mb = stats.member_bytes
+    assert sum(mb.values()) == 2 << 20
+    assert mb["sim0"] == mb["sim1"] == 1 << 20   # even 128KiB stripes
