@@ -35,6 +35,10 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--batches", type=int, default=0,
                     help="cap on evaluated batches (0 = everything)")
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 quantization after load "
+                         "(models/quant.py) - also measures the "
+                         "quantization's perplexity cost")
     args = ap.parse_args(argv)
 
     import jax
@@ -58,6 +62,11 @@ def main(argv=None) -> int:
         lambda name, shape: jax.sharding.SingleDeviceSharding(
             jax.devices()[0]),
         engine=engine)
+    if args.int8:
+        from nvme_strom_tpu.models.quant import quantize_weights_int8
+        params = quantize_weights_int8(params)
+        print("int8: matmul weights quantized "
+              "(ppl delta vs fp measures the cost)", flush=True)
 
     @jax.jit
     def eval_loss(params, tokens):
