@@ -178,7 +178,7 @@ def main() -> int:
 
     # 3: chunk-size sweep at fixed depth budget (depth scaled so
     # depth×chunk stays constant — same outstanding bytes)
-    for chunk_mib in (4, 8, 16):
+    for chunk_mib in (4, 8, 16, 32):
         depth = max(2, 64 // chunk_mib)
         cfg = EngineConfig(chunk_bytes=chunk_mib << 20,
                            queue_depth=depth,
