@@ -82,6 +82,14 @@ class TFRecordIndex:
         self.offsets: list[int] = []   # payload offsets
         self.lengths: list[int] = []
         fsize = os.path.getsize(self.path)
+        with open(self.path, "rb") as f:
+            if f.read(2) == b"\x1f\x8b":
+                raise ValueError(
+                    f"{self.path}: gzip-compressed TFRecord — a "
+                    "compressed stream has no random access, so the "
+                    "direct-read path cannot serve it; decompress the "
+                    "shards at prep time (zcat) or use uncompressed "
+                    "TFRecords")
         pos = 0
         with open(self.path, "rb") as f:
             while True:

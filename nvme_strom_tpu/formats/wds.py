@@ -34,6 +34,14 @@ class WdsShardIndex:
         self.path = str(path)
         self.samples: Dict[str, Dict[str, tuple]] = {}
         self.order: List[str] = []
+        with open(self.path, "rb") as f:
+            if f.read(2) == b"\x1f\x8b":
+                raise ValueError(
+                    f"{self.path}: gzip-compressed shard (.tar.gz) — "
+                    "a compressed stream has no random access, so the "
+                    "direct-read path cannot serve it; store shards as "
+                    "plain .tar (WebDataset's recommended layout for "
+                    "high-throughput readers)")
         # tarfile parses headers only; data is skipped via seeks.
         with tarfile.open(self.path, "r:") as tf:
             for m in tf:

@@ -416,3 +416,23 @@ class TestNpy:
                 # NOT MemoryError: a corrupt length field must never
                 # drive an allocation bomb (the planner clamps)
                 pass
+
+
+def test_compressed_shards_fail_loudly(tmp_path):
+    """gzip'd TFRecord/tar shards have no random access: the index must
+    refuse with a message naming the fix, not die parsing garbage."""
+    import gzip
+
+    import pytest
+
+    from nvme_strom_tpu.formats.tfrecord import TFRecordIndex
+    from nvme_strom_tpu.formats.wds import WdsShardIndex
+
+    gz = tmp_path / "d.tfrecord.gz"
+    gz.write_bytes(gzip.compress(b"payload" * 100))
+    with pytest.raises(ValueError, match="gzip-compressed TFRecord"):
+        TFRecordIndex(gz)
+    tgz = tmp_path / "s.tar.gz"
+    tgz.write_bytes(gzip.compress(b"tarball" * 100))
+    with pytest.raises(ValueError, match="gzip-compressed shard"):
+        WdsShardIndex(tgz)
