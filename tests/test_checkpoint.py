@@ -364,3 +364,29 @@ def test_finalize_times_out_on_missing_marker(tmp_path, monkeypatch):
     with _pytest.raises(TimeoutError, match="done markers"):
         mgr._finalize(1, tmp, final, index)
     assert mgr.all_steps() == []
+
+
+def test_int8_params_roundtrip(tmp_path):
+    """A quantized param tree (nested {q8, scale} leaves) survives
+    save/restore bit-exactly — int8 serving state is persistable."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.checkpoint.manager import CheckpointManager
+    from nvme_strom_tpu.models.quant import quantize_weights_int8
+    from nvme_strom_tpu.models.transformer import (init_params,
+                                                   tiny_config)
+
+    cfg = tiny_config()
+    qp = quantize_weights_int8(init_params(jax.random.key(0), cfg))
+    mgr = CheckpointManager(tmp_path / "ckpt")
+    mgr.save(1, qp)
+
+    target = jax.tree.map(jnp.zeros_like, qp)
+    out = mgr.restore(target)
+    flat_a, _ = jax.tree_util.tree_flatten(qp)
+    flat_b, _ = jax.tree_util.tree_flatten(out)
+    for a, b in zip(flat_a, flat_b):
+        assert np.asarray(a).dtype == np.asarray(b).dtype
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert out["layers.0.wq"]["q8"].dtype == jnp.int8
