@@ -23,9 +23,13 @@ Design (classic FlashAttention, re-tiled for the TPU memory hierarchy):
 
 VMEM sizing: one head's K and V (s × head_dim each) must fit in VMEM,
 which holds to s ≈ 16k at head_dim 128 in bf16.  Beyond that, shard the
-sequence with ring attention (parallel/ring_attention.py) — the two
-compose: ring moves K/V blocks across chips, this kernel handles the
-on-chip blocks.
+sequence with ring attention (parallel/ring_attention.py), which runs
+its own flash-style online-softmax block math over ppermuted K/V
+blocks.  (Swapping this Pallas kernel in as ring's per-block inner
+would need the kernel to emit per-block LSE through its custom VJP —
+the combine weights outputs by LSE, so training would differentiate
+through it; until that VJP exists the two are alternatives, not
+composed layers.)
 """
 
 from __future__ import annotations
