@@ -23,13 +23,12 @@ Design (classic FlashAttention, re-tiled for the TPU memory hierarchy):
 
 VMEM sizing: one head's K and V (s × head_dim each) must fit in VMEM,
 which holds to s ≈ 16k at head_dim 128 in bf16.  Beyond that, shard the
-sequence with ring attention (parallel/ring_attention.py), which runs
-its own flash-style online-softmax block math over ppermuted K/V
-blocks.  (Swapping this Pallas kernel in as ring's per-block inner
-would need the kernel to emit per-block LSE through its custom VJP —
-the combine weights outputs by LSE, so training would differentiate
-through it; until that VJP exists the two are alternatives, not
-composed layers.)
+sequence with ring attention (parallel/ring_attention.py), which can run
+this kernel as its per-block inner via ``flash_attention_lse``: the
+(out, lse) pair is differentiable — the LSE cotangent folds into the
+existing backward kernels as ``delta_eff = delta - dlse`` (the score
+gradient is ``ds = p·(dp - delta + dlse)·scale``), so the ring's
+LSE-weighted block combine trains end-to-end with no extra kernels.
 """
 
 from __future__ import annotations
@@ -44,6 +43,23 @@ from jax.experimental import pallas as pl
 _NEG_INF = -1e30
 
 
+def _vma(*arrays):
+    """Union of the inputs' varying-mesh-axes sets, so pallas_call
+    out_shapes type-check under shard_map's VMA system (outside a manual
+    context this is the empty set and has no effect)."""
+    out = frozenset()
+    for a in arrays:
+        out |= getattr(jax.typeof(a), "vma", frozenset())
+    return out
+
+
+def _struct(shape, dtype, vma):
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except TypeError:  # older jax: no vma kwarg, no VMA checking either
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pick_block(seq: int, want: int) -> int:
     """Largest divisor of ``seq`` that is <= want (block shapes must tile
     the sequence exactly)."""
@@ -56,7 +72,7 @@ def _pick_block(seq: int, want: int) -> int:
 # ----------------------------- forward -----------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                scale, block_q, block_k, causal, seq):
+                scale, block_q, block_k, causal, kv_seq):
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
     d = q.shape[-1]
@@ -69,7 +85,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         # k-blocks strictly after this q-block's last row are fully masked
         n_kb = ((qi + 1) * block_q + block_k - 1) // block_k
     else:
-        n_kb = seq // block_k
+        n_kb = kv_seq // block_k
 
     def body(i, carry):
         m, l, acc = carry
@@ -102,23 +118,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
 def _fwd(q, k, v, scale, block_q, block_k, causal, interpret):
     b, h, s, d = q.shape
+    skv = k.shape[2]                 # may differ from s when non-causal
     grid = (b, h, s // block_q)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal, seq=s),
+                          block_k=block_k, causal=causal, kv_seq=skv),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, skv, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+            _struct(q.shape, q.dtype, _vma(q, k, v)),
+            _struct((b, h, s, 1), jnp.float32, _vma(q, k, v)),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -128,7 +145,7 @@ def _fwd(q, k, v, scale, block_q, block_k, causal, interpret):
 # ----------------------------- backward -----------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, block_q, block_k, causal, seq):
+               scale, block_q, block_k, causal, kv_seq):
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)                  # (bq, d)
     do = do_ref[0, 0].astype(jnp.float32)
@@ -137,7 +154,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     d = q.shape[-1]
 
     n_kb = (((qi + 1) * block_q + block_k - 1) // block_k) if causal \
-        else seq // block_k
+        else kv_seq // block_k
 
     def body(i, dq):
         k = k_ref[0, 0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
@@ -203,37 +220,38 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, block_q, block_k, causal, interpret, res, dout):
-    q, k, v, out, lse = res
+def _bwd_pallas(scale, block_q, block_k, causal, interpret,
+                q, k, v, lse, dout, delta):
+    """Shared backward: ``delta`` is (b, h, s, 1) fp32.  For the plain
+    output VJP it is Σ_d do·o; when an LSE cotangent exists it is
+    Σ_d do·o − dlse (the dlse term enters ds with the opposite sign of
+    delta, so folding it here reuses both kernels unchanged)."""
     b, h, s, d = q.shape
-    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)              # (b, h, s, 1)
-
-    kw = dict(scale=scale, block_q=block_q, block_k=block_k,
-              causal=causal, seq=s)
+    skv = k.shape[2]
+    kw = dict(scale=scale, block_q=block_q, block_k=block_k, causal=causal)
     blk_q = lambda bi, hi, qi: (bi, hi, qi, 0)       # noqa: E731
     full = lambda bi, hi, qi: (bi, hi, 0, 0)         # noqa: E731
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, **kw),
+        functools.partial(_dq_kernel, kv_seq=skv, **kw),
         grid=(b, h, s // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), blk_q),
-            pl.BlockSpec((1, 1, s, d), full),
-            pl.BlockSpec((1, 1, s, d), full),
+            pl.BlockSpec((1, 1, skv, d), full),
+            pl.BlockSpec((1, 1, skv, d), full),
             pl.BlockSpec((1, 1, block_q, d), blk_q),
             pl.BlockSpec((1, 1, block_q, 1), blk_q),
             pl.BlockSpec((1, 1, block_q, 1), blk_q),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), blk_q),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_struct(q.shape, q.dtype, _vma(q, k, v, dout, lse, delta)),
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
 
     blk_k = lambda bi, hi, ki: (bi, hi, ki, 0)       # noqa: E731
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, **kw),
-        grid=(b, h, s // block_k),
+        functools.partial(_dkv_kernel, seq=s, **kw),
+        grid=(b, h, skv // block_k),
         in_specs=[
             pl.BlockSpec((1, 1, s, d), full),
             pl.BlockSpec((1, 1, block_k, d), blk_k),
@@ -247,8 +265,8 @@ def _bwd(scale, block_q, block_k, causal, interpret, res, dout):
             pl.BlockSpec((1, 1, block_k, d), blk_k),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _struct(k.shape, k.dtype, _vma(q, k, v, dout, lse, delta)),
+            _struct(v.shape, v.dtype, _vma(q, k, v, dout, lse, delta)),
         ],
         interpret=interpret,
     )(q, k, v, dout, lse, delta)
@@ -258,17 +276,27 @@ def _bwd(scale, block_q, block_k, causal, interpret, res, dout):
 # ----------------------------- public API -----------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, block_q, block_k, causal, interpret):
-    out, _ = _fwd(q, k, v, scale, block_q, block_k, causal, interpret)
-    return out
-
-
-def _flash_fwd(q, k, v, scale, block_q, block_k, causal, interpret):
+def _flash_lse(q, k, v, scale, block_q, block_k, causal, interpret):
     out, lse = _fwd(q, k, v, scale, block_q, block_k, causal, interpret)
-    return out, (q, k, v, out, lse)
+    return out, lse[..., 0]
 
 
-_flash.defvjp(_flash_fwd, _bwd)
+def _flash_lse_fwd(q, k, v, scale, block_q, block_k, causal, interpret):
+    out, lse = _fwd(q, k, v, scale, block_q, block_k, causal, interpret)
+    return (out, lse[..., 0]), (q, k, v, out, lse)
+
+
+def _flash_lse_bwd(scale, block_q, block_k, causal, interpret, res, cts):
+    q, k, v, out, lse = res
+    dout, dlse = cts
+    delta = (jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                     axis=-1, keepdims=True)
+             - dlse.astype(jnp.float32)[..., None])      # (b, h, s, 1)
+    return _bwd_pallas(scale, block_q, block_k, causal, interpret,
+                       q, k, v, lse, dout, delta)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
@@ -279,18 +307,45 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: float = None,
     Differentiable (custom VJP with blockwise-recompute backward).
     ``interpret`` defaults to True off-TPU so CPU tests and virtual meshes
     run the identical kernel in the Pallas interpreter.
+
+    K/V may have a different sequence length than Q when ``causal=False``
+    (blockwise/ring combines, cross-attention); causal masking assumes
+    aligned positions and therefore requires equal lengths.
     """
+    out, _ = _flash_lse(q, k, v, *_prep(q, k, causal, scale, block_q,
+                                        block_k, interpret))
+    return out
+
+
+def _prep(q, k, causal, scale, block_q, block_k, interpret):
+    """Shared argument normalisation: returns the static tail
+    (scale, block_q, block_k, causal, interpret) for ``_flash_lse``."""
     if q.ndim != 4:
         raise ValueError(f"expected (b, h, s, d), got {q.shape}")
-    s = q.shape[2]
+    s, skv = q.shape[2], k.shape[2]
+    if causal and skv != s:
+        raise ValueError(
+            f"causal attention requires equal q/kv lengths, got {s} vs "
+            f"{skv} (position alignment is ambiguous otherwise)")
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    block_q = _pick_block(s, block_q)
-    block_k = _pick_block(s, block_k)
-    return _flash(q, k, v, float(scale), block_q, block_k, bool(causal),
-                  bool(interpret))
+    return (float(scale), _pick_block(s, block_q),
+            _pick_block(skv, block_k), bool(causal), bool(interpret))
+
+
+def flash_attention_lse(q, k, v, *, causal: bool = True, scale: float = None,
+                        block_q: int = 128, block_k: int = 128,
+                        interpret: bool = None):
+    """Like :func:`flash_attention` but also returns the per-row
+    log-sum-exp, shape (b, h, s) fp32 — the residual a blockwise combine
+    needs (ring attention weights per-block outputs by LSE).  The pair is
+    differentiable: cotangents on BOTH outputs flow through the shared
+    backward kernels.
+    """
+    return _flash_lse(q, k, v, *_prep(q, k, causal, scale, block_q,
+                                      block_k, interpret))
 
 
 def make_flash_attn(causal: bool = True, **kw):

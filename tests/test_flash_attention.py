@@ -11,7 +11,8 @@ import pytest
 
 from nvme_strom_tpu.models.transformer import (
     dense_causal_attention, forward, init_params, tiny_config)
-from nvme_strom_tpu.ops.flash_attention import flash_attention, make_flash_attn
+from nvme_strom_tpu.ops.flash_attention import (
+    flash_attention, flash_attention_lse, make_flash_attn)
 
 
 def _qkv(b=2, h=3, s=128, d=32, dtype=jnp.float32, seed=0):
@@ -94,6 +95,83 @@ def test_model_forward_with_flash():
     flash_logits = forward(params, tokens, cfg, attn_fn=make_flash_attn())
     np.testing.assert_allclose(flash_logits, dense_logits,
                                atol=2e-4, rtol=2e-4)
+
+
+def _dense_lse(q, k, v, causal):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    if causal:
+        s = q.shape[2]
+        scores = jnp.where(jnp.tril(jnp.ones((s, s), bool)), scores, -1e30)
+    return jax.scipy.special.logsumexp(scores, axis=-1)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_lse_matches_dense(causal):
+    q, k, v = _qkv(s=96, d=16, seed=5)
+    out, lse = flash_attention_lse(q, k, v, causal=causal,
+                                   block_q=32, block_k=32)
+    np.testing.assert_allclose(out, _dense(q, k, v, causal),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(lse, _dense_lse(q, k, v, causal),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_lse_pair_grads(causal):
+    """Cotangents on BOTH outputs: loss touches out and lse together, the
+    shared backward must match the dense autodiff exactly."""
+    q, k, v = _qkv(s=64, d=16, seed=7)
+    w = jax.random.normal(jax.random.key(11), q.shape)
+    u = jax.random.normal(jax.random.key(12), q.shape[:3])
+
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, causal=causal,
+                                       block_q=32, block_k=32)
+        return jnp.sum(out * w) + jnp.sum(lse * u)
+
+    def loss_dense(q, k, v):
+        return (jnp.sum(_dense(q, k, v, causal) * w)
+                + jnp.sum(_dense_lse(q, k, v, causal) * u))
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_lse_blockwise_combine_matches_full():
+    """The ring use-case in miniature: split K/V in two halves, run the
+    kernel per half, merge the (out, lse) pairs by LSE weight, compare
+    against one full-sequence call — values AND gradients."""
+    q, k, v = _qkv(s=64, d=16, seed=8)
+    k1, k2 = jnp.split(k, 2, axis=2)
+    v1, v2 = jnp.split(v, 2, axis=2)
+    w = jax.random.normal(jax.random.key(13), q.shape)
+
+    def loss_combined(q, k1, k2, v1, v2):
+        o1, l1 = flash_attention_lse(q, k1, v1, causal=False, block_q=32)
+        o2, l2 = flash_attention_lse(q, k2, v2, causal=False, block_q=32)
+        m = jnp.maximum(l1, l2)
+        w1 = jnp.exp(l1 - m)[..., None]
+        w2 = jnp.exp(l2 - m)[..., None]
+        out = (o1 * w1 + o2 * w2) / (w1 + w2)
+        return jnp.sum(out * w)
+
+    def loss_full(q, k1, k2, v1, v2):
+        out = _dense(q, jnp.concatenate([k1, k2], 2),
+                     jnp.concatenate([v1, v2], 2), causal=False)
+        return jnp.sum(out * w)
+
+    lc = loss_combined(q, k1, k2, v1, v2)
+    lf = loss_full(q, k1, k2, v1, v2)
+    np.testing.assert_allclose(lc, lf, atol=1e-4, rtol=1e-4)
+    gc = jax.grad(loss_combined, argnums=(0, 1, 2, 3, 4))(q, k1, k2, v1, v2)
+    gf = jax.grad(loss_full, argnums=(0, 1, 2, 3, 4))(q, k1, k2, v1, v2)
+    for a, b, name in zip(gc, gf, ["q", "k1", "k2", "v1", "v2"]):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")
 
 
 def test_jit_compatible():
