@@ -34,6 +34,7 @@ _NEG_INF = -1e30
 
 def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, scale, block_k, n_kb):
+    bi = pl.program_id(0)
     ki = pl.program_id(2)
     g = q_ref.shape[2]                                   # query group size
 
@@ -46,17 +47,18 @@ def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref,
     q = q_ref[0, 0].astype(jnp.float32) * scale          # (g, d)
     k = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
     v = v_ref[0, 0].astype(jnp.float32)
+    pos = pos_ref[bi]
     # Rows past pos carry zero weight (p == 0), but a padded block tail
     # may hold NaN/garbage and 0·NaN = NaN — zero those V rows outright.
     rows_ok = (ki * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, v.shape, 0)) <= pos_ref[0, 0]
+        jnp.int32, v.shape, 0)) <= pos
     v = jnp.where(rows_ok, v, 0.0)
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)  # (g, bk)
     cols = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (g, block_k), 1)
     # <= pos masks unfilled cache AND any padded tail (pos < seq <= pad)
-    s = jnp.where(cols <= pos_ref[0, 0], s, _NEG_INF)
+    s = jnp.where(cols <= pos, s, _NEG_INF)
 
     m = m_ref[:, 0]
     l = l_ref[:, 0]
@@ -108,27 +110,34 @@ def decode_attention(q, k, v, pos, *, scale=None, block_k: int = 512,
     elif pos_arr.shape != (b,):
         raise ValueError(f"pos must be scalar or ({b},), "
                          f"got {pos_arr.shape}")
-    pos_arr = pos_arr.reshape(b, 1)
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=float(scale),
-                          block_k=block_k, n_kb=n_kb),
+    # Positions ride scalar prefetch (SMEM): they are control data, and a
+    # (b, 1) VMEM operand would need a (1, 1) block, which the Mosaic
+    # lowering rejects (last two block dims must be (8k, 128k) or the
+    # array dims).
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(b, nkv, n_kb),
         in_specs=[
-            pl.BlockSpec((1, 1), lambda bi, hi, ki: (bi, 0)),
-            pl.BlockSpec((1, 1, g, d), lambda bi, hi, ki: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, g, d),
+                         lambda bi, hi, ki, ps: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+                         lambda bi, hi, ki, ps: (bi, hi, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, ki: (bi, hi, ki, 0)),
+                         lambda bi, hi, ki, ps: (bi, hi, ki, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, g, d),
-                               lambda bi, hi, ki: (bi, hi, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
+                               lambda bi, hi, ki, ps: (bi, hi, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((g, 1), jnp.float32),    # running max
             pltpu.VMEM((g, 1), jnp.float32),    # running denominator
             pltpu.VMEM((g, d), jnp.float32),    # running accumulator
         ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=float(scale),
+                          block_k=block_k, n_kb=n_kb),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
         interpret=interpret,
     )(pos_arr, qg, k, v)
     return out.reshape(b, nh, 1, d)
