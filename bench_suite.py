@@ -60,6 +60,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import statistics
 import sys
 import time
@@ -929,6 +930,75 @@ def _train_variant(cfg, batch: int, seq: int, dev,
     return statistics.median(rates)
 
 
+def bench_opt_offload(engine) -> tuple[float, str]:
+    """Config 14: NVMe-offloaded Adam (parallel/opt_offload) priced
+    against the in-HBM optax step on the same tree.
+
+    The value is the moment-streaming rate: 4× moment payload (2 reads +
+    2 writes) per update over the update's wall time — the number that
+    says whether the engine keeps the optimizer fed.  The tag prices the
+    capability: step-time overhead vs in-HBM adamw, and the HBM the
+    moments actually occupy (one group) vs what in-HBM Adam would pin."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from nvme_strom_tpu.parallel.opt_offload import OffloadedAdam
+
+    tiny = _tiny_compute()
+    leaf = (1 << 18) if tiny else (1 << 22)       # elements per leaf
+    n_leaves = 4 if tiny else 16                  # 4 MiB / 256 MiB params
+    ks = jax.random.split(jax.random.key(0), n_leaves)
+    params = {f"w{i:02d}": jax.random.normal(k, (leaf,), jnp.float32)
+              for i, k in enumerate(ks)}
+    grads = {k: jax.random.normal(jax.random.key(hash(k) % (1 << 30)),
+                                  v.shape, jnp.float32)
+             for k, v in params.items()}
+    payload = 2 * sum(v.nbytes for v in params.values())
+
+    # in-HBM reference: one fused jitted adamw step
+    opt = optax.adamw(1e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def hbm_step(p, s, g):
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    hbm_step(params, state, grads)  # compile
+    t0 = time.monotonic()
+    reps = 3
+    p = params
+    for _ in range(reps):
+        p, state = hbm_step(p, state, grads)
+    jax.block_until_ready(p)
+    t_hbm = (time.monotonic() - t0) / reps
+
+    # fresh state every invocation: a stale dir would either resume old
+    # moments (not a step-1 benchmark) or refuse on a layout change
+    odir = os.path.join(_scratch_dir(), "opt_offload")
+    shutil.rmtree(odir, ignore_errors=True)
+    with OffloadedAdam(odir, params, lr=1e-3, weight_decay=1e-4,
+                       engine=engine,
+                       group_bytes=(1 << 22) if tiny else (64 << 20)
+                       ) as off:
+        off.update(params, grads)   # compile + first touch
+        t0 = time.monotonic()
+        p = params
+        for _ in range(reps):
+            p = off.update(p, grads)
+        jax.block_until_ready(p)
+        t_off = (time.monotonic() - t0) / reps
+        peak = off.peak_group_bytes()
+        groups = off.num_groups()
+    gibs = 2 * payload / t_off / (1 << 30)        # 2R + 2W of the payload
+    over = (t_off - t_hbm) / t_hbm if t_hbm > 0 else float("inf")
+    return gibs, (f"moments={payload >> 20}MiB step={t_off * 1e3:.0f}ms "
+                  f"overhead={over:+.0%} vs in-HBM "
+                  f"({t_hbm * 1e3:.0f}ms), hbm_peak={peak >> 20}MiB of "
+                  f"{payload >> 20}MiB, groups={groups}")
+
+
 def bench_train(device=None) -> tuple[float, str]:
     """Config 7: train-step throughput as model TFLOP/s (and MFU when the
     chip's peak is known).  FLOPs are the 6·T·P matmul estimate plus the
@@ -1075,6 +1145,10 @@ def run(configs: list[int]) -> list[dict]:
             # bound, so no north-star ceiling ratio (like config 12)
             13: ("parquet-dict-scan",
                  lambda: bench_dict_scan(engine, nbytes), "GiB/s", False),
+            # moment-streaming rate (2R+2W of the payload per step);
+            # compute+write mixed, so no read-ceiling ratio
+            14: ("offloaded-optimizer-step",
+                 lambda: bench_opt_offload(engine), "GiB/s", False),
         }
         for c in configs:
             label, fn, unit, io_row = names[c]
@@ -1106,12 +1180,12 @@ def run(configs: list[int]) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 14))
+                    choices=range(1, 15))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = list(range(1, 14))
+        configs = list(range(1, 15))
     for line in run(configs):
         print(json.dumps(line), flush=True)
     return 0

@@ -80,7 +80,15 @@ def main(argv=None) -> int:
                     help="use the Pallas fused flash-attention kernel "
                          "(O(seq) memory) instead of XLA dense "
                          "attention")
+    ap.add_argument("--offload-opt", default=None, metavar="DIR",
+                    help="keep Adam moments on NVMe under DIR instead of "
+                         "HBM (parallel/opt_offload): HBM holds one "
+                         "group of moments at a time, so optimizer "
+                         "state no longer bounds trainable model size")
     args = ap.parse_args(argv)
+    if args.offload_opt and args.lora:
+        ap.error("--offload-opt is for full fine-tunes; LoRA optimizer "
+                 "state is adapter-sized and lives happily in HBM")
 
     import jax
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
@@ -216,6 +224,36 @@ def main(argv=None) -> int:
         print(f"lora: rank {args.lora} alpha {alpha:g} — "
               f"{count_params(trainable)} trainable of "
               f"{count_params(base)} base params")
+    elif args.offload_opt:
+        # grads on device, moments on NVMe: the jitted step stops at the
+        # gradient; OffloadedAdam streams each moment group through the
+        # engine around a per-group update
+        from nvme_strom_tpu.models.transformer import (
+            accumulate_grads, loss_fn)
+        from nvme_strom_tpu.parallel.opt_offload import OffloadedAdam
+
+        trainable = params
+        opt_state = ()          # NVMe-resident; manifest is the state
+        offl = OffloadedAdam(args.offload_opt, params, lr=args.lr,
+                             weight_decay=1e-4,  # = optax.adamw default
+                             engine=engine)
+
+        def gstep(p, tokens):
+            return accumulate_grads(
+                lambda mb: jax.value_and_grad(
+                    lambda q: loss_fn(q, mb, cfg, attn_fn))(p),
+                p, tokens, args.accum_steps)
+
+        grad_fn = jax.jit(gstep, in_shardings=(p_sh, b_sh))
+
+        def step_fn(tr, ost, tokens):
+            loss, grads = grad_fn(tr, tokens)
+            return offl.update(tr, grads), ost, loss
+
+        print(f"offload-opt: {offl.moment_bytes() >> 20} MiB of moments "
+              f"on NVMe, peak {offl.peak_group_bytes() >> 20} MiB in "
+              f"HBM, {offl.num_groups()} groups, resumed at step "
+              f"{offl.step}")
     else:
         trainable = params
         opt_state = replicate_scalars(optimizer.init(params), mesh)
@@ -235,6 +273,18 @@ def main(argv=None) -> int:
             opt_state = jax.device_put(opt_state, rep)
         print(f"resumed from step {start}")
     start = (start or 0)
+    if args.offload_opt and offl.step != start:
+        # A crash between --save-every checkpoints leaves the moment
+        # manifest ahead of the params checkpoint; pairing step-M params
+        # with step-N moments (and t=N+1 bias correction) diverges
+        # SILENTLY, so refuse instead.
+        raise SystemExit(
+            f"offload-opt: moment manifest is at step {offl.step} but "
+            f"params resume at step {start} — Adam would run a "
+            "divergent trajectory.  Restore the params checkpoint "
+            f"matching step {offl.step}, or start a fresh moment dir "
+            "(the moments update in place every step; only "
+            "checkpoint-aligned pairs are coherent)")
 
     def batches():
         def decode(parts):

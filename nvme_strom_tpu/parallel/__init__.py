@@ -5,6 +5,7 @@ from nvme_strom_tpu.parallel.mesh import (
     process_info,
     local_batch_slice,
 )
+from nvme_strom_tpu.parallel.opt_offload import OffloadedAdam
 
 __all__ = ["make_mesh", "batch_sharding", "replicated", "process_info",
-           "local_batch_slice"]
+           "local_batch_slice", "OffloadedAdam"]

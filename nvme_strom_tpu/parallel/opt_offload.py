@@ -1,0 +1,364 @@
+"""NVMe-offloaded optimizer state: Adam moments live on SSD, not HBM.
+
+Adam triples a model's training memory: parameters plus two same-shaped
+moment tensors.  On a TPU the parameters must be resident for fwd/bwd,
+but the moments are touched exactly once per step — a streaming access
+pattern, which is precisely what the engine's NVMe path is for
+(SURVEY.md §3.5: the reference exists to feed accelerators data that
+doesn't fit device memory; this module applies that identity to the
+training loop's own state, the way ZeRO-Offload does for GPU+host-DRAM —
+here the tier is NVMe through the O_DIRECT engine).
+
+Per ``update(params, grads)``:
+
+  1. group g's moment slots stream NVMe → staging → device
+     (``DeviceStream``, chunk-pipelined, device-side assembly — no host
+     concatenation buffer);
+  2. a per-group jitted Adam update consumes (p, grad, m, v) and donates
+     the moment buffers;
+  3. updated moments stream back device → NVMe (pipelined
+     ``submit_write``, O_DIRECT when alignment allows, bounced+counted
+     otherwise), overlapping the next group's reads.
+
+HBM therefore holds the moments of ONE group (default 64 MiB) instead
+of 2× the model: a 16 GiB HBM chip can Adam-train parameters that would
+otherwise need ~3× their size in HBM.  The cost is 2 reads + 2 writes
+of the moment bytes per step, which the bench row (config 14) prices
+against the in-HBM step.
+
+Durability model: moments update IN PLACE (the no-double-write point of
+offloading); the manifest's ``step`` commits only after a full update's
+writes drain, so a crash mid-step leaves a file one step stale at worst
+mixed per-group — treat the manifest step as the resume truth and pair
+restores with the matching params checkpoint (checkpoint/manager.py).
+
+Single-host by design: every process would need its own shard file and
+a commit barrier; multi-process training raises loudly rather than
+corrupting a shared file (same stance as checkpoint save_async took in
+round 2 before its multi-host design existed).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.ops.bridge import DeviceStream, split_ranges
+from nvme_strom_tpu.utils.config import EngineConfig
+
+_ALIGN = 4096
+_MANIFEST_VERSION = 1
+
+
+def _align_up(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class OffloadedAdam:
+    """Adam(W) whose m/v moments live in an NVMe-backed file.
+
+    ``path`` is a directory holding ``moments.bin`` + ``moments.json``.
+    The layout derives from ``params`` (flat or nested pytree); an
+    existing manifest that matches the layout resumes (``.step`` picks
+    up where it left off), anything else is created zero-initialised.
+
+    ``update(params, grads)`` returns new params and advances the
+    NVMe-resident moments; it is numerically identical to
+    ``optax.adamw(lr, b1, b2, eps, weight_decay)`` (bias-corrected,
+    decoupled weight decay) — pinned by tests/test_opt_offload.py.
+
+    ``moment_dtype`` trades moment precision for half the NVMe traffic
+    (bf16 moments ≈ the fp32 trajectory for pretraining-scale lr, but
+    the parity guarantee above holds only for float32).
+    """
+
+    def __init__(self, path, params, *, lr: float,
+                 b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0,
+                 group_bytes: int = 64 << 20,
+                 moment_dtype=jnp.float32,
+                 engine: Optional[StromEngine] = None,
+                 config: Optional[EngineConfig] = None,
+                 depth: int = 4):
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "OffloadedAdam is single-host: each process would need "
+                "its own moment shard file plus a cross-host commit "
+                "barrier for the manifest step; run it on process 0 of "
+                "a single-host mesh or keep moments in HBM")
+        self.lr, self.b1, self.b2 = float(lr), float(b1), float(b2)
+        self.eps, self.weight_decay = float(eps), float(weight_decay)
+        self.moment_dtype = jnp.dtype(moment_dtype)
+        self._own_engine = engine is None
+        self.engine = engine or StromEngine(config or EngineConfig())
+        self.stream = DeviceStream(self.engine, depth=depth, drain="ready")
+
+        leaves, self._treedef = jax.tree_util.tree_flatten_with_path(params)
+        self._names = [jax.tree_util.keystr(kp) for kp, _ in leaves]
+        if len(set(self._names)) != len(self._names):
+            raise ValueError("duplicate leaf names in params tree")
+        order = sorted(range(len(leaves)), key=lambda i: self._names[i])
+        self._order = order
+
+        # ---- layout: per leaf, an aligned slot for m then one for v ----
+        self._layout: Dict[str, dict] = {}
+        off = 0
+        isz = self.moment_dtype.itemsize
+        for i in order:
+            name = self._names[i]
+            arr = leaves[i][1]
+            nbytes = int(np.prod(arr.shape, dtype=np.int64)) * isz if \
+                arr.shape else isz
+            self._layout[name] = {
+                "shape": tuple(int(s) for s in arr.shape),
+                "nbytes": int(nbytes),
+                "off_m": off,
+                "off_v": off + _align_up(nbytes),
+            }
+            off += 2 * _align_up(nbytes)
+        self._total_bytes = off
+
+        # ---- groups: consecutive slots, ~group_bytes of HBM each ----
+        self._groups: list[list[str]] = []
+        cur: list[str] = []
+        cur_b = 0
+        for i in order:
+            name = self._names[i]
+            b = 2 * self._layout[name]["nbytes"]
+            if cur and cur_b + b > group_bytes:
+                self._groups.append(cur)
+                cur, cur_b = [], 0
+            cur.append(name)
+            cur_b += b
+        if cur:
+            self._groups.append(cur)
+
+        os.makedirs(path, exist_ok=True)
+        self.data_path = os.path.join(path, "moments.bin")
+        self.manifest_path = os.path.join(path, "moments.json")
+        self.step = 0
+        if not self._try_resume():
+            self._create_zeroed()
+        self._fh = self.engine.open(self.data_path, writable=True)
+        self._update_fns: Dict[int, object] = {}
+
+    # ------------------------------------------------------------------
+    def _manifest(self) -> dict:
+        return {
+            "version": _MANIFEST_VERSION,
+            "step": self.step,
+            "dtype": self.moment_dtype.name,
+            "align": _ALIGN,
+            "total_bytes": self._total_bytes,
+            "leaves": {n: {k: (list(v) if isinstance(v, tuple) else v)
+                           for k, v in self._layout[n].items()}
+                       for n in self._layout},
+        }
+
+    def _try_resume(self) -> bool:
+        try:
+            with open(self.manifest_path) as f:
+                m = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return False
+        ours = self._manifest()
+        theirs_layout = {n: {k: (tuple(v) if isinstance(v, list) else v)
+                             for k, v in d.items()}
+                         for n, d in m.get("leaves", {}).items()}
+        ours_layout = {n: dict(d) for n, d in self._layout.items()}
+        if (m.get("version") != _MANIFEST_VERSION
+                or m.get("dtype") != ours["dtype"]
+                or theirs_layout != ours_layout):
+            raise ValueError(
+                f"existing moment file at {self.manifest_path} has a "
+                "different layout/dtype than these params — refusing to "
+                "overwrite optimizer state; point at a fresh directory "
+                "or delete it explicitly")
+        self.step = int(m["step"])
+        return True
+
+    def _create_zeroed(self) -> None:
+        fh = self.engine.open(self.data_path, writable=True)
+        try:
+            chunk = self.engine.config.chunk_bytes
+            zeros = np.zeros(min(chunk, self._total_bytes), np.uint8)
+            pend = []
+            for off in range(0, self._total_bytes, chunk):
+                n = min(chunk, self._total_bytes - off)
+                pend.append(self.engine.submit_write(fh, off, zeros[:n]))
+                while len(pend) >= self.engine.config.queue_depth:
+                    pend.pop(0).wait()
+            while pend:
+                pend.pop(0).wait()
+        finally:
+            self.engine.close(fh)
+        self.step = 0
+        self._commit_manifest()
+
+    def _commit_manifest(self) -> None:
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._manifest(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.manifest_path)
+
+    # ------------------------------------------------------------------
+    def _group_ranges(self, names) -> tuple[list, list]:
+        """Chunk-split (offset, length) ranges covering each slot of the
+        group, plus per-leaf chunk counts for device-side reassembly."""
+        chunk = self.engine.config.chunk_bytes
+        ranges: list[tuple[int, int]] = []
+        counts: list[int] = []          # chunks per slot, m then v per leaf
+        for n in names:
+            d = self._layout[n]
+            for off in (d["off_m"], d["off_v"]):
+                flat, cnt = split_ranges([(off, d["nbytes"])], chunk)
+                ranges.extend(flat)
+                counts.append(cnt[0])
+        return ranges, counts
+
+    def _read_group(self, names, shardings):
+        """Moment slots NVMe → device arrays, chunk-pipelined; chunks
+        assemble on device (jnp.concatenate), never in a host buffer."""
+        ranges, counts = self._group_ranges(names)
+        chunks = list(self.stream.stream_ranges(self._fh, ranges))
+        ms, vs = [], []
+        it = iter(chunks)
+        ci = iter(counts)
+        for j, n in enumerate(names):
+            d = self._layout[n]
+            for out in (ms, vs):
+                parts = [next(it) for _ in range(next(ci))]
+                flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+                arr = flat.view(self.moment_dtype).reshape(d["shape"])
+                if shardings[j] is not None:
+                    arr = jax.device_put(arr, shardings[j])
+                out.append(arr)
+        return ms, vs
+
+    def _write_group(self, names, ms, vs, pend) -> None:
+        for n, m, v in zip(names, ms, vs):
+            d = self._layout[n]
+            for off, arr in ((d["off_m"], m), (d["off_v"], v)):
+                host = np.asarray(arr).view(np.uint8).reshape(-1)
+                chunk = self.engine.config.chunk_bytes
+                for pos in range(0, host.nbytes, chunk):
+                    pend.append(self.engine.submit_write(
+                        self._fh, off + pos, host[pos:pos + chunk]))
+                    while len(pend) >= self.engine.config.queue_depth:
+                        pend.pop(0).wait()
+
+    def _update_fn(self, gi: int):
+        """Per-group jitted Adam update; moment buffers are donated."""
+        if gi in self._update_fns:
+            return self._update_fns[gi]
+        b1, b2, eps, wd = self.b1, self.b2, self.eps, self.weight_decay
+        mdt = self.moment_dtype
+
+        def upd(ps, gs, ms, vs, t, lr):
+            out_p, out_m, out_v = [], [], []
+            for p, g, m, v in zip(ps, gs, ms, vs):
+                g32 = g.astype(jnp.float32)
+                m32 = m.astype(jnp.float32) * b1 + g32 * (1 - b1)
+                v32 = v.astype(jnp.float32) * b2 + g32 * g32 * (1 - b2)
+                mh = m32 / (1 - b1 ** t)
+                vh = v32 / (1 - b2 ** t)
+                step = mh / (jnp.sqrt(vh) + eps) + wd * p.astype(jnp.float32)
+                out_p.append((p.astype(jnp.float32) - lr * step)
+                             .astype(p.dtype))
+                out_m.append(m32.astype(mdt))
+                out_v.append(v32.astype(mdt))
+            return out_p, out_m, out_v
+
+        fn = jax.jit(upd, donate_argnums=(2, 3))
+        self._update_fns[gi] = fn
+        return fn
+
+    def update(self, params, grads):
+        """One Adam(W) step: returns the updated params tree; the
+        NVMe-resident moments advance in place and ``.step`` increments
+        (manifest committed after all writes drain)."""
+        p_named = {jax.tree_util.keystr(kp): a for kp, a
+                   in jax.tree_util.tree_flatten_with_path(params)[0]}
+        g_leaves, g_def = jax.tree_util.tree_flatten_with_path(grads)
+        g_named = {jax.tree_util.keystr(kp): a for kp, a in g_leaves}
+        if set(p_named) != set(self._layout) or set(g_named) != set(
+                self._layout):
+            raise ValueError("params/grads tree does not match the "
+                             "layout this optimizer was built for")
+        t = jnp.float32(self.step + 1)
+        lr = jnp.float32(self.lr)
+        new_named: Dict[str, object] = {}
+        pend: list = []
+        try:
+            for gi, names in enumerate(self._groups):
+                ps = [p_named[n] for n in names]
+                gs = [g_named[n] for n in names]
+                sh = [getattr(p, "sharding", None) for p in ps]
+                ms, vs = self._read_group(names, sh)
+                out_p, out_m, out_v = self._update_fn(gi)(
+                    ps, gs, ms, vs, t, lr)
+                # out_shardings are unpinned (m/v leave for NVMe anyway),
+                # so GSPMD may have re-sharded p' — put each leaf back on
+                # its own sharding (no-op when unchanged)
+                out_p = [x if s is None or x.sharding == s
+                         else jax.device_put(x, s)
+                         for x, s in zip(out_p, sh)]
+                # writes of this group overlap the next group's reads:
+                # submit now, drain at the end of the step
+                self._write_group(names, out_m, out_v, pend)
+                for n, p in zip(names, out_p):
+                    new_named[n] = p
+            # success drain MUST raise: a failed moment write that got
+            # swallowed here would let the manifest claim a step whose
+            # slots never landed
+            while pend:
+                pend.pop(0).wait()
+        finally:
+            # only reachable with work left when an exception is already
+            # propagating — release without masking it
+            while pend:
+                try:
+                    pend.pop(0).wait()
+                except OSError:
+                    pass
+        self.step += 1
+        self._commit_manifest()
+        flat = [new_named[n] for n in self._names]
+        return jax.tree_util.tree_unflatten(self._treedef, flat)
+
+    # ------------------------------------------------------------------
+    def moment_bytes(self) -> int:
+        """NVMe footprint of the offloaded state (manifest total)."""
+        return self._total_bytes
+
+    def num_groups(self) -> int:
+        """How many read→update→write rounds one step takes."""
+        return len(self._groups)
+
+    def peak_group_bytes(self) -> int:
+        """Worst-case HBM the moments occupy during a step."""
+        return max(sum(2 * self._layout[n]["nbytes"] for n in g)
+                   for g in self._groups)
+
+    def close(self) -> None:
+        if getattr(self, "_fh", None) is not None:
+            self.engine.close(self._fh)
+            self._fh = None
+        if self._own_engine and self.engine is not None:
+            self.engine.close_all()
+            self.engine = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
