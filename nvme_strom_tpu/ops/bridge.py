@@ -202,6 +202,31 @@ class DeviceStream:
         return out
 
 
+def submit_chunked_writes(engine: StromEngine, fh: int, offset: int,
+                          host: np.ndarray, pend: list) -> int:
+    """Chunk-split pipelined writes of ``host`` bytes at ``offset`` into
+    an open fh.  In-flight submissions live in the CALLER-OWNED ``pend``
+    list (bounded at the engine's queue depth here) so several calls can
+    share one pipeline and drain together — the one write-side pattern
+    every consumer (checkpointing, KV eviction, optimizer offload)
+    shares, mirroring ``split_ranges`` on the read side.
+
+    The caller must drain ``pend`` (``.wait()`` each) before closing the
+    fh: in-flight writes target it, and closing first would EBADF them —
+    or hit a recycled descriptor.  Returns the bytes confirmed by waits
+    done HERE (depth-bound drains); bytes still in ``pend`` are the
+    caller's to count."""
+    chunk = engine.config.chunk_bytes
+    depth = engine.config.queue_depth
+    drained = 0
+    for pos in range(0, host.nbytes, chunk):
+        pend.append(engine.submit_write(fh, offset + pos,
+                                        host[pos:pos + chunk]))
+        while len(pend) >= depth:
+            drained += pend.pop(0).wait()
+    return drained
+
+
 def write_from_device(engine: StromEngine, array, path,
                       offset: int = 0) -> int:
     """Device array → NVMe (the checkpoint/inverse path, SURVEY.md §5).
@@ -211,21 +236,14 @@ def write_from_device(engine: StromEngine, array, path,
     chunk is alignment-conformant, bounced + counted otherwise).
     """
     host = np.ascontiguousarray(np.asarray(array)).view(np.uint8).reshape(-1)
-    chunk = engine.config.chunk_bytes
     fh = engine.open(path, writable=True)
     total = 0
     pend: list = []
     try:
-        for pos in range(0, host.nbytes, chunk):
-            part = host[pos:pos + chunk]
-            pend.append(engine.submit_write(fh, offset + pos, part))
-            if len(pend) >= engine.config.queue_depth:
-                total += pend.pop(0).wait()
+        total += submit_chunked_writes(engine, fh, offset, host, pend)
         while pend:
             total += pend.pop(0).wait()
     finally:
-        # Drain before close: writes still in flight target this fh; closing
-        # it first would EBADF them (or hit a recycled descriptor).
         for p in pend:
             try:
                 p.wait()

@@ -27,10 +27,12 @@ of the moment bytes per step, which the bench row (config 14) prices
 against the in-HBM step.
 
 Durability model: moments update IN PLACE (the no-double-write point of
-offloading); the manifest's ``step`` commits only after a full update's
-writes drain, so a crash mid-step leaves a file one step stale at worst
-mixed per-group — treat the manifest step as the resume truth and pair
-restores with the matching params checkpoint (checkpoint/manager.py).
+offloading).  Each update commits a ``dirty`` marker before its first
+slot write and clears it (with the advanced ``step``) only after every
+write drains — so a crash mid-step, which leaves a MIX of steps in the
+file, is detected and refused at resume rather than silently diverging.
+Pair restores with the params checkpoint matching the manifest step
+(checkpoint/manager.py; train_lm enforces this).
 
 Single-host by design: every process would need its own shard file and
 a commit barrier; multi-process training raises loudly rather than
@@ -50,7 +52,8 @@ import jax
 import jax.numpy as jnp
 
 from nvme_strom_tpu.io.engine import StromEngine
-from nvme_strom_tpu.ops.bridge import DeviceStream, split_ranges
+from nvme_strom_tpu.ops.bridge import (
+    DeviceStream, split_ranges, submit_chunked_writes)
 from nvme_strom_tpu.utils.config import EngineConfig
 
 _ALIGN = 4096
@@ -150,10 +153,11 @@ class OffloadedAdam:
         self._update_fns: Dict[int, object] = {}
 
     # ------------------------------------------------------------------
-    def _manifest(self) -> dict:
+    def _manifest(self, dirty: bool = False) -> dict:
         return {
             "version": _MANIFEST_VERSION,
             "step": self.step,
+            "dirty": dirty,
             "dtype": self.moment_dtype.name,
             "align": _ALIGN,
             "total_bytes": self._total_bytes,
@@ -181,6 +185,14 @@ class OffloadedAdam:
                 "different layout/dtype than these params — refusing to "
                 "overwrite optimizer state; point at a fresh directory "
                 "or delete it explicitly")
+        if m.get("dirty"):
+            raise ValueError(
+                f"moment file at {self.manifest_path} is marked dirty: a "
+                f"previous update crashed mid-step (after step "
+                f"{int(m['step'])}), so slots hold a MIX of steps — "
+                "resuming would silently diverge.  Restore params from "
+                "the matching checkpoint into a fresh moment dir, or "
+                "delete this one explicitly")
         self.step = int(m["step"])
         return True
 
@@ -189,12 +201,11 @@ class OffloadedAdam:
         try:
             chunk = self.engine.config.chunk_bytes
             zeros = np.zeros(min(chunk, self._total_bytes), np.uint8)
-            pend = []
+            pend: list = []
             for off in range(0, self._total_bytes, chunk):
                 n = min(chunk, self._total_bytes - off)
-                pend.append(self.engine.submit_write(fh, off, zeros[:n]))
-                while len(pend) >= self.engine.config.queue_depth:
-                    pend.pop(0).wait()
+                submit_chunked_writes(self.engine, fh, off, zeros[:n],
+                                      pend)
             while pend:
                 pend.pop(0).wait()
         finally:
@@ -202,10 +213,10 @@ class OffloadedAdam:
         self.step = 0
         self._commit_manifest()
 
-    def _commit_manifest(self) -> None:
+    def _commit_manifest(self, dirty: bool = False) -> None:
         tmp = self.manifest_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump(self._manifest(), f)
+            json.dump(self._manifest(dirty), f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.manifest_path)
@@ -249,12 +260,8 @@ class OffloadedAdam:
             d = self._layout[n]
             for off, arr in ((d["off_m"], m), (d["off_v"], v)):
                 host = np.asarray(arr).view(np.uint8).reshape(-1)
-                chunk = self.engine.config.chunk_bytes
-                for pos in range(0, host.nbytes, chunk):
-                    pend.append(self.engine.submit_write(
-                        self._fh, off + pos, host[pos:pos + chunk]))
-                    while len(pend) >= self.engine.config.queue_depth:
-                        pend.pop(0).wait()
+                submit_chunked_writes(self.engine, self._fh, off, host,
+                                      pend)
 
     def _update_fn(self, gi: int):
         """Per-group jitted Adam update; moment buffers are donated."""
@@ -298,6 +305,10 @@ class OffloadedAdam:
         lr = jnp.float32(self.lr)
         new_named: Dict[str, object] = {}
         pend: list = []
+        # mark dirty BEFORE the first in-place slot write: a crash
+        # mid-step leaves a mix of steps in the file, and only this
+        # marker lets a resume detect it (the step counter alone cannot)
+        self._commit_manifest(dirty=True)
         try:
             for gi, names in enumerate(self._groups):
                 ps = [p_named[n] for n in names]

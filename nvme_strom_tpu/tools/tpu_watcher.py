@@ -171,12 +171,23 @@ def capture(device: str) -> bool:
         ("suite_7", [sys.executable, "bench_suite.py", "--config", "7"],
          1500, None),
         # the MFU lever sweep (verdict #3): batch amortizes weight
-        # streaming, dots-remat fits the bigger batches — each variant
-        # compiles fresh over the tunnel, so it gets its own step/budget
-        ("suite_7_sweep",
-         [sys.executable, "bench_suite.py", "--config", "7"], 2400,
-         {"STROM_TRAIN_SWEEP":
-          "16:none,32:dots,64:dots,32:dots:flash"}),
+        # streaming, dots-remat fits the bigger batches.  ONE variant
+        # per step — the combined 4-variant sweep burned its whole
+        # 2400s budget on tunnel-speed compiles and landed nothing
+        # (ledger 2026-07-31T01:14); per-variant steps bound the loss
+        # to one point each.
+        ("suite_7_b16",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "16:none"}),
+        ("suite_7_b32",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "32:dots"}),
+        ("suite_7_b64",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "64:dots"}),
+        ("suite_7_b32_flash",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "32:dots:flash"}),
         ("kernel_probe",
          [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
          1200, None),

@@ -84,6 +84,22 @@ def test_resume_matches_straight_run(tmp_path):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_dirty_manifest_refused(tmp_path):
+    """A crash mid-update leaves the dirty marker set (slots hold a mix
+    of steps); resuming such a file must refuse."""
+    import json
+    params = _params()
+    with OffloadedAdam(tmp_path / "opt", params, lr=1e-2) as opt:
+        opt.update(params, _grads(params, 0))
+        mpath = opt.manifest_path
+    m = json.load(open(mpath))
+    assert m["dirty"] is False          # clean after a completed step
+    m["dirty"] = True
+    json.dump(m, open(mpath, "w"))
+    with pytest.raises(ValueError, match="dirty"):
+        OffloadedAdam(tmp_path / "opt", params, lr=1e-2)
+
+
 def test_layout_mismatch_refused(tmp_path):
     params = _params()
     with OffloadedAdam(tmp_path / "opt", params, lr=1e-2):
