@@ -58,6 +58,7 @@ once; gauges in the tag).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import shutil
@@ -622,22 +623,51 @@ def _tiny_compute() -> bool:
     return os.environ.get("STROM_SUITE_TINY_COMPUTE") == "1"
 
 
-def _bench_cfg():
+def _bench_cfg(train_override: bool = False):
     """One config for both compute rows.  Sized by measurement on the
     v5e: MFU scales with matmul size (d=512 → 8.8%, d=1024 → 15.7%,
     d=2048 → 35.3% at b=8 s=1024), so the row uses d=2048 — large enough
     for real MXU tiles, small enough to compile in ~20 s.  remat stays
     off: it costs ~6 points of measured MFU here (recompute FLOPs are
     real but not model FLOPs) and HBM fits the activations at this
-    size."""
+    size.
+
+    ``train_override=True`` (the train/profile rows ONLY) honors
+    STROM_TRAIN_CFG; decode/kv/serving rows ignore it — their ledger
+    tags carry no shape, so an override there would produce rows
+    indistinguishable from default-config ones."""
     from nvme_strom_tpu.models.transformer import TransformerConfig
     if _tiny_compute():
+        if train_override and os.environ.get("STROM_TRAIN_CFG"):
+            _log("suite: STROM_TRAIN_CFG ignored under "
+                 "STROM_SUITE_TINY_COMPUTE=1 (tiny shape wins)")
         return TransformerConfig(vocab=256, d_model=64, n_layers=2,
                                  n_heads=4, n_kv_heads=2, d_ff=128,
                                  max_seq=256)
-    return TransformerConfig(vocab=16384, d_model=2048, n_layers=8,
-                             n_heads=16, n_kv_heads=8, d_ff=5632,
-                             max_seq=2048)
+    cfg = TransformerConfig(vocab=16384, d_model=2048, n_layers=8,
+                            n_heads=16, n_kv_heads=8, d_ff=5632,
+                            max_seq=2048)
+    # STROM_TRAIN_CFG="d=4096,L=2,ff=11008,heads=32,kv=8[,vocab=N]"
+    # overrides the model shape — the MFU curve is matmul-size-bound
+    # (still rising at d=2048), so the sweep needs points where the
+    # per-layer matmuls are bigger than the default's.  A bad spec is
+    # logged and ignored: one typo must not lose a scarce TPU window.
+    spec = os.environ.get("STROM_TRAIN_CFG", "") if train_override else ""
+    if spec:
+        alias = {"d": "d_model", "L": "n_layers", "ff": "d_ff",
+                 "heads": "n_heads", "kv": "n_kv_heads",
+                 "vocab": "vocab"}
+        try:
+            kw = {}
+            for part in spec.split(","):
+                k, v = part.split("=")
+                kw[alias[k.strip()]] = int(v)
+            cfg = dataclasses.replace(cfg, **kw)
+            _log(f"suite: train cfg override {kw}")
+        except (ValueError, KeyError) as e:
+            _log(f"suite: ignoring bad STROM_TRAIN_CFG {spec!r} ({e}); "
+                 f"want 'd=4096,L=2,ff=11008,heads=32,kv=8'")
+    return cfg
 
 
 def bench_decode(device=None) -> tuple[float, str]:
@@ -1014,9 +1044,8 @@ def bench_train(device=None) -> tuple[float, str]:
     STROM_PROFILE_DIR captures a 3-step jax profiler trace of the LAST
     sweep variant (order the sweep so the variant to profile is last —
     tracing rides that variant's measuring run, no re-compile)."""
-    import dataclasses
     import jax
-    cfg = _bench_cfg()
+    cfg = _bench_cfg(train_override=True)
     batch, seq = (2, 64) if _tiny_compute() else (8, 1024)
     dev = device or jax.devices()[0]
     sweep = os.environ.get("STROM_TRAIN_SWEEP", "")
@@ -1065,7 +1094,12 @@ def bench_train(device=None) -> tuple[float, str]:
             else "mfu=null (unknown peak)")
     per = " ".join(f"b{b}/{p}/{a}={fs / 1e12:.2f}"
                    for fs, b, p, a in results)
-    return best[0] / 1e12, (f"{note} b={best[1]} s={seq} "
+    # model shape in the tag: the d3072/d4096 sweep rows must be
+    # distinguishable from the default-d2048 row in the ledger (every
+    # field the STROM_TRAIN_CFG alias map can override appears)
+    shape = (f"d={cfg.d_model} L={cfg.n_layers} ff={cfg.d_ff} "
+             f"h={cfg.n_heads}/{cfg.n_kv_heads} v={cfg.vocab}")
+    return best[0] / 1e12, (f"{note} {shape} b={best[1]} s={seq} "
                             f"remat={best[2]} attn={best[3]} [{per}]")
 
 
@@ -1158,7 +1192,10 @@ def run(configs: list[int]) -> list[dict]:
                 tag += f", {extra}"
             results.append({
                 "metric": f"config{c}:{label} ({tag})",
-                "value": round(val, 3),
+                # 4 significant figures, not 3 decimals: a tiny-compute
+                # CI run on a loaded box can dip below 0.0005 TFLOP/s
+                # and 3-decimal rounding would floor it to a 0.0 row
+                "value": float(f"{val:.4g}"),
                 "unit": unit,
                 # Ratios against a CPU-derived ceiling are not the north
                 # star — never emit a number a reader could mistake for

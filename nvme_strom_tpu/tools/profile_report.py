@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""MFU attribution: trace a train step, break device time down by op class.
+
+Round-2 verdict #3 asks for "MFU >= 45% or a profile that explains why
+not".  The raw TFLOP/s number says *how much* of the MXU we use; this
+tool says *where the rest went*.  It runs the config-7 train-step
+variant (same model/step as ``bench_suite.bench_train``, honoring
+``STROM_TRAIN_CFG`` / batch / remat / attn flags) under
+``jax.profiler.trace``, then parses the xplane protobuf with
+``jax.profiler.ProfileData`` — no TensorBoard dependency — and emits ONE
+JSON line the tpu_watcher ledgers:
+
+  - per-category device-time shares over the "XLA Ops" timeline
+    (matmul fusions vs elementwise fusions vs copies vs custom calls),
+  - device busy-time vs step wall-time (the gap is host/dispatch stall),
+  - the top-N individual ops by total device time, truncated names.
+
+Categories are keyword classes over HLO fusion names — coarse by
+design: the question the breakdown answers is "is the residual
+(1 - MFU) matmul inefficiency, memory-bound elementwise, data movement,
+or host stall", which these four buckets decide.
+
+Usage:
+    python -m nvme_strom_tpu.tools.profile_report [--batch 8]
+        [--remat none|dots|full] [--attn dense|flash] [--seq 1024]
+        [--dir DIR]   # parse an existing trace instead of capturing
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+
+def _log(msg: str) -> None:
+    print(f"profile: {msg}", file=sys.stderr, flush=True)
+
+
+#: keyword → bucket, first match wins (order matters: a fusion named
+#: "%convolution_reduce_fusion" is matmul work even though it is also a
+#: fusion).  HLO spellings: dot/convolution for MXU work; Pallas/flash
+#: kernels arrive as custom-call "tpu_custom_call".
+_CLASSES = (
+    ("matmul", ("convolution", "dot", "conv_", "%dot", "matmul")),
+    ("attention-kernel", ("tpu_custom_call", "custom-call", "flash",
+                          "pallas")),
+    ("copy", ("copy", "bitcast", "transpose", "reshape")),
+    ("reduce", ("reduce", "scatter", "gather", "sort", "select-and")),
+    ("elementwise-fusion", ("fusion", "add", "multiply", "subtract",
+                            "divide", "exponential", "rsqrt", "tanh")),
+)
+
+
+def classify(name: str) -> str:
+    low = name.lower()
+    for bucket, keys in _CLASSES:
+        if any(k in low for k in keys):
+            return bucket
+    return "other"
+
+
+def parse_trace(trace_dir: str) -> dict:
+    """Aggregate the device plane of the newest xplane.pb under
+    ``trace_dir``.  Returns the breakdown dict (no I/O)."""
+    import jax
+
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    pdata = jax.profiler.ProfileData.from_file(paths[-1])
+    dev_plane = host_plane = None
+    for p in pdata.planes:
+        if "/device:" in p.name and "CUSTOM" not in p.name:
+            dev_plane = p
+            break
+        if p.name == "/host:CPU":
+            host_plane = p
+
+    by_cat: dict[str, float] = {}
+    by_op: dict[str, float] = {}
+    module_ns = []          # per-step module durations (XLA Modules line)
+    module_spans = []       # (start, end) to bound the traced window
+
+    def _tally(ev) -> None:
+        cat = classify(ev.name)
+        by_cat[cat] = by_cat.get(cat, 0.0) + ev.duration_ns
+        # strip the "= <type> op(...)" tail: the lhs name keys the op;
+        # full HLO text would blow up the ledger line
+        short = ev.name.split("=", 1)[0].strip()[:48] or ev.name[:48]
+        by_op[short] = by_op.get(short, 0.0) + ev.duration_ns
+
+    if dev_plane is not None:
+        for line in dev_plane.lines:
+            if line.name == "XLA Modules":
+                for ev in line.events:
+                    module_ns.append(ev.duration_ns)
+                    module_spans.append((ev.start_ns,
+                                         ev.start_ns + ev.duration_ns))
+            elif line.name == "XLA Ops":
+                for ev in line.events:
+                    _tally(ev)
+    elif host_plane is not None:
+        # CPU fallback (tests / tunnel-down): the CPU PJRT client logs
+        # ops on tf_XLAPjRtCpuClient/* thread lines, with paired
+        # "end: <op>" markers and threadpool noise to skip.  Good
+        # enough for parser coverage; the MFU story itself is TPU-only.
+        for line in host_plane.lines:
+            if not line.name.startswith("tf_"):
+                continue
+            for ev in line.events:
+                if ev.name.startswith(("end:", "ThreadpoolListener",
+                                       "ThunkExecutor")):
+                    continue
+                _tally(ev)
+    else:
+        raise RuntimeError(
+            f"no device or host-CPU plane in {paths[-1]}; planes="
+            f"{[p.name for p in pdata.planes]}")
+    if not by_cat:
+        raise RuntimeError("trace has no op events")
+
+    busy_ns = sum(by_cat.values())
+    # wall of the traced region on the device timeline: first module
+    # start to last module end (covers inter-step gaps = host stall)
+    wall_ns = (max(e for _, e in module_spans)
+               - min(s for s, _ in module_spans)) if module_spans else busy_ns
+    top = sorted(by_op.items(), key=lambda kv: -kv[1])[:8]
+    return {
+        "plane": (dev_plane or host_plane).name,
+        "trace": os.path.basename(paths[-1]),
+        "steps_traced": len(module_ns),
+        "device_busy_ms": round(busy_ns / 1e6, 3),
+        "window_wall_ms": round(wall_ns / 1e6, 3),
+        "busy_frac": round(busy_ns / wall_ns, 4) if wall_ns else None,
+        "category_ms": {k: round(v / 1e6, 3)
+                        for k, v in sorted(by_cat.items(),
+                                           key=lambda kv: -kv[1])},
+        "category_frac": {k: round(v / busy_ns, 4)
+                          for k, v in sorted(by_cat.items(),
+                                             key=lambda kv: -kv[1])},
+        "top_ops_ms": {k: round(v / 1e6, 3) for k, v in top},
+    }
+
+
+def capture(batch: int, seq: int, remat: str, attn: str,
+            trace_dir: str) -> float:
+    """Run the measured train variant with a 3-step trace; returns the
+    median model-FLOP/s (same number config 7 reports)."""
+    import dataclasses
+
+    import jax
+
+    import bench_suite
+
+    cfg = dataclasses.replace(bench_suite._bench_cfg(train_override=True),
+                              remat_policy=(None if remat == "none"
+                                            else remat),
+                              remat=False)
+    dev = jax.devices()[0]
+    _log(f"tracing train step on {dev.platform}: d={cfg.d_model} "
+         f"L={cfg.n_layers} b={batch} s={seq} remat={remat} attn={attn}")
+    return bench_suite._train_variant(cfg, batch, seq, dev,
+                                      profile_dir=trace_dir, attn=attn)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--remat", default="none",
+                    choices=("none", "dots", "full"))
+    ap.add_argument("--attn", default="dense", choices=("dense", "flash"))
+    ap.add_argument("--dir", default=None,
+                    help="parse an existing trace dir (skip capture)")
+    args = ap.parse_args(argv)
+
+    flops = None
+    if args.dir:
+        trace_dir = args.dir
+    else:
+        # capture gate: same pattern as bench.py — never hang the
+        # watcher's step on a dead tunnel, the probe runs in-process
+        # here because the watcher already wraps us in a subprocess
+        # with its own timeout.
+        trace_dir = tempfile.mkdtemp(prefix="strom_profile_")
+        try:
+            flops = capture(args.batch, args.seq, args.remat, args.attn,
+                            trace_dir)
+        except Exception as e:  # noqa: BLE001 — ledger the failure mode
+            _log(f"capture failed: {type(e).__name__}: {str(e)[:200]}")
+            shutil.rmtree(trace_dir, ignore_errors=True)
+            return 1
+
+    try:
+        rep = parse_trace(trace_dir)
+    finally:
+        if not args.dir:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+
+    if args.dir:
+        # parse-only mode: the trace came from an earlier capture step
+        # (the suite's STROM_PROFILE_DIR hook) — do NOT instantiate a
+        # backend here, jax.devices() dials the tunnel and this step
+        # must stay cheap/safe even when the window has closed.  The
+        # device identity is in the trace's plane name.
+        rep["device"] = rep["plane"]
+        rep["variant"] = (f"(from {args.dir}) "
+                          f"cfg={os.environ.get('STROM_TRAIN_CFG', 'default')}")
+    else:
+        import jax
+        dev = jax.devices()[0]
+        peak = __import__("bench_suite")._peak_flops(dev)
+        if flops is not None:
+            rep["tflops"] = round(flops / 1e12, 3)
+            if peak:
+                rep["mfu"] = round(flops / peak, 4)
+        rep["device"] = f"{dev.platform} {dev.device_kind}"
+        rep["variant"] = (f"b={args.batch} s={args.seq} "
+                          f"remat={args.remat} attn={args.attn} "
+                          f"cfg={os.environ.get('STROM_TRAIN_CFG', 'default')}")
+    print(json.dumps({"metric": "config7:profile-breakdown", **rep}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

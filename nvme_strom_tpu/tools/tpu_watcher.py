@@ -39,8 +39,10 @@ import argparse
 import datetime
 import json
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
@@ -56,6 +58,10 @@ CAPTURE_COOLDOWN_S = 2700
 CAPTURE_TIMEOUT_S = 2400
 #: retry delay after an incomplete capture (tunnel died or step timed out)
 DUD_RETRY_S = 600
+#: model-size MFU sweep points (verdict #3); ONE definition each —
+#: the capture step and its profile parse step must agree on the shape
+CFG_D3072 = "d=3072,L=5,ff=8192,heads=24,kv=8"
+CFG_D4096 = "d=4096,L=2,ff=11008,heads=32,kv=8"
 
 
 def _now() -> str:
@@ -155,6 +161,12 @@ def capture(device: str) -> bool:
     for CAPTURE_COOLDOWN_S."""
     _log(f"capture START on {device!r}")
     ok = True
+    # fresh per-capture trace dirs: the profile_* parse steps must never
+    # pick up a stale trace from an earlier window whose suite step
+    # failed before tracing
+    prof_root = tempfile.mkdtemp(prefix="strom_capture_prof_")
+    prof_d2048 = os.path.join(prof_root, "d2048")
+    prof_d4096 = os.path.join(prof_root, "d4096")
     # One subprocess per config: a mid-window tunnel death (or one slow
     # compile) loses that step alone — round-3 lesson: a combined
     # 5+6+7 suite step burned its whole 2400s timeout and landed
@@ -169,7 +181,7 @@ def capture(device: str) -> bool:
         ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
          1200, None),
         ("suite_7", [sys.executable, "bench_suite.py", "--config", "7"],
-         1500, None),
+         1500, {"STROM_PROFILE_DIR": prof_d2048}),
         # the MFU lever sweep (verdict #3): batch amortizes weight
         # streaming, dots-remat fits the bigger batches.  ONE variant
         # per step — the combined 4-variant sweep burned its whole
@@ -188,6 +200,16 @@ def capture(device: str) -> bool:
         ("suite_7_b32_flash",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "32:dots:flash"}),
+        # model-size points (verdict #3: the MFU curve was still rising
+        # at d=2048 — measure where it flattens; param counts sized to
+        # keep fp32 params+grads+Adam inside the v5e's 16 GiB)
+        ("suite_7_d3072",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1500,
+         {"STROM_TRAIN_SWEEP": "8:dots", "STROM_TRAIN_CFG": CFG_D3072}),
+        ("suite_7_d4096",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1500,
+         {"STROM_TRAIN_SWEEP": "8:dots", "STROM_TRAIN_CFG": CFG_D4096,
+          "STROM_PROFILE_DIR": prof_d4096}),
         ("kernel_probe",
          [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
          1200, None),
@@ -203,7 +225,22 @@ def capture(device: str) -> bool:
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
          {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
     ]
-    for name, cmd, timeout_s, env_extra in steps:
+    # MFU attribution (verdict #3's "or a profile explaining why not"):
+    # op-class breakdowns parsed from the traces the suite_7 steps above
+    # capture (STROM_PROFILE_DIR rides their measuring run) — zero extra
+    # tunnel traffic.  Kept OUT of the abortable sequence: --dir mode
+    # never dials a backend, so these must run (and salvage an
+    # already-written trace) even when a later step saw the tunnel die.
+    parse_steps = [
+        ("profile_d2048",
+         [sys.executable, "-m", "nvme_strom_tpu.tools.profile_report",
+          "--dir", prof_d2048], 300, None),
+        ("profile_d4096",
+         [sys.executable, "-m", "nvme_strom_tpu.tools.profile_report",
+          "--dir", prof_d4096], 300, {"STROM_TRAIN_CFG": CFG_D4096}),
+    ]
+
+    def _do(name, cmd, timeout_s, env_extra):
         rec = _run_step(name, cmd, timeout_s=timeout_s,
                         env_extra=env_extra)
         rec["device"] = device
@@ -212,20 +249,35 @@ def capture(device: str) -> bool:
         n = len(rec.get("results", []))
         _log(f"capture step {name}: rc={rec.get('rc')} "
              f"results={n} in {rec['elapsed_s']}s")
-        # If the step found the tunnel already dead, don't burn the
-        # remaining steps' timeouts on it.  bench.py exits 0 on its CPU
-        # fallback — the down marker is in its JSON metric, not the rc.
-        # A step TIMEOUT is ambiguous (slow tunnel compile vs mid-step
-        # death): keep going — the next step's own device gate answers
-        # in seconds if the tunnel is gone.
-        if _looks_down(rec):
-            _log("capture step reports tunnel down; aborting capture")
-            ok = False
-            break
-        if rec.get("error", "").startswith("timeout"):
-            _log(f"capture step {name} timed out (slow or dead); "
-                 "continuing to next step")
-            ok = False          # incomplete capture: don't charge cooldown
+        return rec
+
+    try:
+        for name, cmd, timeout_s, env_extra in steps:
+            rec = _do(name, cmd, timeout_s, env_extra)
+            # If the step found the tunnel already dead, don't burn the
+            # remaining steps' timeouts on it.  bench.py exits 0 on its
+            # CPU fallback — the down marker is in its JSON metric, not
+            # the rc.  A step TIMEOUT is ambiguous (slow tunnel compile
+            # vs mid-step death): keep going — the next step's own
+            # device gate answers in seconds if the tunnel is gone.
+            if _looks_down(rec):
+                _log("capture step reports tunnel down; aborting capture")
+                ok = False
+                break
+            if rec.get("error", "").startswith("timeout"):
+                _log(f"capture step {name} timed out (slow or dead); "
+                     "continuing to next step")
+                ok = False      # incomplete capture: don't charge cooldown
+        for name, cmd, timeout_s, env_extra in parse_steps:
+            # cmd[-1] is the --dir argument; no trace dir means the
+            # suite step never got as far as tracing (dud window) —
+            # skip rather than ledger a guaranteed-failure row
+            if os.path.isdir(cmd[-1]):
+                _do(name, cmd, timeout_s, env_extra)
+            else:
+                _log(f"parse step {name}: no trace dir, skipping")
+    finally:
+        shutil.rmtree(prof_root, ignore_errors=True)
     _log(f"capture DONE (ok={ok})")
     return ok
 

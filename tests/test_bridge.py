@@ -137,3 +137,54 @@ def test_stream_ready_drain_matches_blocking(engine, tmp_data_file):
         assert np.asarray(out).tobytes() == payload[off:off + ln]
     with pytest.raises(ValueError, match="drain"):
         DeviceStream(engine, drain="bogus")
+
+
+def test_pjrt_cpu_alias_semantics():
+    """The measured facts behind host_to_device's protective CPU copy
+    (round-2 verdict #2: "a written answer on what PJRT does with the
+    buffer" — the full answer is in ARCHITECTURE.md, this pins the
+    observable half on the CPU client):
+
+      - device_put of a >=64-byte-aligned numpy source ALIASES it
+        (zero-copy): the jax.Array's buffer pointer equals the source's;
+      - the alias is LIVE — mutating the numpy buffer mutates the
+        "device" array, which is exactly why staging views (recycled on
+        release()) must be copied before device_put on host-backed
+        devices;
+      - a misaligned source is copied (no alias), so the behavior is
+        alignment-gated, and the engine pool's 4096-byte alignment
+        always qualifies on the zero-copy side.
+    """
+    import jax
+
+    buf = np.zeros(1 << 16, dtype=np.uint8)
+    off = (-buf.ctypes.data) % 4096
+    aligned = buf[off:off + 4096]
+    arr = jax.device_put(aligned)
+    ptr = arr.addressable_shards[0].data.unsafe_buffer_pointer()
+    assert ptr == aligned.ctypes.data, "aligned source must alias"
+    aligned[:] = 7                      # the hazard host_to_device guards
+    assert int(np.asarray(arr)[0]) == 7, "alias is live"
+
+    misaligned = buf[off + 3:off + 3 + 4096]
+    arr2 = jax.device_put(misaligned)
+    ptr2 = arr2.addressable_shards[0].data.unsafe_buffer_pointer()
+    assert ptr2 != misaligned.ctypes.data, "misaligned source must copy"
+
+
+def test_host_to_device_cpu_copy_is_alias_proof(engine, tmp_data_file):
+    """host_to_device's CPU bounce copy makes the yielded array IMMUNE to
+    staging recycling: stream a file, then scribble over the whole
+    engine pool — every yielded array must still hash to the original
+    payload.  (Without the copy, the aliased buffers would show the
+    scribble — see test_pjrt_cpu_alias_semantics.)"""
+    path, payload = tmp_data_file
+    ds = DeviceStream(engine, depth=2)
+    parts = list(ds.stream_file(path))
+    # scribble: read DIFFERENT content through the same pool slots
+    other = str(path) + ".other"
+    with open(other, "wb") as f:
+        f.write(bytes(len(payload)))
+    list(DeviceStream(engine, depth=2).stream_file(other))
+    got = b"".join(np.asarray(c).tobytes() for c in parts)
+    assert got == payload
