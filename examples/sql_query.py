@@ -4,6 +4,7 @@
     SELECT k, COUNT(v), SUM(v), MEAN(v) FROM t [WHERE lo<=w<=hi] GROUP BY k
     SELECT city, AGG(v)  FROM t GROUP BY city          (string keys)
     SELECT d.attr, SUM(f.v) FROM fact JOIN dim ... GROUP BY d.attr LIMIT n
+    SELECT v, k FROM t ORDER BY v DESC LIMIT n      (stats-eliminated scan)
 
 Points at an existing Parquet file (--table) or synthesizes one
 (--rows).  Column payloads ride the O_DIRECT engine and decode ON
@@ -59,6 +60,8 @@ def main(argv=None) -> int:
                     choices=("none", "zstd", "snappy", "gzip"))
     ap.add_argument("--key", default="k")
     ap.add_argument("--value", default="v")
+    ap.add_argument("--top", type=int, default=5,
+                    help="LIMIT for the ORDER BY demo query")
     ap.add_argument("--where", nargs=3, metavar=("COL", "LO", "HI"),
                     default=None,
                     help="range predicate; row groups the footer stats "
@@ -70,7 +73,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     from nvme_strom_tpu.io import StromEngine
     from nvme_strom_tpu.sql import (ParquetScanner, sql_groupby,
-                                    sql_groupby_str, top_k_groups)
+                                    sql_groupby_str, sql_topk,
+                                    top_k_groups)
 
     tmp = None
     path = args.table
@@ -105,6 +109,15 @@ def main(argv=None) -> int:
                 for a in out}
         print(f"GROUP BY {args.key} (first 5 groups): {head}")
         counters("groupby", t0)
+
+        t0 = time.monotonic()
+        tk = sql_topk(sc, args.value, columns=[args.key], k=args.top,
+                      where_ranges=where_ranges)
+        print(f"ORDER BY {args.value} DESC LIMIT {args.top}: "
+              f"{[round(float(x), 4) for x in tk[args.value]]} "
+              f"(rows {list(tk['_row'])}, "
+              f"{tk['_skipped_row_groups']} row groups eliminated)")
+        counters("order by / limit", t0)
 
         if args.table is None:       # the synthesized string column
             t0 = time.monotonic()

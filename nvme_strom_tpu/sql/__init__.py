@@ -2,7 +2,8 @@ from nvme_strom_tpu.sql.parquet import EngineFile, ParquetScanner
 from nvme_strom_tpu.sql.groupby import (groupby_aggregate, sql_groupby,
                                         sql_groupby_str, top_k_groups)
 from nvme_strom_tpu.sql.join import lookup_unique, star_join_groupby
+from nvme_strom_tpu.sql.topk import sql_topk
 
 __all__ = ["EngineFile", "ParquetScanner", "groupby_aggregate",
            "sql_groupby", "sql_groupby_str", "top_k_groups",
-           "lookup_unique", "star_join_groupby"]
+           "lookup_unique", "star_join_groupby", "sql_topk"]

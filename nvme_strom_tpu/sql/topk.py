@@ -1,0 +1,207 @@
+"""ORDER BY <col> [DESC|ASC] LIMIT k over a streamed Parquet scan, on TPU.
+
+The missing third of the PG-Strom consumer triad (SURVEY.md §3.5): scan
+(parquet/pq_direct), aggregate/join (groupby/join), and now ORDER BY +
+LIMIT pushdown.  PG-Strom sorts/limits on the GPU so only k result rows
+return to host; the TPU formulation is a *streaming top-k merge*:
+
+  - each row group's columns land on device via the usual direct path;
+  - a jitted merge keeps the current best-k rows ON DEVICE — concat the
+    carried k candidates with the group's N rows, ``argsort`` (stable,
+    native dtype: no float-rank precision loss on integer keys), slice
+    k.  Device memory holds one row group + k rows, never the table;
+  - only the final k rows cross back to host.
+
+LIMIT pushdown with scan elimination: row groups are visited in order of
+their footer statistic bound (max for DESC, min for ASC; missing stats
+sort first so they are never skipped), and once k valid rows are held,
+any remaining group whose bound provably cannot beat the current k-th
+row is skipped — its payload never leaves the SSD, the same
+statistics-driven elimination ``prune_row_groups`` does for WHERE.
+
+Ordering semantics: ties beyond position k are unspecified (as in SQL);
+NaN keys and (with ``nulls="skip"``) NULL rows never surface.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nvme_strom_tpu.sql.groupby import _range_mask, iter_device_columns
+
+
+def _sentinel(dtype, descending: bool):
+    """The key value an invalid row is given so it always loses."""
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf if descending else jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.min if descending else info.max, dtype)
+
+
+@partial(jax.jit, static_argnames=("k", "descending"))
+def _merge_topk(key, vals, row, valid, k: int, descending: bool):
+    """Best-k rows of (key, vals, row, valid) by key.  ``k`` ≤ len(key)
+    is static; callers pass the concatenation of the carried candidates
+    and one row group, so one compiled merge serves the whole stream
+    (per distinct row-group length)."""
+    if jnp.issubdtype(key.dtype, jnp.floating):
+        valid = valid & ~jnp.isnan(key)
+    skey = jnp.where(valid, key, _sentinel(key.dtype, descending))
+    # lexsort, validity secondary: a VALID row whose key *equals* the
+    # sentinel (a real -inf/iinfo-min value) must still beat invalid
+    # rows, or WHERE-filtered rows would displace it from the carry
+    if descending:
+        # ascending sort, invalid first among ties → after the reversal
+        # valid rows precede invalid ones
+        order = jnp.lexsort((valid, skey))
+        idx = order[::-1][:k]
+    else:
+        # ascending, valid first among ties
+        order = jnp.lexsort((~valid, skey))
+        idx = order[:k]
+    return (key[idx], {c: v[idx] for c, v in vals.items()},
+            row[idx], valid[idx])
+
+
+def _rg_bound(scanner, rg: int, ci: int, descending: bool):
+    """The best key value row group ``rg`` could possibly contain, per
+    footer statistics — or None when stats are absent (no claim)."""
+    st = scanner.metadata.row_group(rg).column(ci).statistics
+    if st is None or st.min is None or st.max is None:
+        return None
+    return st.max if descending else st.min
+
+
+def _beats(bound, worst, descending: bool) -> bool:
+    """Could a row at ``bound`` displace the current k-th row ``worst``?
+    Strict comparison: a tie cannot improve the top-k multiset."""
+    return bound > worst if descending else bound < worst
+
+
+def sql_topk(scanner, by: str, columns: Sequence[str] = (),
+             k: int = 10, descending: bool = True, device=None,
+             where=None, where_columns: Sequence[str] = (),
+             where_ranges: Sequence[tuple] = (),
+             nulls: str = "forbid") -> Dict[str, np.ndarray]:
+    """``SELECT by, columns... FROM parquet [WHERE ...] ORDER BY by
+    [DESC] LIMIT k`` — streamed, merged on device, statistics-skipped.
+
+    Returns {name: (m,) numpy} for ``by`` and every name in ``columns``,
+    plus ``"_row"`` (int32 global row index — result provenance) and
+    ``"_skipped_row_groups"`` (int: groups the LIMIT elimination proved
+    irrelevant — their payload was never read), with m ≤ k (m < k only
+    when fewer rows survive the WHERE/NULL masks), in result order.
+
+    ``where``/``where_columns``/``where_ranges``: the same on-device
+    WHERE pushdown + footer-statistics row-group pruning as
+    ``sql_groupby``.  ``nulls="skip"`` drops rows where ANY referenced
+    column is NULL (SQL three-valued logic); "forbid" raises on NULLs.
+    """
+    if k < 1:
+        raise ValueError(f"k must be >= 1, got {k}")
+    if nulls not in ("forbid", "skip"):
+        raise ValueError(f"bad nulls={nulls!r}")
+    where_ranges = list(where_ranges)
+    dev = device or jax.local_devices()[0]
+    out_cols = list(dict.fromkeys([by, *columns]))
+    range_cols = [c for c, _, _ in where_ranges]
+    cols_needed = list(dict.fromkeys(
+        [*out_cols, *where_columns, *range_cols]))
+    full_where = ((lambda cols: _range_mask(cols, where_ranges, where))
+                  if (where_ranges or where is not None) else None)
+
+    # row groups the WHERE ranges allow, ordered by how good their best
+    # possible key is — the LIMIT-elimination visit order
+    rgs = (scanner.prune_row_groups(where_ranges) if where_ranges
+           else list(range(scanner.num_row_groups)))
+    name_to_ci = {scanner.metadata.schema.column(i).name: i
+                  for i in range(scanner.metadata.num_columns)}
+    if by not in name_to_ci:
+        raise KeyError(f"column {by!r} not in schema")
+    ci = name_to_ci[by]
+    bounds = {rg: _rg_bound(scanner, rg, ci, descending) for rg in rgs}
+    # missing stats order FIRST (best-possible bound ⇒ never skipped);
+    # bounded groups sort on the EXACT stat value (no float() cast —
+    # int64 bounds above 2^53 must order consistently with _beats, or
+    # the elimination break could skip a group that still wins)
+    unbounded = [rg for rg in rgs if bounds[rg] is None]
+    bounded = sorted((rg for rg in rgs if bounds[rg] is not None),
+                     key=lambda rg: bounds[rg], reverse=descending)
+    rgs = unbounded + bounded
+    # global row offset of each row group, for the _row provenance
+    row_base, acc = {}, 0
+    for rg in range(scanner.num_row_groups):
+        row_base[rg] = acc
+        acc += scanner.metadata.row_group(rg).num_rows
+
+    carry = None          # (key (k,), vals {c: (k,)}, row (k,), valid (k,))
+    skipped_rgs = 0
+
+    def fold(rg_index: int, cols, base_mask):
+        nonlocal carry
+        key = cols[by]
+        n = key.shape[0]
+        row = jnp.arange(n, dtype=jnp.int32) + np.int32(row_base[rg_index])
+        valid = jnp.ones((n,), bool)
+        if full_where is not None:
+            valid = valid & full_where(cols)
+        if base_mask is not None:
+            valid = valid & base_mask
+        vals = {c: cols[c] for c in out_cols}
+        if carry is not None:
+            ckey, cvals, crow, cvalid = carry
+            key = jnp.concatenate([ckey, key])
+            row = jnp.concatenate([crow, row])
+            valid = jnp.concatenate([cvalid, valid])
+            vals = {c: jnp.concatenate([cvals[c], vals[c]])
+                    for c in out_cols}
+        kk = min(k, int(key.shape[0]))
+        carry = _merge_topk(key, vals, row, valid, kk, descending)
+
+    # ONE lazy iterator over the ordered groups: pulling the next item
+    # is what issues that group's reads, so breaking out of the loop
+    # below means eliminated groups' payload is never read at all
+    def group_stream():
+        if nulls == "skip":
+            for cols, masks in iter_device_columns(
+                    scanner, cols_needed, dev, row_groups=rgs,
+                    nulls="mask"):
+                base = None
+                for c in cols_needed:
+                    base = masks[c] if base is None else base & masks[c]
+                yield cols, base
+        else:
+            for cols in iter_device_columns(scanner, cols_needed, dev,
+                                            row_groups=rgs):
+                yield cols, None
+
+    stream = group_stream()
+    for pos, rg in enumerate(rgs):
+        # LIMIT elimination: once k valid rows are held, a group whose
+        # stat bound cannot beat the current k-th row is skipped — and
+        # since groups are visited best-bound-first, so is every group
+        # after it (bounded groups are sorted; unbounded ones came
+        # first).  Checked BEFORE pulling the group from the stream.
+        if carry is not None and carry[0].shape[0] == k:
+            if np.asarray(carry[3]).all():
+                worst = np.asarray(carry[0])[-1]
+                b = bounds[rg]
+                if b is not None and not _beats(b, worst, descending):
+                    skipped_rgs = len(rgs) - pos
+                    break
+        cols, base = next(stream)
+        fold(rg, cols, base)
+
+    if carry is None:
+        raise ValueError("empty table (no row groups survive pruning)")
+    key, vals, row, valid = carry
+    m = int(np.asarray(valid).sum())
+    out = {c: np.asarray(vals[c])[:m] for c in out_cols}
+    out["_row"] = np.asarray(row)[:m]
+    out["_skipped_row_groups"] = skipped_rgs    # elimination evidence
+    return out

@@ -1,0 +1,220 @@
+"""ORDER BY / LIMIT pushdown (sql/topk.py): streamed device-side top-k
+merge vs pandas ground truth, WHERE composition, NULL semantics, and the
+statistics-driven LIMIT elimination (skipped groups never read)."""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io import StromEngine
+from nvme_strom_tpu.sql import ParquetScanner, sql_topk
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+
+@pytest.fixture()
+def engine():
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                       buffer_pool_bytes=8 << 20)
+    with StromEngine(cfg, stats=StromStats()) as e:
+        yield e
+
+
+def _write(tmp_path, tbl, name="t.parquet", row_group_size=8192, **kw):
+    import pyarrow.parquet as pq
+    path = tmp_path / name
+    pq.write_table(tbl, path, row_group_size=row_group_size, **kw)
+    return path
+
+
+@pytest.fixture()
+def pq_file(tmp_path):
+    import pyarrow as pa
+    rng = np.random.default_rng(0)
+    n = 50_000
+    tbl = pa.table({
+        "k": rng.integers(0, 37, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+        "w": rng.integers(0, 1000, n).astype(np.int64),
+    })
+    return _write(tmp_path, tbl, compression="snappy"), tbl
+
+
+def _expect(df, by, k, descending, cols):
+    s = df.sort_values(by, ascending=not descending, kind="stable")
+    return s.head(k)[cols]
+
+
+@pytest.mark.parametrize("descending", [True, False])
+@pytest.mark.parametrize("by,extra", [("v", ["k"]), ("w", ["v", "k"])])
+def test_topk_matches_pandas(engine, pq_file, by, extra, descending):
+    path, tbl = pq_file
+    df = tbl.to_pandas()
+    sc = ParquetScanner(path, engine)
+    res = sql_topk(sc, by, columns=extra, k=25, descending=descending)
+    exp = _expect(df, by, 25, descending, [by, *extra])
+    # the ordered key column must match exactly (ties in OTHER columns
+    # may legitimately resolve differently)
+    np.testing.assert_array_equal(res[by], exp[by].to_numpy())
+    # provenance: _row indexes the original table and re-reads the
+    # same key values
+    np.testing.assert_array_equal(
+        df[by].to_numpy()[res["_row"]], res[by])
+    assert len(res[by]) == 25
+
+
+def test_topk_where_pushdown(engine, pq_file):
+    path, tbl = pq_file
+    df = tbl.to_pandas()
+    sc = ParquetScanner(path, engine)
+    res = sql_topk(sc, "v", columns=["w"], k=10,
+                   where=lambda c: c["w"] < 100,
+                   where_columns=["w"])
+    assert (res["w"] < 100).all()
+    exp = _expect(df[df["w"] < 100], "v", 10, True, ["v"])
+    np.testing.assert_array_equal(res["v"], exp["v"].to_numpy())
+
+
+def test_topk_where_ranges_prune_and_exact(engine, tmp_path):
+    import pyarrow as pa
+    # sorted key ⇒ tight per-group stats ⇒ provable pruning
+    n = 40_000
+    v = np.sort(np.arange(n, dtype=np.float32))
+    tbl = pa.table({"v": v,
+                    "x": np.arange(n, dtype=np.int32)})
+    path = _write(tmp_path, tbl, row_group_size=4096)
+    sc = ParquetScanner(path, engine)
+    res = sql_topk(sc, "v", columns=["x"], k=5,
+                   where_ranges=[("v", None, 999.0)])
+    np.testing.assert_array_equal(
+        res["v"], np.array([999, 998, 997, 996, 995], np.float32))
+    assert (res["x"] == res["v"].astype(np.int32)).all()
+
+
+def test_topk_limit_elimination_skips_groups(engine, tmp_path):
+    import pyarrow as pa
+    # 10 row groups, strictly increasing ⇒ DESC top-k lives entirely in
+    # the last group; statistics order visits it first and the bound
+    # check must eliminate the other 9 WITHOUT reading their payload
+    n = 40_960
+    tbl = pa.table({"v": np.arange(n, dtype=np.int64)})
+    path = _write(tmp_path, tbl, row_group_size=4096)
+    sc = ParquetScanner(path, engine)
+    before = engine.stats.bytes_direct + engine.stats.bytes_fallback \
+        + engine.stats.bounce_bytes
+    res = sql_topk(sc, "v", k=7, descending=True)
+    np.testing.assert_array_equal(
+        res["v"], np.arange(n - 1, n - 8, -1, dtype=np.int64))
+    assert res["_skipped_row_groups"] == 9
+    # ascending flips which single group is read
+    res2 = sql_topk(sc, "v", k=7, descending=False)
+    np.testing.assert_array_equal(
+        res2["v"], np.arange(0, 7, dtype=np.int64))
+    assert res2["_skipped_row_groups"] == 9
+    assert before < (engine.stats.bytes_direct
+                     + engine.stats.bytes_fallback
+                     + engine.stats.bounce_bytes)  # something was read
+
+
+def test_topk_k_larger_than_survivors(engine, tmp_path):
+    import pyarrow as pa
+    tbl = pa.table({"v": np.arange(100, dtype=np.float32),
+                    "w": np.arange(100, dtype=np.int32)})
+    path = _write(tmp_path, tbl, row_group_size=32)
+    sc = ParquetScanner(path, engine)
+    res = sql_topk(sc, "v", k=50, where=lambda c: c["w"] >= 97,
+                   where_columns=["w"])
+    np.testing.assert_array_equal(res["v"],
+                                  np.array([99, 98, 97], np.float32))
+
+
+def test_topk_nan_keys_never_surface(engine, tmp_path):
+    import pyarrow as pa
+    v = np.array([1.0, np.nan, 3.0, np.nan, 2.0], np.float32)
+    path = _write(tmp_path, pa.table({"v": v}), row_group_size=5)
+    sc = ParquetScanner(path, engine)
+    res = sql_topk(sc, "v", k=5)
+    np.testing.assert_array_equal(res["v"],
+                                  np.array([3, 2, 1], np.float32))
+
+
+def test_topk_nulls_skip(engine, tmp_path):
+    import pyarrow as pa
+    v = pa.array([5.0, None, 3.0, 8.0, None, 1.0], pa.float32())
+    w = pa.array([1, 2, None, 4, 5, 6], pa.int32())
+    path = _write(tmp_path, pa.table({"v": v, "w": w}), row_group_size=3)
+    sc = ParquetScanner(path, engine)
+    # forbid (default) raises on NULLs
+    with pytest.raises(ValueError, match="null"):
+        sql_topk(sc, "v", columns=["w"], k=3)
+    # skip: rows with ANY referenced NULL drop (v=3.0 has w NULL)
+    res = sql_topk(sc, "v", columns=["w"], k=3, nulls="skip")
+    np.testing.assert_array_equal(res["v"],
+                                  np.array([8, 5, 1], np.float32))
+    np.testing.assert_array_equal(res["w"], np.array([4, 1, 6], np.int32))
+
+
+def test_topk_bad_args(engine, pq_file):
+    path, _ = pq_file
+    sc = ParquetScanner(path, engine)
+    with pytest.raises(ValueError, match="k must be"):
+        sql_topk(sc, "v", k=0)
+    with pytest.raises(KeyError, match="nope"):
+        sql_topk(sc, "nope", k=3)
+    with pytest.raises(ValueError, match="nulls"):
+        sql_topk(sc, "v", k=3, nulls="bogus")
+
+
+def test_topk_fully_pruned_raises(engine, tmp_path):
+    import pyarrow as pa
+    tbl = pa.table({"v": np.arange(100, dtype=np.float32)})
+    path = _write(tmp_path, tbl, row_group_size=50)
+    sc = ParquetScanner(path, engine)
+    with pytest.raises(ValueError, match="empty"):
+        sql_topk(sc, "v", k=3, where_ranges=[("v", 1000.0, None)])
+
+
+def test_topk_valid_sentinel_value_beats_filtered_rows(engine, tmp_path):
+    """Regression: a VALID row whose key equals the invalid-row sentinel
+    (-inf) must not lose its carry slot to WHERE-filtered rows, and
+    filtered rows must never surface."""
+    import pyarrow as pa
+    v = np.array([-np.inf, 5.0, 7.0], np.float32)
+    w = np.array([1, 0, 0], np.int32)
+    path = _write(tmp_path, pa.table({"v": v, "w": w}), row_group_size=3)
+    sc = ParquetScanner(path, engine)
+    res = sql_topk(sc, "v", columns=["w"], k=2,
+                   where=lambda c: c["w"] == 1, where_columns=["w"])
+    np.testing.assert_array_equal(res["v"],
+                                  np.array([-np.inf], np.float32))
+    # variant: filtered row must not displace/surface among valid ones
+    v2 = np.array([10.0, -np.inf, 5.0], np.float32)
+    w2 = np.array([1, 1, 0], np.int32)
+    path2 = _write(tmp_path, pa.table({"v": v2, "w": w2}),
+                   name="t2.parquet", row_group_size=3)
+    sc2 = ParquetScanner(path2, engine)
+    res2 = sql_topk(sc2, "v", columns=["w"], k=2,
+                    where=lambda c: c["w"] == 1, where_columns=["w"])
+    np.testing.assert_array_equal(
+        res2["v"], np.array([10.0, -np.inf], np.float32))
+    assert (res2["w"] == 1).all()
+
+
+def test_topk_int64_bounds_order_exactly(engine, tmp_path):
+    """Regression: row-group visit order must compare int64 stat bounds
+    exactly — 2^53 and 2^53+1 are equal as floats, and a float-cast sort
+    could visit the smaller group first and eliminate the winner."""
+    import jax
+    import pyarrow as pa
+    lo = np.full(4, 2**53, np.int64)
+    hi = np.full(4, 2**53 + 1, np.int64)
+    # x64 ON: without it device arrays narrow to int32 and 2^53 cannot
+    # even be represented — the bound ordering under test is about
+    # full-width keys by construction
+    with jax.enable_x64(True):
+        for first, second in ((lo, hi), (hi, lo)):  # both physical orders
+            tbl = pa.table({"v": np.concatenate([first, second])})
+            path = _write(tmp_path, tbl, name="t53.parquet",
+                          row_group_size=4)
+            sc = ParquetScanner(path, engine)
+            res = sql_topk(sc, "v", k=4, descending=True)
+            assert (res["v"] == 2**53 + 1).all(), res["v"]
