@@ -251,6 +251,21 @@ def capture(device: str) -> bool:
              f"results={n} in {rec['elapsed_s']}s")
         return rec
 
+    # short windows + a long list: never-captured steps outrank
+    # re-captures, so every step eventually lands even if no single
+    # window fits the whole list
+    done = _captured_steps()
+    # producer/consumer pairing: a trace-capturing suite step only
+    # counts as done once its parse step has ALSO landed — otherwise a
+    # parse failure would demote the producer to the rerun tail and the
+    # (per-capture) trace dir would never exist again to parse
+    for producer, consumer in (("suite_7", "profile_d2048"),
+                               ("suite_7_d4096", "profile_d4096")):
+        if consumer not in done:
+            done.discard(producer)
+    steps = _coverage_order(steps, done,
+                            always=("bench", "stream_probe"))
+    _log("step order: " + " ".join(s[0] for s in steps))
     try:
         for name, cmd, timeout_s, env_extra in steps:
             rec = _do(name, cmd, timeout_s, env_extra)
@@ -295,6 +310,39 @@ def _looks_down(rec: dict) -> bool:
             or "cpu-fallback" in metrics
             or '"probe": "down"' in " ".join(
                 json.dumps(r) for r in rec.get("results", [])))
+
+
+def _captured_steps(ledger_path: str = None) -> set:
+    """Step names that already landed a successful on-silicon result in
+    the ledger (rc==0, non-empty results, a tpu device, and the step
+    didn't observe the tunnel dying under it)."""
+    done = set()
+    try:
+        with open(ledger_path or LEDGER) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if (rec.get("rc") == 0 and rec.get("results")
+                        and str(rec.get("device", "")).startswith("tpu")
+                        and not _looks_down(rec)):
+                    done.add(rec.get("step"))
+    except OSError:
+        pass
+    return done
+
+
+def _coverage_order(steps: list, done: set, always: tuple) -> list:
+    """Coverage-first scheduling: windows are short and the capture list
+    is long, so steps that have NEVER landed a tpu result run before
+    re-captures of ones that have — except the ``always`` prefix (the
+    headline bench + per-window probes are per-window quantities, not
+    one-time coverage).  Order is otherwise stable."""
+    head = [s for s in steps if s[0] in always]
+    fresh = [s for s in steps if s[0] not in always and s[0] not in done]
+    rerun = [s for s in steps if s[0] not in always and s[0] in done]
+    return head + fresh + rerun
 
 
 def _commit() -> None:

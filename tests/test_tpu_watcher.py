@@ -100,3 +100,36 @@ def test_run_step_timeout_preserves_streamed_results(tmp_path):
     rec = tw._run_step("s", [sys.executable, str(script)], timeout_s=15)
     assert rec["error"].startswith("timeout")
     assert [r["metric"] for r in rec["results"]] == ["a", "b"]
+
+
+def test_captured_steps_reads_only_real_successes(tmp_path):
+    """Only rc==0 + non-empty results + tpu device + no down-marker rows
+    count as captured — failures and cpu-fallback rows must re-run."""
+    lg = tmp_path / "ledger.jsonl"
+    rows = [
+        {"step": "suite_7", "rc": 0, "device": "tpu TPU v5 lite0",
+         "results": [{"metric": "config7:x", "value": 1}]},
+        {"step": "suite_6", "rc": -1, "device": "tpu TPU v5 lite0",
+         "results": [{"metric": "config6:x", "value": 1}]},
+        {"step": "suite_5", "rc": 0, "device": "tpu TPU v5 lite0",
+         "results": []},
+        {"step": "suite_12", "rc": 0, "device": "cpu",
+         "results": [{"metric": "y", "value": 1}]},
+        {"step": "suite_13", "rc": 0, "device": "tpu TPU v5 lite0",
+         "results": [{"metric": "z (dev=cpu-fallback-TUNNEL-DOWN)",
+                      "value": 1}]},
+    ]
+    lg.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    assert tw._captured_steps(str(lg)) == {"suite_7"}
+    assert tw._captured_steps(str(tmp_path / "missing.jsonl")) == set()
+
+
+def test_coverage_order_fresh_before_rerun():
+    """Never-captured steps outrank re-captures; the 'always' prefix
+    stays first; relative order is otherwise stable."""
+    steps = [(n, [], 1, None) for n in
+             ("bench", "stream_probe", "a", "b", "c", "d")]
+    out = tw._coverage_order(steps, done={"a", "c"},
+                             always=("bench", "stream_probe"))
+    assert [s[0] for s in out] == ["bench", "stream_probe",
+                                  "b", "d", "a", "c"]
