@@ -98,6 +98,20 @@ def probe() -> dict:
                 "probe_s": round(time.monotonic() - t0, 1)}
 
 
+def _harvest_json(text: str) -> list:
+    """Every parseable JSON line of ``text`` — the one harvest rule for
+    both the normal and the timeout-salvage paths."""
+    out = []
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return out
+
+
 def _run_step(name: str, cmd: list[str],
               timeout_s: int = CAPTURE_TIMEOUT_S,
               env_extra: dict | None = None) -> dict:
@@ -106,9 +120,13 @@ def _run_step(name: str, cmd: list[str],
     the tunnel can die mid-step and the other steps' results must land."""
     t0 = time.monotonic()
     rec: dict = {"step": name, "cmd": " ".join(cmd), "ts": _now()}
-    env = None
+    env = dict(os.environ)
+    # persistent compilation cache: tunnel-speed compiles are what blow
+    # step timeouts, and a killed step's FINISHED compiles are reusable —
+    # the next window's attempt picks them up instead of recompiling
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
     if env_extra:
-        env = dict(os.environ)
         env.update(env_extra)
         rec["env"] = env_extra
     try:
@@ -116,15 +134,7 @@ def _run_step(name: str, cmd: list[str],
                            timeout=timeout_s, cwd=REPO, env=env)
         rec["rc"] = r.returncode
         rec["stderr_tail"] = r.stderr.strip().splitlines()[-12:]
-        results = []
-        for line in r.stdout.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    results.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass
-        rec["results"] = results
+        rec["results"] = _harvest_json(r.stdout)
     except subprocess.TimeoutExpired as e:
         rec["rc"] = -1
         rec["error"] = f"timeout after {timeout_s}s"
@@ -135,14 +145,7 @@ def _run_step(name: str, cmd: list[str],
         # measurements already printed before the stall must land in
         # the ledger — the probes stream one JSON line per result for
         # exactly this failure mode
-        results = []
-        for line in out.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    results.append(json.loads(line))
-                except json.JSONDecodeError:
-                    pass
+        results = _harvest_json(out)
         if results:
             rec["results"] = results
     rec["elapsed_s"] = round(time.monotonic() - t0, 1)
@@ -258,10 +261,13 @@ def capture(device: str) -> bool:
     # producer/consumer pairing: a trace-capturing suite step only
     # counts as done once its parse step has ALSO landed — otherwise a
     # parse failure would demote the producer to the rerun tail and the
-    # (per-capture) trace dir would never exist again to parse
+    # (per-capture) trace dir would never exist again to parse.  Capped
+    # at 3 consumer attempts: a deterministically-failing parse must not
+    # pin its producer in the fresh tier forever, starving tail steps.
+    attempts = _attempt_counts()
     for producer, consumer in (("suite_7", "profile_d2048"),
                                ("suite_7_d4096", "profile_d4096")):
-        if consumer not in done:
+        if consumer not in done and attempts.get(consumer, 0) < 3:
             done.discard(producer)
     steps = _coverage_order(steps, done,
                             always=("bench", "stream_probe"))
@@ -331,6 +337,23 @@ def _captured_steps(ledger_path: str = None) -> set:
     except OSError:
         pass
     return done
+
+
+def _attempt_counts(ledger_path: str = None) -> dict:
+    """Ledger rows per step name — attempts, successful or not."""
+    counts: dict = {}
+    try:
+        with open(ledger_path or LEDGER) as f:
+            for line in f:
+                try:
+                    step = json.loads(line).get("step")
+                except json.JSONDecodeError:
+                    continue
+                if step:
+                    counts[step] = counts.get(step, 0) + 1
+    except OSError:
+        pass
+    return counts
 
 
 def _coverage_order(steps: list, done: set, always: tuple) -> list:

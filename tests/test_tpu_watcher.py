@@ -133,3 +133,16 @@ def test_coverage_order_fresh_before_rerun():
                              always=("bench", "stream_probe"))
     assert [s[0] for s in out] == ["bench", "stream_probe",
                                   "b", "d", "a", "c"]
+
+
+def test_attempt_counts_and_rescue_cap(tmp_path):
+    """_attempt_counts tallies every row per step; the producer rescue in
+    capture() is gated on < 3 consumer attempts (a deterministically
+    failing parse must not pin its producer fresh forever)."""
+    lg = tmp_path / "ledger.jsonl"
+    rows = [{"step": "profile_d2048", "rc": 1}] * 3 + \
+           [{"step": "suite_7", "rc": 0}]
+    lg.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    counts = tw._attempt_counts(str(lg))
+    assert counts == {"profile_d2048": 3, "suite_7": 1}
+    assert tw._attempt_counts(str(tmp_path / "nope.jsonl")) == {}
