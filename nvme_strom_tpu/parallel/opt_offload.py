@@ -34,10 +34,17 @@ file, is detected and refused at resume rather than silently diverging.
 Pair restores with the params checkpoint matching the manifest step
 (checkpoint/manager.py; train_lm enforces this).
 
-Single-host by design: every process would need its own shard file and
-a commit barrier; multi-process training raises loudly rather than
-corrupting a shared file (same stance as checkpoint save_async took in
-round 2 before its multi-host design existed).
+Multi-host: each process owns a PER-PROCESS moment file holding the
+moments of its locally-addressable parameter shards (unique shard
+indices only — replicated leaves store one copy per process, fanned
+back out on read).  The moment path needs no collectives: reads
+assemble global arrays with ``make_array_from_single_device_arrays``,
+writes serialize local shards, and each process commits its own
+manifest — the next train step's existing collective is the barrier,
+exactly the collective-free design checkpoint ``save_async`` uses.
+Cross-process consistency is enforced at resume: an allgather of
+(step, dirty) refuses a mix of steps or any dirty shard file on ANY
+process.
 """
 
 from __future__ import annotations
@@ -64,10 +71,40 @@ def _align_up(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
+def _piece_key(index, shape) -> tuple:
+    """A shard's index tuple normalized to ((start, stop), ...) ints —
+    the identity used to dedupe replicated shards and to match live
+    shards to manifest slots."""
+    return tuple((int(sl.start or 0),
+                  int(sl.stop) if sl.stop is not None else int(dim))
+                 for sl, dim in zip(index, shape))
+
+
+def _local_pieces(arr):
+    """Unique locally-addressable shards of ``arr``: a list of
+    {key, shape} in first-seen order over device-id-sorted shards, plus
+    the device→piece placement.  Replicated leaves collapse to one
+    stored piece fanned out to every holding device."""
+    shards = sorted(arr.addressable_shards, key=lambda sh: sh.device.id)
+    pieces: list = []
+    seen: dict = {}
+    placement: list = []            # (device, piece_number)
+    for sh in shards:
+        key = _piece_key(sh.index, arr.shape)
+        if key not in seen:
+            seen[key] = len(pieces)
+            pieces.append({"key": key,
+                           "shape": tuple(int(x) for x in sh.data.shape)})
+        placement.append((sh.device, seen[key]))
+    return pieces, placement
+
+
 class OffloadedAdam:
     """Adam(W) whose m/v moments live in an NVMe-backed file.
 
-    ``path`` is a directory holding ``moments.bin`` + ``moments.json``.
+    ``path`` is a directory holding ``moments.bin`` + ``moments.json``
+    (multi-process: ``moments-{proc:05d}.*`` per process — a shared dir
+    or per-host local NVMe both work).
     The layout derives from ``params`` (flat or nested pytree); an
     existing manifest that matches the layout resumes (``.step`` picks
     up where it left off), anything else is created zero-initialised.
@@ -90,12 +127,7 @@ class OffloadedAdam:
                  engine: Optional[StromEngine] = None,
                  config: Optional[EngineConfig] = None,
                  depth: int = 4):
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "OffloadedAdam is single-host: each process would need "
-                "its own moment shard file plus a cross-host commit "
-                "barrier for the manifest step; run it on process 0 of "
-                "a single-host mesh or keep moments in HBM")
+        self._multi = jax.process_count() > 1
         self.lr, self.b1, self.b2 = float(lr), float(b1), float(b2)
         self.eps, self.weight_decay = float(eps), float(weight_decay)
         self.moment_dtype = jnp.dtype(moment_dtype)
@@ -110,22 +142,45 @@ class OffloadedAdam:
         order = sorted(range(len(leaves)), key=lambda i: self._names[i])
         self._order = order
 
-        # ---- layout: per leaf, an aligned slot for m then one for v ----
+        # ---- layout: aligned m/v slots; single-process keeps the
+        # round-3 full-leaf format (and its on-disk manifests), multi-
+        # process stores one slot pair PER UNIQUE LOCAL SHARD ----
         self._layout: Dict[str, dict] = {}
         off = 0
         isz = self.moment_dtype.itemsize
         for i in order:
             name = self._names[i]
             arr = leaves[i][1]
-            nbytes = int(np.prod(arr.shape, dtype=np.int64)) * isz if \
-                arr.shape else isz
+            if not self._multi:
+                nbytes = int(np.prod(arr.shape, dtype=np.int64)) * isz \
+                    if arr.shape else isz
+                self._layout[name] = {
+                    "shape": tuple(int(s) for s in arr.shape),
+                    "nbytes": int(nbytes),
+                    "off_m": off,
+                    "off_v": off + _align_up(nbytes),
+                }
+                off += 2 * _align_up(nbytes)
+                continue
+            if not hasattr(arr, "addressable_shards"):
+                raise TypeError(
+                    f"multi-process OffloadedAdam needs jax.Array "
+                    f"params (leaf {name} is {type(arr).__name__}) — "
+                    "the moment shards follow the param sharding")
+            pieces, _ = _local_pieces(arr)
+            plist = []
+            for pc in pieces:
+                nbytes = (int(np.prod(pc["shape"], dtype=np.int64)) * isz
+                          if pc["shape"] else isz)
+                plist.append({"key": pc["key"], "shape": pc["shape"],
+                              "nbytes": int(nbytes),
+                              "off_m": off,
+                              "off_v": off + _align_up(nbytes)})
+                off += 2 * _align_up(nbytes)
             self._layout[name] = {
                 "shape": tuple(int(s) for s in arr.shape),
-                "nbytes": int(nbytes),
-                "off_m": off,
-                "off_v": off + _align_up(nbytes),
+                "pieces": plist,
             }
-            off += 2 * _align_up(nbytes)
         self._total_bytes = off
 
         # ---- groups: consecutive slots, ~group_bytes of HBM each ----
@@ -134,7 +189,11 @@ class OffloadedAdam:
         cur_b = 0
         for i in order:
             name = self._names[i]
-            b = 2 * self._layout[name]["nbytes"]
+            # partition on GLOBAL bytes: local shard sizes can differ
+            # across processes (uneven splits), and the groups define
+            # the jitted SPMD programs every process must run in
+            # lockstep — the metric must be process-invariant
+            b = 2 * self._global_leaf_bytes(name)
             if cur and cur_b + b > group_bytes:
                 self._groups.append(cur)
                 cur, cur_b = [], 0
@@ -144,13 +203,59 @@ class OffloadedAdam:
             self._groups.append(cur)
 
         os.makedirs(path, exist_ok=True)
-        self.data_path = os.path.join(path, "moments.bin")
-        self.manifest_path = os.path.join(path, "moments.json")
+        # per-process files: each host/process owns the moments of ITS
+        # param shards; a shared dir works (distinct names) and so does
+        # per-host local NVMe (same name, different disk)
+        suffix = f"-{jax.process_index():05d}" if self._multi else ""
+        self.data_path = os.path.join(path, f"moments{suffix}.bin")
+        self.manifest_path = os.path.join(path, f"moments{suffix}.json")
         self.step = 0
-        if not self._try_resume():
-            self._create_zeroed()
+        local_err = None
+        try:
+            # resume AND zero-create are both local-failure-prone (I/O,
+            # corrupt manifest); in multi-process mode ANY local failure
+            # must reach the allgather below rather than killing this
+            # process while the others block in it
+            if not self._try_resume():
+                self._create_zeroed()
+        except Exception as e:  # noqa: BLE001 — deferred to allgather
+            if not self._multi:
+                raise
+            local_err = f"{type(e).__name__}: {e}"
+        if self._multi:
+            from jax.experimental import multihost_utils
+            payload = np.array([self.step, 1 if local_err else 0],
+                               np.int64)
+            all_ = multihost_utils.process_allgather(payload)
+            if all_[:, 1].any():
+                raise ValueError(
+                    local_err or "another process refused to resume "
+                    "its moment shard file (dirty or layout mismatch) — "
+                    "all processes must restore from matching state")
+            if (all_[:, 0] != all_[0, 0]).any():
+                raise ValueError(
+                    f"moment shard files disagree on the optimizer "
+                    f"step across processes ({sorted(set(all_[:, 0].tolist()))}) "
+                    "— a previous run crashed between per-process "
+                    "commits; restore params from the matching "
+                    "checkpoint into fresh moment dirs")
         self._fh = self.engine.open(self.data_path, writable=True)
         self._update_fns: Dict[int, object] = {}
+
+    def _leaf_bytes(self, name: str) -> int:
+        """LOCAL stored bytes of one moment tensor (sum of this
+        process's unique shards)."""
+        d = self._layout[name]
+        if "pieces" in d:
+            return sum(p["nbytes"] for p in d["pieces"])
+        return d["nbytes"]
+
+    def _global_leaf_bytes(self, name: str) -> int:
+        """GLOBAL bytes of one moment tensor — process-invariant, the
+        group-partitioning metric."""
+        d = self._layout[name]
+        n = int(np.prod(d["shape"], dtype=np.int64)) if d["shape"] else 1
+        return n * self.moment_dtype.itemsize
 
     # ------------------------------------------------------------------
     def _manifest(self, dirty: bool = False) -> dict:
@@ -161,9 +266,7 @@ class OffloadedAdam:
             "dtype": self.moment_dtype.name,
             "align": _ALIGN,
             "total_bytes": self._total_bytes,
-            "leaves": {n: {k: (list(v) if isinstance(v, tuple) else v)
-                           for k, v in self._layout[n].items()}
-                       for n in self._layout},
+            "leaves": json.loads(json.dumps(self._layout)),
         }
 
     def _try_resume(self) -> bool:
@@ -173,10 +276,8 @@ class OffloadedAdam:
         except (OSError, json.JSONDecodeError):
             return False
         ours = self._manifest()
-        theirs_layout = {n: {k: (tuple(v) if isinstance(v, list) else v)
-                             for k, v in d.items()}
-                         for n, d in m.get("leaves", {}).items()}
-        ours_layout = {n: dict(d) for n, d in self._layout.items()}
+        theirs_layout = m.get("leaves", {})
+        ours_layout = ours["leaves"]    # _manifest already normalized
         if (m.get("version") != _MANIFEST_VERSION
                 or m.get("dtype") != ours["dtype"]
                 or theirs_layout != ours_layout):
@@ -222,23 +323,37 @@ class OffloadedAdam:
         os.replace(tmp, self.manifest_path)
 
     # ------------------------------------------------------------------
+    def _slots(self, name):
+        """(off_m, off_v, nbytes, shape) per stored slot pair of a leaf —
+        one pair for the whole leaf single-process, one per unique local
+        shard multi-process."""
+        d = self._layout[name]
+        if "pieces" in d:
+            return [(pc["off_m"], pc["off_v"], pc["nbytes"], pc["shape"])
+                    for pc in d["pieces"]]
+        return [(d["off_m"], d["off_v"], d["nbytes"], d["shape"])]
+
     def _group_ranges(self, names) -> tuple[list, list]:
         """Chunk-split (offset, length) ranges covering each slot of the
-        group, plus per-leaf chunk counts for device-side reassembly."""
+        group, plus per-slot chunk counts for device-side reassembly."""
         chunk = self.engine.config.chunk_bytes
         ranges: list[tuple[int, int]] = []
-        counts: list[int] = []          # chunks per slot, m then v per leaf
+        counts: list[int] = []      # chunks per slot, m then v, slot order
         for n in names:
-            d = self._layout[n]
-            for off in (d["off_m"], d["off_v"]):
-                flat, cnt = split_ranges([(off, d["nbytes"])], chunk)
-                ranges.extend(flat)
-                counts.append(cnt[0])
+            for off_m, off_v, nbytes, _ in self._slots(n):
+                for off in (off_m, off_v):
+                    flat, cnt = split_ranges([(off, nbytes)], chunk)
+                    ranges.extend(flat)
+                    counts.append(cnt[0])
         return ranges, counts
 
-    def _read_group(self, names, shardings):
+    def _read_group(self, names, ps):
         """Moment slots NVMe → device arrays, chunk-pipelined; chunks
-        assemble on device (jnp.concatenate), never in a host buffer."""
+        assemble on device (jnp.concatenate), never in a host buffer.
+        Multi-process: each stored piece is fanned out to every local
+        device holding that shard index and the global moment array is
+        built with ``make_array_from_single_device_arrays`` — no
+        collectives on the moment path."""
         ranges, counts = self._group_ranges(names)
         chunks = list(self.stream.stream_ranges(self._fh, ranges))
         ms, vs = [], []
@@ -246,22 +361,75 @@ class OffloadedAdam:
         ci = iter(counts)
         for j, n in enumerate(names):
             d = self._layout[n]
-            for out in (ms, vs):
-                parts = [next(it) for _ in range(next(ci))]
-                flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-                arr = flat.view(self.moment_dtype).reshape(d["shape"])
-                if shardings[j] is not None:
-                    arr = jax.device_put(arr, shardings[j])
-                out.append(arr)
+            slot_arrays = []        # per slot: (m_piece, v_piece)
+            for _, _, _, shape in self._slots(n):
+                pair = []
+                for _mv in range(2):
+                    parts = [next(it) for _ in range(next(ci))]
+                    flat = parts[0] if len(parts) == 1 \
+                        else jnp.concatenate(parts)
+                    pair.append(flat.view(self.moment_dtype)
+                                .reshape(shape))
+                slot_arrays.append(pair)
+            if "pieces" not in d:
+                m, v = slot_arrays[0]
+                sh = getattr(ps[j], "sharding", None)
+                if sh is not None:
+                    m = jax.device_put(m, sh)
+                    v = jax.device_put(v, sh)
+                ms.append(m)
+                vs.append(v)
+                continue
+            pieces, placement = _local_pieces(ps[j])
+            want = [tuple(pc["key"]) for pc in d["pieces"]]
+            have = [pc["key"] for pc in pieces]
+            if have != want:
+                raise ValueError(
+                    f"leaf {n}: live sharding's local shards {have} do "
+                    f"not match the moment file layout {want} — the "
+                    "params' sharding changed since this optimizer was "
+                    "built")
+            m_dev = [jax.device_put(slot_arrays[pno][0], dev)
+                     for dev, pno in placement]
+            v_dev = [jax.device_put(slot_arrays[pno][1], dev)
+                     for dev, pno in placement]
+            gshape = d["shape"]
+            ms.append(jax.make_array_from_single_device_arrays(
+                gshape, ps[j].sharding, m_dev))
+            vs.append(jax.make_array_from_single_device_arrays(
+                gshape, ps[j].sharding, v_dev))
         return ms, vs
 
-    def _write_group(self, names, ms, vs, pend) -> None:
-        for n, m, v in zip(names, ms, vs):
+    def _write_group(self, names, ms, vs, ps, pend) -> None:
+        for n, m, v, pref in zip(names, ms, vs, ps):
             d = self._layout[n]
-            for off, arr in ((d["off_m"], m), (d["off_v"], v)):
-                host = np.asarray(arr).view(np.uint8).reshape(-1)
-                submit_chunked_writes(self.engine, self._fh, off, host,
-                                      pend)
+            if "pieces" not in d:
+                for off, arr in ((d["off_m"], m), (d["off_v"], v)):
+                    host = np.asarray(arr).view(np.uint8).reshape(-1)
+                    submit_chunked_writes(self.engine, self._fh, off,
+                                          host, pend)
+                continue
+            # the update's outs are unpinned; land them on the params'
+            # sharding so the local shard structure matches the slots
+            sh = pref.sharding
+            if m.sharding != sh:
+                m = jax.device_put(m, sh)
+            if v.sharding != sh:
+                v = jax.device_put(v, sh)
+            for arr, which in ((m, "off_m"), (v, "off_v")):
+                by_key = {}
+                for shd in arr.addressable_shards:
+                    by_key.setdefault(_piece_key(shd.index, arr.shape),
+                                      shd)
+                for pc in d["pieces"]:
+                    shd = by_key.get(tuple(pc["key"]))
+                    if shd is None:
+                        raise ValueError(
+                            f"leaf {n}: updated moment lost local shard "
+                            f"{pc['key']} — sharding drifted mid-step")
+                    host = np.asarray(shd.data).view(np.uint8).reshape(-1)
+                    submit_chunked_writes(self.engine, self._fh,
+                                          pc[which], host, pend)
 
     def _update_fn(self, gi: int):
         """Per-group jitted Adam update; moment buffers are donated."""
@@ -314,7 +482,7 @@ class OffloadedAdam:
                 ps = [p_named[n] for n in names]
                 gs = [g_named[n] for n in names]
                 sh = [getattr(p, "sharding", None) for p in ps]
-                ms, vs = self._read_group(names, sh)
+                ms, vs = self._read_group(names, ps)
                 out_p, out_m, out_v = self._update_fn(gi)(
                     ps, gs, ms, vs, t, lr)
                 # out_shardings are unpinned (m/v leave for NVMe anyway),
@@ -325,7 +493,7 @@ class OffloadedAdam:
                          for x, s in zip(out_p, sh)]
                 # writes of this group overlap the next group's reads:
                 # submit now, drain at the end of the step
-                self._write_group(names, out_m, out_v, pend)
+                self._write_group(names, out_m, out_v, ps, pend)
                 for n, p in zip(names, out_p):
                     new_named[n] = p
             # success drain MUST raise: a failed moment write that got
@@ -357,7 +525,7 @@ class OffloadedAdam:
 
     def peak_group_bytes(self) -> int:
         """Worst-case HBM the moments occupy during a step."""
-        return max(sum(2 * self._layout[n]["nbytes"] for n in g)
+        return max(sum(2 * self._leaf_bytes(n) for n in g)
                    for g in self._groups)
 
     def close(self) -> None:
