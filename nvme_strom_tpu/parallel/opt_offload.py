@@ -58,6 +58,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from nvme_strom_tpu.checkpoint.manager import _norm_index
 from nvme_strom_tpu.io.engine import StromEngine
 from nvme_strom_tpu.ops.bridge import (
     DeviceStream, split_ranges, submit_chunked_writes)
@@ -72,12 +73,12 @@ def _align_up(n: int) -> int:
 
 
 def _piece_key(index, shape) -> tuple:
-    """A shard's index tuple normalized to ((start, stop), ...) ints —
-    the identity used to dedupe replicated shards and to match live
-    shards to manifest slots."""
-    return tuple((int(sl.start or 0),
-                  int(sl.stop) if sl.stop is not None else int(dim))
-                 for sl, dim in zip(index, shape))
+    """A shard's index normalized to ((start, stop), ...) bounds — the
+    identity that dedupes replicated shards and matches live shards to
+    manifest slots.  Same normalization the checkpoint tile index uses
+    (checkpoint/manager._norm_index), so moment shards and checkpoint
+    tiles can never disagree on shard identity."""
+    return _norm_index(index, shape)
 
 
 def _local_pieces(arr):
@@ -135,6 +136,17 @@ class OffloadedAdam:
         self.engine = engine or StromEngine(config or EngineConfig())
         self.stream = DeviceStream(self.engine, depth=depth, drain="ready")
 
+        try:
+            self._init_state(path, params, group_bytes)
+        except BaseException:
+            # refusal paths (dirty/layout/step-mismatch) and I/O errors
+            # must not leak the engine we just created: its IO threads
+            # and fds outlive the exception otherwise
+            if self._own_engine:
+                self.engine.close_all()
+            raise
+
+    def _init_state(self, path, params, group_bytes: int) -> None:
         leaves, self._treedef = jax.tree_util.tree_flatten_with_path(params)
         self._names = [jax.tree_util.keystr(kp) for kp, _ in leaves]
         if len(set(self._names)) != len(self._names):
@@ -167,13 +179,17 @@ class OffloadedAdam:
                     f"multi-process OffloadedAdam needs jax.Array "
                     f"params (leaf {name} is {type(arr).__name__}) — "
                     "the moment shards follow the param sharding")
-            pieces, _ = _local_pieces(arr)
+            pieces, placement = _local_pieces(arr)
+            fanout = [0] * len(pieces)      # local devices per piece
+            for _dev, pno in placement:
+                fanout[pno] += 1
             plist = []
-            for pc in pieces:
+            for pno, pc in enumerate(pieces):
                 nbytes = (int(np.prod(pc["shape"], dtype=np.int64)) * isz
                           if pc["shape"] else isz)
                 plist.append({"key": pc["key"], "shape": pc["shape"],
                               "nbytes": int(nbytes),
+                              "fanout": fanout[pno],
                               "off_m": off,
                               "off_v": off + _align_up(nbytes)})
                 off += 2 * _align_up(nbytes)
@@ -248,6 +264,16 @@ class OffloadedAdam:
         d = self._layout[name]
         if "pieces" in d:
             return sum(p["nbytes"] for p in d["pieces"])
+        return d["nbytes"]
+
+    def _leaf_hbm_bytes(self, name: str) -> int:
+        """LOCAL HBM one moment tensor occupies during its group's
+        update: replicated pieces are fanned out to every holding
+        device, so they count once per device, not once per slot."""
+        d = self._layout[name]
+        if "pieces" in d:
+            return sum(p["nbytes"] * p.get("fanout", 1)
+                       for p in d["pieces"])
         return d["nbytes"]
 
     def _global_leaf_bytes(self, name: str) -> int:
@@ -525,7 +551,7 @@ class OffloadedAdam:
 
     def peak_group_bytes(self) -> int:
         """Worst-case HBM the moments occupy during a step."""
-        return max(sum(2 * self._leaf_bytes(n) for n in g)
+        return max(sum(2 * self._leaf_hbm_bytes(n) for n in g)
                    for g in self._groups)
 
     def close(self) -> None:
