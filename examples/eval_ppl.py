@@ -35,6 +35,10 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--batches", type=int, default=0,
                     help="cap on evaluated batches (0 = everything)")
+    ap.add_argument("--int4", action="store_true",
+                    help="weight-only int4 (lm_head stays fp; combine "
+                         "with --int8 for the int8-lm_head mixed "
+                         "recipe); the ppl delta vs fp is the cost")
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 quantization after load "
                          "(models/quant.py) - also measures the "
@@ -64,8 +68,16 @@ def main(argv=None) -> int:
         engine=engine)
     if args.int8:
         from nvme_strom_tpu.models.quant import quantize_weights_int8
-        params = quantize_weights_int8(params)
+        # with --int4 too: int8 ONLY the lm_head (the mixed recipe) —
+        # int4 then converts the rest and passes dict leaves through
+        sfx = ("lm_head",) if args.int4 else None
+        params = quantize_weights_int8(params, suffixes=sfx)
         print("int8: matmul weights quantized "
+              "(ppl delta vs fp measures the cost)", flush=True)
+    if args.int4:
+        from nvme_strom_tpu.models.quant import quantize_weights_int4
+        params = quantize_weights_int4(params)
+        print("int4: matmul weights packed 2/byte "
               "(ppl delta vs fp measures the cost)", flush=True)
 
     @jax.jit

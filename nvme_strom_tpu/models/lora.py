@@ -48,9 +48,15 @@ def lora_init(rng: jax.Array, base_params: Dict, rank: int,
         raise ValueError(f"rank must be >= 1, got {rank}")
 
     def shape_of(w):
-        # int8-quantized leaves (models/quant.py) adapt like any other
-        # matmul: the adapter sees only the weight's shape
-        return w["q8"].shape if isinstance(w, dict) else w.shape
+        # quantized leaves (models/quant.py) adapt like any other
+        # matmul: the adapter sees only the LOGICAL weight shape —
+        # int4's q4 packs two input rows per byte, so d_in doubles back
+        if isinstance(w, dict):
+            if "q8" in w:
+                return w["q8"].shape
+            q4 = w["q4"]
+            return (*q4.shape[:-2], 2 * q4.shape[-2], q4.shape[-1])
+        return w.shape
 
     out: Dict[str, Tuple[jax.Array, jax.Array]] = {}
     names = [n for n in sorted(base_params)

@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for inference.
+"""Weight-only int8 / int4 quantization for inference.
 
 Single-sequence decode is WEIGHT-STREAMING bound: every generated token
 reads every matmul weight from HBM once, so halving the weight bytes is
@@ -13,6 +13,14 @@ fuses into the consuming matmul, so the HBM traffic is the int8 bytes.
 Every inference surface (generate, serving, paged, speculative,
 kv_offload) flows through ``wmat`` and serves quantized params with the
 same compiled-program shapes.
+
+int4 (``quantize_weights_int4``) halves the bytes again: group-wise
+absmax along the input dim (default 128 rows per scale group — the
+standard quality/size point for 4-bit) with two values packed per byte,
+stored as ``{"q4": uint8 (..., d_in/2, d_out), "scale4": f32
+(..., n_groups, 1, d_out)}``.  The nibble unpack is two shifts and a
+mask on the VPU (same move as the Parquet dictionary bit-unpack,
+ops/bitunpack.py) and fuses into the consuming matmul's operand read.
 
 Scope: matmul weights only.  ``tok_embed`` stays fp (it is GATHERED,
 not matmul'd — dequantizing the whole table per step would defeat the
@@ -50,6 +58,60 @@ def _quantize_one(w):
     return {"q8": q8, "scale": scale.astype(jnp.float32)}
 
 
+def _quantize_one_int4(w, group: int):
+    """→ {"q4", "scale4"} or None when the leaf can't pack (odd d_in)."""
+    din = int(w.shape[-2])
+    if din % 2:
+        return None
+    # honor the requested grouping as closely as the dim allows: the
+    # largest EVEN divisor of d_in that is <= group (never a silent
+    # whole-column collapse unless d_in truly has no smaller even
+    # divisor — d_in=2p for prime p)
+    g = group if (din % group == 0 and group % 2 == 0) else next(
+        (c for c in range(min(group, din), 1, -1)
+         if din % c == 0 and c % 2 == 0), din)
+    lead = w.shape[:-2]
+    dout = int(w.shape[-1])
+    wf = w.astype(jnp.float32).reshape(*lead, din // g, g, dout)
+    scale = jnp.max(jnp.abs(wf), axis=-2, keepdims=True) / 7.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(wf / scale), -7, 7).astype(jnp.int8)
+    qu = (q + 8).astype(jnp.uint8).reshape(*lead, din, dout)
+    packed = qu[..., 0::2, :] | (qu[..., 1::2, :] << 4)
+    return {"q4": packed, "scale4": scale.astype(jnp.float32)}
+
+
+#: int4 defaults EXCLUDE the lm_head: the output projection decides
+#: token ranks directly and is the layer 4-bit noise hurts most (the
+#: same reason llama.cpp's Q4 presets keep output.weight at higher
+#: precision).  Recipe: int8 the lm_head, int4 the rest — wmat serves
+#: mixed trees leaf by leaf.  Pass suffixes explicitly to override.
+DEFAULT_SUFFIXES_INT4 = tuple(sfx for sfx in DEFAULT_SUFFIXES
+                              if sfx != "lm_head")
+
+
+def quantize_weights_int4(params: Dict,
+                          suffixes: Optional[Sequence[str]] = None,
+                          group: int = 128) -> Dict:
+    """{name: array} params → selected weights as packed int4 leaves
+    (two values per byte + group-wise scales).  Leaves whose input dim
+    can't pack (odd) stay full-precision; already-quantized leaves pass
+    through."""
+    suffixes = tuple(suffixes if suffixes is not None
+                     else DEFAULT_SUFFIXES_INT4)
+    out = {}
+    for name, w in params.items():
+        leafname = name.rsplit(".", 1)[-1]
+        if (isinstance(w, dict) or leafname not in suffixes
+                or getattr(w, "ndim", 0) < 2):
+            out[name] = w
+            continue
+        q = jax.jit(_quantize_one_int4,
+                    static_argnames=("group",))(w, group=group)
+        out[name] = w if q is None else q
+    return out
+
+
 def quantize_weights_int8(params: Dict,
                           suffixes: Optional[Sequence[str]] = None
                           ) -> Dict:
@@ -75,7 +137,12 @@ def quantized_nbytes(params: Dict) -> tuple:
     reference dtype of their scale) — the memory claim, measurable."""
     q = fp = 0
     for w in params.values():
-        if isinstance(w, dict):
+        if not isinstance(w, dict):
+            continue
+        if "q8" in w:
             q += int(w["q8"].nbytes + w["scale"].nbytes)
             fp += int(w["q8"].size * 4)
+        else:
+            q += int(w["q4"].nbytes + w["scale4"].nbytes)
+            fp += int(w["q4"].size * 2 * 4)   # two values per byte
     return q, fp

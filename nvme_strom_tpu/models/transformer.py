@@ -199,19 +199,39 @@ def _rope(q, k, theta, positions=None, scaling=None):
 
 
 def wmat(p: Dict, name: str, dtype):
-    """Matmul weight by name, transparently dequantizing int8
+    """Matmul weight by name, transparently dequantizing quantized
     weight-only leaves.
 
-    A quantized leaf is ``{"q8": int8 (..., d_out), "scale": f32
-    (..., 1, d_out)}`` (models/quant.py) — the dequant multiply is elementwise
-    on the weight and XLA fuses it into the consuming matmul, so the
-    HBM read is the int8 bytes: half of bf16, the lever for
+    Two leaf kinds (models/quant.py): int8 ``{"q8": int8 (..., d_in,
+    d_out), "scale": f32 (..., 1, d_out)}`` and packed int4 ``{"q4":
+    uint8 (..., d_in/2, d_out), "scale4": f32 (..., n_groups, 1,
+    d_out)}`` (two values per byte along d_in, group-wise scales).
+    Dequant is elementwise on the weight and XLA fuses it into the
+    consuming matmul, so the HBM read is the quantized bytes: half
+    (int8) or a quarter (int4) of bf16 — the lever for
     weight-streaming-bound decode.  Plain array leaves pass through, so
     every model path serves quantized and full-precision params with
-    the same code."""
+    the same code.  New consumers that need the logical weight shape
+    must handle BOTH leaf kinds (see lora.shape_of)."""
     w = p[name]
     if isinstance(w, dict):
-        return w["q8"].astype(dtype) * w["scale"].astype(dtype)
+        if "q8" in w:
+            return w["q8"].astype(dtype) * w["scale"].astype(dtype)
+        # int4: two values per byte along d_in; nibble unpack is two
+        # shifts + a mask on the VPU, then the group-wise scale multiply
+        # — all fused into the consuming matmul's operand read
+        pk = w["q4"]
+        sc = w["scale4"]
+        lead = pk.shape[:-2]
+        dhalf, dout = pk.shape[-2], pk.shape[-1]
+        lo = (pk & jnp.uint8(0xF)).astype(jnp.int8) - 8
+        hi = (pk >> jnp.uint8(4)).astype(jnp.int8) - 8
+        q = jnp.stack([lo, hi], axis=-2).reshape(*lead, 2 * dhalf, dout)
+        ngroup = sc.shape[-3]
+        g = (2 * dhalf) // ngroup
+        wf = (q.astype(dtype).reshape(*lead, ngroup, g, dout)
+              * sc.astype(dtype))
+        return wf.reshape(*lead, 2 * dhalf, dout)
     return w.astype(dtype)
 
 

@@ -91,7 +91,7 @@ def shard_params(params: Dict, cfg: TransformerConfig, mesh) -> Dict:
             # as jit's 'incompatible devices', far from the typo
             raise KeyError(f"no sharding spec for param {name!r}")
         s = sh[name]
-        if isinstance(w, dict):
+        if isinstance(w, dict) and "q8" in w:
             spec = tuple(s.spec)
             # pad the spec to the q8 rank, then scale's rank matches
             spec = spec + (None,) * (w["q8"].ndim - len(spec))
@@ -101,6 +101,24 @@ def shard_params(params: Dict, cfg: TransformerConfig, mesh) -> Dict:
                 "scale": jax.device_put(
                     w["scale"],
                     NamedSharding(mesh, P(*spec[:-2], None, spec[-1]))),
+            }
+        elif isinstance(w, dict):
+            # int4: q4 is (..., d_in/2, d_out) — the weight's own spec
+            # applies (the packed axis halves the dim, the axis name
+            # still shards it); scale4 has an extra group dim that
+            # shards like d_in, with the within-group axis unsharded
+            spec = tuple(s.spec)
+            spec = spec + (None,) * (w["q4"].ndim - len(spec))
+            out[name] = {
+                "q4": jax.device_put(w["q4"],
+                                     NamedSharding(mesh, P(*spec))),
+                # group dim replicated: n_groups is typically far
+                # smaller than the mesh axis (tiny tensor anyway);
+                # only the d_out axis shards with the weight
+                "scale4": jax.device_put(
+                    w["scale4"],
+                    NamedSharding(mesh, P(*[None] * (w["scale4"].ndim
+                                                     - 1), spec[-1]))),
             }
         else:
             out[name] = jax.device_put(w, s)

@@ -180,6 +180,18 @@ def test_eval_ppl_cli(tmp_path):
     ppl = float(r.stdout.split("perplexity:")[1].split()[0])
     # untrained model ≈ uniform over vocab
     assert 0.5 * cfg.vocab < ppl < 4 * cfg.vocab
+    # the mixed quant recipe (--int8 --int4: int8 lm_head, int4 rest)
+    # runs the same eval and stays in the uniform band
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "eval_ppl.py"),
+         "--weights", str(wdir), "--npy", str(tmp_path / "ev.npy"),
+         "--batch", "4", "--int8", "--int4"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=str(REPO))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "int4: matmul weights packed" in r.stdout
+    ppl4 = float(r.stdout.split("perplexity:")[1].split()[0])
+    assert 0.5 * cfg.vocab < ppl4 < 4 * cfg.vocab
 
 
 def test_sql_query_example_runs():

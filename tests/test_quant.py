@@ -106,3 +106,127 @@ def test_quantized_params_shard_over_tp(mesh8):
     got = np.asarray(jax.jit(
         lambda p, t: forward(p, t, cfg))(sharded, toks))
     np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_int4_pack_roundtrip_exact():
+    """Packing is lossless over the quantized integers: unpack(pack(q))
+    == q for every nibble value, groups included."""
+    from nvme_strom_tpu.models.quant import _quantize_one_int4
+    from nvme_strom_tpu.models.transformer import wmat
+    w = jax.random.normal(jax.random.key(0), (8, 6), jnp.float32)
+    leaf = jax.jit(_quantize_one_int4,
+                   static_argnames=("group",))(w, group=4)
+    assert leaf["q4"].shape == (4, 6) and leaf["q4"].dtype == jnp.uint8
+    assert leaf["scale4"].shape == (2, 1, 6)
+    deq = wmat({"w": leaf}, "w", jnp.float32)
+    # manual reference: group absmax/7 scales, round, clamp
+    wf = np.asarray(w, np.float64).reshape(2, 4, 6)
+    sc = np.maximum(np.abs(wf).max(axis=1, keepdims=True) / 7, 1e-12)
+    q = np.clip(np.round(wf / sc), -7, 7)
+    np.testing.assert_allclose(np.asarray(deq),
+                               (q * sc).reshape(8, 6), rtol=1e-6)
+
+
+def test_int4_logits_close_and_memory_smaller(setup):
+    from nvme_strom_tpu.models.quant import (quantize_weights_int4,
+                                             quantized_nbytes)
+    cfg, params = setup
+    qp = quantize_weights_int4(params, group=32)
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    lf = forward(params, toks, cfg)
+    lq = forward(qp, toks, cfg)
+    rel = float(jnp.max(jnp.abs(lf - lq))
+                / (jnp.max(jnp.abs(lf)) + 1e-9))
+    # max-abs-relative over every logit of a RANDOM-INIT tiny model is
+    # the worst case for 4-bit (no outlier structure to exploit); the
+    # bound is a regression rail, the quality claim is eval_ppl --int4
+    assert rel < 0.25, rel
+    q, fp = quantized_nbytes(qp)
+    assert q * 6 < fp               # ~7x smaller than fp32
+    # int4 defaults keep the lm_head full-precision (rank-deciding
+    # layer; quantize it to int8 explicitly for the mixed recipe)
+    assert not isinstance(qp["lm_head"], dict)
+    assert isinstance(qp["layers.0.wq"], dict)
+    assert qp["layers.0.wq"]["q4"].dtype == jnp.uint8
+    assert not isinstance(qp["tok_embed"], dict)
+
+
+def test_int4_decode_and_serving(setup):
+    """generate() and the server both run on int4 params and agree."""
+    from nvme_strom_tpu.models.quant import quantize_weights_int4
+    from nvme_strom_tpu.models.serving import DecodeServer
+    cfg, params = setup
+    qp = quantize_weights_int4(params, group=32)
+    prompt = [5, 6, 7]
+    gen = np.asarray(dec.generate(
+        qp, jnp.asarray([prompt], jnp.int32), cfg, 8))[0].tolist()
+    srv = DecodeServer(qp, cfg, max_batch=2, max_len=64)
+    srv.submit("r", prompt, max_new=8)
+    assert srv.run()["r"] == gen
+
+
+def test_int4_moe_and_mixed_with_int8():
+    """Per-expert 3-D weights pack along their input dim; int8 and int4
+    leaves coexist in one tree (wmat dispatches per leaf)."""
+    from nvme_strom_tpu.models.quant import (quantize_weights_int4,
+                                             quantize_weights_int8)
+    cfg = TransformerConfig(**{**tiny_moe_config().__dict__,
+                               "dtype": jnp.float32})
+    params = init_params(jax.random.key(3), cfg)
+    qp = quantize_weights_int8(params, suffixes=("lm_head",))
+    qp = quantize_weights_int4(qp, group=32)   # rest → int4
+    assert "q8" in qp["lm_head"]
+    assert "q4" in qp["layers.1.moe_w_up"]
+    toks = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    lf = forward(params, toks, cfg)
+    lq = forward(qp, toks, cfg)
+    rel = float(jnp.max(jnp.abs(lf - lq))
+                / (jnp.max(jnp.abs(lf)) + 1e-9))
+    # random-init tiny model: 4-bit noise on every mlp/attn weight;
+    # the bound is a sanity rail, not a quality claim
+    assert rel < 0.25, rel
+
+
+def test_int4_params_shard_over_tp(mesh8):
+    from nvme_strom_tpu.models.quant import quantize_weights_int4
+    from nvme_strom_tpu.parallel.shardings import shard_params
+
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    params = init_params(jax.random.key(0), cfg)
+    qp = quantize_weights_int4(params, group=32)
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    want = np.asarray(forward(qp, toks, cfg))
+    sharded = shard_params(qp, cfg, mesh8)
+    assert sharded["layers.0.wq"]["q4"].sharding.spec[-1] == "tp"
+    assert sharded["layers.0.wq"]["scale4"].sharding.spec[-1] == "tp"
+    got = np.asarray(jax.jit(
+        lambda p, t: forward(p, t, cfg))(sharded, toks))
+    np.testing.assert_allclose(got, want, atol=2e-5)
+
+
+def test_int4_base_lora_init(setup):
+    """QLoRA over an int4 base: adapters get the LOGICAL weight shape
+    (q4 packs two input rows per byte) and the freshly-initialized
+    adapter (B=0) leaves the model exactly equal to the base."""
+    from nvme_strom_tpu.models.lora import lora_init, merge_lora
+    from nvme_strom_tpu.models.quant import quantize_weights_int4
+    cfg, params = setup
+    qp = quantize_weights_int4(params, group=32)
+    ad = lora_init(jax.random.key(2), qp, rank=4)
+    some = next(n for n in ad if n.endswith("wq"))
+    a, b = ad[some]
+    # logical d_in comes from the ORIGINAL weight, not the packed q4
+    assert a.shape == (params[some].shape[0], 4)
+    assert b.shape == (4, params[some].shape[1])
+    toks = jax.random.randint(jax.random.key(1), (2, 8), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    base = forward(qp, toks, cfg)
+    adapted = forward(merge_lora(qp, ad), toks, cfg)
+    # merge_lora keeps quantized-base merges in bfloat16 (by design —
+    # the merged copy is transient); t=0 equality is up to bf16 rounding
+    np.testing.assert_allclose(np.asarray(base), np.asarray(adapted),
+                               atol=3e-2, rtol=3e-2)
