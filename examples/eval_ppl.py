@@ -72,7 +72,8 @@ def main(argv=None) -> int:
         # int4 then converts the rest and passes dict leaves through
         sfx = ("lm_head",) if args.int4 else None
         params = quantize_weights_int8(params, suffixes=sfx)
-        print("int8: matmul weights quantized "
+        what = "lm_head (mixed recipe)" if args.int4 else "matmul weights"
+        print(f"int8: {what} quantized "
               "(ppl delta vs fp measures the cost)", flush=True)
     if args.int4:
         from nvme_strom_tpu.models.quant import quantize_weights_int4

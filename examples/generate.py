@@ -53,6 +53,9 @@ def main(argv=None) -> int:
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 quantization after load "
                          "(halved weight streaming; models/quant.py)")
+    ap.add_argument("--int4", action="store_true",
+                    help="packed int4 (with --int8: the mixed recipe — "
+                         "int8 lm_head, int4 everything else)")
     ap.add_argument("--offload", default=None, metavar="PAGEFILE",
                     help="decode with the SSD-backed KV cache spilling "
                          "pages to this path (greedy only; HBM holds a "
@@ -120,10 +123,21 @@ def main(argv=None) -> int:
     if args.int8:
         from nvme_strom_tpu.models.quant import (quantize_weights_int8,
                                                  quantized_nbytes)
-        params = quantize_weights_int8(params)
+        sfx = ("lm_head",) if args.int4 else None
+        params = quantize_weights_int8(params, suffixes=sfx)
         q, fp = quantized_nbytes(params)
-        print(f"int8: matmul weights {q >> 20} MiB "
+        what = "lm_head only (mixed recipe)" if args.int4 \
+            else "matmul weights"
+        print(f"int8: {what} {q >> 20} MiB "
               f"(vs {fp >> 20} MiB fp32)", flush=True)
+    if args.int4:
+        from nvme_strom_tpu.models.quant import (quantize_weights_int4,
+                                                 quantized_nbytes)
+        params = quantize_weights_int4(params)
+        q, fp = quantized_nbytes(params)
+        print(f"int4: all quantized leaves now {q >> 20} MiB "
+              f"(vs {fp >> 20} MiB fp32; incl. any int8 lm_head)",
+              flush=True)
 
     prompt = jnp.asarray([prompt_ids], jnp.int32)
     rng = jax.random.key(args.seed)

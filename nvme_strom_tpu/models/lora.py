@@ -47,16 +47,9 @@ def lora_init(rng: jax.Array, base_params: Dict, rank: int,
     if rank < 1:
         raise ValueError(f"rank must be >= 1, got {rank}")
 
-    def shape_of(w):
-        # quantized leaves (models/quant.py) adapt like any other
-        # matmul: the adapter sees only the LOGICAL weight shape —
-        # int4's q4 packs two input rows per byte, so d_in doubles back
-        if isinstance(w, dict):
-            if "q8" in w:
-                return w["q8"].shape
-            q4 = w["q4"]
-            return (*q4.shape[:-2], 2 * q4.shape[-2], q4.shape[-1])
-        return w.shape
+    from nvme_strom_tpu.models.quant import logical_shape as shape_of
+    # quantized leaves (models/quant.py) adapt like any other matmul:
+    # the adapter sees only the LOGICAL weight shape
 
     out: Dict[str, Tuple[jax.Array, jax.Array]] = {}
     names = [n for n in sorted(base_params)

@@ -132,6 +132,19 @@ def quantize_weights_int8(params: Dict,
     return out
 
 
+def logical_shape(leaf) -> tuple:
+    """The UNQUANTIZED shape of any param leaf — plain arrays pass
+    through, int8 leaves report q8's shape, int4 leaves un-pack the
+    2-values-per-byte input dim.  The one place consumers (LoRA init,
+    shape validation) get quantized-leaf geometry from."""
+    if isinstance(leaf, dict):
+        if "q8" in leaf:
+            return tuple(leaf["q8"].shape)
+        q4 = leaf["q4"]
+        return (*q4.shape[:-2], 2 * q4.shape[-2], q4.shape[-1])
+    return tuple(leaf.shape)
+
+
 def quantized_nbytes(params: Dict) -> tuple:
     """(bytes of quantized leaves, bytes those leaves would cost in the
     reference dtype of their scale) — the memory claim, measurable."""

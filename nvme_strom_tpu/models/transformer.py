@@ -211,8 +211,8 @@ def wmat(p: Dict, name: str, dtype):
     (int8) or a quarter (int4) of bf16 — the lever for
     weight-streaming-bound decode.  Plain array leaves pass through, so
     every model path serves quantized and full-precision params with
-    the same code.  New consumers that need the logical weight shape
-    must handle BOTH leaf kinds (see lora.shape_of)."""
+    the same code.  Consumers that need the logical weight shape
+    use ``quant.logical_shape`` (never re-derive the packing)."""
     w = p[name]
     if isinstance(w, dict):
         if "q8" in w:
