@@ -30,6 +30,33 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _make_lr_schedule(args):
+    """LR as a float (pure constant) or an optax schedule.
+
+    Warmup is linear 0→lr over --warmup-steps; after that either flat
+    (``constant``) or cosine-decayed to 10% of peak over the remaining
+    --steps (``cosine``).  Returned as a plain float when neither knob
+    is set so the offloaded-optimizer path (which takes float-or-
+    callable) keeps its simplest form.  On resume the schedule position
+    comes from the optimizer's own step count (optax count / Offloaded-
+    Adam .step), not wall progress, so a resumed run continues the
+    decay where it left off.
+    """
+    import optax
+    if args.lr_schedule == "constant" and args.warmup_steps <= 0:
+        return args.lr
+    decay_steps = max(args.steps, args.warmup_steps + 1)
+    if args.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.lr,
+            warmup_steps=args.warmup_steps,
+            decay_steps=decay_steps, end_value=args.lr * 0.1)
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, args.lr, args.warmup_steps),
+         optax.constant_schedule(args.lr)],
+        boundaries=[args.warmup_steps])
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--data-dir", default=None,
@@ -52,6 +79,16 @@ def main(argv=None) -> int:
     ap.add_argument("--global-batch", type=int, default=8)
     ap.add_argument("--tp", type=int, default=1)
     ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--lr-schedule", choices=("constant", "cosine"),
+                    default="constant",
+                    help="learning-rate shape after warmup: constant, or "
+                         "cosine decay to 10%% of --lr over --steps")
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help="linear LR warmup from 0 to --lr over N steps")
+    ap.add_argument("--grad-clip", type=float, default=0.0,
+                    metavar="NORM",
+                    help="clip gradients to this global L2 norm before "
+                         "the optimizer update (0 = off)")
     ap.add_argument("--accum-steps", type=int, default=1,
                     help="gradient-accumulation microbatches per step "
                          "(activation memory of global-batch/N)")
@@ -199,7 +236,11 @@ def main(argv=None) -> int:
         params = init_params(jax.random.key(0), cfg)
         params = {k: jax.device_put(v, p_sh[k]) for k, v in params.items()}
 
-    optimizer = optax.adamw(args.lr)
+    lr_sched = _make_lr_schedule(args)
+    optimizer = optax.adamw(lr_sched)
+    if args.grad_clip > 0:
+        optimizer = optax.chain(
+            optax.clip_by_global_norm(args.grad_clip), optimizer)
     b_sh = batch_shardings(mesh)
     if args.lora:
         # frozen streamed base + tiny trainable adapters: the
@@ -234,15 +275,19 @@ def main(argv=None) -> int:
 
         trainable = params
         opt_state = ()          # NVMe-resident; manifest is the state
-        offl = OffloadedAdam(args.offload_opt, params, lr=args.lr,
+        offl = OffloadedAdam(args.offload_opt, params, lr=lr_sched,
                              weight_decay=1e-4,  # = optax.adamw default
                              engine=engine)
 
         def gstep(p, tokens):
-            return accumulate_grads(
+            loss, grads = accumulate_grads(
                 lambda mb: jax.value_and_grad(
                     lambda q: loss_fn(q, mb, cfg, attn_fn))(p),
                 p, tokens, args.accum_steps)
+            if args.grad_clip > 0:
+                grads, _ = optax.clip_by_global_norm(
+                    args.grad_clip).update(grads, optax.EmptyState())
+            return loss, grads
 
         grad_fn = jax.jit(gstep, in_shardings=(p_sh, b_sh))
 

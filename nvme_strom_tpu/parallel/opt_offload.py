@@ -120,7 +120,7 @@ class OffloadedAdam:
     the parity guarantee above holds only for float32).
     """
 
-    def __init__(self, path, params, *, lr: float,
+    def __init__(self, path, params, *, lr,
                  b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
                  weight_decay: float = 0.0,
                  group_bytes: int = 64 << 20,
@@ -129,7 +129,11 @@ class OffloadedAdam:
                  config: Optional[EngineConfig] = None,
                  depth: int = 4):
         self._multi = jax.process_count() > 1
-        self.lr, self.b1, self.b2 = float(lr), float(b1), float(b2)
+        # lr: float, or a schedule callable step->lr (optax schedules
+        # qualify) evaluated host-side at each update's .step — the
+        # update loop is host-driven anyway, so no retrace
+        self.lr = lr if callable(lr) else float(lr)
+        self.b1, self.b2 = float(b1), float(b2)
         self.eps, self.weight_decay = float(eps), float(weight_decay)
         self.moment_dtype = jnp.dtype(moment_dtype)
         self._own_engine = engine is None
@@ -496,7 +500,8 @@ class OffloadedAdam:
             raise ValueError("params/grads tree does not match the "
                              "layout this optimizer was built for")
         t = jnp.float32(self.step + 1)
-        lr = jnp.float32(self.lr)
+        lr = jnp.float32(self.lr(self.step) if callable(self.lr)
+                         else self.lr)
         new_named: Dict[str, object] = {}
         pend: list = []
         # mark dirty BEFORE the first in-place slot write: a crash

@@ -9,7 +9,7 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parents[1]
 
 
-def _run(tmp_path, steps):
+def _run(tmp_path, steps, extra=()):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=2")
     r = subprocess.run(
@@ -17,7 +17,7 @@ def _run(tmp_path, steps):
          "--tiny", "--steps", str(steps), "--save-every", "2",
          "--global-batch", "4", "--tp", "2",
          "--ckpt-dir", str(tmp_path / "ckpt"),
-         "--data-dir", str(tmp_path / "data")],
+         "--data-dir", str(tmp_path / "data"), *extra],
         capture_output=True, text=True, timeout=300, env=env,
         cwd=str(REPO))
     assert r.returncode == 0, r.stderr[-2000:]
@@ -33,11 +33,16 @@ def test_train_checkpoint_resume(tmp_path):
     _synthesize_shards(str(tmp_path / "data"), tiny_config(),
                        n_shards=2, per_shard=8)
 
-    out1 = _run(tmp_path, steps=4)
+    # warmup+cosine schedule and grad clipping ride the same run — the
+    # optimizer count inside the checkpoint keeps the schedule position
+    # coherent across the resume
+    sched = ("--lr-schedule", "cosine", "--warmup-steps", "2",
+             "--grad-clip", "1.0")
+    out1 = _run(tmp_path, steps=4, extra=sched)
     assert "step 4" in out1
     assert (tmp_path / "ckpt").is_dir()
 
-    out2 = _run(tmp_path, steps=6)   # resumes from step 4
+    out2 = _run(tmp_path, steps=6, extra=sched)   # resumes from step 4
     assert "resumed from step 4" in out2
     assert "step 6" in out2
     losses = [float(m) for m in re.findall(r"loss=([\d.]+)", out1 + out2)]

@@ -171,3 +171,38 @@ def test_io_flows_through_engine(tmp_path):
                    - before.get("bounce_bytes", 0))
         assert read >= moment_payload
         assert written >= moment_payload
+
+
+def test_lr_schedule_callable_matches_optax(tmp_path):
+    """A schedule callable (optax cosine) evaluated host-side per step
+    must follow the exact optax.adamw(schedule) trajectory — including
+    across a resume, where .step (not wall progress) positions the
+    schedule."""
+    params = _params()
+    sched = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=1e-2, warmup_steps=2,
+        decay_steps=6, end_value=1e-3)
+
+    opt = optax.adamw(sched, b1=0.9, b2=0.999, eps=1e-8,
+                      weight_decay=0.0)
+    state = opt.init(params)
+    want = params
+    for i in range(4):
+        g = _grads(want, 100 + i)
+        updates, state = opt.update(g, state, want)
+        want = optax.apply_updates(want, updates)
+
+    got = params
+    with OffloadedAdam(tmp_path / "opt", params, lr=sched) as o:
+        for i in range(2):
+            got = o.update(got, _grads(got, 100 + i))
+    # resume: a fresh instance picks up .step=2 → schedule continues
+    with OffloadedAdam(tmp_path / "opt", got, lr=sched) as o:
+        assert o.step == 2
+        for i in range(2, 4):
+            got = o.update(got, _grads(got, 100 + i))
+
+    for w, g in zip(jax.tree_util.tree_leaves(want),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g),
+                                   rtol=1e-5, atol=1e-6)
