@@ -54,6 +54,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--table", default=None,
                     help="existing Parquet file (else synthesized)")
+    ap.add_argument("--sql", default=None, metavar="QUERY",
+                    help="run this SQL string (table name 't') instead "
+                         "of the demo queries — the sql.parser front "
+                         "end plans it onto the device executors")
     ap.add_argument("--rows", type=int, default=1_000_000)
     ap.add_argument("--groups", type=int, default=64)
     ap.add_argument("--compression", default="none",
@@ -76,10 +80,13 @@ def main(argv=None) -> int:
                                     sql_groupby_str, sql_topk,
                                     top_k_groups)
 
-    tmp = None
     path = args.table
     if path is None:
+        import atexit
+        import shutil
         tmp = tempfile.mkdtemp(prefix="strom_sql_")
+        # one cleanup for every exit path — early returns, exceptions
+        atexit.register(shutil.rmtree, tmp, ignore_errors=True)
         path = os.path.join(tmp, "t.parquet")
         _synthesize(path, args.rows, args.groups, args.compression)
 
@@ -95,6 +102,25 @@ def main(argv=None) -> int:
             print(f"  [{label}: {time.monotonic() - t0:.3f}s  "
                   f"direct={s['bytes_direct'] >> 20}MiB "
                   f"bounce={s['bounce_bytes'] >> 20}MiB]")
+
+        if args.sql:
+            from nvme_strom_tpu.sql import sql_query as run_sql
+            t0 = time.monotonic()
+            out = run_sql(args.sql, {"t": sc}, engine=eng)
+            for name, col in out.items():
+                if not hasattr(col, "__len__"):
+                    print(f"  {name}: {col}")
+                    continue
+                def _fmt(x):
+                    try:
+                        return round(float(x), 4)
+                    except (TypeError, ValueError):
+                        return x
+                head = [_fmt(x) for x in list(col[:8])]
+                print(f"  {name}: {head}"
+                      + (" ..." if len(col) > 8 else ""))
+            counters("sql", t0)
+            return 0
 
         where_ranges = []
         if args.where:
@@ -134,9 +160,6 @@ def main(argv=None) -> int:
                       f"mean={float(top['mean'][i]):+.4f}")
             counters("string groupby", t0)
 
-    if tmp:
-        import shutil
-        shutil.rmtree(tmp, ignore_errors=True)
     return 0
 
 

@@ -3,7 +3,9 @@ from nvme_strom_tpu.sql.groupby import (groupby_aggregate, sql_groupby,
                                         sql_groupby_str, top_k_groups)
 from nvme_strom_tpu.sql.join import lookup_unique, star_join_groupby
 from nvme_strom_tpu.sql.topk import sql_topk
+from nvme_strom_tpu.sql.parser import SQLSyntaxError, parse_select, sql_query
 
 __all__ = ["EngineFile", "ParquetScanner", "groupby_aggregate",
            "sql_groupby", "sql_groupby_str", "top_k_groups",
-           "lookup_unique", "star_join_groupby", "sql_topk"]
+           "lookup_unique", "star_join_groupby", "sql_topk",
+           "SQLSyntaxError", "parse_select", "sql_query"]
