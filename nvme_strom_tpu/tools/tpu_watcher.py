@@ -39,6 +39,7 @@ import argparse
 import datetime
 import json
 import os
+import re
 import shutil
 import subprocess
 import sys
@@ -133,7 +134,9 @@ def _run_step(name: str, cmd: list[str],
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout_s, cwd=REPO, env=env)
         rec["rc"] = r.returncode
-        rec["stderr_tail"] = r.stderr.strip().splitlines()[-12:]
+        # 25 lines: a bare python traceback is ~12, which evicted the
+        # diagnostic _log lines printed just before a raise
+        rec["stderr_tail"] = r.stderr.strip().splitlines()[-25:]
         rec["results"] = _harvest_json(r.stdout)
     except subprocess.TimeoutExpired as e:
         rec["rc"] = -1
@@ -334,11 +337,30 @@ def _captured_steps(ledger_path: str = None) -> set:
                     continue
                 if (rec.get("rc") == 0 and rec.get("results")
                         and str(rec.get("device", "")).startswith("tpu")
-                        and not _looks_down(rec)):
+                        and not _looks_down(rec)
+                        and not _suspect_results(rec)):
                     done.add(rec.get("step"))
     except OSError:
         pass
     return done
+
+
+_MFU_PCT = re.compile(r"mfu=(\d+(?:\.\d+)?)%")
+
+
+def _suspect_results(rec: dict) -> bool:
+    """A row whose metric admits it's broken must not count as landed
+    coverage: 'SUSPECT' tags (bench_suite flags rates above device
+    peak) and mfu values over 100% (rows ledgered before that guard
+    existed — the 2026-07-31 d3072/d4096 timing artifacts)."""
+    for res in rec.get("results") or []:
+        m = str(res.get("metric", ""))
+        if "SUSPECT" in m:
+            return True
+        pct = _MFU_PCT.search(m)
+        if pct and float(pct.group(1)) > 100.0:
+            return True
+    return False
 
 
 def _attempt_counts(ledger_path: str = None) -> dict:

@@ -118,9 +118,21 @@ def test_captured_steps_reads_only_real_successes(tmp_path):
         {"step": "suite_13", "rc": 0, "device": "tpu TPU v5 lite0",
          "results": [{"metric": "z (dev=cpu-fallback-TUNNEL-DOWN)",
                       "value": 1}]},
+        # physically impossible rows must not count as coverage: the
+        # flagged form and the pre-guard mfu>100% form both re-run
+        {"step": "suite_7_d3072", "rc": 0, "device": "tpu TPU v5 lite0",
+         "results": [{"metric": "config7:x (mfu=SUSPECT-TIMING (43.9x "
+                                "over device peak 197 TFLOP/s))",
+                      "value": 8647.0}]},
+        {"step": "suite_7_d4096", "rc": 0, "device": "tpu TPU v5 lite0",
+         "results": [{"metric": "config7:x (dev=tpu, mfu=16295.8% "
+                                "d=4096)", "value": 32100.0}]},
+        {"step": "suite_7_ok", "rc": 0, "device": "tpu TPU v5 lite0",
+         "results": [{"metric": "config7:x (dev=tpu, mfu=35.3% d=2048)",
+                      "value": 69.6}]},
     ]
     lg.write_text("".join(json.dumps(r) + "\n" for r in rows))
-    assert tw._captured_steps(str(lg)) == {"suite_7"}
+    assert tw._captured_steps(str(lg)) == {"suite_7", "suite_7_ok"}
     assert tw._captured_steps(str(tmp_path / "missing.jsonl")) == set()
 
 
