@@ -18,11 +18,13 @@ than silently falling back):
         [JOIN d ON t.col = d.col]
         [WHERE conj [AND conj ...]]
         [GROUP BY col]
+        [HAVING hconj [AND hconj ...]]
         [ORDER BY col|agg [ASC|DESC]]
         [LIMIT n]
     item := col | COUNT(*) | {COUNT|SUM|MEAN|AVG|MIN|MAX}(col) [AS name]
     conj := col {=|<|<=|>|>=} number | number {=|<|<=|>|>=} col
           | col BETWEEN number AND number
+    hconj := agg|alias {=|<|<=|>|>=} number      (post-aggregation)
 
 Planning rules (each maps to one streaming executor — the query never
 materializes the table):
@@ -55,8 +57,8 @@ __all__ = ["SQLSyntaxError", "parse_select", "sql_query", "Query"]
 
 _AGG_FNS = ("count", "sum", "mean", "avg", "min", "max")
 _KEYWORDS = {"select", "from", "join", "on", "where", "and", "between",
-             "group", "by", "order", "asc", "desc", "limit", "as",
-             "or", "not"}
+             "group", "by", "having", "order", "asc", "desc", "limit",
+             "as", "or", "not"}
 
 _TOKEN = re.compile(r"""\s*(?:
       (?P<num>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
@@ -92,6 +94,7 @@ class Query:
     join: Optional[Tuple[str, str, str]] = None  # (tbl2, lcol, rcol) qualified
     where: List[Tuple[str, str, float]] = field(default_factory=list)
     group_by: Optional[str] = None
+    having: List[Tuple[str, str, float]] = field(default_factory=list)
     order_by: Optional[Tuple[str, bool]] = None        # (name, descending)
     limit: Optional[int] = None
 
@@ -195,6 +198,20 @@ def parse_select(sql: str) -> Query:
         t.expect("kw", "by")
         group_by = t.expect("id")
 
+    having: List[Tuple[str, str, float]] = []
+    if t.accept("kw", "having"):
+        if group_by is None:
+            raise SQLSyntaxError("HAVING requires GROUP BY")
+        while True:
+            name = _parse_order_target(t, clause="HAVING")
+            op = t.expect("op")
+            if op not in ("<", "<=", ">", ">=", "="):
+                raise SQLSyntaxError(
+                    f"bad HAVING comparison operator {op!r}")
+            having.append((name, op, float(t.expect("num"))))
+            if not t.accept("kw", "and"):
+                break
+
     order_by = None
     if t.accept("kw", "order"):
         t.expect("kw", "by")
@@ -218,7 +235,8 @@ def parse_select(sql: str) -> Query:
         k, v, pos = t.next()
         raise SQLSyntaxError(f"unexpected {v!r} at position {pos}")
     return Query(select=select, table=table, join=join, where=where,
-                 group_by=group_by, order_by=order_by, limit=limit)
+                 group_by=group_by, having=having, order_by=order_by,
+                 limit=limit)
 
 
 def _parse_item(t: _Tokens) -> SelectItem:
@@ -248,13 +266,13 @@ def _parse_item(t: _Tokens) -> SelectItem:
     return item
 
 
-def _parse_order_target(t: _Tokens) -> str:
-    """ORDER BY target: a column, or an aggregate spelled like the
-    select list spells it (``ORDER BY COUNT(v)`` ≡ the item named
+def _parse_order_target(t: _Tokens, clause: str = "ORDER BY") -> str:
+    """ORDER BY / HAVING target: a column, or an aggregate spelled like
+    the select list spells it (``ORDER BY COUNT(v)`` ≡ the item named
     ``count(v)``)."""
     kind, v, pos = t.next()
     if kind != "id":
-        raise SQLSyntaxError(f"bad ORDER BY target at {pos}: {v!r}")
+        raise SQLSyntaxError(f"bad {clause} target at {pos}: {v!r}")
     if v.lower() in _AGG_FNS and t.peek("op", "("):
         fn = "mean" if v.lower() == "avg" else v.lower()
         t.expect("op", "(")
@@ -512,6 +530,8 @@ def _run_groupby(q: Query, sc, *, num_groups, device, method, nulls):
                  else v[:, 0])
         out[it.name] = v
 
+    out = _apply_having(q, out, q.group_by)
+
     if q.order_by is not None:
         if q.limit is None:
             raise SQLSyntaxError("ORDER BY without LIMIT is unbounded; "
@@ -520,8 +540,12 @@ def _run_groupby(q: Query, sc, *, num_groups, device, method, nulls):
         by = _order_key(q, by)
         ranked_in = {k: _as_device(v) for k, v in out.items()
                      if not (str_key and k == q.group_by)}
-        # SQL: LIMIT larger than the result is the whole result
+        # SQL: LIMIT larger than the result is the whole result (and a
+        # HAVING that filtered everything is a legal empty result)
         k_eff = min(q.limit, int(ranked_in[by].shape[0]))
+        if k_eff == 0:
+            return {k: (v if isinstance(v, list) else np.asarray(v))
+                    for k, v in out.items()}
         ranked = top_k_groups(ranked_in, by, k_eff, descending=desc)
         res_out = {k: np.asarray(v) for k, v in ranked.items()
                    if k != "group"}
@@ -536,13 +560,41 @@ def _run_groupby(q: Query, sc, *, num_groups, device, method, nulls):
             for k, v in out.items()}
 
 
-def _order_key(q: Query, by: str) -> str:
-    """ORDER BY target → output column name (alias-aware)."""
+def _order_key(q: Query, by: str, clause: str = "ORDER BY") -> str:
+    """ORDER BY / HAVING target → output column name (alias-aware)."""
     for it in q.select:
         if it.name == by or (it.agg and
                              f"{it.agg}({it.column or '*'})" == by):
             return it.name
-    raise SQLSyntaxError(f"ORDER BY {by!r} is not in the select list")
+    raise SQLSyntaxError(f"{clause} {by!r} is not in the select list")
+
+
+def _apply_having(q: Query, out: dict, group_col: str) -> dict:
+    """Filter the grouped result rows by the HAVING conjuncts.
+
+    Runs host-side on the already-folded aggregates — HAVING touches
+    (num_groups,) arrays, not the scan, so there is nothing left to
+    push down.  A string group key (label list) filters by index; other
+    columns by boolean mask."""
+    import numpy as np
+    if not q.having:
+        return out
+    mask = None
+    for name, op, val in q.having:
+        col = out[_order_key(q, name, clause="HAVING")]
+        if isinstance(col, list):       # the string group-key labels
+            raise SQLSyntaxError(
+                f"HAVING {name!r}: string columns cannot compare to "
+                "numbers — HAVING takes the aggregates (or the integer "
+                "group key)")
+        arr = np.asarray(col)
+        part = {"<": arr < val, "<=": arr <= val, ">": arr > val,
+                ">=": arr >= val, "=": arr == val}[op]
+        mask = part if mask is None else (mask & part)
+    idx = np.nonzero(mask)[0]
+    return {k: ([v[i] for i in idx] if isinstance(v, list)
+                else np.asarray(v)[idx])
+            for k, v in out.items()}
 
 
 def _as_device(v):
@@ -713,6 +765,7 @@ def _run_join(q: Query, tables, *, num_groups, device, engine, method):
     out = {q.group_by: np.arange(ng, dtype=np.int64)}
     for it in agg_items:
         out[it.name] = res[it.agg]
+    out = _apply_having(q, out, q.group_by)
 
     if q.order_by is not None:
         from nvme_strom_tpu.sql.groupby import top_k_groups
@@ -721,8 +774,11 @@ def _run_join(q: Query, tables, *, num_groups, device, engine, method):
                                  "add LIMIT")
         by, desc = q.order_by
         by = _order_key(q, by)
+        k_eff = min(q.limit, len(out[q.group_by]))
+        if k_eff == 0:
+            return {k: np.asarray(v) for k, v in out.items()}
         ranked = top_k_groups({k: _as_device(v) for k, v in out.items()},
-                              by, min(q.limit, ng), descending=desc)
+                              by, k_eff, descending=desc)
         return {k: np.asarray(v) for k, v in ranked.items()
                 if k != "group"}
     if q.limit is not None:

@@ -260,3 +260,50 @@ def test_nulls_skip_refused_where_unsupported(star, table):
 def test_float_limit_is_syntax_error():
     with pytest.raises(SQLSyntaxError, match="integer"):
         parse_select("SELECT v FROM t LIMIT 2.5")
+
+
+def test_having_int_key(table):
+    sc, d = table
+    out = sql_query("SELECT k, SUM(v) AS s FROM t GROUP BY k "
+                    "HAVING s > 0", sc)
+    sums = np.array([d["v"][d["k"] == g].sum() for g in range(23)])
+    keep = np.nonzero(sums > 0)[0]
+    np.testing.assert_array_equal(out["k"], keep)
+    np.testing.assert_allclose(out["s"], sums[keep], rtol=1e-3)
+
+
+def test_having_string_key_with_order(table):
+    sc, d = table
+    import collections
+    counts = collections.Counter(d["city"].tolist())
+    floor = sorted(counts.values())[1]      # drops exactly one city
+    out = sql_query(f"SELECT city, COUNT(v) AS n FROM t GROUP BY city "
+                    f"HAVING n >= {floor} ORDER BY n ASC LIMIT 10", sc)
+    want = sorted(v for v in counts.values() if v >= floor)
+    assert [int(x) for x in out["n"]] == want
+    assert len(out["city"]) == 3
+
+
+def test_having_join_and_empty(star):
+    tables, fact, attr_of = star
+    out = sql_query(
+        "SELECT d.attr, COUNT(*) AS n FROM f JOIN d ON f.fk = d.dk "
+        "GROUP BY d.attr HAVING n > 999999 ORDER BY n DESC LIMIT 3",
+        tables)
+    assert len(out["n"]) == 0               # legal empty result
+
+
+def test_having_refusals(table):
+    sc, _ = table
+    with pytest.raises(SQLSyntaxError, match="GROUP BY"):
+        parse_select("SELECT v FROM t HAVING v > 1")
+    with pytest.raises(SQLSyntaxError, match="select list"):
+        sql_query("SELECT k, SUM(v) FROM t GROUP BY k "
+                  "HAVING max(v) > 0", sc)
+
+
+def test_having_on_string_key_is_syntax_error(table):
+    sc, _ = table
+    with pytest.raises(SQLSyntaxError, match="string columns"):
+        sql_query("SELECT city, COUNT(v) AS n FROM t GROUP BY city "
+                  "HAVING city > 5", sc)
