@@ -99,6 +99,16 @@ def probe() -> dict:
                 "probe_s": round(time.monotonic() - t0, 1)}
 
 
+def _tail(raw, n: int) -> list:
+    """Last ``n`` lines of subprocess output; None/bytes/str all fine
+    (TimeoutExpired hands back whichever the runtime captured)."""
+    if raw is None:
+        return []
+    if isinstance(raw, bytes):
+        raw = raw.decode(errors="replace")
+    return raw.strip().splitlines()[-n:]
+
+
 def _harvest_json(text: str) -> list:
     """Every parseable JSON line of ``text`` — the one harvest rule for
     both the normal and the timeout-salvage paths."""
@@ -136,15 +146,19 @@ def _run_step(name: str, cmd: list[str],
         rec["rc"] = r.returncode
         # 25 lines: a bare python traceback is ~12, which evicted the
         # diagnostic _log lines printed just before a raise
-        rec["stderr_tail"] = r.stderr.strip().splitlines()[-25:]
+        rec["stderr_tail"] = _tail(r.stderr, 25)
         rec["results"] = _harvest_json(r.stdout)
     except subprocess.TimeoutExpired as e:
         rec["rc"] = -1
         rec["error"] = f"timeout after {timeout_s}s"
-        out = (e.stdout or b"")
+        out = e.stdout or b""
         if isinstance(out, bytes):
             out = out.decode(errors="replace")
-        rec["stdout_tail"] = out.strip().splitlines()[-12:]
+        rec["stdout_tail"] = _tail(out, 12)
+        # the suite narrates progress on STDERR (_log) — without it a
+        # timeout is undiagnosable (tunnel death vs slow compile vs a
+        # genuinely slow step; suite_13 2026-07-31T07:55 was opaque)
+        rec["stderr_tail"] = _tail(e.stderr, 25)
         # measurements already printed before the stall must land in
         # the ledger — the probes stream one JSON line per result for
         # exactly this failure mode

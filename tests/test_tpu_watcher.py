@@ -30,11 +30,15 @@ def test_run_step_harvests_json_lines(tmp_path):
 
 def test_run_step_timeout_is_recorded_not_fatal(tmp_path):
     script = tmp_path / "hang.py"
-    script.write_text("import time\nprint('started', flush=True)\n"
-                      "time.sleep(60)\n")
+    script.write_text("import sys, time\nprint('started', flush=True)\n"
+                      "print('suite: compiling', file=sys.stderr, "
+                      "flush=True)\ntime.sleep(60)\n")
     rec = tw._run_step("hang", [sys.executable, str(script)], timeout_s=2)
     assert rec["rc"] == -1
     assert rec["error"].startswith("timeout")
+    # stderr narration must survive a timeout — it's the only way to
+    # tell a slow compile from a dead tunnel
+    assert rec["stderr_tail"] == ["suite: compiling"]
     # a timeout alone is AMBIGUOUS (slow compile vs dead tunnel): it must
     # not read as down — capture() instead marks the run incomplete and
     # lets the next step's own device gate decide
