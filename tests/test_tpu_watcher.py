@@ -33,7 +33,9 @@ def test_run_step_timeout_is_recorded_not_fatal(tmp_path):
     script.write_text("import sys, time\nprint('started', flush=True)\n"
                       "print('suite: compiling', file=sys.stderr, "
                       "flush=True)\ntime.sleep(60)\n")
-    rec = tw._run_step("hang", [sys.executable, str(script)], timeout_s=2)
+    # 6s, not 2: under a loaded box the interpreter can take >2s to
+    # reach the prints, leaving both tails legitimately empty
+    rec = tw._run_step("hang", [sys.executable, str(script)], timeout_s=6)
     assert rec["rc"] == -1
     assert rec["error"].startswith("timeout")
     # stderr narration must survive a timeout — it's the only way to
