@@ -59,6 +59,10 @@ def _make_lr_schedule(args):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--mix", default=None, metavar="DIR:W,DIR:W",
+                    help="train on a weighted MIXTURE of shard dirs "
+                         "(seeded per-step source draws, identical on "
+                         "every host) instead of one --data-dir")
     ap.add_argument("--data-dir", default=None,
                     help="dir of WebDataset .tar shards of token arrays "
                          "(int32, seq_len per sample); synthesized if "
@@ -203,18 +207,40 @@ def main(argv=None) -> int:
 
     engine = StromEngine()
     tmp = None
-    data_dir = args.data_dir
-    if data_dir is None:
-        tmp = tempfile.TemporaryDirectory(prefix="strom_lm_")
-        data_dir = tmp.name
-        _synthesize_shards(data_dir, cfg, n_shards=4,
-                           per_shard=8 * args.global_batch)
-        print(f"data: synthesized 4 shards under {data_dir}")
-    shards = sorted(
-        os.path.join(data_dir, f) for f in os.listdir(data_dir)
-        if f.endswith(".tar"))
-    if not shards:
-        ap.error(f"no .tar shards found under {data_dir}")
+    mix_specs = None           # [(shard list, weight)] when --mix
+    if args.mix:
+        if args.data_dir:
+            ap.error("--mix and --data-dir conflict: list every corpus "
+                     "in --mix (DIR:W,DIR:W)")
+        mix_specs = []
+        for part in args.mix.split(","):
+            d, _, w = part.rpartition(":")
+            try:
+                weight = float(w)
+            except ValueError:
+                weight = -1.0
+            if not d or weight <= 0:
+                ap.error(f"--mix entry {part!r}: want DIR:WEIGHT "
+                         "with a positive weight")
+            entry = sorted(os.path.join(d, f) for f in os.listdir(d)
+                           if f.endswith(".tar"))
+            if not entry:
+                ap.error(f"--mix: no .tar shards under {d}")
+            mix_specs.append((entry, weight))
+        data_dir = None
+    else:
+        data_dir = args.data_dir
+        if data_dir is None:
+            tmp = tempfile.TemporaryDirectory(prefix="strom_lm_")
+            data_dir = tmp.name
+            _synthesize_shards(data_dir, cfg, n_shards=4,
+                               per_shard=8 * args.global_batch)
+            print(f"data: synthesized 4 shards under {data_dir}")
+        shards = sorted(
+            os.path.join(data_dir, f) for f in os.listdir(data_dir)
+            if f.endswith(".tar"))
+        if not shards:
+            ap.error(f"no .tar shards found under {data_dir}")
 
     ckpt_dir = args.ckpt_dir or os.path.join(
         tmp.name if tmp else ".", "ckpt")
@@ -331,10 +357,25 @@ def main(argv=None) -> int:
             "(the moments update in place every step; only "
             "checkpoint-aligned pairs are coherent)")
 
+    def decode(parts):
+        (payload,) = parts.values()
+        return np.frombuffer(payload, dtype=np.int32) % cfg.vocab
+
     def batches():
-        def decode(parts):
-            (payload,) = parts.values()
-            return np.frombuffer(payload, dtype=np.int32) % cfg.vocab
+        if mix_specs is not None:
+            from contextlib import ExitStack
+            from nvme_strom_tpu.data import MixtureLoader
+            with ExitStack() as stack:
+                loaders = [
+                    (stack.enter_context(
+                        ShardedLoader(e, mesh, args.global_batch,
+                                      fmt="wds", decode=decode,
+                                      engine=engine)), w)
+                    for e, w in mix_specs]
+                mix = MixtureLoader(loaders, seed=0)
+                for b, _src in mix:     # unbounded: sources restart
+                    yield b
+            return
         while True:
             n = 0
             with ShardedLoader(shards, mesh, args.global_batch, fmt="wds",

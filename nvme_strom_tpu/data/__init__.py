@@ -1,4 +1,6 @@
 from nvme_strom_tpu.data.loader import ShardedLoader
+from nvme_strom_tpu.data.mixture import MixtureLoader
 from nvme_strom_tpu.data.sharding import assign_shards, shuffled_indices
 
-__all__ = ["ShardedLoader", "assign_shards", "shuffled_indices"]
+__all__ = ["ShardedLoader", "MixtureLoader", "assign_shards",
+           "shuffled_indices"]

@@ -1,0 +1,102 @@
+"""Weighted multi-dataset mixing over sharded loaders.
+
+LM pretraining feeds a weighted mixture of corpora (code/web/books...)
+rather than one dataset; the reference-side analogue is PG-Strom
+scanning many tables through one DMA engine (SURVEY.md §3.5 — the
+consumer composes sources, the engine stays shared).  ``MixtureLoader``
+composes :class:`~nvme_strom_tpu.data.loader.ShardedLoader`s the same
+way: one engine underneath, one batch stream out.
+
+Multi-host correctness is the design constraint: every process must
+draw the SAME source at the SAME step, or the per-process shard reads
+would assemble a global batch from different datasets.  The draw is a
+counter-based PRNG on (seed, step) — ``np.random.default_rng(
+(seed, step))`` — so processes agree without any cross-host
+communication, the same trick the loaders use for shard shuffling
+(data/sharding.py).
+
+An exhausted source restarts transparently: re-iterating a
+ShardedLoader advances its ``.epoch`` and reshuffles, so the mixture
+stream is unbounded even though each underlying epoch is finite
+(matching how optimizer steps, not epochs, bound LM training).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["MixtureLoader"]
+
+
+class MixtureLoader:
+    """Draw batches from several loaders with fixed weights.
+
+    ``sources``: sequence of (loader, weight) — any iterable yielding
+    batches and restartable via ``iter()`` qualifies (ShardedLoader
+    does).  Weights are normalized; they need not sum to 1.
+
+    ``max_restarts``: how many times an exhausted source may restart
+    (None = unbounded, the LM-pretraining default).  A source whose
+    FIRST epoch is empty raises — silently dropping a misconfigured
+    corpus would skew the mixture.
+
+    Iteration yields ``(batch, source_index)``; ``counts`` records how
+    many batches each source served (observability: the realized
+    mixture vs the requested weights).
+    """
+
+    def __init__(self, sources: Sequence[tuple], *, seed: int = 0,
+                 max_restarts: Optional[int] = None):
+        if not sources:
+            raise ValueError("MixtureLoader needs at least one source")
+        self.loaders = [s for s, _ in sources]
+        w = np.asarray([float(wt) for _, wt in sources], np.float64)
+        if (w <= 0).any():
+            raise ValueError(f"weights must be positive, got {w.tolist()}")
+        self.weights = w / w.sum()
+        self.seed = int(seed)
+        self.max_restarts = max_restarts
+        self.counts = [0] * len(self.loaders)
+        self.step = 0
+
+    def _draw(self, step: int) -> int:
+        """Source index for ``step`` — a pure function of (seed, step),
+        identical on every process by construction."""
+        rng = np.random.default_rng((self.seed, step))
+        return int(rng.choice(len(self.weights), p=self.weights))
+
+    def __iter__(self) -> Iterator:
+        iters = [iter(ld) for ld in self.loaders]
+        restarts = [0] * len(iters)
+        try:
+            while True:
+                s = self._draw(self.step)
+                try:
+                    batch = next(iters[s])
+                except StopIteration:
+                    restarts[s] += 1
+                    if (self.max_restarts is not None
+                            and restarts[s] > self.max_restarts):
+                        return
+                    iters[s] = iter(self.loaders[s])  # next epoch,
+                    try:                              # reshuffled
+                        batch = next(iters[s])
+                    except StopIteration:
+                        raise ValueError(
+                            f"mixture source {s} yielded no batches — "
+                            "an empty corpus would silently skew the "
+                            "mixture")
+                self.counts[s] += 1
+                self.step += 1
+                yield batch, s
+        finally:
+            # an abandoned mixture must not leave source producer
+            # threads mid-submit: ShardedLoader.__iter__'s generator
+            # close() joins its producer before the loader's engine
+            # can be torn down
+            for it in iters:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    close()
