@@ -60,6 +60,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import math
 import os
 import shutil
 import statistics
@@ -1003,7 +1004,7 @@ def _train_variant(cfg, batch: int, seq: int, dev,
                    donate_argnums=(0, 1))
     params, opt_state, loss = step(params, opt_state, tokens)  # compile
     jax.block_until_ready((params, opt_state, loss))
-    rates = []
+    rates, losses = [], []
     for _ in range(_RUNS):
         t0 = time.monotonic()
         params, opt_state, loss = step(params, opt_state, tokens)
@@ -1013,6 +1014,16 @@ def _train_variant(cfg, batch: int, seq: int, dev,
         # runtime — a rate above peak is a timing artifact by definition
         jax.block_until_ready((params, opt_state, loss))
         rates.append(flops_step / (time.monotonic() - t0))
+        losses.append(loss)
+    # execution sanity: the tunneled runtime has returned instantly with
+    # garbage instead of raising (2026-07-31, remat=dots variants at
+    # 17-32x device peak even under full-tree blocking).  A real Adam
+    # trajectory moves the loss every step and keeps it finite; anything
+    # else means the device did not actually run the program
+    vals = [float(x) for x in jax.device_get(losses)]
+    if not all(math.isfinite(v) for v in vals) or len(set(vals)) <= 1:
+        raise RuntimeError(f"loss sanity failed (runtime returned "
+                           f"garbage without raising): losses={vals[:6]}")
     if profile_dir:
         # the committed profile breakdown for the MFU story: 3 traced
         # steps, viewable in TensorBoard/xprof

@@ -162,6 +162,20 @@ def _llama3_scale_freqs(freqs, scaling: dict):
                                + smooth * freqs))
 
 
+def _rope_cos_sin(half: int, theta, positions, scaling, seq: int):
+    """cos/sin tables for RoPE: (..., seq, half) in f32."""
+    if positions is None:
+        positions = jnp.arange(seq, dtype=jnp.float32)
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling is not None:
+        rt = scaling.get("rope_type", scaling.get("type"))
+        if rt != "llama3":
+            raise NotImplementedError(f"rope_scaling type {rt!r}")
+        freqs = _llama3_scale_freqs(freqs, scaling)
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(ang), jnp.sin(ang)
+
+
 def _rope(q, k, theta, positions=None, scaling=None):
     """Rotary position embeddings, half-split convention (x split into
     two halves rotated against each other — the same convention as HF
@@ -176,26 +190,19 @@ def _rope(q, k, theta, positions=None, scaling=None):
     dict (see TransformerConfig)."""
     seq = q.shape[-2]
     half = q.shape[-1] // 2
-    if positions is None:
-        positions = jnp.arange(seq, dtype=jnp.float32)
-    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
-    if scaling is not None:
-        rt = scaling.get("rope_type", scaling.get("type"))
-        if rt != "llama3":
-            raise NotImplementedError(f"rope_scaling type {rt!r}")
-        freqs = _llama3_scale_freqs(freqs, scaling)
-    ang = positions.astype(jnp.float32)[..., None] * freqs
-    cos, sin = jnp.cos(ang), jnp.sin(ang)
-    if ang.ndim == 3:              # per-row positions: (b, s, half)
+    cos, sin = _rope_cos_sin(half, theta, positions, scaling, seq)
+    if cos.ndim == 3:              # per-row positions: (b, s, half)
         cos, sin = cos[:, None], sin[:, None]   # broadcast over heads
+    return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin)
 
-    def rot(x):
-        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-        return jnp.concatenate(
-            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
-        ).astype(x.dtype)
 
-    return rot(q), rot(k)
+def _apply_rope(t, cos, sin):
+    """Half-split rotation (the single copy of the RoPE math — both
+    the (b,h,s,d) and (b,s,h,d) paths feed pre-broadcast cos/sin)."""
+    t1, t2 = jnp.split(t.astype(jnp.float32), 2, axis=-1)
+    return jnp.concatenate(
+        [t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1
+    ).astype(t.dtype)
 
 
 def wmat(p: Dict, name: str, dtype):
@@ -248,6 +255,36 @@ def dense_causal_attention(q, k, v):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def dense_causal_attention_grouped(q, k, v):
+    """The same computation with q/k/v in PROJECTION layout (b, s, h, d)
+    and k/v at KV-HEAD width — the default single-chip train path.
+
+    Two copy killers vs transpose + expand + dense_causal_attention
+    (AOT HLO probe on the d2048/b8 train step, 2026-07-31 — the jax
+    profiler showed 69% of device time in copy ops at 35% MFU):
+
+    - no ``jnp.repeat``: the einsums carry (b, nkv) as batch dims and
+      read each K/V head once instead of ``g`` materialized replicas;
+    - no (b,s,h,d)→(b,h,s,d) transposes: the matmul's dot_general
+      absorbs the layout (non-contracting dims are free to permute),
+      where the explicit transposes materialized q/k/v copies.
+
+    Numerically identical to the expanded path (pinned by
+    tests/test_model.py)."""
+    b, s, nh, hd = q.shape
+    nkv = k.shape[2]
+    g = nh // nkv
+    qg = q.reshape(b, s, nkv, g, hd)
+    scores = jnp.einsum("bsngd,btnd->bngst", qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bngst,btnd->bsngd", probs, v)
+    return out.reshape(b, s, nh * hd)
+
+
 def qkv_project(x, p, prefix, cfg: TransformerConfig, positions=None):
     """Shared QKV projection + RoPE.  Returns q (b, nh, s, hd) and k/v at
     kv-head width (b, n_kv_heads, s, hd) — pre-GQA-expansion, which is the
@@ -261,6 +298,25 @@ def qkv_project(x, p, prefix, cfg: TransformerConfig, positions=None):
     q, k = _rope(q, k, cfg.rope_theta, positions=positions,
                  scaling=cfg.rope_scaling_dict)
     return q, k, v
+
+
+def qkv_project_bshd(x, p, prefix, cfg: TransformerConfig,
+                     positions=None):
+    """QKV projection + RoPE in PROJECTION layout (b, s, h, d) — no
+    head/seq transpose; the grouped attention einsums absorb the layout
+    (see dense_causal_attention_grouped)."""
+    b, s, _ = x.shape
+    hd, nh, nkv = cfg.head_dim, cfg.n_heads, cfg.n_kv_heads
+    q = (x @ wmat(p, prefix + "wq", x.dtype)).reshape(b, s, nh, hd)
+    k = (x @ wmat(p, prefix + "wk", x.dtype)).reshape(b, s, nkv, hd)
+    v = (x @ wmat(p, prefix + "wv", x.dtype)).reshape(b, s, nkv, hd)
+    cos, sin = _rope_cos_sin(hd // 2, cfg.rope_theta, positions,
+                             cfg.rope_scaling_dict, s)
+    # (s, half) → (s, 1, half) broadcasts over (b, s, H, half);
+    # per-row positions (b, s, half) → (b, s, 1, half)
+    cos = cos[..., :, None, :]
+    sin = sin[..., :, None, :]
+    return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin), v
 
 
 def expand_gqa(t, cfg: TransformerConfig):
@@ -278,6 +334,16 @@ def attention(x, p, prefix, cfg: TransformerConfig, attn_fn=None,
     ``return_kv=True`` additionally returns the post-RoPE kv-width k/v for
     cache prefill."""
     b, s, _ = x.shape
+    if attn_fn is None and not return_kv:
+        # default dense path: projection layout end-to-end + grouped
+        # einsums — no transposes, no materialized GQA repeat (the
+        # d2048 step's 69%-copy profile, see the grouped fn)
+        q, k, v = qkv_project_bshd(x, p, prefix, cfg,
+                                   positions=positions)
+        out = dense_causal_attention_grouped(q, k, v)
+        return out @ wmat(p, prefix + "wo", x.dtype)
+    # explicit attn_fns (flash/ring/ulysses) and the cache-prefill path
+    # take (b, h, s, d) with equal head counts
     q, k, v = qkv_project(x, p, prefix, cfg, positions=positions)
     out = (attn_fn or dense_causal_attention)(
         q, expand_gqa(k, cfg), expand_gqa(v, cfg))

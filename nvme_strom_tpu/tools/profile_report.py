@@ -84,6 +84,10 @@ def parse_trace(trace_dir: str) -> dict:
 
     by_cat: dict[str, float] = {}
     by_op: dict[str, float] = {}
+    # category → {op: ns}: names the time, not just buckets — the
+    # 2026-07-31 69%-copy profile was unactionable without knowing
+    # WHICH ops the bucket held
+    by_cat_op: dict[str, dict] = {}
     module_ns = []          # per-step module durations (XLA Modules line)
     module_spans = []       # (start, end) to bound the traced window
 
@@ -94,6 +98,8 @@ def parse_trace(trace_dir: str) -> dict:
         # full HLO text would blow up the ledger line
         short = ev.name.split("=", 1)[0].strip()[:48] or ev.name[:48]
         by_op[short] = by_op.get(short, 0.0) + ev.duration_ns
+        co = by_cat_op.setdefault(cat, {})
+        co[short] = co.get(short, 0.0) + ev.duration_ns
 
     if dev_plane is not None:
         for line in dev_plane.lines:
@@ -145,6 +151,12 @@ def parse_trace(trace_dir: str) -> dict:
                           for k, v in sorted(by_cat.items(),
                                              key=lambda kv: -kv[1])},
         "top_ops_ms": {k: round(v / 1e6, 3) for k, v in top},
+        "category_top_ops_ms": {
+            cat: {k: round(v / 1e6, 3)
+                  for k, v in sorted(ops.items(),
+                                     key=lambda kv: -kv[1])[:4]}
+            for cat, ops in sorted(by_cat_op.items(),
+                                   key=lambda kv: -sum(kv[1].values()))},
     }
 
 

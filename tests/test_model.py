@@ -224,3 +224,30 @@ def test_remat_policies_same_loss_and_grads():
     c = dataclasses.replace(cfg, remat_policy="bogus")
     with pytest.raises(ValueError, match="remat_policy"):
         loss_fn(params, toks, c)
+
+
+def test_grouped_default_matches_expanded_attention(cfg, params):
+    """The default (projection-layout, grouped-GQA, no-transpose)
+    attention path must be numerically identical to the explicit
+    expand_gqa + dense_causal_attention path — the copy-elimination
+    rewrite (2026-07-31 profile: 69% of device time in copies) is a
+    layout change, not a math change."""
+    from nvme_strom_tpu.models.transformer import dense_causal_attention
+    assert cfg.n_kv_heads != cfg.n_heads      # the fixture must be GQA
+    tokens = jax.random.randint(jax.random.key(3), (2, 32), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    default_logits, _ = forward(params, tokens, cfg)
+    explicit_logits, _ = forward(params, tokens, cfg,
+                                 attn_fn=dense_causal_attention)
+    np.testing.assert_allclose(np.asarray(default_logits),
+                               np.asarray(explicit_logits),
+                               rtol=2e-4, atol=2e-4)
+
+    # gradients agree too (the bwd pass is where the transposes lived)
+    g_def = jax.grad(lambda p: loss_fn(p, tokens, cfg, None))(params)
+    g_exp = jax.grad(lambda p: loss_fn(
+        p, tokens, cfg, dense_causal_attention))(params)
+    for k in g_def:
+        np.testing.assert_allclose(np.asarray(g_def[k]),
+                                   np.asarray(g_exp[k]),
+                                   rtol=2e-3, atol=2e-4, err_msg=k)
