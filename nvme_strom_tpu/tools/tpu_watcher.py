@@ -208,18 +208,20 @@ def capture(device: str) -> bool:
         # 2400s budget on tunnel-speed compiles and landed nothing
         # (ledger 2026-07-31T01:14); per-variant steps bound the loss
         # to one point each.
+        # b16:none stays as the OOM-boundary probe (its remote-compile
+        # 500 is informative and cheap); the bigger batches ride the
+        # flash kernel's O(s) attention memory instead of dots-remat —
+        # dense b16+ blows compile-time HBM, and remat=dots triggers
+        # the axon instant-garbage pathology (see suite_7_dots_diag)
         ("suite_7_b16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "16:none"}),
-        ("suite_7_b32",
+        ("suite_7_b16_flash",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
-         {"STROM_TRAIN_SWEEP": "32:dots"}),
-        ("suite_7_b64",
-         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
-         {"STROM_TRAIN_SWEEP": "64:dots"}),
+         {"STROM_TRAIN_SWEEP": "16:none:flash"}),
         ("suite_7_b32_flash",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
-         {"STROM_TRAIN_SWEEP": "32:dots:flash"}),
+         {"STROM_TRAIN_SWEEP": "32:none:flash"}),
         # model-size points (verdict #3: the MFU curve was still rising
         # at d=2048 — measure where it flattens; param counts sized to
         # keep fp32 params+grads+Adam inside the v5e's 16 GiB)
