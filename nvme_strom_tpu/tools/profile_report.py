@@ -32,6 +32,7 @@ import argparse
 import glob
 import json
 import os
+import re
 import shutil
 import sys
 import tempfile
@@ -46,22 +47,65 @@ def _log(msg: str) -> None:
 #: fusion).  HLO spellings: dot/convolution for MXU work; Pallas/flash
 #: kernels arrive as custom-call "tpu_custom_call".
 _CLASSES = (
-    ("matmul", ("convolution", "dot", "conv_", "%dot", "matmul")),
-    ("attention-kernel", ("tpu_custom_call", "custom-call", "flash",
-                          "pallas")),
-    ("copy", ("copy", "bitcast", "transpose", "reshape")),
+    ("matmul", ("convolution", "dot", "conv_", "%dot", "matmul",
+                "gemm")),
+    ("attention-kernel", ("tpu_custom_call", "custom-call", "custom_call",
+                          "flash", "pallas")),
+    ("copy", ("copy", "bitcast", "transpose", "reshape", "format")),
     ("reduce", ("reduce", "scatter", "gather", "sort", "select-and")),
     ("elementwise-fusion", ("fusion", "add", "multiply", "subtract",
-                            "divide", "exponential", "rsqrt", "tanh")),
+                            "divide", "exponential", "rsqrt", "tanh",
+                            "elementwise", "loop")),
 )
 
+#: "opcode(" right after the "= type[shape]{layout}" of an HLO line
+_OPCODE = re.compile(r"=\s*[a-z0-9]+\[[^\]]*\][^\s]*\s+([a-z0-9_-]+)\(")
 
-def classify(name: str) -> str:
-    low = name.lower()
+
+def _keyword_bucket(text: str):
+    low = text.lower()
     for bucket, keys in _CLASSES:
         if any(k in low for k in keys):
             return bucket
-    return "other"
+    return None
+
+
+def classify(name: str) -> str:
+    """Bucket an op by its own identity, NEVER its operands.
+
+    The 2026-07-31 window's headline-grade misattribution: TPU op
+    events carry the FULL HLO line (operands included), so any matmul
+    fusion consuming a ``%transpose`` operand keyword-matched "copy" —
+    the ledgered profile read "69% copy" for a step that was really
+    matmul-bound.  Classification now looks only at (in order) the
+    opcode after the "=", then the lhs instruction name (XLA names
+    fusions after their constituent ops), and for bare fusions falls
+    through to the name's constituents."""
+    lhs = name.split("=", 1)[0].strip()
+    m = _OPCODE.search(name)
+    if m and m.group(1) != "fusion":
+        b = _keyword_bucket(m.group(1))
+        if b is not None:
+            return b
+    return _keyword_bucket(lhs) or "other"
+
+
+#: xprof's own per-op category stat (present on TPU device planes) —
+#: authoritative when available; values like "convolution fusion",
+#: "loop fusion", "copy", "all-reduce", "custom-call"
+_CATEGORY_STAT_KEYS = ("hlo_category", "category")
+
+
+def event_bucket(ev) -> str:
+    """Bucket for one xplane event: the profiler's hlo_category stat
+    when present, else name-based :func:`classify`."""
+    try:
+        for k, v in ev.stats:
+            if str(k) in _CATEGORY_STAT_KEYS:
+                return _keyword_bucket(str(v)) or "other"
+    except Exception:
+        pass
+    return classify(ev.name)
 
 
 def parse_trace(trace_dir: str) -> dict:
@@ -92,7 +136,7 @@ def parse_trace(trace_dir: str) -> dict:
     module_spans = []       # (start, end) to bound the traced window
 
     def _tally(ev) -> None:
-        cat = classify(ev.name)
+        cat = event_bucket(ev)
         by_cat[cat] = by_cat.get(cat, 0.0) + ev.duration_ns
         # strip the "= <type> op(...)" tail: the lhs name keys the op;
         # full HLO text would blow up the ledger line

@@ -152,6 +152,31 @@ def test_profile_classify_first_match_wins():
     assert classify("%while.7") == "other"
 
 
+def test_profile_classify_ignores_operands():
+    """Classification must come from the op's own identity, never its
+    operand list — the 2026-07-31 window ledgered '69% copy' because a
+    matmul fusion consuming %transpose operands keyword-matched copy."""
+    from nvme_strom_tpu.tools.profile_report import classify, event_bucket
+    # full HLO line: dot op with a transposed operand — matmul, not copy
+    assert classify("%f.1 = bf16[8,16]{1,0} dot(%transpose.5, %p.2), "
+                    "lhs_contracting_dims={1}") == "matmul"
+    # explicit copy op with a dot-named operand — copy, not matmul
+    assert classify("%copy.9 = bf16[8]{0} copy(%dot.3)") == "copy"
+    # bare fusion: falls back to the lhs name's constituents
+    assert classify("%multiply_reduce_fusion.38 = f32[] fusion("
+                    "%custom-call.2), kind=kOutput") == "reduce"
+
+    class Ev:          # xprof's own category stat wins when present
+        name = "%fusion.212 = bf16[] fusion(%transpose.1)"
+        stats = [("hlo_category", "convolution fusion")]
+    assert event_bucket(Ev()) == "matmul"
+
+    class Ev2:         # no stat → name path
+        name = "%fusion.7 = bf16[] fusion(%p)"
+        stats = []
+    assert event_bucket(Ev2()) == "elementwise-fusion"
+
+
 def test_profile_report_capture_and_parse(capsys, monkeypatch):
     """End-to-end on the CPU backend: trace a tiny train variant, parse
     the xplane protobuf, and emit the one-line breakdown the watcher
