@@ -719,7 +719,7 @@ def _bench_cfg(train_override: bool = False):
     if spec:
         alias = {"d": "d_model", "L": "n_layers", "ff": "d_ff",
                  "heads": "n_heads", "kv": "n_kv_heads",
-                 "vocab": "vocab"}
+                 "vocab": "vocab", "xc": "xent_chunks"}
         try:
             kw = {}
             for part in spec.split(","):
@@ -1187,7 +1187,8 @@ def bench_train(device=None) -> tuple[float, str]:
     # distinguishable from the default-d2048 row in the ledger (every
     # field the STROM_TRAIN_CFG alias map can override appears)
     shape = (f"d={cfg.d_model} L={cfg.n_layers} ff={cfg.d_ff} "
-             f"h={cfg.n_heads}/{cfg.n_kv_heads} v={cfg.vocab}")
+             f"h={cfg.n_heads}/{cfg.n_kv_heads} v={cfg.vocab}"
+             + (f" xc={cfg.xent_chunks}" if cfg.xent_chunks > 1 else ""))
     return best[0] / 1e12, (f"{note} {shape} b={best[1]} s={seq} "
                             f"remat={best[2]} attn={best[3]} [{per}]")
 

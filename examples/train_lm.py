@@ -93,6 +93,10 @@ def main(argv=None) -> int:
                     metavar="NORM",
                     help="clip gradients to this global L2 norm before "
                          "the optimizer update (0 = off)")
+    ap.add_argument("--xent-chunks", type=int, default=0,
+                    help="cross-entropy over N sequence slices so the "
+                         "(b, s, vocab) logits never materialize — the "
+                         "memory lever for 100k+ vocabs (0 = off)")
     ap.add_argument("--accum-steps", type=int, default=1,
                     help="gradient-accumulation microbatches per step "
                          "(activation memory of global-batch/N)")
@@ -197,6 +201,9 @@ def main(argv=None) -> int:
     if args.remat != "none":
         import dataclasses
         cfg = dataclasses.replace(cfg, remat_policy=args.remat)
+    if args.xent_chunks > 1:
+        import dataclasses
+        cfg = dataclasses.replace(cfg, xent_chunks=args.xent_chunks)
     attn_fn = None
     if args.flash:
         from nvme_strom_tpu.ops.flash_attention import make_flash_attn

@@ -61,6 +61,10 @@ class TransformerConfig:
     # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable).
     # Takes precedence over ``remat`` when set.
     remat_policy: str = ""
+    # Cross-entropy in N sequence slices so (b, s, vocab) logits never
+    # materialize (chunked_xent) — essential at Llama-vocab sizes.
+    # 0/1 = the plain full-logits path.
+    xent_chunks: int = 0
 
     def __post_init__(self):
         if isinstance(self.rope_scaling, dict):
@@ -358,10 +362,12 @@ def mlp(x, p, prefix):
     return (gate * up) @ wmat(p, prefix + "w_down", x.dtype)
 
 
-def forward_with_aux(params: Dict, tokens: jax.Array,
-                     cfg: TransformerConfig, attn_fn=None
-                     ) -> tuple[jax.Array, jax.Array]:
-    """tokens (b, s) int32 → (logits (b, s, vocab) f32, aux_loss scalar).
+def forward_hidden(params: Dict, tokens: jax.Array,
+                   cfg: TransformerConfig, attn_fn=None
+                   ) -> tuple[jax.Array, jax.Array]:
+    """tokens (b, s) int32 → (final-norm hidden (b, s, d) in cfg.dtype,
+    aux_loss scalar) — everything up to but excluding the lm_head, so
+    the chunked cross-entropy can project vocab slices itself.
 
     aux_loss is the summed MoE load-balancing loss (0 for dense models)."""
     x = params["tok_embed"].astype(cfg.dtype)[tokens]
@@ -392,7 +398,14 @@ def forward_with_aux(params: Dict, tokens: jax.Array,
     for i in range(cfg.n_layers):
         x, a = one_layer(x, i)
         aux = aux + a
-    x = rms_norm(x, params["final_norm"], cfg.norm_eps)
+    return rms_norm(x, params["final_norm"], cfg.norm_eps), aux
+
+
+def forward_with_aux(params: Dict, tokens: jax.Array,
+                     cfg: TransformerConfig, attn_fn=None
+                     ) -> tuple[jax.Array, jax.Array]:
+    """tokens (b, s) int32 → (logits (b, s, vocab) f32, aux_loss scalar)."""
+    x, aux = forward_hidden(params, tokens, cfg, attn_fn)
     logits = (x @ wmat(params, "lm_head", x.dtype)).astype(jnp.float32)
     return logits, aux
 
@@ -403,12 +416,66 @@ def forward(params: Dict, tokens: jax.Array,
     return forward_with_aux(params, tokens, cfg, attn_fn)[0]
 
 
+def chunked_xent(params, hidden, tokens, cfg) -> jax.Array:
+    """Mean next-token NLL without ever materializing (b, s, vocab).
+
+    The full-logits path peaks at b·s·vocab f32 — ~4 GiB for the Llama-3
+    flagship (vocab 128k, b8 s1024) against a 16 GiB chip.  Here the
+    sequence is scanned in ``cfg.xent_chunks`` slices: each step
+    projects one (b, s/n, d) slice through the lm_head, reduces it to
+    its logsumexp and target logit, and ``jax.checkpoint`` drops the
+    slice's logits so the backward pass recomputes them — peak logits
+    memory is one slice, forward and backward.
+
+    Chunks split the FULL ``s`` positions (so power-of-two chunk counts
+    divide power-of-two sequence lengths); the final position — which
+    has no next token — carries weight 0 instead of being sliced off,
+    which would leave the awkward odd length s-1.  Numerically
+    identical to log_softmax + gather (pinned by tests/test_model.py)."""
+    b, s, d = hidden.shape
+    n = cfg.xent_chunks
+    if s % n:
+        raise ValueError(
+            f"xent_chunks={n} must divide the sequence length {s}")
+    c = s // n
+    # target for position i is token i+1; the last position is padding
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
+    weights = jnp.concatenate(
+        [jnp.ones((b, s - 1), jnp.float32),
+         jnp.zeros((b, 1), jnp.float32)], axis=1)
+    hs = hidden.reshape(b, n, c, d).transpose(1, 0, 2, 3)   # (n, b, c, d)
+    ts = targets.reshape(b, n, c).transpose(1, 0, 2)
+    ws = weights.reshape(b, n, c).transpose(1, 0, 2)
+    w = wmat(params, "lm_head", hidden.dtype)
+
+    def chunk_nll(h, t, wt):
+        logits = (h @ w).astype(jnp.float32)        # (b, c, vocab)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tl = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        return ((lse - tl) * wt).sum()
+
+    def body(acc, htw):
+        return acc + jax.checkpoint(chunk_nll)(*htw), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                            (hs, ts, ws))
+    return total / (b * (s - 1))
+
+
 def loss_fn(params, tokens, cfg, attn_fn=None) -> jax.Array:
     """Next-token cross-entropy (tokens supply both input and target).
 
     The full sequence is forwarded and the last logit dropped — identical
     to forwarding tokens[:, :-1] for a causal model, but keeps the seq dim
-    a multiple of the ``sp`` shard count for ring attention."""
+    a multiple of the ``sp`` shard count for ring attention.
+
+    ``cfg.xent_chunks > 1`` switches to the chunked lm_head+softmax
+    (:func:`chunked_xent`) — the big-vocab activation-memory lever."""
+    if cfg.xent_chunks > 1:
+        hidden, aux = forward_hidden(params, tokens, cfg, attn_fn)
+        loss = chunked_xent(params, hidden, tokens, cfg)
+        return loss + cfg.router_aux_coef * aux
     logits, aux = forward_with_aux(params, tokens, cfg, attn_fn)
     logits = logits[:, :-1]
     targets = tokens[:, 1:]

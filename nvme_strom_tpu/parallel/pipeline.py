@@ -426,7 +426,7 @@ def make_pp_forward_with_aux(cfg: TransformerConfig, mesh,
                 n_mb=n_microbatches),
         mesh, in_specs=(specs, x_spec), out_specs=(x_spec, P()))
 
-    def fwd_with_aux(stack: Dict, rest: Dict, tokens: jax.Array):
+    def fwd_hidden_aux(stack: Dict, rest: Dict, tokens: jax.Array):
         B, s = tokens.shape
         # Validate against the *actual* sequence, not cfg.max_seq — a
         # caller with s != max_seq would otherwise pass the constructor
@@ -445,10 +445,14 @@ def make_pp_forward_with_aux(cfg: TransformerConfig, mesh,
         x = x.reshape(n_microbatches, B // n_microbatches, s, cfg.d_model)
         x, aux = run(stack, x)
         x = x.reshape(B, s, cfg.d_model)
-        x = rms_norm(x, rest["final_norm"], cfg.norm_eps)
+        return rms_norm(x, rest["final_norm"], cfg.norm_eps), aux
+
+    def fwd_with_aux(stack, rest, tokens):
+        x, aux = fwd_hidden_aux(stack, rest, tokens)
         logits = (x @ rest["lm_head"].astype(x.dtype)).astype(jnp.float32)
         return logits, aux
 
+    fwd_with_aux.hidden = fwd_hidden_aux
     return fwd_with_aux
 
 
@@ -466,10 +470,17 @@ def make_pp_forward(cfg: TransformerConfig, mesh, n_microbatches: int,
 def make_pp_loss(cfg, mesh, n_microbatches, **axes):
     """Next-token cross-entropy + router aux term — the pipelined mirror
     of transformer.loss_fn (same coef, same per-row grouping, so the two
-    agree to fp tolerance on MoE configs)."""
+    agree to fp tolerance on MoE configs).  ``cfg.xent_chunks > 1``
+    takes the chunked lm_head+softmax exactly like the unpipelined
+    loss (transformer.chunked_xent reads ``rest["lm_head"]``)."""
     fwd_aux = make_pp_forward_with_aux(cfg, mesh, n_microbatches, **axes)
 
     def loss_fn(stack, rest, tokens):
+        if cfg.xent_chunks > 1:
+            from nvme_strom_tpu.models.transformer import chunked_xent
+            hidden, aux = fwd_aux.hidden(stack, rest, tokens)
+            return (chunked_xent(rest, hidden, tokens, cfg)
+                    + cfg.router_aux_coef * aux)
         logits, aux = fwd_aux(stack, rest, tokens)
         logits = logits[:, :-1]
         targets = tokens[:, 1:]

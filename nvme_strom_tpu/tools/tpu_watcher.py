@@ -242,6 +242,15 @@ def capture(device: str) -> bool:
         ("suite_7_dots_diag",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "8:dots"}),
+        # Llama-vocab demonstration of the chunked cross-entropy: at
+        # v=131072 the full-logits path's b8·s1024·v f32 logits are
+        # ~4.3 GiB (+ their backward) — xc=8 scans the lm_head in
+        # sequence slices so the row fits where full logits cannot
+        ("suite_7_bigvocab",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1500,
+         {"STROM_TRAIN_SWEEP": "8:none",
+          "STROM_TRAIN_CFG": "d=2048,L=4,ff=5632,heads=16,kv=8,"
+                             "vocab=131072,xc=8"}),
         ("kernel_probe",
          [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
          1200, None),

@@ -251,3 +251,28 @@ def test_grouped_default_matches_expanded_attention(cfg, params):
         np.testing.assert_allclose(np.asarray(g_def[k]),
                                    np.asarray(g_exp[k]),
                                    rtol=2e-3, atol=2e-4, err_msg=k)
+
+
+def test_chunked_xent_matches_full_path(cfg):
+    """cfg.xent_chunks slices the lm_head+softmax; loss AND grads must
+    match the full-logits path (it's a memory layout, not new math)."""
+    import dataclasses
+    from nvme_strom_tpu.models.transformer import loss_fn as lf
+    params = init_params(jax.random.key(5), cfg)
+    tokens = jax.random.randint(jax.random.key(6), (2, 32), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    ccfg = dataclasses.replace(cfg, xent_chunks=4)   # 32 positions / 4
+    l_full, g_full = jax.value_and_grad(
+        lambda p: lf(p, tokens, cfg))(params)
+    l_chunk, g_chunk = jax.value_and_grad(
+        lambda p: lf(p, tokens, ccfg))(params)
+    np.testing.assert_allclose(float(l_full), float(l_chunk),
+                               rtol=1e-5, atol=1e-6)
+    for k in g_full:
+        np.testing.assert_allclose(np.asarray(g_full[k]),
+                                   np.asarray(g_chunk[k]),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+    # indivisible chunking refuses instead of silently truncating
+    bad = dataclasses.replace(cfg, xent_chunks=5)
+    with pytest.raises(ValueError, match="divide"):
+        lf(params, tokens, bad)

@@ -210,3 +210,17 @@ def test_pp_moe_aux_loss_matches_reference(moe_setup):
     got_no = float(jax.jit(make_pp_loss(cfg_no, mesh, 2))(stack, rest,
                                                           tokens))
     assert got > got_no
+
+
+def test_pp_loss_honors_xent_chunks(setup):
+    """cfg.xent_chunks must take effect on the pipelined loss too —
+    the flag exists to avoid (b, s, vocab) logits, and silently
+    materializing them in the pp path would be the exact OOM it
+    prevents."""
+    import dataclasses
+    cfg, params, tokens, ref = setup
+    ccfg = dataclasses.replace(cfg, xent_chunks=4)
+    mesh = _mesh((("pp", 2),))
+    stack, rest = split_layer_stack(params, ccfg)
+    got = float(jax.jit(make_pp_loss(ccfg, mesh, 4))(stack, rest, tokens))
+    assert got == pytest.approx(ref, rel=2e-2)
