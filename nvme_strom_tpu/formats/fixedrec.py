@@ -67,11 +67,19 @@ def write_fixedrec(path: Union[str, os.PathLike],
         "dtype": np.dtype(dtype).str,
         "shape": list(shape if shape is not None else (rec_bytes,)),
     }).encode()
-    with open(path, "wb") as f:
+    # temp + atomic rename: a concurrent reader (multi-host shard setup
+    # — one process writes, peers poll for the file) must never see a
+    # half-written shard; the footer-last layout alone can't guarantee
+    # that since exists+size checks pass mid-write
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
         for p in payload:
             f.write(p)
         f.write(meta)
         f.write(_TAIL.pack(len(meta), MAGIC))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
     return count
 
 

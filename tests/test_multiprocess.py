@@ -59,6 +59,26 @@ _WORKER = textwrap.dedent("""
     from nvme_strom_tpu.data.loader import ShardedLoader
     from nvme_strom_tpu.formats.fixedrec import write_fixedrec
     d = os.environ["STROM_TEST_DIR"]
+
+    # one writer (pid 0), peers poll.  Writers are ATOMIC (fixedrec
+    # tmp+rename; _write_tar below mirrors it), so a visible file is a
+    # complete file and exists+size is a sufficient readiness check.
+    import tarfile, io as _io, time
+
+    def _write_tar(path, rows, prefix):
+        tmp = path + ".tmp"
+        with tarfile.open(tmp, "w") as tf:
+            for i, row in enumerate(rows):
+                payload = row.tobytes()
+                ti = tarfile.TarInfo(f"{prefix}{i:04d}.bin")
+                ti.size = len(payload)
+                tf.addfile(ti, _io.BytesIO(payload))
+        os.replace(tmp, path)
+
+    def _await_files(paths):
+        while not all(os.path.exists(p) and os.path.getsize(p)
+                      for p in paths):
+            time.sleep(0.05)
     rng = np.random.default_rng(7)                 # SAME seed both procs
     rec = rng.integers(0, 255, size=(8, 4, 8)).astype(np.uint8)
     # global shard list; each process will read only its own slice
@@ -68,10 +88,7 @@ _WORKER = textwrap.dedent("""
         if pid == 0:                               # one writer
             write_fixedrec(p, rec[s * 4:(s + 1) * 4])
         paths.append(p)
-    import time
-    while not all(os.path.exists(p) and os.path.getsize(p) for p in paths):
-        time.sleep(0.05)
-    time.sleep(0.2)
+    _await_files(paths)
 
     # shard assignment is round-robin over the sorted path list, so
     # process p owns shard-p = rec[4p:4p+4]; a global batch of 4 takes 2
@@ -96,24 +113,15 @@ _WORKER = textwrap.dedent("""
     # must read the SAME shards (one batch-axis group), each slicing its
     # own sequence span at assembly — the round-1 advisor's case, plus
     # the shard-assignment grouping that makes the data consistent.
-    import tarfile, io as _io
     rng2 = np.random.default_rng(11)               # SAME seed both procs
     toks = rng2.integers(0, 1000, size=(8, 8)).astype(np.int32)
     tok_paths = []
     for s in range(2):
         p = os.path.join(d, f"tok-{s}.tar")
         if pid == 0:
-            with tarfile.open(p, "w") as tf:
-                for i in range(4):
-                    payload = toks[s * 4 + i].tobytes()
-                    ti = tarfile.TarInfo(f"{s}{i:04d}.bin")
-                    ti.size = len(payload)
-                    tf.addfile(ti, _io.BytesIO(payload))
+            _write_tar(p, toks[s * 4:(s + 1) * 4], prefix=str(s))
         tok_paths.append(p)
-    while not all(os.path.exists(p) and os.path.getsize(p)
-                  for p in tok_paths):
-        time.sleep(0.05)
-    time.sleep(0.3)
+    _await_files(tok_paths)
 
     mesh_sp = Mesh(devs, ("sp", "dp"))             # sp spans processes
     with ShardedLoader(tok_paths, mesh_sp, global_batch=4, fmt="wds",
@@ -142,17 +150,9 @@ _WORKER = textwrap.dedent("""
     for s in range(2):
         p = os.path.join(d, f"raw-{s}.tar")
         if pid == 0:
-            with tarfile.open(p, "w") as tf:
-                for i in range(4):
-                    payload = raw[s * 4 + i].tobytes()
-                    ti = tarfile.TarInfo(f"{s}{i:04d}.bin")
-                    ti.size = len(payload)
-                    tf.addfile(ti, _io.BytesIO(payload))
+            _write_tar(p, raw[s * 4:(s + 1) * 4], prefix=str(s))
         raw_paths.append(p)
-    while not all(os.path.exists(p) and os.path.getsize(p)
-                  for p in raw_paths):
-        time.sleep(0.05)
-    time.sleep(0.3)
+    _await_files(raw_paths)
     with ShardedLoader(raw_paths, mesh, global_batch=4,
                        fmt="wds_raw") as ld:
         bs = list(ld)
@@ -264,6 +264,43 @@ _WORKER = textwrap.dedent("""
         raise AssertionError("step mismatch not refused")
     except ValueError as e:
         assert "step" in str(e), e
+
+    # -- weighted mixture across processes: the per-step source draw is
+    # a pure function of (seed, step) — both processes pick the same
+    # corpus at the same step with no communication, so the global
+    # batch they assemble together comes from ONE dataset.  Dataset
+    # values are disjoint (<100 vs >=100): if the processes ever
+    # disagreed on the draw, the value-vs-source assertion would fail
+    # on one of them.
+    from nvme_strom_tpu.data import MixtureLoader
+    rng4 = np.random.default_rng(31)               # SAME seed both procs
+    recA = rng4.integers(0, 100, size=(8, 4, 8)).astype(np.uint8)
+    recB = (rng4.integers(0, 100, size=(8, 4, 8)) + 100).astype(np.uint8)
+    mix_paths = {}
+    for tag, rec_ in (("A", recA), ("B", recB)):
+        ps = []
+        for s in range(2):
+            p = os.path.join(d, f"mix{tag}-{s}.sfr")
+            if pid == 0:
+                write_fixedrec(p, rec_[s * 4:(s + 1) * 4])
+            ps.append(p)
+        mix_paths[tag] = ps
+    _await_files([p for ps in mix_paths.values() for p in ps])
+    with ShardedLoader(mix_paths["A"], mesh, global_batch=4,
+                       fmt="fixedrec") as la, \
+         ShardedLoader(mix_paths["B"], mesh, global_batch=4,
+                       fmt="fixedrec") as lb:
+        mix = MixtureLoader([(la, 1.0), (lb, 3.0)], seed=5)
+        seen = []
+        it = iter(mix)
+        for _ in range(6):                  # > one epoch: restarts too
+            batch, src = next(it)
+            v = int(np.asarray(batch.addressable_shards[0].data)[0, 0, 0])
+            assert (v >= 100) == (src == 1), (v, src)
+            seen.append(src)
+        it.close()
+    fresh = MixtureLoader([((), 1.0), ((), 3.0)], seed=5)
+    assert seen == [fresh._draw(t) for t in range(6)], seen
 
     print(f"proc{pid} OK", flush=True)
 """).replace("@REPO@", str(REPO))
