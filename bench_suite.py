@@ -483,6 +483,51 @@ def bench_dict_scan(engine, nbytes: int, cardinality: int = 4096,
                   f", idx_raw={idx_raw}")
 
 
+def bench_tar_index(engine, nbytes: int) -> tuple[float, str]:
+    """Config 16: WebDataset shard-index rate (members/s), native C
+    header walk vs Python tarfile — the first-epoch metadata cost of a
+    many-shard dataset.  Cold-cache per pass like every I/O row; the
+    member count scales with the suite budget (~4.5 KiB/member)."""
+    import tarfile as _tarfile
+    import io as _io
+    from nvme_strom_tpu.io.engine import tar_index
+    d = _scratch_dir()
+    members = max(1000, nbytes // 4608)
+    path = os.path.join(d, "tar_index.tar")
+    tag = "tar_index"
+    if _needs_regen(tag, members) or not os.path.exists(path):
+        payload = b"x" * 4096
+        tmp = path + ".tmp"
+        with _tarfile.open(tmp, "w", format=_tarfile.GNU_FORMAT) as tf:
+            for i in range(members):
+                ti = _tarfile.TarInfo(f"train/{i:08d}.bin")
+                ti.size = len(payload)
+                tf.addfile(ti, _io.BytesIO(payload))
+        os.replace(tmp, path)
+        _mark_generated(tag, members)
+
+    def native():
+        t0 = time.monotonic()
+        n = len(tar_index(path))
+        dt = time.monotonic() - t0
+        assert n == members, (n, members)
+        return members / dt
+
+    def python():
+        t0 = time.monotonic()
+        with _tarfile.open(path, "r:") as tf:
+            n = sum(1 for m in tf if m.isfile())
+        dt = time.monotonic() - t0
+        assert n == members, (n, members)
+        return members / dt
+
+    r_native = _steady([path], native)
+    r_py = _steady([path], python)
+    return (r_native / 1e6,
+            f"members={members} native={r_native / 1e3:.0f}k/s "
+            f"tarfile={r_py / 1e3:.0f}k/s speedup={r_native / r_py:.1f}x")
+
+
 def bench_checkpoint_write(engine, nbytes: int) -> tuple[float, str]:
     """Config 9: the inverse path — checkpoint save bandwidth.  Times
     CheckpointManager.save end to end (tile snapshot, engine writes,
@@ -1275,6 +1320,12 @@ def run(configs: list[int]) -> list[dict]:
                  lambda: bench_opt_offload(engine), "GiB/s", False),
             15: ("parquet-topk-scan",
                  lambda: bench_topk(engine, nbytes), "GiB/s", True),
+            # metadata path, not payload: members/s of the shard-index
+            # header walk (native C vs tarfile in the tag) — the
+            # first-epoch cost of a many-shard WebDataset dataset
+            16: ("tar-index-rate",
+                 lambda: bench_tar_index(engine, nbytes), "Mmembers/s",
+                 False),
         }
         for c in configs:
             label, fn, unit, io_row = names[c]
@@ -1309,12 +1360,12 @@ def run(configs: list[int]) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 16))
+                    choices=range(1, 17))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = list(range(1, 16))
+        configs = list(range(1, 17))
     for line in run(configs):
         print(json.dumps(line), flush=True)
     return 0

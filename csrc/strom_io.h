@@ -223,6 +223,24 @@ int strom_backend_is_uring(strom_engine *eng);
  * `crc` is the running value (0 to start); returns the updated crc. */
 uint32_t strom_crc32c(const void *data, uint64_t len, uint32_t crc);
 
+/* Native tar shard indexer — the header walk that builds the
+ * WebDataset sample map (formats/wds.py) without a Python-loop per
+ * member: ustar (name+prefix), GNU longname ('L'), and pax ('x'
+ * path=/size= overrides) are understood; directories and other
+ * non-file members are skipped.  On success returns the number of
+ * regular-file entries and sets *out to a malloc'd packed buffer of
+ *
+ *   u64 data_offset | u64 size | u32 name_len | name bytes
+ *
+ * records totalling *out_bytes (caller frees with
+ * strom_tar_index_free).  Negative errno on IO error; -EBADMSG for a
+ * malformed archive (bad checksum, truncated header/data, broken pax
+ * records) and for member names over 4096 bytes — always loud, never
+ * a silent partial or truncated-key index. */
+int64_t strom_tar_index(const char *path, uint8_t **out,
+                        uint64_t *out_bytes);
+void strom_tar_index_free(uint8_t *buf);
+
 #ifdef __cplusplus
 }
 #endif

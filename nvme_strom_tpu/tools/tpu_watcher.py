@@ -267,6 +267,8 @@ def capture(device: str) -> bool:
          900, None),
         ("suite_15", [sys.executable, "bench_suite.py", "--config", "15"],
          900, None),
+        ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
+         900, None),
         ("suite_11_prefix",
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
          {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),

@@ -1,0 +1,138 @@
+"""Native tar indexer (csrc strom_tar_index) vs Python tarfile.
+
+The C walk must agree member-for-member with tarfile on every layout
+Python writers emit — ustar, GNU (longname 'L' records), pax (path=
+overrides) — and fail loudly on corruption rather than return a
+partial index.
+"""
+
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io.engine import tar_index
+
+
+def _write(path, names_sizes, fmt):
+    with tarfile.open(path, "w", format=fmt) as tf:
+        for name, size in names_sizes:
+            ti = tarfile.TarInfo(name)
+            ti.size = size
+            tf.addfile(ti, io.BytesIO(b"x" * size))
+        # a directory member: must be skipped by both sides
+        d = tarfile.TarInfo("somedir")
+        d.type = tarfile.DIRTYPE
+        tf.addfile(d)
+
+
+def _ref(path):
+    out = []
+    with tarfile.open(path, "r:") as tf:
+        for m in tf:
+            if m.isfile():
+                out.append((m.name, m.offset_data, m.size))
+    return out
+
+
+@pytest.mark.parametrize("fmt", [tarfile.USTAR_FORMAT,
+                                 tarfile.GNU_FORMAT,
+                                 tarfile.PAX_FORMAT])
+def test_matches_tarfile_all_formats(tmp_path, fmt):
+    rng = np.random.default_rng(0)
+    entries = [(f"sample{i:05d}.bin", int(rng.integers(0, 2000)))
+               for i in range(50)]
+    # a >100-char name: ustar splits into prefix/name, GNU uses an 'L'
+    # record, pax a path= override — all three spellings must decode
+    deep = "/".join(["verylongdirectoryname" + str(i) for i in range(6)])
+    entries.append((deep + "/payload.bin", 123))
+    entries.append(("empty.bin", 0))
+    p = tmp_path / "t.tar"
+    _write(p, entries, fmt)
+    assert tar_index(p) == _ref(p)
+
+
+def test_matches_tarfile_cli_style_archive(tmp_path):
+    """An archive streamed member-by-member with mixed sizes (512-byte
+    boundary cases: exactly one block, one byte over)."""
+    entries = [("a.bin", 512), ("b.bin", 513), ("c.bin", 511),
+               ("d.bin", 1)]
+    p = tmp_path / "t.tar"
+    _write(p, entries, tarfile.GNU_FORMAT)
+    assert tar_index(p) == _ref(p)
+
+
+def test_corrupt_header_fails_loudly(tmp_path):
+    p = tmp_path / "t.tar"
+    _write(p, [("a.bin", 100)], tarfile.USTAR_FORMAT)
+    raw = bytearray(p.read_bytes())
+    raw[150] ^= 0xFF          # inside the checksum field
+    p.write_bytes(bytes(raw))
+    with pytest.raises(ValueError, match="tar index failed"):
+        tar_index(p)
+
+
+def test_truncated_archive_fails_loudly(tmp_path):
+    p = tmp_path / "t.tar"
+    _write(p, [("a.bin", 4000)], tarfile.USTAR_FORMAT)
+    raw = p.read_bytes()
+    p.write_bytes(raw[:700])  # header promises more data than exists
+    with pytest.raises(ValueError, match="tar index failed"):
+        tar_index(p)
+
+
+def test_wds_index_native_and_python_agree(tmp_path):
+    """WdsShardIndex builds the same sample map through both paths."""
+    from nvme_strom_tpu.formats import write_wds_shard
+    from nvme_strom_tpu.formats.wds import WdsShardIndex
+    samples = [{"bin": bytes([i] * 64), "cls": str(i).encode()}
+               for i in range(32)]
+    p = tmp_path / "s.tar"
+    write_wds_shard(p, samples)
+    native = WdsShardIndex(p)
+    import os
+    os.environ["STROM_PY_TAR"] = "1"
+    try:
+        py = WdsShardIndex(p)
+    finally:
+        del os.environ["STROM_PY_TAR"]
+    assert native.order == py.order
+    assert native.samples == py.samples
+
+
+def _raw_header(name: bytes, size: int, typeflag: bytes) -> bytes:
+    h = bytearray(512)
+    h[0:len(name)] = name
+    h[124:136] = b"%011o\x00" % size
+    h[156:157] = typeflag
+    h[257:262] = b"ustar"
+    h[148:156] = b" " * 8
+    csum = sum(h)
+    h[148:156] = b"%06o\x00 " % csum
+    return bytes(h)
+
+
+def test_malformed_pax_record_fails_loudly(tmp_path):
+    """A pax payload like '2 ' used to underflow the record-length
+    math into an out-of-bounds read; it must be -EBADMSG instead."""
+    payload = b"2 "                    # reclen consumes digits+space,
+    pad = 512 - len(payload)          # leaving no room for key or \n
+    raw = (_raw_header(b"h", len(payload), b"x") + payload + b"\0" * pad
+           + _raw_header(b"a.bin", 0, b"0") + b"\0" * 1024)
+    p = tmp_path / "t.tar"
+    p.write_bytes(raw)
+    with pytest.raises(ValueError, match="tar index failed"):
+        tar_index(p)
+
+
+def test_overlong_member_name_fails_loudly(tmp_path):
+    """Names beyond the 4096-byte cap must error, never index the
+    member under a silently truncated ustar key."""
+    p = tmp_path / "t.tar"
+    with tarfile.open(p, "w", format=tarfile.PAX_FORMAT) as tf:
+        ti = tarfile.TarInfo("d/" + "x" * 5000)
+        ti.size = 1
+        tf.addfile(ti, io.BytesIO(b"y"))
+    with pytest.raises(ValueError, match="tar index failed"):
+        tar_index(p)
