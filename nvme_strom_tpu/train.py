@@ -1,0 +1,202 @@
+"""Library train loop: the consumer composition, packaged.
+
+The reference's reason to exist is feeding accelerator compute from
+NVMe (SURVEY.md §3.5); `examples/train_lm.py` demonstrates that
+composition end to end, and this module is the same composition as an
+API — what `models/serving.DecodeServer` is to `examples/serve.py`:
+
+    from nvme_strom_tpu.train import Trainer
+    with Trainer(cfg, ckpt_dir="run1", save_every=100,
+                 watchdog_s=300) as tr:
+        result = tr.fit(batches, steps=10_000)
+
+Owned concerns: mesh + shardings, param init / lazy NVMe warm-start /
+checkpoint resume, the jitted donated train step, save cadence
+(sync or collective-free async), hung-step watchdog, per-step hooks.
+Data stays an iterator of global batches — ShardedLoader,
+MixtureLoader, or anything else that yields (b, s) int32 arrays —
+because input policy (mixing, sharding, epochs) is the caller's
+domain.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional, Sequence
+
+__all__ = ["Trainer", "FitResult"]
+
+
+@dataclass
+class FitResult:
+    steps: int                 # global step after fit
+    last_loss: float
+    steps_per_s: float
+    resumed_from: Optional[int]
+
+
+class Trainer:
+    """See module docstring.  Parameters:
+
+    ``cfg``: TransformerConfig.  ``optimizer``: any optax
+    GradientTransformation (default adamw(lr)).  ``mesh``: jax Mesh
+    (default: all devices on dp).  ``ckpt_dir``: enables
+    checkpoint/resume through the engine's O_DIRECT writer;
+    ``save_every`` steps between saves (0 = only the final save),
+    ``async_save`` uses the collective-free background writer.
+    ``init_weights``: safetensors path/glob for a lazy NVMe warm-start
+    (ignored when a checkpoint exists — resume wins).  ``watchdog_s``:
+    per-step deadline; a hung step dumps stacks + engine counters.
+    ``hooks``: callables ``(step, loss, dt_s) -> None`` run after every
+    step (logging, schedules, early stopping via StopIteration).
+    """
+
+    def __init__(self, cfg, *, optimizer=None, lr: float = 3e-4,
+                 mesh=None, ckpt_dir=None, engine=None,
+                 attn_fn=None, accum_steps: int = 1,
+                 init_weights=None, save_every: int = 0,
+                 async_save: bool = False, watchdog_s: float = 0.0,
+                 seed: int = 0,
+                 hooks: Sequence[Callable] = ()):
+        import jax
+        import optax
+        from nvme_strom_tpu.io import StromEngine
+        from nvme_strom_tpu.models.transformer import (init_params,
+                                                       make_train_step)
+        from nvme_strom_tpu.parallel.mesh import make_mesh
+        from nvme_strom_tpu.parallel.shardings import (
+            batch_shardings, param_shardings, replicate_scalars)
+
+        self.cfg = cfg
+        self.mesh = mesh or make_mesh({"dp": -1, "tp": 1})
+        self.optimizer = optimizer or optax.adamw(lr)
+        self.hooks = list(hooks)
+        self._own_engine = engine is None
+        self.engine = engine or StromEngine()
+        self.save_every = int(save_every)
+        self.async_save = bool(async_save)
+        self._closed = False
+
+        self._wd = None
+        if watchdog_s > 0:
+            from nvme_strom_tpu.utils.watchdog import StepWatchdog
+            self._wd = StepWatchdog(watchdog_s, engine=self.engine)
+
+        p_sh = param_shardings(cfg, self.mesh)
+        self._b_sh = batch_shardings(self.mesh)
+
+        self.manager = None
+        start = None
+        if ckpt_dir is not None:
+            from nvme_strom_tpu.checkpoint.manager import CheckpointManager
+            self.manager = CheckpointManager(ckpt_dir, engine=self.engine)
+            start = self.manager.latest_step()
+
+        if init_weights is not None and start is None:
+            from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+            params = LazyCheckpoint(init_weights).load_sharded(
+                p_sh, engine=self.engine)
+        else:
+            params = init_params(jax.random.key(seed), cfg)
+            params = {k: jax.device_put(v, p_sh[k])
+                      for k, v in params.items()}
+        opt_state = replicate_scalars(self.optimizer.init(params),
+                                      self.mesh)
+        if start is not None:
+            params, opt_state = self.manager.restore((params, opt_state))
+        self.resumed_from = start
+        self.step = start or 0
+        self._last_saved = start     # a resumed step is already on disk
+        self.params, self.opt_state = params, opt_state
+
+        self._step_fn = jax.jit(
+            make_train_step(cfg, self.optimizer, attn_fn=attn_fn,
+                            accum_steps=accum_steps),
+            in_shardings=(p_sh, None, self._b_sh),
+            out_shardings=(p_sh, None, None),
+            donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+
+    def fit(self, batches: Iterable, steps: int) -> FitResult:
+        """Run until global step ``steps`` (absolute, so a resumed run
+        finishes the same schedule).  Saves every ``save_every`` steps
+        and always at the end; a hook raising StopIteration stops
+        early (after a final save)."""
+        import jax
+        if self.step >= steps:
+            return FitResult(self.step, float("nan"), 0.0,
+                             self.resumed_from)
+        from contextlib import nullcontext
+        it = iter(batches)
+        loss = None
+        t0 = time.monotonic()
+        n0 = self.step
+        try:
+            while self.step < steps:
+                ts = time.monotonic()
+                ctx = (self._wd.step(f"step {self.step + 1}")
+                       if self._wd else nullcontext())
+                # the arm covers the WHOLE iteration — input wait, the
+                # step, the loss host-sync, the cadence save: a stalled
+                # prefetch or a wedged save is exactly what the
+                # watchdog exists to surface (examples/train_lm.py arms
+                # the same span)
+                with ctx:
+                    tokens = next(it)
+                    self.params, self.opt_state, loss = self._step_fn(
+                        self.params, self.opt_state, tokens)
+                    lossf = float(loss)
+                    self.step += 1
+                    if (self.manager is not None and self.save_every
+                            and self.step % self.save_every == 0):
+                        self._save()
+                for h in self.hooks:
+                    h(self.step, lossf, time.monotonic() - ts)
+        except StopIteration:
+            pass                     # data exhausted or hook stop
+        if (self.manager is not None and loss is not None
+                and self._last_saved != self.step):
+            self._save()
+        if self.manager is not None:
+            self.manager.wait_pending()
+        wall = time.monotonic() - t0
+        return FitResult(self.step,
+                         float(loss) if loss is not None else float("nan"),
+                         (self.step - n0) / wall if wall > 0 else 0.0,
+                         self.resumed_from)
+
+    def _save(self) -> None:
+        state = (self.params, self.opt_state)
+        if self.async_save:
+            self.manager.save_async(self.step, state)
+        else:
+            self.manager.save(self.step, state)
+        self._last_saved = self.step
+
+    def save(self) -> None:
+        """Checkpoint now (blocking), regardless of cadence."""
+        if self.manager is None:
+            raise ValueError("Trainer built without ckpt_dir")
+        self.manager.save(self.step, (self.params, self.opt_state),
+                          force=True)
+
+    # ------------------------------------------------------------------
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.manager is not None:
+            self.manager.wait_pending()
+        if self._wd is not None:
+            self._wd.close()
+        if self._own_engine:
+            self.engine.close_all()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

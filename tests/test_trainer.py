@@ -1,0 +1,99 @@
+"""Trainer API: fit / checkpoint cadence / resume / hooks (CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from nvme_strom_tpu.models.transformer import tiny_config
+from nvme_strom_tpu.train import FitResult, Trainer
+
+
+def _batches(cfg, b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    while True:
+        yield rng.integers(0, cfg.vocab,
+                           size=(b, 32)).astype(np.int32)
+
+
+def test_fit_trains_and_checkpoints(tmp_path):
+    cfg = tiny_config()
+    seen = []
+    with Trainer(cfg, lr=3e-3, ckpt_dir=tmp_path / "ck", save_every=2,
+                 hooks=[lambda s, l, dt: seen.append((s, l))]) as tr:
+        res = tr.fit(_batches(cfg), steps=4)
+    assert isinstance(res, FitResult)
+    assert res.steps == 4 and res.resumed_from is None
+    assert np.isfinite(res.last_loss)
+    assert [s for s, _ in seen] == [1, 2, 3, 4]
+    assert res.steps_per_s > 0
+
+    # losses head down over a longer run (same API, fresh dir)
+    with Trainer(cfg, lr=3e-3) as tr2:
+        r2 = tr2.fit(_batches(cfg), steps=20)
+    assert r2.last_loss < seen[0][1]
+
+
+def test_resume_continues_schedule(tmp_path):
+    cfg = tiny_config()
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck", save_every=2) as tr:
+        tr.fit(_batches(cfg), steps=4)
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck") as tr2:
+        assert tr2.resumed_from == 4 and tr2.step == 4
+        res = tr2.fit(_batches(cfg, seed=1), steps=6)
+    assert res.steps == 6 and res.resumed_from == 4
+    # a third trainer sees the final checkpoint
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck") as tr3:
+        assert tr3.step == 6
+        # fit() to an already-reached target is a no-op
+        res3 = tr3.fit(_batches(cfg), steps=6)
+        assert res3.steps == 6
+
+
+def test_hook_stop_iteration_stops_early(tmp_path):
+    cfg = tiny_config()
+
+    def stop_at_3(step, loss, dt):
+        if step >= 3:
+            raise StopIteration
+
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck",
+                 hooks=[stop_at_3]) as tr:
+        res = tr.fit(_batches(cfg), steps=100)
+    assert res.steps == 3
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck") as tr2:
+        assert tr2.step == 3          # the early stop still saved
+
+
+def test_async_save_and_manual_save(tmp_path):
+    cfg = tiny_config()
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck", save_every=2,
+                 async_save=True) as tr:
+        tr.fit(_batches(cfg), steps=4)
+        tr.save()
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck") as tr2:
+        assert tr2.step == 4
+
+
+def test_save_without_manager_refused():
+    cfg = tiny_config()
+    with Trainer(cfg) as tr:
+        with pytest.raises(ValueError, match="ckpt_dir"):
+            tr.save()
+
+
+def test_data_exhaustion_at_save_boundary(tmp_path):
+    """Iterator ends exactly on a cadence save: the final save must not
+    collide with the step already on disk (FileExistsError repro)."""
+    cfg = tiny_config()
+
+    def two_batches():
+        g = _batches(cfg)
+        for _ in range(2):
+            yield next(g)
+
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck", save_every=2) as tr:
+        res = tr.fit(two_batches(), steps=10)
+    assert res.steps == 2
+    with Trainer(cfg, ckpt_dir=tmp_path / "ck") as tr2:
+        assert tr2.step == 2
