@@ -35,6 +35,10 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--batches", type=int, default=0,
                     help="cap on evaluated batches (0 = everything)")
+    ap.add_argument("--xent-chunks", type=int, default=0,
+                    help="evaluate the cross-entropy in N sequence "
+                         "slices — (b, s, vocab) logits never "
+                         "materialize (the 100k+-vocab memory lever)")
     ap.add_argument("--int4", action="store_true",
                     help="weight-only int4 (lm_head stays fp; combine "
                          "with --int8 for the int8-lm_head mixed "
@@ -85,6 +89,14 @@ def main(argv=None) -> int:
     def eval_loss(params, tokens):
         # PURE token cross-entropy — loss_fn would fold in the MoE
         # router aux penalty and inflate the metric on expert configs
+        if args.xent_chunks > 1:
+            import dataclasses
+            from nvme_strom_tpu.models.transformer import loss_fn
+            # aux coef zeroed == pure token CE through the library's
+            # own chunked path (no drift if its convention changes)
+            return loss_fn(params, tokens, dataclasses.replace(
+                cfg, xent_chunks=args.xent_chunks,
+                router_aux_coef=0.0))
         from nvme_strom_tpu.models.transformer import forward
         logits = forward(params, tokens, cfg)
         lp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), -1)
