@@ -1,11 +1,12 @@
 from nvme_strom_tpu.sql.parquet import EngineFile, ParquetScanner
 from nvme_strom_tpu.sql.groupby import (groupby_aggregate, sql_groupby,
-                                        sql_groupby_str, top_k_groups)
+                                        sql_groupby_str, sql_scalar_agg,
+                                        top_k_groups)
 from nvme_strom_tpu.sql.join import lookup_unique, star_join_groupby
 from nvme_strom_tpu.sql.topk import sql_topk
 from nvme_strom_tpu.sql.parser import SQLSyntaxError, parse_select, sql_query
 
 __all__ = ["EngineFile", "ParquetScanner", "groupby_aggregate",
-           "sql_groupby", "sql_groupby_str", "top_k_groups",
-           "lookup_unique", "star_join_groupby", "sql_topk",
-           "SQLSyntaxError", "parse_select", "sql_query"]
+           "sql_groupby", "sql_groupby_str", "sql_scalar_agg",
+           "top_k_groups", "lookup_unique", "star_join_groupby",
+           "sql_topk", "SQLSyntaxError", "parse_select", "sql_query"]

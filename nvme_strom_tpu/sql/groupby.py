@@ -361,10 +361,23 @@ def sql_groupby(scanner, key_column: str, value_column,
             "nulls='skip' supports a single value column (per-column "
             "NULL patterns would need per-column counts); aggregate "
             "one nullable column at a time")
+    return _fold_scan(scanner, key_column, vcols, single, num_groups,
+                      aggs, method, device, where, where_columns,
+                      where_ranges, nulls)
+
+
+def _fold_scan(scanner, key_column, vcols, single, num_groups, aggs,
+               method, device, where, where_columns, where_ranges,
+               nulls) -> Dict[str, jax.Array]:
+    """The one scan→fold body behind sql_groupby AND sql_scalar_agg:
+    WHERE pushdown, footer-statistics pruning, NULL masking and the
+    empty-prune contract live here once.  ``key_column=None`` folds
+    into a single global group (constant key)."""
     dev = device or jax.local_devices()[0]
     range_cols = [c for c, _, _ in where_ranges]
+    key_cols = [key_column] if key_column is not None else []
     cols_needed = list(dict.fromkeys(
-        [key_column, *vcols, *where_columns, *range_cols]))
+        [*key_cols, *vcols, *where_columns, *range_cols]))
     rgs = (scanner.prune_row_groups(where_ranges) if where_ranges
            else None)
     full_where = ((lambda cols: _range_mask(cols, where_ranges, where))
@@ -374,27 +387,32 @@ def sql_groupby(scanner, key_column: str, value_column,
             _zero_folds(num_groups, aggs,
                         0 if single else len(vcols)), aggs)
 
+    def keys_of(cols):
+        if key_column is not None:
+            return cols[key_column]
+        return jnp.zeros(cols[vcols[0]].shape[0], jnp.int32)
+
     def stream():
         if nulls == "skip":
             for cols, masks in iter_device_columns(
                     scanner, cols_needed, dev,
-                    narrow_int32=(key_column,), row_groups=rgs,
+                    narrow_int32=tuple(key_cols), row_groups=rgs,
                     nulls="mask"):
                 # AND every referenced column's validity — including
                 # WHERE/range columns: SQL's three-valued logic makes a
                 # NULL comparison unknown, which excludes the row (a
                 # zero-filled NULL would otherwise pass predicates)
-                base = masks[key_column]
+                base = None
                 for c in cols_needed:
-                    if c != key_column:
-                        base = base & masks[c]
-                yield (cols[key_column],
+                    base = (masks[c] if base is None
+                            else base & masks[c])
+                yield (keys_of(cols),
                        _stack_values(cols, vcols, single), cols, base)
         else:
             for cols in iter_device_columns(scanner, cols_needed, dev,
-                                            narrow_int32=(key_column,),
+                                            narrow_int32=tuple(key_cols),
                                             row_groups=rgs):
-                yield (cols[key_column],
+                yield (keys_of(cols),
                        _stack_values(cols, vcols, single), cols, None)
 
     return _stream_fold(stream(), num_groups, aggs, method, full_where)
@@ -423,6 +441,31 @@ def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
     if folds is None:
         raise ValueError("empty table")
     return finalize_folds(folds, aggs)
+
+
+def sql_scalar_agg(scanner, value_column,
+                   aggs: Sequence[str] = ("count", "sum", "mean"),
+                   method: str = "matmul", device=None,
+                   where=None, where_columns: Sequence[str] = (),
+                   where_ranges: Sequence[tuple] = (),
+                   nulls: str = "forbid") -> Dict[str, object]:
+    """``SELECT AGG(v), ... FROM parquet [WHERE ...]`` — no GROUP BY.
+
+    One global group: the same streaming fold as :func:`sql_groupby`
+    with a constant key, so WHERE pushdown, footer-statistics row-group
+    pruning, NULL semantics and the empty-result contract are shared,
+    not re-derived.  Returns {agg: scalar} (or (n_columns,) arrays for
+    a ``value_column`` list)."""
+    _validate_query(aggs, method)
+    if nulls not in ("forbid", "skip"):
+        raise ValueError(f"bad nulls={nulls!r}")
+    where_ranges = list(where_ranges)
+    vcols, single = _value_cols(value_column)
+    if nulls == "skip" and not single:
+        raise ValueError("nulls='skip' supports a single value column")
+    res = _fold_scan(scanner, None, vcols, single, 1, aggs, method,
+                     device, where, where_columns, where_ranges, nulls)
+    return {a: res[a][0] for a in res}
 
 
 def sql_groupby_str(scanner, key_column: str, value_column,

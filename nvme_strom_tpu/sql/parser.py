@@ -29,6 +29,8 @@ than silently falling back):
 Planning rules (each maps to one streaming executor — the query never
 materializes the table):
 
+- aggregates without GROUP BY       → ``sql_scalar_agg`` (one global
+  group, same WHERE pushdown / stats pruning)
 - GROUP BY over an integer key      → ``sql_groupby``   (num_groups
   derived from footer statistics when possible)
 - GROUP BY over a string key        → ``sql_groupby_str`` (dictionary
@@ -462,6 +464,9 @@ def sql_query(sql: str, tables, *, num_groups: Optional[int] = None,
         q.group_by = _unqual(q.group_by, q.table)
         return _run_groupby(q, sc, num_groups=num_groups, device=device,
                             method=method, nulls=nulls)
+    if any(it.agg is not None for it in q.select) and not q.order_by:
+        return _run_scalar_agg(q, sc, device=device, method=method,
+                               nulls=nulls)
     if q.order_by:
         return _run_topk(q, sc, device=device, nulls=nulls)
     if nulls != "forbid":
@@ -602,13 +607,68 @@ def _as_device(v):
     return v if hasattr(v, "devices") else jnp.asarray(v)
 
 
+def _run_scalar_agg(q: Query, sc, *, device, method, nulls):
+    """SELECT AGG(col), ... FROM t [WHERE ...] — one global group."""
+    import numpy as np
+    from nvme_strom_tpu.sql.groupby import sql_scalar_agg
+    agg_items, bare = _agg_items(q)
+    if bare:
+        raise SQLSyntaxError(
+            f"bare column {bare[0].column!r} without GROUP BY — "
+            "aggregate it or add GROUP BY")
+    if q.order_by or q.having:
+        raise SQLSyntaxError("ORDER BY/HAVING need GROUP BY (a scalar "
+                             "aggregate is one row)")
+    has_count_star = any(it.agg == "count" and it.column is None
+                         for it in agg_items)
+    if has_count_star and nulls == "skip":
+        raise SQLSyntaxError(
+            "COUNT(*) counts rows, but nulls='skip' drops NULL rows "
+            "from the stream and would undercount — count a named "
+            "column instead")
+    if (not q.where
+            and all(it.agg == "count" and it.column is None
+                    for it in agg_items)):
+        # bare COUNT(*): the footer already knows — zero payload I/O
+        import numpy as np
+        return {it.name: np.int64(sc.num_rows) for it in agg_items}
+    vcols = list(dict.fromkeys(it.column for it in agg_items
+                               if it.column is not None))
+    if not vcols:       # COUNT(*) alone still needs a column to stream
+        md = sc.metadata
+        numeric = [md.schema.column(i).name
+                   for i in range(md.num_columns)
+                   if str(md.schema.column(i).physical_type)
+                   != "BYTE_ARRAY"]
+        if not numeric:
+            raise SQLSyntaxError("COUNT(*) needs at least one numeric "
+                                 "column in the table to stream")
+        vcols = [numeric[0]]
+    aggs = tuple(dict.fromkeys(it.agg for it in agg_items))
+    where_ranges, strict = _split_where(q.where)
+    where_fn, strict_cols = _strict_predicate(strict)
+    res = sql_scalar_agg(sc, vcols if len(vcols) > 1 else vcols[0],
+                         aggs=aggs, method=method, device=device,
+                         where=where_fn, where_columns=strict_cols,
+                         where_ranges=where_ranges, nulls=nulls)
+    out = {}
+    col_pos = {c: i for i, c in enumerate(vcols)}
+    for it in agg_items:
+        v = res[it.agg]
+        if getattr(v, "ndim", 0) >= 1:
+            v = (v[col_pos[it.column]] if it.column is not None
+                 else v[0])
+        out[it.name] = np.asarray(v)[()]
+    return out
+
+
 def _run_topk(q: Query, sc, *, device, nulls):
     import numpy as np
     from nvme_strom_tpu.sql.topk import sql_topk
     agg_items, bare = _agg_items(q)
     if agg_items:
         raise SQLSyntaxError("aggregates without GROUP BY are not "
-                             "supported (add GROUP BY)")
+                             "supported with ORDER BY (add GROUP BY)")
     if q.limit is None:
         raise SQLSyntaxError("ORDER BY without LIMIT is unbounded; "
                              "add LIMIT")

@@ -86,7 +86,6 @@ def test_parse_full_query():
     ("SELECT k FROM t WHERE k != 3", "!="),
     ("SELECT SUM(*) FROM t", "COUNT"),
     ("SELECT k FROM t ORDER BY k", "LIMIT"),
-    ("SELECT SUM(v) FROM t", "GROUP BY"),
     ("SELECT k, v FROM", "end of query"),
     ("SELECT k FROM t GROUP BY k", "aggregate"),
     ("SELECT v FROM t GROUP BY k", "group key"),
@@ -307,3 +306,58 @@ def test_having_on_string_key_is_syntax_error(table):
     with pytest.raises(SQLSyntaxError, match="string columns"):
         sql_query("SELECT city, COUNT(v) AS n FROM t GROUP BY city "
                   "HAVING city > 5", sc)
+
+
+def test_scalar_aggregates_no_group_by(table):
+    sc, d = table
+    out = sql_query("SELECT COUNT(*), SUM(v), AVG(v), MIN(v), MAX(v) "
+                    "FROM t", sc)
+    assert out["count(*)"] == len(d["v"])
+    np.testing.assert_allclose(out["sum(v)"], d["v"].sum(), rtol=1e-3)
+    np.testing.assert_allclose(out["mean(v)"], d["v"].mean(), rtol=1e-3)
+    np.testing.assert_allclose(out["min(v)"], d["v"].min(), rtol=1e-6)
+    np.testing.assert_allclose(out["max(v)"], d["v"].max(), rtol=1e-6)
+
+
+def test_scalar_aggregates_with_where(table):
+    sc, d = table
+    out = sql_query("SELECT COUNT(*) AS n, SUM(v) AS s FROM t "
+                    "WHERE w > 0.5", sc)
+    keep = d["w"] > 0.5
+    assert out["n"] == keep.sum()
+    np.testing.assert_allclose(out["s"], d["v"][keep].sum(), rtol=1e-3)
+
+
+def test_scalar_aggregates_multi_column(table):
+    sc, d = table
+    out = sql_query("SELECT SUM(v), SUM(w) FROM t", sc)
+    np.testing.assert_allclose(out["sum(v)"], d["v"].sum(), rtol=1e-3)
+    np.testing.assert_allclose(out["sum(w)"], d["w"].sum(), rtol=1e-3)
+
+
+def test_scalar_agg_refusals(table):
+    sc, _ = table
+    with pytest.raises(SQLSyntaxError, match="bare column"):
+        sql_query("SELECT k, SUM(v) FROM t", sc)
+    with pytest.raises(SQLSyntaxError, match="GROUP BY"):
+        sql_query("SELECT SUM(v) FROM t ORDER BY v DESC LIMIT 3", sc)
+
+
+def test_bare_count_star_reads_no_payload(table, engine):
+    sc, d = table
+    engine.sync_stats()
+    before = dict(engine.stats.snapshot())
+    out = sql_query("SELECT COUNT(*) FROM t", sc)
+    engine.sync_stats()
+    after = dict(engine.stats.snapshot())
+    assert out["count(*)"] == len(d["k"])
+    read = (after.get("bytes_direct", 0) + after.get("bytes_fallback", 0)
+            - before.get("bytes_direct", 0)
+            - before.get("bytes_fallback", 0))
+    assert read == 0          # answered from the footer, zero payload
+
+
+def test_count_star_nulls_skip_refused(table):
+    sc, _ = table
+    with pytest.raises(SQLSyntaxError, match="undercount"):
+        sql_query("SELECT COUNT(*) FROM t", sc, nulls="skip")
