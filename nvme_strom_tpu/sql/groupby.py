@@ -14,7 +14,11 @@ Two jit-friendly formulations, both with static ``num_groups``:
 - ``method="scatter"``: ``jax.ops.segment_*`` (scatter-add lowering) for
   large G where the one-hot would dominate memory.
 
-Supported aggregates: count, sum, mean, min, max.
+Supported aggregates: count, sum, mean, min, max, var, std
+(var/std are SAMPLE statistics, n-1 denominator like SQL
+var_samp/stddev; computed from the one-pass sum-of-squares
+fold — fine at aggregate scale, with the usual cancellation
+caveat for |mean| >> std).
 """
 
 from __future__ import annotations
@@ -25,7 +29,7 @@ from typing import Dict, Sequence
 import jax
 import jax.numpy as jnp
 
-_AGGS = ("count", "sum", "mean", "min", "max")
+_AGGS = ("count", "sum", "mean", "min", "max", "var", "std")
 
 
 @partial(jax.jit, static_argnames=("num_groups", "aggs", "method",
@@ -48,7 +52,7 @@ def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
     partial results stay foldable across row groups (sql_groupby's
     incremental path)."""
     for a in aggs:
-        if a not in _AGGS:
+        if a not in _AGGS and a != "sum2":   # sum2: internal foldable
             raise ValueError(f"unknown aggregate {a!r}")
     if method not in ("matmul", "scatter"):
         raise ValueError(f"unknown method {method!r}")
@@ -77,9 +81,28 @@ def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
     summed = summed[:num_groups]
     count = count[:num_groups]
 
+    sum2 = None
+    if {"sum2", "var", "std"} & set(aggs):
+        sq = vals_f * vals_f
+        if method == "matmul":
+            sum2 = jnp.einsum("ng,nc->gc", onehot, sq,
+                              preferred_element_type=jnp.float32
+                              )[:num_groups]
+        else:
+            sum2 = jax.ops.segment_sum(sq, keys, G)[:num_groups]
+
     out: Dict[str, jax.Array] = {}
     if "count" in aggs:
         out["count"] = count.astype(jnp.int32)
+    if "sum2" in aggs:                    # raw foldable partial
+        out["sum2"] = sum2[:, 0] if squeeze else sum2
+    if {"var", "std"} & set(aggs):
+        var = _sample_var(count, summed, sum2)
+        if "var" in aggs:
+            out["var"] = var[:, 0] if squeeze else var
+        if "std" in aggs:
+            std = jnp.sqrt(var)
+            out["std"] = std[:, 0] if squeeze else std
     if "sum" in aggs or "mean" in aggs:
         if "sum" in aggs:
             out["sum"] = summed[:, 0] if squeeze else summed
@@ -116,10 +139,25 @@ def _range_mask(cols, where_ranges, where):
     return m
 
 
+def _sample_var(count, summed, sum2):
+    """(G,) count + (G, C) sum/sum2 -> sample variance (n-1), NaN for
+    n < 2, clamped at 0 against one-pass float cancellation."""
+    n = count.astype(jnp.float32)[:, None]
+    var = (sum2 - summed * summed / jnp.maximum(n, 1.0)) \
+        / jnp.maximum(n - 1.0, 1.0)
+    var = jnp.maximum(var, 0.0)
+    return jnp.where(n >= 2, var, jnp.nan)
+
+
 def _norm_aggs(aggs) -> tuple:
     """The foldable-aggregate set behind any requested aggs (mean folds
-    from sum/count at the end) — one rule for every fold producer."""
-    return tuple(sorted((set(aggs) | {"count", "sum"}) - {"mean"}))
+    from sum/count, var/std from count/sum/sum2, at the end) — one rule
+    for every fold producer."""
+    want = set(aggs)
+    folds = (want | {"count", "sum"}) - {"mean", "var", "std"}
+    if want & {"var", "std"}:
+        folds.add("sum2")
+    return tuple(sorted(folds))
 
 
 def _validate_query(aggs, method) -> None:
@@ -143,6 +181,8 @@ def _zero_folds(num_groups: int, aggs,
     f: Dict[str, jax.Array] = {
         "count": jnp.zeros((num_groups,), jnp.int32),
         "sum": jnp.zeros(vshape, jnp.float32)}
+    if "sum2" in aggs_norm:
+        f["sum2"] = jnp.zeros(vshape, jnp.float32)
     if "min" in aggs_norm:
         f["min"] = jnp.full(vshape, jnp.inf, jnp.float32)
     if "max" in aggs_norm:
@@ -276,6 +316,17 @@ def finalize_folds(folds: Dict[str, jax.Array],
         cf = count.astype(jnp.float32)
         mean = folds["sum"] / jnp.maximum(up(cf, folds["sum"]), 1.0)
         out["mean"] = jnp.where(up(cf, mean) > 0, mean, jnp.nan)
+    if {"var", "std"} & set(aggs):
+        sum_ = folds["sum"]
+        sum2 = folds["sum2"]
+        s1 = sum_ if sum_.ndim == 2 else sum_[:, None]
+        s2 = sum2 if sum2.ndim == 2 else sum2[:, None]
+        var = _sample_var(count, s1, s2)
+        var = var if sum_.ndim == 2 else var[:, 0]
+        if "var" in aggs:
+            out["var"] = var
+        if "std" in aggs:
+            out["std"] = jnp.sqrt(var)
     empty = count == 0
     if "min" in aggs:
         out["min"] = jnp.where(up(empty, folds["min"]), jnp.nan,
@@ -542,7 +593,7 @@ def sql_groupby_str(scanner, key_column: str, value_column,
 def _fold(a: Dict[str, jax.Array], b: Dict[str, jax.Array]):
     out = {}
     for k in a:
-        if k == "count" or k == "sum":
+        if k in ("count", "sum", "sum2"):
             out[k] = a[k] + b[k]
         elif k == "min":
             out[k] = jnp.minimum(a[k], b[k])

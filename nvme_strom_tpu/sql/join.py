@@ -102,7 +102,9 @@ def star_join_groupby(fact_scanner, fact_key: str, fact_value: str,
     dkeys = dcols[dim_key].astype(kdt)
     dattr = dcols[dim_attr].astype(jnp.int32)
 
-    part_aggs = tuple(sorted((set(aggs) | {"count", "sum"}) - {"mean"}))
+    from nvme_strom_tpu.sql.groupby import _norm_aggs
+    part_aggs = _norm_aggs(aggs)   # ONE foldable-set rule (var/std
+                                   # fold via sum2, mean via sum/count)
     cols_needed = list(dict.fromkeys(
         [fact_key, fact_value, *where_columns]))
     folds = None

@@ -21,7 +21,7 @@ than silently falling back):
         [HAVING hconj [AND hconj ...]]
         [ORDER BY col|agg [ASC|DESC]]
         [LIMIT n]
-    item := col | COUNT(*) | {COUNT|SUM|MEAN|AVG|MIN|MAX}(col) [AS name]
+    item := col | COUNT(*) | {COUNT|SUM|MEAN|AVG|MIN|MAX|VAR|STD|STDDEV}(col) [AS name]
     conj := col {=|<|<=|>|>=} number | number {=|<|<=|>|>=} col
           | col BETWEEN number AND number
     hconj := agg|alias {=|<|<=|>|>=} number      (post-aggregation)
@@ -57,7 +57,9 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["SQLSyntaxError", "parse_select", "sql_query", "Query"]
 
-_AGG_FNS = ("count", "sum", "mean", "avg", "min", "max")
+_AGG_FNS = ("count", "sum", "mean", "avg", "min", "max", "var",
+            "std", "stddev")
+_AGG_ALIAS = {"avg": "mean", "stddev": "std"}
 _KEYWORDS = {"select", "from", "join", "on", "where", "and", "between",
              "group", "by", "having", "order", "asc", "desc", "limit",
              "as", "or", "not"}
@@ -244,7 +246,7 @@ def parse_select(sql: str) -> Query:
 def _parse_item(t: _Tokens) -> SelectItem:
     kind, v, pos = t.next()
     if kind == "id" and v.lower() in _AGG_FNS and t.peek("op", "("):
-        fn = "mean" if v.lower() == "avg" else v.lower()
+        fn = _AGG_ALIAS.get(v.lower(), v.lower())
         t.expect("op", "(")
         if t.accept("op", "*"):
             if fn != "count":
@@ -276,7 +278,7 @@ def _parse_order_target(t: _Tokens, clause: str = "ORDER BY") -> str:
     if kind != "id":
         raise SQLSyntaxError(f"bad {clause} target at {pos}: {v!r}")
     if v.lower() in _AGG_FNS and t.peek("op", "("):
-        fn = "mean" if v.lower() == "avg" else v.lower()
+        fn = _AGG_ALIAS.get(v.lower(), v.lower())
         t.expect("op", "(")
         col = None if t.accept("op", "*") else t.expect("id")
         t.expect("op", ")")

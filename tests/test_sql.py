@@ -535,3 +535,31 @@ def test_groupby_nulls_skip_pyarrow_fallback_branch(tmp_path, engine,
                         minlength=groups)
     np.testing.assert_array_equal(np.asarray(out["count"]), exp_c)
     np.testing.assert_allclose(np.asarray(out["sum"]), exp_s, rtol=2e-4)
+
+
+def test_groupby_var_std_vs_numpy(engine, pq_file, tmp_path):
+    """Sample variance/stddev (n-1) through the incremental fold: must
+    match numpy ddof=1 per group; single-row groups are NaN."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq_
+    path, tbl = pq_file
+    sc = ParquetScanner(path, engine)
+    out = sql_groupby(sc, "k", "v", 37, aggs=("count", "var", "std"))
+    k = tbl.column("k").to_numpy()
+    v = tbl.column("v").to_numpy()
+    for g in (0, 17, 36):
+        m = k == g
+        np.testing.assert_allclose(np.asarray(out["var"])[g],
+                                   v[m].var(ddof=1), rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(out["std"])[g],
+                                   v[m].std(ddof=1), rtol=1e-3)
+    # a single-element group: sample variance undefined -> NaN
+    t2 = pa.table({"k": np.array([0, 1, 1], np.int32),
+                   "v": np.array([5.0, 1.0, 3.0], np.float32)})
+    p2 = str(tmp_path / "t2.parquet")
+    pq_.write_table(t2, p2)
+    out2 = sql_groupby(ParquetScanner(p2, engine), "k", "v", 2,
+                       aggs=("var",))
+    assert np.isnan(np.asarray(out2["var"])[0])
+    np.testing.assert_allclose(np.asarray(out2["var"])[1], 2.0,
+                               rtol=1e-6)

@@ -361,3 +361,31 @@ def test_count_star_nulls_skip_refused(table):
     sc, _ = table
     with pytest.raises(SQLSyntaxError, match="undercount"):
         sql_query("SELECT COUNT(*) FROM t", sc, nulls="skip")
+
+
+def test_var_std_aggregates(table):
+    sc, d = table
+    out = sql_query("SELECT k, VAR(v), STDDEV(v) FROM t GROUP BY k", sc)
+    for g in (0, 11, 22):
+        m = d["k"] == g
+        np.testing.assert_allclose(out["var(v)"][g],
+                                   d["v"][m].var(ddof=1), rtol=1e-3)
+        np.testing.assert_allclose(out["std(v)"][g],
+                                   d["v"][m].std(ddof=1), rtol=1e-3)
+    scalar = sql_query("SELECT STD(v) AS s FROM t WHERE w > 0.5", sc)
+    np.testing.assert_allclose(scalar["s"],
+                               d["v"][d["w"] > 0.5].std(ddof=1),
+                               rtol=1e-3)
+
+
+def test_var_through_join(star):
+    tables, fact, attr_of = star
+    out = sql_query(
+        "SELECT d.attr, VAR(f.amount) FROM f JOIN d ON f.fk = d.dk "
+        "GROUP BY d.attr", tables)
+    attrs = np.array([attr_of[int(k)] for k in fact["fk"]])
+    for a in (0, 5):
+        m = attrs == a
+        np.testing.assert_allclose(out["var(f.amount)"][a],
+                                   fact["amount"][m].var(ddof=1),
+                                   rtol=1e-3)
