@@ -419,11 +419,14 @@ def sql_groupby(scanner, key_column: str, value_column,
 
 def _fold_scan(scanner, key_column, vcols, single, num_groups, aggs,
                method, device, where, where_columns, where_ranges,
-               nulls) -> Dict[str, jax.Array]:
+               nulls, finalize: bool = True) -> Dict[str, jax.Array]:
     """The one scan→fold body behind sql_groupby AND sql_scalar_agg:
     WHERE pushdown, footer-statistics pruning, NULL masking and the
     empty-prune contract live here once.  ``key_column=None`` folds
-    into a single global group (constant key)."""
+    into a single global group (constant key).  ``finalize=False``
+    returns the RAW foldable partials (count/sum/sum2/min/max with
+    segment identities) so a multi-file union can keep folding across
+    files before one final finalize (sql/multi.py)."""
     dev = device or jax.local_devices()[0]
     range_cols = [c for c, _, _ in where_ranges]
     key_cols = [key_column] if key_column is not None else []
@@ -434,9 +437,8 @@ def _fold_scan(scanner, key_column, vcols, single, num_groups, aggs,
     full_where = ((lambda cols: _range_mask(cols, where_ranges, where))
                   if (where_ranges or where is not None) else None)
     if rgs is not None and not rgs:    # statistics excluded everything
-        return finalize_folds(
-            _zero_folds(num_groups, aggs,
-                        0 if single else len(vcols)), aggs)
+        zero = _zero_folds(num_groups, aggs, 0 if single else len(vcols))
+        return finalize_folds(zero, aggs) if finalize else zero
 
     def keys_of(cols):
         if key_column is not None:
@@ -466,11 +468,13 @@ def _fold_scan(scanner, key_column, vcols, single, num_groups, aggs,
                 yield (keys_of(cols),
                        _stack_values(cols, vcols, single), cols, None)
 
-    return _stream_fold(stream(), num_groups, aggs, method, full_where)
+    return _stream_fold(stream(), num_groups, aggs, method, full_where,
+                        finalize=finalize)
 
 
 def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
-                 method: str, where) -> Dict[str, jax.Array]:
+                 method: str, where,
+                 finalize: bool = True) -> Dict[str, jax.Array]:
     """Fold per-row-group partial aggregates into the final result.
 
     ``stream`` yields (keys, values, cols-for-where, base_mask) per row
@@ -491,7 +495,7 @@ def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
         folds = part if folds is None else _fold(folds, part)
     if folds is None:
         raise ValueError("empty table")
-    return finalize_folds(folds, aggs)
+    return finalize_folds(folds, aggs) if finalize else folds
 
 
 def sql_scalar_agg(scanner, value_column,

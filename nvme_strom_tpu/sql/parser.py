@@ -377,18 +377,38 @@ def _strict_predicate(strict):
 
 
 def _resolve(tables, name, engine):
+    """A table entry may be a scanner, a path, a LIST of either, or a
+    directory path ending in '/' — lists/directories resolve to a
+    multi-file dataset (executed via sql/multi.py)."""
     from nvme_strom_tpu.sql.parquet import ParquetScanner
     if hasattr(tables, "num_row_groups"):     # a scanner: single table
         return tables
+    if isinstance(tables, (list, tuple)):     # a dataset AS the table
+        if engine is None and any(isinstance(x, (str, bytes))
+                                  for x in tables):
+            raise ValueError("dataset has paths; pass engine= to open "
+                             "them")
+        return [x if hasattr(x, "num_row_groups")
+                else ParquetScanner(x, engine) for x in tables]
     try:
         t = tables[name]
     except (KeyError, TypeError):
         raise KeyError(f"table {name!r} not in tables "
                        f"{sorted(tables) if hasattr(tables, 'keys') else tables!r}")
+    if isinstance(t, (list, tuple)):
+        if engine is None and any(isinstance(x, (str, bytes))
+                                  for x in t):
+            raise ValueError(f"table {name!r} has paths; pass engine=")
+        return [x if hasattr(x, "num_row_groups")
+                else ParquetScanner(x, engine) for x in t]
     if isinstance(t, (str, bytes)):
+        import os
         if engine is None:
             raise ValueError(f"table {name!r} is a path; pass engine= "
                              "to open it")
+        if os.path.isdir(t):
+            from nvme_strom_tpu.sql.multi import open_dataset
+            return open_dataset(t, engine)
         return ParquetScanner(t, engine)
     return t
 
@@ -501,8 +521,14 @@ def _run_groupby(q: Query, sc, *, num_groups, device, method, nulls):
     where_ranges, strict = _split_where(q.where)
     where_fn, strict_cols = _strict_predicate(strict)
 
-    str_key = _is_string_col(sc, q.group_by)
+    dataset = isinstance(sc, list)
+    str_key = _is_string_col(sc[0] if dataset else sc, q.group_by)
     if str_key:
+        if dataset:
+            raise SQLSyntaxError(
+                "string-keyed GROUP BY over a multi-file dataset is "
+                "not supported (per-file dictionaries would need a "
+                "global label union) — query files individually")
         if not vcols:
             raise SQLSyntaxError(
                 "COUNT(*) alone over a string key needs a numeric "
@@ -514,17 +540,31 @@ def _run_groupby(q: Query, sc, *, num_groups, device, method, nulls):
                               where_ranges=where_ranges)
         key_out = {q.group_by: list(res.pop("labels"))}
     else:
-        ng = num_groups or _derive_num_groups(sc, q.group_by)
+        if num_groups:
+            ng = num_groups
+        else:
+            derived = [_derive_num_groups(s, q.group_by)
+                       for s in (sc if dataset else [sc])]
+            ng = (None if any(d is None for d in derived)
+                  else max(derived))
         if ng is None:
             raise ValueError(
                 f"GROUP BY {q.group_by}: footer statistics are absent; "
                 "pass num_groups= explicitly")
         value_column = (vcols if len(vcols) > 1 else
                         (vcols[0] if vcols else q.group_by))
-        res = sql_groupby(sc, q.group_by, value_column, ng, aggs=aggs,
-                          method=method, device=device, where=where_fn,
-                          where_columns=strict_cols,
-                          where_ranges=where_ranges, nulls=nulls)
+        if dataset:
+            from nvme_strom_tpu.sql.multi import multi_groupby
+            res = multi_groupby(sc, q.group_by, value_column, ng,
+                                aggs=aggs, method=method, device=device,
+                                where=where_fn,
+                                where_columns=strict_cols,
+                                where_ranges=where_ranges, nulls=nulls)
+        else:
+            res = sql_groupby(sc, q.group_by, value_column, ng,
+                              aggs=aggs, method=method, device=device,
+                              where=where_fn, where_columns=strict_cols,
+                              where_ranges=where_ranges, nulls=nulls)
         key_out = {q.group_by: np.arange(
             res[aggs[0]].shape[0], dtype=np.int64)}
 
@@ -628,16 +668,17 @@ def _run_scalar_agg(q: Query, sc, *, device, method, nulls):
             "COUNT(*) counts rows, but nulls='skip' drops NULL rows "
             "from the stream and would undercount — count a named "
             "column instead")
+    dataset = isinstance(sc, list)
     if (not q.where
             and all(it.agg == "count" and it.column is None
                     for it in agg_items)):
         # bare COUNT(*): the footer already knows — zero payload I/O
-        import numpy as np
-        return {it.name: np.int64(sc.num_rows) for it in agg_items}
+        n = (sum(s.num_rows for s in sc) if dataset else sc.num_rows)
+        return {it.name: np.int64(n) for it in agg_items}
     vcols = list(dict.fromkeys(it.column for it in agg_items
                                if it.column is not None))
     if not vcols:       # COUNT(*) alone still needs a column to stream
-        md = sc.metadata
+        md = (sc[0] if dataset else sc).metadata
         numeric = [md.schema.column(i).name
                    for i in range(md.num_columns)
                    if str(md.schema.column(i).physical_type)
@@ -649,10 +690,19 @@ def _run_scalar_agg(q: Query, sc, *, device, method, nulls):
     aggs = tuple(dict.fromkeys(it.agg for it in agg_items))
     where_ranges, strict = _split_where(q.where)
     where_fn, strict_cols = _strict_predicate(strict)
-    res = sql_scalar_agg(sc, vcols if len(vcols) > 1 else vcols[0],
-                         aggs=aggs, method=method, device=device,
-                         where=where_fn, where_columns=strict_cols,
-                         where_ranges=where_ranges, nulls=nulls)
+    value_column = vcols if len(vcols) > 1 else vcols[0]
+    if dataset:
+        from nvme_strom_tpu.sql.multi import multi_scalar_agg
+        res = multi_scalar_agg(sc, value_column, aggs=aggs,
+                               method=method, device=device,
+                               where=where_fn,
+                               where_columns=strict_cols,
+                               where_ranges=where_ranges, nulls=nulls)
+    else:
+        res = sql_scalar_agg(sc, value_column, aggs=aggs, method=method,
+                             device=device, where=where_fn,
+                             where_columns=strict_cols,
+                             where_ranges=where_ranges, nulls=nulls)
     out = {}
     col_pos = {c: i for i, c in enumerate(vcols)}
     for it in agg_items:
@@ -683,14 +733,23 @@ def _run_topk(q: Query, sc, *, device, nulls):
     cols = [it.column for it in bare if it.column != by]
     where_ranges, strict = _split_where(q.where)
     where_fn, strict_cols = _strict_predicate(strict)
-    res = sql_topk(sc, by, columns=cols, k=q.limit, descending=desc,
-                   device=device, where=where_fn,
-                   where_columns=strict_cols, where_ranges=where_ranges,
-                   nulls=nulls)
+    if isinstance(sc, list):
+        from nvme_strom_tpu.sql.multi import multi_topk
+        res = multi_topk(sc, by, columns=cols, k=q.limit,
+                         descending=desc, device=device, where=where_fn,
+                         where_columns=strict_cols,
+                         where_ranges=where_ranges, nulls=nulls)
+    else:
+        res = sql_topk(sc, by, columns=cols, k=q.limit,
+                       descending=desc, device=device, where=where_fn,
+                       where_columns=strict_cols,
+                       where_ranges=where_ranges, nulls=nulls)
     out = {}
     for it in bare:       # select order, aliases applied
         out[it.name] = np.asarray(res[it.column])
     out["_row"] = res["_row"]
+    if "_file" in res:
+        out["_file"] = res["_file"]
     out["_skipped_row_groups"] = res["_skipped_row_groups"]
     return out
 
@@ -700,6 +759,18 @@ def _run_projection(q: Query, sc, *, device):
     import numpy as np
     from nvme_strom_tpu.sql.groupby import (_range_mask,
                                             iter_device_columns)
+    if isinstance(sc, list):   # dataset: per-file scans, concatenated
+        parts = [_run_projection(q, s, device=device) for s in sc]
+        # drop fully-pruned members: their typeless np.empty((0,))
+        # placeholders would promote int columns to float64 in concat
+        nonempty = [p for p in parts
+                    if len(next(iter(p.values()))) > 0]
+        parts = nonempty or parts[:1]
+        out = {n: np.concatenate([p[n] for p in parts])
+               for n in parts[0]}
+        if q.limit is not None:
+            out = {n: v[:q.limit] for n, v in out.items()}
+        return out
     agg_items, bare = _agg_items(q)
     if agg_items:
         raise SQLSyntaxError("aggregates without GROUP BY are not "
@@ -742,6 +813,9 @@ def _run_join(q: Query, tables, *, num_groups, device, engine, method):
                              "is the supported join shape)")
     fact_sc = _resolve(tables, q.table, engine)
     dim_sc = _resolve(tables, q.join[0], engine)
+    if isinstance(fact_sc, list) or isinstance(dim_sc, list):
+        raise SQLSyntaxError("JOIN over a multi-file dataset is not "
+                             "supported — query per file")
     if fact_sc is dim_sc and q.table != q.join[0]:
         raise SQLSyntaxError("self-joins are not supported")
     dim_name = q.join[0]

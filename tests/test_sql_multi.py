@@ -1,0 +1,166 @@
+"""Multi-file dataset union: a directory of Parquet files as one table.
+
+The union must answer exactly like the concatenated single table —
+aggregates via cross-file folds, top-k via per-file candidates — with
+per-file row-group pruning still effective and schema drift refused.
+"""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io import StromEngine
+from nvme_strom_tpu.sql import (ParquetScanner, SQLSyntaxError,
+                                multi_topk, open_dataset, sql_query)
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+
+@pytest.fixture()
+def engine():
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                       buffer_pool_bytes=8 << 20)
+    with StromEngine(cfg, stats=StromStats()) as e:
+        yield e
+
+
+@pytest.fixture()
+def dataset(tmp_path, engine):
+    """Three files with disjoint-ish content + the concatenated truth."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(13)
+    frames = []
+    d = tmp_path / "ds"
+    d.mkdir()
+    for f in range(3):
+        n = 4000 + 1000 * f
+        data = {
+            "k": rng.integers(0, 11, n).astype(np.int32),
+            "v": (rng.standard_normal(n) + f).astype(np.float32),
+            # per-file disjoint ts ranges -> cross-file pruning works
+            "ts": (rng.integers(0, 1000, n) + 1000 * f).astype(np.int64),
+        }
+        pq.write_table(pa.table(data), d / f"part-{f}.parquet",
+                       row_group_size=1024)
+        frames.append(data)
+    full = {c: np.concatenate([fr[c] for fr in frames])
+            for c in frames[0]}
+    return str(d), full
+
+
+def test_dataset_groupby_matches_concat(dataset, engine):
+    d, full = dataset
+    out = sql_query("SELECT k, COUNT(*), SUM(v), AVG(v), STD(v) FROM t "
+                    "GROUP BY k", {"t": d}, engine=engine)
+    for g in range(11):
+        m = full["k"] == g
+        assert out["count(*)"][g] == m.sum()
+        np.testing.assert_allclose(out["sum(v)"][g], full["v"][m].sum(),
+                                   rtol=1e-3)
+        np.testing.assert_allclose(out["mean(v)"][g],
+                                   full["v"][m].mean(), rtol=1e-3)
+        np.testing.assert_allclose(out["std(v)"][g],
+                                   full["v"][m].std(ddof=1), rtol=1e-3)
+
+
+def test_dataset_scalar_and_count_star(dataset, engine):
+    d, full = dataset
+    out = sql_query("SELECT COUNT(*) FROM t", {"t": d}, engine=engine)
+    assert out["count(*)"] == len(full["k"])      # pure footer math
+    out2 = sql_query("SELECT SUM(v) AS s, MIN(v), MAX(v) FROM t "
+                     "WHERE ts >= 1500", {"t": d}, engine=engine)
+    keep = full["ts"] >= 1500
+    np.testing.assert_allclose(out2["s"], full["v"][keep].sum(),
+                               rtol=1e-3)
+    np.testing.assert_allclose(out2["max(v)"], full["v"][keep].max(),
+                               rtol=1e-6)
+
+
+def test_dataset_pruning_skips_whole_files(dataset, engine):
+    """ts ranges are per-file disjoint: a WHERE on file 2's range must
+    read less payload than the full scan (files 0/1 prune away)."""
+    d, full = dataset
+
+    def payload(sql):
+        engine.sync_stats()
+        s0 = engine.stats.snapshot()
+        before = s0["bytes_direct"] + s0["bytes_fallback"]
+        out = sql_query(sql, {"t": d}, engine=engine)
+        engine.sync_stats()
+        s1 = engine.stats.snapshot()
+        return out, s1["bytes_direct"] + s1["bytes_fallback"] - before
+
+    full_out, full_bytes = payload("SELECT COUNT(v) AS n FROM t")
+    out, pruned_bytes = payload("SELECT COUNT(v) AS n FROM t "
+                                "WHERE ts BETWEEN 2000 AND 2999")
+    m = (full["ts"] >= 2000) & (full["ts"] <= 2999)
+    assert out["n"] == m.sum()
+    assert full_out["n"] == len(full["v"])
+    # file 2 holds ~6/13 of the rows; the pruned scan must read well
+    # under the full scan's payload (it also reads the ts column, so
+    # compare against the whole, not an exact fraction)
+    assert pruned_bytes < full_bytes * 0.8, (pruned_bytes, full_bytes)
+
+
+def test_dataset_topk_with_pruning_where(dataset, engine):
+    """WHERE that prunes whole member files must not kill the top-k
+    union (the empty members just contribute no candidates)."""
+    d, full = dataset
+    out = sql_query("SELECT v FROM t WHERE ts >= 2000 ORDER BY v DESC "
+                    "LIMIT 5", {"t": d}, engine=engine)
+    keep = full["ts"] >= 2000
+    np.testing.assert_allclose(out["v"],
+                               np.sort(full["v"][keep])[::-1][:5],
+                               rtol=1e-6)
+    assert set(out["_file"]) == {2}
+
+
+def test_dataset_topk_merges_files(dataset, engine):
+    d, full = dataset
+    out = sql_query("SELECT v, k FROM t ORDER BY v DESC LIMIT 7",
+                    {"t": d}, engine=engine)
+    np.testing.assert_allclose(out["v"], np.sort(full["v"])[::-1][:7],
+                               rtol=1e-6)
+    assert set(out["_file"]) <= {0, 1, 2}
+    # the global max lives in file 2 (its values are shifted by +2)
+    assert out["_file"][0] == 2
+
+
+def test_dataset_projection_and_refusals(dataset, engine, tmp_path):
+    d, full = dataset
+    out = sql_query("SELECT v FROM t WHERE ts < 500", {"t": d},
+                    engine=engine)
+    np.testing.assert_allclose(
+        np.sort(out["v"]), np.sort(full["v"][full["ts"] < 500]),
+        rtol=1e-6)
+    # fully-pruned members' empty placeholders must not promote the
+    # dtype (float64 leak from np.empty((0,)))
+    assert out["v"].dtype == np.float32
+    with pytest.raises(SQLSyntaxError, match="multi-file"):
+        sql_query("SELECT d.k, SUM(d.v) FROM d JOIN t ON d.k = t.k "
+                  "GROUP BY d.k", {"t": d, "d": d}, engine=engine)
+
+    # schema drift across members is refused loudly
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    drift = tmp_path / "ds" / "part-9.parquet"
+    pq.write_table(pa.table({"k": np.array([1], np.int32),
+                             "v": np.array([1], np.int64),   # v: int!
+                             "ts": np.array([1], np.int64)}), drift)
+    with pytest.raises(ValueError, match="schema mismatch"):
+        sql_query("SELECT k, SUM(v) FROM t GROUP BY k", {"t": d},
+                  engine=engine)
+
+
+def test_open_dataset_and_direct_api(dataset, engine):
+    d, full = dataset
+    scs = open_dataset(d, engine)
+    assert len(scs) == 3
+    out = multi_topk(scs, "v", columns=["k"], k=3)
+    np.testing.assert_allclose(out["v"], np.sort(full["v"])[::-1][:3],
+                               rtol=1e-6)
+    import os
+    empty = os.path.join(os.path.dirname(d), "empty_ds")
+    os.makedirs(empty, exist_ok=True)
+    with pytest.raises(ValueError, match="no .parquet"):
+        open_dataset(empty, engine)
