@@ -77,6 +77,81 @@ _log = bench._log
 _RUNS = 3
 
 
+class _SuiteWatchdog:
+    """Convert a mid-suite hang into a self-diagnosing row instead of a
+    silent timeout-burn.
+
+    The axon tunnel HANGS rather than errors when it dies under a
+    device op (ledger 2026-07-31T08:50: suite_15 finished all four topk
+    scans in ~3.5s each, then sat wedged in a device transfer until the
+    watcher's 900s kill — the round-3 verdict's weak #3).  Python can't
+    interrupt a hung ``block_until_ready``, so the only honest move is:
+    print WHERE we were wedged as a harvestable JSON line, flush, and
+    ``os._exit`` so the step ends at its budget instead of the watcher's
+    grace-period later.
+
+    Two modes:
+      * ``arm(budget_s)`` — fires while configs still run → rc=3
+        ("HUNG" row names the phase; work was incomplete, the watcher
+        retries the step);
+      * ``teardown(grace_s)`` — armed after every result line has been
+        printed; engine close / JAX runtime teardown hanging must not
+        cost the window anything → rc=0 (the results already landed).
+    """
+
+    def __init__(self) -> None:
+        self._phase = "startup"
+        self._t_phase = time.monotonic()
+        self._timer = None
+
+    def phase(self, name: str) -> None:
+        self._phase = name
+        self._t_phase = time.monotonic()
+
+    def _cancel(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def arm(self, budget_s: float) -> None:
+        import threading
+        self._cancel()
+        self._timer = threading.Timer(budget_s, self._fire_hung,
+                                      args=(budget_s,))
+        self._timer.daemon = True
+        self._timer.start()
+
+    def teardown(self, grace_s: float = 90.0) -> None:
+        import threading
+        self._cancel()
+        self.phase("teardown")
+        self._timer = threading.Timer(grace_s, self._fire_teardown,
+                                      args=(grace_s,))
+        self._timer.daemon = True
+        self._timer.start()
+
+    def _fire_hung(self, budget_s: float) -> None:
+        stuck_s = round(time.monotonic() - self._t_phase, 1)
+        print(json.dumps({
+            "metric": f"WATCHDOG-HUNG in {self._phase} "
+                      f"(stuck {stuck_s}s, budget {budget_s:.0f}s)",
+            "value": stuck_s, "unit": "s", "vs_baseline": None,
+        }), flush=True)
+        _log(f"suite: WATCHDOG — hung in {self._phase} for {stuck_s}s; "
+             "hard-exiting (rc=3) so the step ends at its budget")
+        sys.stderr.flush()
+        os._exit(3)
+
+    def _fire_teardown(self, grace_s: float) -> None:
+        _log(f"suite: WATCHDOG — teardown hung >{grace_s:.0f}s after all "
+             "results printed; hard-exiting rc=0 (results already landed)")
+        sys.stderr.flush()
+        os._exit(0)
+
+
+_WATCHDOG = _SuiteWatchdog()
+
+
 def _steady(evict_paths, timed_fn) -> float:
     """Warmup + _RUNS cold timed runs → median rate.
 
@@ -1240,10 +1315,22 @@ def bench_train(device=None) -> tuple[float, str]:
 
 # ------------------------------- main ----------------------------------
 
-def run(configs: list[int]) -> list[dict]:
+def run(configs: list[int], emit=None) -> list[dict]:
+    """Run ``configs``; returns the result rows.  ``emit`` (if given) is
+    called with each row THE MOMENT it exists — the watcher harvests
+    stdout even from a timed-out step, so a row printed before a tunnel
+    death still lands in the ledger (round-3 weak #3: suite_15 completed
+    its work, hung in teardown, and landed nothing)."""
     from nvme_strom_tpu.io import StromEngine
     from nvme_strom_tpu.utils.config import EngineConfig
     from nvme_strom_tpu.utils.stats import StromStats
+
+    # hang budget (STROM_SUITE_BUDGET_S, set by the watcher to its step
+    # timeout minus a margin): a wedged device op self-reports its phase
+    # instead of silently burning the watcher's timeout
+    budget_s = float(os.environ.get("STROM_SUITE_BUDGET_S", "0") or 0)
+    if budget_s > 0:
+        _WATCHDOG.arm(budget_s)
 
     nbytes = _suite_bytes()
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
@@ -1329,6 +1416,7 @@ def run(configs: list[int]) -> list[dict]:
         }
         for c in configs:
             label, fn, unit, io_row = names[c]
+            _WATCHDOG.phase(f"config{c}:{label}")
             val, extra = fn()
             tag = f"dev={dev_tag}"
             if isinstance(extra, str):
@@ -1346,11 +1434,20 @@ def run(configs: list[int]) -> list[dict]:
                 "vs_baseline": (round(val / ceiling, 3)
                                 if io_row and device_ok else None),
             })
+            if emit is not None:
+                emit(results[-1])
             ratio = results[-1]["vs_baseline"]
             _log(f"suite: config {c} {label}: {val:.3f} {unit} "
                  + (f"({ratio:.2f}x of target)" if ratio is not None
                     else f"(vs_baseline=null: "
                          f"{'no target' if not io_row else 'cpu fallback'})"))
+        # every result row is out the door: from here on a hang (engine
+        # close, JAX runtime teardown over a dead tunnel) must cost at
+        # most the grace period, and exits 0 — the evidence landed.
+        # Gated on the budget: a direct run() caller (REPL, test) that
+        # never asked for a watchdog must not get os._exit'd under it.
+        if budget_s > 0:
+            _WATCHDOG.teardown()
         engine.sync_stats()
     _log(f"suite: stats bounce={stats.bounce_bytes} "
          f"direct={stats.bytes_direct} fallback={stats.bytes_fallback}")
@@ -1366,8 +1463,7 @@ def main() -> int:
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
         configs = list(range(1, 17))
-    for line in run(configs):
-        print(json.dumps(line), flush=True)
+    run(configs, emit=lambda row: print(json.dumps(row), flush=True))
     return 0
 
 
