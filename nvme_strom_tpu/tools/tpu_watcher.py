@@ -426,6 +426,7 @@ def _captured_steps(ledger_path: str = None) -> set:
                     continue
                 if (rec.get("rc") == 0 and rec.get("results")
                         and str(rec.get("device", "")).startswith("tpu")
+                        and rec.get("valid") is not False
                         and not _looks_down(rec)
                         and not _suspect_results(rec)):
                     done.add(rec.get("step"))

@@ -1,0 +1,68 @@
+"""ledger_report: the one safe ledger consumer (round-3 verdict #4).
+
+The committed ledger deliberately keeps honest duds — timeouts, SUSPECT
+timing artifacts, rows tombstoned after a validity gate landed.  The
+report's contract is that aggregations ingest ONLY rows the watcher's
+own coverage gate would trust, and that every excluded row is listed
+with its reason rather than silently dropped."""
+
+import json
+
+from nvme_strom_tpu.tools import ledger_report as lr
+
+
+def _row(**kw):
+    base = {"step": "suite_5", "rc": 0, "device": "tpu TPU v5 lite0",
+            "ts": "2026-07-31T08:00:00Z",
+            "results": [{"metric": "config5:x (dev=tpu)", "value": 1.0,
+                         "unit": "GiB/s", "vs_baseline": 0.5}]}
+    base.update(kw)
+    return base
+
+
+def test_classify_accepts_clean_tpu_row():
+    assert lr.classify(_row()) is None
+
+
+def test_classify_rejects_each_failure_mode():
+    assert "tombstoned" in lr.classify(_row(valid=False,
+                                            invalid_reason="timing"))
+    assert lr.classify(_row(rc=-1, error="timeout after 900s")).startswith(
+        "rc=-1")
+    assert lr.classify(_row(results=[])) == "no results harvested"
+    assert "not tpu" in lr.classify(_row(device="cpu"))
+    assert "SUSPECT" in lr.classify(_row(results=[
+        {"metric": "config7 SUSPECT-TIMING mfu=120%", "value": 1.0}]))
+    # physically impossible MFU ledgered before the SUSPECT gate existed
+    assert "SUSPECT" in lr.classify(_row(results=[
+        {"metric": "config7 (mfu=4389.1%)", "value": 8647.0}]))
+    assert "tunnel death" in lr.classify(_row(results=[
+        {"metric": "x (dev=cpu-fallback-TUNNEL-DOWN)", "value": 1.0}]))
+
+
+def test_build_aggregates_only_valid_and_audits_rest(tmp_path):
+    ledger = tmp_path / "ledger.jsonl"
+    rows = [
+        _row(step="bench", results=[{
+            "metric": "NVMe->HBM (dev=tpu, interleaved raw=1.275 "
+                      "link=0.519 GiB/s)",
+            "value": 0.433, "unit": "GiB/s", "vs_baseline": 0.903}]),
+        _row(step="suite_7", valid=False, invalid_reason="timing"),
+        _row(step="suite_5", results=[{"metric": "config5 (dev=tpu)",
+                                       "value": 0.0298, "unit": "GiB/s",
+                                       "vs_baseline": 0.109}]),
+        _row(step="suite_5", rc=-1, error="timeout after 900s"),
+    ]
+    ledger.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    rep = lr.build(str(ledger))
+    assert rep["rows_total"] == 4 and rep["rows_valid"] == 2
+    # the bench row parsed its same-minute ceilings out of the metric
+    w = rep["north_star"]["windows"][0]
+    assert (w["ratio"], w["raw_gibs"], w["link_gibs"]) == (
+        0.903, 1.275, 0.519)
+    # latest valid suite_5 is the rc=0 one (line 3), not the later dud
+    assert rep["latest_valid_per_step"]["suite_5"]["line"] == 3
+    # both rejects listed with reasons — nothing silently dropped
+    whys = {r["line"]: r["why"] for r in rep["rejected"]}
+    assert set(whys) == {2, 4}
+    assert "tombstoned" in whys[2] and whys[4].startswith("rc=-1")
