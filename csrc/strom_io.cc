@@ -1338,9 +1338,11 @@ struct TarBuf {               /* growable packed result */
 };
 
 /* pax "len key=value\n" records: extract path= / size= overrides.
- * Returns 0, or -1 on a malformed record / an over-long path (the
- * caller turns that into -EBADMSG — never a silent partial parse:
- * kvlen underflow here was an OOB heap read before 2026-07-31). */
+ * Returns 0; -1 on a malformed record (caller: -EBADMSG — never a
+ * silent partial parse: kvlen underflow here was an OOB heap read
+ * before 2026-07-31); -2 when a path exceeds path_cap — a VALID
+ * archive this walker just doesn't support (caller: -ENOTSUP, so the
+ * Python side can fall back to tarfile). */
 static int pax_parse(const uint8_t *data, size_t n, char *path_out,
                      size_t path_cap, int *have_path,
                      int64_t *size_out, int *have_size) {
@@ -1359,7 +1361,8 @@ static int pax_parse(const uint8_t *data, size_t n, char *path_out,
     size_t kvlen = reclen - hdr - 1;   /* minus trailing \n */
     if (kvlen > 5 && memcmp(kv, "path=", 5) == 0) {
       size_t pl = kvlen - 5;
-      if (pl >= path_cap) return -1;   /* loud, not a truncated key */
+      if (pl >= path_cap) return -2;   /* valid archive, name beyond our
+                                        * cap: unsupported, not corrupt */
       memcpy(path_out, kv + 5, pl);
       path_out[pl] = 0;
       *have_path = 1;
@@ -1406,8 +1409,10 @@ extern "C" int64_t strom_tar_index(const char *path, uint8_t **out,
   while ((int64_t)(off + 512) <= st.st_size) {
     if (off < win_off || off + 512 > win_off + win_len) {
       ssize_t got = pread(fd, win, WIN, (off_t)off);
+      if (got < 0) { int e = errno; close(fd); free(win); free(buf.p);
+                     return -e; }     /* real I/O error, not corruption */
       if (got < 512) { close(fd); free(win); free(buf.p);
-                       return -EBADMSG; }
+                       return -EBADMSG; }  /* genuinely short: truncated */
       win_off = off;
       win_len = (uint64_t)got;
     }
@@ -1428,38 +1433,62 @@ extern "C" int64_t strom_tar_index(const char *path, uint8_t **out,
     uint8_t type = h[156];
     uint64_t data = off + 512;
     uint64_t adv = 512 + (((uint64_t)size + 511) & ~511ULL);
-    if (type == 'L' || type == 'x') {
-      /* override payload names/sizes the NEXT real header */
+    if (type == 'L' || type == 'x' || type == 'g') {
+      /* 'L'/'x' override the NEXT real header; 'g' sets GLOBAL pax
+       * defaults.  Error split (advisor round-3): -EBADMSG only for
+       * genuine corruption; a VALID archive using a feature this
+       * walker doesn't implement returns -ENOTSUP so the caller can
+       * fall back to tarfile instead of failing where it used to
+       * succeed. */
       size_t n = (size_t)size;
       if (n > sizeof(longname) * 4) { close(fd); free(win);
-                                free(buf.p); return -EBADMSG; }
+                                free(buf.p); return -ENOTSUP; }
       uint8_t *tmp = (uint8_t *)malloc(n + 1);
       if (!tmp) { close(fd); free(win); free(buf.p); return -ENOMEM; }
-      if (pread(fd, tmp, n, (off_t)data) != (ssize_t)n) {
+      ssize_t got = pread(fd, tmp, n, (off_t)data);
+      if (got != (ssize_t)n) {
+        int e = (got < 0) ? errno : EBADMSG;
         free(tmp); close(fd); free(win); free(buf.p);
-        return -EBADMSG;
+        return -e;
       }
       tmp[n] = 0;
-      int bad = 0;
+      int bad = 0;                   /* -EBADMSG: corrupt */
+      int unsup = 0;                 /* -ENOTSUP: valid, unimplemented */
       if (type == 'L') {
         size_t nl = strnlen((char *)tmp, n);
-        if (nl >= sizeof(longname)) bad = 1;  /* loud, never a silent
-                                                 truncated member key */
+        if (nl >= sizeof(longname)) unsup = 1;  /* loud, never a silent
+                                                   truncated member key */
         else {
           memcpy(longname, tmp, nl);
           longname[nl] = 0;
           have_long = 1;
         }
-      } else if (pax_parse(tmp, n, longname, sizeof(longname),
-                           &have_long, &pax_size, &have_pax_size) != 0) {
-        bad = 1;
+      } else if (type == 'g') {
+        /* Parse the global payload into throwaway slots purely to
+         * CLASSIFY it: global path=/size= overrides would change every
+         * later member's identity — indexing with raw header fields
+         * would be silently wrong, so that's unsupported; globals that
+         * carry neither (comment=, mtime=, ...) are safely ignored. */
+        char gpath[4097];
+        int g_have_path = 0, g_have_size = 0;
+        int64_t g_size = -1;
+        int rc = pax_parse(tmp, n, gpath, sizeof(gpath),
+                           &g_have_path, &g_size, &g_have_size);
+        if (rc == -2) unsup = 1;
+        else if (rc != 0) bad = 1;
+        else if (g_have_path || g_have_size) unsup = 1;
+      } else {
+        int rc = pax_parse(tmp, n, longname, sizeof(longname),
+                           &have_long, &pax_size, &have_pax_size);
+        if (rc == -2) unsup = 1;
+        else if (rc != 0) bad = 1;
       }
       free(tmp);
-      if (bad) { close(fd); free(win); free(buf.p); return -EBADMSG; }
+      if (bad || unsup) { close(fd); free(win); free(buf.p);
+                          return bad ? -EBADMSG : -ENOTSUP; }
       off += adv;
       continue;
     }
-    if (type == 'g') { off += adv; continue; }   /* global pax: ignore */
     if (have_pax_size) {            /* pax size overrides the header's */
       size = pax_size;
       adv = 512 + (((uint64_t)size + 511) & ~511ULL);

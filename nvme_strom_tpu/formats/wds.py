@@ -63,6 +63,11 @@ class WdsShardIndex:
                 return tar_index(self.path)
             except (OSError, ImportError, subprocess.SubprocessError):
                 pass   # library absent or unbuildable — Python fallback
+            except NotImplementedError:
+                pass   # valid archive, feature the C walker doesn't do
+                       # (global pax overrides, >4096-byte names):
+                       # tarfile handles these — corrupt archives still
+                       # raise ValueError loudly above
         out = []
         # tarfile parses headers only; data is skipped via seeks.
         with tarfile.open(self.path, "r:") as tf:

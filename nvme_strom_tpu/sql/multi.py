@@ -132,7 +132,12 @@ def multi_topk(scanners: Sequence, by: str,
                nulls: str = "forbid") -> Dict[str, np.ndarray]:
     """`sql_topk` over a file union: per-file top-k (each with its own
     LIMIT scan-elimination), merged host-side.  ``_file`` joins
-    ``_row`` in the provenance columns; ``_skipped_row_groups`` sums."""
+    ``_row`` in the provenance columns; ``_skipped_row_groups`` sums.
+
+    Tie order: rows with equal keys rank by (_file, _row) ascending in
+    both sort directions — deterministic where single-file ``sql_topk``
+    leaves ties unspecified (its streamed merge carries no provenance
+    to break them with)."""
     from nvme_strom_tpu.sql.topk import sql_topk
     where_ranges = list(where_ranges)   # a generator must not exhaust
     _check_schemas(scanners, [by, *columns])   # after file 0
@@ -155,11 +160,18 @@ def multi_topk(scanners: Sequence, by: str,
         raise ValueError("empty dataset (every file pruned away)")
     names = [by, *[c for c in columns if c != by], "_row", "_file"]
     merged = {n: np.concatenate([p[n] for p in parts]) for n in names}
-    # ascending stable sort + reversal: negating the key would wrap
-    # unsigned dtypes and INT64_MIN (the per-file merge kernel avoids
-    # negation the same way)
-    order = np.argsort(merged[by], kind="stable")
-    order = order[::-1] if descending else order
+    # Explicit tie-break on (_file, _row) ascending in BOTH directions
+    # (advisor round-3: a reversed stable sort returned descending ties
+    # in reverse file/row order).  The KEY column is never negated —
+    # that would wrap unsigned dtypes and INT64_MIN (the per-file merge
+    # kernel avoids negation the same way) — but the provenance columns
+    # are non-negative ordinals, so negating them to pre-reverse the
+    # tie order is safe.
+    if descending:
+        order = np.lexsort((-merged["_row"], -merged["_file"],
+                            merged[by]))[::-1]
+    else:
+        order = np.lexsort((merged["_row"], merged["_file"], merged[by]))
     order = order[:k]
     out = {n: merged[n][order] for n in names}
     out["_skipped_row_groups"] = skipped

@@ -34,30 +34,14 @@ import json
 import re
 import sys
 
-from nvme_strom_tpu.tools.tpu_watcher import (LEDGER, _looks_down,
-                                              _suspect_results)
+from nvme_strom_tpu.tools.tpu_watcher import LEDGER, classify_row
 
 _RAW_LINK = re.compile(r"raw=(\d+(?:\.\d+)?) link=(\d+(?:\.\d+)?)")
 
-
-def classify(rec: dict) -> str | None:
-    """None when the row is valid evidence; else the rejection reason.
-    One rule set, shared in spirit with the watcher's coverage gate —
-    a row the watcher would re-capture is a row no report may cite."""
-    if rec.get("valid") is False:
-        return "tombstoned: " + rec.get("invalid_reason", "(no reason)")
-    if rec.get("rc") != 0:
-        return (f"rc={rec.get('rc')}"
-                + (f" ({rec['error']})" if rec.get("error") else ""))
-    if not rec.get("results"):
-        return "no results harvested"
-    if not str(rec.get("device", "")).startswith("tpu"):
-        return f"device={rec.get('device')!r} (not tpu)"
-    if _looks_down(rec):
-        return "step observed tunnel death"
-    if _suspect_results(rec):
-        return "SUSPECT-tagged result (rate above device peak)"
-    return None
+#: the ONE validity rule set, shared with the watcher's coverage
+#: scheduler — a row the watcher would re-capture is a row no report
+#: may cite, and the two must never drift
+classify = classify_row
 
 
 def load(path: str) -> tuple[list, list]:

@@ -412,10 +412,31 @@ def _looks_down(rec: dict) -> bool:
                 json.dumps(r) for r in rec.get("results", [])))
 
 
+def classify_row(rec: dict) -> str | None:
+    """THE validity predicate for ledger rows — None when the row is
+    trustworthy on-silicon evidence, else the rejection reason.  One
+    definition, two consumers: the watcher's coverage scheduler (a row
+    this rejects gets its step re-captured) and tools/ledger_report
+    (a row this rejects may not be cited) — they must never drift."""
+    if rec.get("valid") is False:
+        return "tombstoned: " + rec.get("invalid_reason", "(no reason)")
+    if rec.get("rc") != 0:
+        return (f"rc={rec.get('rc')}"
+                + (f" ({rec['error']})" if rec.get("error") else ""))
+    if not rec.get("results"):
+        return "no results harvested"
+    if not str(rec.get("device", "")).startswith("tpu"):
+        return f"device={rec.get('device')!r} (not tpu)"
+    if _looks_down(rec):
+        return "step observed tunnel death"
+    if _suspect_results(rec):
+        return "SUSPECT-tagged result (rate above device peak)"
+    return None
+
+
 def _captured_steps(ledger_path: str = None) -> set:
-    """Step names that already landed a successful on-silicon result in
-    the ledger (rc==0, non-empty results, a tpu device, and the step
-    didn't observe the tunnel dying under it)."""
+    """Step names that already landed a valid on-silicon result in the
+    ledger (per classify_row)."""
     done = set()
     try:
         with open(ledger_path or LEDGER) as f:
@@ -424,11 +445,7 @@ def _captured_steps(ledger_path: str = None) -> set:
                     rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
-                if (rec.get("rc") == 0 and rec.get("results")
-                        and str(rec.get("device", "")).startswith("tpu")
-                        and rec.get("valid") is not False
-                        and not _looks_down(rec)
-                        and not _suspect_results(rec)):
+                if classify_row(rec) is None:
                     done.add(rec.get("step"))
     except OSError:
         pass

@@ -236,9 +236,9 @@ def test_grouped_default_matches_expanded_attention(cfg, params):
     assert cfg.n_kv_heads != cfg.n_heads      # the fixture must be GQA
     tokens = jax.random.randint(jax.random.key(3), (2, 32), 0,
                                 cfg.vocab, dtype=jnp.int32)
-    default_logits, _ = forward(params, tokens, cfg)
-    explicit_logits, _ = forward(params, tokens, cfg,
-                                 attn_fn=dense_causal_attention)
+    default_logits = forward(params, tokens, cfg)
+    explicit_logits = forward(params, tokens, cfg,
+                              attn_fn=dense_causal_attention)
     np.testing.assert_allclose(np.asarray(default_logits),
                                np.asarray(explicit_logits),
                                rtol=2e-4, atol=2e-4)

@@ -164,3 +164,27 @@ def test_open_dataset_and_direct_api(dataset, engine):
     os.makedirs(empty, exist_ok=True)
     with pytest.raises(ValueError, match="no .parquet"):
         open_dataset(empty, engine)
+
+
+def test_multi_topk_tie_order_deterministic(tmp_path, engine):
+    """Equal keys rank by (_file, _row) ascending in BOTH directions
+    (advisor round-3: the reversed stable sort returned descending ties
+    in reverse file/row order)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path / "ties"
+    d.mkdir()
+    # every row has key 7 → the ENTIRE result is one big tie
+    for f in range(2):
+        pq.write_table(pa.table({
+            "v": np.full(4, 7, np.int64),
+            "tag": (np.arange(4) + 10 * f).astype(np.int64),
+        }), d / f"part-{f}.parquet")
+    scs = [ParquetScanner(str(d / f"part-{f}.parquet"), engine)
+           for f in range(2)]
+    for desc in (True, False):
+        out = multi_topk(scs, "v", columns=["tag"], k=5,
+                         descending=desc)
+        np.testing.assert_array_equal(out["_file"], [0, 0, 0, 0, 1])
+        np.testing.assert_array_equal(out["_row"], [0, 1, 2, 3, 0])
+        np.testing.assert_array_equal(out["tag"], [0, 1, 2, 3, 10])

@@ -389,3 +389,32 @@ def test_var_through_join(star):
         np.testing.assert_allclose(out["var(f.amount)"][a],
                                    fact["amount"][m].var(ddof=1),
                                    rtol=1e-3)
+
+
+def test_grouped_count_star_nulls_skip_refused(table):
+    """The grouped path mirrors the scalar path's guard: COUNT(*)
+    counts rows, and the null-skipping stream would undercount
+    (advisor round-3, medium)."""
+    sc, _ = table
+    with pytest.raises(SQLSyntaxError, match="undercount"):
+        sql_query("SELECT k, COUNT(*) FROM t GROUP BY k", sc,
+                  nulls="skip")
+
+
+def test_string_key_groupby_nulls_skip_refused(tmp_path, engine):
+    """sql_groupby_str has no null-mask plumbing — accepting
+    nulls='skip' would silently zero-fill NULLs into the aggregates;
+    it must refuse loudly like every other unsupported combination
+    (advisor round-3, medium)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    p = tmp_path / "s.parquet"
+    pq.write_table(pa.table({
+        "name": pa.array(["a", "b", "a", "c"],
+                         pa.dictionary(pa.int32(), pa.string())),
+        "v": np.arange(4, dtype=np.float32),
+    }), p)
+    sc = ParquetScanner(str(p), engine)
+    with pytest.raises(SQLSyntaxError, match="string-keyed"):
+        sql_query("SELECT name, SUM(v) FROM t GROUP BY name", sc,
+                  nulls="skip")
