@@ -219,7 +219,8 @@ def _stack_values(cols, vcols, single):
 def iter_device_columns(scanner, columns: Sequence[str], dev,
                         require_int: Sequence[str] = (),
                         narrow_int32: Sequence[str] = (),
-                        row_groups=None, nulls: str = "forbid"):
+                        row_groups=None, nulls: str = "forbid",
+                        plans=None):
     """Stream a scanner's row groups as {name: device array} dicts.
 
     One policy for every on-device SQL consumer (groupby, join): the
@@ -235,7 +236,13 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
 
     ``nulls="mask"``: yields ({name: values}, {name: bool mask}) pairs
     instead — null slots zero-filled, masks all-True for null-free
-    columns; both decode paths honour the same contract."""
+    columns; both decode paths honour the same contract.
+
+    ``plans``: a prior :func:`pq_direct.plan_columns` walk (built with
+    ``allow_nulls`` matching this call's ``nulls``) — callers that
+    stream a table in several ``row_groups`` windows (sql_topk's
+    elimination loop) pass it so the page walk happens once, not per
+    window."""
     import numpy as np
     from nvme_strom_tpu.ops.bridge import host_to_device
     from nvme_strom_tpu.sql import pq_direct
@@ -252,8 +259,7 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
         for c in narrow_int32:
             cols[c] = cols[c].astype(xp.int32)
 
-    plans = None
-    if hasattr(scanner, "direct_reasons"):
+    if plans is None and hasattr(scanner, "direct_reasons"):
         try:
             plans = pq_direct.plan_columns(scanner, columns,
                                            allow_nulls=masked)

@@ -58,6 +58,7 @@ reads only index ints host-side, never payload values.
 from __future__ import annotations
 
 import struct
+import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -1043,6 +1044,163 @@ def _plain_only(plans: Sequence[ColumnPlan]) -> bool:
                for plan in plans for p in plan.parts)
 
 
+def _compressed_plain_only(plans: Sequence[ColumnPlan]) -> bool:
+    """Every page a codec-tagged null-free PLAIN body — the shape a
+    zstd/snappy analytics table presents."""
+    return all(p.kind == "plain" and p.codec is not None
+               and p.mask is None
+               for plan in plans for p in plan.parts)
+
+
+#: phase breakdown of the most recent _read_compressed_plain_pipelined
+#: call — read_stall (blocked in engine waits), decompress, device put —
+#: so the bench row can ATTRIBUTE a compressed scan instead of shipping
+#: one opaque number (round-3 verdict #5)
+LAST_COMPRESSED_PHASES: Dict[str, float] = {}
+
+
+def _iter_span_bytes_pipelined(eng, fh, spans, stall_box):
+    """Yield ``bytes`` per span with the engine queue kept full ACROSS
+    spans: sub-chunk splits of every span are submitted ahead (up to
+    the configured queue depth) while earlier spans decompress on the
+    host.  The round-3 compressed path read each page span with a
+    blocking ``engine.read`` — one stop-and-wait round trip per page,
+    which is what lost config 12 to pyarrow on the tunneled device
+    (0.24x, ledger L24/L45).  ``stall_box[0]`` accumulates the time
+    actually blocked in waits — the read-stall phase of the breakdown."""
+    from collections import deque
+    from nvme_strom_tpu.ops.bridge import split_ranges
+    flat, n_chunks = split_ranges(spans, eng.config.chunk_bytes)
+    span_of = [i for i, n in enumerate(n_chunks) for _ in range(n)]
+    pend = deque()                  # (span_idx, PendingRead)
+    parts: Dict[int, list] = {}
+    emit_next = 0
+
+    def drain_one():
+        i, pr = pend.popleft()
+        t0 = time.monotonic()
+        view = pr.wait()
+        stall_box[0] += time.monotonic() - t0
+        b = bytes(view)             # copy out of recycled staging
+        eng.stats.add(bounce_bytes=len(b))   # host-touched payload,
+        pr.release()                         # same rule as engine.read
+        parts.setdefault(i, []).append(b)
+
+    try:
+        for si, (off, n) in zip(span_of, flat):
+            pend.append((si, eng.submit_read(fh, off, n)))
+            while len(pend) > eng.config.queue_depth:
+                drain_one()
+            # FIFO completion: span k's chunks all land before k+1's
+            while (emit_next < len(spans)
+                   and len(parts.get(emit_next, ())) ==
+                   n_chunks[emit_next]):
+                chunks = parts.pop(emit_next, [])
+                yield (chunks[0] if len(chunks) == 1
+                       else b"".join(chunks))
+                emit_next += 1
+        while pend:
+            drain_one()
+        while emit_next < len(spans):
+            chunks = parts.pop(emit_next, [])
+            yield (chunks[0] if len(chunks) == 1 else b"".join(chunks))
+            emit_next += 1
+    finally:
+        for _, pr in pend:
+            try:
+                pr.wait()
+            except OSError:
+                pass
+            pr.release()
+
+
+def _read_compressed_plain_pipelined(scanner, fh, columns, plans, dev):
+    """All-compressed-PLAIN scan: pipelined O_DIRECT page reads, host
+    decompression overlapped with the in-flight reads, and one bulk
+    device transfer per (column, row group).
+
+    Contrast with the page-at-a-time path (`_decode_special_part`):
+    that pays a blocking engine read AND a small ``device_put`` per
+    page — ~2 round trips x pages, which on a high-latency link
+    dominates everything (the 0.24x-of-pyarrow ledger rows).  Here the
+    engine queue stays full across pages and the link sees a few
+    column-sized transfers — the same shape pyarrow's fallback enjoys,
+    so the comparison becomes an honest read+decode race."""
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.ops.bridge import host_to_device
+
+    eng = scanner.engine
+    # (column, row-group ordinal, part): host memory is bounded at one
+    # row group's decompressed pages — each (c, rg)'s bodies join and
+    # ship to device the moment its last page lands, so a table larger
+    # than host RAM still scans (the whole-table join this replaced
+    # peaked at ~2x decompressed size)
+    work = [(c, gi, p) for c in columns
+            for gi, plan in enumerate(plans[c])
+            for p in plan.parts]
+    widths = {c: _WIDTHS[plans[c][0].physical_type] for c in columns}
+    stall = [0.0]
+    t_decomp = 0.0
+    t_put = 0.0
+    comp_bytes = 0
+    decomp_bytes = 0
+    dev_parts: Dict[str, list] = {c: [] for c in columns}
+    group_bodies: list = []
+
+    def flush_group(c):
+        nonlocal t_put, decomp_bytes
+        if not group_bodies:
+            return
+        joined = (group_bodies[0] if len(group_bodies) == 1
+                  else b"".join(group_bodies))
+        group_bodies.clear()
+        arr = np.frombuffer(joined, np.dtype(_NP_DTYPES[
+            plans[c][0].physical_type]))
+        decomp_bytes += arr.nbytes
+        t0 = time.monotonic()
+        dev_parts[c].append(host_to_device(eng, arr, dev))
+        t_put += time.monotonic() - t0
+
+    it = _iter_span_bytes_pipelined(eng, fh,
+                                    [p.span for _, _, p in work], stall)
+    prev = None                     # (column, row-group) being filled
+    for (c, gi, p), raw in zip(work, it):
+        if prev is not None and prev != (c, gi):
+            flush_group(prev[0])
+        prev = (c, gi)
+        comp_bytes += len(raw)
+        t0 = time.monotonic()
+        body = _decompress(p.codec, raw, p.uncompressed_len)
+        t_decomp += time.monotonic() - t0
+        if dev.platform != "cpu":
+            eng.stats.add(bounce_bytes=p.uncompressed_len)
+        n_valid = p.valid_count
+        if p.inline_levels:
+            body, mask, n_valid = _inline_levels(body, p)
+            if mask is not None:
+                raise ValueError(
+                    "unexpected nulls in a chunk planned null-free")
+        group_bodies.append(bytes(body[:n_valid * widths[c]]))
+    if prev is not None:
+        flush_group(prev[0])
+    out = {}
+    for c in columns:
+        parts = dev_parts[c]
+        if not parts:
+            out[c] = jnp.zeros((0,), dtype=np.dtype(_NP_DTYPES[
+                plans[c][0].physical_type]))
+        else:
+            out[c] = (parts[0] if len(parts) == 1
+                      else jnp.concatenate(parts))
+    LAST_COMPRESSED_PHASES.clear()
+    LAST_COMPRESSED_PHASES.update(
+        read_stall_s=round(stall[0], 4), decomp_s=round(t_decomp, 4),
+        put_s=round(t_put, 4), compressed_bytes=comp_bytes,
+        decompressed_bytes=decomp_bytes, pages=len(work))
+    return out
+
+
 def _join_chunks(chunks, nulls: str, column: str):
     """[(values, mask|None)] per row group → column output per the
     ``nulls`` policy: "forbid" raises on any real mask (statistics lied
@@ -1089,13 +1247,34 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
     plans = plans or plan_columns(scanner, columns,
                                   allow_nulls=nulls == "mask")
     ds = DeviceStream(scanner.engine, device=dev,
-                      depth=scanner.engine.config.queue_depth)
+                      depth=scanner.engine.config.queue_depth,
+                      drain="ready")
     out = {}
     meta = scanner.metadata
     name_to_ci = {meta.schema.column(i).name: i
                   for i in range(meta.num_columns)}
     fh = scanner.engine.open(scanner.path)
     try:
+        if (nulls == "forbid" and columns
+                and all(plans[c] and _plain_only(plans[c])
+                        for c in columns)):
+            # the whole read is ONE pipelined range sequence across
+            # every (row group, column) chunk — no boundary drains
+            # (same rationale as iter_plain_row_groups_to_device)
+            per_col = {c: [] for c in columns}
+            for rg_out in _iter_plain_pipelined(
+                    scanner, ds, fh, columns, plans,
+                    range(meta.num_row_groups)):
+                for c, v in rg_out.items():
+                    per_col[c].append(v)
+            return {c: (parts[0] if len(parts) == 1
+                        else jnp.concatenate(parts))
+                    for c, parts in per_col.items()}
+        if (nulls == "forbid" and columns
+                and all(plans[c] and _compressed_plain_only(plans[c])
+                        for c in columns)):
+            return _read_compressed_plain_pipelined(scanner, fh,
+                                                    columns, plans, dev)
         for c in columns:
             if not plans[c]:   # zero row groups: empty typed column
                 pt = meta.schema.column(name_to_ci[c]).physical_type
@@ -1340,7 +1519,17 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
     ``row_groups`` restricts to a pruned subset (statistics-based scan
     elimination — skipped chunks never leave the SSD).  ``nulls`` as in
     :func:`read_plain_columns_to_device` ("mask" yields (values, mask)
-    pairs per column)."""
+    pairs per column).
+
+    When every selected chunk is raw-PLAIN (the common analytics case),
+    the WHOLE scan is one pipelined range sequence — row-group
+    boundaries are just chunk counts on the consumer side.  The per-
+    row-group form (one drained ``stream_ranges`` call per column per
+    group) collapsed the engine queue at every boundary: each drain is
+    a ``block_until_ready`` round-trip with the device link idle, and a
+    64-group × 2-column scan paid ~128 of them — the round-3 on-silicon
+    ledger showed config 5 at 0.11× of a ceiling bench.py's single
+    pipelined stream hits at 0.9× through the same tunnel."""
     import jax
     from nvme_strom_tpu.ops.bridge import DeviceStream
 
@@ -1350,11 +1539,19 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
     plans = plans or plan_columns(scanner, columns,
                                   allow_nulls=nulls == "mask")
     ds = DeviceStream(scanner.engine, device=dev,
-                      depth=scanner.engine.config.queue_depth)
+                      depth=scanner.engine.config.queue_depth,
+                      drain="ready")
     fh = scanner.engine.open(scanner.path)
     try:
         groups = (range(scanner.metadata.num_row_groups)
                   if row_groups is None else row_groups)
+        groups = list(groups)
+        if nulls == "forbid" and all(
+                _plain_only([plans[c][rg]])
+                for rg in groups for c in columns):
+            yield from _iter_plain_pipelined(scanner, ds, fh, columns,
+                                             plans, groups)
+            return
         for rg in groups:
             out = {}
             for c in columns:
@@ -1369,3 +1566,46 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
             yield out
     finally:
         scanner.engine.close(fh)
+
+
+def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups):
+    """All-raw-PLAIN scan as ONE pipelined range sequence.
+
+    Every (row group, column) chunk's spans are flattened into a single
+    ``stream_ranges`` submission — the engine keeps ``depth`` reads in
+    flight across row-group boundaries, and the only blocking wait is
+    backpressure (pipe full), never a boundary drain.  The consumer
+    side reassembles boundaries from chunk counts: submission order is
+    yield order.  The fold's device compute overlaps the stream for
+    free — JAX dispatch is async, so by the time the consumer asks for
+    the next group's chunks, its aggregation is already queued behind
+    the transfers."""
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.ops.bridge import split_ranges
+
+    chunk_bytes = scanner.engine.config.chunk_bytes
+    flat = []                      # every sub-range, submission order
+    counts = []                    # (rg, column, n_chunks)
+    for rg in groups:
+        for c in columns:
+            ranges, _ = split_ranges(plans[c][rg].spans, chunk_bytes)
+            flat.extend(ranges)
+            counts.append((rg, c, len(ranges)))
+    it = ds.stream_ranges(fh, flat)
+    try:
+        out = {}
+        for rg, c, n in counts:
+            parts = [next(it) for _ in range(n)]
+            np_dtype = np.dtype(_NP_DTYPES[plans[c][rg].physical_type])
+            if not parts:          # zero-row group
+                out[c] = jnp.zeros((0,), dtype=np_dtype)
+            else:
+                flat_arr = (parts[0] if len(parts) == 1
+                            else jnp.concatenate(parts))
+                out[c] = flat_arr.view(np_dtype)
+            if len(out) == len(columns):
+                yield out
+                out = {}
+    finally:
+        it.close()                 # abandoned scan: release staging now

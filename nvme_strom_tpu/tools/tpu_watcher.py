@@ -279,7 +279,12 @@ def capture(device: str) -> bool:
         ("kernel_probe",
          [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
          1200, None),
-        ("suite_5", [sys.executable, "bench_suite.py", "--config", "5"],
+        # "_v2": the scan pipeline changed (round-3 verdict #2 — one
+        # pipelined range sequence across row groups instead of a
+        # boundary drain per group, windowed topk elimination, phase
+        # attribution in the tag); the round-3 rows measured the old
+        # code, so these re-capture as fresh coverage
+        ("suite_5_v2", [sys.executable, "bench_suite.py", "--config", "5"],
          900, None),
         ("suite_12", [sys.executable, "bench_suite.py", "--config", "12"],
          900, None),
@@ -290,8 +295,8 @@ def capture(device: str) -> bool:
          1800, None),
         ("suite_14", [sys.executable, "bench_suite.py", "--config", "14"],
          900, None),
-        ("suite_15", [sys.executable, "bench_suite.py", "--config", "15"],
-         900, None),
+        ("suite_15_v2",
+         [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
         ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
          900, None),
         ("suite_11_prefix",

@@ -754,3 +754,54 @@ def test_direct_fuzz_random_layouts(tmp_path, engine):
                 np.asarray(out["v"]), ref.to_numpy(),
                 err_msg=f"trial {trial} comp={comp} ver={ver} "
                         f"dict={use_dict} card={cardinality}")
+
+
+def test_pipelined_iter_boundaries_and_pruning(tmp_path, engine):
+    """The all-PLAIN scan streams as ONE pipelined range sequence
+    (round-3 verdict #2); row-group boundaries are reassembled from
+    chunk counts, so each yielded group must carry exactly its own
+    rows — including under a pruned, non-contiguous row_groups subset
+    and a column whose spans split across engine chunks."""
+    import jax
+    rows = 40_000
+    rng = np.random.default_rng(7)
+    data = {
+        "k": rng.integers(0, 9, rows).astype(np.int32),
+        "v": rng.standard_normal(rows).astype(np.float32),
+    }
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table(data), path, row_group_size=4096)
+    sc = ParquetScanner(path, engine)
+    n_rg = sc.metadata.num_row_groups
+    assert n_rg == 10
+    dev = jax.local_devices()[0]
+    subset = [7, 2, 9]              # pruned AND out of order
+    got = list(pq_direct.iter_plain_row_groups_to_device(
+        sc, ["k", "v"], device=dev, row_groups=subset))
+    assert len(got) == len(subset)
+    for rg, cols in zip(subset, got):
+        lo, hi = rg * 4096, min((rg + 1) * 4096, rows)
+        for c in ("k", "v"):
+            np.testing.assert_array_equal(np.asarray(cols[c]),
+                                          data[c][lo:hi])
+
+
+def test_pipelined_iter_abandoned_mid_scan(tmp_path, engine):
+    """Breaking out of the pipelined scan (the topk elimination path)
+    must release every in-flight staging buffer — a second full scan
+    through the same engine would otherwise starve on the pool."""
+    import jax
+    rows = 40_000
+    data = {"v": np.arange(rows, dtype=np.int32)}
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table(data), path, row_group_size=4096)
+    sc = ParquetScanner(path, engine)
+    dev = jax.local_devices()[0]
+    it = pq_direct.iter_plain_row_groups_to_device(sc, ["v"], device=dev)
+    next(it)
+    it.close()                      # abandon after one group
+    # engine still serviceable: a full scan completes and is correct
+    full = list(pq_direct.iter_plain_row_groups_to_device(
+        sc, ["v"], device=dev))
+    np.testing.assert_array_equal(
+        np.concatenate([np.asarray(c["v"]) for c in full]), data["v"])
