@@ -76,6 +76,11 @@ _log = bench._log
 #: timed runs per I/O config AFTER the discarded jit-warmup run
 _RUNS = 3
 
+#: same-run raw-SSD and host->device link rates (GiB/s), set by run()
+#: before any config executes — the normalization base for rows whose
+#: number is medium-bound (config 14's moment stream)
+_CEILINGS: dict = {}
+
 
 class _SuiteWatchdog:
     """Convert a mid-suite hang into a self-diagnosing row instead of a
@@ -440,7 +445,8 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
         t0 = time.monotonic()
         last = None
         for cols in iter_device_columns(scanner, ["k", "v"], dev,
-                                        narrow_int32=("k",)):
+                                        narrow_int32=("k",),
+                                        plans=plans):
             last = cols
         for v in last.values():
             v.block_until_ready()
@@ -1125,20 +1131,37 @@ def bench_serving(device=None) -> tuple[float, str]:
                        + rng.integers(0, cfg.vocab, lens[i]).tolist(),
                        news[i])
 
+    # decode sub-steps per host readback: the round-3 on-silicon row
+    # (43.6 tok/s vs 6,826 decode) was one blocking readback per token
+    # over a high-latency link; lookahead amortizes it (verdict #6)
+    lookahead = int(os.environ.get("STROM_SERVE_LOOKAHEAD", "8"))
+
     # warmup run compiles the step + admission buckets (discarded)
     srv = make()
     submit_all(srv)
-    srv.run()
+    srv.run(lookahead=lookahead)
     ts = []
     for _ in range(_RUNS):
         srv = make()
         submit_all(srv)
         t0 = time.monotonic()
-        out = srv.run()
+        out = srv.run(lookahead=lookahead)
         ts.append(time.monotonic() - t0)
     total = sum(news)
-    rate = total / statistics.median(ts)
-    tag = f"slots={slots} reqs={n_req} tok/req~{total // n_req}"
+    wall = statistics.median(ts)
+    rate = total / wall
+    # phase attribution from the LAST run (its wall time for scale):
+    # admission+prefill, back-to-back dispatch, readback syncs, and
+    # the host-scheduling remainder
+    tm = srv.timings
+    other = max(ts[-1] - tm["admit_s"] - tm["dispatch_s"]
+                - tm["readback_s"], 0.0)
+    tag = (f"slots={slots} reqs={n_req} tok/req~{total // n_req} "
+           f"lookahead={lookahead}; phases(last run "
+           f"{ts[-1]:.2f}s): admit={tm['admit_s']:.2f}s "
+           f"dispatch={tm['dispatch_s']:.2f}s "
+           f"readback={tm['readback_s']:.2f}s({tm['readbacks']}x) "
+           f"sched={other:.2f}s, steps={tm['steps']}")
     if paged:
         tag += (f" paged={total_blocks}x{block_len} "
                 f"({total_blocks * block_len * 100 // (slots * max_len)}"
@@ -1278,10 +1301,36 @@ def bench_opt_offload(engine) -> tuple[float, str]:
         groups = off.num_groups()
     gibs = 2 * payload / t_off / (1 << 30)        # 2R + 2W of the payload
     over = (t_off - t_hbm) / t_hbm if t_hbm > 0 else float("inf")
+    # Medium normalization (round-3 verdict #9: the on-silicon row
+    # ledgered +3.6M% overhead with no frame — evidence AGAINST the
+    # feature absent the link context).  The step must move 2x the
+    # moment payload; at the same-run measured link that takes
+    # t_floor = bytes/link, so overhead below is bounded by the medium,
+    # not the implementation.  The projection column re-prices the step
+    # at the same-run RAW SSD rate — the rate a local deployment's
+    # storage path actually delivers — and the TUNNEL-BOUND tag fires
+    # when >=50% of the step went to link-floor time, telling a reader
+    # the headline overhead measures the tunnel.
+    raw_ceiling = _CEILINGS.get("raw", 0.0)
+    link_ceiling = _CEILINGS.get("link", 0.0)
+    moved = 2 * payload
+    extra = ""
+    if link_ceiling > 0 and raw_ceiling > 0:
+        t_floor = moved / (link_ceiling * (1 << 30))
+        t_local = max(moved / (raw_ceiling * (1 << 30)), 1e-9)
+        over_local = ((t_hbm + t_local) - t_hbm) / t_hbm \
+            if t_hbm > 0 else float("inf")
+        bound = "TUNNEL-BOUND, " if t_floor >= 0.5 * t_off else ""
+        extra = (f", link-normalized: {bound}link-floor="
+                 f"{t_floor * 1e3:.0f}ms of {t_off * 1e3:.0f}ms at "
+                 f"{link_ceiling:.3f} GiB/s; projected at same-run raw "
+                 f"{raw_ceiling:.3f} GiB/s: step="
+                 f"{(t_hbm + t_local) * 1e3:.0f}ms "
+                 f"overhead={over_local:+.0%}")
     return gibs, (f"moments={payload >> 20}MiB step={t_off * 1e3:.0f}ms "
                   f"overhead={over:+.0%} vs in-HBM "
                   f"({t_hbm * 1e3:.0f}ms), hbm_peak={peak >> 20}MiB of "
-                  f"{payload >> 20}MiB, groups={groups}")
+                  f"{payload >> 20}MiB, groups={groups}{extra}")
 
 
 def bench_train(device=None) -> tuple[float, str]:
@@ -1416,6 +1465,10 @@ def run(configs: list[int], emit=None) -> list[dict]:
              f"members={list(dinfo.members)}")
         raw = bench.bench_raw(engine, raw_path)
         link = bench.bench_link()
+        # same-run ceilings, visible to configs that normalize against
+        # the medium (config 14 prices its moment stream against the
+        # link it actually rode — round-3 verdict #9)
+        _CEILINGS.update(raw=raw, link=link)
         ceiling = 0.9 * (min(raw, link) if raw > 0 and link > 0
                          else max(raw, link, 1.0))
         _log(f"suite: raw={raw:.3f} GiB/s link={link:.3f} GiB/s "

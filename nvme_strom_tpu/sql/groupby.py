@@ -259,12 +259,8 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
         for c in narrow_int32:
             cols[c] = cols[c].astype(xp.int32)
 
-    if plans is None and hasattr(scanner, "direct_reasons"):
-        try:
-            plans = pq_direct.plan_columns(scanner, columns,
-                                           allow_nulls=masked)
-        except ValueError:
-            plans = None
+    if plans is None:
+        plans = pq_direct.try_plan(scanner, columns, allow_nulls=masked)
     if plans is not None:
         for cols in pq_direct.iter_plain_row_groups_to_device(
                 scanner, columns, device=dev, plans=plans,

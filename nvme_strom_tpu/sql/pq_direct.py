@@ -1044,6 +1044,19 @@ def _plain_only(plans: Sequence[ColumnPlan]) -> bool:
                for plan in plans for p in plan.parts)
 
 
+def try_plan(scanner, columns: Sequence[str], allow_nulls: bool = False):
+    """plan_columns, or None when the scanner/file isn't direct-eligible
+    — THE fallback rule, shared by every consumer that degrades to the
+    pyarrow path (groupby's iter_device_columns, topk) so the two can
+    never diverge on the same scanner."""
+    if not hasattr(scanner, "direct_reasons"):
+        return None
+    try:
+        return plan_columns(scanner, columns, allow_nulls=allow_nulls)
+    except ValueError:
+        return None
+
+
 def _compressed_plain_only(plans: Sequence[ColumnPlan]) -> bool:
     """Every page a codec-tagged null-free PLAIN body — the shape a
     zstd/snappy analytics table presents."""

@@ -165,14 +165,9 @@ def sql_topk(scanner, by: str, columns: Sequence[str] = (),
 
     # one page walk for the whole query; each elimination window below
     # reuses it instead of re-walking every page per window
-    plans = None
-    if hasattr(scanner, "direct_reasons"):
-        from nvme_strom_tpu.sql import pq_direct
-        try:
-            plans = pq_direct.plan_columns(scanner, cols_needed,
-                                           allow_nulls=nulls == "skip")
-        except ValueError:
-            plans = None
+    from nvme_strom_tpu.sql import pq_direct
+    plans = pq_direct.try_plan(scanner, cols_needed,
+                               allow_nulls=nulls == "skip")
 
     def group_stream(batch):
         if nulls == "skip":

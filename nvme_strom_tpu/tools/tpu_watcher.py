@@ -293,13 +293,17 @@ def capture(device: str) -> bool:
         # completed compile populates the persistent cache for good
         ("suite_13", [sys.executable, "bench_suite.py", "--config", "13"],
          1800, None),
-        ("suite_14", [sys.executable, "bench_suite.py", "--config", "14"],
-         900, None),
+        # _v2: round-4 re-instrumentation (link-normalized frame with a
+        # projected-at-raw column and the TUNNEL-BOUND marker)
+        ("suite_14_v2",
+         [sys.executable, "bench_suite.py", "--config", "14"], 900, None),
         ("suite_15_v2",
          [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
         ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
          900, None),
-        ("suite_11_prefix",
+        # _v2: round-4 lookahead serving (k decode steps per host
+        # readback) + phase attribution in the tag (verdict #6)
+        ("suite_11_prefix_v2",
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
          {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
     ]
