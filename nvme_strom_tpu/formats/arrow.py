@@ -136,16 +136,35 @@ class ArrowFileReader:
         device_put columns via the shared bridge rule → on-device concat."""
         import jax
         import jax.numpy as jnp
-        from nvme_strom_tpu.ops.bridge import host_to_device
+        from nvme_strom_tpu.ops.bridge import (StagingRetirePool,
+                                               host_to_device)
+        import numpy as np
+        from nvme_strom_tpu.ops.bridge import split_ranges
         dev = device or jax.local_devices()[0]
         names = columns or [f.name for f in self.schema]
         parts: Dict[str, list] = {n: [] for n in names}
+        entries = self.plan().entries
+        chunk = engine.config.chunk_bytes
+        # Budget against the engine staging pool (a deferred submit
+        # waits for a buffer only THIS consumer can free): entry_depth
+        # messages in flight × the widest message's sub-chunk count,
+        # plus deferred-release entries, must leave a buffer free.
+        # Tiny pools degrade to retire depth 0 = block per batch.
+        max_subs = max((-(-e.length // chunk) for e in entries),
+                       default=1)
+        if max_subs > engine.n_buffers:
+            raise ValueError(
+                f"one record batch needs {max_subs} staging buffers "
+                f"but the pool has {engine.n_buffers}; raise "
+                "EngineConfig.chunk_bytes or buffer_pool_bytes")
+        entry_depth = min(depth,
+                          max(1, (engine.n_buffers // 2) // max_subs))
+        retire = StagingRetirePool(
+            max(0, engine.n_buffers - entry_depth * max_subs - 1))
         fh = engine.open(self.path)
-        pend: list = []
+        pend: list = []          # [PendingRead, ...] per batch message
         try:
-            def consume(p):
-                view = p.wait()
-                batch = self.decode_batch(view)
+            def decode_and_put(batch, release):
                 put = []
                 for n in names:
                     col = batch.column(n)
@@ -156,21 +175,54 @@ class ArrowFileReader:
                     arr = host_to_device(engine, host, dev)
                     parts[n].append(arr)
                     put.append(arr)
-                # transfers must consume staging before release()
-                for arr in put:
-                    arr.block_until_ready()
-                p.release()
+                # staging released once the transfers complete —
+                # DEFERRED, not blocked per batch: the per-batch
+                # block_until_ready this replaces paid one link round
+                # trip per record batch
+                retire.push(release, put)
 
-            for entry in self.plan().entries:
-                pend.append(
-                    engine.submit_read(fh, entry.offset, entry.length))
-                if len(pend) >= depth:
+            def consume(reads):
+                try:
+                    if len(reads) == 1:
+                        # whole message in one staging buffer:
+                        # zero-copy decode straight from it
+                        decode_and_put(
+                            self.decode_batch(reads[0].wait()),
+                            reads[0].release)
+                        return
+                    # an IPC message larger than one staging buffer:
+                    # the decoder needs it contiguous, so sub-chunks
+                    # assemble into ONE host buffer (counted as bounce
+                    # — raise chunk_bytes to stay zero-copy)
+                    views = [p.wait() for p in reads]
+                    host = np.empty(sum(v.nbytes for v in views),
+                                    np.uint8)
+                    pos = 0
+                    for p, v in zip(reads, views):
+                        host[pos:pos + v.nbytes] = v
+                        pos += v.nbytes
+                        p.release()
+                    engine.stats.add(bounce_bytes=int(pos))
+                    decode_and_put(self.decode_batch(host), None)
+                except BaseException:
+                    for p in reads:    # idempotent: leak-free on a
+                        p.release()    # mid-assembly wait() failure
+                    raise
+
+            for entry in entries:
+                ranges, _ = split_ranges([(entry.offset, entry.length)],
+                                         chunk)
+                pend.append([engine.submit_read(fh, o, ln)
+                             for o, ln in ranges])
+                if len(pend) >= entry_depth:
                     consume(pend.pop(0))
             while pend:
                 consume(pend.pop(0))
         finally:
-            for p in pend:
-                p.release()  # waits if still in flight
+            retire.flush()
+            for reads in pend:
+                for p in reads:
+                    p.release()  # waits if still in flight
             engine.close(fh)
         return {n: (v[0] if len(v) == 1 else jnp.concatenate(v))
                 for n, v in parts.items()}

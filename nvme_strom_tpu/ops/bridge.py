@@ -75,6 +75,75 @@ def host_to_device(engine: StromEngine, host: np.ndarray, dev,
     return arr
 
 
+class StagingRetirePool:
+    """Deferred staging release for read→host-decode→device pipelines.
+
+    ``DeviceStream`` owns the raw-range case; format readers that must
+    touch the bytes on host BETWEEN the engine read and the device put
+    (Arrow IPC decode, safetensors slicing) can't use it — and the
+    conservative alternative they shipped with (block on every batch's
+    transfers before releasing its staging buffer) costs one
+    stop-and-wait link round trip per batch, the same disease the
+    round-3 verdict called on the SQL scan.  This pool is
+    ``DeviceStream``'s drain discipline, factored out: push each
+    batch's (release, device_arrays); completed heads retire
+    opportunistically (``is_ready``), and only when more than ``depth``
+    batches' staging is outstanding does it block on the OLDEST — by
+    which time ``depth-1`` younger transfers are overlapping it.
+
+    Correctness rule unchanged: a staging buffer is released only
+    after every device array transferred out of it reports ready.
+
+    ``depth`` counts outstanding entries; 0 degrades to the old
+    block-per-batch behavior — the safe fallback when the engine's
+    staging pool is too small to also hold deferred entries (callers
+    must budget: reads in flight + deferred entries < pool buffers, or
+    a deferred submit can wait on a buffer only this pool can free)."""
+
+    def __init__(self, depth: int = 3):
+        self.depth = max(0, depth)
+        self._q: list = []          # (release_cb, [device arrays])
+
+    def push(self, release, arrays) -> None:
+        """``release``: the staging release callback (None = nothing to
+        retire, e.g. a host-owned buffer); ``arrays``: device arrays
+        whose transfers consume that staging."""
+        if release is None:
+            return
+        self._q.append((release, list(arrays)))
+        self._drain_ready()
+        while len(self._q) > self.depth:
+            self._block_oldest()
+
+    def drain_ready(self) -> None:
+        """Retire every completed head entry without blocking."""
+        while self._q and all(a.is_ready() for a in self._q[0][1]):
+            rel, _ = self._q.pop(0)
+            rel()
+
+    _drain_ready = drain_ready
+
+    def retire_oldest(self) -> bool:
+        """Blocking-retire the oldest entry; False when none remain.
+        Callers under staging-pool pressure loop on this — it always
+        makes progress (the device finishes transfers on its own)."""
+        if not self._q:
+            return False
+        self._block_oldest()
+        return True
+
+    def _block_oldest(self) -> None:
+        rel, arrs = self._q.pop(0)
+        for a in arrs:
+            a.block_until_ready()
+        rel()
+
+    def flush(self) -> None:
+        """Retire everything (end of stream, or error-path cleanup)."""
+        while self._q:
+            self._block_oldest()
+
+
 class DeviceStream:
     """Pipelined NVMe→HBM chunk stream over one engine.
 

@@ -161,14 +161,28 @@ class LazyCheckpoint:
                 idx if idx is not None else (), gshape)
             spans.setdefault((r0, r1), []).append((dev, tail))
 
-        from nvme_strom_tpu.ops.bridge import host_to_device
+        from nvme_strom_tpu.ops.bridge import (StagingRetirePool,
+                                               host_to_device)
         fh = eng.open(sf.path)
         device_arrays = {}
+        # Deferred staging release (shared DeviceStream discipline):
+        # the per-chunk block_until_ready this replaces paid one link
+        # round trip per weight chunk — on a high-latency link that
+        # serialized the whole load.  Budgeted against the engine's
+        # staging pool: _stream_span keeps up to stream_depth reads in
+        # flight, the pool holds retired-pending entries, and their sum
+        # must leave a free buffer or a deferred submit could wait on
+        # memory only this consumer can release (deadlock).  Tiny pools
+        # degrade to depth 0 = the old block-per-chunk behavior.
+        stream_depth = max(2, eng.config.queue_depth // 2)
+        retire = StagingRetirePool(
+            max(0, min(eng.config.queue_depth // 2,
+                       eng.n_buffers - stream_depth - 1)))
         try:
             for (r0, r1), devs in spans.items():
                 parts: Dict[object, list] = {dev: [] for dev, _ in devs}
-                for view in self._stream_span(eng, fh, sf, name, r0, r1,
-                                              np_dt, gshape):
+                for view, release in self._stream_span(
+                        eng, fh, sf, name, r0, r1, np_dt, gshape):
                     cache: Dict[tuple, np.ndarray] = {}
                     put = []
                     for dev, tail in devs:
@@ -187,13 +201,13 @@ class LazyCheckpoint:
                         arr = host_to_device(eng, sub, dev)
                         parts[dev].append(arr)
                         put.append(arr)
-                    for arr in put:  # staging consumed before next yield
-                        arr.block_until_ready()
+                    retire.push(release, put)
                 for dev, _ in devs:
                     ps = parts[dev]
                     device_arrays[dev] = (
                         ps[0] if len(ps) == 1 else jnp.concatenate(ps))
         finally:
+            retire.flush()
             eng.close(fh)
 
         arrays = [device_arrays[d] for d in idx_map]
@@ -201,13 +215,30 @@ class LazyCheckpoint:
             gshape, sharding, arrays)
 
     def _stream_span(self, eng, fh, sf, name, r0, r1, np_dt, gshape):
-        """Yield host views of row-chunks of rows [r0, r1), each at most one
-        staging buffer; pipelined (several reads in flight).  The yielded
-        view is only valid until the next iteration."""
+        """Yield (host view, release_cb | None) per row-chunk of rows
+        [r0, r1), each at most one staging buffer; pipelined (several
+        reads in flight).  The view is valid until ``release_cb()`` —
+        the CONSUMER calls it (via a StagingRetirePool) once transfers
+        out of the view complete; None means host-owned memory with
+        nothing to retire.  release is idempotent, so generator cleanup
+        can double as a backstop."""
         if not gshape:
             ent = sf.plan([name]).entries[0]
-            with eng.submit_read(fh, ent.offset, ent.length) as p:
-                yield p.wait().view(np_dt).reshape(())
+            p = eng.submit_read(fh, ent.offset, ent.length)
+            done = False
+            try:
+                # ownership transfers at the yield: the consumer's
+                # retire pool releases once transfers finish.  NO
+                # with-block — its __exit__ fired on generator resume,
+                # BEFORE deferred transfers completed (a recycled
+                # buffer under an in-flight H2D read = wrong bytes on
+                # device).  The finally only covers never-yielded
+                # abandonment; release() is idempotent either way.
+                yield p.wait().view(np_dt).reshape(()), p.release
+                done = True
+            finally:
+                if not done:
+                    p.release()
             return
         info = sf.tensors[name]
         row_elems = (int(np.prod(gshape[1:], dtype=np.int64))
@@ -231,7 +262,9 @@ class LazyCheckpoint:
                     pos += v.nbytes
                     p.release()
                 eng.stats.add(bounce_bytes=int(ent.length))
-                yield buf.view(np_dt).reshape((1,) + tuple(gshape[1:]))
+                # host-owned buffer: nothing to retire
+                yield buf.view(np_dt).reshape((1,) + tuple(gshape[1:])), \
+                    None
             return
         depth = max(2, eng.config.queue_depth // 2)
         pend = []
@@ -243,12 +276,10 @@ class LazyCheckpoint:
                              ent.shape))
                 if len(pend) >= depth:
                     p, shp = pend.pop(0)
-                    yield p.wait().view(np_dt).reshape(shp)
-                    p.release()
+                    yield p.wait().view(np_dt).reshape(shp), p.release
             while pend:
                 p, shp = pend.pop(0)
-                yield p.wait().view(np_dt).reshape(shp)
-                p.release()
+                yield p.wait().view(np_dt).reshape(shp), p.release
         finally:
             for p, _ in pend:  # abandoned mid-span: drain + free
                 p.release()

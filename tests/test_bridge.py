@@ -188,3 +188,26 @@ def test_host_to_device_cpu_copy_is_alias_proof(engine, tmp_data_file):
     list(DeviceStream(engine, depth=2).stream_file(other))
     got = b"".join(np.asarray(c).tobytes() for c in parts)
     assert got == payload
+
+
+def test_staging_retire_pool_orders_and_bounds():
+    """StagingRetirePool (deferred staging release, round-4): releases
+    fire exactly once each, oldest-first, and pushing past ``depth``
+    blocks on the oldest instead of growing without bound."""
+    import jax.numpy as jnp
+    from nvme_strom_tpu.ops.bridge import StagingRetirePool
+    released = []
+    pool = StagingRetirePool(depth=2)
+    arrs = [jnp.arange(4) + i for i in range(4)]
+    for i in range(4):
+        pool.push(lambda i=i: released.append(i), [arrs[i]])
+    # depth=2: at most 2 entries outstanding, so >= 2 retired already
+    assert released == sorted(released) and len(released) >= 2
+    pool.flush()
+    assert released == [0, 1, 2, 3]
+    pool.flush()                    # idempotent, nothing double-fires
+    assert released == [0, 1, 2, 3]
+    # None release: nothing tracked
+    pool.push(None, [arrs[0]])
+    pool.flush()
+    assert released == [0, 1, 2, 3]
