@@ -210,14 +210,10 @@ def capture(device: str) -> bool:
          900, None),
         ("suite_4", [sys.executable, "bench_suite.py", "--config", "4"],
          900, None),
-        ("suite_8", [sys.executable, "bench_suite.py", "--config", "8"],
-         900, None),
-        ("suite_9", [sys.executable, "bench_suite.py", "--config", "9"],
-         900, None),
-        ("suite_10", [sys.executable, "bench_suite.py", "--config", "10"],
-         1200, None),
-        ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
-         1200, None),
+        # MFU story (verdict #3) immediately after the contract I/O
+        # rows: d2048 re-trace for the post-fix profile parse, then the
+        # flash d-points — a short window must land these before the
+        # long tail below
         ("suite_7", [sys.executable, "bench_suite.py", "--config", "7"],
          1500, {"STROM_PROFILE_DIR": prof_d2048}),
         # the MFU lever sweep (verdict #3): batch amortizes weight
@@ -226,20 +222,6 @@ def capture(device: str) -> bool:
         # 2400s budget on tunnel-speed compiles and landed nothing
         # (ledger 2026-07-31T01:14); per-variant steps bound the loss
         # to one point each.
-        # b16:none stays as the OOM-boundary probe (its remote-compile
-        # 500 is informative and cheap); the bigger batches ride the
-        # flash kernel's O(s) attention memory instead of dots-remat —
-        # dense b16+ blows compile-time HBM, and remat=dots triggers
-        # the axon instant-garbage pathology (see suite_7_dots_diag)
-        ("suite_7_b16",
-         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
-         {"STROM_TRAIN_SWEEP": "16:none"}),
-        ("suite_7_b16_flash",
-         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
-         {"STROM_TRAIN_SWEEP": "16:none:flash"}),
-        ("suite_7_b32_flash",
-         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
-         {"STROM_TRAIN_SWEEP": "32:none:flash"}),
         # model-size points (verdict #3: the MFU curve was still rising
         # at d=2048 — measure where it flattens; param counts sized to
         # keep fp32 params+grads+Adam inside the v5e's 16 GiB)
@@ -264,9 +246,36 @@ def capture(device: str) -> bool:
          {"STROM_TRAIN_SWEEP": "8:none:flash",
           "STROM_TRAIN_CFG": CFG_D4096,
           "STROM_PROFILE_DIR": prof_d4096}),
-        ("suite_7_dots_diag",
-         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
-         {"STROM_TRAIN_SWEEP": "8:dots"}),
+        # "_v2" steps: the measured code changed in round 4 (pipelined
+        # cross-row-group scans + phase tags for 5/15, the pipelined
+        # compressed path + cost decomposition for 12, link-normalized
+        # frame for 14, lookahead serving + spans for 11) — round-3
+        # rows measured the old code, so these re-capture as fresh
+        # coverage, ordered by how directly the verdict asked
+        ("suite_5_v2", [sys.executable, "bench_suite.py", "--config", "5"],
+         900, None),
+        ("suite_12_v2",
+         [sys.executable, "bench_suite.py", "--config", "12"], 900, None),
+        # 1800s: the dict-scan kernel burned two 900s timeouts inside
+        # the remote compile (hangs right after the link probe); one
+        # completed compile populates the persistent cache for good
+        ("suite_13", [sys.executable, "bench_suite.py", "--config", "13"],
+         1800, None),
+        ("suite_11_prefix_v2",
+         [sys.executable, "bench_suite.py", "--config", "11"], 1200,
+         {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
+        ("suite_14_v2",
+         [sys.executable, "bench_suite.py", "--config", "14"], 900, None),
+        ("suite_15_v2",
+         [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
+        # remaining BASELINE-contract I/O rows (round-2 manual numbers
+        # only) and the capability demonstrations
+        ("suite_8", [sys.executable, "bench_suite.py", "--config", "8"],
+         900, None),
+        ("suite_9", [sys.executable, "bench_suite.py", "--config", "9"],
+         900, None),
+        ("suite_10", [sys.executable, "bench_suite.py", "--config", "10"],
+         1200, None),
         # Llama-vocab demonstration of the chunked cross-entropy: at
         # v=131072 the full-logits path's b8·s1024·v f32 logits are
         # ~4.3 GiB (+ their backward) — xc=8 scans the lm_head in
@@ -276,36 +285,32 @@ def capture(device: str) -> bool:
          {"STROM_TRAIN_SWEEP": "8:none",
           "STROM_TRAIN_CFG": "d=2048,L=4,ff=5632,heads=16,kv=8,"
                              "vocab=131072,xc=8"}),
+        # batch sweep on the flash kernel's O(s) attention memory —
+        # dense b16+ blows compile-time HBM (remote-compile 500s), and
+        # remat=dots triggers the axon instant-garbage pathology (see
+        # suite_7_dots_diag)
+        ("suite_7_b16_flash",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "16:none:flash"}),
+        ("suite_7_b32_flash",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "32:none:flash"}),
+        ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
+         900, None),
+        ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
+         1200, None),
         ("kernel_probe",
          [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
          1200, None),
-        # "_v2": the scan pipeline changed (round-3 verdict #2 — one
-        # pipelined range sequence across row groups instead of a
-        # boundary drain per group, windowed topk elimination, phase
-        # attribution in the tag); the round-3 rows measured the old
-        # code, so these re-capture as fresh coverage
-        ("suite_5_v2", [sys.executable, "bench_suite.py", "--config", "5"],
-         900, None),
-        ("suite_12", [sys.executable, "bench_suite.py", "--config", "12"],
-         900, None),
-        # 1800s: the dict-scan kernel burned two 900s timeouts inside
-        # the remote compile (hangs right after the link probe); one
-        # completed compile populates the persistent cache for good
-        ("suite_13", [sys.executable, "bench_suite.py", "--config", "13"],
-         1800, None),
-        # _v2: round-4 re-instrumentation (link-normalized frame with a
-        # projected-at-raw column and the TUNNEL-BOUND marker)
-        ("suite_14_v2",
-         [sys.executable, "bench_suite.py", "--config", "14"], 900, None),
-        ("suite_15_v2",
-         [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
-        ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
-         900, None),
-        # _v2: round-4 lookahead serving (k decode steps per host
-        # readback) + phase attribution in the tag (verdict #6)
-        ("suite_11_prefix_v2",
-         [sys.executable, "bench_suite.py", "--config", "11"], 1200,
-         {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
+        # diagnostics last: b16:none is the OOM-boundary probe (its
+        # remote-compile 500 is informative and cheap); dots_diag
+        # isolates the instant-garbage trigger at the known-good shape
+        ("suite_7_b16",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "16:none"}),
+        ("suite_7_dots_diag",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "8:dots"}),
     ]
     # MFU attribution (verdict #3's "or a profile explaining why not"):
     # op-class breakdowns parsed from the traces the suite_7 steps above
