@@ -1,0 +1,145 @@
+"""Distributed SQL: scan locally, fold globally.
+
+PG-Strom scales Direct SQL across a partitioned cluster by running the
+GPU scan on every node and merging aggregate state (SURVEY.md §3.5 /
+§5.8's distributed-backend requirement).  The TPU formulation keeps the
+whole storage path LOCAL — every process scans only the Parquet files
+on its own NVMe, with the usual direct-path decode, footer pruning and
+WHERE pushdown — and ships only the RAW foldable partials
+(count/sum/sum2/min/max with segment identities, the same
+``_fold_scan(finalize=False)`` state the single-file and multi-file
+executors use) across hosts.  The cross-process reduction applies the
+op each partial requires (sum for count/sum/sum2, elementwise min/max
+for the extrema) and ONE finalize runs everywhere, so every process
+holds the identical global answer.
+
+Payload economics: table bytes never cross the network — the
+collective moves O(num_groups) floats per aggregate, regardless of
+table size.  A process with no local rows still participates with the
+zero-fold (collectives must be globally congruent or the program
+hangs).
+
+Single-process degenerates to the multi-file union: same partials,
+a trivial gather.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+__all__ = ["dist_groupby", "dist_scalar_agg"]
+
+#: cross-process reduction per raw-partial kind; anything summable
+#: folds with +, the extrema with elementwise min/max over identities
+_REDUCE = {"count": "sum", "sum": "sum", "sum2": "sum",
+           "min": "min", "max": "max"}
+
+
+def _global_fold(folds: Dict[str, object],
+                 had_rows: bool) -> Dict[str, np.ndarray]:
+    """All-gather each partial across processes and reduce with its own
+    op.  Partials are host-side numpy by the time they cross (tiny:
+    O(groups x value-columns)).
+
+    ``had_rows`` travels WITH the partials (as a 0/1 leaf, summed):
+    "no process scanned a row group" must stay distinguishable from
+    "rows streamed but the WHERE matched none" — count==0 alone
+    conflates them, and the single-file executors treat the latter as
+    a legal zero-count/NaN result, not an error.  Raises on the
+    former."""
+    import jax
+    host = {k: np.asarray(v) for k, v in folds.items()}
+    host["_had_rows"] = np.asarray([1 if had_rows else 0], np.int32)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        stacked = multihost_utils.process_allgather(host)  # leading P
+        out = {}
+        for k, v in stacked.items():
+            op = _REDUCE.get(k, "sum")
+            v = np.asarray(v)
+            out[k] = (v.min(axis=0) if op == "min"
+                      else v.max(axis=0) if op == "max"
+                      else v.sum(axis=0))
+        host = out
+    if int(host.pop("_had_rows")[0]) == 0:
+        raise ValueError("empty dataset (no rows on any process)")
+    return host
+
+
+def _local_fold(local_scanners, key_column, vcols, single, num_groups,
+                aggs, method, device, where, where_columns,
+                where_ranges, nulls):
+    """This process's fold (the shared union loop) — or the zero fold:
+    an empty process STILL participates in the gather, since a ragged
+    collective would hang every other process.  Returns
+    (folds, had_rows)."""
+    from nvme_strom_tpu.sql.groupby import _zero_folds
+    from nvme_strom_tpu.sql.multi import _union_fold
+    folds = _union_fold(local_scanners, key_column, vcols, single,
+                        num_groups, aggs, method, device, where,
+                        where_columns, where_ranges, nulls)
+    if folds is None:
+        return _zero_folds(num_groups, aggs,
+                           0 if single else len(vcols)), False
+    return folds, True
+
+
+def dist_groupby(local_scanners: Sequence, key_column: str, value_column,
+                 num_groups: int,
+                 aggs: Sequence[str] = ("count", "sum", "mean"),
+                 method: str = "matmul", device=None,
+                 where=None, where_columns: Sequence[str] = (),
+                 where_ranges: Sequence[tuple] = (),
+                 nulls: str = "forbid") -> Dict[str, np.ndarray]:
+    """``sql_groupby`` over a cluster-partitioned dataset.
+
+    ``local_scanners``: THIS process's files only (each process passes
+    its own list; lists may have different lengths, including empty).
+    ``num_groups`` must be the GLOBAL group count — footer-derived
+    per-process counts could disagree and desynchronize the fold
+    shapes, so it is required here rather than inferred.  Every
+    process returns the identical finalized global result."""
+    from nvme_strom_tpu.sql.groupby import (_validate_nulls,
+                                            _validate_query, _value_cols,
+                                            finalize_folds)
+    from nvme_strom_tpu.sql.multi import _check_schemas
+    _validate_query(aggs, method)
+    where_ranges = list(where_ranges)   # a generator must not exhaust
+    vcols, single = _value_cols(value_column)
+    _validate_nulls(nulls, single)
+    if local_scanners:
+        _check_schemas(local_scanners, [key_column, *vcols])
+    folds, had = _local_fold(local_scanners, key_column, vcols, single,
+                             num_groups, aggs, method, device, where,
+                             where_columns, where_ranges, nulls)
+    gf = _global_fold(folds, had)
+    out = finalize_folds(gf, aggs)
+    return {k: np.asarray(v) for k, v in out.items()}
+
+
+def dist_scalar_agg(local_scanners: Sequence, value_column,
+                    aggs: Sequence[str] = ("count", "sum", "mean"),
+                    method: str = "matmul", device=None,
+                    where=None, where_columns: Sequence[str] = (),
+                    where_ranges: Sequence[tuple] = (),
+                    nulls: str = "forbid") -> Dict[str, object]:
+    """``sql_scalar_agg`` over a cluster-partitioned dataset — one
+    global group, same local-scan/global-fold split."""
+    from nvme_strom_tpu.sql.groupby import (_validate_nulls,
+                                            _validate_query, _value_cols,
+                                            finalize_folds)
+    from nvme_strom_tpu.sql.multi import _check_schemas
+    _validate_query(aggs, method)
+    where_ranges = list(where_ranges)
+    vcols, single = _value_cols(value_column)
+    _validate_nulls(nulls, single)
+    if local_scanners:
+        _check_schemas(local_scanners, vcols)
+    folds, had = _local_fold(local_scanners, None, vcols, single, 1,
+                             aggs, method, device, where, where_columns,
+                             where_ranges, nulls)
+    gf = _global_fold(folds, had)
+    res = finalize_folds(gf, aggs)
+    return {a: np.asarray(res[a])[0] for a in res}

@@ -190,6 +190,20 @@ def _zero_folds(num_groups: int, aggs,
     return f
 
 
+def _validate_nulls(nulls: str, single: bool) -> None:
+    """The one null-policy gate for every scan-fold entry point
+    (single-file, multi-file, distributed): a typo'd policy must raise,
+    never silently run as 'forbid'; skip with a multi-column value list
+    would AND all columns' validity into every aggregate (non-SQL)."""
+    if nulls not in ("forbid", "skip"):
+        raise ValueError(f"bad nulls={nulls!r}")
+    if nulls == "skip" and not single:
+        raise ValueError(
+            "nulls='skip' supports a single value column (per-column "
+            "NULL patterns would need per-column counts); aggregate "
+            "one nullable column at a time")
+
+
 def _value_cols(value_column):
     """value_column str | list | tuple → (list of names, single flag).
 
@@ -405,15 +419,9 @@ def sql_groupby(scanner, key_column: str, value_column,
     counts); the default "forbid" raises on any NULL.
     """
     _validate_query(aggs, method)
-    if nulls not in ("forbid", "skip"):
-        raise ValueError(f"bad nulls={nulls!r}")
     where_ranges = list(where_ranges)   # a generator must not exhaust
     vcols, single = _value_cols(value_column)
-    if nulls == "skip" and not single:
-        raise ValueError(
-            "nulls='skip' supports a single value column (per-column "
-            "NULL patterns would need per-column counts); aggregate "
-            "one nullable column at a time")
+    _validate_nulls(nulls, single)
     return _fold_scan(scanner, key_column, vcols, single, num_groups,
                       aggs, method, device, where, where_columns,
                       where_ranges, nulls)
@@ -514,12 +522,9 @@ def sql_scalar_agg(scanner, value_column,
     not re-derived.  Returns {agg: scalar} (or (n_columns,) arrays for
     a ``value_column`` list)."""
     _validate_query(aggs, method)
-    if nulls not in ("forbid", "skip"):
-        raise ValueError(f"bad nulls={nulls!r}")
     where_ranges = list(where_ranges)
     vcols, single = _value_cols(value_column)
-    if nulls == "skip" and not single:
-        raise ValueError("nulls='skip' supports a single value column")
+    _validate_nulls(nulls, single)
     res = _fold_scan(scanner, None, vcols, single, 1, aggs, method,
                      device, where, where_columns, where_ranges, nulls)
     return {a: res[a][0] for a in res}

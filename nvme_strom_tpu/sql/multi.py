@@ -62,21 +62,15 @@ def _check_schemas(scanners, columns) -> None:
                 f"{got}, first file has {ref}")
 
 
-def multi_groupby(scanners: Sequence, key_column: str, value_column,
-                  num_groups: int,
-                  aggs: Sequence[str] = ("count", "sum", "mean"),
-                  method: str = "matmul", device=None,
-                  where=None, where_columns: Sequence[str] = (),
-                  where_ranges: Sequence[tuple] = (),
-                  nulls: str = "forbid") -> Dict[str, object]:
-    """`sql_groupby` over a file union — one fold, one finalize."""
-    from nvme_strom_tpu.sql.groupby import (_fold, _fold_scan,
-                                            _validate_query, _value_cols,
-                                            finalize_folds)
-    _validate_query(aggs, method)
-    where_ranges = list(where_ranges)   # a generator must not exhaust
-    vcols, single = _value_cols(value_column)   # after file 0
-    _check_schemas(scanners, [key_column, *vcols])
+def _union_fold(scanners, key_column, vcols, single, num_groups, aggs,
+                method, device, where, where_columns, where_ranges,
+                nulls):
+    """THE per-scanner fold loop (raw partials, fully-pruned members
+    skipped) shared by the multi-file union and the distributed
+    executor — three copies of this loop had started to drift (advisor
+    round-4).  Returns the folded partials, or None when no member
+    produced any row group."""
+    from nvme_strom_tpu.sql.groupby import _fold, _fold_scan
     folds = None
     for sc in scanners:
         try:
@@ -88,6 +82,28 @@ def multi_groupby(scanners: Sequence, key_column: str, value_column,
                 continue                  # must not kill the union
             raise
         folds = part if folds is None else _fold(folds, part)
+    return folds
+
+
+def multi_groupby(scanners: Sequence, key_column: str, value_column,
+                  num_groups: int,
+                  aggs: Sequence[str] = ("count", "sum", "mean"),
+                  method: str = "matmul", device=None,
+                  where=None, where_columns: Sequence[str] = (),
+                  where_ranges: Sequence[tuple] = (),
+                  nulls: str = "forbid") -> Dict[str, object]:
+    """`sql_groupby` over a file union — one fold, one finalize."""
+    from nvme_strom_tpu.sql.groupby import (_validate_nulls,
+                                            _validate_query, _value_cols,
+                                            finalize_folds)
+    _validate_query(aggs, method)
+    where_ranges = list(where_ranges)   # a generator must not exhaust
+    vcols, single = _value_cols(value_column)   # after file 0
+    _validate_nulls(nulls, single)
+    _check_schemas(scanners, [key_column, *vcols])
+    folds = _union_fold(scanners, key_column, vcols, single, num_groups,
+                        aggs, method, device, where, where_columns,
+                        where_ranges, nulls)
     if folds is None:
         raise ValueError("empty dataset (no rows in any file)")
     return finalize_folds(folds, aggs)
@@ -100,24 +116,17 @@ def multi_scalar_agg(scanners: Sequence, value_column,
                      where_ranges: Sequence[tuple] = (),
                      nulls: str = "forbid") -> Dict[str, object]:
     """`sql_scalar_agg` over a file union."""
-    from nvme_strom_tpu.sql.groupby import (_fold, _fold_scan,
+    from nvme_strom_tpu.sql.groupby import (_validate_nulls,
                                             _validate_query, _value_cols,
                                             finalize_folds)
     _validate_query(aggs, method)
     where_ranges = list(where_ranges)   # a generator must not exhaust
     vcols, single = _value_cols(value_column)   # after file 0
+    _validate_nulls(nulls, single)
     _check_schemas(scanners, vcols)
-    folds = None
-    for sc in scanners:
-        try:
-            part = _fold_scan(sc, None, vcols, single, 1, aggs, method,
-                              device, where, where_columns, where_ranges,
-                              nulls, finalize=False)
-        except ValueError as e:
-            if "empty table" in str(e):
-                continue
-            raise
-        folds = part if folds is None else _fold(folds, part)
+    folds = _union_fold(scanners, None, vcols, single, 1, aggs, method,
+                        device, where, where_columns, where_ranges,
+                        nulls)
     if folds is None:
         raise ValueError("empty dataset (no rows in any file)")
     res = finalize_folds(folds, aggs)

@@ -302,6 +302,53 @@ _WORKER = textwrap.dedent("""
     fresh = MixtureLoader([((), 1.0), ((), 3.0)], seed=5)
     assert seen == [fresh._draw(t) for t in range(6)], seen
 
+    # -- distributed SQL (sql/dist.py): each process scans ONLY its own
+    # parquet partition; only O(groups) partials cross hosts; both
+    # processes finish with the identical global GROUP BY answer.
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.sql import dist_groupby, dist_scalar_agg
+    from nvme_strom_tpu.sql.parquet import ParquetScanner
+    rng5 = np.random.default_rng(41)               # SAME seed both procs
+    n_rows = 6000
+    keys = rng5.integers(0, 7, n_rows).astype(np.int32)
+    vals = rng5.standard_normal(n_rows).astype(np.float32)
+    part_paths = []
+    for s in range(2):
+        p = os.path.join(d, f"sql-part-{s}.parquet")
+        if pid == 0:
+            tmp = p + f".tmp{pid}"
+            sl = slice(s * 3000, (s + 1) * 3000)
+            pq.write_table(pa.table({"k": keys[sl], "v": vals[sl]}),
+                           tmp, row_group_size=1024)
+            os.replace(tmp, p)
+        part_paths.append(p)
+    _await_files(part_paths)
+    with StromEngine() as sql_eng:
+        local = [ParquetScanner(part_paths[pid], sql_eng)]   # OWN file
+        out = dist_groupby(local, "k", "v", 7,
+                           aggs=("count", "sum", "mean"))
+        for g in range(7):
+            m = keys == g
+            assert int(out["count"][g]) == int(m.sum()), g
+            np.testing.assert_allclose(out["sum"][g], vals[m].sum(),
+                                       rtol=1e-3)
+        sc = dist_scalar_agg(local, "v", aggs=("count", "sum", "min",
+                                               "max"))
+        assert int(sc["count"]) == n_rows
+        np.testing.assert_allclose(float(sc["min"]), vals.min(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(sc["max"]), vals.max(),
+                                   rtol=1e-6)
+        # empty-partition congruence: pid 1 has no local files and must
+        # still reach the same global answer (the gather is congruent)
+        out2 = dist_groupby(local if pid == 0 else [], "k", "v", 7,
+                            aggs=("count",))
+        expect2 = np.bincount(keys[:3000], minlength=7)
+        np.testing.assert_array_equal(out2["count"].astype(np.int64),
+                                      expect2)
+
     print(f"proc{pid} OK", flush=True)
 """).replace("@REPO@", str(REPO))
 

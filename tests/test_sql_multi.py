@@ -188,3 +188,56 @@ def test_multi_topk_tie_order_deterministic(tmp_path, engine):
         np.testing.assert_array_equal(out["_file"], [0, 0, 0, 0, 1])
         np.testing.assert_array_equal(out["_row"], [0, 1, 2, 3, 0])
         np.testing.assert_array_equal(out["tag"], [0, 1, 2, 3, 10])
+
+
+def test_dist_matches_multi_single_process(dataset, engine):
+    """sql/dist.py on one process: the local fold + trivial gather must
+    equal the multi-file union (same partials, same finalize) — and the
+    scalar form must match the concatenated truth."""
+    from nvme_strom_tpu.sql import (dist_groupby, dist_scalar_agg,
+                                    multi_groupby)
+    d, full = dataset
+    scs = open_dataset(d, engine)
+    got = dist_groupby(scs, "k", "v", 11,
+                       aggs=("count", "sum", "mean", "min", "max"))
+    ref = multi_groupby(scs, "k", "v", 11,
+                        aggs=("count", "sum", "mean", "min", "max"))
+    for a in ("count", "sum", "mean", "min", "max"):
+        np.testing.assert_allclose(np.asarray(got[a]),
+                                   np.asarray(ref[a]), rtol=1e-5)
+    sc = dist_scalar_agg(scs, "v", aggs=("count", "sum", "min", "max"))
+    assert int(sc["count"]) == len(full["v"])
+    np.testing.assert_allclose(float(sc["min"]), full["v"].min(),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(sc["max"]), full["v"].max(),
+                               rtol=1e-6)
+
+
+def test_dist_empty_everywhere_raises(engine):
+    from nvme_strom_tpu.sql import dist_groupby
+    with pytest.raises(ValueError, match="empty dataset"):
+        dist_groupby([], "k", "v", 4)
+
+
+def test_dist_where_matches_nothing_is_legal_zero(dataset, engine):
+    """A selective WHERE that excludes every row is a LEGAL zero-count
+    result (NaN means), NOT 'empty dataset' — the distributed executor
+    must match the single-file contract (advisor round-4)."""
+    from nvme_strom_tpu.sql import dist_groupby
+    d, _ = dataset
+    scs = open_dataset(d, engine)
+    out = dist_groupby(scs, "k", "v", 11, aggs=("count", "mean"),
+                       where_ranges=[("ts", 10_000_000, None)])
+    np.testing.assert_array_equal(np.asarray(out["count"]),
+                                  np.zeros(11))
+    assert np.all(np.isnan(np.asarray(out["mean"])))
+
+
+def test_dist_nulls_validation(dataset, engine):
+    from nvme_strom_tpu.sql import dist_groupby
+    d, _ = dataset
+    scs = open_dataset(d, engine)
+    with pytest.raises(ValueError, match="bad nulls"):
+        dist_groupby(scs, "k", "v", 11, nulls="mask")
+    with pytest.raises(ValueError, match="single value column"):
+        dist_groupby(scs, "k", ["v", "ts"], 11, nulls="skip")
