@@ -54,6 +54,10 @@ def main(argv=None) -> int:
                          "tokens, not slots×max-len)")
     ap.add_argument("--block-len", type=int, default=128,
                     help="positions per pool block for --paged")
+    ap.add_argument("--lookahead", type=int, default=1,
+                    help="decode steps per host readback (8-16 "
+                         "amortizes a high-latency host<->device link; "
+                         "token-identical to 1)")
     args = ap.parse_args(argv)
     if not args.request:
         ap.error("at least one --request")
@@ -140,7 +144,7 @@ def main(argv=None) -> int:
                    seed=args.seed + i)
 
     t0 = time.monotonic()
-    results = srv.run()
+    results = srv.run(lookahead=args.lookahead)
     dt = time.monotonic() - t0
     total = sum(len(v) for v in results.values())
     for rid, ids, _ in reqs:
