@@ -66,3 +66,128 @@ def test_build_aggregates_only_valid_and_audits_rest(tmp_path):
     whys = {r["line"]: r["why"] for r in rep["rejected"]}
     assert set(whys) == {2, 4}
     assert "tombstoned" in whys[2] and whys[4].startswith("rc=-1")
+
+
+def test_contract_coverage_maps_variants_and_bars(tmp_path):
+    ledger = tmp_path / "ledger.jsonl"
+    rows = [
+        # bench evidences config 1; 0.903 meets the verdict's ≥0.9 bar
+        _row(step="bench", results=[{
+            "metric": "NVMe->HBM (dev=tpu, interleaved raw=1.2 link=0.5)",
+            "value": 0.43, "unit": "GiB/s", "vs_baseline": 0.903}]),
+        # variant step counts for its base config (7), best MFU wins
+        _row(step="suite_7", results=[{
+            "metric": "config7:train (dev=tpu, mfu=35.3%)",
+            "value": 69.6, "unit": "TFLOP/s", "vs_baseline": None}]),
+        _row(step="suite_7_d3072", results=[{
+            "metric": "config7:train (dev=tpu, mfu=47.0%)",
+            "value": 92.0, "unit": "TFLOP/s", "vs_baseline": None}]),
+        # suite_11_prefix_v2 is config-11 evidence (attr bar: any row)
+        _row(step="suite_11_prefix_v2", results=[{
+            "metric": "config11:serving (dev=tpu)", "value": 100.0,
+            "unit": "tok/s", "vs_baseline": None}]),
+        # suite_15 under its ratio bar
+        _row(step="suite_15", results=[{
+            "metric": "config15:topk (dev=tpu)", "value": 0.02,
+            "unit": "GiB/s", "vs_baseline": 0.065}]),
+    ]
+    ledger.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    c = lr.build(str(ledger))["contract"]
+    assert c[1]["status"] == "met" and c[1]["vs_baseline"] == 0.903
+    # config 7: the d3072 variant's 47% MFU clears the ≥45% bar
+    assert c[7]["status"] == "met" and c[7]["mfu_pct"] == 47.0
+    assert c[7]["step"] == "suite_7_d3072"
+    assert c[11]["status"] == "evidenced"
+    assert c[15]["status"] == "under"
+    # suite_1x steps never leak into config 1
+    assert c[2]["status"] == "missing" and c[3]["status"] == "missing"
+    assert all(c[n]["status"] == "missing" for n in (4, 5, 6))
+
+
+def test_contract_combined_step_and_none_ratio(tmp_path):
+    ledger = tmp_path / "ledger.jsonl"
+    rows = [
+        # the round-3 ledger's combined suite_5_6_7 step: each config is
+        # credited with ITS result row, not results[0]'s
+        _row(step="suite_5_6_7", results=[
+            {"metric": "config5:scan (dev=tpu)", "value": 0.03,
+             "unit": "GiB/s", "vs_baseline": 0.5},
+            {"metric": "config6:decode (dev=tpu)", "value": 5000.0,
+             "unit": "tok/s", "vs_baseline": None},
+            {"metric": "config7:train (dev=tpu, mfu=30.0%)",
+             "value": 59.0, "unit": "TFLOP/s", "vs_baseline": None}]),
+        # a ratio-config row that never computed a ratio must surface as
+        # evidence, not as a fabricated vs_baseline=0.0 'under'
+        _row(step="suite_8", results=[{
+            "metric": "config8:multistream (dev=tpu)", "value": 0.4,
+            "unit": "GiB/s", "vs_baseline": None}]),
+    ]
+    ledger.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    c = lr.build(str(ledger))["contract"]
+    assert c[5]["status"] == "under" and c[5]["value"] == 0.03
+    assert c[6]["status"] == "evidenced" and c[6]["unit"] == "tok/s"
+    assert c[7]["status"] == "under" and c[7]["mfu_pct"] == 30.0
+    assert c[8]["status"] == "evidenced" and "vs_baseline" not in c[8]
+
+
+def test_contract_combined_step_missing_result_not_credited(tmp_path):
+    """A combined suite_5_6_7 row whose config-7 result failed to
+    harvest must NOT credit config 7 with config 5's number."""
+    ledger = tmp_path / "ledger.jsonl"
+    rows = [_row(step="suite_5_6_7", results=[
+        {"metric": "config5:scan (dev=tpu)", "value": 0.03,
+         "unit": "GiB/s", "vs_baseline": 0.5}])]
+    ledger.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    c = lr.build(str(ledger))["contract"]
+    assert c[5]["status"] == "under"
+    assert c[6]["status"] == "missing"
+    assert c[7]["status"] == "missing"
+
+
+def test_contract_mfu_profile_arm(tmp_path):
+    """The config-7 bar is '>=45% MFU OR a profile explaining why not':
+    a valid profile_* parse upgrades an under-bar MFU to 'attributed'."""
+    ledger = tmp_path / "ledger.jsonl"
+    rows = [
+        _row(step="suite_7", results=[{
+            "metric": "config7:train (dev=tpu, mfu=38.6%)",
+            "value": 76.0, "unit": "TFLOP/s", "vs_baseline": None}]),
+        _row(step="profile_d2048", results=[{
+            "metric": "config7:profile-breakdown (dev=tpu, conv=61% "
+                      "copy=22% other=17%)",
+            "value": 61.0, "unit": "%", "vs_baseline": None}]),
+    ]
+    ledger.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    c = lr.build(str(ledger))["contract"]
+    assert c[7]["status"] == "attributed"
+    assert c[7]["mfu_pct"] == 38.6
+    assert c[7]["profile_step"] == "profile_d2048"
+
+
+def test_contract_registry_matches_bench_suite_source():
+    """CONTRACT hand-mirrors bench_suite.py's config registry (labels +
+    the io_row flag that decides ratio-vs-attr bars).  Pin the two
+    together by parsing the registry out of the suite source, so adding
+    config 17 or flipping an io_row flag breaks THIS test instead of
+    silently dropping evidence."""
+    import os
+    import re
+    path = os.path.join(os.path.dirname(lr.__file__), "..", "..",
+                        "bench_suite.py")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    entries = re.findall(
+        r'^\s+(\d+):\s*\("([^"]+)",[^)]*?\)?,\s*\n?\s*"[^"]*",\s*(True|False)\),',
+        src, re.M)
+    assert entries, "failed to parse bench_suite config registry"
+    parsed = {int(n): (label, flag == "True") for n, label, flag in entries}
+    assert set(parsed) == set(lr.CONTRACT), (
+        f"configs drifted: suite={sorted(parsed)} "
+        f"report={sorted(lr.CONTRACT)}")
+    for n, (label, io_row) in parsed.items():
+        rep_label, bar = lr.CONTRACT[n]
+        assert label in rep_label, (n, label, rep_label)
+        if bar == "ratio":
+            assert io_row, f"config {n}: ratio bar but io_row=False"
+        else:
+            assert not io_row, f"config {n}: {bar} bar but io_row=True"
