@@ -1177,10 +1177,13 @@ def bench_serving(device=None) -> tuple[float, str]:
 def _train_variant(cfg, batch: int, seq: int, dev,
                    profile_dir: str | None = None,
                    attn: str = "dense") -> float:
-    """Median model-FLOP/s of one (config, batch, attn) train-step
-    variant; optionally capture a 3-step jax profiler trace while at
-    it.  ``attn``: "dense" (XLA) or "flash" (the Pallas fused kernel —
-    O(s) memory, the long-context/occupancy lever)."""
+    """Aggregate model-FLOP/s of one (config, batch, attn) train-step
+    variant — _RUNS chained steps in ONE timed window bracketed by
+    data-dependent host transfers (not per-step medians: per-step
+    blocking is exactly what the axon runtime lies about); optionally
+    capture a 3-step jax profiler trace while at it.  ``attn``:
+    "dense" (XLA) or "flash" (the Pallas fused kernel — O(s) memory,
+    the long-context/occupancy lever)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -1205,22 +1208,31 @@ def _train_variant(cfg, batch: int, seq: int, dev,
                    donate_argnums=(0, 1))
     params, opt_state, loss = step(params, opt_state, tokens)  # compile
     jax.block_until_ready((params, opt_state, loss))
-    rates, losses = [], []
+    # Timing discipline, third iteration.  Round-3 lesson: loss-only
+    # blocking returned early (44x/163x peak).  Full-tree
+    # block_until_ready fixed d2048 but the 2026-07-31T18:01 window
+    # STILL ledgered d3072/d4096 at 114x/42x peak with rc=0 AND an
+    # evolving, finite loss — on those shapes the axon runtime's
+    # block_until_ready itself returns before execution while the
+    # device runs the chain asynchronously.  So don't trust blocking at
+    # all: bracket N CHAINED steps between data-dependent host
+    # transfers.  float(loss) before the clock pins the start; the
+    # final float() cannot produce bytes until every chained step has
+    # executed (step k consumes step k-1's donated params), so
+    # dispatch-only timing is impossible by construction.
+    float(loss)                       # host round-trip: timeline start
+    losses = []
+    t0 = time.monotonic()
     for _ in range(_RUNS):
-        t0 = time.monotonic()
         params, opt_state, loss = step(params, opt_state, tokens)
-        # block on the WHOLE output tree: the 2026-07-31 window ledgered
-        # d3072/d4096 rows at 44x/163x device peak because loss-only
-        # blocking returned before the update finished on the tunneled
-        # runtime — a rate above peak is a timing artifact by definition
-        jax.block_until_ready((params, opt_state, loss))
-        rates.append(flops_step / (time.monotonic() - t0))
         losses.append(loss)
-    # execution sanity: the tunneled runtime has returned instantly with
-    # garbage instead of raising (2026-07-31, remat=dots variants at
-    # 17-32x device peak even under full-tree blocking).  A real Adam
-    # trajectory moves the loss every step and keeps it finite; anything
-    # else means the device did not actually run the program
+    float(losses[-1])                 # forces the whole chain
+    elapsed = time.monotonic() - t0
+    rate = _RUNS * flops_step / elapsed
+    # execution sanity: a real Adam trajectory moves the loss every
+    # step and keeps it finite; anything else means the device did not
+    # actually run the program (the tunneled runtime has returned
+    # garbage instead of raising)
     vals = [float(x) for x in jax.device_get(losses)]
     if not all(math.isfinite(v) for v in vals) or len(set(vals)) <= 1:
         raise RuntimeError(f"loss sanity failed (runtime returned "
@@ -1232,10 +1244,14 @@ def _train_variant(cfg, batch: int, seq: int, dev,
             for _ in range(3):
                 params, opt_state, loss = step(params, opt_state,
                                                tokens)
-            jax.block_until_ready(loss)
+            # data-dependent host fetch, NOT block_until_ready: on the
+            # shapes where blocking returns early the trace context
+            # would close before the steps execute, committing an
+            # empty trace as MFU "evidence"
+            float(loss)
         _log(f"suite: wrote jax profiler trace to {profile_dir}")
     del params, opt_state
-    return statistics.median(rates)
+    return rate
 
 
 def bench_opt_offload(engine) -> tuple[float, str]:
