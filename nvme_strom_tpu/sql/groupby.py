@@ -23,6 +23,7 @@ caveat for |mean| >> std).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Dict, Sequence
 
@@ -230,11 +231,24 @@ def _stack_values(cols, vcols, single):
     return jnp.stack([cols[c] for c in vcols], axis=1)
 
 
+def sql_window_bytes() -> int:
+    """Row-group coalescing target for FOLD consumers' scans (bytes per
+    yielded batch on the all-PLAIN direct path).  Each yielded batch
+    costs a fixed set of consumer dispatches (concat/view/fold), and on
+    a high-latency link those dispatches — not bandwidth — priced the
+    on-silicon config-5 scan (0.186 GiB/s under a 1.35 GiB/s link), so
+    bigger batches amortize them.  64 MiB default ≈ 4-8 typical row
+    groups while bounding device residency well under HBM;
+    STROM_SQL_WINDOW_BYTES overrides (0 disables coalescing)."""
+    v = os.environ.get("STROM_SQL_WINDOW_BYTES")
+    return int(v) if v is not None else 64 << 20
+
+
 def iter_device_columns(scanner, columns: Sequence[str], dev,
                         require_int: Sequence[str] = (),
                         narrow_int32: Sequence[str] = (),
                         row_groups=None, nulls: str = "forbid",
-                        plans=None):
+                        plans=None, window_bytes: int | None = None):
     """Stream a scanner's row groups as {name: device array} dicts.
 
     One policy for every on-device SQL consumer (groupby, join): the
@@ -256,7 +270,12 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
     ``allow_nulls`` matching this call's ``nulls``) — callers that
     stream a table in several ``row_groups`` windows (sql_topk's
     elimination loop) pass it so the page walk happens once, not per
-    window."""
+    window.
+
+    ``window_bytes``: row-group coalescing for FOLD consumers (see
+    :func:`sql_window_bytes`); applies on the all-PLAIN direct path
+    only.  Positional consumers that zip yields against row-group ids
+    or early-exit per group must leave it None (one yield per group)."""
     import numpy as np
     from nvme_strom_tpu.ops.bridge import host_to_device
     from nvme_strom_tpu.sql import pq_direct
@@ -278,7 +297,8 @@ def iter_device_columns(scanner, columns: Sequence[str], dev,
     if plans is not None:
         for cols in pq_direct.iter_plain_row_groups_to_device(
                 scanner, columns, device=dev, plans=plans,
-                row_groups=row_groups, nulls=nulls):
+                row_groups=row_groups, nulls=nulls,
+                window_bytes=window_bytes):
             if masked:
                 vals = {c: v for c, (v, _) in cols.items()}
                 masks = {c: m for c, (_, m) in cols.items()}
@@ -472,9 +492,12 @@ def _fold_scan(scanner, key_column, vcols, single, num_groups, aggs,
                 yield (keys_of(cols),
                        _stack_values(cols, vcols, single), cols, base)
         else:
+            # fold consumers are yield-size-agnostic: coalesce row
+            # groups so each concat/view/fold dispatch covers a window
             for cols in iter_device_columns(scanner, cols_needed, dev,
                                             narrow_int32=tuple(key_cols),
-                                            row_groups=rgs):
+                                            row_groups=rgs,
+                                            window_bytes=sql_window_bytes()):
                 yield (keys_of(cols),
                        _stack_values(cols, vcols, single), cols, None)
 

@@ -82,7 +82,8 @@ def star_join_groupby(fact_scanner, fact_key: str, fact_value: str,
     Returns {agg: (num_groups,)} like :func:`.groupby.sql_groupby`.
     """
     from nvme_strom_tpu.sql.groupby import (
-        _fold, finalize_folds, iter_device_columns)
+        _fold, _norm_aggs, finalize_folds, iter_device_columns,
+        sql_window_bytes)
 
     dev = device or jax.local_devices()[0]
 
@@ -102,14 +103,14 @@ def star_join_groupby(fact_scanner, fact_key: str, fact_value: str,
     dkeys = dcols[dim_key].astype(kdt)
     dattr = dcols[dim_attr].astype(jnp.int32)
 
-    from nvme_strom_tpu.sql.groupby import _norm_aggs
     part_aggs = _norm_aggs(aggs)   # ONE foldable-set rule (var/std
                                    # fold via sum2, mean via sum/count)
     cols_needed = list(dict.fromkeys(
         [fact_key, fact_value, *where_columns]))
     folds = None
     for cols in iter_device_columns(fact_scanner, cols_needed, dev,
-                                    require_int=(fact_key,)):
+                                    require_int=(fact_key,),
+                                    window_bytes=sql_window_bytes()):
         mask = where(cols) if where is not None else None
         part = _join_part(dkeys, dattr, cols[fact_key],
                           cols[fact_value], mask,

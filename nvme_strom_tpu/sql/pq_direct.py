@@ -1524,7 +1524,8 @@ def read_dict_key_column(scanner, column: str, device=None,
 def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
                                     device=None, plans=None,
                                     row_groups=None,
-                                    nulls: str = "forbid"):
+                                    nulls: str = "forbid",
+                                    window_bytes: int | None = None):
     """Yield {name: device array} per (selected) row group — the
     incremental form sql_groupby folds over, so device memory holds one
     row group of columns at a time regardless of table size.  ``plans``
@@ -1533,6 +1534,17 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
     elimination — skipped chunks never leave the SSD).  ``nulls`` as in
     :func:`read_plain_columns_to_device` ("mask" yields (values, mask)
     pairs per column).
+
+    ``window_bytes`` batches consecutive row groups into one yielded
+    dict holding ~that many payload bytes (all-PLAIN ``forbid`` path
+    only).  For FOLD consumers exclusively: on a high-latency link the
+    per-row-group consumer ops (concat/view/fold dispatches) price the
+    scan, not bandwidth — the 2026-07-31T18:04 on-silicon row ledgered
+    the config-5 stream at 0.186 GiB/s under a 1.35 GiB/s link, ~20 ms
+    per dispatch across ~70 of them.  Windowing divides the dispatch
+    count by the window's group count.  Default None = one yield per
+    row group — POSITIONAL consumers (topk zips yields against row-
+    group ids; LIMIT scans early-exit per group) must keep that.
 
     When every selected chunk is raw-PLAIN (the common analytics case),
     the WHOLE scan is one pipelined range sequence — row-group
@@ -1563,7 +1575,8 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
                 _plain_only([plans[c][rg]])
                 for rg in groups for c in columns):
             yield from _iter_plain_pipelined(scanner, ds, fh, columns,
-                                             plans, groups)
+                                             plans, groups,
+                                             window_bytes=window_bytes)
             return
         for rg in groups:
             out = {}
@@ -1581,7 +1594,8 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
         scanner.engine.close(fh)
 
 
-def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups):
+def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups,
+                          window_bytes: int | None = None):
     """All-raw-PLAIN scan as ONE pipelined range sequence.
 
     Every (row group, column) chunk's spans are flattened into a single
@@ -1592,33 +1606,59 @@ def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups):
     yield order.  The fold's device compute overlaps the stream for
     free — JAX dispatch is async, so by the time the consumer asks for
     the next group's chunks, its aggregation is already queued behind
-    the transfers."""
+    the transfers.
+
+    ``window_bytes`` (see :func:`iter_plain_row_groups_to_device`)
+    coalesces consecutive row groups into one yield of ~that size, so
+    each consumer-side concat/view/fold dispatch covers a window of
+    payload instead of one group — the dispatch-latency lever."""
     import jax.numpy as jnp
     import numpy as np
     from nvme_strom_tpu.ops.bridge import split_ranges
 
+    if window_bytes:
+        windows, cur, cur_b = [], [], 0
+        for rg in groups:
+            b = sum(ln for c in columns for _, ln in plans[c][rg].spans)
+            if cur and cur_b + b > window_bytes:
+                windows.append(cur)
+                cur, cur_b = [], 0
+            cur.append(rg)
+            cur_b += b
+        if cur:
+            windows.append(cur)
+    else:
+        windows = [[rg] for rg in groups]
+
     chunk_bytes = scanner.engine.config.chunk_bytes
     flat = []                      # every sub-range, submission order
     counts = []                    # (rg, column, n_chunks)
-    for rg in groups:
-        for c in columns:
-            ranges, _ = split_ranges(plans[c][rg].spans, chunk_bytes)
-            flat.extend(ranges)
-            counts.append((rg, c, len(ranges)))
+    for w in windows:
+        for rg in w:
+            for c in columns:
+                ranges, _ = split_ranges(plans[c][rg].spans, chunk_bytes)
+                flat.extend(ranges)
+                counts.append((rg, c, len(ranges)))
     it = ds.stream_ranges(fh, flat)
+    ci = iter(counts)
     try:
-        out = {}
-        for rg, c, n in counts:
-            parts = [next(it) for _ in range(n)]
-            np_dtype = np.dtype(_NP_DTYPES[plans[c][rg].physical_type])
-            if not parts:          # zero-row group
-                out[c] = jnp.zeros((0,), dtype=np_dtype)
-            else:
-                flat_arr = (parts[0] if len(parts) == 1
-                            else jnp.concatenate(parts))
-                out[c] = flat_arr.view(np_dtype)
-            if len(out) == len(columns):
-                yield out
-                out = {}
+        for w in windows:
+            parts: dict = {c: [] for c in columns}
+            for rg in w:
+                for c in columns:
+                    _, _, n = next(ci)
+                    parts[c].extend(next(it) for _ in range(n))
+            out = {}
+            for c in columns:
+                np_dtype = np.dtype(
+                    _NP_DTYPES[plans[c][w[0]].physical_type])
+                ps = parts[c]
+                if not ps:         # zero-row window
+                    out[c] = jnp.zeros((0,), dtype=np_dtype)
+                else:
+                    flat_arr = (ps[0] if len(ps) == 1
+                                else jnp.concatenate(ps))
+                    out[c] = flat_arr.view(np_dtype)
+            yield out
     finally:
         it.close()                 # abandoned scan: release staging now

@@ -254,6 +254,13 @@ def capture(device: str) -> bool:
         # coverage, ordered by how directly the verdict asked
         ("suite_5_v2", [sys.executable, "bench_suite.py", "--config", "5"],
          900, None),
+        # "_v3": round-4 second iteration — the v2 on-silicon row's own
+        # phase tags (stream=0.186 GiB/s under a 1.35 GiB/s link,
+        # fold_overhead=0.667s) showed per-dispatch RTT, not bandwidth,
+        # priced the scan; v3 measures the row-group-coalescing window
+        # (sql_window_bytes) that divides the dispatch count ~8x
+        ("suite_5_v3", [sys.executable, "bench_suite.py", "--config", "5"],
+         900, None),
         ("suite_12_v2",
          [sys.executable, "bench_suite.py", "--config", "12"], 900, None),
         # 1800s: the dict-scan kernel burned two 900s timeouts inside

@@ -786,6 +786,73 @@ def test_pipelined_iter_boundaries_and_pruning(tmp_path, engine):
                                           data[c][lo:hi])
 
 
+def test_windowed_iter_coalesces_and_matches(tmp_path, engine):
+    """window_bytes batches consecutive row groups into fewer yields
+    (the dispatch-latency lever for fold consumers) without changing
+    the concatenated data or its order — including under a pruned
+    subset, and degenerating to per-group yields when smaller than one
+    group."""
+    import jax
+    rows = 40_000
+    rng = np.random.default_rng(11)
+    data = {
+        "k": rng.integers(0, 9, rows).astype(np.int32),
+        "v": rng.standard_normal(rows).astype(np.float32),
+    }
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table(data), path, row_group_size=4096,
+                   use_dictionary=False, compression="none")
+    sc = ParquetScanner(path, engine)
+    dev = jax.local_devices()[0]
+    per_rg = list(pq_direct.iter_plain_row_groups_to_device(
+        sc, ["k", "v"], device=dev))
+    # ~2 groups of payload per window → fewer yields, same bytes
+    win = list(pq_direct.iter_plain_row_groups_to_device(
+        sc, ["k", "v"], device=dev, window_bytes=2 * 4096 * 8))
+    assert 1 < len(win) < len(per_rg)
+    for c in ("k", "v"):
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(g[c]) for g in win]), data[c])
+    # pruned, out-of-order subset keeps submission order within windows
+    subset = [7, 2, 9]
+    winp = list(pq_direct.iter_plain_row_groups_to_device(
+        sc, ["k", "v"], device=dev, row_groups=subset,
+        window_bytes=1 << 30))
+    assert len(winp) == 1
+    want = np.concatenate([data["v"][rg * 4096:(rg + 1) * 4096]
+                           for rg in subset])
+    np.testing.assert_array_equal(np.asarray(winp[0]["v"]), want)
+    # a window smaller than one group degenerates to per-group yields
+    tiny = list(pq_direct.iter_plain_row_groups_to_device(
+        sc, ["k", "v"], device=dev, window_bytes=1))
+    assert len(tiny) == len(per_rg)
+
+
+def test_groupby_windowing_invariant(tmp_path, engine, monkeypatch):
+    """sql_groupby's result must not depend on the coalescing window
+    (the fold is associative); pin window-on == window-off."""
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    rows = 50_000
+    rng = np.random.default_rng(3)
+    data = {
+        "k": rng.integers(0, 16, rows).astype(np.int64),
+        "v": rng.standard_normal(rows).astype(np.float64),
+    }
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table(data), path, row_group_size=4096,
+                   use_dictionary=False, compression="none")
+    sc = ParquetScanner(path, engine)
+    monkeypatch.setenv("STROM_SQL_WINDOW_BYTES", "0")
+    off = sql_groupby(sc, "k", "v", 16, aggs=("count", "sum", "min",
+                                              "max"))
+    monkeypatch.setenv("STROM_SQL_WINDOW_BYTES", str(64 << 20))
+    on = sql_groupby(sc, "k", "v", 16, aggs=("count", "sum", "min",
+                                             "max"))
+    for a in off:
+        np.testing.assert_allclose(np.asarray(off[a]), np.asarray(on[a]),
+                                   rtol=1e-12, err_msg=a)
+
+
 def test_pipelined_iter_abandoned_mid_scan(tmp_path, engine):
     """Breaking out of the pipelined scan (the topk elimination path)
     must release every in-flight staging buffer — a second full scan
