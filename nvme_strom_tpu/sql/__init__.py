@@ -8,10 +8,11 @@ from nvme_strom_tpu.sql.parser import SQLSyntaxError, parse_select, sql_query
 from nvme_strom_tpu.sql.multi import (multi_groupby, multi_scalar_agg,
                                       multi_topk, open_dataset)
 from nvme_strom_tpu.sql.dist import dist_groupby, dist_scalar_agg
+from nvme_strom_tpu.sql.cache import DeviceTable
 
 __all__ = ["EngineFile", "ParquetScanner", "groupby_aggregate",
            "sql_groupby", "sql_groupby_str", "sql_scalar_agg",
            "top_k_groups", "lookup_unique", "star_join_groupby",
            "sql_topk", "SQLSyntaxError", "parse_select", "sql_query",
            "multi_groupby", "multi_scalar_agg", "multi_topk",
-           "open_dataset", "dist_groupby", "dist_scalar_agg"]
+           "open_dataset", "dist_groupby", "dist_scalar_agg", "DeviceTable"]

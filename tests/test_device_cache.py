@@ -1,0 +1,173 @@
+"""DeviceTable: the PG-Strom GPU-Cache analogue (scan once, query from
+HBM).  Every query form must bit-match (or float-match) its streaming
+counterpart on the same file — the cache is an execution strategy, not
+different semantics — and the byte-budget guard must refuse, not OOM.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.sql import DeviceTable, sql_groupby, sql_topk
+from nvme_strom_tpu.sql.join import star_join_groupby
+from nvme_strom_tpu.sql.parquet import ParquetScanner
+from nvme_strom_tpu.utils.stats import StromStats
+
+
+@pytest.fixture
+def engine():
+    with StromEngine(stats=StromStats()) as eng:
+        yield eng
+
+
+def _fact(tmp_path, engine, rows=60_000, groups=16, seed=5):
+    rng = np.random.default_rng(seed)
+    data = {
+        "k": rng.integers(0, groups, rows).astype(np.int32),
+        "v": rng.standard_normal(rows).astype(np.float32),
+        "w": rng.random(rows).astype(np.float32),
+    }
+    path = str(tmp_path / "fact.parquet")
+    pq.write_table(pa.table(data), path, row_group_size=8192,
+                   use_dictionary=False, compression="none")
+    return ParquetScanner(path, engine), data
+
+
+def test_cache_matches_streaming_groupby(tmp_path, engine):
+    sc, data = _fact(tmp_path, engine)
+    dt = DeviceTable(sc, ["k", "v"])
+    assert dt.num_rows == len(data["k"])
+    cached = dt.groupby("k", "v", 16, aggs=("count", "sum", "mean",
+                                            "min", "max"))
+    streamed = sql_groupby(sc, "k", "v", 16,
+                           aggs=("count", "sum", "mean", "min", "max"))
+    for a in cached:
+        np.testing.assert_allclose(np.asarray(cached[a]),
+                                   np.asarray(streamed[a]),
+                                   rtol=1e-5, err_msg=a)
+
+
+def test_cache_where_and_scalar(tmp_path, engine):
+    sc, data = _fact(tmp_path, engine)
+    dt = DeviceTable(sc, ["k", "v", "w"])
+    got = dt.scalar_agg("v", aggs=("count", "sum"),
+                        where_ranges=[("w", 0.25, 0.75)])
+    sel = (data["w"] >= 0.25) & (data["w"] <= 0.75)
+    assert int(got["count"]) == int(sel.sum())
+    np.testing.assert_allclose(float(got["sum"]),
+                               data["v"][sel].astype(np.float64).sum(),
+                               rtol=1e-3)
+    # jax-traceable predicate, like the streaming WHERE pushdown
+    g = dt.groupby("k", "v", 16, aggs=("count",),
+                   where=lambda cols: cols["w"] < 0.5)
+    exp = np.bincount(data["k"][data["w"] < 0.5], minlength=16)
+    np.testing.assert_array_equal(np.asarray(g["count"]), exp)
+
+
+def test_cache_topk_deterministic_ties_and_nan(tmp_path, engine):
+    rows = 9_000
+    rng = np.random.default_rng(9)
+    # quantized values force ties: the cache specifies multi_topk's
+    # order (equal keys → ascending row, both directions), stricter
+    # than sql_topk's unspecified ties — but the KEY multiset at k
+    # must agree with the streamed path
+    data = {"v": (rng.integers(0, 50, rows) / 7.0).astype(np.float32),
+            "x": np.arange(rows, dtype=np.int32)}
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table(data), path, row_group_size=2048,
+                   use_dictionary=False, compression="none")
+    sc = ParquetScanner(path, engine)
+    dt = DeviceTable(sc, ["v", "x"])
+    for desc in (True, False):
+        c = dt.topk("v", columns=["v", "x"], k=12, descending=desc)
+        # numpy reference: stable sort on key, ties already row-asc
+        ref = np.argsort(-data["v"] if desc else data["v"],
+                         kind="stable")[:12]
+        np.testing.assert_array_equal(c["_row"], ref)
+        np.testing.assert_array_equal(c["x"], data["x"][ref])
+        s = sql_topk(sc, "v", columns=["v"], k=12, descending=desc)
+        np.testing.assert_array_equal(np.sort(c["v"]), np.sort(s["v"]))
+
+
+def test_cache_topk_nan_never_surfaces(tmp_path, engine):
+    vals = np.array([1.0, np.nan, 3.0, np.nan, 2.0], np.float32)
+    path = str(tmp_path / "nan.parquet")
+    pq.write_table(pa.table({"v": pa.array(vals)}), path,
+                   use_dictionary=False, compression="none")
+    # NaN is a VALUE here, not an Arrow null — direct-eligible
+    dt = DeviceTable(ParquetScanner(path, engine), ["v"])
+    top = dt.topk("v", k=5, descending=True)
+    np.testing.assert_array_equal(top["v"], [3.0, 2.0, 1.0])
+    np.testing.assert_array_equal(top["_row"], [2, 4, 0])
+    bot = dt.topk("v", k=5, descending=False)
+    np.testing.assert_array_equal(bot["v"], [1.0, 2.0, 3.0])
+
+
+def test_cache_star_join_matches_streaming(tmp_path, engine):
+    sc, data = _fact(tmp_path, engine)
+    dim = pa.table({
+        "id": pa.array(np.arange(16, dtype=np.int32)),
+        "region": pa.array((np.arange(16) % 4).astype(np.int32)),
+    })
+    dpath = str(tmp_path / "dim.parquet")
+    pq.write_table(dim, dpath, use_dictionary=False, compression="none")
+    dsc = ParquetScanner(dpath, engine)
+    fact_dt = DeviceTable(sc, ["k", "v"])
+    dim_dt = DeviceTable(dsc, ["id", "region"])
+    cached = fact_dt.star_join_groupby("k", "v", dim_dt, "id", "region",
+                                       4, aggs=("count", "sum"))
+    streamed = star_join_groupby(sc, "k", "v", dsc, "id", "region", 4,
+                                 aggs=("count", "sum"))
+    for a in cached:
+        np.testing.assert_allclose(np.asarray(cached[a]),
+                                   np.asarray(streamed[a]), rtol=1e-5)
+
+
+def test_cache_join_rejects_float_fact_key(tmp_path, engine):
+    """astype would truncate 1.5 → 1 into a silently wrong join; the
+    cache must guard the fact side like the streaming require_int."""
+    fact = pa.table({"fk": pa.array([1.0, 1.5, 2.0], pa.float32()),
+                     "v": pa.array([1.0, 2.0, 3.0], pa.float32())})
+    dim = pa.table({"id": pa.array(np.arange(3, dtype=np.int32)),
+                    "g": pa.array(np.zeros(3, dtype=np.int32))})
+    fp, dp = str(tmp_path / "f.parquet"), str(tmp_path / "d.parquet")
+    for p, t in ((fp, fact), (dp, dim)):
+        pq.write_table(t, p, use_dictionary=False, compression="none")
+    fdt = DeviceTable(ParquetScanner(fp, engine), ["fk", "v"])
+    ddt = DeviceTable(ParquetScanner(dp, engine), ["id", "g"])
+    with pytest.raises(TypeError, match="fk.*integer"):
+        fdt.star_join_groupby("fk", "v", ddt, "id", "g", 1)
+
+
+def test_cache_uncached_where_column_actionable(tmp_path, engine):
+    sc, _ = _fact(tmp_path, engine)
+    dt = DeviceTable(sc, ["k", "v"])     # 'w' not cached
+    with pytest.raises(KeyError, match="not cached"):
+        dt.groupby("k", "v", 16, where_ranges=[("w", 0.0, 0.5)])
+
+
+def test_cache_budget_refuses_oversized(tmp_path, engine):
+    sc, _ = _fact(tmp_path, engine)
+    with pytest.raises(ValueError, match="device-cache budget"):
+        DeviceTable(sc, ["k", "v"], budget_bytes=1024)
+    # unknown column fails fast at the estimate, before any I/O
+    with pytest.raises(KeyError, match="nope"):
+        DeviceTable(sc, ["nope"])
+
+
+def test_cache_second_query_reads_nothing(tmp_path, engine):
+    """The cache's contract: after construction, queries touch no
+    storage — engine read counters must not move."""
+    sc, _ = _fact(tmp_path, engine)
+    dt = DeviceTable(sc, ["k", "v"])
+    dt.groupby("k", "v", 16)            # includes any lazy jit work
+    before = engine.stats.snapshot()["bytes_direct"] + \
+        engine.stats.snapshot()["bytes_fallback"]
+    dt.groupby("k", "v", 16, aggs=("count", "sum", "min"))
+    dt.scalar_agg("v", aggs=("mean",))
+    dt.topk("v", k=5)
+    after = engine.stats.snapshot()["bytes_direct"] + \
+        engine.stats.snapshot()["bytes_fallback"]
+    assert after == before
