@@ -342,8 +342,11 @@ def bench_interleaved(engine, path: str, rounds: int = 3) -> dict:
 def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from nvme_strom_tpu.io import StromEngine, check_file
+    from nvme_strom_tpu.utils.compile_cache import enable_compile_cache
     from nvme_strom_tpu.utils.config import EngineConfig
     from nvme_strom_tpu.utils.stats import StromStats
+
+    enable_compile_cache()      # fresh subprocess, cached executables
 
     nbytes = int(os.environ.get("STROM_BENCH_BYTES", 1 << 30))
     bdir = os.environ.get("STROM_BENCH_DIR",

@@ -1445,8 +1445,14 @@ def run(configs: list[int], emit=None) -> list[dict]:
     death still lands in the ledger (round-3 weak #3: suite_15 completed
     its work, hung in teardown, and landed nothing)."""
     from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.utils.compile_cache import enable_compile_cache
     from nvme_strom_tpu.utils.config import EngineConfig
     from nvme_strom_tpu.utils.stats import StromStats
+
+    # every suite step is a fresh subprocess through a tunnel where one
+    # compile costs 20-40s (and has burned 900s step timeouts) — load
+    # serialized executables from the repo-local disk cache instead
+    enable_compile_cache()
 
     # hang budget (STROM_SUITE_BUDGET_S, set by the watcher to its step
     # timeout minus a margin): a wedged device op self-reports its phase

@@ -139,7 +139,9 @@ def probe_boundary(engine, dev, repeats: int = 7) -> dict:
 
 
 def main() -> int:
-    sys.path.insert(0, REPO)
+    sys.path.insert(0, REPO)   # direct-script mode: repo root first
+    from nvme_strom_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
     import bench
     force_cpu = os.environ.get("STROM_PROBE_FORCE_CPU") == "1"
     if force_cpu:          # functional testing without a tunnel

@@ -1,0 +1,43 @@
+"""Persistent XLA compilation cache for the measurement pipeline.
+
+On the tunneled axon runtime a single fresh compile costs 20-40 s and
+has burned whole capture-step timeouts (suite_13 lost two 900 s windows
+compiling the same program twice; suite_15_v2 spent ~70 s of a 206 s
+step on two lexsort compiles).  Compiles are THE scarcest resource in
+the on-silicon evidence loop — every capture step runs in a fresh
+subprocess, so without a disk cache each window re-pays every compile
+it has ever paid.
+
+``enable_compile_cache()`` points JAX's persistent compilation cache at
+a repo-local directory (gitignored ``.jax_cache/``): the first window
+pays each compile once, every later subprocess loads the serialized
+executable in milliseconds.  Backends whose PJRT client cannot
+serialize executables simply log a warning and skip caching — enabling
+is always safe.
+
+Env knobs: ``STROM_NO_COMPILE_CACHE=1`` disables;
+``STROM_COMPILE_CACHE_DIR`` relocates the directory.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache"))
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Turn on JAX's disk compilation cache (idempotent).  Returns the
+    cache directory, or None when disabled via env."""
+    if os.environ.get("STROM_NO_COMPILE_CACHE") == "1":
+        return None
+    import jax
+    d = (path or os.environ.get("STROM_COMPILE_CACHE_DIR")
+         or _DEFAULT_DIR)
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    # the default 1 s floor would skip small-but-remote compiles whose
+    # cost is round-trip latency, not compile work
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    return d

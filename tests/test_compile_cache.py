@@ -1,0 +1,59 @@
+"""Persistent compilation cache: the tunnel's 20-40 s remote compiles
+must be paid once per program, not once per capture subprocess."""
+
+import os
+import subprocess
+import sys
+
+from nvme_strom_tpu.utils.compile_cache import enable_compile_cache
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_enable_sets_config_and_creates_dir(tmp_path):
+    import jax
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        d = str(tmp_path / "cc")
+        got = enable_compile_cache(d)
+        assert got == d and os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+    finally:
+        # a cache dir pinned to a torn-down tmp_path must not leak
+        # into later tests in this process
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)
+
+
+def test_env_disable(monkeypatch):
+    monkeypatch.setenv("STROM_NO_COMPILE_CACHE", "1")
+    assert enable_compile_cache() is None
+
+
+def test_fresh_process_hits_cache(tmp_path):
+    """Two fresh subprocesses compile the same program; the first must
+    persist a serialized executable, the second must HIT it (no new
+    cache entries — wall-time deltas are too jittery on CPU to pin)."""
+    d = str(tmp_path / "cc")
+    code = f"""
+import sys; sys.path.insert(0, {REPO!r})
+from nvme_strom_tpu.utils.compile_cache import enable_compile_cache
+enable_compile_cache({d!r})
+import jax, jax.numpy as jnp
+jax.jit(lambda x: jnp.tanh(x) @ x.T)(jnp.ones((256, 256))).block_until_ready()
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+
+    def run():
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-1000:]
+        return set(os.listdir(d))
+
+    first = run()
+    assert first, "nothing persisted"
+    second = run()
+    assert second == first, "second process re-compiled instead of " \
+        f"hitting the cache: {second - first}"
