@@ -53,9 +53,17 @@ _CLASSES = (
                           "flash", "pallas")),
     ("copy", ("copy", "bitcast", "transpose", "reshape", "format")),
     ("reduce", ("reduce", "scatter", "gather", "sort", "select-and")),
-    ("elementwise-fusion", ("fusion", "add", "multiply", "subtract",
+    ("elementwise-fusion", ("add", "multiply", "subtract",
                             "divide", "exponential", "rsqrt", "tanh",
                             "elementwise", "loop")),
+    # LAST, and deliberately its own bucket: a bare "%fusion.212" name
+    # says nothing about its constituents — on this runtime's device
+    # plane most dots hide inside such names (the 2026-07-31T19:00
+    # d2048 parse put 0.75% in matmul at a measured 76 TFLOP/s, which
+    # is impossible — the MXU work was inside unnamed fusions).
+    # Claiming "elementwise" for them would be the same class of
+    # misattribution the operand-text fix removed.
+    ("unnamed-fusion", ("fusion",)),
 )
 
 #: "opcode(" right after the "= type[shape]{layout}" of an HLO line

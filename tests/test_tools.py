@@ -149,6 +149,9 @@ def test_profile_classify_first_match_wins():
     assert classify("%tpu_custom_call.3") == "attention-kernel"
     assert classify("%copy-start.1") == "copy"
     assert classify("%add_multiply_fusion.2") == "elementwise-fusion"
+    # a bare fusion name carries no constituent evidence: its own
+    # bucket, never a claim of elementwise (nor matmul) work
+    assert classify("%fusion.212") == "unnamed-fusion"
     assert classify("%while.7") == "other"
 
 
@@ -174,7 +177,7 @@ def test_profile_classify_ignores_operands():
     class Ev2:         # no stat → name path
         name = "%fusion.7 = bf16[] fusion(%p)"
         stats = []
-    assert event_bucket(Ev2()) == "elementwise-fusion"
+    assert event_bucket(Ev2()) == "unnamed-fusion"
 
 
 def test_profile_report_capture_and_parse(capsys, monkeypatch):
