@@ -57,6 +57,7 @@ reads only index ints host-side, never payload values.
 
 from __future__ import annotations
 
+import functools
 import struct
 import time
 from dataclasses import dataclass
@@ -1611,7 +1612,19 @@ def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups,
     ``window_bytes`` (see :func:`iter_plain_row_groups_to_device`)
     coalesces consecutive row groups into one yield of ~that size, so
     each consumer-side concat/view/fold dispatch covers a window of
-    payload instead of one group — the dispatch-latency lever."""
+    payload instead of one group — the dispatch-latency lever.
+
+    Transfer-side coalescing: PLAIN value spans are PER PAGE (~1 MiB
+    each — page headers interleave them), so submitting them verbatim
+    costs ~8x more device puts per byte than the north-star stream's
+    8 MiB chunks; the same-minute window-7 ledger showed the scan's
+    put path at 0.20 GiB/s while bench rode the identical link at
+    1.15 (ratio 0.953).  When a column chunk's header gap is small,
+    the ENCLOSING byte range streams as chunk-sized reads
+    (header bytes ride along) and one jitted static-slice program per
+    (window, column) drops the gaps ON DEVICE — one put per 8 MiB and
+    ~3 device dispatches per window-column, independent of page
+    count."""
     import jax.numpy as jnp
     import numpy as np
     from nvme_strom_tpu.ops.bridge import split_ranges
@@ -1632,22 +1645,59 @@ def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups,
 
     chunk_bytes = scanner.engine.config.chunk_bytes
     flat = []                      # every sub-range, submission order
-    counts = []                    # (rg, column, n_chunks)
+    counts = []                    # (rg, column, n_chunks, spec)
     for w in windows:
+        # merge decision per (window, column): the degap program holds
+        # one lax.slice per value span ACROSS the window, so a
+        # small-page layout (4 KiB pages → thousands of spans per
+        # 64 MiB window) would compile a pathological program — cap
+        # the slice count and fall back to exact per-span reads
+        allow = {c: sum(len([s for s in plans[c][rg].spans if s[1]])
+                        for rg in w) <= _COALESCE_MAX_SLICES
+                 for c in columns}
         for rg in w:
             for c in columns:
-                ranges, _ = split_ranges(plans[c][rg].spans, chunk_bytes)
+                spans = plans[c][rg].spans
+                merged = _coalesce_spans(spans) if allow[c] else None
+                if merged is not None:
+                    ranges, _ = split_ranges([merged], chunk_bytes)
+                    # value spans relative to the merged buffer: the
+                    # on-device degap spec
+                    spec = tuple((off - merged[0], ln)
+                                 for off, ln in spans if ln)
+                else:
+                    ranges, _ = split_ranges(spans, chunk_bytes)
+                    spec = None
                 flat.extend(ranges)
-                counts.append((rg, c, len(ranges)))
+                counts.append((rg, c, len(ranges), spec))
     it = ds.stream_ranges(fh, flat)
     ci = iter(counts)
     try:
         for w in windows:
             parts: dict = {c: [] for c in columns}
+            specs: dict = {c: [] for c in columns}
+            merged_any = {c: False for c in columns}
+            sizes = {c: 0 for c in columns}     # buffer bytes so far
             for rg in w:
                 for c in columns:
-                    _, _, n = next(ci)
-                    parts[c].extend(next(it) for _ in range(n))
+                    _, _, n, spec = next(ci)
+                    got = [next(it) for _ in range(n)]
+                    base = sizes[c]
+                    if spec is not None:
+                        merged_any[c] = True
+                        specs[c].extend((base + o, ln)
+                                        for o, ln in spec)
+                    else:
+                        # unmerged chunks are pure value bytes: they
+                        # enter the buffer verbatim, and the spec keeps
+                        # them in case a SIBLING row group merged
+                        pos = 0
+                        for p in got:
+                            specs[c].append((base + pos,
+                                             int(p.shape[0])))
+                            pos += int(p.shape[0])
+                    parts[c].extend(got)
+                    sizes[c] += sum(int(p.shape[0]) for p in got)
             out = {}
             for c in columns:
                 np_dtype = np.dtype(
@@ -1655,10 +1705,62 @@ def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups,
                 ps = parts[c]
                 if not ps:         # zero-row window
                     out[c] = jnp.zeros((0,), dtype=np_dtype)
-                else:
-                    flat_arr = (ps[0] if len(ps) == 1
-                                else jnp.concatenate(ps))
-                    out[c] = flat_arr.view(np_dtype)
+                    continue
+                buf = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
+                if merged_any[c]:
+                    buf = _degap(tuple(specs[c]), int(buf.shape[0]))(buf)
+                out[c] = buf.view(np_dtype)
             yield out
     finally:
         it.close()                 # abandoned scan: release staging now
+
+
+#: tolerated header/gap overhead when streaming a column chunk's
+#: enclosing range: page headers are ~30-60 B per ~1 MiB page (<0.01%),
+#: so anything beyond a few percent means an unexpected layout — fall
+#: back to exact per-span reads rather than wasting link on holes
+_COALESCE_GAP_FRAC = 0.05
+
+#: max lax.slice ops in one window-column degap program (compile cost
+#: grows with operand count; 1 MiB default pages put a 64 MiB window at
+#: ~64-128 slices, comfortably under; 4 KiB-page layouts blow past and
+#: take the exact per-span path instead)
+_COALESCE_MAX_SLICES = 256
+
+
+def _coalesce_spans(spans):
+    """Enclosing (offset, length) of the span list when the interior
+    gaps (page headers) are a negligible fraction — else None."""
+    spans = [s for s in spans if s[1]]
+    if len(spans) < 2:
+        return None
+    lo = spans[0][0]
+    hi = spans[-1][0] + spans[-1][1]
+    payload = sum(ln for _, ln in spans)
+    if hi - lo - payload > _COALESCE_GAP_FRAC * payload:
+        return None
+    # spans must be ascending and disjoint for the relative spec to be
+    # meaningful (the page walk emits them in file order)
+    pos = lo
+    for off, ln in spans:
+        if off < pos:
+            return None
+        pos = off + ln
+    return (lo, hi - lo)
+
+
+@functools.lru_cache(maxsize=256)
+def _degap(spec: tuple, total: int):
+    """Jitted static-slice compaction: uint8 buffer of ``total`` bytes
+    → the concatenation of the ``spec`` (offset, length) value spans.
+    Page layouts repeat across row groups and windows, so the lru
+    cache (plus the persistent compile cache) makes this one compile
+    per distinct layout, ONE device dispatch per application."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(a):
+        pieces = [jax.lax.slice(a, (o,), (o + ln,)) for o, ln in spec]
+        return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+    return jax.jit(f)

@@ -261,6 +261,15 @@ def capture(device: str) -> bool:
         # (sql_window_bytes) that divides the dispatch count ~8x
         ("suite_5_v3", [sys.executable, "bench_suite.py", "--config", "5"],
          900, None),
+        # "_v4": third iteration — v3's on-silicon row (19:06) cut the
+        # fold overhead 3.7x but its stream phase still ran 0.20 GiB/s
+        # against bench's same-minute 1.15 at ratio 0.953: the per-PAGE
+        # value spans cost ~8x more device puts per byte than bench's
+        # 8 MiB chunks.  v4 measures enclosing-range streaming with
+        # on-device jitted degap (one put per chunk, ~3 dispatches per
+        # window-column)
+        ("suite_5_v4", [sys.executable, "bench_suite.py", "--config", "5"],
+         900, None),
         ("suite_12_v2",
          [sys.executable, "bench_suite.py", "--config", "12"], 900, None),
         # 1800s: the dict-scan kernel burned two 900s timeouts inside
