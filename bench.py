@@ -114,6 +114,17 @@ def last_ledgered_tpu() -> dict | None:
 def force_cpu() -> None:
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # the compile cache partitions by platform selection; a fallback
+    # that flips platforms AFTER enable_compile_cache() ran must
+    # re-derive the subtree, or the local XLA:CPU process shares a
+    # directory with server-compiled AOT artifacts (cpu_aot_loader
+    # feature-mismatch / SIGILL)
+    try:
+        from nvme_strom_tpu.utils.compile_cache import \
+            enable_compile_cache
+        enable_compile_cache()
+    except ImportError:
+        pass
 
 
 def make_file(path: str, nbytes: int) -> None:

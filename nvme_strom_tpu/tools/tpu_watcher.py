@@ -284,6 +284,10 @@ def capture(device: str) -> bool:
          [sys.executable, "bench_suite.py", "--config", "14"], 900, None),
         ("suite_15_v2",
          [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
+        # topk re-measure under the enclosing-range degap streaming
+        # (its per-rg yields route through the same coalesced path)
+        ("suite_15_v3",
+         [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
         # remaining BASELINE-contract I/O rows (round-2 manual numbers
         # only) and the capability demonstrations
         ("suite_8", [sys.executable, "bench_suite.py", "--config", "8"],

@@ -26,18 +26,47 @@ import os
 _DEFAULT_DIR = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "..", ".jax_cache"))
 
+# an explicit base survives re-derives: bench.force_cpu() re-enables
+# with no argument after a platform flip, and must re-partition the
+# SAME base the process configured, not substitute the env/default one
+_explicit_path: str | None = None
+
 
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Turn on JAX's disk compilation cache (idempotent).  Returns the
-    cache directory, or None when disabled via env."""
+    cache directory (``<base>/<platform>``), or None when disabled via
+    env.  ``path`` sets the base for the rest of the process."""
+    global _explicit_path
     if os.environ.get("STROM_NO_COMPILE_CACHE") == "1":
         return None
     import jax
-    d = (path or os.environ.get("STROM_COMPILE_CACHE_DIR")
-         or _DEFAULT_DIR)
+    if path is not None:
+        _explicit_path = path
+    base = (_explicit_path or os.environ.get("STROM_COMPILE_CACHE_DIR")
+            or _DEFAULT_DIR)
+    # partition EVERY base by platform selection: the tunneled backend's
+    # remote-compile helper emits XLA:CPU AOT artifacts built with the
+    # SERVER's machine features — a local JAX_PLATFORMS=cpu process
+    # loading one logs cpu_aot_loader feature-mismatch errors (round-3
+    # weak #3's hang lead) and risks SIGILL.  Separate subtrees keep
+    # server- and host-compiled executables from ever sharing a key.
+    plat = (getattr(jax.config, "jax_platforms", None)
+            or os.environ.get("JAX_PLATFORMS") or "default")
+    d = os.path.join(base, plat)
     os.makedirs(d, exist_ok=True)
+    prev = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_compilation_cache_dir", d)
     # the default 1 s floor would skip small-but-remote compiles whose
     # cost is round-trip latency, not compile work
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    if prev not in (None, d):
+        # JAX's persistent-cache singleton latches the directory at its
+        # first use and ignores later config updates; a re-derive after
+        # a platform flip (force_cpu fallback) must drop it or XLA keeps
+        # writing the server-platform subtree
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
     return d
