@@ -210,6 +210,16 @@ def capture(device: str) -> bool:
          900, None),
         ("suite_4", [sys.executable, "bench_suite.py", "--config", "4"],
          900, None),
+        # "_v2" re-measures under per-pass interleaved link ceilings
+        # (bench_suite module header ¶3): the 19:04 window's suite_2/3
+        # UNDER rows paired passes with a step-start link that had
+        # flapped by the time the passes ran — the probe's own pure
+        # stream ledgered 0.16 GiB/s minutes after bench rode the same
+        # link at 0.95x of 1.35 (L79)
+        ("suite_3_v2", [sys.executable, "bench_suite.py", "--config", "3"],
+         1200, None),
+        ("suite_2_v2", [sys.executable, "bench_suite.py", "--config", "2"],
+         900, None),
         # MFU story (verdict #3) immediately after the contract I/O
         # rows: d2048 re-trace for the post-fix profile parse, then the
         # flash d-points — a short window must land these before the

@@ -39,3 +39,24 @@ def test_suite_all_configs(tmp_path):
         assert rec["vs_baseline"] is None
     # scratch data landed in the requested dir, not the repo
     assert (tmp_path / ".bench_suite").is_dir()
+
+
+def test_per_pass_link_pairing(tmp_path, monkeypatch):
+    """On a live device the suite ratios every _steady pass against its
+    own interleaved link burst (the tunnel link flaps within a step, so
+    a step-start ceiling pairs a pass with the wrong minute); the
+    metric tag carries the per-pass pairs.  Simulated here by forcing
+    the device probe true over the CPU backend."""
+    import bench
+    import bench_suite
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("STROM_SUITE_BYTES", str(4 << 20))
+    monkeypatch.setenv("STROM_BENCH_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "probe_device", lambda: True)
+    rows = bench_suite.run([2])
+    rec = rows[0]
+    assert rec["vs_baseline"] is not None
+    assert "per-pass rate@link=" in rec["metric"]
+    pairs = bench_suite._PASS_LINK["last"]
+    assert pairs and all(l > 0 for _, l in pairs)
+    assert bench_suite._PASS_LINK["probe"] is None   # cleared by run()
