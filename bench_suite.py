@@ -1235,6 +1235,22 @@ def _train_variant(cfg, batch: int, seq: int, dev,
                   + 12 * cfg.n_layers * batch * seq * seq * cfg.d_model)
     step = jax.jit(make_train_step(cfg, opt, attn_fn=attn_fn),
                    donate_argnums=(0, 1))
+    if profile_dir:
+        # the post-optimization HLO names the profiler's events: the
+        # valid window-7 parses put ~70% of device time in bare
+        # "%fusion.NN" buckets, which explains nothing — dumping the
+        # compiled module lets profile_report resolve each fusion to
+        # its constituent ops (dot/reduce/elementwise) and attribute
+        # the MFU ceiling for real.  AOT lower+compile of the SAME jit
+        # hits the compile cache; donation only applies at execution.
+        try:
+            txt = step.lower(params, opt_state, tokens).compile().as_text()
+            os.makedirs(profile_dir, exist_ok=True)
+            with open(os.path.join(profile_dir, "optimized_hlo.txt"),
+                      "w") as f:
+                f.write(txt)
+        except Exception as e:          # remote helper may not serve it
+            _log(f"suite: optimized-HLO dump unavailable: {e!r}")
     params, opt_state, loss = step(params, opt_state, tokens)  # compile
     jax.block_until_ready((params, opt_state, loss))
     # Timing discipline, third iteration.  Round-3 lesson: loss-only

@@ -103,10 +103,96 @@ def classify(name: str) -> str:
 #: "loop fusion", "copy", "all-reduce", "custom-call"
 _CATEGORY_STAT_KEYS = ("hlo_category", "category")
 
+#: computation header: "%name (params...) -> type {"
+_HLO_COMP = re.compile(r"^(?:ENTRY\s+)?(%[\w.\-]+)\s*\([^)]*\)\s*->")
 
-def event_bucket(ev) -> str:
-    """Bucket for one xplane event: the profiler's hlo_category stat
-    when present, else name-based :func:`classify`."""
+#: fusion instruction with its called computation
+_HLO_FUSION = re.compile(r"(%[\w.\-]*fusion[\w.\-]*)\s*=.*?"
+                         r"\bcalls=(%[\w.\-]+)")
+
+#: fused-computation opcode → resolved bucket, first match wins (a
+#: dot+bias+gelu output fusion is MXU work; a reduce+multiply fusion is
+#: VPU reduction work)
+_FUSED_BUCKETS = (
+    ("matmul-fusion", ("dot", "convolution")),
+    ("reduce-fusion", ("reduce", "reduce-window", "scatter", "sort",
+                       "select-and-scatter")),
+    ("gather-fusion", ("gather", "dynamic-slice", "dynamic-update-slice")),
+    # data movement is its own answer, exactly as in _CLASSES — filing
+    # a transpose/copy-only fusion under elementwise would inflate the
+    # compute share with memory traffic
+    ("copy-fusion", ("transpose", "copy", "bitcast", "reshape")),
+)
+
+
+def load_fusion_map(trace_dir: str) -> dict:
+    """{"%fusion.NN": resolved bucket} from the post-optimization HLO
+    dump the capture step writes next to the trace (optimized_hlo.txt).
+
+    The profiler's device plane names most of a train step's time after
+    bare "%fusion.NN" events — ~70% of device time in the valid
+    window-7 parses, which attributes nothing.  The dumped module
+    defines each %fused_computation body, so the fusion's constituent
+    opcodes are known exactly; classification by real constituents
+    replaces the "unnamed-fusion" bucket without re-introducing the
+    operand-text guessing the c92ebd3 fix removed."""
+    path = os.path.join(trace_dir, "optimized_hlo.txt")
+    if not os.path.exists(path):
+        return {}
+    comp_ops: dict[str, set] = {}
+    cur = None
+    with open(path) as f:
+        for line in f:
+            m = _HLO_COMP.match(line.strip())
+            if m and line.rstrip().endswith("{"):
+                cur = m.group(1)
+                comp_ops[cur] = set()
+                continue
+            if line.startswith("}"):
+                cur = None
+                continue
+            if cur is not None:
+                op = _OPCODE.search(line)
+                if op:
+                    comp_ops[cur].add(op.group(1))
+    fmap: dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            m = _HLO_FUSION.search(line)
+            if not m:
+                continue
+            ops = comp_ops.get(m.group(2), set())
+            # keys stored WITHOUT the % sigil: the TPU device plane
+            # names events "%fusion.212" but the CPU host plane logs
+            # "fusion.212" — lookups strip the sigil to match either
+            key = m.group(1).lstrip("%")
+            for bucket, keys in _FUSED_BUCKETS:
+                if any(o in keys for o in ops):
+                    fmap[key] = bucket
+                    break
+            else:
+                if ops:
+                    fmap[key] = "elementwise-fusion"
+    return fmap
+
+
+def _fmap_bucket(ev, fmap: dict | None):
+    """Resolved bucket for an event via the dumped-HLO fusion map, or
+    None on a miss — split out so the tally can count how much device
+    time actually resolved (a silent name-format mismatch must read as
+    0 ms resolved, not as a successful attribution)."""
+    if not fmap:
+        return None
+    return fmap.get(ev.name.split("=", 1)[0].strip().lstrip("%"))
+
+
+def event_bucket(ev, fmap: dict | None = None) -> str:
+    """Bucket for one xplane event: the dumped-HLO fusion resolution
+    when available (exact constituents), else the profiler's
+    hlo_category stat, else name-based :func:`classify`."""
+    b = _fmap_bucket(ev, fmap)
+    if b is not None:
+        return b
     try:
         for k, v in ev.stats:
             if str(k) in _CATEGORY_STAT_KEYS:
@@ -134,6 +220,7 @@ def parse_trace(trace_dir: str) -> dict:
         if p.name == "/host:CPU":
             host_plane = p
 
+    fmap = load_fusion_map(trace_dir)
     by_cat: dict[str, float] = {}
     by_op: dict[str, float] = {}
     # category → {op: ns}: names the time, not just buckets — the
@@ -143,8 +230,12 @@ def parse_trace(trace_dir: str) -> dict:
     module_ns = []          # per-step module durations (XLA Modules line)
     module_spans = []       # (start, end) to bound the traced window
 
+    resolved_ns = [0.0]
+
     def _tally(ev) -> None:
-        cat = event_bucket(ev)
+        cat = event_bucket(ev, fmap)
+        if _fmap_bucket(ev, fmap) is not None:
+            resolved_ns[0] += ev.duration_ns
         by_cat[cat] = by_cat.get(cat, 0.0) + ev.duration_ns
         # strip the "= <type> op(...)" tail: the lhs name keys the op;
         # full HLO text would blow up the ledger line
@@ -192,6 +283,11 @@ def parse_trace(trace_dir: str) -> dict:
     return {
         "plane": (dev_plane or host_plane).name,
         "trace": os.path.basename(paths[-1]),
+        "fusions_resolved": len(fmap),
+        # how much device time the map ACTUALLY resolved: 0 despite a
+        # populated map means the event-name format diverged from the
+        # dump — the attribution did not happen, whatever map size says
+        "fusion_resolved_ms": round(resolved_ns[0] / 1e6, 3),
         "steps_traced": len(module_ns),
         "device_busy_ms": round(busy_ns / 1e6, 3),
         "window_wall_ms": round(wall_ns / 1e6, 3),
@@ -221,7 +317,13 @@ def capture(batch: int, seq: int, remat: str, attn: str,
     import jax
 
     import bench_suite
+    from nvme_strom_tpu.utils.compile_cache import enable_compile_cache
 
+    # a standalone capture bypasses bench_suite.run()'s cache enable;
+    # the HLO-dump path AOT-compiles the step before executing it, and
+    # only the persistent cache makes that one compile, not two (each
+    # 20-40 s on the tunnel)
+    enable_compile_cache()
     cfg = dataclasses.replace(bench_suite._bench_cfg(train_override=True),
                               remat_policy=(None if remat == "none"
                                             else remat),

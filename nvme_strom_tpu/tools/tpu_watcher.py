@@ -353,11 +353,17 @@ def capture(device: str) -> bool:
     # operand text) and are contaminated — the verdict voided them.  A
     # new step name makes the post-fix parse a FRESH coverage target
     # instead of looking already-landed.
+    # "_v3": the _v2 parses were valid but ~70% of device time landed
+    # in bare "%fusion.NN" buckets ("unnamed-fusion"), attributing
+    # nothing.  The suite's capture step now dumps the post-optimization
+    # HLO next to the trace and profile_report resolves each fusion to
+    # its constituent opcodes — the v3 parse is the fusion-resolved
+    # MFU attribution.
     parse_steps = [
-        ("profile_d2048_v2",
+        ("profile_d2048_v3",
          [sys.executable, "-m", "nvme_strom_tpu.tools.profile_report",
           "--dir", prof_d2048], 300, None),
-        ("profile_d4096_v2",
+        ("profile_d4096_v3",
          [sys.executable, "-m", "nvme_strom_tpu.tools.profile_report",
           "--dir", prof_d4096], 300, {"STROM_TRAIN_CFG": CFG_D4096}),
     ]
@@ -395,8 +401,8 @@ def capture(device: str) -> bool:
     # at 3 consumer attempts: a deterministically-failing parse must not
     # pin its producer in the fresh tier forever, starving tail steps.
     attempts = _attempt_counts()
-    for producer, consumer in (("suite_7", "profile_d2048_v2"),
-                               ("suite_7_d4096", "profile_d4096_v2")):
+    for producer, consumer in (("suite_7", "profile_d2048_v3"),
+                               ("suite_7_d4096", "profile_d4096_v3")):
         if consumer not in done and attempts.get(consumer, 0) < 3:
             done.discard(producer)
     steps = _coverage_order(steps, done,
