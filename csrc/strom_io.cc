@@ -1383,7 +1383,17 @@ extern "C" int64_t strom_tar_index(const char *path, uint8_t **out,
                                    uint64_t *out_bytes) {
   *out = nullptr;
   *out_bytes = 0;
-  int fd = open(path, O_RDONLY | O_CLOEXEC);
+  /* O_DIRECT first: the header walk faults its windows through the
+   * page cache otherwise, and a resident member span makes the
+   * engine's submit-time mincore planner deliberately choose the
+   * buffered path for every member read that follows — one index pass
+   * silently demoting the O_DIRECT pipeline to memcpy (a cold wds_raw
+   * epoch measured 100% fallback+bounce from exactly this).  Direct
+   * windows bypass the cache entirely — no pollution AND no eviction
+   * of pages that were legitimately warm before the walk. */
+  int direct = 1;
+  int fd = open(path, O_RDONLY | O_CLOEXEC | O_DIRECT);
+  if (fd < 0) { direct = 0; fd = open(path, O_RDONLY | O_CLOEXEC); }
   if (fd < 0) return -errno;
   struct stat st;
   if (fstat(fd, &st) != 0) { int e = errno; close(fd); return -e; }
@@ -1403,20 +1413,51 @@ extern "C" int64_t strom_tar_index(const char *path, uint8_t **out,
    * simply land the next header outside the window and trigger a
    * refill at the new offset — a seek, not a full-file read. */
   enum { WIN = 4 << 20 };
-  uint8_t *win = (uint8_t *)malloc(WIN);
-  if (!win) { close(fd); return -ENOMEM; }
+  uint8_t *win = nullptr;
+  if (posix_memalign((void **)&win, 4096, WIN) != 0 || !win) {
+    close(fd); return -ENOMEM;
+  }
   uint64_t win_off = 0, win_len = 0;
-  while ((int64_t)(off + 512) <= st.st_size) {
-    if (off < win_off || off + 512 > win_off + win_len) {
-      ssize_t got = pread(fd, win, WIN, (off_t)off);
-      if (got < 0) { int e = errno; close(fd); free(win); free(buf.p);
-                     return -e; }     /* real I/O error, not corruption */
-      if (got < 512) { close(fd); free(win); free(buf.p);
-                       return -EBADMSG; }  /* genuinely short: truncated */
-      win_off = off;
+  /* Every archive byte the walk touches — headers AND 'L'/'x'/'g'
+   * payloads — goes through this one window fill, so the direct-mode
+   * alignment rules hold everywhere (a stray unaligned pread on the
+   * O_DIRECT fd EINVALs on ext4, which would silently demote every
+   * pax-format archive to the polluting Python fallback). */
+  int ferr = 0;
+  auto fill = [&](uint64_t o, uint64_t need) -> uint8_t * {
+    if (need == 0) return win;
+    if (need > (uint64_t)WIN) { ferr = -ENOTSUP; return nullptr; }
+    if (o < win_off || o + need > win_off + win_len) {
+      uint64_t roff = direct ? (o & ~(uint64_t)4095) : o;
+      ssize_t got = pread(fd, win, WIN, (off_t)roff);
+      if (got < 0 && direct) {
+        /* fs accepted O_DIRECT open but refuses the read: reopen
+         * buffered once and continue the walk.  Keep the ORIGINAL
+         * read errno if the reopen fails — a media error must not
+         * masquerade as an fd-limit problem. */
+        int rerr = errno;
+        int bfd = open(path, O_RDONLY | O_CLOEXEC);
+        if (bfd >= 0) {
+          close(fd); fd = bfd; direct = 0; roff = o;
+          got = pread(fd, win, WIN, (off_t)roff);
+        } else {
+          errno = rerr;
+        }
+      }
+      if (got < 0) { ferr = -errno; return nullptr; }
+      if ((uint64_t)got < (o - roff) + need) {
+        ferr = -EBADMSG;              /* genuinely short: truncated */
+        return nullptr;
+      }
+      win_off = roff;
       win_len = (uint64_t)got;
     }
-    memcpy(h, win + (off - win_off), 512);
+    return win + (o - win_off);
+  };
+  while ((int64_t)(off + 512) <= st.st_size) {
+    uint8_t *hp = fill(off, 512);
+    if (!hp) { close(fd); free(win); free(buf.p); return ferr; }
+    memcpy(h, hp, 512);
     int allz = 1;
     for (int i = 0; i < 512 && allz; i++) allz = (h[i] == 0);
     if (allz) {
@@ -1445,12 +1486,12 @@ extern "C" int64_t strom_tar_index(const char *path, uint8_t **out,
                                 free(buf.p); return -ENOTSUP; }
       uint8_t *tmp = (uint8_t *)malloc(n + 1);
       if (!tmp) { close(fd); free(win); free(buf.p); return -ENOMEM; }
-      ssize_t got = pread(fd, tmp, n, (off_t)data);
-      if (got != (ssize_t)n) {
-        int e = (got < 0) ? errno : EBADMSG;
+      uint8_t *pp = fill(data, n);
+      if (!pp) {
         free(tmp); close(fd); free(win); free(buf.p);
-        return -e;
+        return ferr;
       }
+      memcpy(tmp, pp, n);
       tmp[n] = 0;
       int bad = 0;                   /* -EBADMSG: corrupt */
       int unsup = 0;                 /* -ENOTSUP: valid, unimplemented */

@@ -18,6 +18,7 @@ array is assembled without bulk cross-host traffic.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Callable, Iterator, List, Optional, Sequence
@@ -177,6 +178,14 @@ class ShardedLoader:
         self._engine = engine or StromEngine(EngineConfig())
         self._owns_engine = engine is None
         self.epoch = 0
+        # shard files are immutable for the loader's lifetime: index
+        # each once, not once per epoch — the per-epoch re-walk was a
+        # whole extra pass of I/O per epoch.  LRU-bounded by
+        # config.index_cache_samples so web-scale shard lists don't
+        # grow host RSS without limit.
+        from collections import OrderedDict
+        self._shard_index: "OrderedDict[str, list]" = OrderedDict()
+        self._shard_index_total = 0    # cached samples, LRU accounting
 
     @staticmethod
     def _batch_groups(mesh, axis: str, pi: int) -> tuple[int, int]:
@@ -199,16 +208,44 @@ class ShardedLoader:
     # -- sample iteration (host side) -------------------------------------
 
     def _index_shard(self, path):
+        key = str(path)
+        cached = self._shard_index.get(key)
+        if cached is not None:
+            self._shard_index.move_to_end(key)
+            return cached
         if self.fmt in ("wds", "wds_raw"):
             idx = WdsShardIndex(path)
-            return [
+            out = [
                 {ext: rng for ext, rng in idx.samples[k].items()
                  if self.exts is None or ext in self.exts}
                 for k in idx.order
             ]
-        idx = TFRecordIndex(path)
-        return [{"": (idx.offsets[i], idx.lengths[i])}
-                for i in range(len(idx))]
+        else:
+            idx = TFRecordIndex(path)
+            out = [{"": (idx.offsets[i], idx.lengths[i])}
+                   for i in range(len(idx))]
+            if self.config.drop_index_pollution:
+                # the Python record walk faulted the file resident; a
+                # resident span flips the engine's residency planner to
+                # the buffered path for every record read that follows
+                try:
+                    fd = os.open(key, os.O_RDONLY)
+                    try:
+                        os.posix_fadvise(fd, 0, 0,
+                                         os.POSIX_FADV_DONTNEED)
+                    finally:
+                        os.close(fd)
+                except (OSError, AttributeError):
+                    pass
+        cap = self.config.index_cache_samples
+        if cap > 0:
+            self._shard_index[key] = out
+            self._shard_index_total += len(out)
+            while (self._shard_index_total > cap
+                   and len(self._shard_index) > 1):
+                _, old = self._shard_index.popitem(last=False)
+                self._shard_index_total -= len(old)
+        return out
 
     def _iter_local_samples(self) -> Iterator[np.ndarray]:
         eng = self._engine

@@ -79,3 +79,14 @@ class LoaderConfig:
     shuffle_buffer: int = 0
     drop_remainder: bool = True
     seed: int = 0
+    #: total cached index entries (samples) across shards before the
+    #: oldest shard's index is evicted — bounds host RSS on web-scale
+    #: datasets while small/medium datasets index each shard once per
+    #: loader instead of once per epoch
+    index_cache_samples: int = 1_000_000
+    #: drop a shard's page-cache residue after a Python-side index walk
+    #: (tfrecord): the walk faults the file resident, which would flip
+    #: the engine's residency planner to the buffered path for every
+    #: record read that follows.  The native wds walker reads O_DIRECT
+    #: and needs no cleanup.  Set False to keep pre-warmed files warm.
+    drop_index_pollution: bool = True

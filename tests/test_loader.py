@@ -407,6 +407,48 @@ def test_wds_raw_batches_match_standard_path(tmp_path):
         assert len(list(loader)) == 4
 
 
+def test_wds_index_cached_and_no_cache_poisoning(tmp_path, monkeypatch):
+    """(a) shards are indexed once per loader, not once per epoch — the
+    re-walk was a whole extra end-to-end file read per epoch; (b) the
+    index walk leaves no page-cache residue: with the residency probe
+    ON, an evicted epoch's member reads must not be planned resident
+    (the window-7 wds_raw rows bounced their full payload because the
+    walk's 4 MiB windows flipped every member read to the buffered
+    path)."""
+    import bench
+    import jax
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.io.engine import StromEngine
+    from nvme_strom_tpu.utils.stats import StromStats
+    import nvme_strom_tpu.data.loader as loader_mod
+
+    paths, _ = _make_raw_wds_shards(tmp_path, n_shards=2, per_shard=8,
+                                    mlen=8192)
+    built = []
+    orig = loader_mod.WdsShardIndex
+
+    class Counting(orig):
+        def __init__(self, path):
+            built.append(str(path))
+            super().__init__(path)
+
+    monkeypatch.setattr(loader_mod, "WdsShardIndex", Counting)
+    stats = StromStats()
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("dp",))
+    with StromEngine(stats=stats) as eng:
+        with ShardedLoader(paths, mesh, global_batch=8, fmt="wds_raw",
+                           engine=eng) as loader:
+            for _ in range(2):
+                for p in paths:
+                    bench.evict_file(p)
+                assert len(list(loader)) == 2
+        eng.sync_stats()
+    assert sorted(built) == sorted(str(p) for p in paths)
+    assert stats.bytes_resident == 0, (
+        f"index walk poisoned the residency planner: "
+        f"{stats.bytes_resident} bytes planned resident")
+
+
 def test_wds_raw_bounce_accounting(tmp_path, monkeypatch):
     """No host-side payload copy: the only bounce on the CPU test device
     is device_put's alias-protection copy — exactly payload bytes, not
