@@ -781,7 +781,7 @@ def _indices_to_device(eng, fh, parts, dict_count: int, dev):
     one scalar sync per chunk."""
     import jax.numpy as jnp
     import numpy as np
-    from nvme_strom_tpu.ops.bitunpack import rle_hybrid_to_device
+    from nvme_strom_tpu.ops.bitunpack import rle_hybrid_batch_to_device
     from nvme_strom_tpu.ops.bridge import host_to_device
 
     def put_host_idx(idx):
@@ -790,17 +790,31 @@ def _indices_to_device(eng, fh, parts, dict_count: int, dev):
         return host_to_device(eng, idx, dev)
 
     dev_parts = []
+    raw_batch = []     # consecutive raw pages decode as ONE program
+
+    def flush_raw():
+        # three device ops for the whole run of adjacent raw pages,
+        # instead of puts per run — a chunk that mixes raw and
+        # compressed pages still batches each raw stretch
+        if not raw_batch:
+            return
+        d = rle_hybrid_batch_to_device(raw_batch, dev, engine=eng)
+        if d is not None:
+            dev_parts.append(d)
+        else:              # declined: host decode the same buffers
+            dev_parts.extend(put_host_idx(decode_rle_hybrid(b, bw, c))
+                             for b, bw, c in raw_batch)
+        raw_batch.clear()
+
     for p in parts:
         if p.is_raw:
-            buf = _read_span_bytes(eng, fh, *p.span)
-            d = rle_hybrid_to_device(buf, p.bit_width, p.valid_count,
-                                     dev, engine=eng)
-            if d is None:      # device path declined: same buffer, host
-                d = put_host_idx(decode_rle_hybrid(
-                    buf, p.bit_width, p.valid_count))
+            raw_batch.append((_read_span_bytes(eng, fh, *p.span),
+                              p.bit_width, p.valid_count))
         else:
-            d = put_host_idx(_decode_one_index_stream(eng, fh, p, dev))
-        dev_parts.append(d)
+            flush_raw()
+            dev_parts.append(put_host_idx(
+                _decode_one_index_stream(eng, fh, p, dev)))
+    flush_raw()
     if not dev_parts:          # zero-row chunk
         return jnp.zeros((0,), jnp.int32)
     idx = (dev_parts[0] if len(dev_parts) == 1

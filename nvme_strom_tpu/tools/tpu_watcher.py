@@ -287,6 +287,12 @@ def capture(device: str) -> bool:
         # completed compile populates the persistent cache for good
         ("suite_13", [sys.executable, "bench_suite.py", "--config", "13"],
          1800, None),
+        # "_v2": batched RLE/bit-packed decode — the whole index stream
+        # now decodes in 3 device ops per chunk instead of one put per
+        # run (16,784 puts/pass ledgered; ~20 ms tunnel dispatch each
+        # was the entire 1474 s suite_13 step)
+        ("suite_13_v2",
+         [sys.executable, "bench_suite.py", "--config", "13"], 900, None),
         ("suite_11_prefix_v2",
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
          {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
