@@ -322,15 +322,25 @@ def capture(device: str) -> bool:
           "STROM_TRAIN_CFG": "d=2048,L=4,ff=5632,heads=16,kv=8,"
                              "vocab=131072,xc=8"}),
         # batch sweep on the flash kernel's O(s) attention memory —
-        # dense b16+ blows compile-time HBM (remote-compile 500s), and
-        # remat=dots triggers the axon instant-garbage pathology (see
-        # suite_7_dots_diag)
+        # dense b16+ blows compile-time HBM (remote-compile 500s).
+        # b16:none:flash landed VALID at 69.5 TFLOP/s (35%) vs b8's
+        # 83 (42%): batch alone made MFU worse, consistent with HBM
+        # spills at remat=none — so the dots points below cut live
+        # activations instead (dots_diag exonerated remat=dots: 37.4%
+        # valid; the earlier garbage correlation was shape-linked)
         ("suite_7_b16_flash",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "16:none:flash"}),
         ("suite_7_b32_flash",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "32:none:flash"}),
+        ("suite_7_b16_dots_flash",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1200,
+         {"STROM_TRAIN_SWEEP": "16:dots:flash"}),
+        ("suite_7_d3072_b16df",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1500,
+         {"STROM_TRAIN_SWEEP": "16:dots:flash",
+          "STROM_TRAIN_CFG": CFG_D3072}),
         ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
          900, None),
         ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
