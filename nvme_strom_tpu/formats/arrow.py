@@ -159,10 +159,26 @@ class ArrowFileReader:
                 "EngineConfig.chunk_bytes or buffer_pool_bytes")
         entry_depth = min(depth,
                           max(1, (engine.n_buffers // 2) // max_subs))
+        # retire depth is counted in ENTRIES, and a deferred multi-
+        # chunk message holds max_subs staging buffers — budget in
+        # buffers or the submit loop can block on a buffer only this
+        # consumer's retire can free (deadlock on a real accelerator,
+        # where transfers are not instantly ready)
         retire = StagingRetirePool(
-            max(0, engine.n_buffers - entry_depth * max_subs - 1))
+            max(0, (engine.n_buffers - entry_depth * max_subs - 1)
+                // max_subs))
         fh = engine.open(self.path)
-        pend: list = []          # [PendingRead, ...] per batch message
+        pend: list = []    # (entry, [PendingRead, ...]) per message
+        import pyarrow as pa
+        col_types = {n: self.schema.field(n).type for n in names}
+        layout_ok = all(pa.types.is_integer(t) or pa.types.is_floating(t)
+                        for t in col_types.values())
+        # one zeros buffer serves every message's fake-body decode
+        # (body bytes are never read — only buffer ADDRESSES matter —
+        # so stale bytes from a previous reuse are harmless)
+        fake_buf = (np.zeros(max((e.length for e in entries), default=0),
+                             np.uint8)
+                    if layout_ok and max_subs > 1 else None)
         try:
             def decode_and_put(batch, release):
                 put = []
@@ -181,7 +197,63 @@ class ArrowFileReader:
                 # trip per record batch
                 retire.push(release, put)
 
-            def consume(reads):
+            def layout_put(entry, views, reads):
+                """Multi-chunk message, assembled ON DEVICE: decode the
+                metadata against a ZEROS body (no payload byte touched)
+                to learn each column buffer's (offset, length), then put
+                the staging pieces directly and concatenate there —
+                the parquet degap recipe applied to Arrow IPC.  Returns
+                the device arrays, or None when a column isn't a
+                fixed-width int/float (the assembly fallback handles
+                those)."""
+                import pyarrow.ipc as ipc
+                if fake_buf is None:
+                    return None
+                mlen = entry.meta["metadata_length"]
+                total = entry.length
+                fake = fake_buf[:total]
+                pos = 0
+                for v in views:              # metadata bytes are tiny
+                    if pos >= mlen:
+                        break
+                    take = min(mlen - pos, v.nbytes)
+                    fake[pos:pos + take] = v[:take]
+                    pos += take
+                buf = pa.py_buffer(fake)
+                msg = ipc.read_message(pa.BufferReader(buf))
+                batch = ipc.read_record_batch(msg, self.schema)
+                base = fake_buf.ctypes.data
+                rows = batch.num_rows
+                put = []
+                for n in names:
+                    col = batch.column(n)
+                    if col.null_count:
+                        raise ValueError(
+                            f"column {n} has nulls; dense scan only")
+                    data = col.buffers()[-1]
+                    np_dtype = np.dtype(col_types[n].to_pandas_dtype())
+                    start = data.address - base   # message-relative
+                    nbytes = rows * np_dtype.itemsize
+                    pieces, vpos = [], 0
+                    for v in views:
+                        vend = vpos + v.nbytes
+                        if vend > start and vpos < start + nbytes:
+                            a = max(0, start - vpos)
+                            b = min(v.nbytes, start + nbytes - vpos)
+                            if b > a:
+                                pieces.append(host_to_device(
+                                    engine, v[a:b], dev))
+                        vpos = vend
+                    put.extend(pieces)
+                    arr = (pieces[0] if len(pieces) == 1
+                           else jnp.concatenate(pieces)).view(np_dtype)
+                    parts[n].append(arr)
+                retire.push(lambda rs=reads: [p.release() for p in rs],
+                            put)
+                return put
+
+            def consume(item):
+                entry, reads = item
                 try:
                     if len(reads) == 1:
                         # whole message in one staging buffer:
@@ -190,11 +262,12 @@ class ArrowFileReader:
                             self.decode_batch(reads[0].wait()),
                             reads[0].release)
                         return
-                    # an IPC message larger than one staging buffer:
-                    # the decoder needs it contiguous, so sub-chunks
-                    # assemble into ONE host buffer (counted as bounce
-                    # — raise chunk_bytes to stay zero-copy)
                     views = [p.wait() for p in reads]
+                    if layout_put(entry, views, reads) is not None:
+                        return
+                    # non-primitive columns: the decoder needs the
+                    # message contiguous, so sub-chunks assemble into
+                    # ONE host buffer (counted as bounce)
                     host = np.empty(sum(v.nbytes for v in views),
                                     np.uint8)
                     pos = 0
@@ -212,15 +285,15 @@ class ArrowFileReader:
             for entry in entries:
                 ranges, _ = split_ranges([(entry.offset, entry.length)],
                                          chunk)
-                pend.append([engine.submit_read(fh, o, ln)
-                             for o, ln in ranges])
+                pend.append((entry, [engine.submit_read(fh, o, ln)
+                                     for o, ln in ranges]))
                 if len(pend) >= entry_depth:
                     consume(pend.pop(0))
             while pend:
                 consume(pend.pop(0))
         finally:
             retire.flush()
-            for reads in pend:
+            for _, reads in pend:
                 for p in reads:
                     p.release()  # waits if still in flight
             engine.close(fh)
