@@ -161,6 +161,14 @@ def main() -> int:
     dev = jax.devices()[0]
     _log(f"device = {dev}")
 
+    def quick_raw(engine) -> float:
+        """One cold raw pass (payload discarded) in the same minute as
+        the row's stream run — window 7's chunk sweep collapsed to
+        0.16 GiB/s under a 1.4+ link and could not distinguish NVMe-
+        side collapse from stream inefficiency because no raw ceiling
+        rode with the row."""
+        return bench.bench_raw(engine, path, repeats=1)
+
     # 1+2: per-depth sweep, both drain policies, with a same-minute link
     # ceiling before each depth so the ratio survives tunnel drift
     for depth in (4, 8, 16, 32):
@@ -168,15 +176,17 @@ def main() -> int:
         with StromEngine(cfg, stats=StromStats()) as engine:
             link = probe_link(dev, cfg.chunk_bytes,
                               outstanding=max(2, depth))
+            raw = quick_raw(engine)
             for drain in ("blocking", "ready"):
                 rate = probe_stream(engine, path, dev, depth, drain)
                 _emit({"probe": "depth", "depth": depth, "drain": drain,
                        "chunk_mib": cfg.chunk_bytes >> 20,
                        "stream_gibs": round(rate, 4),
                        "link_gibs": round(link, 4),
+                       "raw_gibs": round(raw, 4),
                        "ratio": round(rate / link, 3) if link else None})
                 _log(f"depth={depth} drain={drain}: stream={rate:.3f} "
-                     f"link={link:.3f}")
+                     f"link={link:.3f} raw={raw:.3f}")
 
     # 3: chunk-size sweep at fixed depth budget (depth scaled so
     # depth×chunk stays constant — same outstanding bytes)
@@ -190,13 +200,15 @@ def main() -> int:
         with StromEngine(cfg, stats=StromStats()) as engine:
             link = probe_link(dev, cfg.chunk_bytes,
                               outstanding=max(2, depth))
+            raw = quick_raw(engine)
             rate = probe_stream(engine, path, dev, depth, "ready")
             _emit({"probe": "chunk", "chunk_mib": chunk_mib,
                    "depth": depth, "stream_gibs": round(rate, 4),
                    "link_gibs": round(link, 4),
+                   "raw_gibs": round(raw, 4),
                    "ratio": round(rate / link, 3) if link else None})
             _log(f"chunk={chunk_mib}MiB depth={depth}: "
-                 f"stream={rate:.3f} link={link:.3f}")
+                 f"stream={rate:.3f} link={link:.3f} raw={raw:.3f}")
 
     # 4: the PJRT boundary question
     with StromEngine(EngineConfig(), stats=StromStats()) as engine:
