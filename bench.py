@@ -226,30 +226,15 @@ def _stream_pass(ds, path: str, size: int) -> float:
 
 
 def best_probe_config() -> dict | None:
-    """Highest-ratio (depth/chunk/drain) point the ledgered
+    """Best CREDIBLE (depth/chunk/drain) point the ledgered
     stream-efficiency probe has measured on silicon — the feedback loop
     from tools/stream_probe.py to the headline stream.  None when no
-    probe data exists yet."""
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_tpu_ledger.jsonl")
-    best = None
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if rec.get("step") != "stream_probe":
-                    continue
-                for r in rec.get("results", []):
-                    if (r.get("probe") in ("depth", "chunk")
-                            and r.get("ratio") is not None):
-                        if best is None or r["ratio"] > best["ratio"]:
-                            best = r
-    except OSError:
-        return None
-    return best
+    probe data exists yet.  Shared with the SQL scan's DeviceStream via
+    utils/tuning.py (which also documents the ratio<=1.05 credibility
+    filter — this used to adopt a physically impossible ratio-4.26
+    row)."""
+    from nvme_strom_tpu.utils.tuning import best_probe_config as _bpc
+    return _bpc()
 
 
 def _make_stream(engine, dev):
@@ -262,18 +247,10 @@ def _make_stream(engine, dev):
     # adopt it (STROM_BENCH_AUTO_TUNE=0 opts out; the chunk size must
     # match the engine's buffers, so only depth/drain adapt here —
     # chunk adapts in main() before the engine is built).
-    depth = engine.config.queue_depth
-    drain = "blocking"
-    if os.environ.get("STROM_BENCH_AUTO_TUNE", "1") != "0":
-        best = best_probe_config()
-        if best:
-            depth = min(int(best.get("depth", depth)),
-                        engine.n_buffers // 2)
-            drain = best.get("drain", "ready")
-            _log(f"bench: probe-tuned stream: depth={depth} "
-                 f"drain={drain} (ledgered ratio {best['ratio']})")
-    return DeviceStream(engine, device=dev, depth=max(2, depth),
-                        drain=drain)
+    from nvme_strom_tpu.utils.tuning import tuned_stream_params
+    depth, drain = tuned_stream_params(engine, default_drain="blocking")
+    _log(f"bench: stream operating point: depth={depth} drain={drain}")
+    return DeviceStream(engine, device=dev, depth=depth, drain=drain)
 
 
 def bench_to_device(engine, path: str, repeats: int = 3,

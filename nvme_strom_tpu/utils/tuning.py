@@ -1,0 +1,81 @@
+"""Ledger-informed stream tuning, shared by bench.py and consumers.
+
+tools/stream_probe.py ledgers (depth, drain, chunk) operating points
+with same-minute link/raw ceilings.  The headline bench has adopted the
+best ledgered point since round 3 — but SQL scans kept streaming at the
+engine's raw defaults (queue_depth=16, drain="ready"), which the
+window-7 sweep measured at 0.37 of ceiling while depth 4-8 rode the
+same link at 0.88-0.91.  This module is the one place both sides read
+the probe's verdict.
+
+Credibility filter: a stream cannot beat its own ceiling, so rows with
+ratio > 1.05 interleaved their ceiling with the wrong minute of a
+flapping link (window 7 ledgered 4.26) and carry no information about
+the operating point.  Among credible rows the ABSOLUTE stream rate
+ranks (the highest ratio often belongs to a collapsed-link minute where
+0.16 GiB/s was 0.94 of a 0.17 ceiling).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_LEDGER = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..",
+                 "BENCH_tpu_ledger.jsonl"))
+
+
+def best_probe_config(path: str | None = None,
+                      chunk_mib: int | None = None) -> dict | None:
+    """Best CREDIBLE ledgered stream operating point, or None.
+
+    ``chunk_mib`` restricts to rows measured at that chunk size — a
+    depth measured on a 32 MiB-chunk probe engine says nothing about
+    the right depth for a 4 MiB-chunk consumer."""
+    best = None
+    best_key = None
+    try:
+        with open(path or _LEDGER) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("step") != "stream_probe":
+                    continue
+                for r in rec.get("results", []):
+                    if r.get("probe") not in ("depth", "chunk"):
+                        continue
+                    if (chunk_mib is not None
+                            and r.get("chunk_mib") != chunk_mib):
+                        continue
+                    ratio = r.get("ratio")
+                    if ratio is None or not 0 < ratio <= 1.05:
+                        continue
+                    key = (r.get("stream_gibs", 0.0), ratio)
+                    if best_key is None or key > best_key:
+                        best, best_key = r, key
+    except OSError:
+        return None
+    return best
+
+
+def tuned_stream_params(engine, default_drain: str = "ready"
+                        ) -> tuple[int, str]:
+    """(depth, drain) for a DeviceStream over ``engine``: the engine's
+    defaults, overridden by the best credible ledgered probe point
+    MEASURED AT THIS ENGINE'S CHUNK SIZE when one exists
+    (STROM_BENCH_AUTO_TUNE=0 opts out and restores the raw defaults).
+    A tuned depth is capped at half the staging pool so the engine
+    keeps reading ahead while transfers drain."""
+    depth = engine.config.queue_depth
+    drain = default_drain
+    if os.environ.get("STROM_BENCH_AUTO_TUNE", "1") != "0":
+        best = best_probe_config(
+            chunk_mib=engine.config.chunk_bytes >> 20)
+        if best:
+            depth = min(int(best.get("depth", depth)),
+                        max(2, engine.n_buffers // 2))
+            drain = best.get("drain", default_drain)
+    return max(2, depth), drain

@@ -1,0 +1,65 @@
+"""Ledger-informed stream tuning (utils/tuning.py): the probe feedback
+loop both bench.py and the SQL scans read."""
+
+import json
+from types import SimpleNamespace
+
+from nvme_strom_tpu.utils import tuning
+
+
+def _ledger(tmp_path):
+    rows = [
+        {"step": "stream_probe", "results": [
+            # physically impossible: ceiling sampled the wrong minute
+            {"probe": "depth", "depth": 8, "drain": "ready",
+             "chunk_mib": 4, "stream_gibs": 0.5, "link_gibs": 0.12,
+             "ratio": 4.26},
+            # the best credible ABSOLUTE operating point at 4 MiB
+            {"probe": "depth", "depth": 4, "drain": "ready",
+             "chunk_mib": 4, "stream_gibs": 1.38, "link_gibs": 1.52,
+             "ratio": 0.909},
+            # higher ratio but a collapsed-link minute — must lose
+            {"probe": "chunk", "depth": 32, "drain": "ready",
+             "chunk_mib": 4, "stream_gibs": 0.166, "link_gibs": 0.176,
+             "ratio": 0.944},
+            # best absolute overall, but at 32 MiB chunks — says
+            # nothing about a 4 MiB-chunk engine's depth
+            {"probe": "chunk", "depth": 2, "drain": "ready",
+             "chunk_mib": 32, "stream_gibs": 1.6, "link_gibs": 1.7,
+             "ratio": 0.941},
+        ]},
+        {"step": "bench", "results": [{"metric": "x"}]},
+    ]
+    p = tmp_path / "ledger.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    return str(p)
+
+
+def test_best_probe_config_credibility(tmp_path):
+    path = _ledger(tmp_path)
+    best = tuning.best_probe_config(path)
+    assert best["depth"] == 2 and best["chunk_mib"] == 32  # unfiltered
+    best4 = tuning.best_probe_config(path, chunk_mib=4)
+    assert best4["depth"] == 4 and best4["ratio"] == 0.909
+
+
+def test_best_probe_config_missing_file():
+    assert tuning.best_probe_config("/nonexistent/ledger.jsonl") is None
+
+
+def test_tuned_stream_params(tmp_path, monkeypatch):
+    eng = SimpleNamespace(config=SimpleNamespace(queue_depth=16,
+                                                 chunk_bytes=4 << 20),
+                          n_buffers=64)
+    monkeypatch.setattr(tuning, "_LEDGER", _ledger(tmp_path))
+    # adopts the chunk-MATCHED best point, not the 32 MiB row
+    assert tuning.tuned_stream_params(eng) == (4, "ready")
+    # opt-out restores the raw engine defaults, uncapped
+    monkeypatch.setenv("STROM_BENCH_AUTO_TUNE", "0")
+    assert tuning.tuned_stream_params(eng, "blocking") == (16, "blocking")
+    monkeypatch.delenv("STROM_BENCH_AUTO_TUNE")
+    # a tuned depth is capped at half the staging pool
+    small = SimpleNamespace(config=SimpleNamespace(queue_depth=16,
+                                                   chunk_bytes=4 << 20),
+                            n_buffers=4)
+    assert tuning.tuned_stream_params(small) == (2, "ready")
