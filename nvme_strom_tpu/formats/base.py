@@ -11,8 +11,52 @@ buffered I/O — it is not payload and is never counted as such.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
+
+
+def pread_nopollute(path: str, length: int, offset: int = 0,
+                    fd: int | None = None) -> bytes:
+    """Read header/footer bytes WITHOUT page-cache pollution.
+
+    A plain ``open().read()``'s readahead faults ~128 KiB resident per
+    call, and any fully-resident span makes the engine's submit-time
+    mincore planner deliberately choose the buffered path for the
+    payload reads that follow — one metadata parse silently demoting
+    the O_DIRECT pipeline to memcpy (a cold wds_raw epoch measured
+    100% fallback+bounce from exactly this; a safetensors checkpoint's
+    many small early tensors are the same exposure).  FADV_RANDOM
+    suppresses readahead and the touched pages are dropped after;
+    best-effort on filesystems without fadvise.
+
+    ``fd`` reuses an already-open descriptor (a reader parsing several
+    metadata spans of one file should open once).
+
+    The DONTNEED span rounds OUT to page boundaries on both sides: the
+    kernel drops only pages wholly inside the advised range, so ending
+    at ``offset+length`` would silently keep the final partial page
+    resident — the exact defect this helper exists to prevent
+    (verified with mincore)."""
+    close = fd is None
+    if fd is None:
+        fd = os.open(path, os.O_RDONLY)
+    try:
+        try:
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_RANDOM)
+        except (OSError, AttributeError):
+            pass
+        out = os.pread(fd, length, offset)
+        try:
+            lo = offset & ~4095
+            hi = (offset + len(out) + 4095) & ~4095
+            os.posix_fadvise(fd, lo, hi - lo, os.POSIX_FADV_DONTNEED)
+        except (OSError, AttributeError):
+            pass
+        return out
+    finally:
+        if close:
+            os.close(fd)
 
 
 @dataclass(frozen=True)

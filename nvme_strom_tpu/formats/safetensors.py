@@ -21,7 +21,8 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+from nvme_strom_tpu.formats.base import (PlanEntry, ReadPlan,
+                                         pread_nopollute)
 
 _DTYPES: Dict[str, str] = {
     "BOOL": "bool", "U8": "uint8", "I8": "int8",
@@ -45,11 +46,21 @@ class SafetensorsFile:
 
     def __init__(self, path):
         self.path = str(path)
-        with open(self.path, "rb") as f:
-            (hlen,) = struct.unpack("<Q", f.read(8))
+        # no-pollution header parse (one open): a buffered read's
+        # readahead would leave the file head resident and flip the
+        # engine's residency planner to the buffered path for every
+        # small early tensor
+        fd = os.open(self.path, os.O_RDONLY)
+        try:
+            (hlen,) = struct.unpack(
+                "<Q", pread_nopollute(self.path, 8, fd=fd))
             if hlen > 100 << 20:
-                raise ValueError(f"implausible safetensors header: {hlen}")
-            header = json.loads(f.read(hlen))
+                raise ValueError(
+                    f"implausible safetensors header: {hlen}")
+            header = json.loads(pread_nopollute(self.path, hlen, 8,
+                                                fd=fd))
+        finally:
+            os.close(fd)
         self.data_start = 8 + hlen
         self.metadata = header.pop("__metadata__", {})
         self.tensors: Dict[str, dict] = {}

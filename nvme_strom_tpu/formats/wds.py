@@ -14,7 +14,8 @@ import subprocess
 import tarfile
 from typing import Dict, List, Optional
 
-from nvme_strom_tpu.formats.base import PlanEntry, ReadPlan
+from nvme_strom_tpu.formats.base import (PlanEntry, ReadPlan,
+                                         pread_nopollute)
 
 _BLOCK = 512
 
@@ -36,24 +37,11 @@ class WdsShardIndex:
         self.path = str(path)
         self.samples: Dict[str, Dict[str, tuple]] = {}
         self.order: List[str] = []
-        # magic sniff without page-cache pollution: a plain read(2)'s
-        # readahead faults ~128 KiB resident per shard, enough to flip
-        # the engine's residency planner to the buffered path for the
-        # first dozen members — FADV_RANDOM suppresses readahead and
-        # the probe page is dropped after
-        fd = os.open(self.path, os.O_RDONLY)
-        try:
-            try:
-                os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_RANDOM)
-            except (OSError, AttributeError):
-                pass
-            head = os.pread(fd, 2, 0)
-            try:
-                os.posix_fadvise(fd, 0, 4096, os.POSIX_FADV_DONTNEED)
-            except (OSError, AttributeError):
-                pass
-        finally:
-            os.close(fd)
+        # magic sniff without page-cache pollution (see
+        # formats.base.pread_nopollute: a plain read(2)'s readahead
+        # would flip the engine's residency planner to the buffered
+        # path for the first dozen members)
+        head = pread_nopollute(self.path, 2)
         if head == b"\x1f\x8b":
             raise ValueError(
                 f"{self.path}: gzip-compressed shard (.tar.gz) — "

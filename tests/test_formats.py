@@ -223,6 +223,46 @@ def test_arrow_columns_to_device(engine, tmp_path):
     np.testing.assert_array_equal(np.asarray(cols["b"]), b)
 
 
+def test_pread_nopollute_drops_pages(tmp_path):
+    """pread_nopollute must leave NO touched page resident — including
+    the final PARTIAL page: the kernel drops only pages wholly inside
+    a DONTNEED range, so an un-rounded end silently keeps the last
+    page (verified with mincore; a resident page flips the engine's
+    residency planner to the buffered path for any span inside it)."""
+    import ctypes
+    import mmap
+    import os
+    from nvme_strom_tpu.formats.base import pread_nopollute
+
+    p = tmp_path / "f.bin"
+    payload = os.urandom(32768)
+    p.write_bytes(payload)
+    import bench
+    bench.evict_file(str(p))
+
+    def resident_pages() -> int:
+        size = os.path.getsize(p)
+        # writable mapping only so ctypes.from_buffer can take the
+        # address; nothing is written and mapping populates no pages
+        with open(p, "r+b") as f, \
+                mmap.mmap(f.fileno(), size) as m:
+            npg = (size + 4095) // 4096
+            vec = (ctypes.c_ubyte * npg)()
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(m))
+            libc = ctypes.CDLL("libc.so.6", use_errno=True)
+            assert libc.mincore(ctypes.c_void_p(addr),
+                                ctypes.c_size_t(size), vec) == 0
+            return sum(v & 1 for v in vec)
+
+    # partial-page read in the middle of the file
+    got = pread_nopollute(str(p), 3700, 8)
+    assert got == payload[8:8 + 3700]
+    assert resident_pages() == 0
+    # tiny head read (the wds gzip sniff shape)
+    assert pread_nopollute(str(p), 2) == payload[:2]
+    assert resident_pages() == 0
+
+
 def test_arrow_multichunk_device_assembly(engine, tmp_path):
     """An IPC message larger than one staging buffer assembles ON
     DEVICE: the metadata decodes against a zeros body for the buffer
