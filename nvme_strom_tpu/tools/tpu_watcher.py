@@ -220,10 +220,25 @@ def capture(device: str) -> bool:
          1200, None),
         ("suite_2_v2", [sys.executable, "bench_suite.py", "--config", "2"],
          900, None),
-        # MFU story (verdict #3) immediately after the contract I/O
-        # rows: d2048 re-trace for the post-fix profile parse, then the
-        # flash d-points — a short window must land these before the
-        # long tail below
+        # cheap round-4 re-measures BEFORE the two 1500s profile
+        # re-captures: a short window must land these ~900s steps (the
+        # batched dict decode, the degap+pairing scan, topk) rather
+        # than spend its first 50 minutes on suite_7 traces
+        ("suite_5_v4",
+         [sys.executable, "bench_suite.py", "--config", "5"], 900, None),
+        # 900s is safe ahead of suite_13's 1800s cache-priming step:
+        # the batched decoder is ONE small fused program (searchsorted
+        # + gathers, 1-2 distinct shapes) — the old per-run kernels
+        # whose dozens of remote compiles needed 1800s are gone, and
+        # their cached executables wouldn't serve the new program
+        # anyway
+        ("suite_13_v2",
+         [sys.executable, "bench_suite.py", "--config", "13"], 900, None),
+        ("suite_15_v3",
+         [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
+        # MFU story (verdict #3) after the contract I/O rows: d2048
+        # re-trace for the fusion-resolved profile parse, then the
+        # flash d-points
         ("suite_7", [sys.executable, "bench_suite.py", "--config", "7"],
          1500, {"STROM_PROFILE_DIR": prof_d2048}),
         # the MFU lever sweep (verdict #3): batch amortizes weight
@@ -275,24 +290,18 @@ def capture(device: str) -> bool:
         # fold overhead 3.7x but its stream phase still ran 0.20 GiB/s
         # against bench's same-minute 1.15 at ratio 0.953: the per-PAGE
         # value spans cost ~8x more device puts per byte than bench's
-        # 8 MiB chunks.  v4 measures enclosing-range streaming with
-        # on-device jitted degap (one put per chunk, ~3 dispatches per
-        # window-column)
-        ("suite_5_v4", [sys.executable, "bench_suite.py", "--config", "5"],
-         900, None),
+        # 8 MiB chunks.  v4 (scheduled in the cheap-first block above)
+        # measures enclosing-range streaming with on-device jitted
+        # degap, per-pass ceilings, and the probe-tuned stream depth.
         ("suite_12_v2",
          [sys.executable, "bench_suite.py", "--config", "12"], 900, None),
         # 1800s: the dict-scan kernel burned two 900s timeouts inside
         # the remote compile (hangs right after the link probe); one
-        # completed compile populates the persistent cache for good
+        # completed compile populates the persistent cache for good.
+        # suite_13_v2 (batched RLE decode — 3 device ops per chunk
+        # instead of 16,784 puts/pass) runs in the cheap-first block.
         ("suite_13", [sys.executable, "bench_suite.py", "--config", "13"],
          1800, None),
-        # "_v2": batched RLE/bit-packed decode — the whole index stream
-        # now decodes in 3 device ops per chunk instead of one put per
-        # run (16,784 puts/pass ledgered; ~20 ms tunnel dispatch each
-        # was the entire 1474 s suite_13 step)
-        ("suite_13_v2",
-         [sys.executable, "bench_suite.py", "--config", "13"], 900, None),
         ("suite_11_prefix_v2",
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
          {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
@@ -300,10 +309,8 @@ def capture(device: str) -> bool:
          [sys.executable, "bench_suite.py", "--config", "14"], 900, None),
         ("suite_15_v2",
          [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
-        # topk re-measure under the enclosing-range degap streaming
-        # (its per-rg yields route through the same coalesced path)
-        ("suite_15_v3",
-         [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
+        # (suite_15_v3 — topk under degap streaming + per-pass
+        # ceilings — runs in the cheap-first block above)
         # remaining BASELINE-contract I/O rows (round-2 manual numbers
         # only) and the capability demonstrations
         ("suite_8", [sys.executable, "bench_suite.py", "--config", "8"],
