@@ -733,8 +733,13 @@ def bench_checkpoint_write(engine, nbytes: int) -> tuple[float, str]:
     mode = ("durable O_DIRECT" if direct_w >= payload * _RUNS
             else "BUFFERED (unaligned spans or fs rejects O_DIRECT; "
                  "page-cache speed)")
+    ph = getattr(mgr, "last_save_phases", {})
     shutil.rmtree(d, ignore_errors=True)
-    return statistics.median(rates), f"{payload >> 20}MiB/save, {mode}"
+    return statistics.median(rates), (
+        f"{payload >> 20}MiB/save, {mode}, phases: "
+        f"tiles={ph.get('tiles_s', -1):.3f}s "
+        f"commit={ph.get('commit_s', -1):.3f}s (commit = manifest+"
+        f"rename durability flushes; amortizes at real sizes)")
 
 
 def bench_multistream(engine, nbytes: int,

@@ -301,6 +301,7 @@ class CheckpointManager:
 
         proc = jax.process_index()
         eng, own = self._get_engine()
+        t0 = time.monotonic()
         try:
             write_safetensors_engine(
                 os.path.join(tmp, f"state-{proc:05d}.safetensors"), mine,
@@ -308,6 +309,7 @@ class CheckpointManager:
         finally:
             if own:
                 eng.close_all()
+        t1 = time.monotonic()
 
         if proc == 0:
             self._write_meta(tmp, step, index)
@@ -315,6 +317,17 @@ class CheckpointManager:
         if proc == 0:
             self._publish(tmp, final)
         self._sync()
+        # phase telemetry: tiles = engine writes + the data file's own
+        # fdatasync; commit = manifest fsync + durable rename — PLUS,
+        # in a multi-host save, the _sync() barrier waits (a straggler
+        # peer's tile time shows up here, not in tiles_s).  The
+        # breakdown lets a reader tell durability cost from bandwidth;
+        # at small payloads the device FLUSHes dominate and amortize
+        # away at real checkpoint sizes.
+        self.last_save_phases = {
+            "tiles_s": round(t1 - t0, 4),
+            "commit_s": round(time.monotonic() - t1, 4),
+        }
         if proc == 0:
             self._prune()
         return final
