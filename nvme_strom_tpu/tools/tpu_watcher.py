@@ -236,6 +236,15 @@ def capture(device: str) -> bool:
          [sys.executable, "bench_suite.py", "--config", "13"], 900, None),
         ("suite_15_v3",
          [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
+        # "_v2": chained data-dependent timing — the earlier rows timed
+        # per-call block_until_ready (the lying API; implied ~190x
+        # peak) and their block ranking is noise.  Only "chained" rows
+        # feed the flash kernel's tiling adoption
+        # (utils/tuning.best_attn_blocks); scheduled BEFORE the suite_7
+        # steps so this window's MFU runs adopt the fresh tiling.
+        ("kernel_probe_v2",
+         [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
+         1200, None),
         # MFU story (verdict #3) after the contract I/O rows: d2048
         # re-trace for the fusion-resolved profile parse, then the
         # flash d-points
@@ -351,9 +360,6 @@ def capture(device: str) -> bool:
         ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
          900, None),
         ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
-         1200, None),
-        ("kernel_probe",
-         [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
          1200, None),
         # diagnostics last: b16:none is the OOM-boundary probe (its
         # remote-compile 500 is informative and cheap); dots_diag

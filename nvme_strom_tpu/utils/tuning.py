@@ -18,12 +18,46 @@ ranks (the highest ratio often belongs to a collapsed-link minute where
 
 from __future__ import annotations
 
+import functools
 import json
 import os
+import re
 
 _LEDGER = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "..",
                  "BENCH_tpu_ledger.jsonl"))
+
+#: per-process ledger-mtime pin (see best_attn_blocks): adoption is
+#: stable for a process's lifetime even while the watcher appends
+_MTIME_PIN: dict = {}
+
+
+def _iter_results(step_prefix: str, path: str):
+    """Result dicts from VALID ledger rows whose step matches —
+    validity via tpu_watcher.classify_row, THE predicate the coverage
+    scheduler and ledger_report already share, so adoption can never
+    steer on evidence the project has voided (tombstoned rows, rc!=0,
+    non-tpu devices, tunnel-death or SUSPECT-tagged steps)."""
+    try:
+        from nvme_strom_tpu.tools.tpu_watcher import classify_row
+    except ImportError:                      # trimmed install: minimal
+        def classify_row(rec):               # mirror of the essentials
+            return (None if rec.get("valid") is not False
+                    and rec.get("rc") == 0 else "invalid")
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if not str(rec.get("step", "")).startswith(step_prefix):
+                    continue
+                if classify_row(rec) is not None:
+                    continue
+                yield from rec.get("results", [])
+    except OSError:
+        return
 
 
 def best_probe_config(path: str | None = None,
@@ -35,30 +69,69 @@ def best_probe_config(path: str | None = None,
     the right depth for a 4 MiB-chunk consumer."""
     best = None
     best_key = None
-    try:
-        with open(path or _LEDGER) as f:
-            for line in f:
-                try:
-                    rec = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if rec.get("step") != "stream_probe":
-                    continue
-                for r in rec.get("results", []):
-                    if r.get("probe") not in ("depth", "chunk"):
-                        continue
-                    if (chunk_mib is not None
-                            and r.get("chunk_mib") != chunk_mib):
-                        continue
-                    ratio = r.get("ratio")
-                    if ratio is None or not 0 < ratio <= 1.05:
-                        continue
-                    key = (r.get("stream_gibs", 0.0), ratio)
-                    if best_key is None or key > best_key:
-                        best, best_key = r, key
-    except OSError:
-        return None
+    for r in _iter_results("stream_probe", path or _LEDGER):
+        if r.get("probe") not in ("depth", "chunk"):
+            continue
+        if chunk_mib is not None and r.get("chunk_mib") != chunk_mib:
+            continue
+        ratio = r.get("ratio")
+        if ratio is None or not 0 < ratio <= 1.05:
+            continue
+        key = (r.get("stream_gibs", 0.0), ratio)
+        if best_key is None or key > best_key:
+            best, best_key = r, key
     return best
+
+
+@functools.lru_cache(maxsize=64)
+def _attn_blocks_cached(q_seq: int, kv_seq: int, path: str,
+                        mtime: float):
+    best_q = best_k = None
+    gap_q = gap_k = None
+    for r in _iter_results("kernel_probe", path):
+        if r.get("probe") != "attn_best" or r.get("timing") != "chained":
+            continue
+        m = re.search(r"s(\d+)d", str(r.get("shape", "")))
+        if not m:
+            continue
+        s = int(m.group(1))
+        gq, gk = abs(s - q_seq), abs(s - kv_seq)
+        # per-axis nearest shape: block_q is tuned for the Q length,
+        # block_k for the KV length — they can come from different
+        # probed shapes when q_seq != kv_seq (ring/cross attention).
+        # Later windows win ties: the newest on-silicon verdict.
+        if gap_q is None or gq <= gap_q:
+            best_q, gap_q = int(r["block_q"]), gq
+        if gap_k is None or gk <= gap_k:
+            best_k, gap_k = int(r["block_k"]), gk
+    return (best_q, best_k) if best_q is not None else None
+
+
+def best_attn_blocks(q_seq: int, kv_seq: int,
+                     path: str | None = None) -> tuple[int, int] | None:
+    """Ledgered best flash-attention (block_q, block_k) for the probed
+    shapes nearest ``q_seq``/``kv_seq``, or None.
+
+    Only rows carrying ``timing: "chained"`` qualify: the earlier
+    kernel_probe rows timed per-call ``block_until_ready``, which the
+    tunneled runtime returns from early (they implied ~190x device
+    peak), so their block ranking is noise.
+    (STROM_BENCH_AUTO_TUNE=0 opts out.)  The ledger mtime is PINNED at
+    this process's first lookup per path: a concurrent watcher append
+    must not flip a running job's tiling mid-stream (an unplanned
+    multi-ten-second remote compile plus an accumulation-order numerics
+    shift between steps); a fresh process adopts the newest verdict."""
+    if os.environ.get("STROM_BENCH_AUTO_TUNE", "1") == "0":
+        return None
+    p = path or _LEDGER
+    mtime = _MTIME_PIN.get(p)
+    if mtime is None:
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            return None
+        _MTIME_PIN[p] = mtime
+    return _attn_blocks_cached(q_seq, kv_seq, p, mtime)
 
 
 def tuned_stream_params(engine, default_drain: str = "ready"

@@ -9,7 +9,7 @@ from nvme_strom_tpu.utils import tuning
 
 def _ledger(tmp_path):
     rows = [
-        {"step": "stream_probe", "results": [
+        {"step": "stream_probe", "rc": 0, "device": "tpu TPU v5 lite0", "results": [
             # physically impossible: ceiling sampled the wrong minute
             {"probe": "depth", "depth": 8, "drain": "ready",
              "chunk_mib": 4, "stream_gibs": 0.5, "link_gibs": 0.12,
@@ -28,7 +28,7 @@ def _ledger(tmp_path):
              "chunk_mib": 32, "stream_gibs": 1.6, "link_gibs": 1.7,
              "ratio": 0.941},
         ]},
-        {"step": "bench", "results": [{"metric": "x"}]},
+        {"step": "bench", "rc": 0, "device": "tpu TPU v5 lite0", "results": [{"metric": "x"}]},
     ]
     p = tmp_path / "ledger.jsonl"
     p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
@@ -63,3 +63,57 @@ def test_tuned_stream_params(tmp_path, monkeypatch):
                                                    chunk_bytes=4 << 20),
                             n_buffers=4)
     assert tuning.tuned_stream_params(small) == (2, "ready")
+
+
+def test_best_attn_blocks(tmp_path, monkeypatch):
+    rows = [
+        # old-style row: block_until_ready timing — must be IGNORED
+        {"step": "kernel_probe", "rc": 0, "device": "tpu TPU v5 lite0", "results": [
+            {"probe": "attn_best", "shape": "b8h16s1024d128",
+             "block_q": 512, "block_k": 512, "fwdbwd_ms": 0.04}]},
+        # chained rows: trustworthy; later window wins the tie
+        {"step": "kernel_probe_v2", "rc": 0, "device": "tpu TPU v5 lite0", "results": [
+            {"probe": "attn_best", "shape": "b8h16s1024d128",
+             "block_q": 128, "block_k": 256, "fwdbwd_ms": 1.2,
+             "timing": "chained"},
+            {"probe": "attn_best", "shape": "b2h16s4096d128",
+             "block_q": 256, "block_k": 128, "fwdbwd_ms": 4.0,
+             "timing": "chained"}]},
+    ]
+    p = tmp_path / "ledger.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert tuning.best_attn_blocks(1024, 1024, str(p)) == (128, 256)
+    assert tuning.best_attn_blocks(4096, 4096, str(p)) == (256, 128)
+    # no chained rows at all -> None (the un-chained row never adopted)
+    p2 = tmp_path / "l2.jsonl"
+    p2.write_text(json.dumps(rows[0]) + "\n")
+    assert tuning.best_attn_blocks(1024, 1024, str(p2)) is None
+    monkeypatch.setenv("STROM_BENCH_AUTO_TUNE", "0")
+    assert tuning.best_attn_blocks(1024, 1024, str(p)) is None
+
+
+def test_best_attn_blocks_skips_voided_rows(tmp_path):
+    """A tombstoned (valid: false) or rc!=0 row must never steer the
+    adopted tiling — tuning shares classify_row, THE ledger validity
+    predicate, with the coverage scheduler and ledger_report."""
+    rows = [
+        {"step": "kernel_probe_v2", "rc": 0, "valid": False,
+         "invalid_reason": "flap minute", "device": "tpu TPU v5 lite0",
+         "results": [
+             {"probe": "attn_best", "shape": "b8h16s1024d128",
+              "block_q": 512, "block_k": 512, "fwdbwd_ms": 0.01,
+              "timing": "chained"}]},
+        {"step": "kernel_probe_v2", "rc": 1,
+         "device": "tpu TPU v5 lite0", "results": [
+             {"probe": "attn_best", "shape": "b8h16s1024d128",
+              "block_q": 512, "block_k": 128, "fwdbwd_ms": 0.01,
+              "timing": "chained"}]},
+        {"step": "kernel_probe_v2", "rc": 0,
+         "device": "tpu TPU v5 lite0", "results": [
+             {"probe": "attn_best", "shape": "b8h16s1024d128",
+              "block_q": 128, "block_k": 256, "fwdbwd_ms": 1.2,
+              "timing": "chained"}]},
+    ]
+    p = tmp_path / "ledger.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    assert tuning.best_attn_blocks(1024, 1024, str(p)) == (128, 256)
