@@ -185,7 +185,12 @@ def _steady(evict_paths, timed_fn) -> float:
     When run() installed a link probe (live device), each timed pass is
     preceded by one quick host→device burst and the (rate, link) pairs
     land in ``_PASS_LINK["last"]`` — the flap-proof per-pass ceilings
-    the result assembly ratios against (module header ¶3)."""
+    the result assembly ratios against (module header ¶3).
+
+    CONTRACT: exactly one discarded warmup call (run 0), then _RUNS
+    timed calls.  bench_sql's per-pass phase pairing records side data
+    from inside ``timed_fn`` and slices ``[1:]`` to drop the warmup —
+    if the run structure here ever changes, update that slicing too."""
     probe = _PASS_LINK["probe"]
     rates, pairs = [], []
     for i in range(_RUNS + 1):
@@ -481,10 +486,17 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
             v.block_until_ready()
         return time.monotonic() - t0
 
-    stream_pass()            # warm jit/dispatch caches, like _steady's
-    bench.evict_file(path)   # discarded run 0 — else one-time compile
-    t_stream = stream_pass()  # cost lands in the stream phase only
-    stream_rate = size / (1 << 30) / t_stream
+    # Per-PASS phase pairing (window-7 diagnosis 1 applied to the phase
+    # attribution, not just the ceiling): each timed scan subtracts a
+    # stream pass run SECONDS after it, so a link flap between the two
+    # phase measurements cancels instead of landing in fold_overhead —
+    # window 8 ledgered fold 0.18→2.57 s across captures from exactly
+    # this mispairing (the lone stream pass caught a 1.09 GiB/s moment,
+    # the scans ~0.5 ones).  Order matters: the SCAN runs first, right
+    # after _steady's link burst, so the (rate, link) ceiling pair
+    # stays adjacent too; the stream pass follows the scan.  _steady's
+    # discarded run 0 warms both paths' jit/dispatch caches.
+    stream_ts, fold_ts = [], []
 
     def one_scan() -> float:
         t0 = time.monotonic()
@@ -493,15 +505,23 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
         for v in out.values():
             v.block_until_ready()
         dt = time.monotonic() - t0
+        bench.evict_file(path)   # the stream pass re-reads the NVMe too
+        stream_ts.append(stream_pass())
+        fold_ts.append(max(dt - stream_ts[-1], 0.0))
         _log(f"suite: sql scanned {rows} rows ({size >> 20} MiB) "
-             f"in {dt:.3f}s = {rows / dt / 1e6:.1f} Mrows/s")
+             f"in {dt:.3f}s = {rows / dt / 1e6:.1f} Mrows/s "
+             f"(paired stream={stream_ts[-1]:.3f}s)")
         return size / (1 << 30) / dt
 
     rate = _steady([path], one_scan)
-    fold_s = max(size / (1 << 30) / rate - t_stream, 0.0)
+    # index 0 is _steady's warmup call — drop its pair like _steady does
+    gib = size / (1 << 30)
+    stream_rate = statistics.median(gib / t for t in (stream_ts[1:]
+                                                      or stream_ts))
+    fold_s = statistics.median(fold_ts[1:] or fold_ts)
     tag = (f"rows={rows} plan={t_plan * 1e3:.0f}ms "
            f"stream={stream_rate:.3f} GiB/s "
-           f"fold_overhead={fold_s:.3f}s")
+           f"fold_overhead={fold_s:.3f}s paired=per-pass")
     _log(f"suite: sql phases: {tag}")
     return rate, tag
 

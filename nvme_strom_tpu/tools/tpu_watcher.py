@@ -224,7 +224,15 @@ def capture(device: str) -> bool:
         # re-captures: a short window must land these ~900s steps (the
         # batched dict decode, the degap+pairing scan, topk) rather
         # than spend its first 50 minutes on suite_7 traces
-        ("suite_5_v4",
+        # "_v5" (replaces the captured v4 in this slot — same CLI, so
+        # keeping both would just re-run identical code under a stale
+        # label): window-8's v4 row (stream=1.094 GiB/s but
+        # fold_overhead 0.18→2.57 s vs window 7) exposed the LAST
+        # unpaired measurement: the lone stream pass and the scan
+        # passes sampled different link moments, so the flap landed in
+        # "fold".  v5 measures the per-pass paired attribution (scan
+        # adjacent to its link burst, stream pass seconds after it).
+        ("suite_5_v5",
          [sys.executable, "bench_suite.py", "--config", "5"], 900, None),
         # 900s is safe ahead of suite_13's 1800s cache-priming step:
         # the batched decoder is ONE small fused program (searchsorted
@@ -280,46 +288,22 @@ def capture(device: str) -> bool:
          {"STROM_TRAIN_SWEEP": "8:none:flash",
           "STROM_TRAIN_CFG": CFG_D4096,
           "STROM_PROFILE_DIR": prof_d4096}),
-        # "_v2" steps: the measured code changed in round 4 (pipelined
-        # cross-row-group scans + phase tags for 5/15, the pipelined
-        # compressed path + cost decomposition for 12, link-normalized
-        # frame for 14, lookahead serving + spans for 11) — round-3
-        # rows measured the old code, so these re-capture as fresh
-        # coverage, ordered by how directly the verdict asked
-        ("suite_5_v2", [sys.executable, "bench_suite.py", "--config", "5"],
-         900, None),
-        # "_v3": round-4 second iteration — the v2 on-silicon row's own
-        # phase tags (stream=0.186 GiB/s under a 1.35 GiB/s link,
-        # fold_overhead=0.667s) showed per-dispatch RTT, not bandwidth,
-        # priced the scan; v3 measures the row-group-coalescing window
-        # (sql_window_bytes) that divides the dispatch count ~8x
-        ("suite_5_v3", [sys.executable, "bench_suite.py", "--config", "5"],
-         900, None),
-        # "_v4": third iteration — v3's on-silicon row (19:06) cut the
-        # fold overhead 3.7x but its stream phase still ran 0.20 GiB/s
-        # against bench's same-minute 1.15 at ratio 0.953: the per-PAGE
-        # value spans cost ~8x more device puts per byte than bench's
-        # 8 MiB chunks.  v4 (scheduled in the cheap-first block above)
-        # measures enclosing-range streaming with on-device jitted
-        # degap, per-pass ceilings, and the probe-tuned stream depth.
+        # Version-label hygiene: a step's _vN suffix names the CODE
+        # GENERATION it measured, but every generation shares one CLI —
+        # so once a label's row has landed, its entry is DELETED here
+        # (not kept for re-runs) or a rerun would ledger new code under
+        # a stale label.  Retired after their windows-6/7/8 rows landed:
+        # suite_5_v2 (pipelined scan), suite_5_v3 (row-group windows),
+        # suite_5_v4 (degap streaming), suite_13 (first compile/cache
+        # priming), suite_15_v2 (phase tags).  Their iteration history
+        # lives in TPU_RESULTS.md.
         ("suite_12_v2",
          [sys.executable, "bench_suite.py", "--config", "12"], 900, None),
-        # 1800s: the dict-scan kernel burned two 900s timeouts inside
-        # the remote compile (hangs right after the link probe); one
-        # completed compile populates the persistent cache for good.
-        # suite_13_v2 (batched RLE decode — 3 device ops per chunk
-        # instead of 16,784 puts/pass) runs in the cheap-first block.
-        ("suite_13", [sys.executable, "bench_suite.py", "--config", "13"],
-         1800, None),
         ("suite_11_prefix_v2",
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
          {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
         ("suite_14_v2",
          [sys.executable, "bench_suite.py", "--config", "14"], 900, None),
-        ("suite_15_v2",
-         [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
-        # (suite_15_v3 — topk under degap streaming + per-pass
-        # ceilings — runs in the cheap-first block above)
         # remaining BASELINE-contract I/O rows (round-2 manual numbers
         # only) and the capability demonstrations
         ("suite_8", [sys.executable, "bench_suite.py", "--config", "8"],
