@@ -125,8 +125,168 @@ _FUSED_BUCKETS = (
 )
 
 
+#: "type[d0,d1,...]" — first shape literal in a fragment
+_SHAPE = re.compile(r"\b[a-z0-9]+\[([0-9,]*)\]")
+_LHS_CONTRACT = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+
+
+#: operand inside an op's parens: optional inline shape, then %name
+_OPERAND = re.compile(r"((?:[a-z0-9]+\[[0-9,]*\]\S*\s+)?%[\w.\-]+)")
+_DIM_LABELS = re.compile(r"dim_labels=(\w+)_(\w+)->(\w+)")
+#: "name: type[dims]" parameter declarations in computation headers
+_HEADER_PARAM = re.compile(r"([\w.\-]+)\s*:\s*[a-z0-9]+\[([0-9,]*)\]")
+
+
+def _operand_dims(tok: str, defs: dict) -> list:
+    """Dims of one operand token — inline shape if the dump carries
+    operand shapes, else resolved via the module-wide ``defs``."""
+    m = _SHAPE.match(tok)
+    if m:
+        return [int(d) for d in m.group(1).split(",") if d]
+    return defs[tok.rsplit("%", 1)[-1]]
+
+
+def _matmul_flops(line: str, opcode: str, defs: dict) -> int:
+    """FLOPs of one optimized-HLO ``dot`` or matmul-as-``convolution``
+    line: 2·|output|·K.
+
+    The output shape already carries the batch and free dims, so
+    multiplying by the contracted sizes is exact for batched dots too.
+    XLA's optimized modules spell many matmuls as convolutions
+    (``dim_labels=bf_io->bf`` and friends); there K is the lhs 'f'
+    (feature) dim times any rhs spatial kernel dims.  0 on any parse
+    miss — an unparsed op must read as "no efficiency estimate", never
+    as a wrong one."""
+    try:
+        rhs = line.split("=", 1)[1]
+        elems = 1
+        for d in _SHAPE.search(rhs).group(1).split(","):
+            if d:
+                elems *= int(d)
+        args = rhs[rhs.index(opcode + "(") + len(opcode) + 1:]
+        toks = _OPERAND.findall(args)
+        lhs = _operand_dims(toks[0], defs)
+        if opcode == "dot":
+            k = 1
+            for i in (int(x) for x in
+                      _LHS_CONTRACT.search(line).group(1).split(",") if x):
+                k *= lhs[i]
+        else:
+            lhs_l, rhs_l, _ = _DIM_LABELS.search(line).groups()
+            k = lhs[lhs_l.index("f")]
+            rdims = _operand_dims(toks[1], defs)
+            for ch, d in zip(rhs_l, rdims):
+                if ch.isdigit():
+                    k *= d
+        return 2 * elems * k
+    except Exception:
+        return 0
+
+
+def _load_hlo_maps(trace_dir: str) -> tuple:
+    """ONE walk of the optimized-HLO dump → (bucket map, FLOPs map).
+
+    Both public views come from the same line-walk so a dump-format
+    change cannot silently diverge them: computation bodies yield the
+    constituent-opcode sets (bucket classification) AND the dot/conv
+    FLOPs; the fusion instructions then resolve each %fusion.NN to its
+    called computation for both maps at once.  Keys are sigil-less
+    ("fusion.212"): the TPU device plane names events "%fusion.212"
+    but the CPU host plane logs "fusion.212" — lookups strip the sigil
+    to match either."""
+    path = os.path.join(trace_dir, "optimized_hlo.txt")
+    if not os.path.exists(path):
+        return {}, {}
+    with open(path) as f:
+        lines = f.read().splitlines()
+
+    # pass 1 — module-wide name → dims (HLO instruction names are
+    # unique module-wide, and operands routinely reference names
+    # defined in OTHER computations, e.g. a fused conv consuming an
+    # ENTRY-level fusion's output)
+    defs: dict[str, list] = {}
+    for line in lines:
+        stripped = line.strip()
+        if stripped.endswith("{"):          # computation header params
+            for name, dims in _HEADER_PARAM.findall(stripped):
+                defs[name] = [int(d) for d in dims.split(",") if d]
+            continue
+        if "=" in stripped:
+            name = stripped.removeprefix("ROOT ").split("=", 1)[0].strip()
+            if name.startswith("%"):
+                sh = _SHAPE.search(stripped.split("=", 1)[1])
+                if sh:
+                    defs[name.lstrip("%")] = [int(d) for d in
+                                              sh.group(1).split(",") if d]
+
+    # pass 2 — per-computation opcode sets and dot/conv FLOPs, plus
+    # FLOPs of un-fused matmul instructions (profiler events under
+    # their own names)
+    comp_ops: dict[str, set] = {}
+    comp_flops: dict[str, int] = {}
+    inst_flops: dict[str, int] = {}
+    cur = None
+    for line in lines:
+        m = _HLO_COMP.match(line.strip())
+        if m and line.rstrip().endswith("{"):
+            cur = m.group(1)
+            comp_ops[cur] = set()
+            continue
+        if line.startswith("}"):
+            cur = None
+            continue
+        op = _OPCODE.search(line)
+        if not op:
+            continue
+        if cur is not None:
+            comp_ops[cur].add(op.group(1))
+        if op.group(1) in ("dot", "convolution"):
+            fl = _matmul_flops(line, op.group(1), defs)
+            if not fl:
+                continue
+            if cur is not None:
+                comp_flops[cur] = comp_flops.get(cur, 0) + fl
+            name = line.strip().removeprefix("ROOT ").split("=", 1)[0]
+            name = name.strip()
+            if name.startswith("%"):
+                inst_flops[name.lstrip("%")] = fl
+
+    # pass 3 — resolve fusion instructions through their called
+    # computations, for both maps at once
+    fmap: dict[str, str] = {}
+    for line in lines:
+        m = _HLO_FUSION.search(line)
+        if not m:
+            continue
+        key = m.group(1).lstrip("%")
+        if m.group(2) in comp_flops:
+            inst_flops[key] = comp_flops[m.group(2)]
+        ops = comp_ops.get(m.group(2), set())
+        for bucket, keys in _FUSED_BUCKETS:
+            if any(o in keys for o in ops):
+                fmap[key] = bucket
+                break
+        else:
+            if ops:
+                fmap[key] = "elementwise-fusion"
+    return fmap, inst_flops
+
+
+def load_fusion_flops(trace_dir: str) -> dict:
+    """{"fusion.NN" | "dot.NN": dot/conv FLOPs per execution} from the
+    optimized-HLO dump — the per-op half of the MXU-efficiency table.
+
+    The window-8 fusion-resolved parses settled WHERE the time goes
+    (matmul-fusion ≈ 88% at busy_frac 1.0) but not WHY those fusions
+    run at ~54% of bf16 peak.  Dividing each fusion's known dot FLOPs
+    by its measured device time names the underperformers exactly —
+    lm_head vs ffn vs attention projections — or shows the deficit is
+    spread (a small-shape tax no single kernel fix recovers)."""
+    return _load_hlo_maps(trace_dir)[1]
+
+
 def load_fusion_map(trace_dir: str) -> dict:
-    """{"%fusion.NN": resolved bucket} from the post-optimization HLO
+    """{"fusion.NN": resolved bucket} from the post-optimization HLO
     dump the capture step writes next to the trace (optimized_hlo.txt).
 
     The profiler's device plane names most of a train step's time after
@@ -136,44 +296,7 @@ def load_fusion_map(trace_dir: str) -> dict:
     opcodes are known exactly; classification by real constituents
     replaces the "unnamed-fusion" bucket without re-introducing the
     operand-text guessing the c92ebd3 fix removed."""
-    path = os.path.join(trace_dir, "optimized_hlo.txt")
-    if not os.path.exists(path):
-        return {}
-    comp_ops: dict[str, set] = {}
-    cur = None
-    with open(path) as f:
-        for line in f:
-            m = _HLO_COMP.match(line.strip())
-            if m and line.rstrip().endswith("{"):
-                cur = m.group(1)
-                comp_ops[cur] = set()
-                continue
-            if line.startswith("}"):
-                cur = None
-                continue
-            if cur is not None:
-                op = _OPCODE.search(line)
-                if op:
-                    comp_ops[cur].add(op.group(1))
-    fmap: dict[str, str] = {}
-    with open(path) as f:
-        for line in f:
-            m = _HLO_FUSION.search(line)
-            if not m:
-                continue
-            ops = comp_ops.get(m.group(2), set())
-            # keys stored WITHOUT the % sigil: the TPU device plane
-            # names events "%fusion.212" but the CPU host plane logs
-            # "fusion.212" — lookups strip the sigil to match either
-            key = m.group(1).lstrip("%")
-            for bucket, keys in _FUSED_BUCKETS:
-                if any(o in keys for o in ops):
-                    fmap[key] = bucket
-                    break
-            else:
-                if ops:
-                    fmap[key] = "elementwise-fusion"
-    return fmap
+    return _load_hlo_maps(trace_dir)[0]
 
 
 def _fmap_bucket(ev, fmap: dict | None):
@@ -220,7 +343,7 @@ def parse_trace(trace_dir: str) -> dict:
         if p.name == "/host:CPU":
             host_plane = p
 
-    fmap = load_fusion_map(trace_dir)
+    fmap, flops_map = _load_hlo_maps(trace_dir)
     by_cat: dict[str, float] = {}
     by_op: dict[str, float] = {}
     # category → {op: ns}: names the time, not just buckets — the
@@ -280,6 +403,28 @@ def parse_trace(trace_dir: str) -> dict:
     wall_ns = (max(e for _, e in module_spans)
                - min(s for s, _ in module_spans)) if module_spans else busy_ns
     top = sorted(by_op.items(), key=lambda kv: -kv[1])[:8]
+
+    # MXU-efficiency table: each op's dot FLOPs (from the HLO dump) over
+    # its measured per-execution time.  An op's total ns spans all
+    # traced steps; one HLO instruction executes once per step.
+    matmul_eff = {}
+    if flops_map and module_ns:
+        steps = len(module_ns)
+        ranked = sorted(((ns, op) for op, ns in by_op.items()
+                         if flops_map.get(op.lstrip("%")) and ns > 0),
+                        reverse=True)[:10]
+        for ns, op in ranked:
+            fl = flops_map[op.lstrip("%")]
+            matmul_eff[op] = {"ms": round(ns / 1e6, 3),
+                              "tflops": round(fl * steps / ns / 1e3, 1)}
+        tot_ns = sum(ns for op, ns in by_op.items()
+                     if flops_map.get(op.lstrip("%")))
+        tot_fl = sum(flops_map[op.lstrip("%")] for op in by_op
+                     if flops_map.get(op.lstrip("%")))
+        if tot_ns:
+            matmul_eff["_aggregate"] = {
+                "ms": round(tot_ns / 1e6, 3),
+                "tflops": round(tot_fl * steps / tot_ns / 1e3, 1)}
     return {
         "plane": (dev_plane or host_plane).name,
         "trace": os.path.basename(paths[-1]),
@@ -299,6 +444,8 @@ def parse_trace(trace_dir: str) -> dict:
                           for k, v in sorted(by_cat.items(),
                                              key=lambda kv: -kv[1])},
         "top_ops_ms": {k: round(v / 1e6, 3) for k, v in top},
+        # per-dot-op achieved TFLOP/s (present when the HLO dump parsed)
+        **({"matmul_eff_tflops": matmul_eff} if matmul_eff else {}),
         "category_top_ops_ms": {
             cat: {k: round(v / 1e6, 3)
                   for k, v in sorted(ops.items(),

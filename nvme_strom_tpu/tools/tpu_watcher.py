@@ -234,7 +234,7 @@ def capture(device: str) -> bool:
         # adjacent to its link burst, stream pass seconds after it).
         ("suite_5_v5",
          [sys.executable, "bench_suite.py", "--config", "5"], 900, None),
-        # 900s is safe ahead of suite_13's 1800s cache-priming step:
+        # 900s suffices where the retired suite_13 step needed 1800s:
         # the batched decoder is ONE small fused program (searchsorted
         # + gathers, 1-2 distinct shapes) — the old per-run kernels
         # whose dozens of remote compiles needed 1800s are gone, and
@@ -372,11 +372,17 @@ def capture(device: str) -> bool:
     # HLO next to the trace and profile_report resolves each fusion to
     # its constituent opcodes — the v3 parse is the fusion-resolved
     # MFU attribution.
+    # "_v4": the v3 parses settled WHERE the time goes (matmul-fusion
+    # ≈ 88% at busy_frac 1.0) but not WHY those fusions run at ~54% of
+    # bf16 peak.  profile_report now also divides each fusion's dot/
+    # conv FLOPs (parsed from the same HLO dump) by its measured time —
+    # the v4 parse is the per-op MXU-efficiency table that names the
+    # underperforming matmuls (or shows the deficit is spread).
     parse_steps = [
-        ("profile_d2048_v3",
+        ("profile_d2048_v4",
          [sys.executable, "-m", "nvme_strom_tpu.tools.profile_report",
           "--dir", prof_d2048], 300, None),
-        ("profile_d4096_v3",
+        ("profile_d4096_v4",
          [sys.executable, "-m", "nvme_strom_tpu.tools.profile_report",
           "--dir", prof_d4096], 300, {"STROM_TRAIN_CFG": CFG_D4096}),
     ]
@@ -414,8 +420,8 @@ def capture(device: str) -> bool:
     # at 3 consumer attempts: a deterministically-failing parse must not
     # pin its producer in the fresh tier forever, starving tail steps.
     attempts = _attempt_counts()
-    for producer, consumer in (("suite_7", "profile_d2048_v3"),
-                               ("suite_7_d4096", "profile_d4096_v3")):
+    for producer, consumer in (("suite_7", "profile_d2048_v4"),
+                               ("suite_7_d4096", "profile_d4096_v4")):
         if consumer not in done and attempts.get(consumer, 0) < 3:
             done.discard(producer)
     steps = _coverage_order(steps, done,

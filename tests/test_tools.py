@@ -220,6 +220,35 @@ ENTRY %main.9 (a: bf16[8,128]) -> f32[8] {
     # no map → empty dict → unchanged fallback behavior
     assert profile_report.load_fusion_map("/nonexistent-dir") == {}
 
+    # MXU-efficiency half: the dot inside %fused_computation.1 is
+    # (8,128)@(128,128) → 2·(8·128)·128 FLOPs, attributed to the
+    # calling %fusion.10; the reduce-only fusion gets no entry
+    flops = profile_report.load_fusion_flops(str(tmp_path))
+    assert flops["fusion.10"] == 2 * (8 * 128) * 128
+    assert "fusion.11" not in flops
+    assert profile_report.load_fusion_flops("/nonexistent-dir") == {}
+
+
+def test_profile_matmul_flops_batched_conv_and_malformed():
+    """2·|out|·K is exact for batched dots (batch dims ride the output
+    product) and for XLA's matmul-as-convolution spelling; malformed
+    lines read as 0, never a wrong estimate."""
+    from nvme_strom_tpu.tools import profile_report
+    line = ("%dot.7 = bf16[4,256,512]{2,1,0:T(8,128)(2,1)} "
+            "dot(bf16[4,256,64]{2,1,0} %a, bf16[4,64,512]{2,1,0} %b), "
+            "lhs_batch_dims={0}, lhs_contracting_dims={2}, "
+            "rhs_batch_dims={0}, rhs_contracting_dims={1}")
+    assert (profile_report._matmul_flops(line, "dot", {})
+            == 2 * (4 * 256 * 512) * 64)
+    # optimized modules spell dW = x^T @ dy as a convolution with
+    # dim_labels=fb_io->bf: K = lhs 'f' dim (the contracted batch)
+    conv = ("ROOT %convolution.5 = bf16[256,512]{1,0:T(8,128)(2,1)} "
+            "convolution(%a, %b), dim_labels=fb_io->bf")
+    defs = {"a": [128, 256], "b": [128, 512]}
+    assert (profile_report._matmul_flops(conv, "convolution", defs)
+            == 2 * (256 * 512) * 128)
+    assert profile_report._matmul_flops("%dot.8 = garbage", "dot", {}) == 0
+
 
 def test_profile_report_capture_and_parse(capsys, monkeypatch):
     """End-to-end on the CPU backend: trace a tiny train variant, parse
