@@ -458,7 +458,8 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
     import jax
     from nvme_strom_tpu.sql.parquet import ParquetScanner
     from nvme_strom_tpu.sql.groupby import (iter_device_columns,
-                                            sql_groupby)
+                                            sql_groupby,
+                                            sql_window_bytes)
     path = os.path.join(_scratch_dir(), "table.parquet")
     size = make_parquet_file(path, nbytes, num_groups)
     scanner = ParquetScanner(path, engine)
@@ -498,10 +499,17 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
     # discarded run 0 warms both paths' jit/dispatch caches.
     stream_ts, fold_ts = [], []
 
+    # fold bisect knob: the v5 paired row put the fold at ~1.4 s on a
+    # healthy link — method (matmul one-hot vs scatter segment-sum)
+    # and window size are the two levers that split dispatch cost from
+    # device-side fold cost
+    method = os.environ.get("STROM_SQL_METHOD", "matmul")
+
     def one_scan() -> float:
         t0 = time.monotonic()
         out = sql_groupby(scanner, "k", "v", num_groups,
-                          aggs=("count", "sum", "mean"), device=device)
+                          aggs=("count", "sum", "mean"), method=method,
+                          device=device)
         for v in out.values():
             v.block_until_ready()
         dt = time.monotonic() - t0
@@ -521,7 +529,8 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
     fold_s = statistics.median(fold_ts[1:] or fold_ts)
     tag = (f"rows={rows} plan={t_plan * 1e3:.0f}ms "
            f"stream={stream_rate:.3f} GiB/s "
-           f"fold_overhead={fold_s:.3f}s paired=per-pass")
+           f"fold_overhead={fold_s:.3f}s paired=per-pass "
+           f"method={method} window={sql_window_bytes() >> 20}MiB")
     _log(f"suite: sql phases: {tag}")
     return rate, tag
 

@@ -88,6 +88,17 @@ def _group_blocks(blocks: dict, n_blk: int, pi: int,
     return groups.index(frozenset(blocks[pi])), len(groups)
 
 
+def _settle(arrays) -> None:
+    """Best-effort block on dispatched device transfers before their
+    staging is released (the release-after-ready rule's error path):
+    a failed batch may have younger puts still reading the buffers."""
+    for a in arrays:
+        try:
+            a.block_until_ready()
+        except Exception:
+            pass
+
+
 def _default_decode(parts: dict) -> np.ndarray:
     """Single-part raw samples → uint8 array (copy: counted by caller)."""
     if len(parts) != 1:
@@ -481,13 +492,19 @@ class ShardedLoader:
 
         def to_device(dev, prs):
             parts = []
-            for pr in prs:
-                v = pr.wait()
-                n = v.nbytes // rec_bytes
-                parts.append(host_to_device(
-                    eng, v.view(dtype).reshape((n,) + rshape), dev))
-            return (parts[0] if len(parts) == 1
-                    else jnp.concatenate(parts))
+            try:
+                for pr in prs:
+                    v = pr.wait()
+                    n = v.nbytes // rec_bytes
+                    parts.append(host_to_device(
+                        eng, v.view(dtype).reshape((n,) + rshape), dev))
+                return (parts[0] if len(parts) == 1
+                        else jnp.concatenate(parts))
+            except BaseException:
+                # a mid-piece failure leaves younger puts in flight;
+                # they must retire before the caller releases staging
+                _settle(parts)
+                raise
 
         span_list = sorted({sp for sp in dev_spans.values()})
         batch_pieces = sum(
@@ -601,7 +618,11 @@ class ShardedLoader:
                     per_dev.append(to_device(dev, rs))
             except BaseException:
                 # a failed wait/transfer must still hand every staging
-                # buffer of this entry back to the pool
+                # buffer of this entry back to the pool — but transfers
+                # already dispatched out of it must retire FIRST, or
+                # the recycled buffer is overwritten under an in-flight
+                # H2D read (the module's release-after-ready rule)
+                _settle(per_dev)
                 for pr in reads:
                     pr.release()
                 held[0] -= len(reads)
@@ -616,19 +637,32 @@ class ShardedLoader:
             return jax.make_array_from_single_device_arrays(
                 gshape, sharding, per_dev)
 
-        pending: list = []
+        # Eager dispatch (window-8 diagnosis): finishing an entry only
+        # at yield time meant the consumer's per-batch
+        # ``block_until_ready`` had NO younger transfers overlapping it
+        # — the link ran stop-and-wait at batch granularity (config 3
+        # ledgered 0.35 GiB/s on a 1.44 GiB/s link from exactly this).
+        # Two stages now run ahead of the consumer, ``depth`` entries
+        # across both: ``pending`` holds planned batches whose engine
+        # READS are in flight; a batch whose reads all report ready is
+        # promoted (``finish`` — transfers dispatch) into ``ready``,
+        # opportunistically so younger reads keep the NVMe queue full
+        # while promoted transfers ride the link.  The consumer then
+        # receives arrays whose successors are already on the wire.
+        # Staging-pool pressure is relieved by retiring the oldest
+        # TRANSFERS after force-promoting any read-stage entries
+        # (retire pool + pending cover all held staging between them).
+        pending: list = []      # planned: reads in flight
+        ready: list = []        # finished: transfers dispatched
         try:
             for b in range(n_batches):
                 b0 = b * self.local_batch
                 retire.drain_ready()
-                while pending and held[0] + batch_pieces > eng.n_buffers:
-                    yield finish(pending.pop(0))
-                    retire.drain_ready()
-                # everything dispatched and still over the cap: block on
-                # the oldest outstanding transfers until buffers free
-                while (held[0] + batch_pieces > eng.n_buffers
-                       and retire.retire_oldest()):
-                    pass
+                while held[0] + batch_pieces > eng.n_buffers:
+                    if pending:
+                        ready.append(finish(pending.pop(0)))
+                    elif not retire.retire_oldest():
+                        break
                 span_reads = {}
                 entry = []
                 for dev, (g0, g1) in dev_spans.items():
@@ -639,10 +673,17 @@ class ShardedLoader:
                     entry.append((dev, span_reads[key]))
                 pending.append(entry)
                 held[0] += len(entry_reads(entry))
-                if len(pending) > depth:
-                    yield finish(pending.pop(0))
+                while pending and all(pr.is_ready()
+                                      for pr in entry_reads(pending[0])):
+                    ready.append(finish(pending.pop(0)))
+                if len(pending) + len(ready) > depth:
+                    if not ready:
+                        ready.append(finish(pending.pop(0)))
+                    yield ready.pop(0)
             while pending:
-                yield finish(pending.pop(0))
+                ready.append(finish(pending.pop(0)))
+            while ready:
+                yield ready.pop(0)
         finally:
             retire.flush()
             for entry in pending:
@@ -717,12 +758,21 @@ class ShardedLoader:
 
         def to_device(dev, groups):
             members = []
-            for prs in groups:
-                parts = [host_to_device(eng, pr.wait(), dev)
-                         for pr in prs]
-                members.append(parts[0] if len(parts) == 1
-                               else jnp.concatenate(parts))
-            return jnp.stack(members)
+            dispatched = []
+            try:
+                for prs in groups:
+                    parts = []
+                    for pr in prs:
+                        parts.append(host_to_device(eng, pr.wait(), dev))
+                        dispatched.append(parts[-1])
+                    members.append(parts[0] if len(parts) == 1
+                                   else jnp.concatenate(parts))
+                return jnp.stack(members)
+            except BaseException:
+                # a mid-member failure leaves younger puts in flight;
+                # they must retire before the caller releases staging
+                _settle(dispatched)
+                raise
 
         yield from self._zero_copy_batches(
             sharding, gshape, dev_spans, lo, n_batches, batch_pieces,

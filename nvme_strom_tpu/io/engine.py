@@ -350,6 +350,7 @@ class PendingRead:
         self._length = length
         self._released = False
         self._view: Optional[np.ndarray] = None
+        self._error: Optional[OSError] = None
         self.was_fallback = False
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
@@ -363,6 +364,8 @@ class PendingRead:
         """
         if self._view is not None:
             return self._view
+        if self._error is not None:     # error found by an is_ready probe
+            raise self._error
         comp = _Completion()
         rc = _wait_for_completion(self._engine, self._req_id, comp,
                                   timeout, "read")
@@ -382,6 +385,26 @@ class PendingRead:
         else:
             self._view = np.ctypeslib.as_array(comp.data, shape=(n,))
         return self._view
+
+    def is_ready(self) -> bool:
+        """Non-blocking completion probe: True once ``wait()`` would
+        return without blocking — including completed-with-error reads,
+        whose OSError is cached here and raised by the caller's
+        ``wait()`` (a bool probe must not throw or release as a side
+        effect).  Pipelines use this to promote read-complete batches
+        to the transfer stage while younger reads stay in flight (the
+        read-side analogue of ``DeviceStream``'s ``drain="ready"``)."""
+        if (self._view is not None or self._error is not None
+                or self._released):
+            return True
+        try:
+            self.wait(timeout=0.0)
+            return True
+        except TimeoutError:
+            return False
+        except OSError as e:
+            self._error = e
+            return True
 
     def release(self) -> None:
         if self._released:

@@ -204,21 +204,21 @@ def capture(device: str) -> bool:
         # 3 is the NAMED headline (ImageNet-shaped WebDataset → infeed,
         # the wds_raw zero-copy path) — it goes first among the fresh
         # steps.
-        ("suite_3", [sys.executable, "bench_suite.py", "--config", "3"],
-         1200, None),
-        ("suite_2", [sys.executable, "bench_suite.py", "--config", "2"],
-         900, None),
-        ("suite_4", [sys.executable, "bench_suite.py", "--config", "4"],
-         900, None),
-        # "_v2" re-measures under per-pass interleaved link ceilings
-        # (bench_suite module header ¶3): the 19:04 window's suite_2/3
-        # UNDER rows paired passes with a step-start link that had
-        # flapped by the time the passes ran — the probe's own pure
-        # stream ledgered 0.16 GiB/s minutes after bench rode the same
-        # link at 0.95x of 1.35 (L79)
-        ("suite_3_v2", [sys.executable, "bench_suite.py", "--config", "3"],
+        # "_v3" (retired labels: suite_3 = flap-paired step-start
+        # ceilings, suite_3_v2 = per-pass ceilings + no-pollute
+        # metadata walks — both landed): the v2 on-silicon row showed
+        # the loader capping at 0.35 GiB/s on a 1.44 GiB/s link —
+        # transfers only dispatched at yield time, so the consumer's
+        # per-batch block ran the link stop-and-wait.  v3 measures the
+        # two-stage eager pipeline (reads in flight across batches,
+        # read-complete batches promoted to dispatched transfers
+        # before the consumer asks).  CPU rate 0.38→0.83 from the same
+        # change; config 3 is the NAMED headline, first among fresh.
+        ("suite_3_v3", [sys.executable, "bench_suite.py", "--config", "3"],
          1200, None),
         ("suite_2_v2", [sys.executable, "bench_suite.py", "--config", "2"],
+         900, None),
+        ("suite_4", [sys.executable, "bench_suite.py", "--config", "4"],
          900, None),
         # cheap round-4 re-measures BEFORE the two 1500s profile
         # re-captures: a short window must land these ~900s steps (the
@@ -234,6 +234,18 @@ def capture(device: str) -> bool:
         # adjacent to its link burst, stream pass seconds after it).
         ("suite_5_v5",
          [sys.executable, "bench_suite.py", "--config", "5"], 900, None),
+        # fold bisect (v5's paired row: fold ≈ 1.4 s on a healthy link
+        # — REAL, not ceiling mispairing): scatter swaps the matmul
+        # one-hot (a ~2.2 GB HBM materialization per 64 MiB window if
+        # XLA doesn't fuse it) for segment_sum; w256 folds the whole
+        # table in ONE window (4x fewer consumer dispatch sets).  The
+        # pair splits device-side fold cost from per-window overhead.
+        ("suite_5_scatter",
+         [sys.executable, "bench_suite.py", "--config", "5"], 900,
+         {"STROM_SQL_METHOD": "scatter"}),
+        ("suite_5_w256",
+         [sys.executable, "bench_suite.py", "--config", "5"], 900,
+         {"STROM_SQL_WINDOW_BYTES": str(256 << 20)}),
         # 900s suffices where the retired suite_13 step needed 1800s:
         # the batched decoder is ONE small fused program (searchsorted
         # + gathers, 1-2 distinct shapes) — the old per-run kernels
