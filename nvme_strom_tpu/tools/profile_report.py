@@ -157,10 +157,25 @@ def _matmul_flops(line: str, opcode: str, defs: dict) -> int:
     (feature) dim times any rhs spatial kernel dims.  0 on any parse
     miss — an unparsed op must read as "no efficiency estimate", never
     as a wrong one."""
+    return _matmul_info(line, opcode, defs)[0]
+
+
+#: the JAX source mapping XLA stamps on every instruction
+_OP_NAME = re.compile(r'op_name="([^"]+)"')
+
+
+def _matmul_info(line: str, opcode: str, defs: dict) -> tuple:
+    """(FLOPs, source descriptor) for one dot/convolution line.
+
+    The descriptor — "<out dims>@k<K> <op_name tail>" — is what lets a
+    ledgered efficiency row name the slow matmul in MODEL terms (which
+    projection, fwd or transpose(jvp) bwd) without the HLO dump, which
+    is gone by the time anyone reads the row.  (0, "") on parse miss."""
     try:
         rhs = line.split("=", 1)[1]
+        out = _SHAPE.search(rhs).group(1)
         elems = 1
-        for d in _SHAPE.search(rhs).group(1).split(","):
+        for d in out.split(","):
             if d:
                 elems *= int(d)
         args = rhs[rhs.index(opcode + "(") + len(opcode) + 1:]
@@ -178,9 +193,13 @@ def _matmul_flops(line: str, opcode: str, defs: dict) -> int:
             for ch, d in zip(rhs_l, rdims):
                 if ch.isdigit():
                     k *= d
-        return 2 * elems * k
+        m = _OP_NAME.search(line)
+        desc = f"{out.replace(',', 'x')}@k{k}"
+        if m:
+            desc += " " + m.group(1)[-64:]
+        return 2 * elems * k, desc
     except Exception:
-        return 0
+        return 0, ""
 
 
 def _load_hlo_maps(trace_dir: str) -> tuple:
@@ -196,7 +215,7 @@ def _load_hlo_maps(trace_dir: str) -> tuple:
     to match either."""
     path = os.path.join(trace_dir, "optimized_hlo.txt")
     if not os.path.exists(path):
-        return {}, {}
+        return {}, {}, {}
     with open(path) as f:
         lines = f.read().splitlines()
 
@@ -224,7 +243,9 @@ def _load_hlo_maps(trace_dir: str) -> tuple:
     # their own names)
     comp_ops: dict[str, set] = {}
     comp_flops: dict[str, int] = {}
+    comp_descs: dict[str, list] = {}       # (flops, source desc) pairs
     inst_flops: dict[str, int] = {}
+    inst_descs: dict[str, list] = {}
     cur = None
     for line in lines:
         m = _HLO_COMP.match(line.strip())
@@ -241,15 +262,17 @@ def _load_hlo_maps(trace_dir: str) -> tuple:
         if cur is not None:
             comp_ops[cur].add(op.group(1))
         if op.group(1) in ("dot", "convolution"):
-            fl = _matmul_flops(line, op.group(1), defs)
+            fl, desc = _matmul_info(line, op.group(1), defs)
             if not fl:
                 continue
             if cur is not None:
                 comp_flops[cur] = comp_flops.get(cur, 0) + fl
+                comp_descs.setdefault(cur, []).append((fl, desc))
             name = line.strip().removeprefix("ROOT ").split("=", 1)[0]
             name = name.strip()
             if name.startswith("%"):
                 inst_flops[name.lstrip("%")] = fl
+                inst_descs[name.lstrip("%")] = [(fl, desc)]
 
     # pass 3 — resolve fusion instructions through their called
     # computations, for both maps at once
@@ -261,6 +284,7 @@ def _load_hlo_maps(trace_dir: str) -> tuple:
         key = m.group(1).lstrip("%")
         if m.group(2) in comp_flops:
             inst_flops[key] = comp_flops[m.group(2)]
+            inst_descs[key] = comp_descs.get(m.group(2), [])
         ops = comp_ops.get(m.group(2), set())
         for bucket, keys in _FUSED_BUCKETS:
             if any(o in keys for o in ops):
@@ -269,7 +293,7 @@ def _load_hlo_maps(trace_dir: str) -> tuple:
         else:
             if ops:
                 fmap[key] = "elementwise-fusion"
-    return fmap, inst_flops
+    return fmap, inst_flops, inst_descs
 
 
 def load_fusion_flops(trace_dir: str) -> dict:
@@ -343,7 +367,7 @@ def parse_trace(trace_dir: str) -> dict:
         if p.name == "/host:CPU":
             host_plane = p
 
-    fmap, flops_map = _load_hlo_maps(trace_dir)
+    fmap, flops_map, descs_map = _load_hlo_maps(trace_dir)
     by_cat: dict[str, float] = {}
     by_op: dict[str, float] = {}
     # category → {op: ns}: names the time, not just buckets — the
@@ -414,9 +438,16 @@ def parse_trace(trace_dir: str) -> dict:
                          if flops_map.get(op.lstrip("%")) and ns > 0),
                         reverse=True)[:10]
         for ns, op in ranked:
-            fl = flops_map[op.lstrip("%")]
-            matmul_eff[op] = {"ms": round(ns / 1e6, 3),
-                              "tflops": round(fl * steps / ns / 1e3, 1)}
+            key = op.lstrip("%")
+            fl = flops_map[key]
+            entry = {"ms": round(ns / 1e6, 3),
+                     "tflops": round(fl * steps / ns / 1e3, 1)}
+            # top source descriptors: which model matmuls this fusion
+            # holds ("8192x11008@k4096 ...transpose(jvp())/dot_general")
+            descs = sorted(descs_map.get(key, ()), reverse=True)[:2]
+            if descs:
+                entry["ops"] = [d for _, d in descs]
+            matmul_eff[op] = entry
         tot_ns = sum(ns for op, ns in by_op.items()
                      if flops_map.get(op.lstrip("%")))
         tot_fl = sum(flops_map[op.lstrip("%")] for op in by_op

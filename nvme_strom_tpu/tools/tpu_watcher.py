@@ -390,11 +390,18 @@ def capture(device: str) -> bool:
     # conv FLOPs (parsed from the same HLO dump) by its measured time —
     # the v4 parse is the per-op MXU-efficiency table that names the
     # underperforming matmuls (or shows the deficit is spread).
+    # "_v5": the v4 tables priced each fusion (d4096: fusion.82/76 at
+    # 35.5 TFLOP/s — half the step under 25% of peak) but the HLO dump
+    # was deleted before anyone could ask WHICH model matmuls they
+    # hold.  The v5 parse stamps each entry with its dots' source
+    # descriptors ("8192x11008@k4096 ...transpose(jvp())/dot_general")
+    # and the capture now keeps /tmp/strom_prof_latest for post-hoc
+    # reads.
     parse_steps = [
-        ("profile_d2048_v4",
+        ("profile_d2048_v5",
          [sys.executable, "-m", "nvme_strom_tpu.tools.profile_report",
           "--dir", prof_d2048], 300, None),
-        ("profile_d4096_v4",
+        ("profile_d4096_v5",
          [sys.executable, "-m", "nvme_strom_tpu.tools.profile_report",
           "--dir", prof_d4096], 300, {"STROM_TRAIN_CFG": CFG_D4096}),
     ]
@@ -432,8 +439,8 @@ def capture(device: str) -> bool:
     # at 3 consumer attempts: a deterministically-failing parse must not
     # pin its producer in the fresh tier forever, starving tail steps.
     attempts = _attempt_counts()
-    for producer, consumer in (("suite_7", "profile_d2048_v4"),
-                               ("suite_7_d4096", "profile_d4096_v4")):
+    for producer, consumer in (("suite_7", "profile_d2048_v5"),
+                               ("suite_7_d4096", "profile_d4096_v5")):
         if consumer not in done and attempts.get(consumer, 0) < 3:
             done.discard(producer)
     steps = _coverage_order(steps, done,
@@ -473,7 +480,31 @@ def capture(device: str) -> bool:
             else:
                 _log(f"parse step {name}: no trace dir, skipping")
     finally:
-        shutil.rmtree(prof_root, ignore_errors=True)
+        # keep the newest capture's traces + optimized-HLO dumps at a
+        # stable path instead of deleting them: the window-8 efficiency
+        # table named two 35-TFLOP/s fusions whose BODIES were gone by
+        # the time anyone could ask what they compute (the per-capture
+        # tempdir was rm'd here).  One capture's worth is kept; the
+        # previous one is replaced.
+        # same tempdir as mkdtemp → os.rename stays on one filesystem
+        # (atomic; a cross-fs copy could die half-done and leave a
+        # truncated "latest" that post-hoc parses silently misread)
+        keep = os.path.join(tempfile.gettempdir(), "strom_prof_latest")
+        stage = keep + ".new"
+        try:
+            if any(os.scandir(prof_root)):
+                shutil.rmtree(stage, ignore_errors=True)
+                os.rename(prof_root, stage)
+                shutil.rmtree(keep, ignore_errors=True)
+                if os.path.exists(keep):   # undeletable → don't nest
+                    shutil.rmtree(stage, ignore_errors=True)
+                else:
+                    os.rename(stage, keep)
+            else:
+                shutil.rmtree(prof_root, ignore_errors=True)
+        except OSError:
+            shutil.rmtree(prof_root, ignore_errors=True)
+            shutil.rmtree(stage, ignore_errors=True)
     _log(f"capture DONE (ok={ok})")
     return ok
 
