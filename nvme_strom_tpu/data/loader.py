@@ -746,8 +746,69 @@ class ShardedLoader:
         dev_spans, lo = self._device_row_spans(sharding, gshape)
         n_batches = self._count_batches(len(recs))
         chunk = eng.config.chunk_bytes
-        batch_pieces = self.local_batch * -(-mlen // chunk)
         fhs = [eng.open(p) for p in order]
+
+        # Span coalescing (window-9): tar members of one fixed payload
+        # size sit at a CONSTANT stride (512 B header + padded
+        # payload), so a run of consecutive members is ONE strided
+        # read and ONE device put — the batch then materializes as
+        # reshape(k, stride)[:, :mlen] on device, a single fused
+        # program with the SAME shape every batch (no per-batch
+        # recompiles).  That moves the loader from 8 × 1 MiB puts per
+        # batch to bench's own chunk regime, whose stream rides ≥0.9
+        # of ceiling.  The ~512 B/member of header bytes transferred
+        # along is 0.05% overhead; reading one header-gap past the
+        # last payload is covered by tar's mandatory ≥1024 B
+        # end-of-archive zero blocks (checked against file size below).
+        stride = None
+        uniform = True
+        prev = None
+        for si, off, _ in recs:
+            if prev is not None and prev[0] == si:
+                d = off - prev[1]
+                if stride is None:
+                    stride = d
+                elif d != stride:
+                    uniform = False
+                    break
+            prev = (si, off)
+        uniform = uniform and stride is not None and stride >= mlen
+        if uniform:
+            last = {}
+            for si, off, _ in recs:
+                last[si] = off
+            uniform = all(off + stride <= os.path.getsize(order[si])
+                          for si, off in last.items())
+
+        class _Span(list):
+            """PendingReads of one strided span + its member count
+            (a list subclass so _zero_copy_batches' read-walker still
+            finds the leaves)."""
+            __slots__ = ("k",)
+
+        def span_groups(r0, r1):
+            """Runs of stride-consecutive records in one shard —
+            shared by the read planner and the exact pool-fit count."""
+            groups = []
+            for r in range(r0, r1):
+                si, off, _ = recs[r]
+                if (groups and groups[-1][0] == si
+                        and off == groups[-1][1] + groups[-1][2] * stride):
+                    groups[-1][2] += 1
+                else:
+                    groups.append([si, off, 1])
+            return groups
+
+        def plan_reads_span(r0, r1):
+            out = []
+            for si, off0, k in span_groups(r0, r1):
+                nb = k * stride
+                prs = _Span(
+                    eng.submit_read(fhs[si], off0 + o, min(chunk, nb - o))
+                    for o in range(0, nb, chunk))
+                prs.k = k
+                out.append(prs)
+            return out
 
         def member_reads(si, off, ln):
             return [eng.submit_read(fhs[si], off + o, min(chunk, ln - o))
@@ -756,8 +817,13 @@ class ShardedLoader:
         def plan_reads(r0, r1):
             return [member_reads(*recs[r]) for r in range(r0, r1)]
 
-        def to_device(dev, groups):
-            members = []
+        def dispatch_groups(dev, groups, group_block):
+            """One batch's groups → device blocks: wait each read, put
+            its staging view, concat a multi-chunk group, finish with
+            ``group_block``.  On ANY failure, dispatched puts settle
+            before the caller releases staging (release-after-ready) —
+            one copy of the hazard path for both read plans."""
+            blocks = []
             dispatched = []
             try:
                 for prs in groups:
@@ -765,14 +831,45 @@ class ShardedLoader:
                     for pr in prs:
                         parts.append(host_to_device(eng, pr.wait(), dev))
                         dispatched.append(parts[-1])
-                    members.append(parts[0] if len(parts) == 1
-                                   else jnp.concatenate(parts))
-                return jnp.stack(members)
+                    big = (parts[0] if len(parts) == 1
+                           else jnp.concatenate(parts))
+                    blocks.append(group_block(big, prs))
+                return blocks
             except BaseException:
-                # a mid-member failure leaves younger puts in flight;
-                # they must retire before the caller releases staging
                 _settle(dispatched)
                 raise
+
+        def to_device_span(dev, groups):
+            blocks = dispatch_groups(
+                dev, groups,
+                lambda big, prs: big.reshape(prs.k, stride)[:, :mlen])
+            return (blocks[0] if len(blocks) == 1
+                    else jnp.concatenate(blocks))
+
+        def to_device(dev, groups):
+            return jnp.stack(dispatch_groups(dev, groups,
+                                             lambda big, prs: big))
+
+        if uniform:
+            plan_reads, to_device = plan_reads_span, to_device_span
+            # EXACT worst-case staging pieces per batch: a "+margin"
+            # guess here underestimates datasets of many tiny shards
+            # (each shard boundary opens a new group), and an entry
+            # needing more buffers than the pool deadlocks finish() —
+            # the engine defers the excess reads and only this entry's
+            # own transfers could free buffers.  Walk every batch's
+            # distinct device spans and take the max.
+            span_list = sorted({sp for sp in dev_spans.values()})
+            batch_pieces = 1
+            for b in range(n_batches):
+                b0 = b * self.local_batch
+                tot = sum(-(-(k * stride) // chunk)
+                          for g0, g1 in span_list
+                          for _, _, k in span_groups(b0 + (g0 - lo),
+                                                     b0 + (g1 - lo)))
+                batch_pieces = max(batch_pieces, tot)
+        else:
+            batch_pieces = self.local_batch * -(-mlen // chunk)
 
         yield from self._zero_copy_batches(
             sharding, gshape, dev_spans, lo, n_batches, batch_pieces,

@@ -407,6 +407,36 @@ def test_wds_raw_batches_match_standard_path(tmp_path):
         assert len(list(loader)) == 4
 
 
+def test_wds_raw_nonuniform_stride_falls_back(tmp_path):
+    """A shard whose members are NOT at constant stride (here: one
+    member carries a GNU long-name extension header, adding blocks
+    between payloads) must take the per-member read path and still
+    yield identical rows — span coalescing is an optimization, never a
+    correctness condition."""
+    import io as _io
+    import tarfile
+    import jax
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(7)
+    mlen = 4096
+    rows = []
+    p = str(tmp_path / "odd.tar")
+    with tarfile.open(p, "w", format=tarfile.GNU_FORMAT) as tf:
+        for i in range(8):
+            payload = rng.integers(0, 256, mlen, dtype=np.uint8)
+            rows.append(payload)
+            name = (("x" * 120) if i == 3 else f"{i:05d}") + ".bin"
+            ti = tarfile.TarInfo(name)
+            ti.size = mlen
+            tf.addfile(ti, _io.BytesIO(payload.tobytes()))
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("dp",))
+    with ShardedLoader([p], mesh, global_batch=4,
+                       fmt="wds_raw") as loader:
+        got = [np.asarray(b) for b in loader]
+    np.testing.assert_array_equal(np.concatenate(got), np.stack(rows))
+
+
 def test_wds_index_cached_and_no_cache_poisoning(tmp_path, monkeypatch):
     """(a) shards are indexed once per loader, not once per epoch — the
     re-walk was a whole extra end-to-end file read per epoch; (b) the
@@ -482,12 +512,16 @@ def test_wds_raw_bounce_accounting(tmp_path, monkeypatch):
     np.testing.assert_array_equal(raw_out[0], std_out[0])
     if not direct:
         pytest.skip("fs rejects O_DIRECT")
-    # On the CPU test device both paths count payload exactly once, but
-    # from DIFFERENT copies: wds_raw's term is host_to_device's CPU-only
+    # On the CPU test device both paths count payload once, but from
+    # DIFFERENT copies: wds_raw's term is host_to_device's CPU-only
     # alias-protection copy (vanishes on an accelerator -> bounce 0,
     # the config-3 claim); the standard path's is the per-member
-    # tobytes() handoff, which an accelerator still pays.
-    assert raw_bounce == payload
+    # tobytes() handoff, which an accelerator still pays.  The span-
+    # coalesced read carries each member's 512 B tar header along
+    # (one strided put per batch instead of one per member), so its
+    # transfer counts stride = header + payload bytes per member.
+    stride = 512 + 8192
+    assert raw_bounce == 8 * stride
     assert std_bounce == payload
 
 
