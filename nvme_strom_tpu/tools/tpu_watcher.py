@@ -309,7 +309,12 @@ def capture(device: str) -> bool:
         # suite_5_v4 (degap streaming), suite_13 (first compile/cache
         # priming), suite_15_v2 (phase tags).  Their iteration history
         # lives in TPU_RESULTS.md.
-        ("suite_12_v2",
+        # "_v3" (v2 label retired after its window-6 1.75x row —
+        # window 9 then ledgered 0.61x while the same row's phase tag
+        # showed direct 4x faster: the two _steady runs sampled the
+        # flapping link minutes apart; v3 pairs direct/pyarrow back to
+        # back per pass and reports the median per-pass ratio)
+        ("suite_12_v3",
          [sys.executable, "bench_suite.py", "--config", "12"], 900, None),
         ("suite_11_prefix_v2",
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
