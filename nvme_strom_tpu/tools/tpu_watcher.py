@@ -262,7 +262,14 @@ def capture(device: str) -> bool:
         # feed the flash kernel's tiling adoption
         # (utils/tuning.best_attn_blocks); scheduled BEFORE the suite_7
         # steps so this window's MFU runs adopt the fresh tiling.
-        ("kernel_probe_v2",
+        # "_v3" (v2 label retired — its chained attention rows landed
+        # twice): adds the matmul-roof probe, the honest MFU
+        # denominator — window 9's efficiency table showed EVERY big
+        # train matmul fusion capped near ~92 TFLOP/s on a nominal-197
+        # chip; a bare bf16 matmul chain decides whether that is the
+        # exposed device's roof (step ≈95% of achievable) or program
+        # headroom.
+        ("kernel_probe_v3",
          [sys.executable, "-m", "nvme_strom_tpu.tools.kernel_probe"],
          1200, None),
         # MFU story (verdict #3) after the contract I/O rows: d2048
