@@ -521,11 +521,22 @@ def _stream_fold(stream, num_groups: int, aggs: Sequence[str],
         mask = where(cols) if where is not None else None
         if base is not None:
             mask = base if mask is None else (mask & base)
-        part = groupby_aggregate(
-            keys, values, num_groups,
-            aggs=_norm_aggs(aggs),
-            method=method, mask=mask, empty_as_nan=False)  # keep foldable
-        folds = part if folds is None else _fold(folds, part)
+        if folds is None:
+            folds = groupby_aggregate(
+                keys, values, num_groups,
+                aggs=_norm_aggs(aggs),
+                method=method, mask=mask,
+                empty_as_nan=False)            # keep foldable
+        else:
+            # aggregate + fold as ONE device program with the running
+            # folds donated: on a high-RTT link every dispatch is
+            # priced (the window-9 paired config-5 row put the fold at
+            # ~1.4 s), so the two-call form paid double.  mask=None is
+            # a valid pytree arg — it keys its own trace with the
+            # mask branch folded out.
+            folds = _agg_fold(folds, keys, values, mask,
+                              num_groups=num_groups,
+                              aggs=_norm_aggs(aggs), method=method)
     if folds is None:
         raise ValueError("empty table")
     return finalize_folds(folds, aggs) if finalize else folds
@@ -636,3 +647,16 @@ def _fold(a: Dict[str, jax.Array], b: Dict[str, jax.Array]):
         else:  # mean folds from sum/count at the end
             out[k] = a[k]
     return out
+
+
+# aggregate-and-fold as one device program (the incremental scan's hot
+# call): the running folds are DONATED — their buffers are dead after
+# the fold, and donation lets XLA accumulate in place instead of
+# allocating a fresh result tree per window
+@partial(jax.jit, static_argnames=("num_groups", "aggs", "method"),
+         donate_argnums=(0,))
+def _agg_fold(folds, keys, values, mask, *, num_groups, aggs, method):
+    part = groupby_aggregate(keys, values, num_groups, aggs=aggs,
+                             method=method, mask=mask,
+                             empty_as_nan=False)
+    return _fold(folds, part)

@@ -202,10 +202,13 @@ def probe_matmul_roof(dev) -> None:
         rec = {"probe": "matmul_roof", "n": n,
                "ms": round(t * 1e3, 3), "tflops": round(tf, 1),
                "timing": "chained"}
+        reasons = []
         if tf > 300:                       # v5e peak 197
-            rec["suspect"] = "rate above device peak"
+            reasons.append("rate above device peak")
         if not bool(jnp.isfinite(y).all()):
-            rec["suspect"] = "non-finite chain output"
+            reasons.append("non-finite chain output")
+        if reasons:
+            rec["suspect"] = "; ".join(reasons)
         _emit(rec)
         _log(f"matmul_roof n={n}: {t * 1e3:.2f} ms = {tf:.0f} TF/s"
              f"{' SUSPECT' if 'suspect' in rec else ''}")
@@ -227,7 +230,9 @@ def main() -> int:
     _log(f"device = {dev}")
     def roof_guarded():
         # the roof probe must never cost the step its PRIMARY output
-        # (the attn tiling rows that feed best_attn_blocks adoption)
+        # (the attn tiling rows that feed best_attn_blocks adoption) —
+        # exception-guarded AND ordered LAST, so a hang in it burns
+        # only the tail of the step budget, never the tiling rows
         try:
             probe_matmul_roof(dev)
         except Exception as e:  # noqa: BLE001 — device/alloc flake
@@ -238,9 +243,9 @@ def main() -> int:
         roof_guarded()                        # tiny-n mechanics
         probe_shape(1, 2, 256, 64, dev)       # mechanics only
         return 0
-    roof_guarded()                            # MFU denominator first
     h1, s1 = probe_shape(8, 16, 1024, 128, dev)   # config-7 train shape
     h2, s2 = probe_shape(2, 16, 4096, 128, dev)   # long context
+    roof_guarded()                            # MFU denominator
     if (s1 + s2) and not (h1 + h2):
         # every timed row was impossibly fast: the runtime lied for the
         # whole step — the metric marker makes classify_row void the
