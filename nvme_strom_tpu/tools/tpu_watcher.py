@@ -232,7 +232,11 @@ def capture(device: str) -> bool:
         # passes sampled different link moments, so the flap landed in
         # "fold".  v5 measures the per-pass paired attribution (scan
         # adjacent to its link burst, stream pass seconds after it).
-        ("suite_5_v5",
+        # "_v6" (v5 retired after its window-9 row — per-pass paired
+        # phases, fold ≈1.4 s REAL at a healthy link): v6 measures the
+        # fused aggregate+fold (one donated device program per window
+        # instead of two dispatches).
+        ("suite_5_v6",
          [sys.executable, "bench_suite.py", "--config", "5"], 900, None),
         # fold bisect (v5's paired row: fold ≈ 1.4 s on a healthy link
         # — REAL, not ceiling mispairing): scatter swaps the matmul
