@@ -437,6 +437,33 @@ def test_wds_raw_nonuniform_stride_falls_back(tmp_path):
     np.testing.assert_array_equal(np.concatenate(got), np.stack(rows))
 
 
+def test_wds_raw_many_tiny_shards(tmp_path):
+    """A batch spanning MANY shards opens one span group per shard —
+    the exact shape whose staging-piece count a fixed '+margin'
+    estimate underplans (the pool-fit guard must count real groups, or
+    an entry needing more buffers than the pool deadlocks finish())."""
+    import jax
+    from jax.sharding import Mesh
+
+    rng = np.random.default_rng(11)
+    paths, rows = [], []
+    for s in range(16):
+        samples = []
+        for i in range(2):
+            p = rng.integers(0, 256, 4096, dtype=np.uint8)
+            samples.append({"bin": p.tobytes()})
+            rows.append(p)
+        sp = str(tmp_path / f"tiny-{s:03d}.tar")
+        from nvme_strom_tpu.formats.wds import write_wds_shard
+        write_wds_shard(sp, samples)
+        paths.append(sp)
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("dp",))
+    with ShardedLoader(paths, mesh, global_batch=16,
+                       fmt="wds_raw") as loader:
+        got = [np.asarray(b) for b in loader]
+    np.testing.assert_array_equal(np.concatenate(got), np.stack(rows))
+
+
 def test_wds_index_cached_and_no_cache_poisoning(tmp_path, monkeypatch):
     """(a) shards are indexed once per loader, not once per epoch — the
     re-walk was a whole extra end-to-end file read per epoch; (b) the
