@@ -276,7 +276,11 @@ def make_wds_shards(dirpath: str, nbytes: int, n_shards: int = 4,
     os.makedirs(dirpath, exist_ok=True)
     per_shard = max(2, nbytes // n_shards // item_bytes)
     rng = np.random.default_rng(0)
-    regen = _needs_regen("wds", nbytes)
+    # sentinel keyed per DATASET DIR: config 3 and config 17 both build
+    # wds shards with different sizes — one shared "wds" tag made each
+    # run invalidate the other's cache and regenerate every cycle
+    tag = "wds-" + os.path.basename(os.path.normpath(dirpath))
+    regen = _needs_regen(tag, nbytes)
     paths = []
     for s in range(n_shards):
         p = os.path.join(dirpath, f"shard-{s:04d}.tar")
@@ -290,7 +294,7 @@ def make_wds_shards(dirpath: str, nbytes: int, n_shards: int = 4,
                 ti = tarfile.TarInfo(f"{s:04d}{i:05d}.bin")
                 ti.size = item_bytes
                 tf.addfile(ti, _io.BytesIO(payload))
-    _mark_generated("wds", nbytes)
+    _mark_generated(tag, nbytes)
     return paths
 
 
@@ -1256,16 +1260,11 @@ def bench_serving(device=None) -> tuple[float, str]:
     return rate, tag
 
 
-def _train_variant(cfg, batch: int, seq: int, dev,
-                   profile_dir: str | None = None,
-                   attn: str = "dense") -> float:
-    """Aggregate model-FLOP/s of one (config, batch, attn) train-step
-    variant — _RUNS chained steps in ONE timed window bracketed by
-    data-dependent host transfers (not per-step medians: per-step
-    blocking is exactly what the axon runtime lies about); optionally
-    capture a 3-step jax profiler trace while at it.  ``attn``:
-    "dense" (XLA) or "flash" (the Pallas fused kernel — O(s) memory,
-    the long-context/occupancy lever)."""
+def _train_setup(cfg, batch: int, seq: int, dev, attn: str = "dense"):
+    """(params, opt_state, tokens, step, flops_step) shared by the
+    synthetic (config 7) and NVMe-fed (config 17) train rows — ONE
+    copy of the donated-step construction and the 6·T·P + attention
+    model-FLOP formula, so the two TFLOP/s rows cannot diverge."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -1288,6 +1287,32 @@ def _train_variant(cfg, batch: int, seq: int, dev,
                   + 12 * cfg.n_layers * batch * seq * seq * cfg.d_model)
     step = jax.jit(make_train_step(cfg, opt, attn_fn=attn_fn),
                    donate_argnums=(0, 1))
+    return params, opt_state, tokens, step, flops_step
+
+
+def _loss_sanity(vals: list) -> None:
+    """A real Adam trajectory moves the loss every step and keeps it
+    finite; anything else means the device did not actually run the
+    program (the tunneled runtime has returned garbage instead of
+    raising)."""
+    if not all(math.isfinite(v) for v in vals) or len(set(vals)) <= 1:
+        raise RuntimeError(f"loss sanity failed (runtime returned "
+                           f"garbage without raising): losses={vals[:6]}")
+
+
+def _train_variant(cfg, batch: int, seq: int, dev,
+                   profile_dir: str | None = None,
+                   attn: str = "dense") -> float:
+    """Aggregate model-FLOP/s of one (config, batch, attn) train-step
+    variant — _RUNS chained steps in ONE timed window bracketed by
+    data-dependent host transfers (not per-step medians: per-step
+    blocking is exactly what the axon runtime lies about); optionally
+    capture a 3-step jax profiler trace while at it.  ``attn``:
+    "dense" (XLA) or "flash" (the Pallas fused kernel — O(s) memory,
+    the long-context/occupancy lever)."""
+    import jax
+    params, opt_state, tokens, step, flops_step = _train_setup(
+        cfg, batch, seq, dev, attn=attn)
     if profile_dir:
         # the post-optimization HLO names the profiler's events: the
         # valid window-7 parses put ~70% of device time in bare
@@ -1327,14 +1352,7 @@ def _train_variant(cfg, batch: int, seq: int, dev,
     float(losses[-1])                 # forces the whole chain
     elapsed = time.monotonic() - t0
     rate = _RUNS * flops_step / elapsed
-    # execution sanity: a real Adam trajectory moves the loss every
-    # step and keeps it finite; anything else means the device did not
-    # actually run the program (the tunneled runtime has returned
-    # garbage instead of raising)
-    vals = [float(x) for x in jax.device_get(losses)]
-    if not all(math.isfinite(v) for v in vals) or len(set(vals)) <= 1:
-        raise RuntimeError(f"loss sanity failed (runtime returned "
-                           f"garbage without raising): losses={vals[:6]}")
+    _loss_sanity([float(x) for x in jax.device_get(losses)])
     if profile_dir:
         # the committed profile breakdown for the MFU story: 3 traced
         # steps, viewable in TensorBoard/xprof
@@ -1445,6 +1463,94 @@ def bench_opt_offload(engine) -> tuple[float, str]:
                   f"overhead={over:+.0%} vs in-HBM "
                   f"({t_hbm * 1e3:.0f}ms), hbm_peak={peak >> 20}MiB of "
                   f"{payload >> 20}MiB, groups={groups}{extra}")
+
+
+def bench_fed_train(engine, device=None) -> tuple[float, str]:
+    """Config 17: the reference's core identity as ONE number — train
+    while the NVMe pipeline feeds REAL token batches, paired in the
+    same run against the identical model chained on a device-resident
+    batch.  fed/synthetic ≈ 1.0 means storage never starves the MXU
+    (the SSD→accelerator direct path doing the job the reference's
+    SSD2GPU DMA does for PG-Strom's kernels, SURVEY.md §3.5, applied
+    to the training loop); the tag carries both rates, the ratio, and
+    the pipeline's byte demand so a sub-1.0 row names its own cause.
+
+    Tokens ride the zero-copy wds_raw path: each tar member is one
+    sample row of ``seq`` int32 tokens; bytes go staging→device
+    untouched and the int32 assembly + vocab clamp run on device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from nvme_strom_tpu.data.loader import ShardedLoader
+    cfg = _bench_cfg(train_override=True)
+    batch, seq = (2, 64) if _tiny_compute() else (8, 1024)
+    n_steps = 4 if _tiny_compute() else 16
+    dev = device or jax.devices()[0]
+    item = seq * 4
+    paths = make_wds_shards(os.path.join(_scratch_dir(), "fedtrain"),
+                            n_steps * batch * item, item_bytes=item)
+    params, opt_state, tokens0, step, flops_step = _train_setup(
+        cfg, batch, seq, dev)
+
+    @jax.jit
+    def decode_tokens(arr):
+        # (batch, seq*4) uint8 → (batch, seq) int32 tokens: assemble
+        # little-endian words on the VPU, clamp into the vocab — the
+        # raw member bytes ARE the training data, no host touch
+        b = arr.reshape(batch, seq, 4).astype(jnp.int32)
+        word = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16)
+        return word % cfg.vocab
+
+    params, opt_state, loss = step(params, opt_state, tokens0)  # compile
+    jax.block_until_ready((params, opt_state, loss))
+
+    # synthetic window — _train_variant's chained bracket discipline,
+    # loss-sanity-gated like every other train row (the axon runtime
+    # returns garbage without raising on some shapes)
+    float(loss)
+    losses = []
+    t0 = time.monotonic()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, tokens0)
+        losses.append(loss)
+    float(losses[-1])
+    t_syn = time.monotonic() - t0
+    _loss_sanity([float(x) for x in jax.device_get(losses)])
+    rate_syn = n_steps * flops_step / t_syn
+
+    mesh = Mesh(np.array([dev]).reshape(1), ("dp",))
+    with ShardedLoader(paths, mesh, global_batch=batch, fmt="wds_raw",
+                       engine=engine) as loader:
+        for arr in loader:        # warm: loader jit + decode compile
+            params, opt_state, loss = step(params, opt_state,
+                                           decode_tokens(arr))
+        for p in paths:
+            bench.evict_file(p)   # the timed epoch reads the NVMe
+        float(loss)
+        losses = []
+        t0 = time.monotonic()
+        for arr in loader:
+            params, opt_state, loss = step(params, opt_state,
+                                           decode_tokens(arr))
+            losses.append(loss)
+        float(losses[-1])
+        t_fed = time.monotonic() - t0
+    n = len(losses)
+    _loss_sanity([float(x) for x in jax.device_get(losses)])
+    rate_fed = n * flops_step / t_fed
+    ratio = rate_fed / rate_syn if rate_syn else float("nan")
+    demand = n * batch * item / (1 << 30) / t_fed
+    peak = _peak_flops(dev)
+    suspect = (" SUSPECT-TIMING (above device peak)"
+               if peak and max(rate_fed, rate_syn) > peak else "")
+    tag = (f"fed={rate_fed / 1e12:.2f} TFLOP/s over {n} NVMe-fed steps "
+           f"vs synthetic={rate_syn / 1e12:.2f} (same run) "
+           f"ratio={ratio:.3f}{suspect}; "
+           f"pipeline demand={demand:.4f} GiB/s "
+           f"d={cfg.d_model} b={batch} s={seq}")
+    _log(f"suite: fed-train {tag}")
+    return rate_fed / 1e12, tag
 
 
 def bench_train(device=None) -> tuple[float, str]:
@@ -1654,6 +1760,11 @@ def run(configs: list[int], emit=None) -> list[dict]:
             16: ("tar-index-rate",
                  lambda: bench_tar_index(engine, nbytes), "Mmembers/s",
                  False),
+            # compute row paired with its own same-run synthetic
+            # baseline (the ratio in the tag is the claim) — no
+            # read-ceiling ratio applies
+            17: ("fed-train-mfu",
+                 lambda: bench_fed_train(engine), "TFLOP/s", False),
         }
         # only configs whose _steady passes move payload ACROSS the
         # link get per-pass pairing: config 8's passes are pure engine
@@ -1724,12 +1835,12 @@ def run(configs: list[int], emit=None) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 17))
+                    choices=range(1, 18))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = list(range(1, 17))
+        configs = list(range(1, 18))
     run(configs, emit=lambda row: print(json.dumps(row), flush=True))
     return 0
 

@@ -371,6 +371,13 @@ def capture(device: str) -> bool:
           "STROM_TRAIN_CFG": CFG_D3072}),
         ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
          900, None),
+        # the reference's core identity as one number: train-step
+        # TFLOP/s while the NVMe wds_raw pipeline feeds real token
+        # batches, paired same-run against a device-resident batch —
+        # fed/synthetic ≈ 1.0 is the "storage never starves the MXU"
+        # claim measured end to end
+        ("suite_17", [sys.executable, "bench_suite.py", "--config", "17"],
+         1200, None),
         ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
          1200, None),
         # diagnostics last: b16:none is the OOM-boundary probe (its
