@@ -279,8 +279,15 @@ def capture(device: str) -> bool:
         # MFU story (verdict #3) after the contract I/O rows: d2048
         # re-trace for the fusion-resolved profile parse, then the
         # flash d-points
+        # two attention variants: kernel_probe's chained rows have
+        # flash 512x512 ~22% faster than dense on fwd+bwd at this
+        # shape (attention ≈ 14% of the step → ~1.3 MFU points), yet
+        # every d2048 row so far ran dense.  bench_train reports the
+        # best and carries both in the tag; dense stays LAST so the
+        # profile trace remains comparable with the v3/v4 parses.
         ("suite_7", [sys.executable, "bench_suite.py", "--config", "7"],
-         1500, {"STROM_PROFILE_DIR": prof_d2048}),
+         1500, {"STROM_TRAIN_SWEEP": "8:none:flash,8:none:dense",
+                "STROM_PROFILE_DIR": prof_d2048}),
         # the MFU lever sweep (verdict #3): batch amortizes weight
         # streaming, dots-remat fits the bigger batches.  ONE variant
         # per step — the combined 4-variant sweep burned its whole
