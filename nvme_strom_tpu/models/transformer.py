@@ -145,8 +145,16 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict:
 # ----------------------------- layers -----------------------------
 
 def rms_norm(x, weight, eps):
-    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+    # All norm math in f32, ONE downcast at the end.  The previous
+    # form multiplied the already-downcast activation by the f32
+    # weight, so jnp promotion returned an f32 tensor from every norm
+    # — and since every attention/mlp input is post-norm, EVERY matmul
+    # in the network lowered as f32×f32 (window-9 evidence: the
+    # StableHLO dots were all f32 despite cfg.dtype=bf16, and the big
+    # ff fusions capped at ~92 TFLOP/s while truly-dense ones hit 187).
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * weight).astype(x.dtype)
 
 
 def _llama3_scale_freqs(freqs, scaling: dict):

@@ -279,13 +279,19 @@ def capture(device: str) -> bool:
         # MFU story (verdict #3) after the contract I/O rows: d2048
         # re-trace for the fusion-resolved profile parse, then the
         # flash d-points
-        # two attention variants: kernel_probe's chained rows have
+        # "_bf16" generation (suite_7/6/10/11 labels retired): the
+        # session-4 rms_norm dtype fix — the old norm multiplied the
+        # downcast activation by the f32 weight, so EVERY matmul in
+        # the network lowered f32×f32 despite cfg.dtype=bf16 (the
+        # StableHLO dots proved it; the ff fusions capped at ~92
+        # TFLOP/s while truly-dense ones hit 187).  Every
+        # transformer-backed row measures a different program now.
+        # Two attention variants: kernel_probe's chained rows have
         # flash 512x512 ~22% faster than dense on fwd+bwd at this
-        # shape (attention ≈ 14% of the step → ~1.3 MFU points), yet
-        # every d2048 row so far ran dense.  bench_train reports the
-        # best and carries both in the tag; dense stays LAST so the
-        # profile trace remains comparable with the v3/v4 parses.
-        ("suite_7", [sys.executable, "bench_suite.py", "--config", "7"],
+        # shape, yet every d2048 row so far ran dense.  bench_train
+        # reports the best and carries both in the tag; dense stays
+        # LAST so the profile trace remains comparable.
+        ("suite_7_bf16", [sys.executable, "bench_suite.py", "--config", "7"],
          1500, {"STROM_TRAIN_SWEEP": "8:none:flash,8:none:dense",
                 "STROM_PROFILE_DIR": prof_d2048}),
         # the MFU lever sweep (verdict #3): batch amortizes weight
@@ -309,11 +315,11 @@ def capture(device: str) -> bool:
         # the remote-compile helper's HBM check (dense d3072 b8 carries
         # ~3.8 GiB of f32 score activations at remat=none), and
         # remat=none avoids the axon instant-garbage trigger
-        ("suite_7_d3072",
+        ("suite_7_d3072_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1500,
          {"STROM_TRAIN_SWEEP": "8:none:flash",
           "STROM_TRAIN_CFG": CFG_D3072}),
-        ("suite_7_d4096",
+        ("suite_7_d4096_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1500,
          {"STROM_TRAIN_SWEEP": "8:none:flash",
           "STROM_TRAIN_CFG": CFG_D4096,
@@ -334,7 +340,7 @@ def capture(device: str) -> bool:
         # back per pass and reports the median per-pass ratio)
         ("suite_12_v3",
          [sys.executable, "bench_suite.py", "--config", "12"], 900, None),
-        ("suite_11_prefix_v2",
+        ("suite_11_prefix_v3",
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
          {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
         ("suite_14_v2",
@@ -348,13 +354,13 @@ def capture(device: str) -> bool:
         # through the aligned O_DIRECT streaming writer, structureless)
         ("suite_9_v2",
          [sys.executable, "bench_suite.py", "--config", "9"], 900, None),
-        ("suite_10", [sys.executable, "bench_suite.py", "--config", "10"],
+        ("suite_10_bf16", [sys.executable, "bench_suite.py", "--config", "10"],
          1200, None),
         # Llama-vocab demonstration of the chunked cross-entropy: at
         # v=131072 the full-logits path's b8·s1024·v f32 logits are
         # ~4.3 GiB (+ their backward) — xc=8 scans the lm_head in
         # sequence slices so the row fits where full logits cannot
-        ("suite_7_bigvocab",
+        ("suite_7_bigvocab_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1500,
          {"STROM_TRAIN_SWEEP": "8:none",
           "STROM_TRAIN_CFG": "d=2048,L=4,ff=5632,heads=16,kv=8,"
@@ -366,16 +372,16 @@ def capture(device: str) -> bool:
         # spills at remat=none — so the dots points below cut live
         # activations instead (dots_diag exonerated remat=dots: 37.4%
         # valid; the earlier garbage correlation was shape-linked)
-        ("suite_7_b16_flash",
+        ("suite_7_b16_flash_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "16:none:flash"}),
-        ("suite_7_b32_flash",
+        ("suite_7_b32_flash_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "32:none:flash"}),
-        ("suite_7_b16_dots_flash",
+        ("suite_7_b16_dots_flash_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "16:dots:flash"}),
-        ("suite_7_d3072_b16df",
+        ("suite_7_d3072_b16df_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1500,
          {"STROM_TRAIN_SWEEP": "16:dots:flash",
           "STROM_TRAIN_CFG": CFG_D3072}),
@@ -388,15 +394,15 @@ def capture(device: str) -> bool:
         # claim measured end to end
         ("suite_17", [sys.executable, "bench_suite.py", "--config", "17"],
          1200, None),
-        ("suite_6", [sys.executable, "bench_suite.py", "--config", "6"],
+        ("suite_6_bf16", [sys.executable, "bench_suite.py", "--config", "6"],
          1200, None),
         # diagnostics last: b16:none is the OOM-boundary probe (its
         # remote-compile 500 is informative and cheap); dots_diag
         # isolates the instant-garbage trigger at the known-good shape
-        ("suite_7_b16",
+        ("suite_7_b16_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "16:none"}),
-        ("suite_7_dots_diag",
+        ("suite_7_dots_diag_bf16",
          [sys.executable, "bench_suite.py", "--config", "7"], 1200,
          {"STROM_TRAIN_SWEEP": "8:dots"}),
     ]
@@ -472,8 +478,8 @@ def capture(device: str) -> bool:
     # at 3 consumer attempts: a deterministically-failing parse must not
     # pin its producer in the fresh tier forever, starving tail steps.
     attempts = _attempt_counts()
-    for producer, consumer in (("suite_7", "profile_d2048_v5"),
-                               ("suite_7_d4096", "profile_d4096_v5")):
+    for producer, consumer in (("suite_7_bf16", "profile_d2048_v5"),
+                               ("suite_7_d4096_bf16", "profile_d4096_v5")):
         if consumer not in done and attempts.get(consumer, 0) < 3:
             done.discard(producer)
     steps = _coverage_order(steps, done,

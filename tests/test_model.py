@@ -71,8 +71,12 @@ def test_training_reduces_loss(cfg):
 
 
 def test_sharded_train_step_matches_single_device(cfg, mesh8):
-    """dp×tp sharded step must compute the same loss as unsharded."""
+    """dp×tp sharded step must compute the same loss as unsharded.
+    Probed at f32: the pin is sharded ≡ local, and the tp-split
+    contractions round apart under honest-bf16 activations."""
+    import dataclasses
     import optax
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
     params = init_params(jax.random.key(6), cfg)
     opt = optax.sgd(1e-2)
     tokens = jax.random.randint(jax.random.key(7), (4, 32), 0, cfg.vocab)
@@ -106,6 +110,11 @@ def test_weights_roundtrip_through_lazy_loader(cfg, mesh8, tmp_path):
     from nvme_strom_tpu.utils.config import EngineConfig
     from nvme_strom_tpu.utils.stats import StromStats
 
+    import dataclasses
+    # f32 probe: the pin is storage fidelity (bytes identical); the
+    # forward only witnesses it, and sharded-vs-local reduction orders
+    # round apart under honest-bf16 activations
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
     params = init_params(jax.random.key(8), cfg)
     path = tmp_path / "model.safetensors"
     save_checkpoint(path, params)
@@ -231,9 +240,14 @@ def test_grouped_default_matches_expanded_attention(cfg, params):
     attention path must be numerically identical to the explicit
     expand_gqa + dense_causal_attention path — the copy-elimination
     rewrite (2026-07-31 profile: 69% of device time in copies) is a
-    layout change, not a math change."""
+    layout change, not a math change.  Probed at f32: the pin is
+    path-A ≡ path-B, and bf16 rounds the two contraction orders
+    differently (the rms_norm dtype fix made activations HONESTLY
+    bf16 — they used to ride a hidden f32 promotion)."""
+    import dataclasses
     from nvme_strom_tpu.models.transformer import dense_causal_attention
     assert cfg.n_kv_heads != cfg.n_heads      # the fixture must be GQA
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
     tokens = jax.random.randint(jax.random.key(3), (2, 32), 0,
                                 cfg.vocab, dtype=jnp.int32)
     default_logits = forward(params, tokens, cfg)
@@ -258,6 +272,9 @@ def test_chunked_xent_matches_full_path(cfg):
     match the full-logits path (it's a memory layout, not new math)."""
     import dataclasses
     from nvme_strom_tpu.models.transformer import loss_fn as lf
+    # f32 probe: the pin is chunked ≡ full (same math, different
+    # slicing); honest-bf16 activations round the two orders apart
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
     params = init_params(jax.random.key(5), cfg)
     tokens = jax.random.randint(jax.random.key(6), (2, 32), 0,
                                 cfg.vocab, dtype=jnp.int32)
