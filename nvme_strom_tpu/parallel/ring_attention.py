@@ -47,7 +47,6 @@ def _ring_block(q, k, v, axis_name: str, n_sp: int, causal: bool,
     b, h, s_blk, d = q.shape
     idx = jax.lax.axis_index(axis_name)
     scale = 1.0 / np.sqrt(d)
-    q32 = q.astype(jnp.float32) * scale
     q_pos = idx * s_blk + jnp.arange(s_blk)
 
     m0 = jnp.full((b, h, s_blk), _NEG, jnp.float32)
@@ -64,7 +63,14 @@ def _ring_block(q, k, v, axis_name: str, n_sp: int, causal: bool,
     def body(t, carry):
         k_t, v_t, m, l, o = carry
         j = (idx - t) % n_sp
-        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_t.astype(jnp.float32))
+        # Matmul inputs stay in the activation dtype (bf16 on TPU → MXU)
+        # with f32 accumulation via preferred_element_type — the same
+        # precision pattern as dense_causal_attention.  Upcasting q/k to
+        # f32 here would lower the ring's dots as f32×f32 (the exact
+        # promotion bug the round-4 rms_norm fix killed elsewhere) and
+        # also diverge numerically from the dense reference.
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_t,
+                       preferred_element_type=jnp.float32) * scale
         if causal:
             kv_pos = j * s_blk + jnp.arange(s_blk)
             mask = kv_pos[None, :] <= q_pos[:, None]
@@ -75,8 +81,11 @@ def _ring_block(q, k, v, axis_name: str, n_sp: int, causal: bool,
             p = jnp.where(mask, p, 0.0)  # fully-masked rows: exactly zero
         correction = jnp.exp(m - m_new)
         l = l * correction + p.sum(-1)
+        # Probs downcast to the activation dtype before the PV matmul
+        # (dense does the same: softmax in f32, probs@V on the MXU).
         o = o * correction[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, v_t.astype(jnp.float32))
+            "bhqk,bhkd->bhqd", p.astype(v_t.dtype), v_t,
+            preferred_element_type=jnp.float32)
         # Rotate K/V to the next device (skippable on the last step, but a
         # uniform body keeps the loop fusible).
         k_t = jax.lax.ppermute(k_t, axis_name, perm)
