@@ -83,8 +83,13 @@ import bench  # noqa: E402  (shared helpers: probe_device, make_file, ...)
 
 _log = bench._log
 
-#: timed runs per I/O config AFTER the discarded jit-warmup run
+#: timed runs per I/O config AFTER the discarded jit-warmup run(s)
 _RUNS = 3
+#: discarded warmup calls at the head of every _steady loop — shared
+#: with consumers that record side data from inside timed_fn and must
+#: drop the same prefix (bench_sql's per-pass phase pairing); ONE
+#: constant, so the run structure and the slicing cannot drift apart
+_STEADY_WARMUPS = 1
 
 #: same-run raw-SSD and host->device link rates (GiB/s), set by run()
 #: before any config executes — the normalization base for rows whose
@@ -187,18 +192,20 @@ def _steady(evict_paths, timed_fn) -> float:
     land in ``_PASS_LINK["last"]`` — the flap-proof per-pass ceilings
     the result assembly ratios against (module header ¶3).
 
-    CONTRACT: exactly one discarded warmup call (run 0), then _RUNS
-    timed calls.  bench_sql's per-pass phase pairing records side data
-    from inside ``timed_fn`` and slices ``[1:]`` to drop the warmup —
-    if the run structure here ever changes, update that slicing too."""
+    CONTRACT: exactly _STEADY_WARMUPS discarded warmup call(s), then
+    _RUNS timed calls.  Consumers that record side data from inside
+    ``timed_fn`` (bench_sql's per-pass phase pairing) slice off the
+    same ``_STEADY_WARMUPS`` prefix — the shared constant is the
+    coupling, not a comment."""
     probe = _PASS_LINK["probe"]
     rates, pairs = [], []
-    for i in range(_RUNS + 1):
+    for i in range(_RUNS + _STEADY_WARMUPS):
         for p in evict_paths:
             bench.evict_file(p)
-        link = probe() if (probe is not None and i > 0) else 0.0
+        timed = i >= _STEADY_WARMUPS   # head runs warm jit/IPC caches
+        link = probe() if (probe is not None and timed) else 0.0
         r = timed_fn()
-        if i > 0:          # run 0 warms jit/IPC/placement caches
+        if timed:
             rates.append(r)
             if link > 0:
                 pairs.append((r, link))
@@ -526,11 +533,11 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
         return size / (1 << 30) / dt
 
     rate = _steady([path], one_scan)
-    # index 0 is _steady's warmup call — drop its pair like _steady does
+    # drop _steady's warmup-call prefix, same constant it runs by
     gib = size / (1 << 30)
-    stream_rate = statistics.median(gib / t for t in (stream_ts[1:]
-                                                      or stream_ts))
-    fold_s = statistics.median(fold_ts[1:] or fold_ts)
+    stream_rate = statistics.median(
+        gib / t for t in (stream_ts[_STEADY_WARMUPS:] or stream_ts))
+    fold_s = statistics.median(fold_ts[_STEADY_WARMUPS:] or fold_ts)
     tag = (f"rows={rows} plan={t_plan * 1e3:.0f}ms "
            f"stream={stream_rate:.3f} GiB/s "
            f"fold_overhead={fold_s:.3f}s paired=per-pass "

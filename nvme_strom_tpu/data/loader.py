@@ -858,15 +858,33 @@ class ShardedLoader:
             # needing more buffers than the pool deadlocks finish() —
             # the engine defers the excess reads and only this entry's
             # own transfers could free buffers.  Walk every batch's
-            # distinct device spans and take the max.
+            # distinct device spans and take the max — via ONE
+            # vectorized pass over recs (round-4 advisor: re-running
+            # the pure-Python span_groups walk per batch cost
+            # O(total records) of list-building at every epoch start):
+            # a record BREAKS a group when it changes shard or sits
+            # off-stride from its predecessor; a sub-range's groups are
+            # then its forced start plus the breaks inside it, and the
+            # piece count follows from consecutive-start diffs.
+            sis = np.fromiter((r[0] for r in recs), np.int64, len(recs))
+            offs = np.fromiter((r[1] for r in recs), np.int64, len(recs))
+            brk = np.ones(len(recs), bool)
+            brk[1:] = (sis[1:] != sis[:-1]) | (offs[1:] != offs[:-1]
+                                              + stride)
+
+            def range_pieces(a, b):
+                starts = np.flatnonzero(brk[a:b])
+                if starts.size == 0 or starts[0] != 0:
+                    starts = np.concatenate(([0], starts))
+                k = np.diff(np.append(starts, b - a))
+                return int(np.sum(-(-(k * stride) // chunk)))
+
             span_list = sorted({sp for sp in dev_spans.values()})
             batch_pieces = 1
             for b in range(n_batches):
                 b0 = b * self.local_batch
-                tot = sum(-(-(k * stride) // chunk)
-                          for g0, g1 in span_list
-                          for _, _, k in span_groups(b0 + (g0 - lo),
-                                                     b0 + (g1 - lo)))
+                tot = sum(range_pieces(b0 + (g0 - lo), b0 + (g1 - lo))
+                          for g0, g1 in span_list)
                 batch_pieces = max(batch_pieces, tot)
         else:
             batch_pieces = self.local_batch * -(-mlen // chunk)

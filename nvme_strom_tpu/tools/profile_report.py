@@ -36,6 +36,7 @@ import re
 import shutil
 import sys
 import tempfile
+from collections import ChainMap
 
 
 def _log(msg: str) -> None:
@@ -224,24 +225,49 @@ def _load_hlo_maps(trace_dir: str) -> tuple:
     with open(path) as f:
         lines = f.read().splitlines()
 
-    # pass 1 — module-wide name → dims (HLO instruction names are
-    # unique module-wide, and operands routinely reference names
-    # defined in OTHER computations, e.g. a fused conv consuming an
-    # ENTRY-level fusion's output)
+    # pass 1 — module-wide name → dims for INSTRUCTION names (those
+    # really are unique module-wide, and operands routinely reference
+    # names defined in OTHER computations, e.g. a fused conv consuming
+    # an ENTRY-level fusion's output).  Computation-header PARAMETER
+    # names (param_0, Arg_0.1) are NOT module-unique — every fused
+    # computation reuses them — so they are scoped per computation and
+    # consulted first, falling back to the module-wide map only for
+    # instruction names; a flat map here let a later computation's
+    # same-named param silently overwrite an earlier one and mis-size K
+    # for operands without inline shapes.
     defs: dict[str, list] = {}
+    comp_params: dict[str, dict] = {}
+    cur_hdr = None
     for line in lines:
         stripped = line.strip()
         if stripped.endswith("{"):          # computation header params
-            for name, dims in _HEADER_PARAM.findall(stripped):
-                defs[name] = [int(d) for d in dims.split(",") if d]
+            m = _HLO_COMP.match(stripped)
+            # keyed exactly as pass 2's ``cur`` (sigil kept) so the
+            # per-computation scope lookup matches
+            cur_hdr = m.group(1) if m else None
+            if cur_hdr is not None:
+                scope = comp_params.setdefault(cur_hdr, {})
+                for name, dims in _HEADER_PARAM.findall(stripped):
+                    scope[name] = [int(d) for d in dims.split(",") if d]
+            continue
+        if stripped.startswith("}"):
+            cur_hdr = None
             continue
         if "=" in stripped:
             name = stripped.removeprefix("ROOT ").split("=", 1)[0].strip()
             if name.startswith("%"):
                 sh = _SHAPE.search(stripped.split("=", 1)[1])
                 if sh:
-                    defs[name.lstrip("%")] = [int(d) for d in
-                                              sh.group(1).split(",") if d]
+                    dims = [int(d) for d in sh.group(1).split(",") if d]
+                    # parameter instructions (%p0 = ... parameter(N))
+                    # reuse names across computations just like header
+                    # params — scope them; everything else is a real
+                    # module-unique instruction name
+                    if "parameter(" in stripped and cur_hdr is not None:
+                        comp_params.setdefault(cur_hdr, {})[
+                            name.lstrip("%")] = dims
+                    else:
+                        defs[name.lstrip("%")] = dims
 
     # pass 2 — per-computation opcode sets and dot/conv FLOPs, plus
     # FLOPs of un-fused matmul instructions (profiler events under
@@ -267,7 +293,11 @@ def _load_hlo_maps(trace_dir: str) -> tuple:
         if cur is not None:
             comp_ops[cur].add(op.group(1))
         if op.group(1) in ("dot", "convolution"):
-            fl, desc = _matmul_info(line, op.group(1), defs)
+            # lookup order: this computation's own params, then
+            # module-wide instruction names
+            scope = (ChainMap(comp_params[cur], defs)
+                     if cur is not None and cur in comp_params else defs)
+            fl, desc = _matmul_info(line, op.group(1), scope)
             if not fl:
                 continue
             if cur is not None:

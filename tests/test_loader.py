@@ -544,10 +544,16 @@ def test_wds_raw_bounce_accounting(tmp_path, monkeypatch):
     # alias-protection copy (vanishes on an accelerator -> bounce 0,
     # the config-3 claim); the standard path's is the per-member
     # tobytes() handoff, which an accelerator still pays.  The span-
-    # coalesced read carries each member's 512 B tar header along
-    # (one strided put per batch instead of one per member), so its
-    # transfer counts stride = header + payload bytes per member.
-    stride = 512 + 8192
+    # coalesced read carries each member's tar header along (one
+    # strided put per batch instead of one per member), so its
+    # transfer counts stride = header + payload bytes per member —
+    # derived from the shard's own index (round-4 advisor: a literal
+    # 512+8192 would silently go stale if the helper's item size
+    # changed), as the gap between consecutive member data offsets.
+    from nvme_strom_tpu.io.engine import tar_index
+    members = tar_index(paths[0])
+    stride = members[1][1] - members[0][1]
+    assert stride >= 8192 + 512               # payload + >=1 header blk
     assert raw_bounce == 8 * stride
     assert std_bounce == payload
 

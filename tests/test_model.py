@@ -267,6 +267,37 @@ def test_grouped_default_matches_expanded_attention(cfg, params):
                                    rtol=2e-3, atol=2e-4, err_msg=k)
 
 
+def test_grouped_vs_expanded_bf16_within_noise_floor(cfg, params):
+    """bf16 regression guard (round-4 advisor: every equivalence test
+    moved to f32 after the rms_norm dtype fix, leaving bf16 numerics
+    unexercised).  The two attention paths cannot be bitwise equal in
+    bf16 — they round different contraction orders — but both are
+    round-offs of the same f32 math, so their distance must stay
+    within a small multiple of the bf16 quantization floor measured
+    ON THIS model/input (|default_bf16 − default_f32|).  A real bf16
+    regression (flash/dense drift, a stray promotion re-widening a
+    matmul) blows past that by orders of magnitude."""
+    import dataclasses
+    from nvme_strom_tpu.models.transformer import dense_causal_attention
+    assert cfg.dtype == jnp.bfloat16          # the fixture default
+    tokens = jax.random.randint(jax.random.key(3), (2, 32), 0,
+                                cfg.vocab, dtype=jnp.int32)
+    default = np.asarray(forward(params, tokens, cfg), np.float32)
+    explicit = np.asarray(forward(params, tokens, cfg,
+                                  attn_fn=dense_causal_attention),
+                          np.float32)
+    ref32 = np.asarray(forward(
+        params, tokens, dataclasses.replace(cfg, dtype=jnp.float32)))
+    floor = np.abs(default - ref32).max()
+    assert floor > 0                          # bf16 path really is bf16
+    # explicit is its own valid bf16 rounding of the same math: within
+    # 2x the floor of the f32 truth; the pairwise bound then follows by
+    # triangle inequality (<= floor + 2x floor), so the two asserts can
+    # never contradict each other across backends
+    assert np.abs(explicit - ref32).max() <= 2.0 * floor
+    assert np.abs(default - explicit).max() <= 3.0 * floor
+
+
 def test_chunked_xent_matches_full_path(cfg):
     """cfg.xent_chunks slices the lm_head+softmax; loss AND grads must
     match the full-logits path (it's a memory layout, not new math)."""

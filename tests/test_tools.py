@@ -250,6 +250,41 @@ def test_profile_matmul_flops_batched_conv_and_malformed():
     assert profile_report._matmul_flops("%dot.8 = garbage", "dot", {}) == 0
 
 
+def test_profile_hlo_param_names_scoped_per_computation(tmp_path):
+    """Computation-header/parameter names (p0, param_0) repeat across
+    fused computations; a module-wide defs map let a LATER computation's
+    same-named param overwrite an earlier one and mis-size K for
+    operands without inline shapes (round-4 advisor).  Here two fusions
+    both name their param %p0 with different K dims — each dot must be
+    sized by ITS OWN computation's p0."""
+    hlo = """HloModule jit_scoped
+
+%fused_computation.1 (p0: bf16[8,64]) -> bf16[8,32] {
+  %p0 = bf16[8,64]{1,0} parameter(0)
+  %w1 = bf16[64,32]{1,0} parameter(1)
+  ROOT %dot.1 = bf16[8,32]{1,0} dot(%p0, %w1), lhs_contracting_dims={1}
+}
+
+%fused_computation.2 (p0: bf16[8,4096]) -> bf16[8,32] {
+  %p0 = bf16[8,4096]{1,0} parameter(0)
+  %w2 = bf16[4096,32]{1,0} parameter(1)
+  ROOT %dot.2 = bf16[8,32]{1,0} dot(%p0, %w2), lhs_contracting_dims={1}
+}
+
+ENTRY %main.9 (a: bf16[8,64], b: bf16[8,4096]) -> bf16[8,32] {
+  %fusion.1 = bf16[8,32]{1,0} fusion(%a), kind=kOutput, calls=%fused_computation.1
+  ROOT %fusion.2 = bf16[8,32]{1,0} fusion(%b), kind=kOutput, calls=%fused_computation.2
+}
+"""
+    (tmp_path / "optimized_hlo.txt").write_text(hlo)
+    from nvme_strom_tpu.tools import profile_report
+    flops = profile_report.load_fusion_flops(str(tmp_path))
+    # fusion.1's dot contracts K=64, fusion.2's K=4096 — the flat-map
+    # bug sized BOTH by the last-seen p0 (K=4096)
+    assert flops["fusion.1"] == 2 * (8 * 32) * 64
+    assert flops["fusion.2"] == 2 * (8 * 32) * 4096
+
+
 def test_profile_report_capture_and_parse(capsys, monkeypatch):
     """End-to-end on the CPU backend: trace a tiny train variant, parse
     the xplane protobuf, and emit the one-line breakdown the watcher
