@@ -779,6 +779,18 @@ class ShardedLoader:
                 last[si] = off
             uniform = all(off + stride <= os.path.getsize(order[si])
                           for si, off in last.items())
+        if uniform:
+            # ONE encoding of the grouping rule, shared by the read
+            # planner (span_groups) and the pool-fit piece count
+            # (range_pieces below): record r continues a group iff it
+            # stays in the same shard at exactly one stride past its
+            # predecessor.  brk[r] marks the group STARTS.
+            sis = np.fromiter((r[0] for r in recs), np.int64, len(recs))
+            offs = np.fromiter((r[1] for r in recs), np.int64,
+                               len(recs))
+            brk = np.ones(len(recs), bool)
+            brk[1:] = (sis[1:] != sis[:-1]) | (offs[1:] != offs[:-1]
+                                               + stride)
 
         class _Span(list):
             """PendingReads of one strided span + its member count
@@ -787,13 +799,14 @@ class ShardedLoader:
             __slots__ = ("k",)
 
         def span_groups(r0, r1):
-            """Runs of stride-consecutive records in one shard —
-            shared by the read planner and the exact pool-fit count."""
+            """Runs of stride-consecutive records in one shard, read
+            straight off the shared ``brk`` array — the read planner
+            and the pool-fit count (range_pieces) consume the SAME
+            group boundaries by construction."""
             groups = []
             for r in range(r0, r1):
                 si, off, _ = recs[r]
-                if (groups and groups[-1][0] == si
-                        and off == groups[-1][1] + groups[-1][2] * stride):
+                if groups and not brk[r]:
                     groups[-1][2] += 1
                 else:
                     groups.append([si, off, 1])
@@ -858,20 +871,13 @@ class ShardedLoader:
             # needing more buffers than the pool deadlocks finish() —
             # the engine defers the excess reads and only this entry's
             # own transfers could free buffers.  Walk every batch's
-            # distinct device spans and take the max — via ONE
-            # vectorized pass over recs (round-4 advisor: re-running
-            # the pure-Python span_groups walk per batch cost
-            # O(total records) of list-building at every epoch start):
-            # a record BREAKS a group when it changes shard or sits
-            # off-stride from its predecessor; a sub-range's groups are
-            # then its forced start plus the breaks inside it, and the
-            # piece count follows from consecutive-start diffs.
-            sis = np.fromiter((r[0] for r in recs), np.int64, len(recs))
-            offs = np.fromiter((r[1] for r in recs), np.int64, len(recs))
-            brk = np.ones(len(recs), bool)
-            brk[1:] = (sis[1:] != sis[:-1]) | (offs[1:] != offs[:-1]
-                                              + stride)
-
+            # distinct device spans and take the max — via the shared
+            # ``brk`` array (round-4 advisor: re-running the
+            # pure-Python span_groups walk per batch cost O(total
+            # records) of list-building at every epoch start): a
+            # sub-range's groups are its forced start plus the breaks
+            # inside it, and the piece count follows from
+            # consecutive-start diffs.
             def range_pieces(a, b):
                 starts = np.flatnonzero(brk[a:b])
                 if starts.size == 0 or starts[0] != 0:

@@ -248,6 +248,9 @@ class DecodeServer:
         self.seed = jnp.zeros((max_batch,), jnp.uint32)
         self.slots: List[Optional[_Request]] = [None] * max_batch
         self.queue: List[_Request] = []
+        #: (slot, device scalar) first tokens whose host copy is
+        #: deferred to the next batch readback — admission never syncs
+        self._pending_first: List[tuple] = []
         #: cumulative phase timers (the serving-gap attribution the
         #: round-3 verdict asked for): admission+prefill, device
         #: dispatch, and the host readback syncs
@@ -314,7 +317,7 @@ class DecodeServer:
             jnp.asarray(slot, jnp.int32), self.k_cache, self.v_cache,
             cache["k"], cache["v"])
         first = self._first_token(logits, req, s)
-        req.out.append(first)
+        self._pending_first.append((slot, first))
         self.slots[slot] = req
         self._set_slot_params(slot, req)
         # pos[slot] = s - nothing decoded past the prompt yet; tok is
@@ -322,15 +325,20 @@ class DecodeServer:
         self.pos = self.pos.at[slot].set(s)
         self.tok = self.tok.at[slot].set(first)
 
-    def _first_token(self, logits, req: _Request, s: int) -> int:
+    def _first_token(self, logits, req: _Request, s: int):
         """The prefill's next token under the request's own sampling
         params (same sampler, 1-row view; position s-1 folds in so the
-        first draw differs from the next step's)."""
-        return int(_sample_slots(
+        first draw differs from the next step's).
+
+        Returns the DEVICE scalar — admission must never read back
+        (the round-4 on-silicon row spent 20.6 of 27 s in admit because
+        every ``_admit`` blocked on this value crossing the link); the
+        host copy rides ``step_many``'s single batch readback."""
+        return _sample_slots(
             logits, jnp.asarray([req.temperature], jnp.float32),
             jnp.asarray([req.top_p], jnp.float32),
             jnp.asarray([req.seed], jnp.uint32),
-            jnp.asarray([s - 1], jnp.int32))[0])
+            jnp.asarray([s - 1], jnp.int32))[0]
 
     def _set_slot_params(self, slot: int, req: _Request) -> None:
         self.temp = self.temp.at[slot].set(req.temperature)
@@ -408,20 +416,25 @@ class DecodeServer:
         for slot in range(self.B):
             if (self.slots[slot] is None and self.queue
                     and self._can_admit(self.queue[0])):
+                # dispatch-only: the first token stays on device (in
+                # _pending_first) and retirement is decided after the
+                # batch readback below — admission pipelines with the
+                # decode dispatches instead of paying a link round
+                # trip per request
                 self._admit(slot, self.queue.pop(0))
-                # a request can complete at admission (max_new == 1 or
-                # instant eos)
-                ret = self._retire_or_keep(slot)
-                if ret:
-                    finished[ret[0]] = ret[1]
         self.timings["admit_s"] += time.monotonic() - t0
         active_slots = [i for i, r in enumerate(self.slots)
                         if r is not None]
         if not active_slots:
             return finished
         # steps each slot may still take: positions must never pass the
-        # s + max_new rows/blocks _admit reserved
-        left = {b: self.slots[b].max_new - len(self.slots[b].out)
+        # s + max_new rows/blocks _admit reserved.  A deferred first
+        # token counts against max_new; a first-token EOS decodes
+        # surplus sub-steps (safe — discarded at replay, writes stay in
+        # the slot's own reservation, same invariant as mid-batch EOS).
+        pending_slots = {s for s, _ in self._pending_first}
+        left = {b: (self.slots[b].max_new - len(self.slots[b].out)
+                    - (1 if b in pending_slots else 0))
                 for b in active_slots}
         k_eff = max(1, min(k_steps, max(left.values())))
         toks: List = []
@@ -444,10 +457,20 @@ class DecodeServer:
             stepped.append(stepping)
         self.timings["dispatch_s"] += time.monotonic() - t0
         t0 = time.monotonic()
-        tok_h = jax.device_get(jnp.stack(toks))     # the ONE readback
+        pending, self._pending_first = self._pending_first, []
+        first_h, tok_h = jax.device_get((     # the ONE readback
+            [v for _, v in pending],
+            jnp.stack(toks) if toks else None))
         self.timings["readback_s"] += time.monotonic() - t0
         self.timings["steps"] += len(toks)
         self.timings["readbacks"] += 1
+        # replay in generation order: deferred first tokens precede
+        # this batch's sub-step tokens for their slots
+        for (slot, _), v in zip(pending, first_h):
+            self.slots[slot].out.append(int(v))
+            ret = self._retire_or_keep(slot)
+            if ret:
+                finished[ret[0]] = ret[1]
         for j, stepping in enumerate(stepped):
             for slot in stepping:
                 if self.slots[slot] is None:
@@ -687,7 +710,7 @@ class PagedDecodeServer(DecodeServer):
         for i in range(c, len(keys)):
             self._pc_register(keys[i], blks[i])
         first = self._first_token(logits, req, s)
-        req.out.append(first)
+        self._pending_first.append((slot, first))
         self.slots[slot] = req
         self._set_slot_params(slot, req)
         self.pos = self.pos.at[slot].set(s)

@@ -343,7 +343,12 @@ def capture(device: str) -> bool:
         ("suite_11_prefix_v3",
          [sys.executable, "bench_suite.py", "--config", "11"], 1200,
          {"STROM_SERVE_PAGED": "1", "STROM_SERVE_SHARED_PREFIX": "512"}),
-        ("suite_14_v2",
+        # "_v3" (v2 retired after its window-9 row — link-normalized
+        # frame, residual named "dispatch/sync" at 31x the link floor):
+        # v3 measures the one-group-deep write pipeline (async D2H via
+        # copy_to_host_async + NVMe writes deferred one group) that
+        # removes the per-group device sync the v2 tag indicted.
+        ("suite_14_v3",
          [sys.executable, "bench_suite.py", "--config", "14"], 900, None),
         # remaining BASELINE-contract I/O rows (round-2 manual numbers
         # only) and the capability demonstrations

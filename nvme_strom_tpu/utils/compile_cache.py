@@ -32,6 +32,26 @@ _DEFAULT_DIR = os.path.abspath(
 _explicit_path: str | None = None
 
 
+def _host_fingerprint() -> str:
+    """Short stable hash of this host's CPU identity (machine arch +
+    /proc/cpuinfo flags) — the partition key that keeps XLA:CPU AOT
+    executables from ever being loaded on a machine with different ISA
+    features than the one that compiled them."""
+    import hashlib
+    import platform as _platform
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = line
+                    break
+    except OSError:
+        pass
+    raw = f"{_platform.machine()}|{feats}".encode()
+    return "host-" + hashlib.sha1(raw).hexdigest()[:12]
+
+
 def enable_compile_cache(path: str | None = None) -> str | None:
     """Turn on JAX's disk compilation cache (idempotent).  Returns the
     cache directory (``<base>/<platform>``), or None when disabled via
@@ -53,6 +73,19 @@ def enable_compile_cache(path: str | None = None) -> str | None:
     plat = (getattr(jax.config, "jax_platforms", None)
             or os.environ.get("JAX_PLATFORMS") or "default")
     d = os.path.join(base, plat)
+    # ...and partition the pure-CPU subtree by a host-feature
+    # fingerprint: platform selection alone still shares one "cpu"
+    # tree across MACHINES (builder box, driver box, the remote
+    # helper's server), and round-4's MULTICHIP artifact carried a
+    # cpu_aot_loader feature-mismatch tail ("could lead to SIGILL")
+    # from exactly that — an AOT executable compiled where
+    # +avx10.1/+amx-fp16 exist, loaded where they don't.  One cache
+    # miss per distinct machine buys artifacts that can never list
+    # foreign ISA features.  Mixed selections ("axon,cpu") are NOT
+    # split: their artifacts are device executables whose reuse across
+    # hosts is exactly what saves the 20-40 s tunnel compiles.
+    if plat == "cpu":
+        d = os.path.join(d, _host_fingerprint())
     os.makedirs(d, exist_ok=True)
     prev = jax.config.jax_compilation_cache_dir
     jax.config.update("jax_compilation_cache_dir", d)
