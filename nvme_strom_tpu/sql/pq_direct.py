@@ -951,6 +951,105 @@ def _check_index_range(idx, dict_count: int) -> None:
                 f"[0, {dict_count})")
 
 
+def _raw_dict_only(plans: Sequence[ColumnPlan]) -> bool:
+    """Every row group a raw (uncompressed, null-free) dictionary-
+    encoded chunk with a raw PLAIN dictionary page — the shape the
+    whole-column batched path handles."""
+    return all(
+        plan.parts and plan.dict_span is not None
+        and plan.dict_codec is None
+        and all(p.kind == "dict" and p.is_raw for p in plan.parts)
+        for plan in plans)
+
+
+@functools.lru_cache(maxsize=1)
+def _dict_combine_fn():
+    """Jitted whole-column dict materialization: (concatenated dicts,
+    concatenated per-chunk indices, per-chunk dict bases/sizes,
+    per-chunk row counts) → (values, any-index-out-of-range).
+
+    ONE program per (shape set): the per-chunk dictionary-base offset
+    and the validity bound broadcast to rows via ``jnp.repeat`` with a
+    static total, the gather reads the big dictionary once, and the
+    range check collapses to a single boolean — so the whole column
+    costs one decode + one combine + ONE host sync, independent of row
+    group count."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def combine(big_dict, idx, bases, counts, rows_per_chunk):
+        n = idx.shape[0]
+        off = jnp.repeat(bases, rows_per_chunk, total_repeat_length=n)
+        cnt = jnp.repeat(counts, rows_per_chunk, total_repeat_length=n)
+        bad = ((idx < 0) | (idx >= cnt)).any()
+        return jnp.take(big_dict, idx + off), bad
+
+    return combine
+
+
+def _read_dict_column_batched(scanner, ds, fh,
+                              plans: Sequence[ColumnPlan], dev):
+    """ALL row groups of a raw dictionary-encoded column as one device
+    program set.  When the batched device decode declines, the SAME
+    already-read buffers host-expand (counted as bounce, read once)
+    and feed the identical combine — the per-chunk `_assemble_chunk`
+    walk remains only as the caller's safety net.
+
+    The per-chunk path costs, PER ROW GROUP: a dictionary put, a
+    3-op batched index decode, a gather, and a BLOCKING min/max
+    range-check sync — the window-9 suite_13 row spent 179 s mostly in
+    those per-row-group dispatches on a ~20 ms/dispatch tunnel (the
+    same dispatch-window disease config 5's ``sql_window_bytes`` lever
+    fixed for the groupby scan).  Here the whole column is: one
+    pipelined stream of every chunk's dictionary page (device concat),
+    ONE batched RLE/bit-packed decode across every chunk's index runs,
+    and one jitted combine that adds each chunk's dictionary base
+    offset, range-checks, and gathers — one sync per COLUMN, not per
+    row group."""
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.ops.bitunpack import rle_hybrid_batch_to_device
+
+    eng = scanner.engine
+    raw_parts = []
+    rows_per_chunk = []
+    for plan in plans:
+        raw_parts.extend(
+            (_read_span_bytes(eng, fh, *p.span), p.bit_width,
+             p.valid_count) for p in plan.parts)
+        rows_per_chunk.append(sum(p.valid_count for p in plan.parts))
+    idx = rle_hybrid_batch_to_device(raw_parts, dev, engine=eng)
+    if idx is None:
+        # decode declined (bit_width > 24, segment budget, int32
+        # bit-offset cap): host-expand the SAME buffers — each span is
+        # read once (returning None here would make the per-chunk
+        # fallback re-read every index stream and double the bounce
+        # claim suite_13 exists to verify); the combine below is
+        # identical either way
+        host = [decode_rle_hybrid(b, bw, c) for b, bw, c in raw_parts]
+        idx = _put_control(
+            eng, host[0] if len(host) == 1 else np.concatenate(host),
+            dev)
+    # every chunk's dictionary values in one pipelined stream (device
+    # concat inside _stream_spans); per-chunk bases index into it
+    big_dict = _stream_spans(scanner, ds, fh,
+                             [plan.dict_span for plan in plans],
+                             plans[0].physical_type)
+    counts = np.fromiter((plan.dict_count for plan in plans), np.int64)
+    bases = np.zeros_like(counts)
+    np.cumsum(counts[:-1], out=bases[1:])
+    vals, bad = _dict_combine_fn()(
+        big_dict, idx, jnp.asarray(bases, jnp.int32),
+        jnp.asarray(counts, jnp.int32),
+        jnp.asarray(np.asarray(rows_per_chunk, np.int64), jnp.int32))
+    if bool(bad):              # the column's ONE host sync
+        raise ValueError(
+            f"dictionary index out of range (column of "
+            f"{len(plans)} row groups)")
+    return vals
+
+
 def _assemble_chunk(scanner, ds, fh, plan: ColumnPlan, dev):
     """One column chunk → (device array, device mask | None), pages
     assembled in order.
@@ -1317,9 +1416,19 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
                     (s for p in plans[c] for s in p.spans),
                     plans[c][0].physical_type)
             else:
-                chunks = [_assemble_chunk(scanner, ds, fh, plan, dev)
-                          for plan in plans[c]]
-                out[c] = _join_chunks(chunks, nulls, c)
+                v = None
+                if nulls == "forbid" and _raw_dict_only(plans[c]):
+                    # whole-column batched dict path: one decode + one
+                    # combine + one sync for ALL row groups (None =
+                    # decode declined → per-chunk walk below)
+                    v = _read_dict_column_batched(scanner, ds, fh,
+                                                  plans[c], dev)
+                if v is None:
+                    chunks = [_assemble_chunk(scanner, ds, fh, plan,
+                                              dev)
+                              for plan in plans[c]]
+                    v = _join_chunks(chunks, nulls, c)
+                out[c] = v
     finally:
         scanner.engine.close(fh)
     return out

@@ -256,7 +256,13 @@ def capture(device: str) -> bool:
         # whose dozens of remote compiles needed 1800s are gone, and
         # their cached executables wouldn't serve the new program
         # anyway
-        ("suite_13_v2",
+        # "_v3" (v2 retired after its window-9 row — 8x step-time win
+        # from the batched RLE decode, but still per-ROW-GROUP
+        # dispatches + a blocking range-check sync per chunk at
+        # 0.0049 GiB/s): v3 measures the whole-column batched dict
+        # path (one decode + one combine + ONE sync for all row
+        # groups) and carries the new ×pyarrow bar (per-pass paired).
+        ("suite_13_v3",
          [sys.executable, "bench_suite.py", "--config", "13"], 900, None),
         ("suite_15_v3",
          [sys.executable, "bench_suite.py", "--config", "15"], 900, None),
