@@ -403,6 +403,25 @@ def test_dict_whole_column_batched_path(tmp_path, engine, monkeypatch):
                         lambda *a, **kw: None)
     out2 = sc.read_columns_to_device(["v"], direct="always")
     np.testing.assert_array_equal(np.asarray(out2["v"]), vals)
+    monkeypatch.undo()
+
+    # whole-batch decline → per-CHUNK retry on the SAME buffers (fresh
+    # segment budget per chunk, device decode per chunk, no re-read)
+    from nvme_strom_tpu.ops import bitunpack
+    calls = {"n": 0}
+    real_batch = bitunpack.rle_hybrid_batch_to_device
+
+    def decline_first(parts, dev, engine=None):
+        calls["n"] += 1
+        if calls["n"] == 1:        # the whole-column attempt
+            return None
+        return real_batch(parts, dev, engine=engine)
+
+    monkeypatch.setattr(bitunpack, "rle_hybrid_batch_to_device",
+                        decline_first)
+    out3 = sc.read_columns_to_device(["v"], direct="always")
+    np.testing.assert_array_equal(np.asarray(out3["v"]), vals)
+    assert calls["n"] == 1 + len(plans["v"])   # one retry per chunk
 
 
 def test_dict_single_entry_bit_width_zero(tmp_path, engine):

@@ -47,6 +47,38 @@ def test_mixed_lengths_match_solo(setup):
         assert got[rid] == _solo(params, cfg, p, m), rid
 
 
+def test_max_new_one_and_first_token_eos(setup):
+    """Admission-time completion under the DEFERRED first-token
+    readback: a max_new=1 request and a request whose FIRST token is
+    eos both retire at the batch readback (never having decoded a
+    counted surplus token into their output), their slots recycle, and
+    every result still matches solo.  This is the edge the round-5
+    dispatch-only admission moved: retirement used to happen inside
+    _admit, synchronously."""
+    cfg, params = setup
+    rng = np.random.default_rng(7)
+    p1 = rng.integers(0, cfg.vocab, 6).tolist()
+    # find a prompt whose first generated token can serve as eos
+    first = _solo(params, cfg, p1, 1)[0]
+
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64)
+    srv.submit("one", p1, 1)                      # max_new == 1
+    srv.submit("eos", p1, 10, eos_id=first)       # instant eos
+    p3 = rng.integers(0, cfg.vocab, 4).tolist()
+    srv.submit("tail", p3, 5)                     # queued behind both
+    got = srv.run()
+    assert got["one"] == [first]
+    assert got["eos"] == [first]                  # stopped AT the eos
+    assert got["tail"] == _solo(params, cfg, p3, 5)
+    assert srv.idle
+    # lookahead > 1 (surplus sub-steps decode past the retired slots)
+    srv2 = DecodeServer(params, cfg, max_batch=2, max_len=64)
+    srv2.submit("one", p1, 1)
+    srv2.submit("eos", p1, 10, eos_id=first)
+    got2 = srv2.run(lookahead=8)
+    assert got2 == {"one": [first], "eos": [first]}
+
+
 def test_slot_recycling_and_staggered_admission(setup):
     """More requests than slots: later requests admit into recycled
     slots mid-flight and still match their solo runs."""
