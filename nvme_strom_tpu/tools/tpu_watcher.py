@@ -191,13 +191,14 @@ def capture(device: str) -> bool:
     # One subprocess per config: a mid-window tunnel death (or one slow
     # compile) loses that step alone — round-3 lesson: a combined
     # 5+6+7 suite step burned its whole 2400s timeout and landed
-    # nothing.  Ordered by evidence value per minute: the headline
-    # stream bench, the stream-efficiency probe (verdict task #2), then
-    # compute rows (decode, MFU), then SQL scans.
-    # Round-5 ordering: the verdict's #1 (bf16 MFU + the matmul roof)
-    # and the two named-contract gaps (config 3, config 17) go FIRST —
-    # past windows died mid-schedule, and a short window must land the
-    # round's priority evidence, not re-measures of already-MET rows.
+    # nothing.
+    # Round-5 ordering (evidence value per minute, re-ranked by the
+    # round-4 verdict): the headline stream bench, then the verdict's
+    # #1 (bf16 MFU + the matmul roof) and the two named-contract gaps
+    # (config 3, config 17) — past windows died mid-schedule, and a
+    # short window must land the round's priority evidence, not
+    # re-measures of already-MET rows.  stream_probe is demoted to the
+    # tail: its operating points are ledgered and tuned.
     steps = [
         ("bench", [sys.executable, "bench.py"], 900, None),
         # BASELINE.md's contract is configs 1–5; the round-3 verdict
