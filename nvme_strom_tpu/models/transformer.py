@@ -267,6 +267,7 @@ def dense_causal_attention(q, k, v):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+@jax.custom_vjp
 def dense_causal_attention_grouped(q, k, v):
     """The same computation with q/k/v in PROJECTION layout (b, s, h, d)
     and k/v at KV-HEAD width — the default single-chip train path.
@@ -281,8 +282,24 @@ def dense_causal_attention_grouped(q, k, v):
       absorbs the layout (non-contracting dims are free to permute),
       where the explicit transposes materialized q/k/v copies.
 
+    Custom VJP (round-5): autodiff's backward kept the f32 scores
+    cotangent from ``preferred_element_type`` and promoted k/q, so the
+    dq/dk dots lowered f32×f32 — the last non-bf16 matmuls in the
+    train step (StableHLO dot census: 4 of 57).  The explicit backward
+    runs the softmax VJP in f32 and downcasts dS to the activation
+    dtype before the dq/dk matmuls — exactly what flash-attention
+    backward kernels do — so EVERY dot in the step is now
+    bf16×bf16→f32.  At f32 activations the downcast is a no-op and
+    gradients match autodiff to rounding (pinned by
+    tests/test_model.py).
+
     Numerically identical to the expanded path (pinned by
     tests/test_model.py)."""
+    out, _ = _grouped_attn_fwd(q, k, v)
+    return out
+
+
+def _grouped_attn_probs(q, k):
     b, s, nh, hd = q.shape
     nkv = k.shape[2]
     g = nh // nkv
@@ -292,9 +309,43 @@ def dense_causal_attention_grouped(q, k, v):
     scores = scores / np.sqrt(hd)
     mask = jnp.tril(jnp.ones((s, s), bool))
     scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jax.nn.softmax(scores, axis=-1)       # f32 (b,n,g,s,t)
+
+
+def _grouped_attn_fwd(q, k, v):
+    b, s, nh, hd = q.shape
+    probs32 = _grouped_attn_probs(q, k)
+    probs = probs32.astype(q.dtype)
     out = jnp.einsum("bngst,btnd->bsngd", probs, v)
-    return out.reshape(b, s, nh * hd)
+    return out.reshape(b, s, nh * hd), (q, k, v, probs32)
+
+
+def _grouped_attn_bwd(res, g_out):
+    q, k, v, probs32 = res
+    b, s, nh, hd = q.shape
+    nkv = k.shape[2]
+    gr = nh // nkv
+    go = g_out.reshape(b, s, nkv, gr, hd)
+    probs = probs32.astype(q.dtype)
+    dv = jnp.einsum("bngst,bsngd->btnd", probs, go,
+                    preferred_element_type=jnp.float32).astype(v.dtype)
+    dprobs = jnp.einsum("bsngd,btnd->bngst", go, v,
+                        preferred_element_type=jnp.float32)
+    # softmax VJP in f32; masked entries have probs32 == 0 exactly, so
+    # no gradient leaks through the causal mask
+    ds32 = probs32 * (dprobs
+                      - jnp.sum(dprobs * probs32, -1, keepdims=True))
+    ds = (ds32 / np.sqrt(hd)).astype(q.dtype)    # the precision gate
+    qg = q.reshape(b, s, nkv, gr, hd)
+    dqg = jnp.einsum("bngst,btnd->bsngd", ds, k,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    dk = jnp.einsum("bngst,bsngd->btnd", ds, qg,
+                    preferred_element_type=jnp.float32).astype(k.dtype)
+    return dqg.reshape(b, s, nh, hd), dk, dv
+
+
+dense_causal_attention_grouped.defvjp(_grouped_attn_fwd,
+                                      _grouped_attn_bwd)
 
 
 def qkv_project(x, p, prefix, cfg: TransformerConfig, positions=None):

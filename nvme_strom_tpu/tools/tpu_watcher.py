@@ -288,6 +288,16 @@ def capture(device: str) -> bool:
         ("suite_5_w256",
          [sys.executable, "bench_suite.py", "--config", "5"], 900,
          {"STROM_SQL_WINDOW_BYTES": str(256 << 20)}),
+        # round-5 CPU bisect preview: scatter's fold was 6.3x faster
+        # than the matmul one-hot (1.65 s vs 12.8 s at w64) and w256
+        # made matmul WORSE (36.8 s — the one-hot's memory traffic
+        # scales with window rows) — if silicon agrees, the winner is
+        # likely scatter × few-dispatch windows; this combo row
+        # decides in one step
+        ("suite_5_sw256",
+         [sys.executable, "bench_suite.py", "--config", "5"], 900,
+         {"STROM_SQL_METHOD": "scatter",
+          "STROM_SQL_WINDOW_BYTES": str(256 << 20)}),
         # 900s suffices where the retired suite_13 step needed 1800s:
         # the batched decoder is ONE small fused program (searchsorted
         # + gathers, 1-2 distinct shapes) — the old per-run kernels

@@ -298,6 +298,35 @@ def test_grouped_vs_expanded_bf16_within_noise_floor(cfg, params):
     assert np.abs(default - explicit).max() <= 3.0 * floor
 
 
+def test_every_train_step_dot_is_bf16(cfg, params):
+    """StableHLO dot census: with cfg.dtype=bf16 every dot_general in
+    the train step must take bf16×bf16 operands (f32 accumulation via
+    preferred_element_type is fine — it's the OPERAND dtype that
+    decides MXU rate).  History: the rms_norm promotion bug (round 4)
+    silently ran ALL dots f32×f32; its fix left 4 — the attention
+    backward's dq/dk, fed by the f32 scores cotangent — until the
+    grouped path's custom VJP (round 5) downcast dS.  This census
+    makes the next silent promotion a test failure, not a
+    profile-archaeology project."""
+    import re
+    import optax
+    from nvme_strom_tpu.models.transformer import make_train_step
+    assert cfg.dtype == jnp.bfloat16
+    opt = optax.adamw(1e-3)
+    txt = jax.jit(make_train_step(cfg, opt)).lower(
+        params, opt.init(params),
+        jnp.zeros((2, cfg.max_seq), jnp.int32)).as_text()
+    dots = re.findall(
+        r"dot_general.*?:\s*\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)",
+        txt)
+    assert dots, "census regex matched nothing — StableHLO format moved"
+    bad = [(a, b) for a, b in dots
+           if not (a.endswith("bf16") and b.endswith("bf16"))]
+    assert not bad, (
+        f"{len(bad)}/{len(dots)} dots with non-bf16 operands: "
+        f"{bad[:4]}")
+
+
 def test_chunked_xent_matches_full_path(cfg):
     """cfg.xent_chunks slices the lm_head+softmax; loss AND grads must
     match the full-logits path (it's a memory layout, not new math)."""
