@@ -104,6 +104,47 @@ def patchify(images: jax.Array, cfg: ViTConfig) -> jax.Array:
     return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, n * n, cfg.patch_dim)
 
 
+@jax.custom_vjp
+def _sdpa(q, k, v):
+    """softmax(QKᵀ/√d)V, (b, h, s, d), non-causal — with an explicit
+    backward that downcasts the scores cotangent to the activation
+    dtype before the dq/dk matmuls (softmax VJP stays f32).  Autodiff
+    kept dS in f32 (the preferred_element_type output) and promoted
+    k/q, lowering the attention backward f32×f32 — the same promotion
+    the transformer's grouped path fixed (see
+    dense_causal_attention_grouped; pinned by the dot-census test)."""
+    return _sdpa_fwd(q, k, v)[0]
+
+
+def _sdpa_fwd(q, k, v):
+    hd = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    probs32 = jax.nn.softmax(scores / np.sqrt(hd), axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", probs32.astype(q.dtype), v)
+    return o, (q, k, v, probs32)
+
+
+def _sdpa_bwd(res, g):
+    q, k, v, probs32 = res
+    hd = q.shape[-1]
+    probs = probs32.astype(q.dtype)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", probs, g,
+                    preferred_element_type=jnp.float32).astype(v.dtype)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g, v,
+                    preferred_element_type=jnp.float32)
+    ds32 = probs32 * (dp - jnp.sum(dp * probs32, -1, keepdims=True))
+    ds = (ds32 / np.sqrt(hd)).astype(q.dtype)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k,
+                    preferred_element_type=jnp.float32).astype(q.dtype)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q,
+                    preferred_element_type=jnp.float32).astype(k.dtype)
+    return dq, dk, dv
+
+
+_sdpa.defvjp(_sdpa_fwd, _sdpa_bwd)
+
+
 def _attention(x, p, L, cfg):
     b, s, _ = x.shape
     hd, nh = cfg.head_dim, cfg.n_heads
@@ -111,10 +152,7 @@ def _attention(x, p, L, cfg):
     k = (x @ p[L + "wk"].astype(x.dtype)).reshape(b, s, nh, hd)
     v = (x @ p[L + "wv"].astype(x.dtype)).reshape(b, s, nh, hd)
     q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32)
-    probs = jax.nn.softmax(scores / np.sqrt(hd), axis=-1).astype(x.dtype)
-    o = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    o = _sdpa(q, k, v)
     o = o.transpose(0, 2, 1, 3).reshape(b, s, nh * hd)
     return o @ p[L + "wo"].astype(x.dtype)
 

@@ -313,18 +313,61 @@ def test_every_train_step_dot_is_bf16(cfg, params):
     from nvme_strom_tpu.models.transformer import make_train_step
     assert cfg.dtype == jnp.bfloat16
     opt = optax.adamw(1e-3)
-    txt = jax.jit(make_train_step(cfg, opt)).lower(
+
+    def census(lowered):
+        dots = re.findall(
+            r"dot_general.*?:\s*\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)",
+            lowered.as_text())
+        assert dots, ("census regex matched nothing — StableHLO "
+                      "format moved")
+        bad = [(a, b) for a, b in dots
+               if not (a.endswith("bf16") and b.endswith("bf16"))]
+        return dots, bad
+
+    dots, bad = census(jax.jit(make_train_step(cfg, opt)).lower(
         params, opt.init(params),
-        jnp.zeros((2, cfg.max_seq), jnp.int32)).as_text()
-    dots = re.findall(
-        r"dot_general.*?:\s*\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)",
-        txt)
-    assert dots, "census regex matched nothing — StableHLO format moved"
-    bad = [(a, b) for a, b in dots
-           if not (a.endswith("bf16") and b.endswith("bf16"))]
+        jnp.zeros((2, cfg.max_seq), jnp.int32)))
     assert not bad, (
         f"{len(bad)}/{len(dots)} dots with non-bf16 operands: "
         f"{bad[:4]}")
+
+    # MoE: the ONLY allowed f32-operand dots are the router matmul and
+    # its two backward dots — router math is f32 by design (the
+    # GShard/Switch convention; d_model x n_experts is negligible
+    # FLOPs).  Identity is pinned, not just count: every allowed dot
+    # must touch the n_experts dimension.  Dispatch/combine einsums
+    # must stay bf16.
+    from nvme_strom_tpu.models.transformer import tiny_moe_config
+    mcfg = tiny_moe_config()
+    assert mcfg.dtype == jnp.bfloat16
+    assert mcfg.n_experts not in (mcfg.d_model, mcfg.d_ff,
+                                  mcfg.max_seq, 2)   # dim is unambiguous
+    mparams = init_params(jax.random.key(0), mcfg)
+    _, mbad = census(jax.jit(make_train_step(mcfg, opt)).lower(
+        mparams, opt.init(mparams),
+        jnp.zeros((2, mcfg.max_seq), jnp.int32)))
+    assert len(mbad) == 3, (
+        f"MoE step: expected exactly the 3 f32 router dots, got "
+        f"{len(mbad)}: {mbad[:6]}")
+    for a, b in mbad:
+        dims = a.split("x")[:-1] + b.split("x")[:-1]
+        assert str(mcfg.n_experts) in dims, (
+            f"non-bf16 dot is NOT a router dot (no n_experts dim): "
+            f"({a}, {b})")
+
+    # ViT (config 3's consumer): zero non-bf16 dots
+    from nvme_strom_tpu.models.vit import (init_vit_params,
+                                           make_vit_train_step,
+                                           tiny_vit_config)
+    vcfg = tiny_vit_config()
+    assert vcfg.dtype == jnp.bfloat16
+    vp = init_vit_params(jax.random.key(0), vcfg)
+    _, vbad = census(jax.jit(make_vit_train_step(vcfg, opt)).lower(
+        vp, opt.init(vp),
+        jnp.zeros((2, vcfg.image_size, vcfg.image_size, 3),
+                  jnp.float32),
+        jnp.zeros((2,), jnp.int32)))
+    assert not vbad, f"ViT step non-bf16 dots: {vbad[:4]}"
 
 
 def test_chunked_xent_matches_full_path(cfg):

@@ -132,3 +132,38 @@ def test_wds_image_pipeline_end_to_end(tmp_path):
             n += 1
     assert n == 4
     assert np.isfinite(float(loss))
+
+
+def test_sdpa_custom_vjp_matches_autodiff():
+    """_sdpa's explicit backward (dS downcast to the activation dtype
+    before the dq/dk matmuls) must equal autodiff of the plain SDPA
+    math at f32 — where the downcast is a no-op — to rounding.  A
+    transposed operand or a dropped 1/sqrt(d) in a future edit fails
+    here, not as silent convergence degradation."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from nvme_strom_tpu.models.vit import _sdpa
+
+    def plain(q, k, v):
+        hd = q.shape[-1]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(scores / np.sqrt(hd), -1).astype(q.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    kq, kk, kv, kg = jax.random.split(jax.random.key(3), 4)
+    q = jax.random.normal(kq, (2, 4, 16, 8), jnp.float32)
+    k = jax.random.normal(kk, (2, 4, 16, 8), jnp.float32)
+    v = jax.random.normal(kv, (2, 4, 16, 8), jnp.float32)
+    ct = jax.random.normal(kg, (2, 4, 16, 8), jnp.float32)
+
+    np.testing.assert_array_equal(np.asarray(_sdpa(q, k, v)),
+                                  np.asarray(plain(q, k, v)))
+    g1 = jax.jit(jax.grad(lambda q, k, v: jnp.sum(_sdpa(q, k, v) * ct),
+                          (0, 1, 2)))(q, k, v)
+    g2 = jax.jit(jax.grad(lambda q, k, v: jnp.sum(plain(q, k, v) * ct),
+                          (0, 1, 2)))(q, k, v)
+    for a, b, n in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6, err_msg=n)
