@@ -254,17 +254,87 @@ def wmat(p: Dict, name: str, dtype):
     return w.astype(dtype)
 
 
+# --- attention precision gates -------------------------------------------
+#
+# The two attention einsums with explicit VJPs that downcast the
+# incoming cotangent to the operand dtype before the backward matmuls.
+# Autodiff's rule keeps the f32 cotangent (the preferred_element_type
+# output) and lets jnp promotion widen the bf16 operand, so every
+# attention-backward dot lowered f32×f32 — half the MXU rate (the dot
+# census found 4-8 such dots in every attention-bearing train step).
+# Softmax/mask/scale stay ordinary f32 autodiff; at f32 activations the
+# downcasts are no-ops and gradients equal autodiff to rounding (pinned
+# by the ring/ulysses parity tests).  Composable: callers mix the gates
+# with plain jnp ops and autodiff handles the rest.
+
+@jax.custom_vjp
+def qk_scores(q, k):
+    """einsum("bhqd,bhkd->bhqk") with f32 accumulation; backward dots
+    take activation-dtype operands."""
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                      preferred_element_type=jnp.float32)
+
+
+def _qk_scores_fwd(q, k):
+    return qk_scores(q, k), (q, k)
+
+
+def _qk_scores_bwd(res, g):
+    q, k = res
+    g16 = g.astype(q.dtype)
+    dq = jnp.einsum("bhqk,bhkd->bhqd", g16, k,
+                    preferred_element_type=jnp.float32).astype(q.dtype)
+    dk = jnp.einsum("bhqk,bhqd->bhkd", g16, q,
+                    preferred_element_type=jnp.float32).astype(k.dtype)
+    return dq, dk
+
+
+qk_scores.defvjp(_qk_scores_fwd, _qk_scores_bwd)
+
+
+@jax.custom_vjp
+def pv_apply(p32, v):
+    """einsum("bhqk,bhkd->bhqd") of f32 probabilities against V.
+
+    The probs downcast to V's dtype happens INSIDE the gate (so the
+    forward matmul runs bf16 on the MXU), and the backward downcasts
+    the output cotangent before the dp/dv matmuls — but the dp
+    COTANGENT returned upstream stays f32: the softmax VJP it feeds
+    relies on f32 cancellation, and quantizing a matmul OUTPUT buys no
+    MXU rate (only operand dtypes decide that)."""
+    return jnp.einsum("bhqk,bhkd->bhqd", p32.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32)
+
+
+def _pv_apply_fwd(p32, v):
+    return pv_apply(p32, v), (p32, v)
+
+
+def _pv_apply_bwd(res, g):
+    p32, v = res
+    g16 = g.astype(v.dtype)
+    dp32 = jnp.einsum("bhqd,bhkd->bhqk", g16, v,
+                      preferred_element_type=jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p32.astype(v.dtype), g16,
+                    preferred_element_type=jnp.float32).astype(v.dtype)
+    return dp32, dv
+
+
+pv_apply.defvjp(_pv_apply_fwd, _pv_apply_bwd)
+
+
 def dense_causal_attention(q, k, v):
     """softmax(QKᵀ/√d)V with a causal mask; q/k/v (b, h, s, d), same head
-    count (GQA already expanded).  The single-chip default ``attn_fn``."""
+    count (GQA already expanded).  The single-chip default ``attn_fn``.
+    Built on the precision gates so the backward matmuls stay in the
+    activation dtype (bf16 on TPU) — used directly and as the Ulysses
+    inner."""
     s, hd = q.shape[-2], q.shape[-1]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores / np.sqrt(hd)
+    scores = qk_scores(q, k) / np.sqrt(hd)
     mask = jnp.tril(jnp.ones((s, s), bool))
     scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    probs32 = jax.nn.softmax(scores, axis=-1)
+    return pv_apply(probs32, v).astype(q.dtype)
 
 
 @jax.custom_vjp

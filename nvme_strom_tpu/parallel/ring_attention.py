@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from nvme_strom_tpu.models.transformer import pv_apply, qk_scores
+
 _NEG = -1e30  # mask value: finite so exp() underflows instead of NaN-ing
 
 
@@ -63,14 +65,14 @@ def _ring_block(q, k, v, axis_name: str, n_sp: int, causal: bool,
     def body(t, carry):
         k_t, v_t, m, l, o = carry
         j = (idx - t) % n_sp
-        # Matmul inputs stay in the activation dtype (bf16 on TPU → MXU)
-        # with f32 accumulation via preferred_element_type — the same
-        # precision pattern as dense_causal_attention.  Upcasting q/k to
-        # f32 here would lower the ring's dots as f32×f32 (the exact
-        # promotion bug the round-4 rms_norm fix killed elsewhere) and
-        # also diverge numerically from the dense reference.
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k_t,
-                       preferred_element_type=jnp.float32) * scale
+        # The attention precision gates (models/transformer.qk_scores /
+        # pv_apply): matmul inputs stay in the activation dtype (bf16
+        # on TPU → MXU) with f32 accumulation, and the BACKWARD matmuls
+        # do too — plain autodiff kept the f32 scores/output cotangents
+        # and promoted q/k/v, so the ring's backward dots lowered
+        # f32×f32 (the round-4 rms_norm promotion bug's sibling; the
+        # dot census counted 8 in the sp train step).
+        s = qk_scores(q, k_t) * scale
         if causal:
             kv_pos = j * s_blk + jnp.arange(s_blk)
             mask = kv_pos[None, :] <= q_pos[:, None]
@@ -81,11 +83,9 @@ def _ring_block(q, k, v, axis_name: str, n_sp: int, causal: bool,
             p = jnp.where(mask, p, 0.0)  # fully-masked rows: exactly zero
         correction = jnp.exp(m - m_new)
         l = l * correction + p.sum(-1)
-        # Probs downcast to the activation dtype before the PV matmul
-        # (dense does the same: softmax in f32, probs@V on the MXU).
-        o = o * correction[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(v_t.dtype), v_t,
-            preferred_element_type=jnp.float32)
+        # pv_apply downcasts the f32 probs to V's dtype internally for
+        # the MXU matmul; its dp cotangent stays f32 for the exp VJP.
+        o = o * correction[..., None] + pv_apply(p, v_t)
         # Rotate K/V to the next device (skippable on the last step, but a
         # uniform body keeps the loop fusible).
         k_t = jax.lax.ppermute(k_t, axis_name, perm)

@@ -63,3 +63,19 @@ def mesh_for(axes):
         pytest.skip(f"needs {need} devices")
     return Mesh(np.array(devs[:need]).reshape(sizes),
                 tuple(n for n, _ in axes))
+
+
+def dot_census(lowered):
+    """(all_dots, non_bf16_dots) operand-dtype census of a lowered
+    computation's StableHLO — shared by the bf16 dot-census tests
+    (test_model, test_ring_attention) so the regex and filter cannot
+    drift when the StableHLO text format moves."""
+    import re
+
+    dots = re.findall(
+        r"dot_general.*?:\s*\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)",
+        lowered.as_text())
+    assert dots, "census regex matched nothing — StableHLO format moved"
+    bad = [(a, b) for a, b in dots
+           if not (a.endswith("bf16") and b.endswith("bf16"))]
+    return dots, bad

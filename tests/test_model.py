@@ -308,21 +308,11 @@ def test_every_train_step_dot_is_bf16(cfg, params):
     grouped path's custom VJP (round 5) downcast dS.  This census
     makes the next silent promotion a test failure, not a
     profile-archaeology project."""
-    import re
     import optax
+    from conftest import dot_census as census
     from nvme_strom_tpu.models.transformer import make_train_step
     assert cfg.dtype == jnp.bfloat16
     opt = optax.adamw(1e-3)
-
-    def census(lowered):
-        dots = re.findall(
-            r"dot_general.*?:\s*\(tensor<([^>]*)>,\s*tensor<([^>]*)>\)",
-            lowered.as_text())
-        assert dots, ("census regex matched nothing — StableHLO "
-                      "format moved")
-        bad = [(a, b) for a, b in dots
-               if not (a.endswith("bf16") and b.endswith("bf16"))]
-        return dots, bad
 
     dots, bad = census(jax.jit(make_train_step(cfg, opt)).lower(
         params, opt.init(params),

@@ -207,6 +207,34 @@ def test_ring_rejects_bad_inner(sp8):
         ring_attention(q, k, v, sp8, inner="nope")
 
 
+def test_sp_train_step_every_dot_is_bf16(mesh222):
+    """StableHLO dot census on the SHARDED train step: ring's backward
+    used to promote its dots to f32×f32 (the f32 carry/scores
+    cotangents widened q/k/v — 8 such dots before the precision gates;
+    see models/transformer.qk_scores/pv_apply).  Ulysses inherits the
+    fix through dense_causal_attention.  Same census as
+    tests/test_model.py, on the parallel paths."""
+    import optax
+    from conftest import dot_census
+    from nvme_strom_tpu.parallel.ulysses import make_ulysses_attn
+
+    cfg = tiny_config()
+    assert cfg.dtype == jnp.bfloat16
+    opt = optax.adamw(1e-3)
+    params = init_params(jax.random.key(0), cfg)
+    p_sh = param_shardings(cfg, mesh222)
+    b_sh = batch_shardings(mesh222, seq_sharded=True)
+    ps = {k: jax.device_put(v, p_sh[k]) for k, v in params.items()}
+    tok = jax.device_put(jnp.zeros((4, cfg.max_seq), jnp.int32), b_sh)
+    for name, fn in (("ring", make_ring_attn(mesh222)),
+                     ("ulysses", make_ulysses_attn(mesh222))):
+        step = jax.jit(make_train_step(cfg, opt, attn_fn=fn),
+                       in_shardings=(p_sh, None, b_sh),
+                       out_shardings=(p_sh, None, None))
+        _, bad = dot_census(step.lower(ps, opt.init(ps), tok))
+        assert not bad, f"{name}: non-bf16 dots {bad[:4]}"
+
+
 def test_batch_shardings_requires_sp_axis(mesh8):
     with pytest.raises(ValueError):
         batch_shardings(mesh8, seq_sharded=True)
