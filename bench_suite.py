@@ -1074,7 +1074,8 @@ def _bench_cfg(train_override: bool = False):
     if spec:
         alias = {"d": "d_model", "L": "n_layers", "ff": "d_ff",
                  "heads": "n_heads", "kv": "n_kv_heads",
-                 "vocab": "vocab", "xc": "xent_chunks"}
+                 "vocab": "vocab", "xc": "xent_chunks",
+                 "s": "max_seq"}
         try:
             kw = {}
             for part in spec.split(","):
@@ -1656,6 +1657,15 @@ def bench_train(device=None) -> tuple[float, str]:
     import jax
     cfg = _bench_cfg(train_override=True)
     batch, seq = (2, 64) if _tiny_compute() else (8, 1024)
+    # an APPLIED max_seq override in STROM_TRAIN_CFG trains at that
+    # sequence (the long-context rows); detected from the parsed
+    # config — not by re-reading the env var — so a malformed spec
+    # (which _bench_cfg logs and ignores) safely keeps the historical
+    # s=1024 shape instead of silently training at the default
+    # max_seq.  An explicit s= equal to the default is the one
+    # indistinguishable case and keeps s=1024.
+    if not _tiny_compute() and cfg.max_seq != _bench_cfg().max_seq:
+        seq = cfg.max_seq
     dev = device or jax.devices()[0]
     sweep = os.environ.get("STROM_TRAIN_SWEEP", "")
     variants = []

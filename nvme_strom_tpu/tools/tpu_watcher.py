@@ -344,6 +344,19 @@ def capture(device: str) -> bool:
          {"STROM_TRAIN_SWEEP": "8:none:flash",
           "STROM_TRAIN_CFG": CFG_D4096,
           "STROM_PROFILE_DIR": prof_d4096}),
+        # long-context MFU points: at s=4096/8192 the dense path's
+        # f32 score block alone is 8.6/34 GiB — only the flash
+        # kernel's O(s) attention memory fits, so these rows ARE the
+        # long-context story measured (SURVEY §5.7); batch shrinks to
+        # keep activations inside the v5e's 16 GiB at remat=none
+        ("suite_7_s4096_bf16",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1500,
+         {"STROM_TRAIN_SWEEP": "4:none:flash",
+          "STROM_TRAIN_CFG": "d=2048,L=8,ff=5632,heads=16,kv=8,s=4096"}),
+        ("suite_7_s8192_bf16",
+         [sys.executable, "bench_suite.py", "--config", "7"], 1500,
+         {"STROM_TRAIN_SWEEP": "2:dots:flash",
+          "STROM_TRAIN_CFG": "d=2048,L=8,ff=5632,heads=16,kv=8,s=8192"}),
         # Version-label hygiene: a step's _vN suffix names the CODE
         # GENERATION it measured, but every generation shares one CLI —
         # so once a label's row has landed, its entry is DELETED here
