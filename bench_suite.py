@@ -1551,6 +1551,81 @@ def bench_opt_offload(engine) -> tuple[float, str]:
                   f"{payload >> 20}MiB, groups={groups}{extra}")
 
 
+def bench_act_offload(engine, device=None) -> tuple[float, str]:
+    """Config 18: NVMe-offloaded saved activations
+    (parallel/act_offload, remat_policy="nvme") priced against
+    remat="full" — the honest in-HBM comparison, since BOTH recompute
+    every layer in backward; the delta is exactly the activation round
+    trip (device→host→NVMe→host→device per layer per step) that buys
+    O(1)-layers HBM activations below full remat's O(n_layers).
+
+    The value is the activation-streaming rate (2 × layers × act
+    bytes per step over the step time); the tag prices step overhead
+    vs remat="full" and link-normalizes it like config 14 (on a
+    tunneled chip the link floor, not the implementation, bounds the
+    overhead)."""
+    import jax
+    import numpy as np
+    from nvme_strom_tpu.parallel.act_offload import ActivationStore
+    cfg = _bench_cfg(train_override=True)
+    batch, seq = (2, 64) if _tiny_compute() else (8, 1024)
+    dev = device or jax.devices()[0]
+    rcfg = dataclasses.replace(cfg, remat_policy="full")
+    ncfg = dataclasses.replace(cfg, remat_policy="nvme")
+    params, opt_state, tokens, _step_unused, flops_step = _train_setup(
+        rcfg, batch, seq, dev)
+
+    import optax
+    opt = optax.adamw(1e-3)
+
+    def run(step, p, s, reps=3):
+        p, s, loss = step(p, s, tokens)          # compile + warm slots
+        jax.block_until_ready(loss)
+        float(loss)
+        losses = []
+        t0 = time.monotonic()
+        for _ in range(reps):
+            p, s, loss = step(p, s, tokens)
+            losses.append(loss)
+        float(losses[-1])
+        dt = (time.monotonic() - t0) / reps
+        _loss_sanity([float(x) for x in jax.device_get(losses)])
+        return dt
+
+    from nvme_strom_tpu.models.transformer import make_train_step
+    t_full = run(jax.jit(make_train_step(rcfg, opt)), params, opt_state)
+
+    adir = os.path.join(_scratch_dir(), "act_offload")
+    shutil.rmtree(adir, ignore_errors=True)
+    act_bytes = (batch * seq * cfg.d_model
+                 * np.dtype(cfg.dtype).itemsize)
+    with ActivationStore(os.path.join(adir, "acts.bin"),
+                         cfg.n_layers, engine=engine) as st:
+        t_nvme = run(jax.jit(make_train_step(ncfg, opt, act_store=st)),
+                     params, opt_state)
+    moved = 2 * cfg.n_layers * act_bytes          # 1W + 1R per layer
+    gibs = moved / t_nvme / (1 << 30)
+    over = (t_nvme - t_full) / t_full if t_full > 0 else float("inf")
+    raw_c, link_c = _CEILINGS.get("raw", 0.0), _CEILINGS.get("link", 0.0)
+    extra = ""
+    if raw_c > 0 and link_c > 0:
+        t_floor = moved / (link_c * (1 << 30))
+        t_local = moved / (raw_c * (1 << 30))
+        bound = "TUNNEL-BOUND, " if t_floor >= 0.5 * t_nvme else ""
+        extra = (f", link-normalized: {bound}link-floor="
+                 f"{t_floor * 1e3:.0f}ms of {t_nvme * 1e3:.0f}ms at "
+                 f"{link_c:.3f} GiB/s; projected at same-run raw "
+                 f"{raw_c:.3f} GiB/s: step="
+                 f"{(t_full + t_local) * 1e3:.0f}ms "
+                 f"overhead={t_local / t_full:+.0%}")
+    tag = (f"acts={moved >> 20}MiB/step ({cfg.n_layers} layers x "
+           f"{act_bytes >> 20}MiB x2) step={t_nvme * 1e3:.0f}ms "
+           f"overhead={over:+.0%} vs remat-full "
+           f"({t_full * 1e3:.0f}ms){extra}")
+    _log(f"suite: act-offload {tag}")
+    return gibs, tag
+
+
 def bench_fed_train(engine, device=None) -> tuple[float, str]:
     """Config 17: the reference's core identity as ONE number — train
     while the NVMe pipeline feeds REAL token batches, paired in the
@@ -1860,6 +1935,11 @@ def run(configs: list[int], emit=None) -> list[dict]:
             # read-ceiling ratio applies
             17: ("fed-train-mfu",
                  lambda: bench_fed_train(engine), "TFLOP/s", False),
+            # activation round-trip rate; priced vs remat-full (both
+            # recompute — the delta IS the NVMe leg), link-normalized
+            # like config 14, so no read-ceiling ratio
+            18: ("offloaded-activations-step",
+                 lambda: bench_act_offload(engine), "GiB/s", False),
         }
         # only configs whose _steady passes move payload ACROSS the
         # link get per-pass pairing: config 8's passes are pure engine
@@ -1930,12 +2010,12 @@ def run(configs: list[int], emit=None) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 18))
+                    choices=range(1, 19))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = list(range(1, 18))
+        configs = list(range(1, 19))
     run(configs, emit=lambda row: print(json.dumps(row), flush=True))
     return 0
 

@@ -115,12 +115,18 @@ def main(argv=None) -> int:
     ap.add_argument("--lora-alpha", type=float, default=None,
                     help="LoRA scale numerator (default: RANK)")
     ap.add_argument("--remat", default="none",
-                    choices=("none", "dots", "full"),
+                    choices=("none", "dots", "full", "nvme"),
                     help="rematerialization policy: 'dots' saves matmul "
                          "outputs and recomputes elementwise ops (most "
                          "of full remat's memory win at a fraction of "
                          "its recompute); 'full' recomputes whole "
-                         "layers")
+                         "layers; 'nvme' additionally moves the "
+                         "layer-boundary activations to NVMe "
+                         "(--offload-acts DIR) — O(1)-layers HBM "
+                         "activations")
+    ap.add_argument("--offload-acts", default=None, metavar="DIR",
+                    help="backing dir for --remat nvme "
+                         "(parallel/act_offload ActivationStore)")
     ap.add_argument("--flash", action="store_true",
                     help="use the Pallas fused flash-attention kernel "
                          "(O(seq) memory) instead of XLA dense "
@@ -134,6 +140,12 @@ def main(argv=None) -> int:
     if args.offload_opt and args.lora:
         ap.error("--offload-opt is for full fine-tunes; LoRA optimizer "
                  "state is adapter-sized and lives happily in HBM")
+    if (args.remat == "nvme") != bool(args.offload_acts):
+        ap.error("--remat nvme and --offload-acts DIR go together")
+    if args.remat == "nvme" and (args.offload_opt or args.lora):
+        ap.error("--remat nvme is wired into the plain full-weight "
+                 "step only (the LoRA and offload-opt steps build "
+                 "their own loss without an activation store)")
 
     import jax
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
@@ -333,11 +345,26 @@ def main(argv=None) -> int:
               f"HBM, {offl.num_groups()} groups, resumed at step "
               f"{offl.step}")
     else:
+        act_store = None
+        if args.offload_acts:
+            if len(jax.devices()) > 1:
+                raise SystemExit(
+                    "--remat nvme is single-device: the store's ordered "
+                    "io_callbacks cannot lower inside a multi-device "
+                    "computation — use --remat full/dots on meshes")
+            from nvme_strom_tpu.parallel.act_offload import \
+                ActivationStore
+            act_store = ActivationStore(
+                os.path.join(args.offload_acts, "acts.bin"),
+                cfg.n_layers, engine=engine)
+            print(f"offload-acts: {cfg.n_layers} layer slots under "
+                  f"{args.offload_acts} (O(1)-layers HBM activations)")
         trainable = params
         opt_state = replicate_scalars(optimizer.init(params), mesh)
         step_fn = jax.jit(make_train_step(cfg, optimizer,
                                           attn_fn=attn_fn,
-                                          accum_steps=args.accum_steps),
+                                          accum_steps=args.accum_steps,
+                                          act_store=act_store),
                           in_shardings=(p_sh, None, b_sh),
                           out_shardings=(p_sh, None, None),
                           donate_argnums=(0, 1))

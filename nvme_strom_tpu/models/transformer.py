@@ -492,26 +492,37 @@ def mlp(x, p, prefix):
 
 
 def forward_hidden(params: Dict, tokens: jax.Array,
-                   cfg: TransformerConfig, attn_fn=None
+                   cfg: TransformerConfig, attn_fn=None, act_store=None
                    ) -> tuple[jax.Array, jax.Array]:
     """tokens (b, s) int32 → (final-norm hidden (b, s, d) in cfg.dtype,
     aux_loss scalar) — everything up to but excluding the lm_head, so
     the chunked cross-entropy can project vocab slices itself.
 
-    aux_loss is the summed MoE load-balancing loss (0 for dense models)."""
+    aux_loss is the summed MoE load-balancing loss (0 for dense models).
+
+    ``remat_policy="nvme"`` + ``act_store`` (an
+    ``act_offload.ActivationStore``): layer-boundary activations live
+    on NVMe between forward and backward and the backward recomputes
+    each layer from its streamed-back input — O(1)-layers HBM
+    activations, below remat="full"'s O(n_layers) (the engine's
+    larger-than-device-memory identity applied to the activation
+    axis)."""
     x = params["tok_embed"].astype(cfg.dtype)[tokens]
     aux = jnp.zeros((), jnp.float32)
 
-    def one_layer(x, i):
+    def layer_body(p, x, i):
         L = f"layers.{i}."
-        x = x + attention(rms_norm(x, params[L + "attn_norm"], cfg.norm_eps),
-                          params, L, cfg, attn_fn)
-        h = rms_norm(x, params[L + "mlp_norm"], cfg.norm_eps)
+        x = x + attention(rms_norm(x, p[L + "attn_norm"], cfg.norm_eps),
+                          p, L, cfg, attn_fn)
+        h = rms_norm(x, p[L + "mlp_norm"], cfg.norm_eps)
         if cfg.is_moe_layer(i):
-            h, a = _moe.moe_mlp(h, params, L, cfg)
+            h, a = _moe.moe_mlp(h, p, L, cfg)
         else:
-            h, a = mlp(h, params, L), jnp.zeros((), jnp.float32)
+            h, a = mlp(h, p, L), jnp.zeros((), jnp.float32)
         return x + h, a
+
+    def one_layer(x, i):
+        return layer_body(params, x, i)
 
     policy = cfg.remat_policy or ("full" if cfg.remat else "none")
     if policy == "full":
@@ -521,9 +532,22 @@ def forward_hidden(params: Dict, tokens: jax.Array,
             one_layer, static_argnums=(1,),
             policy=jax.checkpoint_policies
             .dots_with_no_batch_dims_saveable)
+    elif policy == "nvme":
+        if act_store is None:
+            raise ValueError(
+                "remat_policy='nvme' needs an act_store= "
+                "(parallel/act_offload.ActivationStore)")
+        from nvme_strom_tpu.parallel.act_offload import offload_layer
+        off = offload_layer(layer_body, act_store, x.shape, x.dtype)
+        for i in range(cfg.n_layers):
+            L = f"layers.{i}."
+            lp = {k: params[k] for k in params if k.startswith(L)}
+            x, a = off(lp, x, i)
+            aux = aux + a
+        return rms_norm(x, params["final_norm"], cfg.norm_eps), aux
     elif policy != "none":
         raise ValueError(
-            f"remat_policy {policy!r}: expected none|full|dots")
+            f"remat_policy {policy!r}: expected none|full|dots|nvme")
     for i in range(cfg.n_layers):
         x, a = one_layer(x, i)
         aux = aux + a
@@ -531,10 +555,11 @@ def forward_hidden(params: Dict, tokens: jax.Array,
 
 
 def forward_with_aux(params: Dict, tokens: jax.Array,
-                     cfg: TransformerConfig, attn_fn=None
-                     ) -> tuple[jax.Array, jax.Array]:
+                     cfg: TransformerConfig, attn_fn=None,
+                     act_store=None) -> tuple[jax.Array, jax.Array]:
     """tokens (b, s) int32 → (logits (b, s, vocab) f32, aux_loss scalar)."""
-    x, aux = forward_hidden(params, tokens, cfg, attn_fn)
+    x, aux = forward_hidden(params, tokens, cfg, attn_fn,
+                            act_store=act_store)
     logits = (x @ wmat(params, "lm_head", x.dtype)).astype(jnp.float32)
     return logits, aux
 
@@ -592,7 +617,8 @@ def chunked_xent(params, hidden, tokens, cfg) -> jax.Array:
     return total / (b * (s - 1))
 
 
-def loss_fn(params, tokens, cfg, attn_fn=None) -> jax.Array:
+def loss_fn(params, tokens, cfg, attn_fn=None, act_store=None
+            ) -> jax.Array:
     """Next-token cross-entropy (tokens supply both input and target).
 
     The full sequence is forwarded and the last logit dropped — identical
@@ -600,12 +626,15 @@ def loss_fn(params, tokens, cfg, attn_fn=None) -> jax.Array:
     a multiple of the ``sp`` shard count for ring attention.
 
     ``cfg.xent_chunks > 1`` switches to the chunked lm_head+softmax
-    (:func:`chunked_xent`) — the big-vocab activation-memory lever."""
+    (:func:`chunked_xent`) — the big-vocab activation-memory lever.
+    ``act_store`` serves ``remat_policy="nvme"`` (see forward_hidden)."""
     if cfg.xent_chunks > 1:
-        hidden, aux = forward_hidden(params, tokens, cfg, attn_fn)
+        hidden, aux = forward_hidden(params, tokens, cfg, attn_fn,
+                                     act_store=act_store)
         loss = chunked_xent(params, hidden, tokens, cfg)
         return loss + cfg.router_aux_coef * aux
-    logits, aux = forward_with_aux(params, tokens, cfg, attn_fn)
+    logits, aux = forward_with_aux(params, tokens, cfg, attn_fn,
+                                   act_store=act_store)
     logits = logits[:, :-1]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -616,7 +645,7 @@ def loss_fn(params, tokens, cfg, attn_fn=None) -> jax.Array:
 # ----------------------------- training -----------------------------
 
 def make_train_step(cfg: TransformerConfig, optimizer, attn_fn=None,
-                    accum_steps: int = 1):
+                    accum_steps: int = 1, act_store=None):
     """Returns step(params, opt_state, tokens) -> (params, opt_state, loss).
     Pure function — jit/shard it at the call site.  ``attn_fn`` selects the
     attention inner block (dense / ring / flash).
@@ -626,6 +655,9 @@ def make_train_step(cfg: TransformerConfig, optimizer, attn_fn=None,
     averaged in one ``lax.scan`` before the single optimizer update, so
     the activation footprint is that of b/accum_steps while the update
     matches the full-batch step exactly (same mean-over-tokens loss).
+
+    ``act_store``: NVMe-offloaded saved activations for
+    ``remat_policy="nvme"`` (parallel/act_offload).
     """
 
     import optax
@@ -633,7 +665,8 @@ def make_train_step(cfg: TransformerConfig, optimizer, attn_fn=None,
     def step(params, opt_state, tokens):
         loss, grads = accumulate_grads(
             lambda mb: jax.value_and_grad(
-                lambda p: loss_fn(p, mb, cfg, attn_fn))(params),
+                lambda p: loss_fn(p, mb, cfg, attn_fn,
+                                  act_store=act_store))(params),
             params, tokens, accum_steps)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)

@@ -1,0 +1,179 @@
+"""NVMe-offloaded saved activations: training beyond HBM on the
+ACTIVATION axis.
+
+The reference's whole identity is feeding an accelerator data that does
+not fit device memory (SURVEY.md §3.5); this repo already applies it to
+weights (parallel/weights.py lazy loads), the KV cache
+(models/kv_offload.py), and optimizer moments (parallel/opt_offload.py).
+Activations are the remaining memory axis: at remat="full" the backward
+still keeps one (b, s, d) residual-stream tensor PER LAYER alive from
+forward to backward — O(n_layers) HBM that bounds depth.  This module
+moves those layer-boundary tensors to NVMe:
+
+  forward:   layer i's INPUT x streams device → host → engine
+             (ordered ``io_callback``; the write is submitted
+             asynchronously and drained before any read), and x is NOT
+             kept as a residual;
+  backward:  x streams back NVMe → host → device, and the layer
+             recomputes under ``jax.vjp`` — full-remat recompute whose
+             saved values live only for THAT layer's backward.
+
+HBM activation footprint is therefore O(1 layers) regardless of depth —
+below remat="full"'s O(n_layers) — at the cost of 2 transfers of one
+(b, s, d) tensor per layer per step, which the engine prices the same
+way the optimizer offload does (bench config 14's link-normalized
+frame).  Wired as ``remat_policy="nvme"`` via
+``transformer.forward_hidden(..., act_store=...)``; the policy composes
+with everything the plain layer supports (MoE layers, custom attn_fn)
+because the recompute IS the plain layer.
+
+Correctness contract: losses and gradients are bitwise the math of the
+unoffloaded step (pinned by tests/test_act_offload.py); the io_callbacks
+are ``ordered=True`` so XLA cannot reorder a backward read before its
+forward write.  Scope: single-host (the store is one engine + one
+file); sharded activations would gather through the callback — use the
+in-HBM policies under multi-chip meshes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+_ALIGN = 4096
+
+
+def _align_up(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class ActivationStore:
+    """Slotted NVMe backing for one training step's layer inputs.
+
+    One slot per layer; slot size latches on the first write (every
+    layer's residual-stream input shares one (b, s, d) shape).  Writes
+    are submitted async and tracked per slot; a read drains its slot's
+    pending write first, so forward can stream ahead of the engine
+    while backward stays correct."""
+
+    def __init__(self, path: str, n_slots: int, engine=None):
+        from nvme_strom_tpu.io.engine import StromEngine
+        from nvme_strom_tpu.utils.config import EngineConfig
+
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.path = str(path)
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._own_engine = engine is None
+        self.engine = engine or StromEngine(EngineConfig())
+        self.n_slots = n_slots
+        self._slot_bytes: Optional[int] = None
+        self._shape = None
+        self._dtype = None
+        # create/truncate the backing file; opened writable once
+        with open(self.path, "wb"):
+            pass
+        self._fh = self.engine.open(self.path, writable=True)
+        self._pending: Dict[int, list] = {}
+        self.writes = 0
+        self.reads = 0
+
+    # -- host-callback endpoints (called by io_callback) -----------------
+
+    def write(self, slot, x) -> None:
+        slot = int(slot)
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of [0, {self.n_slots})")
+        host = np.ascontiguousarray(x)
+        if self._slot_bytes is None:
+            self._slot_bytes = _align_up(host.nbytes)
+            self._shape, self._dtype = host.shape, host.dtype
+        elif (host.shape, host.dtype) != (self._shape, self._dtype):
+            raise ValueError(
+                f"slot {slot}: activation {host.shape}/{host.dtype} != "
+                f"store layout {self._shape}/{self._dtype} — one store "
+                "serves one step shape; use a second store")
+        self._drain(slot)          # an unread previous write is stale
+        pend: list = []
+        from nvme_strom_tpu.ops.bridge import submit_chunked_writes
+        submit_chunked_writes(self.engine, self._fh,
+                              slot * self._slot_bytes,
+                              host.view(np.uint8).reshape(-1), pend)
+        self._pending[slot] = pend
+        self.writes += 1
+
+    def read(self, slot) -> np.ndarray:
+        slot = int(slot)
+        if self._slot_bytes is None:
+            raise ValueError("read before any write")
+        self._drain(slot)
+        nbytes = int(np.prod(self._shape)) * self._dtype.itemsize
+        chunk = self.engine.config.chunk_bytes
+        off0 = slot * self._slot_bytes
+        out = np.empty(nbytes, np.uint8)
+        reqs = [(pos, self.engine.submit_read(
+            self._fh, off0 + pos, min(chunk, nbytes - pos)))
+            for pos in range(0, nbytes, chunk)]
+        for pos, r in reqs:
+            view = r.wait()
+            out[pos:pos + view.nbytes] = view  # staging is recycled
+            r.release()
+        self.reads += 1
+        return out.view(self._dtype).reshape(self._shape)
+
+    def _drain(self, slot: int) -> None:
+        for p in self._pending.pop(slot, ()):
+            p.wait()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        if getattr(self, "_fh", None) is not None:
+            for s in list(self._pending):
+                self._drain(s)
+            self.engine.close(self._fh)
+            self._fh = None
+        if self._own_engine and self.engine is not None:
+            self.engine.close_all()
+            self.engine = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def offload_layer(core, store: ActivationStore, x_shape, x_dtype):
+    """Wrap ``core(layer_params, x, i) -> (y, aux)`` so layer i's input
+    lives on NVMe between forward and backward.
+
+    Built per trace (the caller knows x's aval there); ``i`` is static
+    (nondiff) so each unrolled layer binds its own slot."""
+    import functools
+
+    from jax.experimental import io_callback
+
+    sds = jax.ShapeDtypeStruct(x_shape, x_dtype)
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def f(lp, x, i):
+        return core(lp, x, i)
+
+    def f_fwd(lp, x, i):
+        y = core(lp, x, i)
+        io_callback(store.write, None, jnp.int32(i), x, ordered=True)
+        return y, lp
+
+    def f_bwd(i, lp, ct):
+        x = io_callback(store.read, sds, jnp.int32(i), ordered=True)
+        _, vjp = jax.vjp(lambda lp, x: core(lp, x, i), lp, x)
+        return vjp(ct)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f

@@ -102,6 +102,7 @@ CONTRACT = {
     15: ("parquet-topk-scan", "ratio"),
     16: ("tar-index-rate", "attr"),
     17: ("fed-train-mfu", "fed"),
+    18: ("offloaded-activations-step", "attr"),
 }
 
 #: the ONE validity rule set, shared with the watcher's coverage

@@ -432,6 +432,12 @@ def capture(device: str) -> bool:
           "STROM_TRAIN_CFG": CFG_D3072}),
         ("suite_16", [sys.executable, "bench_suite.py", "--config", "16"],
          900, None),
+        # NEW round-5 capability: NVMe-offloaded saved activations
+        # (remat_policy="nvme") vs remat-full — the fourth corner of
+        # the larger-than-device-memory story (weights/KV/moments/
+        # activations), priced like config 14
+        ("suite_18", [sys.executable, "bench_suite.py", "--config", "18"],
+         1200, None),
         ("suite_6_bf16", [sys.executable, "bench_suite.py", "--config", "6"],
          1200, None),
         # diagnostics last: b16:none is the OOM-boundary probe (its
