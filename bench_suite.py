@@ -1569,6 +1569,10 @@ def bench_act_offload(engine, device=None) -> tuple[float, str]:
     from nvme_strom_tpu.parallel.act_offload import ActivationStore
     cfg = _bench_cfg(train_override=True)
     batch, seq = (2, 64) if _tiny_compute() else (8, 1024)
+    # honor an applied s= override exactly like bench_train, so a
+    # long-context window's config-18 row shares config 7's shape
+    if not _tiny_compute() and cfg.max_seq != _bench_cfg().max_seq:
+        seq = cfg.max_seq
     dev = device or jax.devices()[0]
     rcfg = dataclasses.replace(cfg, remat_policy="full")
     ncfg = dataclasses.replace(cfg, remat_policy="nvme")

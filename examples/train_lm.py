@@ -142,10 +142,9 @@ def main(argv=None) -> int:
                  "state is adapter-sized and lives happily in HBM")
     if (args.remat == "nvme") != bool(args.offload_acts):
         ap.error("--remat nvme and --offload-acts DIR go together")
-    if args.remat == "nvme" and (args.offload_opt or args.lora):
-        ap.error("--remat nvme is wired into the plain full-weight "
-                 "step only (the LoRA and offload-opt steps build "
-                 "their own loss without an activation store)")
+    if args.remat == "nvme" and args.lora:
+        ap.error("--remat nvme is for full fine-tunes; LoRA's frozen "
+                 "base already skips most activation memory")
 
     import jax
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
@@ -287,6 +286,19 @@ def main(argv=None) -> int:
         optimizer = optax.chain(
             optax.clip_by_global_norm(args.grad_clip), optimizer)
     b_sh = batch_shardings(mesh)
+    act_store = None
+    if args.offload_acts:
+        if len(jax.devices()) > 1:
+            raise SystemExit(
+                "--remat nvme is single-device: the store's ordered "
+                "io_callbacks cannot lower inside a multi-device "
+                "computation — use --remat full/dots on meshes")
+        from nvme_strom_tpu.parallel.act_offload import ActivationStore
+        act_store = ActivationStore(
+            os.path.join(args.offload_acts, "acts.bin"),
+            cfg.n_layers, engine=engine)
+        print(f"offload-acts: {cfg.n_layers} layer slots under "
+              f"{args.offload_acts} (O(1)-layers HBM activations)")
     if args.lora:
         # frozen streamed base + tiny trainable adapters: the
         # checkpoint/optimizer state shrinks to adapter size
@@ -327,7 +339,8 @@ def main(argv=None) -> int:
         def gstep(p, tokens):
             loss, grads = accumulate_grads(
                 lambda mb: jax.value_and_grad(
-                    lambda q: loss_fn(q, mb, cfg, attn_fn))(p),
+                    lambda q: loss_fn(q, mb, cfg, attn_fn,
+                                      act_store=act_store))(p),
                 p, tokens, args.accum_steps)
             if args.grad_clip > 0:
                 grads, _ = optax.clip_by_global_norm(
@@ -345,20 +358,6 @@ def main(argv=None) -> int:
               f"HBM, {offl.num_groups()} groups, resumed at step "
               f"{offl.step}")
     else:
-        act_store = None
-        if args.offload_acts:
-            if len(jax.devices()) > 1:
-                raise SystemExit(
-                    "--remat nvme is single-device: the store's ordered "
-                    "io_callbacks cannot lower inside a multi-device "
-                    "computation — use --remat full/dots on meshes")
-            from nvme_strom_tpu.parallel.act_offload import \
-                ActivationStore
-            act_store = ActivationStore(
-                os.path.join(args.offload_acts, "acts.bin"),
-                cfg.n_layers, engine=engine)
-            print(f"offload-acts: {cfg.n_layers} layer slots under "
-                  f"{args.offload_acts} (O(1)-layers HBM activations)")
         trainable = params
         opt_state = replicate_scalars(optimizer.init(params), mesh)
         step_fn = jax.jit(make_train_step(cfg, optimizer,

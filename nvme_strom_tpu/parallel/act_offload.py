@@ -45,11 +45,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-_ALIGN = 4096
-
-
-def _align_up(n: int) -> int:
-    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+from nvme_strom_tpu.parallel.opt_offload import _align_up
 
 
 class ActivationStore:
@@ -113,12 +109,13 @@ class ActivationStore:
             raise ValueError("read before any write")
         self._drain(slot)
         nbytes = int(np.prod(self._shape)) * self._dtype.itemsize
-        chunk = self.engine.config.chunk_bytes
         off0 = slot * self._slot_bytes
+        from nvme_strom_tpu.ops.bridge import split_ranges
+        ranges, _ = split_ranges([(off0, nbytes)],
+                                 self.engine.config.chunk_bytes)
         out = np.empty(nbytes, np.uint8)
-        reqs = [(pos, self.engine.submit_read(
-            self._fh, off0 + pos, min(chunk, nbytes - pos)))
-            for pos in range(0, nbytes, chunk)]
+        reqs = [(off - off0, self.engine.submit_read(self._fh, off, ln))
+                for off, ln in ranges]
         for pos, r in reqs:
             view = r.wait()
             out[pos:pos + view.nbytes] = view  # staging is recycled

@@ -136,6 +136,41 @@ def test_train_offloaded_optimizer_resume(tmp_path):
     assert "divergent trajectory" in r.stderr
 
 
+def test_train_full_offload_triad(tmp_path):
+    """--offload-opt + --remat nvme --offload-acts in ONE run: weights
+    stream in at init, Adam moments live on NVMe, AND layer activations
+    round-trip through the engine per step — the full
+    larger-than-device-memory story in a single command.  Single
+    device (the activation store's ordered io_callbacks are
+    single-device by scope)."""
+    (tmp_path / "data").mkdir()
+    sys.path.insert(0, str(REPO))
+    from examples.train_lm import _synthesize_shards
+    from nvme_strom_tpu.models.transformer import tiny_config
+    _synthesize_shards(str(tmp_path / "data"), tiny_config(),
+                       n_shards=2, per_shard=8)
+    # the pytest conftest exports an 8-device XLA_FLAGS — override it:
+    # this path is single-device by design and guards against meshes
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "examples" / "train_lm.py"),
+         "--tiny", "--steps", "3", "--save-every", "2",
+         "--global-batch", "4",
+         "--offload-opt", str(tmp_path / "opt"),
+         "--remat", "nvme", "--offload-acts", str(tmp_path / "acts"),
+         "--ckpt-dir", str(tmp_path / "ckpt"),
+         "--data-dir", str(tmp_path / "data")],
+        capture_output=True, text=True, timeout=300, env=env,
+        cwd=str(REPO))
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "offload-opt:" in r.stdout
+    assert "offload-acts:" in r.stdout
+    assert (tmp_path / "acts" / "acts.bin").exists()
+    losses = [float(m) for m in re.findall(r"loss=([\d.]+)", r.stdout)]
+    assert losses and all(l == l and l < 100 for l in losses)
+
+
 def test_train_vit_fixedrec(tmp_path):
     """examples/train_vit.py: the config-3 consumer loop — fixedrec
     records stream to device and decode THERE (slice + bitcast inside
