@@ -76,8 +76,15 @@ class ActivationStore:
             pass
         self._fh = self.engine.open(self.path, writable=True)
         self._pending: Dict[int, list] = {}
+        self._written: set = set()
+        #: slot → in-flight [(dest offset, PendingRead)] submitted
+        #: ahead of the consumer (backward walks slots high→low, so a
+        #: read of slot i prefetches slot i-1 — the NVMe latency rides
+        #: under layer i's recompute instead of in front of i-1's)
+        self._prefetch: Dict[int, list] = {}
         self.writes = 0
         self.reads = 0
+        self.prefetch_hits = 0
 
     # -- host-callback endpoints (called by io_callback) -----------------
 
@@ -95,27 +102,57 @@ class ActivationStore:
                 f"store layout {self._shape}/{self._dtype} — one store "
                 "serves one step shape; use a second store")
         self._drain(slot)          # an unread previous write is stale
+        self._discard_prefetch(slot)   # it would serve last step's bytes
         pend: list = []
         from nvme_strom_tpu.ops.bridge import submit_chunked_writes
         submit_chunked_writes(self.engine, self._fh,
                               slot * self._slot_bytes,
                               host.view(np.uint8).reshape(-1), pend)
         self._pending[slot] = pend
+        self._written.add(slot)
         self.writes += 1
 
-    def read(self, slot) -> np.ndarray:
-        slot = int(slot)
-        if self._slot_bytes is None:
-            raise ValueError("read before any write")
-        self._drain(slot)
+    def _submit_slot_read(self, slot: int) -> list:
         nbytes = int(np.prod(self._shape)) * self._dtype.itemsize
         off0 = slot * self._slot_bytes
         from nvme_strom_tpu.ops.bridge import split_ranges
         ranges, _ = split_ranges([(off0, nbytes)],
                                  self.engine.config.chunk_bytes)
-        out = np.empty(nbytes, np.uint8)
-        reqs = [(off - off0, self.engine.submit_read(self._fh, off, ln))
+        return [(off - off0, self.engine.submit_read(self._fh, off, ln))
                 for off, ln in ranges]
+
+    def _discard_prefetch(self, slot: int) -> None:
+        # release() alone: it waits out in-flight DMA (the -EBUSY path)
+        # without raising, so a failed SPECULATIVE read — whose bytes
+        # were about to be thrown away anyway — can't kill the step,
+        # and every chunk's staging buffer goes back to the pool even
+        # when an earlier chunk errored
+        for _, r in self._prefetch.pop(slot, ()):
+            try:
+                r.release()
+            except OSError:
+                pass
+
+    def read(self, slot) -> np.ndarray:
+        slot = int(slot)
+        if self._slot_bytes is None:
+            raise ValueError("read before any write")
+        reqs = self._prefetch.pop(slot, None)
+        if reqs is not None:
+            self.prefetch_hits += 1
+        else:
+            self._drain(slot)
+            reqs = self._submit_slot_read(slot)
+        # backward's next consumer is slot-1: submit its read NOW so
+        # the NVMe leg overlaps this layer's recompute (a write of the
+        # slot invalidates the prefetch, and a miss just reads fresh)
+        nxt = slot - 1
+        if (nxt >= 0 and nxt not in self._prefetch
+                and nxt in self._written):
+            self._drain(nxt)
+            self._prefetch[nxt] = self._submit_slot_read(nxt)
+        nbytes = int(np.prod(self._shape)) * self._dtype.itemsize
+        out = np.empty(nbytes, np.uint8)
         for pos, r in reqs:
             view = r.wait()
             out[pos:pos + view.nbytes] = view  # staging is recycled
@@ -133,6 +170,8 @@ class ActivationStore:
         if getattr(self, "_fh", None) is not None:
             for s in list(self._pending):
                 self._drain(s)
+            for s in list(self._prefetch):
+                self._discard_prefetch(s)
             self.engine.close(self._fh)
             self._fh = None
         if self._own_engine and self.engine is not None:

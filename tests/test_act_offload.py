@@ -110,6 +110,27 @@ def test_store_mechanics(tmp_path):
             st2.read(0)
 
 
+def test_backward_order_prefetch(tmp_path):
+    """Reading slots high→low (backward's order) prefetches slot-1
+    under the consumer's recompute; a rewrite of a prefetched slot
+    invalidates the stale bytes."""
+    with ActivationStore(str(tmp_path / "p.bin"), n_slots=4) as st:
+        arrs = [np.full((2048,), i, np.float32) for i in range(4)]
+        for i, a in enumerate(arrs):
+            st.write(i, a)
+        for i in (3, 2, 1, 0):
+            np.testing.assert_array_equal(st.read(i), arrs[i])
+        assert st.prefetch_hits == 3      # slots 2, 1, 0 were prefetched
+        # next step: slot 3 read prefetches slot 2, then slot 2 is
+        # REWRITTEN before its read — the prefetch must not serve the
+        # old bytes
+        for i, a in enumerate(arrs):
+            st.write(i, a)
+        np.testing.assert_array_equal(st.read(3), arrs[3])   # prefetches 2
+        st.write(2, arrs[2] * 10)
+        np.testing.assert_array_equal(st.read(2), arrs[2] * 10)
+
+
 def test_policy_requires_store():
     cfg = dataclasses.replace(_f32(tiny_config()), remat_policy="nvme")
     params = init_params(jax.random.key(6), cfg)
