@@ -365,7 +365,7 @@ def main() -> int:
         print(f"  {name:<22} L{s['line']:>3} {_age(s['ts']):>9}  "
               f"{s['value']} {s['unit']}{vb}")
     print("\nBASELINE-contract coverage (configs 1-5 = the contract, "
-          "6-16 = extended):")
+          "6-18 = extended):")
     for cfg, c in rep["contract"].items():
         if c["status"] == "missing":
             print(f"  cfg {cfg:>2} {c['label']:<42} MISSING — no valid "
