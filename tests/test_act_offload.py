@@ -162,3 +162,39 @@ def test_bf16_activations_roundtrip(store_dir):
     assert float(l_off) == pytest.approx(float(l_ref), rel=1e-6)
     assert all(bool(jnp.isfinite(v.astype(jnp.float32)).all())
                for v in jax.tree.leaves(g_off))
+
+
+def test_accum_steps_reuse_slots_within_one_step(store_dir):
+    """Gradient accumulation runs fwd+bwd per MICROBATCH inside one
+    jitted step — each microbatch rewrites and re-reads every slot.
+    The ordered callbacks must serialize write(i)...read(i) per
+    microbatch, and the accumulated update must match the accum step
+    WITHOUT offload exactly (f32)."""
+    import optax
+    cfg = dataclasses.replace(_f32(tiny_config()), remat_policy="nvme")
+    plain = dataclasses.replace(cfg, remat_policy="none")
+    params = init_params(jax.random.key(9), cfg)
+    tokens = jax.random.randint(jax.random.key(10), (4, 32), 0,
+                                cfg.vocab)
+    opt = optax.adamw(1e-3)
+
+    def run(c, store):
+        p = jax.tree_util.tree_map(jnp.copy, params)
+        st = opt.init(p)
+        step = jax.jit(make_train_step(c, opt, accum_steps=2,
+                                       act_store=store))
+        for _ in range(2):
+            p, st, loss = step(p, st, tokens)
+        return p, float(loss)
+
+    p_ref, l_ref = run(plain, None)
+    with ActivationStore(store_dir, cfg.n_layers) as st:
+        p_off, l_off = run(cfg, st)
+        # 2 steps x 2 microbatches x n_layers writes+reads
+        assert st.writes == 2 * 2 * cfg.n_layers
+        assert st.reads == 2 * 2 * cfg.n_layers
+    np.testing.assert_allclose(l_off, l_ref, rtol=1e-6)
+    for k in p_ref:
+        np.testing.assert_allclose(np.asarray(p_off[k]),
+                                   np.asarray(p_ref[k]),
+                                   rtol=1e-5, atol=1e-7, err_msg=k)
