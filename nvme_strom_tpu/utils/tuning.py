@@ -107,6 +107,39 @@ def _attn_blocks_cached(q_seq: int, kv_seq: int, path: str,
     return (best_q, best_k) if best_q is not None else None
 
 
+_SQL_FOLD = re.compile(r"method=(\w+) window=(\d+)MiB")
+
+
+def best_sql_fold(path: str | None = None) -> dict | None:
+    """Ledgered best config-5 fold operating point, or None.
+
+    The round-5 bisect ledgers suite_5 variants whose tags carry
+    ``method=<matmul|scatter> window=<N>MiB`` (bench_sql stamps every
+    row); the winner by measured GiB/s among VALID dev=tpu rows with a
+    credible ratio (≤1.05 — over-ceiling rows are link-flap evidence)
+    becomes the default operating point of later runs, exactly like
+    the flash-tiling adoption (best_attn_blocks).  Explicit
+    STROM_SQL_METHOD / STROM_SQL_WINDOW_BYTES env always win;
+    STROM_BENCH_AUTO_TUNE=0 opts out entirely."""
+    if os.environ.get("STROM_BENCH_AUTO_TUNE", "1") == "0":
+        return None
+    best, best_rate = None, 0.0
+    for r in _iter_results("suite_5", path or _LEDGER):
+        m = _SQL_FOLD.search(str(r.get("metric", "")))
+        if not m:
+            continue
+        vb = r.get("vs_baseline")
+        if vb is not None and not 0 < vb <= 1.05:
+            continue
+        rate = r.get("value") or 0.0
+        if rate > best_rate:
+            best_rate = rate
+            best = {"method": m.group(1),
+                    "window_bytes": int(m.group(2)) << 20,
+                    "gibs": rate}
+    return best
+
+
 def best_attn_blocks(q_seq: int, kv_seq: int,
                      path: str | None = None) -> tuple[int, int] | None:
     """Ledgered best flash-attention (block_q, block_k) for the probed

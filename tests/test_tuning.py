@@ -117,3 +117,43 @@ def test_best_attn_blocks_skips_voided_rows(tmp_path):
     p = tmp_path / "ledger.jsonl"
     p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
     assert tuning.best_attn_blocks(1024, 1024, str(p)) == (128, 256)
+
+
+def test_best_sql_fold_adoption(tmp_path, monkeypatch):
+    """The config-5 bisect's ledgered winner (max GiB/s among valid
+    rows with a credible ratio) becomes the fold operating point;
+    over-ceiling rows and voided rows can't win; opt-out respected."""
+    rows = [
+        {"step": "suite_5_v6", "rc": 0, "device": "tpu TPU v5 lite0",
+         "results": [{
+             "metric": "config5:parquet-groupby-scan (dev=tpu, "
+                       "method=matmul window=64MiB)",
+             "value": 0.15, "unit": "GiB/s", "vs_baseline": 0.30}]},
+        {"step": "suite_5_sw256", "rc": 0, "device": "tpu TPU v5 lite0",
+         "results": [{
+             "metric": "config5:parquet-groupby-scan (dev=tpu, "
+                       "method=scatter window=256MiB)",
+             "value": 0.82, "unit": "GiB/s", "vs_baseline": 0.91}]},
+        # faster, but over-ceiling ratio: a link-flap minute, not a
+        # faster fold — inadmissible as the winner
+        {"step": "suite_5_scatter", "rc": 0,
+         "device": "tpu TPU v5 lite0",
+         "results": [{
+             "metric": "config5:parquet-groupby-scan (dev=tpu, "
+                       "method=scatter window=64MiB)",
+             "value": 1.9, "unit": "GiB/s", "vs_baseline": 1.4}]},
+        # fastest of all but tombstoned
+        {"step": "suite_5_w256", "rc": 0, "valid": False,
+         "invalid_reason": "x", "device": "tpu TPU v5 lite0",
+         "results": [{
+             "metric": "config5:parquet-groupby-scan (dev=tpu, "
+                       "method=matmul window=256MiB)",
+             "value": 2.5, "unit": "GiB/s", "vs_baseline": 0.95}]},
+    ]
+    p = tmp_path / "ledger.jsonl"
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    best = tuning.best_sql_fold(str(p))
+    assert best["method"] == "scatter"
+    assert best["window_bytes"] == 256 << 20
+    monkeypatch.setenv("STROM_BENCH_AUTO_TUNE", "0")
+    assert tuning.best_sql_fold(str(p)) is None
