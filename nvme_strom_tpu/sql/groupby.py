@@ -51,7 +51,15 @@ def groupby_aggregate(keys: jax.Array, values: jax.Array, num_groups: int,
     Empty groups (count 0): mean/min/max are NaN (SQL-NULL-like).
     ``empty_as_nan=False`` keeps the raw segment identities (±inf) so
     partial results stay foldable across row groups (sql_groupby's
-    incremental path)."""
+    incremental path).
+
+    PRECISION POLICY: all float aggregates compute in f32 (JAX runs
+    x64-disabled; f64 inputs — e.g. a Parquet DOUBLE column — downcast
+    at the fold).  A SUM over n values carries relative error
+    ~n·2⁻²⁴ of Σ|v| — measured ~2e-5 on a 25k-row double column —
+    where PostgreSQL's float8 SUM would accumulate in f64.  Exact
+    integer aggregates (COUNT) are unaffected (counts are exact in f32
+    far beyond any row-group size, then cast to int32)."""
     for a in aggs:
         if a not in _AGGS and a != "sum2":   # sum2: internal foldable
             raise ValueError(f"unknown aggregate {a!r}")
