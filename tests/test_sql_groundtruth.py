@@ -1,0 +1,85 @@
+"""Randomized SQL front-end sweep against pyarrow-computed ground
+truth — the bounded, committed form of the round-5 idle-window fuzz
+(56 queries over 14 seeds, one finding: the f32 accumulation floor,
+now documented in groupby_aggregate's precision policy).
+
+Each seed builds a random multi-row-group table and checks GROUP BY
+aggregates, WHERE pushdown with aliases, scalar aggregates, and
+ORDER BY+LIMIT against numpy/pyarrow reference answers, at tolerances
+derived from the stated f32 policy (absolute floor scaled by Σ|v|)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pyarrow.parquet as pq
+import pytest
+
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.sql.parquet import ParquetScanner
+from nvme_strom_tpu.sql.parser import sql_query
+
+
+@pytest.mark.parametrize("seed", [0, 3, 7])
+def test_random_queries_match_pyarrow(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    rows = int(rng.integers(2000, 12000))
+    ngroups = int(rng.integers(2, 24))
+    k = rng.integers(0, ngroups, rows).astype(np.int32)
+    v = (rng.standard_normal(rows) * 100).astype(np.float64)
+    w = rng.integers(-50, 50, rows).astype(np.int64)
+    tbl = pa.table({"k": k, "v": v, "w": w})
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(tbl, path, compression="none", use_dictionary=False,
+                   row_group_size=max(1024, rows // 4))
+    # f32 accumulation floor (the documented policy): abs error of a
+    # group SUM is bounded by a few ulps of the group's Σ|v|
+    tol = 16 * np.abs(v).sum() * 2.0 ** -24
+
+    with StromEngine() as eng:
+        sc = ParquetScanner(path, eng)
+
+        got = sql_query("SELECT k, COUNT(*), SUM(v), MEAN(v) FROM t "
+                        "GROUP BY k", {"t": sc})
+        gk = np.asarray(got["k"])
+        order = np.argsort(gk)
+        gk = gk[order]
+        np.testing.assert_array_equal(gk, np.unique(k))
+        want_c = np.array([(k == key).sum() for key in gk])
+        want_s = np.array([v[k == key].sum() for key in gk])
+        np.testing.assert_array_equal(
+            np.asarray(got["count(*)"])[order], want_c)
+        np.testing.assert_allclose(np.asarray(got["sum(v)"])[order],
+                                   want_s, atol=tol, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got["mean(v)"])[order],
+                                   want_s / want_c, atol=tol, rtol=1e-4)
+
+        lo, hi = int(rng.integers(-40, 0)), int(rng.integers(1, 40))
+        got = sql_query(
+            f"SELECT k, COUNT(*) AS n, MAX(w) AS mw FROM t "
+            f"WHERE w >= {lo} AND w < {hi} GROUP BY k", {"t": sc})
+        sel = (w >= lo) & (w < hi)
+        gk = np.asarray(got["k"])
+        order = np.argsort(gk)
+        gk = gk[order]
+        np.testing.assert_array_equal(gk, np.unique(k[sel]))
+        np.testing.assert_array_equal(
+            np.asarray(got["n"])[order],
+            np.array([(sel & (k == key)).sum() for key in gk]))
+        np.testing.assert_array_equal(
+            np.asarray(got["mw"])[order],
+            np.array([w[sel & (k == key)].max() for key in gk]))
+
+        got = sql_query("SELECT MIN(v), MAX(v), SUM(w) FROM t",
+                        {"t": sc})
+        assert float(np.asarray(got["min(v)"])) == pytest.approx(
+            float(pc.min(tbl["v"]).as_py()), rel=1e-6)
+        assert float(np.asarray(got["max(v)"])) == pytest.approx(
+            float(pc.max(tbl["v"]).as_py()), rel=1e-6)
+        assert float(np.asarray(got["sum(w)"])) == pytest.approx(
+            float(pc.sum(tbl["w"]).as_py()), abs=tol)
+
+        got = sql_query("SELECT v, w FROM t ORDER BY v DESC LIMIT 7",
+                        {"t": sc})
+        np.testing.assert_allclose(
+            np.sort(np.asarray(got["v"]))[::-1],
+            np.sort(v)[::-1][:7], rtol=1e-6)
