@@ -366,6 +366,40 @@ struct strom_engine {
       st_written{0}, st_sub{0}, st_comp{0}, st_fail{0}, st_retry{0},
       st_resident{0};
   bool probe_residency = true;   /* STROM_NO_RESIDENCY_PROBE disables */
+
+  /* Fault injection BELOW Python (stress/chaos runs; see
+   * nvme_strom_tpu/io/faults.py for the Python-level plan): read at
+   * engine create from STROM_FAULT_READ_EIO_EVERY /
+   * STROM_FAULT_READ_SHORT_EVERY / STROM_FAULT_READ_DELAY_MS.  All
+   * zero (the default) keeps this path entirely off the hot loop. */
+  uint64_t fault_eio_every = 0, fault_short_every = 0, fault_delay_ns = 0;
+  std::atomic<uint64_t> fault_seq{0};
+
+  /* Applied at the read completion boundary (both backends funnel
+   * through here right before complete(r)): a delay holds the
+   * completion in flight — a latency straggler as the waiter sees it —
+   * then every Nth read is failed with -EIO or halved (a short read
+   * the caller must detect and recover). */
+  void maybe_inject_read_fault(Req *r) {
+    if (r->is_write ||
+        !(fault_eio_every | fault_short_every | fault_delay_ns))
+      return;
+    uint64_t n = fault_seq.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (fault_delay_ns) {
+      struct timespec ts = {
+          (time_t)(fault_delay_ns / 1000000000ull),
+          (long)(fault_delay_ns % 1000000000ull)};
+      nanosleep(&ts, nullptr);
+    }
+    if (fault_eio_every && n % fault_eio_every == 0) {
+      r->status = -EIO;
+      r->done_len = 0;
+      st_fail.fetch_add(1, std::memory_order_relaxed);
+    } else if (fault_short_every && n % fault_short_every == 0 &&
+               r->status == 0 && r->done_len > 1) {
+      r->done_len /= 2;
+    }
+  }
   std::atomic<uint64_t> lat_read[STROM_LAT_BUCKETS] = {};
   std::atomic<uint64_t> lat_write[STROM_LAT_BUCKETS] = {};
 
@@ -604,6 +638,7 @@ struct strom_engine {
           read_sync(r, fe);
           r->was_fallback = true;
         }
+        maybe_inject_read_fault(r);
         complete(r);
       });
     }
@@ -631,6 +666,7 @@ struct strom_engine {
         write_sync(r, fe);
       else
         read_sync(r, fe);
+      maybe_inject_read_fault(r);
       complete(r);
     }
   }
@@ -663,6 +699,17 @@ strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
    * so neither NVMe DMA nor the TPU transfer hits a fault. Soft-fail. */
   if (lock_buffers) e->locked = mlock(e->pool, e->pool_sz) == 0;
   e->probe_residency = getenv("STROM_NO_RESIDENCY_PROBE") == nullptr;
+  {
+    /* Chaos knobs (tests/stress only; all default off — see
+     * maybe_inject_read_fault). */
+    auto env_u64 = [](const char *name) -> uint64_t {
+      const char *v = getenv(name);
+      return v ? strtoull(v, nullptr, 10) : 0;
+    };
+    e->fault_eio_every = env_u64("STROM_FAULT_READ_EIO_EVERY");
+    e->fault_short_every = env_u64("STROM_FAULT_READ_SHORT_EVERY");
+    e->fault_delay_ns = env_u64("STROM_FAULT_READ_DELAY_MS") * 1000000ull;
+  }
   for (int i = (int)n_buffers - 1; i >= 0; i--) e->free_bufs.push_back(i);
 
   if (use_io_uring && e->ring.init(queue_depth * 2)) {

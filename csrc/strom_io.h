@@ -83,7 +83,16 @@ void strom_get_latency(strom_engine *eng,
  *   alignment    — O_DIRECT alignment (power of two, >= 512)
  *   use_io_uring — 0 forces the thread-pool backend
  *   lock_buffers — mlock the pool (pin pages, as MAP_GPU_MEMORY pins BAR1)
- * Returns NULL on failure (errno set). */
+ * Returns NULL on failure (errno set).
+ *
+ * Fault injection below the C ABI (chaos/stress runs; default off) is
+ * read from the environment at create time:
+ *   STROM_FAULT_READ_EIO_EVERY=N    every Nth read completes -EIO
+ *   STROM_FAULT_READ_SHORT_EVERY=N  every Nth read reports half its bytes
+ *   STROM_FAULT_READ_DELAY_MS=D     every read completion held D ms
+ * The Python-level plan (nvme_strom_tpu/io/faults.py) is richer and
+ * deterministic; these knobs exist to exercise the native completion
+ * path itself. */
 strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
                                   uint64_t buf_bytes, uint32_t alignment,
                                   int use_io_uring, int lock_buffers);

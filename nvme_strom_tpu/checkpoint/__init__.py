@@ -13,6 +13,7 @@ the read side.  This package supplies the trainer-facing layer on top:
 
 from nvme_strom_tpu.checkpoint.manager import (  # noqa: F401
     CheckpointManager,
+    TargetMismatchError,
     flatten_with_names,
     unflatten_from_names,
 )

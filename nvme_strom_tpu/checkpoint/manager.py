@@ -26,6 +26,7 @@ assembled.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import shutil
@@ -39,10 +40,18 @@ from nvme_strom_tpu.formats.safetensors import (
     _np_dtype,
     write_safetensors_engine,
 )
-from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.io.engine import StromEngine, wait_exact
 from nvme_strom_tpu.utils.config import EngineConfig
 
 _STEP_RE = re.compile(r"^step_(\d{8})$")
+_log = logging.getLogger(__name__)
+
+
+class TargetMismatchError(ValueError):
+    """The restore target's schema disagrees with the checkpoint (wrong
+    shape, renamed/missing tensor): a code bug, never checkpoint damage
+    — restore-fallback must not step past it to an older checkpoint
+    that would fail (or, worse, silently fit) the same wrong target."""
 
 
 # --------------------------------------------------------------------------
@@ -139,6 +148,9 @@ class CheckpointManager:
         self._engine = engine
         self._executor = None      # lazy, one IO thread (save_async)
         self._pending = None
+        #: step the last successful restore() actually read — differs
+        #: from the requested step when restore-fallback engaged
+        self.last_restore_step: Optional[int] = None
         os.makedirs(self.directory, exist_ok=True)
 
     # -- introspection -----------------------------------------------------
@@ -486,21 +498,95 @@ class CheckpointManager:
 
     # -- restore -----------------------------------------------------------
 
+    #: exception classes that mean "this checkpoint is damaged" (torn
+    #: manifest, missing/truncated tile file, under-covered region) —
+    #: the set restore-fallback steps past.  Target-schema errors
+    #: (TargetMismatchError, KeyError from a tensor the target has but
+    #: the manifest lacks) are NOT damage: they are code bugs that every
+    #: candidate would reproduce, so they stay fatal on the first step.
+    _DAMAGE = (OSError, ValueError, json.JSONDecodeError)
+
     def restore(self, target, step: Optional[int] = None,
-                shardings: Union[Dict, Callable, None] = None):
+                shardings: Union[Dict, Callable, None] = None,
+                fallback: bool = True):
         """Read checkpoint ``step`` (default: latest) into ``target``'s
         structure.  Leaf placement: ``shardings`` (dict name→Sharding or
         fn(name, shape)→Sharding) wins; else a jax.Array target leaf's own
-        sharding; else the array stays a host-resident numpy array."""
-        import jax
+        sharding; else the array stays a host-resident numpy array.
 
+        ``fallback`` (docs/RESILIENCE.md): when the chosen step turns
+        out damaged — manifest unreadable, a tile file missing or
+        truncated, a region under-covered — fall back to the next-older
+        intact step instead of killing the run on a checkpoint that no
+        retry can repair.  Every step skipped is logged loudly, counted
+        (``StromStats.restore_fallbacks``), and traced; the step
+        actually restored lands in ``self.last_restore_step``.  Only
+        when NO candidate restores does the last error surface (the
+        original exception when a single candidate existed).  Pass
+        ``fallback=False`` to fail fast on exactly the requested step.
+        """
         self.wait_pending()  # never read past an in-flight async save
 
+        steps = self.all_steps()
         if step is None:
-            step = self.latest_step()
-            if step is None:
+            if not steps:
                 raise FileNotFoundError(
                     f"no checkpoints under {self.directory}")
+            candidates = steps[::-1]
+        else:
+            if step not in steps and not os.path.isdir(self.step_dir(step)):
+                # a step that never existed is a caller bug (typo),
+                # not damage — silently restoring an older step here
+                # would resume training from the wrong state
+                raise FileNotFoundError(
+                    f"checkpoint step {step} does not exist under "
+                    f"{self.directory} (have {steps})")
+            # the pinned step first (even if its manifest no longer
+            # parses — the failure itself is the fallback trigger),
+            # then every intact older step
+            candidates = [step] + [s for s in steps[::-1] if s < step]
+        if not fallback:
+            candidates = candidates[:1]
+
+        # flatten ONCE, before any candidate: a malformed target
+        # (duplicate flattened names) is a code bug and must raise here,
+        # not be retried against every checkpoint as "damage"
+        named_t, treedef = flatten_with_names(target)
+
+        eng, own = self._get_engine()
+        try:
+            for i, s in enumerate(candidates):
+                try:
+                    out = self._restore_step(eng, named_t, treedef, s,
+                                             shardings)
+                except self._DAMAGE as e:
+                    if isinstance(e, TargetMismatchError):
+                        raise       # schema bug, not damage
+                    if i + 1 >= len(candidates):
+                        raise
+                    eng.stats.add(restore_fallbacks=1)
+                    tracer = getattr(eng, "tracer", None)
+                    if tracer is not None and tracer.enabled:
+                        now = time.monotonic_ns()
+                        tracer.add_span(
+                            "strom.ckpt.restore_fallback", now, now,
+                            category="strom.resilient", step=s,
+                            next_step=candidates[i + 1],
+                            error=f"{type(e).__name__}: {e}")
+                    _log.warning(
+                        "checkpoint step %d is damaged (%s: %s); "
+                        "falling back to step %d", s, type(e).__name__,
+                        e, candidates[i + 1])
+                else:
+                    self.last_restore_step = s
+                    return out
+        finally:
+            if own:
+                eng.close_all()
+
+    def _restore_step(self, eng, named_t, treedef, step: int,
+                      shardings: Union[Dict, Callable, None]):
+        """One restore attempt against exactly checkpoint ``step``."""
         d = self.step_dir(step)
         with open(os.path.join(d, "meta.json")) as f:
             meta = json.load(f)
@@ -510,24 +596,18 @@ class CheckpointManager:
                 "(this reader is format 2, the general tile index; "
                 "re-save from the run that wrote it)")
 
-        named_t, treedef = flatten_with_names(target)
         files: Dict[str, SafetensorsFile] = {}
-        eng, own = self._get_engine()
         out: Dict[str, object] = {}
-        try:
-            for name, tleaf in named_t.items():
-                if tleaf is None:
-                    out[name] = None
-                    continue
-                info = meta["tensors"].get(name)
-                if info is None:
-                    raise KeyError(
-                        f"checkpoint step {step} lacks tensor {name!r}")
-                out[name] = self._restore_leaf(
-                    eng, d, files, name, info, tleaf, shardings)
-        finally:
-            if own:
-                eng.close_all()
+        for name, tleaf in named_t.items():
+            if tleaf is None:
+                out[name] = None
+                continue
+            info = meta["tensors"].get(name)
+            if info is None:
+                raise KeyError(
+                    f"checkpoint step {step} lacks tensor {name!r}")
+            out[name] = self._restore_leaf(
+                eng, d, files, name, info, tleaf, shardings)
         return unflatten_from_names(treedef, out, list(named_t))
 
     def _restore_leaf(self, eng, cdir, files, name, info, tleaf, shardings):
@@ -538,13 +618,22 @@ class CheckpointManager:
         np_dt = _np_dtype(info["dtype"])
         t_shape = tuple(np.shape(tleaf))
         if t_shape != shape:
-            raise ValueError(f"{name}: checkpoint shape {shape} != "
-                             f"target shape {t_shape}")
+            raise TargetMismatchError(
+                f"{name}: checkpoint shape {shape} != "
+                f"target shape {t_shape}")
 
         sh = None
         if shardings is not None:
-            sh = (shardings.get(name) if isinstance(shardings, dict)
-                  else shardings(name, shape))
+            try:
+                sh = (shardings.get(name) if isinstance(shardings, dict)
+                      else shardings(name, shape))
+            except Exception as e:
+                # a user shardings callable blowing up is a code bug —
+                # must not be classified as checkpoint damage and walked
+                # past to older steps (it would fail them all identically)
+                raise TargetMismatchError(
+                    f"shardings callback failed for {name!r}: "
+                    f"{type(e).__name__}: {e}") from e
         if sh is None and isinstance(tleaf, jax.Array) \
                 and hasattr(tleaf, "sharding"):
             sh = tleaf.sharding
@@ -670,27 +759,42 @@ class CheckpointManager:
         one copy into the result buffer is inherent and counted)."""
         out = np.empty(length, dtype=np.uint8)
         fh = eng.open(path)
+        pend: list = []
         try:
             chunk = eng.config.chunk_bytes
-            pend = []
             pos = 0
             for o in range(0, length, chunk):
                 pend.append((eng.submit_read(fh, offset + o,
                                              min(chunk, length - o))))
                 if len(pend) >= max(2, eng.config.queue_depth // 2):
                     p = pend.pop(0)
-                    v = p.wait()
+                    v = wait_exact(p)   # truncated tile must fail HERE
                     out[pos:pos + v.nbytes] = v
                     pos += v.nbytes
                     p.release()
             while pend:
                 p = pend.pop(0)
-                v = p.wait()
+                v = wait_exact(p)
                 out[pos:pos + v.nbytes] = v
                 pos += v.nbytes
                 p.release()
         finally:
+            # a failed wait leaves younger reads in flight: they must be
+            # released or their staging buffers are lost for the engine's
+            # lifetime — and restore()'s fallback loop REUSES this engine
+            # on the next candidate step
+            for p in pend:
+                p.release()
             eng.close(fh)
+        if pos != length:
+            # belt over wait_exact's braces: a truncated tile must fail
+            # verification here, never reach the restored state as the
+            # np.empty tail — the raise is what restore()'s
+            # fallback-to-previous-step catches
+            import errno as _errno
+            raise OSError(_errno.EIO,
+                          f"short tile read: {pos} of {length} bytes",
+                          str(path))
         eng.stats.add(bounce_bytes=int(length))
         return out
 
@@ -699,7 +803,8 @@ class CheckpointManager:
     def _get_engine(self) -> tuple[StromEngine, bool]:
         if self._engine is not None:
             return self._engine, False
-        return StromEngine(EngineConfig()), True
+        from nvme_strom_tpu.io.faults import build_engine
+        return build_engine(EngineConfig()), True
 
     @staticmethod
     def _sync() -> None:

@@ -1,6 +1,7 @@
-from nvme_strom_tpu.data.loader import ShardedLoader
+from nvme_strom_tpu.data.loader import (LoaderErrors, ShardReadError,
+                                        ShardedLoader)
 from nvme_strom_tpu.data.mixture import MixtureLoader
 from nvme_strom_tpu.data.sharding import assign_shards, shuffled_indices
 
 __all__ = ["ShardedLoader", "MixtureLoader", "assign_shards",
-           "shuffled_indices"]
+           "shuffled_indices", "ShardReadError", "LoaderErrors"]

@@ -18,9 +18,11 @@ array is assembled without bulk cross-host traffic.
 
 from __future__ import annotations
 
+import logging
 import os
 import queue
 import threading
+import time
 from typing import Callable, Iterator, List, Optional, Sequence
 
 import numpy as np
@@ -28,11 +30,39 @@ import numpy as np
 from nvme_strom_tpu.data.sharding import assign_shards, shuffled_indices
 from nvme_strom_tpu.formats.tfrecord import TFRecordIndex
 from nvme_strom_tpu.formats.wds import WdsShardIndex
-from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.io.engine import StromEngine, wait_exact
 from nvme_strom_tpu.parallel.mesh import batch_sharding
 from nvme_strom_tpu.utils.config import EngineConfig, LoaderConfig
 
 _SENTINEL = object()
+_log = logging.getLogger(__name__)
+
+
+class ShardReadError(RuntimeError):
+    """A shard failed (index/read/decode) and could not be quarantined.
+
+    Always names the originating shard (``path``); the underlying
+    exception rides along as ``__cause__``."""
+
+    def __init__(self, path: str, exc: BaseException, detail: str = ""):
+        self.path = str(path)
+        super().__init__(
+            f"shard {self.path}: {type(exc).__name__}: {exc}"
+            + (f" ({detail})" if detail else ""))
+
+
+class LoaderErrors(RuntimeError):
+    """Several producer-side errors queued before the consumer saw any.
+
+    3.10-compatible stand-in for ExceptionGroup: every queued error is
+    in ``errors`` (oldest first) and in the message; the first is also
+    the ``__cause__`` chain root."""
+
+    def __init__(self, errors):
+        self.errors = list(errors)
+        super().__init__(
+            f"{len(self.errors)} loader errors: "
+            + "; ".join(f"{type(e).__name__}: {e}" for e in self.errors))
 
 
 def _process_span(sharding, global_shape, dim: int, proc: int):
@@ -150,6 +180,12 @@ class ShardedLoader:
                 raise ValueError(
                     f"{fmt} cannot seq-shard: a device's seq slice of "
                     "every row is not a contiguous file span")
+            if config is not None and config.shard_error_budget > 0:
+                raise ValueError(
+                    f"{fmt} does not support shard_error_budget: its "
+                    "batch spans coalesce across shards, so per-shard "
+                    "quarantine isolation does not exist — zero-copy "
+                    "paths fail fast (docs/RESILIENCE.md)")
         self.mesh = mesh
         self.axis = axis
         self.seq_axis = seq_axis
@@ -186,9 +222,17 @@ class ShardedLoader:
                 f"{n_groups} batch-axis groups")
         self.local_batch = global_batch // n_groups
         self.local_shards = assign_shards(shard_paths, group_idx, n_groups)
-        self._engine = engine or StromEngine(EngineConfig())
-        self._owns_engine = engine is None
+        if engine is None:
+            from nvme_strom_tpu.io.faults import build_engine
+            engine, self._owns_engine = build_engine(EngineConfig()), True
+        else:
+            self._owns_engine = False
+        self._engine = engine
         self.epoch = 0
+        #: shards skipped under config.shard_error_budget, in failure
+        #: order — public so a training loop can alert on degradation
+        self.quarantined: List[str] = []
+        self._quarantined_set: set = set()
         # shard files are immutable for the loader's lifetime: index
         # each once, not once per epoch — the per-epoch re-walk was a
         # whole extra pass of I/O per epoch.  LRU-bounded by
@@ -259,50 +303,99 @@ class ShardedLoader:
         return out
 
     def _iter_local_samples(self) -> Iterator[np.ndarray]:
-        eng = self._engine
         order = list(self.local_shards)
         if self.config.shuffle_buffer:
             perm = shuffled_indices(len(order), self.config.seed, self.epoch)
             order = [order[i] for i in perm]
         for path in order:
-            samples = self._index_shard(path)
-            sample_order = range(len(samples))
-            if self.config.shuffle_buffer:
-                sample_order = shuffled_indices(
-                    len(samples), self.config.seed + 1, self.epoch)
-            fh = eng.open(path)
-            pend: list = []
+            if str(path) in self._quarantined_set:
+                continue   # failed a previous epoch; still out
             try:
-                depth = max(2, eng.config.queue_depth // 2)
+                yield from self._shard_samples(path)
+            except Exception as e:   # GeneratorExit/KeyboardInterrupt pass
+                self._quarantine_or_raise(path, e)
 
-                def finish(entry):
-                    idx_parts, reads = entry
-                    parts = {}
+    def _quarantine_or_raise(self, path, e: Exception) -> None:
+        """The shard-quarantine policy (docs/RESILIENCE.md): under the
+        error budget the failing shard is skipped-and-logged (counted,
+        traced, excluded from later epochs); at budget the failure is
+        loud and carries the full quarantine list."""
+        budget = self.config.shard_error_budget
+        if budget <= 0:
+            raise ShardReadError(path, e) from e
+        if len(self.quarantined) >= budget:
+            raise ShardReadError(
+                path, e,
+                f"shard error budget ({budget}) exhausted; already "
+                f"quarantined: {self.quarantined}") from e
+        self.quarantined.append(str(path))
+        self._quarantined_set.add(str(path))
+        self._engine.stats.add(shards_quarantined=1)
+        tracer = getattr(self._engine, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            now = time.monotonic_ns()
+            tracer.add_span("strom.loader.quarantine", now, now,
+                            category="strom.resilient", shard=str(path),
+                            error=f"{type(e).__name__}: {e}")
+        _log.warning(
+            "quarantining shard %s after %s: %s (%d/%d of error budget "
+            "used)", path, type(e).__name__, e, len(self.quarantined),
+            budget)
+
+    def _shard_samples(self, path) -> Iterator[np.ndarray]:
+        """Index → pipelined reads → decode for ONE shard (the unit the
+        quarantine policy skips)."""
+        eng = self._engine
+        samples = self._index_shard(path)
+        sample_order = range(len(samples))
+        if self.config.shuffle_buffer:
+            sample_order = shuffled_indices(
+                len(samples), self.config.seed + 1, self.epoch)
+        fh = eng.open(path)
+        pend: list = []
+        try:
+            depth = max(2, eng.config.queue_depth // 2)
+
+            def finish(entry):
+                idx_parts, reads = entry
+                parts = {}
+                try:
                     for ext, p in reads.items():
-                        view = p.wait()
-                        parts[ext] = view.tobytes()  # host copy for decode
+                        # the index promised the bytes inside the shard:
+                        # a short read means truncation — loud
+                        # (quarantine-able), never a silently short
+                        # training sample
+                        view = wait_exact(p)
+                        parts[ext] = view.tobytes()  # host copy, decode
                         p.release()
-                    eng.stats.add(bounce_bytes=sum(
-                        len(v) for v in parts.values()))
-                    return self.decode(parts)
-
-                for si in sample_order:
-                    reads = {
-                        ext: eng.submit_read(fh, off, ln)
-                        for ext, (off, ln) in samples[si].items()}
-                    pend.append((si, reads))
-                    if len(pend) >= depth:
-                        yield finish(pend.pop(0))
-                while pend:
-                    yield finish(pend.pop(0))
-            finally:
-                # Drain before close: in-flight reads DMA into pool buffers
-                # and must be waited + released, or the pool leaks and the
-                # engine teardown would race the I/O.
-                for _, reads in pend:
+                finally:
+                    # a mid-sample failure must hand the sample's OTHER
+                    # reads back too — the entry already left pend, so
+                    # the outer drain cannot see them (release is
+                    # idempotent for the ones that got there)
                     for p in reads.values():
-                        p.release()  # waits if still in flight
-                eng.close(fh)
+                        p.release()
+                eng.stats.add(bounce_bytes=sum(
+                    len(v) for v in parts.values()))
+                return self.decode(parts)
+
+            for si in sample_order:
+                reads = {
+                    ext: eng.submit_read(fh, off, ln)
+                    for ext, (off, ln) in samples[si].items()}
+                pend.append((si, reads))
+                if len(pend) >= depth:
+                    yield finish(pend.pop(0))
+            while pend:
+                yield finish(pend.pop(0))
+        finally:
+            # Drain before close: in-flight reads DMA into pool buffers
+            # and must be waited + released, or the pool leaks and the
+            # engine teardown would race the I/O.
+            for _, reads in pend:
+                for p in reads.values():
+                    p.release()  # waits if still in flight
+            eng.close(fh)
 
     # -- batching + device placement ---------------------------------------
 
@@ -359,7 +452,12 @@ class ShardedLoader:
             except BaseException as e:  # surfaced in the consumer
                 err.append(e)
             finally:
-                gen.close()  # runs the sample iterator's drain/close
+                try:
+                    gen.close()  # runs the sample iterator's drain/close
+                except BaseException as e:
+                    # a drain/close failure is a SECOND error — queue it
+                    # too, never shadow (or be shadowed by) the first
+                    err.append(e)
                 put_checked(_SENTINEL)
 
         t = threading.Thread(target=producer, daemon=True)
@@ -368,8 +466,10 @@ class ShardedLoader:
             while True:
                 hb = q.get()
                 if hb is _SENTINEL:
-                    if err:
+                    if len(err) == 1:
                         raise err[0]
+                    if err:   # every queued error propagates, not just
+                        raise LoaderErrors(err) from err[0]   # err[0]
                     break
                 global_shape_of = (
                     lambda x: (self.global_batch,) + x.shape[1:])
@@ -494,7 +594,10 @@ class ShardedLoader:
             parts = []
             try:
                 for pr in prs:
-                    v = pr.wait()
+                    # the plan never crosses EOF, so a short read ==
+                    # truncation; the silent alternative is dropped
+                    # records and an opaque shape mismatch at assembly
+                    v = wait_exact(pr)
                     n = v.nbytes // rec_bytes
                     parts.append(host_to_device(
                         eng, v.view(dtype).reshape((n,) + rshape), dev))
@@ -842,7 +945,8 @@ class ShardedLoader:
                 for prs in groups:
                     parts = []
                     for pr in prs:
-                        parts.append(host_to_device(eng, pr.wait(), dev))
+                        parts.append(host_to_device(
+                            eng, wait_exact(pr), dev))
                         dispatched.append(parts[-1])
                     big = (parts[0] if len(parts) == 1
                            else jnp.concatenate(parts))

@@ -9,8 +9,22 @@ from nvme_strom_tpu.io.engine import (
     resolve_device,
     file_extents,
     file_eligible,
+    wait_exact,
+)
+from nvme_strom_tpu.io.faults import (
+    FaultPlan,
+    FaultSpec,
+    FaultyEngine,
+    build_engine,
+)
+from nvme_strom_tpu.io.resilient import (
+    ReadError,
+    ResilientEngine,
+    ResilientRead,
 )
 
 __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
            "DeviceInfo", "Extent", "check_file", "resolve_device",
-           "file_extents", "file_eligible"]
+           "file_extents", "file_eligible", "wait_exact",
+           "FaultPlan", "FaultSpec", "FaultyEngine", "build_engine",
+           "ReadError", "ResilientEngine", "ResilientRead"]

@@ -353,6 +353,13 @@ class PendingRead:
         self._error: Optional[OSError] = None
         self.was_fallback = False
 
+    @property
+    def length(self) -> int:
+        """Bytes REQUESTED at submit (the completed view may be shorter
+        only at EOF — consumers whose plans never cross EOF treat a
+        shorter view as a short read and recover or raise)."""
+        return self._length
+
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block for the completed staging view.
 
@@ -361,6 +368,18 @@ class PendingRead:
         releasing it (hang detection: the caller can diagnose, retry
         the wait, or ``release()`` to abort; the buffer stays a live
         DMA target until then).
+
+        The still-live contract after a TimeoutError, explicitly:
+
+        - retrying ``wait()`` on the same request is always valid and
+          returns the completed payload once the I/O lands;
+        - ``release()`` is the CANCEL path: it blocks until the request
+          is out of flight (the staging buffer is a live DMA target and
+          cannot be recycled under the kernel), then frees it — after
+          which a fresh ``submit_read`` of the same range is the
+          cancel-then-retry recovery ``io/resilient.py`` builds on
+          (tested in tests/test_engine.py
+          ``test_wait_timeout_cancel_then_retry``).
         """
         if self._view is not None:
             return self._view
@@ -423,6 +442,29 @@ class PendingRead:
 
     def __exit__(self, *exc):
         self.release()
+
+
+def wait_exact(pending, timeout: Optional[float] = None) -> np.ndarray:
+    """``pending.wait(timeout)`` + strict length verification.
+
+    For consumers whose read plans never cross EOF (index-derived
+    ranges: the loader's sample/record plans, checkpoint tiles, weight
+    slices, offload slots) a completed view shorter than the submit
+    request can only mean file truncation or a device short read — and
+    accepting it silently yields garbage-tailed tensors.  One helper so
+    every consumer enforces the invariant identically instead of
+    hand-rolling the check (works on PendingRead, FaultyRead, and
+    ResilientRead alike via their ``length`` property).  TimeoutError
+    passes through with the request still live (the ``wait`` contract);
+    the short-read OSError releases the request first.
+    """
+    view = pending.wait(timeout)
+    if view.nbytes != pending.length:
+        pending.release()
+        raise OSError(errno.EIO,
+                      f"short read: {view.nbytes} of {pending.length} "
+                      "bytes")
+    return view
 
 
 def _wait_for_completion(engine: "StromEngine", req_id: int,

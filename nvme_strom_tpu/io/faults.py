@@ -1,0 +1,356 @@
+"""Fault injection at the submit/wait boundary — the chaos half of the
+recovery story (docs/RESILIENCE.md).
+
+The paper's DMA chain (NVMe → locked host buffers → TPU) hard-fails on
+any link error in the reference; this module makes every failure mode of
+that chain *reproducible on demand* so the recovery paths
+(``io/resilient.py``, the loader's shard quarantine, checkpoint
+restore-fallback) are testable without flaky hardware:
+
+    plan   = FaultPlan.parse("eio:p=0.05, delay:every=100:delay_s=0.2")
+    engine = FaultyEngine(StromEngine(), plan)
+
+``FaultyEngine`` wraps any engine-shaped object and injects faults into
+the ``PendingRead``s it hands out — no C rebuild required.  The fault
+taxonomy (one class per link of the chain):
+
+    eio      the device/kernel failed the read        → OSError(EIO)
+    short    the read returned fewer bytes than asked → truncated view
+    delay    a latency straggler                      → wait blocks longer
+    stuck    a wedged request                         → waits time out
+    bitflip  payload corrupted in flight              → one byte flipped
+
+Plans are deterministic: decisions come from ``random.Random(seed)`` in
+submit order, so a failing CI run replays exactly.  For injection BELOW
+Python (exercising the C completion path itself), the engine honors
+``STROM_FAULT_READ_EIO_EVERY`` / ``STROM_FAULT_READ_SHORT_EVERY`` /
+``STROM_FAULT_READ_DELAY_MS`` at ``strom_engine_create`` time (see
+csrc/strom_io.cc).
+
+Every injected fault is counted (``StromStats.faults_injected``), tagged
+per kind on the plan, and traced (``strom.fault.<kind>`` spans in
+utils/trace.py) — a chaos run's injections are auditable next to the
+recoveries they provoked.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import random
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+FAULT_KINDS = ("eio", "short", "delay", "stuck", "bitflip")
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One fault class plus its trigger rule.
+
+    Triggering: ``every`` (deterministic: the Nth, 2Nth, ... matching
+    read) wins over ``p`` (per-read probability from the plan's seeded
+    rng).  ``max_count`` bounds total injections from this spec
+    (0 = unlimited).  ``path_substr`` restricts injection to reads of
+    files whose path contains the substring ("" = all files).
+    """
+
+    kind: str
+    p: float = 1.0
+    every: int = 0
+    max_count: int = 0
+    #: delay/stuck duration (seconds).  Negative (the default) resolves
+    #: per kind in __post_init__: 0.05 for a latency spike, 300 for
+    #: 'stuck' — far past any reasonable stuck_timeout so
+    #: cancel-then-retry always triggers first, while staying finite (an
+    #: abandoned stuck read can never hang teardown forever)
+    delay_s: float = -1.0
+    #: errno raised by 'eio' faults
+    err: int = errno.EIO
+    #: fraction of the payload kept by 'short' faults
+    frac: float = 0.5
+    path_substr: str = ""
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; one of {FAULT_KINDS}")
+        if not 0 <= self.p <= 1:
+            raise ValueError(f"p ({self.p}) must be in [0, 1]")
+        if self.every < 0 or self.max_count < 0:
+            raise ValueError("every/max_count must be >= 0")
+        if self.delay_s < 0:   # auto: same default however constructed
+            object.__setattr__(
+                self, "delay_s", 300.0 if self.kind == "stuck" else 0.05)
+        if not 0 <= self.frac < 1:
+            raise ValueError(f"frac ({self.frac}) must be in [0, 1)")
+
+
+_SPEC_FLOAT = {"p", "delay_s", "frac"}
+_SPEC_INT = {"every", "max_count", "err"}
+
+
+class FaultPlan:
+    """A seeded, ordered list of FaultSpecs; decides per submitted read.
+
+    The first spec whose trigger matches wins, so ordering encodes
+    priority.  ``injected`` tallies injections per kind — tests assert
+    against it, and tools/strom_stat reads the aggregate via
+    ``StromStats.faults_injected``.
+    """
+
+    def __init__(self, specs, seed: int = 0):
+        self.specs: List[FaultSpec] = list(specs)
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._reads = 0
+        self._matches: Dict[int, int] = {}   # spec index → matching reads
+        self._fired: Dict[int, int] = {}     # spec index → injections
+        self.injected: Dict[str, int] = {}   # kind → injections
+
+    @classmethod
+    def parse(cls, text: str, seed: int = 0) -> "FaultPlan":
+        """``"eio:p=0.1, delay:every=3:delay_s=0.2"`` → FaultPlan.
+
+        Comma-separated specs; each is ``kind[:key=value]...``.  Keys are
+        the FaultSpec fields (p, every, max_count, delay_s, err, frac,
+        path).  'stuck' without an explicit delay_s defaults to 300 s.
+        """
+        specs = []
+        for part in filter(None, (s.strip() for s in text.split(","))):
+            kind, _, rest = part.partition(":")
+            kw: dict = {}
+            for item in filter(None, (s.strip() for s in rest.split(":"))):
+                key, eq, val = item.partition("=")
+                if not eq:
+                    raise ValueError(
+                        f"fault spec {part!r}: expected key=value, "
+                        f"got {item!r}")
+                if key == "path":
+                    kw["path_substr"] = val
+                elif key in _SPEC_FLOAT:
+                    kw[key] = float(val)
+                elif key in _SPEC_INT:
+                    kw[key] = int(val)
+                else:
+                    raise ValueError(
+                        f"fault spec {part!r}: unknown key {key!r}")
+            specs.append(FaultSpec(kind=kind, **kw))
+        return cls(specs, seed=seed)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        """Plan from ``$STROM_FAULTS`` (+ ``$STROM_FAULTS_SEED``); None
+        when unset — the zero-cost production default."""
+        text = os.environ.get("STROM_FAULTS")
+        if not text:
+            return None
+        return cls.parse(text, seed=int(os.environ.get(
+            "STROM_FAULTS_SEED", "0")))
+
+    def decide(self, path: str = "") -> Optional[FaultSpec]:
+        """Fault for the next submitted read (None = read runs clean)."""
+        self._reads += 1
+        for i, spec in enumerate(self.specs):
+            if spec.path_substr and spec.path_substr not in path:
+                continue
+            if spec.max_count and self._fired.get(i, 0) >= spec.max_count:
+                continue
+            n = self._matches[i] = self._matches.get(i, 0) + 1
+            if spec.every:
+                hit = n % spec.every == 0
+            else:
+                hit = self._rng.random() < spec.p
+            if hit:
+                self._fired[i] = self._fired.get(i, 0) + 1
+                self.injected[spec.kind] = \
+                    self.injected.get(spec.kind, 0) + 1
+                return spec
+        return None
+
+    def corrupt_byte(self, length: int) -> tuple[int, int]:
+        """(index, xor mask) for a bitflip — from the plan's own rng so
+        corruption position replays with the seed."""
+        return (self._rng.randrange(max(1, length)),
+                1 << self._rng.randrange(8))
+
+
+class FaultyRead:
+    """A PendingRead with a fault grafted onto its wait/release path.
+
+    Honors the engine contract exactly: ``wait(timeout=...)`` raises
+    TimeoutError with the request STILL LIVE; errors release the staging
+    buffer before raising (mirroring PendingRead.wait); ``is_ready`` is a
+    non-throwing probe; ``release`` is idempotent.
+    """
+
+    def __init__(self, inner, spec: FaultSpec, plan: FaultPlan):
+        self._inner = inner
+        self._spec = spec
+        self._plan = plan
+        self._t0 = time.monotonic()
+        self._view: Optional[np.ndarray] = None
+        self._error: Optional[OSError] = None
+        self._released = False
+
+    @property
+    def was_fallback(self) -> bool:
+        return self._inner.was_fallback
+
+    @property
+    def length(self) -> int:
+        """Bytes requested at submit — NOT shrunk by a 'short' fault:
+        consumers compare the completed view against this to detect
+        exactly that truncation."""
+        return self._inner.length
+
+    def _remaining_delay(self) -> float:
+        if self._spec.kind not in ("delay", "stuck"):
+            return 0.0
+        return self._spec.delay_s - (time.monotonic() - self._t0)
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if self._view is not None:
+            return self._view
+        if self._error is not None:
+            raise self._error
+        remain = self._remaining_delay()
+        if remain > 0:
+            # latency spike / wedged request: the underlying read may be
+            # long done, but this request refuses to complete yet
+            if timeout is not None and timeout < remain:
+                time.sleep(timeout)
+                raise TimeoutError(
+                    f"read still in flight after {timeout}s "
+                    f"(injected {self._spec.kind})")
+            time.sleep(remain)
+            if timeout is not None:
+                timeout = max(0.0, timeout - remain)
+        if self._spec.kind == "eio":
+            self._error = OSError(self._spec.err,
+                                  os.strerror(self._spec.err)
+                                  + " (injected)")
+            self._inner.release()
+            raise self._error
+        view = self._inner.wait(
+            timeout=None if timeout is None else max(0.0, timeout))
+        if self._spec.kind == "short" and view.nbytes > 0:
+            view = view[:int(view.nbytes * self._spec.frac)]
+        elif self._spec.kind == "bitflip" and view.nbytes > 0:
+            idx, mask = self._plan.corrupt_byte(view.nbytes)
+            # flip in the staging view itself — exactly what in-flight
+            # corruption looks like to every downstream consumer
+            view[idx] ^= mask
+        self._view = view
+        return view
+
+    def is_ready(self) -> bool:
+        if self._view is not None or self._error is not None \
+                or self._released:
+            return True
+        if self._remaining_delay() > 0:
+            return False
+        # eio included: completed-with-error counts as ready (wait() will
+        # raise) — mirrors PendingRead.is_ready caching semantics
+        return self._inner.is_ready()
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._view = None
+        self._inner.release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def build_engine(config=None, stats=None, tracer=None):
+    """Default engine factory for consumers (loader, checkpoint, weight
+    streaming): a plain StromEngine, wrapped per the resilience env
+    knobs so ANY existing run becomes a chaos and/or self-healing run
+    without code changes (docs/RESILIENCE.md):
+
+    - ``STROM_FAULTS`` set       → FaultyEngine with the env FaultPlan
+    - ``STROM_RESILIENT=1``      → ResilientEngine on top (retry /
+                                   hedge / cancel-stuck per the
+                                   STROM_RETRY_* / STROM_HEDGE_* /
+                                   STROM_STUCK_* knobs)
+
+    Both unset (the default) returns the bare engine — zero added
+    indirection on the hot path.
+    """
+    from nvme_strom_tpu.io.engine import StromEngine
+    from nvme_strom_tpu.utils.config import EngineConfig
+
+    eng = StromEngine(config or EngineConfig(), stats=stats,
+                      tracer=tracer)
+    plan = FaultPlan.from_env()
+    if plan is not None:
+        eng = FaultyEngine(eng, plan)
+    if os.environ.get("STROM_RESILIENT", "0") == "1":
+        from nvme_strom_tpu.io.resilient import ResilientEngine
+        eng = ResilientEngine(eng)
+    return eng
+
+
+class FaultyEngine:
+    """Engine wrapper injecting a FaultPlan at the submit boundary.
+
+    Transparent to consumers (ShardedLoader, CheckpointManager,
+    ResilientEngine): everything but ``open``/``close``/``submit_read``
+    delegates to the wrapped engine.  Stack under ResilientEngine —
+    ``ResilientEngine(FaultyEngine(StromEngine(), plan))`` — so
+    recoveries are exercised against the injected faults.
+    """
+
+    def __init__(self, engine, plan: Optional[FaultPlan] = None):
+        self._engine = engine
+        self.plan = plan if plan is not None else FaultPlan.from_env()
+        if self.plan is None:
+            self.plan = FaultPlan([])
+        self._paths: Dict[int, str] = {}
+
+    def open(self, path, **kw) -> int:
+        fh = self._engine.open(path, **kw)
+        self._paths[fh] = str(path)
+        return fh
+
+    def close(self, fh: int) -> None:
+        self._paths.pop(fh, None)
+        self._engine.close(fh)
+
+    def submit_read(self, fh: int, offset: int, length: int):
+        pending = self._engine.submit_read(fh, offset, length)
+        spec = self.plan.decide(self._paths.get(fh, ""))
+        if spec is None:
+            return pending
+        self.stats.add(faults_injected=1)
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            now = time.monotonic_ns()
+            tracer.add_span(f"strom.fault.{spec.kind}", now, now,
+                            category="strom.fault", fh=fh, offset=offset,
+                            length=length)
+        return FaultyRead(pending, spec, self.plan)
+
+    def read(self, fh: int, offset: int, length: int) -> np.ndarray:
+        with self.submit_read(fh, offset, length) as p:
+            out = p.wait().copy()
+        self.stats.add(bounce_bytes=int(out.nbytes))
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._engine.close_all()

@@ -1,0 +1,457 @@
+"""ResilientEngine — turn every detected I/O failure into a recovery.
+
+The stack already *detects* failures (``wait(timeout=...)`` in
+io/engine.py, the step watchdog in utils/watchdog.py); before this
+module one bad read killed the run.  ``ResilientEngine`` wraps any
+engine-shaped object (StromEngine, or FaultyEngine for chaos runs) and
+gives its reads three recovery mechanisms (knobs: ResilientConfig in
+utils/config.py; semantics: docs/RESILIENCE.md):
+
+  retry    a read that completes with an error, or returns fewer bytes
+           than the file holds, is released and resubmitted with
+           exponential backoff + deterministic jitter, up to
+           ``max_retries`` times; the final failure raises ReadError
+           carrying the full per-attempt fault history.
+  hedge    a read still in flight past a latency threshold (explicit
+           ``hedge_after_s``, or derived from the engine's own log2
+           latency histogram: p<hedge_percentile> * hedge_multiplier)
+           gets a duplicate submission; whichever completes first wins,
+           the loser is released.  Stragglers cost one duplicate read
+           instead of a stalled pipeline.
+  cancel   a read still in flight after ``stuck_timeout_s`` is presumed
+           wedged: it is cancelled (released — safe per the engine's
+           release-waits-if-live contract) and resubmitted, counted
+           against the same retry budget.
+
+Every action is accounted (StromStats: resilient_retries, hedges_issued,
+hedges_won, stuck_cancelled) and traced (strom.resilient.* spans), so a
+recovered run shows its scars in ``strom_stat`` instead of hiding them.
+
+The wrapper preserves the engine read contract: ``wait(timeout=...)``
+raises TimeoutError with the request still live; ``release()`` frees
+both the original and any outstanding hedge; views obey the
+valid-until-release rule.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from nvme_strom_tpu.utils.config import ResilientConfig
+
+#: granularity of the hedged/stuck wait loop: long enough to stay off
+#: the hot path (one wake per slice only while a read is *already* a
+#: straggler), short enough to notice a hedge winning promptly
+_POLL_S = 0.02
+
+
+class ReadError(OSError):
+    """A read that stayed failed after the full retry budget.
+
+    ``attempts`` is the per-attempt fault history: a list of
+    ``{"error": str, "kind": str, "elapsed_s": float}`` dicts, oldest
+    first — the loud, fully-accounted failure the error budget demands.
+    """
+
+    def __init__(self, msg: str, attempts):
+        super().__init__(msg)
+        self.attempts = list(attempts)
+
+
+class _Attempt:
+    """One submission of the logical read (original, retry, or hedge)."""
+
+    def __init__(self, pending, t0: float):
+        self.pending = pending
+        self.t0 = t0
+
+
+class ResilientRead:
+    """The recoverable counterpart of ``PendingRead``.
+
+    Holds (fh, offset, length) so a failed attempt can be resubmitted;
+    the underlying PendingRead is replaced across retries, invisibly to
+    the caller.
+    """
+
+    def __init__(self, engine: "ResilientEngine", fh: int, offset: int,
+                 length: int, pending, expected: int):
+        self._engine = engine
+        self._fh = fh
+        self._offset = offset
+        self._length = length
+        self._expected = expected    # bytes the file actually holds here
+        self._primary = _Attempt(pending, time.monotonic())
+        self._hedge: Optional[_Attempt] = None
+        self._attempts: list = []    # fault history of failed attempts
+        self._retries = 0
+        self._hedges = 0             # hedges issued for the CURRENT
+        # primary: capped at one — a fast-failing hedge must not turn
+        # into a resubmission storm against an unhealthy device
+        self._view: Optional[np.ndarray] = None
+        self._winner = None          # the attempt whose view we returned
+        self._released = False
+        self.was_fallback = False
+
+    @property
+    def length(self) -> int:
+        """Bytes requested at submit (PendingRead.length parity)."""
+        return self._length
+
+    # -- the recovery loop -------------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Blocking wait with retry/hedge/cancel recovery.
+
+        ``timeout`` bounds THIS call (the engine contract): TimeoutError
+        means the logical read is still live — recovery continues on the
+        next wait; release() aborts it.
+        """
+        if self._view is not None:
+            return self._view
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        cfg = self._engine.rconfig
+        while True:
+            try:
+                view = self._wait_attempts(deadline)
+            except TimeoutError:
+                raise            # caller's bound, read still live
+            except OSError as e:
+                self._note_failure(e)
+                if self._retries >= cfg.max_retries:
+                    self._release_attempts()
+                    self._released = True
+                    raise ReadError(
+                        f"read fh={self._fh} off={self._offset} "
+                        f"len={self._length} failed after "
+                        f"{self._retries + 1} attempts: {e} "
+                        f"(history: {self._attempts})",
+                        self._attempts) from e
+                self._retry(deadline)
+                continue
+            short = self._expected - view.nbytes
+            if short > 0:
+                self._note_failure(OSError(
+                    f"short read: {view.nbytes} of {self._expected} "
+                    f"bytes"), kind="short")
+                if self._retries >= cfg.max_retries:
+                    self._release_attempts()
+                    self._released = True
+                    raise ReadError(
+                        f"read fh={self._fh} off={self._offset} "
+                        f"len={self._length} still short after "
+                        f"{self._retries + 1} attempts "
+                        f"(history: {self._attempts})", self._attempts)
+                self._retry(deadline)
+                continue
+            self._view = view
+            return view
+
+    def _wait_attempts(self, deadline) -> np.ndarray:
+        """Wait for the primary (or a hedge) to produce a view; raises
+        OSError on a completed-with-error attempt, TimeoutError only at
+        the caller's deadline."""
+        eng = self._engine
+        cfg = eng.rconfig
+        hedge_after = eng._hedge_after()
+        while True:
+            # primary probe FIRST: a read whose payload already landed
+            # must return its view even at timeout=0 (PendingRead.wait
+            # parity — engine.is_ready builds on exactly that)
+            slice_s = _POLL_S
+            if deadline is not None:
+                slice_s = min(slice_s,
+                              max(0.0, deadline - time.monotonic()))
+            try:
+                view = self._primary.pending.wait(timeout=slice_s)
+            except TimeoutError:
+                pass
+            else:
+                if self._hedge is not None:
+                    # primary won the race: the losing hedge hands its
+                    # staging buffer back as soon as it lands (deferred
+                    # — it may still be in flight, and release() would
+                    # block)
+                    eng._defer_release(self._fh, self._hedge.pending)
+                    self._hedge = None
+                self._winner = self._primary
+                self.was_fallback = bool(getattr(
+                    self._primary.pending, "was_fallback", False))
+                return view
+            now = time.monotonic()
+            if deadline is not None and now >= deadline:
+                raise TimeoutError(
+                    f"read fh={self._fh} off={self._offset} still in "
+                    f"flight (recovery continues on the next wait)")
+            elapsed = now - self._primary.t0
+            # hedge: the primary is a straggler — race ONE duplicate
+            if (self._hedge is None and self._hedges == 0
+                    and hedge_after is not None
+                    and elapsed >= hedge_after):
+                self._hedge = self._submit_hedge()
+            # stuck: cancel-then-retry (counts against the retry budget)
+            if elapsed >= cfg.stuck_timeout_s:
+                raise _Stuck(f"request still in flight after "
+                             f"{elapsed:.3f}s (stuck_timeout_s="
+                             f"{cfg.stuck_timeout_s})")
+            if self._hedge is not None and self._hedge.pending.is_ready():
+                try:
+                    view = self._hedge.pending.wait(timeout=0.0)
+                except TimeoutError:
+                    pass
+                except OSError:
+                    # a failed hedge never fails the read — drop it and
+                    # keep waiting on the primary (wait() released it)
+                    self._hedge = None
+                else:
+                    eng.stats.add(hedges_won=1)
+                    eng._trace("strom.resilient.hedge_won",
+                               int(self._hedge.t0 * 1e9), fh=self._fh,
+                               offset=self._offset)
+                    # the straggler primary may run for a while yet:
+                    # release() would BLOCK until its I/O lands, erasing
+                    # the hedge's entire latency win — park it instead
+                    eng._defer_release(self._fh, self._primary.pending)
+                    self._primary, self._hedge = self._hedge, None
+                    self._winner = self._primary
+                    self.was_fallback = bool(getattr(
+                        self._primary.pending, "was_fallback", False))
+                    return view
+
+    def _submit_hedge(self) -> _Attempt:
+        eng = self._engine
+        self._hedges += 1
+        eng.stats.add(hedges_issued=1)
+        eng._trace("strom.resilient.hedge", time.monotonic_ns(),
+                   fh=self._fh, offset=self._offset, length=self._length)
+        return _Attempt(eng._engine.submit_read(
+            self._fh, self._offset, self._length), time.monotonic())
+
+    def _note_failure(self, e: OSError, kind: Optional[str] = None):
+        self._attempts.append({
+            "error": str(e),
+            "kind": kind or ("stuck" if isinstance(e, _Stuck) else "io"),
+            "elapsed_s": round(time.monotonic() - self._primary.t0, 4),
+        })
+
+    def _retry(self, deadline) -> None:
+        """Release the failed/stuck attempt, back off, resubmit."""
+        eng = self._engine
+        cfg = eng.rconfig
+        stuck = self._attempts[-1]["kind"] == "stuck"
+        t0 = time.monotonic_ns()
+        self._release_attempts()
+        if stuck:
+            eng.stats.add(stuck_cancelled=1)
+        eng.stats.add(resilient_retries=1)
+        delay = min(cfg.backoff_max_s,
+                    cfg.backoff_base_s * (2 ** self._retries))
+        delay *= 1.0 + cfg.jitter * (2 * eng._rng.random() - 1)
+        if deadline is not None:
+            delay = min(delay, max(0.0, deadline - time.monotonic()))
+        if delay > 0:
+            time.sleep(delay)
+        self._retries += 1
+        self._hedges = 0     # a fresh primary earns a fresh hedge budget
+        self._primary = _Attempt(
+            eng._engine.submit_read(self._fh, self._offset, self._length),
+            time.monotonic())
+        eng._trace("strom.resilient.retry", t0, fh=self._fh,
+                   offset=self._offset, attempt=self._retries,
+                   stuck=stuck, error=self._attempts[-1]["error"])
+
+    def _release_attempts(self) -> None:
+        """Hand every outstanding attempt back — DEFERRED for attempts
+        still in flight: a synchronous release() blocks until the I/O
+        lands, which on a genuinely wedged request means the stuck
+        recovery would never get to resubmit.  Internal-recovery use
+        only; the caller-facing :meth:`release` blocks, preserving the
+        engine's release-before-close invariant."""
+        self._engine._defer_release(self._fh, self._primary.pending)
+        if self._hedge is not None:
+            self._engine._defer_release(self._fh, self._hedge.pending)
+            self._hedge = None
+
+    # -- PendingRead-compatible surface ------------------------------------
+
+    def is_ready(self) -> bool:
+        """Non-blocking probe; True once wait() would not block on I/O
+        (recovery work — backoff, resubmit — may still run inside it)."""
+        if self._view is not None or self._released:
+            return True
+        if self._primary.pending.is_ready():
+            return True
+        return self._hedge is not None and self._hedge.pending.is_ready()
+
+    def release(self) -> None:
+        """Caller-facing abort/free: BLOCKS until every attempt is out
+        of flight (the PendingRead contract drain paths rely on — the
+        caller may close the fh right after)."""
+        if self._released:
+            return
+        self._released = True
+        self._view = None
+        self._primary.pending.release()   # waits if still in flight
+        if self._hedge is not None:
+            self._hedge.pending.release()
+            self._hedge = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class _Stuck(OSError):
+    """Internal: a wait that exceeded stuck_timeout_s (cancel + retry)."""
+
+
+class ResilientEngine:
+    """Engine wrapper adding retry / hedging / stuck-cancel to reads.
+
+    Drop-in for StromEngine everywhere reads happen (ShardedLoader,
+    CheckpointManager, parallel/weights): ``submit_read`` returns a
+    ResilientRead; all other attributes delegate to the wrapped engine.
+    Writes are NOT wrapped — the checkpoint path has its own atomicity
+    story (staged temp dir + durable rename) and a blind rewrite could
+    mask it.
+    """
+
+    def __init__(self, engine, config: Optional[ResilientConfig] = None):
+        self._engine = engine
+        self.rconfig = config or ResilientConfig()
+        self._rng = random.Random(self.rconfig.seed)
+        # abandoned attempts (lost hedges, cancelled stuck reads) whose
+        # I/O may still be in flight: released opportunistically once
+        # complete — a synchronous release would block on the very
+        # straggler/wedge being recovered from.  Bounded: at most
+        # 1 + max_retries outstanding attempts exist per logical read.
+        self._zombies: list = []
+        self._zombie_lock = threading.Lock()
+        # derived hedge threshold, refreshed at most once a second: the
+        # percentile walk over the C histogram is cheap but runs per
+        # wait — uncached it becomes measurable on tens of thousands of
+        # small reads per second
+        self._hedge_cache: tuple = (-1.0, None)   # (computed_at, value)
+
+    # -- delegation --------------------------------------------------------
+
+    def open(self, path, **kw) -> int:
+        return self._engine.open(path, **kw)
+
+    def close(self, fh: int) -> None:
+        # lost hedges / cancelled stuck reads on this file must be out
+        # of flight before the fd goes away (a recycled fd number would
+        # hand their late completion someone else's file)
+        self._reap_zombies(fh=fh, block=True)
+        self._engine.close(fh)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def __enter__(self):
+        return self
+
+    def close_all(self) -> None:
+        # completed zombies release cleanly; a genuinely wedged one is
+        # left to the engine's own teardown drain (which must wait for
+        # the kernel anyway before unmapping the pool)
+        self._reap_zombies()
+        self._engine.close_all()
+
+    def __exit__(self, *exc):
+        self.close_all()
+
+    # -- reads -------------------------------------------------------------
+
+    def _defer_release(self, fh: int, pending) -> None:
+        """Release ``pending`` without ever blocking: immediately when
+        its I/O already landed, else parked (tagged with its fh) for the
+        next reap — ``close(fh)`` force-releases its stragglers so the
+        fd never closes under an in-flight read."""
+        if pending.is_ready():
+            pending.release()
+        else:
+            with self._zombie_lock:
+                self._zombies.append((fh, pending))
+
+    def _reap_zombies(self, fh: Optional[int] = None,
+                      block: bool = False) -> None:
+        """Release parked attempts that have landed; ``fh``+``block``
+        restricts to that file's zombies and waits for them (the
+        close-time invariant: no read may be in flight on a closing fd)."""
+        with self._zombie_lock:
+            zombies, self._zombies = self._zombies, []
+        survivors = []
+        for zfh, p in zombies:
+            if block and (fh is None or zfh == fh):
+                p.release()               # waits if still in flight
+            elif p.is_ready():
+                p.release()
+            else:
+                survivors.append((zfh, p))
+        if survivors:
+            with self._zombie_lock:
+                self._zombies.extend(survivors)
+
+    def submit_read(self, fh: int, offset: int,
+                    length: int) -> ResilientRead:
+        self._reap_zombies()   # lost hedges hand buffers back here
+        pending = self._engine.submit_read(fh, offset, length)
+        # size AFTER submit: the C engine re-fstats the file at every
+        # submit, so this reflects writes since open() (a size cached at
+        # open time would make short-read detection silently inert on
+        # grow-after-open files like the offload stores' backing files)
+        try:
+            size = self._engine.file_size(fh)
+        except OSError:
+            size = 0
+        expected = min(length, max(0, size - offset))
+        return ResilientRead(self, fh, offset, length, pending, expected)
+
+    def read(self, fh: int, offset: int, length: int) -> np.ndarray:
+        """Synchronous owning-array read through the recovery path."""
+        with self.submit_read(fh, offset, length) as p:
+            out = p.wait().copy()
+        self.stats.add(bounce_bytes=int(out.nbytes))
+        return out
+
+    # -- policy helpers ----------------------------------------------------
+
+    def _hedge_after(self) -> Optional[float]:
+        """Seconds after which an in-flight read earns a hedge; None
+        disables hedging (config, or the histogram is still cold)."""
+        cfg = self.rconfig
+        if not cfg.hedging:
+            return None
+        if cfg.hedge_after_s > 0:
+            return cfg.hedge_after_s
+        now = time.monotonic()
+        computed_at, cached = self._hedge_cache
+        if now - computed_at < 1.0:
+            return cached
+        try:
+            pct = self._engine.latency_percentiles(
+                "read", ps=(cfg.hedge_percentile,))
+        except (OSError, AttributeError):
+            return None
+        ns = pct.get(cfg.hedge_percentile, 0)
+        # None while no read has completed — nothing to derive from
+        val = (max(cfg.hedge_min_s, ns / 1e9 * cfg.hedge_multiplier)
+               if ns else None)
+        self._hedge_cache = (now, val)
+        return val
+
+    def _trace(self, name: str, t0_ns: int, **args) -> None:
+        tracer = getattr(self._engine, "tracer", None)
+        if tracer is None or not tracer.enabled:
+            return
+        tracer.add_span(name, int(t0_ns), time.monotonic_ns(),
+                        category="strom.resilient", **args)

@@ -58,7 +58,6 @@ class ActivationStore:
     while backward stays correct."""
 
     def __init__(self, path: str, n_slots: int, engine=None):
-        from nvme_strom_tpu.io.engine import StromEngine
         from nvme_strom_tpu.utils.config import EngineConfig
 
         if n_slots < 1:
@@ -66,7 +65,10 @@ class ActivationStore:
         self.path = str(path)
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         self._own_engine = engine is None
-        self.engine = engine or StromEngine(EngineConfig())
+        if engine is None:
+            from nvme_strom_tpu.io.faults import build_engine
+            engine = build_engine(EngineConfig())
+        self.engine = engine
         self.n_slots = n_slots
         self._slot_bytes: Optional[int] = None
         self._shape = None
@@ -153,8 +155,9 @@ class ActivationStore:
             self._prefetch[nxt] = self._submit_slot_read(nxt)
         nbytes = int(np.prod(self._shape)) * self._dtype.itemsize
         out = np.empty(nbytes, np.uint8)
+        from nvme_strom_tpu.io.engine import wait_exact
         for pos, r in reqs:
-            view = r.wait()
+            view = wait_exact(r)   # a short slot read must be loud
             out[pos:pos + view.nbytes] = view  # staging is recycled
             r.release()
         self.reads += 1

@@ -141,7 +141,10 @@ class OffloadedAdam:
         self.eps, self.weight_decay = float(eps), float(weight_decay)
         self.moment_dtype = jnp.dtype(moment_dtype)
         self._own_engine = engine is None
-        self.engine = engine or StromEngine(config or EngineConfig())
+        if engine is None:
+            from nvme_strom_tpu.io.faults import build_engine
+            engine = build_engine(config or EngineConfig())
+        self.engine = engine
         self.stream = DeviceStream(self.engine, depth=depth, drain="ready")
 
         try:

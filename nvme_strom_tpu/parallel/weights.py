@@ -27,7 +27,7 @@ from nvme_strom_tpu.formats.safetensors import (
     SafetensorsFile,
     _np_dtype,
 )
-from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.io.engine import StromEngine, wait_exact
 from nvme_strom_tpu.utils.config import EngineConfig
 
 
@@ -117,7 +117,10 @@ class LazyCheckpoint:
         import jax
 
         own = engine is None
-        eng = engine or StromEngine(EngineConfig())
+        if engine is None:
+            from nvme_strom_tpu.io.faults import build_engine
+            engine = build_engine(EngineConfig())
+        eng = engine
         out: Dict[str, object] = {}
         try:
             for name in self.keys():
@@ -257,7 +260,9 @@ class LazyCheckpoint:
                                         min(step, ent.length - o))
                         for o in range(0, ent.length, step)]
                 for p in pend:
-                    v = p.wait()
+                    # cumulative assembly: a silently short view would
+                    # leave a garbage tail that reshapes cleanly
+                    v = wait_exact(p)
                     buf[pos:pos + v.nbytes] = v
                     pos += v.nbytes
                     p.release()
@@ -305,7 +310,10 @@ def save_checkpoint(path, params: Dict[str, object],
         host[name] = np.asarray(arr)
 
     own = engine is None
-    eng = engine or StromEngine(EngineConfig())
+    if engine is None:
+        from nvme_strom_tpu.io.faults import build_engine
+        engine = build_engine(EngineConfig())
+    eng = engine
     try:
         write_safetensors_engine(path, host, eng)
     finally:

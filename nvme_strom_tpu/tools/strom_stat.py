@@ -29,6 +29,16 @@ _COUNTERS = (
     "requests_completed", "requests_failed", "retries",
 )
 
+#: recovery-path counters (io/resilient.py, io/faults.py, loader
+#: quarantine, checkpoint restore-fallback — docs/RESILIENCE.md);
+#: rendered in their own block, and only when any is non-zero: a
+#: healthy run's report stays exactly as short as before
+_RESILIENCE_COUNTERS = (
+    "faults_injected", "resilient_retries", "hedges_issued",
+    "hedges_won", "stuck_cancelled", "shards_quarantined",
+    "restore_fallbacks",
+)
+
 
 def render_device(path: str) -> str:
     """Backing-device topology of ``path`` — the observable form of the
@@ -78,6 +88,16 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
         lines.append(f"  {name:<22} {shown:>14}{suffix}")
     for name in sorted(k for k in snap if k.startswith("lat_")):
         lines.append(f"  {name:<22} {snap[name]:>14.1f}")
+    if any(int(snap.get(n, 0)) for n in _RESILIENCE_COUNTERS):
+        lines.append("  resilience (recoveries + degradations):")
+        for name in _RESILIENCE_COUNTERS:
+            v = int(snap.get(name, 0))
+            suffix = ""
+            if prev is not None and dt:
+                d = v - int(prev.get(name, 0))
+                if d:
+                    suffix = f"   (+{d})" if d > 0 else f"   ({d})"
+            lines.append(f"    {name:<20} {v:>14}{suffix}")
     members = snap.get("member_bytes")
     if members:
         total = max(1, sum(members.values()))

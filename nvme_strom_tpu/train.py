@@ -61,7 +61,6 @@ class Trainer:
                  hooks: Sequence[Callable] = ()):
         import jax
         import optax
-        from nvme_strom_tpu.io import StromEngine
         from nvme_strom_tpu.models.transformer import (init_params,
                                                        make_train_step)
         from nvme_strom_tpu.parallel.mesh import make_mesh
@@ -73,7 +72,10 @@ class Trainer:
         self.optimizer = optimizer or optax.adamw(lr)
         self.hooks = list(hooks)
         self._own_engine = engine is None
-        self.engine = engine or StromEngine()
+        if engine is None:
+            from nvme_strom_tpu.io.faults import build_engine
+            engine = build_engine()
+        self.engine = engine
         self.save_every = int(save_every)
         self.async_save = bool(async_save)
         self._closed = False

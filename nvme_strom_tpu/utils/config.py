@@ -15,6 +15,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
 @dataclass(frozen=True)
 class EngineConfig:
     """strom-io C++ engine knobs.
@@ -71,6 +78,65 @@ class EngineConfig:
 
 
 @dataclass(frozen=True)
+class ResilientConfig:
+    """Recovery policy of ``io/resilient.py``'s ``ResilientEngine``.
+
+    One knob block for the three recovery mechanisms (docs/RESILIENCE.md):
+    bounded retry with exponential backoff + jitter, hedged duplicate
+    reads past a latency threshold, and cancel-then-resubmit of stuck
+    requests.  STROM_* environment variables are read at construction
+    time, mirroring EngineConfig.
+    """
+
+    #: failed/short/stuck read resubmissions before giving up loudly
+    max_retries: int = field(
+        default_factory=lambda: _env_int("STROM_RETRY_MAX", 3))
+    #: first backoff sleep; doubles per attempt up to backoff_max_s
+    backoff_base_s: float = field(
+        default_factory=lambda: _env_float("STROM_RETRY_BACKOFF_S", 0.01))
+    backoff_max_s: float = field(
+        default_factory=lambda: _env_float("STROM_RETRY_BACKOFF_MAX_S", 1.0))
+    #: uniform jitter fraction applied to every backoff sleep (0..1);
+    #: deterministic per engine via ``seed``
+    jitter: float = field(
+        default_factory=lambda: _env_float("STROM_RETRY_JITTER", 0.5))
+    #: issue a duplicate (hedged) read when the original is still in
+    #: flight after this many seconds; 0 = derive from the engine's
+    #: latency histogram (hedge_percentile * hedge_multiplier)
+    hedge_after_s: float = field(
+        default_factory=lambda: _env_float("STROM_HEDGE_AFTER_S", 0.0))
+    hedge_percentile: int = 99
+    hedge_multiplier: float = field(
+        default_factory=lambda: _env_float("STROM_HEDGE_MULTIPLIER", 3.0))
+    #: floor for the derived threshold — a cold histogram must not turn
+    #: every read into a hedge
+    hedge_min_s: float = field(
+        default_factory=lambda: _env_float("STROM_HEDGE_MIN_S", 0.005))
+    #: 0 disables hedging entirely (retry/stuck handling stays on)
+    hedging: bool = field(
+        default_factory=lambda: os.environ.get("STROM_HEDGE", "1") != "0")
+    #: a request still in flight after this long is presumed wedged:
+    #: cancel (release) it and resubmit — counts against max_retries
+    stuck_timeout_s: float = field(
+        default_factory=lambda: _env_float("STROM_STUCK_TIMEOUT_S", 30.0))
+    #: seed of the deterministic backoff-jitter stream
+    seed: int = field(
+        default_factory=lambda: _env_int("STROM_RETRY_SEED", 0))
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff times must be >= 0")
+        if not 0 <= self.jitter <= 1:
+            raise ValueError(f"jitter ({self.jitter}) must be in [0, 1]")
+        if self.hedge_after_s < 0 or self.hedge_min_s < 0:
+            raise ValueError("hedge thresholds must be >= 0")
+        if self.stuck_timeout_s <= 0:
+            raise ValueError("stuck_timeout_s must be > 0")
+
+
+@dataclass(frozen=True)
 class LoaderConfig:
     """Dataloader knobs: per-host shard selection + device prefetch depth."""
 
@@ -88,6 +154,20 @@ class LoaderConfig:
     #: datasets while small/medium datasets index each shard once per
     #: loader instead of once per epoch
     index_cache_samples: int = 1_000_000
+    #: shard-quarantine error budget (docs/RESILIENCE.md): a shard whose
+    #: index/read/decode fails is skipped-and-logged (counted as
+    #: shards_quarantined, skipped for the loader's remaining epochs) as
+    #: long as fewer than this many shards have been quarantined; the
+    #: budget exhausted, the next failure raises loudly with the full
+    #: quarantine list.  0 (default) preserves fail-fast behavior.
+    #: CAVEAT (multi-host): a quarantined shard shrinks only THIS host's
+    #: epoch, so hosts yield different batch counts and the collective
+    #: batch assembly desynchronizes at epoch end — keep the default 0
+    #: in multi-host training (fail fast, restart from checkpoint) and
+    #: use budgets on single-host / per-host-symmetric runs; with
+    #: ``drop_remainder=False`` a quarantined shard can also surface as
+    #: the partial-final-batch ValueError.
+    shard_error_budget: int = 0
     #: drop a shard's page-cache residue after a Python-side index walk
     #: (tfrecord): the walk faults the file resident, which would flip
     #: the engine's residency planner to the buffered path for every

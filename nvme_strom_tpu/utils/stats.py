@@ -55,6 +55,22 @@ class StromStats:
     # planned page-cache reads (submit-time residency probe chose the
     # buffered path; subset of bytes_fallback, never a rescue)
     bytes_resident: int = 0
+    # -- resilience counters (io/faults.py, io/resilient.py) --------------
+    # faults injected by an active FaultPlan (test/chaos runs; 0 in prod)
+    faults_injected: int = 0
+    # ResilientEngine recovery actions: failed/short reads resubmitted
+    # after backoff; hedges issued past the latency threshold; hedges
+    # that completed before the original; stuck requests cancelled and
+    # resubmitted after wait_timeout
+    resilient_retries: int = 0
+    hedges_issued: int = 0
+    hedges_won: int = 0
+    stuck_cancelled: int = 0
+    # graceful-degradation actions in consumers: shards skipped under the
+    # loader's error budget; checkpoint restores that fell back to an
+    # older intact step
+    shards_quarantined: int = 0
+    restore_fallbacks: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)

@@ -160,6 +160,15 @@ class StepWatchdog:
                       f"completed={s.requests_completed} "
                       f"failed={s.requests_failed} "
                       f"retries={s.retries}", file=w, flush=True)
+                # the recovery tier's own accounting: a hung step whose
+                # resilient counters are MOVING is recovering, not
+                # wedged — the distinction this dump exists to make
+                print(f"resilience: retries={s.resilient_retries} "
+                      f"hedges={s.hedges_issued}/{s.hedges_won} "
+                      f"stuck_cancelled={s.stuck_cancelled} "
+                      f"quarantined={s.shards_quarantined} "
+                      f"faults_injected={s.faults_injected}",
+                      file=w, flush=True)
             except Exception as e:       # diagnosis must not crash the job
                 print(f"engine stats unavailable: {e}", file=w,
                       flush=True)

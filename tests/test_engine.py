@@ -554,3 +554,32 @@ def test_engine_stripe_accounting_writes(tmp_path, monkeypatch):
     mb = stats.member_bytes
     assert sum(mb.values()) == 2 << 20
     assert mb["sim0"] == mb["sim1"] == 1 << 20   # even 128KiB stripes
+
+
+def test_wait_timeout_cancel_then_retry(tmp_data_file, monkeypatch):
+    """The wait(timeout=...) contract, end to end against the C engine:
+    after a TimeoutError the request is STILL LIVE — (a) retrying the
+    wait returns the payload, and (b) release() cancels cleanly so a
+    fresh submit of the same range succeeds (the cancel-then-retry
+    recovery io/resilient.py builds on).  The C-level
+    STROM_FAULT_READ_DELAY_MS hook holds every completion 150 ms so the
+    timeout genuinely fires below Python."""
+    path, payload = tmp_data_file
+    monkeypatch.setenv("STROM_FAULT_READ_DELAY_MS", "150")
+    with StromEngine(_cfg(), stats=StromStats()) as eng:
+        fh = eng.open(path)
+        # (a) timeout, then retry the wait on the SAME request
+        p = eng.submit_read(fh, 0, 4096)
+        with pytest.raises(TimeoutError, match="still in flight"):
+            p.wait(timeout=0.01)
+        assert p.wait().tobytes() == payload[:4096]
+        p.release()
+        # (b) timeout, cancel, resubmit the same range
+        p2 = eng.submit_read(fh, 4096, 4096)
+        with pytest.raises(TimeoutError):
+            p2.wait(timeout=0.01)
+        p2.release()     # blocks until out of flight, then frees
+        p3 = eng.submit_read(fh, 4096, 4096)
+        assert p3.wait().tobytes() == payload[4096:8192]
+        p3.release()
+        eng.close(fh)
