@@ -324,7 +324,9 @@ def bench_interleaved(engine, path: str, rounds: int = 3) -> dict:
     jax.device_put(bufs[0], dev).block_until_ready()  # warmup
     per = []
     stream_delta = {"bounce_bytes": 0, "bytes_direct": 0,
-                    "bytes_resident": 0}
+                    "bytes_resident": 0, "requests_submitted": 0,
+                    "spans_coalesced": 0, "submit_batches": 0,
+                    "submit_syscalls_saved": 0}
     for i in range(rounds):
         evict_file(path)
         raw = _raw_pass(engine, fh, size)
@@ -348,7 +350,11 @@ def bench_interleaved(engine, path: str, rounds: int = 3) -> dict:
             "ratio": med("ratio"), "rounds": per,
             "stream_bounce": stream_delta["bounce_bytes"],
             "stream_direct": stream_delta["bytes_direct"],
-            "stream_resident": stream_delta["bytes_resident"]}
+            "stream_resident": stream_delta["bytes_resident"],
+            "stream_submits": stream_delta["requests_submitted"],
+            "stream_coalesced": stream_delta["spans_coalesced"],
+            "stream_batches": stream_delta["submit_batches"],
+            "stream_syscalls_saved": stream_delta["submit_syscalls_saved"]}
 
 
 def main() -> int:
@@ -406,6 +412,24 @@ def main() -> int:
              f"{inter['ratio']:.3f} "
              f"[direct={cold_direct} bounce={cold_bounce} "
              f"resident={cold_resident}]")
+        # Submission-path attribution (docs/PERF.md): how many engine
+        # submissions the stream made, how many extents the planner
+        # merged away, and the submission round trips the vectored path
+        # saved — so BENCH_r06+ can tie any throughput delta to the
+        # fewer-syscalls / fewer-larger-commands levers.
+        stream_gib = max(1e-9, 3 * nbytes / (1 << 30))  # 3 stream rounds
+        submits = inter["stream_submits"]
+        saved = inter["stream_syscalls_saved"]
+        merged = inter["stream_coalesced"]
+        coalesce_ratio = (merged / (merged + submits)) if submits else 0.0
+        # doorbells actually rung: every submission minus the batched
+        # extents that shared one (a batch of n rings once = n-1 saved)
+        syscalls_per_gib = (submits - saved) / stream_gib
+        _log(f"bench: submit path: {submits} submits in "
+             f"{inter['stream_batches']} batches, "
+             f"{saved} submit syscalls saved, "
+             f"coalesce_ratio={coalesce_ratio:.3f}, "
+             f"submit syscalls/GiB={syscalls_per_gib:.1f}")
 
         # Warm pass: the residency planner's deliberate page-cache path.
         # Secondary (logged, not the headline): on a tunnel-limited chip
@@ -452,6 +476,11 @@ def main() -> int:
         "value": round(hbm, 3),
         "unit": "GiB/s",
         "vs_baseline": round(inter["ratio"], 3) if device_ok else None,
+        # submission-path attribution (docs/PERF.md): lets a later
+        # round tie a throughput delta to the batching/coalescing
+        # levers without rerunning
+        "coalesce_ratio": round(coalesce_ratio, 3),
+        "submit_syscalls_per_gib": round(syscalls_per_gib, 1),
     }), flush=True)
     try:
         os.unlink(path)

@@ -82,6 +82,43 @@ void reader_thread(strom_engine *eng, int fh, int iters, int seed) {
   }
 }
 
+/* Vectored submitter: batches of random extents through
+ * strom_submit_readv, racing the scalar readers for buffers and the
+ * deferred-flush doorbell against concurrent dispatches. */
+void readv_thread(strom_engine *eng, int fh, int iters, int seed) {
+  Rng rng(seed * 7919 + 3);
+  for (int i = 0; i < iters; i++) {
+    const uint32_t n = 1 + (uint32_t)(rng.next() % 8);
+    strom_rd_ext exts[8];
+    for (uint32_t j = 0; j < n; j++) {
+      uint64_t off = rng.next() % (kFileBytes - 1);
+      uint64_t len = 1 + rng.next() % (kMaxRead / 4);
+      if (off + len > kFileBytes) len = kFileBytes - off;
+      exts[j] = strom_rd_ext{fh, 0, off, len};
+    }
+    int64_t ids[8];
+    if (strom_submit_readv(eng, exts, n, ids) != 0) {
+      fail("submit_readv");
+      continue;
+    }
+    for (uint32_t j = 0; j < n; j++) {
+      strom_completion c;
+      if (strom_wait(eng, ids[j], &c) != 0 || c.status != 0) {
+        fail("readv status");
+        strom_release(eng, ids[j]);
+        continue;
+      }
+      if (c.len != exts[j].length) fail("readv short");
+      for (uint64_t k = 0; k < c.len; k += 997)
+        if (c.data[k] != pat(exts[j].offset + k)) {
+          fail("readv payload mismatch");
+          break;
+        }
+      strom_release(eng, ids[j]);
+    }
+  }
+}
+
 void writer_thread(strom_engine *eng, const std::string &dir, int iters) {
   std::string path = dir + "/stress_w.bin";
   int fh = strom_open(eng, path.c_str(), STROM_OPEN_WRITABLE);
@@ -157,6 +194,8 @@ int main(int argc, char **argv) {
     std::vector<std::thread> ts;
     for (int r = 0; r < n_readers; r++)
       ts.emplace_back(reader_thread, eng, fh, iters, r + 1);
+    for (int r = 0; r < 2; r++)
+      ts.emplace_back(readv_thread, eng, fh, iters / 2 + 1, r + 1);
     ts.emplace_back(writer_thread, eng, dir, iters / 2 + 1);
     ts.emplace_back(churn_thread, eng, path, iters / 2 + 1);
     std::thread obs(observer_thread, eng, &stop);

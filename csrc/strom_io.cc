@@ -209,9 +209,14 @@ struct Uring {
 
   /* Caller must serialise submissions (engine holds a mutex). Returns 0 or
    * -errno. The SQE is always published; a transient enter failure leaves
-   * it queued for the next flush rather than failing the request. */
+   * it queued for the next flush rather than failing the request.
+   * ``flush_now = false`` stages the SQE without ringing the doorbell —
+   * the vectored submit path publishes a whole batch, then pays ONE
+   * io_uring_enter via flush() (an SQ that fills mid-batch still flushes
+   * inline below; correctness never depends on the deferred flush). */
   int submit(uint8_t opcode, int fd_, uint64_t off, void *addr, uint32_t len,
-             uint64_t user_data, uint16_t buf_index = 0) {
+             uint64_t user_data, uint16_t buf_index = 0,
+             bool flush_now = true) {
     uint32_t tail = *sq_tail;
     uint32_t head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
     if (tail - head >= sq_entries) {
@@ -236,7 +241,7 @@ struct Uring {
     sq_array[idx] = idx;
     __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
     unsubmitted.fetch_add(1, std::memory_order_acq_rel);
-    flush();
+    if (flush_now) flush();
     return 0; /* published: the op WILL reach the kernel */
   }
 
@@ -364,7 +369,7 @@ struct strom_engine {
 
   std::atomic<uint64_t> st_direct{0}, st_fallback{0}, st_bounce{0},
       st_written{0}, st_sub{0}, st_comp{0}, st_fail{0}, st_retry{0},
-      st_resident{0};
+      st_resident{0}, st_batches{0}, st_sysc_saved{0};
   bool probe_residency = true;   /* STROM_NO_RESIDENCY_PROBE disables */
 
   /* Fault injection BELOW Python (stress/chaos runs; see
@@ -507,8 +512,10 @@ struct strom_engine {
 
   /* Hand a buffer-holding request to the backend. mu must be held.
    * Submissions never block: if the ring is jammed (practically impossible —
-   * we drain the SQ on every enter) the request fails with -EBUSY. */
-  void dispatch_locked(Req *r) {
+   * we drain the SQ on every enter) the request fails with -EBUSY.
+   * ``flush_now = false`` defers the uring doorbell (vectored submit:
+   * the caller flushes once for the whole batch). */
+  void dispatch_locked(Req *r, bool flush_now = true) {
     auto it = files.find(r->fh);
     if (it == files.end()) {
       r->status = -EBADF;
@@ -528,7 +535,7 @@ struct strom_engine {
                          r->direct ? fe.fd_direct : fe.fd_buffered,
                          r->offset, (void *)s, (uint32_t)r->len,
                          (uint64_t)r->id,
-                         fixed ? (uint16_t)r->buf_idx : 0);
+                         fixed ? (uint16_t)r->buf_idx : 0, flush_now);
       } else {
         int fd = r->direct ? fe.fd_direct : fe.fd_buffered;
         uint64_t off = r->direct ? r->a_off : r->offset;
@@ -536,7 +543,7 @@ struct strom_engine {
         uint32_t rlen = (uint32_t)(r->direct ? r->a_len : r->len);
         rc = ring.submit(fixed ? kOpReadFixed : kOpRead, fd, off, dst, rlen,
                          (uint64_t)r->id,
-                         fixed ? (uint16_t)r->buf_idx : 0);
+                         fixed ? (uint16_t)r->buf_idx : 0, flush_now);
       }
       if (rc != 0) {
         r->status = rc;
@@ -1113,6 +1120,96 @@ int64_t strom_submit_read(strom_engine *e, int fh, uint64_t offset,
   return r->id;
 }
 
+int strom_submit_readv(strom_engine *e, const strom_rd_ext *exts,
+                       uint32_t n, int64_t *out_ids) {
+  if (n == 0) return 0;
+  for (uint32_t i = 0; i < n; i++)
+    if (exts[i].length > e->buf_bytes) return -EINVAL;
+  /* Residency probes to run with the lock DROPPED (same discipline as
+   * strom_submit_read: mmap/mincore must not serialize other
+   * submitters; dup so a concurrent close cannot retarget the fd). */
+  struct Probe { uint32_t i; int pfd; uint64_t off, avail; };
+  std::vector<Probe> probes;
+  std::vector<char> resident(n, 0);
+  std::vector<char> direct(n, 0);
+  std::unique_lock<std::mutex> lk(e->mu);
+  if (e->stopping) return -ECANCELED;
+  {
+    /* Atomic validation + one size refresh per distinct fh: on any bad
+     * extent NOTHING has been submitted. */
+    std::unordered_map<int, int64_t> sized;
+    for (uint32_t i = 0; i < n; i++) {
+      auto it = e->files.find(exts[i].fh);
+      if (it == e->files.end()) {
+        for (auto &p : probes) close(p.pfd);
+        return -EBADF;
+      }
+      if (sized.find(exts[i].fh) == sized.end()) {
+        struct stat st;
+        if (fstat(it->second.fd_buffered, &st) == 0)
+          it->second.size = (int64_t)st.st_size;
+        sized.emplace(exts[i].fh, it->second.size);
+      }
+      direct[i] = it->second.fd_direct >= 0 ? 1 : 0;
+      if (direct[i] && e->probe_residency &&
+          exts[i].offset < (uint64_t)it->second.size) {
+        uint64_t avail = std::min<uint64_t>(
+            exts[i].length, (uint64_t)it->second.size - exts[i].offset);
+        int pfd = dup(it->second.fd_buffered);
+        if (pfd >= 0)
+          probes.push_back(Probe{i, pfd, exts[i].offset, avail});
+      }
+    }
+  }
+  if (!probes.empty()) {
+    lk.unlock();
+    for (auto &p : probes) {
+      resident[p.i] = span_resident(p.pfd, p.off, p.avail) ? 1 : 0;
+      close(p.pfd);
+    }
+    lk.lock();
+    if (e->stopping) return -ECANCELED;
+    for (uint32_t i = 0; i < n; i++)
+      if (e->files.find(exts[i].fh) == e->files.end()) return -EBADF;
+  }
+  /* Stage every extent — uring SQEs publish WITHOUT ringing the
+   * doorbell — then pay one io_uring_enter for the whole batch.
+   * Only extents dispatched inline share that doorbell; extents that
+   * defer on pool pressure ring their own when a buffer frees, so
+   * they must not be credited as saved syscalls. */
+  uint32_t inline_n = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    const strom_rd_ext &x = exts[i];
+    Req *r = new Req();
+    r->offset = x.offset;
+    r->len = x.length;
+    r->a_off = align_down(x.offset, e->alignment);
+    r->a_len = align_up(x.offset + x.length, e->alignment) - r->a_off;
+    r->direct = direct[i] && !resident[i];
+    r->planned_resident = direct[i] != 0 && resident[i] != 0;
+    r->id = e->next_req++;
+    r->fh = x.fh;
+    r->t_submit = now_ns();
+    e->reqs[r->id] = r;
+    e->st_sub.fetch_add(1, std::memory_order_relaxed);
+    out_ids[i] = r->id;
+    if (e->free_bufs.empty()) {
+      e->defer_q.push_back(r); /* never block: dispatched on next free */
+    } else {
+      r->buf_idx = e->free_bufs.back();
+      e->free_bufs.pop_back();
+      r->buf = e->buf_ptr(r->buf_idx);
+      e->dispatch_locked(r, /*flush_now=*/false);
+      inline_n++;
+    }
+  }
+  e->st_batches.fetch_add(1, std::memory_order_relaxed);
+  if (inline_n > 1)
+    e->st_sysc_saved.fetch_add(inline_n - 1, std::memory_order_relaxed);
+  if (e->use_uring) e->ring.flush();
+  return 0;
+}
+
 static int fill_completion(Req *r, strom_completion *out) {
   if (out) {
     out->data = r->is_write ? nullptr
@@ -1224,6 +1321,9 @@ void strom_get_stats(strom_engine *e, strom_stats_blk *out) {
   out->requests_failed = e->st_fail.load(std::memory_order_relaxed);
   out->retries = e->st_retry.load(std::memory_order_relaxed);
   out->bytes_resident = e->st_resident.load(std::memory_order_relaxed);
+  out->submit_batches = e->st_batches.load(std::memory_order_relaxed);
+  out->submit_syscalls_saved =
+      e->st_sysc_saved.load(std::memory_order_relaxed);
 }
 
 void strom_drain_stats(strom_engine *e, strom_stats_blk *out) {
@@ -1237,12 +1337,15 @@ void strom_drain_stats(strom_engine *e, strom_stats_blk *out) {
   out->requests_failed = e->st_fail.exchange(0, std::memory_order_acq_rel);
   out->retries = e->st_retry.exchange(0, std::memory_order_acq_rel);
   out->bytes_resident = e->st_resident.exchange(0, std::memory_order_acq_rel);
+  out->submit_batches = e->st_batches.exchange(0, std::memory_order_acq_rel);
+  out->submit_syscalls_saved =
+      e->st_sysc_saved.exchange(0, std::memory_order_acq_rel);
 }
 
 void strom_reset_stats(strom_engine *e) {
   e->st_direct = 0; e->st_fallback = 0; e->st_bounce = 0; e->st_written = 0;
   e->st_sub = 0; e->st_comp = 0; e->st_fail = 0; e->st_retry = 0;
-  e->st_resident = 0;
+  e->st_resident = 0; e->st_batches = 0; e->st_sysc_saved = 0;
   for (int i = 0; i < STROM_LAT_BUCKETS; i++) {
     e->lat_read[i].store(0, std::memory_order_relaxed);
     e->lat_write[i].store(0, std::memory_order_relaxed);

@@ -53,6 +53,14 @@ typedef struct strom_stats_blk {
                                     resident-block return, SURVEY.md §3.1) —
                                     a subset of bytes_fallback, and NOT a
                                     rescue (retries unaffected)              */
+  uint64_t submit_batches;       /* strom_submit_readv calls (n >= 1)        */
+  uint64_t submit_syscalls_saved;/* INLINE-dispatched extents per batch
+                                    beyond the first: submission round trips
+                                    a per-extent caller would have paid
+                                    (io_uring_enter doorbells on the uring
+                                    backend).  Extents that defer on pool
+                                    pressure ring their own doorbell later
+                                    and are never credited.                 */
 } strom_stats_blk;
 
 typedef struct strom_completion {
@@ -196,6 +204,27 @@ int strom_file_is_direct(strom_engine *eng, int fh);
  * Blocks if no staging buffer is free. Returns req_id >= 0 or -errno. */
 int64_t strom_submit_read(strom_engine *eng, int fh, uint64_t offset,
                           uint64_t len);
+
+/* One extent of a vectored submission (strom_submit_readv). */
+typedef struct strom_rd_ext {
+  int32_t  fh;
+  uint32_t pad;
+  uint64_t offset;
+  uint64_t length;     /* must be <= buf_bytes */
+} strom_rd_ext;
+
+/* Vectored read submission: stage every extent's SQE, then ring the
+ * doorbell with a SINGLE io_uring_enter (the thread-pool backend queues
+ * all extents under one lock hold) — the per-request ioctl/syscall
+ * amortization the reference gets from multi-chunk MEMCPY_SSD2GPU
+ * commands (SURVEY.md §3.1).  Validation is atomic: on any invalid
+ * extent (-EINVAL over-size, -EBADF unknown fh) NOTHING is submitted.
+ * On success returns 0 and fills out_ids[0..n) with per-extent request
+ * ids (wait/release each exactly like strom_submit_read's).  Extents
+ * whose buffers are exhausted defer, never block, preserving
+ * submission order. */
+int strom_submit_readv(strom_engine *eng, const strom_rd_ext *exts,
+                       uint32_t n, int64_t *out_ids);
 
 /* Wait until req_id completes; fills *out. The buffer stays owned by the
  * request until strom_release. */

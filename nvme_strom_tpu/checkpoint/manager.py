@@ -41,6 +41,7 @@ from nvme_strom_tpu.formats.safetensors import (
     write_safetensors_engine,
 )
 from nvme_strom_tpu.io.engine import StromEngine, wait_exact
+from nvme_strom_tpu.io.plan import plan_and_submit
 from nvme_strom_tpu.utils.config import EngineConfig
 
 _STEP_RE = re.compile(r"^step_(\d{8})$")
@@ -761,20 +762,16 @@ class CheckpointManager:
         fh = eng.open(path)
         pend: list = []
         try:
-            chunk = eng.config.chunk_bytes
+            # the planner owns the chunk split (ledger-tuned size) and
+            # the whole tile submits as ONE vectored batch — the engine
+            # defers reads past its pool without blocking, and this
+            # loop releases oldest-first, so the batch cannot deadlock
+            (pend,) = plan_and_submit(eng, [(fh, offset, length)])
+            pend = list(pend)
             pos = 0
-            for o in range(0, length, chunk):
-                pend.append((eng.submit_read(fh, offset + o,
-                                             min(chunk, length - o))))
-                if len(pend) >= max(2, eng.config.queue_depth // 2):
-                    p = pend.pop(0)
-                    v = wait_exact(p)   # truncated tile must fail HERE
-                    out[pos:pos + v.nbytes] = v
-                    pos += v.nbytes
-                    p.release()
             while pend:
                 p = pend.pop(0)
-                v = wait_exact(p)
+                v = wait_exact(p)   # truncated tile must fail HERE
                 out[pos:pos + v.nbytes] = v
                 pos += v.nbytes
                 p.release()

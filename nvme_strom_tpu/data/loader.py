@@ -31,8 +31,10 @@ from nvme_strom_tpu.data.sharding import assign_shards, shuffled_indices
 from nvme_strom_tpu.formats.tfrecord import TFRecordIndex
 from nvme_strom_tpu.formats.wds import WdsShardIndex
 from nvme_strom_tpu.io.engine import StromEngine, wait_exact
+from nvme_strom_tpu.io.plan import plan_and_submit
 from nvme_strom_tpu.parallel.mesh import batch_sharding
 from nvme_strom_tpu.utils.config import EngineConfig, LoaderConfig
+from nvme_strom_tpu.utils.tuning import tuned_chunk_bytes
 
 _SENTINEL = object()
 _log = logging.getLogger(__name__)
@@ -360,29 +362,37 @@ class ShardedLoader:
                 idx_parts, reads = entry
                 parts = {}
                 try:
-                    for ext, p in reads.items():
+                    for ext, pieces in reads.items():
                         # the index promised the bytes inside the shard:
                         # a short read means truncation — loud
                         # (quarantine-able), never a silently short
                         # training sample
-                        view = wait_exact(p)
-                        parts[ext] = view.tobytes()  # host copy, decode
-                        p.release()
+                        parts[ext] = b"".join(
+                            wait_exact(p).tobytes()  # host copy, decode
+                            for p in pieces)
+                        for p in pieces:
+                            p.release()
                 finally:
                     # a mid-sample failure must hand the sample's OTHER
                     # reads back too — the entry already left pend, so
                     # the outer drain cannot see them (release is
                     # idempotent for the ones that got there)
-                    for p in reads.values():
-                        p.release()
+                    for pieces in reads.values():
+                        for p in pieces:
+                            p.release()
                 eng.stats.add(bounce_bytes=sum(
                     len(v) for v in parts.values()))
                 return self.decode(parts)
 
             for si in sample_order:
-                reads = {
-                    ext: eng.submit_read(fh, off, ln)
-                    for ext, (off, ln) in samples[si].items()}
+                # one planned batch per sample: a sample's members are
+                # adjacent tar/record ranges, so they coalesce into
+                # fewer, larger reads and submit under ONE doorbell
+                items = list(samples[si].items())
+                planned = plan_and_submit(
+                    eng, [(fh, off, ln) for _, (off, ln) in items])
+                reads = {ext: pieces
+                         for (ext, _), pieces in zip(items, planned)}
                 pend.append((si, reads))
                 if len(pend) >= depth:
                     yield finish(pend.pop(0))
@@ -393,8 +403,9 @@ class ShardedLoader:
             # and must be waited + released, or the pool leaks and the
             # engine teardown would race the I/O.
             for _, reads in pend:
-                for p in reads.values():
-                    p.release()  # waits if still in flight
+                for pieces in reads.values():
+                    for p in pieces:
+                        p.release()  # waits if still in flight
             eng.close(fh)
 
     # -- batching + device placement ---------------------------------------
@@ -551,7 +562,13 @@ class ShardedLoader:
                                                          rshape):
                 raise ValueError(
                     f"{ix.path}: record layout differs from {idxs[0].path}")
-        max_read = (eng.config.chunk_bytes // rec_bytes) * rec_bytes
+        # split size: the ledger-tuned chunk (planner default), floored
+        # to whole records so every piece reshapes cleanly; fall back to
+        # the engine's full buffer when a record outgrows the tuned size
+        split_src = tuned_chunk_bytes(eng)
+        if split_src < rec_bytes:
+            split_src = eng.config.chunk_bytes
+        max_read = (split_src // rec_bytes) * rec_bytes
         if max_read == 0:
             raise ValueError(
                 f"record ({rec_bytes}B) exceeds engine chunk_bytes "
@@ -568,27 +585,39 @@ class ShardedLoader:
             total += ix.count
         n_batches = self._count_batches(total)
 
-        def pieces(r0, r1):
-            """Local records [r0, r1) → [(shard_i, offset, length), ...]
-            contiguous file ranges, split at shard and buffer bounds."""
+        def row_spans(r0, r1):
+            """Local records [r0, r1) → [(shard_i, offset, nbytes), ...]
+            contiguous per-shard extents (split only at shard bounds —
+            the planner owns the buffer-bound split)."""
             out = []
             si = 0
             while r0 < r1:
                 while base[si] + idxs[si].count <= r0:
                     si += 1
                 take = min(r1, base[si] + idxs[si].count) - r0
-                off0 = (r0 - base[si]) * rec_bytes
-                nb = take * rec_bytes
-                for o in range(0, nb, max_read):
-                    out.append((si, off0 + o, min(max_read, nb - o)))
+                out.append((si, (r0 - base[si]) * rec_bytes,
+                            take * rec_bytes))
                 r0 += take
             return out
+
+        def span_pieces(r0, r1) -> int:
+            """Worst-case staging pieces the planner produces for these
+            rows (per-shard extents never coalesce across files, so the
+            per-extent ceil is exact-or-over — safe for pool-fit)."""
+            return sum(-(-nb // max_read)
+                       for _, _, nb in row_spans(r0, r1))
 
         fhs = [eng.open(p) for p in order]
 
         def plan_reads(r0, r1):
-            return [eng.submit_read(fhs[si], off, ln)
-                    for si, off, ln in pieces(r0, r1)]
+            """One planned, vectored submission for the rows: pieces
+            stay record-aligned (split_unit=rec_bytes) so each staging
+            view reshapes to whole records."""
+            exts = [(fhs[si], off, nb)
+                    for si, off, nb in row_spans(r0, r1)]
+            parts = plan_and_submit(eng, exts, split_unit=rec_bytes,
+                                    chunk_bytes=split_src)
+            return [p for pieces in parts for p in pieces]
 
         def to_device(dev, prs):
             parts = []
@@ -611,7 +640,7 @@ class ShardedLoader:
 
         span_list = sorted({sp for sp in dev_spans.values()})
         batch_pieces = sum(
-            len(pieces((g0 - lo), (g1 - lo))) for g0, g1 in span_list)
+            span_pieces((g0 - lo), (g1 - lo)) for g0, g1 in span_list)
         yield from self._zero_copy_batches(
             sharding, gshape, dev_spans, lo, n_batches, batch_pieces,
             plan_reads, to_device, fhs)
@@ -848,7 +877,7 @@ class ShardedLoader:
         gshape = (self.global_batch, mlen)
         dev_spans, lo = self._device_row_spans(sharding, gshape)
         n_batches = self._count_batches(len(recs))
-        chunk = eng.config.chunk_bytes
+        chunk = tuned_chunk_bytes(eng)   # planner split size (≤ buffer)
         fhs = [eng.open(p) for p in order]
 
         # Span coalescing (window-9): tar members of one fixed payload
@@ -915,23 +944,31 @@ class ShardedLoader:
                     groups.append([si, off, 1])
             return groups
 
+        # BOTH read plans (strided spans and per-member) route through
+        # the shared planner: one place owns the chunk-split rule (the
+        # two hand-rolled loops here used to drift), near-adjacent
+        # ranges coalesce (consecutive tar members sit one 512 B header
+        # apart — under the default gap), and the whole range submits
+        # as ONE vectored batch.
+
         def plan_reads_span(r0, r1):
+            groups = span_groups(r0, r1)
+            planned = plan_and_submit(
+                eng, [(fhs[si], off0, k * stride)
+                      for si, off0, k in groups],
+                chunk_bytes=chunk)
             out = []
-            for si, off0, k in span_groups(r0, r1):
-                nb = k * stride
-                prs = _Span(
-                    eng.submit_read(fhs[si], off0 + o, min(chunk, nb - o))
-                    for o in range(0, nb, chunk))
+            for (si, off0, k), pieces in zip(groups, planned):
+                prs = _Span(pieces)
                 prs.k = k
                 out.append(prs)
             return out
 
-        def member_reads(si, off, ln):
-            return [eng.submit_read(fhs[si], off + o, min(chunk, ln - o))
-                    for o in range(0, ln, chunk)]
-
         def plan_reads(r0, r1):
-            return [member_reads(*recs[r]) for r in range(r0, r1)]
+            return plan_and_submit(
+                eng, [(fhs[recs[r][0]], recs[r][1], recs[r][2])
+                      for r in range(r0, r1)],
+                chunk_bytes=chunk)
 
         def dispatch_groups(dev, groups, group_block):
             """One batch's groups → device blocks: wait each read, put

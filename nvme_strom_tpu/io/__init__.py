@@ -17,6 +17,14 @@ from nvme_strom_tpu.io.faults import (
     FaultyEngine,
     build_engine,
 )
+from nvme_strom_tpu.io.plan import (
+    ExtentPlan,
+    SpanView,
+    plan_and_submit,
+    plan_extents,
+    split_spans,
+    submit_spans,
+)
 from nvme_strom_tpu.io.resilient import (
     ReadError,
     ResilientEngine,
@@ -27,4 +35,6 @@ __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
            "DeviceInfo", "Extent", "check_file", "resolve_device",
            "file_extents", "file_eligible", "wait_exact",
            "FaultPlan", "FaultSpec", "FaultyEngine", "build_engine",
+           "ExtentPlan", "SpanView", "plan_and_submit", "plan_extents",
+           "split_spans", "submit_spans",
            "ReadError", "ResilientEngine", "ResilientRead"]

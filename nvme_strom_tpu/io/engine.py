@@ -84,7 +84,17 @@ class _StatsBlk(ctypes.Structure):
     _fields_ = [(n, ctypes.c_uint64) for n in (
         "bytes_direct", "bytes_fallback", "bounce_bytes",
         "bytes_written_direct", "requests_submitted", "requests_completed",
-        "requests_failed", "retries", "bytes_resident")]
+        "requests_failed", "retries", "bytes_resident",
+        "submit_batches", "submit_syscalls_saved")]
+
+
+class _RdExt(ctypes.Structure):
+    _fields_ = [
+        ("fh", ctypes.c_int32),
+        ("pad", ctypes.c_uint32),
+        ("offset", ctypes.c_uint64),
+        ("length", ctypes.c_uint64),
+    ]
 
 
 class _Completion(ctypes.Structure):
@@ -149,6 +159,10 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_submit_read.restype = ctypes.c_int64
         lib.strom_submit_read.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.c_uint64, ctypes.c_uint64]
+        lib.strom_submit_readv.argtypes = [ctypes.c_void_p,
+                                           ctypes.POINTER(_RdExt),
+                                           ctypes.c_uint32,
+                                           ctypes.POINTER(ctypes.c_int64)]
         lib.strom_submit_write.restype = ctypes.c_int64
         lib.strom_submit_write.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                            ctypes.c_uint64, ctypes.c_void_p,
@@ -344,10 +358,15 @@ class PendingRead:
     view is valid until ``release()``.
     """
 
-    def __init__(self, engine: "StromEngine", req_id: int, length: int):
+    def __init__(self, engine: "StromEngine", req_id: int, length: int,
+                 fh: int = -1, offset: int = -1):
         self._engine = engine
         self._req_id = req_id
         self._length = length
+        #: submit-time identity, carried so short-read/error reports can
+        #: name the exact range (wait_exact, ReadError history)
+        self.fh = fh
+        self.offset = offset
         self._released = False
         self._view: Optional[np.ndarray] = None
         self._error: Optional[OSError] = None
@@ -461,9 +480,13 @@ def wait_exact(pending, timeout: Optional[float] = None) -> np.ndarray:
     view = pending.wait(timeout)
     if view.nbytes != pending.length:
         pending.release()
+        fh = getattr(pending, "fh", None)
+        offset = getattr(pending, "offset", None)
+        where = ("" if fh is None or fh < 0
+                 else f" (fh={fh} offset={offset})")
         raise OSError(errno.EIO,
-                      f"short read: {view.nbytes} of {pending.length} "
-                      "bytes")
+                      f"short read: got {view.nbytes} of "
+                      f"{pending.length} expected bytes{where}")
     return view
 
 
@@ -668,7 +691,44 @@ class StromEngine:
             raise OSError(-rid, os.strerror(-rid))
         if self._stripe:
             self._attr_stripe(fh, offset, length)
-        return PendingRead(self, rid, length)
+        return PendingRead(self, rid, length, fh=fh, offset=offset)
+
+    def submit_readv(self, reads) -> list:
+        """Vectored submission: one C call, one io_uring doorbell for the
+        whole batch (``strom_submit_readv``).
+
+        ``reads``: sequence of ``(fh, offset, length)``.  Returns one
+        PendingRead per input extent, in order — each waits/releases
+        exactly like a ``submit_read`` result.  Validation is atomic:
+        on ValueError/OSError nothing was submitted.  This is the L2
+        boundary the extent-coalescing planner (io/plan.py) submits
+        through; calling it directly is fine for pre-split ranges.
+        """
+        reads = list(reads)
+        if not reads:
+            return []
+        chunk = self.config.chunk_bytes
+        for fh, offset, length in reads:
+            if length > chunk:
+                raise ValueError(
+                    f"read length {length} exceeds chunk_bytes "
+                    f"{chunk}; split the range (io/plan.py does)")
+        n = len(reads)
+        exts = (_RdExt * n)()
+        for i, (fh, offset, length) in enumerate(reads):
+            exts[i].fh = fh
+            exts[i].offset = offset
+            exts[i].length = length
+        rids = (ctypes.c_int64 * n)()
+        rc = self._lib.strom_submit_readv(self._h, exts, n, rids)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        if self._stripe:
+            for fh, offset, length in reads:
+                self._attr_stripe(fh, offset, length)
+        return [PendingRead(self, int(rids[i]), reads[i][2],
+                            fh=reads[i][0], offset=reads[i][1])
+                for i in range(n)]
 
     def read(self, fh: int, offset: int, length: int) -> np.ndarray:
         """Synchronous convenience read returning an *owning* array.

@@ -207,6 +207,14 @@ class FaultyRead:
         exactly that truncation."""
         return self._inner.length
 
+    @property
+    def fh(self) -> int:
+        return getattr(self._inner, "fh", -1)
+
+    @property
+    def offset(self) -> int:
+        return getattr(self._inner, "offset", -1)
+
     def _remaining_delay(self) -> float:
         if self._spec.kind not in ("delay", "stuck"):
             return 0.0
@@ -326,8 +334,9 @@ class FaultyEngine:
         self._paths.pop(fh, None)
         self._engine.close(fh)
 
-    def submit_read(self, fh: int, offset: int, length: int):
-        pending = self._engine.submit_read(fh, offset, length)
+    def _maybe_fault(self, pending, fh: int, offset: int, length: int):
+        """Per-read injection decision + accounting, shared by the
+        scalar and vectored submit paths."""
         spec = self.plan.decide(self._paths.get(fh, ""))
         if spec is None:
             return pending
@@ -339,6 +348,21 @@ class FaultyEngine:
                             category="strom.fault", fh=fh, offset=offset,
                             length=length)
         return FaultyRead(pending, spec, self.plan)
+
+    def submit_read(self, fh: int, offset: int, length: int):
+        pending = self._engine.submit_read(fh, offset, length)
+        return self._maybe_fault(pending, fh, offset, length)
+
+    def submit_readv(self, reads) -> list:
+        """Vectored path: ONE batched submission through the wrapped
+        engine, then a PER-EXTENT injection decision — a chaos plan
+        hits individual spans of a batch exactly as a real device
+        fails individual commands of a multi-command submission."""
+        from nvme_strom_tpu.io.plan import submit_spans
+        reads = list(reads)
+        pendings = submit_spans(self._engine, reads)
+        return [self._maybe_fault(p, fh, offset, length)
+                for (fh, offset, length), p in zip(reads, pendings)]
 
     def read(self, fh: int, offset: int, length: int) -> np.ndarray:
         with self.submit_read(fh, offset, length) as p:

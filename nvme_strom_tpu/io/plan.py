@@ -1,0 +1,332 @@
+"""Extent-coalescing I/O planner — the shared read-plan layer between
+consumers and the engine's vectored submit.
+
+The reference amortizes per-request overhead by carrying MANY chunks in
+one MEMCPY_SSD2GPU command (SURVEY.md §3.1); before this module every
+consumer crossed Python→ctypes→``io_uring_enter`` once per extent and
+hand-rolled its own chunk-split loop.  The planner is the one place
+both problems are solved:
+
+  coalesce   extents that are adjacent — or separated by at most
+             ``STROM_COALESCE_GAP`` bytes (default one 4 KiB block) —
+             on the SAME file merge into one larger O_DIRECT read.
+             Consumers get zero-copy SUB-VIEWS of the completed span
+             buffer (legal because the engine already returns offset
+             views instead of memcpy'ing: slicing a numpy view costs
+             nothing).  Overlapping/duplicate extents dedupe into one
+             read the same way.  Cross-file extents never coalesce.
+  split      extents larger than the split size (the ledger-tuned
+             chunk from ``utils/tuning.tuned_chunk_bytes``, capped at
+             the engine's staging-buffer capacity) break into pieces —
+             replacing the near-identical hard-coded loops each
+             consumer carried.  ``split_unit`` keeps piece boundaries
+             on record boundaries (fixedrec) — pieces of one extent
+             are always multiples of the unit from the extent's start.
+  batch      the resulting spans submit through the engine's
+             ``submit_readv`` (ONE C call, ONE ``io_uring_enter``
+             doorbell) when available, falling back to per-span
+             ``submit_read`` for engine wrappers that predate it.
+
+Accounting: every merged extent counts ``StromStats.spans_coalesced``;
+the C engine counts ``submit_batches`` / ``submit_syscalls_saved`` at
+the vectored boundary.  ``bench.py`` reports the resulting coalesce
+ratio and syscalls/GiB next to the throughput headline; thresholds and
+semantics are documented in docs/PERF.md.
+
+The planner composes with the resilience stack unchanged: a
+``ResilientEngine`` submits the batch through the wrapped engine and
+wraps EACH span in its own recovery loop (a failed span retries alone,
+never the whole batch), and ``FaultyEngine`` injects per-span faults
+into the vectored path (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: default coalesce-gap: one O_DIRECT logical block — reading one
+#: wasted block is cheaper than a second NVMe round trip, and tar's
+#: 512 B inter-member headers / the offload file's slot padding both
+#: fall under it
+DEFAULT_COALESCE_GAP = 4096
+
+
+def coalesce_gap() -> int:
+    """Gap threshold in bytes (env ``STROM_COALESCE_GAP``; default one
+    4 KiB block).  0 disables coalescing across gaps (adjacent and
+    overlapping extents still merge); negative values clamp to 0."""
+    try:
+        return max(0, int(os.environ.get("STROM_COALESCE_GAP",
+                                         DEFAULT_COALESCE_GAP)))
+    except ValueError:
+        return DEFAULT_COALESCE_GAP
+
+
+def split_spans(spans, chunk: int):
+    """(offset, length) spans → (flat sub-ranges ≤ ``chunk``, per-span
+    sub-range counts).  The one splitting rule every chunk-bound
+    consumer shares (engine reads are capped at chunk_bytes);
+    zero-length spans contribute zero sub-ranges but keep their count
+    entry so group boundaries stay aligned.  (Formerly
+    ``ops.bridge.split_ranges``, which now delegates here.)"""
+    flat, counts = [], []
+    for off, ln in spans:
+        before = len(flat)
+        while ln > 0:
+            take = min(chunk, ln)
+            flat.append((off, take))
+            off += take
+            ln -= take
+        counts.append(len(flat) - before)
+    return flat, counts
+
+
+@dataclass(frozen=True)
+class ExtentPlan:
+    """The pure (side-effect-free) plan: which engine reads to submit
+    and where each input extent's bytes land in them.
+
+    ``spans``       (fh, offset, length) engine reads, each ≤ the split
+                    size, in submission order.
+    ``placements``  per input extent (input order), the ordered pieces
+                    covering it: (span_index, lo, hi) byte ranges
+                    RELATIVE to that span's completed view.  Zero-
+                    length extents get an empty piece list.
+    ``spans_coalesced``  input extents that merged into a span opened
+                    by an earlier extent (k-extent merge counts k-1).
+    """
+
+    spans: List[Tuple[int, int, int]]
+    placements: List[List[Tuple[int, int, int]]]
+    spans_coalesced: int
+    n_extents: int
+
+    @property
+    def submits_saved(self) -> int:
+        """Engine submissions a per-extent caller would have made minus
+        what this plan makes (coalescing net of splitting)."""
+        return self.n_extents - len(self.spans)
+
+
+def plan_extents(extents: Sequence[Tuple[int, int, int]], *,
+                 chunk_bytes: int, gap: Optional[int] = None,
+                 split_unit: int = 1) -> ExtentPlan:
+    """Sort + coalesce + split ``(fh, offset, length)`` extents.
+
+    ``chunk_bytes``: max bytes of one engine read (≤ the engine's
+    staging-buffer capacity).  ``gap``: max bytes of dead space to read
+    through when merging (None = env/default via :func:`coalesce_gap`).
+    ``split_unit``: piece boundaries of a SPLIT extent stay multiples
+    of this from the extent's start (record size for fixedrec); a
+    merged span is never split, so sub-views inside it keep exact
+    byte placement regardless of the unit.
+    """
+    if gap is None:
+        gap = coalesce_gap()
+    if split_unit <= 0:
+        raise ValueError(f"split_unit must be >= 1, got {split_unit}")
+    split = (chunk_bytes // split_unit) * split_unit
+    if split <= 0:
+        raise ValueError(
+            f"split_unit ({split_unit}) exceeds chunk_bytes "
+            f"({chunk_bytes}); raise EngineConfig.chunk_bytes")
+    n = len(extents)
+    placements: List[List[Tuple[int, int, int]]] = [[] for _ in range(n)]
+    spans: List[Tuple[int, int, int]] = []
+    coalesced = 0
+
+    for i in range(n):
+        if extents[i][2] < 0:
+            raise ValueError(f"extent {i}: negative length "
+                             f"{extents[i][2]}")
+    order = sorted((i for i in range(n) if extents[i][2] > 0),
+                   key=lambda i: (extents[i][0], extents[i][1],
+                                  extents[i][2]))
+
+    def emit(group: list) -> None:
+        """One coalesced group → spans + placements.  Multi-extent
+        groups fit one span by construction; a lone oversized extent
+        splits at unit-aligned piece boundaries."""
+        nonlocal coalesced
+        fh = extents[group[0]][0]
+        start = extents[group[0]][1]
+        end = max(extents[i][1] + extents[i][2] for i in group)
+        length = end - start
+        if length <= split:
+            si = len(spans)
+            spans.append((fh, start, length))
+            for i in group:
+                off, ln = extents[i][1], extents[i][2]
+                placements[i].append((si, off - start, off - start + ln))
+            coalesced += len(group) - 1
+            return
+        # lone oversized extent: piece k covers [start + k*split, ...)
+        assert len(group) == 1
+        i = group[0]
+        pos = 0
+        while pos < length:
+            take = min(split, length - pos)
+            si = len(spans)
+            spans.append((fh, start + pos, take))
+            placements[i].append((si, 0, take))
+            pos += take
+
+    group: list = []
+    g_fh = g_start = g_end = 0
+    for i in order:
+        fh, off, ln = extents[i]
+        if group and fh == g_fh and off <= g_end + gap \
+                and max(g_end, off + ln) - g_start <= split:
+            group.append(i)
+            g_end = max(g_end, off + ln)
+            continue
+        if group:
+            emit(group)
+        group = [i]
+        g_fh, g_start, g_end = fh, off, off + ln
+    if group:
+        emit(group)
+    return ExtentPlan(spans=spans, placements=placements,
+                      spans_coalesced=coalesced, n_extents=n)
+
+
+class _SharedSpan:
+    """One submitted span read, shared by every sub-view cut from it.
+    The underlying request releases when the LAST view releases."""
+
+    __slots__ = ("pending", "_refs")
+
+    def __init__(self, pending, refs: int):
+        self.pending = pending
+        self._refs = refs
+
+    def release_one(self) -> None:
+        self._refs -= 1
+        if self._refs <= 0:
+            self.pending.release()
+
+
+_EMPTY = np.empty(0, dtype=np.uint8)
+
+
+class SpanView:
+    """PendingRead-shaped zero-copy sub-view of a (possibly coalesced)
+    span read.
+
+    ``wait()`` returns ``span_view[lo:hi]`` — a numpy slice of the
+    engine's staging buffer, no copy; validity follows the span's
+    buffer (until every view of the span releases).  ``length``/
+    ``fh``/``offset`` describe THIS piece, so ``wait_exact`` reports
+    name the exact range.  A span completing short (EOF/device short
+    read) surfaces here as a short sub-view, which ``wait_exact``
+    turns into the loud OSError.  Piece of a zero-length extent:
+    ``lo == hi``, waits to an empty view without any I/O dependency
+    beyond its span.
+    """
+
+    __slots__ = ("_span", "_lo", "_hi", "fh", "offset", "_released")
+
+    def __init__(self, span: _SharedSpan, lo: int, hi: int,
+                 fh: int, offset: int):
+        self._span = span
+        self._lo = lo
+        self._hi = hi
+        self.fh = fh
+        self.offset = offset
+        self._released = False
+
+    @property
+    def length(self) -> int:
+        return self._hi - self._lo
+
+    @property
+    def was_fallback(self) -> bool:
+        return bool(getattr(self._span.pending, "was_fallback", False))
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        view = self._span.pending.wait(timeout)
+        lo = min(self._lo, view.nbytes)
+        return view[lo:min(self._hi, view.nbytes)]
+
+    def is_ready(self) -> bool:
+        return self._span.pending.is_ready()
+
+    def release(self) -> None:
+        """Idempotent; the shared span's request frees once every view
+        cut from it has released (refcounted — the engine's
+        release-waits-if-live contract applies to the last one)."""
+        if self._released:
+            return
+        self._released = True
+        self._span.release_one()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def submit_spans(engine, spans: Sequence[Tuple[int, int, int]]) -> list:
+    """Submit planned spans through the engine's vectored path when it
+    has one (StromEngine/Resilient/Faulty all do), else per-span —
+    returns pending reads aligned with ``spans``.  All-or-nothing
+    either way: the C path validates atomically, and the per-span
+    fallback releases already-submitted reads before re-raising, so a
+    mid-list failure never strands staging buffers."""
+    readv = getattr(engine, "submit_readv", None)
+    if readv is not None:
+        return readv(spans)
+    out: list = []
+    try:
+        for fh, off, ln in spans:
+            out.append(engine.submit_read(fh, off, ln))
+    except BaseException:
+        for p in out:
+            p.release()
+        raise
+    return out
+
+
+def plan_and_submit(engine, extents: Sequence[Tuple[int, int, int]], *,
+                    gap: Optional[int] = None, split_unit: int = 1,
+                    chunk_bytes: Optional[int] = None
+                    ) -> List[List[SpanView]]:
+    """Plan ``(fh, offset, length)`` extents, submit the spans as ONE
+    batch, and return — aligned with the input — each extent's ordered
+    list of :class:`SpanView` pieces (one piece unless the extent was
+    split; empty list for zero-length extents).
+
+    The split size defaults to the ledger-tuned chunk
+    (``utils.tuning.tuned_chunk_bytes``); pass ``chunk_bytes`` to pin
+    it (must be ≤ the engine's staging capacity).  Coalescing counts
+    into ``StromStats.spans_coalesced``.
+    """
+    if chunk_bytes is None:
+        from nvme_strom_tpu.utils.tuning import tuned_chunk_bytes
+        chunk_bytes = tuned_chunk_bytes(engine)
+    plan = plan_extents(extents, chunk_bytes=chunk_bytes, gap=gap,
+                        split_unit=split_unit)
+    pendings = submit_spans(engine, plan.spans)
+    refs = [0] * len(pendings)
+    for pieces in plan.placements:
+        for si, _, _ in pieces:
+            refs[si] += 1
+    shared = [_SharedSpan(p, max(1, r))
+              for p, r in zip(pendings, refs)]
+    out: List[List[SpanView]] = []
+    for (fh, off, _ln), pieces in zip(extents, plan.placements):
+        views = []
+        pos = 0
+        for si, lo, hi in pieces:
+            views.append(SpanView(shared[si], lo, hi, fh, off + pos))
+            pos += hi - lo
+        out.append(views)
+    stats = getattr(engine, "stats", None)
+    if stats is not None and plan.spans_coalesced:
+        stats.add(spans_coalesced=plan.spans_coalesced)
+    return out

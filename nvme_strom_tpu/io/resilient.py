@@ -103,6 +103,14 @@ class ResilientRead:
         """Bytes requested at submit (PendingRead.length parity)."""
         return self._length
 
+    @property
+    def fh(self) -> int:
+        return self._fh
+
+    @property
+    def offset(self) -> int:
+        return self._offset
+
     # -- the recovery loop -------------------------------------------------
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
@@ -415,6 +423,31 @@ class ResilientEngine:
             size = 0
         expected = min(length, max(0, size - offset))
         return ResilientRead(self, fh, offset, length, pending, expected)
+
+    def submit_readv(self, reads) -> list:
+        """Batch-aware vectored submission: the whole batch goes down
+        in ONE wrapped-engine call (keeping the syscall amortization),
+        but every extent comes back as its OWN ResilientRead — a
+        failed/short/stuck span retries, hedges, and cancels alone;
+        the rest of the batch is never resubmitted."""
+        from nvme_strom_tpu.io.plan import submit_spans
+        self._reap_zombies()   # lost hedges hand buffers back here
+        reads = list(reads)
+        pendings = submit_spans(self._engine, reads)
+        sizes: dict = {}
+        out = []
+        for (fh, offset, length), pending in zip(reads, pendings):
+            size = sizes.get(fh)
+            if size is None:
+                try:
+                    size = self._engine.file_size(fh)
+                except OSError:
+                    size = 0
+                sizes[fh] = size
+            expected = min(length, max(0, size - offset))
+            out.append(ResilientRead(self, fh, offset, length, pending,
+                                     expected))
+        return out
 
     def read(self, fh: int, offset: int, length: int) -> np.ndarray:
         """Synchronous owning-array read through the recovery path."""

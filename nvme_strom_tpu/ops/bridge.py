@@ -20,6 +20,7 @@ from typing import Iterator, Optional, Sequence
 import numpy as np
 
 from nvme_strom_tpu.io.engine import StromEngine, PendingRead
+from nvme_strom_tpu.io.plan import split_spans, submit_spans
 from nvme_strom_tpu.utils.config import EngineConfig
 
 
@@ -30,20 +31,10 @@ def _default_device():
 
 def split_ranges(spans, chunk: int):
     """(offset, length) spans → (flat sub-ranges ≤ ``chunk``, per-span
-    sub-range counts).  The one splitting rule every consumer of
-    ``stream_ranges`` shares (engine reads are capped at chunk_bytes);
-    zero-length spans contribute zero sub-ranges but keep their count
-    entry so group boundaries stay aligned."""
-    flat, counts = [], []
-    for off, ln in spans:
-        before = len(flat)
-        while ln > 0:
-            take = min(chunk, ln)
-            flat.append((off, take))
-            off += take
-            ln -= take
-        counts.append(len(flat) - before)
-    return flat, counts
+    sub-range counts).  Delegates to the planner's shared splitting
+    rule (``io.plan.split_spans``) — kept under its historical name for
+    the format readers that import it from here."""
+    return split_spans(spans, chunk)
 
 
 def host_to_device(engine: StromEngine, host: np.ndarray, dev,
@@ -219,12 +210,23 @@ class DeviceStream:
             while inflight and inflight[0][0].is_ready():
                 yield drain_one()
 
-        it = iter(ranges)
-        shapes_it = iter(shapes) if shapes is not None else None
+        ranges = list(ranges)
+        shapes_l = list(shapes) if shapes is not None else None
         try:
-            for i, (off, ln) in enumerate(it):
-                shape = next(shapes_it) if shapes_it is not None else None
-                pending.append((self.engine.submit_read(fh, off, ln), shape))
+            i = 0
+            while i < len(ranges):
+                # vectored refill: up to ``depth`` ranges enter the
+                # engine as ONE batched submission (single
+                # io_uring_enter via submit_readv) instead of one
+                # boundary crossing per chunk
+                take = ranges[i:i + self.depth]
+                prs = submit_spans(self.engine,
+                                   [(fh, off, ln) for off, ln in take])
+                for j, pr in enumerate(prs):
+                    shape = (shapes_l[i + j] if shapes_l is not None
+                             else None)
+                    pending.append((pr, shape))
+                i += len(take)
                 # keep `depth` reads in flight before starting transfers
                 while len(pending) > self.depth:
                     pr, shp = pending.pop(0)

@@ -372,8 +372,12 @@ class OffloadedAdam:
 
     def _group_ranges(self, names) -> tuple[list, list]:
         """Chunk-split (offset, length) ranges covering each slot of the
-        group, plus per-slot chunk counts for device-side reassembly."""
-        chunk = self.engine.config.chunk_bytes
+        group, plus per-slot chunk counts for device-side reassembly.
+        The split rule and size come from the shared planner
+        (``io.plan.split_spans`` via the ledger-tuned chunk); the
+        ranges then ride ``DeviceStream``'s vectored submission."""
+        from nvme_strom_tpu.utils.tuning import tuned_chunk_bytes
+        chunk = tuned_chunk_bytes(self.engine)
         ranges: list[tuple[int, int]] = []
         counts: list[int] = []      # chunks per slot, m then v, slot order
         for n in names:

@@ -28,6 +28,7 @@ from nvme_strom_tpu.formats.safetensors import (
     _np_dtype,
 )
 from nvme_strom_tpu.io.engine import StromEngine, wait_exact
+from nvme_strom_tpu.io.plan import plan_and_submit
 from nvme_strom_tpu.utils.config import EngineConfig
 
 
@@ -189,7 +190,11 @@ class LazyCheckpoint:
                     cache: Dict[tuple, np.ndarray] = {}
                     put = []
                     for dev, tail in devs:
-                        sub = cache.get(tail)
+                        # hashable key: slice objects only hash on
+                        # 3.12+, and devs sharing a column shard must
+                        # share the gathered sub-array
+                        tkey = tuple((s.start, s.stop) for s in tail)
+                        sub = cache.get(tkey)
                         if sub is None:
                             sub = view
                             if tail and any(
@@ -200,7 +205,7 @@ class LazyCheckpoint:
                                 sub = np.ascontiguousarray(sub)
                                 eng.stats.add(
                                     bounce_bytes=int(sub.nbytes))
-                            cache[tail] = sub
+                            cache[tkey] = sub
                         arr = host_to_device(eng, sub, dev)
                         parts[dev].append(arr)
                         put.append(arr)
@@ -227,7 +232,9 @@ class LazyCheckpoint:
         can double as a backstop."""
         if not gshape:
             ent = sf.plan([name]).entries[0]
-            p = eng.submit_read(fh, ent.offset, ent.length)
+            (pieces,) = plan_and_submit(eng, [(fh, ent.offset,
+                                               ent.length)])
+            (p,) = pieces   # scalar payload never splits
             done = False
             try:
                 # ownership transfers at the yield: the consumer's
@@ -250,15 +257,15 @@ class LazyCheckpoint:
         chunk_rows = max(1, eng.config.chunk_bytes // max(1, row_bytes))
         if row_bytes > eng.config.chunk_bytes:
             # One row exceeds the staging buffer: assemble rows on host
-            # (counted as bounce — resize the pool to avoid this).
+            # (counted as bounce — resize the pool to avoid this).  The
+            # planner owns the oversized-extent split.
             for r in range(r0, r1):
                 ent = sf.slice_plan(name, r, 1)
                 buf = np.empty(ent.length, dtype=np.uint8)
                 pos = 0
-                step = eng.config.chunk_bytes
-                pend = [eng.submit_read(fh, ent.offset + o,
-                                        min(step, ent.length - o))
-                        for o in range(0, ent.length, step)]
+                (pend,) = plan_and_submit(
+                    eng, [(fh, ent.offset, ent.length)],
+                    chunk_bytes=eng.config.chunk_bytes)
                 for p in pend:
                     # cumulative assembly: a silently short view would
                     # leave a garbage tail that reshapes cleanly
@@ -271,23 +278,39 @@ class LazyCheckpoint:
                 yield buf.view(np_dt).reshape((1,) + tuple(gshape[1:])), \
                     None
             return
-        depth = max(2, eng.config.queue_depth // 2)
+        # One planned, vectored submission for the whole row span: row
+        # chunks are contiguous on disk, so small tensors coalesce into
+        # fewer reads (each slice keeps its own zero-copy sub-view) and
+        # every span crosses Python→C→io_uring_enter once, not once per
+        # chunk.  The engine defers reads past its pool without
+        # blocking, so submitting the span up front cannot deadlock —
+        # buffers recycle oldest-first as the consumer retires views.
+        slices = []
+        for r in range(r0, r1, chunk_rows):
+            n = min(chunk_rows, r1 - r)
+            ent = sf.slice_plan(name, r, n)
+            slices.append(((fh, ent.offset, ent.length), ent.shape))
+        planned = plan_and_submit(eng, [s for s, _ in slices],
+                                  chunk_bytes=eng.config.chunk_bytes)
         pend = []
+        for ((_, _, ln), shp), pieces in zip(slices, planned):
+            if not pieces:    # zero-element slice: no I/O to wait on
+                pend.append((None, shp))
+                continue
+            (p,) = pieces   # a nonzero slice fits one buffer: never split
+            pend.append((p, shp))
         try:
-            for r in range(r0, r1, chunk_rows):
-                n = min(chunk_rows, r1 - r)
-                ent = sf.slice_plan(name, r, n)
-                pend.append((eng.submit_read(fh, ent.offset, ent.length),
-                             ent.shape))
-                if len(pend) >= depth:
-                    p, shp = pend.pop(0)
-                    yield p.wait().view(np_dt).reshape(shp), p.release
             while pend:
                 p, shp = pend.pop(0)
+                if p is None:
+                    yield np.empty(0, np.uint8).view(np_dt).reshape(shp), \
+                        None
+                    continue
                 yield p.wait().view(np_dt).reshape(shp), p.release
         finally:
             for p, _ in pend:  # abandoned mid-span: drain + free
-                p.release()
+                if p is not None:
+                    p.release()
 
 
 def save_checkpoint(path, params: Dict[str, object],

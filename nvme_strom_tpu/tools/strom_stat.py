@@ -39,6 +39,13 @@ _RESILIENCE_COUNTERS = (
     "restore_fallbacks",
 )
 
+#: batched-submission counters (io/plan.py planner + the engine's
+#: strom_submit_readv — docs/PERF.md); own block, shown only when the
+#: vectored path ran
+_BATCH_COUNTERS = (
+    "spans_coalesced", "submit_batches", "submit_syscalls_saved",
+)
+
 
 def render_device(path: str) -> str:
     """Backing-device topology of ``path`` — the observable form of the
@@ -88,6 +95,17 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
         lines.append(f"  {name:<22} {shown:>14}{suffix}")
     for name in sorted(k for k in snap if k.startswith("lat_")):
         lines.append(f"  {name:<22} {snap[name]:>14.1f}")
+    if any(int(snap.get(n, 0)) for n in _BATCH_COUNTERS):
+        lines.append("  batched submission (planner + submit_readv):")
+        for name in _BATCH_COUNTERS:
+            lines.append(f"    {name:<20} {int(snap.get(name, 0)):>14}")
+        subs = int(snap.get("requests_submitted", 0))
+        if subs:
+            merged = int(snap.get("spans_coalesced", 0))
+            lines.append(
+                f"    coalesce ratio       "
+                f"{merged / (merged + subs):>14.3f}   "
+                "(extents merged / extents planned)")
     if any(int(snap.get(n, 0)) for n in _RESILIENCE_COUNTERS):
         lines.append("  resilience (recoveries + degradations):")
         for name in _RESILIENCE_COUNTERS:

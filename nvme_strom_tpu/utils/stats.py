@@ -55,6 +55,17 @@ class StromStats:
     # planned page-cache reads (submit-time residency probe chose the
     # buffered path; subset of bytes_fallback, never a rescue)
     bytes_resident: int = 0
+    # -- batched-submission counters (io/plan.py + strom_submit_readv) -----
+    # extents the planner merged into a shared span read (a k-extent
+    # merge counts k-1): the fewer-larger-NVMe-commands half of the win
+    spans_coalesced: int = 0
+    # vectored submit calls (strom_submit_readv batches, n >= 1), and
+    # the per-extent submission round trips they avoided (extents per
+    # batch beyond the first — io_uring_enter doorbells on the uring
+    # backend, one Python→C crossing each either way): the
+    # fewer-syscalls half of the win
+    submit_batches: int = 0
+    submit_syscalls_saved: int = 0
     # -- resilience counters (io/faults.py, io/resilient.py) --------------
     # faults injected by an active FaultPlan (test/chaos runs; 0 in prod)
     faults_injected: int = 0

@@ -167,6 +167,43 @@ def best_attn_blocks(q_seq: int, kv_seq: int,
     return _attn_blocks_cached(q_seq, kv_seq, p, mtime)
 
 
+@functools.lru_cache(maxsize=32)
+def _tuned_chunk_cached(cap: int, path: str, mtime: float) -> int:
+    best = best_probe_config(path)
+    if best and best.get("chunk_mib"):
+        ck = int(best["chunk_mib"]) << 20
+        if 0 < ck <= cap:
+            return ck
+    return cap
+
+
+def tuned_chunk_bytes(engine) -> int:
+    """Read-split size for the extent planner (io/plan.py): the engine's
+    chunk_bytes (the staging-buffer capacity, the hard cap), lowered to
+    the best CREDIBLE ledgered probe chunk when one exists and fits —
+    the one place the planner's split granularity reads the on-silicon
+    verdict instead of each consumer hard-coding its own loop bound.
+    STROM_BENCH_AUTO_TUNE=0 opts out (raw engine chunk).
+
+    Cached against the ledger's PINNED mtime (same discipline as
+    best_attn_blocks): the planner calls this per submission batch —
+    on the wds per-sample path that is once per training sample, and
+    re-parsing the whole ledger there would cost more than the
+    syscalls the planner saves."""
+    cap = engine.config.chunk_bytes
+    if os.environ.get("STROM_BENCH_AUTO_TUNE", "1") == "0":
+        return cap
+    p = _LEDGER
+    mtime = _MTIME_PIN.get(p)
+    if mtime is None:
+        try:
+            mtime = os.path.getmtime(p)
+        except OSError:
+            return cap
+        _MTIME_PIN[p] = mtime
+    return _tuned_chunk_cached(cap, p, mtime)
+
+
 def tuned_stream_params(engine, default_drain: str = "ready"
                         ) -> tuple[int, str]:
     """(depth, drain) for a DeviceStream over ``engine``: the engine's

@@ -160,6 +160,13 @@ class StepWatchdog:
                       f"completed={s.requests_completed} "
                       f"failed={s.requests_failed} "
                       f"retries={s.retries}", file=w, flush=True)
+                # vectored-submission tier (planner + submit_readv): a
+                # wedged batch shows up as batches advancing without
+                # completions
+                print(f"batching: batches={s.submit_batches} "
+                      f"syscalls_saved={s.submit_syscalls_saved} "
+                      f"coalesced={s.spans_coalesced}",
+                      file=w, flush=True)
                 # the recovery tier's own accounting: a hung step whose
                 # resilient counters are MOVING is recovering, not
                 # wedged — the distinction this dump exists to make

@@ -611,3 +611,89 @@ def test_hedge_capped_at_one_per_attempt(data_file, tmp_path):
     assert stats.hedges_issued == 1, (
         f"hedge storm: {stats.hedges_issued} issued")
     assert stats.hedges_won == 0
+
+
+def test_vectored_submit_injects_per_extent(data_file, tmp_path):
+    """The planner's batched path (submit_readv) gets the SAME chaos
+    coverage as scalar submits: every extent of a batch is a separate
+    injection decision, and recovery retries ONLY the faulted extent —
+    never the whole batch."""
+    from nvme_strom_tpu.io.plan import plan_and_submit
+    from nvme_strom_tpu.io.engine import wait_exact
+
+    path, payload = data_file
+    eng, stats, plan, tracer = _stack("eio:every=2:max_count=2",
+                                      tmp_path, _rcfg(max_retries=3))
+    with eng:
+        fh = eng.open(path)
+        extents = [(fh, 0, 1024), (fh, 8192, 2048),
+                   (fh, 65536, 512), (fh, 131072, 4096)]
+        submits_before = None
+        views = plan_and_submit(eng, extents, chunk_bytes=1 << 20)
+        for (f, off, ln), pieces in zip(extents, views):
+            got = b"".join(bytes(wait_exact(p)) for p in pieces)
+            assert got == payload[off:off + ln], (off, ln)
+            for p in pieces:
+                p.release()
+        eng.close(fh)
+    # two extents were faulted; each recovered ALONE (one resubmission
+    # per faulted extent, not a batch resubmission)
+    assert stats.faults_injected == 2
+    assert stats.resilient_retries == 2
+    names = _trace_names(tracer)
+    assert names.count("strom.fault.eio") == 2
+    assert names.count("strom.resilient.retry") == 2
+
+
+def test_vectored_submit_short_read_retried_per_extent(data_file,
+                                                       tmp_path):
+    """A 'short' fault on one extent of a batch is detected by that
+    extent's expected-length check and resubmitted individually."""
+    from nvme_strom_tpu.io.plan import plan_and_submit
+    from nvme_strom_tpu.io.engine import wait_exact
+
+    path, payload = data_file
+    eng, stats, _, _ = _stack("short:every=3:max_count=1:frac=0.25",
+                              tmp_path, _rcfg(max_retries=2))
+    with eng:
+        fh = eng.open(path)
+        extents = [(fh, 0, 4096), (fh, 16384, 4096), (fh, 40960, 4096)]
+        views = plan_and_submit(eng, extents, chunk_bytes=1 << 20)
+        for (f, off, ln), pieces in zip(extents, views):
+            got = b"".join(bytes(wait_exact(p)) for p in pieces)
+            assert got == payload[off:off + ln]
+            for p in pieces:
+                p.release()
+        eng.close(fh)
+    assert stats.faults_injected == 1
+    assert stats.resilient_retries == 1
+
+
+def test_faulty_engine_vectored_counts_and_taxonomy(data_file):
+    """FaultyEngine.submit_readv alone (no resilience): the faulted
+    extent raises, its batch siblings complete clean."""
+    from nvme_strom_tpu.io.engine import wait_exact
+
+    path, payload = data_file
+    stats = StromStats()
+    plan = FaultPlan.parse("eio:every=2")
+    eng = FaultyEngine(StromEngine(_cfg(), stats=stats), plan)
+    try:
+        fh = eng.open(path)
+        prs = eng.submit_readv([(fh, 0, 512), (fh, 4096, 512),
+                                (fh, 8192, 512), (fh, 12288, 512)])
+        failures = 0
+        for (off, ln), p in zip([(0, 512), (4096, 512), (8192, 512),
+                                 (12288, 512)], prs):
+            try:
+                got = bytes(wait_exact(p))
+            except OSError:
+                failures += 1
+            else:
+                assert got == payload[off:off + ln]
+            p.release()
+        assert failures == 2                  # every 2nd extent
+        assert stats.faults_injected == 2
+        eng.close(fh)
+    finally:
+        eng._engine.close_all()

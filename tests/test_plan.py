@@ -1,0 +1,316 @@
+"""Extent-coalescing planner + vectored submission (io/plan.py,
+strom_submit_readv — docs/PERF.md).
+
+Two tiers:
+
+- pure-plan tests: `plan_extents` edge cases (zero-length, overlap,
+  gap exactly at threshold, cross-file, split alignment) need no
+  engine at all;
+- engine tests: data correctness of coalesced sub-views through a real
+  StromEngine (O_DIRECT where the fs supports it, fallback otherwise —
+  both paths exercised), refcounted release, batch counters.
+
+The ``perf``-marked smoke is the hardware-free CI gate: a synthetic
+extent set must coalesce (``spans_coalesced > 0``), submit in one
+batch, and keep ``bounce_bytes == 0`` on the direct path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io import (StromEngine, plan_and_submit,
+                               plan_extents, split_spans, wait_exact)
+from nvme_strom_tpu.io.plan import SpanView, coalesce_gap
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+
+def _cfg(**kw):
+    base = dict(chunk_bytes=1 << 20, queue_depth=8,
+                buffer_pool_bytes=16 << 20)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture()
+def data_file(tmp_path):
+    payload = np.random.default_rng(7).integers(
+        0, 256, 2 << 20, dtype=np.uint8).tobytes()
+    path = tmp_path / "plan_data.bin"
+    path.write_bytes(payload)
+    return str(path), payload
+
+
+@pytest.fixture()
+def engine():
+    stats = StromStats()
+    eng = StromEngine(_cfg(), stats=stats)
+    yield eng
+    eng.close_all()
+
+
+# ---------------------------------------------------------------- pure plan
+
+def test_adjacent_extents_coalesce():
+    p = plan_extents([(1, 0, 100), (1, 100, 100), (1, 200, 100)],
+                     chunk_bytes=1 << 20, gap=0)
+    assert len(p.spans) == 1
+    assert p.spans[0] == (1, 0, 300)
+    assert p.spans_coalesced == 2
+    assert p.placements == [[(0, 0, 100)], [(0, 100, 200)],
+                            [(0, 200, 300)]]
+    assert p.submits_saved == 2
+
+
+def test_gap_exactly_at_threshold_coalesces_one_past_does_not():
+    # gap == threshold merges; threshold + 1 starts a new span
+    at = plan_extents([(1, 0, 100), (1, 100 + 4096, 50)],
+                      chunk_bytes=1 << 20, gap=4096)
+    assert len(at.spans) == 1 and at.spans_coalesced == 1
+    past = plan_extents([(1, 0, 100), (1, 100 + 4097, 50)],
+                        chunk_bytes=1 << 20, gap=4096)
+    assert len(past.spans) == 2 and past.spans_coalesced == 0
+
+
+def test_cross_file_batches_never_coalesce():
+    p = plan_extents([(1, 0, 100), (2, 100, 100)],
+                     chunk_bytes=1 << 20, gap=1 << 30)
+    assert len(p.spans) == 2
+    assert p.spans_coalesced == 0
+
+
+def test_zero_length_extents_plan_to_no_pieces():
+    p = plan_extents([(1, 0, 100), (1, 50, 0), (1, 100, 0)],
+                     chunk_bytes=1 << 20)
+    assert len(p.spans) == 1
+    assert p.placements[1] == [] and p.placements[2] == []
+    assert p.spans_coalesced == 0      # nothing merged, nothing read
+
+
+def test_overlapping_extents_dedupe_into_one_span():
+    p = plan_extents([(1, 0, 1000), (1, 500, 1000), (1, 0, 1000)],
+                     chunk_bytes=1 << 20, gap=0)
+    assert len(p.spans) == 1
+    assert p.spans[0] == (1, 0, 1500)
+    assert p.placements[0] == [(0, 0, 1000)]
+    assert p.placements[1] == [(0, 500, 1500)]
+    assert p.placements[2] == [(0, 0, 1000)]   # duplicate: one read
+    assert p.spans_coalesced == 2
+
+
+def test_oversized_extent_splits_at_unit_boundaries():
+    # 1000 bytes of 96-byte records through a 256-byte buffer:
+    # pieces are multiples of 96 (2 records = 192) except the tail
+    p = plan_extents([(1, 0, 1000)], chunk_bytes=256, split_unit=96)
+    assert len(p.spans) > 1
+    offs = [off for _, off, _ in p.spans]
+    assert all((o - 0) % 96 == 0 for o in offs)
+    assert sum(ln for _, _, ln in p.spans) == 1000
+    # pieces of the one extent cover it contiguously in order
+    pos = 0
+    for si, lo, hi in p.placements[0]:
+        assert (lo, hi) == (0, p.spans[si][2])
+        pos += hi - lo
+    assert pos == 1000
+
+
+def test_split_unit_larger_than_chunk_raises():
+    with pytest.raises(ValueError):
+        plan_extents([(1, 0, 10)], chunk_bytes=100, split_unit=200)
+
+
+def test_negative_length_raises():
+    with pytest.raises(ValueError):
+        plan_extents([(1, 0, -5)], chunk_bytes=1 << 20)
+
+
+def test_unsorted_input_keeps_input_order_of_placements():
+    exts = [(1, 5000, 100), (1, 0, 100), (1, 110, 100)]
+    p = plan_extents(exts, chunk_bytes=1 << 20, gap=64)
+    # (0,100) and (110,100) merge (gap 10); (5000,100) stays its own
+    assert len(p.spans) == 2
+    assert p.spans_coalesced == 1
+    # placements align with INPUT order
+    for (fh, off, ln), pieces in zip(exts, p.placements):
+        assert sum(hi - lo for _, lo, hi in pieces) == ln
+
+
+def test_coalesce_gap_env(monkeypatch):
+    monkeypatch.setenv("STROM_COALESCE_GAP", "0")
+    assert coalesce_gap() == 0
+    monkeypatch.setenv("STROM_COALESCE_GAP", "bogus")
+    assert coalesce_gap() == 4096
+    monkeypatch.delenv("STROM_COALESCE_GAP")
+    assert coalesce_gap() == 4096
+
+
+def test_split_spans_matches_legacy_rule():
+    flat, counts = split_spans([(0, 10), (100, 0), (200, 25)], 10)
+    assert flat == [(0, 10), (200, 10), (210, 10), (220, 5)]
+    assert counts == [1, 0, 3]
+
+
+# ------------------------------------------------------------- engine-backed
+
+def test_subview_correctness_and_refcounted_release(data_file, engine):
+    path, payload = data_file
+    fh = engine.open(path)
+    extents = [(fh, 0, 600), (fh, 700, 300),     # coalesce across a gap
+               (fh, 4096 * 10, 4096),            # aligned span
+               (fh, 123, 456),                   # unaligned head/tail
+               (fh, 0, 0),                       # zero-length
+               (fh, 512, (1 << 20) + 512)]       # oversized: splits
+    views = plan_and_submit(engine, extents, chunk_bytes=1 << 20)
+    for (f, off, ln), pieces in zip(extents, views):
+        got = b"".join(bytes(wait_exact(p)) for p in pieces)
+        assert got == payload[off:off + ln], (off, ln)
+    # release every view; the shared spans' buffers must all return
+    for pieces in views:
+        for p in pieces:
+            p.release()
+            p.release()   # idempotent
+    info = engine.pool_info()
+    assert info["in_flight"] == 0
+    assert info["free_buffers"] == info["n_buffers"]
+    engine.close(fh)
+
+
+def test_direct_path_stays_zero_copy(tmp_path):
+    """Coalesced-span sub-views on the O_DIRECT path add no host copy:
+    bounce_bytes stays 0 (the north star).  On filesystems without
+    O_DIRECT the engine honestly counts fallback bounces instead —
+    asserted only when the direct fd exists."""
+    payload = np.random.default_rng(3).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    path = tmp_path / "direct.bin"
+    path.write_bytes(payload)
+    stats = StromStats()
+    # disable the residency probe so a page-cache-warm file still takes
+    # the O_DIRECT path (the probe would legitimately choose buffered)
+    os.environ["STROM_NO_RESIDENCY_PROBE"] = "1"
+    try:
+        eng = StromEngine(_cfg(), stats=stats)
+        try:
+            fh = eng.open(path)
+            if not eng.file_is_direct(fh):
+                pytest.skip("filesystem rejects O_DIRECT")
+            extents = [(fh, 100, 1000), (fh, 1200, 800),
+                       (fh, 8192, 4096)]
+            views = plan_and_submit(eng, extents, chunk_bytes=1 << 20)
+            for (f, off, ln), pieces in zip(extents, views):
+                got = b"".join(bytes(wait_exact(p)) for p in pieces)
+                assert got == payload[off:off + ln]
+                for p in pieces:
+                    p.release()
+            eng.close(fh)
+            snap = eng.engine_stats()
+            assert snap["bounce_bytes"] == 0
+            assert snap["bytes_direct"] > 0
+        finally:
+            eng.close_all()
+    finally:
+        del os.environ["STROM_NO_RESIDENCY_PROBE"]
+
+
+def test_submit_readv_batches_counted(data_file, engine):
+    path, payload = data_file
+    fh = engine.open(path)
+    prs = engine.submit_readv([(fh, 0, 100), (fh, 4096, 100),
+                               (fh, 65536, 100)])
+    for (off, ln), p in zip([(0, 100), (4096, 100), (65536, 100)], prs):
+        assert bytes(wait_exact(p)) == payload[off:off + ln]
+        p.release()
+    snap = engine.engine_stats()
+    assert snap["submit_batches"] == 1
+    assert snap["submit_syscalls_saved"] == 2
+    assert snap["requests_submitted"] == 3
+    engine.close(fh)
+
+
+def test_submit_readv_atomic_validation(data_file, engine):
+    path, _ = data_file
+    fh = engine.open(path)
+    before = engine.engine_stats()["requests_submitted"]
+    with pytest.raises(ValueError):
+        engine.submit_readv([(fh, 0, 100),
+                             (fh, 0, engine.config.chunk_bytes + 1)])
+    with pytest.raises(OSError):
+        engine.submit_readv([(fh, 0, 100), (9999, 0, 100)])
+    assert engine.engine_stats()["requests_submitted"] == before
+    engine.close(fh)
+
+
+def test_wait_exact_reports_fh_offset(data_file, engine):
+    path, _ = data_file
+    fh = engine.open(path)
+    size = engine.file_size(fh)
+    p = engine.submit_read(fh, size - 64, 256)   # crosses EOF: short
+    with pytest.raises(OSError) as ei:
+        wait_exact(p)
+    msg = str(ei.value)
+    assert f"fh={fh}" in msg and f"offset={size - 64}" in msg
+    assert "64" in msg and "256" in msg          # got vs expected
+    engine.close(fh)
+
+
+def test_planner_counts_spans_coalesced_in_stats(data_file, engine):
+    path, _ = data_file
+    fh = engine.open(path)
+    views = plan_and_submit(
+        engine, [(fh, 0, 512), (fh, 512, 512), (fh, 1024, 512)],
+        chunk_bytes=1 << 20)
+    for pieces in views:
+        for p in pieces:
+            p.wait()
+            p.release()
+    assert engine.stats.spans_coalesced == 2
+    engine.close(fh)
+
+
+# ------------------------------------------------------------------- perf
+
+@pytest.mark.perf
+def test_perf_smoke_synthetic_extents(tmp_path):
+    """The hardware-free `-m perf` gate: on a synthetic extent set the
+    planner must REDUCE the submit count (coalescing), submit the plan
+    as one vectored batch, and add zero host copies of its own."""
+    payload = np.random.default_rng(11).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    path = tmp_path / "perf.bin"
+    path.write_bytes(payload)
+    # 64 records of 4 KiB with 512 B of dead space between them — the
+    # tar-member shape: every neighbor is within the default gap
+    extents_shape = [(4608 * i, 4096) for i in range(64)]
+    plan = plan_extents([(1, off, ln) for off, ln in extents_shape],
+                        chunk_bytes=128 << 10)
+    assert len(plan.spans) < 64           # fewer, larger NVMe commands
+    assert plan.spans_coalesced > 0
+    assert plan.submits_saved > 0
+
+    stats = StromStats()
+    eng = StromEngine(_cfg(), stats=stats)
+    try:
+        fh = eng.open(path)
+        views = plan_and_submit(eng, [(fh, off, ln)
+                                      for off, ln in extents_shape],
+                                chunk_bytes=128 << 10)
+        bounce_before = stats.bounce_bytes
+        for (off, ln), pieces in zip(extents_shape, views):
+            got = b"".join(bytes(wait_exact(p)) for p in pieces)
+            assert got == payload[off:off + ln]
+            for p in pieces:
+                p.release()
+        # sub-view slicing is zero-copy: the planner itself never
+        # bounces (engine-level fallback copies are the engine's to
+        # count, python-side adds nothing)
+        assert stats.bounce_bytes == bounce_before
+        assert stats.spans_coalesced > 0
+        eng.close(fh)
+        snap = eng.engine_stats()
+        assert snap["submit_batches"] >= 1
+        assert snap["submit_syscalls_saved"] > 0
+        assert snap["requests_submitted"] == len(plan.spans)
+    finally:
+        eng.close_all()

@@ -192,3 +192,22 @@ def test_header_parse_no_residency_pollution(mesh8, engine, tmp_path):
         np.testing.assert_array_equal(np.asarray(params[name]), v)
     engine.sync_stats()
     assert engine.stats.snapshot()["bytes_resident"] == 0
+
+
+def test_lazy_load_zero_size_tensor(mesh8, engine, tmp_path):
+    """Zero-element tensors are legal safetensors payloads; the planner
+    gives their zero-length extents an empty piece list, and the weight
+    streamer must yield the empty view instead of unpacking it.
+    Regression: (4, 0) tensor raised ValueError at load."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    empty = np.zeros((4, 0), dtype=np.float32)
+    write_safetensors(tmp_path / "empty.safetensors",
+                      {"empty": empty,
+                       "real": np.ones((4, 4), np.float32)})
+    lc = LazyCheckpoint(tmp_path / "empty.safetensors")
+    params = lc.load_sharded(
+        {"empty": NamedSharding(mesh8, P()),
+         "real": NamedSharding(mesh8, P())}, engine=engine)
+    assert np.asarray(params["empty"]).shape == (4, 0)
+    np.testing.assert_array_equal(np.asarray(params["real"]),
+                                  np.ones((4, 4), np.float32))
