@@ -203,6 +203,55 @@ def bench_raw(engine, path: str, repeats: int = 3, cold: bool = True) -> float:
     return statistics.median(rates)
 
 
+def bench_verify(engine, path: str) -> dict:
+    """The integrity tax (docs/RESILIENCE.md): one pipelined read pass
+    with STROM_VERIFY=full-equivalent CRC32C over every completed view,
+    against one plain pass — same chunks, same depth, same (cold) cache
+    state.  The delta prices exactly what full verification adds on the
+    read path: one host CRC pass per payload byte at native CRC speed.
+    Returns {"verify_off_gib_s", "verify_full_gib_s",
+    "verify_overhead_pct", "verify_gib"}."""
+    from nvme_strom_tpu.utils.checksum import crc32c
+    fh = engine.open(path)
+    size = engine.file_size(fh)
+    chunk = engine.config.chunk_bytes
+    depth = max(2, engine.config.queue_depth // 2)
+
+    def one_pass(verify: bool) -> float:
+        evict_file(path)
+        t0 = time.monotonic()
+        crc = 0
+        pend = []
+
+        def drain_one():
+            nonlocal crc
+            p = pend.pop(0)
+            view = p.wait()
+            if verify:
+                crc = crc32c(view, crc)
+            p.release()
+
+        for off in range(0, size, chunk):
+            pend.append(engine.submit_read(fh, off,
+                                           min(chunk, size - off)))
+            if len(pend) >= depth:
+                drain_one()
+        while pend:
+            drain_one()
+        return size / (1 << 30) / (time.monotonic() - t0)
+
+    off_rate = statistics.median(one_pass(False) for _ in range(2))
+    full_rate = statistics.median(one_pass(True) for _ in range(2))
+    engine.stats.add(bytes_verified=2 * size)
+    overhead = (100.0 * (off_rate - full_rate) / off_rate
+                if off_rate > 0 else 0.0)
+    engine.close(fh)
+    return {"verify_off_gib_s": off_rate,
+            "verify_full_gib_s": full_rate,
+            "verify_overhead_pct": overhead,
+            "verify_gib": size / (1 << 30)}
+
+
 def _link_bufs(outstanding: int, chunk_bytes: int):
     import numpy as np
     sz = chunk_bytes or (32 << 20)
@@ -439,6 +488,17 @@ def main() -> int:
         engine.sync_stats()
         _log(f"bench: NVMe->HBM warm (page cache) = {warm:.3f} GiB/s")
 
+        # Integrity tax: the same pipelined read with and without a
+        # CRC32C pass over every completed view — what STROM_VERIFY=full
+        # costs on the read path (docs/RESILIENCE.md).  The ledger
+        # tracks it so a regression in the native CRC (or a silent flip
+        # to the Python fallback) shows up as an overhead jump.
+        ver = bench_verify(engine, path)
+        engine.sync_stats()
+        _log(f"bench: verify tax: off={ver['verify_off_gib_s']:.3f} "
+             f"full={ver['verify_full_gib_s']:.3f} GiB/s "
+             f"(overhead {ver['verify_overhead_pct']:.1f}%)")
+
     direct_ok = info.supports_direct
     bounce = cold_bounce
     if direct_ok and bounce and device_ok:
@@ -481,6 +541,15 @@ def main() -> int:
         # levers without rerunning
         "coalesce_ratio": round(coalesce_ratio, 3),
         "submit_syscalls_per_gib": round(syscalls_per_gib, 1),
+        # integrity tax + write-path resilience (docs/RESILIENCE.md):
+        # GiB/s with full CRC verification vs off, and the recovery
+        # counters — normally 0; non-zero means this very bench run
+        # fought real device errors
+        "verify_off_gib_s": round(ver["verify_off_gib_s"], 3),
+        "verify_full_gib_s": round(ver["verify_full_gib_s"], 3),
+        "verify_overhead_pct": round(ver["verify_overhead_pct"], 1),
+        "write_retries": int(stats.write_retries),
+        "checksum_failures": int(stats.checksum_failures),
     }), flush=True)
     try:
         os.unlink(path)

@@ -7,6 +7,11 @@
  *   - reader threads: random-offset reads, each verified against the
  *     deterministic content pattern (catches buffer-recycling races);
  *   - a writer thread appending to a scratch file;
+ *   - burst-writer threads keeping several submit_writes in flight at
+ *     once (the checkpoint/offload pipelined-write pattern), each with
+ *     length verification of the completion;
+ *   - a mixed thread alternating submit_write with submit_readv batches
+ *     on the same ring (write path racing the vectored read path);
  *   - an observer thread polling stats/pool-info/latency (lock-free
  *     counter reads racing the hot path);
  *   - an open/close churn thread (file-table mutation under I/O).
@@ -137,6 +142,102 @@ void writer_thread(strom_engine *eng, const std::string &dir, int iters) {
   strom_close(eng, fh);
 }
 
+/* Pipelined writer: keeps kBurst submit_writes in flight on one fh
+ * (each source buffer owned until its wait returns), racing the readv
+ * batches and scalar readers for ring slots and pool buffers — the
+ * write half of the checkpoint/offload submit pattern, on ONE ring.
+ * Each thread owns a disjoint file so content verification stays a
+ * pure function of (seed, offset). */
+void writer_burst_thread(strom_engine *eng, const std::string &dir,
+                         int iters, int seed) {
+  constexpr int kBurst = 6;
+  std::string path = dir + "/stress_wb" + std::to_string(seed) + ".bin";
+  int fh = strom_open(eng, path.c_str(), STROM_OPEN_WRITABLE);
+  if (fh < 0) { fail("open burst writable"); return; }
+  struct Slot { int64_t id; uint64_t len; std::vector<uint8_t> buf; };
+  std::vector<Slot> inflight;
+  Rng rng(seed * 131071 + 17);
+  auto drain_one = [&]() {
+    Slot s = std::move(inflight.front());
+    inflight.erase(inflight.begin());
+    strom_completion c;
+    if (strom_wait(eng, s.id, &c) != 0 || c.status != 0)
+      fail("burst write status");
+    else if (c.len != s.len)
+      fail("burst short write");
+    strom_release(eng, s.id);
+  };
+  for (int i = 0; i < iters; i++) {
+    uint64_t off = (rng.next() % 128) * 4096;
+    uint64_t len = 1 + rng.next() % (64 * 1024);
+    Slot s;
+    s.len = len;
+    s.buf.resize(len);
+    for (uint64_t k = 0; k < len; k++) s.buf[k] = pat(off + k);
+    s.id = strom_submit_write(eng, fh, off, s.buf.data(), len);
+    if (s.id < 0) { fail("burst submit_write"); continue; }
+    inflight.push_back(std::move(s));
+    while ((int)inflight.size() >= kBurst) drain_one();
+  }
+  while (!inflight.empty()) drain_one();
+  strom_close(eng, fh);
+  unlink(path.c_str());
+}
+
+/* Mixed submitter: alternates a write and a readv batch on the SAME
+ * ring iteration — the exact interleaving a checkpoint save overlapping
+ * a loader epoch produces (submit_write and submit_readv racing for the
+ * SQ and the deferred-dispatch queue). */
+void mixed_rw_thread(strom_engine *eng, int read_fh, const std::string &dir,
+                     int iters, int seed) {
+  std::string path = dir + "/stress_mx" + std::to_string(seed) + ".bin";
+  int wfh = strom_open(eng, path.c_str(), STROM_OPEN_WRITABLE);
+  if (wfh < 0) { fail("open mixed writable"); return; }
+  Rng rng(seed * 524287 + 29);
+  std::vector<uint8_t> wbuf(16 * 1024);
+  for (int i = 0; i < iters; i++) {
+    uint64_t woff = (rng.next() % 32) * wbuf.size();
+    for (size_t k = 0; k < wbuf.size(); k++) wbuf[k] = pat(woff + k);
+    int64_t wid = strom_submit_write(eng, wfh, woff, wbuf.data(),
+                                     wbuf.size());
+    strom_rd_ext exts[4];
+    const uint32_t n = 1 + (uint32_t)(rng.next() % 4);
+    for (uint32_t j = 0; j < n; j++) {
+      uint64_t off = rng.next() % (kFileBytes - 1);
+      uint64_t len = 1 + rng.next() % (kMaxRead / 8);
+      if (off + len > kFileBytes) len = kFileBytes - off;
+      exts[j] = strom_rd_ext{read_fh, 0, off, len};
+    }
+    int64_t ids[4];
+    if (strom_submit_readv(eng, exts, n, ids) != 0) {
+      fail("mixed submit_readv");
+    } else {
+      for (uint32_t j = 0; j < n; j++) {
+        strom_completion c;
+        if (strom_wait(eng, ids[j], &c) != 0 || c.status != 0)
+          fail("mixed readv status");
+        else
+          for (uint64_t k = 0; k < c.len; k += 997)
+            if (c.data[k] != pat(exts[j].offset + k)) {
+              fail("mixed readv payload");
+              break;
+            }
+        strom_release(eng, ids[j]);
+      }
+    }
+    if (wid < 0) {
+      fail("mixed submit_write");
+    } else {
+      strom_completion c;
+      if (strom_wait(eng, wid, &c) != 0 || c.status != 0)
+        fail("mixed write status");
+      strom_release(eng, wid);
+    }
+  }
+  strom_close(eng, wfh);
+  unlink(path.c_str());
+}
+
 void observer_thread(strom_engine *eng, std::atomic<bool> *stop) {
   uint64_t rd[STROM_LAT_BUCKETS], wr[STROM_LAT_BUCKETS];
   while (!stop->load(std::memory_order_acquire)) {
@@ -197,6 +298,9 @@ int main(int argc, char **argv) {
     for (int r = 0; r < 2; r++)
       ts.emplace_back(readv_thread, eng, fh, iters / 2 + 1, r + 1);
     ts.emplace_back(writer_thread, eng, dir, iters / 2 + 1);
+    for (int r = 0; r < 2; r++)
+      ts.emplace_back(writer_burst_thread, eng, dir, iters / 2 + 1, r + 1);
+    ts.emplace_back(mixed_rw_thread, eng, fh, dir, iters / 2 + 1, 1);
     ts.emplace_back(churn_thread, eng, path, iters / 2 + 1);
     std::thread obs(observer_thread, eng, &stop);
     for (auto &t : ts) t.join();

@@ -379,6 +379,12 @@ struct strom_engine {
    * zero (the default) keeps this path entirely off the hot loop. */
   uint64_t fault_eio_every = 0, fault_short_every = 0, fault_delay_ns = 0;
   std::atomic<uint64_t> fault_seq{0};
+  /* Write-path mirror (STROM_FAULT_WRITE_*): the checkpoint/offload
+   * durability story needs the native completion path to fail too —
+   * EIO, ENOSPC, short write, completion delay. */
+  uint64_t wfault_eio_every = 0, wfault_enospc_every = 0,
+      wfault_short_every = 0, wfault_delay_ns = 0;
+  std::atomic<uint64_t> wfault_seq{0};
 
   /* Applied at the read completion boundary (both backends funnel
    * through here right before complete(r)): a delay holds the
@@ -401,6 +407,37 @@ struct strom_engine {
       r->done_len = 0;
       st_fail.fetch_add(1, std::memory_order_relaxed);
     } else if (fault_short_every && n % fault_short_every == 0 &&
+               r->status == 0 && r->done_len > 1) {
+      r->done_len /= 2;
+    }
+  }
+
+  /* Write-completion injection (both backends funnel through here right
+   * before complete(r) on the write branch): delay holds the completion
+   * in flight, then every Nth write fails -EIO / -ENOSPC or reports
+   * half its bytes written — the short-write resubmission case the
+   * Python-level retry path must detect and finish. */
+  void maybe_inject_write_fault(Req *r) {
+    if (!r->is_write ||
+        !(wfault_eio_every | wfault_enospc_every | wfault_short_every |
+          wfault_delay_ns))
+      return;
+    uint64_t n = wfault_seq.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (wfault_delay_ns) {
+      struct timespec ts = {
+          (time_t)(wfault_delay_ns / 1000000000ull),
+          (long)(wfault_delay_ns % 1000000000ull)};
+      nanosleep(&ts, nullptr);
+    }
+    if (wfault_eio_every && n % wfault_eio_every == 0) {
+      r->status = -EIO;
+      r->done_len = 0;
+      st_fail.fetch_add(1, std::memory_order_relaxed);
+    } else if (wfault_enospc_every && n % wfault_enospc_every == 0) {
+      r->status = -ENOSPC;
+      r->done_len = 0;
+      st_fail.fetch_add(1, std::memory_order_relaxed);
+    } else if (wfault_short_every && n % wfault_short_every == 0 &&
                r->status == 0 && r->done_len > 1) {
       r->done_len /= 2;
     }
@@ -611,6 +648,7 @@ struct strom_engine {
             st_retry.fetch_add(1, std::memory_order_relaxed);
             write_sync(r, fe); /* rescue: finish/retry synchronously */
           }
+          maybe_inject_write_fault(r);
           complete(r);
           return;
         }
@@ -674,6 +712,7 @@ struct strom_engine {
       else
         read_sync(r, fe);
       maybe_inject_read_fault(r);
+      maybe_inject_write_fault(r);
       complete(r);
     }
   }
@@ -716,6 +755,10 @@ strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
     e->fault_eio_every = env_u64("STROM_FAULT_READ_EIO_EVERY");
     e->fault_short_every = env_u64("STROM_FAULT_READ_SHORT_EVERY");
     e->fault_delay_ns = env_u64("STROM_FAULT_READ_DELAY_MS") * 1000000ull;
+    e->wfault_eio_every = env_u64("STROM_FAULT_WRITE_EIO_EVERY");
+    e->wfault_enospc_every = env_u64("STROM_FAULT_WRITE_ENOSPC_EVERY");
+    e->wfault_short_every = env_u64("STROM_FAULT_WRITE_SHORT_EVERY");
+    e->wfault_delay_ns = env_u64("STROM_FAULT_WRITE_DELAY_MS") * 1000000ull;
   }
   for (int i = (int)n_buffers - 1; i >= 0; i--) e->free_bufs.push_back(i);
 

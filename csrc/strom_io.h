@@ -98,6 +98,10 @@ void strom_get_latency(strom_engine *eng,
  *   STROM_FAULT_READ_EIO_EVERY=N    every Nth read completes -EIO
  *   STROM_FAULT_READ_SHORT_EVERY=N  every Nth read reports half its bytes
  *   STROM_FAULT_READ_DELAY_MS=D     every read completion held D ms
+ *   STROM_FAULT_WRITE_EIO_EVERY=N   every Nth write completes -EIO
+ *   STROM_FAULT_WRITE_ENOSPC_EVERY=N  every Nth write completes -ENOSPC
+ *   STROM_FAULT_WRITE_SHORT_EVERY=N every Nth write reports half its bytes
+ *   STROM_FAULT_WRITE_DELAY_MS=D    every write completion held D ms
  * The Python-level plan (nvme_strom_tpu/io/faults.py) is richer and
  * deterministic; these knobs exist to exercise the native completion
  * path itself. */

@@ -38,14 +38,47 @@ import numpy as np
 from nvme_strom_tpu.formats.safetensors import (
     SafetensorsFile,
     _np_dtype,
+    tensor_checksums,
     write_safetensors_engine,
 )
 from nvme_strom_tpu.io.engine import StromEngine, wait_exact
+from nvme_strom_tpu.io.faults import crash_point
 from nvme_strom_tpu.io.plan import plan_and_submit
+from nvme_strom_tpu.utils.checksum import VerifyPolicy
 from nvme_strom_tpu.utils.config import EngineConfig
 
 _STEP_RE = re.compile(r"^step_(\d{8})$")
+_TMP_RE = re.compile(r"^\.tmp_step_(\d{8})$")
 _log = logging.getLogger(__name__)
+
+
+def _gc_min_age() -> float:
+    """The live-save age gate (``STROM_CKPT_GC_AGE_S``, default 3600s)
+    shared by the startup GC and ``strom-scrub --gc`` — one parse so
+    the two sweepers can never disagree about what counts as debris."""
+    try:
+        return float(os.environ.get("STROM_CKPT_GC_AGE_S", 3600))
+    except ValueError:
+        return 3600.0
+
+
+def _newest_mtime(path: str) -> float:
+    """Newest mtime across a staging dir and its immediate entries.
+    The dir mtime alone moves only on entry creation/rename — a save
+    that has been engine-writing into one large tile file for a while
+    bumps the FILE's mtime on every write, not the dir's, and must not
+    look cold to the GC age gate."""
+    newest = os.path.getmtime(path)
+    try:
+        with os.scandir(path) as it:
+            for ent in it:
+                try:
+                    newest = max(newest, ent.stat().st_mtime)
+                except OSError:
+                    continue
+    except OSError:
+        pass
+    return newest
 
 
 class TargetMismatchError(ValueError):
@@ -153,6 +186,59 @@ class CheckpointManager:
         #: from the requested step when restore-fallback engaged
         self.last_restore_step: Optional[int] = None
         os.makedirs(self.directory, exist_ok=True)
+        #: dotted temp dirs from crashed saves removed at startup
+        self.tmp_gc: list[str] = []
+        if os.environ.get("STROM_CKPT_GC", "1") != "0":
+            self._gc_tmp_dirs()
+
+    def _gc_tmp_dirs(self) -> None:
+        """Startup GC: remove orphaned ``.tmp_step_*`` staging dirs left
+        by crashed saves (docs/RESILIENCE.md).  A crash anywhere before
+        the atomic rename leaves the dotted dir behind — invisible to
+        ``all_steps`` (restore already falls back past it) but
+        accumulating payload-sized garbage on the NVMe namespace.  This
+        process has no save in flight yet, and multi-host runs construct
+        their managers at the same startup point — but a DIFFERENT
+        process (an eval job restoring from a live training dir) may be
+        mid-save, so only dirs whose newest mtime (the dir or any file
+        inside it — a long engine write bumps the tile file, not the
+        dir) is older than ``STROM_CKPT_GC_AGE_S`` (default 3600) are
+        debris: a live staging dir keeps moving, a crashed one froze
+        at the crash.  ``STROM_CKPT_GC=0``
+        opts out entirely for post-mortem inspection of a torn save;
+        ``strom-scrub --gc`` honors the same age gate (``--force``
+        overrides it)."""
+        min_age = _gc_min_age()
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        now = time.time()
+        for name in names:
+            if not _TMP_RE.match(name):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                if (not os.path.isdir(path)
+                        or now - _newest_mtime(path) < min_age):
+                    continue
+            except OSError:
+                continue    # racing rename/removal: not ours to touch
+            shutil.rmtree(path, ignore_errors=True)
+            if os.path.exists(path):
+                # rmtree swallowed an error (foreign-uid file,
+                # immutable flag): the debris is still there — say so
+                # instead of recording a removal that didn't happen
+                _log.warning(
+                    "could not remove orphaned checkpoint staging dir "
+                    "%s (permission?); remove it manually or with "
+                    "strom-scrub --gc", path)
+                continue
+            self.tmp_gc.append(path)
+            _log.warning(
+                "removed orphaned checkpoint staging dir %s "
+                "(crashed save; the previous intact step is unaffected)",
+                path)
 
     # -- introspection -----------------------------------------------------
 
@@ -322,11 +408,14 @@ class CheckpointManager:
         finally:
             if own:
                 eng.close_all()
+        crash_point("ckpt.tiles")   # torn-save window: data, no commit
         t1 = time.monotonic()
 
         if proc == 0:
             self._write_meta(tmp, step, index)
+        crash_point("ckpt.meta")    # manifest staged, rename pending
         self._sync()  # all payloads durable before the rename
+        crash_point("ckpt.rename")  # the instant before the commit
         if proc == 0:
             self._publish(tmp, final)
         self._sync()
@@ -426,12 +515,14 @@ class CheckpointManager:
         finally:
             if own:
                 eng.close_all()
+        crash_point("ckpt.tiles")   # data durable, marker not yet cut
         marker = os.path.join(tmp, f"done-{proc:05d}.json")
         with open(marker, "w") as f:
             json.dump({"step": step, "process": proc,
                        "nbytes": os.path.getsize(fname)}, f)
             f.flush()
             os.fsync(f.fileno())
+        crash_point("ckpt.marker")  # marker cut, commit still pending
 
     def _finalize(self, step: int, tmp: str, final: str,
                   index: Dict[str, dict]) -> str:
@@ -457,8 +548,10 @@ class CheckpointManager:
                     f"wrote their done markers (STROM_CKPT_WAIT_S)")
             time.sleep(0.1)
         self._write_meta(tmp, step, index)
+        crash_point("ckpt.meta")    # manifest staged, rename pending
         for m in markers:
             os.unlink(m)
+        crash_point("ckpt.rename")  # the instant before the commit
         self._publish(tmp, final)
         self._prune()
         return final
@@ -553,6 +646,13 @@ class CheckpointManager:
         # (duplicate flattened names) is a code bug and must raise here,
         # not be retried against every checkpoint as "damage"
         named_t, treedef = flatten_with_names(target)
+
+        # read-side integrity gate (STROM_VERIFY, utils/checksum.py):
+        # one policy per restore call so the mode cannot flip between
+        # candidate steps.  A checksum mismatch is _DAMAGE (ChecksumError
+        # is an OSError): retried once at the tile read, then this very
+        # fallback loop steps to the previous intact checkpoint.
+        self._verify = VerifyPolicy()
 
         eng, own = self._get_engine()
         try:
@@ -682,36 +782,84 @@ class CheckpointManager:
         tiles = [(tuple(tuple(b) for b in t["idx"]), t["file"])
                  for t in info["tiles"]]
         tile_cache: Dict = {}
+        policy = getattr(self, "_verify", None)
+        if policy is None:
+            policy = VerifyPolicy("off")
+        crc_cache: Dict[str, Dict[str, int]] = {}   # fname → stamps
+
+        def get_sf(fname):
+            sf = files.get(fname)
+            if sf is None:
+                sf = SafetensorsFile(os.path.join(cdir, fname))
+                files[fname] = sf
+            return sf
+
+        def verify_tile(sf, fname, tkey, t, flat) -> np.ndarray:
+            """Whole-tile CRC32C check against the write-time stamp,
+            via the shared retry-once protocol (utils/checksum.py): a
+            mismatch re-reads the tile ONCE (transient in-flight
+            corruption heals, counted), and a second mismatch raises
+            ChecksumError — an OSError, i.e. _DAMAGE, so restore steps
+            back to the previous intact checkpoint."""
+            stamps = crc_cache.get(fname)
+            if stamps is None:
+                stamps = crc_cache[fname] = tensor_checksums(sf)
+            expected = stamps.get(tkey)
+            if expected is None or not policy.want():
+                return flat         # unstamped / not sampled this time
+            return policy.check_with_reread(
+                flat, expected,
+                lambda: self._engine_read(eng, sf.path, t["offset"],
+                                          t["nbytes"]),
+                eng.stats, where=f"tile {tkey} of {sf.path}")
 
         def read_tile_rows(bounds, fname, a, b):
             """Rows [a, b) (tile-local, leading axis) of a stored tile —
             a contiguous byte range, so a cross-mesh restore that needs a
             sliver of a tile reads only those rows from NVMe, not the
-            whole tile (parity with the old row-span sub-range reads)."""
+            whole tile (parity with the old row-span sub-range reads).
+            Under ``STROM_VERIFY`` a whole-tile read is checked against
+            its write-time stamp; ``full`` mode widens partial-row
+            requests to the whole tile (cached — each tile reads and
+            verifies once) so every consumed byte is covered."""
             tshape = tuple(hi - lo for lo, hi in bounds)
+            rows_total = tshape[0] if tshape else 1
             key = (bounds, a, b)
             got = tile_cache.get(key)
             if got is not None:
                 return got
-            whole = tile_cache.get((bounds, 0, tshape[0] if tshape else 1))
+            whole = tile_cache.get((bounds, 0, rows_total))
             if whole is not None:
                 return whole[a:b] if tshape else whole
-            sf = files.get(fname)
-            if sf is None:
-                sf = SafetensorsFile(os.path.join(cdir, fname))
-                files[fname] = sf
-            t = sf.tensors[_tile_key(name, bounds, shape)]
+            sf = get_sf(fname)
+            tkey = _tile_key(name, bounds, shape)
+            t = sf.tensors[tkey]
+            if (policy.mode == "full" and tshape
+                    and (a, b) != (0, rows_total)):
+                # widen a partial-row request to the whole tile ONLY
+                # when a stamp exists to check it against — an
+                # unstamped (pre-integrity) tile keeps the sliver read
+                stamps = crc_cache.get(fname)
+                if stamps is None:
+                    stamps = crc_cache[fname] = tensor_checksums(sf)
+                if stamps.get(tkey) is not None:
+                    whole = read_tile_rows(bounds, fname, 0, rows_total)
+                    return whole[a:b]
             if not tshape:  # scalar tile
                 flat = self._engine_read(eng, sf.path, t["offset"],
-                                         t["nbytes"]).view(np_dt)
-                got = flat.reshape(())
+                                         t["nbytes"])
+                if policy.enabled:
+                    flat = verify_tile(sf, fname, tkey, t, flat)
+                got = flat.view(np_dt).reshape(())
             else:
                 row_bytes = (np_dt.itemsize *
                              int(np.prod(tshape[1:], dtype=np.int64)))
                 flat = self._engine_read(eng, sf.path,
                                          t["offset"] + a * row_bytes,
-                                         (b - a) * row_bytes).view(np_dt)
-                got = flat.reshape((b - a,) + tshape[1:])
+                                         (b - a) * row_bytes)
+                if policy.enabled and (a, b) == (0, rows_total):
+                    flat = verify_tile(sf, fname, tkey, t, flat)
+                got = flat.view(np_dt).reshape((b - a,) + tshape[1:])
             tile_cache[key] = got
             return got
 

@@ -243,6 +243,24 @@ class ShardedLoader:
         from collections import OrderedDict
         self._shard_index: "OrderedDict[str, list]" = OrderedDict()
         self._shard_index_total = 0    # cached samples, LRU accounting
+        # read-side integrity (STROM_VERIFY, utils/checksum.py): sample
+        # payloads verify against each shard's offset-keyed .crc.json
+        # sidecar when one exists.  A mismatch is treated like a failed
+        # read — re-read once, then the shard takes the normal
+        # quarantine-or-raise path.  Applies to the per-sample formats
+        # (wds, tfrecord); the zero-copy paths (fixedrec, wds_raw) never
+        # touch payload bytes on the host, so their integrity lives in
+        # the offline scrubber (tools/strom_scrub.py).
+        from nvme_strom_tpu.utils.checksum import VerifyPolicy
+        self._verify = VerifyPolicy()
+        self._sidecars: dict = {}      # shard path → Sidecar | None
+
+    def _sidecar(self, path):
+        key = str(path)
+        if key not in self._sidecars:
+            from nvme_strom_tpu.utils.checksum import load_sidecar
+            self._sidecars[key] = load_sidecar(key)
+        return self._sidecars[key]
 
     @staticmethod
     def _batch_groups(mesh, axis: str, pi: int) -> tuple[int, int]:
@@ -355,8 +373,28 @@ class ShardedLoader:
                 len(samples), self.config.seed + 1, self.epoch)
         fh = eng.open(path)
         pend: list = []
+        policy = self._verify
+        sidecar = self._sidecar(path) if policy.enabled else None
         try:
             depth = max(2, eng.config.queue_depth // 2)
+
+            def verify_part(ext, off, ln, payload: bytes) -> bytes:
+                """CRC32C the part against the shard sidecar (when the
+                span is stamped and the policy samples it), via the
+                shared retry-once protocol (utils/checksum.py): a
+                mismatch re-reads once — transient in-flight corruption
+                heals, counted — and a persistent one raises
+                ChecksumError, which the caller's quarantine-or-raise
+                policy treats exactly like any other shard failure."""
+                expected = sidecar.lookup(off, ln)
+                if expected is None or not policy.want():
+                    return payload
+                return policy.check_with_reread(
+                    payload, expected,
+                    lambda: eng.read(fh, off, ln).tobytes(),
+                    eng.stats,
+                    where=f"sample part {ext!r} at [{off}:+{ln}] "
+                          f"of {path}")
 
             def finish(entry):
                 idx_parts, reads = entry
@@ -372,6 +410,10 @@ class ShardedLoader:
                             for p in pieces)
                         for p in pieces:
                             p.release()
+                        if sidecar is not None:
+                            off, ln = idx_parts[ext]
+                            parts[ext] = verify_part(ext, off, ln,
+                                                     parts[ext])
                 finally:
                     # a mid-sample failure must hand the sample's OTHER
                     # reads back too — the entry already left pend, so
@@ -393,7 +435,7 @@ class ShardedLoader:
                     eng, [(fh, off, ln) for _, (off, ln) in items])
                 reads = {ext: pieces
                          for (ext, _), pieces in zip(items, planned)}
-                pend.append((si, reads))
+                pend.append((samples[si], reads))
                 if len(pend) >= depth:
                     yield finish(pend.pop(0))
             while pend:

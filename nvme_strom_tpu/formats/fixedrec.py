@@ -33,12 +33,18 @@ _TAIL = struct.Struct("<Q4s")   # meta length + magic
 
 def write_fixedrec(path: Union[str, os.PathLike],
                    records: Union[np.ndarray, Iterable[bytes]],
-                   dtype=None, shape=None) -> int:
+                   dtype=None, shape=None,
+                   checksums: bool = False) -> int:
     """Write records to ``path``; returns the record count.
 
     ``records`` is either an (n, *shape) array (dtype/shape recorded so
     batches decode as arrays with no further parsing) or an iterable of
     equal-length bytes objects (recorded as uint8 vectors).
+
+    ``checksums=True`` also stamps a per-record CRC32C sidecar
+    (``<path>.crc.json``) — the zero-copy read path never touches
+    payload bytes on the host, so fixedrec integrity is verified
+    offline by ``strom-scrub`` against exactly this sidecar.
     """
     if isinstance(records, np.ndarray):
         if records.ndim < 1:
@@ -79,7 +85,35 @@ def write_fixedrec(path: Union[str, os.PathLike],
         f.write(_TAIL.pack(len(meta), MAGIC))
         f.flush()
         os.fsync(f.fileno())
+    # a previous writer's sidecar must never pair with the NEW bytes
+    # (stale stamps would "verify" them against the OLD contents and
+    # quarantine a healthy shard), including the crash window between
+    # the rename below and a checksums=True restamp — drop it BEFORE
+    # publishing; unstamped merely skips verification
+    from nvme_strom_tpu.utils.checksum import sidecar_path
+    try:
+        os.unlink(sidecar_path(path))
+    except OSError:
+        pass
     os.replace(tmp, path)
+    if checksums:
+        # stamp from the in-memory payload — re-reading a multi-GB
+        # shard just written would double its I/O (utils.checksum's
+        # stamp_fixedrec exists for after-the-fact stamping of shards
+        # written elsewhere)
+        from nvme_strom_tpu.utils.checksum import write_sidecar
+        flat = payload[0] if len(payload) == 1 else None
+
+        def spans():
+            if flat is not None:        # one contiguous array
+                for i in range(count):
+                    yield (i * rec_bytes, rec_bytes,
+                           flat[i * rec_bytes:(i + 1) * rec_bytes])
+            else:
+                for i, p in enumerate(payload):
+                    yield i * rec_bytes, rec_bytes, p
+
+        write_sidecar(path, spans())
     return count
 
 

@@ -63,6 +63,12 @@ class SafetensorsFile:
             os.close(fd)
         self.data_start = 8 + hlen
         self.metadata = header.pop("__metadata__", {})
+        # integrity stamps ride __metadata__ on disk (spec-legal) but
+        # are plumbing, not user metadata: split them out so consumers
+        # of .metadata see exactly what the writer was asked to record
+        self._integrity = {
+            k: self.metadata.pop(k) for k in list(self.metadata)
+            if k.startswith(_CRC_PREFIX) or k == _CRC_ALGO_KEY}
         self.tensors: Dict[str, dict] = {}
         for name, info in header.items():
             begin, end = info["data_offsets"]
@@ -112,6 +118,44 @@ class SafetensorsFile:
         )
 
 
+#: __metadata__ key prefix for per-tensor CRC32C stamps (str values —
+#: the spec keeps metadata flat string→string); the algo tag rides
+#: alongside under _CRC_ALGO_KEY so readers never compare values from
+#: different polynomials
+_CRC_PREFIX = "crc32c."
+_CRC_ALGO_KEY = "checksum_algo"
+
+
+def _checksum_metadata(tensors: Dict[str, np.ndarray]) -> dict:
+    """Per-tensor CRC32C stamps for ``__metadata__`` — write-time
+    integrity (docs/RESILIENCE.md): one pass over the payload bytes at
+    native CRC speed, so a reader (restore, weight streaming,
+    strom_scrub) can prove the bytes it got are the bytes written."""
+    from nvme_strom_tpu.utils.checksum import CRC_ALGO, crc32c
+    meta = {_CRC_ALGO_KEY: CRC_ALGO}
+    for name, arr in tensors.items():
+        meta[_CRC_PREFIX + name] = str(crc32c(np.asarray(arr)))
+    return meta
+
+
+def tensor_checksums(sf: "SafetensorsFile") -> Dict[str, int]:
+    """Stamped per-tensor checksums of a parsed file ({} when the file
+    predates stamping or used a different algo — verification of an
+    unstamped tensor is silently skipped, never an error)."""
+    from nvme_strom_tpu.utils.checksum import CRC_ALGO
+    md = getattr(sf, "_integrity", {})
+    if md.get(_CRC_ALGO_KEY) != CRC_ALGO:
+        return {}
+    out = {}
+    for k, v in md.items():
+        if k.startswith(_CRC_PREFIX):
+            try:
+                out[k[len(_CRC_PREFIX):]] = int(v)
+            except ValueError:
+                continue
+    return out
+
+
 def build_header(tensors: Dict[str, np.ndarray],
                  metadata: Optional[dict] = None,
                  align: int = 8) -> tuple[bytes, Dict]:
@@ -149,8 +193,12 @@ def build_header(tensors: Dict[str, np.ndarray],
 
 def write_safetensors(path, tensors: Dict[str, np.ndarray],
                       metadata: Optional[dict] = None) -> None:
-    """Minimal safetensors writer (row-major, offsets in insertion order)."""
-    head, _ = build_header(tensors, metadata)
+    """Minimal safetensors writer (row-major, offsets in insertion order).
+    Stamps per-tensor CRC32C in ``__metadata__`` (spec-legal; readers
+    that ignore metadata are unaffected)."""
+    md = dict(metadata or {})
+    md.update(_checksum_metadata(tensors))
+    head, _ = build_header(tensors, md)
     with open(path, "wb") as f:
         f.write(head)
         for arr in tensors.values():
@@ -182,9 +230,15 @@ def write_safetensors_engine(path, tensors: Dict[str, np.ndarray], engine,
     staging memcpy, which counted the same) that DMA straight to the
     device: no kernel page-cache copy, no writeback debt, bytes durable
     at completion.  Only the final partial chunk takes the buffered
-    path.  The file stays 100% standard safetensors."""
+    path.  The file stays 100% standard safetensors.
+
+    Every tensor is CRC32C-stamped in ``__metadata__`` at write time
+    (one extra host pass at native CRC speed — the write half of the
+    end-to-end integrity story; the read half is ``STROM_VERIFY``)."""
     align = engine.config.alignment
-    head, _ = build_header(tensors, metadata, align=align)
+    md = dict(metadata or {})
+    md.update(_checksum_metadata(tensors))
+    head, _ = build_header(tensors, md, align=align)
     open(path, "wb").close()  # truncate any previous file
     fh = engine.open(path, writable=True)
     # Direct streaming is safe only when alignment is a whole number of

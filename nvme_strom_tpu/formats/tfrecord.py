@@ -47,23 +47,13 @@ def _crc32c_py(data: bytes, crc: int = 0) -> int:
     return ~c & 0xFFFFFFFF
 
 
-def _crc32c_native():
-    try:
-        import ctypes
-        from nvme_strom_tpu.io.engine import _load_lib
-        lib = _load_lib()
-        lib.strom_crc32c.restype = ctypes.c_uint32
-        lib.strom_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
-                                     ctypes.c_uint32]
-
-        def crc(data: bytes, crc0: int = 0) -> int:
-            return int(lib.strom_crc32c(bytes(data), len(data), crc0))
-        return crc
-    except Exception:
-        return None
-
-
-crc32c = _crc32c_native() or _crc32c_py
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C via the stack's single binding owner (utils/checksum —
+    binding the same CDLL symbol here too would race it for the cached
+    function object's ``argtypes``); falls back to the pure-Python
+    table when the native library is unavailable."""
+    from nvme_strom_tpu.utils.checksum import crc32c as _impl
+    return _impl(data, crc)
 
 
 def masked_crc(data: bytes) -> int:

@@ -109,9 +109,27 @@ class WdsShardIndex:
 
 
 def write_wds_shard(path, samples: List[Dict[str, bytes]],
-                    keys: Optional[List[str]] = None) -> None:
-    """Write samples (each a {ext: payload} dict) as an uncompressed tar."""
+                    keys: Optional[List[str]] = None,
+                    checksums: bool = False) -> None:
+    """Write samples (each a {ext: payload} dict) as an uncompressed tar.
+
+    ``checksums=True`` also stamps an offset-keyed CRC32C sidecar
+    (``<path>.crc.json``, utils/checksum.py) so readers under
+    ``STROM_VERIFY`` — and the offline scrubber — can prove every
+    member payload; existing shards stamp after the fact via
+    ``utils.checksum.stamp_wds`` / ``strom-scrub --stamp``."""
     import io
+    # a previous writer's sidecar must never pair with the NEW bytes
+    # (stale stamps would "verify" them against the OLD contents and
+    # quarantine a healthy shard), including the crash window between
+    # the data write below and a checksums=True restamp — drop it
+    # BEFORE any new byte lands; unstamped merely skips verification
+    from nvme_strom_tpu.utils.checksum import sidecar_path
+    try:
+        os.unlink(sidecar_path(path))
+    except OSError:
+        pass
+    spans = []      # (payload offset, length, payload) per tar member
     with tarfile.open(path, "w", format=tarfile.USTAR_FORMAT) as tf:
         for i, sample in enumerate(samples):
             key = keys[i] if keys else f"{i:08d}"
@@ -119,3 +137,17 @@ def write_wds_shard(path, samples: List[Dict[str, bytes]],
                 info = tarfile.TarInfo(name=f"{key}.{ext}")
                 info.size = len(payload)
                 tf.addfile(info, io.BytesIO(payload))
+                if checksums:
+                    # addfile leaves tf.offset at the end of the
+                    # 512-padded payload (it deep-copies the TarInfo,
+                    # so info.offset_data is NOT updated) — recover the
+                    # payload start from there and stamp from the bytes
+                    # in hand instead of re-reading the whole shard
+                    # back (utils.checksum's stamp_wds exists for
+                    # after-the-fact stamping)
+                    padded = -(-len(payload) // 512) * 512
+                    spans.append((tf.offset - padded, len(payload),
+                                  payload))
+    if checksums:
+        from nvme_strom_tpu.utils.checksum import write_sidecar
+        write_sidecar(path, spans)

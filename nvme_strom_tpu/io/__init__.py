@@ -16,6 +16,7 @@ from nvme_strom_tpu.io.faults import (
     FaultSpec,
     FaultyEngine,
     build_engine,
+    crash_point,
 )
 from nvme_strom_tpu.io.plan import (
     ExtentPlan,
@@ -29,12 +30,16 @@ from nvme_strom_tpu.io.resilient import (
     ReadError,
     ResilientEngine,
     ResilientRead,
+    ResilientWrite,
+    WriteError,
 )
 
 __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
            "DeviceInfo", "Extent", "check_file", "resolve_device",
            "file_extents", "file_eligible", "wait_exact",
            "FaultPlan", "FaultSpec", "FaultyEngine", "build_engine",
+           "crash_point",
            "ExtentPlan", "SpanView", "plan_and_submit", "plan_extents",
            "split_spans", "submit_spans",
-           "ReadError", "ResilientEngine", "ResilientRead"]
+           "ReadError", "ResilientEngine", "ResilientRead",
+           "ResilientWrite", "WriteError"]

@@ -513,10 +513,17 @@ def _wait_for_completion(engine: "StromEngine", req_id: int,
 
 class PendingWrite:
     def __init__(self, engine: "StromEngine", req_id: int,
-                 keepalive: Optional[np.ndarray]):
+                 keepalive: Optional[np.ndarray],
+                 fh: int = -1, offset: int = -1):
         self._engine = engine
         self._req_id = req_id
         self._keepalive = keepalive  # zero-copy source must outlive the I/O
+        #: submit-time identity + size, carried so short-write/error
+        #: reports (and the resilient write-retry mirror) can name the
+        #: exact range without re-deriving it
+        self.fh = fh
+        self.offset = offset
+        self.length = keepalive.nbytes if keepalive is not None else 0
         self._released = False
 
     def release(self) -> None:
@@ -753,7 +760,7 @@ class StromEngine:
             raise OSError(-rid, os.strerror(-rid))
         if self._stripe:
             self._attr_stripe(fh, offset, arr.nbytes)
-        return PendingWrite(self, rid, arr)
+        return PendingWrite(self, rid, arr, fh=fh, offset=offset)
 
     # -- stats / lifecycle -------------------------------------------------
 

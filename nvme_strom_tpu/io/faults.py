@@ -11,8 +11,8 @@ restore-fallback) are testable without flaky hardware:
     engine = FaultyEngine(StromEngine(), plan)
 
 ``FaultyEngine`` wraps any engine-shaped object and injects faults into
-the ``PendingRead``s it hands out — no C rebuild required.  The fault
-taxonomy (one class per link of the chain):
+the ``PendingRead``s/``PendingWrite``s it hands out — no C rebuild
+required.  The fault taxonomy (one class per link of the chain):
 
     eio      the device/kernel failed the read        → OSError(EIO)
     short    the read returned fewer bytes than asked → truncated view
@@ -20,12 +20,28 @@ taxonomy (one class per link of the chain):
     stuck    a wedged request                         → waits time out
     bitflip  payload corrupted in flight              → one byte flipped
 
+and the write-path mirror (the durability story's failure modes —
+checkpoint saves, optimizer spill, KV eviction):
+
+    weio     the device/kernel failed the write       → OSError(EIO)
+    wenospc  the namespace filled up                  → OSError(ENOSPC)
+    wshort   fewer bytes committed than submitted     → short wait() count
+    wdelay   a write-completion straggler             → wait blocks longer
+
+Crash-at-point injection (torn-save recovery) is process-level, not
+request-level: ``crash_point(name)`` calls mark the checkpoint commit
+sequence's crash windows (tile write → marker → manifest → rename), and
+``STROM_CRASH_POINT=<name>`` kills the process (os._exit) at exactly
+that point — the subprocess half of the crash-recovery tests.
+
 Plans are deterministic: decisions come from ``random.Random(seed)`` in
 submit order, so a failing CI run replays exactly.  For injection BELOW
 Python (exercising the C completion path itself), the engine honors
 ``STROM_FAULT_READ_EIO_EVERY`` / ``STROM_FAULT_READ_SHORT_EVERY`` /
-``STROM_FAULT_READ_DELAY_MS`` at ``strom_engine_create`` time (see
-csrc/strom_io.cc).
+``STROM_FAULT_READ_DELAY_MS`` — and the write mirror
+``STROM_FAULT_WRITE_EIO_EVERY`` / ``STROM_FAULT_WRITE_ENOSPC_EVERY`` /
+``STROM_FAULT_WRITE_SHORT_EVERY`` / ``STROM_FAULT_WRITE_DELAY_MS`` — at
+``strom_engine_create`` time (see csrc/strom_io.cc).
 
 Every injected fault is counted (``StromStats.faults_injected``), tagged
 per kind on the plan, and traced (``strom.fault.<kind>`` spans in
@@ -44,7 +60,21 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-FAULT_KINDS = ("eio", "short", "delay", "stuck", "bitflip")
+READ_FAULT_KINDS = ("eio", "short", "delay", "stuck", "bitflip")
+WRITE_FAULT_KINDS = ("weio", "wenospc", "wshort", "wdelay")
+FAULT_KINDS = READ_FAULT_KINDS + WRITE_FAULT_KINDS
+
+
+def crash_point(name: str) -> None:
+    """Deterministic crash injection: when ``$STROM_CRASH_POINT`` equals
+    ``name``, the process dies HERE (``os._exit`` — no atexit, no
+    flushes, exactly what a power loss or OOM-kill leaves behind).
+    Instrumented at the checkpoint commit sequence's crash windows
+    (checkpoint/manager.py): ``ckpt.tiles``, ``ckpt.marker``,
+    ``ckpt.meta``, ``ckpt.rename``.  Zero cost when the env is unset."""
+    want = os.environ.get("STROM_CRASH_POINT")
+    if want and want == name:
+        os._exit(137)
 
 
 @dataclass(frozen=True)
@@ -87,6 +117,14 @@ class FaultSpec:
                 self, "delay_s", 300.0 if self.kind == "stuck" else 0.05)
         if not 0 <= self.frac < 1:
             raise ValueError(f"frac ({self.frac}) must be in [0, 1)")
+        if self.kind == "wenospc" and self.err == errno.EIO:
+            # the kind IS the errno: 'wenospc' without an explicit err=
+            # models the namespace filling up
+            object.__setattr__(self, "err", errno.ENOSPC)
+
+    @property
+    def is_write(self) -> bool:
+        return self.kind in WRITE_FAULT_KINDS
 
 
 _SPEC_FLOAT = {"p", "delay_s", "frac"}
@@ -94,7 +132,9 @@ _SPEC_INT = {"every", "max_count", "err"}
 
 
 class FaultPlan:
-    """A seeded, ordered list of FaultSpecs; decides per submitted read.
+    """A seeded, ordered list of FaultSpecs; decides per submitted
+    request (reads and writes draw from separate taxonomy halves of
+    the same plan — see ``decide``'s ``op``).
 
     The first spec whose trigger matches wins, so ordering encodes
     priority.  ``injected`` tallies injections per kind — tests assert
@@ -151,10 +191,17 @@ class FaultPlan:
         return cls.parse(text, seed=int(os.environ.get(
             "STROM_FAULTS_SEED", "0")))
 
-    def decide(self, path: str = "") -> Optional[FaultSpec]:
-        """Fault for the next submitted read (None = read runs clean)."""
+    def decide(self, path: str = "", op: str = "read"
+               ) -> Optional[FaultSpec]:
+        """Fault for the next submitted request (None = runs clean).
+        ``op`` selects the taxonomy half: read specs never fire on
+        writes and vice versa, so one plan can chaos both directions
+        of the chain with independent triggers."""
         self._reads += 1
+        want_write = op == "write"
         for i, spec in enumerate(self.specs):
+            if spec.is_write != want_write:
+                continue
             if spec.path_substr and spec.path_substr not in path:
                 continue
             if spec.max_count and self._fired.get(i, 0) >= spec.max_count:
@@ -279,6 +326,62 @@ class FaultyRead:
         self.release()
 
 
+class FaultyWrite:
+    """A PendingWrite with a write fault grafted onto its wait path.
+
+    Honors the write contract exactly: ``wait()`` returns the byte
+    count actually committed (a ``wshort`` fault shrinks it — the
+    signal the resilient write mirror resubmits on); error kinds
+    release the request before raising (PendingWrite.wait parity);
+    ``release`` is idempotent.
+    """
+
+    def __init__(self, inner, spec: FaultSpec):
+        self._inner = inner
+        self._spec = spec
+        self._t0 = time.monotonic()
+        self._released = False
+
+    @property
+    def fh(self) -> int:
+        return getattr(self._inner, "fh", -1)
+
+    @property
+    def offset(self) -> int:
+        return getattr(self._inner, "offset", -1)
+
+    @property
+    def length(self) -> int:
+        return getattr(self._inner, "length", 0)
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        if self._spec.kind == "wdelay":
+            remain = self._spec.delay_s - (time.monotonic() - self._t0)
+            if remain > 0:
+                if timeout is not None and timeout < remain:
+                    time.sleep(timeout)
+                    raise TimeoutError(
+                        f"write still in flight after {timeout}s "
+                        f"(injected wdelay)")
+                time.sleep(remain)
+                if timeout is not None:
+                    timeout = max(0.0, timeout - remain)
+        n = self._inner.wait(timeout)
+        self._released = True
+        if self._spec.kind in ("weio", "wenospc"):
+            raise OSError(self._spec.err,
+                          os.strerror(self._spec.err) + " (injected)")
+        if self._spec.kind == "wshort" and n > 1:
+            return int(n * self._spec.frac)
+        return n
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self._inner.release()
+
+
 def build_engine(config=None, stats=None, tracer=None):
     """Default engine factory for consumers (loader, checkpoint, weight
     streaming): a plain StromEngine, wrapped per the resilience env
@@ -312,10 +415,12 @@ class FaultyEngine:
     """Engine wrapper injecting a FaultPlan at the submit boundary.
 
     Transparent to consumers (ShardedLoader, CheckpointManager,
-    ResilientEngine): everything but ``open``/``close``/``submit_read``
+    ResilientEngine): everything but ``open``/``close`` and the three
+    submit paths (``submit_read``/``submit_readv``/``submit_write``)
     delegates to the wrapped engine.  Stack under ResilientEngine —
     ``ResilientEngine(FaultyEngine(StromEngine(), plan))`` — so
-    recoveries are exercised against the injected faults.
+    recoveries (read AND write) are exercised against the injected
+    faults.
     """
 
     def __init__(self, engine, plan: Optional[FaultPlan] = None):
@@ -334,10 +439,11 @@ class FaultyEngine:
         self._paths.pop(fh, None)
         self._engine.close(fh)
 
-    def _maybe_fault(self, pending, fh: int, offset: int, length: int):
-        """Per-read injection decision + accounting, shared by the
-        scalar and vectored submit paths."""
-        spec = self.plan.decide(self._paths.get(fh, ""))
+    def _maybe_fault(self, pending, fh: int, offset: int, length: int,
+                     op: str = "read"):
+        """Per-request injection decision + accounting, shared by the
+        scalar, vectored, and write submit paths."""
+        spec = self.plan.decide(self._paths.get(fh, ""), op=op)
         if spec is None:
             return pending
         self.stats.add(faults_injected=1)
@@ -347,6 +453,8 @@ class FaultyEngine:
             tracer.add_span(f"strom.fault.{spec.kind}", now, now,
                             category="strom.fault", fh=fh, offset=offset,
                             length=length)
+        if op == "write":
+            return FaultyWrite(pending, spec)
         return FaultyRead(pending, spec, self.plan)
 
     def submit_read(self, fh: int, offset: int, length: int):
@@ -363,6 +471,15 @@ class FaultyEngine:
         pendings = submit_spans(self._engine, reads)
         return [self._maybe_fault(p, fh, offset, length)
                 for (fh, offset, length), p in zip(reads, pendings)]
+
+    def submit_write(self, fh: int, offset: int, data):
+        """Write-path injection: the wrapped engine's write goes down
+        unchanged; the handed-back PendingWrite carries the fault
+        (weio/wenospc/wshort/wdelay) into its ``wait``."""
+        pending = self._engine.submit_write(fh, offset, data)
+        return self._maybe_fault(pending, fh, offset,
+                                 getattr(pending, "length", 0),
+                                 op="write")
 
     def read(self, fh: int, offset: int, length: int) -> np.ndarray:
         with self.submit_read(fh, offset, length) as p:

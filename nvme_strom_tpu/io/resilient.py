@@ -23,9 +23,17 @@ utils/config.py; semantics: docs/RESILIENCE.md):
            release-waits-if-live contract) and resubmitted, counted
            against the same retry budget.
 
+The write path carries the same contract through ``submit_write`` →
+:class:`ResilientWrite`: a write that completes with an error is
+resubmitted with backoff, a SHORT write resubmits exactly the remaining
+span (``data[n:]`` at ``offset+n``), and budget exhaustion raises
+``WriteError`` with the full attempt history.  Hedging is deliberately
+read-only — duplicate in-flight writes of one range can interleave.
+
 Every action is accounted (StromStats: resilient_retries, hedges_issued,
-hedges_won, stuck_cancelled) and traced (strom.resilient.* spans), so a
-recovered run shows its scars in ``strom_stat`` instead of hiding them.
+hedges_won, stuck_cancelled, write_retries) and traced
+(strom.resilient.* spans), so a recovered run shows its scars in
+``strom_stat`` instead of hiding them.
 
 The wrapper preserves the engine read contract: ``wait(timeout=...)``
 raises TimeoutError with the request still live; ``release()`` frees
@@ -57,6 +65,15 @@ class ReadError(OSError):
     ``{"error": str, "kind": str, "elapsed_s": float}`` dicts, oldest
     first — the loud, fully-accounted failure the error budget demands.
     """
+
+    def __init__(self, msg: str, attempts):
+        super().__init__(msg)
+        self.attempts = list(attempts)
+
+
+class WriteError(OSError):
+    """A write that stayed failed after the full retry budget —
+    ReadError's mirror, same ``attempts`` fault-history shape."""
 
     def __init__(self, msg: str, attempts):
         super().__init__(msg)
@@ -321,15 +338,143 @@ class _Stuck(OSError):
     """Internal: a wait that exceeded stuck_timeout_s (cancel + retry)."""
 
 
-class ResilientEngine:
-    """Engine wrapper adding retry / hedging / stuck-cancel to reads.
+class ResilientWrite:
+    """The recoverable counterpart of ``PendingWrite`` — the write-path
+    mirror of :class:`ResilientRead` (docs/RESILIENCE.md, write path).
 
-    Drop-in for StromEngine everywhere reads happen (ShardedLoader,
-    CheckpointManager, parallel/weights): ``submit_read`` returns a
-    ResilientRead; all other attributes delegate to the wrapped engine.
-    Writes are NOT wrapped — the checkpoint path has its own atomicity
-    story (staged temp dir + durable rename) and a blind rewrite could
-    mask it.
+    Holds (fh, offset, source bytes) so a failed attempt can be
+    resubmitted whole and a SHORT write can resubmit exactly the
+    remaining span (``data[n:]`` at ``offset + n``) instead of
+    rewriting committed bytes.  Hedging does not apply: two in-flight
+    writes of one range could land out of order and interleave torn
+    content — retry/backoff is the whole recovery vocabulary here.
+    The source buffer stays referenced until the logical write
+    completes (the engine works from a raw pointer).
+    """
+
+    def __init__(self, engine: "ResilientEngine", fh: int, offset: int,
+                 data: np.ndarray, pending):
+        self._engine = engine
+        self._fh = fh
+        self._offset = offset
+        self._data = data            # contiguous uint8; keepalive
+        self._pending = pending
+        self._done_total: Optional[int] = None
+        self._written = 0            # bytes committed by prior attempts
+        self._attempt_off = offset   # submit offset of the CURRENT attempt
+        self._attempts: list = []
+        self._retries = 0
+        self._t0 = time.monotonic()
+        self._released = False
+
+    @property
+    def fh(self) -> int:
+        return self._fh
+
+    @property
+    def offset(self) -> int:
+        return self._offset
+
+    @property
+    def length(self) -> int:
+        return self._data.nbytes
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """Block until every byte is committed (retrying failed and
+        short attempts under the engine's retry budget); returns the
+        total byte count, PendingWrite.wait parity.  ``timeout`` bounds
+        THIS call: TimeoutError means the logical write is still live
+        and recovery continues on the next wait."""
+        if self._done_total is not None:
+            return self._done_total
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
+        cfg = self._engine.rconfig
+        while True:
+            slice_t = None
+            if deadline is not None:
+                slice_t = max(0.0, deadline - time.monotonic())
+            try:
+                n = self._pending.wait(timeout=slice_t)
+            except TimeoutError:
+                raise            # caller's bound, write still live
+            except OSError as e:
+                self._note(e, kind="io")
+                # retry exactly the failed attempt's span (bytes before
+                # self._written were committed by earlier short attempts)
+                self._retry_or_raise(cfg, deadline,
+                                     resubmit_from=self._written)
+                continue
+            expected = self._data.nbytes - self._written
+            if n < expected:
+                self._note(OSError(
+                    f"short write: {n} of {expected} bytes at "
+                    f"offset {self._attempt_off}"), kind="short")
+                # bytes [0, n) of this attempt ARE committed: resubmit
+                # only the remainder
+                self._written += n
+                self._retry_or_raise(cfg, deadline,
+                                     resubmit_from=self._written)
+                continue
+            self._done_total = self._written + n
+            self._released = True
+            return self._done_total
+
+    def _note(self, e: OSError, kind: str) -> None:
+        self._attempts.append({
+            "error": str(e), "kind": kind,
+            "elapsed_s": round(time.monotonic() - self._t0, 4)})
+
+    def _retry_or_raise(self, cfg, deadline, resubmit_from: int) -> None:
+        eng = self._engine
+        if self._retries >= cfg.max_retries:
+            self._released = True
+            raise WriteError(
+                f"write fh={self._fh} off={self._offset} "
+                f"len={self._data.nbytes} failed after "
+                f"{self._retries + 1} attempts "
+                f"(history: {self._attempts})", self._attempts)
+        delay = min(cfg.backoff_max_s,
+                    cfg.backoff_base_s * (2 ** self._retries))
+        delay *= 1.0 + cfg.jitter * (2 * eng._rng.random() - 1)
+        if deadline is not None:
+            delay = min(delay, max(0.0, deadline - time.monotonic()))
+        if delay > 0:
+            time.sleep(delay)
+        self._retries += 1
+        eng.stats.add(write_retries=1)
+        self._attempt_off = self._offset + resubmit_from
+        remaining = self._data[resubmit_from:]
+        self._pending = eng._engine.submit_write(
+            self._fh, self._attempt_off, remaining)
+        eng._trace("strom.resilient.write_retry", time.monotonic_ns(),
+                   fh=self._fh, offset=self._attempt_off,
+                   attempt=self._retries,
+                   error=self._attempts[-1]["error"])
+
+    def release(self) -> None:
+        """Abort/free: blocks until the current attempt is out of
+        flight (the PendingWrite contract), then drops the keepalive."""
+        if self._released:
+            return
+        self._released = True
+        self._pending.release()
+
+
+class ResilientEngine:
+    """Engine wrapper adding retry / hedging / stuck-cancel to reads,
+    and retry / short-write-resubmit to writes.
+
+    Drop-in for StromEngine everywhere I/O happens (ShardedLoader,
+    CheckpointManager, OffloadedAdam, PagedKVCache, parallel/weights):
+    ``submit_read`` returns a ResilientRead, ``submit_write`` a
+    ResilientWrite; all other attributes delegate to the wrapped
+    engine.  Write recovery is SAFE under the checkpoint path's
+    atomicity story: every consumer writes into a staged temp file or
+    an exclusively-owned slot, so rewriting the same bytes at the same
+    offset is idempotent, and the commit record (marker/manifest/
+    rename) only lands after the waits succeed — a retry can never
+    resurrect a save the commit sequence already abandoned.
     """
 
     def __init__(self, engine, config: Optional[ResilientConfig] = None):
@@ -455,6 +600,19 @@ class ResilientEngine:
             out = p.wait().copy()
         self.stats.add(bounce_bytes=int(out.nbytes))
         return out
+
+    # -- writes ------------------------------------------------------------
+
+    def submit_write(self, fh: int, offset: int, data) -> ResilientWrite:
+        """Recoverable write: failed attempts resubmit with backoff,
+        short writes resubmit the remaining span, and exhaustion raises
+        WriteError with the per-attempt history — the write mirror of
+        submit_read's retry half (hedging deliberately excluded: racing
+        duplicate writes of one range can interleave torn content)."""
+        arr = np.ascontiguousarray(np.asarray(data)) \
+            .view(np.uint8).reshape(-1)
+        pending = self._engine.submit_write(fh, offset, arr)
+        return ResilientWrite(self, fh, offset, arr, pending)
 
     # -- policy helpers ----------------------------------------------------
 

@@ -29,6 +29,14 @@ Honest accounting: evicted pages ride ``submit_write`` (O_DIRECT when
 aligned, bounced+counted otherwise); streamed pages ride the zero-copy
 read path and count ``bytes_to_device``, exactly like every other
 consumer of the engine.
+
+Durability + integrity (docs/RESILIENCE.md): eviction writes adopt the
+resilient write mirror when the engine carries it (each page slot is an
+exclusively-owned range, so retries are idempotent), and under
+``STROM_VERIFY`` every evicted section stamps a per-layer CRC32C that
+the read tier re-checks in the staging window before the device
+transfer — a flipped bit in cold history fails attention loudly instead
+of skewing the softmax silently.
 """
 
 from __future__ import annotations
@@ -254,6 +262,14 @@ class PagedKVCache:
         self._host_cache: "dict" = {}
         self.host_cache_hits = 0
         self.host_cache_misses = 0
+        # read-side integrity (STROM_VERIFY): per-(page, section, layer)
+        # CRC32C stamped at eviction time, verified when the layer slice
+        # streams back for attention.  Session-scoped and in-memory —
+        # the page file's lifetime IS the cache's, so unlike checkpoint
+        # tiles there is no durable sidecar to keep in sync.
+        from nvme_strom_tpu.utils.checksum import VerifyPolicy
+        self._verify = VerifyPolicy()
+        self._page_crc: Dict[tuple, int] = {}
 
     _MAX_PENDING_PAGES = 4
 
@@ -338,10 +354,28 @@ class PagedKVCache:
             sections = ((k_page, kd), (v_page, vd))
         pend = []
         hosts = []
-        for arr, off in sections:
+        sec_lens = (self._pb_layer, self._sb_layer,
+                    self._pb_layer, self._sb_layer)
+        for sec_idx, (arr, off) in enumerate(sections):
             host = np.ascontiguousarray(
                 np.asarray(arr)).view(np.uint8).reshape(-1)
             hosts.append(host)
+            if self._verify.enabled:
+                # stamp per LAYER slice — exactly the spans the read
+                # tier streams back (one layer's k/v/scales per page).
+                # The sampling policy gates HERE, at stamp time: in
+                # ``sample`` mode only every Nth span pays the CRC on
+                # this hot eviction path, and the read tier verifies
+                # precisely the spans that carry a stamp — one gate,
+                # not two multiplying into 1/N².
+                from nvme_strom_tpu.utils.checksum import crc32c
+                ln = (sec_lens[sec_idx] if self._quant
+                      else self._pb_layer)
+                L = self.k_win.shape[0]
+                for layer in range(L):
+                    if self._verify.want():
+                        self._page_crc[(self.n_cold, sec_idx, layer)] = \
+                            crc32c(host[layer * ln:(layer + 1) * ln])
             chunk = self.engine.config.chunk_bytes
             for p0 in range(0, host.nbytes, chunk):
                 part = host[p0:p0 + chunk]
@@ -508,6 +542,49 @@ class PagedKVCache:
 
     # -- read tier --------------------------------------------------------
 
+    def _make_verify_cb(self, layer: int, span_meta, n_sub):
+        """Staging-view CRC32C check for the page stream — hooks
+        ``DeviceStream.stream_ranges``'s host-visible window (the only
+        point on this path where payload bytes exist host-side).  A
+        span split across several chunk ranges accumulates its CRC
+        incrementally; the final chunk compares against the eviction-
+        time stamp.  Sampling happened at STAMP time (the eviction
+        path), so every span that carries a stamp is verified — an
+        unstamped span (not sampled, or evicted before verification
+        was enabled) is skipped.  A mismatch raises ChecksumError —
+        corrupt KV history must never reach attention silently (there
+        is no older intact copy to fall back to; the session aborts
+        loudly)."""
+        from nvme_strom_tpu.utils.checksum import ChecksumError, crc32c
+        # range index → (span index, is_last_chunk_of_span)
+        range_span = []
+        for si, cnt in enumerate(n_sub):
+            for j in range(cnt):
+                range_span.append((si, j == cnt - 1))
+        running: Dict[int, int] = {}
+        stats = self.engine.stats
+
+        def verify(ri: int, view) -> None:
+            si, last = range_span[ri]
+            page, sec = span_meta[si]
+            expected = self._page_crc.get((page, sec, layer))
+            if expected is None:
+                return      # unstamped: not sampled at eviction
+            running[si] = crc32c(view, running.get(si, 0))
+            stats.add(bytes_verified=int(view.nbytes))
+            if not last:
+                return
+            got = running.pop(si)
+            if got != expected:
+                stats.add(checksum_failures=1)
+                raise ChecksumError(
+                    f"KV page {page} section {sec} layer {layer} of "
+                    f"{self.ocfg.path} fails its eviction-time CRC32C "
+                    f"({got:#010x} != {expected:#010x}) — corrupt "
+                    f"history must not reach attention")
+
+        return verify
+
     def _iter_layer_pages(self, layer: int):
         """Stream (k_page, v_page) device pairs for one layer's cold
         history, pipelined at queue depth across all pages.  Spans
@@ -522,17 +599,27 @@ class PagedKVCache:
                                        self._pb_layer, self._sb_layer)
                          if ln)
         spans = []          # per UNCACHED page: k data[, sc], v data[, sc]
+        span_meta = []      # parallel: (page, write-section index)
         for page in range(self.n_cold):
             if page in self._host_cache:
                 continue     # served from the RAM tier, no NVMe read
             kd, ks, vd, vs = self._section_offsets(page)
-            for base, ln in ((kd, self._pb_layer), (ks, self._sb_layer),
-                             (vd, self._pb_layer), (vs, self._sb_layer)):
+            for sec_idx, (base, ln) in enumerate(
+                    ((kd, self._pb_layer), (ks, self._sb_layer),
+                     (vd, self._pb_layer), (vs, self._sb_layer))):
                 if ln:
                     spans.append((base + layer * ln, ln))
+                    # write-side stamps key by the FILTERED order the
+                    # eviction path enumerated (k,v unquantized;
+                    # k,ks,v,vs quantized) — recover it here
+                    span_meta.append(
+                        (page, sec_idx if self._quant else sec_idx // 2))
         ranges, n_sub = split_ranges(spans,
                                      self.engine.config.chunk_bytes)
-        it = self._stream.stream_ranges(self._fh, ranges)
+        verify_cb = (self._make_verify_cb(layer, span_meta, n_sub)
+                     if self._verify.enabled else None)
+        it = self._stream.stream_ranges(self._fh, ranges,
+                                        verify=verify_cb)
         counts = iter(n_sub)
 
         def stream_flat():

@@ -15,7 +15,7 @@ The staging buffer is released back to the pool only after
 
 from __future__ import annotations
 
-from typing import Iterator, Optional, Sequence
+from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
 
@@ -187,11 +187,17 @@ class DeviceStream:
             self.engine.close(fh)
 
     def stream_ranges(self, fh: int, ranges: Sequence[tuple[int, int]],
-                      dtype=None, shapes: Optional[Sequence] = None
-                      ) -> Iterator:
+                      dtype=None, shapes: Optional[Sequence] = None,
+                      verify: Optional[Callable] = None) -> Iterator:
         """Yield device arrays for arbitrary (offset, length) ranges of an
-        open file — the planner-facing API used by the format readers."""
-        pending: list = []   # (PendingRead, shape)
+        open file — the planner-facing API used by the format readers.
+
+        ``verify``: optional ``fn(range_index, host_view)`` invoked on
+        the completed staging view BEFORE the device transfer — the one
+        window where payload bytes are host-visible on this path, so
+        read-side integrity checks (STROM_VERIFY, utils/checksum.py)
+        hook here; raising aborts the stream loudly."""
+        pending: list = []   # (PendingRead, shape, range_index)
         inflight: list = []  # (device_array, PendingRead)
 
         def drain_one():
@@ -210,6 +216,21 @@ class DeviceStream:
             while inflight and inflight[0][0].is_ready():
                 yield drain_one()
 
+        def start_transfer():
+            # oldest pending read → verified staging view → device;
+            # the entry leaves ``pending`` first, so on a verify
+            # failure the finally can't see it — release here, no
+            # buffer leak
+            pr, shp, ri = pending.pop(0)
+            view = pr.wait()
+            if verify is not None:
+                try:
+                    verify(ri, view)
+                except BaseException:
+                    pr.release()
+                    raise
+            inflight.append((self._put(view, dtype, shp), pr))
+
         ranges = list(ranges)
         shapes_l = list(shapes) if shapes is not None else None
         try:
@@ -225,25 +246,21 @@ class DeviceStream:
                 for j, pr in enumerate(prs):
                     shape = (shapes_l[i + j] if shapes_l is not None
                              else None)
-                    pending.append((pr, shape))
+                    pending.append((pr, shape, i + j))
                 i += len(take)
                 # keep `depth` reads in flight before starting transfers
                 while len(pending) > self.depth:
-                    pr, shp = pending.pop(0)
-                    view = pr.wait()
-                    inflight.append((self._put(view, dtype, shp), pr))
+                    start_transfer()
                     if self.drain == "ready":
                         yield from drain_ready()
                     while len(inflight) > self.depth:
                         yield drain_one()
-            for pr, shp in pending:
-                view = pr.wait()
-                inflight.append((self._put(view, dtype, shp), pr))
-            pending = []
+            while pending:
+                start_transfer()
             while inflight:
                 yield drain_one()
         finally:
-            for pr, _ in pending:
+            for pr, _, _ in pending:
                 try:
                     pr.wait()
                 except OSError:

@@ -36,7 +36,12 @@ slot write and clears it (with the advanced ``step``) only after every
 write drains — so a crash mid-step, which leaves a MIX of steps in the
 file, is detected and refused at resume rather than silently diverging.
 Pair restores with the params checkpoint matching the manifest step
-(checkpoint/manager.py; train_lm enforces this).
+(checkpoint/manager.py; train_lm enforces this).  Transient write
+failures (EIO/ENOSPC/short) are recovered below this layer when the
+engine carries the resilient write mirror (``STROM_RESILIENT=1`` or an
+explicit ``ResilientEngine`` — docs/RESILIENCE.md): slot writes are
+exclusively-owned ranges, so a retry rewriting the same bytes is
+idempotent and the dirty/step protocol above is unaffected.
 
 Multi-host: each process owns a PER-PROCESS moment file holding the
 moments of its locally-addressable parameter shards (unique shard

@@ -167,6 +167,27 @@ class LazyCheckpoint:
 
         from nvme_strom_tpu.ops.bridge import (StagingRetirePool,
                                                host_to_device)
+        from nvme_strom_tpu.utils.checksum import (ChecksumError,
+                                                   VerifyPolicy, crc32c)
+        # read-side integrity (STROM_VERIFY): a span covering the WHOLE
+        # tensor accumulates a CRC32C over its streamed chunks and
+        # compares against the write-time stamp (formats/safetensors).
+        # Row-sharded spans read sub-ranges the whole-tensor stamp
+        # cannot cover — the offline scrubber owns those (strom-scrub).
+        # Detection is loud-by-raise: the views were already in flight
+        # to devices, but the load fails before the params are returned,
+        # so corruption never reaches training silently.
+        policy = getattr(self, "_verify", None)
+        if policy is None:
+            policy = self._verify = VerifyPolicy()
+        stamp = None
+        if policy.enabled:
+            from nvme_strom_tpu.formats.safetensors import \
+                tensor_checksums
+            stamps = getattr(sf, "_strom_crcs", None)
+            if stamps is None:
+                stamps = sf._strom_crcs = tensor_checksums(sf)
+            stamp = stamps.get(name)
         fh = eng.open(sf.path)
         device_arrays = {}
         # Deferred staging release (shared DeviceStream discipline):
@@ -184,9 +205,16 @@ class LazyCheckpoint:
                        eng.n_buffers - stream_depth - 1)))
         try:
             for (r0, r1), devs in spans.items():
+                full_span = (r0, r1) == (0, gshape[0] if gshape else 1)
+                check = (stamp is not None and full_span
+                         and policy.want())
+                crc = 0
                 parts: Dict[object, list] = {dev: [] for dev, _ in devs}
                 for view, release in self._stream_span(
                         eng, fh, sf, name, r0, r1, np_dt, gshape):
+                    if check:
+                        crc = crc32c(view, crc)
+                        eng.stats.add(bytes_verified=int(view.nbytes))
                     cache: Dict[tuple, np.ndarray] = {}
                     put = []
                     for dev, tail in devs:
@@ -210,6 +238,12 @@ class LazyCheckpoint:
                         parts[dev].append(arr)
                         put.append(arr)
                     retire.push(release, put)
+                if check and crc != stamp:
+                    eng.stats.add(checksum_failures=1)
+                    raise ChecksumError(
+                        f"tensor {name} of {sf.path} fails its stamped "
+                        f"CRC32C ({crc:#010x} != {stamp:#010x}) — "
+                        f"corrupt weights must not reach the model")
                 for dev, _ in devs:
                     ps = parts[dev]
                     device_arrays[dev] = (

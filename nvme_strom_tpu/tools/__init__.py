@@ -5,4 +5,5 @@ Run as modules:
 
     python -m nvme_strom_tpu.tools.ssd2tpu_test <file> [--verify] [...]
     python -m nvme_strom_tpu.tools.strom_stat [stats.json] [--json]
+    python -m nvme_strom_tpu.tools.strom_scrub <dir> [--gc] [--stamp]
 """

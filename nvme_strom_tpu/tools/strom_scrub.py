@@ -1,0 +1,364 @@
+"""strom_scrub — offline integrity scrubber + crash-debris GC.
+
+The online verification gate (``STROM_VERIFY``, utils/checksum.py)
+protects bytes as they flow; this tool is the at-rest half: walk a
+checkpoint directory or a data-shard set, re-read every stamped span
+through the engine, and report exactly which files hold damage — the
+NVMe-tier analogue of a RAID scrub, and the recovery-planning step
+after a suspected corruption event ("which checkpoints can I still
+trust?").  It also garbage-collects ``.tmp_step_*`` staging dirs left
+by crashed saves (the same debris ``CheckpointManager`` removes at
+startup — the scrubber handles fleets of checkpoint dirs no manager
+will ever reopen).
+
+    strom-scrub /data/ckpts             # verify every step's tiles
+    strom-scrub /data/ckpts --gc        # + remove crashed-save debris
+    strom-scrub /data/shards            # verify sidecar-stamped shards
+    strom-scrub /data/shards --stamp    # write sidecars for unstamped
+    strom-scrub model.safetensors       # one file
+
+Exit code: 0 clean, 1 damage found, 2 usage/IO error.  ``--json``
+emits one machine-readable line (per-file damage list + counters) for
+fleet tooling.  Reads ride the direct engine — a scrub doubles as a
+sequential-read health pass over the namespace — and every verified
+byte counts ``StromStats.bytes_verified``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import time
+from typing import Dict, List, Optional
+
+# the manager OWNS the step/staging naming and the live-save age gate;
+# importing them keeps the scrubber's GC and dir sniffing in lockstep
+# with the layout (jax is imported lazily there, so this is cheap)
+from nvme_strom_tpu.checkpoint.manager import (_STEP_RE, _TMP_RE,
+                                               _gc_min_age, _newest_mtime)
+
+
+def _engine(config=None):
+    from nvme_strom_tpu.io.faults import build_engine
+    from nvme_strom_tpu.utils.config import EngineConfig
+    return build_engine(config or EngineConfig())
+
+
+def _crc_spans(eng, fh, spans) -> Dict[int, tuple]:
+    """CRC32C each ``(offset, length)`` span via depth-pipelined
+    chunked engine reads — the queue depth stays full instead of one
+    serial submit/wait round trip per chunk (a scrub IS a bulk
+    sequential read; pacing it at depth 1 would hide device throughput
+    problems the health pass exists to surface), constant staging
+    memory however large the tensor.  Returns
+    ``{span_index: (crc | None, error | None)}``."""
+    from nvme_strom_tpu.io.engine import wait_exact
+    from nvme_strom_tpu.utils.checksum import crc32c
+    chunk = eng.config.chunk_bytes
+    depth = max(2, eng.config.queue_depth // 2)
+    acc: Dict[int, int] = {}      # span → running crc (FIFO waits keep
+    done: Dict[int, tuple] = {}   # chunk accumulation ordered)
+    pend: List[tuple] = []        # (PendingRead, span_idx, is_last)
+
+    def drain_one():
+        p, si, last = pend.pop(0)
+        if si in done:            # span already failed: discard chunk
+            try:
+                wait_exact(p)
+            except OSError:
+                pass
+            finally:
+                p.release()
+            return
+        try:
+            crcv = crc32c(wait_exact(p), acc.pop(si, 0))
+        except OSError as e:
+            done[si] = (None, e)
+            return
+        finally:
+            p.release()           # idempotent if wait already released
+        if last:
+            done[si] = (crcv, None)
+        else:
+            acc[si] = crcv
+
+    for si, (off, ln) in enumerate(spans):
+        if ln == 0:
+            done[si] = (crc32c(b""), None)
+            continue
+        pos = 0
+        while pos < ln and si not in done:
+            n = min(chunk, ln - pos)
+            pend.append((eng.submit_read(fh, off + pos, n), si,
+                         pos + n == ln))
+            pos += n
+            while len(pend) >= depth:
+                drain_one()
+    while pend:
+        drain_one()
+    return done
+
+
+def _scrub_stamped_spans(eng, path: str, spans, where_key: str
+                         ) -> List[dict]:
+    """Verify stamped spans of one file — the shared engine of both
+    scrub targets.  ``spans``: (offset, length, expected_crc,
+    where_value) per span; ``where_key`` names the damage-entry field
+    ("tensor" for safetensors, "offset" for sidecar shards)."""
+    try:
+        fh = eng.open(path)
+    except OSError as e:
+        # an unopenable file is damage to REPORT, never a scrub crash:
+        # the 0/1/2 exit contract must survive a chmod'd/vanished shard
+        return [{"file": path, where_key: spans[0][3] if spans else "",
+                 "error": f"unreadable: {e}"}]
+    try:
+        got = _crc_spans(eng, fh, [(s[0], s[1]) for s in spans])
+    finally:
+        eng.close(fh)
+    damage: List[dict] = []
+    for si, (off, ln, expected, wv) in enumerate(spans):
+        crcv, err = got.get(si, (None, "not read"))
+        if err is not None:
+            damage.append({"file": path, where_key: wv,
+                           "error": f"read failed: {err}"})
+            continue
+        eng.stats.add(bytes_verified=int(ln))
+        if crcv != expected:
+            eng.stats.add(checksum_failures=1)
+            damage.append({"file": path, where_key: wv,
+                           "error": f"crc32c {crcv:#010x} != "
+                                    f"stamped {expected:#010x}"})
+    return damage
+
+
+def scrub_safetensors(eng, path: str) -> List[dict]:
+    """Verify every stamped tensor of one safetensors file; returns the
+    damage list (one entry per failing/unreadable tensor)."""
+    from nvme_strom_tpu.formats.safetensors import (SafetensorsFile,
+                                                    tensor_checksums)
+    try:
+        sf = SafetensorsFile(path)
+        stamps = tensor_checksums(sf)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        return [{"file": path, "error": f"unreadable: {e}"}]
+    if not stamps:
+        return [{"file": path, "error": "unstamped (no crc32c metadata)",
+                 "unstamped": True}]
+    damage: List[dict] = []
+    spans = []
+    for name, expected in sorted(stamps.items()):
+        t = sf.tensors.get(name)
+        if t is None:
+            damage.append({"file": path, "tensor": name,
+                           "error": "stamped tensor missing"})
+            continue
+        spans.append((t["offset"], t["nbytes"], expected, name))
+    damage.extend(_scrub_stamped_spans(eng, path, spans, "tensor"))
+    return damage
+
+
+def scrub_sidecar_file(eng, path: str, sc=None) -> List[dict]:
+    """Verify every sidecar-stamped span of one data shard.  ``sc``:
+    an already-parsed Sidecar (the directory walk loads it to decide
+    stamped-vs-unstamped — don't parse it twice per shard)."""
+    if sc is None:
+        from nvme_strom_tpu.utils.checksum import load_sidecar
+        sc = load_sidecar(path)
+    if sc is None:
+        return [{"file": path, "error": "unstamped (no .crc.json "
+                                        "sidecar)", "unstamped": True}]
+    spans = [(off,) + sc.spans[off] + (off,) for off in sorted(sc.spans)]
+    return _scrub_stamped_spans(eng, path, spans, "offset")
+
+
+def stamp_file(path: str) -> Optional[str]:
+    """Write a sidecar for an unstamped shard (format sniffed by
+    suffix); returns the sidecar path or None when unsupported."""
+    from nvme_strom_tpu.utils import checksum as ck
+    if path.endswith(".tar"):
+        return ck.stamp_wds(path)
+    if path.endswith((".tfrecord", ".tfrecords")):
+        return ck.stamp_tfrecord(path)
+    try:
+        from nvme_strom_tpu.formats.fixedrec import FixedRecIndex
+        FixedRecIndex(path)
+        return ck.stamp_fixedrec(path)
+    except (OSError, ValueError):
+        return None
+
+
+def find_tmp_dirs(root: str) -> List[str]:
+    """Crashed-save staging dirs under ``root`` (any nesting level a
+    checkpoint dir layout produces: root itself, or step parents)."""
+    out = []
+    for dirpath, dirnames, _ in os.walk(root):
+        for name in list(dirnames):
+            if _TMP_RE.match(name):
+                out.append(os.path.join(dirpath, name))
+                dirnames.remove(name)    # never descend into debris
+    return sorted(out)
+
+
+def _is_ckpt_dir(path: str) -> bool:
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return False
+    return any(_STEP_RE.match(n) or _TMP_RE.match(n) for n in names)
+
+
+def collect_targets(path: str) -> Dict[str, List[str]]:
+    """{kind: paths} for ``path``: safetensors files (checkpoint tiles,
+    weight shards) and sidecar-eligible data shards."""
+    st: List[str] = []
+    shards: List[str] = []
+    if os.path.isfile(path):
+        (st if path.endswith(".safetensors") else shards).append(path)
+        return {"safetensors": st, "shards": shards}
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = [d for d in dirnames if not _TMP_RE.match(d)]
+        for name in sorted(filenames):
+            p = os.path.join(dirpath, name)
+            if name.endswith(".safetensors"):
+                st.append(p)
+            elif name.endswith((".tar", ".tfrecord", ".tfrecords",
+                                ".fixedrec", ".bin")):
+                shards.append(p)
+    return {"safetensors": st, "shards": shards}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="strom_scrub",
+        description="offline checksum scrubber + crashed-save GC "
+                    "(docs/RESILIENCE.md)")
+    ap.add_argument("path", help="checkpoint dir, shard dir, or file")
+    ap.add_argument("--gc", action="store_true",
+                    help="remove .tmp_step_* staging dirs left by "
+                         "crashed saves (age-gated by "
+                         "STROM_CKPT_GC_AGE_S, default 3600s, so a "
+                         "concurrent live save is never swept)")
+    ap.add_argument("--force", action="store_true",
+                    help="with --gc: remove staging dirs regardless "
+                         "of age (you are sure no save is in flight)")
+    ap.add_argument("--stamp", action="store_true",
+                    help="write CRC32C sidecars for unstamped shards "
+                         "instead of reporting them")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one machine-readable JSON line")
+    args = ap.parse_args(argv)
+
+    if not os.path.exists(args.path):
+        print(f"strom_scrub: {args.path}: no such path", file=sys.stderr)
+        return 2
+
+    targets = collect_targets(args.path)
+    report: dict = {"path": args.path, "files_scanned": 0,
+                    "damage": [], "unstamped": [], "stamped": [],
+                    "tmp_dirs": [], "tmp_dirs_removed": [],
+                    "tmp_dirs_live": []}
+
+    try:
+        return _scan(args, targets, report)
+    except Exception as e:      # engine creation, walk, unexpected I/O
+        # the 0/1/2 contract: 1 is reserved for DAMAGE — a scrub that
+        # could not run must not read as a corrupt namespace
+        print(f"strom_scrub: error: {e}", file=sys.stderr)
+        return 2
+
+
+def _scan(args, targets, report) -> int:
+    eng = _engine()
+    try:
+        for p in targets["safetensors"]:
+            report["files_scanned"] += 1
+            for d in scrub_safetensors(eng, p):
+                (report["unstamped"] if d.get("unstamped")
+                 else report["damage"]).append(d)
+        for p in targets["shards"]:
+            from nvme_strom_tpu.utils.checksum import load_sidecar
+            sc = load_sidecar(p)
+            if sc is None:
+                if args.stamp:
+                    if stamp_file(p):
+                        report["stamped"].append(p)
+                        continue
+                report["unstamped"].append(
+                    {"file": p, "error": "unstamped", "unstamped": True})
+                continue
+            report["files_scanned"] += 1
+            report["damage"].extend(scrub_sidecar_file(eng, p, sc))
+
+        if os.path.isdir(args.path):
+            tmp = find_tmp_dirs(args.path)
+            report["tmp_dirs"] = tmp
+            if args.gc:
+                # same live-save age gate as CheckpointManager startup
+                # GC: a staging dir whose newest mtime is fresh may be
+                # a concurrent trainer mid-save — skip it unless the
+                # operator forces (a scrub fleet-sweep must not delete
+                # an in-flight checkpoint out from under a job)
+                min_age = 0.0 if args.force else _gc_min_age()
+                now = time.time()
+                for t in tmp:
+                    try:
+                        fresh = now - _newest_mtime(t) < min_age
+                    except OSError:
+                        fresh = True     # racing removal: leave it
+                    if fresh:
+                        report["tmp_dirs_live"].append(t)
+                        continue
+                    shutil.rmtree(t, ignore_errors=True)
+                    if os.path.exists(t):
+                        # rmtree swallowed an error: report the debris
+                        # as damage-adjacent, not as removed
+                        report["damage"].append(
+                            {"file": t,
+                             "error": "staging dir could not be "
+                                      "removed (permission?)"})
+                        continue
+                    report["tmp_dirs_removed"].append(t)
+
+        eng.sync_stats()
+        snap = eng.stats.snapshot()
+        report["bytes_verified"] = int(snap.get("bytes_verified", 0))
+        report["checksum_failures"] = int(
+            snap.get("checksum_failures", 0))
+    finally:
+        eng.close_all()
+
+    if args.as_json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(f"scrubbed {report['files_scanned']} file(s), "
+              f"{report['bytes_verified']} bytes verified")
+        for d in report["damage"]:
+            where = d.get("tensor", d.get("offset", ""))
+            print(f"  DAMAGED {d['file']}"
+                  f"{' [' + str(where) + ']' if where != '' else ''}: "
+                  f"{d['error']}")
+        for u in report["unstamped"]:
+            print(f"  unstamped {u['file']} (run --stamp, or re-save "
+                  f"with a current writer)")
+        for p in report["stamped"]:
+            print(f"  stamped {p}")
+        for t in report["tmp_dirs"]:
+            if t in report["tmp_dirs_removed"]:
+                tag = "removed"
+            elif t in report["tmp_dirs_live"]:
+                tag = ("recently written — possibly a live save "
+                       "(--force to remove anyway)")
+            else:
+                tag = "crashed-save debris (use --gc)"
+            print(f"  tmp {t}: {tag}")
+        if not report["damage"]:
+            print("no damage found")
+    return 1 if report["damage"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

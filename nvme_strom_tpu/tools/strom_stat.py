@@ -36,7 +36,14 @@ _COUNTERS = (
 _RESILIENCE_COUNTERS = (
     "faults_injected", "resilient_retries", "hedges_issued",
     "hedges_won", "stuck_cancelled", "shards_quarantined",
-    "restore_fallbacks",
+    "restore_fallbacks", "write_retries",
+)
+
+#: end-to-end integrity counters (STROM_VERIFY + the write-path
+#: CRC32C stamps — utils/checksum.py, docs/RESILIENCE.md); own block,
+#: shown only when verification ran or a corruption was caught
+_INTEGRITY_COUNTERS = (
+    "bytes_verified", "checksum_failures",
 )
 
 #: batched-submission counters (io/plan.py planner + the engine's
@@ -116,6 +123,16 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
                 if d:
                     suffix = f"   (+{d})" if d > 0 else f"   ({d})"
             lines.append(f"    {name:<20} {v:>14}{suffix}")
+    if any(int(snap.get(n, 0)) for n in _INTEGRITY_COUNTERS):
+        lines.append("  integrity (STROM_VERIFY checksums):")
+        for name in _INTEGRITY_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if name.startswith("bytes") else str(v)
+            lines.append(f"    {name:<20} {shown:>14}")
+        if int(snap.get("checksum_failures", 0)):
+            lines.append(
+                "    CORRUPTION CAUGHT — scrub the namespace "
+                "(strom-scrub) before trusting older data")
     members = snap.get("member_bytes")
     if members:
         total = max(1, sum(members.values()))

@@ -1,0 +1,327 @@
+"""End-to-end payload integrity — CRC32C stamping + verification policy.
+
+The DMA chain's whole point is that host DRAM never touches payload
+bytes (SURVEY.md §3.1) — which also means no kernel-level safety net
+ever sees them: a bit flipped on the NVMe→HBM path flows straight into
+training state with clean lengths and clean status.  This module is the
+one place the stack's integrity story lives:
+
+- :func:`crc32c` — CRC32C (Castagnoli) over bytes/views, the engine's
+  native slice-by-8/SSE4.2 implementation (``strom_crc32c`` in
+  csrc/strom_io.cc) bound zero-copy via ctypes, with the pure-Python
+  table fallback when the library cannot build.  Incremental: pass the
+  previous value back as ``crc`` to checksum a span in pieces.
+- write-time stamping helpers: safetensors files carry per-tensor
+  checksums in ``__metadata__`` (formats/safetensors.py); per-record
+  formats (fixedrec, wds, tfrecord shards) carry an offset-keyed
+  ``<file>.crc.json`` sidecar (:func:`write_sidecar` /
+  :class:`Sidecar`), so ANY reader that knows a span's file offset can
+  verify it without format knowledge.
+- :class:`VerifyPolicy` — the read-side gate.  ``STROM_VERIFY`` is
+  ``off`` (default: zero cost, the direct path's bounce_bytes == 0
+  guarantee untouched), ``sample`` (every ``STROM_VERIFY_SAMPLE``-th
+  eligible span, default 16 — cheap steady-state scrubbing), or
+  ``full`` (every eligible span).  Verified bytes count
+  ``StromStats.bytes_verified``; every mismatch counts
+  ``checksum_failures`` and raises :class:`ChecksumError` — an OSError,
+  so the consumers' existing failure plumbing (retry-once, loader
+  quarantine, checkpoint restore-fallback) treats it exactly like a
+  failed read (docs/RESILIENCE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+_log = logging.getLogger(__name__)
+
+#: algorithm tag recorded next to every stamped checksum; verification
+#: dispatches on the recorded tag so a reader never compares values
+#: computed by different polynomials
+CRC_ALGO = "crc32c"
+
+_native_lock = threading.Lock()
+_native = None            # (fn, True) once resolved; (None, False) = py
+
+
+def _resolve_native():
+    """ctypes binding of strom_crc32c taking a raw pointer — ZERO-COPY
+    over numpy views (a bytes() copy would double every verified span's
+    memory traffic).  Bound on a PRIVATE CDLL handle: ctypes caches one
+    function object per CDLL instance, so sharing ``_load_lib()``'s
+    handle would let any other module's ``argtypes`` assignment on the
+    same symbol silently retype this one (and vice versa)."""
+    global _native
+    with _native_lock:
+        if _native is not None:
+            return _native
+        try:
+            import ctypes
+            from nvme_strom_tpu.io.engine import _load_lib
+            lib = ctypes.CDLL(_load_lib()._name)
+            lib.strom_crc32c.restype = ctypes.c_uint32
+            lib.strom_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                         ctypes.c_uint32]
+            _native = lib.strom_crc32c
+        except Exception:
+            _native = False
+        return _native
+
+
+class ChecksumError(OSError):
+    """A stamped checksum did not match the bytes read.
+
+    An OSError so every existing damage path treats it like a failed
+    read: ``CheckpointManager._DAMAGE`` (restore-fallback), the loader's
+    shard quarantine, and retry loops that catch OSError."""
+
+
+def crc32c(data, crc: int = 0) -> int:
+    """CRC32C of ``data`` (bytes / memoryview / uint8-viewable ndarray);
+    ``crc`` chains incremental spans."""
+    fn = _resolve_native()
+    if isinstance(data, memoryview):
+        # contiguous views route through the ndarray branch ZERO-COPY
+        # (the write-time stampers hand record-sized memoryviews over
+        # multi-GB shards — a bytes() here would re-copy all of it)
+        data = (np.frombuffer(data, np.uint8) if data.contiguous
+                else np.frombuffer(data.tobytes(), np.uint8))
+    if isinstance(data, np.ndarray):
+        # reshape(-1) BEFORE the uint8 view: a 0-d array cannot view a
+        # different itemsize, but its (1,) reshape can
+        arr = np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+        if fn:
+            return int(fn(arr.ctypes.data, arr.nbytes, crc))
+        data = arr.tobytes()
+    if fn:
+        return int(fn(data, len(data), crc))
+    from nvme_strom_tpu.formats.tfrecord import _crc32c_py
+    return _crc32c_py(data, crc)
+
+
+# --------------------------------------------------------------------------
+# read-side policy
+# --------------------------------------------------------------------------
+
+VERIFY_MODES = ("off", "sample", "full")
+
+
+def verify_mode() -> str:
+    """``$STROM_VERIFY`` → off (default) | sample | full."""
+    mode = os.environ.get("STROM_VERIFY", "off").strip().lower()
+    if mode in ("", "0", "no", "false"):
+        return "off"
+    if mode in ("1", "yes", "true", "on"):
+        return "full"
+    if mode not in VERIFY_MODES:
+        raise ValueError(
+            f"STROM_VERIFY={mode!r}: expected one of {VERIFY_MODES}")
+    return mode
+
+
+def sample_every() -> int:
+    try:
+        return max(1, int(os.environ.get("STROM_VERIFY_SAMPLE", 16)))
+    except ValueError:
+        return 16
+
+
+class VerifyPolicy:
+    """Per-consumer verification gate; construct once per loader /
+    restore / cache (reads the env at construction so a consumer's
+    behavior cannot flip mid-epoch)."""
+
+    def __init__(self, mode: Optional[str] = None):
+        self.mode = mode if mode is not None else verify_mode()
+        self._every = sample_every()
+        self._seen = 0
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    def want(self) -> bool:
+        """Should the NEXT eligible span be verified?  Deterministic:
+        ``full`` always, ``sample`` every Nth call (thread-safe counter
+        so concurrent producers share one sampling stream)."""
+        if self.mode == "off":
+            return False
+        if self.mode == "full":
+            return True
+        with self._lock:
+            self._seen += 1
+            return self._seen % self._every == 0
+
+    def check(self, data, expected: int, stats=None, *,
+              where: str = "") -> None:
+        """Verify ``data`` against ``expected`` CRC32C; counts
+        bytes_verified / checksum_failures on ``stats`` and raises
+        :class:`ChecksumError` on mismatch."""
+        nbytes = (data.nbytes if isinstance(data, np.ndarray)
+                  else len(data))
+        got = crc32c(data)
+        if stats is not None:
+            stats.add(bytes_verified=int(nbytes))
+        if got != expected:
+            if stats is not None:
+                stats.add(checksum_failures=1)
+            raise ChecksumError(
+                f"checksum mismatch{' for ' + where if where else ''}: "
+                f"crc32c {got:#010x} != stamped {expected:#010x} "
+                f"({nbytes} bytes)")
+
+    def check_with_reread(self, data, expected: int, reread, stats=None,
+                          *, where: str = ""):
+        """The consumers' shared recovery protocol (docs/RESILIENCE.md):
+        verify ``data``; on mismatch re-read ONCE via ``reread()`` —
+        transient in-flight corruption heals here, each attempt counted
+        — and verify again, letting a second mismatch raise
+        :class:`ChecksumError` (persistent corruption; the caller's
+        damage path — quarantine, restore-fallback, loud abort — takes
+        over).  Returns the verified payload (the re-read one when the
+        first copy was damaged)."""
+        try:
+            self.check(data, expected, stats, where=where)
+            return data
+        except ChecksumError:
+            _log.warning("checksum mismatch for %s — re-reading once",
+                         where or "span")
+        data = reread()
+        self.check(data, expected, stats,
+                   where=where + " (after a re-read)")
+        return data
+
+
+# --------------------------------------------------------------------------
+# offset-keyed sidecars (fixedrec / wds / any span-addressed format)
+# --------------------------------------------------------------------------
+
+SIDECAR_SUFFIX = ".crc.json"
+_SIDECAR_VERSION = 1
+
+
+def sidecar_path(path) -> str:
+    return str(path) + SIDECAR_SUFFIX
+
+
+def write_sidecar(path, spans: Iterable[Tuple[int, int, object]]) -> str:
+    """Stamp ``path`` with an offset-keyed checksum sidecar.
+
+    ``spans``: (offset, length, payload-bytes) triples — one per
+    independently-readable span (record, tar member, tile).  Keyed by
+    byte offset so readers that only know a span's file range (the
+    loader's index entries) can verify without format knowledge.
+    Written atomically (temp + rename) next to the data file.
+    """
+    entries: Dict[str, list] = {}
+    for off, length, payload in spans:
+        entries[str(int(off))] = [int(length), crc32c(payload)]
+    out = sidecar_path(path)
+    tmp = f"{out}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"version": _SIDECAR_VERSION, "algo": CRC_ALGO,
+                   "spans": entries}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, out)
+    return out
+
+
+class Sidecar:
+    """Parsed ``<file>.crc.json``: span-offset → (length, crc32c)."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        with open(self.path) as f:
+            doc = json.load(f)
+        if doc.get("version") != _SIDECAR_VERSION:
+            raise ValueError(
+                f"{self.path}: unsupported sidecar version "
+                f"{doc.get('version')}")
+        self.algo = doc.get("algo", CRC_ALGO)
+        if self.algo != CRC_ALGO:
+            raise ValueError(
+                f"{self.path}: sidecar algo {self.algo!r} is not "
+                f"{CRC_ALGO!r} — restamp with tools/strom_scrub")
+        self.spans: Dict[int, Tuple[int, int]] = {
+            int(k): (int(v[0]), int(v[1]))
+            for k, v in doc.get("spans", {}).items()}
+
+    def lookup(self, offset: int, length: int) -> Optional[int]:
+        """Stamped crc32c for the span at ``offset`` (None when the
+        sidecar has no entry, or the entry's length disagrees — an
+        unstamped or re-laid-out span is not an integrity failure)."""
+        ent = self.spans.get(int(offset))
+        if ent is None or ent[0] != int(length):
+            return None
+        return ent[1]
+
+    def __len__(self) -> int:
+        return len(self.spans)
+
+
+def load_sidecar(path) -> Optional[Sidecar]:
+    """Sidecar for data file ``path``; None when absent/unreadable
+    (unstamped data verifies nothing — never an error)."""
+    sc = sidecar_path(path)
+    if not os.path.exists(sc):
+        return None
+    try:
+        return Sidecar(sc)
+    except (OSError, ValueError, json.JSONDecodeError):
+        return None
+
+
+# --------------------------------------------------------------------------
+# format stamping helpers (offline tools + writers)
+# --------------------------------------------------------------------------
+
+def stamp_fixedrec(path) -> str:
+    """Sidecar for a fixedrec shard: one span per record."""
+    from nvme_strom_tpu.formats.fixedrec import FixedRecIndex
+    idx = FixedRecIndex(path)
+    rb = idx.record_bytes
+
+    def spans():
+        with open(path, "rb") as f:
+            for i in range(idx.count):
+                f.seek(i * rb)
+                yield i * rb, rb, f.read(rb)
+
+    return write_sidecar(path, spans())
+
+
+def stamp_wds(path) -> str:
+    """Sidecar for a wds tar shard: one span per member payload."""
+    from nvme_strom_tpu.formats.wds import WdsShardIndex
+    idx = WdsShardIndex(path)
+
+    def spans():
+        with open(path, "rb") as f:
+            for key in idx.order:
+                for ext, (off, ln) in idx.samples[key].items():
+                    f.seek(off)
+                    yield off, ln, f.read(ln)
+
+    return write_sidecar(path, spans())
+
+
+def stamp_tfrecord(path) -> str:
+    """Sidecar for a TFRecord shard: one span per record payload."""
+    from nvme_strom_tpu.formats.tfrecord import TFRecordIndex
+    idx = TFRecordIndex(path)
+
+    def spans():
+        with open(path, "rb") as f:
+            for off, ln in zip(idx.offsets, idx.lengths):
+                f.seek(off)
+                yield off, ln, f.read(ln)
+
+    return write_sidecar(path, spans())

@@ -82,6 +82,16 @@ class StromStats:
     # older intact step
     shards_quarantined: int = 0
     restore_fallbacks: int = 0
+    # -- write-path resilience + end-to-end integrity (io/resilient.py
+    # submit_write, utils/checksum.py) ------------------------------------
+    # failed/short writes resubmitted by ResilientEngine's write mirror
+    write_retries: int = 0
+    # payload bytes checksummed on the read path (STROM_VERIFY) — the
+    # integrity tax, priced by bench.py's verify rows
+    bytes_verified: int = 0
+    # stamped-checksum mismatches detected (each is a silent corruption
+    # that would otherwise have flowed into training state)
+    checksum_failures: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)

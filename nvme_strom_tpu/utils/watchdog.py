@@ -176,6 +176,14 @@ class StepWatchdog:
                       f"quarantined={s.shards_quarantined} "
                       f"faults_injected={s.faults_injected}",
                       file=w, flush=True)
+                # write-path + integrity tier: a hung save whose
+                # write_retries are moving is fighting the device, not
+                # wedged; any checksum_failures mean the hang may be a
+                # verify-retry loop over damaged media
+                print(f"integrity: write_retries={s.write_retries} "
+                      f"bytes_verified={s.bytes_verified} "
+                      f"checksum_failures={s.checksum_failures}",
+                      file=w, flush=True)
             except Exception as e:       # diagnosis must not crash the job
                 print(f"engine stats unavailable: {e}", file=w,
                       flush=True)

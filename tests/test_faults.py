@@ -697,3 +697,514 @@ def test_faulty_engine_vectored_counts_and_taxonomy(data_file):
         eng.close(fh)
     finally:
         eng._engine.close_all()
+
+
+# -- write-path fault matrix (the tentpole's write mirror) -------------------
+
+
+def test_write_fault_plan_parse_and_taxonomy():
+    plan = FaultPlan.parse(
+        "weio:every=2, wenospc:max_count=1, wshort:frac=0.25, "
+        "wdelay:delay_s=0.2")
+    kinds = [s.kind for s in plan.specs]
+    assert kinds == ["weio", "wenospc", "wshort", "wdelay"]
+    import errno
+    assert plan.specs[1].err == errno.ENOSPC   # the kind IS the errno
+    assert all(s.is_write for s in plan.specs)
+    # read decisions never fire write specs and vice versa
+    assert plan.decide(op="read") is None
+    assert plan.decide(op="write") is not None
+
+
+def _write_file(tmp_path, name="w.bin"):
+    p = tmp_path / name
+    p.write_bytes(b"")
+    return str(p)
+
+
+def test_write_eio_recovered_then_loud(tmp_path):
+    from nvme_strom_tpu.io import WriteError
+    path = _write_file(tmp_path)
+    data = (np.arange(128 << 10, dtype=np.uint8) % 251)
+
+    eng, stats, _, tracer = _stack("weio:max_count=2", tmp_path,
+                                   _rcfg(max_retries=3))
+    with eng:
+        fh = eng.open(path, writable=True)
+        n = eng.submit_write(fh, 0, data).wait()
+        eng.close(fh)
+    assert n == data.nbytes
+    assert stats.write_retries == 2
+    assert stats.faults_injected == 2
+    with open(path, "rb") as f:
+        assert f.read() == data.tobytes()
+    names = _trace_names(tracer)
+    assert names.count("strom.fault.weio") == 2
+    assert names.count("strom.resilient.write_retry") == 2
+
+    # over budget: loud WriteError with the per-attempt history
+    eng2, stats2, _, _ = _stack("weio", tmp_path, _rcfg(max_retries=2))
+    with eng2:
+        fh = eng2.open(path, writable=True)
+        with pytest.raises(WriteError, match="after 3 attempts") as ei:
+            eng2.submit_write(fh, 0, data[:4096]).wait()
+        eng2.close(fh)
+    assert len(ei.value.attempts) == 3
+    assert all(a["kind"] == "io" for a in ei.value.attempts)
+    assert stats2.write_retries == 2
+
+
+def test_short_write_resubmits_remaining_span(tmp_path):
+    """A wshort fault commits a prefix; the resilient mirror resubmits
+    EXACTLY the remainder (offset+n), so the final payload is whole and
+    committed bytes are never rewritten."""
+    path = _write_file(tmp_path)
+    data = (np.arange(64 << 10, dtype=np.uint8) % 113)
+    eng, stats, _, _ = _stack("wshort:max_count=1:frac=0.5", tmp_path,
+                              _rcfg(max_retries=2))
+    with eng:
+        fh = eng.open(path, writable=True)
+        n = eng.submit_write(fh, 0, data).wait()
+        eng.close(fh)
+    assert n == data.nbytes
+    assert stats.write_retries == 1
+    with open(path, "rb") as f:
+        assert f.read() == data.tobytes()
+
+
+def test_write_enospc_is_loud_with_errno(tmp_path):
+    import errno
+    from nvme_strom_tpu.io import WriteError
+    path = _write_file(tmp_path)
+    eng, stats, _, _ = _stack("wenospc", tmp_path, _rcfg(max_retries=1))
+    with eng:
+        fh = eng.open(path, writable=True)
+        with pytest.raises(WriteError, match="No space left") as ei:
+            eng.submit_write(fh, 0, np.zeros(4096, np.uint8)).wait()
+        eng.close(fh)
+    assert "No space left" in ei.value.attempts[0]["error"]
+
+
+def test_write_delay_honors_wait_timeout(tmp_path):
+    """wdelay holds the completion; a bounded wait times out with the
+    logical write still live, and the next wait finishes it."""
+    path = _write_file(tmp_path)
+    eng, _, _, _ = _stack("wdelay:max_count=1:delay_s=0.3", tmp_path)
+    with eng:
+        fh = eng.open(path, writable=True)
+        w = eng.submit_write(fh, 0, np.ones(4096, np.uint8))
+        with pytest.raises(TimeoutError):
+            w.wait(timeout=0.05)
+        assert w.wait() == 4096
+        eng.close(fh)
+
+
+def test_c_level_write_fault_hooks(tmp_path, monkeypatch):
+    """STROM_FAULT_WRITE_EIO_EVERY injects beneath the ctypes boundary
+    and the resilient write mirror recovers it — the native completion
+    path exercised end to end."""
+    monkeypatch.setenv("STROM_FAULT_WRITE_EIO_EVERY", "2")
+    path = _write_file(tmp_path)
+    stats = StromStats()
+    eng = ResilientEngine(StromEngine(_cfg(), stats=stats),
+                          _rcfg(max_retries=3))
+    data = (np.arange(32 << 10, dtype=np.uint8) % 7)
+    with eng:
+        fh = eng.open(path, writable=True)
+        for i in range(4):
+            assert eng.submit_write(fh, i * data.nbytes,
+                                    data).wait() == data.nbytes
+        eng.close(fh)
+    assert stats.write_retries >= 1
+    assert stats.requests_failed >= 1
+    with open(path, "rb") as f:
+        back = np.frombuffer(f.read(), np.uint8).reshape(4, -1)
+    assert np.array_equal(back, np.tile(data, (4, 1)))
+
+
+def test_c_level_short_write_hook(tmp_path, monkeypatch):
+    monkeypatch.setenv("STROM_FAULT_WRITE_SHORT_EVERY", "2")
+    path = _write_file(tmp_path)
+    stats = StromStats()
+    eng = ResilientEngine(StromEngine(_cfg(), stats=stats),
+                          _rcfg(max_retries=3))
+    data = (np.arange(32 << 10, dtype=np.uint8) % 11)
+    with eng:
+        fh = eng.open(path, writable=True)
+        for i in range(4):
+            assert eng.submit_write(fh, i * data.nbytes,
+                                    data).wait() == data.nbytes
+        eng.close(fh)
+    assert stats.write_retries >= 1
+
+
+def test_checkpoint_save_survives_write_faults(tmp_path):
+    """A save through a chaos-wrapped resilient engine commits a fully
+    restorable checkpoint — the write half of the recovery story on the
+    real consumer."""
+    from nvme_strom_tpu.checkpoint import CheckpointManager
+    stats = StromStats()
+    plan = FaultPlan.parse("weio:every=3:max_count=2, "
+                           "wshort:every=4:max_count=1:frac=0.5")
+    eng = ResilientEngine(
+        FaultyEngine(StromEngine(_cfg(), stats=stats), plan),
+        _rcfg(max_retries=3))
+    mgr = CheckpointManager(tmp_path / "ckpt", engine=eng)
+    state = {"w": np.arange(64, dtype=np.float32).reshape(8, 8),
+             "step": 7}
+    mgr.save(7, state)
+    assert stats.write_retries >= 1, "no write fault was recovered"
+    got = mgr.restore({"w": np.zeros((8, 8), np.float32), "step": 0})
+    np.testing.assert_array_equal(got["w"], state["w"])
+    assert got["step"] == 7
+    eng.close_all()
+
+
+def test_kv_offload_write_faults_recovered(tmp_path):
+    """PagedKVCache eviction writes retry under the resilient mirror
+    and the streamed-back history is byte-identical."""
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.kv_offload import (OffloadConfig,
+                                                  PagedKVCache)
+    from nvme_strom_tpu.models.transformer import (TransformerConfig,
+                                                   tiny_config)
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    stats = StromStats()
+    plan = FaultPlan.parse("weio:every=2:max_count=3")
+    eng = ResilientEngine(
+        FaultyEngine(StromEngine(_cfg(), stats=stats), plan),
+        _rcfg(max_retries=3))
+    ocfg = OffloadConfig(path=str(tmp_path / "kv.bin"), page_len=4,
+                         window_pages=2)
+    rng = np.random.default_rng(3)
+    L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    S, b = 19, 1
+    k = rng.standard_normal((L, b, nkv, S, hd)).astype(np.float32)
+    v = rng.standard_normal((L, b, nkv, S, hd)).astype(np.float32)
+    with PagedKVCache(cfg, ocfg, eng, batch=b) as cache:
+        cache.append(jnp.asarray(k), jnp.asarray(v))
+        cache.flush()
+        assert cache.n_cold >= 2
+        q = jnp.asarray(rng.standard_normal(
+            (b, cfg.n_heads, 1, hd)).astype(np.float32))
+        out = cache.attend(0, q)       # streams pages back through reads
+        assert np.isfinite(np.asarray(out)).all()
+    assert stats.write_retries >= 1
+    eng.close_all()
+
+
+# -- end-to-end integrity (STROM_VERIFY; the silent-corruption hole) --------
+
+
+def _verify_env(monkeypatch, mode):
+    monkeypatch.setenv("STROM_VERIFY", mode)
+
+
+def test_restore_bit_flipped_tile_falls_back(tmp_path, monkeypatch):
+    """Satellite #2: a bit-flip FaultPlan on the checkpoint read path is
+    DETECTED by STROM_VERIFY=full (no length/status signal exists), the
+    damaged step is skipped, and checksum_failures counts the catch."""
+    from nvme_strom_tpu.checkpoint import CheckpointManager
+    _verify_env(monkeypatch, "full")
+    stats = StromStats()
+    # persistent corruption: EVERY read of step 2's tile file is flipped
+    # (the retry-once re-read included), so verification must fall back
+    plan = FaultPlan.parse("bitflip:path=step_00000002")
+    eng = FaultyEngine(StromEngine(_cfg(), stats=stats), plan)
+    mgr = CheckpointManager(tmp_path / "ckpt", engine=eng)
+    mgr.save(1, _ckpt_state(1.0))
+    mgr.save(2, _ckpt_state(2.0))
+
+    got = mgr.restore(_ckpt_state(0.0))
+    np.testing.assert_array_equal(got["w"], _ckpt_state(1.0)["w"])
+    assert mgr.last_restore_step == 1
+    assert stats.checksum_failures >= 2      # first pass + re-read
+    assert stats.restore_fallbacks == 1
+    assert stats.bytes_verified > 0
+    eng.close_all()
+
+
+def test_restore_transient_bitflip_heals_on_reread(tmp_path,
+                                                   monkeypatch):
+    """One in-flight flip (max_count=1): the verify failure re-reads
+    once, the re-read is clean, and the ORIGINAL step restores — no
+    fallback, corruption counted but never consumed."""
+    from nvme_strom_tpu.checkpoint import CheckpointManager
+    _verify_env(monkeypatch, "full")
+    stats = StromStats()
+    plan = FaultPlan.parse("bitflip:path=step_00000002:max_count=1")
+    eng = FaultyEngine(StromEngine(_cfg(), stats=stats), plan)
+    mgr = CheckpointManager(tmp_path / "ckpt", engine=eng)
+    mgr.save(1, _ckpt_state(1.0))
+    mgr.save(2, _ckpt_state(2.0))
+    got = mgr.restore(_ckpt_state(0.0))
+    np.testing.assert_array_equal(got["w"], _ckpt_state(2.0)["w"])
+    assert mgr.last_restore_step == 2
+    assert stats.checksum_failures == 1
+    assert stats.restore_fallbacks == 0
+    eng.close_all()
+
+
+def _write_stamped_shards(tmp_path, n_shards=2, per_shard=16, item=64):
+    from nvme_strom_tpu.formats.wds import write_wds_shard
+    paths = []
+    for s in range(n_shards):
+        samples = [{"bin": np.full(item, s * 100 + i,
+                                   dtype=np.uint8).tobytes()}
+                   for i in range(per_shard)]
+        p = tmp_path / f"shard-{s:05d}.tar"
+        write_wds_shard(p, samples, checksums=True)
+        paths.append(str(p))
+    return paths
+
+
+def test_loader_transient_bitflip_healed_by_reread(tmp_path,
+                                                   monkeypatch):
+    """An in-flight flip on a sample part is caught by the sidecar
+    check and healed by the single re-read: every row arrives intact,
+    nothing is quarantined, and the catch is counted."""
+    from nvme_strom_tpu.data import ShardedLoader
+    _verify_env(monkeypatch, "full")
+    paths = _write_stamped_shards(tmp_path)
+    stats = StromStats()
+    plan = FaultPlan.parse("bitflip:path=shard-00000:max_count=1")
+    eng = FaultyEngine(StromEngine(_cfg(), stats=stats), plan)
+    with ShardedLoader(paths, _mesh1(), global_batch=8, fmt="wds",
+                       engine=eng,
+                       config=LoaderConfig(batch_size=8,
+                                           shard_error_budget=1)) as dl:
+        rows = [bytes(r.tobytes()) for b in dl for r in np.asarray(b)]
+        assert dl.quarantined == []
+    eng.close_all()
+    assert len(rows) == 32                   # BOTH shards intact
+    assert all(len(set(r)) == 1 for r in rows), "corrupt row escaped"
+    assert stats.checksum_failures == 1
+    assert stats.shards_quarantined == 0
+    assert stats.bytes_verified > 0
+
+
+def test_loader_persistent_corruption_quarantined(tmp_path,
+                                                  monkeypatch):
+    """On-disk damage (re-read returns the same bad bytes) exhausts the
+    retry-once and the shard takes the quarantine path — zero corrupt
+    rows escape, without any checking decode()."""
+    from nvme_strom_tpu.data import ShardedLoader
+    _verify_env(monkeypatch, "full")
+    paths = _write_stamped_shards(tmp_path)
+    # flip one payload byte of shard 0 on disk (header block is 512B;
+    # first member payload starts at 512)
+    with open(paths[0], "r+b") as f:
+        f.seek(520)
+        b = f.read(1)
+        f.seek(520)
+        f.write(bytes([b[0] ^ 0x20]))
+    stats = StromStats()
+    eng = FaultyEngine(StromEngine(_cfg(), stats=stats), FaultPlan([]))
+    with ShardedLoader(paths, _mesh1(), global_batch=8, fmt="wds",
+                       engine=eng,
+                       config=LoaderConfig(batch_size=8,
+                                           shard_error_budget=1)) as dl:
+        rows = [bytes(r.tobytes()) for b in dl for r in np.asarray(b)]
+        assert dl.quarantined == [paths[0]]
+    eng.close_all()
+    assert len(rows) == 16
+    assert all(r[0] >= 100 for r in rows)    # only shard 1 rows
+    assert stats.checksum_failures >= 2
+    assert stats.shards_quarantined == 1
+
+
+def test_loader_verify_off_is_zero_cost(tmp_path, monkeypatch):
+    """STROM_VERIFY=off (the default): stamped shards load with ZERO
+    verified bytes — the gate adds nothing to the hot path."""
+    from nvme_strom_tpu.data import ShardedLoader
+    monkeypatch.delenv("STROM_VERIFY", raising=False)
+    paths = _write_stamped_shards(tmp_path)
+    stats = StromStats()
+    eng = FaultyEngine(StromEngine(_cfg(), stats=stats), FaultPlan([]))
+    with ShardedLoader(paths, _mesh1(), global_batch=8, fmt="wds",
+                       engine=eng) as dl:
+        rows = [r for b in dl for r in np.asarray(b)]
+    eng.close_all()
+    assert len(rows) == 32
+    assert stats.bytes_verified == 0
+    assert stats.checksum_failures == 0
+
+
+def test_weights_bit_flip_detected(tmp_path, monkeypatch):
+    """A flipped byte in a stamped safetensors weight file fails the
+    streaming load loudly under STROM_VERIFY=full — corrupt weights
+    never reach the model."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P, Mesh
+    from nvme_strom_tpu.formats.safetensors import write_safetensors
+    from nvme_strom_tpu.parallel.weights import LazyCheckpoint
+    from nvme_strom_tpu.utils.checksum import ChecksumError
+    _verify_env(monkeypatch, "full")
+    path = tmp_path / "model.safetensors"
+    w = np.random.default_rng(0).standard_normal(
+        (32, 16)).astype(np.float32)
+    write_safetensors(path, {"w": w})
+    # clean load first: verification passes
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("dp",))
+    sh = NamedSharding(mesh, P())
+    stats = StromStats()
+    eng = StromEngine(_cfg(), stats=stats)
+    out = LazyCheckpoint(str(path)).load_sharded({"w": sh}, engine=eng)
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    assert stats.bytes_verified >= w.nbytes
+    # flip one payload byte on disk: the SAME load now raises
+    size = (tmp_path / "model.safetensors").stat().st_size
+    with open(path, "r+b") as f:
+        f.seek(size - 7)
+        b = f.read(1)
+        f.seek(size - 7)
+        f.write(bytes([b[0] ^ 0x01]))
+    with pytest.raises(ChecksumError, match="corrupt weights"):
+        LazyCheckpoint(str(path)).load_sharded({"w": sh}, engine=eng)
+    assert stats.checksum_failures == 1
+    eng.close_all()
+
+
+def test_kv_offload_bit_flip_detected(tmp_path, monkeypatch):
+    """A flipped byte in the KV page file fails attention loudly under
+    STROM_VERIFY=full — corrupt history never reaches the softmax."""
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.kv_offload import (OffloadConfig,
+                                                  PagedKVCache)
+    from nvme_strom_tpu.models.transformer import (TransformerConfig,
+                                                   tiny_config)
+    from nvme_strom_tpu.utils.checksum import ChecksumError
+    _verify_env(monkeypatch, "full")
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    stats = StromStats()
+    eng = StromEngine(_cfg(), stats=stats)
+    page_file = tmp_path / "kv.bin"
+    ocfg = OffloadConfig(path=str(page_file), page_len=4,
+                         window_pages=2)
+    rng = np.random.default_rng(5)
+    L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+    S, b = 19, 1
+    k = rng.standard_normal((L, b, nkv, S, hd)).astype(np.float32)
+    v = rng.standard_normal((L, b, nkv, S, hd)).astype(np.float32)
+    with PagedKVCache(cfg, ocfg, eng, batch=b) as cache:
+        cache.append(jnp.asarray(k), jnp.asarray(v))
+        cache.flush()
+        assert cache.n_cold >= 2
+        q = jnp.asarray(rng.standard_normal(
+            (b, cfg.n_heads, 1, hd)).astype(np.float32))
+        out = cache.attend(0, q)             # clean pass verifies
+        assert np.isfinite(np.asarray(out)).all()
+        assert stats.bytes_verified > 0
+        with open(page_file, "r+b") as f:    # flip a page byte on disk
+            f.seek(100)
+            c = f.read(1)
+            f.seek(100)
+            f.write(bytes([c[0] ^ 0x10]))
+        with pytest.raises(ChecksumError, match="corrupt"):
+            cache.attend(0, q)
+    assert stats.checksum_failures == 1
+    eng.close_all()
+
+
+# -- crash-at-point: torn saves recover (satellites #1 + acceptance) --------
+
+
+_CRASH_CHILD = r"""
+import os, sys
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, {repo!r})
+from nvme_strom_tpu.checkpoint import CheckpointManager
+from nvme_strom_tpu.io import StromEngine
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+eng = StromEngine(EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                               buffer_pool_bytes=16 << 20),
+                  stats=StromStats())
+mgr = CheckpointManager({ckpt!r}, engine=eng)
+state1 = {{"w": np.full((4, 4), 1.0, np.float32), "step": 1}}
+mgr.save(1, state1)
+os.environ["STROM_CRASH_POINT"] = {point!r}
+state2 = {{"w": np.full((4, 4), 2.0, np.float32), "step": 2}}
+mgr.save(2, state2)       # dies inside, at exactly the crash point
+print("CRASH POINT NEVER FIRED", file=sys.stderr)
+sys.exit(3)
+"""
+
+
+@pytest.mark.parametrize("point", ["ckpt.tiles", "ckpt.meta",
+                                   "ckpt.rename"])
+def test_crash_at_point_leaves_restorable_previous_step(tmp_path,
+                                                        point,
+                                                        monkeypatch):
+    """Acceptance: a deterministic crash anywhere before the atomic
+    rename (after tiles, after manifest, the instant before rename)
+    leaves step 1 restorable, step 2 invisible, and only the dotted
+    staging dir as debris — which the next manager start GCs."""
+    import subprocess
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ckpt = str(tmp_path / "ckpt")
+    child = _CRASH_CHILD.format(repo=repo, ckpt=ckpt, point=point)
+    r = subprocess.run([_sys.executable, "-c", child],
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 137, (
+        f"crash point {point} did not fire: rc={r.returncode} "
+        f"stderr={r.stderr[-500:]}")
+    # torn save: step 2 never published, staging debris remains
+    assert not os.path.isdir(os.path.join(ckpt, "step_00000002"))
+    debris = [n for n in os.listdir(ckpt) if n.startswith(".tmp_step_")]
+    assert debris == [".tmp_step_00000002"]
+
+    # recovery: a fresh manager GCs the debris and restores step 1
+    # (age gate zeroed — the debris is seconds old here, while the
+    # production default only collects hour-cold dirs so a concurrent
+    # process' LIVE staging dir is never swept)
+    monkeypatch.setenv("STROM_CKPT_GC_AGE_S", "0")
+    from nvme_strom_tpu.checkpoint import CheckpointManager
+    stats = StromStats()
+    eng = StromEngine(_cfg(), stats=stats)
+    mgr = CheckpointManager(ckpt, engine=eng)
+    assert mgr.tmp_gc == [os.path.join(ckpt, ".tmp_step_00000002")]
+    assert not any(n.startswith(".tmp_step_")
+                   for n in os.listdir(ckpt))
+    assert mgr.all_steps() == [1]
+    got = mgr.restore({"w": np.zeros((4, 4), np.float32), "step": 0})
+    np.testing.assert_array_equal(
+        got["w"], np.full((4, 4), 1.0, np.float32))
+    assert got["step"] == 1
+    eng.close_all()
+
+
+def test_crash_gc_opt_out(tmp_path, monkeypatch):
+    """STROM_CKPT_GC=0 preserves torn-save debris for post-mortems."""
+    from nvme_strom_tpu.checkpoint import CheckpointManager
+    ckpt = tmp_path / "ckpt"
+    debris = ckpt / ".tmp_step_00000042"
+    os.makedirs(debris)
+    monkeypatch.setenv("STROM_CKPT_GC", "0")
+    mgr = CheckpointManager(ckpt)
+    assert mgr.tmp_gc == []
+    assert debris.is_dir()
+
+
+def test_crash_gc_age_gate_spares_fresh_staging(tmp_path, monkeypatch):
+    """The startup GC only sweeps hour-cold dirs by default: a staging
+    dir another process is actively saving into has a fresh mtime and
+    must survive a concurrent manager construction (eval job opening a
+    live training dir)."""
+    from nvme_strom_tpu.checkpoint import CheckpointManager
+    ckpt = tmp_path / "ckpt"
+    live = ckpt / ".tmp_step_00000007"
+    cold = ckpt / ".tmp_step_00000003"
+    os.makedirs(live)
+    os.makedirs(cold)
+    hour_ago = time.time() - 7200
+    os.utime(cold, (hour_ago, hour_ago))
+    monkeypatch.delenv("STROM_CKPT_GC_AGE_S", raising=False)
+    mgr = CheckpointManager(ckpt)
+    assert mgr.tmp_gc == [str(cold)]
+    assert live.is_dir() and not cold.exists()

@@ -1,0 +1,207 @@
+"""Offline integrity scrubber + crash-debris GC (tools/strom_scrub.py).
+
+Hardware-free (`pytest -m scrub`): checkpoints and shards live on tmp
+files, damage is byte-level on disk, and the scrubber's verdicts are
+asserted through both the CLI exit codes and the JSON report.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io import StromEngine
+from nvme_strom_tpu.tools import strom_scrub
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+pytestmark = pytest.mark.scrub
+
+
+def _cfg():
+    return EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                        buffer_pool_bytes=16 << 20)
+
+
+def _make_ckpt(tmp_path, steps=(1, 2)):
+    from nvme_strom_tpu.checkpoint import CheckpointManager
+    eng = StromEngine(_cfg(), stats=StromStats())
+    mgr = CheckpointManager(tmp_path / "ckpt", engine=eng)
+    for s in steps:
+        mgr.save(s, {"w": np.full((8, 8), float(s), np.float32),
+                     "step": s})
+    eng.close_all()
+    return str(tmp_path / "ckpt"), mgr
+
+
+def test_scrub_clean_checkpoint_exits_zero(tmp_path, capsys):
+    ckpt, _ = _make_ckpt(tmp_path)
+    rc = strom_scrub.main([ckpt, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["files_scanned"] == 2
+    assert report["damage"] == []
+    assert report["bytes_verified"] > 0
+
+
+def test_scrub_reports_flipped_tile(tmp_path, capsys):
+    ckpt, mgr = _make_ckpt(tmp_path)
+    tile = os.path.join(mgr.step_dir(2), "state-00000.safetensors")
+    size = os.path.getsize(tile)
+    with open(tile, "r+b") as f:          # flip a payload byte
+        f.seek(size - 9)
+        b = f.read(1)
+        f.seek(size - 9)
+        f.write(bytes([b[0] ^ 0x08]))
+    rc = strom_scrub.main([ckpt, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert len(report["damage"]) == 1
+    assert report["damage"][0]["file"] == tile
+    assert "crc32c" in report["damage"][0]["error"]
+    assert report["checksum_failures"] >= 1
+    # step 1's file stays clean: damage is localized, not dir-wide
+    assert all(d["file"] == tile for d in report["damage"])
+
+
+def test_scrub_gc_removes_crashed_save_debris(tmp_path, capsys):
+    import time as _time
+    ckpt, _ = _make_ckpt(tmp_path, steps=(1,))
+    debris = os.path.join(ckpt, ".tmp_step_00000002")
+    os.makedirs(debris)
+    torn = os.path.join(debris, "state-00000.safetensors")
+    with open(torn, "wb") as f:
+        f.write(b"torn")
+    # without --gc: reported, preserved
+    rc = strom_scrub.main([ckpt, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["tmp_dirs"] == [debris]
+    assert report["tmp_dirs_removed"] == []
+    assert os.path.isdir(debris)
+    # --gc alone spares FRESH staging (a concurrent save looks exactly
+    # like this) …
+    rc = strom_scrub.main([ckpt, "--gc", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["tmp_dirs_live"] == [debris]
+    assert report["tmp_dirs_removed"] == []
+    assert os.path.isdir(debris)
+    # … removes it once hour-cold (and the torn tile inside is never
+    # scanned) …
+    old = _time.time() - 7200
+    os.utime(debris, (old, old))
+    os.utime(torn, (old, old))
+    rc = strom_scrub.main([ckpt, "--gc", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["tmp_dirs_removed"] == [debris]
+    assert not os.path.exists(debris)
+    # … and --force overrides the age gate for fresh debris
+    os.makedirs(debris)
+    rc = strom_scrub.main([ckpt, "--gc", "--force", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["tmp_dirs_removed"] == [debris]
+    assert not os.path.exists(debris)
+
+
+def test_scrub_stamps_and_verifies_shards(tmp_path, capsys):
+    from nvme_strom_tpu.formats.fixedrec import write_fixedrec
+    from nvme_strom_tpu.formats.wds import write_wds_shard
+    shard_dir = tmp_path / "shards"
+    os.makedirs(shard_dir)
+    rows = (np.arange(64 * 32, dtype=np.uint8).reshape(64, 32) % 199)
+    write_fixedrec(shard_dir / "data.fixedrec", rows)
+    write_wds_shard(shard_dir / "shard-0.tar",
+                    [{"bin": bytes([i]) * 128} for i in range(8)])
+
+    # unstamped: exit 0 but flagged
+    rc = strom_scrub.main([str(shard_dir), "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert len(report["unstamped"]) == 2
+
+    # --stamp writes the sidecars…
+    rc = strom_scrub.main([str(shard_dir), "--stamp", "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert sorted(os.path.basename(p) for p in report["stamped"]) == [
+        "data.fixedrec", "shard-0.tar"]
+
+    # …after which a verify pass covers every span
+    rc = strom_scrub.main([str(shard_dir), "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["files_scanned"] == 2
+    assert report["damage"] == []
+
+    # flip one record byte → exactly that span is reported
+    with open(shard_dir / "data.fixedrec", "r+b") as f:
+        f.seek(3 * 32 + 5)               # record 3
+        b = f.read(1)
+        f.seek(3 * 32 + 5)
+        f.write(bytes([b[0] ^ 0x04]))
+    rc = strom_scrub.main([str(shard_dir), "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert len(report["damage"]) == 1
+    assert report["damage"][0]["offset"] == 3 * 32
+
+
+def test_scrub_single_safetensors_file(tmp_path, capsys):
+    from nvme_strom_tpu.formats.safetensors import write_safetensors
+    path = tmp_path / "m.safetensors"
+    write_safetensors(path, {"a": np.arange(100, dtype=np.float32)})
+    assert strom_scrub.main([str(path), "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["files_scanned"] == 1 and report["damage"] == []
+
+
+def test_scrub_missing_path_exits_two(tmp_path):
+    assert strom_scrub.main([str(tmp_path / "nope")]) == 2
+
+
+def test_sidecar_lookup_semantics(tmp_path):
+    """Offset-keyed sidecar: exact (offset, length) hits; a re-laid-out
+    span (length drift) verifies nothing rather than failing falsely."""
+    from nvme_strom_tpu.utils.checksum import (Sidecar, crc32c,
+                                               load_sidecar,
+                                               write_sidecar)
+    p = tmp_path / "d.bin"
+    p.write_bytes(b"abcdefgh" * 64)
+    write_sidecar(p, [(0, 8, b"abcdefgh"), (8, 8, b"abcdefgh")])
+    sc = load_sidecar(p)
+    assert isinstance(sc, Sidecar) and len(sc) == 2
+    assert sc.lookup(0, 8) == crc32c(b"abcdefgh")
+    assert sc.lookup(0, 9) is None       # length drift → unstamped
+    assert sc.lookup(16, 8) is None      # unknown span → unstamped
+    assert load_sidecar(tmp_path / "absent.bin") is None
+
+
+def test_verify_policy_modes(monkeypatch):
+    from nvme_strom_tpu.utils.checksum import ChecksumError, VerifyPolicy
+    monkeypatch.delenv("STROM_VERIFY", raising=False)
+    assert VerifyPolicy().mode == "off"
+    assert not VerifyPolicy().want()
+    monkeypatch.setenv("STROM_VERIFY", "full")
+    p = VerifyPolicy()
+    assert all(p.want() for _ in range(10))
+    monkeypatch.setenv("STROM_VERIFY", "sample")
+    monkeypatch.setenv("STROM_VERIFY_SAMPLE", "4")
+    p = VerifyPolicy()
+    assert [p.want() for p_ in range(8)] == [False, False, False, True,
+                                             False, False, False, True]
+    monkeypatch.setenv("STROM_VERIFY", "bogus")
+    with pytest.raises(ValueError, match="STROM_VERIFY"):
+        VerifyPolicy()
+    # check() counts and raises
+    stats = StromStats()
+    pol = VerifyPolicy("full")
+    from nvme_strom_tpu.utils.checksum import crc32c
+    pol.check(b"payload", crc32c(b"payload"), stats)
+    assert stats.bytes_verified == 7 and stats.checksum_failures == 0
+    with pytest.raises(ChecksumError):
+        pol.check(b"payload", 12345, stats)
+    assert stats.checksum_failures == 1
