@@ -249,7 +249,77 @@ void observer_thread(strom_engine *eng, std::atomic<bool> *stop) {
     strom_get_pool_info(eng, &pi);
     if (pi.free_buffers > pi.n_buffers) fail("pool accounting");
     strom_get_latency(eng, rd, wr);
+    /* per-ring counters race the hot path lock-free: completed may
+     * never exceed submitted within one ring's snapshot */
+    int nr = strom_ring_count(eng);
+    for (int r = 0; r < nr; r++) {
+      strom_ring_info ri;
+      if (strom_get_ring_info(eng, (uint32_t)r, &ri) != 0) {
+        fail("ring_info rc");
+        continue;
+      }
+      if (ri.completed > ri.submitted) fail("ring completed > submitted");
+      if (ri.free_buffers > ri.n_buffers) fail("ring pool accounting");
+    }
     usleep(500);
+  }
+}
+
+/* Multi-ring mixed-class reader: models the QoS scheduler's dispatch —
+ * each thread plays one latency class pinned round-robin over a ring
+ * subset (decode -> ring 0, bulk -> the rest), batches via
+ * strom_submit_readv_ring racing scalar strom_submit_read_ring
+ * stragglers on the SAME rings from sibling threads.  Payload verified
+ * against the offset pattern: a cross-ring buffer-recycling bug shows
+ * up as a mismatch, a routing bug as -EINVAL/-ENOENT failures. */
+void ring_class_thread(strom_engine *eng, int fh, int iters, int seed,
+                       uint32_t ring_lo, uint32_t ring_hi) {
+  Rng rng(seed * 2654435761ull + 11);
+  const uint32_t span = ring_hi - ring_lo + 1;
+  for (int i = 0; i < iters; i++) {
+    uint32_t ring = ring_lo + (uint32_t)(rng.next() % span);
+    if ((i & 3) == 3) {          /* scalar straggler on the same ring */
+      uint64_t off = rng.next() % (kFileBytes - 1);
+      uint64_t len = 1 + rng.next() % (kMaxRead / 8);
+      if (off + len > kFileBytes) len = kFileBytes - off;
+      int64_t id = strom_submit_read_ring(eng, ring, fh, off, len);
+      if (id < 0) { fail("submit_read_ring"); continue; }
+      strom_completion c;
+      if (strom_wait(eng, id, &c) != 0 || c.status != 0)
+        fail("ring read status");
+      else
+        for (uint64_t k = 0; k < c.len; k += 997)
+          if (c.data[k] != pat(off + k)) { fail("ring payload"); break; }
+      strom_release(eng, id);
+      continue;
+    }
+    const uint32_t n = 1 + (uint32_t)(rng.next() % 6);
+    strom_rd_ext exts[6];
+    for (uint32_t j = 0; j < n; j++) {
+      uint64_t off = rng.next() % (kFileBytes - 1);
+      uint64_t len = 1 + rng.next() % (kMaxRead / 4);
+      if (off + len > kFileBytes) len = kFileBytes - off;
+      exts[j] = strom_rd_ext{fh, 0, off, len};
+    }
+    int64_t ids[6];
+    if (strom_submit_readv_ring(eng, ring, exts, n, ids) != 0) {
+      fail("submit_readv_ring");
+      continue;
+    }
+    for (uint32_t j = 0; j < n; j++) {
+      strom_completion c;
+      if (strom_wait(eng, ids[j], &c) != 0 || c.status != 0)
+        fail("ring readv status");
+      else {
+        if (c.len != exts[j].length) fail("ring readv short");
+        for (uint64_t k = 0; k < c.len; k += 997)
+          if (c.data[k] != pat(exts[j].offset + k)) {
+            fail("ring readv payload");
+            break;
+          }
+      }
+      strom_release(eng, ids[j]);
+    }
   }
 }
 
@@ -312,6 +382,60 @@ int main(int argc, char **argv) {
     fprintf(stderr,
             "stress[%s]: submitted=%llu completed=%llu failed=%llu "
             "errors=%llu\n",
+            use_uring ? "io_uring" : "threadpool",
+            (unsigned long long)st.requests_submitted,
+            (unsigned long long)st.requests_completed,
+            (unsigned long long)st.requests_failed,
+            (unsigned long long)g_errors.load());
+    if (st.requests_failed != 0) fail("requests_failed != 0");
+    strom_close(eng, fh);
+    strom_engine_destroy(eng);
+  }
+
+  /* Multi-ring phase: 4 rings, mixed-class reader threads pinned the
+   * way the QoS scheduler pins them (one decode-class thread owning
+   * ring 0, bulk threads spread over rings 1-3), racing the writer and
+   * churn paths that route round-robin across ALL rings — the
+   * cross-ring file-table and pool-slice interactions TSAN must bless. */
+  for (int use_uring = 1; use_uring >= 0; use_uring--) {
+    strom_engine *eng = strom_engine_create_rings(
+        4, 4, 4, kMaxRead + 8192, 4096, use_uring, 1);
+    if (!eng) { perror("engine_create_rings"); return 2; }
+    if (strom_ring_count(eng) != 4) fail("ring_count");
+    /* ring routing validation is loud, not silent */
+    if (strom_submit_read_ring(eng, 9, 1, 0, 4096) != -EINVAL)
+      fail("bad ring index not rejected");
+    int fh = strom_open(eng, path.c_str(), 0);
+    if (fh < 0) { fprintf(stderr, "open failed\n"); return 2; }
+
+    std::atomic<bool> stop{false};
+    std::vector<std::thread> ts;
+    ts.emplace_back(ring_class_thread, eng, fh, iters, 101, 0u, 0u);
+    for (int r = 0; r < n_readers; r++)
+      ts.emplace_back(ring_class_thread, eng, fh, iters, 200 + r, 1u, 3u);
+    ts.emplace_back(writer_thread, eng, dir, iters / 2 + 1);
+    ts.emplace_back(mixed_rw_thread, eng, fh, dir, iters / 2 + 1, 9);
+    ts.emplace_back(churn_thread, eng, path, iters / 2 + 1);
+    std::thread obs(observer_thread, eng, &stop);
+    for (auto &t : ts) t.join();
+    stop.store(true, std::memory_order_release);
+    obs.join();
+
+    strom_stats_blk st;
+    strom_get_stats(eng, &st);
+    uint64_t ring_sub = 0, ring_comp = 0;
+    for (int r = 0; r < 4; r++) {
+      strom_ring_info ri;
+      strom_get_ring_info(eng, (uint32_t)r, &ri);
+      ring_sub += ri.submitted;
+      ring_comp += ri.completed;
+      if (ri.inflight_io != 0) fail("ring inflight after drain");
+    }
+    if (ring_sub != st.requests_submitted) fail("ring submit accounting");
+    if (ring_comp != st.requests_completed) fail("ring comp accounting");
+    fprintf(stderr,
+            "stress[rings=4,%s]: submitted=%llu completed=%llu "
+            "failed=%llu errors=%llu\n",
             use_uring ? "io_uring" : "threadpool",
             (unsigned long long)st.requests_submitted,
             (unsigned long long)st.requests_completed,

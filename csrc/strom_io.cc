@@ -31,6 +31,7 @@
 #include <condition_variable>
 #include <cstring>
 #include <deque>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <unordered_map>
@@ -272,6 +273,8 @@ struct Uring {
 
 /* ---------------------------- engine ---------------------------- */
 
+struct RingCtx;
+
 namespace {
 
 inline uint64_t align_down(uint64_t x, uint64_t a) { return x & ~(a - 1); }
@@ -321,6 +324,7 @@ static bool span_resident(int fd, uint64_t offset, uint64_t len) {
 
 struct Req {
   int64_t id = 0;
+  RingCtx *rc = nullptr;               /* owning ring                 */
   int fh = -1;
   uint64_t offset = 0, len = 0;        /* caller's request            */
   uint64_t a_off = 0, a_len = 0;       /* aligned span actually read  */
@@ -340,32 +344,119 @@ struct Req {
 
 }  // namespace
 
-struct strom_engine {
-  uint32_t queue_depth, n_buffers, alignment;
-  uint64_t buf_bytes;     /* payload capacity */
-  uint64_t buf_cap;       /* buf_bytes + 2*alignment slack */
-  bool use_uring = false;
-  bool locked = false;
-
+/* One submission ring: an io_uring instance (or worker pool) with its
+ * own completion reaping, its own slice of the staging pool, its own
+ * deferral queue, and its own lock.  The engine shards into N of these
+ * (strom_engine_create_rings) so concurrent traffic classes never
+ * serialize behind one doorbell or one pool mutex; request ids carry
+ * the ring index in their low STROM_RING_ID_BITS bits, so wait/release
+ * route without any shared map. */
+struct RingCtx {
+  strom_engine *eng = nullptr;
+  uint32_t idx = 0;
   Uring ring;
+  bool use_uring = false;
   std::thread reaper;
   std::vector<std::thread> workers;
   std::deque<Req *> work_q;             /* thread-pool backend queue */
-  bool stopping = false;
-
-  uint8_t *pool = nullptr;
-  size_t pool_sz = 0;
-  std::vector<int> free_bufs;
-  std::deque<Req *> defer_q;            /* submitted, awaiting a buffer */
 
   std::mutex mu;
   std::condition_variable cv_done;      /* request completed       */
   std::condition_variable cv_work;      /* thread-pool work queue  */
-
   std::unordered_map<int64_t, Req *> reqs;
-  int64_t next_req = 1;
+
+  /* Lock-free per-ring counters: the QoS scheduler polls queue depth
+   * (submitted - completed) at dispatch frequency without ever taking
+   * the ring mutex. */
+  std::atomic<uint64_t> rg_sub{0}, rg_comp{0};
+
+  void complete_locked(Req *r);
+  void complete(Req *r) {
+    std::lock_guard<std::mutex> g(mu);
+    complete_locked(r);
+  }
+  void dispatch_locked(Req *r, bool flush_now = true);
+  void reaper_loop();
+  void worker_loop();
+};
+
+struct strom_engine {
+  uint32_t queue_depth, n_buffers, alignment;  /* PER RING */
+  uint32_t n_rings = 1;
+  uint64_t buf_bytes;     /* payload capacity */
+  uint64_t buf_cap;       /* buf_bytes + 2*alignment slack */
+  bool locked = false;
+  std::atomic<bool> stopping{false};
+
+  uint8_t *pool = nullptr;   /* ONE mapping, ONE fungible pool: any ring
+                                may stage into any buffer (each ring
+                                registers the whole pool as fixed
+                                buffers).  A global pool is load-bearing
+                                for deadlock freedom: consumers size
+                                their in-flight window against the WHOLE
+                                pool, and a batch pinned to one ring
+                                must never deadlock behind a per-ring
+                                slice smaller than that window. */
+  size_t pool_sz = 0;
+  std::mutex pool_mu;        /* leaf lock (may nest under a ring mutex):
+                                guards free_bufs + the GLOBAL deferral
+                                FIFO, which preserves engine-wide
+                                submission order for buffer handoff */
+  std::vector<int> free_bufs;
+  std::deque<Req *> defer_q; /* submitted, awaiting a buffer (any ring) */
+  std::vector<std::unique_ptr<RingCtx>> rings;
+  std::atomic<uint64_t> rr{0};          /* round-robin ring pick  */
+  std::atomic<int64_t> next_req{1};
+
+  std::mutex files_mu;                  /* leaf lock: may be taken while
+                                           a ring mutex is held, never
+                                           the other way around */
   std::unordered_map<int, FileEnt> files;
   int next_fh = 1;
+
+  RingCtx *pick_ring() {
+    return rings[rr.fetch_add(1, std::memory_order_relaxed)
+                 % n_rings].get();
+  }
+  RingCtx *ring_of_id(int64_t id) {
+    if (id < 0) return nullptr;
+    uint32_t ri = (uint32_t)(id & ((1 << STROM_RING_ID_BITS) - 1));
+    return ri < n_rings ? rings[ri].get() : nullptr;
+  }
+  int64_t alloc_id(RingCtx *rc) {
+    return (next_req.fetch_add(1, std::memory_order_relaxed)
+            << STROM_RING_ID_BITS) | (int64_t)rc->idx;
+  }
+  bool file_copy(int fh, FileEnt *out) {
+    std::lock_guard<std::mutex> g(files_mu);
+    auto it = files.find(fh);
+    if (it == files.end()) return false;
+    *out = it->second;
+    return true;
+  }
+
+  /* Assign a free staging buffer to r (1), park it on the global
+   * deferral FIFO (0), or refuse because the engine is stopping (-1 —
+   * the caller completes it -ECANCELED).  Never blocks.  The owning
+   * ring's mutex must be held (pool_mu nests under it).  The stopping
+   * re-check under pool_mu closes the race with destroy's cancel
+   * sweep: either the sweep (also under pool_mu) sees our parked
+   * request, or we see stopping — a request can never park AFTER the
+   * sweep and wedge the drain. */
+  int acquire_or_defer(Req *r) {
+    std::lock_guard<std::mutex> g(pool_mu);
+    if (!free_bufs.empty()) {
+      r->buf_idx = free_bufs.back();
+      free_bufs.pop_back();
+      r->buf = buf_ptr(r->buf_idx);
+      return 1;
+    }
+    if (stopping.load(std::memory_order_acquire)) return -1;
+    defer_q.push_back(r);
+    return 0;
+  }
+
+  void recycle_buffer(int buf_idx);   /* defined after RingCtx methods */
 
   std::atomic<uint64_t> st_direct{0}, st_fallback{0}, st_bounce{0},
       st_written{0}, st_sub{0}, st_comp{0}, st_fail{0}, st_retry{0},
@@ -522,221 +613,228 @@ struct strom_engine {
       st_bounce.fetch_add(put, std::memory_order_relaxed);
   }
 
-  void complete_locked(Req *r) {
-    r->state = ReqState::kDone;
-    r->t_complete = now_ns();
-    if (r->status == 0) {
-      /* Failures are counted in st_fail; bucketing their near-instant
-       * "latency" would drag the p50/p99 gauges toward zero exactly when
-       * the system is misbehaving. */
-      uint64_t lat = r->t_complete - r->t_submit;
-      int b = 63 - __builtin_clzll(lat | 1);
-      (r->is_write ? lat_write : lat_read)[b].fetch_add(
-          1, std::memory_order_relaxed);
-    }
-    /* release: pairs with the acquire load in strom_get_stats so an
-     * observer that sees this completion also sees the corresponding
-     * st_sub increment (which happens-before it via the request's
-     * submit->complete chain). */
-    st_comp.fetch_add(1, std::memory_order_release);
-    cv_done.notify_all();
-  }
-
-  void complete(Req *r) {
-    std::lock_guard<std::mutex> g(mu);
-    complete_locked(r);
-  }
-
-  /* Hand a buffer-holding request to the backend. mu must be held.
-   * Submissions never block: if the ring is jammed (practically impossible —
-   * we drain the SQ on every enter) the request fails with -EBUSY.
-   * ``flush_now = false`` defers the uring doorbell (vectored submit:
-   * the caller flushes once for the whole batch). */
-  void dispatch_locked(Req *r, bool flush_now = true) {
-    auto it = files.find(r->fh);
-    if (it == files.end()) {
-      r->status = -EBADF;
-      st_fail.fetch_add(1, std::memory_order_relaxed);
-      complete_locked(r);
-      return;
-    }
-    const FileEnt &fe = it->second;
-    if (use_uring) {
-      int rc;
-      /* A request holding a staging buffer targets registered memory:
-       * use the fixed-buffer opcode so the kernel skips per-I/O pinning. */
-      bool fixed = ring.fixed_bufs && r->buf_idx >= 0;
-      if (r->is_write) {
-        const uint8_t *s = r->buf_idx >= 0 ? r->buf : (const uint8_t *)r->wsrc;
-        rc = ring.submit(fixed ? kOpWriteFixed : kOpWrite,
-                         r->direct ? fe.fd_direct : fe.fd_buffered,
-                         r->offset, (void *)s, (uint32_t)r->len,
-                         (uint64_t)r->id,
-                         fixed ? (uint16_t)r->buf_idx : 0, flush_now);
-      } else {
-        int fd = r->direct ? fe.fd_direct : fe.fd_buffered;
-        uint64_t off = r->direct ? r->a_off : r->offset;
-        uint8_t *dst = r->direct ? r->buf : r->buf + (r->offset - r->a_off);
-        uint32_t rlen = (uint32_t)(r->direct ? r->a_len : r->len);
-        rc = ring.submit(fixed ? kOpReadFixed : kOpRead, fd, off, dst, rlen,
-                         (uint64_t)r->id,
-                         fixed ? (uint16_t)r->buf_idx : 0, flush_now);
-      }
-      if (rc != 0) {
-        r->status = rc;
-        st_fail.fetch_add(1, std::memory_order_relaxed);
-        complete_locked(r);
-      }
-      return;
-    }
-    work_q.push_back(r);
-    cv_work.notify_one();
-  }
-
-  /* A staging buffer became free (or is free at submit time): either give
-   * it to the oldest deferred request, or return it to the pool.
-   * mu must be held. */
-  void assign_or_free_locked(int buf_idx) {
-    while (!defer_q.empty()) {
-      Req *r = defer_q.front();
-      defer_q.pop_front();
-      r->buf_idx = buf_idx;
-      r->buf = buf_ptr(buf_idx);
-      if (r->is_write) {
-        /* Deferred bounce write: stage the caller bytes now. The wrapper
-         * keeps the source alive until wait(). */
-        memcpy(r->buf, r->wsrc, r->len);
-        st_bounce.fetch_add(r->len, std::memory_order_relaxed);
-      }
-      dispatch_locked(r);
-      return;
-    }
-    free_bufs.push_back(buf_idx);
-  }
-
-  void reaper_loop() {
-    bool stop = false;
-    while (!stop) {
-      ring.reap([&](uint64_t ud, int32_t res) {
-        if (ud == kShutdownUserData) { stop = true; return; }
-        Req *r;
-        FileEnt fe;
-        {
-          std::lock_guard<std::mutex> g(mu);
-          auto it = reqs.find((int64_t)ud);
-          if (it == reqs.end()) return;
-          r = it->second;
-          auto fit = files.find(r->fh);
-          if (fit == files.end()) {
-            r->status = -EBADF;
-            complete_locked(r);
-            return;
-          }
-          fe = fit->second;
-        }
-        if (r->is_write) {
-          if (res >= 0 && (uint64_t)res == r->len) {
-            r->status = 0;
-            r->done_len = r->len;
-            if (r->direct)
-              st_written.fetch_add(r->len, std::memory_order_relaxed);
-            else if (r->buf_idx < 0)
-              /* See write_sync: staged writes counted their bounce at the
-               * staging memcpy already. */
-              st_bounce.fetch_add(r->len, std::memory_order_relaxed);
-          } else {
-            st_retry.fetch_add(1, std::memory_order_relaxed);
-            write_sync(r, fe); /* rescue: finish/retry synchronously */
-          }
-          maybe_inject_write_fault(r);
-          complete(r);
-          return;
-        }
-        /* Direct reads were submitted over the aligned span (head bytes of
-         * slack precede the payload); buffered reads were submitted at the
-         * exact offset and return at most `avail`. */
-        uint64_t head = r->direct ? r->offset - r->a_off : 0;
-        uint64_t avail = r->offset < (uint64_t)fe.size
-                             ? std::min<uint64_t>(r->len, fe.size - r->offset)
-                             : 0;
-        if (res >= 0 && (uint64_t)res >= head + avail) {
-          r->status = 0;
-          r->done_len = avail;
-          if (r->direct)
-            st_direct.fetch_add(avail, std::memory_order_relaxed);
-          else {
-            r->was_fallback = true;
-            st_fallback.fetch_add(avail, std::memory_order_relaxed);
-            st_bounce.fetch_add(avail, std::memory_order_relaxed);
-            if (r->planned_resident)
-              st_resident.fetch_add(avail, std::memory_order_relaxed);
-          }
-        } else {
-          /* Short read or error (EINVAL on tmpfs etc.): rescue path.
-           * A rescued read is a RETRY, whatever the original plan —
-           * clear planned_resident so its bytes never count as a
-           * planned page-cache hit (header contract: resident is not
-           * a rescue). */
-          st_retry.fetch_add(1, std::memory_order_relaxed);
-          r->direct = false;
-          r->planned_resident = false;
-          read_sync(r, fe);
-          r->was_fallback = true;
-        }
-        maybe_inject_read_fault(r);
-        complete(r);
-      });
-    }
-  }
-
-  void worker_loop() {
-    for (;;) {
-      Req *r;
-      FileEnt fe;
-      {
-        std::unique_lock<std::mutex> lk(mu);
-        cv_work.wait(lk, [&] { return stopping || !work_q.empty(); });
-        if (stopping && work_q.empty()) return;
-        r = work_q.front();
-        work_q.pop_front();
-        auto fit = files.find(r->fh);
-        if (fit == files.end()) {
-          r->status = -EBADF;
-          complete_locked(r);
-          continue;
-        }
-        fe = fit->second;
-      }
-      if (r->is_write)
-        write_sync(r, fe);
-      else
-        read_sync(r, fe);
-      maybe_inject_read_fault(r);
-      maybe_inject_write_fault(r);
-      complete(r);
-    }
-  }
 };
+
+void RingCtx::complete_locked(Req *r) {
+  r->state = ReqState::kDone;
+  r->t_complete = now_ns();
+  if (r->status == 0) {
+    /* Failures are counted in st_fail; bucketing their near-instant
+     * "latency" would drag the p50/p99 gauges toward zero exactly when
+     * the system is misbehaving. */
+    uint64_t lat = r->t_complete - r->t_submit;
+    int b = 63 - __builtin_clzll(lat | 1);
+    (r->is_write ? eng->lat_write : eng->lat_read)[b].fetch_add(
+        1, std::memory_order_relaxed);
+  }
+  /* release: pairs with the acquire load in strom_get_stats so an
+   * observer that sees this completion also sees the corresponding
+   * st_sub increment (which happens-before it via the request's
+   * submit->complete chain). */
+  eng->st_comp.fetch_add(1, std::memory_order_release);
+  rg_comp.fetch_add(1, std::memory_order_release);
+  cv_done.notify_all();
+}
+
+/* Hand a buffer-holding request to the backend. The ring mutex must be
+ * held (files_mu is a leaf lock and may be taken under it).
+ * Submissions never block: if the ring is jammed (practically impossible —
+ * we drain the SQ on every enter) the request fails with -EBUSY.
+ * ``flush_now = false`` defers the uring doorbell (vectored submit:
+ * the caller flushes once for the whole batch). */
+void RingCtx::dispatch_locked(Req *r, bool flush_now) {
+  FileEnt fe;
+  if (!eng->file_copy(r->fh, &fe)) {
+    r->status = -EBADF;
+    eng->st_fail.fetch_add(1, std::memory_order_relaxed);
+    complete_locked(r);
+    return;
+  }
+  if (use_uring) {
+    int rc;
+    /* A request holding a staging buffer targets registered memory:
+     * use the fixed-buffer opcode so the kernel skips per-I/O pinning.
+     * Every ring registered the WHOLE pool, so buf_index is global. */
+    bool fixed = ring.fixed_bufs && r->buf_idx >= 0;
+    uint16_t bidx = fixed ? (uint16_t)r->buf_idx : 0;
+    if (r->is_write) {
+      const uint8_t *s = r->buf_idx >= 0 ? r->buf : (const uint8_t *)r->wsrc;
+      rc = ring.submit(fixed ? kOpWriteFixed : kOpWrite,
+                       r->direct ? fe.fd_direct : fe.fd_buffered,
+                       r->offset, (void *)s, (uint32_t)r->len,
+                       (uint64_t)r->id, bidx, flush_now);
+    } else {
+      int fd = r->direct ? fe.fd_direct : fe.fd_buffered;
+      uint64_t off = r->direct ? r->a_off : r->offset;
+      uint8_t *dst = r->direct ? r->buf : r->buf + (r->offset - r->a_off);
+      uint32_t rlen = (uint32_t)(r->direct ? r->a_len : r->len);
+      rc = ring.submit(fixed ? kOpReadFixed : kOpRead, fd, off, dst, rlen,
+                       (uint64_t)r->id, bidx, flush_now);
+    }
+    if (rc != 0) {
+      r->status = rc;
+      eng->st_fail.fetch_add(1, std::memory_order_relaxed);
+      complete_locked(r);
+    }
+    return;
+  }
+  work_q.push_back(r);
+  cv_work.notify_one();
+}
+
+/* A staging buffer became free: hand it to the OLDEST deferred request
+ * engine-wide (whatever its ring — this cross-ring handoff is the
+ * deadlock-freedom guarantee a batch pinned to one ring relies on), or
+ * return it to the global pool.  Called with NO locks held. */
+void strom_engine::recycle_buffer(int buf_idx) {
+  Req *next = nullptr;
+  {
+    std::lock_guard<std::mutex> g(pool_mu);
+    if (defer_q.empty()) {
+      free_bufs.push_back(buf_idx);
+      return;
+    }
+    next = defer_q.front();
+    defer_q.pop_front();
+    next->buf_idx = buf_idx;
+    next->buf = buf_ptr(buf_idx);
+  }
+  RingCtx *rc = next->rc;
+  std::lock_guard<std::mutex> g(rc->mu);
+  if (next->is_write) {
+    /* Deferred bounce write: stage the caller bytes now. The wrapper
+     * keeps the source alive until wait(). */
+    memcpy(next->buf, next->wsrc, next->len);
+    st_bounce.fetch_add(next->len, std::memory_order_relaxed);
+  }
+  rc->dispatch_locked(next);
+}
+
+void RingCtx::reaper_loop() {
+  bool stop = false;
+  while (!stop) {
+    ring.reap([&](uint64_t ud, int32_t res) {
+      if (ud == kShutdownUserData) { stop = true; return; }
+      Req *r;
+      {
+        std::lock_guard<std::mutex> g(mu);
+        auto it = reqs.find((int64_t)ud);
+        if (it == reqs.end()) return;
+        r = it->second;
+      }
+      FileEnt fe;
+      if (!eng->file_copy(r->fh, &fe)) {
+        r->status = -EBADF;
+        complete(r);
+        return;
+      }
+      if (r->is_write) {
+        if (res >= 0 && (uint64_t)res == r->len) {
+          r->status = 0;
+          r->done_len = r->len;
+          if (r->direct)
+            eng->st_written.fetch_add(r->len, std::memory_order_relaxed);
+          else if (r->buf_idx < 0)
+            /* See write_sync: staged writes counted their bounce at the
+             * staging memcpy already. */
+            eng->st_bounce.fetch_add(r->len, std::memory_order_relaxed);
+        } else {
+          eng->st_retry.fetch_add(1, std::memory_order_relaxed);
+          eng->write_sync(r, fe); /* rescue: finish/retry synchronously */
+        }
+        eng->maybe_inject_write_fault(r);
+        complete(r);
+        return;
+      }
+      /* Direct reads were submitted over the aligned span (head bytes of
+       * slack precede the payload); buffered reads were submitted at the
+       * exact offset and return at most `avail`. */
+      uint64_t head = r->direct ? r->offset - r->a_off : 0;
+      uint64_t avail = r->offset < (uint64_t)fe.size
+                           ? std::min<uint64_t>(r->len, fe.size - r->offset)
+                           : 0;
+      if (res >= 0 && (uint64_t)res >= head + avail) {
+        r->status = 0;
+        r->done_len = avail;
+        if (r->direct)
+          eng->st_direct.fetch_add(avail, std::memory_order_relaxed);
+        else {
+          r->was_fallback = true;
+          eng->st_fallback.fetch_add(avail, std::memory_order_relaxed);
+          eng->st_bounce.fetch_add(avail, std::memory_order_relaxed);
+          if (r->planned_resident)
+            eng->st_resident.fetch_add(avail, std::memory_order_relaxed);
+        }
+      } else {
+        /* Short read or error (EINVAL on tmpfs etc.): rescue path.
+         * A rescued read is a RETRY, whatever the original plan —
+         * clear planned_resident so its bytes never count as a
+         * planned page-cache hit (header contract: resident is not
+         * a rescue). */
+        eng->st_retry.fetch_add(1, std::memory_order_relaxed);
+        r->direct = false;
+        r->planned_resident = false;
+        eng->read_sync(r, fe);
+        r->was_fallback = true;
+      }
+      eng->maybe_inject_read_fault(r);
+      complete(r);
+    });
+  }
+}
+
+void RingCtx::worker_loop() {
+  for (;;) {
+    Req *r;
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      cv_work.wait(lk, [&] {
+        return eng->stopping.load(std::memory_order_acquire) ||
+               !work_q.empty();
+      });
+      if (work_q.empty()) return;  /* stopping, queue drained */
+      r = work_q.front();
+      work_q.pop_front();
+    }
+    FileEnt fe;
+    if (!eng->file_copy(r->fh, &fe)) {
+      r->status = -EBADF;
+      complete(r);
+      continue;
+    }
+    if (r->is_write)
+      eng->write_sync(r, fe);
+    else
+      eng->read_sync(r, fe);
+    eng->maybe_inject_read_fault(r);
+    eng->maybe_inject_write_fault(r);
+    complete(r);
+  }
+}
 
 /* ------------------------- public C ABI ------------------------- */
 
 extern "C" {
 
-strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
-                                  uint64_t buf_bytes, uint32_t alignment,
-                                  int use_io_uring, int lock_buffers) {
-  if (!queue_depth || !n_buffers || !buf_bytes || !alignment ||
-      (alignment & (alignment - 1))) {
+strom_engine *strom_engine_create_rings(uint32_t n_rings,
+                                        uint32_t queue_depth,
+                                        uint32_t n_buffers,
+                                        uint64_t buf_bytes,
+                                        uint32_t alignment,
+                                        int use_io_uring, int lock_buffers) {
+  if (!n_rings || n_rings > STROM_MAX_RINGS || !queue_depth || !n_buffers ||
+      !buf_bytes || !alignment || (alignment & (alignment - 1))) {
     errno = EINVAL;
     return nullptr;
   }
   auto *e = new strom_engine();
+  e->n_rings = n_rings;
   e->queue_depth = queue_depth;
   e->n_buffers = n_buffers;
   e->alignment = alignment;
   e->buf_bytes = buf_bytes;
   e->buf_cap = align_up(buf_bytes, alignment) + 2 * (uint64_t)alignment;
-  e->pool_sz = (size_t)e->buf_cap * n_buffers;
+  e->pool_sz = (size_t)e->buf_cap * n_buffers * n_rings;
   e->pool = (uint8_t *)mmap(nullptr, e->pool_sz, PROT_READ | PROT_WRITE,
                             MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
   if (e->pool == MAP_FAILED) { delete e; return nullptr; }
@@ -760,56 +858,125 @@ strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
     e->wfault_short_every = env_u64("STROM_FAULT_WRITE_SHORT_EVERY");
     e->wfault_delay_ns = env_u64("STROM_FAULT_WRITE_DELAY_MS") * 1000000ull;
   }
-  for (int i = (int)n_buffers - 1; i >= 0; i--) e->free_bufs.push_back(i);
-
-  if (use_io_uring && e->ring.init(queue_depth * 2)) {
-    e->use_uring = true;
-    e->ring.try_register(e->pool, e->buf_cap, n_buffers);
-    e->reaper = std::thread([e] { e->reaper_loop(); });
-  } else {
-    uint32_t nw = queue_depth < 32 ? queue_depth : 32;
-    for (uint32_t i = 0; i < nw; i++)
-      e->workers.emplace_back([e] { e->worker_loop(); });
+  for (int i = (int)(n_buffers * n_rings) - 1; i >= 0; i--)
+    e->free_bufs.push_back(i);
+  for (uint32_t ri = 0; ri < n_rings; ri++) {
+    auto rcp = std::unique_ptr<RingCtx>(new RingCtx());
+    RingCtx *rc = rcp.get();
+    rc->eng = e;
+    rc->idx = ri;
+    if (use_io_uring && rc->ring.init(queue_depth * 2)) {
+      rc->use_uring = true;
+      /* Each ring registers the WHOLE pool with its uring fd: buffers
+       * are fungible across rings (deadlock freedom — see pool_mu). */
+      rc->ring.try_register(e->pool, e->buf_cap, n_buffers * n_rings);
+      rc->reaper = std::thread([rc] { rc->reaper_loop(); });
+    } else {
+      uint32_t nw = queue_depth < 32 ? queue_depth : 32;
+      for (uint32_t i = 0; i < nw; i++)
+        rc->workers.emplace_back([rc] { rc->worker_loop(); });
+    }
+    e->rings.push_back(std::move(rcp));
   }
   return e;
 }
 
+strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
+                                  uint64_t buf_bytes, uint32_t alignment,
+                                  int use_io_uring, int lock_buffers) {
+  return strom_engine_create_rings(1, queue_depth, n_buffers, buf_bytes,
+                                   alignment, use_io_uring, lock_buffers);
+}
+
 void strom_engine_destroy(strom_engine *e) {
   if (!e) return;
+  e->stopping.store(true, std::memory_order_release);
   {
-    std::unique_lock<std::mutex> lk(e->mu);
-    e->stopping = true;
-    for (Req *r : e->defer_q) {
-      r->status = -ECANCELED;
-      e->complete_locked(r);
+    /* Cancel the global deferral FIFO first: a deferred request's ring
+     * drain below would otherwise wait forever for a buffer that no
+     * releaser will recycle once callers stop. */
+    std::deque<Req *> cancelled;
+    {
+      std::lock_guard<std::mutex> g(e->pool_mu);
+      cancelled.swap(e->defer_q);
     }
-    e->defer_q.clear();
-    e->cv_work.notify_all();
+    for (Req *r : cancelled) {
+      RingCtx *rc = r->rc;
+      std::lock_guard<std::mutex> g(rc->mu);
+      r->status = -ECANCELED;
+      rc->complete_locked(r);
+    }
+  }
+  for (auto &rcp : e->rings) {
+    RingCtx *rc = rcp.get();
+    std::unique_lock<std::mutex> lk(rc->mu);
+    rc->cv_work.notify_all();
     /* Drain: every in-flight request's DMA targets the staging pool — the
      * pool cannot be unmapped until the kernel is done with it. */
-    e->cv_done.wait(lk, [&] {
-      for (auto &kv : e->reqs)
+    rc->cv_done.wait(lk, [&] {
+      for (auto &kv : rc->reqs)
         if (kv.second->state != ReqState::kDone) return false;
       return true;
     });
   }
-  if (e->use_uring) {
-    {
-      std::lock_guard<std::mutex> g(e->mu);
-      e->ring.submit(kOpNop, -1, 0, nullptr, 0, kShutdownUserData);
+  for (auto &rcp : e->rings) {
+    RingCtx *rc = rcp.get();
+    if (rc->use_uring) {
+      {
+        std::lock_guard<std::mutex> g(rc->mu);
+        rc->ring.submit(kOpNop, -1, 0, nullptr, 0, kShutdownUserData);
+      }
+      if (rc->reaper.joinable()) rc->reaper.join();
+      rc->ring.teardown();
     }
-    if (e->reaper.joinable()) e->reaper.join();
-    e->ring.teardown();
+    for (auto &w : rc->workers)
+      if (w.joinable()) w.join();
   }
-  for (auto &w : e->workers)
-    if (w.joinable()) w.join();
   for (auto &kv : e->files) {
     if (kv.second.fd_direct >= 0) close(kv.second.fd_direct);
     if (kv.second.fd_buffered >= 0) close(kv.second.fd_buffered);
   }
-  for (auto &kv : e->reqs) delete kv.second;
+  for (auto &rcp : e->rings)
+    for (auto &kv : rcp->reqs) delete kv.second;
   if (e->pool) munmap(e->pool, e->pool_sz);
   delete e;
+}
+
+int strom_ring_count(strom_engine *e) { return (int)e->n_rings; }
+
+int64_t strom_ring_inflight(strom_engine *e, uint32_t ring) {
+  if (ring >= e->n_rings) return -EINVAL;
+  RingCtx *rc = e->rings[ring].get();
+  /* completed first: see strom_get_ring_info */
+  uint64_t comp = rc->rg_comp.load(std::memory_order_acquire);
+  uint64_t sub = rc->rg_sub.load(std::memory_order_relaxed);
+  return sub > comp ? (int64_t)(sub - comp) : 0;
+}
+
+int strom_get_ring_info(strom_engine *e, uint32_t ring,
+                        strom_ring_info *out) {
+  if (ring >= e->n_rings) return -EINVAL;
+  RingCtx *rc = e->rings[ring].get();
+  /* completed BEFORE submitted: any completion implies visibility of its
+   * own submission, so the snapshot's depth (sub - comp) is never
+   * negative. */
+  uint64_t comp = rc->rg_comp.load(std::memory_order_acquire);
+  uint64_t sub = rc->rg_sub.load(std::memory_order_relaxed);
+  out->ring_id = ring;
+  out->n_buffers = e->n_buffers * e->n_rings;  /* pool is global */
+  out->submitted = sub;
+  out->completed = comp;
+  out->inflight_io = (uint32_t)(sub > comp ? sub - comp : 0);
+  out->backend_uring = rc->use_uring ? 1 : 0;
+  {
+    std::lock_guard<std::mutex> g(e->pool_mu);
+    out->free_buffers = (uint32_t)e->free_bufs.size();
+    uint32_t d = 0;
+    for (Req *r : e->defer_q)
+      if (r->rc == rc) d++;
+    out->deferred = d;
+  }
+  return 0;
 }
 
 int strom_check_file(const char *path, strom_file_info *out) {
@@ -1042,16 +1209,30 @@ void strom_stripe_attr(uint64_t phys_off, uint64_t len, uint64_t chunk,
 }
 
 void strom_get_pool_info(strom_engine *e, strom_pool_info *out) {
-  std::lock_guard<std::mutex> g(e->mu);
-  out->n_buffers = e->n_buffers;
-  out->free_buffers = (uint32_t)e->free_bufs.size();
+  /* Global pool + per-ring request maps; per-ring occupancy is
+   * strom_get_ring_info. */
+  uint32_t freeb = 0, infl = 0, def = 0;
+  int fixed = e->rings.empty() ? 0 : 1;
+  {
+    std::lock_guard<std::mutex> g(e->pool_mu);
+    freeb = (uint32_t)e->free_bufs.size();
+    def = (uint32_t)e->defer_q.size();
+  }
+  for (auto &rcp : e->rings) {
+    RingCtx *rc = rcp.get();
+    std::lock_guard<std::mutex> g(rc->mu);
+    infl += (uint32_t)rc->reqs.size();
+    if (!rc->ring.fixed_bufs) fixed = 0;
+  }
+  out->n_buffers = e->n_buffers * e->n_rings;
+  out->free_buffers = freeb;
   out->buf_bytes = e->buf_bytes;
   out->pool_bytes = (uint64_t)e->pool_sz;
   out->locked = e->locked ? 1 : 0;
-  out->queue_depth = (int32_t)e->queue_depth;
-  out->in_flight = (uint32_t)e->reqs.size();
-  out->deferred = (uint32_t)e->defer_q.size();
-  out->fixed_bufs = e->ring.fixed_bufs ? 1 : 0;
+  out->queue_depth = (int32_t)(e->queue_depth * e->n_rings);
+  out->in_flight = infl;
+  out->deferred = def;
+  out->fixed_bufs = fixed;
   out->pad = 0;
   out->pool_base = (uint64_t)(uintptr_t)e->pool;
 }
@@ -1072,7 +1253,7 @@ int strom_open(strom_engine *e, const char *path, int flags) {
     if (fdd >= 0) close(fdd);
     return err;
   }
-  std::lock_guard<std::mutex> g(e->mu);
+  std::lock_guard<std::mutex> g(e->files_mu);
   int fh = e->next_fh++;
   FileEnt fe;
   fe.fd_direct = fdd;
@@ -1084,7 +1265,7 @@ int strom_open(strom_engine *e, const char *path, int flags) {
 }
 
 int strom_close(strom_engine *e, int fh) {
-  std::lock_guard<std::mutex> g(e->mu);
+  std::lock_guard<std::mutex> g(e->files_mu);
   auto it = e->files.find(fh);
   if (it == e->files.end()) return -EBADF;
   if (it->second.fd_direct >= 0) close(it->second.fd_direct);
@@ -1094,92 +1275,113 @@ int strom_close(strom_engine *e, int fh) {
 }
 
 int64_t strom_file_size(strom_engine *e, int fh) {
-  std::lock_guard<std::mutex> g(e->mu);
+  std::lock_guard<std::mutex> g(e->files_mu);
   auto it = e->files.find(fh);
   return it == e->files.end() ? -EBADF : it->second.size;
 }
 
 int strom_file_is_direct(strom_engine *e, int fh) {
-  std::lock_guard<std::mutex> g(e->mu);
+  std::lock_guard<std::mutex> g(e->files_mu);
   auto it = e->files.find(fh);
   return it == e->files.end() ? -EBADF : (it->second.fd_direct >= 0 ? 1 : 0);
 }
 
-int64_t strom_submit_read(strom_engine *e, int fh, uint64_t offset,
-                          uint64_t len) {
+/* Shared submit body: validate + size-refresh under files_mu (leaf
+ * lock), residency-probe with NO lock held, then stage on the chosen
+ * ring under that ring's mutex only. */
+static int64_t submit_read_on(strom_engine *e, RingCtx *rcx, int fh,
+                              uint64_t offset, uint64_t len) {
   if (len > e->buf_bytes) return -EINVAL;
+  if (e->stopping.load(std::memory_order_acquire)) return -ECANCELED;
+  bool direct = false;
+  int pfd = -1;
+  int64_t fsize = 0;
+  {
+    std::lock_guard<std::mutex> g(e->files_mu);
+    auto it = e->files.find(fh);
+    if (it == e->files.end()) return -EBADF;
+    /* Refresh size: the file may have grown since open. */
+    struct stat st;
+    if (fstat(it->second.fd_buffered, &st) == 0)
+      it->second.size = (int64_t)st.st_size;
+    fsize = it->second.size;
+    direct = it->second.fd_direct >= 0;
+    /* Residency-aware planning: if every page of the span is already in
+     * the page cache, a buffered read is a memcpy and the NVMe
+     * round-trip pure waste — CHOOSE the cache deliberately.  Counted
+     * as bytes_resident (+fallback+bounce: the host copy is real),
+     * never as a retry/rescue.  The probe's mmap/mincore syscalls run
+     * OUTSIDE any lock (on a dup so a concurrent close cannot retarget
+     * the fd) — a cold streaming submitter must not serialize behind
+     * them. */
+    if (direct && e->probe_residency && offset < (uint64_t)fsize)
+      pfd = dup(it->second.fd_buffered);
+  }
+  bool resident = false;
+  if (pfd >= 0) {
+    uint64_t avail = std::min<uint64_t>(len, (uint64_t)fsize - offset);
+    resident = span_resident(pfd, offset, avail);
+    close(pfd);
+  }
   Req *r = new Req();
-  std::unique_lock<std::mutex> lk(e->mu);
-  auto it = e->files.find(fh);
-  if (it == e->files.end()) { delete r; return -EBADF; }
-  if (e->stopping) { delete r; return -ECANCELED; }
-  /* Refresh size: the file may have grown since open. */
-  struct stat st;
-  if (fstat(it->second.fd_buffered, &st) == 0)
-    it->second.size = (int64_t)st.st_size;
   r->offset = offset;
   r->len = len;
   r->a_off = align_down(offset, e->alignment);
   r->a_len = align_up(offset + len, e->alignment) - r->a_off;
-  r->direct = it->second.fd_direct >= 0;
-  /* Residency-aware planning: if every page of the span is already in
-   * the page cache, a buffered read is a memcpy and the NVMe round-trip
-   * pure waste — CHOOSE the cache deliberately.  Counted as
-   * bytes_resident (+fallback+bounce: the host copy is real), never as
-   * a retry/rescue.  The probe's mmap/mincore syscalls run OUTSIDE the
-   * engine lock (on a dup so a concurrent close cannot retarget the fd)
-   * — a cold streaming submitter must not serialize behind them. */
-  if (r->direct && e->probe_residency && offset < (uint64_t)it->second.size) {
-    uint64_t avail =
-        std::min<uint64_t>(len, (uint64_t)it->second.size - offset);
-    int pfd = dup(it->second.fd_buffered);
-    if (pfd >= 0) {
-      lk.unlock();
-      bool resident = span_resident(pfd, offset, avail);
-      close(pfd);
-      lk.lock();
-      it = e->files.find(fh);
-      if (it == e->files.end()) { delete r; return -EBADF; }
-      if (e->stopping) { delete r; return -ECANCELED; }
-      if (resident) {
-        r->direct = false;
-        r->planned_resident = true;
-      }
-    }
-  }
-  r->id = e->next_req++;
+  r->direct = direct && !resident;
+  r->planned_resident = direct && resident;
   r->fh = fh;
+  r->rc = rcx;
+  std::lock_guard<std::mutex> g(rcx->mu);
+  if (e->stopping.load(std::memory_order_acquire)) {
+    delete r;
+    return -ECANCELED;
+  }
+  r->id = e->alloc_id(rcx);
   r->t_submit = now_ns();
-  e->reqs[r->id] = r;
+  rcx->reqs[r->id] = r;
   e->st_sub.fetch_add(1, std::memory_order_relaxed);
-  if (e->free_bufs.empty()) {
-    e->defer_q.push_back(r); /* never block the submitter */
-  } else {
-    r->buf_idx = e->free_bufs.back();
-    e->free_bufs.pop_back();
-    r->buf = e->buf_ptr(r->buf_idx);
-    e->dispatch_locked(r);
+  rcx->rg_sub.fetch_add(1, std::memory_order_relaxed);
+  int got = e->acquire_or_defer(r);  /* never blocks the submitter */
+  if (got > 0) {
+    rcx->dispatch_locked(r);
+  } else if (got < 0) {
+    r->status = -ECANCELED;          /* raced engine destroy */
+    rcx->complete_locked(r);
   }
   return r->id;
 }
 
-int strom_submit_readv(strom_engine *e, const strom_rd_ext *exts,
-                       uint32_t n, int64_t *out_ids) {
+int64_t strom_submit_read(strom_engine *e, int fh, uint64_t offset,
+                          uint64_t len) {
+  return submit_read_on(e, e->pick_ring(), fh, offset, len);
+}
+
+int64_t strom_submit_read_ring(strom_engine *e, uint32_t ring, int fh,
+                               uint64_t offset, uint64_t len) {
+  if (ring >= e->n_rings) return -EINVAL;
+  return submit_read_on(e, e->rings[ring].get(), fh, offset, len);
+}
+
+/* Shared vectored-submit body: the whole batch stages on ONE ring. */
+static int submit_readv_on(strom_engine *e, RingCtx *rcx,
+                           const strom_rd_ext *exts, uint32_t n,
+                           int64_t *out_ids) {
   if (n == 0) return 0;
   for (uint32_t i = 0; i < n; i++)
     if (exts[i].length > e->buf_bytes) return -EINVAL;
-  /* Residency probes to run with the lock DROPPED (same discipline as
-   * strom_submit_read: mmap/mincore must not serialize other
-   * submitters; dup so a concurrent close cannot retarget the fd). */
+  if (e->stopping.load(std::memory_order_acquire)) return -ECANCELED;
+  /* Residency probes run with NO lock held (same discipline as
+   * submit_read_on: mmap/mincore must not serialize other submitters;
+   * dup so a concurrent close cannot retarget the fd). */
   struct Probe { uint32_t i; int pfd; uint64_t off, avail; };
   std::vector<Probe> probes;
   std::vector<char> resident(n, 0);
   std::vector<char> direct(n, 0);
-  std::unique_lock<std::mutex> lk(e->mu);
-  if (e->stopping) return -ECANCELED;
   {
-    /* Atomic validation + one size refresh per distinct fh: on any bad
-     * extent NOTHING has been submitted. */
+    /* Atomic validation + one size refresh per distinct fh under
+     * files_mu: on any bad extent NOTHING has been submitted. */
+    std::lock_guard<std::mutex> g(e->files_mu);
     std::unordered_map<int, int64_t> sized;
     for (uint32_t i = 0; i < n; i++) {
       auto it = e->files.find(exts[i].fh);
@@ -1204,16 +1406,9 @@ int strom_submit_readv(strom_engine *e, const strom_rd_ext *exts,
       }
     }
   }
-  if (!probes.empty()) {
-    lk.unlock();
-    for (auto &p : probes) {
-      resident[p.i] = span_resident(p.pfd, p.off, p.avail) ? 1 : 0;
-      close(p.pfd);
-    }
-    lk.lock();
-    if (e->stopping) return -ECANCELED;
-    for (uint32_t i = 0; i < n; i++)
-      if (e->files.find(exts[i].fh) == e->files.end()) return -EBADF;
+  for (auto &p : probes) {
+    resident[p.i] = span_resident(p.pfd, p.off, p.avail) ? 1 : 0;
+    close(p.pfd);
   }
   /* Stage every extent — uring SQEs publish WITHOUT ringing the
    * doorbell — then pay one io_uring_enter for the whole batch.
@@ -1221,6 +1416,8 @@ int strom_submit_readv(strom_engine *e, const strom_rd_ext *exts,
    * defer on pool pressure ring their own when a buffer frees, so
    * they must not be credited as saved syscalls. */
   uint32_t inline_n = 0;
+  std::lock_guard<std::mutex> g(rcx->mu);
+  if (e->stopping.load(std::memory_order_acquire)) return -ECANCELED;
   for (uint32_t i = 0; i < n; i++) {
     const strom_rd_ext &x = exts[i];
     Req *r = new Req();
@@ -1230,27 +1427,42 @@ int strom_submit_readv(strom_engine *e, const strom_rd_ext *exts,
     r->a_len = align_up(x.offset + x.length, e->alignment) - r->a_off;
     r->direct = direct[i] && !resident[i];
     r->planned_resident = direct[i] != 0 && resident[i] != 0;
-    r->id = e->next_req++;
+    r->id = e->alloc_id(rcx);
     r->fh = x.fh;
+    r->rc = rcx;
     r->t_submit = now_ns();
-    e->reqs[r->id] = r;
+    rcx->reqs[r->id] = r;
     e->st_sub.fetch_add(1, std::memory_order_relaxed);
+    rcx->rg_sub.fetch_add(1, std::memory_order_relaxed);
     out_ids[i] = r->id;
-    if (e->free_bufs.empty()) {
-      e->defer_q.push_back(r); /* never block: dispatched on next free */
-    } else {
-      r->buf_idx = e->free_bufs.back();
-      e->free_bufs.pop_back();
-      r->buf = e->buf_ptr(r->buf_idx);
-      e->dispatch_locked(r, /*flush_now=*/false);
+    int got = e->acquire_or_defer(r);  /* never blocks: deferred
+                                          requests dispatch on the next
+                                          buffer free */
+    if (got > 0) {
+      rcx->dispatch_locked(r, /*flush_now=*/false);
       inline_n++;
+    } else if (got < 0) {
+      r->status = -ECANCELED;          /* raced engine destroy */
+      rcx->complete_locked(r);
     }
   }
   e->st_batches.fetch_add(1, std::memory_order_relaxed);
   if (inline_n > 1)
     e->st_sysc_saved.fetch_add(inline_n - 1, std::memory_order_relaxed);
-  if (e->use_uring) e->ring.flush();
+  if (rcx->use_uring) rcx->ring.flush();
   return 0;
+}
+
+int strom_submit_readv(strom_engine *e, const strom_rd_ext *exts,
+                       uint32_t n, int64_t *out_ids) {
+  return submit_readv_on(e, e->pick_ring(), exts, n, out_ids);
+}
+
+int strom_submit_readv_ring(strom_engine *e, uint32_t ring,
+                            const strom_rd_ext *exts, uint32_t n,
+                            int64_t *out_ids) {
+  if (ring >= e->n_rings) return -EINVAL;
+  return submit_readv_on(e, e->rings[ring].get(), exts, n, out_ids);
 }
 
 static int fill_completion(Req *r, strom_completion *out) {
@@ -1267,11 +1479,13 @@ static int fill_completion(Req *r, strom_completion *out) {
 }
 
 int strom_wait(strom_engine *e, int64_t req_id, strom_completion *out) {
-  std::unique_lock<std::mutex> lk(e->mu);
-  auto it = e->reqs.find(req_id);
-  if (it == e->reqs.end()) return -ENOENT;
+  RingCtx *rc = e->ring_of_id(req_id);
+  if (!rc) return -ENOENT;
+  std::unique_lock<std::mutex> lk(rc->mu);
+  auto it = rc->reqs.find(req_id);
+  if (it == rc->reqs.end()) return -ENOENT;
   Req *r = it->second;
-  e->cv_done.wait(lk, [&] { return r->state == ReqState::kDone; });
+  rc->cv_done.wait(lk, [&] { return r->state == ReqState::kDone; });
   return fill_completion(r, out);
 }
 
@@ -1281,11 +1495,13 @@ int strom_wait_timeout(strom_engine *e, int64_t req_id,
    * or wedged backend turns into -ETIMEDOUT the caller can act on
    * (diagnose, rescue, abort) instead of blocking forever.  The
    * request stays live — a timed-out wait may be retried. */
-  std::unique_lock<std::mutex> lk(e->mu);
-  auto it = e->reqs.find(req_id);
-  if (it == e->reqs.end()) return -ENOENT;
+  RingCtx *rc = e->ring_of_id(req_id);
+  if (!rc) return -ENOENT;
+  std::unique_lock<std::mutex> lk(rc->mu);
+  auto it = rc->reqs.find(req_id);
+  if (it == rc->reqs.end()) return -ENOENT;
   Req *r = it->second;
-  bool done = e->cv_done.wait_for(
+  bool done = rc->cv_done.wait_for(
       lk, std::chrono::nanoseconds(timeout_ns),
       [&] { return r->state == ReqState::kDone; });
   if (!done) return -ETIMEDOUT;
@@ -1293,59 +1509,73 @@ int strom_wait_timeout(strom_engine *e, int64_t req_id,
 }
 
 int strom_release(strom_engine *e, int64_t req_id) {
-  std::lock_guard<std::mutex> g(e->mu);
-  auto it = e->reqs.find(req_id);
-  if (it == e->reqs.end()) return -ENOENT;
-  Req *r = it->second;
-  if (r->state != ReqState::kDone) return -EBUSY;
-  if (r->buf_idx >= 0) e->assign_or_free_locked(r->buf_idx);
-  e->reqs.erase(it);
-  delete r;
+  RingCtx *rc = e->ring_of_id(req_id);
+  if (!rc) return -ENOENT;
+  int buf_idx = -1;
+  {
+    std::lock_guard<std::mutex> g(rc->mu);
+    auto it = rc->reqs.find(req_id);
+    if (it == rc->reqs.end()) return -ENOENT;
+    Req *r = it->second;
+    if (r->state != ReqState::kDone) return -EBUSY;
+    buf_idx = r->buf_idx;
+    rc->reqs.erase(it);
+    delete r;
+  }
+  /* Buffer handoff runs with no ring lock held: the recipient may live
+   * on a DIFFERENT ring (global deferral FIFO), and two ring mutexes
+   * must never nest. */
+  if (buf_idx >= 0) e->recycle_buffer(buf_idx);
   return 0;
 }
 
 int64_t strom_submit_write(strom_engine *e, int fh, uint64_t offset,
                            const void *src, uint64_t len) {
+  if (e->stopping.load(std::memory_order_acquire)) return -ECANCELED;
+  bool conformant;
+  {
+    std::lock_guard<std::mutex> g(e->files_mu);
+    auto it = e->files.find(fh);
+    if (it == e->files.end()) return -EBADF;
+    if (!it->second.writable) return -EACCES;
+    conformant = ((uint64_t)src % e->alignment == 0) &&
+                 (offset % e->alignment == 0) &&
+                 (len % e->alignment == 0) && it->second.fd_direct >= 0;
+  }
+  if (!conformant && len > e->buf_bytes) return -EINVAL;
+  RingCtx *rcx = e->pick_ring();
   Req *r = new Req();
   r->is_write = true;
-  std::lock_guard<std::mutex> lk(e->mu);
-  auto it = e->files.find(fh);
-  if (it == e->files.end()) { delete r; return -EBADF; }
-  if (!it->second.writable) { delete r; return -EACCES; }
-  if (e->stopping) { delete r; return -ECANCELED; }
-  const FileEnt &fe = it->second;
-  bool conformant = ((uint64_t)src % e->alignment == 0) &&
-                    (offset % e->alignment == 0) &&
-                    (len % e->alignment == 0) && fe.fd_direct >= 0;
-  r->id = e->next_req++;
   r->fh = fh;
+  r->rc = rcx;
   r->offset = offset;
   r->len = len;
-  r->t_submit = now_ns();
   r->direct = conformant;
   r->wsrc = src; /* wrapper keeps src alive until wait() */
-  e->reqs[r->id] = r;
+  std::lock_guard<std::mutex> g(rcx->mu);
+  if (e->stopping.load(std::memory_order_acquire)) {
+    delete r;
+    return -ECANCELED;
+  }
+  r->id = e->alloc_id(rcx);
+  r->t_submit = now_ns();
+  rcx->reqs[r->id] = r;
   e->st_sub.fetch_add(1, std::memory_order_relaxed);
+  rcx->rg_sub.fetch_add(1, std::memory_order_relaxed);
   if (conformant) {
     /* zero-copy: O_DIRECT DMA straight from caller memory, no buffer */
     r->buf_idx = -1;
-    e->dispatch_locked(r);
+    rcx->dispatch_locked(r);
     return r->id;
   }
-  if (len > e->buf_bytes) {
-    e->reqs.erase(r->id);
-    delete r;
-    return -EINVAL;
-  }
-  if (e->free_bufs.empty()) {
-    e->defer_q.push_back(r); /* staged (memcpy'd) when a buffer frees */
-  } else {
-    r->buf_idx = e->free_bufs.back();
-    e->free_bufs.pop_back();
-    r->buf = e->buf_ptr(r->buf_idx);
+  int got = e->acquire_or_defer(r);  /* else staged when a buffer frees */
+  if (got > 0) {
     memcpy(r->buf, src, len); /* the one counted bounce */
     e->st_bounce.fetch_add(len, std::memory_order_relaxed);
-    e->dispatch_locked(r);
+    rcx->dispatch_locked(r);
+  } else if (got < 0) {
+    r->status = -ECANCELED;          /* raced engine destroy */
+    rcx->complete_locked(r);
   }
   return r->id;
 }
@@ -1395,7 +1625,9 @@ void strom_reset_stats(strom_engine *e) {
   }
 }
 
-int strom_backend_is_uring(strom_engine *e) { return e->use_uring ? 1 : 0; }
+int strom_backend_is_uring(strom_engine *e) {
+  return (!e->rings.empty() && e->rings[0]->use_uring) ? 1 : 0;
+}
 
 void strom_get_latency(strom_engine *e,
                        uint64_t out_read[STROM_LAT_BUCKETS],

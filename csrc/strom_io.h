@@ -108,7 +108,58 @@ void strom_get_latency(strom_engine *eng,
 strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
                                   uint64_t buf_bytes, uint32_t alignment,
                                   int use_io_uring, int lock_buffers);
+
+/* Multi-ring engine: N independent submission rings (io_uring instance
+ * or worker pool EACH, with private completion reaping and a private
+ * request table) behind ONE file table, ONE public ABI, and ONE
+ * fungible staging pool (global pool + global deferral FIFO: a batch
+ * pinned to one ring can never deadlock behind a per-ring buffer slice
+ * smaller than a consumer's in-flight window — buffers freed on any
+ * ring hand over to the oldest deferred request engine-wide).  The
+ * single-ring engine serializes every consumer through one doorbell;
+ * sharding lets concurrent traffic classes (decode-critical reads vs
+ * bulk prefetch vs scrub) ride disjoint queues — the QoS scheduler
+ * above (io/sched.py) decides which class lands on which ring.
+ * queue_depth and n_buffers are PER RING.  strom_engine_create(...) ==
+ * strom_engine_create_rings(1, ...), bit-for-bit the old behavior.
+ * Request ids encode their ring in the low STROM_RING_ID_BITS bits, so
+ * wait/release route lock-free. */
+#define STROM_MAX_RINGS 64
+#define STROM_RING_ID_BITS 6
+strom_engine *strom_engine_create_rings(uint32_t n_rings,
+                                        uint32_t queue_depth,
+                                        uint32_t n_buffers,
+                                        uint64_t buf_bytes,
+                                        uint32_t alignment,
+                                        int use_io_uring, int lock_buffers);
 void strom_engine_destroy(strom_engine *eng);
+
+/* Per-ring introspection: the scheduler's dispatch decisions key off
+ * in-flight queue depth (submitted - completed, lock-free atomics — the
+ * poll can run at dispatch frequency without touching the ring mutex);
+ * free_buffers/deferred take the ring lock briefly. */
+typedef struct strom_ring_info {
+  uint32_t ring_id;
+  uint32_t n_buffers;      /* TOTAL staging buffers (the pool is global) */
+  uint32_t free_buffers;   /* free in the global pool                    */
+  uint32_t deferred;       /* THIS ring's requests awaiting a buffer     */
+  uint64_t submitted;      /* requests ever submitted to this ring      */
+  uint64_t completed;      /* requests completed (I/O done, incl. fail) */
+  uint32_t inflight_io;    /* submitted - completed: queue depth        */
+  int32_t  backend_uring;  /* 1 if this ring runs on io_uring           */
+} strom_ring_info;
+
+int strom_ring_count(strom_engine *eng);
+int strom_get_ring_info(strom_engine *eng, uint32_t ring,
+                        strom_ring_info *out);
+
+/* Depth-only fast path: submitted - completed from the lock-free
+ * per-ring atomics, NO mutex and NO deferral-queue walk — what the QoS
+ * scheduler's admission poll calls at dispatch frequency (the full
+ * strom_get_ring_info takes pool_mu for buffer/deferral occupancy and
+ * belongs in stat dumps, not hot polls).  Returns >= 0, or -EINVAL for
+ * a ring index out of range. */
+int64_t strom_ring_inflight(strom_engine *eng, uint32_t ring);
 
 /* Engine-independent file eligibility probe (CHECK_FILE analogue). */
 int strom_check_file(const char *path, strom_file_info *out);
@@ -229,6 +280,17 @@ typedef struct strom_rd_ext {
  * submission order. */
 int strom_submit_readv(strom_engine *eng, const strom_rd_ext *exts,
                        uint32_t n, int64_t *out_ids);
+
+/* Ring-pinned variants: identical semantics, but the caller (the QoS
+ * scheduler) names the ring instead of the engine's round-robin pick.
+ * A whole readv batch lands on ONE ring — one doorbell, one deferral
+ * queue, no cross-ring interleave within the batch.  -EINVAL for a
+ * ring index out of range. */
+int64_t strom_submit_read_ring(strom_engine *eng, uint32_t ring, int fh,
+                               uint64_t offset, uint64_t len);
+int strom_submit_readv_ring(strom_engine *eng, uint32_t ring,
+                            const strom_rd_ext *exts, uint32_t n,
+                            int64_t *out_ids);
 
 /* Wait until req_id completes; fills *out. The buffer stays owned by the
  * request until strom_release. */

@@ -914,7 +914,8 @@ class CheckpointManager:
             # the whole tile submits as ONE vectored batch — the engine
             # defers reads past its pool without blocking, and this
             # loop releases oldest-first, so the batch cannot deadlock
-            (pend,) = plan_and_submit(eng, [(fh, offset, length)])
+            (pend,) = plan_and_submit(eng, [(fh, offset, length)],
+                                      klass="restore")
             pend = list(pend)
             pos = 0
             while pend:

@@ -432,7 +432,8 @@ class ShardedLoader:
                 # fewer, larger reads and submit under ONE doorbell
                 items = list(samples[si].items())
                 planned = plan_and_submit(
-                    eng, [(fh, off, ln) for _, (off, ln) in items])
+                    eng, [(fh, off, ln) for _, (off, ln) in items],
+                    klass="prefetch")
                 reads = {ext: pieces
                          for (ext, _), pieces in zip(items, planned)}
                 pend.append((samples[si], reads))
@@ -658,7 +659,8 @@ class ShardedLoader:
             exts = [(fhs[si], off, nb)
                     for si, off, nb in row_spans(r0, r1)]
             parts = plan_and_submit(eng, exts, split_unit=rec_bytes,
-                                    chunk_bytes=split_src)
+                                    chunk_bytes=split_src,
+                                    klass="prefetch")
             return [p for pieces in parts for p in pieces]
 
         def to_device(dev, prs):
@@ -998,7 +1000,7 @@ class ShardedLoader:
             planned = plan_and_submit(
                 eng, [(fhs[si], off0, k * stride)
                       for si, off0, k in groups],
-                chunk_bytes=chunk)
+                chunk_bytes=chunk, klass="prefetch")
             out = []
             for (si, off0, k), pieces in zip(groups, planned):
                 prs = _Span(pieces)
@@ -1010,7 +1012,7 @@ class ShardedLoader:
             return plan_and_submit(
                 eng, [(fhs[recs[r][0]], recs[r][1], recs[r][2])
                       for r in range(r0, r1)],
-                chunk_bytes=chunk)
+                chunk_bytes=chunk, klass="prefetch")
 
         def dispatch_groups(dev, groups, group_block):
             """One batch's groups → device blocks: wait each read, put

@@ -33,6 +33,13 @@ from nvme_strom_tpu.io.resilient import (
     ResilientWrite,
     WriteError,
 )
+from nvme_strom_tpu.io.sched import (
+    CLASS_ORDER,
+    DEFAULT_CLASS,
+    ClassPolicy,
+    QoSScheduler,
+    default_policies,
+)
 
 __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
            "DeviceInfo", "Extent", "check_file", "resolve_device",
@@ -42,4 +49,6 @@ __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
            "ExtentPlan", "SpanView", "plan_and_submit", "plan_extents",
            "split_spans", "submit_spans",
            "ReadError", "ResilientEngine", "ResilientRead",
-           "ResilientWrite", "WriteError"]
+           "ResilientWrite", "WriteError",
+           "CLASS_ORDER", "DEFAULT_CLASS", "ClassPolicy", "QoSScheduler",
+           "default_policies"]

@@ -109,6 +109,52 @@ class _Completion(ctypes.Structure):
 
 
 _LAT_BUCKETS = 64
+_MAX_RINGS = 64    # STROM_MAX_RINGS: request ids carry 6 ring bits
+
+
+class _RingInfo(ctypes.Structure):
+    _fields_ = [
+        ("ring_id", ctypes.c_uint32),
+        ("n_buffers", ctypes.c_uint32),
+        ("free_buffers", ctypes.c_uint32),
+        ("deferred", ctypes.c_uint32),
+        ("submitted", ctypes.c_uint64),
+        ("completed", ctypes.c_uint64),
+        ("inflight_io", ctypes.c_uint32),
+        ("backend_uring", ctypes.c_int32),
+    ]
+
+
+def _nvme_hw_queues() -> int:
+    """Largest hardware-queue count across visible NVMe namespaces
+    (/sys/block/nvme*/mq has one directory per hw queue); 0 unknown."""
+    best = 0
+    try:
+        for d in os.listdir("/sys/block"):
+            if d.startswith("nvme"):
+                try:
+                    best = max(best, len(os.listdir(f"/sys/block/{d}/mq")))
+                except OSError:
+                    pass
+    except OSError:
+        pass
+    return best
+
+
+def auto_ring_count() -> int:
+    """Default ring count: CPU topology capped by the NVMe device's
+    hardware queue count, rounded down to a power of two (divides the
+    default queue depths/pools evenly), ceiling 8.  The caller further
+    caps by what the configured pool/queue depth can feed."""
+    cpus = os.cpu_count() or 1
+    n = max(1, min(8, cpus // 4))
+    mq = _nvme_hw_queues()
+    if mq:
+        n = max(1, min(n, mq))
+    p = 1
+    while p * 2 <= n:
+        p *= 2
+    return p
 
 
 def _load_lib() -> ctypes.CDLL:
@@ -133,6 +179,24 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_engine_create.argtypes = [
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.c_uint32, ctypes.c_int, ctypes.c_int]
+        lib.strom_engine_create_rings.restype = ctypes.c_void_p
+        lib.strom_engine_create_rings.argtypes = [
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int, ctypes.c_int]
+        lib.strom_ring_count.argtypes = [ctypes.c_void_p]
+        lib.strom_get_ring_info.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_uint32,
+                                            ctypes.POINTER(_RingInfo)]
+        lib.strom_ring_inflight.restype = ctypes.c_int64
+        lib.strom_ring_inflight.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_uint32]
+        lib.strom_submit_read_ring.restype = ctypes.c_int64
+        lib.strom_submit_read_ring.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_uint64]
+        lib.strom_submit_readv_ring.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.POINTER(_RdExt),
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_int64)]
         lib.strom_engine_destroy.argtypes = [ctypes.c_void_p]
         lib.strom_check_file.argtypes = [ctypes.c_char_p,
                                          ctypes.POINTER(_FileInfo)]
@@ -562,8 +626,15 @@ class PendingWrite:
 class StromEngine:
     """The userspace handle to the strom-io engine.
 
-    One engine owns one io_uring and one locked staging-buffer pool (the
-    MAP_GPU_MEMORY analogue — created once, reused for every transfer).
+    One engine owns N submission rings (``EngineConfig.n_rings``; each
+    an io_uring or worker pool reaping its own completions) over ONE
+    locked staging pool — the MAP_GPU_MEMORY analogue, created once and
+    reused for every transfer, deliberately global: buffers freed on
+    any ring recycle to the oldest deferred request engine-wide, so
+    ring pinning can never deadlock on pool pressure.  A sharded engine
+    also owns the QoS scheduler that maps latency classes onto its
+    rings (io/sched.py).  ``n_rings=1`` is exactly the pre-sharding
+    engine: no scheduler, one ring, one pool.
     """
 
     def __init__(self, config: Optional[EngineConfig] = None,
@@ -577,18 +648,46 @@ class StromEngine:
         c = self.config
         n_buffers = max(
             2, min(64, c.buffer_pool_bytes // max(1, c.chunk_bytes)))
-        self._h = self._lib.strom_engine_create(
-            c.queue_depth, n_buffers, c.chunk_bytes, c.alignment,
+        # Ring count: explicit n_rings, or auto from CPU/NVMe topology —
+        # capped by what the CONFIGURED engine can feed (each ring needs
+        # >= 2 staging buffers and >= 1 queue slot, so a deliberately
+        # tiny engine stays single-ring and keeps its exact pre-sharding
+        # deferral behavior).
+        n_rings = c.n_rings if c.n_rings > 0 else auto_ring_count()
+        n_rings = max(1, min(n_rings, _MAX_RINGS, n_buffers // 2,
+                             c.queue_depth))
+        qd_ring = max(1, c.queue_depth // n_rings)
+        bufs_ring = max(2, n_buffers // n_rings)
+        self._h = self._lib.strom_engine_create_rings(
+            n_rings, qd_ring, bufs_ring, c.chunk_bytes, c.alignment,
             1 if c.use_io_uring else 0, 1 if c.lock_buffers else 0)
         if not self._h:
             raise OSError(ctypes.get_errno(),
                           "strom_engine_create failed: "
                           + os.strerror(ctypes.get_errno()))
-        self.n_buffers = n_buffers
+        self.n_rings = n_rings
+        self.n_buffers = bufs_ring * n_rings
+        self._qd_ring = qd_ring
         self._open_fhs: set[int] = set()
         self._last_lat_read: list[int] = [0] * _LAT_BUCKETS
         self._stripe: dict = {}   # fh → (chunk, members, extents)
         self._closed = False
+        self.scheduler = None
+        if n_rings > 1:
+            from nvme_strom_tpu.utils.config import SchedConfig
+            scfg = SchedConfig()
+            if scfg.enabled:
+                from nvme_strom_tpu.io.sched import (QoSScheduler,
+                                                     default_policies)
+                cap = scfg.max_inflight_per_ring or qd_ring
+                self._ring_cap = max(1, cap)
+                self.scheduler = QoSScheduler(
+                    submit_ring=self._submit_readv_ring,
+                    ring_free=self._ring_free_slots,
+                    policies=default_policies(scfg.class_weights),
+                    aging_rounds=scfg.aging_rounds,
+                    stats=self.stats,
+                    ring_cap=self._ring_cap)
 
     # -- file handles ------------------------------------------------------
 
@@ -686,21 +785,85 @@ class StromEngine:
     def file_is_direct(self, fh: int) -> bool:
         return self._lib.strom_file_is_direct(self._h, fh) == 1
 
+    # -- rings -------------------------------------------------------------
+
+    def ring_info(self, ring: int) -> dict:
+        """One ring's occupancy/counters (strom_get_ring_info)."""
+        info = _RingInfo()
+        rc = self._lib.strom_get_ring_info(self._h, ring,
+                                           ctypes.byref(info))
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return {n: int(getattr(info, n)) for n, _ in _RingInfo._fields_}
+
+    def ring_depths(self) -> list:
+        """Per-ring in-flight I/O (submitted - completed) via the
+        lock-free depth-only C path — the scheduler's admission polls
+        this at dispatch frequency, so it must never contend with the
+        pool mutex the data path is hammering (strom_ring_inflight, not
+        the full strom_get_ring_info)."""
+        return [max(0, int(self._lib.strom_ring_inflight(self._h, r)))
+                for r in range(self.n_rings)]
+
+    def _ring_free_slots(self) -> list:
+        cap = getattr(self, "_ring_cap", self._qd_ring)
+        return [max(0, cap - d) for d in self.ring_depths()]
+
     # -- reads -------------------------------------------------------------
 
-    def submit_read(self, fh: int, offset: int, length: int) -> PendingRead:
+    def submit_read(self, fh: int, offset: int, length: int,
+                    klass: Optional[str] = None,
+                    ring: Optional[int] = None) -> PendingRead:
+        """Scalar read.  Scalar submissions route round-robin across
+        rings (``ring`` pins one) and never queue at the scheduler:
+        they are the retry/hedge/probe path, where added queueing delay
+        would fight the recovery that issued them.  ``klass`` is
+        accepted for API symmetry (wrappers use it for per-class
+        budgets); it does not affect scalar routing."""
+        del klass  # scalar routing is class-blind by design
         if length > self.config.chunk_bytes:
             raise ValueError(
                 f"read length {length} exceeds chunk_bytes "
                 f"{self.config.chunk_bytes}; split the range")
-        rid = self._lib.strom_submit_read(self._h, fh, offset, length)
+        if ring is None:
+            rid = self._lib.strom_submit_read(self._h, fh, offset, length)
+        else:
+            rid = self._lib.strom_submit_read_ring(self._h, ring, fh,
+                                                   offset, length)
         if rid < 0:
             raise OSError(-rid, os.strerror(-rid))
         if self._stripe:
             self._attr_stripe(fh, offset, length)
         return PendingRead(self, rid, length, fh=fh, offset=offset)
 
-    def submit_readv(self, reads) -> list:
+    def _submit_readv_ring(self, reads, ring: Optional[int]) -> list:
+        """Raw vectored submission to one ring (or C round-robin when
+        ``ring`` is None) — the scheduler's dispatch callback; no
+        scheduler re-entry."""
+        reads = list(reads)
+        n = len(reads)
+        exts = (_RdExt * n)()
+        for i, (fh, offset, length) in enumerate(reads):
+            exts[i].fh = fh
+            exts[i].offset = offset
+            exts[i].length = length
+        rids = (ctypes.c_int64 * n)()
+        if ring is None:
+            rc = self._lib.strom_submit_readv(self._h, exts, n, rids)
+        else:
+            rc = self._lib.strom_submit_readv_ring(self._h, ring, exts,
+                                                   n, rids)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        if self._stripe:
+            for fh, offset, length in reads:
+                self._attr_stripe(fh, offset, length)
+        return [PendingRead(self, int(rids[i]), reads[i][2],
+                            fh=reads[i][0], offset=reads[i][1])
+                for i in range(n)]
+
+    def submit_readv(self, reads, klass: Optional[str] = None,
+                     ring: Optional[int] = None) -> list:
         """Vectored submission: one C call, one io_uring doorbell for the
         whole batch (``strom_submit_readv``).
 
@@ -710,6 +873,15 @@ class StromEngine:
         on ValueError/OSError nothing was submitted.  This is the L2
         boundary the extent-coalescing planner (io/plan.py) submits
         through; calling it directly is fine for pre-split ranges.
+
+        ``klass``: the batch's latency class.  On a sharded engine the
+        QoS scheduler (io/sched.py) gates dispatch — the call may block
+        behind higher classes under contention, exactly the admission
+        control that protects decode-critical reads.  ``ring`` pins a
+        ring and bypasses the scheduler (the scheduler's own dispatch
+        path; also handy in tests).  Single-ring engines have no
+        scheduler: every batch submits immediately, the pre-sharding
+        behavior.
         """
         reads = list(reads)
         if not reads:
@@ -720,22 +892,9 @@ class StromEngine:
                 raise ValueError(
                     f"read length {length} exceeds chunk_bytes "
                     f"{chunk}; split the range (io/plan.py does)")
-        n = len(reads)
-        exts = (_RdExt * n)()
-        for i, (fh, offset, length) in enumerate(reads):
-            exts[i].fh = fh
-            exts[i].offset = offset
-            exts[i].length = length
-        rids = (ctypes.c_int64 * n)()
-        rc = self._lib.strom_submit_readv(self._h, exts, n, rids)
-        if rc < 0:
-            raise OSError(-rc, os.strerror(-rc))
-        if self._stripe:
-            for fh, offset, length in reads:
-                self._attr_stripe(fh, offset, length)
-        return [PendingRead(self, int(rids[i]), reads[i][2],
-                            fh=reads[i][0], offset=reads[i][1])
-                for i in range(n)]
+        if self.scheduler is not None and ring is None:
+            return self.scheduler.submit(reads, klass)
+        return self._submit_readv_ring(reads, ring)
 
     def read(self, fh: int, offset: int, length: int) -> np.ndarray:
         """Synchronous convenience read returning an *owning* array.
@@ -815,6 +974,10 @@ class StromEngine:
         if any(pct.values()):
             self.stats.set_gauges(lat_read_p50_us=pct[50] / 1000.0,
                                   lat_read_p99_us=pct[99] / 1000.0)
+        if self.n_rings > 1:
+            # instantaneous per-ring queue depth: the scheduler block in
+            # strom_stat/watchdog reads these next to the sched counters
+            self.stats.set_gauges(ring_depths=self.ring_depths())
         self.stats.maybe_export()  # keep strom_stat --watch observers live
         return snap
 
@@ -826,6 +989,10 @@ class StromEngine:
     def close_all(self) -> None:
         if self._closed:
             return
+        if self.scheduler is not None:
+            # wake any thread still blocked in a grant loop BEFORE the C
+            # handle dies under its capacity poll (it raises ECANCELED)
+            self.scheduler.close()
         self.sync_stats()  # drains counters and exports the final snapshot
         self._lib.strom_engine_destroy(self._h)
         self._closed = True

@@ -457,18 +457,21 @@ class FaultyEngine:
             return FaultyWrite(pending, spec)
         return FaultyRead(pending, spec, self.plan)
 
-    def submit_read(self, fh: int, offset: int, length: int):
+    def submit_read(self, fh: int, offset: int, length: int,
+                    klass: Optional[str] = None):
+        del klass   # scalar routing is class-blind (engine contract)
         pending = self._engine.submit_read(fh, offset, length)
         return self._maybe_fault(pending, fh, offset, length)
 
-    def submit_readv(self, reads) -> list:
+    def submit_readv(self, reads, klass: Optional[str] = None) -> list:
         """Vectored path: ONE batched submission through the wrapped
-        engine, then a PER-EXTENT injection decision — a chaos plan
-        hits individual spans of a batch exactly as a real device
-        fails individual commands of a multi-command submission."""
+        engine (``klass`` rides along to the QoS scheduler below), then
+        a PER-EXTENT injection decision — a chaos plan hits individual
+        spans of a batch exactly as a real device fails individual
+        commands of a multi-command submission."""
         from nvme_strom_tpu.io.plan import submit_spans
         reads = list(reads)
-        pendings = submit_spans(self._engine, reads)
+        pendings = submit_spans(self._engine, reads, klass=klass)
         return [self._maybe_fault(p, fh, offset, length)
                 for (fh, offset, length), p in zip(reads, pendings)]
 

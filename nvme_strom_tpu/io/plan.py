@@ -271,15 +271,46 @@ class SpanView:
         self.release()
 
 
-def submit_spans(engine, spans: Sequence[Tuple[int, int, int]]) -> list:
+#: per-engine-class cache: does this engine's submit_readv accept the
+#: ``klass`` keyword?  In-repo engines all do; a foreign/stub wrapper
+#: without it still works (the class tag is dropped, traffic rides the
+#: scheduler's default class if one sits below).
+_READV_KLASS: dict = {}
+
+
+def _readv_accepts_klass(engine) -> bool:
+    t = type(engine)
+    ok = _READV_KLASS.get(t)
+    if ok is None:
+        import inspect
+        try:
+            params = inspect.signature(engine.submit_readv).parameters
+            ok = "klass" in params or any(
+                p.kind is inspect.Parameter.VAR_KEYWORD
+                for p in params.values())
+        except (TypeError, ValueError):
+            ok = False
+        _READV_KLASS[t] = ok
+    return ok
+
+
+def submit_spans(engine, spans: Sequence[Tuple[int, int, int]],
+                 klass: Optional[str] = None) -> list:
     """Submit planned spans through the engine's vectored path when it
     has one (StromEngine/Resilient/Faulty all do), else per-span —
     returns pending reads aligned with ``spans``.  All-or-nothing
     either way: the C path validates atomically, and the per-span
     fallback releases already-submitted reads before re-raising, so a
-    mid-list failure never strands staging buffers."""
+    mid-list failure never strands staging buffers.
+
+    ``klass`` tags the batch's latency class (io/sched.py: ``decode`` >
+    ``restore`` > ``prefetch`` > ``scrub``); on a sharded engine the QoS
+    scheduler dispatches accordingly, and the resilience layer applies
+    that class's hedge/retry budgets.  None rides the default class."""
     readv = getattr(engine, "submit_readv", None)
     if readv is not None:
+        if klass is not None and _readv_accepts_klass(engine):
+            return readv(spans, klass=klass)
         return readv(spans)
     out: list = []
     try:
@@ -294,7 +325,8 @@ def submit_spans(engine, spans: Sequence[Tuple[int, int, int]]) -> list:
 
 def plan_and_submit(engine, extents: Sequence[Tuple[int, int, int]], *,
                     gap: Optional[int] = None, split_unit: int = 1,
-                    chunk_bytes: Optional[int] = None
+                    chunk_bytes: Optional[int] = None,
+                    klass: Optional[str] = None
                     ) -> List[List[SpanView]]:
     """Plan ``(fh, offset, length)`` extents, submit the spans as ONE
     batch, and return — aligned with the input — each extent's ordered
@@ -305,13 +337,17 @@ def plan_and_submit(engine, extents: Sequence[Tuple[int, int, int]], *,
     (``utils.tuning.tuned_chunk_bytes``); pass ``chunk_bytes`` to pin
     it (must be ≤ the engine's staging capacity).  Coalescing counts
     into ``StromStats.spans_coalesced``.
+
+    ``klass`` is the batch's latency class (see :func:`submit_spans`) —
+    the one knob consumers use to tag their traffic for the QoS
+    scheduler and the per-class resilience budgets.
     """
     if chunk_bytes is None:
         from nvme_strom_tpu.utils.tuning import tuned_chunk_bytes
         chunk_bytes = tuned_chunk_bytes(engine)
     plan = plan_extents(extents, chunk_bytes=chunk_bytes, gap=gap,
                         split_unit=split_unit)
-    pendings = submit_spans(engine, plan.spans)
+    pendings = submit_spans(engine, plan.spans, klass=klass)
     refs = [0] * len(pendings)
     for pieces in plan.placements:
         for si, _, _ in pieces:

@@ -30,8 +30,19 @@ span (``data[n:]`` at ``offset+n``), and budget exhaustion raises
 ``WriteError`` with the full attempt history.  Hedging is deliberately
 read-only — duplicate in-flight writes of one range can interleave.
 
+Recovery policy is PER LATENCY CLASS, not process-global: reads tagged
+with a class (``submit_read(..., klass=...)`` / ``submit_readv(...,
+klass=...)`` — the same tags the QoS scheduler ranks, io/sched.py) run
+under that class's ``ResilientConfig`` (``class_configs``) and charge a
+per-class CONCURRENT-hedge token budget (``hedge_budgets``): a scrub
+storm that exhausts its own hedge quota is denied further hedges
+(counted ``hedges_denied``) while the decode class's quota stays
+untouched.  Untagged reads keep the engine-wide config and unlimited
+legacy hedging (capped, as always, at one hedge per primary).
+
 Every action is accounted (StromStats: resilient_retries, hedges_issued,
-hedges_won, stuck_cancelled, write_retries) and traced
+hedges_won, hedges_denied, stuck_cancelled, write_retries — plus the
+per-class breakdown in ``class_stats``) and traced
 (strom.resilient.* spans), so a recovered run shows its scars in
 ``strom_stat`` instead of hiding them.
 
@@ -97,14 +108,21 @@ class ResilientRead:
     """
 
     def __init__(self, engine: "ResilientEngine", fh: int, offset: int,
-                 length: int, pending, expected: int):
+                 length: int, pending, expected: int,
+                 klass: Optional[str] = None):
         self._engine = engine
         self._fh = fh
         self._offset = offset
         self._length = length
         self._expected = expected    # bytes the file actually holds here
+        #: latency class: selects this read's ResilientConfig (per-class
+        #: retry/backoff/hedge policy) and charges its hedge budget
+        self._klass = klass
+        self._cfg = engine.config_for(klass)
         self._primary = _Attempt(pending, time.monotonic())
         self._hedge: Optional[_Attempt] = None
+        self._hedge_token = False    # class hedge-budget token held
+        self._hedge_denied = False   # denial counted for this primary
         self._attempts: list = []    # fault history of failed attempts
         self._retries = 0
         self._hedges = 0             # hedges issued for the CURRENT
@@ -141,7 +159,7 @@ class ResilientRead:
             return self._view
         deadline = None if timeout is None \
             else time.monotonic() + timeout
-        cfg = self._engine.rconfig
+        cfg = self._cfg
         while True:
             try:
                 view = self._wait_attempts(deadline)
@@ -183,8 +201,8 @@ class ResilientRead:
         OSError on a completed-with-error attempt, TimeoutError only at
         the caller's deadline."""
         eng = self._engine
-        cfg = eng.rconfig
-        hedge_after = eng._hedge_after()
+        cfg = self._cfg
+        hedge_after = eng._hedge_after(self._klass)
         while True:
             # primary probe FIRST: a read whose payload already landed
             # must return its view even at timeout=0 (PendingRead.wait
@@ -204,7 +222,7 @@ class ResilientRead:
                     # — it may still be in flight, and release() would
                     # block)
                     eng._defer_release(self._fh, self._hedge.pending)
-                    self._hedge = None
+                    self._drop_hedge()
                 self._winner = self._primary
                 self.was_fallback = bool(getattr(
                     self._primary.pending, "was_fallback", False))
@@ -233,9 +251,12 @@ class ResilientRead:
                 except OSError:
                     # a failed hedge never fails the read — drop it and
                     # keep waiting on the primary (wait() released it)
-                    self._hedge = None
+                    self._drop_hedge()
                 else:
                     eng.stats.add(hedges_won=1)
+                    if self._klass:
+                        eng.stats.add_class_stat(self._klass,
+                                                 hedges_won=1)
                     eng._trace("strom.resilient.hedge_won",
                                int(self._hedge.t0 * 1e9), fh=self._fh,
                                offset=self._offset)
@@ -243,20 +264,45 @@ class ResilientRead:
                     # release() would BLOCK until its I/O lands, erasing
                     # the hedge's entire latency win — park it instead
                     eng._defer_release(self._fh, self._primary.pending)
-                    self._primary, self._hedge = self._hedge, None
+                    self._primary = self._hedge
+                    self._drop_hedge()
                     self._winner = self._primary
                     self.was_fallback = bool(getattr(
                         self._primary.pending, "was_fallback", False))
                     return view
 
-    def _submit_hedge(self) -> _Attempt:
+    def _submit_hedge(self) -> Optional[_Attempt]:
+        """Issue the duplicate read IF the class's concurrent-hedge
+        budget has a token; None (counted hedges_denied, once per
+        primary) when the budget is exhausted — this is the isolation
+        that keeps a scrub storm from eating the decode class's hedge
+        quota."""
         eng = self._engine
+        if not eng._acquire_hedge(self._klass):
+            if not self._hedge_denied:
+                self._hedge_denied = True
+                eng.stats.add(hedges_denied=1)
+                if self._klass:
+                    eng.stats.add_class_stat(self._klass, hedges_denied=1)
+            return None
+        self._hedge_token = True
         self._hedges += 1
         eng.stats.add(hedges_issued=1)
+        if self._klass:
+            eng.stats.add_class_stat(self._klass, hedges_issued=1)
         eng._trace("strom.resilient.hedge", time.monotonic_ns(),
                    fh=self._fh, offset=self._offset, length=self._length)
         return _Attempt(eng._engine.submit_read(
             self._fh, self._offset, self._length), time.monotonic())
+
+    def _drop_hedge(self) -> None:
+        """Clear the hedge slot and hand its budget token back (every
+        transition out of 'hedge outstanding' funnels here exactly
+        once)."""
+        if self._hedge_token:
+            self._engine._release_hedge(self._klass)
+            self._hedge_token = False
+        self._hedge = None
 
     def _note_failure(self, e: OSError, kind: Optional[str] = None):
         self._attempts.append({
@@ -268,13 +314,15 @@ class ResilientRead:
     def _retry(self, deadline) -> None:
         """Release the failed/stuck attempt, back off, resubmit."""
         eng = self._engine
-        cfg = eng.rconfig
+        cfg = self._cfg
         stuck = self._attempts[-1]["kind"] == "stuck"
         t0 = time.monotonic_ns()
         self._release_attempts()
         if stuck:
             eng.stats.add(stuck_cancelled=1)
         eng.stats.add(resilient_retries=1)
+        if self._klass:
+            eng.stats.add_class_stat(self._klass, retries=1)
         delay = min(cfg.backoff_max_s,
                     cfg.backoff_base_s * (2 ** self._retries))
         delay *= 1.0 + cfg.jitter * (2 * eng._rng.random() - 1)
@@ -284,6 +332,7 @@ class ResilientRead:
             time.sleep(delay)
         self._retries += 1
         self._hedges = 0     # a fresh primary earns a fresh hedge budget
+        self._hedge_denied = False
         self._primary = _Attempt(
             eng._engine.submit_read(self._fh, self._offset, self._length),
             time.monotonic())
@@ -301,7 +350,7 @@ class ResilientRead:
         self._engine._defer_release(self._fh, self._primary.pending)
         if self._hedge is not None:
             self._engine._defer_release(self._fh, self._hedge.pending)
-            self._hedge = None
+        self._drop_hedge()
 
     # -- PendingRead-compatible surface ------------------------------------
 
@@ -325,7 +374,7 @@ class ResilientRead:
         self._primary.pending.release()   # waits if still in flight
         if self._hedge is not None:
             self._hedge.pending.release()
-            self._hedge = None
+        self._drop_hedge()
 
     def __enter__(self):
         return self
@@ -477,9 +526,29 @@ class ResilientEngine:
     resurrect a save the commit sequence already abandoned.
     """
 
-    def __init__(self, engine, config: Optional[ResilientConfig] = None):
+    def __init__(self, engine, config: Optional[ResilientConfig] = None,
+                 class_configs: Optional[dict] = None,
+                 hedge_budgets: Optional[dict] = None):
         self._engine = engine
         self.rconfig = config or ResilientConfig()
+        #: per-latency-class ResilientConfig overrides ({class: config})
+        #: — recovery policy is no longer process-global: tests and
+        #: serving deployments vary a class's retry/backoff/hedging
+        #: without touching env vars or the other classes
+        self.class_configs = dict(class_configs or {})
+        # concurrent-hedge budget per class (tokens; {class: int}).
+        # Default from the scheduler's stock policies so the two layers
+        # agree on class names and relative generosity; explicit
+        # ``hedge_budgets`` wins.  Reads with NO class share the
+        # unlimited legacy pool (hedging capped at 1 per primary as
+        # before), so un-tagged callers keep exact pre-PR behavior.
+        if hedge_budgets is None:
+            from nvme_strom_tpu.io.sched import default_policies
+            hedge_budgets = {name: p.hedge_budget
+                            for name, p in default_policies().items()}
+        self.hedge_budgets = dict(hedge_budgets)
+        self._hedge_out: dict = {}           # class -> outstanding hedges
+        self._hedge_lock = threading.Lock()
         self._rng = random.Random(self.rconfig.seed)
         # abandoned attempts (lost hedges, cancelled stuck reads) whose
         # I/O may still be in flight: released opportunistically once
@@ -488,11 +557,49 @@ class ResilientEngine:
         # 1 + max_retries outstanding attempts exist per logical read.
         self._zombies: list = []
         self._zombie_lock = threading.Lock()
-        # derived hedge threshold, refreshed at most once a second: the
-        # percentile walk over the C histogram is cheap but runs per
-        # wait — uncached it becomes measurable on tens of thousands of
-        # small reads per second
-        self._hedge_cache: tuple = (-1.0, None)   # (computed_at, value)
+        # derived hedge threshold, refreshed at most once a second PER
+        # CLASS: the percentile walk over the C histogram is cheap but
+        # runs per wait — uncached it becomes measurable on tens of
+        # thousands of small reads per second
+        self._hedge_cache: dict = {}   # class -> (computed_at, value)
+
+    def config_for(self, klass: Optional[str]) -> ResilientConfig:
+        """The ResilientConfig governing reads of ``klass`` (the
+        engine-wide config unless a per-class override is registered)."""
+        if klass is not None:
+            cfg = self.class_configs.get(klass)
+            if cfg is not None:
+                return cfg
+        return self.rconfig
+
+    # -- per-class hedge budget (token accounting) -------------------------
+
+    def _acquire_hedge(self, klass: Optional[str]) -> bool:
+        """Take one concurrent-hedge token for ``klass``; False when the
+        class's budget is exhausted.  Class-less reads always succeed
+        (legacy behavior: their only cap is one hedge per primary)."""
+        if klass is None:
+            return True
+        budget = self.hedge_budgets.get(klass)
+        if budget is None:
+            return True
+        with self._hedge_lock:
+            if self._hedge_out.get(klass, 0) >= budget:
+                return False
+            self._hedge_out[klass] = self._hedge_out.get(klass, 0) + 1
+            return True
+
+    def _release_hedge(self, klass: Optional[str]) -> None:
+        if klass is None or klass not in self.hedge_budgets:
+            return
+        with self._hedge_lock:
+            n = self._hedge_out.get(klass, 0)
+            if n > 0:
+                self._hedge_out[klass] = n - 1
+
+    def hedges_outstanding(self, klass: str) -> int:
+        with self._hedge_lock:
+            return self._hedge_out.get(klass, 0)
 
     # -- delegation --------------------------------------------------------
 
@@ -554,8 +661,8 @@ class ResilientEngine:
             with self._zombie_lock:
                 self._zombies.extend(survivors)
 
-    def submit_read(self, fh: int, offset: int,
-                    length: int) -> ResilientRead:
+    def submit_read(self, fh: int, offset: int, length: int,
+                    klass: Optional[str] = None) -> ResilientRead:
         self._reap_zombies()   # lost hedges hand buffers back here
         pending = self._engine.submit_read(fh, offset, length)
         # size AFTER submit: the C engine re-fstats the file at every
@@ -567,18 +674,21 @@ class ResilientEngine:
         except OSError:
             size = 0
         expected = min(length, max(0, size - offset))
-        return ResilientRead(self, fh, offset, length, pending, expected)
+        return ResilientRead(self, fh, offset, length, pending, expected,
+                             klass=klass)
 
-    def submit_readv(self, reads) -> list:
+    def submit_readv(self, reads, klass: Optional[str] = None) -> list:
         """Batch-aware vectored submission: the whole batch goes down
         in ONE wrapped-engine call (keeping the syscall amortization),
         but every extent comes back as its OWN ResilientRead — a
         failed/short/stuck span retries, hedges, and cancels alone;
-        the rest of the batch is never resubmitted."""
+        the rest of the batch is never resubmitted.  ``klass`` flows
+        down to the scheduler AND selects the per-class retry/hedge
+        budgets each ResilientRead runs under."""
         from nvme_strom_tpu.io.plan import submit_spans
         self._reap_zombies()   # lost hedges hand buffers back here
         reads = list(reads)
-        pendings = submit_spans(self._engine, reads)
+        pendings = submit_spans(self._engine, reads, klass=klass)
         sizes: dict = {}
         out = []
         for (fh, offset, length), pending in zip(reads, pendings):
@@ -591,7 +701,7 @@ class ResilientEngine:
                 sizes[fh] = size
             expected = min(length, max(0, size - offset))
             out.append(ResilientRead(self, fh, offset, length, pending,
-                                     expected))
+                                     expected, klass=klass))
         return out
 
     def read(self, fh: int, offset: int, length: int) -> np.ndarray:
@@ -616,16 +726,17 @@ class ResilientEngine:
 
     # -- policy helpers ----------------------------------------------------
 
-    def _hedge_after(self) -> Optional[float]:
-        """Seconds after which an in-flight read earns a hedge; None
-        disables hedging (config, or the histogram is still cold)."""
-        cfg = self.rconfig
+    def _hedge_after(self, klass: Optional[str] = None) -> Optional[float]:
+        """Seconds after which an in-flight read of ``klass`` earns a
+        hedge; None disables hedging (per-class config, or the
+        histogram is still cold)."""
+        cfg = self.config_for(klass)
         if not cfg.hedging:
             return None
         if cfg.hedge_after_s > 0:
             return cfg.hedge_after_s
         now = time.monotonic()
-        computed_at, cached = self._hedge_cache
+        computed_at, cached = self._hedge_cache.get(klass, (-1.0, None))
         if now - computed_at < 1.0:
             return cached
         try:
@@ -637,7 +748,7 @@ class ResilientEngine:
         # None while no read has completed — nothing to derive from
         val = (max(cfg.hedge_min_s, ns / 1e9 * cfg.hedge_multiplier)
                if ns else None)
-        self._hedge_cache = (now, val)
+        self._hedge_cache[klass] = (now, val)
         return val
 
     def _trace(self, name: str, t0_ns: int, **args) -> None:

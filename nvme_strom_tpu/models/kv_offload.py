@@ -252,6 +252,7 @@ class PagedKVCache:
         self._page_stride = 2 * (self._pb_block + self._sb_block)
         self._fh = engine.open(ocfg.path, writable=True)
         self._stream = DeviceStream(engine, device=self.device,
+                                    klass="decode",
                                     depth=engine.config.queue_depth)
         # in-flight eviction writes (PendingWrite keeps the host buffer
         # alive); drained before any read and bounded by _MAX_PENDING

@@ -153,7 +153,7 @@ class DeviceStream:
     """
 
     def __init__(self, engine: StromEngine, device=None, depth: int = 3,
-                 drain: str = "blocking"):
+                 drain: str = "blocking", klass: Optional[str] = None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
         if drain not in ("blocking", "ready"):
@@ -162,6 +162,10 @@ class DeviceStream:
         self.device = device
         self.depth = depth
         self.drain = drain
+        #: latency class every batch of this stream submits under
+        #: (io/sched.py; the per-stream default — stream_ranges can
+        #: override per call)
+        self.klass = klass
 
     def _put(self, view: np.ndarray, dtype, shape):
         dev = self.device or _default_device()
@@ -188,7 +192,8 @@ class DeviceStream:
 
     def stream_ranges(self, fh: int, ranges: Sequence[tuple[int, int]],
                       dtype=None, shapes: Optional[Sequence] = None,
-                      verify: Optional[Callable] = None) -> Iterator:
+                      verify: Optional[Callable] = None,
+                      klass: Optional[str] = None) -> Iterator:
         """Yield device arrays for arbitrary (offset, length) ranges of an
         open file — the planner-facing API used by the format readers.
 
@@ -196,7 +201,13 @@ class DeviceStream:
         the completed staging view BEFORE the device transfer — the one
         window where payload bytes are host-visible on this path, so
         read-side integrity checks (STROM_VERIFY, utils/checksum.py)
-        hook here; raising aborts the stream loudly."""
+        hook here; raising aborts the stream loudly.
+
+        ``klass``: latency class of this stream's batches (defaults to
+        the stream's own ``klass``) — the QoS tag consumers set so the
+        scheduler can rank their traffic (io/sched.py)."""
+        if klass is None:
+            klass = self.klass
         pending: list = []   # (PendingRead, shape, range_index)
         inflight: list = []  # (device_array, PendingRead)
 
@@ -242,7 +253,8 @@ class DeviceStream:
                 # boundary crossing per chunk
                 take = ranges[i:i + self.depth]
                 prs = submit_spans(self.engine,
-                                   [(fh, off, ln) for off, ln in take])
+                                   [(fh, off, ln) for off, ln in take],
+                                   klass=klass)
                 for j, pr in enumerate(prs):
                     shape = (shapes_l[i + j] if shapes_l is not None
                              else None)

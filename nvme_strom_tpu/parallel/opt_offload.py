@@ -150,7 +150,8 @@ class OffloadedAdam:
             from nvme_strom_tpu.io.faults import build_engine
             engine = build_engine(config or EngineConfig())
         self.engine = engine
-        self.stream = DeviceStream(self.engine, depth=depth, drain="ready")
+        self.stream = DeviceStream(self.engine, depth=depth, drain="ready",
+                                   klass="restore")
 
         try:
             self._init_state(path, params, group_bytes)

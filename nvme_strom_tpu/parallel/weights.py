@@ -267,7 +267,8 @@ class LazyCheckpoint:
         if not gshape:
             ent = sf.plan([name]).entries[0]
             (pieces,) = plan_and_submit(eng, [(fh, ent.offset,
-                                               ent.length)])
+                                               ent.length)],
+                                        klass="restore")
             (p,) = pieces   # scalar payload never splits
             done = False
             try:
@@ -299,7 +300,7 @@ class LazyCheckpoint:
                 pos = 0
                 (pend,) = plan_and_submit(
                     eng, [(fh, ent.offset, ent.length)],
-                    chunk_bytes=eng.config.chunk_bytes)
+                    chunk_bytes=eng.config.chunk_bytes, klass="restore")
                 for p in pend:
                     # cumulative assembly: a silently short view would
                     # leave a garbage tail that reshapes cleanly
@@ -325,7 +326,8 @@ class LazyCheckpoint:
             ent = sf.slice_plan(name, r, n)
             slices.append(((fh, ent.offset, ent.length), ent.shape))
         planned = plan_and_submit(eng, [s for s, _ in slices],
-                                  chunk_bytes=eng.config.chunk_bytes)
+                                  chunk_bytes=eng.config.chunk_bytes,
+                                  klass="restore")
         pend = []
         for ((_, _, ln), shp), pieces in zip(slices, planned):
             if not pieces:    # zero-element slice: no I/O to wait on

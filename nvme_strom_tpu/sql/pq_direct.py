@@ -1389,6 +1389,7 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
                                   allow_nulls=nulls == "mask")
     depth, drain = tuned_stream_params(scanner.engine)
     ds = DeviceStream(scanner.engine, device=dev, depth=depth,
+                      klass="prefetch",
                       drain=drain)
     out = {}
     meta = scanner.metadata
@@ -1708,6 +1709,7 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
     # identical link at 0.88-0.91
     depth, drain = tuned_stream_params(scanner.engine)
     ds = DeviceStream(scanner.engine, device=dev, depth=depth,
+                      klass="prefetch",
                       drain=drain)
     fh = scanner.engine.open(scanner.path)
     try:

@@ -92,7 +92,8 @@ def _crc_spans(eng, fh, spans) -> Dict[int, tuple]:
         pos = 0
         while pos < ln and si not in done:
             n = min(chunk, ln - pos)
-            pend.append((eng.submit_read(fh, off + pos, n), si,
+            pend.append((eng.submit_read(fh, off + pos, n,
+                                         klass="scrub"), si,
                          pos + n == ln))
             pos += n
             while len(pend) >= depth:

@@ -53,6 +53,14 @@ _BATCH_COUNTERS = (
     "spans_coalesced", "submit_batches", "submit_syscalls_saved",
 )
 
+#: QoS scheduler counters (io/sched.py over the multi-ring engine —
+#: docs/PERF.md); own block with per-ring depth and per-class tallies,
+#: shown only when a scheduler dispatched anything
+_SCHED_COUNTERS = (
+    "sched_enqueued", "sched_dispatches", "sched_promotions",
+    "hedges_denied",
+)
+
 
 def render_device(path: str) -> str:
     """Backing-device topology of ``path`` — the observable form of the
@@ -113,6 +121,33 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
                 f"    coalesce ratio       "
                 f"{merged / (merged + subs):>14.3f}   "
                 "(extents merged / extents planned)")
+    if (any(int(snap.get(n, 0)) for n in _SCHED_COUNTERS)
+            or snap.get("class_stats") or snap.get("ring_depths")):
+        lines.append("  scheduler (QoS classes over the ring shards):")
+        for name in _SCHED_COUNTERS:
+            lines.append(f"    {name:<20} {int(snap.get(name, 0)):>14}")
+        depths = snap.get("ring_depths")
+        if depths:
+            shown = " ".join(str(int(d)) for d in depths)
+            lines.append(f"    ring depth           {shown:>14}   "
+                         "(in-flight I/O per ring)")
+        cls = snap.get("class_stats") or {}
+        for k in sorted(cls, key=lambda c: -cls[c].get("dispatches", 0)):
+            blk = cls[k]
+            n_w = int(blk.get("queue_wait_s_n", 0))
+            avg_ms = (1000.0 * blk.get("queue_wait_s_sum", 0.0) / n_w
+                      if n_w else 0.0)
+            max_ms = 1000.0 * blk.get("queue_wait_s_max", 0.0)
+            lines.append(
+                f"    class {k:<12} "
+                f"dispatches={int(blk.get('dispatches', 0))} "
+                f"spans={int(blk.get('spans', 0))} "
+                f"promoted={int(blk.get('promotions', 0))} "
+                f"wait avg/max={avg_ms:.2f}/{max_ms:.2f} ms "
+                f"hedges={int(blk.get('hedges_issued', 0))}"
+                f"/{int(blk.get('hedges_won', 0))} "
+                f"denied={int(blk.get('hedges_denied', 0))} "
+                f"retries={int(blk.get('retries', 0))}")
     if any(int(snap.get(n, 0)) for n in _RESILIENCE_COUNTERS):
         lines.append("  resilience (recoveries + degradations):")
         for name in _RESILIENCE_COUNTERS:

@@ -53,6 +53,16 @@ class EngineConfig:
     stripe_accounting: bool = field(
         default_factory=lambda: os.environ.get("STROM_STRIPE_ACCT",
                                                "0") == "1")
+    #: submission rings the engine shards into (docs/PERF.md): each ring
+    #: is an independent io_uring (or worker pool) with its own staging
+    #: pool slice, deferral queue, and completion reaping, so concurrent
+    #: traffic classes never serialize behind one doorbell.  0 (default)
+    #: = auto from CPU topology and the NVMe device's hardware queue
+    #: count, capped by what the configured queue_depth/buffer pool can
+    #: feed (an engine too small to shard stays single-ring — the exact
+    #: pre-sharding behavior, also forced by STROM_RINGS=1).
+    n_rings: int = field(
+        default_factory=lambda: _env_int("STROM_RINGS", 0))
 
     def __post_init__(self):
         if (self.alignment < 512 or self.alignment > (1 << 22)
@@ -75,6 +85,51 @@ class EngineConfig:
                 f"least one chunk ({self.chunk_bytes})")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if not 0 <= self.n_rings <= 64:
+            raise ValueError(
+                f"n_rings ({self.n_rings}) must be in [0, 64] "
+                "(0 = auto; 64 = STROM_MAX_RINGS, the request-id "
+                "ring-bits budget)")
+
+
+@dataclass(frozen=True)
+class SchedConfig:
+    """QoS scheduler knobs (io/sched.py; semantics in docs/PERF.md).
+
+    The scheduler sits at the planned-batch boundary of a multi-ring
+    engine: every batch carries a latency class, classes share rings by
+    weighted fair-share (strict priority order, one round's deficit of
+    banking), and aging promotes any batch stuck longer than
+    ``aging_rounds`` dispatch rounds so the lowest class can never
+    starve outright.  STROM_* environment variables are read at
+    construction time, mirroring EngineConfig.
+    """
+
+    #: scheduler on/off (STROM_SCHED=0 disables even on a multi-ring
+    #: engine: batches then route round-robin exactly like scalar reads)
+    enabled: bool = field(
+        default_factory=lambda: os.environ.get("STROM_SCHED", "1") != "0")
+    #: dispatch rounds a queued batch may wait before aging promotes it
+    #: ahead of every weight/priority consideration — the starvation
+    #: bound (tests/test_sched.py proves it)
+    aging_rounds: int = field(
+        default_factory=lambda: _env_int("STROM_SCHED_AGING_K", 16))
+    #: per-ring in-flight I/O budget gating dispatch; 0 = the ring's
+    #: queue depth.  Measured as submitted-minus-COMPLETED (not
+    #: released), so a consumer sitting on completed views can never
+    #: wedge admission.
+    max_inflight_per_ring: int = field(
+        default_factory=lambda: _env_int("STROM_SCHED_INFLIGHT", 0))
+    #: "decode=8,restore=4,prefetch=2,scrub=1" — overrides the default
+    #: class weights (io/sched.py DEFAULT_POLICIES)
+    class_weights: str = field(
+        default_factory=lambda: os.environ.get("STROM_CLASS_WEIGHTS", ""))
+
+    def __post_init__(self):
+        if self.aging_rounds < 1:
+            raise ValueError("aging_rounds must be >= 1")
+        if self.max_inflight_per_ring < 0:
+            raise ValueError("max_inflight_per_ring must be >= 0")
 
 
 @dataclass(frozen=True)

@@ -82,6 +82,18 @@ class StromStats:
     # older intact step
     shards_quarantined: int = 0
     restore_fallbacks: int = 0
+    # -- QoS scheduler (io/sched.py over the multi-ring engine) -----------
+    # planned batches queued at the scheduler, batches dispatched to a
+    # ring, and aging promotions (batches that hit the starvation bound
+    # and jumped the weight/priority order); per-class breakdowns live
+    # in class_stats (add_class_stat)
+    sched_enqueued: int = 0
+    sched_dispatches: int = 0
+    sched_promotions: int = 0
+    # hedged reads refused because the request's latency class had
+    # exhausted its concurrent-hedge budget (per-class isolation: a
+    # scrub storm starves its OWN hedges, never the decode class's)
+    hedges_denied: int = 0
     # -- write-path resilience + end-to-end integrity (io/resilient.py
     # submit_write, utils/checksum.py) ------------------------------------
     # failed/short writes resubmitted by ResilientEngine's write mirror
@@ -99,11 +111,38 @@ class StromStats:
     # SURVEY.md §6): {member name: bytes}; filled only when stripe
     # accounting is on (EngineConfig.stripe_accounting)
     _member_bytes: dict = field(default_factory=dict, repr=False)
+    # per-latency-class tallies (QoS scheduler + per-class resilience
+    # budgets): {class: {counter: value}}; exported as "class_stats"
+    _class_stats: dict = field(default_factory=dict, repr=False)
 
     def add(self, **deltas: int) -> None:
         with self._lock:
             for name, d in deltas.items():
                 setattr(self, name, getattr(self, name) + d)
+
+    def add_class_stat(self, klass: str, **deltas) -> None:
+        """Accumulate per-latency-class counters (scheduler dispatches,
+        per-class hedges/retries) under one lock with the flat block."""
+        with self._lock:
+            blk = self._class_stats.setdefault(klass, {})
+            for name, d in deltas.items():
+                blk[name] = blk.get(name, 0) + d
+
+    def class_stat_gauges(self, klass: str, **values: float) -> None:
+        """Per-class point-in-time values: each keeps a running max and
+        a running sum/count (so the export carries avg + worst-case
+        queue wait per class without a reservoir)."""
+        with self._lock:
+            blk = self._class_stats.setdefault(klass, {})
+            for name, v in values.items():
+                blk[f"{name}_max"] = max(blk.get(f"{name}_max", 0.0), v)
+                blk[f"{name}_sum"] = blk.get(f"{name}_sum", 0.0) + v
+                blk[f"{name}_n"] = blk.get(f"{name}_n", 0) + 1
+
+    @property
+    def class_stats(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._class_stats.items()}
 
     def add_member_bytes(self, members, deltas) -> None:
         """Accumulate per-raid-member payload bytes (parallel lists)."""
@@ -143,6 +182,9 @@ class StromStats:
             snap.update(self._gauges)
             if self._member_bytes:
                 snap["member_bytes"] = dict(self._member_bytes)
+            if self._class_stats:
+                snap["class_stats"] = {k: dict(v)
+                                       for k, v in self._class_stats.items()}
             return snap
 
     def dump_json(self) -> str:
@@ -154,6 +196,7 @@ class StromStats:
                 setattr(self, name, 0)
             self._gauges.clear()
             self._member_bytes.clear()
+            self._class_stats.clear()
             self._t0 = time.monotonic()
 
     def maybe_export(self) -> None:

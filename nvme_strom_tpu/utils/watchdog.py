@@ -167,6 +167,27 @@ class StepWatchdog:
                       f"syscalls_saved={s.submit_syscalls_saved} "
                       f"coalesced={s.spans_coalesced}",
                       file=w, flush=True)
+                # scheduler tier (multi-ring QoS, io/sched.py): a hang
+                # with deep rings is device-bound; a hang with EMPTY
+                # rings but queued batches means the scheduler (or its
+                # admission budget) is the bottleneck — per-ring depth
+                # makes the two distinguishable at a glance
+                try:
+                    depths = eng.ring_depths()
+                except (AttributeError, OSError):
+                    depths = None
+                if depths is not None and len(depths) > 1:
+                    cls = s.class_stats
+                    cls_brief = " ".join(
+                        f"{k}={v.get('dispatches', 0)}"
+                        for k, v in sorted(cls.items())) or "-"
+                    print(f"scheduler: rings={depths} "
+                          f"enq={s.sched_enqueued} "
+                          f"disp={s.sched_dispatches} "
+                          f"promoted={s.sched_promotions} "
+                          f"hedges_denied={s.hedges_denied} "
+                          f"class_dispatches[{cls_brief}]",
+                          file=w, flush=True)
                 # the recovery tier's own accounting: a hung step whose
                 # resilient counters are MOVING is recovering, not
                 # wedged — the distinction this dump exists to make

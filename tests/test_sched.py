@@ -1,0 +1,410 @@
+"""QoS scheduler + multi-ring engine tests (hardware-free, `-m perf`).
+
+The scheduler core (io/sched.py) takes injectable ``submit_ring`` /
+``ring_free`` callables, so its dispatch properties — strict priority,
+weighted fair-share, the aging starvation bound, urgent-ring placement —
+are proven deterministically against fakes, no engine and no hardware.
+The integration half runs a REAL multi-ring engine (thread-pool backend)
+against tmp files: content correctness through every class tag, per-ring
+counters, per-class hedge-budget isolation, and the single-ring
+degenerate mode matching pre-sharding behavior exactly.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io import StromEngine
+from nvme_strom_tpu.io.plan import plan_and_submit
+from nvme_strom_tpu.io.resilient import ReadError, ResilientEngine
+from nvme_strom_tpu.io.sched import (ClassPolicy, QoSScheduler,
+                                     default_policies)
+from nvme_strom_tpu.utils.config import EngineConfig, ResilientConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+pytestmark = pytest.mark.perf
+
+
+# -- scheduler core against fakes -------------------------------------------
+
+
+class _Fake:
+    """Records grants; capacity is a mutable list of free slots."""
+
+    def __init__(self, slots):
+        self.slots = list(slots)
+        self.granted = []          # (klass marker via spans, ring)
+
+    def submit_ring(self, spans, ring):
+        self.granted.append((tuple(spans), ring))
+        return ["pend"] * len(spans)
+
+    def ring_free(self):
+        return list(self.slots)
+
+
+def _sched(fake, policies=None, aging=16, stats=None, cap=None):
+    return QoSScheduler(fake.submit_ring, fake.ring_free,
+                        policies=policies, aging_rounds=aging,
+                        stats=stats, ring_cap=cap)
+
+
+def test_priority_ordering():
+    """Bulk classes grant strictly by priority when capacity is scarce
+    (one grant per round)."""
+    fake = _Fake([2])          # 1 ring, 2 free slots: one bulk grant
+    s = _sched(fake, cap=2)    # per round (reserve keeps 1 back)
+    bs = s.enqueue([("scrub", 0, 1)], "scrub")
+    bp = s.enqueue([("prefetch", 0, 1)], "prefetch")
+    br = s.enqueue([("restore", 0, 1)], "restore")
+    assert s.step()
+    assert br.granted and not bp.granted and not bs.granted
+    s.ack_submitted(br)        # capacity handed to the engine counters
+    fake.slots = [2]           # ... which report it free again
+    assert s.step()
+    assert bp.granted and not bs.granted
+    s.ack_submitted(bp)
+    fake.slots = [2]
+    # scrub's own weight credit (1.0/round, accumulated) grants it now
+    assert s.step()
+    assert bs.granted
+
+
+def test_decode_never_admission_queued():
+    """The top class grants even with ZERO free slots — admission
+    control exists to bound bulk, never the latency-critical class."""
+    fake = _Fake([0, 0])
+    s = _sched(fake, cap=4)
+    bd = s.enqueue([("decode", 0, 1)], "decode")
+    bp = s.enqueue([("prefetch", 0, 1)], "prefetch")
+    assert s.step()
+    assert bd.granted and bd.ring is not None
+    assert not bp.granted      # bulk waits for capacity
+
+
+def test_fair_share_weights():
+    """Saturated restore (w=4) and scrub (w=1) queues share grants
+    4:1 under ample capacity."""
+    fake = _Fake([100])
+    s = _sched(fake, cap=100)
+    restore = [s.enqueue([("restore", i, 1)], "restore")
+               for i in range(40)]
+    scrub = [s.enqueue([("scrub", i, 1)], "scrub") for i in range(40)]
+    acked = set()
+    for _ in range(5):
+        fake.slots = [100]
+        s.step()
+        for b in restore + scrub:
+            if b.granted and id(b) not in acked:
+                acked.add(id(b))
+                s.ack_submitted(b)
+    restore_n = sum(1 for b in restore if b.granted)
+    scrub_n = sum(1 for b in scrub if b.granted)
+    assert restore_n == 4 * scrub_n, (restore_n, scrub_n)
+    assert scrub_n == 5        # served every round, never starved
+
+
+def test_aging_starvation_bound():
+    """ACCEPTANCE: the lowest-priority class completes within K dispatch
+    rounds even under a saturating higher-priority load that would
+    otherwise win every slot."""
+    K = 4
+    pol = default_policies()
+    pol["restore"] = ClassPolicy("restore", 1, weight=1000.0)
+    fake = _Fake([2])
+    s = _sched(fake, policies=pol, aging=K, cap=2)
+    scrub = s.enqueue([("scrub", 0, 1)], "scrub")
+    rounds_to_grant = None
+    for rnd in range(K + 2):
+        # saturating high-priority load: fresh restore work every round
+        s.enqueue([(f"restore{rnd}", 0, 1)], "restore")
+        fake.slots = [2]       # one bulk grant's worth per round
+        s.step()
+        if scrub.granted and rounds_to_grant is None:
+            rounds_to_grant = rnd + 1
+    assert scrub.granted, "scrub starved past the aging bound"
+    assert rounds_to_grant <= K + 1, rounds_to_grant
+    assert scrub.promoted      # granted via the aging path
+    assert s.promotions == 1
+
+
+def test_zero_capacity_round_does_not_age():
+    """A round with no capacity must not burn the starvation budget
+    (else a long device stall promotes everything at once)."""
+    fake = _Fake([0])
+    s = _sched(fake, aging=3, cap=2)
+    b = s.enqueue([("scrub", 0, 1)], "scrub")
+    for _ in range(10):
+        s.step()               # zero capacity: no progress, no aging
+    assert b.rounds == 0 and not b.granted
+
+
+def test_urgent_ring_reservation():
+    """Bulk classes avoid ring 0 unless it is COMPLETELY idle; the top
+    class lands least-loaded including ring 0."""
+    fake = _Fake([3, 4])       # ring 0 not idle (cap 4): bulk -> ring 1
+    s = _sched(fake, cap=4)
+    bp = s.enqueue([("prefetch", 0, 1)], "prefetch")
+    s.step()
+    assert bp.granted and bp.ring == 1
+    # fully idle ring 0 is usable by bulk (work-conserving)
+    fake2 = _Fake([4, 1])
+    s2 = _sched(fake2, cap=4)
+    bp2 = s2.enqueue([("prefetch", 0, 1)], "prefetch")
+    s2.step()
+    assert bp2.granted and bp2.ring == 0
+
+
+def test_cap_one_stays_work_conserving():
+    """REGRESSION (review): with a per-ring admission budget of 1
+    (qd_ring=1 topologies, STROM_SCHED_INFLIGHT=1) the bulk headroom
+    reserve must collapse to 0 — an idle engine grants a lone bulk
+    batch on the FIRST round, not after the aging bound."""
+    fake = _Fake([1] * 8)
+    s = _sched(fake, cap=1)
+    b = s.enqueue([("prefetch", 0, 1)], "prefetch")
+    assert s.step()
+    assert b.granted and not b.promoted and b.rounds == 0
+
+
+def test_close_unblocks_grant_waiter():
+    """REGRESSION (review): engine teardown must wake a thread blocked
+    in submit()'s grant loop (raising ECANCELED) instead of leaving it
+    polling ring state on a dying engine."""
+    import threading
+
+    fake = _Fake([0])           # capacity never appears
+    s = _sched(fake, cap=4)
+    err = []
+
+    def blocked():
+        try:
+            s.submit([("prefetch", 0, 1)], "prefetch")
+        except OSError as e:
+            err.append(e)
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    t.join(timeout=0.2)
+    assert t.is_alive()          # genuinely blocked on capacity
+    s.close()
+    import errno as _errno
+    t.join(timeout=2.0)
+    assert not t.is_alive() and err
+    assert err[0].errno == _errno.ECANCELED
+    with pytest.raises(OSError):
+        s.submit([("x", 0, 1)], "prefetch")   # refused after close
+
+
+def test_sched_counters_flow_to_stats():
+    st = StromStats()
+    fake = _Fake([10])
+    s = _sched(fake, stats=st, cap=10)
+    pendings = s.submit([("x", 0, 1), ("y", 0, 1)], "restore")
+    assert pendings == ["pend", "pend"]
+    snap = st.snapshot()
+    assert snap["sched_enqueued"] == 1
+    assert snap["sched_dispatches"] == 1
+    cls = snap["class_stats"]["restore"]
+    assert cls["dispatches"] == 1 and cls["spans"] == 2
+    assert cls["queue_wait_s_n"] == 1
+
+
+def test_unknown_class_rides_default():
+    fake = _Fake([10])
+    st = StromStats()
+    s = _sched(fake, stats=st, cap=10)
+    s.submit([("x", 0, 1)], "no-such-class")
+    assert "prefetch" in st.snapshot()["class_stats"]
+
+
+# -- real multi-ring engine --------------------------------------------------
+
+
+def _cfg(**kw):
+    base = dict(chunk_bytes=1 << 20, queue_depth=8,
+                buffer_pool_bytes=16 << 20)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture
+def data_file(tmp_path):
+    path = tmp_path / "sched.bin"
+    payload = np.random.default_rng(7).integers(
+        0, 256, 4 << 20, dtype=np.uint8).tobytes()
+    path.write_bytes(payload)
+    return path, payload
+
+
+def test_multi_ring_reads_all_classes(data_file):
+    """Content correctness through every class tag on a sharded engine;
+    per-ring counters account every submission."""
+    path, payload = data_file
+    with StromEngine(_cfg(n_rings=2, use_io_uring=False),
+                     stats=StromStats()) as eng:
+        assert eng.n_rings == 2
+        assert eng.scheduler is not None
+        fh = eng.open(path)
+        for klass in ("decode", "restore", "prefetch", "scrub", None):
+            planned = plan_and_submit(
+                eng, [(fh, i * 100_000, 50_000) for i in range(6)],
+                klass=klass)
+            for i, pieces in enumerate(planned):
+                for p in pieces:
+                    assert p.wait().tobytes() == \
+                        payload[i * 100_000:i * 100_000 + 50_000]
+                    p.release()
+        eng.close(fh)
+        infos = [eng.ring_info(r) for r in range(eng.n_rings)]
+        assert sum(i["submitted"] for i in infos) \
+            == eng.engine_stats()["requests_submitted"]
+        assert all(i["inflight_io"] == 0 for i in infos)
+        assert len(eng.ring_depths()) == 2
+        # aggregate pool info stays coherent across ring slices
+        pi = eng.pool_info()
+        assert pi["n_buffers"] == eng.n_buffers
+        assert pi["free_buffers"] == pi["n_buffers"]
+
+
+def test_ring_pinned_submission(data_file):
+    """ring= pins a batch to one ring and bypasses the scheduler."""
+    path, payload = data_file
+    with StromEngine(_cfg(n_rings=2, use_io_uring=False),
+                     stats=StromStats()) as eng:
+        fh = eng.open(path)
+        before = eng.ring_info(1)["submitted"]
+        prs = eng.submit_readv([(fh, 0, 4096), (fh, 8192, 4096)], ring=1)
+        for p in prs:
+            p.wait()
+            p.release()
+        assert eng.ring_info(1)["submitted"] == before + 2
+        assert eng.stats.sched_dispatches == 0   # scheduler bypassed
+        eng.close(fh)
+
+
+def test_single_ring_degenerate_mode(data_file, monkeypatch):
+    """STROM_RINGS=1 reproduces pre-sharding behavior: no scheduler, one
+    ring, identical read results and submission accounting whether or
+    not batches carry a class tag."""
+    path, payload = data_file
+    monkeypatch.setenv("STROM_RINGS", "1")
+    with StromEngine(_cfg(), stats=StromStats()) as eng:
+        assert eng.n_rings == 1
+        assert eng.scheduler is None
+        fh = eng.open(path)
+        tagged = eng.submit_readv([(fh, 0, 8192)], klass="decode")
+        plain = eng.submit_readv([(fh, 0, 8192)])
+        assert tagged[0].wait().tobytes() == plain[0].wait().tobytes() \
+            == payload[:8192]
+        tagged[0].release()
+        plain[0].release()
+        snap = eng.engine_stats()
+        assert snap["requests_submitted"] == 2
+        assert snap["submit_batches"] == 2
+        # no scheduler activity, no class accounting: the old engine
+        assert eng.stats.sched_enqueued == 0
+        assert eng.stats.snapshot().get("class_stats") is None
+        eng.close(fh)
+
+
+def test_tiny_engine_stays_single_ring():
+    """An engine too small to shard (pool of 2 buffers) resolves auto
+    rings to 1 — pre-sharding deferral semantics preserved exactly."""
+    with StromEngine(_cfg(chunk_bytes=16 << 10,
+                          buffer_pool_bytes=32 << 10, queue_depth=2,
+                          use_io_uring=False),
+                     stats=StromStats()) as eng:
+        assert eng.n_rings == 1 and eng.scheduler is None
+
+
+# -- per-class resilience budgets -------------------------------------------
+
+
+def test_per_class_retry_config(data_file):
+    """SATELLITE FIX: retry/hedge policy is per-class config objects,
+    not process-global env — a scrub read can run fail-fast while the
+    default classes keep the full budget, no env churn."""
+    from nvme_strom_tpu.io.faults import FaultPlan, FaultyEngine
+    path, _ = data_file
+    plan = FaultPlan.parse("eio:p=1.0")   # every read fails
+    base = StromEngine(_cfg(n_rings=1, use_io_uring=False),
+                       stats=StromStats())
+    eng = ResilientEngine(
+        FaultyEngine(base, plan),
+        config=ResilientConfig(max_retries=2, backoff_base_s=0.0,
+                               hedging=False),
+        class_configs={"scrub": ResilientConfig(
+            max_retries=0, backoff_base_s=0.0, hedging=False)})
+    with base:
+        fh = eng.open(path)
+        with pytest.raises(ReadError) as ei:
+            eng.submit_read(fh, 0, 4096, klass="scrub").wait()
+        assert len(ei.value.attempts) == 1      # fail-fast: 0 retries
+        with pytest.raises(ReadError) as ei:
+            eng.submit_read(fh, 0, 4096, klass="prefetch").wait()
+        assert len(ei.value.attempts) == 3      # engine-wide budget
+        eng.close(fh)
+
+
+def test_hedge_budget_isolation(data_file):
+    """ACCEPTANCE: per-class hedge budgets — a class with budget 0 is
+    denied hedges (counted) while another class still hedges, against
+    the same engine at the same moment."""
+    from nvme_strom_tpu.io.faults import FaultPlan, FaultyEngine
+    path, payload = data_file
+    # every read is a 150 ms straggler: with hedge_after_s=0.02 every
+    # wait wants a hedge
+    plan = FaultPlan.parse("delay:p=1.0:delay_s=0.15")
+    st = StromStats()
+    base = StromEngine(_cfg(n_rings=1, use_io_uring=False), stats=st)
+    rcfg = ResilientConfig(hedge_after_s=0.02, hedging=True,
+                           backoff_base_s=0.0)
+    eng = ResilientEngine(FaultyEngine(base, plan), config=rcfg,
+                          hedge_budgets={"scrub": 0, "decode": 4})
+    with base:
+        fh = eng.open(path)
+        p = eng.submit_read(fh, 0, 4096, klass="scrub")
+        assert p.wait().tobytes() == payload[:4096]
+        p.release()
+        assert st.hedges_denied >= 1
+        assert st.class_stats["scrub"].get("hedges_issued", 0) == 0
+        denied_before = st.hedges_denied
+        p = eng.submit_read(fh, 0, 4096, klass="decode")
+        assert p.wait().tobytes() == payload[:4096]
+        p.release()
+        assert st.class_stats["decode"]["hedges_issued"] >= 1
+        assert st.hedges_denied == denied_before   # decode never denied
+        assert eng.hedges_outstanding("decode") == 0   # token returned
+        assert eng.hedges_outstanding("scrub") == 0
+        eng.close(fh)
+
+
+def test_classes_flow_through_wrappers(data_file):
+    """klass survives Resilient(Faulty(Strom)) down to the scheduler:
+    class_stats record the batch under its tag on a sharded engine."""
+    from nvme_strom_tpu.io.faults import FaultPlan, FaultyEngine
+    path, payload = data_file
+    st = StromStats()
+    base = StromEngine(_cfg(n_rings=2, use_io_uring=False), stats=st)
+    eng = ResilientEngine(FaultyEngine(base, FaultPlan([])),
+                          config=ResilientConfig(hedging=False))
+    with base:
+        fh = eng.open(path)
+        prs = eng.submit_readv([(fh, 0, 4096), (fh, 4096, 4096)],
+                               klass="decode")
+        for i, p in enumerate(prs):
+            assert p.wait().tobytes() == \
+                payload[i * 4096:(i + 1) * 4096]
+            p.release()
+        assert st.class_stats["decode"]["dispatches"] == 1
+        assert st.class_stats["decode"]["spans"] == 2
+        eng.close(fh)
+
+
+def test_auto_ring_count_caps():
+    from nvme_strom_tpu.io.engine import auto_ring_count
+    n = auto_ring_count()
+    assert 1 <= n <= 8
+    assert n & (n - 1) == 0      # power of two
