@@ -2000,6 +2000,10 @@ def run(configs: list[int], emit=None) -> list[dict]:
                     # and 3-decimal rounding would floor it to a 0.0 row
                     "value": float(f"{val:.4g}"),
                     "unit": unit,
+                    # machine-readable platform tag: BENCH_r* trajectories
+                    # mix tunnel-up TPU rows with CPU-fallback rows, and
+                    # only this field makes them comparable after the fact
+                    "platform": dev_tag,
                     # Ratios against a CPU-derived ceiling are not the north
                     # star — never emit a number a reader could mistake for
                     # "target met" from a CPU-fallback run.  On a live

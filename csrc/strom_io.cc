@@ -1286,6 +1286,24 @@ int strom_file_is_direct(strom_engine *e, int fh) {
   return it == e->files.end() ? -EBADF : (it->second.fd_direct >= 0 ? 1 : 0);
 }
 
+int strom_file_ident(strom_engine *e, int fh, uint64_t out[4]) {
+  int fd;
+  {
+    std::lock_guard<std::mutex> g(e->files_mu);
+    auto it = e->files.find(fh);
+    if (it == e->files.end()) return -EBADF;
+    fd = it->second.fd_buffered;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) return -errno;
+  out[0] = (uint64_t)st.st_dev;
+  out[1] = (uint64_t)st.st_ino;
+  out[2] = (uint64_t)st.st_mtim.tv_sec * 1000000000ull +
+           (uint64_t)st.st_mtim.tv_nsec;
+  out[3] = (uint64_t)st.st_size;
+  return 0;
+}
+
 /* Shared submit body: validate + size-refresh under files_mu (leaf
  * lock), residency-probe with NO lock held, then stage on the chosen
  * ring under that ring's mutex only. */
@@ -1709,6 +1727,37 @@ uint32_t strom_crc32c(const void *data, uint64_t len, uint32_t crc) {
   }
   while (len--) c = g_crc_tbl[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
   return ~c;
+}
+
+/* ------------- pinned host-DRAM cache arena (io/hostcache.py) ------------- */
+
+void *strom_hostcache_arena_create(uint64_t bytes, int lock_pages,
+                                   int32_t *locked_out) {
+  if (locked_out) *locked_out = 0;
+  if (bytes == 0) {
+    errno = EINVAL;
+    return NULL;
+  }
+  void *base = mmap(NULL, bytes, PROT_READ | PROT_WRITE,
+                    MAP_PRIVATE | MAP_ANONYMOUS | MAP_POPULATE, -1, 0);
+  if (base == MAP_FAILED) {
+    /* MAP_POPULATE can fail on exotic kernels; the arena is still
+     * usable unfaulted — retry plain before giving up. */
+    base = mmap(NULL, bytes, PROT_READ | PROT_WRITE,
+                MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (base == MAP_FAILED) return NULL;
+  }
+  if (lock_pages && mlock(base, bytes) == 0 && locked_out)
+    *locked_out = 1; /* best-effort: RLIMIT_MEMLOCK refusal is not fatal */
+  return base;
+}
+
+void strom_hostcache_arena_destroy(void *base, uint64_t bytes) {
+  if (base && bytes) munmap(base, bytes); /* munlock implied */
+}
+
+void strom_hostcache_copy(void *dst, const void *src, uint64_t bytes) {
+  if (dst && src && bytes) memcpy(dst, src, bytes);
 }
 
 }  /* extern "C" */

@@ -253,6 +253,13 @@ int strom_close(strom_engine *eng, int fh);
 int64_t strom_file_size(strom_engine *eng, int fh);
 int strom_file_is_direct(strom_engine *eng, int fh);
 
+/* Stable identity of the file BEHIND the open fh, via fstat on the
+ * engine's own descriptor (never the path — a rename racing the open
+ * could attribute one inode's bytes to another's identity): out =
+ * {st_dev, st_ino, mtime_ns, size}.  The pinned-host cache tier keys
+ * its lines by this. */
+int strom_file_ident(strom_engine *eng, int fh, uint64_t out[4]);
+
 /* Submit an async read of [offset, offset+len). len must be
  * <= buf_bytes. Unaligned offset/len are handled by reading the enclosing
  * aligned span; the completion's data pointer is pre-offset (no copy).
@@ -326,6 +333,28 @@ int strom_backend_is_uring(strom_engine *eng);
  * implementation, hardware SSE4.2 path when the CPU supports it.
  * `crc` is the running value (0 to start); returns the updated crc. */
 uint32_t strom_crc32c(const void *data, uint64_t len, uint32_t crc);
+
+/* Pinned host-DRAM cache arena (io/hostcache.py — the tier between NVMe
+ * and HBM).  Engine-independent, like strom_crc32c: the Python tier owns
+ * line bookkeeping; this is just the mapped+pinned backing store and the
+ * completion->line copy primitive.
+ *
+ * strom_hostcache_arena_create maps `bytes` of anonymous memory,
+ * pre-faults it (MAP_POPULATE: a fill must memcpy, never page-fault, so
+ * the staging buffer it drains recycles at DRAM speed) and — when
+ * `lock_pages` — best-effort mlocks it so cache hits can never stall on
+ * swapped-out lines.  *locked_out (optional) reports whether the mlock
+ * held (RLIMIT_MEMLOCK may refuse; the arena still works, unpinned).
+ * Returns NULL with errno set when the mapping itself fails.
+ *
+ * strom_hostcache_copy is the fill primitive: memcpy a completed staging
+ * view into a line.  Called via ctypes, it runs with the GIL dropped —
+ * the copy happens off the Python hot path exactly like the engine's own
+ * bounce copies. */
+void *strom_hostcache_arena_create(uint64_t bytes, int lock_pages,
+                                   int32_t *locked_out);
+void strom_hostcache_arena_destroy(void *base, uint64_t bytes);
+void strom_hostcache_copy(void *dst, const void *src, uint64_t bytes);
 
 /* Native tar shard indexer — the header walk that builds the
  * WebDataset sample map (formats/wds.py) without a Python-loop per

@@ -807,11 +807,14 @@ class CheckpointManager:
             expected = stamps.get(tkey)
             if expected is None or not policy.want():
                 return flat         # unstamped / not sampled this time
+            from nvme_strom_tpu.io.hostcache import spoil_path
             return policy.check_with_reread(
                 flat, expected,
                 lambda: self._engine_read(eng, sf.path, t["offset"],
                                           t["nbytes"]),
-                eng.stats, where=f"tile {tkey} of {sf.path}")
+                eng.stats, where=f"tile {tkey} of {sf.path}",
+                spoil=lambda: spoil_path(sf.path, t["offset"],
+                                         t["nbytes"], eng.stats))
 
         def read_tile_rows(bounds, fname, a, b):
             """Rows [a, b) (tile-local, leading axis) of a stored tile —

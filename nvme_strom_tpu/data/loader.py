@@ -389,12 +389,15 @@ class ShardedLoader:
                 expected = sidecar.lookup(off, ln)
                 if expected is None or not policy.want():
                     return payload
+                from nvme_strom_tpu.io.hostcache import spoil_span
                 return policy.check_with_reread(
                     payload, expected,
                     lambda: eng.read(fh, off, ln).tobytes(),
                     eng.stats,
                     where=f"sample part {ext!r} at [{off}:+{ln}] "
-                          f"of {path}")
+                          f"of {path}",
+                    spoil=lambda: spoil_span(eng, fh, off, ln,
+                                             eng.stats))
 
             def finish(entry):
                 idx_parts, reads = entry

@@ -18,6 +18,11 @@ from nvme_strom_tpu.io.faults import (
     build_engine,
     crash_point,
 )
+from nvme_strom_tpu.io.hostcache import (
+    CacheHitRead,
+    HostCache,
+    get_cache,
+)
 from nvme_strom_tpu.io.plan import (
     ExtentPlan,
     SpanView,
@@ -25,6 +30,7 @@ from nvme_strom_tpu.io.plan import (
     plan_extents,
     split_spans,
     submit_spans,
+    submit_spans_tiered,
 )
 from nvme_strom_tpu.io.resilient import (
     ReadError,
@@ -46,8 +52,9 @@ __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
            "file_extents", "file_eligible", "wait_exact",
            "FaultPlan", "FaultSpec", "FaultyEngine", "build_engine",
            "crash_point",
+           "CacheHitRead", "HostCache", "get_cache",
            "ExtentPlan", "SpanView", "plan_and_submit", "plan_extents",
-           "split_spans", "submit_spans",
+           "split_spans", "submit_spans", "submit_spans_tiered",
            "ReadError", "ResilientEngine", "ResilientRead",
            "ResilientWrite", "WriteError",
            "CLASS_ORDER", "DEFAULT_CLASS", "ClassPolicy", "QoSScheduler",

@@ -220,6 +220,8 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_file_size.restype = ctypes.c_int64
         lib.strom_file_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.strom_file_is_direct.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.strom_file_ident.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_uint64)]
         lib.strom_submit_read.restype = ctypes.c_int64
         lib.strom_submit_read.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.c_uint64, ctypes.c_uint64]
@@ -605,6 +607,9 @@ class PendingWrite:
                                             self._req_id)
         self._released = True
         self._keepalive = None
+        # the abandoned write may still have (partially) landed
+        self._engine._hostcache_write_done(self.fh, self.offset,
+                                           self.length)
 
     def wait(self, timeout: Optional[float] = None) -> int:
         comp = _Completion()
@@ -614,6 +619,12 @@ class PendingWrite:
         self._engine._lib.strom_release(self._engine._h, self._req_id)
         self._released = True
         self._keepalive = None
+        # completion-side staleness guard (the submit-side bump alone
+        # leaves a hole: a read admitted AFTER submit can complete with
+        # pre-write bytes while the write is still in flight, and would
+        # otherwise install them as a resident line)
+        self._engine._hostcache_write_done(self.fh, self.offset,
+                                           self.length)
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
         tracer = self._engine.tracer
@@ -671,6 +682,10 @@ class StromEngine:
         self._open_fhs: set[int] = set()
         self._last_lat_read: list[int] = [0] * _LAT_BUCKETS
         self._stripe: dict = {}   # fh → (chunk, members, extents)
+        # fh → (dev, ino, mtime_ns, size): the stable file identity the
+        # pinned-host tier keys its lines by (io/hostcache.py) — a file
+        # modified between opens gets a new key, so stale lines never hit
+        self._file_keys: dict = {}
         self._closed = False
         self.scheduler = None
         if n_rings > 1:
@@ -698,9 +713,22 @@ class StromEngine:
         if fh < 0:
             raise OSError(-fh, os.strerror(-fh), str(path))
         self._open_fhs.add(fh)
+        # identity via fstat on the engine's OWN descriptor, never the
+        # path: a rename racing the open (the checkpoint commit window)
+        # could otherwise key one inode's cached bytes under another
+        # file's identity
+        ident = (ctypes.c_uint64 * 4)()
+        if self._lib.strom_file_ident(self._h, fh, ident) == 0:
+            self._file_keys[fh] = tuple(int(x) for x in ident)
         if self.config.stripe_accounting:
             self._setup_stripe(fh, path, writable=writable)
         return fh
+
+    def file_key(self, fh: int) -> Optional[tuple]:
+        """Stable identity of the file behind ``fh`` — what the
+        pinned-host tier (io/hostcache.py) keys cache lines by; None
+        when unknown (the tier then skips this fh)."""
+        return self._file_keys.get(fh)
 
     def _setup_stripe(self, fh: int, path, writable: bool = False) -> None:
         """Per-member attribution geometry for this file (SURVEY.md §6:
@@ -775,6 +803,7 @@ class StromEngine:
         self._lib.strom_close(self._h, fh)
         self._open_fhs.discard(fh)
         self._stripe.pop(fh, None)
+        self._file_keys.pop(fh, None)
 
     def file_size(self, fh: int) -> int:
         n = self._lib.strom_file_size(self._h, fh)
@@ -919,7 +948,23 @@ class StromEngine:
             raise OSError(-rid, os.strerror(-rid))
         if self._stripe:
             self._attr_stripe(fh, offset, arr.nbytes)
+        # staleness guard, submit side: a cached line overlapping a
+        # write must never serve the pre-write bytes (kv/optimizer slot
+        # rewrites read their pages back through the same planner);
+        # PendingWrite invalidates AGAIN at completion — see wait()
+        self._hostcache_write_done(fh, offset, arr.nbytes)
         return PendingWrite(self, rid, arr, fh=fh, offset=offset)
+
+    def _hostcache_write_done(self, fh: int, offset: int,
+                              length: int) -> None:
+        """Drop host-tier lines overlapping a write and bump the file's
+        invalidation epoch (voiding in-flight admitted fills) — called
+        at write submit AND completion so no read/write interleaving
+        can persist pre-write bytes in the tier."""
+        fkey = self._file_keys.get(fh)
+        if fkey is not None and length > 0:
+            from nvme_strom_tpu.io.hostcache import notify_write
+            notify_write(fkey, offset, length, stats=self.stats)
 
     # -- stats / lifecycle -------------------------------------------------
 

@@ -271,6 +271,67 @@ class SpanView:
         self.release()
 
 
+class JoinedPieces:
+    """Pending-shaped join of one extent's MULTIPLE pieces.
+
+    Pre-tier, an extent ≤ the split size always came back as exactly
+    one piece; the host tier's hit/miss splitting (docs/PERF.md §4) can
+    return several (one per cache line plus miss runs).  Consumers
+    whose shape logic needs ONE view per extent (weight row chunks)
+    join them here: ``wait()`` assembles the pieces into one host
+    buffer — a host copy, honestly counted as ``bounce_bytes`` — and
+    ``release()`` releases every piece.  :func:`join_pieces` returns
+    the piece ITSELF when there is only one, so the common case stays
+    zero-copy."""
+
+    __slots__ = ("_pieces", "_stats", "_buf", "fh", "offset", "length")
+
+    def __init__(self, pieces, stats=None):
+        self._pieces = list(pieces)
+        self._stats = stats
+        self._buf: Optional[np.ndarray] = None
+        first = self._pieces[0]
+        self.fh = first.fh
+        self.offset = first.offset
+        self.length = sum(p.length for p in self._pieces)
+
+    @property
+    def was_fallback(self) -> bool:
+        return any(getattr(p, "was_fallback", False)
+                   for p in self._pieces)
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if self._buf is None:
+            views = [p.wait(timeout).reshape(-1).view(np.uint8)
+                     for p in self._pieces]
+            self._buf = np.concatenate(views)
+            if self._stats is not None:
+                self._stats.add(bounce_bytes=int(self._buf.nbytes))
+        return self._buf
+
+    def is_ready(self) -> bool:
+        return all(p.is_ready() for p in self._pieces)
+
+    def release(self) -> None:
+        for p in self._pieces:
+            p.release()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def join_pieces(pieces, stats=None):
+    """One pending-shaped object for an extent's ordered pieces: the
+    single piece itself (zero-copy) or a :class:`JoinedPieces` host
+    assembly.  ``pieces`` must be non-empty."""
+    if len(pieces) == 1:
+        return pieces[0]
+    return JoinedPieces(pieces, stats)
+
+
 #: per-engine-class cache: does this engine's submit_readv accept the
 #: ``klass`` keyword?  In-repo engines all do; a foreign/stub wrapper
 #: without it still works (the class tag is dropped, traffic rides the
@@ -341,28 +402,190 @@ def plan_and_submit(engine, extents: Sequence[Tuple[int, int, int]], *,
     ``klass`` is the batch's latency class (see :func:`submit_spans`) —
     the one knob consumers use to tag their traffic for the QoS
     scheduler and the per-class resilience budgets.
+
+    When the pinned-host tier is on (``STROM_HOSTCACHE_MB``,
+    io/hostcache.py) each extent is first split into HIT spans — served
+    as zero-copy views over resident cache lines, bypassing the engine
+    (and any Faulty/Resilient wrapper) entirely — and MISS spans, which
+    ride the planner/scheduler exactly as below and fill the cache on
+    completion behind the admission gate.  Record-unit-pinned plans
+    (``split_unit > 1``) bypass the tier: line boundaries cannot
+    guarantee unit-aligned pieces.
     """
     if chunk_bytes is None:
         from nvme_strom_tpu.utils.tuning import tuned_chunk_bytes
         chunk_bytes = tuned_chunk_bytes(engine)
+    if split_unit == 1:
+        from nvme_strom_tpu.io import hostcache
+        cache = hostcache.get_cache(engine)
+        if cache is not None:
+            return _plan_and_submit_tiered(cache, engine, extents,
+                                           gap=gap,
+                                           chunk_bytes=chunk_bytes,
+                                           klass=klass)
     plan = plan_extents(extents, chunk_bytes=chunk_bytes, gap=gap,
                         split_unit=split_unit)
     pendings = submit_spans(engine, plan.spans, klass=klass)
-    refs = [0] * len(pendings)
-    for pieces in plan.placements:
-        for si, _, _ in pieces:
-            refs[si] += 1
-    shared = [_SharedSpan(p, max(1, r))
-              for p, r in zip(pendings, refs)]
-    out: List[List[SpanView]] = []
-    for (fh, off, _ln), pieces in zip(extents, plan.placements):
-        views = []
-        pos = 0
-        for si, lo, hi in pieces:
-            views.append(SpanView(shared[si], lo, hi, fh, off + pos))
-            pos += hi - lo
-        out.append(views)
+    shared = _share_spans(pendings, plan.placements)
+    out = [_views_for(shared, pieces, fh, off)
+           for (fh, off, _ln), pieces in zip(extents, plan.placements)]
     stats = getattr(engine, "stats", None)
     if stats is not None and plan.spans_coalesced:
         stats.add(spans_coalesced=plan.spans_coalesced)
+    return out
+
+
+def _fill_keys_for_span(cache, fkey, admitted: dict, s_off: int,
+                        s_ln: int) -> dict:
+    """Admitted line keys (→ admission epoch) whose fill data this
+    span's completion can provide (line starts covered from their
+    beginning)."""
+    lb = cache.line_bytes
+    start = s_off if s_off % lb == 0 else s_off - s_off % lb + lb
+    return {(fkey, lo): admitted[(fkey, lo)]
+            for lo in range(start, s_off + s_ln, lb)
+            if (fkey, lo) in admitted}
+
+
+def _share_spans(pendings, placements) -> list:
+    """Refcount each submitted span by the pieces cut from it — the
+    release unit both submit paths share (the span's request frees when
+    the LAST view does)."""
+    refs = [0] * len(pendings)
+    for pieces in placements:
+        for si, _, _ in pieces:
+            refs[si] += 1
+    return [_SharedSpan(p, max(1, r)) for p, r in zip(pendings, refs)]
+
+
+def _views_for(shared, pieces, fh: int, start_off: int) -> list:
+    """One placement's ordered pieces → SpanViews (offsets advance from
+    ``start_off`` piece by piece)."""
+    views = []
+    pos = 0
+    for si, lo, hi in pieces:
+        views.append(SpanView(shared[si], lo, hi, fh, start_off + pos))
+        pos += hi - lo
+    return views
+
+
+def _plan_and_submit_tiered(cache, engine, extents, *, gap, chunk_bytes,
+                            klass) -> List[List[SpanView]]:
+    """The host-tier path of :func:`plan_and_submit`: probe each extent
+    against the cache, serve hit spans as pinned zero-copy line views,
+    plan+submit only the miss spans (which fill admitted lines when
+    they complete)."""
+    from nvme_strom_tpu.io.hostcache import (CacheHitRead, _FillOnWait,
+                                             file_key_of)
+    stats = getattr(engine, "stats", None)
+    for i, (_fh, _off, ln) in enumerate(extents):
+        if ln < 0:   # validate BEFORE probing: probes pin cache lines
+            raise ValueError(f"extent {i}: negative length {ln}")
+    fkeys: dict = {}
+    segs_all: List[list] = []
+    miss_exts: List[Tuple[int, int, int]] = []
+    admitted: dict = {}      # line key → admission-time epoch
+    for fh, off, ln in extents:
+        if ln == 0:
+            segs_all.append([])
+            continue
+        if fh not in fkeys:
+            fkeys[fh] = file_key_of(engine, fh)
+        fkey = fkeys[fh]
+        if fkey is None:
+            segs = [("miss", off, ln)]
+        else:
+            segs, adm = cache.probe_range(fkey, off, ln, klass, stats)
+            admitted.update(adm)
+        segs_all.append(segs)
+        for s in segs:
+            if s[0] == "miss":
+                miss_exts.append((fh, s[1], s[2]))
+    try:
+        plan = plan_extents(miss_exts, chunk_bytes=chunk_bytes, gap=gap)
+        pendings = submit_spans(engine, plan.spans, klass=klass)
+    except BaseException:
+        for segs in segs_all:       # pinned hits must not leak
+            for s in segs:
+                if s[0] == "hit":
+                    cache.unpin(s[3])
+        raise
+    wrapped = []
+    for (fh, s_off, s_ln), p in zip(plan.spans, pendings):
+        fkey = fkeys.get(fh)
+        keys = (_fill_keys_for_span(cache, fkey, admitted, s_off, s_ln)
+                if fkey is not None and admitted else {})
+        wrapped.append(_FillOnWait(p, cache, fkey, s_off, keys, klass,
+                                   stats) if keys else p)
+    shared = _share_spans(wrapped, plan.placements)
+    out: List[List[SpanView]] = []
+    mi = 0
+    for (fh, _off, ln), segs in zip(extents, segs_all):
+        pieces_out: list = []
+        for s in segs:
+            if s[0] == "hit":
+                _, a, sl, line = s
+                rel = a - line.key[1]
+                pieces_out.append(CacheHitRead(cache, line, rel,
+                                               rel + sl, fh, a))
+            else:
+                _, a, _sl = s
+                pieces_out.extend(_views_for(shared,
+                                             plan.placements[mi], fh, a))
+                mi += 1
+        out.append(pieces_out)
+    if stats is not None and plan.spans_coalesced:
+        stats.add(spans_coalesced=plan.spans_coalesced)
+    return out
+
+
+def submit_spans_tiered(engine, spans: Sequence[Tuple[int, int, int]],
+                        klass: Optional[str] = None) -> list:
+    """:func:`submit_spans` with the pinned-host tier in front: spans
+    fully resident in ONE cache line return as ready zero-copy cache
+    views (no engine submission, no retry/hedge), the rest submit as
+    one vectored batch exactly like :func:`submit_spans` — and fill
+    admitted lines when they complete.  This is the refill primitive of
+    ``DeviceStream.stream_ranges``, which is how kv_offload/opt_offload/
+    pq_direct streams get the tier; with the tier off it IS
+    ``submit_spans``."""
+    from nvme_strom_tpu.io import hostcache
+    cache = hostcache.get_cache(engine)
+    if cache is None:
+        return submit_spans(engine, spans, klass=klass)
+    from nvme_strom_tpu.io.hostcache import (CacheHitRead, _FillOnWait,
+                                             file_key_of)
+    stats = getattr(engine, "stats", None)
+    spans = list(spans)
+    out: list = [None] * len(spans)
+    miss: list = []
+    meta: list = []    # (out index, fkey, admitted keys)
+    fkeys: dict = {}
+    for i, (fh, off, ln) in enumerate(spans):
+        if fh not in fkeys:
+            fkeys[fh] = file_key_of(engine, fh)
+        fkey = fkeys[fh]
+        line = None
+        adm: dict = {}
+        if fkey is not None and ln > 0:
+            line, adm = cache.probe_span(fkey, off, ln, klass, stats)
+        if line is not None:
+            rel = off - line.key[1]
+            out[i] = CacheHitRead(cache, line, rel, rel + ln, fh, off)
+        else:
+            miss.append((fh, off, ln))
+            meta.append((i, fkey, adm))
+    try:
+        pendings = submit_spans(engine, miss, klass=klass)
+    except BaseException:
+        for p in out:
+            if p is not None:
+                p.release()
+        raise
+    for (i, fkey, adm), p in zip(meta, pendings):
+        fh, off, ln = spans[i]
+        keys = (_fill_keys_for_span(cache, fkey, adm, off, ln)
+                if fkey is not None and adm else {})
+        out[i] = _FillOnWait(p, cache, fkey, off, keys, klass,
+                             stats) if keys else p
     return out

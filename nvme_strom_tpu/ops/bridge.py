@@ -20,7 +20,7 @@ from typing import Callable, Iterator, Optional, Sequence
 import numpy as np
 
 from nvme_strom_tpu.io.engine import StromEngine, PendingRead
-from nvme_strom_tpu.io.plan import split_spans, submit_spans
+from nvme_strom_tpu.io.plan import split_spans, submit_spans_tiered
 from nvme_strom_tpu.utils.config import EngineConfig
 
 
@@ -238,6 +238,16 @@ class DeviceStream:
                 try:
                     verify(ri, view)
                 except BaseException:
+                    # a corrupt read may have been FILLED into the
+                    # pinned tier before this check ran: spoil the
+                    # overlapping lines so no retry/future read is
+                    # served the same bytes from DRAM
+                    from nvme_strom_tpu.io.hostcache import spoil_span
+                    try:
+                        spoil_span(self.engine, pr.fh, pr.offset,
+                                   pr.length, self.engine.stats)
+                    except Exception:
+                        pass
                     pr.release()
                     raise
             inflight.append((self._put(view, dtype, shp), pr))
@@ -252,9 +262,12 @@ class DeviceStream:
                 # io_uring_enter via submit_readv) instead of one
                 # boundary crossing per chunk
                 take = ranges[i:i + self.depth]
-                prs = submit_spans(self.engine,
-                                   [(fh, off, ln) for off, ln in take],
-                                   klass=klass)
+                # tiered refill: ranges resident in the pinned host
+                # cache come back as ready zero-copy views (no engine
+                # I/O); the rest enter as ONE batched submission
+                prs = submit_spans_tiered(
+                    self.engine, [(fh, off, ln) for off, ln in take],
+                    klass=klass)
                 for j, pr in enumerate(prs):
                     shape = (shapes_l[i + j] if shapes_l is not None
                              else None)

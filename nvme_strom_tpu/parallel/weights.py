@@ -28,7 +28,7 @@ from nvme_strom_tpu.formats.safetensors import (
     _np_dtype,
 )
 from nvme_strom_tpu.io.engine import StromEngine, wait_exact
-from nvme_strom_tpu.io.plan import plan_and_submit
+from nvme_strom_tpu.io.plan import join_pieces, plan_and_submit
 from nvme_strom_tpu.utils.config import EngineConfig
 
 
@@ -269,7 +269,9 @@ class LazyCheckpoint:
             (pieces,) = plan_and_submit(eng, [(fh, ent.offset,
                                                ent.length)],
                                         klass="restore")
-            (p,) = pieces   # scalar payload never splits
+            # one piece pre-tier; the host tier's hit/miss split can
+            # return several — join_pieces keeps one view either way
+            p = join_pieces(pieces, eng.stats)
             done = False
             try:
                 # ownership transfers at the yield: the consumer's
@@ -333,8 +335,9 @@ class LazyCheckpoint:
             if not pieces:    # zero-element slice: no I/O to wait on
                 pend.append((None, shp))
                 continue
-            (p,) = pieces   # a nonzero slice fits one buffer: never split
-            pend.append((p, shp))
+            # a nonzero slice fits one buffer, so pre-tier this is one
+            # zero-copy piece; a host-tier hit/miss split joins on host
+            pend.append((join_pieces(pieces, eng.stats), shp))
         try:
             while pend:
                 p, shp = pend.pop(0)

@@ -61,6 +61,14 @@ _SCHED_COUNTERS = (
     "hedges_denied",
 )
 
+#: pinned-host DRAM tier counters (io/hostcache.py — docs/PERF.md §4);
+#: own block, shown only when the tier saw traffic
+_HOSTCACHE_COUNTERS = (
+    "cache_hits", "cache_misses", "bytes_served_cache",
+    "cache_admissions", "cache_admission_rejections",
+    "cache_fill_failures", "cache_evictions", "cache_invalidations",
+)
+
 
 def render_device(path: str) -> str:
     """Backing-device topology of ``path`` — the observable form of the
@@ -148,6 +156,33 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
                 f"/{int(blk.get('hedges_won', 0))} "
                 f"denied={int(blk.get('hedges_denied', 0))} "
                 f"retries={int(blk.get('retries', 0))}")
+    if (any(int(snap.get(n, 0)) for n in _HOSTCACHE_COUNTERS)
+            or snap.get("cache_bytes_resident")):
+        lines.append("  host cache (pinned DRAM tier, NVMe<->HBM):")
+        for name in _HOSTCACHE_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if name.startswith("bytes") else str(v)
+            lines.append(f"    {name:<26} {shown:>14}")
+        resident = snap.get("cache_bytes_resident")
+        if resident is not None:
+            lines.append(f"    {'bytes_resident (lines)':<26} "
+                         f"{_human(int(resident)):>14}   "
+                         f"({int(snap.get('cache_lines_resident', 0))} "
+                         f"lines)")
+        hits = int(snap.get("cache_hits", 0))
+        misses = int(snap.get("cache_misses", 0))
+        if hits + misses:
+            lines.append(f"    {'hit rate':<26} "
+                         f"{hits / (hits + misses):>14.3f}")
+        cls = snap.get("class_stats") or {}
+        for k in sorted(cls):
+            ch = int(cls[k].get("cache_hits", 0))
+            cm = int(cls[k].get("cache_misses", 0))
+            if ch + cm:
+                lines.append(
+                    f"    class {k:<12} hits={ch} misses={cm} "
+                    f"rate={ch / (ch + cm):.3f} "
+                    f"served={_human(int(cls[k].get('bytes_served_cache', 0)))}")
     if any(int(snap.get(n, 0)) for n in _RESILIENCE_COUNTERS):
         lines.append("  resilience (recoveries + degradations):")
         for name in _RESILIENCE_COUNTERS:

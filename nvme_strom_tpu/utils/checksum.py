@@ -178,7 +178,7 @@ class VerifyPolicy:
                 f"({nbytes} bytes)")
 
     def check_with_reread(self, data, expected: int, reread, stats=None,
-                          *, where: str = ""):
+                          *, where: str = "", spoil=None):
         """The consumers' shared recovery protocol (docs/RESILIENCE.md):
         verify ``data``; on mismatch re-read ONCE via ``reread()`` —
         transient in-flight corruption heals here, each attempt counted
@@ -186,13 +186,24 @@ class VerifyPolicy:
         :class:`ChecksumError` (persistent corruption; the caller's
         damage path — quarantine, restore-fallback, loud abort — takes
         over).  Returns the verified payload (the re-read one when the
-        first copy was damaged)."""
+        first copy was damaged).
+
+        ``spoil``: optional callback invoked between the failed check
+        and the re-read — consumers pass a host-cache invalidation
+        (``io.hostcache.spoil_span``/``spoil_path``) so a corrupt read
+        that was FILLED into the pinned tier cannot satisfy the re-read
+        from DRAM with the same bytes."""
         try:
             self.check(data, expected, stats, where=where)
             return data
         except ChecksumError:
             _log.warning("checksum mismatch for %s — re-reading once",
                          where or "span")
+        if spoil is not None:
+            try:
+                spoil()
+            except Exception:
+                pass   # the heal must proceed even if spoiling fails
         data = reread()
         self.check(data, expected, stats,
                    where=where + " (after a re-read)")

@@ -133,6 +133,64 @@ class SchedConfig:
 
 
 @dataclass(frozen=True)
+class HostCacheConfig:
+    """Tiered pinned-host DRAM cache knobs (io/hostcache.py; semantics in
+    docs/PERF.md §4).
+
+    The cache sits between NVMe and HBM at the planner boundary: repeat
+    reads of hot spans (weight shards re-streamed per replica, hot KV
+    prefixes, hot SQL partitions) are served from an mlock'd host arena
+    at DRAM speed instead of re-paying SSD latency.  STROM_* environment
+    variables are read at construction time, mirroring EngineConfig.
+    """
+
+    #: arena budget in MiB; 0 (default) disables the tier entirely —
+    #: the planner's submit path is then bit-for-bit the pre-cache code
+    budget_mb: int = field(
+        default_factory=lambda: _env_int("STROM_HOSTCACHE_MB", 0))
+    #: cache-line size override in bytes (0 = adopt the ledger-tuned
+    #: chunk from utils/tuning.tuned_chunk_bytes of the first engine
+    #: that touches the tier); must be a power of two >= 4096
+    line_bytes: int = field(
+        default_factory=lambda: _env_int("STROM_HOSTCACHE_LINE_BYTES", 0))
+    #: "decode=8,restore=4,prefetch=2,scrub=1" — per-QoS-class residency
+    #: quota weights (normalized over the budget); empty = the QoS
+    #: scheduler's stock class weights, so the two layers agree on
+    #: relative generosity by default
+    class_quotas: str = field(
+        default_factory=lambda: os.environ.get(
+            "STROM_HOSTCACHE_CLASS_QUOTAS", ""))
+    #: ghost-list capacity as a multiple of the line capacity — how long
+    #: a once-missed line key is remembered for the second-chance
+    #: admission verdict
+    ghost_factor: int = field(
+        default_factory=lambda: _env_int("STROM_HOSTCACHE_GHOST_FACTOR", 4))
+    #: pin the arena (mlock) — shares the engine pool's STROM_MLOCK knob:
+    #: one switch for "no pinned memory on this box"
+    lock_arena: bool = field(
+        default_factory=lambda: os.environ.get("STROM_MLOCK", "1") != "0")
+
+    def __post_init__(self):
+        if self.budget_mb < 0:
+            raise ValueError("budget_mb must be >= 0")
+        if self.line_bytes and (self.line_bytes < 4096
+                                or self.line_bytes & (self.line_bytes - 1)):
+            raise ValueError(
+                f"line_bytes ({self.line_bytes}) must be 0 (auto) or a "
+                f"power of two >= 4096 (O_DIRECT block alignment)")
+        if self.ghost_factor < 1:
+            raise ValueError("ghost_factor must be >= 1")
+        if self.class_quotas:
+            # validate HERE, like every other knob: a malformed value
+            # must fail loudly at construction, not out of the first
+            # consumer read that lazily builds the tier.  One grammar:
+            # the tier's own parser (lazy import breaks no cycle — this
+            # module is fully loaded before any config is constructed).
+            from nvme_strom_tpu.io.hostcache import parse_class_quotas
+            parse_class_quotas(self.class_quotas)
+
+
+@dataclass(frozen=True)
 class ResilientConfig:
     """Recovery policy of ``io/resilient.py``'s ``ResilientEngine``.
 

@@ -104,6 +104,28 @@ class StromStats:
     # stamped-checksum mismatches detected (each is a silent corruption
     # that would otherwise have flowed into training state)
     checksum_failures: int = 0
+    # -- tiered pinned-host DRAM cache (io/hostcache.py, docs/PERF.md §4) --
+    # planner-boundary probe outcomes: spans (or parts of spans) served
+    # from resident cache lines vs sent to the engine; per-class
+    # breakdowns live in class_stats
+    cache_hits: int = 0
+    cache_misses: int = 0
+    # payload bytes served straight from the pinned arena — the repeat
+    # traffic that no longer pays SSD latency (bench.py "hostcache")
+    bytes_served_cache: int = 0
+    # fills accepted by the ghost-list admission gate / misses the gate
+    # refused to admit (one-shot streaming scans land here, by design)
+    cache_admissions: int = 0
+    cache_admission_rejections: int = 0
+    # admitted fills that could not land anyway: arena full with nothing
+    # reclaimable (all lines pinned/referenced) or voided by a racing
+    # write — budget starvation, NOT healthy scan filtering, so it must
+    # not hide inside cache_admission_rejections
+    cache_fill_failures: int = 0
+    # resident lines reclaimed under budget/quota pressure, and lines
+    # dropped because an engine write overlapped them (staleness guard)
+    cache_evictions: int = 0
+    cache_invalidations: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)

@@ -188,6 +188,22 @@ class StepWatchdog:
                           f"hedges_denied={s.hedges_denied} "
                           f"class_dispatches[{cls_brief}]",
                           file=w, flush=True)
+                # pinned-host tier (io/hostcache.py): a hang with a high
+                # hit rate is NOT waiting on the device — and a tier
+                # whose admissions/evictions churn while hits stay flat
+                # is thrashing its budget (docs/PERF.md §4)
+                hits, misses = s.cache_hits, s.cache_misses
+                if hits or misses or s.cache_admissions:
+                    rate = hits / (hits + misses) if hits + misses else 0.0
+                    resident = s.snapshot().get("cache_bytes_resident", 0)
+                    print(f"host cache: resident={int(resident)} "
+                          f"hits={hits} misses={misses} "
+                          f"rate={rate:.3f} "
+                          f"served={s.bytes_served_cache} "
+                          f"admitted={s.cache_admissions} "
+                          f"rejected={s.cache_admission_rejections} "
+                          f"evicted={s.cache_evictions}",
+                          file=w, flush=True)
                 # the recovery tier's own accounting: a hung step whose
                 # resilient counters are MOVING is recovering, not
                 # wedged — the distinction this dump exists to make

@@ -30,7 +30,9 @@ def test_suite_all_configs(tmp_path):
              17: "TFLOP/s", 18: "GiB/s"}
     for i, ln in enumerate(lines, start=1):
         rec = json.loads(ln)
-        assert set(rec) == {"metric", "value", "unit", "vs_baseline"}
+        assert set(rec) == {"metric", "value", "unit", "vs_baseline",
+                            "platform"}
+        assert rec["platform"] in ("tpu", "cpu-fallback")
         assert rec["metric"].startswith(f"config{i}:")
         assert rec["value"] > 0
         assert rec["unit"] == units[i]

@@ -1,0 +1,622 @@
+"""Tiered pinned-host DRAM cache (io/hostcache.py — docs/PERF.md §4).
+
+Hardware-free (`-m perf` rides along with the planner smoke): unit
+tests drive the HostCache directly (admission ghost list, class
+quotas + eviction exactness, write invalidation); planner tests prove
+the hit/miss splitting through a real StromEngine on tmp files — full
+hits, head/tail hits with a middle miss, line-boundary straddles, hit
+spans bypassing FaultyEngine/ResilientEngine entirely, and
+``STROM_HOSTCACHE_MB=0`` restoring the exact pre-tier path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io import StromEngine, plan_and_submit, wait_exact
+from nvme_strom_tpu.io import hostcache
+from nvme_strom_tpu.io.hostcache import HostCache
+from nvme_strom_tpu.io.plan import submit_spans_tiered
+from nvme_strom_tpu.utils.config import EngineConfig, HostCacheConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+LINE = 64 << 10
+
+
+def _cfg(**kw):
+    base = dict(chunk_bytes=1 << 20, queue_depth=8,
+                buffer_pool_bytes=16 << 20, n_rings=1)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+@pytest.fixture()
+def tier():
+    """Process tier pinned to a small deterministic geometry; torn down
+    so other tests see the env-derived (disabled) default again."""
+    cache = hostcache.configure(HostCacheConfig(
+        budget_mb=1, line_bytes=LINE))   # 16 lines of 64 KiB
+    yield cache
+    hostcache.reset()
+
+
+@pytest.fixture()
+def data_file(tmp_path):
+    payload = np.random.default_rng(13).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    path = tmp_path / "hc.bin"
+    path.write_bytes(payload)
+    return str(path), payload
+
+
+@pytest.fixture()
+def engine():
+    stats = StromStats()
+    eng = StromEngine(_cfg(), stats=stats)
+    yield eng
+    eng.close_all()
+
+
+def _warm(cache, engine, fh, extents, klass=None):
+    """Two passes: ghost-note, then admit+fill (the admission dance)."""
+    for _ in range(2):
+        for pieces in plan_and_submit(engine, extents,
+                                      chunk_bytes=256 << 10, klass=klass):
+            for p in pieces:
+                p.wait()
+                p.release()
+
+
+def _read(engine, extents, klass=None):
+    out = []
+    views = plan_and_submit(engine, extents, chunk_bytes=256 << 10,
+                            klass=klass)
+    for pieces in views:
+        out.append(b"".join(bytes(wait_exact(p)) for p in pieces))
+        for p in pieces:
+            p.release()
+    return out, views
+
+
+# ------------------------------------------------------------- unit: cache
+
+@pytest.mark.perf
+def test_ghost_list_admission_refuses_first_touch(tier):
+    fkey = ("f", 1)
+    segs, admitted = tier.probe_range(fkey, 0, LINE, None)
+    assert segs == [("miss", 0, LINE)]
+    assert admitted == {}                 # one-shot scan: not admitted
+    segs, admitted = tier.probe_range(fkey, 0, LINE, None)
+    assert set(admitted) == {(fkey, 0)}   # second touch: admitted
+    assert tier.fill(fkey, 0, np.zeros(LINE, np.uint8), None)
+    segs, _ = tier.probe_range(fkey, 0, LINE, None)
+    assert segs[0][0] == "hit"
+    tier.unpin(segs[0][3])
+
+
+@pytest.mark.perf
+def test_partial_prefix_line_upgrades_on_a_longer_read(tier):
+    """A resident-but-short line must not pin its slot while the full
+    line misses forever: a longer read's probe admits the extension."""
+    fkey = ("f", 8)
+    assert tier.fill(fkey, 0, np.zeros(LINE // 2, np.uint8), None)
+    segs, admitted = tier.probe_range(fkey, 0, LINE, None)
+    assert segs == [("miss", 0, LINE)]
+    assert set(admitted) == {(fkey, 0)}   # resident line → extend
+    assert tier.fill(fkey, 0, np.zeros(LINE, np.uint8), None,
+                     epoch=admitted[(fkey, 0)])
+    segs, _ = tier.probe_range(fkey, 0, LINE, None)
+    assert segs[0][0] == "hit"
+    tier.unpin(segs[0][3])
+
+
+@pytest.mark.perf
+def test_write_completion_bumps_epoch_again(tier, data_file, engine):
+    """The staleness guard fires at write SUBMIT and COMPLETION: a read
+    admitted between the two (which may complete with pre-write bytes)
+    is voided by the second bump."""
+    path, _payload = data_file
+    fh = engine.open(path, writable=True)
+    fkey = engine.file_key(fh)
+    w = engine.submit_write(fh, 0, np.zeros(LINE, np.uint8))
+    e_submit = tier._key_epoch.get((fkey, 0), 0)
+    assert e_submit >= 1
+    w.wait()
+    assert tier._key_epoch.get((fkey, 0), 0) > e_submit
+    # the guard is per line: other offsets of the file are untouched
+    assert (fkey, 4 * LINE) not in tier._key_epoch
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_epoch_map_eviction_fails_closed(tier):
+    """Losing a write's epoch entry to the bounded map must REFUSE a
+    pre-write fill (floor semantics), never re-admit it as epoch 0."""
+    fkey = ("f", 11)
+    tier.probe_range(fkey, 0, LINE, None)
+    _, admitted = tier.probe_range(fkey, 0, LINE, None)
+    epoch0 = admitted[(fkey, 0)]
+    assert epoch0 == 0                        # never-written key
+    tier.invalidate(fkey, 0, 1)               # the write
+    # force the bounded map to drop the write's entry
+    tier._key_epoch_cap = 0
+    tier.invalidate(("other", 1), 0, 1)       # triggers the trim
+    assert (fkey, 0) not in tier._key_epoch
+    assert tier._epoch_floor >= 1
+    assert not tier.fill(fkey, 0, np.zeros(LINE, np.uint8), None,
+                         epoch=epoch0)        # refused, not re-admitted
+
+
+@pytest.mark.perf
+def test_consumer_checksum_failure_spoils_the_filled_line(tier,
+                                                          data_file,
+                                                          engine):
+    """The PR 5 heal protocol must not re-read a corrupt FILL from the
+    tier: check_with_reread's spoil hook drops the line, so the re-read
+    reaches the device and heals."""
+    from nvme_strom_tpu.io.hostcache import spoil_span
+    from nvme_strom_tpu.utils.checksum import VerifyPolicy, crc32c
+    path, payload = data_file
+    fh = engine.open(path)
+    _warm(tier, engine, fh, [(fh, 0, LINE)])
+    assert tier.bytes_resident >= LINE
+    # simulate a transiently corrupt fill: flip a byte in the resident
+    # line (the stamp below is over the TRUE file bytes)
+    fkey = engine.file_key(fh)
+    line = tier._lines[(fkey, 0)]
+    tier.arena.view[line.slot * tier.line_bytes] ^= 0xFF
+    got, _ = _read(engine, [(fh, 0, LINE)])
+    assert got[0] != payload[:LINE]           # the tier serves corruption
+    policy = VerifyPolicy(mode="full")
+    healed = policy.check_with_reread(
+        np.frombuffer(got[0], np.uint8), crc32c(payload[:LINE]),
+        lambda: _read(engine, [(fh, 0, LINE)])[0][0],
+        engine.stats, where="spoil test",
+        spoil=lambda: spoil_span(engine, fh, 0, LINE, engine.stats))
+    assert bytes(healed) == payload[:LINE]    # re-read hit the device
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_write_between_admission_and_fill_voids_the_fill(tier):
+    """A fill whose admission verdict predates a write to the file is
+    refused — a miss read racing a write can never install pre-write
+    bytes as a resident line."""
+    fkey = ("f", 9)
+    tier.probe_range(fkey, 0, LINE, None)             # ghost note
+    _, admitted = tier.probe_range(fkey, 0, LINE, None)
+    (key, epoch), = admitted.items()
+    tier.invalidate(fkey, 0, 1)                        # the racing write
+    assert not tier.fill(fkey, 0, np.zeros(LINE, np.uint8), None,
+                         epoch=epoch)
+    assert tier.bytes_resident == 0
+    # the written line re-earns admission from scratch (the write also
+    # cleared its ghost entry), then fills normally under the new epoch
+    _, admitted = tier.probe_range(fkey, 0, LINE, None)
+    assert admitted == {}                 # first touch again, by design
+    _, admitted = tier.probe_range(fkey, 0, LINE, None)
+    assert tier.fill(fkey, 0, np.zeros(LINE, np.uint8), None,
+                     epoch=admitted[key])
+
+
+@pytest.mark.perf
+def test_eviction_falls_back_past_pinned_over_quota_class():
+    """When every over-quota line is pinned, pressure reclaims from an
+    under-quota class instead of refusing the fill."""
+    cache = HostCache(line_bytes=LINE, budget_bytes=4 * LINE,
+                      quotas={"decode": 1.0, "prefetch": 1.0})
+    try:
+        fkey = ("f", 10)
+        for i in range(3):   # decode over its 2-slot quota
+            cache.fill(fkey, i * LINE, np.zeros(LINE, np.uint8), "decode")
+        cache.fill(fkey, 3 * LINE, np.zeros(LINE, np.uint8), "prefetch")
+        pins = []
+        for i in range(3):   # pin ALL decode lines
+            segs, _ = cache.probe_range(fkey, i * LINE, LINE, None)
+            pins.append(segs[0][3])
+        assert cache.fill(fkey, 9 * LINE, np.zeros(LINE, np.uint8),
+                          "prefetch")   # evicts the unpinned prefetch line
+        assert cache.bytes_resident == 4 * LINE
+        for line in pins:
+            cache.unpin(line)
+    finally:
+        cache.close()
+
+
+@pytest.mark.perf
+def test_partial_line_prefix_serves_only_valid_bytes(tier):
+    fkey = ("f", 2)
+    assert tier.fill(fkey, 0, np.zeros(100, np.uint8), None)
+    # inside the prefix: hit; past it: miss
+    segs, _ = tier.probe_range(fkey, 0, 100, None)
+    assert segs[0][0] == "hit"
+    tier.unpin(segs[0][3])
+    segs, _ = tier.probe_range(fkey, 0, 200, None)
+    assert [s[0] for s in segs] == ["miss"]
+
+
+@pytest.mark.perf
+def test_eviction_under_quota_pressure_keeps_bytes_resident_exact():
+    cache = HostCache(line_bytes=LINE, budget_bytes=4 * LINE,
+                      quotas={"decode": 1.0, "prefetch": 1.0})
+    try:
+        stats = StromStats()
+        fkey = ("f", 3)
+        # decode grows past its 2-line quota into free space (borrowing)
+        for i in range(4):
+            assert cache.fill(fkey, i * LINE,
+                              np.full(LINE, i, np.uint8), "decode", stats)
+        assert cache.bytes_resident == 4 * LINE
+        # prefetch pressure: the over-quota decode class pays, exactly
+        # one line per fill, and the ledger stays exact throughout
+        for i in range(4, 6):
+            assert cache.fill(fkey, i * LINE,
+                              np.full(LINE, i, np.uint8), "prefetch",
+                              stats)
+            resident = sum(ln.valid for ln in cache._lines.values())
+            assert cache.bytes_resident == resident == 4 * LINE
+        assert stats.cache_evictions == 2
+        assert cache.counters()["class_slots"]["prefetch"] == 2
+        # pinned lines are never reclaimed: pin everything, next fill
+        # is refused rather than corrupting a held view
+        pins = []
+        for key in list(cache._lines):
+            segs, _ = cache.probe_range(key[0], key[1], LINE, None)
+            pins.append(segs[0][3])
+        assert not cache.fill(fkey, 99 * LINE, np.zeros(LINE, np.uint8),
+                              "prefetch", stats)
+        for line in pins:
+            cache.unpin(line)
+    finally:
+        cache.close()
+
+
+@pytest.mark.perf
+def test_write_invalidation_drops_overlapping_lines(tier):
+    fkey = ("f", 4)
+    stats = StromStats()
+    for i in range(3):
+        tier.fill(fkey, i * LINE, np.zeros(LINE, np.uint8), None, stats)
+    assert tier.bytes_resident == 3 * LINE
+    n = tier.invalidate(fkey, LINE + 7, 1, stats=stats)
+    assert n == 1
+    assert tier.bytes_resident == 2 * LINE
+    assert stats.cache_invalidations == 1
+    segs, _ = tier.probe_range(fkey, LINE, LINE, None)
+    assert segs[0][0] == "miss"
+
+
+@pytest.mark.perf
+def test_checksum_mismatch_drops_line_and_heals_as_miss(tier):
+    from nvme_strom_tpu.utils.checksum import VerifyPolicy
+    cache = HostCache(line_bytes=LINE, budget_bytes=4 * LINE,
+                      verify=VerifyPolicy(mode="full"))
+    try:
+        stats = StromStats()
+        fkey = ("f", 5)
+        cache.fill(fkey, 0, np.zeros(LINE, np.uint8), None, stats)
+        line = cache._lines[(fkey, 0)]
+        cache.arena.view[line.slot * LINE] ^= 0xFF   # flip a resident bit
+        segs, _ = cache.probe_range(fkey, 0, LINE, None, stats)
+        assert segs[0][0] == "miss"                  # dropped, not served
+        assert stats.checksum_failures == 1
+        assert cache.bytes_resident == 0
+    finally:
+        cache.close()
+
+
+# ------------------------------------------------- planner hit/miss split
+
+@pytest.mark.perf
+def test_extent_fully_cached_serves_zero_copy_hits(tier, data_file,
+                                                   engine):
+    path, payload = data_file
+    fh = engine.open(path)
+    exts = [(fh, 0, 2 * LINE)]
+    _warm(tier, engine, fh, exts)
+    before = engine.engine_stats()["requests_submitted"]
+    got, views = _read(engine, exts)
+    assert got[0] == payload[:2 * LINE]
+    # one zero-copy piece per line, nothing submitted to the engine
+    assert len(views[0]) == 2
+    assert engine.engine_stats()["requests_submitted"] == before
+    assert engine.stats.bytes_served_cache == 2 * LINE
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_head_tail_cached_middle_miss(tier, data_file, engine):
+    path, payload = data_file
+    fh = engine.open(path)
+    fkey = engine.file_key(fh)
+    # resident head and tail lines; the middle line stays cold
+    with open(path, "rb") as f:
+        raw = f.read()
+    tier.fill(fkey, 0, np.frombuffer(raw[:LINE], np.uint8), None)
+    tier.fill(fkey, 2 * LINE,
+              np.frombuffer(raw[2 * LINE:3 * LINE], np.uint8), None)
+    exts = [(fh, 0, 3 * LINE)]
+    before = engine.engine_stats()["requests_submitted"]
+    got, views = _read(engine, exts)
+    assert got[0] == payload[:3 * LINE]
+    kinds = [type(p).__name__ for p in views[0]]
+    assert kinds == ["CacheHitRead", "SpanView", "CacheHitRead"]
+    # exactly the middle line went to the device
+    assert engine.engine_stats()["requests_submitted"] == before + 1
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_line_boundary_straddles(tier, data_file, engine):
+    path, payload = data_file
+    fh = engine.open(path)
+    fkey = engine.file_key(fh)
+    with open(path, "rb") as f:
+        raw = f.read()
+    tier.fill(fkey, 0, np.frombuffer(raw[:LINE], np.uint8), None)
+    # [32K, 96K) straddles resident line 0 and cold line 1
+    a, b = LINE // 2, LINE // 2 + LINE
+    got, views = _read(engine, [(fh, a, b - a)])
+    assert got[0] == payload[a:b]
+    assert [type(p).__name__ for p in views[0]] == ["CacheHitRead",
+                                                    "SpanView"]
+    # both lines resident: the same straddle becomes two hit pieces
+    tier.fill(fkey, LINE, np.frombuffer(raw[LINE:2 * LINE], np.uint8),
+              None)
+    got, views = _read(engine, [(fh, a, b - a)])
+    assert got[0] == payload[a:b]
+    assert [type(p).__name__ for p in views[0]] == ["CacheHitRead",
+                                                    "CacheHitRead"]
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_hit_spans_never_enter_faulty_or_resilient(tier, data_file):
+    """A fully-cached extent must succeed even when EVERY engine read
+    fails: the hit path goes straight to the arena, below no wrapper."""
+    from nvme_strom_tpu.io import FaultPlan, FaultyEngine, ResilientEngine
+    from nvme_strom_tpu.io.resilient import ReadError
+    from nvme_strom_tpu.utils.config import ResilientConfig
+    path, payload = data_file
+    stats = StromStats()
+    base = StromEngine(_cfg(), stats=stats)
+    try:
+        fh = base.open(path)
+        fkey = base.file_key(fh)
+        with open(path, "rb") as f:
+            raw = f.read()
+        tier.fill(fkey, 0, np.frombuffer(raw[:LINE], np.uint8), None)
+        eng = ResilientEngine(
+            FaultyEngine(base, FaultPlan.parse("eio:p=1.0", seed=1)),
+            config=ResilientConfig(max_retries=0, backoff_base_s=0.0,
+                                   hedging=False))
+        (pieces,) = plan_and_submit(eng, [(fh, 0, LINE)],
+                                    chunk_bytes=256 << 10)
+        assert bytes(wait_exact(pieces[0])) == payload[:LINE]
+        for p in pieces:
+            p.release()
+        # the cold neighbor goes through the wrappers and DOES fail —
+        # proof the fault plan was live while the hit sailed past it
+        (pieces,) = plan_and_submit(eng, [(fh, LINE, LINE)],
+                                    chunk_bytes=256 << 10)
+        with pytest.raises(ReadError):
+            pieces[0].wait()
+        for p in pieces:
+            p.release()
+        base.close(fh)
+    finally:
+        base.close_all()
+
+
+@pytest.mark.perf
+def test_fill_on_miss_after_admission(tier, data_file, engine):
+    path, payload = data_file
+    fh = engine.open(path)
+    exts = [(fh, 0, LINE)]
+    _warm(tier, engine, fh, exts)      # pass 1 ghost, pass 2 fill
+    assert engine.stats.cache_admissions >= 1
+    assert tier.bytes_resident >= LINE
+    got, _ = _read(engine, exts)       # pass 3: a hit
+    assert got[0] == payload[:LINE]
+    assert engine.stats.cache_hits >= 1
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_engine_write_invalidates_through_the_tier(tier, data_file,
+                                                   engine):
+    path, payload = data_file
+    fh = engine.open(path, writable=True)
+    exts = [(fh, 0, LINE)]
+    _warm(tier, engine, fh, exts)
+    new = np.random.default_rng(5).integers(0, 256, LINE, dtype=np.uint8)
+    engine.submit_write(fh, 0, new).wait()
+    assert engine.stats.cache_invalidations == 1
+    got, _ = _read(engine, exts)
+    assert got[0] == new.tobytes()     # never the stale cached bytes
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_stream_span_path_hits_single_line_spans(tier, data_file,
+                                                 engine):
+    path, payload = data_file
+    fh = engine.open(path)
+    spans = [(fh, 0, LINE), (fh, 2 * LINE, LINE // 2)]
+    for _ in range(2):                 # ghost, then fill
+        for pr in submit_spans_tiered(engine, spans):
+            pr.wait()
+            pr.release()
+    before = engine.engine_stats()["requests_submitted"]
+    prs = submit_spans_tiered(engine, spans)
+    for (f, off, ln), pr in zip(spans, prs):
+        assert bytes(pr.wait()) == payload[off:off + ln]
+        assert pr.is_ready()
+        pr.release()
+    assert engine.engine_stats()["requests_submitted"] == before
+    assert engine.stats.cache_hits >= 2
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_join_pieces_gives_single_view_for_split_extents(tier,
+                                                         data_file,
+                                                         engine):
+    """Consumers that need ONE view per extent (weights row chunks)
+    survive the tier's multi-piece hit/miss splits via join_pieces."""
+    from nvme_strom_tpu.io.plan import join_pieces
+    path, payload = data_file
+    fh = engine.open(path)
+    fkey = engine.file_key(fh)
+    with open(path, "rb") as f:
+        raw = f.read()
+    tier.fill(fkey, 0, np.frombuffer(raw[:LINE], np.uint8), None)
+    a, b = LINE // 2, LINE // 2 + LINE        # straddle: hit + miss
+    (pieces,) = plan_and_submit(engine, [(fh, a, b - a)],
+                                chunk_bytes=256 << 10)
+    assert len(pieces) == 2
+    p = join_pieces(pieces, engine.stats)
+    assert p.length == b - a and p.offset == a and p.fh == fh
+    assert bytes(p.wait()) == payload[a:b]
+    p.release()
+    # the single-piece case stays the piece itself (zero-copy)
+    (pieces,) = plan_and_submit(engine, [(fh, 4 * LINE, LINE)],
+                                chunk_bytes=256 << 10)
+    assert join_pieces(pieces) is pieces[0]
+    for pc in pieces:
+        pc.release()
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_stream_span_unaligned_spans_never_admit(tier, data_file,
+                                                 engine):
+    """A stream-path span that can never hit (crosses lines / starts
+    mid-line) must not fill the tier — no budget squat, no ghost
+    churn."""
+    path, _payload = data_file
+    fh = engine.open(path)
+    spans = [(fh, LINE // 2, LINE)]           # crosses a line boundary
+    for _ in range(4):
+        for pr in submit_spans_tiered(engine, spans):
+            pr.wait()
+            pr.release()
+    assert tier.bytes_resident == 0
+    assert engine.stats.cache_admissions == 0
+    assert engine.stats.cache_hits == 0
+    engine.close(fh)
+
+
+@pytest.mark.perf
+def test_disabled_budget_restores_pre_tier_path(data_file, monkeypatch):
+    monkeypatch.setenv("STROM_HOSTCACHE_MB", "0")
+    hostcache.reset()
+    try:
+        assert hostcache.get_cache() is None
+        path, payload = data_file
+        stats = StromStats()
+        eng = StromEngine(_cfg(), stats=stats)
+        try:
+            fh = eng.open(path)
+            for _ in range(3):
+                views = plan_and_submit(eng, [(fh, 0, LINE)],
+                                        chunk_bytes=256 << 10)
+                for pieces in views:
+                    for p in pieces:
+                        assert bytes(wait_exact(p)) == payload[:LINE]
+                        p.release()
+            assert stats.cache_hits == 0 and stats.cache_misses == 0
+            assert stats.cache_admissions == 0
+            eng.close(fh)
+        finally:
+            eng.close_all()
+    finally:
+        hostcache.reset()
+
+
+@pytest.mark.perf
+def test_counters_flow_to_strom_stat_json_and_block(tier, data_file,
+                                                    tmp_path,
+                                                    monkeypatch, capsys):
+    """cache_* counters + the bytes-resident gauge ride StromStats →
+    the export file → `strom_stat --json` (scripting/dashboards) and
+    the rendered "host cache" block."""
+    import json as _json
+
+    from nvme_strom_tpu.tools import strom_stat
+    export = tmp_path / "stats.json"
+    monkeypatch.setenv("STROM_STATS_EXPORT", str(export))
+    path, _payload = data_file
+    stats = StromStats()
+    eng = StromEngine(_cfg(), stats=stats)
+    try:
+        fh = eng.open(path)
+        _warm(tier, eng, fh, [(fh, 0, LINE)], klass="decode")
+        _read(eng, [(fh, 0, LINE)], klass="decode")
+        eng.close(fh)
+    finally:
+        eng.close_all()    # sync_stats → export
+
+    rc = strom_stat.main([str(export), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    snap = _json.loads(out)
+    assert snap["cache_hits"] >= 1
+    assert snap["cache_admissions"] >= 1
+    assert snap["bytes_served_cache"] >= LINE
+    assert snap["cache_bytes_resident"] >= LINE
+    assert snap["class_stats"]["decode"]["cache_hits"] >= 1
+
+    rc = strom_stat.main([str(export)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "host cache" in out
+    assert "hit rate" in out
+    assert "class decode" in out
+
+
+@pytest.mark.perf
+def test_watchdog_dump_carries_host_cache_line(tier, data_file):
+    import io as _io
+
+    from nvme_strom_tpu.utils.watchdog import StepWatchdog
+    path, _payload = data_file
+    stats = StromStats()
+    eng = StromEngine(_cfg(), stats=stats)
+    try:
+        fh = eng.open(path)
+        _warm(tier, eng, fh, [(fh, 0, LINE)])
+        _read(eng, [(fh, 0, LINE)])
+        buf = _io.StringIO()
+        wd = StepWatchdog(deadline_s=0.05, engine=eng, stream=buf)
+        try:
+            with wd.step("hc"):
+                import time
+                time.sleep(0.2)
+        finally:
+            wd.close()
+        dump = buf.getvalue()
+        assert "host cache:" in dump
+        assert "hits=" in dump and "resident=" in dump
+        eng.close(fh)
+    finally:
+        eng.close_all()
+
+
+@pytest.mark.perf
+def test_record_unit_plans_bypass_the_tier(tier, data_file, engine):
+    """split_unit > 1 (fixedrec) keeps the uncached path: line
+    boundaries cannot guarantee record-aligned pieces."""
+    path, payload = data_file
+    fh = engine.open(path)
+    for _ in range(3):
+        views = plan_and_submit(engine, [(fh, 0, LINE)],
+                                chunk_bytes=256 << 10, split_unit=96)
+        for pieces in views:
+            for p in pieces:
+                p.wait()
+                p.release()
+    assert engine.stats.cache_hits == 0
+    assert engine.stats.cache_misses == 0
+    engine.close(fh)

@@ -1,0 +1,63 @@
+"""Knob-documentation drift check.
+
+Every ``STROM_*`` environment variable the package (or the C engine)
+reads must appear in README.md's environment-variable table — the
+knob-doc rot that previously required manual sweeps (PRs 3/5/7) now
+fails CI instead.  The README may document a whole family with a glob
+row (``STROM_FAULT_READ_*``)."""
+
+import re
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+#: a Python-side env READ of a STROM knob: os.environ.get("STROM_X"),
+#: os.environ["STROM_X"], _env_int("STROM_X", d), _env_float(...) —
+#: the name may sit on the next line (black-wrapped calls), so \s*
+#: spans newlines
+_PY_READ = re.compile(
+    r'(?:environ(?:\.get)?\s*[\[\(]|_env_int\(|_env_float\(|'
+    r'getenv\()\s*["\'](STROM_[A-Z0-9_]+)')
+
+#: the C engine's reads: getenv("STROM_X") / env_u64("STROM_X")
+_C_READ = re.compile(r'(?:getenv|env_[a-z0-9_]+)\s*\(\s*"(STROM_[A-Z0-9_]+)"')
+
+
+def _knobs_read_by_the_code() -> set:
+    knobs = set()
+    for py in (REPO / "nvme_strom_tpu").rglob("*.py"):
+        knobs |= set(_PY_READ.findall(py.read_text()))
+    cc = REPO / "csrc" / "strom_io.cc"
+    if cc.exists():
+        knobs |= set(_C_READ.findall(cc.read_text()))
+    return knobs
+
+
+def _knobs_documented_in_readme():
+    text = (REPO / "README.md").read_text()
+    tokens = set(re.findall(r"STROM_[A-Z0-9_]+\*?", text))
+    exact = {t for t in tokens if not t.endswith("*")}
+    prefixes = {t[:-1] for t in tokens if t.endswith("*")}
+    return exact, prefixes
+
+
+def test_every_env_knob_is_documented_in_readme():
+    knobs = _knobs_read_by_the_code()
+    assert knobs, "the scan found no knobs at all — the regex rotted"
+    exact, prefixes = _knobs_documented_in_readme()
+    missing = sorted(
+        k for k in knobs
+        if k not in exact and not any(k.startswith(p) for p in prefixes))
+    assert not missing, (
+        f"STROM_* knobs read by the code but absent from README.md's "
+        f"env-var table: {missing} — add a row (or a family glob row "
+        f"like STROM_FAULT_READ_*) to README.md 'Environment notes'")
+
+
+def test_scan_sees_known_knobs():
+    """The scanner itself must keep finding the long-lived knobs — a
+    silently-empty scan would green-light any future rot."""
+    knobs = _knobs_read_by_the_code()
+    for known in ("STROM_CHUNK_BYTES", "STROM_RINGS", "STROM_VERIFY",
+                  "STROM_HOSTCACHE_MB", "STROM_FAULT_READ_EIO_EVERY"):
+        assert known in knobs, known
