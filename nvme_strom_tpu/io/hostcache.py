@@ -153,7 +153,7 @@ class _Line:
     """One resident cache line (a valid PREFIX of ``line_bytes``)."""
 
     __slots__ = ("key", "slot", "valid", "klass", "crc", "pins", "ref",
-                 "dead")
+                 "dead", "sticky")
 
     def __init__(self, key: LineKey, slot: int, klass: str):
         self.key = key
@@ -165,6 +165,13 @@ class _Line:
         self.ref = False      # second-chance bit
         self.dead = False     # invalidated while pinned: slot freed on
         #                       last unpin, mapping already gone
+        self.sticky = False   # hot-pinned (docs/PERF.md §5): eviction
+        #                       skips it while its class is WITHIN quota
+        #                       — a KV-prefix page stays resident through
+        #                       the decode quota instead of rotating out
+        #                       under bulk pressure; over-quota sticky
+        #                       lines pay like everyone else, and writes
+        #                       still invalidate them
 
 
 class CacheHitRead:
@@ -220,10 +227,11 @@ class _FillOnWait:
     the view through untouched.  A cache failure never fails the read."""
 
     __slots__ = ("_pending", "_cache", "_fkey", "_off", "_keys",
-                 "_klass", "_stats", "_filled")
+                 "_klass", "_stats", "_filled", "_sticky")
 
     def __init__(self, pending, cache: "HostCache", fkey: tuple,
-                 span_off: int, keys: Dict[LineKey, int], klass, stats):
+                 span_off: int, keys: Dict[LineKey, int], klass, stats,
+                 sticky: bool = False):
         self._pending = pending
         self._cache = cache
         self._fkey = fkey
@@ -232,6 +240,7 @@ class _FillOnWait:
         self._klass = klass
         self._stats = stats
         self._filled = False
+        self._sticky = sticky
 
     @property
     def length(self) -> int:
@@ -256,7 +265,8 @@ class _FillOnWait:
             try:
                 self._cache.fill_from_view(self._fkey, self._off, view,
                                            self._keys, self._klass,
-                                           self._stats)
+                                           self._stats,
+                                           sticky=self._sticky)
             except Exception:
                 pass   # the tier is an accelerator, never a failure mode
         return view
@@ -404,7 +414,7 @@ class HostCache:
     # -- probe (the planner boundary) --------------------------------------
 
     def probe_range(self, fkey: tuple, off: int, length: int,
-                    klass: Optional[str], stats=None
+                    klass: Optional[str], stats=None, hot: bool = False
                     ) -> Tuple[List[tuple], Dict[LineKey, int]]:
         """Split ``[off, off+length)`` into hit and miss segments.
 
@@ -415,7 +425,13 @@ class HostCache:
         ``admitted`` maps each line key the caller should fill from the
         miss reads' completions (the ghost-list verdict) to the file's
         invalidation epoch at verdict time — a fill is refused if a
-        write bumps the epoch in between."""
+        write bumps the epoch in between.
+
+        ``hot`` marks the range latency-critical repeat traffic (KV
+        prefix pages): missed lines are admitted on FIRST touch (the
+        ghost gate exists to filter one-shot scans, which a declared-hot
+        range is not) and resident lines turn sticky — protected from
+        eviction while their class stays within quota."""
         kl = self._klass(klass)
         lb = self.line_bytes
         segments: List[tuple] = []
@@ -437,6 +453,8 @@ class HostCache:
                         m_lo = None
                     line.pins += 1
                     line.ref = True
+                    if hot:
+                        line.sticky = True
                     segments.append(("hit", pos, take_end - pos, line))
                     hits += 1
                     served += take_end - pos
@@ -456,7 +474,7 @@ class HostCache:
                                 self._epoch_of((fkey, lo))
                         else:
                             self._admit_or_note((fkey, lo), admitted,
-                                                stats)
+                                                stats, hot=hot)
                 pos = take_end
             if m_lo is not None:
                 segments.append(("miss", m_lo, end - m_lo))
@@ -468,7 +486,7 @@ class HostCache:
         return segments, admitted
 
     def probe_span(self, fkey: tuple, off: int, length: int,
-                   klass: Optional[str], stats=None
+                   klass: Optional[str], stats=None, hot: bool = False
                    ) -> Tuple[Optional[_Line], Dict[LineKey, int]]:
         """Whole-span variant for vectored refill paths
         (``DeviceStream.stream_ranges``): a span is a hit only when it
@@ -486,6 +504,8 @@ class HostCache:
                     and self._verify_ok(line, stats)):
                 line.pins += 1
                 line.ref = True
+                if hot:
+                    line.sticky = True
                 if stats is not None:
                     stats.add(cache_hits=1, bytes_served_cache=length)
                     stats.add_class_stat(kl, cache_hits=1,
@@ -504,7 +524,7 @@ class HostCache:
                     # too-short resident prefix: admit the extension
                     admitted[key] = self._epoch_of(key)
                 else:
-                    self._admit_or_note(key, admitted, stats)
+                    self._admit_or_note(key, admitted, stats, hot=hot)
         if stats is not None:
             # per-line units, matching probe_range's hits
             n_lines = (off + length - 1) // lb - lo // lb + 1
@@ -513,14 +533,17 @@ class HostCache:
         return None, admitted
 
     def _admit_or_note(self, key: LineKey, admitted: Dict[LineKey, int],
-                       stats) -> None:
+                       stats, hot: bool = False) -> None:
         """The ghost-list second-chance verdict (lock held): admit a
         missed line only if it was ALREADY missed recently — the first
         touch of a streaming scan is refused (counted) and remembered.
         An admitted key carries the file's current invalidation epoch,
-        so a write landing between verdict and fill voids the fill."""
-        if key in self._ghost:
-            self._ghost.pop(key)
+        so a write landing between verdict and fill voids the fill.
+        ``hot`` skips the ghost gate entirely: a declared-hot range
+        (KV prefix restore) is repeat traffic by contract, so the first
+        touch admits."""
+        if hot or key in self._ghost:
+            self._ghost.pop(key, None)
             admitted[key] = self._epoch_of(key)
             return
         self._ghost[key] = None
@@ -533,7 +556,8 @@ class HostCache:
 
     def fill_from_view(self, fkey: tuple, span_off: int,
                        view: np.ndarray, keys: Dict[LineKey, int],
-                       klass: Optional[str], stats=None) -> None:
+                       klass: Optional[str], stats=None,
+                       sticky: bool = False) -> None:
         """Copy the admitted line-aligned portions of a completed span
         read into lines.  ``view`` may be short (EOF) — each line holds
         whatever prefix the read actually covered.  ``keys`` carries
@@ -546,11 +570,11 @@ class HostCache:
                 continue   # admitted under another span of the batch
             self.fill(fkey, line_off,
                       view[rel:rel + min(self.line_bytes, n - rel)],
-                      klass, stats, epoch=epoch)
+                      klass, stats, epoch=epoch, sticky=sticky)
 
     def fill(self, fkey: tuple, line_off: int, payload: np.ndarray,
              klass: Optional[str], stats=None,
-             epoch: Optional[int] = None) -> bool:
+             epoch: Optional[int] = None, sticky: bool = False) -> bool:
         """Install ``payload`` (a prefix of the line at ``line_off``) —
         allocating a slot, evicting under the class-quota policy when
         the arena is full.  False when the fill was skipped (already
@@ -594,6 +618,8 @@ class HostCache:
                 self._ghost.pop(key, None)
                 self._class_slots[kl] = self._class_slots.get(kl, 0) + 1
                 self._clock.setdefault(kl, deque()).append(key)
+            if sticky:
+                line.sticky = True
             line.pins += 1              # copy in progress: unevictable
         try:
             self.arena.copy_in(line.slot * self.line_bytes, payload)
@@ -669,6 +695,13 @@ class HostCache:
                     return None
                 continue
             if line.pins > 0:
+                q.rotate(-1)
+                continue
+            if line.sticky and not self._over_quota(klass):
+                # hot-pinned within quota (docs/PERF.md §5): the decode
+                # class's KV-prefix residency survives bulk churn; an
+                # over-quota class's sticky lines pay normally, so the
+                # pin can never wedge the shared budget
                 q.rotate(-1)
                 continue
             if line.ref:

@@ -387,7 +387,7 @@ def submit_spans(engine, spans: Sequence[Tuple[int, int, int]],
 def plan_and_submit(engine, extents: Sequence[Tuple[int, int, int]], *,
                     gap: Optional[int] = None, split_unit: int = 1,
                     chunk_bytes: Optional[int] = None,
-                    klass: Optional[str] = None
+                    klass: Optional[str] = None, hot: bool = False
                     ) -> List[List[SpanView]]:
     """Plan ``(fh, offset, length)`` extents, submit the spans as ONE
     batch, and return — aligned with the input — each extent's ordered
@@ -411,6 +411,13 @@ def plan_and_submit(engine, extents: Sequence[Tuple[int, int, int]], *,
     completion behind the admission gate.  Record-unit-pinned plans
     (``split_unit > 1``) bypass the tier: line boundaries cannot
     guarantee unit-aligned pieces.
+
+    ``hot`` declares the batch latency-critical REPEAT traffic (the KV
+    prefix store's page restores): tier lines it touches are admitted
+    on first miss (no ghost round) and pinned sticky under the class's
+    residency quota — hot prefix pages ride DRAM on the next restore
+    instead of rotating out behind a bulk scan (docs/PERF.md §5).  With
+    the tier off it changes nothing.
     """
     if chunk_bytes is None:
         from nvme_strom_tpu.utils.tuning import tuned_chunk_bytes
@@ -422,7 +429,7 @@ def plan_and_submit(engine, extents: Sequence[Tuple[int, int, int]], *,
             return _plan_and_submit_tiered(cache, engine, extents,
                                            gap=gap,
                                            chunk_bytes=chunk_bytes,
-                                           klass=klass)
+                                           klass=klass, hot=hot)
     plan = plan_extents(extents, chunk_bytes=chunk_bytes, gap=gap,
                         split_unit=split_unit)
     pendings = submit_spans(engine, plan.spans, klass=klass)
@@ -470,7 +477,8 @@ def _views_for(shared, pieces, fh: int, start_off: int) -> list:
 
 
 def _plan_and_submit_tiered(cache, engine, extents, *, gap, chunk_bytes,
-                            klass) -> List[List[SpanView]]:
+                            klass, hot: bool = False
+                            ) -> List[List[SpanView]]:
     """The host-tier path of :func:`plan_and_submit`: probe each extent
     against the cache, serve hit spans as pinned zero-copy line views,
     plan+submit only the miss spans (which fill admitted lines when
@@ -495,7 +503,8 @@ def _plan_and_submit_tiered(cache, engine, extents, *, gap, chunk_bytes,
         if fkey is None:
             segs = [("miss", off, ln)]
         else:
-            segs, adm = cache.probe_range(fkey, off, ln, klass, stats)
+            segs, adm = cache.probe_range(fkey, off, ln, klass, stats,
+                                          hot=hot)
             admitted.update(adm)
         segs_all.append(segs)
         for s in segs:
@@ -516,7 +525,7 @@ def _plan_and_submit_tiered(cache, engine, extents, *, gap, chunk_bytes,
         keys = (_fill_keys_for_span(cache, fkey, admitted, s_off, s_ln)
                 if fkey is not None and admitted else {})
         wrapped.append(_FillOnWait(p, cache, fkey, s_off, keys, klass,
-                                   stats) if keys else p)
+                                   stats, sticky=hot) if keys else p)
     shared = _share_spans(wrapped, plan.placements)
     out: List[List[SpanView]] = []
     mi = 0

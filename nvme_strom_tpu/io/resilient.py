@@ -601,6 +601,18 @@ class ResilientEngine:
         with self._hedge_lock:
             return self._hedge_out.get(klass, 0)
 
+    def set_hedge_budget(self, klass: str, budget: int) -> None:
+        """Adjust one class's concurrent-hedge token budget at runtime —
+        the SLO governor's resilience lever (docs/PERF.md §5): a
+        decode-path p99 violation buys the decode class more concurrent
+        hedges, and the governor decays the budget back once the target
+        is met.  Outstanding tokens are untouched: a shrink simply
+        denies NEW hedges until enough in-flight ones release."""
+        if budget < 0:
+            raise ValueError(f"budget must be >= 0, got {budget}")
+        with self._hedge_lock:
+            self.hedge_budgets[klass] = int(budget)
+
     # -- delegation --------------------------------------------------------
 
     def open(self, path, **kw) -> int:

@@ -257,6 +257,20 @@ class QoSScheduler:
         with self._lock:
             return {k: len(q) for k, q in self._queues.items()}
 
+    def set_weight(self, klass: str, weight: float) -> None:
+        """Adjust one class's fair-share weight at runtime — the SLO
+        governor's scheduler lever (docs/PERF.md §5): a decode-path p99
+        violation temporarily raises the decode class's share, and the
+        governor lowers it back when the target is met again.  Priority
+        order and the aging bound are untouched, so the starvation
+        guarantee survives any weight setting (weight 0 included)."""
+        with self._lock:
+            p = self.policies.get(klass)
+            if p is None:
+                raise KeyError(f"unknown class {klass!r} "
+                               f"(have {sorted(self.policies)})")
+            self.policies[klass] = replace(p, weight=float(weight))
+
     # -- dispatch core -----------------------------------------------------
 
     def _drain_locked(self) -> None:

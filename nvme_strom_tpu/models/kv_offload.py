@@ -793,6 +793,630 @@ def offloaded_prefill(params: Dict, tokens, cfg: TransformerConfig,
     return _final_logits(params, x_last, cfg)
 
 
+# ---------------------------------------------------------------------------
+# serving prefix store: content-addressed cross-request KV pages on NVMe
+# ---------------------------------------------------------------------------
+#
+# PagedKVCache above is a PER-SESSION offload: one decode session's own
+# history spills to its own page file.  Production serving is
+# CROSS-request: thousands of sessions share system prompts and few-shot
+# prefixes whose aggregate KV far exceeds HBM+DRAM (ROADMAP open item 2;
+# Tutti, PAPERS.md).  PrefixStore is that tier — prompt KV pages keyed
+# by a rolling hash of their TOKEN CHAIN (per model identity), written
+# once however many sessions compute them, restored through the
+# decode-class batched read path (io/plan.py + io/sched.py) and pinned
+# hot in the host-DRAM tier (io/hostcache.py) so a popular prefix costs
+# one prefill fleet-wide and one NVMe read per cold restore.
+# models/serving.py's DecodeServer/PagedDecodeServer drive it at
+# admission; docs/PERF.md §5 documents knobs, counters, and policy.
+
+
+class SloGovernor:
+    """Decode-path p99 SLO: turn a restore-latency target into policy.
+
+    ``STROM_KV_P99_MS`` names the restore p99 the serving path promises
+    (the existing log2-histogram machinery measures it).  On violation
+    the governor raises the ``decode`` class's concurrent-hedge budget
+    (io/resilient.py, the PR-7 per-class tokens) and its fair-share
+    weight (io/sched.py) one notch — stragglers get hedged away and the
+    scheduler leans harder toward decode; once the p99 recovers below
+    half the target the boost decays back a notch toward the baseline.
+    Bounded (``_MAX_BOOST`` doublings) and rate-limited, so a noisy
+    histogram can never ratchet the budgets to infinity or flap them
+    per-request.  With no target (0, the default), or an engine without
+    the matching lever, it is inert."""
+
+    _MAX_BOOST = 3
+    _MIN_INTERVAL_S = 0.5
+
+    def __init__(self, target_ms: float, klass: str = "decode"):
+        self.target_ms = float(target_ms)
+        self.klass = klass
+        self.boost = 0
+        self._base_budget: Optional[int] = None
+        self._base_weight: Optional[float] = None
+        self._last = 0.0
+
+    def observe(self, engine, p99_ms: Optional[float], stats=None) -> None:
+        """Feed one restore-p99 sample; applies/decays the boost."""
+        import time
+        if self.target_ms <= 0 or not p99_ms:
+            return
+        now = time.monotonic()
+        if now - self._last < self._MIN_INTERVAL_S:
+            return
+        step = 0
+        if p99_ms > self.target_ms and self.boost < self._MAX_BOOST:
+            step = 1
+        elif p99_ms < 0.5 * self.target_ms and self.boost > 0:
+            step = -1
+        if step == 0:
+            return
+        self._last = now
+        self.boost += step
+        set_budget = getattr(engine, "set_hedge_budget", None)
+        if set_budget is not None:
+            if self._base_budget is None:
+                self._base_budget = int(getattr(engine, "hedge_budgets",
+                                                {}).get(self.klass, 8))
+            set_budget(self.klass,
+                       self._base_budget * (2 ** self.boost))
+        sched = getattr(engine, "scheduler", None)
+        if sched is not None:
+            try:
+                if self._base_weight is None:
+                    self._base_weight = sched.policies[self.klass].weight
+                sched.set_weight(self.klass,
+                                 self._base_weight * (1 + self.boost))
+            except (KeyError, AttributeError):
+                pass
+        if step > 0 and stats is not None:
+            stats.add(kv_slo_boosts=1)
+
+
+class PrefixStore:
+    """Content-addressed NVMe store of prompt KV pages, shared across
+    decode sessions/servers (thread-safe; one instance per page file).
+
+    A page holds ``page_tokens`` positions of a SINGLE sequence at
+    kv-head width — layout ``[k block][v block]``, each
+    ``(L, nkv, page_tokens, hd)`` of the model dtype — keyed by the
+    rolling hash of the full token chain up to and including the page
+    (seeded with the model identity, so two models or dtypes can never
+    alias).  ``put`` writes a page once (a resident key counts
+    ``kv_pages_deduped``/``kv_bytes_saved`` instead of re-writing);
+    ``restore_many`` gathers EVERY requesting slot's due pages into ONE
+    ``plan_and_submit`` batch under the ``decode`` QoS class with
+    ``hot=True`` — cross-request locality for the extent-coalescing
+    planner and the multi-ring scheduler, and sticky host-tier lines
+    under the decode quota.  Every page carries a write-time CRC32C
+    stamp (PR-5 machinery) persisted in a ``.kvman.json`` manifest
+    sidecar, verified on restore behind ``STROM_VERIFY`` and offline by
+    ``strom-scrub``.
+
+    Eviction (capacity pressure) reclaims the lowest BENEFIT score —
+    reuse frequency x the histogram-estimated per-page restore cost —
+    so the hottest prefixes stay SSD-resident (docs/PERF.md §5); pages
+    pinned by an in-flight restore are never reclaimed.  Restore
+    failures (I/O or CRC) drop the damaged entry and heal through the
+    server's normal prefill — the store accelerates, it never fails a
+    request.
+    """
+
+    #: async page writes kept in flight before put() drains (mirrors
+    #: PagedKVCache's bounded write pipeline)
+    _MAX_PENDING = 4
+
+    def __init__(self, cfg: TransformerConfig, engine: StromEngine,
+                 path: str, page_tokens: int, capacity_bytes: int,
+                 p99_target_ms: float = 0.0):
+        import hashlib
+        import threading
+        if page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, "
+                             f"got {page_tokens}")
+        self.cfg = cfg
+        self.engine = engine
+        self.path = str(path)
+        self.page_tokens = page_tokens
+        L, nkv, hd = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+        self._np_dtype = jnp.dtype(cfg.dtype)
+        self._kv_shape = (L, nkv, page_tokens, hd)
+        self.page_bytes = (2 * L * nkv * page_tokens * hd
+                          * self._np_dtype.itemsize)
+        if capacity_bytes < self.page_bytes:
+            capacity_bytes = self.page_bytes   # a non-zero budget means
+            #                                    the user wants the tier
+        self.capacity_pages = max(1, capacity_bytes // self.page_bytes)
+        #: chain-hash seed: the model identity — every field that
+        #: changes the KV bytes a token chain produces
+        self._seed = hashlib.sha1(repr((
+            "kvprefix-v1", cfg.vocab, cfg.d_model, cfg.n_layers,
+            cfg.n_heads, cfg.n_kv_heads, cfg.d_ff, cfg.rope_theta,
+            cfg.rope_scaling, cfg.norm_eps, self._np_dtype.name,
+            cfg.n_experts, cfg.expert_top_k, cfg.moe_every,
+            page_tokens)).encode()).digest()
+        import os
+        d = os.path.dirname(self.path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = engine.open(self.path, writable=True)
+        self.stats = getattr(engine, "stats", None)
+        self._lock = threading.Lock()
+        self._wlock = threading.Lock()   # pending-write pipeline
+        #: key -> {"page": slot, "hits": n, "seq": lru-tick, "crc": int,
+        #:         "pins": in-flight restores}
+        self._entries: Dict[bytes, dict] = {}
+        # reversed so pop() hands out slot 0 first: the page file grows
+        # from the front instead of starting capacity-sized-sparse
+        self._free = list(range(self.capacity_pages - 1, -1, -1))
+        self._seq = 0
+        self._pending_writes: list = []
+        #: restore-latency log2 histogram in µs (the same bucketing as
+        #: the engine's native histogram; utils/stats percentile walk)
+        self._restore_hist = [0] * 40
+        self._man_last = 0.0          # throttled manifest-save clock
+        self.slo = SloGovernor(p99_target_ms)
+        from nvme_strom_tpu.utils.checksum import VerifyPolicy
+        self._verify = VerifyPolicy()
+        self._load_manifest()
+
+    # -- identity / lookup -------------------------------------------------
+
+    def chain_keys(self, tokens) -> list:
+        """One key per FULL page of the token chain, capped at
+        ``(len-1)//page_tokens`` — at least one token always prefills
+        live (the first-token logits need a real forward; the cap also
+        matches the serving block cache's rule, so the two tiers index
+        the same boundaries)."""
+        import hashlib
+        P = self.page_tokens
+        n = max(0, (len(tokens) - 1) // P)
+        keys, h = [], self._seed
+        for i in range(n):
+            chunk = np.asarray(tokens[i * P:(i + 1) * P],
+                               np.int32).tobytes()
+            h = hashlib.sha1(h + chunk).digest()
+            keys.append(h)
+        return keys
+
+    def match(self, keys) -> int:
+        """Length of the longest resident chain prefix (pages whose
+        write is fully SUBMITTED — a restore drains pending writes
+        before reading, so ready pages can never serve torn bytes)."""
+        with self._lock:
+            n = 0
+            for kx in keys:
+                e = self._entries.get(kx)
+                if e is None or not e["ready"]:
+                    break
+                n += 1
+            return n
+
+    def pages_resident(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    # -- restore (the decode-class batched read path) ----------------------
+
+    def restore_many(self, wants: Dict[object, tuple]) -> Dict[object, Dict[int, tuple]]:
+        """Restore every requesting slot's due pages in ONE batch.
+
+        ``wants``: slot -> (first_chain_index, [chain keys]).  Returns
+        slot -> {chain_index: (k, v)} numpy ``(L, nkv, P, hd)`` pairs
+        for the pages that restored cleanly (duplicate pages across
+        slots — two sessions admitting the same prompt in one step —
+        submit once: the planner dedupes the overlapping extents into
+        one span and hands each slot a view).  A failed page drops its
+        store entry (healed by recompute) and is simply absent from the
+        result; the caller prefills it like any miss."""
+        import time as _time
+        plan: list = []            # (slot, chain_index, key, entry)
+        with self._lock:
+            for slot, (start, keys) in wants.items():
+                for j, kx in enumerate(keys):
+                    e = self._entries.get(kx)
+                    if e is None or not e["ready"]:
+                        continue   # evicted since match(), or a put
+                        #            still submitting; recompute
+                    e["pins"] += 1
+                    e["hits"] += 1
+                    self._seq += 1
+                    e["seq"] = self._seq
+                    plan.append((slot, start + j, kx, e))
+        if not plan:
+            return {}
+        from nvme_strom_tpu.io.plan import plan_and_submit
+        out: Dict[object, Dict[int, tuple]] = {}
+        failed: list = []
+        t0 = _time.monotonic()
+        try:
+            # a failed eviction WRITE surfacing here must degrade to
+            # recompute, not fail the serving step (and must not leak
+            # the pins just taken)
+            self._drain_writes()
+            extents = [(self._fh, e["page"] * self.page_bytes,
+                        self.page_bytes) for (_s, _i, _k, e) in plan]
+            planned = plan_and_submit(self.engine, extents,
+                                      klass="decode", hot=True)
+        except OSError:
+            with self._lock:
+                for (_s, _i, _k, e) in plan:
+                    self._unpin_locked(e)
+            if self.stats is not None:
+                self.stats.add(kv_restore_failures=len(plan))
+            return {}
+        try:
+            for (slot, idx, kx, e), pieces in zip(plan, planned):
+                buf = np.empty(self.page_bytes, np.uint8)
+                pos = 0
+                bad = None
+                for p in pieces:
+                    try:
+                        v = p.wait()
+                    except OSError as err:
+                        bad = err
+                        break
+                    buf[pos:pos + v.nbytes] = v.reshape(-1).view(np.uint8)
+                    pos += v.nbytes
+                if bad is None and pos != self.page_bytes:
+                    bad = OSError(f"short page: {pos} of "
+                                  f"{self.page_bytes} bytes")
+                if bad is None and self._verify.enabled \
+                        and self._verify.want():
+                    from nvme_strom_tpu.utils.checksum import crc32c
+                    got = crc32c(buf)
+                    if self.stats is not None:
+                        self.stats.add(bytes_verified=int(buf.nbytes))
+                    if got != e["crc"]:
+                        if self.stats is not None:
+                            self.stats.add(checksum_failures=1)
+                        bad = OSError(
+                            f"KV prefix page {e['page']} fails its "
+                            f"write-time CRC32C ({got:#010x} != "
+                            f"{e['crc']:#010x})")
+                if bad is not None:
+                    failed.append((kx, e))
+                    continue
+                half = self.page_bytes // 2
+                k = buf[:half].view(self._np_dtype).reshape(self._kv_shape)
+                v = buf[half:].view(self._np_dtype).reshape(self._kv_shape)
+                out.setdefault(slot, {})[idx] = (k, v)
+        finally:
+            for pieces in planned:
+                for p in pieces:
+                    p.release()
+            with self._lock:
+                for (_s, _i, _k, e) in plan:
+                    self._unpin_locked(e)
+        elapsed_us = max(1, int((_time.monotonic() - t0) * 1e6))
+        n_ok = sum(len(v) for v in out.values())
+        with self._lock:
+            # hist[i] counts [2^i, 2^(i+1)) — the same convention as
+            # percentiles_from_log2_hist and the engine's histogram.
+            # Aged by halving past 512 samples (exponential forgetting)
+            # so the SLO governor reacts to CURRENT latency, not a
+            # lifetime average a cold start poisoned for hours.
+            self._restore_hist[min(elapsed_us.bit_length() - 1,
+                                   len(self._restore_hist) - 1)] += 1
+            if sum(self._restore_hist) >= 512:
+                self._restore_hist = [c // 2
+                                      for c in self._restore_hist]
+        if failed:
+            # damaged/vanished pages heal through recompute: drop the
+            # entries so the NEXT admission re-writes fresh bytes
+            with self._lock:
+                for kx, e in failed:
+                    if self._entries.get(kx) is e and e["pins"] == 0:
+                        del self._entries[kx]
+                        self._free.append(e["page"])
+            self._save_manifest()
+        if self.stats is not None:
+            self.stats.add(kv_pages_restored=n_ok, kv_prefix_hits=n_ok,
+                           **({"kv_restore_failures": len(failed)}
+                              if failed else {}))
+            self.stats.set_gauges(
+                kv_restore_p99_ms=self.restore_p99_ms() or 0.0,
+                kv_store_pages_resident=self.pages_resident())
+        self.slo.observe(self.engine, self.restore_p99_ms(), self.stats)
+        return out
+
+    def restore_p99_ms(self) -> Optional[float]:
+        """p99 of the restore-batch latency from the log2 histogram
+        (µs buckets; the percentile walk shared with the engine's own
+        histogram rendering)."""
+        from nvme_strom_tpu.utils.stats import percentiles_from_log2_hist
+        with self._lock:
+            hist = list(self._restore_hist)
+        p = percentiles_from_log2_hist(hist, ps=(99,))[99]
+        return p / 1000.0 if p else None
+
+    def _restore_cost_ms(self) -> float:
+        """Median restore cost estimate (the benefit-score factor).
+        Called from ``_evict_locked`` with the store lock HELD — reads
+        the histogram without re-acquiring (a snapshot of monotonic
+        counters; the non-reentrant lock would deadlock)."""
+        from nvme_strom_tpu.utils.stats import percentiles_from_log2_hist
+        p = percentiles_from_log2_hist(list(self._restore_hist),
+                                       ps=(50,))[50]
+        return max(p / 1000.0, 1e-3)
+
+    # -- write tier --------------------------------------------------------
+
+    def _drain_writes(self, keep: int = 0) -> None:
+        """Complete pending page writes (oldest first).  A FAILED write
+        never raises: the store is a cache, so the affected page simply
+        drops (the next admission recomputes and re-writes it) — the
+        never-fail-a-request contract, write side."""
+        bad: list = []
+        with self._wlock:
+            while len(self._pending_writes) > keep:
+                for p in self._pending_writes.pop(0):
+                    try:
+                        p.wait()
+                    except OSError:
+                        bad.append(getattr(p, "offset", None))
+        if bad:
+            self._drop_pages_at(bad)
+
+    def _drop_pages_at(self, offsets) -> None:
+        """Drop entries whose backing page overlaps a failed write —
+        ALWAYS removed from the map (no future match/restore can serve
+        them); a pinned entry's slot is reclaimed by the in-flight
+        restore's unpin instead of here, so it is never reused under
+        an outstanding read."""
+        slots = {off // self.page_bytes for off in offsets
+                 if off is not None}
+        dropped = 0
+        with self._lock:
+            for kx, e in list(self._entries.items()):
+                if e["page"] in slots:
+                    del self._entries[kx]
+                    if e["pins"] == 0:
+                        self._free.append(e["page"])
+                    else:
+                        e["dropped"] = True   # unpin frees the slot
+                    dropped += 1
+        if dropped and self.stats is not None:
+            self.stats.add(kv_restore_failures=dropped)
+
+    def _unpin_locked(self, e: dict) -> None:
+        """Release one restore pin (lock held); hands a dropped
+        entry's slot back on the LAST unpin."""
+        e["pins"] -= 1
+        if e["pins"] == 0 and e.pop("dropped", False):
+            self._free.append(e["page"])
+
+    def put(self, pages) -> int:
+        """Persist computed pages: ``pages`` is a list of
+        ``(chain_key, k, v)`` with k/v numpy/JAX ``(L, nkv, P, hd)`` of
+        the model dtype.  A key already resident dedupes (counted) —
+        identical system prompts across sessions are written exactly
+        once.  Returns the number of pages actually written.  Writes
+        are async (bounded pipeline) and ride the engine's resilient
+        write mirror when it carries one; ``flush()`` drains.
+
+        Ordering contract: the entry is registered not-ready first (so
+        a racing put of the same key dedupes instead of double-writing)
+        and flips ready only AFTER its writes are submitted — a restore
+        that sees a ready page and then drains pending writes can never
+        read bytes the device hasn't been handed."""
+        from nvme_strom_tpu.utils.checksum import crc32c
+        written = 0
+        deduped = 0
+        for kx, k, v in pages:
+            # membership FIRST: the common dedupe case (two slots of
+            # one batch, or two servers, computing the same prompt)
+            # must not pay the page copy + CRC it is about to discard
+            with self._lock:
+                if kx in self._entries:
+                    deduped += 1
+                    continue
+                if self._free:
+                    slot = self._free.pop()
+                else:
+                    slot = self._evict_locked()
+                    if slot is None:
+                        continue   # everything pinned: skip, not fail
+                self._seq += 1
+                self._entries[kx] = {"page": slot, "hits": 0,
+                                     "seq": self._seq, "crc": None,
+                                     "pins": 0, "ready": False}
+            host = np.empty(self.page_bytes, np.uint8)
+            half = self.page_bytes // 2
+            host[:half] = np.ascontiguousarray(
+                np.asarray(k)).view(np.uint8).reshape(-1)
+            host[half:] = np.ascontiguousarray(
+                np.asarray(v)).view(np.uint8).reshape(-1)
+            crc = crc32c(host)
+            off = slot * self.page_bytes
+            chunk = self.engine.config.chunk_bytes
+            pend: list = []
+            try:
+                self._drain_writes(keep=self._MAX_PENDING - 1)
+                for p0 in range(0, self.page_bytes, chunk):
+                    pend.append(self.engine.submit_write(
+                        self._fh, off + p0, host[p0:p0 + chunk]))
+            except OSError:
+                # a submit failure mid-page must not leak the slot (a
+                # never-ready entry is invisible to match AND eviction)
+                # nor strand in-flight chunks' buffers: settle them,
+                # then reclaim
+                for p in pend:
+                    try:
+                        p.wait()
+                    except OSError:
+                        pass
+                with self._lock:
+                    e = self._entries.get(kx)
+                    if (e is not None and e["page"] == slot
+                            and not e["ready"]):
+                        del self._entries[kx]
+                        self._free.append(slot)
+                break
+            with self._wlock:
+                self._pending_writes.append(pend)
+            with self._lock:
+                e = self._entries.get(kx)
+                if e is not None and e["page"] == slot:
+                    e["crc"] = crc
+                    e["ready"] = True
+            written += 1
+        if self.stats is not None and (written or deduped):
+            self.stats.add(kv_pages_written=written,
+                           kv_pages_deduped=deduped,
+                           kv_bytes_saved=deduped * self.page_bytes)
+            self.stats.set_gauges(
+                kv_store_pages_resident=self.pages_resident())
+        if written:
+            self._save_manifest(throttle=True)
+        return written
+
+    def _evict_locked(self) -> Optional[int]:
+        """Reclaim the lowest-benefit unpinned page (lock held): score =
+        reuse frequency x estimated restore cost (docs/PERF.md §5) with
+        LRU tiebreak — equal-size pages make the cost a common factor,
+        but the formula stays literal so variable-size layouts inherit
+        the right policy."""
+        cost = self._restore_cost_ms()
+        victim_key = None
+        victim_score = None
+        for kx, e in self._entries.items():
+            if e["pins"] > 0 or not e["ready"]:
+                continue   # in-flight restore or a put still writing
+            score = (e["hits"] * cost, e["seq"])
+            if victim_score is None or score < victim_score:
+                victim_score = score
+                victim_key = kx
+        if victim_key is None:
+            return None
+        e = self._entries.pop(victim_key)
+        if self.stats is not None:
+            self.stats.add(kv_store_evictions=1)
+        return e["page"]
+
+    # -- durable manifest (the scrub contract) -----------------------------
+
+    @property
+    def manifest_path(self) -> str:
+        return self.path + ".kvman.json"
+
+    def _save_manifest(self, throttle: bool = False,
+                       clean: bool = False) -> None:
+        """Atomically persist {page slot -> (key hex, crc)} so
+        ``strom-scrub`` can verify the store offline with no model or
+        server around (the PR-5 at-rest integrity contract).
+
+        ``throttle`` (the per-put call) rewrites at most once per
+        second: the dump is O(resident pages) and must not ride every
+        admission of a large store.  ``clean`` is set ONLY by
+        ``flush()``/``close()`` — after the write pipeline drained —
+        and is what :meth:`_load_manifest` requires to reattach: a
+        mid-run manifest may stamp pages whose async writes never
+        completed (or whose slot was re-used inside the throttle
+        window), so a crash must cost cache entries, never serve torn
+        bytes to a restarted server."""
+        import json
+        import os
+        import time as _time
+        if throttle:
+            now = _time.monotonic()
+            if now - self._man_last < 1.0:
+                return
+            self._man_last = now
+        with self._lock:
+            pages = {str(e["page"]): {"key": kx.hex(), "crc": e["crc"]}
+                     for kx, e in self._entries.items() if e["ready"]}
+        man = {"version": 1, "page_bytes": self.page_bytes,
+               "page_tokens": self.page_tokens, "clean": clean,
+               "pages": pages}
+        tmp = self.manifest_path + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(man, f, sort_keys=True)
+            os.replace(tmp, self.manifest_path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _load_manifest(self) -> None:
+        """Reattach a previous process's store: resident pages (and
+        their stamps) survive a server restart — the cross-SESSION half
+        of cross-request reuse.  Chain keys are content hashes, so a
+        manifest from another model/page size simply never matches;
+        only a CLEAN manifest (written after the write pipeline
+        drained) reattaches, and ANY malformed content starts the
+        store cold instead of failing construction — a cache's
+        manifest must never be able to crash a serving deployment."""
+        import json
+        try:
+            with open(self.manifest_path) as f:
+                man = json.load(f)
+            if (man.get("version") != 1
+                    or man.get("page_bytes") != self.page_bytes
+                    or man.get("page_tokens") != self.page_tokens
+                    or not man.get("clean")):
+                return
+            with self._lock:
+                for slot_s, row in man.get("pages", {}).items():
+                    slot = int(slot_s)
+                    if slot >= self.capacity_pages:
+                        continue
+                    self._entries[bytes.fromhex(row["key"])] = {
+                        "page": slot, "hits": 0, "seq": 0,
+                        "crc": int(row["crc"]), "pins": 0,
+                        "ready": True}
+                    if slot in self._free:
+                        self._free.remove(slot)
+        except (OSError, ValueError, TypeError, KeyError,
+                AttributeError):
+            with self._lock:
+                self._entries.clear()
+                self._free = list(range(self.capacity_pages - 1, -1,
+                                        -1))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def flush(self) -> None:
+        self._drain_writes()
+        self._save_manifest(clean=True)
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self.flush()
+            finally:
+                self.engine.close(self._fh)
+                self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def build_prefix_store(cfg: TransformerConfig, engine: StromEngine,
+                       path: str, page_tokens: int,
+                       kvcfg=None) -> Optional[PrefixStore]:
+    """The env-gated factory serving deployments use: None when
+    ``STROM_KV_PREFIX`` is unset/0 OR the budget is 0 — the servers
+    then run today's per-session path bit-for-bit
+    (tests/test_kvserve.py proves it).  A zero budget must disable
+    rather than clamp: a one-page store would thrash every multi-page
+    prefix while paying full write/manifest/restore overhead."""
+    from nvme_strom_tpu.utils.config import KVServeConfig
+    kvcfg = kvcfg or KVServeConfig()
+    if not kvcfg.prefix_enabled or kvcfg.store_mb <= 0:
+        return None
+    return PrefixStore(cfg, engine, path,
+                       page_tokens=kvcfg.page_tokens or page_tokens,
+                       capacity_bytes=kvcfg.store_mb << 20,
+                       p99_target_ms=kvcfg.p99_target_ms)
+
+
 def offloaded_generate(params: Dict, prompt, cfg: TransformerConfig,
                        ocfg: OffloadConfig, engine: StromEngine,
                        max_new_tokens: int,

@@ -52,12 +52,27 @@ class _Request:
     seed: int = 0
     out: List[int] = field(default_factory=list)
     chain_keys: object = None     # paged prefix-cache memo
+    store_keys: object = None     # NVMe prefix-store memo (may differ:
+    #                               store page size vs HBM block size)
+    # serving-SLO timeline (docs/PERF.md §5): queued, admitted, first
+    # token DELIVERED (the host readback — the moment a client could
+    # see it); stats() aggregates TTFT and admission wait from these
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first: Optional[float] = None
 
 
+@jax.jit
 def _sample_slots(logits, temps, top_ps, seeds, pos):
     """Per-slot temperature/top-p sampling, all quantities DATA so one
     compiled program serves any mix of greedy and sampled requests
     (the per-slot-position trick applied to decoding params).
+
+    Jitted at this level because ``_first_token`` calls it EAGERLY once
+    per admission: un-jitted, the ``lax.cond`` dispatch re-traced its
+    branches every call (~175 ms per admission on the CPU fallback —
+    it dominated the whole admission phase); inside the jitted step
+    programs the wrapper is inlined and changes nothing.
 
     logits (B, V) f32; temps/top_ps (B,) f32; seeds (B,) uint32 (per
     request, from submit); pos (B,) int32 — the step index folds into
@@ -220,11 +235,19 @@ class DecodeServer:
     """
 
     def __init__(self, params: Dict, cfg: TransformerConfig,
-                 max_batch: int, max_len: int, cache_attn="auto"):
+                 max_batch: int, max_len: int, cache_attn="auto",
+                 kv_store=None):
         self.params = params
         self.cfg = cfg
         self.B = max_batch
         self.max_len = max_len
+        #: content-addressed NVMe prefix store (models/kv_offload.py
+        #: PrefixStore, docs/PERF.md §5) — None (default) is today's
+        #: per-session path bit-for-bit.  Shared system prompts across
+        #: sessions/servers restore from NVMe instead of re-prefilling;
+        #: each serve step batches EVERY admitting slot's due page
+        #: reads into one decode-class plan_and_submit.
+        self.kv_store = kv_store
         # cache_attn: None = XLA dense; a callable (e.g.
         # ops.decode_attention.make_decode_attn()) = that kernel;
         # "auto" (default) = the fused Pallas kernel on TPU when
@@ -257,6 +280,12 @@ class DecodeServer:
         self.timings: Dict[str, float] = {
             "admit_s": 0.0, "dispatch_s": 0.0, "readback_s": 0.0,
             "steps": 0, "readbacks": 0}
+        #: per-request serving metrics of RETIRED requests ({rid:
+        #: {"ttft_ms", "admit_wait_ms"}}, newest last, bounded) plus
+        #: the running aggregates stats() reports
+        self.request_metrics: Dict[object, Dict[str, float]] = {}
+        self._metrics_agg = {"n": 0, "ttft_sum": 0.0, "ttft_max": 0.0,
+                             "wait_sum": 0.0, "wait_max": 0.0}
         self._alloc_storage()
 
     def _alloc_storage(self) -> None:
@@ -293,37 +322,165 @@ class DecodeServer:
         self.queue.append(_Request(rid, list(prompt_ids), max_new,
                                    eos_id, temperature=temperature,
                                    top_p=top_p,
-                                   seed=seed & 0xFFFFFFFF))
+                                   seed=seed & 0xFFFFFFFF,
+                                   t_submit=time.monotonic()))
+
+    # -- admission (plan / restore / finish) ------------------------------
+    #
+    # Admission is split in two so ONE serve step can gather every
+    # admitting slot's due NVMe page reads into a single decode-class
+    # plan_and_submit batch (the prefix store, docs/PERF.md §5): the
+    # PLAN phase makes the capacity decisions sequentially (block
+    # allocation, HBM prefix-cache refs — exactly the old per-slot
+    # order, so admission control is unchanged), the batched restore
+    # runs between, and the FINISH phase prefills/scatters.  With no
+    # store attached the two halves compose to the old _admit verbatim.
 
     def _admit(self, slot: int, req: _Request) -> None:
-        """Prefill the request alone, scatter its KV into the slot.
+        """Single-request admission (compat path; step_many batches)."""
+        self._admit_finish(self._admit_plan(slot, req), {})
 
-        The prompt right-pads to a power-of-two bucket so admission
-        compiles once per bucket, not once per prompt length; the pad
-        rows' cache entries are dead (decode overwrites a position
-        before its mask exposes it) and the first-token logits read at
-        the true last position."""
+    def _admit_plan(self, slot: int, req: _Request) -> dict:
+        """Capacity decisions only — nothing is prefilled yet."""
+        return {"slot": slot, "req": req}
+
+    def _store_keys(self, req: _Request) -> list:
+        """The request's prefix-store chain keys, hashed once."""
+        if self.kv_store is None:
+            return []
+        if req.store_keys is None:
+            req.store_keys = self.kv_store.chain_keys(req.prompt)
+        return req.store_keys
+
+    def _store_skip(self, plan: dict) -> int:
+        """Chain pages a CHEAPER tier already covers (the paged server's
+        in-HBM block cache); the store only restores past them."""
+        return 0
+
+    def _store_fits(self, plan: dict, n_pages: int) -> bool:
+        """Whether a restored-prefix admission cache of ``n_pages``-page
+        granularity fits this server's storage."""
+        s = len(plan["req"].prompt)
+        P = self.kv_store.page_tokens
+        return -(-s // P) * P <= self.max_len
+
+    def _restore_prefixes(self, plans: list) -> Dict[int, dict]:
+        """Batch-restore every admitting slot's store-resident pages:
+        ONE plan_and_submit under the decode class (cross-request
+        locality for the coalescing planner and the ring scheduler).
+        Returns {slot: {chain_index: (k, v) numpy pages}}."""
+        store = self.kv_store
+        wants: Dict[int, tuple] = {}
+        misses = 0
+        for plan in plans:
+            req = plan["req"]
+            keys = self._store_keys(req)
+            if not keys:
+                continue
+            skip = self._store_skip(plan)
+            matched = store.match(keys)
+            misses += len(keys) - matched
+            if matched > skip and self._store_fits(plan, matched):
+                wants[plan["slot"]] = (skip, keys[skip:matched])
+        if misses and store.stats is not None:
+            store.stats.add(kv_prefix_misses=misses)
+        if not wants:
+            return {}
+        return store.restore_many(wants)
+
+    def _contiguous_from(self, restored: dict, start: int) -> list:
+        """The restored pages usable as a prefix extension: chain
+        indices ``start, start+1, ...`` without a gap."""
+        use = []
+        i = start
+        while i in restored:
+            use.append(restored[i])
+            i += 1
+        return use
+
+    def _admit_finish(self, plan: dict, restored: dict) -> None:
+        """Prefill the request (suffix-only when pages restored),
+        scatter its KV into the slot.
+
+        Without a store hit the prompt right-pads to a power-of-two
+        bucket so admission compiles once per bucket, not once per
+        prompt length; the pad rows' cache entries are dead (decode
+        overwrites a position before its mask exposes it) and the
+        first-token logits read at the true last position.  With a hit,
+        the restored pages head a page-granular cache and block_step
+        prefills only the suffix (block_step at pos 0 IS the dense
+        prefill, so the two paths share one math)."""
+        import numpy as np
+        slot, req = plan["slot"], plan["req"]
         s = len(req.prompt)
-        bucket = 16
-        while bucket < s:
-            bucket *= 2
-        bucket = min(bucket, self.max_len)
-        cache = _dec.init_cache(self.cfg, 1, bucket)
-        padded = req.prompt + [0] * (bucket - s)
-        prompt = jnp.asarray([padded], jnp.int32)
-        logits, cache = _dec.prefill(self.params, prompt, self.cfg,
-                                     cache, last=s - 1)
+        store = self.kv_store
+        use = self._contiguous_from(restored, 0) if restored else []
+        if use:
+            P = store.page_tokens
+            c2 = len(use)
+            n_pb = -(-s // P)
+            cache = _dec.init_cache(self.cfg, 1, n_pb * P)
+            k_head = jnp.asarray(np.concatenate(
+                [k for k, _ in use], axis=2))[:, None]
+            v_head = jnp.asarray(np.concatenate(
+                [v for _, v in use], axis=2))[:, None]
+            cache["k"] = jax.lax.dynamic_update_slice(
+                cache["k"], k_head.astype(cache["k"].dtype),
+                (0, 0, 0, 0, 0))
+            cache["v"] = jax.lax.dynamic_update_slice(
+                cache["v"], v_head.astype(cache["v"].dtype),
+                (0, 0, 0, 0, 0))
+            cache["pos"] = jnp.asarray(c2 * P, jnp.int32)
+            suffix = req.prompt[c2 * P:]
+            padded = suffix + [0] * ((n_pb - c2) * P - len(suffix))
+            logits, cache = _dec.block_step(
+                self.params, jnp.asarray([padded], jnp.int32),
+                self.cfg, cache, last=len(suffix) - 1)
+        else:
+            bucket = 16
+            while bucket < s:
+                bucket *= 2
+            bucket = min(bucket, self.max_len)
+            cache = _dec.init_cache(self.cfg, 1, bucket)
+            padded = req.prompt + [0] * (bucket - s)
+            prompt = jnp.asarray([padded], jnp.int32)
+            logits, cache = _dec.prefill(self.params, prompt, self.cfg,
+                                         cache, last=s - 1)
         self.k_cache, self.v_cache = _scatter_prefill(
             jnp.asarray(slot, jnp.int32), self.k_cache, self.v_cache,
             cache["k"], cache["v"])
+        if store is not None:
+            self._store_put(req, cache, len(use), store.page_tokens)
         first = self._first_token(logits, req, s)
         self._pending_first.append((slot, first))
         self.slots[slot] = req
         self._set_slot_params(slot, req)
+        req.t_admit = time.monotonic()
         # pos[slot] = s - nothing decoded past the prompt yet; tok is
         # the token entering the cache on the next step
         self.pos = self.pos.at[slot].set(s)
         self.tok = self.tok.at[slot].set(first)
+
+    def _store_put(self, req: _Request, cache: Dict, have: int,
+                   P: int) -> None:
+        """Persist this admission's newly computed full prompt pages
+        (chain indices ``have..``) — written once store-wide however
+        many sessions share them (put() dedupes by content key).  The
+        device→host pull is one slice per admission; admission already
+        tolerates host work, and the write itself is async."""
+        import numpy as np
+        keys = self._store_keys(req)
+        n_full = len(keys)
+        if n_full <= have:
+            return
+        # one device_get for the whole new-page range, then page slices
+        k_all = np.asarray(cache["k"][:, 0, :, have * P:n_full * P])
+        v_all = np.asarray(cache["v"][:, 0, :, have * P:n_full * P])
+        pages = [(keys[i],
+                  k_all[:, :, (i - have) * P:(i - have + 1) * P],
+                  v_all[:, :, (i - have) * P:(i - have + 1) * P])
+                 for i in range(have, n_full)]
+        self.kv_store.put(pages)
 
     def _first_token(self, logits, req: _Request, s: int):
         """The prefill's next token under the request's own sampling
@@ -351,8 +508,31 @@ class DecodeServer:
         done_eos = req.eos_id is not None and req.out[-1] == req.eos_id
         if done_len or done_eos:
             self.slots[slot] = None
+            self._record_metrics(req)
             return req.rid, req.out
         return None
+
+    _METRICS_KEEP = 512
+
+    def _record_metrics(self, req: _Request) -> None:
+        """Retire-time serving metrics: TTFT (submit → first token
+        DELIVERED at a host readback) and admission wait (submit →
+        admitted into a slot) — the observable form of the SLO story
+        (docs/PERF.md §5)."""
+        ttft_ms = (1000.0 * (req.t_first - req.t_submit)
+                   if req.t_first is not None else 0.0)
+        wait_ms = 1000.0 * (req.t_admit - req.t_submit)
+        self.request_metrics[req.rid] = {
+            "ttft_ms": round(ttft_ms, 3),
+            "admit_wait_ms": round(wait_ms, 3)}
+        while len(self.request_metrics) > self._METRICS_KEEP:
+            self.request_metrics.pop(next(iter(self.request_metrics)))
+        agg = self._metrics_agg
+        agg["n"] += 1
+        agg["ttft_sum"] += ttft_ms
+        agg["ttft_max"] = max(agg["ttft_max"], ttft_ms)
+        agg["wait_sum"] += wait_ms
+        agg["wait_max"] = max(agg["wait_max"], wait_ms)
 
     # -- serving ----------------------------------------------------------
 
@@ -362,14 +542,24 @@ class DecodeServer:
 
     def stats(self) -> Dict[str, int]:
         """Point-in-time serving gauges (the STAT_INFO discipline for
-        the inference tier): slot occupancy, queue depth, and tokens
-        generated by in-flight requests."""
+        the inference tier): slot occupancy, queue depth, tokens
+        generated by in-flight requests, and the retired requests'
+        TTFT / admission-wait aggregates (per-request values live in
+        ``request_metrics``)."""
+        agg = self._metrics_agg
+        n = agg["n"]
         return {
             "slots_total": self.B,
             "slots_busy": sum(r is not None for r in self.slots),
             "queued": len(self.queue),
             "inflight_tokens": sum(len(r.out) for r in self.slots
                                    if r is not None),
+            "requests_finished": n,
+            "ttft_ms_avg": round(agg["ttft_sum"] / n, 3) if n else 0.0,
+            "ttft_ms_max": round(agg["ttft_max"], 3),
+            "admit_wait_ms_avg": round(agg["wait_sum"] / n, 3)
+            if n else 0.0,
+            "admit_wait_ms_max": round(agg["wait_max"], 3),
         }
 
     def _can_admit(self, req: _Request) -> bool:
@@ -413,15 +603,23 @@ class DecodeServer:
         ``k_steps - 1`` sub-steps."""
         finished: Dict[object, List[int]] = {}
         t0 = time.monotonic()
+        # plan every admission first (capacity decisions in the same
+        # sequential order as per-slot admission), batch-restore ALL
+        # their store-resident prefix pages in ONE decode-class read
+        # batch, then finish each admission — dispatch-only: the first
+        # token stays on device (in _pending_first) and retirement is
+        # decided after the batch readback below, so admission
+        # pipelines with the decode dispatches instead of paying a
+        # link round trip per request
+        plans = []
         for slot in range(self.B):
             if (self.slots[slot] is None and self.queue
                     and self._can_admit(self.queue[0])):
-                # dispatch-only: the first token stays on device (in
-                # _pending_first) and retirement is decided after the
-                # batch readback below — admission pipelines with the
-                # decode dispatches instead of paying a link round
-                # trip per request
-                self._admit(slot, self.queue.pop(0))
+                plans.append(self._admit_plan(slot, self.queue.pop(0)))
+        restored = (self._restore_prefixes(plans)
+                    if plans and self.kv_store is not None else {})
+        for plan in plans:
+            self._admit_finish(plan, restored.get(plan["slot"], {}))
         self.timings["admit_s"] += time.monotonic() - t0
         active_slots = [i for i, r in enumerate(self.slots)
                         if r is not None]
@@ -466,7 +664,9 @@ class DecodeServer:
         self.timings["readbacks"] += 1
         # replay in generation order: deferred first tokens precede
         # this batch's sub-step tokens for their slots
+        t_now = time.monotonic()
         for (slot, _), v in zip(pending, first_h):
+            self.slots[slot].t_first = t_now    # first token DELIVERED
             self.slots[slot].out.append(int(v))
             ret = self._retire_or_keep(slot)
             if ret:
@@ -530,16 +730,23 @@ class PagedDecodeServer(DecodeServer):
 
     def __init__(self, params: Dict, cfg: TransformerConfig,
                  max_batch: int, max_len: int, total_blocks: int,
-                 block_len: int = 128, prefix_cache: bool = True):
+                 block_len: int = 128, prefix_cache: bool = True,
+                 kv_store=None):
         if block_len < 1 or total_blocks < 1:
             raise ValueError("block_len and total_blocks must be >= 1")
+        if kv_store is not None and kv_store.page_tokens != block_len:
+            # store pages scatter 1:1 into pool blocks; a mismatch
+            # would need a re-chunking copy on every restore
+            raise ValueError(
+                f"kv_store.page_tokens ({kv_store.page_tokens}) must "
+                f"equal block_len ({block_len})")
         self.block_len = block_len
         self.total_blocks = total_blocks
         self.prefix_cache = prefix_cache
         # cache_attn is the DENSE servers' knob; the paged step always
         # runs the paged-attention kernel
         super().__init__(params, cfg, max_batch, max_len,
-                         cache_attn=None)
+                         cache_attn=None, kv_store=kv_store)
         self.max_blocks = -(-max_len // block_len)
 
     def _alloc_storage(self) -> None:
@@ -662,7 +869,10 @@ class PagedDecodeServer(DecodeServer):
             out.append(self.free.pop())
         return out
 
-    def _admit(self, slot: int, req: _Request) -> None:
+    def _admit_plan(self, slot: int, req: _Request) -> dict:
+        """Capacity phase: HBM prefix-cache refs + block allocation, in
+        the exact order sequential admission made them (so a later
+        queue head's _can_admit sees the updated free list)."""
         s = len(req.prompt)
         bk = self.block_len
         need = -(-(s + req.max_new) // bk)
@@ -671,48 +881,86 @@ class PagedDecodeServer(DecodeServer):
         c = len(matched)
         shared = [self._pc_acquire(kx) for kx in matched]
         new_blks = self._alloc_blocks(need - c)
-        blks = shared + new_blks
+        return {"slot": slot, "req": req, "keys": keys, "c": c,
+                "blks": shared + new_blks}
+
+    def _store_skip(self, plan: dict) -> int:
+        # pages the in-HBM block cache already serves cost one gather —
+        # cheaper than any NVMe read, so the store starts past them
+        return plan["c"]
+
+    def _store_fits(self, plan: dict, n_pages: int) -> bool:
+        return True    # restored pages land in already-reserved blocks
+
+    def _admit_finish(self, plan: dict, restored: dict) -> None:
+        slot, req = plan["slot"], plan["req"]
+        keys, c, blks = plan["keys"], plan["c"], plan["blks"]
+        s = len(req.prompt)
+        bk = self.block_len
         self.blocks[slot] = blks
         self._table_dev = None
         if c:
             self._pc_hits += 1
             self._pc_shared_blocks += c
+        # NVMe-restored pages (chain indices past the HBM match, from
+        # the step's batched decode-class read) scatter into this
+        # request's own new blocks and REGISTER in the HBM cache — the
+        # next same-prefix admission hits DRAM, not NVMe
+        use = self._contiguous_from(restored, c) if restored else []
+        c2 = len(use)
+        if use:
+            import numpy as np
+            rows_k = jnp.asarray(np.stack([k for k, _ in use], axis=1))
+            rows_v = jnp.asarray(np.stack([v for _, v in use], axis=1))
+            self.k_pool, self.v_pool = _scatter_blocks(
+                self.k_pool, self.v_pool,
+                jnp.asarray(blks[c:c + c2], jnp.int32), rows_k, rows_v)
+            if keys:
+                # keys is empty with prefix_cache=False (store restores
+                # still work; there is just no HBM registry to join)
+                for j in range(c2):
+                    self._pc_register(keys[c + j], blks[c + j])
+        ct = c + c2
 
-        # prefill: gathered cached prefix + one block_step over the
-        # suffix (from an empty cache when nothing matched — block_step
-        # at pos 0 IS the dense prefill); pad rows sit past pos and are
-        # overwritten before the mask reaches them
+        # prefill: gathered cached prefix (HBM-shared + just-restored
+        # blocks) + one block_step over the suffix (from an empty cache
+        # when nothing matched — block_step at pos 0 IS the dense
+        # prefill); pad rows sit past pos and are overwritten before
+        # the mask reaches them
         n_pb = -(-s // bk)
         cache = _dec.init_cache(self.cfg, 1, n_pb * bk)
-        if c:
+        if ct:
             k_d, v_d = _gather_prefix(self.k_pool, self.v_pool,
-                                      jnp.asarray(shared, jnp.int32),
+                                      jnp.asarray(blks[:ct], jnp.int32),
                                       n_pb * bk)
             cache["k"], cache["v"] = k_d, v_d
-            cache["pos"] = jnp.asarray(c * bk, jnp.int32)
-        suffix = req.prompt[c * bk:]
-        padded = suffix + [0] * ((n_pb - c) * bk - len(suffix))
+            cache["pos"] = jnp.asarray(ct * bk, jnp.int32)
+        suffix = req.prompt[ct * bk:]
+        padded = suffix + [0] * ((n_pb - ct) * bk - len(suffix))
         logits, cache = _dec.block_step(
             self.params, jnp.asarray([padded], jnp.int32), self.cfg,
             cache, last=len(suffix) - 1)
         L, nkv, hd = (self.cfg.n_layers, self.cfg.n_kv_heads,
                       self.cfg.head_dim)
-        rows_k = (cache["k"][:, 0, :, c * bk:n_pb * bk]
-                  .reshape(L, nkv, n_pb - c, bk, hd))
-        rows_v = (cache["v"][:, 0, :, c * bk:n_pb * bk]
-                  .reshape(L, nkv, n_pb - c, bk, hd))
+        rows_k = (cache["k"][:, 0, :, ct * bk:n_pb * bk]
+                  .reshape(L, nkv, n_pb - ct, bk, hd))
+        rows_v = (cache["v"][:, 0, :, ct * bk:n_pb * bk]
+                  .reshape(L, nkv, n_pb - ct, bk, hd))
         self.k_pool, self.v_pool = _scatter_blocks(
             self.k_pool, self.v_pool,
-            jnp.asarray(blks[c:n_pb], jnp.int32),
+            jnp.asarray(blks[ct:n_pb], jnp.int32),
             rows_k.transpose(0, 2, 1, 3, 4),
             rows_v.transpose(0, 2, 1, 3, 4))
         # newly computed FULL blocks join the cache for future requests
-        for i in range(c, len(keys)):
+        for i in range(ct, len(keys)):
             self._pc_register(keys[i], blks[i])
+        if self.kv_store is not None:
+            self._store_put(req, cache, ct, bk)
         first = self._first_token(logits, req, s)
         self._pending_first.append((slot, first))
         self.slots[slot] = req
         self._set_slot_params(slot, req)
+        req.t_admit = time.monotonic()
         self.pos = self.pos.at[slot].set(s)
         self._pos_h[slot] = s
         self.tok = self.tok.at[slot].set(first)

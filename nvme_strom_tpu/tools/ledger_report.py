@@ -103,6 +103,10 @@ CONTRACT = {
     16: ("tar-index-rate", "attr"),
     17: ("fed-train-mfu", "fed"),
     18: ("offloaded-activations-step", "attr"),
+    # serving with the NVMe KV prefix store: the claim (TTFT/ratio vs
+    # the same-run store-off baseline, hit/dedupe counters) lives in
+    # the metric tag — an attribution row like the other serving rows
+    19: ("kv-serving-prefix", "attr"),
 }
 
 #: the ONE validity rule set, shared with the watcher's coverage

@@ -176,6 +176,32 @@ def scrub_sidecar_file(eng, path: str, sc=None) -> List[dict]:
     return _scrub_stamped_spans(eng, path, spans, "offset")
 
 
+def scrub_kv_store(eng, path: str) -> List[dict]:
+    """Verify every manifest-stamped page of one serving KV prefix
+    store (models/kv_offload.py PrefixStore — docs/PERF.md §5): the
+    ``.kvman.json`` sidecar maps page slots to write-time CRC32C
+    stamps, so the offline scrub covers the store's persistent state
+    exactly like checkpoint tiles and shard sidecars."""
+    import json as _json
+    man_path = path + ".kvman.json"
+    try:
+        with open(man_path) as f:
+            man = _json.load(f)
+    except (OSError, ValueError) as e:
+        return [{"file": path, "error": f"unreadable manifest "
+                                        f"{man_path}: {e}"}]
+    pb = int(man.get("page_bytes", 0))
+    if man.get("version") != 1 or pb <= 0:
+        return [{"file": path,
+                 "error": f"unsupported kv manifest {man_path}"}]
+    spans = [(int(slot) * pb, pb, int(row["crc"]), int(slot))
+             for slot, row in sorted(man.get("pages", {}).items(),
+                                     key=lambda kv: int(kv[0]))]
+    if not spans:
+        return []
+    return _scrub_stamped_spans(eng, path, spans, "page")
+
+
 def stamp_file(path: str) -> Optional[str]:
     """Write a sidecar for an unstamped shard (format sniffed by
     suffix); returns the sidecar path or None when unsupported."""
@@ -214,22 +240,35 @@ def _is_ckpt_dir(path: str) -> bool:
 
 def collect_targets(path: str) -> Dict[str, List[str]]:
     """{kind: paths} for ``path``: safetensors files (checkpoint tiles,
-    weight shards) and sidecar-eligible data shards."""
+    weight shards), sidecar-eligible data shards, and serving KV prefix
+    stores (recognized by their ``.kvman.json`` manifest — the page
+    file itself may carry any name)."""
     st: List[str] = []
     shards: List[str] = []
+    kvstores: List[str] = []
     if os.path.isfile(path):
-        (st if path.endswith(".safetensors") else shards).append(path)
-        return {"safetensors": st, "shards": shards}
+        if os.path.exists(path + ".kvman.json"):
+            kvstores.append(path)
+        elif path.endswith(".safetensors"):
+            st.append(path)
+        else:
+            shards.append(path)
+        return {"safetensors": st, "shards": shards,
+                "kvstores": kvstores}
     for dirpath, dirnames, filenames in os.walk(path):
         dirnames[:] = [d for d in dirnames if not _TMP_RE.match(d)]
         for name in sorted(filenames):
             p = os.path.join(dirpath, name)
-            if name.endswith(".safetensors"):
+            if name.endswith(".kvman.json"):
+                continue            # the manifest rides its page file
+            if os.path.exists(p + ".kvman.json"):
+                kvstores.append(p)
+            elif name.endswith(".safetensors"):
                 st.append(p)
             elif name.endswith((".tar", ".tfrecord", ".tfrecords",
                                 ".fixedrec", ".bin")):
                 shards.append(p)
-    return {"safetensors": st, "shards": shards}
+    return {"safetensors": st, "shards": shards, "kvstores": kvstores}
 
 
 def main(argv=None) -> int:
@@ -280,6 +319,9 @@ def _scan(args, targets, report) -> int:
             for d in scrub_safetensors(eng, p):
                 (report["unstamped"] if d.get("unstamped")
                  else report["damage"]).append(d)
+        for p in targets.get("kvstores", []):
+            report["files_scanned"] += 1
+            report["damage"].extend(scrub_kv_store(eng, p))
         for p in targets["shards"]:
             from nvme_strom_tpu.utils.checksum import load_sidecar
             sc = load_sidecar(p)
@@ -338,7 +380,7 @@ def _scan(args, targets, report) -> int:
         print(f"scrubbed {report['files_scanned']} file(s), "
               f"{report['bytes_verified']} bytes verified")
         for d in report["damage"]:
-            where = d.get("tensor", d.get("offset", ""))
+            where = d.get("tensor", d.get("offset", d.get("page", "")))
             print(f"  DAMAGED {d['file']}"
                   f"{' [' + str(where) + ']' if where != '' else ''}: "
                   f"{d['error']}")

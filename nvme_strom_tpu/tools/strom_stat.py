@@ -69,6 +69,14 @@ _HOSTCACHE_COUNTERS = (
     "cache_fill_failures", "cache_evictions", "cache_invalidations",
 )
 
+#: serving KV prefix-store counters (models/kv_offload.py PrefixStore —
+#: docs/PERF.md §5); own block, shown only when a store saw traffic
+_KV_COUNTERS = (
+    "kv_prefix_hits", "kv_prefix_misses", "kv_pages_deduped",
+    "kv_bytes_saved", "kv_pages_written", "kv_pages_restored",
+    "kv_store_evictions", "kv_slo_boosts", "kv_restore_failures",
+)
+
 
 def render_device(path: str) -> str:
     """Backing-device topology of ``path`` — the observable form of the
@@ -183,6 +191,26 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
                     f"    class {k:<12} hits={ch} misses={cm} "
                     f"rate={ch / (ch + cm):.3f} "
                     f"served={_human(int(cls[k].get('bytes_served_cache', 0)))}")
+    if (any(int(snap.get(n, 0)) for n in _KV_COUNTERS)
+            or snap.get("kv_store_pages_resident")):
+        lines.append("  kv serving (content-addressed prefix store):")
+        for name in _KV_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if "bytes" in name else str(v)
+            lines.append(f"    {name:<22} {shown:>14}")
+        hits = int(snap.get("kv_prefix_hits", 0))
+        misses = int(snap.get("kv_prefix_misses", 0))
+        if hits + misses:
+            lines.append(f"    {'prefix hit rate':<22} "
+                         f"{hits / (hits + misses):>14.3f}")
+        resident = snap.get("kv_store_pages_resident")
+        if resident is not None:
+            lines.append(f"    {'pages resident':<22} "
+                         f"{int(resident):>14}")
+        p99 = snap.get("kv_restore_p99_ms")
+        if p99:
+            lines.append(f"    {'restore p99':<22} "
+                         f"{float(p99):>11.2f} ms")
     if any(int(snap.get(n, 0)) for n in _RESILIENCE_COUNTERS):
         lines.append("  resilience (recoveries + degradations):")
         for name in _RESILIENCE_COUNTERS:

@@ -191,6 +191,49 @@ class HostCacheConfig:
 
 
 @dataclass(frozen=True)
+class KVServeConfig:
+    """Serving KV prefix-store knobs (models/kv_offload.py PrefixStore;
+    semantics in docs/PERF.md §5).
+
+    The store sits under the decode servers (models/serving.py): prompt
+    KV pages are content-addressed by a rolling hash of their token
+    chain (per model identity), written ONCE however many sessions
+    share the prefix, and restored through the decode-class batched
+    read path instead of being re-prefilled.  STROM_* environment
+    variables are read at construction time, mirroring EngineConfig.
+    """
+
+    #: master switch: STROM_KV_PREFIX=1 enables the store for servers
+    #: built through ``build_prefix_store``; 0 (default) is bit-for-bit
+    #: today's per-session path (proven by tests/test_kvserve.py)
+    prefix_enabled: bool = field(
+        default_factory=lambda: os.environ.get("STROM_KV_PREFIX",
+                                               "0") == "1")
+    #: NVMe budget of the page store in MiB; eviction reclaims the
+    #: lowest benefit score (reuse frequency x restore cost) first
+    store_mb: int = field(
+        default_factory=lambda: _env_int("STROM_KV_STORE_MB", 64))
+    #: tokens per content-addressed page; 0 (default) adopts the
+    #: server's own granularity (PagedDecodeServer.block_len, or the
+    #: dense server's page default)
+    page_tokens: int = field(
+        default_factory=lambda: _env_int("STROM_KV_PAGE_TOKENS", 0))
+    #: decode-path restore p99 target in ms; a violation makes the SLO
+    #: governor raise the decode class's concurrent-hedge budget (and
+    #: scheduler weight) until the p99 recovers.  0 (default) = no SLO.
+    p99_target_ms: float = field(
+        default_factory=lambda: _env_float("STROM_KV_P99_MS", 0.0))
+
+    def __post_init__(self):
+        if self.store_mb < 0:
+            raise ValueError("store_mb must be >= 0")
+        if self.page_tokens < 0:
+            raise ValueError("page_tokens must be >= 0")
+        if self.p99_target_ms < 0:
+            raise ValueError("p99_target_ms must be >= 0")
+
+
+@dataclass(frozen=True)
 class ResilientConfig:
     """Recovery policy of ``io/resilient.py``'s ``ResilientEngine``.
 

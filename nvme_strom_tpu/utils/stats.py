@@ -126,6 +126,31 @@ class StromStats:
     # dropped because an engine write overlapped them (staleness guard)
     cache_evictions: int = 0
     cache_invalidations: int = 0
+    # -- serving KV prefix store (models/kv_offload.py PrefixStore,
+    # docs/PERF.md §5) -----------------------------------------------------
+    # content-addressed prompt pages served from NVMe instead of being
+    # re-prefilled (hits) vs pages the store had to let the server
+    # compute (misses) — the cross-request dedupe win, page units
+    kv_prefix_hits: int = 0
+    kv_prefix_misses: int = 0
+    # pages written to the store / restored from it through the decode-
+    # class batched read path
+    kv_pages_written: int = 0
+    kv_pages_restored: int = 0
+    # put() calls that found the page already resident under its chain
+    # key (identical system prompts across sessions write ONCE), and the
+    # NVMe write bytes that dedupe avoided
+    kv_pages_deduped: int = 0
+    kv_bytes_saved: int = 0
+    # SSD-resident prefixes reclaimed by the benefit-scored eviction
+    # (reuse frequency x restore cost, docs/PERF.md §5)
+    kv_store_evictions: int = 0
+    # SLO-governor actions: decode hedge-budget/weight raises after a
+    # restore-p99 target (STROM_KV_P99_MS) violation, and pages dropped
+    # after a failed restore (I/O or CRC) or a failed eviction write —
+    # either way healed through recompute on the next admission
+    kv_slo_boosts: int = 0
+    kv_restore_failures: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)

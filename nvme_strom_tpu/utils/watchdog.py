@@ -204,6 +204,27 @@ class StepWatchdog:
                           f"rejected={s.cache_admission_rejections} "
                           f"evicted={s.cache_evictions}",
                           file=w, flush=True)
+                # serving KV prefix store (models/kv_offload.py,
+                # docs/PERF.md §5): a stalled admission with restores
+                # MOVING is waiting on NVMe, not wedged; restore
+                # failures or a climbing SLO-boost count mean the
+                # decode path is fighting the device for its p99
+                if (s.kv_prefix_hits or s.kv_prefix_misses
+                        or s.kv_pages_written):
+                    ksnap = s.snapshot()
+                    print(f"kv serving: "
+                          f"prefix={s.kv_prefix_hits}/"
+                          f"{s.kv_prefix_misses} "
+                          f"deduped={s.kv_pages_deduped} "
+                          f"saved={s.kv_bytes_saved} "
+                          f"written={s.kv_pages_written} "
+                          f"restored={s.kv_pages_restored} "
+                          f"restore_p99_ms="
+                          f"{ksnap.get('kv_restore_p99_ms', 0)} "
+                          f"evicted={s.kv_store_evictions} "
+                          f"slo_boosts={s.kv_slo_boosts} "
+                          f"failures={s.kv_restore_failures}",
+                          file=w, flush=True)
                 # the recovery tier's own accounting: a hung step whose
                 # resilient counters are MOVING is recovering, not
                 # wedged — the distinction this dump exists to make

@@ -205,3 +205,49 @@ def test_verify_policy_modes(monkeypatch):
     with pytest.raises(ChecksumError):
         pol.check(b"payload", 12345, stats)
     assert stats.checksum_failures == 1
+
+
+def test_scrub_verifies_kv_prefix_store(tmp_path, capsys):
+    """The serving prefix store's pages carry write-time CRC32C stamps
+    in a .kvman.json manifest; the offline scrub verifies them, flags a
+    flipped byte as damage, and a directory walk discovers the store by
+    its manifest."""
+    import jax.numpy as jnp
+    from nvme_strom_tpu.models.kv_offload import PrefixStore
+    from nvme_strom_tpu.models.transformer import (TransformerConfig,
+                                                   tiny_config)
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    eng = StromEngine(_cfg(), stats=StromStats())
+    path = str(tmp_path / "serve.kvstore")
+    store = PrefixStore(cfg, eng, path, page_tokens=4,
+                        capacity_bytes=1 << 20)
+    shape = (cfg.n_layers, cfg.n_kv_heads, 4, cfg.head_dim)
+    keys = store.chain_keys(list(range(13)))
+    for i, kx in enumerate(keys):
+        page = np.full(shape, float(i + 1), np.float32)
+        store.put([(kx, page, page)])
+    store.flush()
+    store.close()
+    eng.close_all()
+
+    # clean store: directory walk finds it, zero damage, exit 0
+    rc = strom_scrub.main([str(tmp_path), "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert rep["damage"] == []
+    assert rep["files_scanned"] >= 1
+    assert rep["bytes_verified"] >= 3 * store.page_bytes
+
+    # flip one byte of page 1: exactly that page reports damage
+    with open(path, "r+b") as f:
+        f.seek(store.page_bytes + 7)
+        b = f.read(1)
+        f.seek(store.page_bytes + 7)
+        f.write(bytes([b[0] ^ 0x01]))
+    rc = strom_scrub.main([path, "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert len(rep["damage"]) == 1
+    assert rep["damage"][0]["page"] == 1
+    assert "crc32c" in rep["damage"][0]["error"]

@@ -246,11 +246,14 @@ def test_server_stats_gauges(setup):
     srv.submit("a", [1, 2, 3], 5)      # needs 2 blocks
     srv.submit("b", [4, 5], 5)         # needs 2 blocks
     s0 = srv.stats()
-    assert s0 == {"slots_total": 2, "slots_busy": 0, "queued": 2,
-                  "inflight_tokens": 0, "blocks_total": 6,
-                  "blocks_free": 6, "prefix_cached_blocks": 0,
-                  "prefix_evictable": 0, "prefix_hits": 0,
-                  "prefix_shared_blocks": 0}
+    want0 = {"slots_total": 2, "slots_busy": 0, "queued": 2,
+             "inflight_tokens": 0, "blocks_total": 6,
+             "blocks_free": 6, "prefix_cached_blocks": 0,
+             "prefix_evictable": 0, "prefix_hits": 0,
+             "prefix_shared_blocks": 0, "requests_finished": 0,
+             "ttft_ms_avg": 0.0, "ttft_ms_max": 0.0,
+             "admit_wait_ms_avg": 0.0, "admit_wait_ms_max": 0.0}
+    assert s0 == want0
     srv.step()
     s1 = srv.stats()
     assert s1["slots_busy"] == 2 and s1["queued"] == 0
@@ -258,6 +261,31 @@ def test_server_stats_gauges(setup):
     srv.run()
     s2 = srv.stats()
     assert s2["slots_busy"] == 0 and s2["blocks_free"] == 6
+
+
+def test_server_ttft_and_admission_wait_metrics(setup):
+    """The SLO satellite: every retired request carries TTFT (submit →
+    first token delivered at a readback) and admission wait (submit →
+    slot), per-request in ``request_metrics`` and aggregated in
+    stats().  A request queued behind a full batch must show a LONGER
+    admission wait than one admitted immediately, and TTFT is always
+    >= its admission wait."""
+    cfg, params = setup
+    srv = DecodeServer(params, cfg, max_batch=1, max_len=64)
+    srv.submit("first", [1, 2, 3], 4)
+    srv.submit("queued", [4, 5, 6], 4)    # waits for the slot
+    srv.run()
+    m = srv.request_metrics
+    assert set(m) == {"first", "queued"}
+    for rid in m:
+        assert m[rid]["ttft_ms"] >= m[rid]["admit_wait_ms"] >= 0.0
+    # "queued" sat through "first"'s whole generation before admission
+    assert m["queued"]["admit_wait_ms"] > m["first"]["admit_wait_ms"]
+    st = srv.stats()
+    assert st["requests_finished"] == 2
+    assert st["ttft_ms_max"] >= st["ttft_ms_avg"] > 0.0
+    assert st["admit_wait_ms_max"] == max(v["admit_wait_ms"]
+                                          for v in m.values())
 
 
 def test_sampled_requests_reproducible_and_mixed_with_greedy(setup):

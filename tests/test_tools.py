@@ -139,6 +139,78 @@ def test_strom_stat_renders_member_bytes(capsys):
     assert "nvme1n1" in out and "25.0%" in out
 
 
+def test_strom_stat_renders_kv_serving_block():
+    """The serving prefix-store counters get their own block: hit
+    rate, dedupe savings, restore p99 — and stay invisible on a run
+    with no store traffic."""
+    from nvme_strom_tpu.tools.strom_stat import render
+    out = render({"bytes_direct": 4096, "bounce_bytes": 0,
+                  "kv_prefix_hits": 30, "kv_prefix_misses": 10,
+                  "kv_pages_deduped": 12, "kv_bytes_saved": 3 << 20,
+                  "kv_pages_written": 4, "kv_pages_restored": 30,
+                  "kv_store_pages_resident": 4,
+                  "kv_restore_p99_ms": 12.5})
+    assert "kv serving" in out
+    assert "kv_pages_deduped" in out and "12" in out
+    assert "3.00 MiB" in out                  # kv_bytes_saved humanized
+    assert "0.750" in out                     # prefix hit rate
+    assert "12.50 ms" in out                  # restore p99
+    quiet = render({"bytes_direct": 4096, "bounce_bytes": 0})
+    assert "kv serving" not in quiet
+
+
+def test_strom_stat_json_carries_kv_counters(capsys, tmp_path,
+                                             monkeypatch):
+    """--json round-trips the kv_* counters an exporting engine
+    wrote (the fleet-tooling contract of the satellite)."""
+    import json as _json
+    from nvme_strom_tpu.utils.stats import StromStats
+    export = tmp_path / "stats.json"
+    monkeypatch.setenv("STROM_STATS_EXPORT", str(export))
+    st = StromStats()
+    st.add(kv_prefix_hits=5, kv_pages_deduped=2, kv_bytes_saved=1024)
+    st.set_gauges(kv_restore_p99_ms=7.25)
+    st.maybe_export()
+    rc = strom_stat.main([str(export), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    snap = _json.loads(out)
+    assert snap["kv_prefix_hits"] == 5
+    assert snap["kv_pages_deduped"] == 2
+    assert snap["kv_restore_p99_ms"] == 7.25
+
+
+def test_watchdog_dump_carries_kv_serving_line():
+    """A watchdog timeout dump includes the kv-serving line when the
+    store saw traffic (and omits it otherwise)."""
+    import io as _io
+    import time as _time
+    from nvme_strom_tpu.utils.stats import StromStats
+    from nvme_strom_tpu.utils.watchdog import StepWatchdog
+
+    class Eng:
+        def __init__(self, stats):
+            self.stats = stats
+
+        def sync_stats(self):
+            return {}
+
+    for traffic, expect in ((True, True), (False, False)):
+        st = StromStats()
+        if traffic:
+            st.add(kv_prefix_hits=3, kv_pages_restored=3,
+                   kv_pages_written=2)
+        stream = _io.StringIO()
+        wd = StepWatchdog(deadline_s=0.05, engine=Eng(st),
+                          stream=stream, max_reports=1)
+        with wd.step("kv"):
+            _time.sleep(0.2)
+        wd.close()
+        dump = stream.getvalue()
+        assert "watchdog" in dump
+        assert ("kv serving:" in dump) is expect, dump
+
+
 def test_profile_classify_first_match_wins():
     """A matmul fusion must land in the matmul bucket even though its
     name also says "fusion" — the bucket order IS the precedence."""
