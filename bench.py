@@ -1145,6 +1145,20 @@ def main() -> int:
         # prefetch storm, store off vs on, dedupe/hit counters — the
         # one-prefill-fleet-wide evidence (docs/PERF.md §5)
         "kvserve": kvserve,
+        # failure-domain supervision (io/health.py): normally all
+        # zeros — non-zero means THIS bench run tripped breakers,
+        # hot-restarted rings, requeued extents, or browned out to the
+        # buffered path mid-measurement, and its throughput rows must
+        # be read with that in mind
+        "health": {
+            "breaker_trips": int(stats.breaker_trips),
+            "ring_restarts": int(stats.ring_restarts),
+            "extents_requeued": int(stats.extents_requeued),
+            "degraded_reads": int(stats.degraded_reads),
+            "degraded_bytes": int(stats.degraded_bytes),
+            "degraded_probes": int(stats.degraded_probes),
+            "admissions_shed": int(stats.serve_admissions_shed),
+        },
     }), flush=True)
     _hc.reset()   # back to the env-derived tier for any caller after us
     try:

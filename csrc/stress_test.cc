@@ -124,6 +124,62 @@ void readv_thread(strom_engine *eng, int fh, int iters, int seed) {
   }
 }
 
+/* Restart-tolerant vectored reader: the hot-restart phase's consumer.
+ * A completion cancelled by a ring restart (-ECANCELED) is RESUBMITTED
+ * round-robin (the Python supervision layer's requeue path, here in
+ * miniature) and must then verify — any other error, short read, or
+ * payload mismatch is a hard failure.  Counts requeues so the phase
+ * can assert the restart actually cancelled something. */
+void restart_reader_thread(strom_engine *eng, int fh, int iters, int seed,
+                           std::atomic<uint64_t> *requeued) {
+  Rng rng(seed * 104729 + 11);
+  for (int i = 0; i < iters; i++) {
+    const uint32_t n = 1 + (uint32_t)(rng.next() % 4);
+    strom_rd_ext exts[4];
+    for (uint32_t j = 0; j < n; j++) {
+      uint64_t off = rng.next() % (kFileBytes - 1);
+      uint64_t len = 1 + rng.next() % (kMaxRead / 8);
+      if (off + len > kFileBytes) len = kFileBytes - off;
+      exts[j] = strom_rd_ext{fh, 0, off, len};
+    }
+    int64_t ids[4];
+    uint32_t ring = (uint32_t)(rng.next() % 2); /* rings 0-1; 1 restarts */
+    if (strom_submit_readv_ring(eng, ring, exts, n, ids) != 0) {
+      fail("restart submit_readv_ring");
+      continue;
+    }
+    for (uint32_t j = 0; j < n; j++) {
+      int64_t id = ids[j];
+      for (int attempt = 0; attempt < 64; attempt++) {
+        strom_completion c;
+        int rc = strom_wait(eng, id, &c);
+        if (rc == -ECANCELED) {
+          /* requeue: release the cancelled request, resubmit the same
+           * range (round-robin — lands on whichever ring is healthy) */
+          strom_release(eng, id);
+          requeued->fetch_add(1);
+          id = strom_submit_read(eng, fh, exts[j].offset, exts[j].length);
+          if (id < 0) { fail("requeue resubmit"); break; }
+          continue;
+        }
+        if (rc != 0 || c.status != 0) {
+          fail("restart-phase read status");
+          strom_release(eng, id);
+          break;
+        }
+        if (c.len != exts[j].length) fail("restart-phase short read");
+        for (uint64_t k = 0; k < c.len; k += 997)
+          if (c.data[k] != pat(exts[j].offset + k)) {
+            fail("restart-phase payload mismatch");
+            break;
+          }
+        strom_release(eng, id);
+        break;
+      }
+    }
+  }
+}
+
 void writer_thread(strom_engine *eng, const std::string &dir, int iters) {
   std::string path = dir + "/stress_w.bin";
   int fh = strom_open(eng, path.c_str(), STROM_OPEN_WRITABLE);
@@ -442,6 +498,68 @@ int main(int argc, char **argv) {
             (unsigned long long)st.requests_failed,
             (unsigned long long)g_errors.load());
     if (st.requests_failed != 0) fail("requests_failed != 0");
+    strom_close(eng, fh);
+    strom_engine_destroy(eng);
+  }
+  /* Hot-restart phase: 2 rings; readers pin batches to both rings while
+   * the main thread repeatedly wedges ring 1 (stall injection parks its
+   * dispatches), hot-restarts it (parked requests cancel -ECANCELED and
+   * the readers requeue them), and lets traffic resume on the rebuilt
+   * ring.  TSAN must bless the restart's drain/rebuild racing live
+   * submitters, waiters, and the stat observer; functionally every read
+   * must end verified — cancellation is a requeue, never a loss. */
+  for (int use_uring = 1; use_uring >= 0; use_uring--) {
+    strom_engine *eng = strom_engine_create_rings(
+        2, 4, 8, kMaxRead + 8192, 4096, use_uring, 1);
+    if (!eng) { perror("engine_create_rings(restart)"); return 2; }
+    if (strom_ring_restart(eng, 9, 1000000ull) != -EINVAL)
+      fail("bad restart ring index not rejected");
+    if (strom_set_ring_stall(eng, 9, 1) != -EINVAL)
+      fail("bad stall ring index not rejected");
+    int fh = strom_open(eng, path.c_str(), 0);
+    if (fh < 0) { fprintf(stderr, "open failed\n"); return 2; }
+
+    std::atomic<bool> stop{false};
+    std::atomic<uint64_t> requeued{0};
+    std::vector<std::thread> ts;
+    for (int r = 0; r < 3; r++)
+      ts.emplace_back(restart_reader_thread, eng, fh, iters, 300 + r,
+                      &requeued);
+    ts.emplace_back(churn_thread, eng, path, iters / 2 + 1);
+    std::thread obs(observer_thread, eng, &stop);
+    std::thread killer([&] {
+      int restarts = 0;
+      while (!stop.load(std::memory_order_acquire)) {
+        strom_set_ring_stall(eng, 1, 1);
+        usleep(3000);               /* let dispatches park */
+        int64_t rc = strom_ring_restart(eng, 1, 500000000ull);
+        if (rc < 0 && rc != -EBUSY) fail("ring_restart");
+        restarts++;
+        usleep(2000);               /* healthy window: traffic drains */
+      }
+      if (restarts < 1) fail("killer never restarted");
+    });
+    for (auto &t : ts) t.join();
+    stop.store(true, std::memory_order_release);
+    killer.join();
+    obs.join();
+
+    strom_ring_info ri;
+    if (strom_get_ring_info(eng, 1, &ri) != 0) fail("ring_info(1)");
+    if (ri.restarts < 1) fail("restart counter never moved");
+    if (ri.parked != 0) fail("parked requests survived the phase");
+    fprintf(stderr,
+            "stress[restart,%s]: restarts=%llu requeued=%llu "
+            "failed_comps=%llu errors=%llu\n",
+            use_uring ? "io_uring" : "threadpool",
+            (unsigned long long)ri.restarts,
+            (unsigned long long)requeued.load(),
+            (unsigned long long)ri.failed,
+            (unsigned long long)g_errors.load());
+    strom_stats_blk st;
+    strom_get_stats(eng, &st);
+    if (st.requests_failed != 0) fail("restart phase requests_failed != 0");
+    if (ri.failed != 0) fail("cancels counted as ring failures");
     strom_close(eng, fh);
     strom_engine_destroy(eng);
   }

@@ -334,6 +334,14 @@ struct Req {
   bool is_write = false;
   bool direct = false;                 /* submitted O_DIRECT          */
   bool was_fallback = false;
+  bool dispatched = false;             /* handed to a backend (uring
+                                          SQE staged / worker queued) —
+                                          a restart's drain waits ONLY
+                                          for these; deferred and
+                                          parked requests hold no
+                                          kernel-visible I/O          */
+  bool parked = false;                 /* on the ring's stall/restart
+                                          park queue                  */
   bool planned_resident = false;       /* submit-time mincore probe chose
                                           the page-cache path on purpose */
   ReqState state = ReqState::kInflight;
@@ -369,6 +377,21 @@ struct RingCtx {
    * (submitted - completed) at dispatch frequency without ever taking
    * the ring mutex. */
   std::atomic<uint64_t> rg_sub{0}, rg_comp{0};
+  /* Failure-domain health (strom_ring_info / io/health.py): completions
+   * with a real error (cancels excluded) and hot restarts survived. */
+  std::atomic<uint64_t> rg_fail{0}, rg_restarts{0};
+
+  /* Stall injection + restart window (all under mu): while `stalled`
+   * (chaos) or `restarting`, requests reaching dispatch park here in
+   * order instead of going to a backend — the deterministic stand-in
+   * for a wedged submission queue.  strom_ring_restart cancels the
+   * backlog (-ECANCELED, the requeue path); strom_set_ring_stall(.., 0)
+   * dispatches it (a stall that healed itself). */
+  std::deque<Req *> park_q;
+  bool stalled = false;
+  bool restarting = false;
+  uint64_t stall_after = 0;   /* clean dispatches before the stall bites */
+  uint64_t stall_seen = 0;
 
   void complete_locked(Req *r);
   void complete(Req *r) {
@@ -407,6 +430,12 @@ struct strom_engine {
   std::vector<std::unique_ptr<RingCtx>> rings;
   std::atomic<uint64_t> rr{0};          /* round-robin ring pick  */
   std::atomic<int64_t> next_req{1};
+  std::mutex restart_mu;                /* serializes hot ring restarts
+                                           against each other and
+                                           against engine destroy (held
+                                           across the whole restart —
+                                           outermost, never taken under
+                                           a ring mutex) */
 
   std::mutex files_mu;                  /* leaf lock: may be taken while
                                            a ring mutex is held, never
@@ -616,8 +645,14 @@ struct strom_engine {
 };
 
 void RingCtx::complete_locked(Req *r) {
+  if (r->state == ReqState::kDone) return;  /* idempotent: a restart's
+                                               cancel must not race a
+                                               backend completion into
+                                               double accounting */
   r->state = ReqState::kDone;
   r->t_complete = now_ns();
+  if (r->status < 0 && r->status != -ECANCELED)
+    rg_fail.fetch_add(1, std::memory_order_relaxed);
   if (r->status == 0) {
     /* Failures are counted in st_fail; bucketing their near-instant
      * "latency" would drag the p50/p99 gauges toward zero exactly when
@@ -643,6 +678,24 @@ void RingCtx::complete_locked(Req *r) {
  * ``flush_now = false`` defers the uring doorbell (vectored submit:
  * the caller flushes once for the whole batch). */
 void RingCtx::dispatch_locked(Req *r, bool flush_now) {
+  /* Failure-domain hooks: a restart window or an armed stall parks the
+   * request (in order) instead of dispatching — it stays kInflight
+   * with no backend I/O, exactly what a wedged submission queue looks
+   * like to its waiter. */
+  if (restarting) {
+    r->parked = true;
+    park_q.push_back(r);
+    return;
+  }
+  if (stalled) {
+    if (stall_seen >= stall_after) {
+      r->parked = true;
+      park_q.push_back(r);
+      return;
+    }
+    stall_seen++;
+  }
+  r->dispatched = true;
   FileEnt fe;
   if (!eng->file_copy(r->fh, &fe)) {
     r->status = -EBADF;
@@ -860,11 +913,24 @@ strom_engine *strom_engine_create_rings(uint32_t n_rings,
   }
   for (int i = (int)(n_buffers * n_rings) - 1; i >= 0; i--)
     e->free_bufs.push_back(i);
+  /* Ring-stall injection (chaos; default off): the named ring parks
+   * its dispatches after the first N — the deterministic wedged-ring
+   * drive for the supervision layer (io/health.py). */
+  const char *stall_ring_env = getenv("STROM_FAULT_RING_STALL_RING");
+  int64_t stall_ring = stall_ring_env ? strtoll(stall_ring_env, nullptr, 10)
+                                      : -1;
+  uint64_t stall_after = 0;
+  if (const char *v = getenv("STROM_FAULT_RING_STALL_AFTER"))
+    stall_after = strtoull(v, nullptr, 10);
   for (uint32_t ri = 0; ri < n_rings; ri++) {
     auto rcp = std::unique_ptr<RingCtx>(new RingCtx());
     RingCtx *rc = rcp.get();
     rc->eng = e;
     rc->idx = ri;
+    if (stall_ring >= 0 && (uint32_t)stall_ring == ri) {
+      rc->stalled = true;
+      rc->stall_after = stall_after;
+    }
     if (use_io_uring && rc->ring.init(queue_depth * 2)) {
       rc->use_uring = true;
       /* Each ring registers the WHOLE pool with its uring fd: buffers
@@ -891,6 +957,27 @@ strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
 void strom_engine_destroy(strom_engine *e) {
   if (!e) return;
   e->stopping.store(true, std::memory_order_release);
+  /* Flush any in-flight restart before tearing rings down (bounded
+   * wait: a restart's drain is bounded by its timeout).  Acquire-and-
+   * release: `stopping` is already visible, and strom_ring_restart
+   * re-checks it under this mutex, so no NEW restart can start — and
+   * the guard must not live across the `delete e` below. */
+  { std::lock_guard<std::mutex> restart_guard(e->restart_mu); }
+  for (auto &rcp : e->rings) {
+    /* Parked (stalled / restart-window) requests never reached a
+     * backend: cancel them so the per-ring drain below cannot wedge
+     * waiting for completions that will never arrive. */
+    RingCtx *rc = rcp.get();
+    std::lock_guard<std::mutex> g(rc->mu);
+    while (!rc->park_q.empty()) {
+      Req *r = rc->park_q.front();
+      rc->park_q.pop_front();
+      r->parked = false;
+      r->status = -ECANCELED;
+      r->done_len = 0;
+      rc->complete_locked(r);
+    }
+  }
   {
     /* Cancel the global deferral FIFO first: a deferred request's ring
      * drain below would otherwise wait forever for a buffer that no
@@ -968,6 +1055,26 @@ int strom_get_ring_info(strom_engine *e, uint32_t ring,
   out->completed = comp;
   out->inflight_io = (uint32_t)(sub > comp ? sub - comp : 0);
   out->backend_uring = rc->use_uring ? 1 : 0;
+  out->failed = rc->rg_fail.load(std::memory_order_relaxed);
+  out->restarts = rc->rg_restarts.load(std::memory_order_relaxed);
+  {
+    /* Health walk under the ring mutex (request maps are queue-depth
+     * sized — this is a stat poll, not the dispatch hot path): parked
+     * backlog plus the age of the oldest request a backend owes a
+     * completion for.  Deferred requests are excluded from the age —
+     * pool pressure is not a ring stall. */
+    std::lock_guard<std::mutex> g(rc->mu);
+    out->parked = (uint32_t)rc->park_q.size();
+    out->stalled = rc->stalled ? 1 : 0;
+    uint64_t oldest = 0;
+    for (auto &kv : rc->reqs) {
+      Req *r = kv.second;
+      if (r->state == ReqState::kDone || !(r->dispatched || r->parked))
+        continue;
+      if (oldest == 0 || r->t_submit < oldest) oldest = r->t_submit;
+    }
+    out->oldest_inflight_ns = oldest ? now_ns() - oldest : 0;
+  }
   {
     std::lock_guard<std::mutex> g(e->pool_mu);
     out->free_buffers = (uint32_t)e->free_bufs.size();
@@ -977,6 +1084,170 @@ int strom_get_ring_info(strom_engine *e, uint32_t ring,
     out->deferred = d;
   }
   return 0;
+}
+
+int strom_set_ring_stall(strom_engine *e, uint32_t ring, int on) {
+  if (ring >= e->n_rings) return -EINVAL;
+  RingCtx *rc = e->rings[ring].get();
+  std::lock_guard<std::mutex> g(rc->mu);
+  rc->stalled = on != 0;
+  rc->stall_after = 0;
+  rc->stall_seen = 0;
+  if (!rc->stalled && !rc->restarting) {
+    /* Disarm = the wedge healed on its own: dispatch the parked
+     * backlog in order (waiters just saw one longer wait). */
+    while (!rc->park_q.empty()) {
+      Req *r = rc->park_q.front();
+      rc->park_q.pop_front();
+      r->parked = false;
+      rc->dispatch_locked(r);
+    }
+  }
+  return 0;
+}
+
+int64_t strom_ring_restart(strom_engine *e, uint32_t ring,
+                           uint64_t drain_timeout_ns) {
+  if (ring >= e->n_rings) return -EINVAL;
+  if (e->stopping.load(std::memory_order_acquire)) return -ECANCELED;
+  /* One restart at a time engine-wide, and never concurrent with
+   * destroy (restart_mu is outermost; the drain below is bounded, so
+   * a destroy blocked on it waits at most drain_timeout_ns). */
+  std::unique_lock<std::mutex> restart_guard(e->restart_mu,
+                                             std::try_to_lock);
+  if (!restart_guard.owns_lock()) return -EBUSY;
+  if (e->stopping.load(std::memory_order_acquire)) return -ECANCELED;
+  RingCtx *rc = e->rings[ring].get();
+  int64_t cancelled = 0;
+  bool drained;
+  {
+    std::unique_lock<std::mutex> lk(rc->mu);
+    rc->restarting = true;  /* new dispatches park until the rebuild */
+    /* requests parked BEFORE this restart are the wedged backlog the
+     * restart exists to requeue; anything parking during the window
+     * (appended behind them) is fresh traffic that must DISPATCH
+     * after the rebuild, never cancel */
+    size_t pre_parked = rc->park_q.size();
+    /* 1) bounded drain of I/O a backend actually owns (the predicate
+     * ignores parked requests — no backend ever saw those).  An
+     * un-completable request cannot be cancelled from here (its
+     * staging buffer is a live DMA target): on timeout the restart
+     * ABORTS with the ring truly as it was — parked requests stay
+     * parked, nothing was cancelled — and the caller falls back to
+     * degraded buffered reads. */
+    auto quiesced = [&] {
+      for (auto &kv : rc->reqs) {
+        Req *r = kv.second;
+        if (r->state != ReqState::kDone && r->dispatched) return false;
+      }
+      return true;
+    };
+    drained = rc->cv_done.wait_for(
+        lk, std::chrono::nanoseconds(drain_timeout_ns), quiesced);
+    if (!drained) {
+      rc->restarting = false;
+      /* requests parked during the window resume on the (still-sick)
+       * backend — status quo ante; the supervisor keeps the breaker
+       * open and routes around the ring.  Drain via a LOCAL queue:
+       * with stall injection still armed, dispatch_locked re-parks
+       * each request into rc->park_q — draining that same queue
+       * in place would spin forever under both mutexes. */
+      std::deque<Req *> resume;
+      resume.swap(rc->park_q);
+      while (!resume.empty()) {
+        Req *r = resume.front();
+        resume.pop_front();
+        r->parked = false;
+        rc->dispatch_locked(r);
+      }
+      return -ETIMEDOUT;
+    }
+    /* 2) the restart is now committed: cancel the stall-parked
+     * backlog.  No backend ever saw these, so their buffers are clean
+     * — the waiter's retry (ResilientRead) resubmits them, and the
+     * engine's healthy-ring routing lands the resubmission elsewhere:
+     * the requeue path.  Cancelling only AFTER the drain succeeded
+     * keeps the return value exact (a timed-out restart requeued
+     * nothing) and the abort contract honest. */
+    while (pre_parked-- > 0 && !rc->park_q.empty()) {
+      Req *r = rc->park_q.front();
+      rc->park_q.pop_front();
+      r->parked = false;
+      r->status = -ECANCELED;
+      r->done_len = 0;
+      rc->complete_locked(r);
+      cancelled++;
+    }
+  }
+  /* 3) rebuild the uring outside the ring mutex (the nop handshake
+   * below needs the reaper to keep consuming).  The quiesced ring has
+   * nothing in flight, so the teardown/re-init races nobody. */
+  if (rc->use_uring) {
+    {
+      std::lock_guard<std::mutex> g(rc->mu);
+      rc->ring.submit(kOpNop, -1, 0, nullptr, 0, kShutdownUserData);
+    }
+    if (rc->reaper.joinable()) rc->reaper.join();
+    /* In-place rebuild under the ring mutex (strom_get_pool_info reads
+     * ring.fixed_bufs under it): the quiesced ring has no in-flight
+     * I/O and the reaper is joined, so nobody else touches the Uring. */
+    std::lock_guard<std::mutex> g(rc->mu);
+    rc->ring.teardown();
+    rc->ring.unsubmitted.store(0, std::memory_order_relaxed);
+    rc->ring.fixed_bufs = false;
+    if (rc->ring.init(e->queue_depth * 2)) {
+      rc->ring.try_register(e->pool, e->buf_cap,
+                            e->n_buffers * e->n_rings);
+      rc->reaper = std::thread([rc] { rc->reaper_loop(); });
+    } else {
+      /* Rebuild refused (fd limits, kernel state): fall back to the
+       * worker-pool backend so the ring keeps serving. */
+      rc->use_uring = false;
+      uint32_t nw = e->queue_depth < 32 ? e->queue_depth : 32;
+      for (uint32_t i = 0; i < nw; i++)
+        rc->workers.emplace_back([rc] { rc->worker_loop(); });
+    }
+  }
+  {
+    /* 4) reopen: disarm stall injection (the restart heals the wedge —
+     * that is its contract) and dispatch requests parked during the
+     * window, in order. */
+    std::lock_guard<std::mutex> g(rc->mu);
+    rc->stalled = false;
+    rc->stall_seen = 0;
+    rc->restarting = false;
+    while (!rc->park_q.empty()) {
+      Req *r = rc->park_q.front();
+      rc->park_q.pop_front();
+      r->parked = false;
+      rc->dispatch_locked(r);
+    }
+    rc->rg_restarts.fetch_add(1, std::memory_order_relaxed);
+  }
+  return cancelled;
+}
+
+int64_t strom_read_buffered(strom_engine *e, int fh, uint64_t offset,
+                            uint64_t len, void *dst) {
+  FileEnt fe;
+  if (!e->file_copy(fh, &fe)) return -EBADF;
+  uint64_t got = 0;
+  while (got < len) {
+    ssize_t n = pread(fe.fd_buffered, (uint8_t *)dst + got, len - got,
+                      (off_t)(offset + got));
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (n == 0) break; /* EOF */
+    got += (uint64_t)n;
+  }
+  /* Honest accounting: this payload rode the page cache and was host-
+   * copied into the caller's buffer — fallback + bounce, exactly like
+   * the engine's own buffered rescue path. */
+  e->st_fallback.fetch_add(got, std::memory_order_relaxed);
+  e->st_bounce.fetch_add(got, std::memory_order_relaxed);
+  return (int64_t)got;
 }
 
 int strom_check_file(const char *path, strom_file_info *out) {
@@ -1547,8 +1818,9 @@ int strom_release(strom_engine *e, int64_t req_id) {
   return 0;
 }
 
-int64_t strom_submit_write(strom_engine *e, int fh, uint64_t offset,
-                           const void *src, uint64_t len) {
+static int64_t submit_write_on(strom_engine *e, RingCtx *rcx, int fh,
+                               uint64_t offset, const void *src,
+                               uint64_t len) {
   if (e->stopping.load(std::memory_order_acquire)) return -ECANCELED;
   bool conformant;
   {
@@ -1561,7 +1833,6 @@ int64_t strom_submit_write(strom_engine *e, int fh, uint64_t offset,
                  (len % e->alignment == 0) && it->second.fd_direct >= 0;
   }
   if (!conformant && len > e->buf_bytes) return -EINVAL;
-  RingCtx *rcx = e->pick_ring();
   Req *r = new Req();
   r->is_write = true;
   r->fh = fh;
@@ -1596,6 +1867,18 @@ int64_t strom_submit_write(strom_engine *e, int fh, uint64_t offset,
     rcx->complete_locked(r);
   }
   return r->id;
+}
+
+int64_t strom_submit_write(strom_engine *e, int fh, uint64_t offset,
+                           const void *src, uint64_t len) {
+  return submit_write_on(e, e->pick_ring(), fh, offset, src, len);
+}
+
+int64_t strom_submit_write_ring(strom_engine *e, uint32_t ring, int fh,
+                                uint64_t offset, const void *src,
+                                uint64_t len) {
+  if (ring >= e->n_rings) return -EINVAL;
+  return submit_write_on(e, e->rings[ring].get(), fh, offset, src, len);
 }
 
 void strom_get_stats(strom_engine *e, strom_stats_blk *out) {

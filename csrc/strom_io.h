@@ -102,6 +102,11 @@ void strom_get_latency(strom_engine *eng,
  *   STROM_FAULT_WRITE_ENOSPC_EVERY=N  every Nth write completes -ENOSPC
  *   STROM_FAULT_WRITE_SHORT_EVERY=N every Nth write reports half its bytes
  *   STROM_FAULT_WRITE_DELAY_MS=D    every write completion held D ms
+ *   STROM_FAULT_RING_STALL_RING=R   arm ring R's stall injection (see
+ *                                   strom_set_ring_stall): its requests
+ *                                   park instead of dispatching
+ *   STROM_FAULT_RING_STALL_AFTER=N  first N dispatches run clean before
+ *                                   the stall engages (default 0)
  * The Python-level plan (nvme_strom_tpu/io/faults.py) is richer and
  * deterministic; these knobs exist to exercise the native completion
  * path itself. */
@@ -147,11 +152,74 @@ typedef struct strom_ring_info {
   uint64_t completed;      /* requests completed (I/O done, incl. fail) */
   uint32_t inflight_io;    /* submitted - completed: queue depth        */
   int32_t  backend_uring;  /* 1 if this ring runs on io_uring           */
+  /* Failure-domain health (io/health.py supervision layer): */
+  uint64_t failed;         /* completions with status < 0, cancels
+                              excluded (a hot restart's -ECANCELED
+                              requeue must not read as device damage)  */
+  uint64_t restarts;       /* hot restarts this ring has survived       */
+  uint32_t parked;         /* requests parked by stall injection or a
+                              restart window (in flight, never
+                              dispatched to a backend)                  */
+  int32_t  stalled;        /* 1 while stall injection is armed          */
+  uint64_t oldest_inflight_ns; /* age of the oldest dispatched-or-parked
+                              un-completed request; 0 when idle.  The
+                              reap-side stall detector: a completion
+                              that never arrives shows up here as an
+                              age that only grows.                      */
 } strom_ring_info;
 
 int strom_ring_count(strom_engine *eng);
 int strom_get_ring_info(strom_engine *eng, uint32_t ring,
                         strom_ring_info *out);
+
+/* Hot ring restart — the failure-domain recovery primitive (the
+ * supervision layer in io/health.py drives it; docs/RESILIENCE.md
+ * "failure domains").  Sequence:
+ *   1. the ring stops dispatching (new submissions park, in order);
+ *   2. dispatched in-flight I/O is drained for up to drain_timeout_ns.
+ *      If it will not drain the restart ABORTS with -ETIMEDOUT and the
+ *      ring resumes exactly as it was (nothing cancelled): an
+ *      un-completable kernel I/O cannot be cancelled from userspace
+ *      without recycling a live DMA target, so the caller's fallback
+ *      is the degraded buffered path, not a forced cancel;
+ *   3. the pre-restart stall-parked backlog is completed -ECANCELED —
+ *      those requests never reached a backend, so their staging
+ *      buffers are clean and the waiter's resubmission (ResilientRead's
+ *      retry) is the requeue path;
+ *   4. on the io_uring backend the uring is torn down and rebuilt
+ *      (fresh fd, fresh SQ/CQ mappings, fresh reaper thread); if the
+ *      rebuild fails the ring falls back to the worker-pool backend so
+ *      it keeps serving;
+ *   5. stall injection is disarmed (the injected wedge heals — that is
+ *      the point of the restart) and requests parked during the window
+ *      dispatch in order: consumers see one longer wait, never an
+ *      error.
+ * Returns the number of requests cancelled for requeue (>= 0), or
+ * -EINVAL / -EBUSY (restart already running) / -ETIMEDOUT /
+ * -ECANCELED (engine stopping). */
+int64_t strom_ring_restart(strom_engine *eng, uint32_t ring,
+                           uint64_t drain_timeout_ns);
+
+/* Ring-stall fault injection (chaos/stress; see also the env knobs
+ * STROM_FAULT_RING_STALL_RING / STROM_FAULT_RING_STALL_AFTER read at
+ * engine create): while armed, requests reaching the ring's dispatch
+ * point are parked instead of dispatched — a wedged submission queue /
+ * hung kernel worker as the waiters see it (completions never arrive,
+ * lock-free counters freeze, oldest_inflight_ns grows).  Disarming
+ * with on=0 dispatches the parked backlog (a transient stall that
+ * healed itself); strom_ring_restart cancels it instead (the requeue
+ * path).  Returns 0 or -EINVAL. */
+int strom_set_ring_stall(strom_engine *eng, uint32_t ring, int on);
+
+/* Degraded-mode read: a plain synchronous pread on the buffered fd
+ * from the CALLING thread — no ring, no uring, no worker pool, no
+ * staging buffer.  This is the brown-out path io/health.py falls back
+ * to when every ring (or the device behind them) is unhealthy: reduced
+ * bandwidth, but alive while the fast path is hot-restarted/probed.
+ * Counted as fallback + bounce payload (the page-cache copy is real).
+ * Returns bytes read (may be short at EOF) or -errno. */
+int64_t strom_read_buffered(strom_engine *eng, int fh, uint64_t offset,
+                            uint64_t len, void *dst);
 
 /* Depth-only fast path: submitted - completed from the lock-free
  * per-ring atomics, NO mutex and NO deferral-queue walk — what the QoS
@@ -319,6 +387,14 @@ int strom_release(strom_engine *eng, int64_t req_id);
  * release with strom_release. */
 int64_t strom_submit_write(strom_engine *eng, int fh, uint64_t offset,
                            const void *src, uint64_t len);
+
+/* Ring-pinned write (strom_submit_read_ring's mirror): the caller
+ * names the ring instead of the engine's round-robin pick — how the
+ * supervision layer keeps checkpoint/KV writes off a ring whose
+ * breaker is open.  -EINVAL for a ring index out of range. */
+int64_t strom_submit_write_ring(strom_engine *eng, uint32_t ring, int fh,
+                                uint64_t offset, const void *src,
+                                uint64_t len);
 
 void strom_get_stats(strom_engine *eng, strom_stats_blk *out);
 void strom_reset_stats(strom_engine *eng);

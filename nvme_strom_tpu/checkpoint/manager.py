@@ -62,6 +62,60 @@ def _gc_min_age() -> float:
         return 3600.0
 
 
+_KVMAN_SUFFIX = ".kvman.json"
+
+
+def find_orphan_manifests(root: str, recursive: bool = True) -> list:
+    """Serving KV prefix-store manifests (models/kv_offload.py) whose
+    page file is gone — a deleted or crash-torn store's debris.
+    ``recursive=False`` scans only ``root`` itself (the manager's
+    startup scope: cheap on huge checkpoint trees; ``strom-scrub``
+    applies the same missing-page-file verdict inline during its own
+    full walk, and both sweepers remove via
+    :func:`sweep_orphan_manifests` so the age-gate semantics can never
+    diverge)."""
+    out = []
+    if recursive:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if not _TMP_RE.match(d)]
+            for name in filenames:
+                p = os.path.join(dirpath, name)
+                if (name.endswith(_KVMAN_SUFFIX)
+                        and not os.path.exists(p[:-len(_KVMAN_SUFFIX)])):
+                    out.append(p)
+    else:
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return []
+        for name in names:
+            p = os.path.join(root, name)
+            if (name.endswith(_KVMAN_SUFFIX)
+                    and not os.path.exists(p[:-len(_KVMAN_SUFFIX)])):
+                out.append(p)
+    return sorted(out)
+
+
+def sweep_orphan_manifests(paths, min_age: float) -> list:
+    """Unlink orphaned manifests older than ``min_age`` (the same
+    live-save gate as the staging-dir GC: a store racing a
+    delete/recreate cycle is never swept out from under its process);
+    returns the paths actually removed.  Races (concurrent removal,
+    permissions) skip the entry — debris is harmless, a false removal
+    is not."""
+    removed = []
+    now = time.time()
+    for p in paths:
+        try:
+            if now - os.path.getmtime(p) < min_age:
+                continue
+            os.unlink(p)
+        except OSError:
+            continue
+        removed.append(p)
+    return removed
+
+
 def _newest_mtime(path: str) -> float:
     """Newest mtime across a staging dir and its immediate entries.
     The dir mtime alone moves only on entry creation/rename — a save
@@ -188,8 +242,11 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         #: dotted temp dirs from crashed saves removed at startup
         self.tmp_gc: list[str] = []
+        #: orphaned .kvman.json manifests (page file gone) removed
+        self.manifest_gc: list[str] = []
         if os.environ.get("STROM_CKPT_GC", "1") != "0":
             self._gc_tmp_dirs()
+            self._gc_orphan_manifests()
 
     def _gc_tmp_dirs(self) -> None:
         """Startup GC: remove orphaned ``.tmp_step_*`` staging dirs left
@@ -238,6 +295,25 @@ class CheckpointManager:
             _log.warning(
                 "removed orphaned checkpoint staging dir %s "
                 "(crashed save; the previous intact step is unaffected)",
+                path)
+
+    def _gc_orphan_manifests(self) -> None:
+        """Startup GC, KV-store half: a serving PrefixStore
+        (models/kv_offload.py) colocated with the checkpoint dir leaves
+        a ``.kvman.json`` manifest beside its page file; deleting or
+        crash-tearing the page file strands the manifest — harmless but
+        accumulating, and it makes ``strom-scrub`` report a vanished
+        store forever.  Top-level scope only, like ``_gc_tmp_dirs``
+        (stores live beside the step dirs, and a full-tree walk at
+        every manager construction is a stat storm on big trees —
+        ``strom-scrub --gc`` covers nested debris)."""
+        orphans = find_orphan_manifests(self.directory, recursive=False)
+        self.manifest_gc = sweep_orphan_manifests(orphans,
+                                                  _gc_min_age())
+        for path in self.manifest_gc:
+            _log.warning(
+                "removed orphaned kv-store manifest %s (its page "
+                "file is gone; the store rebuilds on first use)",
                 path)
 
     # -- introspection -----------------------------------------------------

@@ -122,6 +122,15 @@ class _RingInfo(ctypes.Structure):
         ("completed", ctypes.c_uint64),
         ("inflight_io", ctypes.c_uint32),
         ("backend_uring", ctypes.c_int32),
+        # failure-domain health (io/health.py): real-error completions
+        # (cancels excluded), hot restarts survived, parked backlog,
+        # stall-injection state, and the age of the oldest completion
+        # a backend still owes — the reap-side stall signal
+        ("failed", ctypes.c_uint64),
+        ("restarts", ctypes.c_uint64),
+        ("parked", ctypes.c_uint32),
+        ("stalled", ctypes.c_int32),
+        ("oldest_inflight_ns", ctypes.c_uint64),
     ]
 
 
@@ -190,6 +199,17 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_ring_inflight.restype = ctypes.c_int64
         lib.strom_ring_inflight.argtypes = [ctypes.c_void_p,
                                             ctypes.c_uint32]
+        lib.strom_ring_restart.restype = ctypes.c_int64
+        lib.strom_ring_restart.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_uint32,
+                                           ctypes.c_uint64]
+        lib.strom_set_ring_stall.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_uint32,
+                                             ctypes.c_int]
+        lib.strom_read_buffered.restype = ctypes.c_int64
+        lib.strom_read_buffered.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_void_p]
         lib.strom_submit_read_ring.restype = ctypes.c_int64
         lib.strom_submit_read_ring.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
@@ -233,6 +253,10 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_submit_write.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                            ctypes.c_uint64, ctypes.c_void_p,
                                            ctypes.c_uint64]
+        lib.strom_submit_write_ring.restype = ctypes.c_int64
+        lib.strom_submit_write_ring.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64]
         lib.strom_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                    ctypes.POINTER(_Completion)]
         lib.strom_wait_timeout.argtypes = [ctypes.c_void_p,
@@ -445,6 +469,14 @@ class PendingRead:
         shorter view as a short read and recover or raise)."""
         return self._length
 
+    @property
+    def ring(self) -> int:
+        """The submission ring this request rode (request ids carry
+        their ring in the low STROM_RING_ID_BITS bits) — how the
+        supervision layer (io/health.py) attributes a failed attempt
+        to its failure domain."""
+        return int(self._req_id) & (_MAX_RINGS - 1)
+
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block for the completed staging view.
 
@@ -475,7 +507,14 @@ class PendingRead:
                                   timeout, "read")
         if rc < 0:
             self.release()
-            raise OSError(-rc, os.strerror(-rc))
+            e = OSError(-rc, os.strerror(-rc))
+            # the C engine already counted this completion in its
+            # per-ring failed counter: the supervision layer must not
+            # count it a second time via note_error (io/health.py —
+            # the breaker budgets would silently halve for exactly the
+            # real device errors they are calibrated against)
+            e.engine_counted = True
+            raise e
         self.was_fallback = bool(comp.was_fallback)
         tracer = self._engine.tracer
         if tracer is not None and tracer.enabled:
@@ -592,6 +631,12 @@ class PendingWrite:
         self.length = keepalive.nbytes if keepalive is not None else 0
         self._released = False
 
+    @property
+    def ring(self) -> int:
+        """Submission ring (failure-domain attribution, PendingRead
+        parity)."""
+        return int(self._req_id) & (_MAX_RINGS - 1)
+
     def release(self) -> None:
         """Abort/free path (e.g. after a wait timeout): blocks until
         the write is out of flight, then frees the request — the
@@ -626,7 +671,10 @@ class PendingWrite:
         self._engine._hostcache_write_done(self.fh, self.offset,
                                            self.length)
         if rc < 0:
-            raise OSError(-rc, os.strerror(-rc))
+            e = OSError(-rc, os.strerror(-rc))
+            e.engine_counted = True   # see PendingRead.wait: the C
+            #                           ring counter has this failure
+            raise e
         tracer = self._engine.tracer
         if tracer is not None and tracer.enabled:
             tracer.add_span("strom.write", int(comp.submit_ns),
@@ -687,6 +735,16 @@ class StromEngine:
         # modified between opens gets a new key, so stale lines never hit
         self._file_keys: dict = {}
         self._closed = False
+        # failure-domain supervision (io/health.py): per-ring breakers,
+        # hot restart, degraded buffered fallback.  STROM_BREAKER=0
+        # removes the layer entirely (None = the exact pre-supervision
+        # engine; every hook below is a cheap None check).
+        self.supervisor = None
+        from nvme_strom_tpu.utils.config import BreakerConfig
+        bcfg = BreakerConfig()
+        if bcfg.enabled:
+            from nvme_strom_tpu.io.health import EngineSupervisor
+            self.supervisor = EngineSupervisor(self, bcfg)
         self.scheduler = None
         if n_rings > 1:
             from nvme_strom_tpu.utils.config import SchedConfig
@@ -836,7 +894,58 @@ class StromEngine:
 
     def _ring_free_slots(self) -> list:
         cap = getattr(self, "_ring_cap", self._qd_ring)
-        return [max(0, cap - d) for d in self.ring_depths()]
+        free = [max(0, cap - d) for d in self.ring_depths()]
+        if self.supervisor is not None:
+            # the scheduler's admission poll doubles as the supervision
+            # heartbeat (time-gated inside), and tripped rings report
+            # zero headroom so new batches route around them
+            self.supervisor.tick()
+            free = self.supervisor.mask_free_slots(free)
+        return free
+
+    def ring_restart(self, ring: int, drain_timeout_s: float = 0.5) -> int:
+        """Hot-restart one ring (``strom_ring_restart``): cancel its
+        stall-parked backlog (-ECANCELED — the waiters' retry loop is
+        the requeue path), drain dispatched I/O bounded, rebuild the
+        uring, resume.  Returns the number of requests cancelled for
+        requeue; raises TimeoutError when in-flight I/O would not
+        drain (the ring resumes untouched — fall back to degraded
+        reads), OSError otherwise."""
+        ns = max(1, int(drain_timeout_s * 1e9))
+        rc = self._lib.strom_ring_restart(self._h, ring, ns)
+        if rc == -errno.ETIMEDOUT:
+            raise TimeoutError(
+                f"ring {ring}: in-flight I/O did not drain within "
+                f"{drain_timeout_s}s; restart aborted")
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        return int(rc)
+
+    def set_ring_stall(self, ring: int, on: bool = True) -> None:
+        """Arm/disarm the C-level ring-stall injection (chaos/tests):
+        while armed the ring parks every dispatch — completions never
+        arrive, exactly what a wedged uring looks like.  Disarm
+        dispatches the backlog; ``ring_restart`` cancels it instead."""
+        rc = self._lib.strom_set_ring_stall(self._h, ring,
+                                            1 if on else 0)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+
+    def read_buffered(self, fh: int, offset: int, length: int
+                      ) -> np.ndarray:
+        """Degraded-mode primitive: one synchronous buffered ``pread``
+        into a caller-owned array — no ring, no staging pool (counted
+        fallback + bounce).  Returns the bytes actually read (short
+        only at EOF)."""
+        arr = np.empty(max(0, length), dtype=np.uint8)
+        if length <= 0:
+            return arr
+        n = self._lib.strom_read_buffered(
+            self._h, fh, offset, length,
+            arr.ctypes.data_as(ctypes.c_void_p))
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        return arr[:int(n)]
 
     # -- reads -------------------------------------------------------------
 
@@ -854,6 +963,11 @@ class StromEngine:
             raise ValueError(
                 f"read length {length} exceeds chunk_bytes "
                 f"{self.config.chunk_bytes}; split the range")
+        if ring is None and self.supervisor is not None:
+            # route around rings with an open breaker (None = all
+            # trusted, keep the C round-robin): this is what lands a
+            # requeued extent's resubmission on a HEALTHY ring
+            ring = self.supervisor.pick_ring()
         if ring is None:
             rid = self._lib.strom_submit_read(self._h, fh, offset, length)
         else:
@@ -877,6 +991,10 @@ class StromEngine:
             exts[i].offset = offset
             exts[i].length = length
         rids = (ctypes.c_int64 * n)()
+        if ring is None and self.supervisor is not None:
+            # scheduler-less batches (single ring, STROM_SCHED=0) still
+            # avoid rings with an open breaker
+            ring = self.supervisor.pick_ring()
         if ring is None:
             rc = self._lib.strom_submit_readv(self._h, exts, n, rids)
         else:
@@ -942,8 +1060,17 @@ class StromEngine:
                      data: np.ndarray) -> PendingWrite:
         arr = np.ascontiguousarray(data).view(np.uint8).reshape(-1)
         ptr = arr.ctypes.data_as(ctypes.c_void_p)
-        rid = self._lib.strom_submit_write(self._h, fh, offset, ptr,
-                                           arr.nbytes)
+        ring = (self.supervisor.pick_ring()
+                if self.supervisor is not None else None)
+        if ring is None:
+            rid = self._lib.strom_submit_write(self._h, fh, offset, ptr,
+                                               arr.nbytes)
+        else:
+            # checkpoint/KV writes route around rings with an open
+            # breaker exactly like scalar reads do — a ResilientWrite
+            # retry must never resubmit into the condemned domain
+            rid = self._lib.strom_submit_write_ring(
+                self._h, ring, fh, offset, ptr, arr.nbytes)
         if rid < 0:
             raise OSError(-rid, os.strerror(-rid))
         if self._stripe:
@@ -1023,6 +1150,11 @@ class StromEngine:
             # instantaneous per-ring queue depth: the scheduler block in
             # strom_stat/watchdog reads these next to the sched counters
             self.stats.set_gauges(ring_depths=self.ring_depths())
+        if self.supervisor is not None:
+            # a stat sync is a natural supervision heartbeat, and the
+            # health gauges (ring_health / engine_degraded) ride the
+            # same export the counters do
+            self.supervisor.tick()
         self.stats.maybe_export()  # keep strom_stat --watch observers live
         return snap
 
@@ -1034,6 +1166,10 @@ class StromEngine:
     def close_all(self) -> None:
         if self._closed:
             return
+        if self.supervisor is not None:
+            # release landed probe zombies and stop supervising before
+            # the C handle dies under a tick's ring poll
+            self.supervisor.close()
         if self.scheduler is not None:
             # wake any thread still blocked in a grant loop BEFORE the C
             # handle dies under its capacity poll (it raises ECANCELED)

@@ -19,6 +19,10 @@ required.  The fault taxonomy (one class per link of the chain):
     delay    a latency straggler                      → wait blocks longer
     stuck    a wedged request                         → waits time out
     bitflip  payload corrupted in flight              → one byte flipped
+    estorm   a bounded EIO *storm*: the next max_count matching reads
+             ALL fail (consecutive, then clean) — the whole-device
+             brown-out that drives the breaker / degraded-mode story
+             (io/health.py, docs/RESILIENCE.md "failure domains")
 
 and the write-path mirror (the durability story's failure modes —
 checkpoint saves, optimizer spill, KV eviction):
@@ -41,7 +45,13 @@ Python (exercising the C completion path itself), the engine honors
 ``STROM_FAULT_READ_DELAY_MS`` — and the write mirror
 ``STROM_FAULT_WRITE_EIO_EVERY`` / ``STROM_FAULT_WRITE_ENOSPC_EVERY`` /
 ``STROM_FAULT_WRITE_SHORT_EVERY`` / ``STROM_FAULT_WRITE_DELAY_MS`` — at
-``strom_engine_create`` time (see csrc/strom_io.cc).
+``strom_engine_create`` time (see csrc/strom_io.cc).  The failure-DOMAIN
+kind lives below even that: ``STROM_FAULT_RING_STALL_RING`` /
+``STROM_FAULT_RING_STALL_AFTER`` (or :func:`set_ring_stall` on a live
+engine) wedge one submission ring — its dispatches park and completions
+never arrive — which is the deterministic drive for the supervision
+layer's stall detector, circuit breakers, and hot ring restart
+(io/health.py, docs/RESILIENCE.md "failure domains").
 
 Every injected fault is counted (``StromStats.faults_injected``), tagged
 per kind on the plan, and traced (``strom.fault.<kind>`` spans in
@@ -60,9 +70,25 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-READ_FAULT_KINDS = ("eio", "short", "delay", "stuck", "bitflip")
+READ_FAULT_KINDS = ("eio", "short", "delay", "stuck", "bitflip",
+                    "estorm")
 WRITE_FAULT_KINDS = ("weio", "wenospc", "wshort", "wdelay")
 FAULT_KINDS = READ_FAULT_KINDS + WRITE_FAULT_KINDS
+
+
+def set_ring_stall(engine, ring: int, on: bool = True) -> None:
+    """Arm/disarm the C-level ring-stall injection on a live engine —
+    unwraps any Faulty/Resilient stack to the base StromEngine (the
+    stall lives below all of them: requests park at the ring's dispatch
+    point and completions never arrive).  The deterministic wedged-ring
+    drive for the supervision layer (io/health.py); the env twins
+    ``STROM_FAULT_RING_STALL_RING`` / ``STROM_FAULT_RING_STALL_AFTER``
+    arm it at engine create for subprocess chaos runs."""
+    base = engine
+    while getattr(base, "_engine", None) is not None \
+            and not hasattr(base, "set_ring_stall"):
+        base = base._engine
+    base.set_ring_stall(ring, on)
 
 
 def crash_point(name: str) -> None:
@@ -121,6 +147,13 @@ class FaultSpec:
             # the kind IS the errno: 'wenospc' without an explicit err=
             # models the namespace filling up
             object.__setattr__(self, "err", errno.ENOSPC)
+        if self.kind == "estorm" and self.max_count == 0:
+            # an EIO *storm* is bounded by definition: CONSECUTIVE
+            # failures for max_count matching reads, then clean — the
+            # deterministic device-brown-out drive for the breaker /
+            # degraded-mode story (io/health.py).  every/p are ignored:
+            # a storm that skips reads isn't a storm.
+            object.__setattr__(self, "max_count", 16)
 
     @property
     def is_write(self) -> bool:
@@ -207,7 +240,9 @@ class FaultPlan:
             if spec.max_count and self._fired.get(i, 0) >= spec.max_count:
                 continue
             n = self._matches[i] = self._matches.get(i, 0) + 1
-            if spec.every:
+            if spec.kind == "estorm":
+                hit = True      # consecutive until max_count exhausts
+            elif spec.every:
                 hit = n % spec.every == 0
             else:
                 hit = self._rng.random() < spec.p
@@ -262,6 +297,11 @@ class FaultyRead:
     def offset(self) -> int:
         return getattr(self._inner, "offset", -1)
 
+    @property
+    def ring(self) -> int:
+        """Failure-domain attribution rides through the fault layer."""
+        return getattr(self._inner, "ring", -1)
+
     def _remaining_delay(self) -> float:
         if self._spec.kind not in ("delay", "stuck"):
             return 0.0
@@ -284,7 +324,7 @@ class FaultyRead:
             time.sleep(remain)
             if timeout is not None:
                 timeout = max(0.0, timeout - remain)
-        if self._spec.kind == "eio":
+        if self._spec.kind in ("eio", "estorm"):
             self._error = OSError(self._spec.err,
                                   os.strerror(self._spec.err)
                                   + " (injected)")
@@ -353,6 +393,10 @@ class FaultyWrite:
     @property
     def length(self) -> int:
         return getattr(self._inner, "length", 0)
+
+    @property
+    def ring(self) -> int:
+        return getattr(self._inner, "ring", -1)
 
     def wait(self, timeout: Optional[float] = None) -> int:
         if self._spec.kind == "wdelay":

@@ -367,7 +367,24 @@ def submit_spans(engine, spans: Sequence[Tuple[int, int, int]],
     ``klass`` tags the batch's latency class (io/sched.py: ``decode`` >
     ``restore`` > ``prefetch`` > ``scrub``); on a sharded engine the QoS
     scheduler dispatches accordingly, and the resilience layer applies
-    that class's hedge/retry budgets.  None rides the default class."""
+    that class's hedge/retry budgets.  None rides the default class.
+
+    Failure-domain fallback (io/health.py, docs/RESILIENCE.md): when
+    the engine's supervisor reports the DEVICE degraded (every ring
+    breaker open, or the error budget blown across domains), the batch
+    is served as plain synchronous buffered preads instead — bypassing
+    the engine, the scheduler, AND any Faulty/Resilient wrapper above
+    it, exactly like host-cache hits do — so serving browns out at
+    reduced bandwidth instead of blacking out.  One half-open probe
+    per interval rides the real path; its success restores the fast
+    path for the very batch that probed."""
+    sup = getattr(engine, "supervisor", None)
+    if sup is not None:
+        sup.tick()
+        if sup.degraded():
+            out = sup.serve_degraded(engine, spans)
+            if out is not None:
+                return out      # still degraded (None = probe healed)
     readv = getattr(engine, "submit_readv", None)
     if readv is not None:
         if klass is not None and _readv_accepts_klass(engine):

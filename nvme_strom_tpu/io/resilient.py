@@ -232,6 +232,13 @@ class ResilientRead:
                 raise TimeoutError(
                     f"read fh={self._fh} off={self._offset} still in "
                     f"flight (recovery continues on the next wait)")
+            # supervision heartbeat while a read is a straggler: the
+            # stall detector and the hot-restart run on exactly the
+            # threads that are stuck waiting on the wedged domain
+            # (time-gated inside — one monotonic compare per slice)
+            sup = eng._supervisor
+            if sup is not None:
+                sup.tick()
             elapsed = now - self._primary.t0
             # hedge: the primary is a straggler — race ONE duplicate
             if (self._hedge is None and self._hedges == 0
@@ -285,6 +292,16 @@ class ResilientRead:
                 if self._klass:
                     eng.stats.add_class_stat(self._klass, hedges_denied=1)
             return None
+        try:
+            pending = eng._engine.submit_read(self._fh, self._offset,
+                                              self._length)
+        except OSError:
+            # a hedge that cannot even submit (pool teardown, routing
+            # refusal) must neither fail the read NOR strand the token:
+            # hand it straight back — the deferral-queue wedge a leaked
+            # token eventually becomes is exactly what the audit closed
+            eng._release_hedge(self._klass)
+            return None
         self._hedge_token = True
         self._hedges += 1
         eng.stats.add(hedges_issued=1)
@@ -292,8 +309,7 @@ class ResilientRead:
             eng.stats.add_class_stat(self._klass, hedges_issued=1)
         eng._trace("strom.resilient.hedge", time.monotonic_ns(),
                    fh=self._fh, offset=self._offset, length=self._length)
-        return _Attempt(eng._engine.submit_read(
-            self._fh, self._offset, self._length), time.monotonic())
+        return _Attempt(pending, time.monotonic())
 
     def _drop_hedge(self) -> None:
         """Clear the hedge slot and hand its budget token back (every
@@ -310,6 +326,16 @@ class ResilientRead:
             "kind": kind or ("stuck" if isinstance(e, _Stuck) else "io"),
             "elapsed_s": round(time.monotonic() - self._primary.t0, 4),
         })
+        # feed the failure-domain supervisor (io/health.py): a Python-
+        # level fault plan never moves the C ring counters, yet must
+        # trip the same breakers.  Ring attribution via the request id's
+        # ring bits; cancellations are requeues and filtered inside.
+        sup = self._engine._supervisor
+        if sup is not None:
+            sup.note_error(getattr(self._primary.pending, "ring", -1),
+                           err=getattr(e, "errno", None),
+                           engine_counted=getattr(e, "engine_counted",
+                                                  False))
 
     def _retry(self, deadline) -> None:
         """Release the failed/stuck attempt, back off, resubmit."""
@@ -333,9 +359,42 @@ class ResilientRead:
         self._retries += 1
         self._hedges = 0     # a fresh primary earns a fresh hedge budget
         self._hedge_denied = False
-        self._primary = _Attempt(
-            eng._engine.submit_read(self._fh, self._offset, self._length),
-            time.monotonic())
+        sup = eng._supervisor
+        if sup is not None and sup.degraded():
+            # the device breaker opened while this read was mid-
+            # recovery: its next attempt browns out onto the buffered
+            # path (io/health.py) instead of burning the remaining
+            # retry budget against a device the supervisor already
+            # condemned — zero consumer errors is the contract
+            self._primary = _Attempt(
+                sup.degraded_pending(self._fh, self._offset,
+                                     self._length,
+                                     getattr(eng, "stats", None),
+                                     probe_engine=eng._engine),
+                time.monotonic())
+            eng._trace("strom.resilient.retry", t0, fh=self._fh,
+                       offset=self._offset, attempt=self._retries,
+                       stuck=stuck, degraded=True,
+                       error=self._attempts[-1]["error"])
+            return
+        try:
+            pending = eng._engine.submit_read(self._fh, self._offset,
+                                              self._length)
+        except OSError as e:
+            # the RESUBMISSION itself failed (engine teardown, pool
+            # refusal): every prior attempt is already released/parked —
+            # surface the loud, history-carrying ReadError instead of a
+            # raw OSError with the logical read half-alive (audit:
+            # wait_exact/consumers treat ReadError's released state as
+            # final; a live-looking read here would strand its slot)
+            self._note_failure(e, kind="resubmit")
+            self._released = True
+            raise ReadError(
+                f"read fh={self._fh} off={self._offset} "
+                f"len={self._length} could not be resubmitted after "
+                f"{self._retries} retries: {e} "
+                f"(history: {self._attempts})", self._attempts) from e
+        self._primary = _Attempt(pending, time.monotonic())
         eng._trace("strom.resilient.retry", t0, fh=self._fh,
                    offset=self._offset, attempt=self._retries,
                    stuck=stuck, error=self._attempts[-1]["error"])
@@ -473,6 +532,12 @@ class ResilientWrite:
         self._attempts.append({
             "error": str(e), "kind": kind,
             "elapsed_s": round(time.monotonic() - self._t0, 4)})
+        sup = self._engine._supervisor
+        if sup is not None:   # write failures feed the same breakers
+            sup.note_error(getattr(self._pending, "ring", -1),
+                           err=getattr(e, "errno", None),
+                           engine_counted=getattr(e, "engine_counted",
+                                                  False))
 
     def _retry_or_raise(self, cfg, deadline, resubmit_from: int) -> None:
         eng = self._engine
@@ -547,6 +612,11 @@ class ResilientEngine:
             hedge_budgets = {name: p.hedge_budget
                             for name, p in default_policies().items()}
         self.hedge_budgets = dict(hedge_budgets)
+        # the failure-domain supervisor (io/health.py) of the BASE
+        # engine, reached through the wrapper chain's delegation;
+        # cached — _note_failure runs on error paths, but the wait
+        # loop's supervision tick runs per poll slice
+        self._supervisor = getattr(engine, "supervisor", None)
         self._hedge_out: dict = {}           # class -> outstanding hedges
         self._hedge_lock = threading.Lock()
         self._rng = random.Random(self.rconfig.seed)

@@ -48,6 +48,17 @@ Every decision is accounted: ``StromStats.sched_*`` counters, per-class
 dispatch/queue-wait tallies (``class_stats`` in the export), and
 per-ring depth gauges — rendered by ``strom_stat``'s scheduler block,
 watchdog dumps, and bench.py's mixed-workload scenario.
+
+Failure domains (io/health.py, docs/RESILIENCE.md): the ``ring_free``
+callback the engine binds here is supervision-aware — a ring whose
+circuit breaker is OPEN reports zero admission headroom, so every
+queued batch routes to healthy rings until the hot restart brings the
+ring back half-open; the admission poll doubles as the supervision
+heartbeat (time-gated ``tick`` inside the callback).  The scheduler
+itself never sees an all-masked ring set: the device-level breaker
+(whose open state diverts traffic to the degraded buffered path at the
+planner boundary, above this layer) is decided atomically with the
+last ring trip.
 """
 
 from __future__ import annotations

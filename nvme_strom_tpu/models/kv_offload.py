@@ -852,6 +852,14 @@ class SloGovernor:
             step = -1
         if step == 0:
             return
+        sup = getattr(engine, "supervisor", None)
+        if step > 0 and sup is not None and sup.unhealthy():
+            # failure-domain gate (docs/RESILIENCE.md): a p99 violation
+            # caused by a tripped ring / degraded device is not a
+            # scheduling problem — boosting the hedge budget would
+            # DOUBLE the I/O pressed into the sick domain exactly when
+            # the breaker is trying to drain it.  Decay still runs.
+            return
         self._last = now
         self.boost += step
         set_budget = getattr(engine, "set_hedge_budget", None)

@@ -236,11 +236,23 @@ class DecodeServer:
 
     def __init__(self, params: Dict, cfg: TransformerConfig,
                  max_batch: int, max_len: int, cache_attn="auto",
-                 kv_store=None):
+                 kv_store=None, shed_probe=None):
         self.params = params
         self.cfg = cfg
         self.B = max_batch
         self.max_len = max_len
+        #: load-shedding probe (docs/RESILIENCE.md "failure domains"):
+        #: a callable returning True while new prefill admissions should
+        #: DEFER (requests wait queued; in-flight decode continues;
+        #: nothing fails).  None (default) auto-wires to the KV store
+        #: engine's failure-domain supervisor — when the NVMe tier is
+        #: degraded, admitting a prefill would push restore/store
+        #: traffic into a sick device and crater every in-flight
+        #: request's p99; deferring sheds load until the half-open
+        #: probe restores the fast path.
+        self._shed_probe = shed_probe
+        #: admission opportunities deferred by shedding (stats())
+        self.admissions_shed = 0
         #: content-addressed NVMe prefix store (models/kv_offload.py
         #: PrefixStore, docs/PERF.md §5) — None (default) is today's
         #: per-session path bit-for-bit.  Shared system prompts across
@@ -560,10 +572,36 @@ class DecodeServer:
             "admit_wait_ms_avg": round(agg["wait_sum"] / n, 3)
             if n else 0.0,
             "admit_wait_ms_max": round(agg["wait_max"], 3),
+            "admissions_shed": self.admissions_shed,
         }
 
     def _can_admit(self, req: _Request) -> bool:
         return True            # dense slots carry their own reservation
+
+    def _shed_now(self) -> bool:
+        """True while new prefill admissions should defer (the engine's
+        failure domains are degraded, or the explicit probe says so)."""
+        if self._shed_probe is not None:
+            return bool(self._shed_probe())
+        store = self.kv_store
+        sup = getattr(getattr(store, "engine", None), "supervisor",
+                      None) if store is not None else None
+        if sup is None:
+            return False
+        # the serving loop is a supervision heartbeat while it sheds:
+        # with admissions deferred there may be NO other I/O left to
+        # carry the half-open probe, and tick() re-probes from the
+        # last degraded span (time-gated inside)
+        sup.tick()
+        return bool(sup.degraded())
+
+    def _note_shed(self, n: int) -> None:
+        self.admissions_shed += n
+        store = self.kv_store
+        stats = getattr(getattr(store, "engine", None), "stats",
+                        None) if store is not None else None
+        if stats is not None:
+            stats.add(serve_admissions_shed=n)
 
     def _run_step(self):
         """Storage-specific batched step → next-token device array."""
@@ -612,10 +650,20 @@ class DecodeServer:
         # pipelines with the decode dispatches instead of paying a
         # link round trip per request
         plans = []
-        for slot in range(self.B):
-            if (self.slots[slot] is None and self.queue
-                    and self._can_admit(self.queue[0])):
-                plans.append(self._admit_plan(slot, self.queue.pop(0)))
+        # load shedding (docs/RESILIENCE.md "failure domains"): while
+        # the engine behind the KV store is degraded, new prefills
+        # DEFER — they stay queued (re-checked every step; nothing
+        # fails) and in-flight decode keeps its slots, so the sick
+        # device serves the work it already owes instead of taking more
+        if self.queue and self._shed_now():
+            self._note_shed(min(sum(s is None for s in self.slots),
+                                len(self.queue)))
+        else:
+            for slot in range(self.B):
+                if (self.slots[slot] is None and self.queue
+                        and self._can_admit(self.queue[0])):
+                    plans.append(self._admit_plan(slot,
+                                                  self.queue.pop(0)))
         restored = (self._restore_prefixes(plans)
                     if plans and self.kv_store is not None else {})
         for plan in plans:
@@ -731,7 +779,7 @@ class PagedDecodeServer(DecodeServer):
     def __init__(self, params: Dict, cfg: TransformerConfig,
                  max_batch: int, max_len: int, total_blocks: int,
                  block_len: int = 128, prefix_cache: bool = True,
-                 kv_store=None):
+                 kv_store=None, shed_probe=None):
         if block_len < 1 or total_blocks < 1:
             raise ValueError("block_len and total_blocks must be >= 1")
         if kv_store is not None and kv_store.page_tokens != block_len:
@@ -746,7 +794,8 @@ class PagedDecodeServer(DecodeServer):
         # cache_attn is the DENSE servers' knob; the paged step always
         # runs the paged-attention kernel
         super().__init__(params, cfg, max_batch, max_len,
-                         cache_attn=None, kv_store=kv_store)
+                         cache_attn=None, kv_store=kv_store,
+                         shed_probe=shed_probe)
         self.max_blocks = -(-max_len // block_len)
 
     def _alloc_storage(self) -> None:

@@ -38,7 +38,8 @@ from typing import Dict, List, Optional
 # importing them keeps the scrubber's GC and dir sniffing in lockstep
 # with the layout (jax is imported lazily there, so this is cheap)
 from nvme_strom_tpu.checkpoint.manager import (_STEP_RE, _TMP_RE,
-                                               _gc_min_age, _newest_mtime)
+                                               _gc_min_age, _newest_mtime,
+                                               sweep_orphan_manifests)
 
 
 def _engine(config=None):
@@ -240,27 +241,40 @@ def _is_ckpt_dir(path: str) -> bool:
 
 def collect_targets(path: str) -> Dict[str, List[str]]:
     """{kind: paths} for ``path``: safetensors files (checkpoint tiles,
-    weight shards), sidecar-eligible data shards, and serving KV prefix
+    weight shards), sidecar-eligible data shards, serving KV prefix
     stores (recognized by their ``.kvman.json`` manifest — the page
-    file itself may carry any name)."""
+    file itself may carry any name), and ORPHANED manifests whose page
+    file is gone (a deleted/crashed store's debris — ``--gc`` sweeps
+    them like ``.tmp_step_*`` dirs)."""
     st: List[str] = []
     shards: List[str] = []
     kvstores: List[str] = []
+    orphans: List[str] = []
     if os.path.isfile(path):
-        if os.path.exists(path + ".kvman.json"):
+        if path.endswith(".kvman.json"):
+            base = path[:-len(".kvman.json")]
+            (kvstores.append(base) if os.path.exists(base)
+             else orphans.append(path))
+        elif os.path.exists(path + ".kvman.json"):
             kvstores.append(path)
         elif path.endswith(".safetensors"):
             st.append(path)
         else:
             shards.append(path)
         return {"safetensors": st, "shards": shards,
-                "kvstores": kvstores}
+                "kvstores": kvstores, "orphan_manifests": orphans}
     for dirpath, dirnames, filenames in os.walk(path):
         dirnames[:] = [d for d in dirnames if not _TMP_RE.match(d)]
         for name in sorted(filenames):
             p = os.path.join(dirpath, name)
             if name.endswith(".kvman.json"):
-                continue            # the manifest rides its page file
+                # the manifest rides its page file — unless the page
+                # file is gone, which makes it sweepable debris (same
+                # verdict as checkpoint.manager.find_orphan_manifests;
+                # detected inline so the tree is walked ONCE)
+                if not os.path.exists(p[:-len(".kvman.json")]):
+                    orphans.append(p)
+                continue
             if os.path.exists(p + ".kvman.json"):
                 kvstores.append(p)
             elif name.endswith(".safetensors"):
@@ -268,7 +282,8 @@ def collect_targets(path: str) -> Dict[str, List[str]]:
             elif name.endswith((".tar", ".tfrecord", ".tfrecords",
                                 ".fixedrec", ".bin")):
                 shards.append(p)
-    return {"safetensors": st, "shards": shards, "kvstores": kvstores}
+    return {"safetensors": st, "shards": shards, "kvstores": kvstores,
+            "orphan_manifests": sorted(orphans)}
 
 
 def main(argv=None) -> int:
@@ -300,7 +315,8 @@ def main(argv=None) -> int:
     report: dict = {"path": args.path, "files_scanned": 0,
                     "damage": [], "unstamped": [], "stamped": [],
                     "tmp_dirs": [], "tmp_dirs_removed": [],
-                    "tmp_dirs_live": []}
+                    "tmp_dirs_live": [], "orphan_manifests": [],
+                    "orphan_manifests_removed": []}
 
     try:
         return _scan(args, targets, report)
@@ -366,6 +382,18 @@ def _scan(args, targets, report) -> int:
                         continue
                     report["tmp_dirs_removed"].append(t)
 
+        # orphaned .kvman.json manifests (page file gone — a deleted
+        # or crash-torn PrefixStore's debris): the shared sweeper with
+        # the same age gate as the staging dirs, so a store racing a
+        # delete/recreate cycle is never swept out from under its
+        # process (--force overrides, as for tmp dirs)
+        report["orphan_manifests"] = list(targets.get(
+            "orphan_manifests", []))
+        if args.gc:
+            report["orphan_manifests_removed"] = sweep_orphan_manifests(
+                report["orphan_manifests"],
+                0.0 if args.force else _gc_min_age())
+
         eng.sync_stats()
         snap = eng.stats.snapshot()
         report["bytes_verified"] = int(snap.get("bytes_verified", 0))
@@ -398,6 +426,11 @@ def _scan(args, targets, report) -> int:
             else:
                 tag = "crashed-save debris (use --gc)"
             print(f"  tmp {t}: {tag}")
+        for m in report["orphan_manifests"]:
+            tag = ("removed" if m in report["orphan_manifests_removed"]
+                   else "orphaned kv manifest — page file gone "
+                        "(use --gc)")
+            print(f"  orphan {m}: {tag}")
         if not report["damage"]:
             print("no damage found")
     return 1 if report["damage"] else 0

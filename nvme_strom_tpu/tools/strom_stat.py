@@ -77,6 +77,16 @@ _KV_COUNTERS = (
     "kv_store_evictions", "kv_slo_boosts", "kv_restore_failures",
 )
 
+#: failure-domain supervision counters (io/health.py —
+#: docs/RESILIENCE.md "failure domains"); own block, shown only when a
+#: breaker ever acted or the ring_health gauge reports a non-closed
+#: state — a healthy run's report stays exactly as short as before
+_HEALTH_COUNTERS = (
+    "breaker_trips", "ring_restarts", "extents_requeued",
+    "degraded_reads", "degraded_bytes", "degraded_probes",
+    "serve_admissions_shed",
+)
+
 
 def render_device(path: str) -> str:
     """Backing-device topology of ``path`` — the observable form of the
@@ -211,6 +221,27 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
         if p99:
             lines.append(f"    {'restore p99':<22} "
                          f"{float(p99):>11.2f} ms")
+    ring_health = snap.get("ring_health") or []
+    if (any(int(snap.get(n, 0)) for n in _HEALTH_COUNTERS)
+            or any(s != "closed" for s in ring_health)
+            or int(snap.get("engine_degraded", 0))):
+        lines.append("  health (failure domains: breakers / restarts "
+                     "/ degraded mode):")
+        for name in _HEALTH_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if name.startswith("degraded_bytes") \
+                else str(v)
+            lines.append(f"    {name:<22} {shown:>14}")
+        if ring_health:
+            lines.append(f"    {'ring breakers':<22} "
+                         f"{' '.join(ring_health):>14}")
+        degraded = int(snap.get("engine_degraded", 0))
+        lines.append(f"    {'device state':<22} "
+                     f"{'DEGRADED (buffered brown-out)' if degraded else 'ok':>14}")
+        if degraded:
+            lines.append(
+                "    BROWNED OUT — all fast domains unhealthy; serving "
+                "rides plain preads until a half-open probe recovers")
     if any(int(snap.get(n, 0)) for n in _RESILIENCE_COUNTERS):
         lines.append("  resilience (recoveries + degradations):")
         for name in _RESILIENCE_COUNTERS:

@@ -191,6 +191,81 @@ class HostCacheConfig:
 
 
 @dataclass(frozen=True)
+class BreakerConfig:
+    """Failure-domain supervision knobs (io/health.py; semantics in
+    docs/RESILIENCE.md "Failure domains").
+
+    The supervisor sits above ResilientEngine: per-ring rolling error
+    windows + a completion-stall detector feed a circuit breaker per
+    ring (trip → route around it via the QoS scheduler → hot-restart it
+    → half-open → closed) and a device-level breaker whose open state
+    is the degraded buffered mode — ``plan_and_submit`` serves plain
+    ``pread``s until a half-open probe restores the fast path.  STROM_*
+    environment variables are read at construction time, mirroring
+    EngineConfig.
+    """
+
+    #: master switch (STROM_BREAKER=0 removes the supervision layer
+    #: entirely: no health polling, no degraded fallback — the exact
+    #: pre-supervision engine)
+    enabled: bool = field(
+        default_factory=lambda: os.environ.get("STROM_BREAKER",
+                                               "1") != "0")
+    #: rolling error-window span: errors older than this stop counting
+    #: toward any breaker verdict
+    window_s: float = field(
+        default_factory=lambda: _env_float("STROM_BREAKER_WINDOW_S", 5.0))
+    #: per-ring error budget: this many errors inside the window trips
+    #: the ring's breaker
+    ring_errors: int = field(
+        default_factory=lambda: _env_int("STROM_BREAKER_ERRORS", 8))
+    #: device-level error budget: this many errors across ALL rings
+    #: inside the window opens the device breaker (degraded mode)
+    device_errors: int = field(
+        default_factory=lambda: _env_int("STROM_BREAKER_DEVICE_ERRORS",
+                                         16))
+    #: a ring whose oldest in-flight request is older than this is
+    #: declared stalled (completions never arrived) and trips its
+    #: breaker — the reap-side stall detector
+    stall_s: float = field(
+        default_factory=lambda: _env_float("STROM_BREAKER_STALL_S", 5.0))
+    #: hot-restart drain budget: how long the restart waits for a
+    #: tripped ring's dispatched I/O before aborting -ETIMEDOUT
+    drain_s: float = field(
+        default_factory=lambda: _env_float("STROM_BREAKER_DRAIN_S", 0.5))
+    #: clean time a restarted (half-open) ring must serve before its
+    #: breaker closes again
+    half_open_s: float = field(
+        default_factory=lambda: _env_float("STROM_BREAKER_HALF_OPEN_S",
+                                           2.0))
+    #: min interval between hot-restart attempts of one ring (a ring
+    #: that re-trips immediately must not be restarted in a tight loop)
+    restart_backoff_s: float = field(
+        default_factory=lambda: _env_float("STROM_BREAKER_RESTART_S", 5.0))
+    #: degraded-mode half-open probe interval: while browned out, one
+    #: read per interval rides the REAL path; success restores it
+    probe_s: float = field(
+        default_factory=lambda: _env_float("STROM_DEGRADED_PROBE_S", 1.0))
+    #: wait budget of one half-open probe (a wedged device must not
+    #: stall the degraded reader behind its own probe for long)
+    probe_timeout_s: float = field(
+        default_factory=lambda: _env_float(
+            "STROM_DEGRADED_PROBE_TIMEOUT_S", 2.0))
+
+    def __post_init__(self):
+        if self.window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        if self.ring_errors < 1 or self.device_errors < 1:
+            raise ValueError("error budgets must be >= 1")
+        if self.stall_s <= 0 or self.drain_s <= 0:
+            raise ValueError("stall_s/drain_s must be > 0")
+        if self.half_open_s < 0 or self.restart_backoff_s < 0:
+            raise ValueError("half_open_s/restart_backoff_s must be >= 0")
+        if self.probe_s < 0 or self.probe_timeout_s <= 0:
+            raise ValueError("probe_s must be >= 0, probe_timeout_s > 0")
+
+
+@dataclass(frozen=True)
 class KVServeConfig:
     """Serving KV prefix-store knobs (models/kv_offload.py PrefixStore;
     semantics in docs/PERF.md §5).

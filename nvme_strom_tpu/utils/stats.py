@@ -151,6 +151,25 @@ class StromStats:
     # either way healed through recompute on the next admission
     kv_slo_boosts: int = 0
     kv_restore_failures: int = 0
+    # -- failure-domain supervision (io/health.py, docs/RESILIENCE.md
+    # "failure domains") ---------------------------------------------------
+    # circuit-breaker trips (per-ring error budget / stall detector,
+    # plus the device-level breaker whose open state is degraded mode)
+    breaker_trips: int = 0
+    # hot ring restarts performed, and the in-flight extents a restart
+    # cancelled for requeue (their waiters resubmitted onto healthy
+    # rings — one longer wait, never a consumer error)
+    ring_restarts: int = 0
+    extents_requeued: int = 0
+    # degraded buffered mode: spans served as plain preads while every
+    # fast domain was sick, their payload bytes, and the half-open
+    # probes that rode the real path to test recovery
+    degraded_reads: int = 0
+    degraded_bytes: int = 0
+    degraded_probes: int = 0
+    # serving-side load shedding: prefill admissions deferred while the
+    # engine reported degraded (requests wait queued; nothing fails)
+    serve_admissions_shed: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)

@@ -225,6 +225,30 @@ class StepWatchdog:
                           f"slo_boosts={s.kv_slo_boosts} "
                           f"failures={s.kv_restore_failures}",
                           file=w, flush=True)
+                # failure-domain tier (io/health.py): a hang with an
+                # OPEN breaker or the degraded flag set is a supervised
+                # brown-out in progress, not a silent wedge — and a
+                # hang with every breaker closed clears the I/O
+                # domains as suspects at a glance
+                hsnap = s.snapshot()
+                ring_health = hsnap.get("ring_health")
+                if (s.breaker_trips or s.ring_restarts
+                        or s.degraded_reads or s.serve_admissions_shed
+                        or (ring_health
+                            and any(x != "closed"
+                                    for x in ring_health))):
+                    states = " ".join(ring_health) if ring_health \
+                        else "-"
+                    print(f"health: breakers=[{states}] "
+                          f"degraded={int(hsnap.get('engine_degraded', 0))} "
+                          f"trips={s.breaker_trips} "
+                          f"restarts={s.ring_restarts} "
+                          f"requeued={s.extents_requeued} "
+                          f"degraded_reads={s.degraded_reads} "
+                          f"degraded_bytes={s.degraded_bytes} "
+                          f"probes={s.degraded_probes} "
+                          f"shed={s.serve_admissions_shed}",
+                          file=w, flush=True)
                 # the recovery tier's own accounting: a hung step whose
                 # resilient counters are MOVING is recovering, not
                 # wedged — the distinction this dump exists to make

@@ -251,3 +251,70 @@ def test_scrub_verifies_kv_prefix_store(tmp_path, capsys):
     assert len(rep["damage"]) == 1
     assert rep["damage"][0]["page"] == 1
     assert "crc32c" in rep["damage"][0]["error"]
+
+
+def test_scrub_gc_sweeps_orphaned_kv_manifests(tmp_path, capsys):
+    """PR-9 debris: a ``.kvman.json`` manifest whose page file is gone
+    (store deleted / crash-torn) is reported, age-gated, and removed by
+    ``--gc`` — while a LIVE store's manifest is never touched."""
+    import time as _time
+    live = tmp_path / "live.kvpages"
+    live.write_bytes(b"\0" * 4096)
+    (tmp_path / "live.kvpages.kvman.json").write_text(
+        json.dumps({"version": 1, "page_bytes": 4096, "pages": {}}))
+    orphan = tmp_path / "gone.kvpages.kvman.json"
+    orphan.write_text(
+        json.dumps({"version": 1, "page_bytes": 4096, "pages": {}}))
+    # without --gc: reported, preserved
+    rc = strom_scrub.main([str(tmp_path), "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert rep["orphan_manifests"] == [str(orphan)]
+    assert rep["orphan_manifests_removed"] == []
+    assert orphan.exists()
+    # --gc spares a FRESH orphan (racing store recreate) …
+    rc = strom_scrub.main([str(tmp_path), "--gc", "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0 and rep["orphan_manifests_removed"] == []
+    assert orphan.exists()
+    # … removes it once hour-cold; the live manifest survives
+    old = _time.time() - 7200
+    os.utime(orphan, (old, old))
+    rc = strom_scrub.main([str(tmp_path), "--gc", "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert rep["orphan_manifests_removed"] == [str(orphan)]
+    assert not orphan.exists()
+    assert (tmp_path / "live.kvpages.kvman.json").exists()
+    # --force overrides the age gate
+    orphan.write_text(
+        json.dumps({"version": 1, "page_bytes": 4096, "pages": {}}))
+    rc = strom_scrub.main([str(tmp_path), "--gc", "--force", "--json"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rc == 0 and rep["orphan_manifests_removed"] == [str(orphan)]
+    assert not orphan.exists()
+
+
+def test_checkpoint_manager_startup_gc_sweeps_orphan_manifests(tmp_path):
+    """CheckpointManager startup GC (the other sweeper): hour-cold
+    orphaned manifests under its directory are removed and recorded;
+    fresh ones and live stores survive."""
+    import time as _time
+    from nvme_strom_tpu.checkpoint.manager import CheckpointManager
+    live = tmp_path / "store.kvpages"
+    live.write_bytes(b"\0" * 4096)
+    (tmp_path / "store.kvpages.kvman.json").write_text(
+        json.dumps({"version": 1, "page_bytes": 4096, "pages": {}}))
+    cold = tmp_path / "cold.kvpages.kvman.json"
+    cold.write_text(
+        json.dumps({"version": 1, "page_bytes": 4096, "pages": {}}))
+    old = _time.time() - 7200
+    os.utime(cold, (old, old))
+    fresh = tmp_path / "fresh.kvpages.kvman.json"
+    fresh.write_text(
+        json.dumps({"version": 1, "page_bytes": 4096, "pages": {}}))
+    mgr = CheckpointManager(tmp_path)
+    assert mgr.manifest_gc == [str(cold)]
+    assert not cold.exists()
+    assert fresh.exists()                  # age-gated: possibly live
+    assert (tmp_path / "store.kvpages.kvman.json").exists()

@@ -252,7 +252,8 @@ def test_server_stats_gauges(setup):
              "prefix_evictable": 0, "prefix_hits": 0,
              "prefix_shared_blocks": 0, "requests_finished": 0,
              "ttft_ms_avg": 0.0, "ttft_ms_max": 0.0,
-             "admit_wait_ms_avg": 0.0, "admit_wait_ms_max": 0.0}
+             "admit_wait_ms_avg": 0.0, "admit_wait_ms_max": 0.0,
+             "admissions_shed": 0}
     assert s0 == want0
     srv.step()
     s1 = srv.stats()
