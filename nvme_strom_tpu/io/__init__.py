@@ -18,6 +18,10 @@ from nvme_strom_tpu.io.faults import (
     build_engine,
     crash_point,
 )
+from nvme_strom_tpu.io.flightrec import (
+    FlightRecorder,
+    flight_of,
+)
 from nvme_strom_tpu.io.health import (
     DegradedRead,
     EngineSupervisor,
@@ -56,6 +60,7 @@ __all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
            "file_extents", "file_eligible", "wait_exact",
            "FaultPlan", "FaultSpec", "FaultyEngine", "build_engine",
            "crash_point",
+           "FlightRecorder", "flight_of",
            "DegradedRead", "EngineSupervisor",
            "CacheHitRead", "HostCache", "get_cache",
            "ExtentPlan", "SpanView", "plan_and_submit", "plan_extents",

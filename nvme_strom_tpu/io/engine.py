@@ -22,6 +22,7 @@ import numpy as np
 
 from nvme_strom_tpu.utils.config import EngineConfig
 from nvme_strom_tpu.utils.stats import StromStats, global_stats
+from nvme_strom_tpu.utils.trace import NO_CONTEXT
 
 _CSRC = Path(__file__).resolve().parents[2] / "csrc"
 _LIB_PATH = _CSRC / "libstrom_io.so"
@@ -514,6 +515,11 @@ class PendingRead:
             # the breaker budgets would silently halve for exactly the
             # real device errors they are calibrated against)
             e.engine_counted = True
+            flight = self._engine.flight
+            if flight is not None:
+                flight.record("read", getattr(self, "op_klass", None),
+                              self.ring, self.fh, self.offset, 0, 0,
+                              "error", err=-rc)
             raise e
         self.was_fallback = bool(comp.was_fallback)
         tracer = self._engine.tracer
@@ -521,7 +527,15 @@ class PendingRead:
             tracer.add_span(
                 "strom.read.fallback" if comp.was_fallback else "strom.read",
                 int(comp.submit_ns), int(comp.complete_ns),
+                ctx=getattr(self, "trace_ctx", NO_CONTEXT),
                 bytes=int(comp.len))
+        flight = self._engine.flight
+        if flight is not None:
+            flight.record(
+                "read", getattr(self, "op_klass", None), self.ring,
+                self.fh, self.offset, int(comp.len),
+                max(0, int(comp.complete_ns - comp.submit_ns)) // 1000,
+                "fallback" if comp.was_fallback else "ok")
         n = int(comp.len)
         if n == 0:
             self._view = np.empty(0, dtype=np.uint8)
@@ -670,15 +684,28 @@ class PendingWrite:
         # otherwise install them as a resident line)
         self._engine._hostcache_write_done(self.fh, self.offset,
                                            self.length)
+        flight = self._engine.flight
         if rc < 0:
             e = OSError(-rc, os.strerror(-rc))
             e.engine_counted = True   # see PendingRead.wait: the C
             #                           ring counter has this failure
+            if flight is not None:
+                flight.record("write", getattr(self, "op_klass", None),
+                              self.ring, self.fh, self.offset, 0, 0,
+                              "error", err=-rc)
             raise e
         tracer = self._engine.tracer
         if tracer is not None and tracer.enabled:
             tracer.add_span("strom.write", int(comp.submit_ns),
-                            int(comp.complete_ns), bytes=n)
+                            int(comp.complete_ns),
+                            ctx=getattr(self, "trace_ctx", NO_CONTEXT),
+                            bytes=n)
+        if flight is not None:
+            flight.record(
+                "write", getattr(self, "op_klass", None), self.ring,
+                self.fh, self.offset, n,
+                max(0, int(comp.complete_ns - comp.submit_ns)) // 1000,
+                "ok")
         return n
 
 
@@ -703,6 +730,12 @@ class StromEngine:
         self.config = config or EngineConfig()
         self.stats = stats if stats is not None else global_stats
         self.tracer = tracer if tracer is not None else global_tracer
+        if self.tracer is not None and self.tracer.stats is None:
+            # drop accounting must land in the block THIS engine
+            # exports, or trace_spans_dropped can never reach the
+            # strom_stat/watchdog warnings for private-stats engines
+            # (first engine wins on a shared tracer)
+            self.tracer.stats = self.stats
         self._lib = _load_lib()
         c = self.config
         n_buffers = max(
@@ -745,6 +778,28 @@ class StromEngine:
         if bcfg.enabled:
             from nvme_strom_tpu.io.health import EngineSupervisor
             self.supervisor = EngineSupervisor(self, bcfg)
+        # flight recorder (io/flightrec.py, docs/OBSERVABILITY.md):
+        # always-on bounded ring of recent op records, dumped by the
+        # health/SLO/watchdog triggers.  STROM_FLIGHT=0 removes it
+        # (None = the exact pre-recorder wait path).
+        self.flight = None
+        from nvme_strom_tpu.utils.config import FlightConfig
+        fcfg = FlightConfig()
+        if fcfg.enabled:
+            from nvme_strom_tpu.io.flightrec import FlightRecorder
+            self.flight = FlightRecorder(fcfg, self.stats)
+        # opt-in OpenMetrics textfile writer (STROM_METRICS_FILE):
+        # started once per process with the first engine's stats block.
+        # When the writer observes THIS engine's block, its periodic
+        # snapshots drain the C counters through sync_stats (detached
+        # at close_all so a snapshot can never race engine teardown).
+        from nvme_strom_tpu.utils.stats import maybe_start_metrics_writer
+        self._metrics_writer = maybe_start_metrics_writer(self.stats)
+        if (self._metrics_writer is not None
+                and self._metrics_writer.stats is self.stats):
+            self._metrics_writer.set_sync(self.sync_stats)
+        else:
+            self._metrics_writer = None
         self.scheduler = None
         if n_rings > 1:
             from nvme_strom_tpu.utils.config import SchedConfig
@@ -760,7 +815,8 @@ class StromEngine:
                     policies=default_policies(scfg.class_weights),
                     aging_rounds=scfg.aging_rounds,
                     stats=self.stats,
-                    ring_cap=self._ring_cap)
+                    ring_cap=self._ring_cap,
+                    tracer=self.tracer)
 
     # -- file handles ------------------------------------------------------
 
@@ -957,8 +1013,8 @@ class StromEngine:
         they are the retry/hedge/probe path, where added queueing delay
         would fight the recovery that issued them.  ``klass`` is
         accepted for API symmetry (wrappers use it for per-class
-        budgets); it does not affect scalar routing."""
-        del klass  # scalar routing is class-blind by design
+        budgets) and stamped onto the pending for flight-recorder
+        attribution; it does not affect scalar routing."""
         if length > self.config.chunk_bytes:
             raise ValueError(
                 f"read length {length} exceeds chunk_bytes "
@@ -977,7 +1033,16 @@ class StromEngine:
             raise OSError(-rid, os.strerror(-rid))
         if self._stripe:
             self._attr_stripe(fh, offset, length)
-        return PendingRead(self, rid, length, fh=fh, offset=offset)
+        pending = PendingRead(self, rid, length, fh=fh, offset=offset)
+        if klass is not None:
+            pending.op_klass = klass
+        if self.tracer is not None and self.tracer.enabled:
+            # causal attachment (docs/OBSERVABILITY.md): the completion
+            # span may be waited on another thread — carry the child
+            # context explicitly instead of relying on the contextvar
+            from nvme_strom_tpu.utils.trace import attach_context
+            pending.trace_ctx = attach_context()
+        return pending
 
     def _submit_readv_ring(self, reads, ring: Optional[int]) -> list:
         """Raw vectored submission to one ring (or C round-robin when
@@ -1005,9 +1070,14 @@ class StromEngine:
         if self._stripe:
             for fh, offset, length in reads:
                 self._attr_stripe(fh, offset, length)
-        return [PendingRead(self, int(rids[i]), reads[i][2],
-                            fh=reads[i][0], offset=reads[i][1])
-                for i in range(n)]
+        out = [PendingRead(self, int(rids[i]), reads[i][2],
+                           fh=reads[i][0], offset=reads[i][1])
+               for i in range(n)]
+        if self.tracer is not None and self.tracer.enabled:
+            from nvme_strom_tpu.utils.trace import attach_context
+            for p in out:
+                p.trace_ctx = attach_context()
+        return out
 
     def submit_readv(self, reads, klass: Optional[str] = None,
                      ring: Optional[int] = None) -> list:
@@ -1041,7 +1111,11 @@ class StromEngine:
                     f"{chunk}; split the range (io/plan.py does)")
         if self.scheduler is not None and ring is None:
             return self.scheduler.submit(reads, klass)
-        return self._submit_readv_ring(reads, ring)
+        out = self._submit_readv_ring(reads, ring)
+        if klass is not None:
+            for p in out:
+                p.op_klass = klass   # flight-recorder attribution
+        return out
 
     def read(self, fh: int, offset: int, length: int) -> np.ndarray:
         """Synchronous convenience read returning an *owning* array.
@@ -1080,7 +1154,11 @@ class StromEngine:
         # rewrites read their pages back through the same planner);
         # PendingWrite invalidates AGAIN at completion — see wait()
         self._hostcache_write_done(fh, offset, arr.nbytes)
-        return PendingWrite(self, rid, arr, fh=fh, offset=offset)
+        pending = PendingWrite(self, rid, arr, fh=fh, offset=offset)
+        if self.tracer is not None and self.tracer.enabled:
+            from nvme_strom_tpu.utils.trace import attach_context
+            pending.trace_ctx = attach_context()
+        return pending
 
     def _hostcache_write_done(self, fh: int, offset: int,
                               length: int) -> None:
@@ -1166,6 +1244,13 @@ class StromEngine:
     def close_all(self) -> None:
         if self._closed:
             return
+        if self._metrics_writer is not None:
+            # detach BEFORE teardown (blocks on any in-flight periodic
+            # drain, so no snapshot can touch the dying handle) — but
+            # compare-and-clear: a later engine on the same shared
+            # stats block may have installed ITS hook over ours
+            self._metrics_writer.detach_sync(self.sync_stats)
+            self._metrics_writer = None
         if self.supervisor is not None:
             # release landed probe zombies and stop supervising before
             # the C handle dies under a tick's ring poll

@@ -503,8 +503,10 @@ class FaultyEngine:
 
     def submit_read(self, fh: int, offset: int, length: int,
                     klass: Optional[str] = None):
-        del klass   # scalar routing is class-blind (engine contract)
-        pending = self._engine.submit_read(fh, offset, length)
+        # scalar routing stays class-blind (engine contract); the tag
+        # rides through for flight-recorder attribution only
+        pending = self._engine.submit_read(fh, offset, length,
+                                           klass=klass)
         return self._maybe_fault(pending, fh, offset, length)
 
     def submit_readv(self, reads, klass: Optional[str] = None) -> list:

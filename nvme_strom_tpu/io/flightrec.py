@@ -1,0 +1,143 @@
+"""Flight recorder — a bounded ring buffer of recent I/O op records
+that dumps itself to disk when a failure trigger fires
+(docs/OBSERVABILITY.md).
+
+Aggregate counters answer "how much"; the post-mortem question after a
+breaker trip, a hot ring restart, an SLO violation, or a watchdog stall
+is "what exactly was in flight just before".  The recorder keeps the
+answer ALWAYS available at near-zero cost: every completed engine read/
+write (plus degraded-mode preads) appends one compact record — class,
+ring, bytes, latency, outcome — to a ``deque(maxlen=N)`` (a single
+GIL-atomic append, no lock on the hot path), and PR 10's health layer
+plus the serving SLO governor and the step watchdog call :meth:`dump`
+on their triggers.  The dump is an atomic JSON file carrying the recent
+ops, a latency :class:`Log2Histogram` summary, and the full StromStats
+snapshot at the moment of the event.
+
+Knobs (``FlightConfig`` in utils/config.py): ``STROM_FLIGHT`` (master,
+default on), ``STROM_FLIGHT_OPS`` (ring capacity), ``STROM_FLIGHT_DIR``
+(dump directory; default the system temp dir), ``STROM_FLIGHT_MIN_S``
+(dump rate limit).  Every dump counts ``StromStats.flight_dumps`` —
+rendered by strom_stat's observability block and watchdog dumps.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from nvme_strom_tpu.utils.config import FlightConfig
+from nvme_strom_tpu.utils.stats import Log2Histogram, _atomic_write_text
+
+#: op-record field order (records are plain tuples — ~4x smaller and
+#: ~3x faster to append than dicts; the dump re-labels them)
+FIELDS = ("t_s", "kind", "klass", "ring", "fh", "offset", "bytes",
+          "latency_us", "outcome", "err")
+
+
+class FlightRecorder:
+    """The always-on ring buffer + trigger-dump sink of one engine."""
+
+    def __init__(self, config: Optional[FlightConfig] = None,
+                 stats=None):
+        self.cfg = config or FlightConfig()
+        self.stats = stats
+        self._ops: collections.deque = collections.deque(
+            maxlen=self.cfg.ops)
+        self._lat = Log2Histogram("strom_flight_latency_us",
+                                  "recorded op latency (µs)")
+        self._dump_lock = threading.Lock()
+        self._last_dump = -1e9
+        self.dumps = 0
+        #: dump paths written, newest last (bounded; tests and the
+        #: watchdog report read these)
+        self.dump_paths: list = []
+
+    # -- hot path ----------------------------------------------------------
+
+    def record(self, kind: str, klass: Optional[str], ring: int,
+               fh: int, offset: int, nbytes: int, latency_us: int,
+               outcome: str, err: Optional[int] = None) -> None:
+        """Append one completed-op record.  One deque append (GIL-atomic
+        — no lock) plus one histogram bucket increment; the callers
+        guard with ``if flight is not None`` so STROM_FLIGHT=0 keeps the
+        hot path untouched."""
+        self._ops.append((time.time(), kind, klass, ring, fh, offset,
+                          nbytes, latency_us, outcome, err))
+        if latency_us > 0:
+            # error records carry no real completion latency (0): an
+            # EIO storm must not drag the dump's p50/p99 to ~1 µs —
+            # those headline numbers exist for exactly that post-mortem
+            self._lat.observe(latency_us)
+
+    def __len__(self) -> int:
+        return len(self._ops)
+
+    def snapshot_ops(self) -> list:
+        """The recent ops as dicts, oldest first (tools, tests)."""
+        return [dict(zip(FIELDS, op)) for op in list(self._ops)]
+
+    # -- trigger dump ------------------------------------------------------
+
+    def _dump_dir(self) -> str:
+        return self.cfg.dir or tempfile.gettempdir()
+
+    def dump(self, reason: str, extra: Optional[dict] = None,
+             force: bool = False) -> Optional[str]:
+        """Write the post-mortem file for ``reason``; returns its path,
+        or None when rate-limited (``force`` bypasses — the watchdog's
+        abort path must never lose its last dump).  Never raises: a
+        full disk must not turn a brown-out into a crash."""
+        with self._dump_lock:   # dumps are rare: serialize whole-hog
+            now = time.monotonic()
+            if not force and now - self._last_dump \
+                    < self.cfg.min_interval_s:
+                return None
+            ops = self.snapshot_ops()
+            doc = {
+                "reason": reason,
+                "ts": time.time(),
+                "pid": os.getpid(),
+                "n_ops": len(ops),
+                "latency_us_p50": self._lat.percentile(50),
+                "latency_us_p99": self._lat.percentile(99),
+                "ops": ops,
+            }
+            if extra:
+                doc["extra"] = dict(extra)
+            if self.stats is not None:
+                try:
+                    doc["stats"] = self.stats.snapshot()
+                except Exception:
+                    pass
+            safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                           for c in reason)[:48]
+            path = os.path.join(self._dump_dir(),
+                                f"strom_flight_{os.getpid()}_{safe}_"
+                                f"{self.dumps + 1}.json")
+            try:
+                _atomic_write_text(path, json.dumps(doc))
+            except OSError:
+                # nothing was published: do NOT burn the rate-limit
+                # window — the next trigger (a ring restart typically
+                # follows a trip within seconds) must still get to
+                # write the incident's FIRST usable post-mortem
+                return None
+            self._last_dump = now
+            self.dumps += 1
+        if self.stats is not None:
+            self.stats.add(flight_dumps=1)
+        self.dump_paths.append(path)
+        del self.dump_paths[:-16]
+        return path
+
+
+def flight_of(engine) -> Optional[FlightRecorder]:
+    """The recorder behind any engine-shaped object (wrapper chains
+    delegate attribute access); None when disabled or absent."""
+    return getattr(engine, "flight", None)

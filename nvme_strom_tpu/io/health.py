@@ -138,7 +138,7 @@ class DegradedRead:
     an engine read (``wait_exact`` raises identically)."""
 
     __slots__ = ("_engine", "fh", "offset", "_length", "_stats",
-                 "_view", "_released")
+                 "_view", "_released", "_ctx")
 
     #: the payload rode the page cache — fallback semantics, honestly
     was_fallback = True
@@ -152,6 +152,16 @@ class DegradedRead:
         self._stats = stats
         self._view: Optional[np.ndarray] = None
         self._released = False
+        #: causal identity, captured at construction (the pread runs at
+        #: wait() time, possibly on another thread) — degraded service
+        #: must stay visible in a request's trace tree, and an
+        #: out-of-scope read must stay OUT of whatever request happens
+        #: to be current on the waiting thread (NO_CONTEXT default)
+        from nvme_strom_tpu.utils.trace import NO_CONTEXT, attach_context
+        self._ctx = NO_CONTEXT
+        tracer = getattr(base_engine, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            self._ctx = attach_context()
 
     @property
     def length(self) -> int:
@@ -160,10 +170,22 @@ class DegradedRead:
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         del timeout   # synchronous: the pread happens here, bounded by I/O
         if self._view is None:
+            t0 = time.monotonic_ns()
             self._view = self._engine.read_buffered(
                 self.fh, self.offset, self._length)
+            t1 = time.monotonic_ns()
             if self._stats is not None:
                 self._stats.add(degraded_bytes=int(self._view.nbytes))
+            tracer = getattr(self._engine, "tracer", None)
+            if tracer is not None and tracer.enabled:
+                tracer.add_span("strom.read.degraded", t0, t1,
+                                category="strom.health", ctx=self._ctx,
+                                bytes=int(self._view.nbytes))
+            flight = getattr(self._engine, "flight", None)
+            if flight is not None:
+                flight.record("read", None, -1, self.fh, self.offset,
+                              int(self._view.nbytes),
+                              max(0, t1 - t0) // 1000, "degraded")
         return self._view
 
     def is_ready(self) -> bool:
@@ -335,12 +357,21 @@ class EngineSupervisor:
             self._maybe_probe(eng, [(fh, off, ln)],
                               getattr(eng, "stats", None))
 
+    def _flight_dump(self, reason: str, **extra) -> None:
+        """Post-mortem trigger (io/flightrec.py): capture the recent-op
+        ring at the moment a failure-domain verdict lands."""
+        flight = getattr(self._engine, "flight", None)
+        if flight is not None:
+            flight.dump(reason, extra=extra or None)
+
     def _trip_ring(self, ring: int, now: float, stats) -> None:
         rb = self.rings[ring]
         rb.state = OPEN
         rb.opened_at = now
         if stats is not None:
             stats.add(breaker_trips=1)
+        self._flight_dump("breaker_trip", ring=ring,
+                          window_errors=rb.window.count(now))
         # all rings open == no healthy failure domain left: that IS the
         # device verdict, decided here atomically so the scheduler can
         # never face an all-masked ring set outside degraded mode
@@ -353,6 +384,7 @@ class EngineSupervisor:
         open (an undrainable ring is the degraded path's problem)."""
         rb = self.rings[ring]
         rb.last_restart = now
+        t0 = time.monotonic_ns()
         try:
             cancelled = self._engine.ring_restart(ring, self.cfg.drain_s)
         except TimeoutError:
@@ -364,6 +396,14 @@ class EngineSupervisor:
             stats.add(ring_restarts=1,
                       **({"extents_requeued": cancelled}
                          if cancelled else {}))
+        tracer = getattr(self._engine, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            tracer.add_span("strom.health.ring_restart", t0,
+                            time.monotonic_ns(),
+                            category="strom.health", ring=ring,
+                            cancelled=cancelled)
+        self._flight_dump("ring_restart", ring=ring,
+                          cancelled=cancelled)
         rb.window.clear()
         rb.state = HALF_OPEN
         rb.half_open_at = time.monotonic()
@@ -380,6 +420,8 @@ class EngineSupervisor:
             self._next_probe = now + self.cfg.probe_s
             if stats is not None:
                 stats.add(breaker_trips=1)   # the device breaker's trip
+            self._flight_dump("device_degraded",
+                              device_errors=self.device_window.count(now))
             self._export_gauges(stats)
 
     def _recover(self, stats) -> None:
@@ -473,6 +515,7 @@ class EngineSupervisor:
             engine = engine._engine
         ok = False
         pending = None
+        t0 = time.monotonic_ns()
         try:
             pending = engine.submit_read(fh, off,
                                          min(ln, _PROBE_BYTES))
@@ -506,6 +549,12 @@ class EngineSupervisor:
                     pass
         if stats is not None:
             stats.add(degraded_probes=1)
+        tracer = getattr(self._engine, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            tracer.add_span("strom.health.probe", t0,
+                            time.monotonic_ns(),
+                            category="strom.health", fh=fh, offset=off,
+                            ok=ok)
         if ok:
             self._recover(stats)
         return ok

@@ -227,11 +227,12 @@ class _FillOnWait:
     the view through untouched.  A cache failure never fails the read."""
 
     __slots__ = ("_pending", "_cache", "_fkey", "_off", "_keys",
-                 "_klass", "_stats", "_filled", "_sticky")
+                 "_klass", "_stats", "_filled", "_sticky", "_tracer",
+                 "_ctx")
 
     def __init__(self, pending, cache: "HostCache", fkey: tuple,
                  span_off: int, keys: Dict[LineKey, int], klass, stats,
-                 sticky: bool = False):
+                 sticky: bool = False, tracer=None):
         self._pending = pending
         self._cache = cache
         self._fkey = fkey
@@ -241,6 +242,14 @@ class _FillOnWait:
         self._stats = stats
         self._filled = False
         self._sticky = sticky
+        #: fill-span sink + causal identity, captured at construction —
+        #: the fill runs at wait() time, possibly on another thread
+        self._tracer = tracer if (tracer is not None
+                                  and tracer.enabled) else None
+        self._ctx = None
+        if self._tracer is not None:
+            from nvme_strom_tpu.utils.trace import attach_context
+            self._ctx = attach_context()
 
     @property
     def length(self) -> int:
@@ -262,6 +271,8 @@ class _FillOnWait:
         view = self._pending.wait(timeout)
         if not self._filled:
             self._filled = True
+            import time as _time
+            t0 = _time.monotonic_ns()
             try:
                 self._cache.fill_from_view(self._fkey, self._off, view,
                                            self._keys, self._klass,
@@ -269,6 +280,12 @@ class _FillOnWait:
                                            sticky=self._sticky)
             except Exception:
                 pass   # the tier is an accelerator, never a failure mode
+            if self._tracer is not None:
+                self._tracer.add_span(
+                    "strom.cache.fill", t0, _time.monotonic_ns(),
+                    category="strom.cache", ctx=self._ctx,
+                    lines=len(self._keys), bytes=int(view.nbytes),
+                    klass=self._klass)
         return view
 
     def is_ready(self) -> bool:

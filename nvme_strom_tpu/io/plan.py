@@ -503,6 +503,9 @@ def _plan_and_submit_tiered(cache, engine, extents, *, gap, chunk_bytes,
     from nvme_strom_tpu.io.hostcache import (CacheHitRead, _FillOnWait,
                                              file_key_of)
     stats = getattr(engine, "stats", None)
+    tracer = getattr(engine, "tracer", None)
+    if tracer is not None and not tracer.enabled:
+        tracer = None
     for i, (_fh, _off, ln) in enumerate(extents):
         if ln < 0:   # validate BEFORE probing: probes pin cache lines
             raise ValueError(f"extent {i}: negative length {ln}")
@@ -542,9 +545,11 @@ def _plan_and_submit_tiered(cache, engine, extents, *, gap, chunk_bytes,
         keys = (_fill_keys_for_span(cache, fkey, admitted, s_off, s_ln)
                 if fkey is not None and admitted else {})
         wrapped.append(_FillOnWait(p, cache, fkey, s_off, keys, klass,
-                                   stats, sticky=hot) if keys else p)
+                                   stats, sticky=hot, tracer=tracer)
+                       if keys else p)
     shared = _share_spans(wrapped, plan.placements)
     out: List[List[SpanView]] = []
+    hit_bytes = hit_count = 0
     mi = 0
     for (fh, _off, ln), segs in zip(extents, segs_all):
         pieces_out: list = []
@@ -554,12 +559,23 @@ def _plan_and_submit_tiered(cache, engine, extents, *, gap, chunk_bytes,
                 rel = a - line.key[1]
                 pieces_out.append(CacheHitRead(cache, line, rel,
                                                rel + sl, fh, a))
+                hit_bytes += sl
+                hit_count += 1
             else:
                 _, a, _sl = s
                 pieces_out.extend(_views_for(shared,
                                              plan.placements[mi], fh, a))
                 mi += 1
         out.append(pieces_out)
+    if tracer is not None and hit_count:
+        # one aggregate span per probed batch (per-line spans would
+        # dominate the trace on a hot run): the DRAM-served portion of
+        # this batch, causally under the requester
+        import time as _time
+        now = _time.monotonic_ns()
+        tracer.add_span("strom.cache.hit", now, now,
+                        category="strom.cache", klass=klass,
+                        hits=hit_count, bytes=hit_bytes)
     if stats is not None and plan.spans_coalesced:
         stats.add(spans_coalesced=plan.spans_coalesced)
     return out
@@ -582,11 +598,15 @@ def submit_spans_tiered(engine, spans: Sequence[Tuple[int, int, int]],
     from nvme_strom_tpu.io.hostcache import (CacheHitRead, _FillOnWait,
                                              file_key_of)
     stats = getattr(engine, "stats", None)
+    tracer = getattr(engine, "tracer", None)
+    if tracer is not None and not tracer.enabled:
+        tracer = None
     spans = list(spans)
     out: list = [None] * len(spans)
     miss: list = []
     meta: list = []    # (out index, fkey, admitted keys)
     fkeys: dict = {}
+    hit_bytes = hit_count = 0
     for i, (fh, off, ln) in enumerate(spans):
         if fh not in fkeys:
             fkeys[fh] = file_key_of(engine, fh)
@@ -598,9 +618,17 @@ def submit_spans_tiered(engine, spans: Sequence[Tuple[int, int, int]],
         if line is not None:
             rel = off - line.key[1]
             out[i] = CacheHitRead(cache, line, rel, rel + ln, fh, off)
+            hit_bytes += ln
+            hit_count += 1
         else:
             miss.append((fh, off, ln))
             meta.append((i, fkey, adm))
+    if tracer is not None and hit_count:
+        import time as _time
+        now = _time.monotonic_ns()
+        tracer.add_span("strom.cache.hit", now, now,
+                        category="strom.cache", klass=klass,
+                        hits=hit_count, bytes=hit_bytes)
     try:
         pendings = submit_spans(engine, miss, klass=klass)
     except BaseException:
@@ -613,5 +641,5 @@ def submit_spans_tiered(engine, spans: Sequence[Tuple[int, int, int]],
         keys = (_fill_keys_for_span(cache, fkey, adm, off, ln)
                 if fkey is not None and adm else {})
         out[i] = _FillOnWait(p, cache, fkey, off, keys, klass,
-                             stats) if keys else p
+                             stats, tracer=tracer) if keys else p
     return out

@@ -119,6 +119,13 @@ class ResilientRead:
         #: retry/backoff/hedge policy) and charges its hedge budget
         self._klass = klass
         self._cfg = engine.config_for(klass)
+        #: causal identity for recovery spans (hedge/retry may fire from
+        #: a wait() on another thread/context — capture at submit)
+        self._ctx = None
+        tracer = getattr(engine._engine, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            from nvme_strom_tpu.utils.trace import current_context
+            self._ctx = current_context()
         self._primary = _Attempt(pending, time.monotonic())
         self._hedge: Optional[_Attempt] = None
         self._hedge_token = False    # class hedge-budget token held
@@ -265,7 +272,8 @@ class ResilientRead:
                         eng.stats.add_class_stat(self._klass,
                                                  hedges_won=1)
                     eng._trace("strom.resilient.hedge_won",
-                               int(self._hedge.t0 * 1e9), fh=self._fh,
+                               int(self._hedge.t0 * 1e9),
+                               ctx=self._ctx, fh=self._fh,
                                offset=self._offset)
                     # the straggler primary may run for a while yet:
                     # release() would BLOCK until its I/O lands, erasing
@@ -294,7 +302,8 @@ class ResilientRead:
             return None
         try:
             pending = eng._engine.submit_read(self._fh, self._offset,
-                                              self._length)
+                                              self._length,
+                                              klass=self._klass)
         except OSError:
             # a hedge that cannot even submit (pool teardown, routing
             # refusal) must neither fail the read NOR strand the token:
@@ -308,7 +317,8 @@ class ResilientRead:
         if self._klass:
             eng.stats.add_class_stat(self._klass, hedges_issued=1)
         eng._trace("strom.resilient.hedge", time.monotonic_ns(),
-                   fh=self._fh, offset=self._offset, length=self._length)
+                   ctx=self._ctx, fh=self._fh, offset=self._offset,
+                   length=self._length)
         return _Attempt(pending, time.monotonic())
 
     def _drop_hedge(self) -> None:
@@ -372,14 +382,15 @@ class ResilientRead:
                                      getattr(eng, "stats", None),
                                      probe_engine=eng._engine),
                 time.monotonic())
-            eng._trace("strom.resilient.retry", t0, fh=self._fh,
-                       offset=self._offset, attempt=self._retries,
-                       stuck=stuck, degraded=True,
+            eng._trace("strom.resilient.retry", t0, ctx=self._ctx,
+                       fh=self._fh, offset=self._offset,
+                       attempt=self._retries, stuck=stuck, degraded=True,
                        error=self._attempts[-1]["error"])
             return
         try:
             pending = eng._engine.submit_read(self._fh, self._offset,
-                                              self._length)
+                                              self._length,
+                                              klass=self._klass)
         except OSError as e:
             # the RESUBMISSION itself failed (engine teardown, pool
             # refusal): every prior attempt is already released/parked —
@@ -395,9 +406,10 @@ class ResilientRead:
                 f"{self._retries} retries: {e} "
                 f"(history: {self._attempts})", self._attempts) from e
         self._primary = _Attempt(pending, time.monotonic())
-        eng._trace("strom.resilient.retry", t0, fh=self._fh,
-                   offset=self._offset, attempt=self._retries,
-                   stuck=stuck, error=self._attempts[-1]["error"])
+        eng._trace("strom.resilient.retry", t0, ctx=self._ctx,
+                   fh=self._fh, offset=self._offset,
+                   attempt=self._retries, stuck=stuck,
+                   error=self._attempts[-1]["error"])
 
     def _release_attempts(self) -> None:
         """Hand every outstanding attempt back — DEFERRED for attempts
@@ -746,7 +758,8 @@ class ResilientEngine:
     def submit_read(self, fh: int, offset: int, length: int,
                     klass: Optional[str] = None) -> ResilientRead:
         self._reap_zombies()   # lost hedges hand buffers back here
-        pending = self._engine.submit_read(fh, offset, length)
+        pending = self._engine.submit_read(fh, offset, length,
+                                           klass=klass)
         # size AFTER submit: the C engine re-fstats the file at every
         # submit, so this reflects writes since open() (a size cached at
         # open time would make short-read detection silently inert on
@@ -833,9 +846,17 @@ class ResilientEngine:
         self._hedge_cache[klass] = (now, val)
         return val
 
-    def _trace(self, name: str, t0_ns: int, **args) -> None:
+    def _trace(self, name: str, t0_ns: int, ctx=None, **args) -> None:
+        from nvme_strom_tpu.utils.trace import NO_CONTEXT
         tracer = getattr(self._engine, "tracer", None)
         if tracer is None or not tracer.enabled:
             return
+        if ctx is not None and ctx is not NO_CONTEXT:
+            ctx = ctx.child()   # ctx is the PARENT here (the submit-
+            #                     time context the read captured)
+        elif ctx is None:
+            # a recovery span may fire from a wait() on another
+            # request's thread: never auto-adopt that thread's context
+            ctx = NO_CONTEXT
         tracer.add_span(name, int(t0_ns), time.monotonic_ns(),
-                        category="strom.resilient", **args)
+                        category="strom.resilient", ctx=ctx, **args)

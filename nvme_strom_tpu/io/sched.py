@@ -128,9 +128,9 @@ class _Batch:
     """One planned batch queued for a dispatch grant."""
 
     __slots__ = ("spans", "klass", "rounds", "granted", "ring",
-                 "promoted", "t_enq")
+                 "promoted", "t_enq", "t_enq_ns", "ctx")
 
-    def __init__(self, spans, klass: str):
+    def __init__(self, spans, klass: str, ctx=None):
         self.spans = spans
         self.klass = klass
         self.rounds = 0          # dispatch rounds survived ungranted
@@ -138,6 +138,11 @@ class _Batch:
         self.ring: Optional[int] = None
         self.promoted = False    # granted via the aging bound
         self.t_enq = time.monotonic()
+        self.t_enq_ns = time.monotonic_ns()
+        #: requester's TraceContext, captured at enqueue — the grant may
+        #: run on ANOTHER thread's dispatch round, so the queue-wait
+        #: span carries its causal identity explicitly
+        self.ctx = ctx
 
 
 class QoSScheduler:
@@ -159,7 +164,7 @@ class QoSScheduler:
                  ring_free: Callable[[], List[int]],
                  policies: Optional[Dict[str, ClassPolicy]] = None,
                  aging_rounds: int = 16, stats=None,
-                 ring_cap: Optional[int] = None):
+                 ring_cap: Optional[int] = None, tracer=None):
         if aging_rounds < 1:
             raise ValueError("aging_rounds must be >= 1")
         self._submit_ring = submit_ring
@@ -167,6 +172,9 @@ class QoSScheduler:
         self.policies = policies or default_policies()
         self.aging_rounds = aging_rounds
         self.stats = stats
+        #: span sink for queue-wait attribution (strom.sched.queue);
+        #: None = no tracing overhead on dispatch
+        self.tracer = tracer
         #: per-ring admission budget (what a fully idle ring reports
         #: free) — lets the urgent-ring rule tell "ring 0 is idle" from
         #: "every ring is equally saturated"
@@ -194,7 +202,14 @@ class QoSScheduler:
         production path)."""
         if klass not in self.policies:
             klass = DEFAULT_CLASS
-        b = _Batch(list(spans), klass)
+        # NO_CONTEXT, not None, when untraced/out-of-scope: the grant
+        # may run on ANOTHER request's thread, and ctx=None at emit
+        # would auto-adopt that request's context (mis-attribution)
+        from nvme_strom_tpu.utils.trace import NO_CONTEXT, attach_context
+        ctx = NO_CONTEXT
+        if self.tracer is not None and self.tracer.enabled:
+            ctx = attach_context()
+        b = _Batch(list(spans), klass, ctx=ctx)
         with self._cv:
             if self._closed:
                 raise OSError(errno.ECANCELED,
@@ -232,7 +247,13 @@ class QoSScheduler:
                 # grant by another thread's round notifies immediately
                 self._cv.wait(timeout=self._POLL_S)
         try:
-            return self._submit_ring(b.spans, b.ring)
+            out = self._submit_ring(b.spans, b.ring)
+            for p in out:
+                try:
+                    p.op_klass = b.klass   # flight-recorder attribution
+                except AttributeError:
+                    break   # injected test double without a __dict__
+            return out
         finally:
             self.ack_submitted(b)
 
@@ -409,6 +430,13 @@ class QoSScheduler:
         self.dispatches += 1
         if promoted:
             self.promotions += 1
+        if self.tracer is not None and self.tracer.enabled:
+            # the scheduler-queue wait this batch paid, causally under
+            # the requester's span (b.ctx captured at enqueue)
+            self.tracer.add_span(
+                "strom.sched.queue", b.t_enq_ns, time.monotonic_ns(),
+                category="strom.sched", ctx=b.ctx, klass=b.klass,
+                ring=ring, spans=len(b.spans), promoted=promoted)
         if self.stats is not None:
             wait_s = time.monotonic() - b.t_enq
             self.stats.add(sched_dispatches=1,

@@ -880,6 +880,16 @@ class SloGovernor:
                 pass
         if step > 0 and stats is not None:
             stats.add(kv_slo_boosts=1)
+        if step > 0:
+            # SLO violation: capture the op ring NOW — the post-mortem
+            # wants the reads that blew the p99, not the recovered
+            # steady state an hour later (io/flightrec.py)
+            flight = getattr(engine, "flight", None)
+            if flight is not None:
+                flight.dump("slo_violation",
+                            extra={"p99_ms": p99_ms,
+                                   "target_ms": self.target_ms,
+                                   "boost": self.boost})
 
 
 class PrefixStore:
@@ -1037,6 +1047,10 @@ class PrefixStore:
         from nvme_strom_tpu.io.plan import plan_and_submit
         out: Dict[object, Dict[int, tuple]] = {}
         failed: list = []
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is not None and not tracer.enabled:
+            tracer = None
+        t0_ns = _time.monotonic_ns()
         t0 = _time.monotonic()
         try:
             # a failed eviction WRITE surfacing here must degrade to
@@ -1099,6 +1113,14 @@ class PrefixStore:
                     self._unpin_locked(e)
         elapsed_us = max(1, int((_time.monotonic() - t0) * 1e6))
         n_ok = sum(len(v) for v in out.values())
+        if tracer is not None:
+            # the store's own restore span (NVMe read + page assembly +
+            # verify), a child of the serving kv_restore scope
+            tracer.add_span("strom.kv.restore", t0_ns,
+                            _time.monotonic_ns(), category="strom.kv",
+                            pages=len(plan), ok=n_ok,
+                            failed=len(failed),
+                            bytes=len(plan) * self.page_bytes)
         with self._lock:
             # hist[i] counts [2^i, 2^(i+1)) — the same convention as
             # percentiles_from_log2_hist and the engine's histogram.

@@ -60,6 +60,13 @@ class _Request:
     t_submit: float = 0.0
     t_admit: float = 0.0
     t_first: Optional[float] = None
+    # request-scoped causal trace (utils/trace.py TraceContext,
+    # docs/OBSERVABILITY.md): the ROOT of this request's span tree,
+    # created at submit when tracing is on — admission, KV restore,
+    # scheduler queue wait, cache hit/fill, and engine I/O all
+    # correlate under its trace_id
+    trace: object = None
+    t_submit_ns: int = 0
 
 
 @jax.jit
@@ -331,11 +338,17 @@ class DecodeServer:
         if rid in in_flight:
             # results key on rid — a duplicate would silently clobber
             raise ValueError(f"request id {rid!r} already in flight")
-        self.queue.append(_Request(rid, list(prompt_ids), max_new,
-                                   eos_id, temperature=temperature,
-                                   top_p=top_p,
-                                   seed=seed & 0xFFFFFFFF,
-                                   t_submit=time.monotonic()))
+        req = _Request(rid, list(prompt_ids), max_new,
+                       eos_id, temperature=temperature,
+                       top_p=top_p,
+                       seed=seed & 0xFFFFFFFF,
+                       t_submit=time.monotonic())
+        tracer = self._tracer()
+        if tracer is not None:
+            from nvme_strom_tpu.utils.trace import TraceContext
+            req.trace = TraceContext.new()
+            req.t_submit_ns = time.monotonic_ns()
+        self.queue.append(req)
 
     # -- admission (plan / restore / finish) ------------------------------
     #
@@ -348,9 +361,46 @@ class DecodeServer:
     # runs between, and the FINISH phase prefills/scatters.  With no
     # store attached the two halves compose to the old _admit verbatim.
 
+    def _tracer(self):
+        """The span sink of this server: the KV-store engine's tracer
+        when a store is attached (one file for the whole stack), else
+        the global tracer — None when tracing is off, so every call
+        site stays one cheap check."""
+        store = self.kv_store
+        tracer = (getattr(getattr(store, "engine", None), "tracer",
+                          None) if store is not None else None)
+        if tracer is None:
+            from nvme_strom_tpu.utils.trace import global_tracer
+            tracer = global_tracer
+        return tracer if tracer.enabled else None
+
     def _admit(self, slot: int, req: _Request) -> None:
         """Single-request admission (compat path; step_many batches)."""
-        self._admit_finish(self._admit_plan(slot, req), {})
+        self._finish_traced(self._admit_plan(slot, req), {})
+
+    def _finish_traced(self, plan: dict, restored: dict) -> None:
+        """``_admit_finish`` under the request's trace scope: the
+        admission span (prefill + scatter) lands in the request's tree,
+        and everything the finish triggers — store puts, engine writes
+        — auto-parents to it via the contextvar."""
+        tracer = self._tracer()
+        req = plan["req"]
+        if tracer is None or req.trace is None:
+            self._admit_finish(plan, restored)
+            return
+        from nvme_strom_tpu.utils.trace import use_context
+        ctx = req.trace.child()
+        t0 = time.monotonic_ns()
+        with use_context(ctx):
+            self._admit_finish(plan, restored)
+        tracer.add_span("strom.serve.admit", t0, time.monotonic_ns(),
+                        category="strom.serve", ctx=ctx,
+                        rid=str(req.rid), slot=plan["slot"],
+                        prompt_tokens=len(req.prompt),
+                        restored_pages=len(restored),
+                        queue_wait_ms=round(
+                            1000.0 * (time.monotonic() - req.t_submit),
+                            3))
 
     def _admit_plan(self, slot: int, req: _Request) -> dict:
         """Capacity decisions only — nothing is prefilled yet."""
@@ -398,7 +448,27 @@ class DecodeServer:
             store.stats.add(kv_prefix_misses=misses)
         if not wants:
             return {}
-        return store.restore_many(wants)
+        tracer = self._tracer()
+        if tracer is None:
+            return store.restore_many(wants)
+        # ONE batched restore serves several admitting requests: scope
+        # it under the FIRST participating request's tree (the single-
+        # request case — the acceptance walkthrough — is exact) and
+        # name every trace id so a multi-request step stays attributable
+        from nvme_strom_tpu.utils.trace import use_context
+        by_slot = {p["slot"]: p["req"] for p in plans}
+        traced = [by_slot[s].trace for s in wants
+                  if by_slot[s].trace is not None]
+        ctx = traced[0].child() if traced else None
+        t0 = time.monotonic_ns()
+        with use_context(ctx):
+            restored = store.restore_many(wants)
+        tracer.add_span(
+            "strom.serve.kv_restore", t0, time.monotonic_ns(),
+            category="strom.serve", ctx=ctx, slots=len(wants),
+            pages=sum(len(k) for _s, k in wants.values()),
+            traces=[f"{t.trace_id:x}" for t in traced])
+        return restored
 
     def _contiguous_from(self, restored: dict, start: int) -> list:
         """The restored pages usable as a prefix extension: chain
@@ -534,6 +604,16 @@ class DecodeServer:
         ttft_ms = (1000.0 * (req.t_first - req.t_submit)
                    if req.t_first is not None else 0.0)
         wait_ms = 1000.0 * (req.t_admit - req.t_submit)
+        tracer = self._tracer()
+        if tracer is not None and req.trace is not None:
+            # the request's ROOT span, submit → retirement: the tree
+            # every admit/restore/queue/engine span hangs under
+            tracer.add_span("strom.serve.request", req.t_submit_ns,
+                            time.monotonic_ns(),
+                            category="strom.serve", ctx=req.trace,
+                            rid=str(req.rid), ttft_ms=round(ttft_ms, 3),
+                            admit_wait_ms=round(wait_ms, 3),
+                            tokens=len(req.out))
         self.request_metrics[req.rid] = {
             "ttft_ms": round(ttft_ms, 3),
             "admit_wait_ms": round(wait_ms, 3)}
@@ -667,7 +747,7 @@ class DecodeServer:
         restored = (self._restore_prefixes(plans)
                     if plans and self.kv_store is not None else {})
         for plan in plans:
-            self._admit_finish(plan, restored.get(plan["slot"], {}))
+            self._finish_traced(plan, restored.get(plan["slot"], {}))
         self.timings["admit_s"] += time.monotonic() - t0
         active_slots = [i for i, r in enumerate(self.slots)
                         if r is not None]

@@ -87,6 +87,24 @@ _HEALTH_COUNTERS = (
     "serve_admissions_shed",
 )
 
+#: observability-layer counters (utils/trace.py tracer drops +
+#: io/flightrec.py post-mortem dumps — docs/OBSERVABILITY.md); own
+#: block, shown only when either fired: dropped spans mean the trace
+#: is incomplete, a flight dump means a trigger captured a post-mortem
+_OBS_COUNTERS = (
+    "trace_spans_dropped", "flight_dumps",
+)
+
+#: every counter block above, in render order — the counter-drift CI
+#: check (tests/test_observability.py) asserts the union covers ALL of
+#: StromStats.COUNTER_FIELDS, so a new counter cannot silently vanish
+#: from the tooling
+ALL_COUNTER_BLOCKS = (
+    _COUNTERS, _RESILIENCE_COUNTERS, _INTEGRITY_COUNTERS,
+    _BATCH_COUNTERS, _SCHED_COUNTERS, _HOSTCACHE_COUNTERS,
+    _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
+)
+
 
 def render_device(path: str) -> str:
     """Backing-device topology of ``path`` — the observable form of the
@@ -262,6 +280,14 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
             lines.append(
                 "    CORRUPTION CAUGHT — scrub the namespace "
                 "(strom-scrub) before trusting older data")
+    if any(int(snap.get(n, 0)) for n in _OBS_COUNTERS):
+        lines.append("  observability (tracer / flight recorder):")
+        for name in _OBS_COUNTERS:
+            lines.append(f"    {name:<22} {int(snap.get(name, 0)):>14}")
+        if int(snap.get("trace_spans_dropped", 0)):
+            lines.append(
+                "    TRACE INCOMPLETE — the span buffer capped out; "
+                "raise STROM_TRACE_MAX_EVENTS or trace a shorter window")
     members = snap.get("member_bytes")
     if members:
         total = max(1, sum(members.values()))
@@ -290,6 +316,11 @@ def main(argv=None) -> int:
                     help="stats export file (default: $STROM_STATS_EXPORT)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="dump raw JSON instead of the table")
+    ap.add_argument("--prom", action="store_true", dest="as_prom",
+                    help="emit OpenMetrics/Prometheus text exposition "
+                         "instead of the table (counters as "
+                         "strom_*_total, class/ring/member labels; "
+                         "docs/OBSERVABILITY.md)")
     ap.add_argument("--watch", type=float, default=None, metavar="SECS",
                     help="re-read and print rates every SECS seconds")
     ap.add_argument("--device", metavar="PATH", default=None,
@@ -316,13 +347,22 @@ def main(argv=None) -> int:
         print(f"strom_stat: cannot read {args.path}: {e}", file=sys.stderr)
         return 2
 
+    def emit(s, prev=None, dt=None):
+        if args.as_prom:
+            from nvme_strom_tpu.utils.stats import \
+                openmetrics_from_snapshot
+            print(openmetrics_from_snapshot(s), end="")
+        elif args.as_json:
+            print(json.dumps(s, sort_keys=True))
+        else:
+            print(render(s, prev, dt))
+
     if args.watch is None:
-        print(json.dumps(snap, sort_keys=True) if args.as_json
-              else render(snap))
+        emit(snap)
         return 0
 
     prev, t_prev = snap, time.monotonic()
-    print(json.dumps(snap, sort_keys=True) if args.as_json else render(snap))
+    emit(snap)
     try:
         while True:
             time.sleep(args.watch)
@@ -331,9 +371,11 @@ def main(argv=None) -> int:
             except (OSError, json.JSONDecodeError):
                 continue
             now = time.monotonic()
-            print("---")
-            print(json.dumps(snap, sort_keys=True) if args.as_json
-                  else render(snap, prev, now - t_prev))
+            if not args.as_prom:
+                # '---' would corrupt an OpenMetrics stream; exposition
+                # records are already delimited by their '# EOF'
+                print("---")
+            emit(snap, prev, now - t_prev)
             prev, t_prev = snap, now
     except KeyboardInterrupt:
         return 0

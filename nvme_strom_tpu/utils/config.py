@@ -266,6 +266,48 @@ class BreakerConfig:
 
 
 @dataclass(frozen=True)
+class FlightConfig:
+    """Flight-recorder knobs (io/flightrec.py; semantics in
+    docs/OBSERVABILITY.md).
+
+    An always-on bounded ring buffer of recent per-op records (class,
+    ring, bytes, latency, outcome) that dumps itself to disk when a
+    failure trigger fires — breaker trip, ring restart, SLO violation,
+    watchdog stall — so the post-mortem starts with the exact ops that
+    preceded the event instead of aggregate counters.  STROM_*
+    environment variables are read at construction time, mirroring
+    EngineConfig.
+    """
+
+    #: master switch (STROM_FLIGHT=0 removes the recorder entirely:
+    #: no per-op record, no trigger dumps — the exact pre-recorder
+    #: engine)
+    enabled: bool = field(
+        default_factory=lambda: os.environ.get("STROM_FLIGHT",
+                                               "1") != "0")
+    #: ring-buffer capacity in op records (each ~100 B of Python tuple;
+    #: the default keeps the always-on footprint under ~1 MiB)
+    ops: int = field(
+        default_factory=lambda: _env_int("STROM_FLIGHT_OPS", 4096))
+    #: dump directory; empty = the system temp dir (dumps are named
+    #: strom_flight_<pid>_<reason>_<n>.json)
+    dir: str = field(
+        default_factory=lambda: os.environ.get("STROM_FLIGHT_DIR", ""))
+    #: min seconds between dumps — a flapping breaker must not bury the
+    #: disk in near-identical post-mortems (the FIRST dump of a burst
+    #: is the interesting one)
+    min_interval_s: float = field(
+        default_factory=lambda: _env_float("STROM_FLIGHT_MIN_S", 5.0))
+
+    def __post_init__(self):
+        if self.ops < 16:
+            raise ValueError(f"ops ({self.ops}) must be >= 16 — a "
+                             "post-mortem of 15 ops explains nothing")
+        if self.min_interval_s < 0:
+            raise ValueError("min_interval_s must be >= 0")
+
+
+@dataclass(frozen=True)
 class KVServeConfig:
     """Serving KV prefix-store knobs (models/kv_offload.py PrefixStore;
     semantics in docs/PERF.md §5).

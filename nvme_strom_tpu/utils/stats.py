@@ -20,6 +20,16 @@ Semantics of the byte counters:
   (fallback reads count; any Python-side copy counts; the direct path
   contributes zero).
 - ``bytes_to_device`` — bytes handed to the accelerator via the JAX bridge.
+
+Metrics registry (docs/OBSERVABILITY.md): beyond the flat counter block,
+this module carries the TYPED metric layer fleet tooling consumes —
+:class:`MCounter` / :class:`MGauge` / :class:`Log2Histogram` with label
+support (class, ring, tenant-ready) collected by a
+:class:`MetricsRegistry`, an OpenMetrics/Prometheus text exporter
+(:func:`openmetrics_from_snapshot`, served by ``strom_stat --prom``),
+an opt-in textfile writer (``STROM_METRICS_FILE``), and a periodic
+:class:`MetricsSnapshotter` so benches and fleet scrapers get TIME
+SERIES instead of one-shot dumps.
 """
 
 from __future__ import annotations
@@ -27,10 +37,12 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import math
 import os
 import threading
 import time
 from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
 
 #: Every public counter on StromStats, derived once from the dataclass —
 #: snapshot/reset/merge iterate this so a new counter needs exactly one edit.
@@ -170,6 +182,15 @@ class StromStats:
     # serving-side load shedding: prefill admissions deferred while the
     # engine reported degraded (requests wait queued; nothing fails)
     serve_admissions_shed: int = 0
+    # -- observability layer (utils/trace.py, io/flightrec.py,
+    # docs/OBSERVABILITY.md) ------------------------------------------------
+    # spans the tracer dropped at its in-memory cap (previously visible
+    # only in the exported file's metadata — a long run silently losing
+    # its tail must show in strom_stat)
+    trace_spans_dropped: int = 0
+    # flight-recorder post-mortem dumps written (breaker trip, ring
+    # restart, SLO violation, watchdog stall)
+    flight_dumps: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)
@@ -275,22 +296,23 @@ class StromStats:
         is atomic (rename) so readers never see a torn block.
         """
         path = os.environ.get("STROM_STATS_EXPORT")
-        if not path:
+        mpath = os.environ.get("STROM_METRICS_FILE")
+        if not path and not mpath:
             return
         snap = self.snapshot()
         snap["_exported_at"] = time.time()
         snap["_pid"] = os.getpid()
-        # pid+thread+sequence: two engines exporting concurrently must not
-        # share a temp file, or the rename publishes torn JSON.
-        tmp = (f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-               f".{next(_export_seq)}")
-        try:
-            with open(tmp, "w") as f:
-                json.dump(snap, f, sort_keys=True)
-            os.replace(tmp, path)
-        except OSError:
+        if path:
             try:
-                os.unlink(tmp)
+                _atomic_write_text(path, json.dumps(snap, sort_keys=True))
+            except OSError:
+                pass
+        # the OpenMetrics textfile rides the same sync points —
+        # INDEPENDENTLY of the JSON export, so setting only
+        # STROM_METRICS_FILE still gets every post-sync snapshot
+        if mpath:
+            try:
+                write_openmetrics_file(mpath, snap)
             except OSError:
                 pass
 
@@ -302,13 +324,21 @@ COUNTER_FIELDS = tuple(
 global_stats = StromStats()
 
 
+#: geometric mean of a [2^i, 2^(i+1)) bucket relative to its lower edge:
+#: sqrt(2^i * 2^(i+1)) = 2^i * sqrt(2) — the unbiased point estimate for
+#: log-uniform samples (the old 1.5 arithmetic midpoint systematically
+#: over-reported by ~6%)
+_LOG2_BUCKET_MEAN = math.sqrt(2.0)
+
+
 def percentiles_from_log2_hist(hist: list, ps=(50, 90, 99)) -> dict:
     """Approximate percentiles from a log2-bucketed histogram.
 
     ``hist[i]`` counts samples in [2^i, 2^(i+1)); each percentile reports
-    the geometric midpoint of the bucket the rank falls in (~±41% worst
-    case, plenty for latency triage). Returns {p: value} with value 0 when
-    the histogram is empty.
+    the bucket's GEOMETRIC MEAN (2^i·√2 — consistently, for every p):
+    at most a √2 multiplicative error against the exact sample, which
+    tests/test_stats.py pins against ground truth.  Returns {p: value}
+    with value 0 when the histogram is empty.
     """
     total = sum(hist)
     out = {}
@@ -322,7 +352,7 @@ def percentiles_from_log2_hist(hist: list, ps=(50, 90, 99)) -> dict:
         for i, c in enumerate(hist):
             acc += c
             if acc >= rank and c > 0:
-                val = int((2 ** i) * 1.5)
+                val = int((2 ** i) * _LOG2_BUCKET_MEAN)
                 break
         out[p] = val
     return out
@@ -335,3 +365,404 @@ def human_bytes(n: float) -> str:
             return f"{n:.2f} {unit}"
         n /= 1024
     return f"{n:.2f} TiB"
+
+
+# ---------------------------------------------------------------------------
+# Typed metrics registry (docs/OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+
+def _label_key(labelnames: Tuple[str, ...], labels: dict) -> tuple:
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} != declared {sorted(labelnames)}")
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+class _Metric:
+    """Shared shape of the typed metrics: a name, a help string, fixed
+    label names, and one value per label combination."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Tuple[str, ...] = ()):
+        if not name or not name.replace("_", "a").isalnum():
+            raise ValueError(f"metric name {name!r} must be [a-z0-9_]+")
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._values: Dict[tuple, float] = {}
+
+    def samples(self) -> List[Tuple[tuple, float]]:
+        with self._lock:
+            return sorted(self._values.items())
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(
+                _label_key(self.labelnames, labels), 0)
+
+
+class MCounter(_Metric):
+    """Monotone counter with labels: ``inc(n, ring="0", klass="decode")``.
+    (``M``-prefixed to keep the name clear of typing.Counter.)"""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError("counters only go up")
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + n
+
+
+class MGauge(_Metric):
+    """Point-in-time value with labels: ``set(v, ring="0")``."""
+
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values[key] = v
+
+
+class Log2Histogram:
+    """Log2-bucketed histogram: ``observe(v)`` lands v in bucket
+    ``floor(log2(v))`` — the same convention as the engine's native
+    latency histogram and :func:`percentiles_from_log2_hist`, so one
+    percentile walk serves both.  Thread-safe; O(1) observe."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", buckets: int = 40):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._counts = [0] * buckets
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        i = max(0, int(v).bit_length() - 1) if v >= 1 else 0
+        with self._lock:
+            self._counts[min(i, len(self._counts) - 1)] += 1
+            self._sum += v
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    def counts(self) -> List[int]:
+        with self._lock:
+            return list(self._counts)
+
+    def percentile(self, p: int) -> int:
+        return percentiles_from_log2_hist(self.counts(), ps=(p,))[p]
+
+    def samples(self):
+        """OpenMetrics histogram series: cumulative ``_bucket{le=2^i}``
+        rows plus ``_count``/``_sum``."""
+        with self._lock:
+            counts = list(self._counts)
+            hsum = self._sum
+        acc = 0
+        out = []
+        for i, c in enumerate(counts):
+            acc += c
+            if c:
+                out.append(((("le", str(float(2 ** (i + 1)))),), acc))
+        return out, acc, hsum
+
+
+class MetricsRegistry:
+    """A named collection of typed metrics; renders OpenMetrics text.
+
+    Fleet tooling registers here (the flight recorder does; per-tenant
+    serving metrics will), while the legacy flat :class:`StromStats`
+    block is bridged in at render time by
+    :func:`openmetrics_from_snapshot` — one exporter, two sources."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Tuple[str, ...] = ()) -> MCounter:
+        return self._register(MCounter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Tuple[str, ...] = ()) -> MGauge:
+        return self._register(MGauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: int = 40) -> Log2Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Log2Histogram(name, help, buckets)
+                self._metrics[name] = m
+            elif not isinstance(m, Log2Histogram):
+                raise ValueError(f"{name} already registered as {m.kind}")
+            return m
+
+    def _register(self, cls, name, help, labelnames):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help, labelnames)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(f"{name} already registered as {m.kind}")
+            return m
+
+    def metrics(self) -> list:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def render_openmetrics(self, eof: bool = True) -> str:
+        lines: List[str] = []
+        for m in self.metrics():
+            _render_family(lines, m)
+        if eof:
+            lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt_labels(pairs) -> str:
+    if not pairs:
+        return ""
+    body = ",".join(f'{k}="{_escape(v)}"' for k, v in pairs)
+    return "{" + body + "}"
+
+
+def _escape(v) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def _fmt_val(v) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _render_family(lines: List[str], m) -> None:
+    name = m.name
+    lines.append(f"# TYPE {name} {m.kind}")
+    if m.help:
+        lines.append(f"# HELP {name} {_escape(m.help)}")
+    if isinstance(m, Log2Histogram):
+        buckets, count, total = m.samples()
+        for pairs, v in buckets:
+            lines.append(f"{name}_bucket{_fmt_labels(pairs)} "
+                         f"{_fmt_val(v)}")
+        lines.append(f'{name}_bucket{{le="+Inf"}} {_fmt_val(count)}')
+        lines.append(f"{name}_count {_fmt_val(count)}")
+        lines.append(f"{name}_sum {_fmt_val(total)}")
+        return
+    suffix = "_total" if m.kind == "counter" else ""
+    samples = m.samples()
+    for key, v in samples:
+        pairs = tuple(zip(m.labelnames, key))
+        lines.append(f"{name}{suffix}{_fmt_labels(pairs)} {_fmt_val(v)}")
+    if not samples:
+        lines.append(f"{name}{suffix} 0")
+
+
+#: per-class counters in ``class_stats`` exported as counters; the
+#: running max/sum/n triplets class_stat_gauges maintains export as
+#: gauges (they reset with the block, not monotone across it)
+_CLASS_GAUGE_SUFFIXES = ("_max", "_sum", "_n")
+
+
+def openmetrics_from_snapshot(snap: dict) -> str:
+    """Render a :meth:`StromStats.snapshot` dict as OpenMetrics text —
+    the bridge that gives the flat counter block typed, labeled output:
+    counters → ``strom_<name>_total``, gauges → ``strom_<name>``,
+    ``class_stats`` → ``{class=...}`` labels, ``ring_depths``/
+    ``ring_health`` → ``{ring=...}``, ``member_bytes`` → ``{member=...}``
+    (served by ``strom_stat --prom`` and the ``STROM_METRICS_FILE``
+    textfile writer)."""
+    reg = MetricsRegistry()
+    for name in COUNTER_FIELDS:
+        c = reg.counter(f"strom_{name}", f"strom-io counter {name}")
+        c.inc(int(snap.get(name, 0)))
+    cls = snap.get("class_stats") or {}
+    names = sorted({n for blk in cls.values() for n in blk})
+    for n in names:
+        is_gauge = n.endswith(_CLASS_GAUGE_SUFFIXES)
+        m = (reg.gauge(f"strom_class_{n}",
+                       f"per-class gauge {n}", ("klass",)) if is_gauge
+             else reg.counter(f"strom_class_{n}",
+                              f"per-class counter {n}", ("klass",)))
+        for k, blk in sorted(cls.items()):
+            if n in blk:
+                (m.set if is_gauge else m.inc)(blk[n], klass=k)
+    depths = snap.get("ring_depths")
+    if depths:
+        g = reg.gauge("strom_ring_depth",
+                      "in-flight I/O per ring", ("ring",))
+        for i, d in enumerate(depths):
+            g.set(int(d), ring=i)
+    health = snap.get("ring_health")
+    if health:
+        g = reg.gauge("strom_ring_breaker_open",
+                      "1 while the ring's circuit breaker is not closed",
+                      ("ring", "state"))
+        for i, s in enumerate(health):
+            g.set(0 if s == "closed" else 1, ring=i, state=s)
+    members = snap.get("member_bytes")
+    if members:
+        g = reg.counter("strom_member_bytes",
+                        "payload bytes per raid member", ("member",))
+        for m_, v in sorted(members.items()):
+            g.inc(int(v), member=m_)
+    skip = (set(COUNTER_FIELDS)
+            | {"class_stats", "ring_depths", "ring_health",
+               "member_bytes"})
+    for name in sorted(snap):
+        if name in skip or name.startswith("_"):
+            continue
+        v = snap[name]
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            reg.gauge(f"strom_{name}",
+                      f"strom-io gauge {name}").set(v)
+    return reg.render_openmetrics()
+
+
+def _atomic_write_text(path: str, text: str) -> None:
+    """The ONE atomic-publish primitive for exporter files: write to a
+    unique temp (pid+thread+sequence — two engines exporting
+    concurrently must not share one, or the rename publishes torn
+    content), then rename; the temp is unlinked on failure.  Raises
+    OSError for callers that need to know; exporters swallow it."""
+    tmp = (f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+           f".{next(_export_seq)}")
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def write_openmetrics_file(path: str, snap: dict) -> None:
+    """Atomically write ``snap`` as OpenMetrics text (the
+    ``STROM_METRICS_FILE`` textfile-collector contract)."""
+    _atomic_write_text(path, openmetrics_from_snapshot(snap))
+
+
+class MetricsSnapshotter:
+    """Periodic snapshotter: every ``interval_s`` it snapshots a
+    StromStats block into an in-memory series (bounded) and, when
+    ``path`` is set, rewrites the OpenMetrics textfile — the time-series
+    half of the registry (bench.py emits the series; a fleet scraper
+    tails the file).  Daemon thread; ``close()`` (or the context
+    manager) takes a final snapshot so short runs never export empty."""
+
+    def __init__(self, stats: StromStats, interval_s: float = 10.0,
+                 path: Optional[str] = None, keep: int = 512,
+                 sync=None):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        self.stats = stats
+        self.interval_s = interval_s
+        self.path = path
+        self.keep = keep
+        #: optional callable run before each snapshot (an engine's
+        #: ``sync_stats`` — drains the C counters into the block).
+        #: Guarded by ``_sync_lock`` so :meth:`set_sync` (engine
+        #: teardown detaches here) can never race a drain against the
+        #: C handle being destroyed.
+        self._sync = sync
+        self._sync_lock = threading.Lock()
+        self.series: List[dict] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="strom-metrics")
+        self._thread.start()
+
+    def set_sync(self, sync) -> None:
+        """Attach/detach the pre-snapshot drain hook.  Blocks until any
+        in-flight drain finishes, so detaching before engine teardown
+        guarantees no snapshot is mid-``sync_stats`` when the C handle
+        dies."""
+        with self._sync_lock:
+            self._sync = sync
+
+    def detach_sync(self, sync) -> None:
+        """Compare-and-clear: detach ONLY when the current hook is
+        ``sync`` — a closing engine must not rip out a hook a LATER
+        live engine (sharing the same stats block) installed over its
+        own.  Same blocking guarantee as :meth:`set_sync`."""
+        with self._sync_lock:
+            if self._sync == sync:
+                self._sync = None
+
+    def snap_once(self) -> None:
+        """Take one snapshot now (the periodic thread calls this; bench
+        code calls it at pass boundaries for aligned series points)."""
+        with self._sync_lock:
+            if self._sync is not None:
+                try:
+                    self._sync()
+                except Exception:
+                    pass    # a dying engine must not kill the exporter
+        snap = self.stats.snapshot()
+        snap["_t"] = time.time()
+        self.series.append(snap)
+        if len(self.series) > self.keep:
+            del self.series[:len(self.series) - self.keep]
+        if self.path:
+            try:
+                write_openmetrics_file(self.path, snap)
+            except OSError:
+                pass
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.snap_once()
+
+    def close(self) -> None:
+        if not self._stop.is_set():
+            self._stop.set()
+            self._thread.join(timeout=5)
+            self.snap_once()    # final point: short runs export too
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_writer_lock = threading.Lock()
+_writer: Optional[MetricsSnapshotter] = None
+
+
+def maybe_start_metrics_writer(stats: StromStats,
+                               sync=None) -> Optional[MetricsSnapshotter]:
+    """Start the process-wide ``STROM_METRICS_FILE`` textfile writer
+    (interval ``STROM_METRICS_INTERVAL_S``, default 10 s) the first time
+    an engine comes up — the continuous-scrape counterpart of the
+    snapshot written at every ``maybe_export``.  No env → no thread."""
+    global _writer
+    path = os.environ.get("STROM_METRICS_FILE")
+    if not path:
+        return None
+    with _writer_lock:
+        if _writer is None:
+            try:
+                interval = float(os.environ.get(
+                    "STROM_METRICS_INTERVAL_S", 10.0))
+            except ValueError:
+                interval = 10.0
+            _writer = MetricsSnapshotter(stats, max(0.05, interval),
+                                         path=path, sync=sync)
+        return _writer

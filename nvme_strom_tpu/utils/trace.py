@@ -7,6 +7,18 @@ exports them as a Chrome ``traceEvents`` JSON file loadable in
 ``chrome://tracing`` / Perfetto — alongside ``jax.profiler`` traces, since
 both use CLOCK_MONOTONIC timestamps on Linux.
 
+Request-scoped CAUSAL tracing (docs/OBSERVABILITY.md): a
+:class:`TraceContext` — ``trace_id`` plus a span id — is created at a
+request boundary (serving admission, a bench pass), propagated through a
+``contextvars.ContextVar`` on the submitting thread, and explicitly
+attached to planned batches and pending reads that complete on OTHER
+threads.  Every span emitted while a context is current carries
+``args.trace`` / ``args.span`` / ``args.parent``, so one Perfetto load
+shows a request's whole NVMe→host→HBM causal tree: serving admission →
+KV restore → scheduler queue wait → hostcache hit/fill → engine I/O,
+correlated by trace_id.  With no current context nothing is attached —
+the pre-existing flat spans, byte for byte.
+
 Activation:
 - environment: ``STROM_TRACE=/path/out.trace.json`` — the global tracer
   enables itself and every engine/stream records into it; the file is
@@ -20,6 +32,9 @@ so spans reflect true I/O latency, not Python call timing.
 from __future__ import annotations
 
 import atexit
+import contextlib
+import contextvars
+import itertools
 import json
 import os
 import threading
@@ -29,16 +44,106 @@ from typing import Optional
 
 #: Default in-memory span cap; override per-tracer or with
 #: $STROM_TRACE_MAX_EVENTS.  When full, new spans are DROPPED and counted
-#: (exported as metadata) — an unbounded event list on a multi-hour run
-#: would otherwise grow to OOM.
+#: (``Tracer.dropped`` → the ``trace_spans_dropped`` StromStats counter
+#: and the exported file's metadata) — an unbounded event list on a
+#: multi-hour run would otherwise grow to OOM.
 DEFAULT_MAX_EVENTS = 1_000_000
+
+#: process-wide id stream shared by trace and span ids: unique within a
+#: process, which is the correlation domain (the export stamps pid)
+_ids = itertools.count(1)
+
+#: the current request's TraceContext on THIS thread/task (None = no
+#: request scope: spans stay flat, exactly the pre-causal behavior)
+_ctx_var: contextvars.ContextVar[Optional["TraceContext"]] = \
+    contextvars.ContextVar("strom_trace_ctx", default=None)
+
+
+class TraceContext:
+    """One node of a request's causal tree: ``trace_id`` names the
+    request, ``span_id`` this node, ``parent_id`` its parent (None at
+    the root).  Immutable; ``child()`` allocates the next node.
+
+    Two attachment conventions, used consistently across io/ and
+    models/ (docs/OBSERVABILITY.md):
+
+    - ``Tracer.add_span(..., ctx=c)`` — ``c`` IS the span's identity
+      (the caller already allocated it with ``.child()``).
+    - ``Tracer.add_span(...)`` with a context CURRENT on the thread —
+      the span auto-becomes a fresh child of the current context.
+    """
+
+    __slots__ = ("trace_id", "span_id", "parent_id")
+
+    def __init__(self, trace_id: int, span_id: int,
+                 parent_id: Optional[int] = None):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        """A fresh root context (one per request)."""
+        return cls(next(_ids), next(_ids), None)
+
+    def child(self) -> "TraceContext":
+        """A child node: same trace, new span id, parent = this span."""
+        return TraceContext(self.trace_id, next(_ids), self.span_id)
+
+    def args(self) -> dict:
+        """The correlation args stamped onto an exported span."""
+        out = {"trace": f"{self.trace_id:x}", "span": self.span_id}
+        if self.parent_id is not None:
+            out["parent"] = self.parent_id
+        return out
+
+    def __repr__(self) -> str:
+        return (f"TraceContext(trace={self.trace_id:x}, "
+                f"span={self.span_id}, parent={self.parent_id})")
+
+
+#: explicit "no causal scope" sentinel for cross-thread emit sites.
+#: ``add_span(ctx=None)`` means "auto-attach from the CURRENT thread's
+#: context" — but a span whose submit point had no scope must not
+#: inherit whatever unrelated request happens to be current on the
+#: thread that completes it.  ``attach_context()`` returns this instead
+#: of None so captured contexts always round-trip unambiguously.
+NO_CONTEXT = TraceContext(0, 0, None)
+
+
+def current_context() -> Optional[TraceContext]:
+    """The TraceContext current on this thread/task (None outside any
+    request scope)."""
+    return _ctx_var.get()
+
+
+@contextlib.contextmanager
+def use_context(ctx: Optional[TraceContext]):
+    """Make ``ctx`` current for the enclosed block (None = explicitly
+    no scope, shadowing an outer one)."""
+    token = _ctx_var.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _ctx_var.reset(token)
+
+
+def attach_context() -> TraceContext:
+    """The explicit-attachment helper for work that completes on another
+    thread (planned batches, pending reads): a child of the current
+    context, or :data:`NO_CONTEXT` outside any request scope — so the
+    later emit can never mis-inherit the COMPLETING thread's context.
+    The returned context is the future span's identity — pass it to
+    ``add_span(..., ctx=...)``."""
+    cur = _ctx_var.get()
+    return cur.child() if cur is not None else NO_CONTEXT
 
 
 class Tracer:
     """Thread-safe span recorder with chrome://tracing export."""
 
     def __init__(self, path: Optional[str] = None,
-                 max_events: Optional[int] = None):
+                 max_events: Optional[int] = None, stats=None):
         self._lock = threading.Lock()
         self._events: list[dict] = []
         self._path = path
@@ -46,6 +151,10 @@ class Tracer:
         self.max_events = max_events if max_events is not None else int(
             os.environ.get("STROM_TRACE_MAX_EVENTS", DEFAULT_MAX_EVENTS))
         self.dropped = 0
+        #: StromStats block charged ``trace_spans_dropped`` on drops
+        #: (None = the process-global block, resolved lazily so the
+        #: import graph stays acyclic)
+        self.stats = stats
         self._atexit_registered = False
         if self.enabled:
             self._register_atexit()
@@ -60,11 +169,32 @@ class Tracer:
         self.enabled = True
         self._register_atexit()
 
+    def disable(self) -> None:
+        """Stop recording AND exporting (the atexit hook becomes a
+        no-op) — for throwaway tracers in bench/test passes."""
+        self.enabled = False
+        self._path = None
+
     def add_span(self, name: str, begin_ns: int, end_ns: int,
-                 category: str = "strom", **args) -> None:
-        """Record a completed span [begin_ns, end_ns) (CLOCK_MONOTONIC)."""
+                 category: str = "strom",
+                 ctx: Optional[TraceContext] = None, **args) -> None:
+        """Record a completed span [begin_ns, end_ns) (CLOCK_MONOTONIC).
+
+        ``ctx``: the span's causal identity (see :class:`TraceContext`);
+        None auto-attaches a fresh child of the thread's current context
+        (nothing when no context is current); :data:`NO_CONTEXT` attaches
+        nothing regardless — the captured-at-submit "there was no scope"
+        verdict, immune to whatever is current on THIS thread."""
         if not self.enabled:
             return
+        if ctx is None:
+            cur = _ctx_var.get()
+            if cur is not None:
+                ctx = cur.child()
+        elif ctx is NO_CONTEXT:
+            ctx = None
+        if ctx is not None:
+            args = {**ctx.args(), **args}
         ev = {
             "name": name,
             "cat": category,
@@ -79,17 +209,32 @@ class Tracer:
         with self._lock:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
+                stats = self.stats
+                if stats is None:
+                    from nvme_strom_tpu.utils.stats import global_stats
+                    stats = self.stats = global_stats
+                stats.add(trace_spans_dropped=1)
                 return
             self._events.append(ev)
 
-    def span(self, name: str, category: str = "strom", **args):
+    def span(self, name: str, category: str = "strom",
+             ctx: Optional[TraceContext] = None, **args):
         """Context manager measuring a Python-side span with the same
-        clock the engine stamps I/O with (CLOCK_MONOTONIC)."""
-        return _SpanCtx(self, name, category, args)
+        clock the engine stamps I/O with (CLOCK_MONOTONIC).  While the
+        block runs, the span's OWN context is current on the thread, so
+        spans emitted inside become its children — the nesting that
+        builds the causal tree without threading ctx through every
+        call."""
+        return _SpanCtx(self, name, category, ctx, args)
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._events)
+
+    def events(self) -> list:
+        """A snapshot copy of the recorded events (tests, tooling)."""
+        with self._lock:
+            return list(self._events)
 
     def export(self, path: Optional[str] = None) -> Optional[str]:
         """Atomically write the trace file; returns the path (None if the
@@ -114,21 +259,65 @@ class Tracer:
 
 
 class _SpanCtx:
-    def __init__(self, tracer: Tracer, name: str, category: str, args: dict):
+    def __init__(self, tracer: Tracer, name: str, category: str,
+                 ctx: Optional[TraceContext], args: dict):
         self._tracer = tracer
         self._name = name
         self._cat = category
         self._args = args
         self._t0 = 0
+        self._ctx = ctx
+        self._token = None
 
     def __enter__(self):
         self._t0 = time.monotonic_ns()
+        if self._tracer.enabled:
+            if self._ctx is None:
+                cur = _ctx_var.get()
+                if cur is not None:
+                    self._ctx = cur.child()
+            if self._ctx is not None and self._ctx is not NO_CONTEXT:
+                self._token = _ctx_var.set(self._ctx)
         return self
 
     def __exit__(self, *exc):
+        if self._token is not None:
+            _ctx_var.reset(self._token)
+            self._token = None
         self._tracer.add_span(self._name, self._t0, time.monotonic_ns(),
-                              category=self._cat, **self._args)
+                              category=self._cat, ctx=self._ctx,
+                              **self._args)
         return False
+
+
+def connected_tree(events, trace_id: Optional[str] = None) -> bool:
+    """True when every causally-tagged event of ``trace_id`` (default:
+    the first tagged event's trace) forms ONE connected tree: every
+    span's parent is either absent (an emitted root), another tagged
+    span's id, or the SINGLE implicit root node every parentless chain
+    shares (a request whose root span has not been emitted yet still
+    forms one tree).  The acceptance check behind the e2e propagation
+    tests (and handy for ad-hoc triage)."""
+    tagged = [e.get("args", {}) for e in events
+              if e.get("args", {}).get("trace") is not None]
+    if trace_id is None:
+        if not tagged:
+            return False
+        trace_id = tagged[0]["trace"]
+    mine = [a for a in tagged if a["trace"] == trace_id]
+    if not mine:
+        return False
+    ids = {a["span"] for a in mine}
+    unresolved = {a["parent"] for a in mine
+                  if a.get("parent") is not None
+                  and a["parent"] not in ids}
+    roots = [a for a in mine if a.get("parent") is None]
+    # one tree: at most one root — emitted (parent None, all unresolved
+    # edges would then be a disconnect) or implicit (all unresolved
+    # parents name the SAME never-emitted node)
+    if roots:
+        return len(roots) == 1 and not unresolved
+    return len(unresolved) == 1
 
 
 global_tracer = Tracer(os.environ.get("STROM_TRACE") or None)

@@ -266,6 +266,27 @@ class StepWatchdog:
                       f"bytes_verified={s.bytes_verified} "
                       f"checksum_failures={s.checksum_failures}",
                       file=w, flush=True)
+                # observability tier: dropped spans mean the trace of
+                # THIS hang is incomplete; flight dumps mean a trigger
+                # already captured the op-level post-mortem
+                if s.trace_spans_dropped or s.flight_dumps:
+                    print(f"observability: "
+                          f"trace_spans_dropped={s.trace_spans_dropped} "
+                          f"flight_dumps={s.flight_dumps}",
+                          file=w, flush=True)
+                # a stalled step IS a flight-recorder trigger: dump the
+                # recent-op ring so the post-mortem names what was in
+                # flight when the deadline blew (force=True — the abort
+                # path must never rate-limit away its last evidence)
+                flight = getattr(eng, "flight", None)
+                if flight is not None:
+                    fpath = flight.dump(
+                        "watchdog_stall", force=True,
+                        extra={"label": label,
+                               "running_s": round(total, 3)})
+                    if fpath:
+                        print(f"flight recorder: dumped {fpath}",
+                              file=w, flush=True)
             except Exception as e:       # diagnosis must not crash the job
                 print(f"engine stats unavailable: {e}", file=w,
                       flush=True)

@@ -107,9 +107,12 @@ def test_latency_gauges_exported(tmp_data_file, tmp_path, monkeypatch):
     assert snap["lat_read_p99_us"] >= snap["lat_read_p50_us"]
 
 
+_GM = 2 ** 0.5   # per-bucket geometric mean factor (utils/stats.py)
+
+
 @pytest.mark.parametrize("hist,expect", [
     ([0] * 64, {50: 0, 90: 0, 99: 0}),
-    ([0, 0, 4], {50: int(4 * 1.5), 90: int(4 * 1.5), 99: int(4 * 1.5)}),
+    ([0, 0, 4], {50: int(4 * _GM), 90: int(4 * _GM), 99: int(4 * _GM)}),
 ])
 def test_percentiles_from_log2_hist(hist, expect):
     assert percentiles_from_log2_hist(hist, ps=(50, 90, 99)) == expect
@@ -120,5 +123,5 @@ def test_percentiles_spread():
     hist[10] = 90   # 90 fast requests ~1µs
     hist[20] = 10   # 10 slow ~1ms
     pct = percentiles_from_log2_hist(hist, ps=(50, 99))
-    assert pct[50] == int(2 ** 10 * 1.5)
-    assert pct[99] == int(2 ** 20 * 1.5)
+    assert pct[50] == int(2 ** 10 * _GM)
+    assert pct[99] == int(2 ** 20 * _GM)
