@@ -766,6 +766,155 @@ def bench_kvserve(path: str) -> dict:
     }
 
 
+def bench_overlap(path: str) -> dict:
+    """Zero-copy overlap scenario (docs/PERF.md §6) — the two claims of
+    the registered-files/SQPOLL/arena/double-buffering arc, measured:
+
+    (a) **overlapped vs serialized streaming.**  The same chunk ranges
+        stream through ``DeviceStream`` twice: once serialized (each
+        chunk's host→device hop completes before the next chunk's
+        pipeline slot frees — the pre-overlap ordering) and once
+        through the double-buffered slab stage (the hop of chunk K
+        overlaps the NVMe read of chunk K+1).  On a box whose
+        "device" is the CPU fallback, ``device_put`` is a DRAM memcpy
+        far faster than the SSD — nothing to overlap — so the hop is
+        emulated with a ``STROM_BENCH_OVERLAP_PAD_MS`` service pad
+        (default 2; same discipline as bench_mixed's pad): the pad is
+        the transfer both arms pay, and the overlapped arm hides it
+        behind the reads.  On a real TPU set the pad to 0: both arms
+        then ride their true paths (device_put vs Pallas DMA stage).
+
+    (b) **submission syscalls/GiB, SQPOLL off vs on.**  A scalar-read
+        storm against a fresh engine with STROM_SQPOLL=0 then =1;
+        ``submit_enters`` (doorbells actually rung) per GiB is the
+        claim — the uring backend elides ``io_uring_enter`` while the
+        SQ thread is awake, the worker-pool backend elides its wakeup
+        notifies through the same state machine, so the number is
+        meaningful on both.
+    """
+    from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.ops.bridge import DeviceStream
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    size = os.path.getsize(path)
+    chunk = 1 << 20
+    n_chunks = min(192, size // chunk)
+    ranges = [(i * chunk, chunk) for i in range(n_chunks)]
+    pad_ms = float(os.environ.get("STROM_BENCH_OVERLAP_PAD_MS", "2"))
+    import jax
+    dev = jax.devices()[0]
+    real_paths = dev.platform == "tpu" and pad_ms == 0
+
+    class _PadArray:
+        """Fake device array completing ``pad_ms`` after launch —
+        the emulated host→HBM hop (is_ready/block_until_ready shaped).
+        ``sync=True`` is the serialized arm: launch blocks inline."""
+
+        def __init__(self, view, sync: bool):
+            self.nbytes = view.nbytes
+            self._done_at = time.monotonic() + pad_ms / 1000.0
+            if sync:
+                time.sleep(pad_ms / 1000.0)
+
+        def is_ready(self):
+            return time.monotonic() >= self._done_at
+
+        def block_until_ready(self):
+            dt = self._done_at - time.monotonic()
+            if dt > 0:
+                time.sleep(dt)
+            return self
+
+    def stream_once(overlapped: bool) -> float:
+        stats = StromStats()
+        cfg = EngineConfig(chunk_bytes=chunk, queue_depth=8,
+                           buffer_pool_bytes=16 << 20, n_rings=1)
+        with StromEngine(cfg, stats=stats) as eng:
+            fh = eng.open(path)
+            try:
+                evict_file(path)
+                if real_paths:
+                    ds = DeviceStream(eng, depth=4,
+                                      overlap=overlapped)
+                else:
+                    # pad-emulated hop, both arms: the serialized arm
+                    # blocks inline per chunk, the overlapped arm lets
+                    # the slab stage hide the pad behind the reads
+                    ds = DeviceStream(
+                        eng, depth=4, overlap=True,
+                        overlap_transfer=lambda v, d, s: _PadArray(
+                            v, sync=not overlapped))
+                t0 = time.monotonic()
+                n = 0
+                for arr in ds.stream_ranges(fh, ranges):
+                    n += int(arr.nbytes)   # drain orders completions
+                dt = time.monotonic() - t0
+            finally:
+                eng.close(fh)
+        return (n / (1 << 30)) / dt if dt > 0 else 0.0
+
+    def sq_storm(sqpoll: bool) -> dict:
+        prev = {k: os.environ.get(k)
+                for k in ("STROM_SQPOLL", "STROM_NO_RESIDENCY_PROBE")}
+        os.environ["STROM_SQPOLL"] = "1" if sqpoll else "0"
+        os.environ["STROM_NO_RESIDENCY_PROBE"] = "1"
+        try:
+            stats = StromStats()
+            cfg = EngineConfig(chunk_bytes=chunk, queue_depth=8,
+                               buffer_pool_bytes=16 << 20, n_rings=1)
+            with StromEngine(cfg, stats=stats) as eng:
+                fh = eng.open(path)
+                try:
+                    got = 0
+                    for i in range(n_chunks):
+                        with eng.submit_read(fh, i * chunk, chunk) as p:
+                            got += p.wait().nbytes
+                    blk = eng.engine_stats()
+                finally:
+                    eng.close(fh)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        gib = max(1e-9, got / (1 << 30))
+        return {
+            "enters": int(blk["submit_enters"]),
+            "elided": int(blk["submit_syscalls_saved"]),
+            "enters_per_gib": round(blk["submit_enters"] / gib, 1),
+            "sqpoll_active": bool(sqpoll),
+        }
+
+    # alternating arms so medium drift hits both equally (repo-standard
+    # interleaving discipline)
+    ser, ovl = [], []
+    for _ in range(3):
+        ser.append(stream_once(overlapped=False))
+        ovl.append(stream_once(overlapped=True))
+    ser_gib = sorted(ser)[len(ser) // 2]
+    ovl_gib = sorted(ovl)[len(ovl) // 2]
+    sq_off = sq_storm(sqpoll=False)
+    sq_on = sq_storm(sqpoll=True)
+    off_rate = sq_off["enters_per_gib"]
+    reduction = (100.0 * (off_rate - sq_on["enters_per_gib"]) / off_rate
+                 if off_rate else 0.0)
+    return {
+        "platform": "tpu" if dev.platform == "tpu" else "cpu-fallback",
+        "real_paths": real_paths,
+        "pad_ms": pad_ms,
+        "n_chunks": int(n_chunks),
+        "serialized_gib_s": round(ser_gib, 3),
+        "overlapped_gib_s": round(ovl_gib, 3),
+        "overlap_speedup_pct": round(
+            100.0 * (ovl_gib - ser_gib) / ser_gib if ser_gib else 0.0, 1),
+        "sqpoll_off": sq_off,
+        "sqpoll_on": sq_on,
+        "syscalls_per_gib_reduction_pct": round(reduction, 1),
+    }
+
+
 def _link_bufs(outstanding: int, chunk_bytes: int):
     import numpy as np
     sz = chunk_bytes or (32 << 20)
@@ -1195,6 +1344,22 @@ def main() -> int:
              f"{obs['trace_spans']} spans), "
              f"{len(obs['metrics_series'])} metric snapshots")
 
+    # Zero-copy overlap scenario (docs/PERF.md §6): overlapped vs
+    # serialized streaming and submission syscalls/GiB with SQPOLL off
+    # vs on.  STROM_BENCH_OVERLAP=0 skips.
+    overlap = None
+    if os.environ.get("STROM_BENCH_OVERLAP", "1") != "0":
+        overlap = bench_overlap(path)
+        _log(f"bench: overlap: stream "
+             f"{overlap['serialized_gib_s']:.3f} -> "
+             f"{overlap['overlapped_gib_s']:.3f} GiB/s "
+             f"({overlap['overlap_speedup_pct']:+.1f}%, pad="
+             f"{overlap['pad_ms']}ms), submit syscalls/GiB "
+             f"{overlap['sqpoll_off']['enters_per_gib']} -> "
+             f"{overlap['sqpoll_on']['enters_per_gib']} with SQPOLL "
+             f"({overlap['syscalls_per_gib_reduction_pct']:-.1f}% "
+             f"reduction, elided={overlap['sqpoll_on']['elided']})")
+
     direct_ok = info.supports_direct
     bounce = cold_bounce
     if direct_ok and bounce and device_ok:
@@ -1276,6 +1441,11 @@ def main() -> int:
         # path, plus the metrics-registry snapshot SERIES — so the
         # "always-on" claim ships with its measurement
         "observability": obs,
+        # zero-copy overlap scenario (bench_overlap): overlapped vs
+        # serialized streaming GiB/s and submission syscalls/GiB with
+        # SQPOLL off vs on — the doorbell-elision + transfer-overlap
+        # evidence (docs/PERF.md §6)
+        "overlap": overlap,
         "health": {
             "breaker_trips": int(stats.breaker_trips),
             "ring_restarts": int(stats.ring_restarts),

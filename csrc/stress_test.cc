@@ -198,6 +198,41 @@ void writer_thread(strom_engine *eng, const std::string &dir, int iters) {
   strom_close(eng, fh);
 }
 
+/* Restart-tolerant writer: like writer_thread, but a -ECANCELED
+ * completion (the request parked on a ring being hot-restarted) is the
+ * REQUEUE contract, not damage — resubmit the same range, exactly as
+ * ResilientWrite's retry does.  Used by phases that restart rings
+ * under live write traffic. */
+void restart_writer_thread(strom_engine *eng, const std::string &dir,
+                           int iters, int seed) {
+  std::string path = dir + "/stress_rw" + std::to_string(seed) + ".bin";
+  int fh = strom_open(eng, path.c_str(), STROM_OPEN_WRITABLE);
+  if (fh < 0) { fail("open restart writable"); return; }
+  std::vector<uint8_t> buf(64 * 1024);
+  Rng rng(seed * 6700417 + 3);
+  for (int i = 0; i < iters; i++) {
+    uint64_t off = (rng.next() % 64) * buf.size();
+    for (size_t k = 0; k < buf.size(); k++) buf[k] = pat(off + k);
+    int64_t id = strom_submit_write(eng, fh, off, buf.data(), buf.size());
+    if (id < 0) { fail("restart submit_write"); continue; }
+    for (int attempt = 0; attempt < 64; attempt++) {
+      strom_completion c;
+      int rc = strom_wait(eng, id, &c);
+      int st = rc == 0 ? c.status : rc;
+      strom_release(eng, id);
+      if (st == -ECANCELED) {
+        id = strom_submit_write(eng, fh, off, buf.data(), buf.size());
+        if (id < 0) { fail("restart write resubmit"); break; }
+        continue;
+      }
+      if (st != 0) fail("restart write status");
+      break;
+    }
+  }
+  strom_close(eng, fh);
+  unlink(path.c_str());
+}
+
 /* Pipelined writer: keeps kBurst submit_writes in flight on one fh
  * (each source buffer owned until its wait returns), racing the readv
  * batches and scalar readers for ring slots and pool buffers — the
@@ -563,6 +598,99 @@ int main(int argc, char **argv) {
     strom_close(eng, fh);
     strom_engine_destroy(eng);
   }
+  /* Zero-copy submission phase (PR 12): SQPOLL + registered files +
+   * an arena-prealloc'd staging pool, hammered by mixed read / readv /
+   * write threads with a mid-run hot restart of ring 1.  The doorbell
+   * elision, the slot-table updates racing open/close churn, the
+   * restart's re-registration, AND the caller-owned pool must all be
+   * TSAN-clean — and functionally every read still verifies. */
+  setenv("STROM_SQPOLL", "1", 1);
+  setenv("STROM_SQPOLL_IDLE_MS", "20", 1);
+  setenv("STROM_REG_FILES", "1", 1);
+  for (int use_uring = 1; use_uring >= 0; use_uring--) {
+    uint64_t pool_bytes =
+        strom_engine_pool_bytes(2, 8, kMaxRead + 8192, 4096);
+    if (pool_bytes == 0) { fail("engine_pool_bytes"); break; }
+    void *arena = strom_arena_create(pool_bytes);
+    if (!arena) { perror("arena_create"); return 2; }
+    strom_arena_lock(arena, pool_bytes);   /* best effort */
+    strom_engine *eng = strom_engine_create_prealloc(
+        2, 4, 8, kMaxRead + 8192, 4096, use_uring, 1,
+        arena, pool_bytes);
+    if (!eng) { perror("engine_create_prealloc"); return 2; }
+    int fh = strom_open(eng, path.c_str(), 0);
+    if (fh < 0) { fprintf(stderr, "open failed\n"); return 2; }
+
+    std::atomic<bool> stop{false};
+    std::atomic<uint64_t> requeued{0};
+    std::vector<std::thread> ts;
+    for (int r = 0; r < n_readers; r++)
+      ts.emplace_back(restart_reader_thread, eng, fh, iters, 400 + r,
+                      &requeued);
+    /* write traffic must be restart-tolerant here: the mid-run stall
+     * parks round-robin writes on ring 1 and the restart cancels them
+     * for requeue — plain writer_thread would read that as damage */
+    for (int r = 0; r < 2; r++)
+      ts.emplace_back(restart_writer_thread, eng, dir, iters / 2 + 1,
+                      50 + r);
+    ts.emplace_back(churn_thread, eng, path, iters / 2 + 1);
+    std::thread obs(observer_thread, eng, &stop);
+    std::thread killer([&] {
+      /* one mid-run restart cycle: the rebuilt uring must re-register
+       * buffers + files and re-arm SQPOLL (checked below) */
+      usleep(5000);
+      strom_set_ring_stall(eng, 1, 1);
+      usleep(3000);
+      int64_t rc = strom_ring_restart(eng, 1, 500000000ull);
+      if (rc < 0 && rc != -EBUSY) fail("sqpoll-phase ring_restart");
+    });
+    for (auto &t : ts) t.join();
+    stop.store(true, std::memory_order_release);
+    killer.join();
+    obs.join();
+
+    strom_ring_info ri;
+    if (strom_get_ring_info(eng, 1, &ri) != 0) fail("ring_info(1)");
+    /* The kernel may legitimately refuse IORING_SETUP_SQPOLL
+     * (privileges pre-5.13, old kernels): the engine's documented
+     * soft-fallback is a plain ring.  Only a backend that ACCEPTED the
+     * mode must keep it across the restart — the worker-pool analogue
+     * always does. */
+    bool sq_active = ri.sqpoll == 1;
+    if (!ri.backend_uring && !sq_active)
+      fail("worker-pool sqpoll analogue not active after restart");
+    if (!sq_active)
+      fprintf(stderr, "stress[sqpoll]: note: kernel refused SQPOLL, "
+                      "phase ran on the plain ring\n");
+    if (ri.backend_uring && !ri.reg_files)
+      fprintf(stderr, "stress[sqpoll]: note: reg_files soft-failed\n");
+    strom_pool_info pi;
+    strom_get_pool_info(eng, &pi);
+    if (pi.pool_base != (uint64_t)(uintptr_t)arena)
+      fail("prealloc pool base mismatch");
+    strom_stats_blk st;
+    strom_get_stats(eng, &st);
+    fprintf(stderr,
+            "stress[sqpoll+regfiles+arena,%s]: submitted=%llu "
+            "enters=%llu elided=%llu requeued=%llu failed=%llu "
+            "errors=%llu\n",
+            use_uring ? "io_uring" : "threadpool",
+            (unsigned long long)st.requests_submitted,
+            (unsigned long long)st.submit_enters,
+            (unsigned long long)st.submit_syscalls_saved,
+            (unsigned long long)requeued.load(),
+            (unsigned long long)st.requests_failed,
+            (unsigned long long)g_errors.load());
+    if (st.requests_failed != 0) fail("sqpoll phase requests_failed != 0");
+    if (sq_active && st.submit_syscalls_saved == 0)
+      fail("sqpoll phase elided no doorbells");
+    strom_close(eng, fh);
+    strom_engine_destroy(eng);
+    strom_arena_destroy(arena, pool_bytes);
+  }
+  unsetenv("STROM_SQPOLL");
+  unsetenv("STROM_SQPOLL_IDLE_MS");
+  unsetenv("STROM_REG_FILES");
   unlink(path.c_str());
   unlink((dir + "/stress_w.bin").c_str());
   return g_errors.load() == 0 ? 0 : 1;

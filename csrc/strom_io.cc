@@ -103,18 +103,40 @@ static constexpr uint64_t kOffCqRing = 0x8000000ULL;
 static constexpr uint64_t kOffSqes = 0x10000000ULL;
 static constexpr uint32_t kFeatSingleMmap = 1u << 0;
 static constexpr uint32_t kEnterGetevents = 1u << 0;
+/* SQPOLL plumbing: IORING_SETUP_SQPOLL asks the kernel for a dedicated
+ * SQ-consuming thread; while it is awake submissions need NO syscall at
+ * all — the tail store IS the submission (the natural endpoint of the
+ * "one doorbell" arc: zero doorbells).  When the thread idles out
+ * (sq_thread_idle ms) the SQ ring flags raise NEED_WAKEUP and the next
+ * submit pays one io_uring_enter(SQ_WAKEUP). */
+static constexpr uint32_t kSetupSqpoll = 1u << 1;
+static constexpr uint32_t kSqNeedWakeup = 1u << 0;
+static constexpr uint32_t kEnterSqWakeup = 1u << 1;
 static constexpr uint8_t kOpNop = 0, kOpRead = 22, kOpWrite = 23;
 /* Fixed-buffer variants: the kernel pins the staging pool ONCE at
  * registration instead of get_user_pages()-pinning every I/O — the same
  * pin-once pattern as the reference's MAP_GPU_MEMORY (SURVEY.md §3.2). */
 static constexpr uint8_t kOpReadFixed = 4, kOpWriteFixed = 5;
 static constexpr uint32_t kRegisterBuffers = 0;
+/* Registered files: a slot table the kernel resolves instead of a per-op
+ * fget()/fput() on the raw fd — IOSQE_FIXED_FILE turns sqe->fd into a
+ * table index.  The table registers sparse (-1 slots) at ring init and
+ * is updated at strom_open/strom_close. */
+static constexpr uint32_t kRegisterFiles = 2;
+static constexpr uint32_t kRegisterFilesUpdate = 6;
+static constexpr uint8_t kSqeFixedFile = 1u << 0;
 static constexpr uint64_t kShutdownUserData = ~0ULL;
+
+struct io_uring_files_update_ {
+  uint32_t offset, resv;
+  uint64_t fds;   /* pointer to int32_t fds */
+};
 
 struct Uring {
   int fd = -1;
   uint32_t *sq_head = nullptr, *sq_tail = nullptr, *sq_mask = nullptr;
   uint32_t *sq_array = nullptr;
+  uint32_t *sq_flags = nullptr;   /* NEED_WAKEUP lives here (SQPOLL) */
   uint32_t *cq_head = nullptr, *cq_tail = nullptr, *cq_mask = nullptr;
   io_uring_cqe_ *cqes = nullptr;
   io_uring_sqe_ *sqes = nullptr;
@@ -123,6 +145,25 @@ struct Uring {
   uint32_t sq_entries = 0;
   bool single_mmap = false;
   bool fixed_bufs = false;   /* staging pool registered with the kernel */
+  /* fd slot table registered (FIXED_FILE).  Atomic: cleared under
+   * files_mu by a refused slot update while dispatchers read it under
+   * their ring mutex — a plain bool would be a (benign) race. */
+  std::atomic<bool> reg_files{false};
+  bool sqpoll = false;       /* IORING_SETUP_SQPOLL accepted            */
+  /* requested mode, preserved across a hot restart's teardown/re-init */
+  bool want_sqpoll = false;
+  uint32_t sqpoll_idle_ms = 50;
+  /* submission-doorbell accounting (engine-owned atomics; see
+   * strom_stats_blk.submit_enters): enters = doorbells actually rung,
+   * elided = doorbells SQPOLL made unnecessary */
+  std::atomic<uint64_t> *c_enters = nullptr, *c_elided = nullptr;
+
+  void count_enter() {
+    if (c_enters) c_enters->fetch_add(1, std::memory_order_relaxed);
+  }
+  void count_elided() {
+    if (c_elided) c_elided->fetch_add(1, std::memory_order_relaxed);
+  }
   /* SQEs published to the ring but not yet consumed by io_uring_enter
    * (enter can fail with EINTR/EBUSY after the tail was advanced; the
    * entry then MUST be submitted by a later enter, never abandoned —
@@ -133,7 +174,18 @@ struct Uring {
   bool init(uint32_t entries) {
     io_uring_params_ p;
     memset(&p, 0, sizeof(p));
-    int r = (int)syscall(__NR_io_uring_setup, entries, &p);
+    int r = -1;
+    sqpoll = false;
+    if (want_sqpoll) {
+      /* SQPOLL first; refused (old kernel, privileges pre-5.11) falls
+       * back to the plain ring — slower, never broken. */
+      p.flags = kSetupSqpoll;
+      p.sq_thread_idle = sqpoll_idle_ms;
+      r = (int)syscall(__NR_io_uring_setup, entries, &p);
+      if (r >= 0) sqpoll = true;
+      else memset(&p, 0, sizeof(p));
+    }
+    if (r < 0) r = (int)syscall(__NR_io_uring_setup, entries, &p);
     if (r < 0) return false;
     fd = r;
     sq_entries = p.sq_entries;
@@ -161,6 +213,7 @@ struct Uring {
     sq_tail = (uint32_t *)(sqb + p.sq_off.tail);
     sq_mask = (uint32_t *)(sqb + p.sq_off.ring_mask);
     sq_array = (uint32_t *)(sqb + p.sq_off.array);
+    sq_flags = (uint32_t *)(sqb + p.sq_off.flags);
     auto *cqb = (uint8_t *)cq_ring_ptr;
     cq_head = (uint32_t *)(cqb + p.cq_off.head);
     cq_tail = (uint32_t *)(cqb + p.cq_off.tail);
@@ -182,21 +235,97 @@ struct Uring {
                          iov.data(), n) == 0;
   }
 
+  /* Register the fd slot table (sparse: -1 slots are empty).  Soft-fail
+   * like try_register: kernels without sparse REGISTER_FILES support
+   * just keep resolving raw fds per op. */
+  void try_register_files(const int32_t *fds, uint32_t n) {
+    reg_files = syscall(__NR_io_uring_register, fd, kRegisterFiles,
+                        fds, n) == 0;
+  }
+
+  /* Point one slot of the registered table at `newfd` (-1 clears).
+   * Returns false when the kernel refused — the caller downgrades that
+   * file to raw-fd submission rather than risking a stale slot. */
+  bool update_file(uint32_t slot, int32_t newfd) {
+    if (!reg_files) return false;
+    io_uring_files_update_ up;
+    up.offset = slot;
+    up.resv = 0;
+    up.fds = (uint64_t)(uintptr_t)&newfd;
+    return syscall(__NR_io_uring_register, fd, kRegisterFilesUpdate,
+                   &up, 1) == 1;
+  }
+
+  /* Wait until the kernel has CONSUMED every published SQE (sq_head
+   * caught up).  An unconsumed SQE carrying IOSQE_FIXED_FILE resolves
+   * its slot at consumption time — so a slot must not be recycled to
+   * another file while any SQE referencing it is still in the SQ.
+   * Bounded: returns false if the queue would not drain (the caller
+   * then leaks the slot instead of recycling it — safe, never
+   * wrong). */
+  bool drain_sq() {
+    if (fd < 0) return true;
+    for (int i = 0; i < 100000; i++) {
+      if (!sqpoll) flush();
+      else sqpoll_kick(/*count_elide=*/false);
+      uint32_t head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
+      uint32_t tail = __atomic_load_n(sq_tail, __ATOMIC_ACQUIRE);
+      if (head == tail &&
+          unsubmitted.load(std::memory_order_acquire) == 0)
+        return true;
+      usleep(10);
+    }
+    return false;
+  }
+
   void teardown() {
     if (sqes) munmap(sqes, sqes_sz);
     if (cq_ring_ptr && cq_ring_ptr != sq_ring_ptr) munmap(cq_ring_ptr, cq_ring_sz);
     if (sq_ring_ptr) munmap(sq_ring_ptr, sq_ring_sz);
     if (fd >= 0) close(fd);
     sqes = nullptr; cq_ring_ptr = sq_ring_ptr = nullptr; fd = -1;
+    sqpoll = false; reg_files = false;
+  }
+
+  /* SQPOLL doorbell: the kernel thread consumes published SQEs on its
+   * own; only when it idled out (NEED_WAKEUP raised) does the submitter
+   * pay one io_uring_enter(SQ_WAKEUP).  Every skipped doorbell counts —
+   * that is the syscall elision the whole mode exists for.
+   * ``count_elide=false`` for polls that do not correspond to a
+   * published SQE (the SQ-full spin), so backpressure noise cannot
+   * inflate the elision counter. */
+  void sqpoll_kick(bool count_elide = true) {
+    /* Full fence between the tail store and the NEED_WAKEUP load: the
+     * SQ thread sets NEED_WAKEUP after seeing an empty queue, and a
+     * StoreLoad reordering here (legal on x86 AND arm) could read the
+     * flags from before it slept — doorbell elided, SQE stranded, the
+     * waiter hangs.  This is the io_uring_smp_mb() liburing documents
+     * for exactly this handshake. */
+    __atomic_thread_fence(__ATOMIC_SEQ_CST);
+    if (__atomic_load_n(sq_flags, __ATOMIC_ACQUIRE) & kSqNeedWakeup) {
+      syscall(__NR_io_uring_enter, fd, 0, 0, kEnterSqWakeup, nullptr, 0);
+      count_enter();
+    } else if (count_elide) {
+      count_elided();
+    }
   }
 
   /* Push any published-but-unconsumed SQEs into the kernel. Safe to call
    * from any thread. Returns 0 when the backlog is drained. */
   int flush() {
+    if (sqpoll) {
+      /* nothing tracked in `unsubmitted` under SQPOLL (publishing IS
+       * submitting); just make sure the poller is awake */
+      if (unsubmitted.load(std::memory_order_acquire) == 0) {
+        sqpoll_kick();
+        return 0;
+      }
+    }
     for (int attempt = 0; attempt < 1000; attempt++) {
       uint32_t n = unsubmitted.load(std::memory_order_acquire);
       if (n == 0) return 0;
       int r = (int)syscall(__NR_io_uring_enter, fd, n, 0, 0, nullptr, 0);
+      if (r >= 0) count_enter();
       if (r > 0) {
         unsubmitted.fetch_sub((uint32_t)r, std::memory_order_acq_rel);
         continue;
@@ -217,14 +346,19 @@ struct Uring {
    * inline below; correctness never depends on the deferred flush). */
   int submit(uint8_t opcode, int fd_, uint64_t off, void *addr, uint32_t len,
              uint64_t user_data, uint16_t buf_index = 0,
-             bool flush_now = true) {
+             bool flush_now = true, uint8_t sqe_flags = 0) {
     uint32_t tail = *sq_tail;
     uint32_t head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
     if (tail - head >= sq_entries) {
       /* SQ full: nudge the kernel and spin-wait (bounded by in-flight I/O). */
       for (int i = 0; i < 100000 && tail - head >= sq_entries; i++) {
-        flush();
-        syscall(__NR_io_uring_enter, fd, 0, 0, 0, nullptr, 0);
+        if (sqpoll) sqpoll_kick(/*count_elide=*/false);  /* poller
+                                          drains the SQ; spin polls are
+                                          not elided doorbells */
+        else {
+          flush();
+          syscall(__NR_io_uring_enter, fd, 0, 0, 0, nullptr, 0);
+        }
         head = __atomic_load_n(sq_head, __ATOMIC_ACQUIRE);
       }
       if (tail - head >= sq_entries) return -EBUSY;
@@ -233,6 +367,7 @@ struct Uring {
     io_uring_sqe_ *sqe = &sqes[idx];
     memset(sqe, 0, sizeof(*sqe));
     sqe->opcode = opcode;
+    sqe->flags = sqe_flags;
     sqe->fd = fd_;
     sqe->off = off;
     sqe->addr = (uint64_t)addr;
@@ -241,6 +376,13 @@ struct Uring {
     sqe->buf_index = buf_index;
     sq_array[idx] = idx;
     __atomic_store_n(sq_tail, tail + 1, __ATOMIC_RELEASE);
+    if (sqpoll) {
+      /* publishing IS submitting: the SQ thread consumes the tail on
+       * its own.  `unsubmitted` stays 0 — there is no backlog an
+       * abandoned enter could strand. */
+      if (flush_now) sqpoll_kick();
+      return 0;
+    }
     unsubmitted.fetch_add(1, std::memory_order_acq_rel);
     if (flush_now) flush();
     return 0; /* published: the op WILL reach the kernel */
@@ -285,6 +427,11 @@ struct FileEnt {
   int fd_buffered = -1;
   int64_t size = 0;
   bool writable = false;
+  /* registered-file slots (-1 = not in the table): hot submissions use
+   * IOSQE_FIXED_FILE with the slot index so the kernel skips the
+   * per-op fget/fput of the raw fd */
+  int slot_direct = -1;
+  int slot_buffered = -1;
 };
 
 enum class ReqState { kInflight, kDone };
@@ -393,6 +540,16 @@ struct RingCtx {
   uint64_t stall_after = 0;   /* clean dispatches before the stall bites */
   uint64_t stall_seen = 0;
 
+  /* Worker-pool SQPOLL analogue (under mu): workers POLL the work
+   * queue for sq_idle_ns before sleeping, and a dispatch that finds a
+   * poller awake skips the wakeup notification entirely — the same
+   * doorbell-elision state machine as the kernel SQ thread, same
+   * counters, so the mode is benchable and testable on hosts without
+   * io_uring. */
+  bool sq_poll = false;
+  uint64_t sq_idle_ns = 0;
+  int poll_workers = 0;       /* workers currently awake-polling */
+
   void complete_locked(Req *r);
   void complete(Req *r) {
     std::lock_guard<std::mutex> g(mu);
@@ -409,6 +566,12 @@ struct strom_engine {
   uint64_t buf_bytes;     /* payload capacity */
   uint64_t buf_cap;       /* buf_bytes + 2*alignment slack */
   bool locked = false;
+  bool owns_pool = true;  /* false: pool is an arena carve the caller
+                             owns — never munmap'd here (PR 12)       */
+  /* Zero-copy submission modes (env at create; see strom_io.h): */
+  bool sqpoll_enabled = false;
+  uint32_t sqpoll_idle_ms = 50;
+  bool reg_files_enabled = true;
   std::atomic<bool> stopping{false};
 
   uint8_t *pool = nullptr;   /* ONE mapping, ONE fungible pool: any ring
@@ -442,6 +605,27 @@ struct strom_engine {
                                            the other way around */
   std::unordered_map<int, FileEnt> files;
   int next_fh = 1;
+  /* Registered-file slot table (under files_mu): the canonical fd-per-
+   * slot view every uring registered at init and updates at open/close
+   * — and what a hot restart re-registers from after its rebuild. */
+  std::vector<int32_t> reg_fds;
+  std::vector<uint32_t> reg_free;
+
+  /* Update one slot on every registered ring.  Caller holds
+   * restart_mu, NOT files_mu: the syscall touches each Uring's fd,
+   * which only a hot restart ever tears down/rebuilds — restart_mu is
+   * exactly the lock that excludes restarts (taking a ring mutex here
+   * instead would invert the ring-mutex→files_mu order).  A ring that
+   * refuses the update drops its reg_files flag — raw-fd submission
+   * is always correct, a stale slot never is.  (On pre-5.11 kernels a
+   * raw fd on a SQPOLL ring completes -EBADF; the reaper's sync
+   * rescue path then serves the op buffered — degraded, never
+   * wrong.) */
+  void reg_update_all(uint32_t slot, int32_t newfd);
+  int32_t reg_alloc_slot(int fd);      /* files_mu held; -1 = full    */
+  void reg_clear_slot(int32_t slot);   /* files_mu held: table -1,
+                                          slot NOT yet reusable       */
+  void reg_recycle_slot(int32_t slot); /* files_mu held: back to free */
 
   RingCtx *pick_ring() {
     return rings[rr.fetch_add(1, std::memory_order_relaxed)
@@ -489,7 +673,7 @@ struct strom_engine {
 
   std::atomic<uint64_t> st_direct{0}, st_fallback{0}, st_bounce{0},
       st_written{0}, st_sub{0}, st_comp{0}, st_fail{0}, st_retry{0},
-      st_resident{0}, st_batches{0}, st_sysc_saved{0};
+      st_resident{0}, st_batches{0}, st_sysc_saved{0}, st_enters{0};
   bool probe_residency = true;   /* STROM_NO_RESIDENCY_PROBE disables */
 
   /* Fault injection BELOW Python (stress/chaos runs; see
@@ -710,19 +894,26 @@ void RingCtx::dispatch_locked(Req *r, bool flush_now) {
      * Every ring registered the WHOLE pool, so buf_index is global. */
     bool fixed = ring.fixed_bufs && r->buf_idx >= 0;
     uint16_t bidx = fixed ? (uint16_t)r->buf_idx : 0;
+    /* Registered file: sqe->fd becomes the slot index and the kernel
+     * skips the per-op fget — the hot-path half of "one doorbell". */
+    int slot = r->direct ? fe.slot_direct : fe.slot_buffered;
+    bool ff = ring.reg_files && slot >= 0;
+    uint8_t sflags = ff ? kSqeFixedFile : 0;
     if (r->is_write) {
       const uint8_t *s = r->buf_idx >= 0 ? r->buf : (const uint8_t *)r->wsrc;
+      int fd = r->direct ? fe.fd_direct : fe.fd_buffered;
       rc = ring.submit(fixed ? kOpWriteFixed : kOpWrite,
-                       r->direct ? fe.fd_direct : fe.fd_buffered,
+                       ff ? slot : fd,
                        r->offset, (void *)s, (uint32_t)r->len,
-                       (uint64_t)r->id, bidx, flush_now);
+                       (uint64_t)r->id, bidx, flush_now, sflags);
     } else {
       int fd = r->direct ? fe.fd_direct : fe.fd_buffered;
       uint64_t off = r->direct ? r->a_off : r->offset;
       uint8_t *dst = r->direct ? r->buf : r->buf + (r->offset - r->a_off);
       uint32_t rlen = (uint32_t)(r->direct ? r->a_len : r->len);
-      rc = ring.submit(fixed ? kOpReadFixed : kOpRead, fd, off, dst, rlen,
-                       (uint64_t)r->id, bidx, flush_now);
+      rc = ring.submit(fixed ? kOpReadFixed : kOpRead, ff ? slot : fd,
+                       off, dst, rlen,
+                       (uint64_t)r->id, bidx, flush_now, sflags);
     }
     if (rc != 0) {
       r->status = rc;
@@ -732,7 +923,20 @@ void RingCtx::dispatch_locked(Req *r, bool flush_now) {
     return;
   }
   work_q.push_back(r);
-  cv_work.notify_one();
+  if (sq_poll && poll_workers >= (int)work_q.size()) {
+    /* SQPOLL analogue: enough pollers are awake to absorb the WHOLE
+     * queue on their next poll tick — the wakeup doorbell is
+     * unnecessary, which is the whole point of the mode.  Counted
+     * exactly like the uring backend's elided io_uring_enter.  The
+     * queue-size bound matters: unlike the kernel SQ thread (which
+     * only consumes submissions), our pollers execute the full I/O —
+     * eliding more wakeups than there are awake pollers would
+     * serialize a burst behind one worker while the rest sleep. */
+    eng->st_sysc_saved.fetch_add(1, std::memory_order_relaxed);
+  } else {
+    eng->st_enters.fetch_add(1, std::memory_order_relaxed);
+    cv_work.notify_one();
+  }
 }
 
 /* A staging buffer became free: hand it to the OLDEST deferred request
@@ -841,10 +1045,38 @@ void RingCtx::worker_loop() {
     Req *r;
     {
       std::unique_lock<std::mutex> lk(mu);
-      cv_work.wait(lk, [&] {
+      auto ready = [&] {
         return eng->stopping.load(std::memory_order_acquire) ||
                !work_q.empty();
-      });
+      };
+      if (sq_poll) {
+        /* SQPOLL analogue: poll the queue in short ticks for up to
+         * sq_idle_ns before sleeping.  While polling, this worker is
+         * counted in poll_workers so dispatchers elide their wakeup
+         * (the doorbell the mode removes); once the idle budget is
+         * spent the worker sleeps indefinitely and the NEXT dispatch
+         * pays one wakeup — exactly the kernel SQ thread's
+         * NEED_WAKEUP handshake. */
+        uint64_t idle_start = now_ns();
+        while (!ready()) {
+          poll_workers++;
+          /* system-clock wait_until, NOT wait_for: libstdc++'s
+           * steady-clock wait lands on pthread_cond_clockwait, which
+           * gcc-10-era TSAN does not intercept — every poll tick would
+           * then read as a phantom double-lock.  The poll cadence does
+           * not care which clock measures 200 us. */
+          cv_work.wait_until(lk, std::chrono::system_clock::now() +
+                                     std::chrono::microseconds(200));
+          poll_workers--;
+          if (ready()) break;
+          if (now_ns() - idle_start >= sq_idle_ns) {
+            cv_work.wait(lk, ready);   /* asleep: doorbell required */
+            break;
+          }
+        }
+      } else {
+        cv_work.wait(lk, ready);
+      }
       if (work_q.empty()) return;  /* stopping, queue drained */
       r = work_q.front();
       work_q.pop_front();
@@ -869,12 +1101,15 @@ void RingCtx::worker_loop() {
 
 extern "C" {
 
-strom_engine *strom_engine_create_rings(uint32_t n_rings,
-                                        uint32_t queue_depth,
-                                        uint32_t n_buffers,
-                                        uint64_t buf_bytes,
-                                        uint32_t alignment,
-                                        int use_io_uring, int lock_buffers) {
+/* Registered-file slot budget per engine: big enough for every consumer
+ * pattern in the repo (each open costs <= 2 slots: direct + buffered
+ * fd); files past it simply submit by raw fd. */
+#define STROM_REG_FILE_SLOTS 128
+
+static strom_engine *engine_create_common(
+    uint32_t n_rings, uint32_t queue_depth, uint32_t n_buffers,
+    uint64_t buf_bytes, uint32_t alignment, int use_io_uring,
+    int lock_buffers, void *prealloc, uint64_t prealloc_bytes) {
   if (!n_rings || n_rings > STROM_MAX_RINGS || !queue_depth || !n_buffers ||
       !buf_bytes || !alignment || (alignment & (alignment - 1))) {
     errno = EINVAL;
@@ -887,13 +1122,30 @@ strom_engine *strom_engine_create_rings(uint32_t n_rings,
   e->alignment = alignment;
   e->buf_bytes = buf_bytes;
   e->buf_cap = align_up(buf_bytes, alignment) + 2 * (uint64_t)alignment;
-  e->pool_sz = (size_t)e->buf_cap * n_buffers * n_rings;
-  e->pool = (uint8_t *)mmap(nullptr, e->pool_sz, PROT_READ | PROT_WRITE,
-                            MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
-  if (e->pool == MAP_FAILED) { delete e; return nullptr; }
+  /* ONE formula, shared with the public helper: a prealloc caller's
+   * computed carve size must never drift from the engine's own check */
+  e->pool_sz = (size_t)strom_engine_pool_bytes(n_rings, n_buffers,
+                                               buf_bytes, alignment);
+  if (prealloc != nullptr) {
+    /* Arena carve (io/arena.py): the caller owns (and outlives) the
+     * mapping; the engine stages into it but never unmaps it. */
+    if (prealloc_bytes < e->pool_sz) {
+      delete e;
+      errno = EINVAL;
+      return nullptr;
+    }
+    e->pool = (uint8_t *)prealloc;
+    e->owns_pool = false;
+  } else {
+    e->pool = (uint8_t *)mmap(nullptr, e->pool_sz, PROT_READ | PROT_WRITE,
+                              MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (e->pool == MAP_FAILED) { delete e; return nullptr; }
+  }
   /* Pin the pool — the MAP_GPU_MEMORY analogue: the reference pins BAR1
    * pages so DMA targets never move (SURVEY.md §3.2); we pin staging pages
-   * so neither NVMe DMA nor the TPU transfer hits a fault. Soft-fail. */
+   * so neither NVMe DMA nor the TPU transfer hits a fault. Soft-fail.
+   * (A prealloc'd pool is re-mlocked here harmlessly: destroy skips the
+   * munmap, so the arena's lock outlives the engine either way.) */
   if (lock_buffers) e->locked = mlock(e->pool, e->pool_sz) == 0;
   e->probe_residency = getenv("STROM_NO_RESIDENCY_PROBE") == nullptr;
   {
@@ -911,6 +1163,22 @@ strom_engine *strom_engine_create_rings(uint32_t n_rings,
     e->wfault_short_every = env_u64("STROM_FAULT_WRITE_SHORT_EVERY");
     e->wfault_delay_ns = env_u64("STROM_FAULT_WRITE_DELAY_MS") * 1000000ull;
   }
+  {
+    /* Zero-copy submission modes (PR 12; defaults: registered files
+     * on — they soft-fail harmlessly — SQPOLL opt-in: the poller burns
+     * a core while idle, a deliberate spend). */
+    const char *v = getenv("STROM_REG_FILES");
+    e->reg_files_enabled = !(v && v[0] == '0' && v[1] == '\0');
+    v = getenv("STROM_SQPOLL");
+    e->sqpoll_enabled = v && v[0] == '1' && v[1] == '\0';
+    if (const char *ims = getenv("STROM_SQPOLL_IDLE_MS")) {
+      uint64_t ms = strtoull(ims, nullptr, 10);
+      if (ms > 0 && ms <= 10000) e->sqpoll_idle_ms = (uint32_t)ms;
+    }
+  }
+  e->reg_fds.assign(STROM_REG_FILE_SLOTS, -1);
+  for (int s = STROM_REG_FILE_SLOTS - 1; s >= 0; s--)
+    e->reg_free.push_back((uint32_t)s);
   for (int i = (int)(n_buffers * n_rings) - 1; i >= 0; i--)
     e->free_bufs.push_back(i);
   /* Ring-stall injection (chaos; default off): the named ring parks
@@ -931,13 +1199,22 @@ strom_engine *strom_engine_create_rings(uint32_t n_rings,
       rc->stalled = true;
       rc->stall_after = stall_after;
     }
+    rc->ring.want_sqpoll = e->sqpoll_enabled;
+    rc->ring.sqpoll_idle_ms = e->sqpoll_idle_ms;
+    rc->ring.c_enters = &e->st_enters;
+    rc->ring.c_elided = &e->st_sysc_saved;
     if (use_io_uring && rc->ring.init(queue_depth * 2)) {
       rc->use_uring = true;
       /* Each ring registers the WHOLE pool with its uring fd: buffers
        * are fungible across rings (deadlock freedom — see pool_mu). */
       rc->ring.try_register(e->pool, e->buf_cap, n_buffers * n_rings);
+      if (e->reg_files_enabled)
+        rc->ring.try_register_files(e->reg_fds.data(),
+                                    STROM_REG_FILE_SLOTS);
       rc->reaper = std::thread([rc] { rc->reaper_loop(); });
     } else {
+      rc->sq_poll = e->sqpoll_enabled;
+      rc->sq_idle_ns = (uint64_t)e->sqpoll_idle_ms * 1000000ull;
       uint32_t nw = queue_depth < 32 ? queue_depth : 32;
       for (uint32_t i = 0; i < nw; i++)
         rc->workers.emplace_back([rc] { rc->worker_loop(); });
@@ -947,11 +1224,72 @@ strom_engine *strom_engine_create_rings(uint32_t n_rings,
   return e;
 }
 
+strom_engine *strom_engine_create_rings(uint32_t n_rings,
+                                        uint32_t queue_depth,
+                                        uint32_t n_buffers,
+                                        uint64_t buf_bytes,
+                                        uint32_t alignment,
+                                        int use_io_uring, int lock_buffers) {
+  return engine_create_common(n_rings, queue_depth, n_buffers, buf_bytes,
+                              alignment, use_io_uring, lock_buffers,
+                              nullptr, 0);
+}
+
+strom_engine *strom_engine_create_prealloc(uint32_t n_rings,
+                                           uint32_t queue_depth,
+                                           uint32_t n_buffers,
+                                           uint64_t buf_bytes,
+                                           uint32_t alignment,
+                                           int use_io_uring,
+                                           int lock_buffers,
+                                           void *pool,
+                                           uint64_t pool_bytes) {
+  if (!pool) { errno = EINVAL; return nullptr; }
+  return engine_create_common(n_rings, queue_depth, n_buffers, buf_bytes,
+                              alignment, use_io_uring, lock_buffers,
+                              pool, pool_bytes);
+}
+
+uint64_t strom_engine_pool_bytes(uint32_t n_rings, uint32_t n_buffers,
+                                 uint64_t buf_bytes, uint32_t alignment) {
+  if (!n_rings || n_rings > STROM_MAX_RINGS || !n_buffers || !buf_bytes ||
+      !alignment || (alignment & (alignment - 1)))
+    return 0;
+  uint64_t cap = align_up(buf_bytes, alignment) + 2 * (uint64_t)alignment;
+  return cap * n_buffers * n_rings;
+}
+
 strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
                                   uint64_t buf_bytes, uint32_t alignment,
                                   int use_io_uring, int lock_buffers) {
   return strom_engine_create_rings(1, queue_depth, n_buffers, buf_bytes,
                                    alignment, use_io_uring, lock_buffers);
+}
+
+/* ---- unified pinned arena (io/arena.py) ---- */
+
+void *strom_arena_create(uint64_t bytes) {
+  if (bytes == 0) { errno = EINVAL; return NULL; }
+  /* NORESERVE: the arena is a cheap VIRTUAL reservation — pages commit
+   * (and pin, via strom_arena_lock) per CARVE, so a generously sized
+   * arena costs nothing until consumers actually stage into it. */
+  void *base = mmap(NULL, bytes, PROT_READ | PROT_WRITE,
+                    MAP_PRIVATE | MAP_ANONYMOUS | MAP_NORESERVE, -1, 0);
+  if (base == MAP_FAILED) {
+    base = mmap(NULL, bytes, PROT_READ | PROT_WRITE,
+                MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (base == MAP_FAILED) return NULL;
+  }
+  return base;
+}
+
+void strom_arena_destroy(void *base, uint64_t bytes) {
+  if (base && bytes) munmap(base, bytes);
+}
+
+int strom_arena_lock(void *base, uint64_t bytes) {
+  if (!base || !bytes) return -EINVAL;
+  return mlock(base, bytes) == 0 ? 0 : -errno;
 }
 
 void strom_engine_destroy(strom_engine *e) {
@@ -1025,8 +1363,42 @@ void strom_engine_destroy(strom_engine *e) {
   }
   for (auto &rcp : e->rings)
     for (auto &kv : rcp->reqs) delete kv.second;
-  if (e->pool) munmap(e->pool, e->pool_sz);
+  /* An arena-carved pool belongs to the caller (io/arena.py recycles
+   * the carve); unmapping it here would yank live cache lines and DMA
+   * slabs sharing the arena. */
+  if (e->pool && e->owns_pool) munmap(e->pool, e->pool_sz);
   delete e;
+}
+
+/* ---- registered-file slot table (files_mu held by callers) ---- */
+
+int32_t strom_engine::reg_alloc_slot(int fd) {
+  if (fd < 0 || reg_free.empty()) return -1;
+  uint32_t slot = reg_free.back();
+  reg_free.pop_back();
+  reg_fds[slot] = fd;
+  return (int32_t)slot;
+}
+
+void strom_engine::reg_clear_slot(int32_t slot) {
+  if (slot >= 0) reg_fds[slot] = -1;
+}
+
+void strom_engine::reg_recycle_slot(int32_t slot) {
+  /* Only AFTER the rings' slot entries were updated to -1: recycling
+   * first would let a concurrent open re-allocate the slot and
+   * register a fresh fd that our in-flight -1 update then clobbers. */
+  if (slot >= 0) reg_free.push_back((uint32_t)slot);
+}
+
+void strom_engine::reg_update_all(uint32_t slot, int32_t newfd) {
+  for (auto &rcp : rings) {
+    RingCtx *rc = rcp.get();
+    if (rc->use_uring && rc->ring.reg_files) {
+      if (!rc->ring.update_file(slot, newfd))
+        rc->ring.reg_files = false;   /* stale slots are never risked */
+    }
+  }
 }
 
 int strom_ring_count(strom_engine *e) { return (int)e->n_rings; }
@@ -1066,6 +1438,13 @@ int strom_get_ring_info(strom_engine *e, uint32_t ring,
     std::lock_guard<std::mutex> g(rc->mu);
     out->parked = (uint32_t)rc->park_q.size();
     out->stalled = rc->stalled ? 1 : 0;
+    /* zero-copy submission state (PR 12), read under the ring mutex (a
+     * hot restart rewrites these during its rebuild): a silently-
+     * unregistered pool or slot table must be VISIBLE, not just slow */
+    out->fixed_bufs = rc->use_uring && rc->ring.fixed_bufs ? 1 : 0;
+    out->reg_files = rc->use_uring &&
+        rc->ring.reg_files.load(std::memory_order_relaxed) ? 1 : 0;
+    out->sqpoll = (rc->use_uring ? rc->ring.sqpoll : rc->sq_poll) ? 1 : 0;
     uint64_t oldest = 0;
     for (auto &kv : rc->reqs) {
       Req *r = kv.second;
@@ -1198,11 +1577,23 @@ int64_t strom_ring_restart(strom_engine *e, uint32_t ring,
     if (rc->ring.init(e->queue_depth * 2)) {
       rc->ring.try_register(e->pool, e->buf_cap,
                             e->n_buffers * e->n_rings);
+      if (e->reg_files_enabled) {
+        /* Fresh uring, fresh registrations: re-register the CURRENT
+         * slot table (files_mu is a leaf lock under the ring mutex) so
+         * files opened before the restart keep their fixed slots.
+         * init() preserved want_sqpoll, so SQPOLL re-arms identically.
+         */
+        std::lock_guard<std::mutex> fg(e->files_mu);
+        rc->ring.try_register_files(e->reg_fds.data(),
+                                    STROM_REG_FILE_SLOTS);
+      }
       rc->reaper = std::thread([rc] { rc->reaper_loop(); });
     } else {
       /* Rebuild refused (fd limits, kernel state): fall back to the
        * worker-pool backend so the ring keeps serving. */
       rc->use_uring = false;
+      rc->sq_poll = e->sqpoll_enabled;
+      rc->sq_idle_ns = (uint64_t)e->sqpoll_idle_ms * 1000000ull;
       uint32_t nw = e->queue_depth < 32 ? e->queue_depth : 32;
       for (uint32_t i = 0; i < nw; i++)
         rc->workers.emplace_back([rc] { rc->worker_loop(); });
@@ -1524,24 +1915,90 @@ int strom_open(strom_engine *e, const char *path, int flags) {
     if (fdd >= 0) close(fdd);
     return err;
   }
-  std::lock_guard<std::mutex> g(e->files_mu);
-  int fh = e->next_fh++;
-  FileEnt fe;
-  fe.fd_direct = fdd;
-  fe.fd_buffered = fdb;
-  fe.size = (int64_t)st.st_size;
-  fe.writable = writable != 0;
-  e->files[fh] = fe;
+  int fh;
+  int slot_b = -1, slot_d = -1;
+  {
+    std::lock_guard<std::mutex> g(e->files_mu);
+    fh = e->next_fh++;
+    FileEnt fe;
+    fe.fd_direct = fdd;
+    fe.fd_buffered = fdb;
+    fe.size = (int64_t)st.st_size;
+    fe.writable = writable != 0;
+    if (e->reg_files_enabled) {
+      /* Dynamic slot table: point registered slots at the new fds so
+       * hot submissions ride IOSQE_FIXED_FILE.  Table full / kernel
+       * refusal leaves the slots -1 — raw-fd submission, never an
+       * error.  Slots are claimed (and reg_fds filled) HERE under
+       * files_mu; the per-ring syscalls run below under restart_mu. */
+      fe.slot_buffered = slot_b = e->reg_alloc_slot(fdb);
+      fe.slot_direct = slot_d = e->reg_alloc_slot(fdd);
+    }
+    e->files[fh] = fe;
+  }
+  if (slot_b >= 0 || slot_d >= 0) {
+    /* restart_mu excludes hot restarts (the only writer of a ring's
+     * uring fd), so the FILES_UPDATE syscalls can never race a
+     * teardown/rebuild onto a recycled descriptor.  Either ordering
+     * with a restart is consistent: reg_fds already carries the new
+     * fds, so a racing rebuild re-registers the complete table. */
+    std::lock_guard<std::mutex> rg(e->restart_mu);
+    if (slot_b >= 0) e->reg_update_all((uint32_t)slot_b, fdb);
+    if (slot_d >= 0) e->reg_update_all((uint32_t)slot_d, fdd);
+  }
   return fh;
 }
 
 int strom_close(strom_engine *e, int fh) {
-  std::lock_guard<std::mutex> g(e->files_mu);
-  auto it = e->files.find(fh);
-  if (it == e->files.end()) return -EBADF;
-  if (it->second.fd_direct >= 0) close(it->second.fd_direct);
-  close(it->second.fd_buffered);
-  e->files.erase(it);
+  int slot_b, slot_d, fdd, fdb;
+  {
+    std::lock_guard<std::mutex> g(e->files_mu);
+    auto it = e->files.find(fh);
+    if (it == e->files.end()) return -EBADF;
+    slot_b = it->second.slot_buffered;
+    slot_d = it->second.slot_direct;
+    fdd = it->second.fd_direct;
+    fdb = it->second.fd_buffered;
+    /* Table entries go -1 FIRST (a restart's re-register must not
+     * resurrect slots for fds about to close); the slots become
+     * re-allocatable only after the rings were updated below. */
+    e->reg_clear_slot(slot_b);
+    e->reg_clear_slot(slot_d);
+    e->files.erase(it);
+  }
+  if (slot_b >= 0 || slot_d >= 0) {
+    bool drained = true;
+    {
+      std::lock_guard<std::mutex> rg(e->restart_mu);
+      /* A published-but-unconsumed SQE resolves IOSQE_FIXED_FILE slots
+       * at CONSUMPTION time (SQPOLL thread / later flush): drain every
+       * ring's SQ first, so any straggler referencing these slots
+       * still resolves to OUR fds (held open until below).  Only then
+       * may the slots point elsewhere. */
+      for (auto &rcp : e->rings) {
+        RingCtx *rc = rcp.get();
+        if (rc->use_uring && rc->ring.reg_files)
+          drained = rc->ring.drain_sq() && drained;
+      }
+      if (slot_b >= 0) e->reg_update_all((uint32_t)slot_b, -1);
+      if (slot_d >= 0) e->reg_update_all((uint32_t)slot_d, -1);
+    }
+    std::lock_guard<std::mutex> g(e->files_mu);
+    if (drained) {
+      e->reg_recycle_slot(slot_b);
+      e->reg_recycle_slot(slot_d);
+    }
+    /* !drained: LEAK the slot ids — a slot that might still be named
+     * by an un-consumed SQE must never be recycled to another file
+     * (the table entry is already -1, so nothing NEW can use it; the
+     * 128-slot budget degrades to raw-fd submission long before this
+     * matters). */
+  }
+  /* fds close LAST: every registered slot that pointed at them is
+   * cleared, so no straggler submission can land in a recycled
+   * descriptor. */
+  if (fdd >= 0) close(fdd);
+  close(fdb);
   return 0;
 }
 
@@ -1898,6 +2355,7 @@ void strom_get_stats(strom_engine *e, strom_stats_blk *out) {
   out->submit_batches = e->st_batches.load(std::memory_order_relaxed);
   out->submit_syscalls_saved =
       e->st_sysc_saved.load(std::memory_order_relaxed);
+  out->submit_enters = e->st_enters.load(std::memory_order_relaxed);
 }
 
 void strom_drain_stats(strom_engine *e, strom_stats_blk *out) {
@@ -1914,12 +2372,14 @@ void strom_drain_stats(strom_engine *e, strom_stats_blk *out) {
   out->submit_batches = e->st_batches.exchange(0, std::memory_order_acq_rel);
   out->submit_syscalls_saved =
       e->st_sysc_saved.exchange(0, std::memory_order_acq_rel);
+  out->submit_enters = e->st_enters.exchange(0, std::memory_order_acq_rel);
 }
 
 void strom_reset_stats(strom_engine *e) {
   e->st_direct = 0; e->st_fallback = 0; e->st_bounce = 0; e->st_written = 0;
   e->st_sub = 0; e->st_comp = 0; e->st_fail = 0; e->st_retry = 0;
   e->st_resident = 0; e->st_batches = 0; e->st_sysc_saved = 0;
+  e->st_enters = 0;
   for (int i = 0; i < STROM_LAT_BUCKETS; i++) {
     e->lat_read[i].store(0, std::memory_order_relaxed);
     e->lat_write[i].store(0, std::memory_order_relaxed);

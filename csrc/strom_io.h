@@ -60,7 +60,20 @@ typedef struct strom_stats_blk {
                                     (io_uring_enter doorbells on the uring
                                     backend).  Extents that defer on pool
                                     pressure ring their own doorbell later
-                                    and are never credited.                 */
+                                    and are never credited.  With SQPOLL
+                                    active this ALSO counts every doorbell
+                                    the poller made unnecessary (an
+                                    io_uring_enter the submitter skipped
+                                    because the SQ thread was awake; the
+                                    worker-pool backend counts elided
+                                    dispatch wakeups the same way).         */
+  uint64_t submit_enters;        /* submission doorbells actually rung:
+                                    io_uring_enter submit/wakeup calls on
+                                    the uring backend, dispatch wakeups on
+                                    the worker pool.  enters/GiB is the
+                                    steady-state submission-syscall rate
+                                    bench.py's overlap scenario prices;
+                                    SQPOLL drives it toward zero.           */
 } strom_stats_blk;
 
 typedef struct strom_completion {
@@ -109,7 +122,23 @@ void strom_get_latency(strom_engine *eng,
  *                                   the stall engages (default 0)
  * The Python-level plan (nvme_strom_tpu/io/faults.py) is richer and
  * deterministic; these knobs exist to exercise the native completion
- * path itself. */
+ * path itself.
+ *
+ * Zero-copy submission knobs (PR 12; also read at create time):
+ *   STROM_REG_FILES=0     disable the registered-file slot table
+ *                         (default on; soft-fails on kernels without
+ *                         sparse IORING_REGISTER_FILES support)
+ *   STROM_SQPOLL=1        enable SQPOLL: the uring backend sets
+ *                         IORING_SETUP_SQPOLL so a kernel thread
+ *                         consumes SQEs without io_uring_enter; the
+ *                         worker-pool backend runs the same state
+ *                         machine with polling workers (a dispatch
+ *                         whose poller is awake skips the wakeup).
+ *                         Default off: the poller burns a core.
+ *   STROM_SQPOLL_IDLE_MS  poller idle budget before it sleeps and
+ *                         submissions need a wakeup doorbell again
+ *                         (default 50)
+ */
 strom_engine *strom_engine_create(uint32_t queue_depth, uint32_t n_buffers,
                                   uint64_t buf_bytes, uint32_t alignment,
                                   int use_io_uring, int lock_buffers);
@@ -139,6 +168,38 @@ strom_engine *strom_engine_create_rings(uint32_t n_rings,
                                         int use_io_uring, int lock_buffers);
 void strom_engine_destroy(strom_engine *eng);
 
+/* ---- unified pinned arena (io/arena.py, PR 12) ----------------------
+ * ONE anonymous reservation (MAP_NORESERVE: virtual until touched) the
+ * Python allocator carves into engine staging slices, host-cache lines
+ * and bridge DMA slabs — one mmap, one mlock policy, zero copies
+ * between pinned regions.  strom_arena_lock pins one carve (best
+ * effort: returns 0 or -errno; RLIMIT_MEMLOCK refusal is not fatal). */
+void *strom_arena_create(uint64_t bytes);
+void strom_arena_destroy(void *base, uint64_t bytes);
+int strom_arena_lock(void *base, uint64_t bytes);
+
+/* Exact staging-pool footprint strom_engine_create_rings would map for
+ * this geometry (buf_cap slack included) — what the arena carve for a
+ * preallocated engine must provide.  0 on invalid geometry. */
+uint64_t strom_engine_pool_bytes(uint32_t n_rings, uint32_t n_buffers,
+                                 uint64_t buf_bytes, uint32_t alignment);
+
+/* strom_engine_create_rings over a CALLER-OWNED staging pool (an arena
+ * carve): the engine stages/DMA-targets/registers `pool` exactly as it
+ * would its own mapping but never munmaps it — the arena outlives the
+ * engine.  `pool_bytes` must be >= strom_engine_pool_bytes(...) and
+ * `pool` alignment-conformant (the arena carves page-aligned).  NULL +
+ * errno on failure, like strom_engine_create. */
+strom_engine *strom_engine_create_prealloc(uint32_t n_rings,
+                                           uint32_t queue_depth,
+                                           uint32_t n_buffers,
+                                           uint64_t buf_bytes,
+                                           uint32_t alignment,
+                                           int use_io_uring,
+                                           int lock_buffers,
+                                           void *pool,
+                                           uint64_t pool_bytes);
+
 /* Per-ring introspection: the scheduler's dispatch decisions key off
  * in-flight queue depth (submitted - completed, lock-free atomics — the
  * poll can run at dispatch frequency without touching the ring mutex);
@@ -166,6 +227,17 @@ typedef struct strom_ring_info {
                               reap-side stall detector: a completion
                               that never arrives shows up here as an
                               age that only grows.                      */
+  /* Zero-copy submission state (PR 12): a silently-unregistered pool or
+   * slot table is SLOW, not broken — these gauges make it visible in
+   * strom_stat's engine block instead of only in a flamegraph. */
+  int32_t  fixed_bufs;     /* staging pool registered as fixed buffers
+                              with this ring's uring (pin-once DMA)     */
+  int32_t  reg_files;      /* fd slot table registered (hot submissions
+                              skip the per-op fget via IOSQE_FIXED_FILE) */
+  int32_t  sqpoll;         /* 1 while this ring's submissions are
+                              consumed by a kernel SQPOLL thread (uring)
+                              or a polling worker (worker-pool analogue)
+                              — steady-state submission needs no doorbell */
 } strom_ring_info;
 
 int strom_ring_count(strom_engine *eng);

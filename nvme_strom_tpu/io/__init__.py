@@ -1,3 +1,8 @@
+from nvme_strom_tpu.io.arena import (
+    PinnedArena,
+    Slab,
+    get_arena,
+)
 from nvme_strom_tpu.io.engine import (
     StromEngine,
     PendingRead,
@@ -55,7 +60,8 @@ from nvme_strom_tpu.io.sched import (
     default_policies,
 )
 
-__all__ = ["StromEngine", "PendingRead", "PendingWrite", "FileInfo",
+__all__ = ["PinnedArena", "Slab", "get_arena",
+           "StromEngine", "PendingRead", "PendingWrite", "FileInfo",
            "DeviceInfo", "Extent", "check_file", "resolve_device",
            "file_extents", "file_eligible", "wait_exact",
            "FaultPlan", "FaultSpec", "FaultyEngine", "build_engine",

@@ -1,0 +1,305 @@
+"""Unified pinned host arena — ONE mapping behind every pinned consumer.
+
+Before this module each pinned region was its own mmap/mlock: the
+engine's staging pool, the host-cache line arena (io/hostcache.py), and
+any bridge-side DMA source each paid their own mapping, their own lock
+policy, and — the real cost — their own identity: a byte could only
+move between them by copy.  The arena collapses them into ONE
+reservation (``strom_arena_create``: anonymous ``MAP_NORESERVE``
+memory, virtual until touched) that a simple first-fit allocator carves
+into tagged slabs:
+
+  ``staging``    engine staging pools (``strom_engine_create_prealloc``
+                 stages, DMA-targets, and registers the carve as fixed
+                 buffers exactly as it would its own mapping — but
+                 never unmaps it);
+  ``hostcache``  the pinned cache-line arena;
+  ``bridge``     the overlap pipeline's ping-pong host→HBM DMA slabs
+                 (ops/bridge.py).
+
+Pages commit (and best-effort mlock, gated by ``STROM_MLOCK``) per
+CARVE, so a generous reservation costs nothing until used.  A carve
+that cannot fit falls back to the consumer's private pre-arena path —
+counted as ``arena_fallbacks``, never an error.
+
+``STROM_ARENA=0`` removes the module entirely: every consumer takes its
+exact pre-arena path, bit-for-bit (proven by test).  ``STROM_ARENA_MB``
+sizes the reservation (default 1024 — virtual).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: carve alignment: every slab starts O_DIRECT/page aligned, so an
+#: engine pool carved here is exactly as alignment-conformant as its
+#: own anonymous mapping would have been
+CARVE_ALIGN = 4096
+
+
+class Slab:
+    """One tagged carve of the arena: a zero-copy numpy view plus the
+    base address consumers hand to the C ABI.  ``release()`` returns
+    the range to the arena's free list (idempotent)."""
+
+    __slots__ = ("arena", "offset", "nbytes", "tag", "addr", "view",
+                 "locked", "_released")
+
+    def __init__(self, arena: "PinnedArena", offset: int, nbytes: int,
+                 tag: str):
+        self.arena = arena
+        self.offset = offset
+        self.nbytes = nbytes
+        self.tag = tag
+        self.addr = arena.base + offset
+        self.view = arena.view[offset:offset + nbytes]
+        #: did THIS carve's mlock hold (set by carve; consumers that
+        #: report pin state — hostcache's ``arena_locked`` — read the
+        #: slab's own verdict, not arena-wide history)
+        self.locked = False
+        self._released = False
+
+    def release(self) -> None:
+        if self._released:
+            return
+        self._released = True
+        self.view = None
+        self.arena._free(self.offset, self.nbytes, locked=self.locked)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+class PinnedArena:
+    """First-fit slab allocator over one native reservation.
+
+    Thread-safe.  The free list keeps ``(offset, nbytes)`` ranges sorted
+    by offset and coalesces neighbours on release, so carve/release
+    churn (tests build and tear down many engines) cannot fragment the
+    arena into uselessness.  Accounting (:meth:`carves`) is exact:
+    tagged bytes are disjoint by construction and sum with the free
+    ranges to the arena size — the invariant tests/test_arena.py pins.
+    """
+
+    def __init__(self, nbytes: int, lock_pages: bool = True):
+        if nbytes <= 0:
+            raise ValueError("arena nbytes must be > 0")
+        nbytes = (nbytes + CARVE_ALIGN - 1) // CARVE_ALIGN * CARVE_ALIGN
+        self.nbytes = nbytes
+        self.lock_pages = lock_pages
+        self._lock = threading.Lock()
+        self._free_list: List[Tuple[int, int]] = [(0, nbytes)]
+        self._carved: Dict[int, Tuple[int, str]] = {}   # off → (n, tag)
+        self._lib = None
+        self._base: Optional[int] = None
+        self.locked_bytes = 0
+        try:
+            from nvme_strom_tpu.io.engine import _load_lib
+            # private CDLL handle (ctypes caches one function object per
+            # CDLL instance; sharing would let another module's argtypes
+            # assignment silently retype ours — the PR-5 lesson)
+            lib = ctypes.CDLL(_load_lib()._name)
+            lib.strom_arena_create.restype = ctypes.c_void_p
+            lib.strom_arena_create.argtypes = [ctypes.c_uint64]
+            lib.strom_arena_destroy.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_uint64]
+            lib.strom_arena_lock.restype = ctypes.c_int
+            lib.strom_arena_lock.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_uint64]
+            base = lib.strom_arena_create(nbytes)
+            if base:
+                self._base = int(base)
+                self._lib = lib
+        except Exception:
+            self._base = None
+        if self._base is None:
+            # trimmed install / exotic kernel: a plain numpy buffer is
+            # unpinned but carves identically — consumers never notice
+            self._buf = np.zeros(nbytes, dtype=np.uint8)
+            self.base = self._buf.ctypes.data
+            self.view = self._buf
+        else:
+            self.base = self._base
+            self.view = np.ctypeslib.as_array(
+                ctypes.cast(self._base,
+                            ctypes.POINTER(ctypes.c_uint8)),
+                shape=(nbytes,))
+        self._closed = False
+
+    # -- allocation --------------------------------------------------------
+
+    def carve(self, nbytes: int, tag: str,
+              lock: Optional[bool] = None) -> Optional[Slab]:
+        """First-fit carve of ``nbytes`` (page-rounded) tagged ``tag``;
+        None when no free range fits (the caller falls back to its
+        private pre-arena path and counts ``arena_fallbacks``).
+
+        ``lock``: pin THIS carve (mlock).  None adopts the arena's
+        ``STROM_MLOCK`` policy; a consumer that opted out of pinning
+        (``EngineConfig.lock_buffers=False``,
+        ``HostCacheConfig.lock_arena=False``) passes False so its
+        RLIMIT_MEMLOCK budget is honored exactly as pre-arena."""
+        if nbytes <= 0:
+            raise ValueError(f"carve nbytes must be > 0, got {nbytes}")
+        need = (nbytes + CARVE_ALIGN - 1) // CARVE_ALIGN * CARVE_ALIGN
+        with self._lock:
+            if self._closed:
+                return None
+            for i, (off, ln) in enumerate(self._free_list):
+                if ln >= need:
+                    if ln == need:
+                        self._free_list.pop(i)
+                    else:
+                        self._free_list[i] = (off + need, ln - need)
+                    self._carved[off] = (need, tag)
+                    break
+            else:
+                return None
+        # pin per carve, outside the lock (mlock faults the pages in —
+        # that is the point: a fill/DMA must never page-fault later);
+        # best effort, RLIMIT_MEMLOCK refusal leaves it unpinned
+        slab = Slab(self, off, need, tag)
+        want_lock = self.lock_pages if lock is None else lock
+        if want_lock and self._lib is not None:
+            if self._lib.strom_arena_lock(self.base + off, need) == 0:
+                slab.locked = True
+                with self._lock:
+                    self.locked_bytes += need
+        return slab
+
+    def _free(self, offset: int, nbytes: int, locked: bool = False) -> None:
+        with self._lock:
+            got = self._carved.pop(offset, None)
+            if got is None or got[0] != nbytes:
+                return   # double free / foreign range: refuse silently
+            if locked:
+                # the gauge tracks bytes pinned by LIVE carves (munlock
+                # is deliberately skipped — the pages recycle pinned,
+                # which only helps the next carve — but re-locking them
+                # re-adds, so without this decrement the gauge would
+                # drift past the arena size under carve churn)
+                self.locked_bytes = max(0, self.locked_bytes - nbytes)
+            # insert sorted + coalesce with neighbours
+            fl = self._free_list
+            lo, hi = 0, len(fl)
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if fl[mid][0] < offset:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            fl.insert(lo, (offset, nbytes))
+            if lo + 1 < len(fl) and fl[lo][0] + fl[lo][1] == fl[lo + 1][0]:
+                fl[lo] = (fl[lo][0], fl[lo][1] + fl[lo + 1][1])
+                fl.pop(lo + 1)
+            if lo > 0 and fl[lo - 1][0] + fl[lo - 1][1] == fl[lo][0]:
+                fl[lo - 1] = (fl[lo - 1][0], fl[lo - 1][1] + fl[lo][1])
+                fl.pop(lo)
+
+    # -- introspection -----------------------------------------------------
+
+    def carves(self) -> Dict[str, int]:
+        """Bytes carved per tag (exact; disjoint by construction)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for _off, (n, tag) in self._carved.items():
+                out[tag] = out.get(tag, 0) + n
+            return out
+
+    @property
+    def bytes_carved(self) -> int:
+        with self._lock:
+            return sum(n for n, _t in self._carved.values())
+
+    @property
+    def bytes_free(self) -> int:
+        with self._lock:
+            return sum(ln for _off, ln in self._free_list)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.view = None
+        if self._base is not None:
+            self._lib.strom_arena_destroy(self._base, self.nbytes)
+            self._base = None
+
+
+# ---------------------------------------------------------------------------
+# module singleton — one reservation per process
+# ---------------------------------------------------------------------------
+
+_singleton_lock = threading.Lock()
+_arena: Optional[PinnedArena] = None
+_arena_init = False
+
+
+def _build_locked() -> None:
+    global _arena, _arena_init
+    if _arena is not None:
+        _arena.close()
+        _arena = None
+    new = None
+    if os.environ.get("STROM_ARENA", "1") != "0":
+        try:
+            mb = int(os.environ.get("STROM_ARENA_MB", 1024))
+        except ValueError:
+            mb = 1024
+        if mb > 0:
+            lock = os.environ.get("STROM_MLOCK", "1") != "0"
+            try:
+                new = PinnedArena(mb << 20, lock_pages=lock)
+            except Exception:
+                new = None   # no arena is always safe: private mmaps
+    _arena = new
+    _arena_init = True
+
+
+def get_arena() -> Optional[PinnedArena]:
+    """The process-wide arena, built lazily from the environment; None
+    when ``STROM_ARENA=0`` (every consumer then takes its exact
+    pre-arena path).  Double-checked under the lock."""
+    if _arena_init:
+        return _arena
+    with _singleton_lock:
+        if not _arena_init:
+            _build_locked()
+        return _arena
+
+
+def reset() -> None:
+    """Tear the singleton down; the next :func:`get_arena` re-reads the
+    environment (tests toggle the arena this way).  Callers must have
+    released their slabs — a live slab view into a closed arena is the
+    same contract breach as using a staging view after close_all."""
+    global _arena, _arena_init
+    with _singleton_lock:
+        if _arena is not None:
+            _arena.close()
+        _arena = None
+        _arena_init = False
+
+
+def carve_or_none(nbytes: int, tag: str, stats=None,
+                  lock: Optional[bool] = None) -> Optional[Slab]:
+    """One-line consumer helper: carve from the process arena, or None
+    (arena off / exhausted — counted ``arena_fallbacks`` when a stats
+    block rides along, so budget starvation is visible).  ``lock``
+    threads the consumer's own pinning choice through to the carve."""
+    a = get_arena()
+    if a is None:
+        return None
+    slab = a.carve(nbytes, tag, lock=lock)
+    if slab is None and stats is not None:
+        stats.add(arena_fallbacks=1)
+    return slab

@@ -86,7 +86,7 @@ class _StatsBlk(ctypes.Structure):
         "bytes_direct", "bytes_fallback", "bounce_bytes",
         "bytes_written_direct", "requests_submitted", "requests_completed",
         "requests_failed", "retries", "bytes_resident",
-        "submit_batches", "submit_syscalls_saved")]
+        "submit_batches", "submit_syscalls_saved", "submit_enters")]
 
 
 class _RdExt(ctypes.Structure):
@@ -132,6 +132,12 @@ class _RingInfo(ctypes.Structure):
         ("parked", ctypes.c_uint32),
         ("stalled", ctypes.c_int32),
         ("oldest_inflight_ns", ctypes.c_uint64),
+        # zero-copy submission state (PR 12): fixed-buffer registration,
+        # registered-file slot table, SQPOLL mode — per-ring gauges so a
+        # silently-unregistered pool is visible instead of just slow
+        ("fixed_bufs", ctypes.c_int32),
+        ("reg_files", ctypes.c_int32),
+        ("sqpoll", ctypes.c_int32),
     ]
 
 
@@ -193,6 +199,20 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_engine_create_rings.argtypes = [
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
             ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int, ctypes.c_int]
+        lib.strom_engine_create_prealloc.restype = ctypes.c_void_p
+        lib.strom_engine_create_prealloc.argtypes = [
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_uint64]
+        lib.strom_engine_pool_bytes.restype = ctypes.c_uint64
+        lib.strom_engine_pool_bytes.argtypes = [
+            ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_uint32]
+        lib.strom_arena_create.restype = ctypes.c_void_p
+        lib.strom_arena_create.argtypes = [ctypes.c_uint64]
+        lib.strom_arena_destroy.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_uint64]
+        lib.strom_arena_lock.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.strom_ring_count.argtypes = [ctypes.c_void_p]
         lib.strom_get_ring_info.argtypes = [ctypes.c_void_p,
                                             ctypes.c_uint32,
@@ -750,9 +770,32 @@ class StromEngine:
                              c.queue_depth))
         qd_ring = max(1, c.queue_depth // n_rings)
         bufs_ring = max(2, n_buffers // n_rings)
-        self._h = self._lib.strom_engine_create_rings(
-            n_rings, qd_ring, bufs_ring, c.chunk_bytes, c.alignment,
-            1 if c.use_io_uring else 0, 1 if c.lock_buffers else 0)
+        # Unified pinned arena (io/arena.py, docs/PERF.md §6): carve the
+        # staging pool out of the ONE process reservation so staging,
+        # cache lines and bridge slabs share a single mapping + lock
+        # policy.  Arena off/exhausted → the engine maps its own pool,
+        # the exact pre-arena path (arena_fallbacks counts exhaustion).
+        self._pool_slab = None
+        from nvme_strom_tpu.io import arena as _arena
+        pool_bytes = int(self._lib.strom_engine_pool_bytes(
+            n_rings, bufs_ring, c.chunk_bytes, c.alignment))
+        slab = (_arena.carve_or_none(pool_bytes, "staging",
+                                     stats=self.stats,
+                                     lock=c.lock_buffers)
+                if pool_bytes else None)
+        if slab is not None:
+            self._h = self._lib.strom_engine_create_prealloc(
+                n_rings, qd_ring, bufs_ring, c.chunk_bytes, c.alignment,
+                1 if c.use_io_uring else 0, 1 if c.lock_buffers else 0,
+                slab.addr, slab.nbytes)
+            if not self._h:
+                slab.release()
+                slab = None
+        if slab is None:
+            self._h = self._lib.strom_engine_create_rings(
+                n_rings, qd_ring, bufs_ring, c.chunk_bytes, c.alignment,
+                1 if c.use_io_uring else 0, 1 if c.lock_buffers else 0)
+        self._pool_slab = slab
         if not self._h:
             raise OSError(ctypes.get_errno(),
                           "strom_engine_create failed: "
@@ -800,6 +843,11 @@ class StromEngine:
             self._metrics_writer.set_sync(self.sync_stats)
         else:
             self._metrics_writer = None
+        # per-ring registration/SQPOLL gauge cache (refreshed only at
+        # create and ring restart; sync_stats exports it without the
+        # per-sync ring_info walk)
+        self._zc_gauges = None
+        self._refresh_zc_gauges()
         self.scheduler = None
         if n_rings > 1:
             from nvme_strom_tpu.utils.config import SchedConfig
@@ -959,6 +1007,19 @@ class StromEngine:
             free = self.supervisor.mask_free_slots(free)
         return free
 
+    def _refresh_zc_gauges(self) -> None:
+        """Snapshot the per-ring registration/SQPOLL state (changes only
+        at engine create and ring restart — the two callers)."""
+        try:
+            ri = [self.ring_info(r) for r in range(self.n_rings)]
+            self._zc_gauges = dict(
+                ring_fixed_bufs=[r["fixed_bufs"] for r in ri],
+                ring_reg_files=[r["reg_files"] for r in ri],
+                ring_sqpoll=[r["sqpoll"] for r in ri],
+                pool_arena=1 if self._pool_slab is not None else 0)
+        except OSError:
+            self._zc_gauges = None
+
     def ring_restart(self, ring: int, drain_timeout_s: float = 0.5) -> int:
         """Hot-restart one ring (``strom_ring_restart``): cancel its
         stall-parked backlog (-ECANCELED — the waiters' retry loop is
@@ -975,6 +1036,10 @@ class StromEngine:
                 f"{drain_timeout_s}s; restart aborted")
         if rc < 0:
             raise OSError(-rc, os.strerror(-rc))
+        # the rebuilt uring re-registered buffers/files and re-armed
+        # SQPOLL (or fell back to the worker pool): refresh the cached
+        # registration gauges sync_stats exports
+        self._refresh_zc_gauges()
         return int(rc)
 
     def set_ring_stall(self, ring: int, on: bool = True) -> None:
@@ -1228,6 +1293,16 @@ class StromEngine:
             # instantaneous per-ring queue depth: the scheduler block in
             # strom_stat/watchdog reads these next to the sched counters
             self.stats.set_gauges(ring_depths=self.ring_depths())
+        # zero-copy submission state (docs/PERF.md §6): per-ring
+        # fixed-buffer / registered-file / SQPOLL gauges, so a try_register
+        # that silently soft-failed (old kernel, RLIMIT_MEMLOCK) shows in
+        # strom_stat's engine block instead of only as missing throughput.
+        # Served from the cache refreshed at create/restart — this state
+        # only changes then, and the full strom_get_ring_info walk holds
+        # each ring mutex over its request map, too heavy for a path the
+        # watchdog and metrics writer hit at stat frequency.
+        if self._zc_gauges is not None:
+            self.stats.set_gauges(**self._zc_gauges)
         if self.supervisor is not None:
             # a stat sync is a natural supervision heartbeat, and the
             # health gauges (ring_health / engine_degraded) ride the
@@ -1262,6 +1337,11 @@ class StromEngine:
         self.sync_stats()  # drains counters and exports the final snapshot
         self._lib.strom_engine_destroy(self._h)
         self._closed = True
+        if self._pool_slab is not None:
+            # the staging carve returns to the arena only AFTER destroy
+            # drained every in-flight DMA targeting it
+            self._pool_slab.release()
+            self._pool_slab = None
 
     def __enter__(self):
         return self
@@ -1274,5 +1354,9 @@ class StromEngine:
             if not getattr(self, "_closed", True):
                 self._lib.strom_engine_destroy(self._h)
                 self._closed = True
+                slab = getattr(self, "_pool_slab", None)
+                if slab is not None:
+                    slab.release()
+                    self._pool_slab = None
         except Exception:
             pass

@@ -98,6 +98,41 @@ class _Arena:
         self.locked = False
         self._base: Optional[int] = None
         self._lib = None
+        self._slab = None
+        # Unified arena first (io/arena.py, docs/PERF.md §6): cache
+        # lines share ONE reservation with staging pools and bridge
+        # slabs instead of owning a second mapping.  The carve is
+        # mlock'd (pages fault in then) under the same STROM_MLOCK
+        # policy; carve refused/arena off → the private pre-arena
+        # mapping below, bit-for-bit.
+        try:
+            from nvme_strom_tpu.io import arena as _arena
+            from nvme_strom_tpu.utils.stats import global_stats
+            # the tier is built engine-agnostically, so a refused carve
+            # lands in the process-global block — starvation of the
+            # LARGEST intended arena consumer must not be silent
+            slab = _arena.carve_or_none(nbytes, "hostcache",
+                                        stats=global_stats,
+                                        lock=lock_pages)
+        except Exception:
+            slab = None
+        if slab is not None:
+            self._slab = slab
+            self._base = slab.addr
+            self.view = slab.view
+            self.locked = bool(slab.locked)   # THIS carve's mlock verdict
+            try:
+                from nvme_strom_tpu.io.engine import _load_lib
+                lib = ctypes.CDLL(_load_lib()._name)
+                lib.strom_hostcache_copy.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+                self._lib = lib
+            except Exception:
+                # numpy-backed arena (trimmed install): copy_in's
+                # _lib-is-None branch serves fills — unpinned but
+                # functional, the documented degradation
+                self._lib = None
+            return
         try:
             from nvme_strom_tpu.io.engine import _load_lib
             # private CDLL handle: ctypes caches one function object per
@@ -142,6 +177,12 @@ class _Arena:
             self.view[dst_off:dst_off + n] = src.reshape(-1)
 
     def close(self) -> None:
+        if self._slab is not None:
+            self.view = None
+            self._base = None
+            self._slab.release()   # the carve recycles; the arena lives
+            self._slab = None
+            return
         if self._base is not None:
             self.view = None
             self._lib.strom_hostcache_arena_destroy(self._base,

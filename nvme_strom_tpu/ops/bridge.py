@@ -15,6 +15,7 @@ The staging buffer is released back to the pool only after
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -27,6 +28,167 @@ from nvme_strom_tpu.utils.config import EngineConfig
 def _default_device():
     import jax
     return jax.local_devices()[0]
+
+
+def overlap_env_enabled() -> bool:
+    """Global kill switch of the double-buffered host→HBM stage:
+    ``STROM_BRIDGE_OVERLAP=0`` restores today's wait→device_put path
+    bit-for-bit, even for streams constructed with ``overlap=True``
+    (an off-switch that explicit call sites could override would not
+    be an off-switch)."""
+    return os.environ.get("STROM_BRIDGE_OVERLAP", "1") != "0"
+
+
+#: per-device cache of the jitted Pallas host→HBM DMA callable
+_H2D_DMA_CACHE: dict = {}
+
+
+def _pallas_h2d(dev):
+    """Jitted Pallas kernel DMA'ing a pinned-host array into device HBM
+    (SNIPPETS.md [2]'s pinned-host→HBM ``pltpu.async_copy`` pattern).
+    The copy runs on the device's DMA engines, asynchronously to the
+    Python thread — which is what lets the NVMe read of chunk K+1
+    overlap the host→HBM hop of chunk K."""
+    fn = _H2D_DMA_CACHE.get(dev)
+    if fn is not None:
+        return fn
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def _dma_kernel(x_ref, y_ref):
+        def body(sem):
+            pltpu.make_async_copy(x_ref, y_ref, sem).wait()
+
+        pl.run_scoped(body, pltpu.SemaphoreType.DMA)
+
+    @jax.jit
+    def _call(x):
+        return pl.pallas_call(
+            _dma_kernel,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        )(x)
+
+    _H2D_DMA_CACHE[dev] = _call
+    return _call
+
+
+class OverlapStage:
+    """Double-buffered host→HBM stage of ``DeviceStream.stream_ranges``
+    (docs/PERF.md §6).
+
+    Two ping-pong slabs carved from the unified pinned arena
+    (io/arena.py, tag ``bridge``; private buffers when the arena is
+    off/full).  Per chunk: the completed — and verified — staging view
+    is memcpy'd into the next slab, the STAGING buffer releases
+    immediately (the NVMe read of chunk K+1 can start while chunk K is
+    still in flight to the device), and the device transfer launches
+    asynchronously off the slab.  A slab is never overwritten before
+    the transfer it sources reports ready — the rotation invariant
+    tests/test_bridge.py pins with a fake transfer.
+
+    ``transfer(host_view, dtype, shape) -> device_array`` is injectable
+    (tests, exotic transports); the default is the Pallas
+    pinned-host→HBM DMA on a TPU device and the alias-safe
+    ``host_to_device`` everywhere else.
+    """
+
+    def __init__(self, engine: StromEngine, dev, chunk_bytes: int,
+                 transfer: Optional[Callable] = None):
+        from nvme_strom_tpu.io import arena as _arena
+        self.engine = engine
+        self.dev = dev
+        self.chunk_bytes = chunk_bytes
+        self._slabs: list = []       # numpy views, one per ping-pong slot
+        self._carves: list = []      # arena Slab objects (None = private)
+        for _ in range(2):
+            slab = _arena.carve_or_none(chunk_bytes, "bridge",
+                                        stats=engine.stats)
+            if slab is not None:
+                self._carves.append(slab)
+                self._slabs.append(slab.view)
+            else:
+                self._carves.append(None)
+                self._slabs.append(np.empty(chunk_bytes, dtype=np.uint8))
+        self._busy: list = [None, None]   # device array sourcing slot k
+        self._k = 0
+        self._transfer = transfer
+        self._pallas_ok = dev.platform == "tpu"
+
+    # -- transfer backends -------------------------------------------------
+
+    def _default_transfer(self, host: np.ndarray, dtype, shape):
+        import jax
+        arr = host if dtype is None else host.view(dtype)
+        if shape is not None:
+            arr = arr.reshape(shape)
+        if self._pallas_ok:
+            try:
+                # pinned-host residency first (one host copy at DRAM
+                # speed), then the Pallas DMA moves it to HBM on the
+                # device's own engines — fully async to this thread
+                sharding = jax.sharding.SingleDeviceSharding(
+                    self.dev, memory_kind="pinned_host")
+                pinned = jax.device_put(arr, sharding)
+                out = _pallas_h2d(self.dev)(pinned)
+                self.engine.stats.add(bytes_to_device=int(host.nbytes))
+                return out
+            except Exception:
+                # kernels/memory-kinds unavailable on this runtime:
+                # degrade once to the plain path, stay correct
+                self._pallas_ok = False
+        return host_to_device(self.engine, arr, self.dev)
+
+    # -- the ping-pong rotation --------------------------------------------
+
+    def put(self, view: np.ndarray, dtype, shape):
+        """Stage one completed chunk view and launch its device
+        transfer; returns the device array, or None for a view larger
+        than the slabs (an oversized cache-line hit, say) — the CALLER
+        must then take the non-overlapped path and hold the source
+        until the transfer is ready (transferring straight off the
+        view here and letting the caller release it immediately would
+        let the buffer recycle under a live DMA).  Blocks only when
+        BOTH slabs still source in-flight transfers (depth-2
+        backpressure — by then the link, not the host, is the
+        bottleneck)."""
+        n = view.nbytes
+        if n > self.chunk_bytes:
+            return None
+        k = self._k
+        self._k ^= 1
+        prev = self._busy[k]
+        if prev is not None:
+            # slab-reuse gate: the transfer sourced from this slab must
+            # be done with the bytes before they are overwritten
+            prev.block_until_ready()
+            self._busy[k] = None
+        slab_view = self._slabs[k][:n]
+        slab_view[:] = view.reshape(-1).view(np.uint8)
+        arr = (self._transfer or self._default_transfer)(
+            slab_view, dtype, shape)
+        self._busy[k] = arr
+        self.engine.stats.add(overlap_chunks=1, overlap_bytes=int(n))
+        return arr
+
+    def close(self) -> None:
+        """Block out the in-flight transfers, then recycle the slabs
+        (a carve returned while a DMA still sources it would let the
+        next consumer overwrite live transfer bytes)."""
+        for i, arr in enumerate(self._busy):
+            if arr is not None:
+                try:
+                    arr.block_until_ready()
+                except Exception:
+                    pass
+                self._busy[i] = None
+        self._slabs = []
+        for slab in self._carves:
+            if slab is not None:
+                slab.release()
+        self._carves = []
 
 
 def split_ranges(spans, chunk: int):
@@ -153,7 +315,9 @@ class DeviceStream:
     """
 
     def __init__(self, engine: StromEngine, device=None, depth: int = 3,
-                 drain: str = "blocking", klass: Optional[str] = None):
+                 drain: str = "blocking", klass: Optional[str] = None,
+                 overlap: Optional[bool] = None,
+                 overlap_transfer: Optional[Callable] = None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
         if drain not in ("blocking", "ready"):
@@ -166,6 +330,23 @@ class DeviceStream:
         #: (io/sched.py; the per-stream default — stream_ranges can
         #: override per call)
         self.klass = klass
+        #: double-buffered host→HBM stage (docs/PERF.md §6).  None =
+        #: auto: engage on a TPU device when STROM_BRIDGE_OVERLAP
+        #: allows (the CPU fallback keeps the current device_put path
+        #: bit-for-bit — an extra slab copy would only cost there).
+        #: True forces the stage on any device (tests, measurements);
+        #: False disables for this stream; STROM_BRIDGE_OVERLAP=0
+        #: overrides everything.
+        self.overlap = overlap
+        #: injectable transfer callable for the stage (tests)
+        self.overlap_transfer = overlap_transfer
+
+    def _overlap_active(self, dev) -> bool:
+        if not overlap_env_enabled():
+            return False
+        if self.overlap is not None:
+            return self.overlap
+        return dev.platform == "tpu"
 
     def _put(self, view: np.ndarray, dtype, shape):
         dev = self.device or _default_device()
@@ -209,14 +390,25 @@ class DeviceStream:
         if klass is None:
             klass = self.klass
         pending: list = []   # (PendingRead, shape, range_index)
-        inflight: list = []  # (device_array, PendingRead)
+        inflight: list = []  # (device_array, PendingRead-or-None)
+        dev = self.device or _default_device()
+        # double-buffered host→HBM stage (docs/PERF.md §6): the staging
+        # buffer releases the moment its bytes land in a ping-pong slab,
+        # so the NVMe read of chunk K+1 overlaps the host→HBM DMA of
+        # chunk K instead of queueing behind it.  Inactive (None) =
+        # today's wait→device_put path, bit-for-bit.
+        stage = (OverlapStage(self.engine, dev,
+                              self.engine.config.chunk_bytes,
+                              transfer=self.overlap_transfer)
+                 if self._overlap_active(dev) else None)
 
         def drain_one():
             arr, pr = inflight.pop(0)
             with self.engine.tracer.span("strom.h2d.sync",
                                          bytes=int(arr.nbytes)):
                 arr.block_until_ready()  # device owns the bytes now
-            pr.release()
+            if pr is not None:
+                pr.release()
             return arr
 
         def drain_ready():
@@ -235,6 +427,10 @@ class DeviceStream:
             pr, shp, ri = pending.pop(0)
             view = pr.wait()
             if verify is not None:
+                # ordering contract (docs/PERF.md §6): the verify hook
+                # (and the host-tier fill inside pr.wait()) runs on the
+                # completed view BEFORE any slab copy/reuse — a corrupt
+                # chunk never reaches a DMA slab, let alone the device
                 try:
                     verify(ri, view)
                 except BaseException:
@@ -250,7 +446,15 @@ class DeviceStream:
                         pass
                     pr.release()
                     raise
-            inflight.append((self._put(view, dtype, shp), pr))
+            arr = (stage.put(view, dtype, shp)
+                   if stage is not None else None)
+            if arr is not None:
+                pr.release()   # staging recycles NOW — the overlap win
+                inflight.append((arr, None))
+            else:
+                # no stage, or the view outgrew the slabs: the classic
+                # path, source held until its transfer drains ready
+                inflight.append((self._put(view, dtype, shp), pr))
 
         ranges = list(ranges)
         shapes_l = list(shapes) if shapes is not None else None
@@ -292,7 +496,10 @@ class DeviceStream:
                     pass
                 pr.release()
             for _, pr in inflight:
-                pr.release()
+                if pr is not None:
+                    pr.release()
+            if stage is not None:
+                stage.close()
 
     def read_to_device(self, path, dtype=None, shape=None):
         """Whole file → one device array (concatenated on device, not host).

@@ -384,16 +384,133 @@ def event_bucket(ev, fmap: dict | None = None) -> str:
     return classify(ev.name)
 
 
+class _XStatView:
+    """(key, value) pairs of one XEvent's stats — the iteration shape
+    ``event_bucket`` expects from ``jax.profiler.ProfileData``."""
+
+    __slots__ = ("_pairs",)
+
+    def __init__(self, pairs):
+        self._pairs = pairs
+
+    def __iter__(self):
+        return iter(self._pairs)
+
+
+class _XEventView:
+    __slots__ = ("name", "start_ns", "duration_ns", "stats")
+
+    def __init__(self, name, start_ns, duration_ns, stats):
+        self.name = name
+        self.start_ns = start_ns
+        self.duration_ns = duration_ns
+        self.stats = stats
+
+
+class _XLineView:
+    __slots__ = ("name", "events")
+
+    def __init__(self, name, events):
+        self.name = name
+        self.events = events
+
+
+class _XPlaneView:
+    __slots__ = ("name", "lines")
+
+    def __init__(self, name, lines):
+        self.name = name
+        self.lines = lines
+
+
+class _XSpaceView:
+    __slots__ = ("planes",)
+
+    def __init__(self, planes):
+        self.planes = planes
+
+
+def _stat_value(stat, stat_md):
+    for f in ("double_value", "uint64_value", "int64_value", "str_value",
+              "bytes_value"):
+        if stat.HasField(f):
+            return getattr(stat, f)
+    if stat.HasField("ref_value"):
+        md = stat_md.get(stat.ref_value)
+        return md.name if md is not None else stat.ref_value
+    return ""
+
+
+def _xplane_pb2():
+    """The XSpace protobuf module, wherever this install keeps it."""
+    for mod in ("tensorflow.tsl.profiler.protobuf.xplane_pb2",
+                "tsl.profiler.protobuf.xplane_pb2",
+                "tensorflow.core.profiler.protobuf.xplane_pb2"):
+        try:
+            import importlib
+            return importlib.import_module(mod)
+        except Exception:
+            continue
+    return None
+
+
+def _load_profile_data(path: str):
+    """``jax.profiler.ProfileData``-shaped view of one xplane.pb.
+
+    Newer jax ships ``ProfileData`` (no TensorBoard dependency); older
+    runtimes (jax ≤ 0.4.x of this container) don't — there the raw
+    XSpace protobuf is decoded into the same planes/lines/events shape,
+    so ``parse_trace`` has exactly one consumption path.  Times follow
+    ProfileData's convention: ps-resolution fields scaled to ns."""
+    try:
+        import jax
+        pd = getattr(jax.profiler, "ProfileData", None)
+        if pd is not None:
+            return pd.from_file(path)
+    except Exception:
+        pass
+    pb2 = _xplane_pb2()
+    if pb2 is None:
+        raise RuntimeError(
+            "no xplane parser available: jax.profiler.ProfileData is "
+            "missing and no xplane_pb2 protobuf module could be "
+            "imported — upgrade jax or install tensorflow")
+    with open(path, "rb") as f:
+        space = pb2.XSpace.FromString(f.read())
+    planes = []
+    for plane in space.planes:
+        ev_md = dict(plane.event_metadata)
+        st_md = dict(plane.stat_metadata)
+        lines = []
+        for line in plane.lines:
+            t0 = int(line.timestamp_ns)
+            events = []
+            for ev in line.events:
+                md = ev_md.get(ev.metadata_id)
+                name = ""
+                if md is not None:
+                    name = md.display_name or md.name
+                stats = _XStatView([
+                    ((st_md[s.metadata_id].name
+                      if s.metadata_id in st_md else str(s.metadata_id)),
+                     _stat_value(s, st_md))
+                    for s in ev.stats])
+                events.append(_XEventView(
+                    name, t0 + ev.offset_ps / 1000.0,
+                    ev.duration_ps / 1000.0, stats))
+            lines.append(_XLineView(line.name, events))
+        planes.append(_XPlaneView(plane.name, lines))
+    return _XSpaceView(planes)
+
+
 def parse_trace(trace_dir: str) -> dict:
     """Aggregate the device plane of the newest xplane.pb under
     ``trace_dir``.  Returns the breakdown dict (no I/O)."""
-    import jax
-
     paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
                              recursive=True), key=os.path.getmtime)
     if not paths:
         raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
-    pdata = jax.profiler.ProfileData.from_file(paths[-1])
+    pdata = _load_profile_data(paths[-1])
     dev_plane = host_plane = None
     for p in pdata.planes:
         if "/device:" in p.name and "CUSTOM" not in p.name:

@@ -53,6 +53,16 @@ _BATCH_COUNTERS = (
     "spans_coalesced", "submit_batches", "submit_syscalls_saved",
 )
 
+#: zero-copy submission/overlap counters (registered files + SQPOLL +
+#: unified arena + bridge double buffering — docs/PERF.md §6); the
+#: engine block also renders the per-ring registration gauges, because
+#: a pool whose try_register silently soft-failed is SLOW, not broken —
+#: it must be visible here, not only in a flamegraph
+_ENGINE_COUNTERS = (
+    "submit_enters", "arena_fallbacks", "overlap_chunks",
+    "overlap_bytes",
+)
+
 #: QoS scheduler counters (io/sched.py over the multi-ring engine —
 #: docs/PERF.md); own block with per-ring depth and per-class tallies,
 #: shown only when a scheduler dispatched anything
@@ -101,8 +111,8 @@ _OBS_COUNTERS = (
 #: from the tooling
 ALL_COUNTER_BLOCKS = (
     _COUNTERS, _RESILIENCE_COUNTERS, _INTEGRITY_COUNTERS,
-    _BATCH_COUNTERS, _SCHED_COUNTERS, _HOSTCACHE_COUNTERS,
-    _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
+    _BATCH_COUNTERS, _ENGINE_COUNTERS, _SCHED_COUNTERS,
+    _HOSTCACHE_COUNTERS, _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
 )
 
 
@@ -165,6 +175,43 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
                 f"    coalesce ratio       "
                 f"{merged / (merged + subs):>14.3f}   "
                 "(extents merged / extents planned)")
+    if (any(int(snap.get(n, 0)) for n in _ENGINE_COUNTERS)
+            or snap.get("ring_fixed_bufs") is not None):
+        lines.append("  engine (zero-copy submission: registered bufs/"
+                     "files, SQPOLL, arena, overlap):")
+        for name in _ENGINE_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if name.endswith("bytes") else str(v)
+            lines.append(f"    {name:<22} {shown:>14}")
+        enters = int(snap.get("submit_enters", 0))
+        saved = int(snap.get("submit_syscalls_saved", 0))
+        if enters + saved:
+            lines.append(
+                f"    {'doorbells elided':<22} "
+                f"{saved / (enters + saved):>14.3f}   "
+                "(saved / (saved + rung))")
+        for key, label in (("ring_fixed_bufs", "fixed buffers"),
+                           ("ring_reg_files", "registered files"),
+                           ("ring_sqpoll", "sqpoll active")):
+            vals = snap.get(key)
+            if vals is not None:
+                shown = " ".join(str(int(v)) for v in vals)
+                lines.append(f"    {label:<22} {shown:>14}   (per ring)")
+        if snap.get("pool_arena") is not None:
+            lines.append(f"    {'pool from arena':<22} "
+                         f"{int(snap.get('pool_arena', 0)):>14}")
+        if (snap.get("ring_fixed_bufs")
+                and not all(snap["ring_fixed_bufs"])
+                # reg_files is uring-only state: its presence proves the
+                # rings ARE urings, so a missing buffer registration is
+                # real per-op pinning (the worker pool registers
+                # nothing and must not trip this)
+                and any(int(d) for d in snap.get("ring_reg_files") or [])
+                and any(int(d) for d in snap.get("ring_sqpoll") or [])):
+            lines.append(
+                "    UNREGISTERED POOL under SQPOLL — per-op page "
+                "pinning is eating the doorbell win; check "
+                "RLIMIT_MEMLOCK / kernel support")
     if (any(int(snap.get(n, 0)) for n in _SCHED_COUNTERS)
             or snap.get("class_stats") or snap.get("ring_depths")):
         lines.append("  scheduler (QoS classes over the ring shards):")

@@ -78,6 +78,23 @@ class StromStats:
     # fewer-syscalls half of the win
     submit_batches: int = 0
     submit_syscalls_saved: int = 0
+    # -- zero-copy overlap pipeline (PR 12: registered files + SQPOLL +
+    # unified arena + bridge double buffering; docs/PERF.md §6) ----------
+    # submission doorbells actually rung (io_uring_enter submit/wakeup
+    # calls on the uring backend, dispatch wakeups on the worker pool):
+    # enters/GiB is the steady-state submission-syscall rate SQPOLL
+    # drives toward zero — submit_syscalls_saved counts the elisions
+    submit_enters: int = 0
+    # arena carves that could not fit (io/arena.py): the consumer fell
+    # back to its private pre-arena mapping — correct but unpooled, so
+    # budget starvation must be visible rather than silent
+    arena_fallbacks: int = 0
+    # chunks/bytes that rode the bridge's double-buffered host→HBM
+    # stage (ops/bridge.py): the overlapped path's traffic share, so a
+    # silently-disengaged overlap (platform gate, slab fallback) shows
+    # as zeros next to a busy stream
+    overlap_chunks: int = 0
+    overlap_bytes: int = 0
     # -- resilience counters (io/faults.py, io/resilient.py) --------------
     # faults injected by an active FaultPlan (test/chaos runs; 0 in prod)
     faults_injected: int = 0
@@ -606,6 +623,21 @@ def openmetrics_from_snapshot(snap: dict) -> str:
                       "in-flight I/O per ring", ("ring",))
         for i, d in enumerate(depths):
             g.set(int(d), ring=i)
+    # zero-copy submission state (docs/PERF.md §6): per-ring 0/1 gauges
+    # — fleet dashboards alert on a ring whose registrations silently
+    # soft-failed (slow-but-working is the failure mode to catch)
+    for key, mname, mhelp in (
+            ("ring_fixed_bufs", "strom_ring_fixed_bufs",
+             "1 while the staging pool is registered as fixed buffers"),
+            ("ring_reg_files", "strom_ring_reg_files",
+             "1 while the fd slot table is registered (FIXED_FILE)"),
+            ("ring_sqpoll", "strom_ring_sqpoll",
+             "1 while submissions ride SQPOLL (no doorbell syscalls)")):
+        vals = snap.get(key)
+        if vals:
+            g = reg.gauge(mname, mhelp, ("ring",))
+            for i, v in enumerate(vals):
+                g.set(int(v), ring=i)
     health = snap.get("ring_health")
     if health:
         g = reg.gauge("strom_ring_breaker_open",
@@ -621,7 +653,8 @@ def openmetrics_from_snapshot(snap: dict) -> str:
             g.inc(int(v), member=m_)
     skip = (set(COUNTER_FIELDS)
             | {"class_stats", "ring_depths", "ring_health",
-               "member_bytes"})
+               "member_bytes", "ring_fixed_bufs", "ring_reg_files",
+               "ring_sqpoll"})
     for name in sorted(snap):
         if name in skip or name.startswith("_"):
             continue

@@ -167,6 +167,26 @@ class StepWatchdog:
                       f"syscalls_saved={s.submit_syscalls_saved} "
                       f"coalesced={s.spans_coalesced}",
                       file=w, flush=True)
+                # zero-copy submission tier (docs/PERF.md §6): a hang
+                # with SQPOLL active and doorbells still being rung
+                # means the poller is asleep (or never armed) — and an
+                # unregistered pool/slot table explains "slow but
+                # moving" at a glance
+                zsnap = s.snapshot()
+                if (s.submit_enters or s.overlap_chunks
+                        or s.arena_fallbacks
+                        or zsnap.get("ring_sqpoll") is not None):
+                    fmt = lambda key: ",".join(  # noqa: E731
+                        str(int(v)) for v in zsnap.get(key) or []) or "-"
+                    print(f"engine zero-copy: "
+                          f"enters={s.submit_enters} "
+                          f"fixed_bufs=[{fmt('ring_fixed_bufs')}] "
+                          f"reg_files=[{fmt('ring_reg_files')}] "
+                          f"sqpoll=[{fmt('ring_sqpoll')}] "
+                          f"arena_fallbacks={s.arena_fallbacks} "
+                          f"overlap={s.overlap_chunks}"
+                          f"/{s.overlap_bytes}B",
+                          file=w, flush=True)
                 # scheduler tier (multi-ring QoS, io/sched.py): a hang
                 # with deep rings is device-bound; a hang with EMPTY
                 # rings but queued batches means the scheduler (or its

@@ -1,0 +1,146 @@
+"""Unified pinned arena (io/arena.py, docs/PERF.md §6).
+
+The arena is ONE reservation carved into engine staging slices,
+host-cache lines, and bridge DMA slabs.  These tests pin the allocator
+invariants (disjoint carves, exact accounting, coalescing free list),
+the consumer integrations (engine pool + hostcache ride the arena and
+fall back cleanly), and the ``STROM_ARENA=0`` off switch.
+"""
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io import arena as arena_mod
+from nvme_strom_tpu.io.arena import CARVE_ALIGN, PinnedArena
+
+pytestmark = pytest.mark.perf
+
+
+@pytest.fixture()
+def fresh_arena_env(monkeypatch):
+    """Reset the singleton around each test so env toggles take."""
+    arena_mod.reset()
+    yield monkeypatch
+    arena_mod.reset()
+
+
+def test_carves_are_disjoint_and_sum_to_arena():
+    a = PinnedArena(1 << 20, lock_pages=False)
+    slabs = [a.carve(100_000, t) for t in ("staging", "hostcache",
+                                           "bridge", "bridge")]
+    assert all(s is not None for s in slabs)
+    ranges = sorted((s.offset, s.offset + s.nbytes) for s in slabs)
+    for (lo1, hi1), (lo2, _hi2) in zip(ranges, ranges[1:]):
+        assert hi1 <= lo2, "carves overlap"
+    # tagged accounting is exact and bytes sum to the arena
+    carves = a.carves()
+    assert carves["staging"] == slabs[0].nbytes
+    assert carves["hostcache"] == slabs[1].nbytes
+    assert carves["bridge"] == slabs[2].nbytes + slabs[3].nbytes
+    assert a.bytes_carved + a.bytes_free == a.nbytes
+    # every slab starts page-aligned (O_DIRECT conformance of an
+    # engine pool carved here)
+    for s in slabs:
+        assert s.offset % CARVE_ALIGN == 0
+        assert s.addr % CARVE_ALIGN == 0
+    a.close()
+
+
+def test_release_coalesces_and_recycles():
+    a = PinnedArena(256 << 10, lock_pages=False)
+    s1 = a.carve(64 << 10, "x")
+    s2 = a.carve(64 << 10, "x")
+    s3 = a.carve(64 << 10, "x")
+    assert a.carve(256 << 10, "big") is None     # exhausted: soft None
+    s2.release()
+    s1.release()                                  # coalesce with s2
+    s3.release()
+    assert a.bytes_carved == 0
+    big = a.carve(256 << 10, "big")               # whole arena again
+    assert big is not None and big.nbytes == 256 << 10
+    big.release()
+    a.close()
+
+
+def test_slab_release_is_idempotent_and_views_are_zero_copy():
+    a = PinnedArena(128 << 10, lock_pages=False)
+    s = a.carve(4096, "x")
+    s.view[:] = 7
+    assert a.view[s.offset] == 7                  # same memory, no copy
+    s.release()
+    s.release()                                   # idempotent
+    assert a.bytes_carved == 0
+    a.close()
+
+
+def test_env_off_switch_disables_singleton(fresh_arena_env):
+    fresh_arena_env.setenv("STROM_ARENA", "0")
+    assert arena_mod.get_arena() is None
+    assert arena_mod.carve_or_none(4096, "x") is None
+
+
+def test_engine_pool_carves_from_arena(fresh_arena_env, tmp_data_file):
+    """With the arena on, the engine's staging pool is an arena carve
+    (tag ``staging``) — and reads are bit-for-bit the private-pool
+    engine's."""
+    from nvme_strom_tpu.io.engine import StromEngine
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    fresh_arena_env.setenv("STROM_ARENA_MB", "64")
+    path, payload = tmp_data_file
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=4,
+                       buffer_pool_bytes=4 << 20)
+    with StromEngine(cfg, stats=StromStats()) as e:
+        assert e._pool_slab is not None
+        assert arena_mod.get_arena().carves().get("staging", 0) \
+            == e._pool_slab.nbytes
+        fh = e.open(path)
+        with e.submit_read(fh, 12345, 100_000) as p:
+            assert p.wait().tobytes() == payload[12345:12345 + 100_000]
+        e.close(fh)
+    # the carve recycled at close_all
+    assert arena_mod.get_arena().carves().get("staging", 0) == 0
+
+
+def test_engine_falls_back_when_arena_exhausted(fresh_arena_env,
+                                                tmp_data_file):
+    from nvme_strom_tpu.io.engine import StromEngine
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    fresh_arena_env.setenv("STROM_ARENA_MB", "1")   # far too small
+    path, payload = tmp_data_file
+    stats = StromStats()
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=4,
+                       buffer_pool_bytes=8 << 20)
+    with StromEngine(cfg, stats=stats) as e:
+        assert e._pool_slab is None                 # private pool
+        assert stats.arena_fallbacks >= 1           # ...and counted
+        fh = e.open(path)
+        with e.submit_read(fh, 0, 4096) as p:
+            assert p.wait().tobytes() == payload[:4096]
+        e.close(fh)
+
+
+def test_hostcache_arena_rides_the_process_arena(fresh_arena_env):
+    from nvme_strom_tpu.io import hostcache
+    from nvme_strom_tpu.utils.config import HostCacheConfig
+
+    fresh_arena_env.setenv("STROM_ARENA_MB", "32")
+    hostcache.reset()
+    try:
+        cache = hostcache.configure(HostCacheConfig(budget_mb=2))
+        assert cache is not None
+        assert arena_mod.get_arena().carves().get("hostcache", 0) \
+            == cache.arena.nbytes
+        # lines fill and serve out of the shared reservation
+        fkey = (1, 2, 3, 4)
+        payload = np.arange(cache.line_bytes, dtype=np.uint8) % 251
+        assert cache.fill(fkey, 0, payload, "decode")
+        line = cache._lines[(fkey, 0)]
+        got = cache.line_view(line, 0, cache.line_bytes)
+        assert np.array_equal(got, payload)
+    finally:
+        hostcache.reset()
+    assert arena_mod.get_arena().carves().get("hostcache", 0) == 0

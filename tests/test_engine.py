@@ -583,3 +583,139 @@ def test_wait_timeout_cancel_then_retry(tmp_data_file, monkeypatch):
         assert p3.wait().tobytes() == payload[4096:8192]
         p3.release()
         eng.close(fh)
+
+
+# ---------------------------------------------------------------------------
+# Zero-copy submission modes (PR 12): SQPOLL, registered files, gauges
+# ---------------------------------------------------------------------------
+
+@pytest.mark.perf
+def test_sqpoll_elides_submission_doorbells(tmp_data_file, monkeypatch):
+    """STROM_SQPOLL=1: steady-state submissions skip the dispatch
+    doorbell (io_uring_enter on a uring ring; the wakeup notify on the
+    worker-pool analogue) — counted in submit_syscalls_saved while
+    submit_enters stays near zero."""
+    path, payload = tmp_data_file
+    monkeypatch.setenv("STROM_SQPOLL", "1")
+    monkeypatch.setenv("STROM_SQPOLL_IDLE_MS", "200")
+    stats = StromStats()
+    n = 16
+    with StromEngine(_cfg(queue_depth=4, n_rings=1), stats=stats) as e:
+        assert e.ring_info(0)["sqpoll"] == 1
+        fh = e.open(path)
+        for i in range(n):
+            with e.submit_read(fh, i * 4096, 4096) as p:
+                assert p.wait().tobytes() == \
+                    payload[i * 4096:(i + 1) * 4096]
+        e.close(fh)
+        blk = e.engine_stats()
+        # the poller consumed (nearly) every submission without a
+        # doorbell; allow a few wakeups for pollers that idled out
+        assert blk["submit_syscalls_saved"] >= n // 2
+        assert blk["submit_enters"] < n
+        assert blk["submit_enters"] + blk["submit_syscalls_saved"] >= n
+
+
+@pytest.mark.perf
+def test_sqpoll_off_switch_bit_for_bit(tmp_data_file, monkeypatch):
+    """STROM_SQPOLL unset/0 is today's engine exactly: every dispatch
+    rings its doorbell (enters == reads on the worker pool), zero
+    elisions, same bytes."""
+    path, payload = tmp_data_file
+
+    def read_all(n, want_sqpoll=0):
+        stats = StromStats()
+        out = []
+        with StromEngine(_cfg(queue_depth=4, n_rings=1),
+                         stats=stats) as e:
+            assert e.ring_info(0)["sqpoll"] == want_sqpoll
+            fh = e.open(path)
+            for i in range(n):
+                with e.submit_read(fh, i * 8192, 8192) as p:
+                    out.append(p.wait().tobytes())
+            e.close(fh)
+            blk = e.engine_stats()
+        return out, blk
+
+    monkeypatch.setenv("STROM_SQPOLL", "0")
+    off_bytes, off_blk = read_all(8)
+    assert off_bytes == [payload[i * 8192:(i + 1) * 8192]
+                         for i in range(8)]
+    if not off_blk["submit_batches"]:
+        # scalar worker-pool reads: one doorbell each, none saved
+        assert off_blk["submit_syscalls_saved"] == 0
+    monkeypatch.setenv("STROM_SQPOLL", "1")
+    on_bytes, _on_blk = read_all(8, want_sqpoll=1)
+    assert on_bytes == off_bytes
+
+
+@pytest.mark.perf
+def test_reg_files_off_switch_bit_for_bit(tmp_data_file, monkeypatch):
+    """STROM_REG_FILES=0 disables the slot table; reads are identical
+    and the per-ring gauge reports unregistered."""
+    path, payload = tmp_data_file
+
+    def read_some():
+        with StromEngine(_cfg(queue_depth=4, n_rings=1),
+                         stats=StromStats()) as e:
+            fh = e.open(path)
+            prs = e.submit_readv([(fh, i * 65536, 65536)
+                                  for i in range(4)])
+            got = [p.wait().tobytes() for p in prs]
+            for p in prs:
+                p.release()
+            info = e.ring_info(0)
+            e.close(fh)
+        return got, info
+
+    monkeypatch.setenv("STROM_REG_FILES", "0")
+    off_got, off_info = read_some()
+    assert off_info["reg_files"] == 0
+    monkeypatch.delenv("STROM_REG_FILES")
+    on_got, on_info = read_some()
+    assert on_got == off_got == [payload[i * 65536:(i + 1) * 65536]
+                                 for i in range(4)]
+    # threadpool backend has no slot table either way; a uring backend
+    # must register when enabled (soft-fail tolerated on old kernels)
+    assert on_info["reg_files"] in (0, 1)
+
+
+@pytest.mark.perf
+def test_sync_stats_exports_zero_copy_gauges(tmp_data_file):
+    stats = StromStats()
+    with StromEngine(_cfg(queue_depth=4), stats=stats) as e:
+        fh = e.open(tmp_data_file[0])
+        with e.submit_read(fh, 0, 4096) as p:
+            p.wait()
+        e.close(fh)
+        e.sync_stats()
+        snap = stats.snapshot()
+    for key in ("ring_fixed_bufs", "ring_reg_files", "ring_sqpoll"):
+        assert key in snap and len(snap[key]) == e.n_rings
+        assert all(v in (0, 1) for v in snap[key])
+    assert snap.get("pool_arena") in (0, 1)
+    assert "submit_enters" in snap
+
+
+@pytest.mark.perf
+def test_ring_restart_under_sqpoll(tmp_data_file, monkeypatch):
+    """PR-10 contract under SQPOLL: stall → park → hot restart cancels
+    the backlog (-ECANCELED requeue), and the rebuilt ring serves —
+    with SQPOLL still active after the rebuild."""
+    path, payload = tmp_data_file
+    monkeypatch.setenv("STROM_SQPOLL", "1")
+    monkeypatch.setenv("STROM_BREAKER", "0")   # drive the C layer bare
+    with StromEngine(_cfg(queue_depth=4, n_rings=1),
+                     stats=StromStats()) as e:
+        fh = e.open(path)
+        e.set_ring_stall(0, True)
+        p = e.submit_read(fh, 0, 4096)
+        cancelled = e.ring_restart(0, drain_timeout_s=2.0)
+        assert cancelled == 1
+        with pytest.raises(OSError):
+            p.wait()
+        p.release()
+        assert e.ring_info(0)["sqpoll"] == 1   # mode survived the rebuild
+        with e.submit_read(fh, 4096, 4096) as p2:
+            assert p2.wait().tobytes() == payload[4096:8192]
+        e.close(fh)
