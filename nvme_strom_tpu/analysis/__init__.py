@@ -1,0 +1,36 @@
+"""strom-lint — static analysis for the concurrent I/O core.
+
+The worst historical bugs in this stack were all of one family: a
+shared-CDLL ``argtypes`` clobber (PR 5), an eviction-lock self-deadlock
+(PR 9), a staging-pool deadlock (PR 7) and a TSAN-caught use-after-free
+across ``restart_mu`` (PR 10).  This package makes those classes fail CI
+*before* they recur instead of relying on chaos tests to catch the next
+one:
+
+- :mod:`~nvme_strom_tpu.analysis.cabi` — parser for the ``strom_*`` C
+  prototypes and structs in ``csrc/strom_io.h`` (the ABI ground truth).
+- :mod:`~nvme_strom_tpu.analysis.abi` — ctypes-ABI conformance: every
+  Python binding's ``argtypes``/``restype`` checked for completeness,
+  type agreement and single-bind ownership.
+- :mod:`~nvme_strom_tpu.analysis.locks` — lock-discipline AST pass:
+  acquisition-graph construction, lock-order-manifest enforcement,
+  blocking-operation-under-lock detection.
+- :mod:`~nvme_strom_tpu.analysis.manifest` — the declared lock-order
+  manifest + waiver grammar (``lock_order.conf``, docs/ANALYSIS.md).
+- :mod:`~nvme_strom_tpu.analysis.knobs` — STROM_* knob-documentation
+  drift (migrated from tests/test_knob_docs.py).
+- :mod:`~nvme_strom_tpu.analysis.counters` — StromStats counter drift
+  against strom_stat's render/--json/--prom (migrated from the PR-11
+  check in tests/test_observability.py).
+- :mod:`~nvme_strom_tpu.analysis.driver` — runs every checker under one
+  CLI exit-code contract (``strom-lint``; 0 clean, 1 violations,
+  2 runtime error — the strom-scrub convention).
+
+The runtime half of the story — the mini-lockdep armed in the
+chaos/stress suites — lives in :mod:`nvme_strom_tpu.utils.lockwitness`;
+the sanitizer matrix (ASAN/UBSAN/TSAN ``stress_test``) in
+``csrc/Makefile`` (``make sanitize``).  See docs/ANALYSIS.md.
+"""
+
+from nvme_strom_tpu.analysis.driver import (   # noqa: F401
+    Violation, Report, run_checks, ALL_CHECKS)

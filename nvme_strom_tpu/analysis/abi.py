@@ -1,0 +1,458 @@
+"""ctypes-ABI conformance checker (the anti-PR-5 pass).
+
+The bug class this kills structurally: ctypes caches ONE function object
+per CDLL handle, so two modules assigning ``argtypes`` on the same
+symbol of a shared handle silently retype each other (the PR-5
+``strom_crc32c`` clobber was exactly that, import-order-dependent).  The
+repo's idiom since is private-CDLL handles plus one *owning* bind site
+per symbol; this checker makes the idiom a machine-checked invariant:
+
+- **completeness** — every ``strom_*`` function the header declares has
+  a binding site, and that site assigns BOTH ``argtypes`` and an
+  explicit ``restype`` (ctypes' implicit ``c_int`` default is treated as
+  unbound: it happens to be right until the day the C return type
+  widens, and then it is silently wrong on LP64).
+- **type agreement** — the bound ``argtypes``/``restype`` match the
+  header prototype, including pointer depth, struct identity
+  (``_RingInfo`` vs ``strom_ring_info``), struct field layout, and
+  fixed-size array shapes.
+- **single-bind ownership** — each symbol's ``argtypes`` is assigned at
+  exactly one site in the package, and only the owning module calls the
+  symbol through a raw handle (other modules delegate through the
+  owner's Python wrapper, like formats/tfrecord.py -> utils/checksum.py).
+
+Everything here is static (AST over the sources + the parsed header) —
+the checker needs neither the built ``.so`` nor an importable JAX stack,
+so it runs first in CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from nvme_strom_tpu.analysis.cabi import (
+    CType, HeaderABI, expected_ctypes, parse_header,
+    struct_name_matches)
+from nvme_strom_tpu.analysis.driver import Violation
+
+CHECK = "abi"
+
+
+@dataclass
+class BindSite:
+    module: str          # repo-relative path
+    qual: str            # enclosing function/class qualname ("<module>")
+    symbol: str
+    kind: str            # "argtypes" | "restype"
+    line: int
+    value: Optional[str]  # canonical spelling, None = unparseable
+
+
+@dataclass
+class ModuleScan:
+    path: str
+    binds: List[BindSite] = field(default_factory=list)
+    calls: List[Tuple[str, int]] = field(default_factory=list)
+    structs: Dict[str, List[Tuple[str, str]]] = field(default_factory=dict)
+    struct_lines: Dict[str, int] = field(default_factory=dict)
+    consts: Dict[str, int] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------------------
+# python-side normalization
+# --------------------------------------------------------------------------
+
+def _const_int(node: ast.AST, consts: Dict[str, int]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _norm_ctype_expr(node: ast.AST, consts: Dict[str, int]) -> Optional[str]:
+    """Canonical spelling of a ctypes type expression:
+    ``c_uint64`` / ``c_char_p`` / ``None`` / ``POINTER(x)`` /
+    ``ARRAY(x,n)`` / ``PYSTRUCT(ClassName)`` (resolved against the
+    header later).  None = not understood (reported, never skipped)."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    if isinstance(node, ast.Attribute):
+        # ctypes.c_uint64 (whatever the ctypes module is called locally)
+        if node.attr.startswith("c_"):
+            return node.attr
+        return None
+    if isinstance(node, ast.Name):
+        if node.id.startswith("c_"):
+            return node.id
+        return f"PYSTRUCT({node.id})"
+    if isinstance(node, ast.Call):
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None)
+        if fname == "POINTER" and len(node.args) == 1:
+            inner = _norm_ctype_expr(node.args[0], consts)
+            return None if inner is None else f"POINTER({inner})"
+        return None
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        inner = _norm_ctype_expr(node.left, consts)
+        n = _const_int(node.right, consts)
+        if inner is None or n is None:
+            return None
+        return f"ARRAY({inner},{n})"
+    return None
+
+
+def _resolve_pystructs(spelling: str,
+                       abi: HeaderABI) -> Tuple[str, Optional[str]]:
+    """Replace ``PYSTRUCT(X)`` with ``STRUCT(<c name>)`` by matching the
+    Python Structure class name against the header's structs.  Returns
+    (resolved spelling, error or None)."""
+    err: Optional[str] = None
+
+    def _sub(m: re.Match) -> str:
+        nonlocal err
+        py = m.group(1)
+        for c_name in abi.structs:
+            if struct_name_matches(py, c_name):
+                return f"STRUCT({c_name})"
+        err = (f"Python struct class {py!r} matches no struct in "
+               f"{abi.path}")
+        return f"STRUCT(?{py})"
+
+    return re.sub(r"PYSTRUCT\((\w+)\)", _sub, spelling), err
+
+
+# --------------------------------------------------------------------------
+# module scanning
+# --------------------------------------------------------------------------
+
+class _Scanner(ast.NodeVisitor):
+    def __init__(self, scan: ModuleScan):
+        self.scan = scan
+        self.stack: List[str] = []
+
+    # qualname tracking -----------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        bases = [ast.unparse(b) for b in node.bases]
+        if any(b.split(".")[-1] == "Structure" for b in bases):
+            self._capture_struct(node)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def _qual(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    # module-level int constants (array dims like _MAX_RAID_MEMBERS) --------
+    def _capture_const(self, node: ast.Assign) -> None:
+        if (len(node.targets) == 1 and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, int)):
+            self.scan.consts[node.targets[0].id] = node.value.value
+
+    # ctypes.Structure subclasses ------------------------------------------
+    def _capture_struct(self, node: ast.ClassDef) -> None:
+        fields: List[Tuple[str, str]] = []
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "_fields_"):
+                continue
+            val = stmt.value
+            if isinstance(val, ast.List):
+                for elt in val.elts:
+                    got = self._field_pair(elt)
+                    if got is None:
+                        fields.append(("?", "?"))
+                    else:
+                        fields.append(got)
+            elif isinstance(val, ast.ListComp):
+                # the _StatsBlk idiom:
+                #   [(n, ctypes.c_uint64) for n in ("a", "b", ...)]
+                fields.extend(self._expand_comp(val))
+            else:
+                fields.append(("?", "?"))
+        self.scan.structs[node.name] = fields
+        self.scan.struct_lines[node.name] = node.lineno
+
+    def _field_pair(self, elt: ast.AST) -> Optional[Tuple[str, str]]:
+        if not (isinstance(elt, ast.Tuple) and len(elt.elts) == 2
+                and isinstance(elt.elts[0], ast.Constant)):
+            return None
+        name = elt.elts[0].value
+        spelling = _norm_ctype_expr(elt.elts[1], self.scan.consts)
+        return (str(name), spelling if spelling is not None else "?")
+
+    def _expand_comp(self, comp: ast.ListComp) -> List[Tuple[str, str]]:
+        out: List[Tuple[str, str]] = []
+        if len(comp.generators) != 1:
+            return [("?", "?")]
+        gen = comp.generators[0]
+        src = gen.iter
+        if not (isinstance(src, (ast.Tuple, ast.List))
+                and isinstance(comp.elt, ast.Tuple)
+                and len(comp.elt.elts) == 2):
+            return [("?", "?")]
+        spelling = _norm_ctype_expr(comp.elt.elts[1], self.scan.consts)
+        for name_node in src.elts:
+            if isinstance(name_node, ast.Constant):
+                out.append((str(name_node.value),
+                            spelling if spelling is not None else "?"))
+        return out
+
+    # binding assignments + raw-handle calls --------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if not self.stack:
+            self._capture_const(node)
+        for tgt in node.targets:
+            if (isinstance(tgt, ast.Attribute)
+                    and tgt.attr in ("argtypes", "restype")
+                    and isinstance(tgt.value, ast.Attribute)
+                    and tgt.value.attr.startswith("strom_")):
+                symbol = tgt.value.attr
+                if tgt.attr == "argtypes":
+                    value = self._norm_argtypes(node.value)
+                else:
+                    value = _norm_ctype_expr(node.value, self.scan.consts)
+                self.scan.binds.append(BindSite(
+                    module=self.scan.path, qual=self._qual(),
+                    symbol=symbol, kind=tgt.attr, line=node.lineno,
+                    value=value))
+        self.generic_visit(node)
+
+    def _norm_argtypes(self, node: ast.AST) -> Optional[str]:
+        if not isinstance(node, (ast.List, ast.Tuple)):
+            return None
+        parts = []
+        for elt in node.elts:
+            s = _norm_ctype_expr(elt, self.scan.consts)
+            if s is None:
+                return None
+            parts.append(s)
+        return "[" + ",".join(parts) + "]"
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr.startswith("strom_"):
+            self.scan.calls.append((fn.attr, node.lineno))
+        self.generic_visit(node)
+
+
+def scan_module(path: Path, rel: str) -> ModuleScan:
+    scan = ModuleScan(path=rel)
+    tree = ast.parse(path.read_text(), filename=rel)
+    _Scanner(scan).visit(tree)
+    return scan
+
+
+# --------------------------------------------------------------------------
+# the check
+# --------------------------------------------------------------------------
+
+def check_abi(header_path: Path, py_files: List[Path],
+              root: Path) -> List[Violation]:
+    """Run the full conformance pass; returns violations.  A header the
+    parser cannot read RAISES (exit 2, 'fix the linter') instead of
+    returning a violation: a violation is exit 1 ('fix your code') and
+    waivable — a broad 'waiver abi *' must never be able to green-light
+    a run with zero ABI coverage."""
+    out: List[Violation] = []
+    abi = parse_header(str(header_path))
+
+    scans = [scan_module(p, str(p.relative_to(root))) for p in py_files]
+
+    # ownership map: symbol -> argtypes bind sites
+    arg_sites: Dict[str, List[BindSite]] = {}
+    res_sites: Dict[str, List[BindSite]] = {}
+    for scan in scans:
+        for b in scan.binds:
+            (arg_sites if b.kind == "argtypes" else
+             res_sites).setdefault(b.symbol, []).append(b)
+
+    # unknown symbols (typo'd binds or calls)
+    for sites in (arg_sites, res_sites):
+        for sym, bs in sites.items():
+            if sym not in abi.funcs:
+                for b in bs:
+                    out.append(Violation(
+                        CHECK, b.module, b.line,
+                        f"{sym}: bound but not declared in "
+                        f"{header_path.name} — typo or dead binding"))
+    for scan in scans:
+        for sym, line in scan.calls:
+            if sym not in abi.funcs:
+                out.append(Violation(
+                    CHECK, scan.path, line,
+                    f"{sym}(): called but not declared in "
+                    f"{header_path.name}"))
+
+    # completeness + single-bind ownership + agreement, per header func
+    for name, func in sorted(abi.funcs.items()):
+        asites = arg_sites.get(name, [])
+        rsites = res_sites.get(name, [])
+        if not asites:
+            out.append(Violation(
+                CHECK, str(header_path), func.line,
+                f"{name}: declared in the header but argtypes are bound "
+                f"nowhere in the package — every ABI symbol needs one "
+                f"owning bind site"))
+            continue
+        if len(asites) > 1:
+            where = ", ".join(f"{b.module}:{b.line}" for b in asites)
+            for b in asites[1:]:
+                out.append(Violation(
+                    CHECK, b.module, b.line,
+                    f"{name}: argtypes bound at {len(asites)} sites "
+                    f"({where}) — exactly one owning site allowed "
+                    f"(the PR-5 clobber class)"))
+        owner = asites[0]
+        if not rsites:
+            out.append(Violation(
+                CHECK, owner.module, owner.line,
+                f"{name}: argtypes bound but restype never set — "
+                f"ctypes' implicit c_int default is not a binding "
+                f"(bind restype explicitly, None for void)"))
+        elif len(rsites) > 1:
+            where = ", ".join(f"{b.module}:{b.line}" for b in rsites)
+            for b in rsites[1:]:
+                out.append(Violation(
+                    CHECK, b.module, b.line,
+                    f"{name}: restype bound at {len(rsites)} sites "
+                    f"({where}) — exactly one owning site allowed"))
+        if rsites and rsites[0].module != owner.module:
+            out.append(Violation(
+                CHECK, rsites[0].module, rsites[0].line,
+                f"{name}: restype bound in {rsites[0].module} but "
+                f"argtypes in {owner.module} — one site must own the "
+                f"whole signature"))
+
+        # agreement: argtypes
+        want = [expected_ctypes(p.ctype)[0] for p in func.params]
+        got_s = owner.value
+        if got_s is None:
+            out.append(Violation(
+                CHECK, owner.module, owner.line,
+                f"{name}: argtypes expression not statically "
+                f"understood — use plain ctypes type lists"))
+        else:
+            resolved, err = _resolve_pystructs(got_s, abi)
+            if err:
+                out.append(Violation(CHECK, owner.module, owner.line,
+                                     f"{name}: {err}"))
+            got = resolved[1:-1].split(",") if resolved != "[]" else []
+            got = _rejoin_nested(got)
+            if len(got) != len(func.params):
+                out.append(Violation(
+                    CHECK, owner.module, owner.line,
+                    f"{name}: argtypes has {len(got)} entries, header "
+                    f"declares {len(func.params)} parameters"))
+            else:
+                for i, (g, w, p) in enumerate(zip(got, want, func.params)):
+                    if not _types_agree(g, w):
+                        out.append(Violation(
+                            CHECK, owner.module, owner.line,
+                            f"{name}: argtypes[{i}] ({p.name}) is {g}, "
+                            f"header wants {w} ({p.ctype})"))
+        # agreement: restype
+        if rsites:
+            rs = rsites[0]
+            wantr = expected_ctypes(func.ret)[0]
+            if rs.value is None:
+                out.append(Violation(
+                    CHECK, rs.module, rs.line,
+                    f"{name}: restype expression not statically "
+                    f"understood"))
+            else:
+                resolved, err = _resolve_pystructs(rs.value, abi)
+                if err:
+                    out.append(Violation(CHECK, rs.module, rs.line,
+                                         f"{name}: {err}"))
+                elif not _types_agree(resolved, wantr):
+                    out.append(Violation(
+                        CHECK, rs.module, rs.line,
+                        f"{name}: restype is {resolved}, header wants "
+                        f"{wantr} ({func.ret})"))
+
+        # ownership of call sites
+        for scan in scans:
+            if scan.path == owner.module:
+                continue
+            for sym, line in scan.calls:
+                if sym == name:
+                    out.append(Violation(
+                        CHECK, scan.path, line,
+                        f"{name}(): called outside its owning module "
+                        f"{owner.module} — delegate through the owner's "
+                        f"Python wrapper instead of a second raw handle"))
+
+    # struct layout agreement (every Python Structure that names a
+    # header struct must match its field list exactly)
+    for scan in scans:
+        for py_name, fields in scan.structs.items():
+            c_name = next((c for c in abi.structs
+                           if struct_name_matches(py_name, c)), None)
+            if c_name is None:
+                continue
+            st = abi.structs[c_name]
+            line = scan.struct_lines.get(py_name, 1)
+            if len(fields) != len(st.fields):
+                out.append(Violation(
+                    CHECK, scan.path, line,
+                    f"{py_name}: {len(fields)} fields, C struct "
+                    f"{c_name} has {len(st.fields)}"))
+                continue
+            for (fn_py, ft_py), fc in zip(fields, st.fields):
+                wantf = expected_ctypes(fc.ctype)[0]
+                if fn_py != fc.name:
+                    out.append(Violation(
+                        CHECK, scan.path, line,
+                        f"{py_name}: field {fn_py!r} where C struct "
+                        f"{c_name} has {fc.name!r} — order/name drift"))
+                elif ft_py != "?" and not _types_agree(ft_py, wantf):
+                    out.append(Violation(
+                        CHECK, scan.path, line,
+                        f"{py_name}.{fn_py}: {ft_py}, C struct wants "
+                        f"{wantf}"))
+    return out
+
+
+def _rejoin_nested(parts: List[str]) -> List[str]:
+    """Undo the naive comma split inside POINTER(ARRAY(x,n)) etc."""
+    out: List[str] = []
+    depth = 0
+    buf = ""
+    for p in parts:
+        buf = f"{buf},{p}" if buf else p
+        depth = buf.count("(") - buf.count(")")
+        if depth == 0:
+            out.append(buf)
+            buf = ""
+    if buf:
+        out.append(buf)
+    return out
+
+
+def _types_agree(got: str, want: str) -> bool:
+    if got == want:
+        return True
+    # a POINTER(STRUCT(x)) may legitimately be passed where the header
+    # wants a raw pointer the Python side never dereferences — but not
+    # the reverse; and c_char_p/c_void_p are NOT interchangeable (NUL
+    # semantics differ).
+    if want == "c_void_p" and got.startswith("POINTER("):
+        return True
+    # size_t == uint64 on every platform this engine builds for (LP64)
+    aliases = {"c_size_t": "c_uint64"}
+    return aliases.get(got, got) == aliases.get(want, want)

@@ -1,0 +1,268 @@
+"""Parser for the ``strom_*`` C ABI in csrc/strom_io.h.
+
+The header is the stable contract between the native engine and every
+ctypes consumer (the analogue of the reference's nvme_strom.h ioctl
+ABI) — so it is the ground truth the ABI conformance checker
+(analysis/abi.py) compares the Python bindings against.  This is not a
+C compiler: it understands exactly the subset the header uses —
+``extern "C"`` prototypes, ``typedef struct { ... } name;`` blocks,
+``#define NAME <int>`` constants, fixed-size array fields/params — and
+*fails loudly* on anything it cannot parse, so a header edit the parser
+does not understand breaks the lint run instead of silently shrinking
+its coverage.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+#: scalar C type token -> canonical name (also the ctypes suffix:
+#: canonical "uint64" corresponds to ctypes.c_uint64)
+_SCALARS = {
+    "int": "int",
+    "unsigned": "uint",
+    "unsigned int": "uint",
+    "char": "char",
+    "int8_t": "int8", "uint8_t": "uint8",
+    "int16_t": "int16", "uint16_t": "uint16",
+    "int32_t": "int32", "uint32_t": "uint32",
+    "int64_t": "int64", "uint64_t": "uint64",
+    "size_t": "size_t",
+    "void": "void",
+}
+
+
+class HeaderParseError(ValueError):
+    """The header contains a construct this parser does not understand —
+    extend the parser, never skip the declaration."""
+
+
+@dataclass(frozen=True)
+class CType:
+    """Canonicalized C type: a scalar or struct base, pointer depth, and
+    array dimensions (outermost first; arrays in parameter position decay
+    to one extra pointer level)."""
+    base: str                       # canonical scalar or "struct:<name>"
+    ptr: int = 0                    # pointer depth
+    dims: Tuple[int, ...] = ()      # array dims, outermost first
+
+    def __str__(self) -> str:
+        s = self.base + "*" * self.ptr
+        for d in self.dims:
+            s += f"[{d}]"
+        return s
+
+
+@dataclass
+class CParam:
+    name: str
+    ctype: CType
+
+
+@dataclass
+class CFunc:
+    name: str
+    ret: CType
+    params: List[CParam]
+    line: int
+
+
+@dataclass
+class CStruct:
+    name: str
+    fields: List[CParam] = field(default_factory=list)
+    line: int = 0
+
+
+@dataclass
+class HeaderABI:
+    """Everything the conformance checker needs from one header."""
+    path: str
+    funcs: Dict[str, CFunc] = field(default_factory=dict)
+    structs: Dict[str, CStruct] = field(default_factory=dict)
+    macros: Dict[str, int] = field(default_factory=dict)
+
+
+def _strip_comments(text: str) -> str:
+    # replace comments with spaces, preserving newlines for line numbers
+    def _blank(m: re.Match) -> str:
+        return re.sub(r"[^\n]", " ", m.group(0))
+    text = re.sub(r"/\*.*?\*/", _blank, text, flags=re.S)
+    return re.sub(r"//[^\n]*", _blank, text)
+
+
+def _parse_decl(tokens: str, macros: Dict[str, int],
+                where: str) -> Tuple[CType, str]:
+    """``tokens`` is one declarator ("const strom_rd_ext *exts",
+    "uint64_t out_read[STROM_LAT_BUCKETS]", "void", ...).  Returns
+    (CType, name); name is "" for abstract declarators."""
+    t = tokens.strip()
+    dims: List[int] = []
+    for m in reversed(list(re.finditer(r"\[\s*([A-Za-z_0-9]+)\s*\]", t))):
+        tok = m.group(1)
+        if tok.isdigit():
+            dims.insert(0, int(tok))
+        elif tok in macros:
+            dims.insert(0, macros[tok])
+        else:
+            raise HeaderParseError(
+                f"{where}: unknown array dimension {tok!r} in {tokens!r}")
+        t = t[:m.start()] + t[m.end():]
+    ptr = t.count("*")
+    t = t.replace("*", " ")
+    words = [w for w in t.split() if w not in ("const", "struct")]
+    if not words:
+        raise HeaderParseError(f"{where}: empty declarator in {tokens!r}")
+    # longest scalar match first ("unsigned int")
+    name = ""
+    if len(words) >= 2 and " ".join(words[:2]) in _SCALARS:
+        base, rest = _SCALARS[" ".join(words[:2])], words[2:]
+    elif words[0] in _SCALARS:
+        base, rest = _SCALARS[words[0]], words[1:]
+    else:
+        base, rest = f"struct:{words[0]}", words[1:]
+    if len(rest) > 1:
+        raise HeaderParseError(f"{where}: cannot parse declarator {tokens!r}")
+    if rest:
+        name = rest[0]
+    return CType(base, ptr, tuple(dims)), name
+
+
+def parse_header(path: str) -> HeaderABI:
+    """Parse ``path`` into a :class:`HeaderABI`.  Every ``strom_``
+    prototype and every ``typedef struct`` is captured; a declaration the
+    parser cannot handle raises :class:`HeaderParseError`."""
+    raw = open(path).read()
+    text = _strip_comments(raw)
+    abi = HeaderABI(path=path)
+
+    for m in re.finditer(r"^\s*#\s*define\s+([A-Z_0-9]+)\s+"
+                         r"(0x[0-9a-fA-F]+|\d+)u?\s*$",
+                         text, re.M):
+        abi.macros[m.group(1)] = int(m.group(2), 0)
+
+    # opaque handles: "typedef struct X X;" — treated as void* targets
+    opaque = set(re.findall(
+        r"typedef\s+struct\s+(\w+)\s+\1\s*;", text))
+
+    for m in re.finditer(
+            r"typedef\s+struct\s+(\w+)?\s*\{(.*?)\}\s*(\w+)\s*;",
+            text, re.S):
+        name = m.group(3)
+        line = text[:m.start()].count("\n") + 1
+        st = CStruct(name=name, line=line)
+        body = m.group(2)
+        for decl in body.split(";"):
+            decl = decl.strip()
+            if not decl:
+                continue
+            ctype, fname = _parse_decl(decl, abi.macros,
+                                       f"{path}:struct {name}")
+            if not fname:
+                raise HeaderParseError(
+                    f"{path}: unnamed field in struct {name}: {decl!r}")
+            st.fields.append(CParam(fname, ctype))
+        abi.structs[name] = st
+
+    # prototypes: "<ret> strom_xxx(<params>);" possibly spanning lines.
+    # The return type may itself be a pointer ("void *strom_arena_create").
+    for m in re.finditer(
+            r"^[ \t]*([A-Za-z_][A-Za-z_0-9 ]*?[ \t*]+)"
+            r"(strom_\w+)\s*\(([^;{]*)\)\s*;",
+            text, re.M | re.S):
+        ret_tok, name, params_tok = m.groups()
+        line = text[:m.start()].count("\n") + 1
+        where = f"{path}:{line}"
+        ret, _ = _parse_decl(ret_tok, abi.macros, where)
+        params: List[CParam] = []
+        params_tok = params_tok.strip()
+        if params_tok and params_tok != "void":
+            for p in params_tok.split(","):
+                ctype, pname = _parse_decl(p, abi.macros, where)
+                # array parameters decay to pointers
+                if ctype.dims:
+                    ctype = CType(ctype.base, ctype.ptr + 1,
+                                  ctype.dims[1:])
+                params.append(CParam(pname, ctype))
+        if ret.base.startswith("struct:") and \
+                ret.base[len("struct:"):] in opaque:
+            ret = CType("void", max(ret.ptr, 1), ret.dims)
+        fixed: List[CParam] = []
+        for p in params:
+            if p.ctype.base.startswith("struct:") and \
+                    p.ctype.base[len("struct:"):] in opaque:
+                p = CParam(p.name, CType("void", max(p.ctype.ptr, 1),
+                                         p.ctype.dims))
+            fixed.append(p)
+        abi.funcs[name] = CFunc(name=name, ret=ret, params=fixed, line=line)
+
+    if not abi.funcs:
+        raise HeaderParseError(f"{path}: no strom_* prototypes found — "
+                               "the parser or the header rotted")
+    # the loud-failure backstop the module contract promises: any
+    # strom_* name followed by '(' that the prototype regex did NOT
+    # capture is a declaration shape we cannot parse (e.g. the return
+    # type on its own line) — fail the run instead of silently
+    # exempting that function from every conformance check
+    for m in re.finditer(r"\b(strom_\w+)\s*\(", text):
+        name = m.group(1)
+        if name not in abi.funcs:
+            line = text[:m.start()].count("\n") + 1
+            raise HeaderParseError(
+                f"{path}:{line}: {name!r} looks like a prototype the "
+                f"parser could not capture (return type on its own "
+                f"line?) — extend the parser, never skip the "
+                f"declaration")
+    return abi
+
+
+# --------------------------------------------------------------------------
+# expected-ctypes mapping
+# --------------------------------------------------------------------------
+
+def _snake(name: str) -> str:
+    """_RingInfo -> ring_info (how Python Structure class names are
+    matched against header struct names)."""
+    name = name.lstrip("_")
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+def struct_name_matches(py_class: str, c_struct: str) -> bool:
+    """Does Python Structure class ``py_class`` plausibly model C struct
+    ``c_struct``?  ``_RingInfo`` matches ``strom_ring_info``."""
+    s = _snake(py_class)
+    return c_struct in (s, f"strom_{s}")
+
+
+def expected_ctypes(ctype: CType) -> List[str]:
+    """Acceptable canonical ctypes spellings for one C parameter/return
+    type (see analysis/abi.py for the canonical spelling grammar)."""
+    base, ptr = ctype.base, ctype.ptr
+    if ctype.dims:
+        # only reachable for struct fields; parameters decayed already
+        inner = expected_ctypes(CType(base, ptr))[0]
+        for d in reversed(ctype.dims):
+            inner = f"ARRAY({inner},{d})"
+        return [inner]
+    if ptr == 0:
+        if base == "void":
+            return ["None"]
+        if base.startswith("struct:"):
+            return [f"STRUCT({base[len('struct:'):]})"]
+        return [f"c_{base}"]
+    if base == "void":
+        return ["c_void_p"]
+    if base == "char" and ptr == 1:
+        return ["c_char_p"]
+    if base.startswith("struct:"):
+        sname = base[len("struct:"):]
+        out = [f"POINTER(STRUCT({sname}))" + ""]
+        if ptr > 1:
+            out = [f"POINTER({out[0]})"]
+        return out
+    inner = f"c_{base}"
+    for _ in range(ptr):
+        inner = f"POINTER({inner})"
+    return [inner]

@@ -36,6 +36,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nvme_strom_tpu.utils.lockwitness import make_lock
+
 #: carve alignment: every slab starts O_DIRECT/page aligned, so an
 #: engine pool carved here is exactly as alignment-conformant as its
 #: own anonymous mapping would have been
@@ -95,7 +97,7 @@ class PinnedArena:
         nbytes = (nbytes + CARVE_ALIGN - 1) // CARVE_ALIGN * CARVE_ALIGN
         self.nbytes = nbytes
         self.lock_pages = lock_pages
-        self._lock = threading.Lock()
+        self._lock = make_lock("arena.PinnedArena._lock")
         self._free_list: List[Tuple[int, int]] = [(0, nbytes)]
         self._carved: Dict[int, Tuple[int, str]] = {}   # off → (n, tag)
         self._lib = None
@@ -109,6 +111,7 @@ class PinnedArena:
             lib = ctypes.CDLL(_load_lib()._name)
             lib.strom_arena_create.restype = ctypes.c_void_p
             lib.strom_arena_create.argtypes = [ctypes.c_uint64]
+            lib.strom_arena_destroy.restype = None
             lib.strom_arena_destroy.argtypes = [ctypes.c_void_p,
                                                 ctypes.c_uint64]
             lib.strom_arena_lock.restype = ctypes.c_int
@@ -239,7 +242,7 @@ class PinnedArena:
 # module singleton — one reservation per process
 # ---------------------------------------------------------------------------
 
-_singleton_lock = threading.Lock()
+_singleton_lock = make_lock("arena._singleton_lock")
 _arena: Optional[PinnedArena] = None
 _arena_init = False
 

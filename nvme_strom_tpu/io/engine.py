@@ -21,12 +21,13 @@ from typing import Optional
 import numpy as np
 
 from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.lockwitness import make_lock
 from nvme_strom_tpu.utils.stats import StromStats, global_stats
 from nvme_strom_tpu.utils.trace import NO_CONTEXT
 
 _CSRC = Path(__file__).resolve().parents[2] / "csrc"
 _LIB_PATH = _CSRC / "libstrom_io.so"
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("engine._lib_lock")
 _lib: Optional[ctypes.CDLL] = None
 
 
@@ -208,12 +209,12 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_engine_pool_bytes.argtypes = [
             ctypes.c_uint32, ctypes.c_uint32, ctypes.c_uint64,
             ctypes.c_uint32]
-        lib.strom_arena_create.restype = ctypes.c_void_p
-        lib.strom_arena_create.argtypes = [ctypes.c_uint64]
-        lib.strom_arena_destroy.argtypes = [ctypes.c_void_p,
-                                            ctypes.c_uint64]
-        lib.strom_arena_lock.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        # strom_arena_* is OWNED by io/arena.py (its private handle) —
+        # binding it here too was exactly the double-bind shape
+        # strom-lint's abi pass forbids (one owning site per symbol)
+        lib.strom_ring_count.restype = ctypes.c_int
         lib.strom_ring_count.argtypes = [ctypes.c_void_p]
+        lib.strom_get_ring_info.restype = ctypes.c_int
         lib.strom_get_ring_info.argtypes = [ctypes.c_void_p,
                                             ctypes.c_uint32,
                                             ctypes.POINTER(_RingInfo)]
@@ -224,6 +225,7 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_ring_restart.argtypes = [ctypes.c_void_p,
                                            ctypes.c_uint32,
                                            ctypes.c_uint64]
+        lib.strom_set_ring_stall.restype = ctypes.c_int
         lib.strom_set_ring_stall.argtypes = [ctypes.c_void_p,
                                              ctypes.c_uint32,
                                              ctypes.c_int]
@@ -235,14 +237,19 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_submit_read_ring.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
             ctypes.c_uint64, ctypes.c_uint64]
+        lib.strom_submit_readv_ring.restype = ctypes.c_int
         lib.strom_submit_readv_ring.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.POINTER(_RdExt),
             ctypes.c_uint32, ctypes.POINTER(ctypes.c_int64)]
+        lib.strom_engine_destroy.restype = None
         lib.strom_engine_destroy.argtypes = [ctypes.c_void_p]
+        lib.strom_check_file.restype = ctypes.c_int
         lib.strom_check_file.argtypes = [ctypes.c_char_p,
                                          ctypes.POINTER(_FileInfo)]
+        lib.strom_resolve_device.restype = ctypes.c_int
         lib.strom_resolve_device.argtypes = [ctypes.c_char_p,
                                              ctypes.POINTER(_DeviceInfo)]
+        lib.strom_file_extents.restype = ctypes.c_int
         lib.strom_file_extents.argtypes = [ctypes.c_char_p,
                                            ctypes.POINTER(_Extent),
                                            ctypes.c_uint32]
@@ -250,22 +257,29 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
             ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint64)]
         lib.strom_stripe_attr.restype = None
+        lib.strom_get_pool_info.restype = None
         lib.strom_get_pool_info.argtypes = [ctypes.c_void_p,
                                             ctypes.POINTER(_PoolInfo)]
+        lib.strom_get_latency.restype = None
         lib.strom_get_latency.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_uint64)]
+        lib.strom_open.restype = ctypes.c_int
         lib.strom_open.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                    ctypes.c_int]
+        lib.strom_close.restype = ctypes.c_int
         lib.strom_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.strom_file_size.restype = ctypes.c_int64
         lib.strom_file_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.strom_file_is_direct.restype = ctypes.c_int
         lib.strom_file_is_direct.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.strom_file_ident.restype = ctypes.c_int
         lib.strom_file_ident.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                          ctypes.POINTER(ctypes.c_uint64)]
         lib.strom_submit_read.restype = ctypes.c_int64
         lib.strom_submit_read.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                           ctypes.c_uint64, ctypes.c_uint64]
+        lib.strom_submit_readv.restype = ctypes.c_int
         lib.strom_submit_readv.argtypes = [ctypes.c_void_p,
                                            ctypes.POINTER(_RdExt),
                                            ctypes.c_uint32,
@@ -278,23 +292,31 @@ def _load_lib() -> ctypes.CDLL:
         lib.strom_submit_write_ring.argtypes = [
             ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int,
             ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64]
+        lib.strom_wait.restype = ctypes.c_int
         lib.strom_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                    ctypes.POINTER(_Completion)]
+        lib.strom_wait_timeout.restype = ctypes.c_int
         lib.strom_wait_timeout.argtypes = [ctypes.c_void_p,
                                            ctypes.c_int64,
                                            ctypes.POINTER(_Completion),
                                            ctypes.c_uint64]
+        lib.strom_release.restype = ctypes.c_int
         lib.strom_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.strom_get_stats.restype = None
         lib.strom_get_stats.argtypes = [ctypes.c_void_p,
                                         ctypes.POINTER(_StatsBlk)]
+        lib.strom_drain_stats.restype = None
         lib.strom_drain_stats.argtypes = [ctypes.c_void_p,
                                           ctypes.POINTER(_StatsBlk)]
+        lib.strom_reset_stats.restype = None
         lib.strom_reset_stats.argtypes = [ctypes.c_void_p]
+        lib.strom_backend_is_uring.restype = ctypes.c_int
         lib.strom_backend_is_uring.argtypes = [ctypes.c_void_p]
         lib.strom_tar_index.restype = ctypes.c_int64
         lib.strom_tar_index.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
             ctypes.POINTER(ctypes.c_uint64)]
+        lib.strom_tar_index_free.restype = None
         lib.strom_tar_index_free.argtypes = [
             ctypes.POINTER(ctypes.c_uint8)]
         _lib = lib

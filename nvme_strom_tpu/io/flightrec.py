@@ -32,6 +32,7 @@ import time
 from typing import Optional
 
 from nvme_strom_tpu.utils.config import FlightConfig
+from nvme_strom_tpu.utils.lockwitness import make_lock
 from nvme_strom_tpu.utils.stats import Log2Histogram, _atomic_write_text
 
 #: op-record field order (records are plain tuples — ~4x smaller and
@@ -51,7 +52,7 @@ class FlightRecorder:
             maxlen=self.cfg.ops)
         self._lat = Log2Histogram("strom_flight_latency_us",
                                   "recorded op latency (µs)")
-        self._dump_lock = threading.Lock()
+        self._dump_lock = make_lock("flightrec.FlightRecorder._dump_lock")
         self._last_dump = -1e9
         self.dumps = 0
         #: dump paths written, newest last (bounded; tests and the

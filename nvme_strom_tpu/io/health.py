@@ -66,6 +66,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from nvme_strom_tpu.utils.config import BreakerConfig
+from nvme_strom_tpu.utils.lockwitness import make_rlock
 
 #: breaker states (the ``ring_health`` gauge renders these)
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
@@ -218,7 +219,7 @@ class EngineSupervisor:
         self.rings = [_RingBreaker(self.cfg.window_s) for _ in range(n)]
         self.device_window = _Window(self.cfg.window_s)
         self._degraded = False         # device breaker open
-        self._lock = threading.RLock()
+        self._lock = make_rlock("health.EngineSupervisor._lock")
         self._next_tick = 0.0
         self._next_probe = 0.0
         self._rr = 0                   # healthy-ring round-robin cursor

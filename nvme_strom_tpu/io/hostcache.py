@@ -67,12 +67,49 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from nvme_strom_tpu.utils.lockwitness import make_lock, make_rlock
 from nvme_strom_tpu.io.sched import CLASS_ORDER, DEFAULT_CLASS, \
     default_policies
 from nvme_strom_tpu.utils.config import HostCacheConfig
 
 #: line-key type: ((dev, ino, mtime_ns, size), line_offset)
 LineKey = Tuple[tuple, int]
+
+
+_hc_lib = None        # bound private CDLL handle (None until first bind)
+_hc_lib_lock = make_lock("hostcache._hc_lib_lock")
+
+
+def _hostcache_lib():
+    """The module's ONE owning bind site for the ``strom_hostcache_*``
+    symbols (strom-lint abi: single-bind ownership — the pre-PR-13
+    shape bound ``strom_hostcache_copy`` at two sites).  Private CDLL
+    handle: ctypes caches one function object per CDLL instance, so
+    sharing ``_load_lib()``'s handle would let another module's
+    ``argtypes`` assignment silently retype ours.  None when the
+    library cannot build (trimmed installs) — NOT cached, so a later
+    arena retries once the build becomes possible (the pre-PR-13
+    per-arena cadence)."""
+    global _hc_lib
+    with _hc_lib_lock:
+        if _hc_lib is None:
+            try:
+                from nvme_strom_tpu.io.engine import _load_lib
+                lib = ctypes.CDLL(_load_lib()._name)
+                lib.strom_hostcache_arena_create.restype = ctypes.c_void_p
+                lib.strom_hostcache_arena_create.argtypes = [
+                    ctypes.c_uint64, ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_int32)]
+                lib.strom_hostcache_arena_destroy.restype = None
+                lib.strom_hostcache_arena_destroy.argtypes = [
+                    ctypes.c_void_p, ctypes.c_uint64]
+                lib.strom_hostcache_copy.restype = None
+                lib.strom_hostcache_copy.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+                _hc_lib = lib
+            except Exception:
+                return None
+        return _hc_lib
 
 
 def _scheduler_weights() -> Dict[str, float]:
@@ -121,32 +158,15 @@ class _Arena:
             self._base = slab.addr
             self.view = slab.view
             self.locked = bool(slab.locked)   # THIS carve's mlock verdict
-            try:
-                from nvme_strom_tpu.io.engine import _load_lib
-                lib = ctypes.CDLL(_load_lib()._name)
-                lib.strom_hostcache_copy.argtypes = [
-                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
-                self._lib = lib
-            except Exception:
-                # numpy-backed arena (trimmed install): copy_in's
-                # _lib-is-None branch serves fills — unpinned but
-                # functional, the documented degradation
-                self._lib = None
+            # numpy-backed fallback when the lib can't build: copy_in's
+            # _lib-is-None branch serves fills — unpinned but
+            # functional, the documented degradation
+            self._lib = _hostcache_lib()
             return
         try:
-            from nvme_strom_tpu.io.engine import _load_lib
-            # private CDLL handle: ctypes caches one function object per
-            # CDLL instance, so sharing _load_lib()'s handle would let
-            # another module's argtypes assignment silently retype ours
-            lib = ctypes.CDLL(_load_lib()._name)
-            lib.strom_hostcache_arena_create.restype = ctypes.c_void_p
-            lib.strom_hostcache_arena_create.argtypes = [
-                ctypes.c_uint64, ctypes.c_int,
-                ctypes.POINTER(ctypes.c_int32)]
-            lib.strom_hostcache_arena_destroy.argtypes = [
-                ctypes.c_void_p, ctypes.c_uint64]
-            lib.strom_hostcache_copy.argtypes = [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+            lib = _hostcache_lib()
+            if lib is None:
+                raise OSError("libstrom_io unavailable")
             locked = ctypes.c_int32(0)
             base = lib.strom_hostcache_arena_create(
                 nbytes, 1 if lock_pages else 0, ctypes.byref(locked))
@@ -375,7 +395,7 @@ class HostCache:
         for k in quotas:
             if k not in self._rev_order:
                 self._rev_order.insert(0, k)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("hostcache.HostCache._lock")
         self._lines: Dict[LineKey, _Line] = {}
         self._free: List[int] = list(range(self.capacity))
         self._ghost: "OrderedDict[LineKey, None]" = OrderedDict()
@@ -866,7 +886,7 @@ class HostCache:
 # module singleton — the ONE shared budget
 # --------------------------------------------------------------------------
 
-_singleton_lock = threading.Lock()
+_singleton_lock = make_lock("hostcache._singleton_lock")
 _cache: Optional[HostCache] = None
 _cache_init = False
 

@@ -62,6 +62,7 @@ from typing import Optional
 import numpy as np
 
 from nvme_strom_tpu.utils.config import ResilientConfig
+from nvme_strom_tpu.utils.lockwitness import make_lock
 
 #: granularity of the hedged/stuck wait loop: long enough to stay off
 #: the hot path (one wake per slice only while a read is *already* a
@@ -630,7 +631,7 @@ class ResilientEngine:
         # loop's supervision tick runs per poll slice
         self._supervisor = getattr(engine, "supervisor", None)
         self._hedge_out: dict = {}           # class -> outstanding hedges
-        self._hedge_lock = threading.Lock()
+        self._hedge_lock = make_lock("resilient.ResilientEngine._hedge_lock")
         self._rng = random.Random(self.rconfig.seed)
         # abandoned attempts (lost hedges, cancelled stuck reads) whose
         # I/O may still be in flight: released opportunistically once
@@ -638,7 +639,7 @@ class ResilientEngine:
         # straggler/wedge being recovered from.  Bounded: at most
         # 1 + max_retries outstanding attempts exist per logical read.
         self._zombies: list = []
-        self._zombie_lock = threading.Lock()
+        self._zombie_lock = make_lock("resilient.ResilientEngine._zombie_lock")
         # derived hedge threshold, refreshed at most once a second PER
         # CLASS: the percentile walk over the C histogram is cheap but
         # runs per wait — uncached it becomes measurable on tens of

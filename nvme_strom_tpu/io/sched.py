@@ -70,6 +70,8 @@ from collections import deque
 from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Sequence
 
+from nvme_strom_tpu.utils.lockwitness import make_condition, make_lock
+
 #: priority order, highest first — the serving decode path outranks
 #: checkpoint/weight restore, which outranks loader/SQL prefetch, which
 #: outranks background scrub
@@ -186,8 +188,8 @@ class QoSScheduler:
         self._granted_out: Dict[int, int] = {}  # ring -> spans granted,
         #                                         not yet engine-submitted
         self._closed = False
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = make_lock("sched.QoSScheduler._lock")
+        self._cv = make_condition("sched.QoSScheduler._cv", self._lock)
         # counters mirrored into StromStats when one is attached
         self.dispatches = 0
         self.promotions = 0

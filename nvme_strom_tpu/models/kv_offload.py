@@ -42,6 +42,7 @@ of skewing the softmax silently.
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
 
@@ -55,6 +56,7 @@ from nvme_strom_tpu.models.decode import mlp_block as _mlp_block
 from nvme_strom_tpu.models.transformer import (
     TransformerConfig, qkv_project, rms_norm, wmat)
 from nvme_strom_tpu.ops.bridge import DeviceStream
+from nvme_strom_tpu.utils.lockwitness import make_condition, make_lock
 
 
 @dataclass(frozen=True)
@@ -960,8 +962,34 @@ class PrefixStore:
             os.makedirs(d, exist_ok=True)
         self._fh = engine.open(self.path, writable=True)
         self.stats = getattr(engine, "stats", None)
-        self._lock = threading.Lock()
-        self._wlock = threading.Lock()   # pending-write pipeline
+        self._lock = make_lock("kv_offload.PrefixStore._lock")
+        self._wlock = make_lock("kv_offload.PrefixStore._wlock")
+        #: set by close() BEFORE its final flush: put()/restore_many()
+        #: refuse new work once closing, so the bounded drain converges
+        #: (no new appends) and the engine fh is never closed under a
+        #: storm's in-flight I/O.  _io_inflight counts put() writes AND
+        #: restore_many() reads past the gate; close() waits for it to
+        #: hit zero before touching the fh, so an op that won the gate
+        #: race can never submit against a closed (or None) handle.
+        self._closed = False
+        self._io_inflight = 0
+        #: notified whenever _io_inflight hits zero (shares _lock);
+        #: close() waits on it instead of busy-polling
+        self._io_cv = make_condition("kv_offload.PrefixStore._io_cv",
+                                     self._lock)
+        #: thread id of the active drainer (_drain_mu holder): a put()
+        #: re-entered from one of the drain's own waits must SKIP the
+        #: backpressure acquire below, not self-deadlock on it
+        self._drain_owner: Optional[int] = None
+        # serializes DRAINERS only (flush semantics: on return, every
+        # batch beyond `keep` is COMPLETE, even when popped by a
+        # concurrent drainer); put()'s bounded maintenance drain only
+        # TRY-acquires it — when a drain is already running the
+        # submitter skips (the active drainer enforces the bound), so
+        # put() never blocks behind another thread's I/O waits while
+        # the backlog is within 2x the soft bound (past that it blocks
+        # for backpressure: memory stays bounded under a wedged drain)
+        self._drain_mu = make_lock("kv_offload.PrefixStore._drain_mu")
         #: key -> {"page": slot, "hits": n, "seq": lru-tick, "crc": int,
         #:         "pins": in-flight restores}
         self._entries: Dict[bytes, dict] = {}
@@ -1028,6 +1056,22 @@ class PrefixStore:
         one span and hands each slot a view).  A failed page drops its
         store entry (healed by recompute) and is simply absent from the
         result; the caller prefills it like any miss."""
+        # same close() gate as put(): this path submits against
+        # self._fh, and an empty result just means the caller
+        # recomputes — refuse work, never fail it
+        with self._lock:
+            if self._closed:
+                return {}
+            self._io_inflight += 1
+        try:
+            return self._restore_many_gated(wants)
+        finally:
+            with self._io_cv:
+                self._io_inflight -= 1
+                if self._io_inflight == 0:
+                    self._io_cv.notify_all()
+
+    def _restore_many_gated(self, wants) -> Dict[object, Dict[int, tuple]]:
         import time as _time
         plan: list = []            # (slot, chain_index, key, entry)
         with self._lock:
@@ -1179,15 +1223,122 @@ class PrefixStore:
         drops (the next admission recomputes and re-writes it) — the
         never-fail-a-request contract, write side."""
         bad: list = []
-        with self._wlock:
-            while len(self._pending_writes) > keep:
-                for p in self._pending_writes.pop(0):
-                    try:
-                        p.wait()
-                    except OSError:
-                        bad.append(getattr(p, "offset", None))
+        # strom-lint lock-blocking fix (PR 13): the pre-PR shape waited
+        # the whole backlog UNDER _wlock, stalling every concurrent
+        # put() behind this thread's I/O.  Now _wlock covers only the
+        # pop; the waits run outside it, serialized by _drain_mu.  A
+        # MAINTENANCE drain (keep > 0, put()'s backlog bound) only
+        # try-acquires: if another thread is already draining, it will
+        # observe our append and enforce the bound itself, so the
+        # submitter returns without ever blocking on foreign I/O.
+        # flush (keep == 0) blocks — its contract is completion.
+        me = threading.get_ident()
+        if keep > 0:
+            if not self._drain_mu.acquire(blocking=False):
+                # a drainer is already active; skip — UNLESS the
+                # backlog has outrun it past the hard cap, where the
+                # submitter must block for backpressure (the pre-PR
+                # memory bound: each pending batch pins a page of
+                # write buffers, and a wedged drain must not let
+                # every subsequent put() grow the backlog forever).
+                # A put() RE-ENTERED from the active drain's own
+                # wait() is that drainer — blocking here would
+                # self-deadlock on our own non-reentrant mu
+                if self._drain_owner == me:
+                    return
+                with self._wlock:
+                    backlog = len(self._pending_writes)
+                if backlog <= 2 * self._MAX_PENDING:
+                    return
+                self._drain_mu.acquire()
+            self._drain_owner = me
+            try:
+                self._drain_loop(keep, bad)
+            finally:
+                self._drain_owner = None
+                self._drain_mu.release()
+        else:
+            if self._drain_owner == me:
+                # restore_many()/flush() re-entered from the active
+                # drain's own wait(): the outer drainer IS doing the
+                # work — blocking would self-deadlock on our own mu
+                return
+            with self._drain_mu:
+                self._drain_owner = me
+                try:
+                    self._drain_loop(0, bad)
+                finally:
+                    self._drain_owner = None
         if bad:
             self._drop_pages_at(bad)
+
+    def _drain_all_and_snapshot(self) -> Optional[set]:
+        """flush()'s drain: returns the set of keys PROVEN drained, for
+        the ``clean=True`` manifest stamp.  Each round snapshots the
+        ready key set FIRST, then runs the snapshot drain; the stamp is
+        the final round's pre-drain snapshot.  Why that is safe:
+        (a) put() appends an entry's batch BEFORE flipping it ready, so
+        a snapshotted entry's batch predates the drain that follows;
+        (b) ``_drain_loop`` pops FIFO at least every batch pending at
+        its entry, and waits them; (c) a batch popped by an EARLIER
+        drainer is complete, because drainers finish their waits before
+        releasing ``_drain_mu`` and we hold it.  An entry that flips
+        ready after the snapshot (a put() racing the flush) is simply
+        not stamped — a crash costs that cache entry, never serves torn
+        bytes (snapshotting AFTER the drain instead would TOCTOU: the
+        racing entry lands in the stamp with its writes in flight).
+        Rounds are BOUNDED: sustained put() traffic appends faster than
+        one round drains, and an unbounded chase would pin
+        flush()/close() forever — the leftover tail batches stay
+        pending (and unstamped) for the next drain."""
+        bad: list = []
+        stamped: set = set()
+        if self._drain_owner == threading.get_ident():
+            # flush() re-entered from our own drain's wait(): None =
+            # "do not save a manifest at all" — the outer flush
+            # finishes the job (an empty SET here would stamp an
+            # empty clean manifest over every persisted page)
+            return None
+        with self._drain_mu:
+            self._drain_owner = threading.get_ident()
+            try:
+                for _ in range(8):
+                    with self._lock:
+                        stamped = {kx for kx, e in self._entries.items()
+                                   if e["ready"]}
+                    self._drain_loop(0, bad)
+                    with self._wlock:
+                        if not self._pending_writes:
+                            break
+            finally:
+                self._drain_owner = None
+        if bad:
+            # dropped entries leave _entries, and _save_manifest
+            # re-filters against the live map — a failed write's page
+            # can't be stamped through the stale snapshot
+            self._drop_pages_at(bad)
+        return stamped
+
+    def _drain_loop(self, keep: int, bad: list) -> None:
+        # caller holds _drain_mu (waived in the lock-order manifest:
+        # these waits are the drain, and only drainers contend the mu).
+        # Drain a SNAPSHOT of the backlog: _wlock is released during
+        # each batch's waits, so batches appended meanwhile belong to
+        # the NEXT drain — chasing the moving tail would let sustained
+        # put() traffic pin flush()/restore_many() forever.
+        with self._wlock:
+            excess = len(self._pending_writes) - keep
+        while excess > 0:
+            excess -= 1
+            with self._wlock:
+                if len(self._pending_writes) <= keep:
+                    break
+                batch = self._pending_writes.pop(0)
+            for p in batch:
+                try:
+                    p.wait()
+                except OSError:
+                    bad.append(getattr(p, "offset", None))
 
     def _drop_pages_at(self, offsets) -> None:
         """Drop entries whose backing page overlaps a failed write —
@@ -1232,6 +1383,23 @@ class PrefixStore:
         that sees a ready page and then drains pending writes can never
         read bytes the device hasn't been handed."""
         from nvme_strom_tpu.utils.checksum import crc32c
+        with self._lock:
+            if self._closed:
+                # closing/closed: a cache may refuse work, never fail
+                # it — the caller's recompute path serves
+                return 0
+            self._io_inflight += 1
+        try:
+            return self._put_gated(pages, crc32c)
+        finally:
+            with self._io_cv:
+                self._io_inflight -= 1
+                if self._io_inflight == 0:
+                    self._io_cv.notify_all()
+
+    def _put_gated(self, pages, crc32c) -> int:
+        # body of put(); the caller holds an _io_inflight reference,
+        # so close() cannot close the engine fh under these submits
         written = 0
         deduped = 0
         for kx, k, v in pages:
@@ -1239,6 +1407,8 @@ class PrefixStore:
             # one batch, or two servers, computing the same prompt)
             # must not pay the page copy + CRC it is about to discard
             with self._lock:
+                if self._closed:
+                    break
                 if kx in self._entries:
                     deduped += 1
                     continue
@@ -1332,7 +1502,7 @@ class PrefixStore:
         return self.path + ".kvman.json"
 
     def _save_manifest(self, throttle: bool = False,
-                       clean: bool = False) -> None:
+                       clean: bool = False, keys=None) -> None:
         """Atomically persist {page slot -> (key hex, crc)} so
         ``strom-scrub`` can verify the store offline with no model or
         server around (the PR-5 at-rest integrity contract).
@@ -1345,7 +1515,11 @@ class PrefixStore:
         mid-run manifest may stamp pages whose async writes never
         completed (or whose slot was re-used inside the throttle
         window), so a crash must cost cache entries, never serve torn
-        bytes to a restarted server."""
+        bytes to a restarted server.  ``keys`` (set by ``flush()``)
+        restricts the stamp to entries whose writes were PROVEN
+        drained (:meth:`_drain_all_and_snapshot`): a put() racing the
+        flush can flip an entry ready after the drain, and a clean
+        manifest must not cover it."""
         import json
         import os
         import time as _time
@@ -1356,7 +1530,8 @@ class PrefixStore:
             self._man_last = now
         with self._lock:
             pages = {str(e["page"]): {"key": kx.hex(), "crc": e["crc"]}
-                     for kx, e in self._entries.items() if e["ready"]}
+                     for kx, e in self._entries.items()
+                     if e["ready"] and (keys is None or kx in keys)}
         man = {"version": 1, "page_bytes": self.page_bytes,
                "page_tokens": self.page_tokens, "clean": clean,
                "pages": pages}
@@ -1410,11 +1585,28 @@ class PrefixStore:
     # -- lifecycle ---------------------------------------------------------
 
     def flush(self) -> None:
-        self._drain_writes()
-        self._save_manifest(clean=True)
+        stamped = self._drain_all_and_snapshot()
+        if stamped is None:
+            # re-entered from our own drain's wait(): the OUTER flush
+            # saves — stamping now would atomically install an EMPTY
+            # clean manifest, wiping every persisted page on a crash
+            return
+        self._save_manifest(clean=True, keys=stamped)
 
     def close(self) -> None:
         if self._fh is not None:
+            # gate BEFORE the flush: put() refuses new work once
+            # closing, so the bounded drain converges.  Then WAIT for
+            # puts already past the gate — their submits target
+            # self._fh, and closing (or None-ing) it under them would
+            # surface a ctypes/OS error into the serving path a cache
+            # must never fail.  put() holds no lock across its I/O,
+            # so the in-flight count drains promptly.
+            with self._lock:
+                self._closed = True
+            with self._io_cv:
+                while self._io_inflight:
+                    self._io_cv.wait(timeout=1.0)
             try:
                 self.flush()
             finally:

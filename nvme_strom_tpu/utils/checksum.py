@@ -39,6 +39,8 @@ from typing import Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
+from nvme_strom_tpu.utils.lockwitness import make_lock
+
 _log = logging.getLogger(__name__)
 
 #: algorithm tag recorded next to every stamped checksum; verification
@@ -46,7 +48,7 @@ _log = logging.getLogger(__name__)
 #: computed by different polynomials
 CRC_ALGO = "crc32c"
 
-_native_lock = threading.Lock()
+_native_lock = make_lock("checksum._native_lock")
 _native = None            # (fn, True) once resolved; (None, False) = py
 
 
@@ -141,7 +143,7 @@ class VerifyPolicy:
         self.mode = mode if mode is not None else verify_mode()
         self._every = sample_every()
         self._seen = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("checksum.VerifyPolicy._lock")
 
     @property
     def enabled(self) -> bool:

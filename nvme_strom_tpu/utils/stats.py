@@ -44,6 +44,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from nvme_strom_tpu.utils.lockwitness import make_lock
+
 #: Every public counter on StromStats, derived once from the dataclass —
 #: snapshot/reset/merge iterate this so a new counter needs exactly one edit.
 COUNTER_FIELDS: tuple = ()  # filled in after the class definition
@@ -208,7 +210,9 @@ class StromStats:
     # flight-recorder post-mortem dumps written (breaker trip, ring
     # restart, SLO violation, watchdog stall)
     flight_dumps: int = 0
-    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _lock: threading.Lock = field(
+        default_factory=lambda: make_lock("stats.StromStats._lock"),
+        repr=False)
     _t0: float = field(default_factory=time.monotonic, repr=False)
     _gauges: dict = field(default_factory=dict, repr=False)
     # per-raid-member payload attribution (striped-scaling evidence,
@@ -408,7 +412,7 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats._Metric._lock")
         self._values: Dict[tuple, float] = {}
 
     def samples(self) -> List[Tuple[tuple, float]]:
@@ -457,7 +461,7 @@ class Log2Histogram:
     def __init__(self, name: str, help: str = "", buckets: int = 40):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats.Log2Histogram._lock")
         self._counts = [0] * buckets
         self._sum = 0.0
 
@@ -503,7 +507,7 @@ class MetricsRegistry:
     :func:`openmetrics_from_snapshot` — one exporter, two sources."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("stats.MetricsRegistry._lock")
         self._metrics: Dict[str, object] = {}
 
     def counter(self, name: str, help: str = "",
@@ -714,7 +718,7 @@ class MetricsSnapshotter:
         #: teardown detaches here) can never race a drain against the
         #: C handle being destroyed.
         self._sync = sync
-        self._sync_lock = threading.Lock()
+        self._sync_lock = make_lock("stats.MetricsSnapshotter._sync_lock")
         self.series: List[dict] = []
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
@@ -775,7 +779,7 @@ class MetricsSnapshotter:
         self.close()
 
 
-_writer_lock = threading.Lock()
+_writer_lock = make_lock("stats._writer_lock")
 _writer: Optional[MetricsSnapshotter] = None
 
 

@@ -41,6 +41,8 @@ import threading
 import time
 from typing import Optional
 
+from nvme_strom_tpu.utils.lockwitness import make_lock
+
 
 #: Default in-memory span cap; override per-tracer or with
 #: $STROM_TRACE_MAX_EVENTS.  When full, new spans are DROPPED and counted
@@ -144,7 +146,7 @@ class Tracer:
 
     def __init__(self, path: Optional[str] = None,
                  max_events: Optional[int] = None, stats=None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("trace.Tracer._lock")
         self._events: list[dict] = []
         self._path = path
         self.enabled = path is not None

@@ -28,6 +28,8 @@ import time
 from contextlib import contextmanager
 from typing import Optional
 
+from nvme_strom_tpu.utils.lockwitness import make_condition, make_lock
+
 
 class StepWatchdog:
     """Deadline monitor for an iterative loop.
@@ -56,8 +58,8 @@ class StepWatchdog:
         self.timeouts = 0                 # total deadline overruns seen
         self._gen = 0                     # increments on arm/disarm
         self._armed_at: Optional[float] = None
-        self._lock = threading.Lock()
-        self._wake = threading.Condition(self._lock)
+        self._lock = make_lock("watchdog.StepWatchdog._lock")
+        self._wake = make_condition("watchdog.StepWatchdog._wake", self._lock)
         self._stop = False
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="strom-watchdog")

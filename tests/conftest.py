@@ -24,6 +24,26 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _lock_witness_armed(request):
+    """Arm the runtime lock-order witness (utils/lockwitness.py,
+    docs/ANALYSIS.md) for every chaos/stress/analysis test: locks built
+    during the test record real acquisition edges, and a cycle — an
+    inversion that WOULD deadlock under another interleaving — fails the
+    test even though this run survived it.  Other suites run disarmed
+    (plain threading primitives, zero overhead)."""
+    wanted = {"chaos", "analysis"}
+    marked = {m.name for m in request.node.iter_markers()}
+    if not (marked & wanted) and "test_stress" not in request.node.nodeid:
+        yield None
+        return
+    from nvme_strom_tpu.utils import lockwitness
+    with lockwitness.armed_scope() as w:
+        yield w
+    assert not w.violations, (
+        f"lock-order witness recorded violations: {w.violations}")
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     import jax

@@ -1,0 +1,34 @@
+/* Fixture header for strom-lint's abi pass tests: a miniature strom ABI
+ * with enough surface to seed every violation class. */
+#ifndef ABI_BAD_H
+#define ABI_BAD_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct fx_engine fx_engine;
+
+#define FX_SLOTS 8
+
+typedef struct strom_fx_info {
+  uint64_t bytes;
+  int32_t  flags;
+  int32_t  pad;
+  char     name[32];
+} strom_fx_info;
+
+fx_engine *strom_fx_create(uint32_t depth, uint64_t bytes);
+int strom_fx_info_get(fx_engine *eng, strom_fx_info *out);
+int64_t strom_fx_read(fx_engine *eng, int fh, uint64_t offset,
+                      uint64_t len);
+void strom_fx_destroy(fx_engine *eng);
+uint32_t strom_fx_crc(const void *data, uint64_t len, uint32_t crc);
+int strom_fx_never_bound(fx_engine *eng);
+
+#ifdef __cplusplus
+}
+#endif
+#endif

@@ -1,0 +1,53 @@
+"""Seeded-defect fixture for strom-lint's ctypes-ABI pass (abi_bad.h).
+
+Every violation class the checker must report is planted here ON
+PURPOSE — tests/test_strom_lint.py asserts each one surfaces with a
+file:line report and that the driver exits non-zero:
+
+1. strom_fx_read: argtypes DISAGREE with the header (c_uint32 where the
+   header says uint64_t offset) — the silent-truncation bug class.
+2. strom_fx_read: restype never bound (implicit c_int would truncate
+   the int64_t request id on LP64 — the exact shape the real tree
+   fixed in PR 3).
+3. strom_fx_crc: bound at TWO sites (the PR-5 shared-handle clobber).
+4. strom_fx_destroy: called but never bound anywhere.
+5. strom_fx_never_bound: declared in the header, bound nowhere.
+6. strom_fx_create: argtypes has the wrong ARITY (missing a param).
+7. _FxInfo: struct field order drifted from strom_fx_info.
+"""
+
+import ctypes
+
+
+class _FxInfo(ctypes.Structure):
+    _fields_ = [
+        ("flags", ctypes.c_int32),          # header order: bytes first
+        ("bytes", ctypes.c_uint64),
+        ("pad", ctypes.c_int32),
+        ("name", ctypes.c_char * 32),
+    ]
+
+
+def bind(lib: ctypes.CDLL) -> None:
+    lib.strom_fx_create.restype = ctypes.c_void_p
+    lib.strom_fx_create.argtypes = [ctypes.c_uint32]        # arity: 1 of 2
+    lib.strom_fx_info_get.restype = ctypes.c_int
+    lib.strom_fx_info_get.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(_FxInfo)]
+    lib.strom_fx_read.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                  ctypes.c_uint32,           # != uint64_t
+                                  ctypes.c_uint64]
+    lib.strom_fx_crc.restype = ctypes.c_uint32
+    lib.strom_fx_crc.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                 ctypes.c_uint32]
+
+
+def bind_again(lib: ctypes.CDLL) -> None:
+    # the PR-5 clobber: a SECOND site retyping the same symbol
+    lib.strom_fx_crc.restype = ctypes.c_uint32
+    lib.strom_fx_crc.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                 ctypes.c_uint32]
+
+
+def shutdown(lib: ctypes.CDLL, eng) -> None:
+    lib.strom_fx_destroy(eng)          # called, never bound

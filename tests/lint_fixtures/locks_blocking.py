@@ -1,0 +1,60 @@
+"""Seeded-defect fixture for strom-lint's blocking-under-lock pass.
+
+Plants the exact shapes PRs 7/8/9 fixed by hand:
+
+1. ``Worker.sleepy`` — ``time.sleep`` under a lock.
+2. ``Worker.crc_fill`` — a CRC fill (``crc32c``) under a lock.
+3. ``Worker.engine_wait`` — an engine-style ``.wait()`` on a pending
+   request under a lock.
+4. ``Worker.cv_other_lock`` — ``Condition.wait`` while holding a lock
+   OTHER than the condition's own (the wait releases only its own
+   lock; the second one blocks for the whole wait).
+5. ``Worker.syscall`` — ``os.fsync`` under a lock.
+
+``Worker.cv_own_lock`` (waiting on a condition while holding only its
+own lock) is the canonical correct pattern and must NOT be flagged;
+``Worker.unlocked_sleep`` must not be flagged either.
+"""
+
+import os
+import threading
+import time
+
+
+def crc32c(data, crc=0):
+    return 0
+
+
+class Worker:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._cv_mu = threading.Lock()
+        self._cv = threading.Condition(self._cv_mu)
+
+    def sleepy(self):
+        with self._mu:
+            time.sleep(0.5)
+
+    def crc_fill(self, view):
+        with self._mu:
+            return crc32c(view)
+
+    def engine_wait(self, pending):
+        with self._mu:
+            return pending.wait()
+
+    def cv_other_lock(self):
+        with self._mu:
+            with self._cv:
+                self._cv.wait()
+
+    def cv_own_lock(self):
+        with self._cv:
+            self._cv.wait()             # correct: NOT a violation
+
+    def syscall(self, fd):
+        with self._mu:
+            os.fsync(fd)
+
+    def unlocked_sleep(self):
+        time.sleep(0.01)                # correct: NOT a violation

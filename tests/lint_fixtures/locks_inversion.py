@@ -1,0 +1,56 @@
+"""Seeded-defect fixture for strom-lint's lock-order pass.
+
+Against the fixture manifest (lockorder_fixture.conf: order alpha >
+beta), this module plants:
+
+1. ``Duo.wrong_way`` — a DIRECT nested-with inversion: the beta-group
+   lock held while acquiring the alpha-group lock.
+2. ``Duo.wrong_way_via_call`` — the same inversion one call deep
+   (beta held, callee acquires alpha) — the interprocedural shape.
+3. ``Duo.reenter`` — a self-deadlock: a non-reentrant lock re-acquired
+   through a helper while already held (the PR-9 eviction-lock bug,
+   miniature).
+
+``Duo.right_way`` is the conforming direction and must NOT be flagged.
+"""
+
+import threading
+
+_mod_alpha = threading.Lock()
+
+
+class Duo:
+    def __init__(self):
+        self._a = threading.Lock()      # group alpha (fixture manifest)
+        self._b = threading.Lock()      # group beta
+
+    def right_way(self):
+        with self._a:
+            with self._b:
+                return 1
+
+    def wrong_way(self):
+        with self._b:
+            with self._a:               # inversion: beta held, alpha taken
+                return 2
+
+    def _take_alpha(self):
+        with self._a:
+            return 3
+
+    def wrong_way_via_call(self):
+        with self._b:
+            return self._take_alpha()   # inversion, one call deep
+
+    def _helper(self):
+        with self._b:
+            return 4
+
+    def reenter(self):
+        with self._b:
+            return self._helper()       # self-deadlock: _b not an RLock
+
+    def module_level_ok(self):
+        with _mod_alpha:                # alpha group, module-level
+            with self._b:
+                return 5

@@ -1,63 +1,33 @@
-"""Knob-documentation drift check.
+"""Knob-documentation drift check — thin pytest shim.
 
-Every ``STROM_*`` environment variable the package (or the C engine)
-reads must appear in README.md's environment-variable table — the
-knob-doc rot that previously required manual sweeps (PRs 3/5/7) now
-fails CI instead.  The README may document a whole family with a glob
-row (``STROM_FAULT_READ_*``)."""
+The logic moved into the strom-lint driver
+(nvme_strom_tpu/analysis/knobs.py, PR 13) so one CLI run covers it; this
+shim keeps tier-1 coverage identical: every ``STROM_*`` environment
+variable the package (or the C engine) reads must appear in README.md's
+environment-variable table (family glob rows like ``STROM_FAULT_READ_*``
+allowed)."""
 
-import re
 from pathlib import Path
+
+from nvme_strom_tpu.analysis.knobs import (
+    check_knob_docs, knobs_read_by_the_code)
 
 REPO = Path(__file__).resolve().parents[1]
 
-#: a Python-side env READ of a STROM knob: os.environ.get("STROM_X"),
-#: os.environ["STROM_X"], _env_int("STROM_X", d), _env_float(...) —
-#: the name may sit on the next line (black-wrapped calls), so \s*
-#: spans newlines
-_PY_READ = re.compile(
-    r'(?:environ(?:\.get)?\s*[\[\(]|_env_int\(|_env_float\(|'
-    r'getenv\()\s*["\'](STROM_[A-Z0-9_]+)')
-
-#: the C engine's reads: getenv("STROM_X") / env_u64("STROM_X")
-_C_READ = re.compile(r'(?:getenv|env_[a-z0-9_]+)\s*\(\s*"(STROM_[A-Z0-9_]+)"')
-
-
-def _knobs_read_by_the_code() -> set:
-    knobs = set()
-    for py in (REPO / "nvme_strom_tpu").rglob("*.py"):
-        knobs |= set(_PY_READ.findall(py.read_text()))
-    cc = REPO / "csrc" / "strom_io.cc"
-    if cc.exists():
-        knobs |= set(_C_READ.findall(cc.read_text()))
-    return knobs
-
-
-def _knobs_documented_in_readme():
-    text = (REPO / "README.md").read_text()
-    tokens = set(re.findall(r"STROM_[A-Z0-9_]+\*?", text))
-    exact = {t for t in tokens if not t.endswith("*")}
-    prefixes = {t[:-1] for t in tokens if t.endswith("*")}
-    return exact, prefixes
-
 
 def test_every_env_knob_is_documented_in_readme():
-    knobs = _knobs_read_by_the_code()
-    assert knobs, "the scan found no knobs at all — the regex rotted"
-    exact, prefixes = _knobs_documented_in_readme()
-    missing = sorted(
-        k for k in knobs
-        if k not in exact and not any(k.startswith(p) for p in prefixes))
-    assert not missing, (
-        f"STROM_* knobs read by the code but absent from README.md's "
-        f"env-var table: {missing} — add a row (or a family glob row "
-        f"like STROM_FAULT_READ_*) to README.md 'Environment notes'")
+    violations = check_knob_docs(REPO)
+    assert not violations, (
+        "STROM_* knobs read by the code but absent from README.md's "
+        "env-var table:\n  " + "\n  ".join(v.format()
+                                           for v in violations))
 
 
 def test_scan_sees_known_knobs():
     """The scanner itself must keep finding the long-lived knobs — a
     silently-empty scan would green-light any future rot."""
-    knobs = _knobs_read_by_the_code()
+    knobs = knobs_read_by_the_code(REPO)
     for known in ("STROM_CHUNK_BYTES", "STROM_RINGS", "STROM_VERIFY",
-                  "STROM_HOSTCACHE_MB", "STROM_FAULT_READ_EIO_EVERY"):
+                  "STROM_HOSTCACHE_MB", "STROM_FAULT_READ_EIO_EVERY",
+                  "STROM_LOCK_WITNESS"):
         assert known in knobs, known

@@ -459,3 +459,203 @@ def test_prefix_store_survives_process_restart(setup, engine,
     assert engine.stats.kv_pages_written == written   # restored, not
     assert engine.stats.kv_pages_restored >= 2        # rewritten
     store2.close()
+
+
+def test_flush_clean_manifest_covers_racing_put(setup, engine,
+                                               tmp_path):
+    """flush()'s clean=True manifest must never stamp a page whose
+    async writes are still in flight: a put() racing the drain appends
+    its batch (and flips its entry ready) while the drainer is blocked
+    in an earlier batch's wait.  The drain must loop until the
+    pipeline is OBSERVED empty — a single snapshot drain would return
+    with the racing batch pending and stamp clean anyway (the PR-13
+    review fix, kv_offload._drain_all_and_snapshot)."""
+    cfg, params = setup
+    store = _store(cfg, engine, tmp_path)
+    shape = (cfg.n_layers, cfg.n_kv_heads, PAGE, cfg.head_dim)
+    k = np.zeros(shape, np.float32)
+    key_a = store.chain_keys([1] * (PAGE + 1))[0]
+    key_b = store.chain_keys([2] * (PAGE + 1))[0]
+    store.put([(key_a, k, k)])
+
+    class _RacingPend:
+        # a pending write whose wait() performs the racing put: by the
+        # time the drainer unblocks, put(B)'s batch is appended and
+        # its entry ready — exactly the mid-drain window.  put()'s own
+        # maintenance drain try-acquires _drain_mu (held), stays
+        # within the backlog cap, and returns without blocking.
+        def __init__(self, inner):
+            self._inner = inner
+
+        def wait(self):
+            if not getattr(self, "fired", False):
+                self.fired = True
+                store.put([(key_b, k, k)])
+            return self._inner.wait()
+
+    with store._wlock:
+        store._pending_writes[0] = [
+            _RacingPend(p) for p in store._pending_writes[0]]
+    store.flush()
+    with store._wlock:
+        assert store._pending_writes == []     # drained to empty
+    import json
+    with open(store.manifest_path) as f:
+        man = json.load(f)
+    assert man["clean"]
+    stamped = {v["key"] for v in man["pages"].values()}
+    # both pages were proven drained before the stamp, so both appear
+    assert key_a.hex() in stamped and key_b.hex() in stamped
+    store.close()
+
+
+def test_flush_bounded_rounds_terminate_under_sustained_puts(
+        setup, engine, tmp_path):
+    """A put() storm that re-fills the pipeline every drain round must
+    not pin flush() forever: the drain is bounded, and when it exits by
+    bound the clean manifest stamps only the final round's PRE-drain
+    ready snapshot — a key that flipped ready after that snapshot
+    (writes possibly in flight) is left out, never stamped torn."""
+    cfg, params = setup
+    store = _store(cfg, engine, tmp_path)
+    shape = (cfg.n_layers, cfg.n_kv_heads, PAGE, cfg.head_dim)
+    k = np.zeros(shape, np.float32)
+    keys = [store.chain_keys([t] * (PAGE + 1))[0] for t in range(1, 12)]
+    store.put([(keys[0], k, k)])
+    fired = []
+
+    class _Refill:
+        # every round's wait() appends ANOTHER batch: the pipeline is
+        # never observed empty, so flush must exit by round bound
+        def __init__(self, inner):
+            self._inner = inner
+
+        def wait(self):
+            if not getattr(self, "done", False):
+                self.done = True
+                if len(fired) + 1 < len(keys):
+                    nxt = keys[len(fired) + 1]
+                    # put() refuses new work once close() set the
+                    # gate (returns 0, appends nothing) — that is how
+                    # close's own drain converges
+                    if store.put([(nxt, k, k)]):
+                        with store._wlock:
+                            store._pending_writes[-1] = [
+                                _Refill(p)
+                                for p in store._pending_writes[-1]]
+                        fired.append(nxt)
+            return self._inner.wait()
+
+    with store._wlock:
+        store._pending_writes[0] = [
+            _Refill(p) for p in store._pending_writes[0]]
+    store.flush()                      # terminates despite the refills
+    import json
+    with open(store.manifest_path) as f:
+        man = json.load(f)
+    assert man["clean"]
+    stamped = {v["key"] for v in man["pages"].values()}
+    assert keys[0].hex() in stamped
+    # the refill chain outran the 8-round bound: the tail key readied
+    # after the final pre-drain snapshot must NOT be stamped
+    assert len(fired) >= 8
+    assert fired[-1].hex() not in stamped
+    store.close()                      # gate stops refills, tail drains
+    with store._wlock:
+        assert store._pending_writes == []
+    assert store.put([(keys[-1], k, k)]) == 0   # closed store refuses
+
+
+def test_close_waits_for_inflight_put(setup, engine, tmp_path):
+    """A put() that won the _closed gate race must finish before
+    close() touches the engine fh: closing (or None-ing) the handle
+    under the put's submit would raise into the serving path — a
+    cache may refuse work, never fail it."""
+    import threading
+    import time
+    cfg, params = setup
+    store = _store(cfg, engine, tmp_path)
+    shape = (cfg.n_layers, cfg.n_kv_heads, PAGE, cfg.head_dim)
+    k = np.zeros(shape, np.float32)
+    key = store.chain_keys([1] * (PAGE + 1))[0]
+    gate = threading.Event()
+    real = store.engine.submit_write
+
+    def slow_submit(*a, **kw):
+        gate.wait(5)                   # put is now inside its I/O,
+        return real(*a, **kw)          # past the _closed gate check
+
+    store.engine.submit_write = slow_submit
+    errs = []
+
+    def putter():
+        try:
+            store.put([(key, k, k)])
+        except Exception as e:         # the bug: ctypes ArgumentError
+            errs.append(repr(e))
+
+    t = threading.Thread(target=putter)
+    t.start()
+    time.sleep(0.05)
+    closer = threading.Thread(target=store.close)
+    closer.start()
+    time.sleep(0.1)
+    assert closer.is_alive()           # close waits on the in-flight put
+    gate.set()
+    t.join(5)
+    closer.join(5)
+    store.engine.submit_write = real
+    assert not errs, errs
+    assert not t.is_alive() and not closer.is_alive()
+    assert store._fh is None           # closed cleanly afterwards
+
+
+def test_reentrant_put_during_drain_skips_backpressure(setup, engine,
+                                                       tmp_path):
+    """A put() re-entered from the active drain's own wait() IS the
+    drainer: with the backlog past the 2x hard cap it must skip the
+    backpressure acquire (it would self-deadlock on the drainer's own
+    non-reentrant _drain_mu) instead of blocking forever."""
+    import threading
+    cfg, params = setup
+    store = _store(cfg, engine, tmp_path)
+    shape = (cfg.n_layers, cfg.n_kv_heads, PAGE, cfg.head_dim)
+    k = np.zeros(shape, np.float32)
+
+    class _ReentrantPend:
+        def __init__(self, t):
+            self.t = t
+
+        def wait(self):
+            key = store.chain_keys([self.t] * (PAGE + 1))[0]
+            store.put([(key, k, k)])   # re-enters mid-drain
+
+    # backlog far past 2 * _MAX_PENDING so the re-entered put's
+    # maintenance drain takes the backpressure branch
+    with store._wlock:
+        for t in range(3 * store._MAX_PENDING):
+            store._pending_writes.append([_ReentrantPend(100 + t)])
+    done = threading.Event()
+
+    def flusher():
+        store.flush()
+        done.set()
+
+    th = threading.Thread(target=flusher, daemon=True)
+    th.start()
+    assert done.wait(30), "flush deadlocked on its own _drain_mu"
+    th.join(5)
+    store.close()
+
+
+def test_close_gates_restore_many(setup, engine, tmp_path):
+    """restore_many() on a closing/closed store returns {} (the caller
+    recomputes) instead of submitting reads against a dead fh."""
+    cfg, params = setup
+    store = _store(cfg, engine, tmp_path)
+    shape = (cfg.n_layers, cfg.n_kv_heads, PAGE, cfg.head_dim)
+    k = np.zeros(shape, np.float32)
+    key = store.chain_keys([1] * (PAGE + 1))[0]
+    store.put([(key, k, k)])
+    store.close()
+    assert store.restore_many({0: (0, [key])}) == {}

@@ -1,0 +1,556 @@
+"""strom-lint suite (docs/ANALYSIS.md).
+
+Four layers:
+
+1. seeded-defect fixtures (tests/lint_fixtures/): every planted ABI
+   mismatch, lock-order inversion and blocking-under-lock shape must be
+   reported with a file:line, and the CLI must exit non-zero on them;
+2. no-false-positive pass: the full strom-lint run over the SHIPPED
+   tree exits 0 with zero unwaived violations (the acceptance bar);
+3. the runtime lock-order witness (utils/lockwitness.py): cycles and
+   self-deadlocks caught live, RLock re-entry and conditions exempt;
+4. the sanitizer matrix (csrc/Makefile): ASAN/UBSAN/TSAN builds of
+   stress_test all run clean (marked slow; `pytest -m analysis` is the
+   full-matrix entry point).
+"""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from nvme_strom_tpu.analysis import run_checks
+from nvme_strom_tpu.analysis.abi import check_abi
+from nvme_strom_tpu.analysis.driver import default_header, default_manifest
+from nvme_strom_tpu.analysis.locks import check_locks
+from nvme_strom_tpu.analysis.manifest import (
+    ManifestError, parse_manifest)
+from nvme_strom_tpu.tools.strom_lint import main as lint_main
+
+REPO = Path(__file__).resolve().parents[1]
+FIX = Path(__file__).resolve().parent / "lint_fixtures"
+
+pytestmark = pytest.mark.analysis
+
+
+def _msgs(violations, check=None):
+    return [v for v in violations
+            if (check is None or v.check == check) and not v.waived]
+
+
+# --------------------------------------------------------------------------
+# 1a. seeded ABI defects
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def abi_report():
+    return check_abi(FIX / "abi_bad.h", [FIX / "abi_bad.py"], FIX)
+
+
+def _one(violations, needle, file=None):
+    got = [v for v in violations if needle in v.message]
+    assert got, (f"no violation mentioning {needle!r}; have:\n  "
+                 + "\n  ".join(v.format() for v in violations))
+    v = got[0]
+    assert v.line > 0
+    if file:
+        assert v.file.endswith(file)
+    return v
+
+
+def test_abi_fixture_type_mismatch(abi_report):
+    v = _one(abi_report, "argtypes[2] (offset) is c_uint32", "abi_bad.py")
+    assert "strom_fx_read" in v.message and "c_uint64" in v.message
+
+
+def test_abi_fixture_missing_restype(abi_report):
+    v = _one(abi_report, "restype never set")
+    assert "strom_fx_read" in v.message
+
+
+def test_abi_fixture_double_bind(abi_report):
+    v = _one(abi_report, "argtypes bound at 2 sites")
+    assert "strom_fx_crc" in v.message and "PR-5" in v.message
+
+
+def test_abi_fixture_wrong_arity(abi_report):
+    v = _one(abi_report, "argtypes has 1 entries")
+    assert "strom_fx_create" in v.message
+
+
+def test_abi_fixture_unbound_symbols(abi_report):
+    _one(abi_report, "strom_fx_destroy: declared in the header")
+    _one(abi_report, "strom_fx_never_bound: declared in the header")
+
+
+def test_abi_fixture_struct_field_drift(abi_report):
+    v = _one(abi_report, "order/name drift")
+    assert "_FxInfo" in v.message
+
+
+def test_abi_cli_exits_nonzero(capsys):
+    rc = lint_main(["--check", "abi", "--root", str(FIX),
+                    "--header", str(FIX / "abi_bad.h"),
+                    "--manifest", str(FIX / "lockorder_fixture.conf"),
+                    str(FIX / "abi_bad.py")])
+    assert rc == 1
+    out = capsys.readouterr().out
+    assert "abi_bad.py" in out and "[abi]" in out
+
+
+# --------------------------------------------------------------------------
+# 1b. seeded lock defects
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lock_fixture_report():
+    man = parse_manifest(FIX / "lockorder_fixture.conf")
+    files = [FIX / "locks_inversion.py", FIX / "locks_blocking.py"]
+    return check_locks(files, FIX, man)
+
+
+def test_lock_fixture_direct_inversion(lock_fixture_report):
+    vs, _ = lock_fixture_report
+    v = _one(_msgs(vs, "lock-order"), "nested with",
+             "locks_inversion.py")
+    assert "Duo._b" in v.message and "Duo._a" in v.message
+
+
+def test_lock_fixture_inversion_via_call(lock_fixture_report):
+    vs, _ = lock_fixture_report
+    v = _one(_msgs(vs, "lock-order"), "via call to")
+    assert "_take_alpha" in v.message
+
+
+def test_lock_fixture_self_deadlock(lock_fixture_report):
+    vs, _ = lock_fixture_report
+    v = _one(_msgs(vs, "lock-order"), "self-deadlock")
+    assert "Duo._b" in v.message and "not an RLock" in v.message
+
+
+def test_lock_fixture_conforming_paths_not_flagged(lock_fixture_report):
+    vs, edges = lock_fixture_report
+    # EXACTLY the three seeded defects — right_way and module_level_ok
+    # (the conforming directions) must not add a fourth
+    assert len(_msgs(vs, "lock-order")) == 3, "\n".join(
+        v.format() for v in _msgs(vs, "lock-order"))
+    # the conforming edges ARE in the acquisition graph
+    assert any(e.held.endswith("Duo._a") and e.acquired.endswith("Duo._b")
+               for e in edges)
+
+
+def test_lock_fixture_blocking_shapes(lock_fixture_report):
+    vs, _ = lock_fixture_report
+    blocking = _msgs(vs, "lock-blocking")
+    for needle in ("time.sleep", "crc32c", "pending.wait",
+                   "os.fsync"):
+        _one(blocking, needle, "locks_blocking.py")
+    cv = _one(blocking, "Condition.wait releases only its own lock")
+    assert "Worker._mu" in cv.message
+
+
+def test_lock_fixture_correct_patterns_not_flagged(lock_fixture_report):
+    vs, _ = lock_fixture_report
+    blocking = _msgs(vs, "lock-blocking")
+    src = (FIX / "locks_blocking.py").read_text().splitlines()
+    own_wait = next(i + 1 for i, ln in enumerate(src)
+                    if "correct: NOT a violation" in ln)
+    bad_lines = {v.line for v in blocking}
+    assert own_wait not in bad_lines
+    # unlocked sleep (last function) not flagged
+    unlocked = next(i + 1 for i, ln in enumerate(src)
+                    if "time.sleep(0.01)" in ln)
+    assert unlocked not in bad_lines
+
+
+def test_lock_cli_exits_nonzero():
+    rc = lint_main(["--check", "locks", "--root", str(FIX),
+                    "--manifest", str(FIX / "lockorder_fixture.conf"),
+                    str(FIX / "locks_inversion.py"),
+                    str(FIX / "locks_blocking.py")])
+    assert rc == 1
+
+
+# --------------------------------------------------------------------------
+# 1c. manifest grammar
+# --------------------------------------------------------------------------
+
+def test_manifest_rejects_bad_grammar(tmp_path):
+    p = tmp_path / "bad.conf"
+    p.write_text("group only_a_name\n")
+    with pytest.raises(ManifestError):
+        parse_manifest(p)
+    p.write_text("waiver blocking x:y\n")    # no reason string
+    with pytest.raises(ManifestError):
+        parse_manifest(p)
+    p.write_text("order ghost > phantom\n")  # undeclared groups
+    with pytest.raises(ManifestError):
+        parse_manifest(p)
+
+
+def test_manifest_error_is_exit_2(tmp_path):
+    p = tmp_path / "bad.conf"
+    p.write_text("definitely not a directive\n")
+    rc = lint_main(["--check", "locks", "--manifest", str(p)])
+    assert rc == 2
+
+
+def test_unknown_check_is_exit_2():
+    assert lint_main(["--check", "nonsense"]) == 2
+
+
+def test_manifest_orders_compose_transitively(tmp_path):
+    """Cross-chain orders compose: 'a > b' + 'b > c' implies a > c,
+    and an edge acquiring a while holding c is an inversion even
+    though no single declared chain contains both groups (the review
+    gap: a per-chain check silently passed it)."""
+    p = tmp_path / "m.conf"
+    p.write_text("group a fx.A\n"
+                 "group b fx.B\n"
+                 "group c fx.C\n"
+                 "order a > b\n"
+                 "order b > c\n")
+    man = parse_manifest(p)
+    v = man.order_violations("fx.C", "fx.A")
+    assert v is not None and "a > b > c" in v
+    assert man.order_violations("fx.A", "fx.C") is None   # conforms
+    assert man.order_violations("fx.C", "fx.B") is not None
+
+
+def test_manifest_rejects_cyclic_orders(tmp_path):
+    p = tmp_path / "m.conf"
+    p.write_text("group a fx.A\n"
+                 "group b fx.B\n"
+                 "group c fx.C\n"
+                 "order a > b\n"
+                 "order b > c\n"
+                 "order c > a\n")
+    with pytest.raises(ManifestError, match="cyclic"):
+        parse_manifest(p)
+
+
+def test_unused_waiver_reported(tmp_path):
+    man = default_manifest().read_text()
+    p = tmp_path / "m.conf"
+    p.write_text(man + '\nwaiver blocking never.Matches:anything '
+                 'reason "stale"\n')
+    rep = run_checks(manifest_path=p)
+    assert any("unused waiver" in v.message for v in rep.active)
+    assert rep.exit_code == 1
+
+
+# --------------------------------------------------------------------------
+# 2. the shipped tree is clean (the no-false-positive bar)
+# --------------------------------------------------------------------------
+
+def test_real_tree_lints_clean():
+    rep = run_checks()
+    assert rep.active == [], (
+        "strom-lint violations in the shipped tree:\n  "
+        + "\n  ".join(v.format() for v in rep.active))
+    assert rep.exit_code == 0
+    # the waivers that ARE declared all matched something (no stale ones)
+    assert set(rep.checks_run) == {"abi", "locks", "knobs", "counters"}
+
+
+def test_real_tree_cli_exit_zero():
+    assert lint_main([]) == 0
+
+
+def test_real_tree_acquisition_graph_nonempty():
+    man = parse_manifest(default_manifest())
+    from nvme_strom_tpu.analysis.driver import package_py_files
+    vs, edges = check_locks(package_py_files(REPO), REPO, man)
+    assert edges, "the lock pass observed no acquisition edges at all"
+    # the bind-lock chain the manifest declares is actually observed
+    assert any(e.held == "checksum._native_lock"
+               and e.acquired == "engine._lib_lock" for e in edges)
+
+
+def test_abi_covers_the_full_header():
+    """Every strom_* function in the real header is reachable by the
+    checker (parses + is bound once) — guards against the parser
+    silently skipping new declarations."""
+    from nvme_strom_tpu.analysis.cabi import parse_header
+    abi = parse_header(str(default_header(REPO)))
+    assert len(abi.funcs) >= 40
+    for must in ("strom_engine_create_rings", "strom_submit_readv_ring",
+                 "strom_hostcache_copy", "strom_crc32c",
+                 "strom_tar_index"):
+        assert must in abi.funcs
+    assert "strom_ring_info" in abi.structs
+    assert abi.macros["STROM_LAT_BUCKETS"] == 64
+
+
+def test_header_parser_fails_loudly_on_unparseable_prototype(tmp_path):
+    """The module contract: a declaration the regex cannot capture
+    (e.g. return type on its own line) must raise, never be silently
+    exempted from conformance checking."""
+    from nvme_strom_tpu.analysis.cabi import HeaderParseError, parse_header
+    h = tmp_path / "h.h"
+    h.write_text("int strom_ok(int a);\n"
+                 "uint64_t\n"
+                 "strom_orphan(int a);\n")
+    with pytest.raises(HeaderParseError, match="strom_orphan"):
+        parse_header(str(h))
+    # and through the CLI it is exit 2 ('fix the linter'), NOT a
+    # waivable exit-1 violation — a 'waiver abi *' must never be able
+    # to green-light a run with zero ABI coverage
+    assert lint_main(["--check", "abi", "--header", str(h)]) == 2
+
+
+def test_json_report_shape():
+    import io, json
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = lint_main(["--json"])
+    doc = json.loads(buf.getvalue())
+    assert rc == 0
+    assert doc["exit_code"] == 0
+    assert doc["n_active"] == 0
+    assert doc["n_waived"] >= 4          # the documented waivers
+    assert set(doc["checks_run"]) == {"abi", "locks", "knobs", "counters"}
+
+
+# --------------------------------------------------------------------------
+# 3. runtime witness (mini-lockdep)
+# --------------------------------------------------------------------------
+
+def test_witness_records_edges_and_cycle():
+    from nvme_strom_tpu.utils import lockwitness as lw
+    w = lw.arm()
+    try:
+        a, b = lw.make_lock("fx.A"), lw.make_lock("fx.B")
+        with a:
+            with b:
+                pass
+        assert w.snapshot_edges() == {"fx.A": ["fx.B"]}
+        assert not w.violations
+        # now the inversion: this run does NOT deadlock, but the
+        # witness must still convict it
+        with b:
+            with a:
+                pass
+        assert len(w.violations) == 1
+        v = w.violations[0]
+        assert v["kind"] == "cycle" and v["edge"] == ("fx.B", "fx.A")
+        # the flagged INVERTED edge must not enter the graph: later
+        # correct-order acquisitions would otherwise all "close a
+        # cycle" too, cascading false positives over one real bug
+        assert w.snapshot_edges() == {"fx.A": ["fx.B"]}
+        with a:
+            with b:                    # correct declared order again
+                pass
+        assert len(w.violations) == 1  # no cascade
+    finally:
+        w.reset()
+        lw.disarm()
+
+
+def test_witness_strict_mode_raises(monkeypatch):
+    from nvme_strom_tpu.utils import lockwitness as lw
+    monkeypatch.setenv("STROM_LOCK_WITNESS", "strict")
+    w = lw.arm()
+    try:
+        a, b = lw.make_lock("fx.SA"), lw.make_lock("fx.SB")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lw.LockOrderError):
+            with b:
+                with a:
+                    pass
+    finally:
+        w.reset()
+        lw.disarm()
+
+
+def test_witness_self_deadlock_raises_instead_of_hanging():
+    from nvme_strom_tpu.utils import lockwitness as lw
+    w = lw.arm()
+    try:
+        a = lw.make_lock("fx.SD")
+        with a:
+            with pytest.raises(lw.LockOrderError):
+                a.acquire()          # would hang forever unwitnessed
+    finally:
+        w.reset()
+        lw.disarm()
+
+
+def test_witness_rlock_reentry_is_clean():
+    from nvme_strom_tpu.utils import lockwitness as lw
+    w = lw.arm()
+    try:
+        r = lw.make_rlock("fx.R")
+        with r:
+            with r:
+                pass
+        assert not w.violations
+        assert w.snapshot_edges() == {}
+    finally:
+        w.reset()
+        lw.disarm()
+
+
+def test_witness_condition_wait_tracks_held_set():
+    import threading
+    from nvme_strom_tpu.utils import lockwitness as lw
+    w = lw.arm()
+    try:
+        mu = lw.make_lock("fx.CVmu")
+        cv = lw.make_condition("fx.CV", mu)
+        hits = []
+
+        def waiter():
+            with cv:
+                cv.wait(timeout=5)
+                hits.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.05)
+        with cv:
+            cv.notify()
+        t.join(timeout=5)
+        assert hits == [True]
+        assert not w.violations
+    finally:
+        w.reset()
+        lw.disarm()
+
+
+def test_witness_condition_over_rlock_owns_correctly():
+    """The documented no-lock form (make_condition builds a witnessed
+    RLock): Condition's try-acquire ownership fallback reports False
+    for the OWNER of a reentrant lock, so without the proxy's
+    _is_owned every wait()/notify() raised 'cannot notify on
+    un-acquired lock'.  Also pins _release_save releasing ALL
+    re-entrant levels across a wait."""
+    import threading
+    from nvme_strom_tpu.utils import lockwitness as lw
+    w = lw.arm()
+    try:
+        cv = lw.make_condition("fx.CVr")
+        hits = []
+
+        def waiter():
+            with cv:
+                with cv:           # depth 2: wait must release both
+                    cv.wait(timeout=5)
+                    hits.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.05)
+        with cv:                   # acquirable => waiter released fully
+            cv.notify()
+        t.join(timeout=5)
+        assert hits == [True]
+        assert not w.violations
+    finally:
+        w.reset()
+        lw.disarm()
+
+
+def test_witness_rlock_locked_probe():
+    """threading.RLock has no .locked() before 3.14; the proxy must
+    answer from its own depth / a direct ownership probe instead of
+    raising AttributeError only in armed runs."""
+    import threading
+    from nvme_strom_tpu.utils import lockwitness as lw
+    w = lw.arm()
+    try:
+        r = lw.make_rlock("fx.RLP")
+        assert r.locked() is False
+        with r:
+            assert r.locked() is True
+        assert r.locked() is False
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with r:
+                held.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        held.wait(5)
+        assert r.locked() is True      # held by ANOTHER thread
+        release.set()
+        t.join(5)
+        assert not w.violations
+    finally:
+        w.reset()
+        lw.disarm()
+
+
+def test_witness_disarmed_returns_plain_primitives():
+    import threading
+    from nvme_strom_tpu.utils import lockwitness as lw
+    lw.disarm()
+    try:
+        assert isinstance(lw.make_lock("fx.P"), type(threading.Lock()))
+    finally:
+        # back to env-driven default (the autouse fixture re-arms per
+        # test as needed)
+        lw._armed_override = None
+
+
+def test_witness_cycle_dumps_flight_record(tmp_path, monkeypatch):
+    monkeypatch.setenv("STROM_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("STROM_FLIGHT_MIN_S", "0")
+    from nvme_strom_tpu.utils import lockwitness as lw
+    w = lw.arm()
+    try:
+        a, b = lw.make_lock("fx.DA"), lw.make_lock("fx.DB")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert w.violations
+        dumps = list(tmp_path.glob("strom_flight_*lock_order_cycle*"))
+        assert dumps, "no flight-recorder dump for the cycle"
+        import json
+        doc = json.loads(dumps[0].read_text())
+        assert doc["extra"]["violation"]["edge"] == ["fx.DB", "fx.DA"]
+    finally:
+        w.reset()
+        lw.disarm()
+
+
+# --------------------------------------------------------------------------
+# 4. sanitizer matrix (the native half; slow, part of -m analysis)
+# --------------------------------------------------------------------------
+
+CSRC = REPO / "csrc"
+_SAN = [("stress_test_tsan", "ThreadSanitizer",
+         {"TSAN_OPTIONS": "halt_on_error=0 exitcode=66"}),
+        ("stress_test_asan", "AddressSanitizer",
+         {"ASAN_OPTIONS": "abort_on_error=1"}),
+        ("stress_test_ubsan", "runtime error",
+         {"UBSAN_OPTIONS": "print_stacktrace=1"})]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("target,report,env",
+                         _SAN, ids=[t[0] for t in _SAN])
+def test_sanitizer_matrix(target, report, env, tmp_path):
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    r = subprocess.run(["make", "-C", str(CSRC), target],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, f"build of {target} failed:\n{r.stderr[-2000:]}"
+    r = subprocess.run([str(CSRC / target), "60", "3", str(tmp_path)],
+                       capture_output=True, text=True, timeout=600,
+                       env={"PATH": "/usr/bin:/bin", **env})
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert report not in r.stderr, r.stderr[-3000:]
+    assert "errors=0" in r.stderr
