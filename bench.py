@@ -937,14 +937,18 @@ def bench_observability(path: str, repeats: int = 3) -> dict:
     """Price the always-on observability layer (docs/OBSERVABILITY.md)
     — the '≤2% overhead' claim measured, not asserted.
 
-    Three interleaved pipelined read passes per round over the same
+    Four interleaved pipelined read passes per round over the same
     cold file: OFF (STROM_FLIGHT=0, no tracer — the pre-observability
     engine), FLIGHT (the always-on default: flight recorder on, tracer
-    off), and TRACED (flight + causal tracing under a request
-    context).  Medians across rounds; a metrics-registry snapshotter
-    runs through the traced pass so the JSON carries a time SERIES of
-    the counter block, not one end-state dump."""
+    off), TRACED (flight + causal tracing under a request context),
+    and ATTRIB (flight + a sink-only tracer feeding the attribution
+    collector, STROM_ATTRIB=1's exact configuration — spans emitted
+    and folded, nothing exported).  Medians across rounds; a
+    metrics-registry snapshotter runs through the traced pass so the
+    JSON carries a time SERIES of the counter block, not one end-state
+    dump."""
     from nvme_strom_tpu.io.engine import StromEngine
+    from nvme_strom_tpu.obs.attrib import AttributionCollector
     from nvme_strom_tpu.utils.config import EngineConfig
     from nvme_strom_tpu.utils.stats import MetricsSnapshotter, StromStats
     from nvme_strom_tpu.utils.trace import (TraceContext, Tracer,
@@ -959,7 +963,7 @@ def bench_observability(path: str, repeats: int = 3) -> dict:
     stats = StromStats()
     snapper = MetricsSnapshotter(stats, interval_s=3600)  # manual ticks
 
-    def one_pass(flight: bool, tracer=None) -> float:
+    def one_pass(flight: bool, tracer=None, ctx=None) -> float:
         old = os.environ.get("STROM_FLIGHT")
         os.environ["STROM_FLIGHT"] = "1" if flight else "0"
         try:
@@ -976,7 +980,8 @@ def bench_observability(path: str, repeats: int = 3) -> dict:
         try:
             fh = eng.open(path)
             evict_file(path)
-            scope = (use_context(TraceContext.new())
+            scope = (use_context(ctx if ctx is not None
+                                 else TraceContext.new())
                      if tracer is not None else None)
             if scope is not None:
                 scope.__enter__()
@@ -993,9 +998,10 @@ def bench_observability(path: str, repeats: int = 3) -> dict:
         finally:
             eng.close_all()
 
-    rates = {"off": [], "flight": [], "traced": []}
+    rates = {"off": [], "flight": [], "traced": [], "attrib": []}
     trace_path = path + ".obs.trace.json"
     n_spans = 0
+    collector = AttributionCollector()
     for _ in range(repeats):
         rates["off"].append(one_pass(False))
         rates["flight"].append(one_pass(True))
@@ -1003,6 +1009,18 @@ def bench_observability(path: str, repeats: int = 3) -> dict:
         rates["traced"].append(one_pass(True, tracer=t))
         n_spans = max(n_spans, len(t))
         t.disable()   # throwaway: no atexit export litter
+        # the STROM_ATTRIB=1 configuration: sink-only tracer feeding
+        # the collector, pass folded at the end like a request retire
+        ta = Tracer()
+        ta.add_sink(collector.sink)
+        root = TraceContext.new()
+        t0_ns = time.monotonic_ns()
+        eng_rate = one_pass(True, tracer=ta, ctx=root)
+        collector.request_retired(root.trace_id, t0_ns,
+                                  time.monotonic_ns(),
+                                  klass="prefetch")
+        rates["attrib"].append(eng_rate)
+        ta.remove_sink(collector.sink)
     snapper.close()   # one extra final point; the series is per-pass
     try:
         os.unlink(trace_path)
@@ -1027,13 +1045,17 @@ def bench_observability(path: str, repeats: int = 3) -> dict:
                + int(s.get("bytes_fallback", 0)),
                "requests_completed": int(s.get("requests_completed", 0))}
               for s in snapper.series]
+    fold_n = collector.requests
     return {
         "off_gib_s": round(off, 3),
         "flight_gib_s": round(flight, 3),
         "traced_gib_s": round(traced, 3),
+        "attrib_gib_s": round(statistics.median(rates["attrib"]), 3),
         "flight_overhead_pct": pct("flight"),
         "traced_overhead_pct": pct("traced"),
+        "attrib_overhead_pct": pct("attrib"),
         "trace_spans": n_spans,
+        "attrib_requests_folded": fold_n,
         "metrics_series": series,
     }
 
@@ -1342,6 +1364,9 @@ def main() -> int:
              f"{obs['traced_gib_s']:.3f} traced "
              f"({obs['traced_overhead_pct']:+.2f}%, "
              f"{obs['trace_spans']} spans), "
+             f"{obs['attrib_gib_s']:.3f} attributed "
+             f"({obs['attrib_overhead_pct']:+.2f}%, "
+             f"{obs['attrib_requests_folded']} folds), "
              f"{len(obs['metrics_series'])} metric snapshots")
 
     # Zero-copy overlap scenario (docs/PERF.md §6): overlapped vs

@@ -176,7 +176,20 @@ class PinnedArena:
                 slab.locked = True
                 with self._lock:
                     self.locked_bytes += need
+        self._emit_occupancy()
         return slab
+
+    def _emit_occupancy(self) -> None:
+        """Perfetto counter track: per-tag carved bytes + free bytes on
+        the trace timeline (docs/OBSERVABILITY.md) — emitted at every
+        carve/release, only while a trace is live.  Never called with
+        the arena lock held (``carves``/``bytes_free`` take it)."""
+        from nvme_strom_tpu.utils.trace import global_tracer
+        if not global_tracer.exports:
+            return   # sink-only attribution tracer: skip the walk too
+        vals = {f"carved_{t}": n for t, n in self.carves().items()}
+        vals["free"] = self.bytes_free
+        global_tracer.add_counter("strom.arena.occupancy", vals)
 
     def _free(self, offset: int, nbytes: int, locked: bool = False) -> None:
         with self._lock:
@@ -206,6 +219,7 @@ class PinnedArena:
             if lo > 0 and fl[lo - 1][0] + fl[lo - 1][1] == fl[lo][0]:
                 fl[lo - 1] = (fl[lo - 1][0], fl[lo - 1][1] + fl[lo][1])
                 fl.pop(lo)
+        self._emit_occupancy()
 
     # -- introspection -----------------------------------------------------
 

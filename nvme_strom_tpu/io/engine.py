@@ -14,6 +14,7 @@ import errno
 import os
 import subprocess
 import threading
+import time
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional
@@ -578,6 +579,10 @@ class PendingRead:
                 self.fh, self.offset, int(comp.len),
                 max(0, int(comp.complete_ns - comp.submit_ns)) // 1000,
                 "fallback" if comp.was_fallback else "ok")
+        # completion reaping doubles as the ring time-in-state sampling
+        # point (obs/ledger.py; time-gated inside — one monotonic read
+        # per completed op on the fast path)
+        self._engine._sample_ring_states()
         n = int(comp.len)
         if n == 0:
             self._view = np.empty(0, dtype=np.uint8)
@@ -853,6 +858,30 @@ class StromEngine:
         if fcfg.enabled:
             from nvme_strom_tpu.io.flightrec import FlightRecorder
             self.flight = FlightRecorder(fcfg, self.stats)
+        # critical-path attribution (obs/attrib.py, STROM_ATTRIB=1):
+        # the process collector rides this engine's tracer as a span
+        # sink — span emission turns on (sink-only: nothing accumulates
+        # in memory) and serving folds per-request trees at retire.
+        # None (the default) is the exact pre-attribution engine.
+        from nvme_strom_tpu.obs.attrib import attach as _attach_attrib
+        self._attrib = _attach_attrib(self.tracer, self.stats)
+        if self._attrib is not None and self.flight is not None:
+            # every post-mortem dump opens with where recent requests'
+            # time went
+            self.flight.attrib = self._attrib
+        # per-ring time-in-state ledger (obs/ledger.py): cumulative
+        # busy/idle/stalled/restarting seconds, sampled at completion
+        # reaping (time-gated below) and exported at every stats sync
+        from nvme_strom_tpu.obs.ledger import RingTimeLedger
+        self.ring_ledger = RingTimeLedger(n_rings)
+        self._ring_sample_next = 0.0
+        self._ring_counter_live = False
+        # live debug endpoint (obs/debugsrv.py, STROM_DEBUG_PORT): one
+        # loopback HTTP server per process serving /metrics /attrib
+        # /ledger /flight /health /locks; off by default (None)
+        from nvme_strom_tpu.obs.debugsrv import maybe_start_debug_server
+        self._debug_srv = maybe_start_debug_server(self.stats,
+                                                   engine=self)
         # opt-in OpenMetrics textfile writer (STROM_METRICS_FILE):
         # started once per process with the first engine's stats block.
         # When the writer observes THIS engine's block, its periodic
@@ -1029,6 +1058,23 @@ class StromEngine:
             free = self.supervisor.mask_free_slots(free)
         return free
 
+    def _sample_ring_states(self) -> None:
+        """Time-gated per-ring time-in-state sample (obs/ledger.py):
+        charges the elapsed interval to each ring's current state
+        (busy/idle/stalled) from the lock-free depth counters and the
+        supervisor's breaker verdicts.  Called from completion reaping
+        and stat syncs; ~10 Hz cap keeps it off the hot path."""
+        now = time.monotonic()
+        if now < self._ring_sample_next or self._closed:
+            return
+        self._ring_sample_next = now + 0.1
+        states = (self.supervisor.ring_states()
+                  if self.supervisor is not None else None)
+        try:
+            self.ring_ledger.sample(self.ring_depths(), states, now=now)
+        except OSError:
+            pass
+
     def _refresh_zc_gauges(self) -> None:
         """Snapshot the per-ring registration/SQPOLL state (changes only
         at engine create and ring restart — the two callers)."""
@@ -1051,7 +1097,11 @@ class StromEngine:
         drain (the ring resumes untouched — fall back to degraded
         reads), OSError otherwise."""
         ns = max(1, int(drain_timeout_s * 1e9))
+        t0 = time.monotonic()
         rc = self._lib.strom_ring_restart(self._h, ring, ns)
+        # the restart window is charged explicitly: it is a rare,
+        # bounded interval the ~10 Hz state sampler would mostly miss
+        self.ring_ledger.note_restart(ring, time.monotonic() - t0)
         if rc == -errno.ETIMEDOUT:
             raise TimeoutError(
                 f"ring {ring}: in-flight I/O did not drain within "
@@ -1315,6 +1365,29 @@ class StromEngine:
             # instantaneous per-ring queue depth: the scheduler block in
             # strom_stat/watchdog reads these next to the sched counters
             self.stats.set_gauges(ring_depths=self.ring_depths())
+        # ring time-in-state accounting (obs/ledger.py): sample at the
+        # sync boundary too (an idle engine still accumulates idle
+        # time), then publish the ring_state_s gauge every exporter
+        # rides — and a Perfetto counter track when a trace is live, so
+        # per-ring in-flight lands on the spans' own timeline
+        self._sample_ring_states()
+        self.ring_ledger.export(self.stats)
+        if (self.n_rings > 1 and self.tracer is not None
+                and getattr(self.tracer, "exports", False)):
+            try:
+                depths = self.ring_depths()
+                # emit while I/O is in flight, plus ONE trailing all-
+                # zero sample so the Perfetto track returns to zero —
+                # and an idle engine's stat syncs add no events at all
+                # (tests pin exact span counts around idle syncs)
+                live = any(depths)
+                if live or self._ring_counter_live:
+                    self.tracer.add_counter(
+                        "strom.ring.inflight",
+                        {str(i): d for i, d in enumerate(depths)})
+                self._ring_counter_live = live
+            except OSError:
+                pass
         # zero-copy submission state (docs/PERF.md §6): per-ring
         # fixed-buffer / registered-file / SQPOLL gauges, so a try_register
         # that silently soft-failed (old kernel, RLIMIT_MEMLOCK) shows in
@@ -1348,6 +1421,11 @@ class StromEngine:
             # stats block may have installed ITS hook over ours
             self._metrics_writer.detach_sync(self.sync_stats)
             self._metrics_writer = None
+        if self._debug_srv is not None:
+            # the debug server outlives engines (process-wide); just
+            # stop routing live-engine queries at this dying handle
+            self._debug_srv.detach_engine(self)
+            self._debug_srv = None
         if self.supervisor is not None:
             # release landed probe zombies and stop supervising before
             # the C handle dies under a tick's ring poll

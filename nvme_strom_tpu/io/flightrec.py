@@ -53,8 +53,18 @@ class FlightRecorder:
         self._lat = Log2Histogram("strom_flight_latency_us",
                                   "recorded op latency (µs)")
         self._dump_lock = make_lock("flightrec.FlightRecorder._dump_lock")
-        self._last_dump = -1e9
+        #: PER-REASON rate-limit watermarks: a ``breaker_trip`` dump
+        #: must not shadow the ``slo_violation`` dump that follows it
+        #: inside STROM_FLIGHT_MIN_S — they are different incidents'
+        #: first post-mortems (the old single watermark did exactly
+        #: that shadowing)
+        self._last_dump: dict = {}
         self.dumps = 0
+        #: optional AttributionCollector (obs/attrib.py): when set,
+        #: every dump embeds the recent-request attribution summary —
+        #: the post-mortem opens with WHERE the time went, not just
+        #: which ops were in flight
+        self.attrib = None
         #: dump paths written, newest last (bounded; tests and the
         #: watchdog report read these)
         self.dump_paths: list = []
@@ -96,7 +106,7 @@ class FlightRecorder:
         full disk must not turn a brown-out into a crash."""
         with self._dump_lock:   # dumps are rare: serialize whole-hog
             now = time.monotonic()
-            if not force and now - self._last_dump \
+            if not force and now - self._last_dump.get(reason, -1e9) \
                     < self.cfg.min_interval_s:
                 return None
             ops = self.snapshot_ops()
@@ -116,6 +126,13 @@ class FlightRecorder:
                     doc["stats"] = self.stats.snapshot()
                 except Exception:
                     pass
+            if self.attrib is not None:
+                # where recent requests' time went, at the moment the
+                # trigger fired (obs/attrib.py summary)
+                try:
+                    doc["attrib"] = self.attrib.summary()
+                except Exception:
+                    pass
             safe = "".join(c if c.isalnum() or c in "-_" else "_"
                            for c in reason)[:48]
             path = os.path.join(self._dump_dir(),
@@ -129,7 +146,7 @@ class FlightRecorder:
                 # follows a trip within seconds) must still get to
                 # write the incident's FIRST usable post-mortem
                 return None
-            self._last_dump = now
+            self._last_dump[reason] = now
             self.dumps += 1
         if self.stats is not None:
             self.stats.add(flight_dumps=1)

@@ -177,6 +177,11 @@ class DegradedRead:
             t1 = time.monotonic_ns()
             if self._stats is not None:
                 self._stats.add(degraded_bytes=int(self._view.nbytes))
+                # delivered, but through the page-cache brown-out on a
+                # condemned device — the ledger's degraded waste class
+                from nvme_strom_tpu.obs.ledger import charge_waste
+                charge_waste(self._stats, "degraded",
+                             int(self._view.nbytes))
             tracer = getattr(self._engine, "tracer", None)
             if tracer is not None and tracer.enabled:
                 tracer.add_span("strom.read.degraded", t0, t1,

@@ -214,7 +214,7 @@ class _Line:
     """One resident cache line (a valid PREFIX of ``line_bytes``)."""
 
     __slots__ = ("key", "slot", "valid", "klass", "crc", "pins", "ref",
-                 "dead", "sticky")
+                 "dead", "sticky", "hits")
 
     def __init__(self, key: LineKey, slot: int, klass: str):
         self.key = key
@@ -224,6 +224,9 @@ class _Line:
         self.crc: Optional[int] = None
         self.pins = 0         # outstanding hit views
         self.ref = False      # second-chance bit
+        self.hits = 0         # lifetime hit count: a line evicted at 0
+        #                       was filled from NVMe for nothing — the
+        #                       ledger's evicted-before-reuse waste class
         self.dead = False     # invalidated while pinned: slot freed on
         #                       last unpin, mapping already gone
         self.sticky = False   # hot-pinned (docs/PERF.md §5): eviction
@@ -531,6 +534,7 @@ class HostCache:
                         m_lo = None
                     line.pins += 1
                     line.ref = True
+                    line.hits += 1
                     if hot:
                         line.sticky = True
                     segments.append(("hit", pos, take_end - pos, line))
@@ -582,6 +586,7 @@ class HostCache:
                     and self._verify_ok(line, stats)):
                 line.pins += 1
                 line.ref = True
+                line.hits += 1
                 if hot:
                     line.sticky = True
                 if stats is not None:
@@ -792,6 +797,12 @@ class HostCache:
             self.bytes_resident -= line.valid
             if stats is not None:
                 stats.add(cache_evictions=1)
+                if line.hits == 0 and line.valid:
+                    # filled from NVMe, never served a hit: the fill's
+                    # bandwidth bought nothing (ledger waste class —
+                    # growth means the ghost gate or quotas are wrong)
+                    from nvme_strom_tpu.obs.ledger import charge_waste
+                    charge_waste(stats, "evicted_unused", line.valid)
                 stats.set_gauges(cache_bytes_resident=self.bytes_resident,
                                  cache_lines_resident=len(self._lines))
             return line.slot

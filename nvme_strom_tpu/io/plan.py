@@ -98,12 +98,18 @@ class ExtentPlan:
                     length extents get an empty piece list.
     ``spans_coalesced``  input extents that merged into a span opened
                     by an earlier extent (k-extent merge counts k-1).
+    ``gap_bytes``   dead bytes deliberately read through when merging
+                    near-adjacent extents (the coalesce-gap waste class
+                    of obs/ledger.py: cheaper than extra NVMe round
+                    trips, but bandwidth nonetheless — honestly
+                    accounted as ``waste_coalesce_gap_bytes``).
     """
 
     spans: List[Tuple[int, int, int]]
     placements: List[List[Tuple[int, int, int]]]
     spans_coalesced: int
     n_extents: int
+    gap_bytes: int = 0
 
     @property
     def submits_saved(self) -> int:
@@ -177,10 +183,14 @@ def plan_extents(extents: Sequence[Tuple[int, int, int]], *,
 
     group: list = []
     g_fh = g_start = g_end = 0
+    gap_bytes = 0
     for i in order:
         fh, off, ln = extents[i]
         if group and fh == g_fh and off <= g_end + gap \
                 and max(g_end, off + ln) - g_start <= split:
+            if off > g_end:
+                # dead bytes read through to merge (ledger waste class)
+                gap_bytes += off - g_end
             group.append(i)
             g_end = max(g_end, off + ln)
             continue
@@ -191,7 +201,8 @@ def plan_extents(extents: Sequence[Tuple[int, int, int]], *,
     if group:
         emit(group)
     return ExtentPlan(spans=spans, placements=placements,
-                      spans_coalesced=coalesced, n_extents=n)
+                      spans_coalesced=coalesced, n_extents=n,
+                      gap_bytes=gap_bytes)
 
 
 class _SharedSpan:
@@ -456,6 +467,9 @@ def plan_and_submit(engine, extents: Sequence[Tuple[int, int, int]], *,
     stats = getattr(engine, "stats", None)
     if stats is not None and plan.spans_coalesced:
         stats.add(spans_coalesced=plan.spans_coalesced)
+    if stats is not None and plan.gap_bytes:
+        from nvme_strom_tpu.obs.ledger import charge_waste
+        charge_waste(stats, "coalesce_gap", plan.gap_bytes)
     return out
 
 
@@ -578,6 +592,9 @@ def _plan_and_submit_tiered(cache, engine, extents, *, gap, chunk_bytes,
                         hits=hit_count, bytes=hit_bytes)
     if stats is not None and plan.spans_coalesced:
         stats.add(spans_coalesced=plan.spans_coalesced)
+    if stats is not None and plan.gap_bytes:
+        from nvme_strom_tpu.obs.ledger import charge_waste
+        charge_waste(stats, "coalesce_gap", plan.gap_bytes)
     return out
 
 

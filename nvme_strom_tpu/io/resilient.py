@@ -188,6 +188,11 @@ class ResilientRead:
                 continue
             short = self._expected - view.nbytes
             if short > 0:
+                # the short attempt's delivered bytes are discarded and
+                # re-read whole by the resubmission — retry-reread waste
+                from nvme_strom_tpu.obs.ledger import charge_waste
+                charge_waste(self._engine.stats, "retry_reread",
+                             int(view.nbytes))
                 self._note_failure(OSError(
                     f"short read: {view.nbytes} of {self._expected} "
                     f"bytes"), kind="short")
@@ -228,7 +233,10 @@ class ResilientRead:
                     # primary won the race: the losing hedge hands its
                     # staging buffer back as soon as it lands (deferred
                     # — it may still be in flight, and release() would
-                    # block)
+                    # block).  Its bytes are the hedge's bandwidth
+                    # price — the ledger's hedge-loss waste class.
+                    from nvme_strom_tpu.obs.ledger import charge_waste
+                    charge_waste(eng.stats, "hedge_loss", self._length)
                     eng._defer_release(self._fh, self._hedge.pending)
                     self._drop_hedge()
                 self._winner = self._primary
@@ -269,6 +277,10 @@ class ResilientRead:
                     self._drop_hedge()
                 else:
                     eng.stats.add(hedges_won=1)
+                    # the parked primary's bytes are the losing side of
+                    # this race — same hedge-loss waste class
+                    from nvme_strom_tpu.obs.ledger import charge_waste
+                    charge_waste(eng.stats, "hedge_loss", self._length)
                     if self._klass:
                         eng.stats.add_class_stat(self._klass,
                                                  hedges_won=1)
@@ -357,6 +369,10 @@ class ResilientRead:
         self._release_attempts()
         if stuck:
             eng.stats.add(stuck_cancelled=1)
+            # a cancelled stuck read typically completes into the void
+            # after the resubmission: its whole range is re-read
+            from nvme_strom_tpu.obs.ledger import charge_waste
+            charge_waste(eng.stats, "retry_reread", self._length)
         eng.stats.add(resilient_retries=1)
         if self._klass:
             eng.stats.add_class_stat(self._klass, retries=1)

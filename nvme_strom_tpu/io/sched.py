@@ -194,6 +194,10 @@ class QoSScheduler:
         self.dispatches = 0
         self.promotions = 0
         self.enqueued = 0
+        # Perfetto counter-track sampling gate (docs/OBSERVABILITY.md):
+        # per-class queue depth lands on the trace timeline at most
+        # every 20 ms, so a hot dispatch loop never floods the file
+        self._next_counter_t = 0.0
 
     # -- public API --------------------------------------------------------
 
@@ -418,6 +422,16 @@ class QoSScheduler:
         for q in self._queues.values():
             for b in q:
                 b.rounds += 1
+        if self.tracer is not None and self.tracer.exports:
+            now = time.monotonic()
+            if now >= self._next_counter_t:
+                # per-class queue depth as a Perfetto counter track:
+                # the sched spans' queue waits get their denominator on
+                # the same timeline (docs/OBSERVABILITY.md)
+                self._next_counter_t = now + 0.02
+                self.tracer.add_counter(
+                    "strom.sched.queue_depth",
+                    {k: len(q) for k, q in self._queues.items()})
         return progress
 
     def _dispatch_one(self, b: _Batch, ring: int,

@@ -606,14 +606,25 @@ class DecodeServer:
         wait_ms = 1000.0 * (req.t_admit - req.t_submit)
         tracer = self._tracer()
         if tracer is not None and req.trace is not None:
+            end_ns = time.monotonic_ns()
             # the request's ROOT span, submit → retirement: the tree
             # every admit/restore/queue/engine span hangs under
             tracer.add_span("strom.serve.request", req.t_submit_ns,
-                            time.monotonic_ns(),
+                            end_ns,
                             category="strom.serve", ctx=req.trace,
                             rid=str(req.rid), ttft_ms=round(ttft_ms, 3),
                             admit_wait_ms=round(wait_ms, 3),
                             tokens=len(req.out))
+            # critical-path attribution (obs/attrib.py): fold this
+            # request's span tree into the per-class profiles —
+            # serving requests are the decode class
+            from nvme_strom_tpu.obs.attrib import get_collector
+            col = get_collector()
+            if col is not None:
+                col.request_retired(req.trace.trace_id, req.t_submit_ns,
+                                    end_ns, klass="decode",
+                                    extra={"rid": str(req.rid),
+                                           "ttft_ms": round(ttft_ms, 3)})
         self.request_metrics[req.rid] = {
             "ttft_ms": round(ttft_ms, 3),
             "admit_wait_ms": round(wait_ms, 3)}

@@ -1,0 +1,31 @@
+"""Observability ANALYSIS layer (docs/OBSERVABILITY.md §§4-6).
+
+PR 11 made the stack *emit* telemetry (causal spans, OpenMetrics, the
+flight recorder); this package *answers questions* with it:
+
+- ``obs.attrib``   critical-path attribution: at request retire, fold
+                   the request's span tree into a fixed component
+                   breakdown (sched queue-wait, hostcache, NVMe device
+                   time, retry/backoff, hedge, degraded fallback,
+                   host→HBM bridge hop, unattributed remainder),
+                   conservation-checked against wall time and rolled
+                   into per-QoS-class p50/p99 profiles.
+- ``obs.ledger``   goodput/waste accounting: every completed byte
+                   classified goodput vs waste {hedge-loss,
+                   retry-reread, coalesce-gap, evicted-before-reuse,
+                   degraded-fallback}, plus per-ring time-in-state
+                   (busy/idle/stalled/restarting).
+- ``obs.debugsrv`` the live debug endpoint (``STROM_DEBUG_PORT``):
+                   ``/metrics /attrib /ledger /flight /health /locks``,
+                   polled by the ``strom-top`` console tool.
+"""
+
+from nvme_strom_tpu.obs.attrib import (AttributionCollector, fold_events,
+                                       get_collector)
+from nvme_strom_tpu.obs.ledger import (RingTimeLedger, charge_waste,
+                                       ledger_view)
+
+__all__ = [
+    "AttributionCollector", "fold_events", "get_collector",
+    "RingTimeLedger", "charge_waste", "ledger_view",
+]

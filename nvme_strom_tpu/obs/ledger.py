@@ -1,0 +1,152 @@
+"""Goodput/waste ledger — where every delivered byte went
+(docs/OBSERVABILITY.md §5).
+
+The stack already counts HOW MUCH it moved (``bytes_direct`` etc.);
+nobody could say what fraction of that bandwidth was *useful*.  The
+ledger classifies every completed byte:
+
+  goodput            delivered to a consumer and not re-read, not a
+                     planner gap, not a lost race — DERIVED as
+                     ``delivered - waste`` so the classes can never
+                     double-count it;
+  hedge_loss         the losing side of a hedge race (io/resilient.py);
+  retry_reread       bytes recovery re-read that an earlier attempt
+                     had already delivered (io/resilient.py);
+  coalesce_gap       dead gap bytes the planner deliberately read
+                     through when merging extents (io/plan.py);
+  evicted_unused     host-tier lines filled from NVMe and evicted
+                     before a single hit (io/hostcache.py);
+  degraded           bytes served through the buffered brown-out
+                     (io/health.py — delivered, but at page-cache
+                     bandwidth on a condemned device).
+
+The per-kind counters live on :class:`~nvme_strom_tpu.utils.stats.
+StromStats` (``waste_*_bytes``) so they ride every existing exporter;
+:func:`ledger_view` is the folded view ``/ledger`` serves, ``strom-top``
+renders, and ``strom_stat``'s ledger block prints.
+
+Per-ring TIME-in-state accounting rides along
+(:class:`RingTimeLedger`): cumulative seconds each ring spent
+busy/idle/stalled/restarting, sampled at completion reaping
+(io/engine.py, time-gated) and at every stats sync — the capacity
+denominator under the byte classification (a ring that is 40% stalled
+explains a goodput dip no byte counter can).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+from nvme_strom_tpu.utils.lockwitness import make_lock
+
+#: waste classes → their StromStats counter
+WASTE_COUNTERS = {
+    "hedge_loss": "waste_hedge_loss_bytes",
+    "retry_reread": "waste_retry_reread_bytes",
+    "coalesce_gap": "waste_coalesce_gap_bytes",
+    "evicted_unused": "waste_evicted_unused_bytes",
+    "degraded": "waste_degraded_bytes",
+}
+
+#: ring states, render order
+RING_STATES = ("busy", "idle", "stalled", "restarting")
+
+
+def charge_waste(stats, kind: str, nbytes: int) -> None:
+    """Charge ``nbytes`` of waste class ``kind`` (one StromStats add;
+    the I/O-layer hooks call this so the taxonomy lives in ONE place)."""
+    if stats is None or nbytes <= 0:
+        return
+    stats.add(**{WASTE_COUNTERS[kind]: int(nbytes)})
+
+
+def ledger_view(snap: dict) -> dict:
+    """Fold a :meth:`StromStats.snapshot` into the goodput/waste view.
+
+    ``delivered`` = engine payload (direct + fallback) + host-tier
+    served bytes; degraded preads count into ``bytes_fallback`` via
+    the C counter AND into their waste class, so the classification
+    stays a partition of delivered traffic."""
+    delivered = (int(snap.get("bytes_direct", 0))
+                 + int(snap.get("bytes_fallback", 0))
+                 + int(snap.get("bytes_served_cache", 0)))
+    waste = {kind: int(snap.get(counter, 0))
+             for kind, counter in WASTE_COUNTERS.items()}
+    waste_total = sum(waste.values())
+    goodput = max(0, delivered - waste_total)
+    out = {
+        "delivered_bytes": delivered,
+        "goodput_bytes": goodput,
+        "waste_bytes": waste_total,
+        "waste": waste,
+        "goodput_fraction": round(goodput / delivered, 4)
+        if delivered else 1.0,
+    }
+    rs = snap.get("ring_state_s")
+    if rs:
+        out["ring_state_s"] = {k: [round(float(v), 3) for v in vals]
+                               for k, vals in rs.items()}
+    return out
+
+
+class RingTimeLedger:
+    """Cumulative per-ring time-in-state accounting.
+
+    ``sample(depths, breaker_states)`` charges the elapsed time since
+    the previous sample to each ring's CURRENT state — busy (in-flight
+    I/O), idle, or stalled (breaker open / C stall flag) — so the
+    accounting is an interval integral of cheap instantaneous reads,
+    not per-op bookkeeping.  ``note_restart`` charges hot-restart wall
+    time explicitly (restarts are rare, bounded windows the sampler
+    would mostly miss).  Callers time-gate sampling (io/engine.py reaps
+    at ~10 Hz); the math is O(rings) dict arithmetic under one lock.
+    """
+
+    def __init__(self, n_rings: int):
+        self.n_rings = max(1, int(n_rings))
+        self._lock = make_lock("ledger.RingTimeLedger._lock")
+        self._t: Dict[str, List[float]] = {
+            s: [0.0] * self.n_rings for s in RING_STATES}
+        self._last = time.monotonic()
+
+    def sample(self, depths: Sequence[int],
+               breaker_states: Optional[Sequence[str]] = None,
+               now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            dt = now - self._last
+            self._last = now
+            if dt <= 0:
+                return
+            for r in range(self.n_rings):
+                depth = depths[r] if r < len(depths) else 0
+                state = "busy" if depth > 0 else "idle"
+                if breaker_states is not None \
+                        and r < len(breaker_states) \
+                        and breaker_states[r] == "open":
+                    state = "stalled"
+                self._t[state][r] += dt
+
+    def note_restart(self, ring: int, seconds: float) -> None:
+        """Charge one hot-restart window (io/engine.py ``ring_restart``
+        measures it around the C call).  Advances the sampler watermark
+        past the window so the next :meth:`sample` cannot charge the
+        same interval to busy/idle/stalled again — state seconds must
+        never sum past wall time."""
+        if seconds <= 0 or not 0 <= ring < self.n_rings:
+            return
+        with self._lock:
+            self._t["restarting"][ring] += seconds
+            self._last = max(self._last, time.monotonic())
+
+    def snapshot(self) -> Dict[str, List[float]]:
+        with self._lock:
+            return {s: list(v) for s, v in self._t.items()}
+
+    def export(self, stats) -> None:
+        """Publish the accounting as the ``ring_state_s`` gauge (ridden
+        by every exporter: --json, --prom ``strom_ring_state_seconds``,
+        ``/ledger``)."""
+        if stats is not None:
+            stats.set_gauges(ring_state_s=self.snapshot())

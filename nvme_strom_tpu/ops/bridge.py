@@ -165,12 +165,21 @@ class OverlapStage:
             # be done with the bytes before they are overwritten
             prev.block_until_ready()
             self._busy[k] = None
+        import time as _time
+        t0 = _time.monotonic_ns()
         slab_view = self._slabs[k][:n]
         slab_view[:] = view.reshape(-1).view(np.uint8)
         arr = (self._transfer or self._default_transfer)(
             slab_view, dtype, shape)
         self._busy[k] = arr
         self.engine.stats.add(overlap_chunks=1, overlap_bytes=int(n))
+        tracer = getattr(self.engine, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            # the host→HBM hop of this chunk (slab copy + async launch)
+            # — the `bridge` component of obs/attrib.py's breakdown
+            tracer.add_span("strom.bridge.hop", t0, _time.monotonic_ns(),
+                            category="strom.bridge", bytes=int(n),
+                            slab=k)
         return arr
 
     def close(self) -> None:

@@ -102,7 +102,18 @@ _HEALTH_COUNTERS = (
 #: block, shown only when either fired: dropped spans mean the trace
 #: is incomplete, a flight dump means a trigger captured a post-mortem
 _OBS_COUNTERS = (
-    "trace_spans_dropped", "flight_dumps",
+    "trace_spans_dropped", "flight_dumps", "attrib_requests",
+    "attrib_spans_dropped",
+)
+
+#: goodput/waste ledger counters (obs/ledger.py —
+#: docs/OBSERVABILITY.md §5); own block with the derived goodput line,
+#: shown only when any waste class fired: a fully-useful run's report
+#: stays exactly as short as before
+_LEDGER_COUNTERS = (
+    "waste_hedge_loss_bytes", "waste_retry_reread_bytes",
+    "waste_coalesce_gap_bytes", "waste_evicted_unused_bytes",
+    "waste_degraded_bytes",
 )
 
 #: every counter block above, in render order — the counter-drift CI
@@ -113,6 +124,7 @@ ALL_COUNTER_BLOCKS = (
     _COUNTERS, _RESILIENCE_COUNTERS, _INTEGRITY_COUNTERS,
     _BATCH_COUNTERS, _ENGINE_COUNTERS, _SCHED_COUNTERS,
     _HOSTCACHE_COUNTERS, _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
+    _LEDGER_COUNTERS,
 )
 
 
@@ -327,6 +339,31 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
             lines.append(
                 "    CORRUPTION CAUGHT — scrub the namespace "
                 "(strom-scrub) before trusting older data")
+    # shown only when a waste class fired — a fully-useful run's report
+    # stays exactly as short as before (ring time-in-state is always on
+    # /ledger and --prom; here it rides along inside the waste block)
+    if any(int(snap.get(n, 0)) for n in _LEDGER_COUNTERS):
+        lines.append("  ledger (goodput vs waste, per-ring "
+                     "time-in-state — docs/OBSERVABILITY.md):")
+        from nvme_strom_tpu.obs.ledger import ledger_view
+        view = ledger_view(snap)
+        lines.append(f"    {'delivered':<26} "
+                     f"{_human(view['delivered_bytes']):>14}")
+        lines.append(f"    {'goodput':<26} "
+                     f"{_human(view['goodput_bytes']):>14}   "
+                     f"(fraction {view['goodput_fraction']:.4f})")
+        for name in _LEDGER_COUNTERS:
+            v = int(snap.get(name, 0))
+            if v:
+                lines.append(f"    {name:<26} {_human(v):>14}")
+        rs = view.get("ring_state_s")
+        if rs:
+            for state in ("busy", "idle", "stalled", "restarting"):
+                vals = rs.get(state)
+                if vals and any(v > 0 for v in vals):
+                    shown = " ".join(f"{v:.1f}" for v in vals)
+                    lines.append(f"    ring {state + '_s':<21} "
+                                 f"{shown:>14}")
     if any(int(snap.get(n, 0)) for n in _OBS_COUNTERS):
         lines.append("  observability (tracer / flight recorder):")
         for name in _OBS_COUNTERS:

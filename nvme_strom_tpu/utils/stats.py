@@ -210,6 +210,35 @@ class StromStats:
     # flight-recorder post-mortem dumps written (breaker trip, ring
     # restart, SLO violation, watchdog stall)
     flight_dumps: int = 0
+    # -- goodput/waste ledger (obs/ledger.py, docs/OBSERVABILITY.md) ------
+    # every completed byte is either goodput (delivered and useful) or
+    # one of these waste classes; goodput is DERIVED (delivered minus
+    # waste) so the classes can never double-count it
+    # bytes read by the losing side of a hedge race (the duplicate that
+    # completed pointlessly — hedging's bandwidth price)
+    waste_hedge_loss_bytes: int = 0
+    # bytes re-read by retry recovery that an earlier attempt had
+    # already delivered (short-read resubmits re-read the whole range;
+    # stuck-cancelled requests usually complete into the void)
+    waste_retry_reread_bytes: int = 0
+    # dead gap bytes the planner deliberately read through when merging
+    # near-adjacent extents (STROM_COALESCE_GAP) — cheaper than extra
+    # NVMe round trips, but bandwidth nonetheless
+    waste_coalesce_gap_bytes: int = 0
+    # host-tier line bytes filled from NVMe and evicted before a single
+    # hit — admission that never paid off (the ghost gate exists to
+    # keep this near zero; growth means the gate or quotas are wrong)
+    waste_evicted_unused_bytes: int = 0
+    # bytes served through the degraded buffered brown-out (delivered,
+    # but via page cache + bounce at reduced bandwidth — the capacity
+    # lost to an unhealthy device)
+    waste_degraded_bytes: int = 0
+    # -- critical-path attribution (obs/attrib.py) ------------------------
+    # retired requests folded into attribution profiles, and spans the
+    # collector dropped at its per-trace bound (an incomplete fold must
+    # be visible, exactly like trace_spans_dropped)
+    attrib_requests: int = 0
+    attrib_spans_dropped: int = 0
     _lock: threading.Lock = field(
         default_factory=lambda: make_lock("stats.StromStats._lock"),
         repr=False)
@@ -649,6 +678,16 @@ def openmetrics_from_snapshot(snap: dict) -> str:
                       ("ring", "state"))
         for i, s in enumerate(health):
             g.set(0 if s == "closed" else 1, ring=i, state=s)
+    # per-ring time-in-state accounting (obs/ledger.py RingTimeLedger):
+    # cumulative seconds each ring spent busy/idle/stalled/restarting
+    ring_state = snap.get("ring_state_s")
+    if ring_state:
+        g = reg.gauge("strom_ring_state_seconds",
+                      "cumulative seconds per ring per state",
+                      ("ring", "state"))
+        for state, per_ring in sorted(ring_state.items()):
+            for i, v in enumerate(per_ring):
+                g.set(round(float(v), 3), ring=i, state=state)
     members = snap.get("member_bytes")
     if members:
         g = reg.counter("strom_member_bytes",
@@ -658,7 +697,7 @@ def openmetrics_from_snapshot(snap: dict) -> str:
     skip = (set(COUNTER_FIELDS)
             | {"class_stats", "ring_depths", "ring_health",
                "member_bytes", "ring_fixed_bufs", "ring_reg_files",
-               "ring_sqpoll"})
+               "ring_sqpoll", "ring_state_s"})
     for name in sorted(snap):
         if name in skip or name.startswith("_"):
             continue

@@ -157,6 +157,12 @@ class Tracer:
         #: (None = the process-global block, resolved lazily so the
         #: import graph stays acyclic)
         self.stats = stats
+        #: span SINKS (obs/attrib.py): callables handed every completed
+        #: span event dict.  A sink-only tracer (no export path) records
+        #: nothing in memory — spans flow to the sinks and are gone, so
+        #: always-on attribution never grows the event list toward the
+        #: cap.  Sinks must be cheap and never raise.
+        self._sinks: list = []
         self._atexit_registered = False
         if self.enabled:
             self._register_atexit()
@@ -173,9 +179,29 @@ class Tracer:
 
     def disable(self) -> None:
         """Stop recording AND exporting (the atexit hook becomes a
-        no-op) — for throwaway tracers in bench/test passes."""
-        self.enabled = False
+        no-op) — for throwaway tracers in bench/test passes.  A tracer
+        with attached sinks stays enabled for sink delivery only."""
         self._path = None
+        self.enabled = bool(self._sinks)
+
+    def add_sink(self, sink) -> None:
+        """Attach a span sink (``sink(event_dict)`` per completed span —
+        obs/attrib.py's collector).  Enables the tracer for sink
+        delivery even with no export path; idempotent per callable."""
+        with self._lock:
+            if sink not in self._sinks:
+                self._sinks.append(sink)
+        self.enabled = True
+
+    def remove_sink(self, sink) -> None:
+        with self._lock:
+            try:
+                self._sinks.remove(sink)
+            except ValueError:
+                pass
+            has = bool(self._sinks)
+        if not has and self._path is None:
+            self.enabled = False
 
     def add_span(self, name: str, begin_ns: int, end_ns: int,
                  category: str = "strom",
@@ -208,6 +234,16 @@ class Tracer:
         }
         if args:
             ev["args"] = args
+        for sink in self._sinks:
+            try:
+                sink(ev)
+            except Exception:
+                pass   # a broken sink must never fail the traced I/O
+        if self._path is None and self._sinks:
+            # sink-only tracer (always-on attribution): nothing to
+            # export, so keep no in-memory copy — a multi-day run must
+            # not creep toward the event cap for spans nobody reads
+            return
         with self._lock:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
@@ -216,6 +252,38 @@ class Tracer:
                     from nvme_strom_tpu.utils.stats import global_stats
                     stats = self.stats = global_stats
                 stats.add(trace_spans_dropped=1)
+                return
+            self._events.append(ev)
+
+    @property
+    def exports(self) -> bool:
+        """True when spans/counters land in a trace FILE — the gate for
+        counter-track emission sites, which do real work (depth walks,
+        dict builds) a sink-only attribution tracer would discard."""
+        return self.enabled and self._path is not None
+
+    def add_counter(self, name: str, values: dict,
+                    t_ns: Optional[int] = None) -> None:
+        """Record one Perfetto COUNTER-track sample (``ph: "C"``): the
+        numeric series in ``values`` land on one stacked counter track
+        named ``name``, on the same timeline as the spans — per-class
+        scheduler queue depth, arena occupancy, and per-ring in-flight
+        ride this, so traces and metrics read off one Perfetto load
+        (docs/OBSERVABILITY.md).  Counter samples are not delivered to
+        span sinks and only recorded when an export path is set."""
+        if not self.enabled or self._path is None or not values:
+            return
+        ev = {
+            "name": name,
+            "ph": "C",
+            "ts": (time.monotonic_ns() if t_ns is None else t_ns)
+            / 1000.0,
+            "pid": os.getpid(),
+            "args": {str(k): float(v) for k, v in values.items()},
+        }
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
                 return
             self._events.append(ev)
 

@@ -385,14 +385,19 @@ def test_flight_records_and_bounded_ring(tmp_path):
     assert st.flight_dumps == 1
 
 
-def test_flight_dump_rate_limited(tmp_path):
+def test_flight_dump_rate_limited_per_reason(tmp_path):
+    """The rate limit is PER REASON: a breaker_trip dump must not
+    shadow the slo_violation dump that follows it inside the window —
+    they are different incidents' first post-mortems."""
     fr = FlightRecorder(FlightConfig(enabled=True, ops=16,
                                      dir=str(tmp_path),
                                      min_interval_s=60.0), StromStats())
     fr.record("read", None, 0, 1, 0, 4096, 10, "ok")
-    assert fr.dump("first") is not None
-    assert fr.dump("second") is None          # inside the window
-    assert fr.dump("forced", force=True) is not None
+    assert fr.dump("breaker_trip") is not None
+    assert fr.dump("breaker_trip") is None    # same reason, in-window
+    assert fr.dump("slo_violation") is not None   # different reason
+    assert fr.dump("slo_violation") is None
+    assert fr.dump("breaker_trip", force=True) is not None
 
 
 def test_engine_records_ops_with_class_and_ring(tmp_data_file, tmp_path,
