@@ -915,6 +915,154 @@ def bench_overlap(path: str) -> dict:
     }
 
 
+def bench_scatter(path: str) -> dict:
+    """Read-once/ICI-scatter restore scenario (docs/PERF.md §7,
+    ops/ici.py) — aggregate restore throughput, read-all vs scatter.
+
+    An N-host restore classically moves N·T bytes off flash (every host
+    re-reads the whole payload); read-once moves T (each host reads its
+    1/N share, peers' shares arrive over the interconnect).  Both arms
+    deliver the SAME payload to every virtual host and report aggregate
+    GiB/s = N·T / wall:
+
+    - **read-all** (the N=1-per-host baseline): N sequential full-file
+      restore-class planner reads off a cold file.
+    - **scatter**: one ``scatter_engine`` pass (1/N per host off flash,
+      one all-gather over the exchange mesh) and N full-file reads
+      served from the gathered bytes.
+
+    On the CPU-emulated mesh the exchange is the ``jax.lax`` degrade
+    path and flash is fast DRAM-backed cache, so the ratio here is a
+    plumbing check, not the paper claim — the counters
+    (``ici_bytes_read`` == T, per-host shares <= T/N + slack) are the
+    load-bearing output, and a real-TPU run prices the true ICI hop.
+    """
+    import jax
+    from nvme_strom_tpu.io import StromEngine, wait_exact
+    from nvme_strom_tpu.io.plan import plan_and_submit
+    from nvme_strom_tpu.ops.ici import scatter_engine
+    from nvme_strom_tpu.parallel.mesh import exchange_mesh
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    nbytes = min(os.path.getsize(path),
+                 int(os.environ.get("STROM_BENCH_SCATTER_BYTES",
+                                    64 << 20)))
+    n_hosts = min(8, jax.device_count())
+    spath = path + ".scatter"
+    make_file(spath, nbytes)
+    cfg = EngineConfig(chunk_bytes=4 << 20, queue_depth=8,
+                       buffer_pool_bytes=32 << 20, n_rings=1)
+
+    def drain(eng, fh) -> int:
+        got = 0
+        for pieces in plan_and_submit(eng, [(fh, 0, nbytes)],
+                                      klass="restore"):
+            for p in pieces:
+                got += wait_exact(p).nbytes
+                p.release()
+        return got
+
+    try:
+        # arm A: read-all — every virtual host re-reads the payload
+        with StromEngine(cfg, stats=StromStats()) as eng:
+            fh = eng.open(spath)
+            try:
+                evict_file(spath)
+                t0 = time.monotonic()
+                for _ in range(n_hosts):
+                    assert drain(eng, fh) == nbytes
+                dt_all = time.monotonic() - t0
+            finally:
+                eng.close(fh)
+
+        # arm B: read-once/scatter — T off flash, N·T delivered
+        stats = StromStats()
+        fell_back = False
+        with StromEngine(cfg, stats=stats) as eng:
+            evict_file(spath)
+            t0 = time.monotonic()
+            served = (scatter_engine(eng, [spath],
+                                     mesh=exchange_mesh(n_hosts),
+                                     unit_bytes=4 << 20)
+                      if n_hosts > 1 else None)
+            if served is None:       # <2 hosts, or any brown-out
+                fell_back = True
+                fh = eng.open(spath)
+                try:
+                    for _ in range(n_hosts):
+                        assert drain(eng, fh) == nbytes
+                finally:
+                    eng.close(fh)
+            else:
+                fh = served.open(spath)
+                try:
+                    for _ in range(n_hosts):
+                        assert drain(served, fh) == nbytes
+                finally:
+                    served.close(fh)
+            dt_sc = time.monotonic() - t0
+            share_max = (max(served.scatter_store.host_bytes_read
+                             .values()) if served is not None else nbytes)
+    finally:
+        try:
+            os.unlink(spath)
+        except OSError:
+            pass
+
+    gib = nbytes / (1 << 30)
+    agg_all = n_hosts * gib / dt_all if dt_all > 0 else 0.0
+    agg_sc = n_hosts * gib / dt_sc if dt_sc > 0 else 0.0
+    return {
+        "platform": ("tpu" if jax.devices()[0].platform == "tpu"
+                     else "cpu-fallback"),
+        "n_hosts": int(n_hosts),
+        "payload_bytes": int(nbytes),
+        "read_all_gib_s": round(agg_all, 3),
+        "scatter_gib_s": round(agg_sc, 3),
+        "scatter_fell_back": fell_back,
+        # the read-once evidence: flash traffic for the whole mesh, and
+        # the worst single host's share (<= T/N + unit slack)
+        "ici_bytes_read": int(stats.ici_bytes_read),
+        "ici_bytes_received": int(stats.ici_bytes_received),
+        "ici_fallbacks": int(stats.ici_fallbacks),
+        "max_host_share_bytes": int(share_max),
+    }
+
+
+def _bench_scatter_subprocess(path: str, n_hosts: int = 8):
+    """Run :func:`bench_scatter` on an emulated ``n_hosts``-device mesh.
+
+    The device count is an init-time XLA flag, so a process already
+    holding one CPU device (the tunnel-down fallback) cannot grow a
+    mesh — the N-host arm rides a throwaway subprocess instead
+    (``probe_device``'s discipline).  Returns the scenario dict, or
+    None if the subprocess fails (the bench JSON then carries null,
+    never a crash)."""
+    import subprocess
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count="
+                          f"{n_hosts}").strip()
+    code = ("import json, bench; "
+            f"print(json.dumps(bench.bench_scatter({path!r})))")
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            _log(f"bench: scatter subprocess rc={out.returncode}: "
+                 f"{out.stderr.strip()[-300:]}")
+            return None
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (OSError, subprocess.SubprocessError,
+            json.JSONDecodeError, IndexError) as e:
+        _log(f"bench: scatter subprocess failed: {e}")
+        return None
+
+
 def _link_bufs(outstanding: int, chunk_bytes: int):
     import numpy as np
     sz = chunk_bytes or (32 << 20)
@@ -1385,6 +1533,28 @@ def main() -> int:
              f"({overlap['syscalls_per_gib_reduction_pct']:-.1f}% "
              f"reduction, elided={overlap['sqpoll_on']['elided']})")
 
+    # read-once/ICI-scatter restore: aggregate restore GiB/s with every
+    # host re-reading vs each host reading 1/N and the mesh exchanging
+    # shares, plus the ici_* counters that prove the read-once shape.
+    # STROM_BENCH_SCATTER=0 skips.
+    scatter = None
+    if os.environ.get("STROM_BENCH_SCATTER", "1") != "0":
+        import jax as _jax
+        if _jax.device_count() >= 2:
+            scatter = bench_scatter(path)
+        else:
+            # 1-device process: emulate the 8-host mesh out of process
+            scatter = _bench_scatter_subprocess(path)
+        if scatter is not None:
+            _log(f"bench: scatter: restore aggregate "
+                 f"{scatter['read_all_gib_s']:.3f} (read-all) vs "
+                 f"{scatter['scatter_gib_s']:.3f} GiB/s (read-once, "
+                 f"N={scatter['n_hosts']}), flash bytes "
+                 f"{scatter['n_hosts'] * scatter['payload_bytes']} -> "
+                 f"{scatter['ici_bytes_read']}"
+                 + (" [FELL BACK to read-all]"
+                    if scatter["scatter_fell_back"] else ""))
+
     direct_ok = info.supports_direct
     bounce = cold_bounce
     if direct_ok and bounce and device_ok:
@@ -1471,6 +1641,11 @@ def main() -> int:
         # SQPOLL off vs on — the doorbell-elision + transfer-overlap
         # evidence (docs/PERF.md §6)
         "overlap": overlap,
+        # read-once/ICI-scatter restore scenario (bench_scatter):
+        # aggregate restore GiB/s read-all vs scatter plus the
+        # ici_bytes_* counters — the each-byte-leaves-flash-once
+        # evidence (docs/PERF.md §7)
+        "scatter": scatter,
         "health": {
             "breaker_trips": int(stats.breaker_trips),
             "ring_restarts": int(stats.ring_restarts),

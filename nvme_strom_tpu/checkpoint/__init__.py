@@ -9,6 +9,10 @@ the read side.  This package supplies the trainer-facing layer on top:
   directories with retention, saving arbitrary pytrees (params + optimizer
   state + counters) through the engine's O_DIRECT writer and restoring them
   under pjit shardings without a host-side global assembly.
+- :class:`RestoreManifest` (checkpoint/scatter.py) — the deterministic
+  per-host byte-share partition of a step's payload that the read-once/
+  ICI-scatter restore mode (``STROM_ICI_SCATTER=1``, ops/ici.py) exchanges
+  over the interconnect instead of re-reading on every host.
 """
 
 from nvme_strom_tpu.checkpoint.manager import (  # noqa: F401
@@ -16,4 +20,9 @@ from nvme_strom_tpu.checkpoint.manager import (  # noqa: F401
     TargetMismatchError,
     flatten_with_names,
     unflatten_from_names,
+)
+from nvme_strom_tpu.checkpoint.scatter import (  # noqa: F401
+    RestoreManifest,
+    build_restore_manifest,
+    scatter_data_paths,
 )

@@ -678,11 +678,25 @@ class CheckpointManager:
 
     def restore(self, target, step: Optional[int] = None,
                 shardings: Union[Dict, Callable, None] = None,
-                fallback: bool = True):
+                fallback: bool = True, ici_mesh=None):
         """Read checkpoint ``step`` (default: latest) into ``target``'s
         structure.  Leaf placement: ``shardings`` (dict name→Sharding or
         fn(name, shape)→Sharding) wins; else a jax.Array target leaf's own
         sharding; else the array stays a host-resident numpy array.
+
+        Read-once/scatter mode (``STROM_ICI_SCATTER=1``, docs/PERF.md
+        §7): each host NVMe-reads only its 1/N contiguous byte share of
+        the step's payload files (at ``restore`` class, through the
+        ordinary planner/scheduler/breaker stack) and the mesh
+        all-gathers the shares over ICI; every tile read below is then
+        served from the gathered bytes — bit-identical by construction,
+        since the shares cover every payload byte exactly once.
+        ``ici_mesh`` pins the exchange mesh (1-axis ``("hosts",)``;
+        default ``parallel.mesh.exchange_mesh``).  Any scatter failure
+        — breaker open, exchange error, single-host mesh — browns out
+        to the plain read-all path (counted ``ici_fallbacks``), never
+        to a restore error.  Mode off (the default) touches zero code
+        paths.
 
         ``fallback`` (docs/RESILIENCE.md): when the chosen step turns
         out damaged — manifest unreadable, a tile file missing or
@@ -734,8 +748,9 @@ class CheckpointManager:
         try:
             for i, s in enumerate(candidates):
                 try:
-                    out = self._restore_step(eng, named_t, treedef, s,
-                                             shardings)
+                    eng_s = self._scatter_engine(eng, s, ici_mesh)
+                    out = self._restore_step(eng_s or eng, named_t,
+                                             treedef, s, shardings)
                 except self._DAMAGE as e:
                     if isinstance(e, TargetMismatchError):
                         raise       # schema bug, not damage
@@ -760,6 +775,37 @@ class CheckpointManager:
         finally:
             if own:
                 eng.close_all()
+
+    def _scatter_engine(self, eng, step: int, ici_mesh=None):
+        """Read-once/scatter front-end over ``eng`` for candidate
+        ``step``, or None for the plain read-all path (mode off, or any
+        scatter-build failure — counted ``ici_fallbacks`` — because a
+        scatter brown-out must never become a restore error).  The
+        manifest derives deterministically from the step directory
+        (checkpoint/scatter.py), so every host partitions identically
+        without coordination traffic."""
+        from nvme_strom_tpu.ops.ici import (
+            ici_scatter_enabled, ici_unit_bytes, scatter_engine)
+        if not ici_scatter_enabled():
+            return None
+        try:
+            from nvme_strom_tpu.checkpoint.scatter import (
+                build_restore_manifest)
+            from nvme_strom_tpu.ops.ici import ici_hosts
+            from nvme_strom_tpu.parallel.mesh import exchange_mesh
+            mesh = (ici_mesh if ici_mesh is not None
+                    else exchange_mesh(ici_hosts()))
+            man = build_restore_manifest(
+                self.step_dir(step), int(mesh.shape["hosts"]),
+                ici_unit_bytes())
+            return scatter_engine(eng, list(man.paths), mesh=mesh,
+                                  klass="restore", manifest=man.shares)
+        except Exception as e:
+            _log.warning(
+                "ici scatter disabled for step %d: %s: %s (falling "
+                "back to local full reads)", step, type(e).__name__, e)
+            eng.stats.add(ici_fallbacks=1)
+            return None
 
     def _restore_step(self, eng, named_t, treedef, step: int,
                       shardings: Union[Dict, Callable, None]):

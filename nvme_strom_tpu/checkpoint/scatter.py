@@ -1,0 +1,77 @@
+"""Sharded restore manifest: which bytes of a checkpoint each host reads.
+
+The read-once/scatter restore (ops/ici.py, docs/PERF.md §7) needs every
+host in the mesh to agree — without any coordination traffic — on a
+partition of the checkpoint step's payload into per-host byte shares.
+This module is that agreement: the data-file list of a step directory in
+a DETERMINISTIC order (sorted names, so every host derives the identical
+manifest from its own copy of the directory listing) plus the shared
+contiguous-span partition rule (``io.scatter.partition_files``).
+
+Partitioning is by byte range over whole files, not by tensor tile: the
+union of shares covers every byte of every ``state-*.safetensors`` file
+exactly once, so after the exchange the ScatterStore serves ANY tile
+read — including cross-mesh restores whose tile slivers no writer-side
+partition could anticipate — and the restored tensors are bit-identical
+to the read-all path by construction.  ``meta.json`` stays an ordinary
+host-local read (it is the few-KiB index both paths parse first; its
+cost is the "manifest overhead" the acceptance bound allows).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Tuple
+
+from nvme_strom_tpu.io.scatter import ShareManifest, partition_files
+
+
+def scatter_data_paths(step_dir: str) -> List[str]:
+    """The step's payload files in manifest order: every
+    ``*.safetensors`` under ``step_dir``, sorted by name — the same
+    deterministic order on every host."""
+    try:
+        names = sorted(n for n in os.listdir(step_dir)
+                       if n.endswith(".safetensors"))
+    except OSError:
+        return []
+    return [os.path.join(step_dir, n) for n in names]
+
+
+@dataclass(frozen=True)
+class RestoreManifest:
+    """A checkpoint step's read-once partition: the ordered payload
+    files and their per-host byte shares."""
+
+    step_dir: str
+    paths: Tuple[str, ...]
+    shares: ShareManifest
+
+    @property
+    def n_hosts(self) -> int:
+        return self.shares.n_hosts
+
+    @property
+    def total_bytes(self) -> int:
+        return self.shares.total_bytes
+
+    @property
+    def host_bytes(self) -> Tuple[int, ...]:
+        """Bytes host h reads from its local NVMe — the quantity the
+        read-once acceptance bound (≤ total/N + unit slack) holds on."""
+        return self.shares.host_bytes
+
+
+def build_restore_manifest(step_dir: str, n_hosts: int,
+                           unit_bytes: int) -> RestoreManifest:
+    """The deterministic per-host partition of ``step_dir``'s payload.
+
+    Raises OSError when the directory or a payload file is unreadable —
+    restore's _DAMAGE/fallback machinery owns that decision, not this
+    module."""
+    paths = scatter_data_paths(step_dir)
+    sizes = [os.path.getsize(p) for p in paths]
+    return RestoreManifest(
+        step_dir=str(step_dir), paths=tuple(paths),
+        shares=partition_files(sizes, n_hosts, unit_bytes))

@@ -52,6 +52,12 @@ from nvme_strom_tpu.io.resilient import (
     ResilientWrite,
     WriteError,
 )
+from nvme_strom_tpu.io.scatter import (
+    ScatterServeEngine,
+    ScatterStore,
+    ShareManifest,
+    partition_files,
+)
 from nvme_strom_tpu.io.sched import (
     CLASS_ORDER,
     DEFAULT_CLASS,
@@ -71,6 +77,8 @@ __all__ = ["PinnedArena", "Slab", "get_arena",
            "CacheHitRead", "HostCache", "get_cache",
            "ExtentPlan", "SpanView", "plan_and_submit", "plan_extents",
            "split_spans", "submit_spans", "submit_spans_tiered",
+           "ScatterServeEngine", "ScatterStore", "ShareManifest",
+           "partition_files",
            "ReadError", "ResilientEngine", "ResilientRead",
            "ResilientWrite", "WriteError",
            "CLASS_ORDER", "DEFAULT_CLASS", "ClassPolicy", "QoSScheduler",

@@ -537,6 +537,31 @@ def forward_hidden(params: Dict, tokens: jax.Array,
             raise ValueError(
                 "remat_policy='nvme' needs an act_store= "
                 "(parallel/act_offload.ActivationStore)")
+        # The store's ordered io_callbacks cannot lower inside a
+        # multi-device computation (they would either fail to lower or
+        # force implicit gathers far from the cause) — reject HERE, in
+        # the library, not just in examples/train_lm.py's arg parsing.
+        # Inputs that merely COULD be sharded are fine: under the
+        # test/dev hosts jax exposes many CPU devices, so the predicate
+        # is "this computation actually spans devices", i.e. a
+        # multi-process runtime or a committed input sharded across >1
+        # device (tracers inside jit expose no sharding — callers going
+        # through examples/train_lm.py are guarded there).
+        if jax.process_count() > 1:
+            raise ValueError(
+                "remat_policy='nvme' is single-host: the activation "
+                "store's ordered io_callbacks cannot lower in a "
+                "multi-process computation — use remat full/dots")
+        try:
+            n_dev = len(tokens.sharding.device_set)
+        except Exception:       # tracer / non-jax input: no verdict
+            n_dev = 1
+        if n_dev > 1:
+            raise ValueError(
+                "remat_policy='nvme' is single-device: tokens are "
+                f"sharded across {n_dev} devices and the activation "
+                "store's ordered io_callbacks cannot lower inside a "
+                "multi-device computation — use remat full/dots")
         from nvme_strom_tpu.parallel.act_offload import offload_layer
         off = offload_layer(layer_body, act_store, x.shape, x.dtype)
         for i in range(cfg.n_layers):

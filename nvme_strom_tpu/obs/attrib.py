@@ -38,6 +38,8 @@ Components (span-name mapping in ``NAME_TO_COMPONENT``):
   ``degraded``       buffered brown-out service (``strom.read.degraded``,
                      ``strom.health.*``)
   ``bridge``         host→HBM hop (``strom.bridge.hop``, ``strom.h2d.*``)
+  ``ici_scatter``    read-once restore shard exchange over the
+                     interconnect (``strom.ici.*`` — ops/ici.py)
   ``unattributed``   wall time outside every component (compute)
 
 Activation: ``STROM_ATTRIB=1`` (default off) builds the process-wide
@@ -56,7 +58,7 @@ from nvme_strom_tpu.utils.lockwitness import make_lock
 #: the fixed breakdown, in render order (``unattributed`` is derived,
 #: always last)
 COMPONENTS = ("sched_queue", "hostcache", "nvme_read", "retry_backoff",
-              "hedge", "degraded", "bridge")
+              "hedge", "degraded", "bridge", "ici_scatter")
 
 #: span name → component.  Prefix matching (see :func:`component_of`)
 #: keeps future ``strom.resilient.*`` names in the right bucket.
@@ -77,6 +79,8 @@ NAME_TO_COMPONENT = {
     "strom.bridge.hop": "bridge",
     "strom.h2d.dispatch": "bridge",
     "strom.h2d.sync": "bridge",
+    "strom.ici.exchange": "ici_scatter",
+    "strom.ici.scatter": "ici_scatter",
 }
 
 #: serving/root spans: structure, not a cost component — excluded from
@@ -96,6 +100,8 @@ def component_of(name: str) -> Optional[str]:
             return None
     if name.startswith("strom.resilient."):
         return "retry_backoff"
+    if name.startswith("strom.ici."):
+        return "ici_scatter"
     return None
 
 

@@ -1,3 +1,9 @@
 from nvme_strom_tpu.ops.bridge import DeviceStream, write_from_device
+from nvme_strom_tpu.ops.ici import (
+    IciExchange,
+    ici_scatter_enabled,
+    scatter_engine,
+)
 
-__all__ = ["DeviceStream", "write_from_device"]
+__all__ = ["DeviceStream", "write_from_device", "IciExchange",
+           "ici_scatter_enabled", "scatter_engine"]

@@ -1,0 +1,409 @@
+"""Read-once shard exchange over the mesh interconnect (docs/PERF.md §7).
+
+The paper's restore bottleneck is per-host SSD bandwidth: every host in a
+mesh re-reads the ENTIRE weight/checkpoint payload from its own NVMe, so
+an N-host restore moves N·T bytes off flash to deliver T useful bytes per
+host.  ICI is an order of magnitude faster than any SSD, so the right
+shape is read-once/scatter: each host NVMe-reads only its 1/N byte share
+(through the ordinary ``plan_and_submit`` → staging → bridge path at
+``restore`` class, governed by the scheduler, breakers and ledger like
+any other consumer) and the mesh all-gathers the shares — restore becomes
+mesh-aggregate-bound instead of per-host-SSD-bound.
+
+Two layers live here:
+
+:class:`IciExchange`
+    ``shard_map``-compatible all-gather of per-host byte rows.  On an
+    all-TPU mesh the exchange is a Pallas ring collective built on
+    ``pltpu.make_async_remote_copy`` (one-hop neighbour pushes around the
+    ring, DMA'd HBM→HBM on the device's own engines); ANY failure — no
+    TPU, kernels unavailable, runtime refuses the remote DMA — degrades
+    ONE-WAY to the ``jax.lax.all_gather`` collective, exactly the
+    ``ops/bridge.py`` ``OverlapStage`` discipline, which is also the
+    CPU/emulated-mesh path the tests pin.
+
+:func:`scatter_engine`
+    The consumer-facing orchestrator: partition a file set into per-host
+    contiguous byte shares, read the local share(s), exchange, and return
+    a :class:`~nvme_strom_tpu.io.scatter.ScatterServeEngine` that serves
+    every subsequent read of those files from the gathered bytes.  Any
+    failure returns None (counted ``ici_fallbacks``) and the caller keeps
+    its plain engine — scatter can only ever brown out to the read-all
+    path, never black out a restore.
+
+Knobs: ``STROM_ICI_SCATTER`` (default off — ``=0`` is bit-for-bit the
+read-all stack), ``STROM_ICI_HOSTS``, ``STROM_ICI_UNIT_BYTES``.
+Counters: ``ici_bytes_read``, ``ici_bytes_received``, ``ici_fallbacks``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from nvme_strom_tpu.parallel.mesh import exchange_mesh
+
+_log = logging.getLogger("nvme_strom_tpu.ici")
+
+#: lane-friendly padding of a host's share row: rows exchange as int32
+#: words and TPU tiles want multiples of a full (8, 128) tile
+_ROW_ALIGN = 4096
+
+#: default partition unit — share boundaries stay on O_DIRECT-friendly
+#: 1 MiB lines so each host's span submits as large aligned reads
+DEFAULT_UNIT_BYTES = 1 << 20
+
+
+def ici_scatter_enabled() -> bool:
+    """``STROM_ICI_SCATTER=1`` turns the read-once/scatter restore mode
+    on; unset/``0`` (the default) is the exact read-all stack — the
+    gate sits at the consumer so OFF touches zero code paths."""
+    return os.environ.get("STROM_ICI_SCATTER", "0") not in ("", "0")
+
+
+def ici_unit_bytes() -> int:
+    """Partition unit for per-host byte shares (``STROM_ICI_UNIT_BYTES``,
+    default 1 MiB; clamped to >= 4 KiB so shares stay O_DIRECT-aligned)."""
+    try:
+        v = int(os.environ.get("STROM_ICI_UNIT_BYTES", DEFAULT_UNIT_BYTES))
+    except ValueError:
+        return DEFAULT_UNIT_BYTES
+    return max(4096, v)
+
+
+def ici_hosts() -> Optional[int]:
+    """Pinned exchange width (``STROM_ICI_HOSTS``); None = every host
+    (one per process, or every local device when single-process)."""
+    v = os.environ.get("STROM_ICI_HOSTS")
+    if not v:
+        return None
+    try:
+        return max(1, int(v))
+    except ValueError:
+        return None
+
+
+class IciExchange:
+    """All-gather of per-host byte rows over the mesh interconnect.
+
+    ``all_gather(rows)`` takes a ``(n_hosts, row_bytes)`` uint8 array
+    whose row h is host h's share (single-process emulation holds every
+    row; multi-process runs only need their own rows populated) and
+    returns the fully-gathered array on this host.
+
+    TPU: Pallas ring all-gather — each device primes its own output slot,
+    then ``n-1`` lockstep steps push the freshest slot to the right
+    neighbour via ``make_async_remote_copy`` so every chunk DMAs straight
+    into its final HBM location.  Non-TPU meshes, or any Pallas failure,
+    take the one-way ``jax.lax.all_gather`` degrade (the bridge's
+    ``_pallas_ok`` discipline): correct everywhere, and the only path a
+    CPU-emulated mesh ever compiles.
+    """
+
+    def __init__(self, mesh=None, axis: str = "hosts", stats=None,
+                 tracer=None):
+        if mesh is None:
+            mesh = exchange_mesh(ici_hosts())
+        if axis not in mesh.shape:
+            raise ValueError(f"mesh has no {axis!r} axis: {mesh.shape}")
+        self.mesh = mesh
+        self.axis = axis
+        self.n = int(mesh.shape[axis])
+        self.stats = stats
+        self.tracer = tracer
+        devs = list(mesh.devices.flat)
+        self._pallas_ok = bool(devs) and all(
+            d.platform == "tpu" for d in devs)
+        self._fns: dict = {}    # (words, pallas) -> jitted gather
+
+    # -- the two exchange backends ------------------------------------
+
+    def _shard_map(self, fn, in_specs, out_specs):
+        try:
+            from jax import shard_map as sm          # jax >= 0.8
+        except ImportError:
+            from jax.experimental.shard_map import shard_map as sm
+        try:
+            return sm(fn, mesh=self.mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+        except TypeError:
+            return sm(fn, mesh=self.mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+    def _lax_gather_fn(self):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        axis = self.axis
+
+        def gather(block):          # (1, words) int32 per device
+            return jax.lax.all_gather(block, axis, axis=0, tiled=True)
+
+        return jax.jit(self._shard_map(gather, P(axis, None),
+                                       P(None, None)))
+
+    def _pallas_gather_fn(self, words: int):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+        from jax.sharding import PartitionSpec as P
+
+        n, axis = self.n, self.axis
+
+        def kernel(local_ref, out_ref, send_sem, recv_sem):
+            my_id = lax.axis_index(axis)
+            right = lax.rem(my_id + 1, n)
+            left = lax.rem(my_id + n - 1, n)
+            # both neighbours must have primed their output slots
+            # before any remote DMA lands in them
+            barrier = pltpu.get_barrier_semaphore()
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=(left,),
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            pltpu.semaphore_signal(
+                barrier, inc=1, device_id=(right,),
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            out_ref[pl.ds(my_id, 1)] = local_ref[:]
+            pltpu.semaphore_wait(barrier, 2)
+            # lockstep ring: at step k every device pushes the chunk
+            # that originated k hops to its left straight into the
+            # right neighbour's matching output slot — no staging
+            # buffer, each chunk DMAs once into its final location
+            for step in range(n - 1):
+                src = lax.rem(my_id + n - step, n) if step else my_id
+                rdma = pltpu.make_async_remote_copy(
+                    src_ref=out_ref.at[pl.ds(src, 1)],
+                    dst_ref=out_ref.at[pl.ds(src, 1)],
+                    send_sem=send_sem.at[step % 2],
+                    recv_sem=recv_sem.at[step % 2],
+                    device_id=(right,),
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+                rdma.start()
+                rdma.wait()
+
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((2,)),
+                            pltpu.SemaphoreType.DMA((2,))],
+        )
+
+        params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+            pltpu, "TPUCompilerParams")
+
+        def ring(block):            # (1, words) int32 per device
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((n, words), jnp.int32),
+                grid_spec=grid_spec,
+                compiler_params=params_cls(
+                    has_side_effects=True, collective_id=0),
+            )(block)
+
+        return jax.jit(self._shard_map(ring, P(axis, None),
+                                       P(None, None)))
+
+    def _gather_fn(self, words: int):
+        key = (words, self._pallas_ok)
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        if self._pallas_ok:
+            try:
+                fn = self._pallas_gather_fn(words)
+            except Exception as e:              # build/trace failure:
+                _log.warning("ici: pallas ring unavailable (%s: %s); "
+                             "degrading to lax all_gather",
+                             type(e).__name__, e)
+                self._pallas_ok = False         # degrade ONCE, stay there
+        if fn is None:
+            fn = self._lax_gather_fn()
+        self._fns[(words, self._pallas_ok)] = fn
+        return fn
+
+    # -- the host-facing exchange -------------------------------------
+
+    def all_gather(self, rows: np.ndarray) -> np.ndarray:
+        """``rows`` (n_hosts, row_bytes) uint8 → the gathered array on
+        this host.  Row length pads to an int32-word multiple
+        internally; callers see exact bytes back."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        if rows.ndim != 2 or rows.shape[0] != self.n:
+            raise ValueError(
+                f"rows {rows.shape} != ({self.n}, row_bytes)")
+        nbytes = rows.shape[1]
+        pad = (-nbytes) % _ROW_ALIGN
+        if pad:
+            rows = np.pad(rows, ((0, 0), (0, pad)))
+        words = rows.shape[1] // 4
+        t0 = time.monotonic_ns()
+        sharding = NamedSharding(self.mesh, P(self.axis, None))
+        wrows = np.ascontiguousarray(rows).view(np.int32)
+        if jax.process_count() > 1:
+            arr = jax.make_array_from_process_local_data(sharding, wrows)
+        else:
+            arr = jax.device_put(wrows, sharding)
+        fn = self._gather_fn(words)
+        try:
+            out = fn(arr)
+            out.block_until_ready()
+        except Exception as e:
+            if not self._pallas_ok:
+                raise
+            # runtime refusal AFTER a successful trace: same one-way
+            # degrade, retried once on the collective path
+            _log.warning("ici: pallas ring failed at run time (%s: %s); "
+                         "degrading to lax all_gather",
+                         type(e).__name__, e)
+            self._pallas_ok = False
+            out = self._gather_fn(words)(arr)
+            out.block_until_ready()
+        got = np.asarray(jax.device_get(out)).view(np.uint8)
+        got = got.reshape(self.n, -1)[:, :nbytes]
+        if self.tracer is not None and getattr(self.tracer, "enabled",
+                                               False):
+            self.tracer.add_span(
+                "strom.ici.exchange", t0, time.monotonic_ns(),
+                category="strom.ici", hosts=self.n,
+                bytes=int(self.n * nbytes),
+                backend="pallas" if self._pallas_ok else "lax")
+        return got
+
+
+def _read_share(engine, paths: Sequence[str], fhs: Sequence[int],
+                units, row_bytes: int, klass: str) -> np.ndarray:
+    """One host's share row: its assigned ``(file_idx, offset, length)``
+    units read through the ordinary planner path (coalesced, split at
+    the ledger-tuned chunk, ``restore``-class — scheduler, breakers and
+    hostcache all apply) and packed in unit order."""
+    from nvme_strom_tpu.io.engine import wait_exact
+    from nvme_strom_tpu.io.plan import plan_and_submit
+
+    row = np.zeros(row_bytes, dtype=np.uint8)
+    extents = [(fhs[fi], off, ln) for fi, off, ln in units]
+    pos = 0
+    per_extent = plan_and_submit(engine, extents, klass=klass)
+    flat = [p for pieces in per_extent for p in pieces]
+    try:
+        for pieces in per_extent:
+            for p in pieces:
+                v = wait_exact(p)           # short read must fail HERE
+                row[pos:pos + v.nbytes] = v
+                pos += v.nbytes
+                flat.remove(p)
+                p.release()
+    finally:
+        for p in flat:
+            p.release()
+    return row
+
+
+def scatter_engine(engine, paths: Sequence[str], mesh=None,
+                   klass: str = "restore",
+                   unit_bytes: Optional[int] = None, manifest=None):
+    """Read-once/scatter front-end over ``engine`` for ``paths``.
+
+    Partitions the files into per-host contiguous byte shares, reads the
+    local share(s) through ``plan_and_submit`` at ``klass``, exchanges
+    the shares over :class:`IciExchange`, and returns a
+    :class:`~nvme_strom_tpu.io.scatter.ScatterServeEngine` serving every
+    later read of those files from the gathered bytes — so the consumer
+    above (checkpoint restore, weight streaming) runs unchanged and
+    bit-identical while each byte leaves flash exactly once per mesh.
+
+    Single-process meshes emulate every virtual host (reading each
+    host's share once, attributed per host in the store); multi-process
+    runs read only this process's rows.  Returns None — and counts
+    ``ici_fallbacks`` — on ANY failure or on a degraded (breaker-open)
+    engine, leaving the caller on the plain read-all path with zero
+    consumer-visible errors."""
+    from nvme_strom_tpu.io.scatter import (
+        ScatterServeEngine, ScatterStore, partition_files)
+
+    stats = getattr(engine, "stats", None)
+    tracer = getattr(engine, "tracer", None)
+
+    def fall_back(why: str) -> None:
+        _log.warning("ici scatter disabled for this restore: %s "
+                     "(falling back to local full reads)", why)
+        if stats is not None:
+            stats.add(ici_fallbacks=1)
+
+    sup = getattr(engine, "supervisor", None)
+    if sup is not None:
+        try:
+            sup.tick()
+            if sup.degraded():
+                # a browned-out device must serve the work it already
+                # owes, not take on the whole mesh's share traffic
+                fall_back("engine degraded (breaker open)")
+                return None
+        except Exception:
+            pass
+
+    t0 = time.monotonic_ns()
+    try:
+        exchange = IciExchange(mesh, stats=stats, tracer=tracer)
+        if exchange.n < 2:
+            fall_back(f"exchange mesh has {exchange.n} host(s)")
+            return None
+        if manifest is None:
+            sizes = [os.path.getsize(p) for p in paths]
+            manifest = partition_files(
+                sizes, exchange.n,
+                unit_bytes if unit_bytes is not None else ici_unit_bytes())
+        elif manifest.n_hosts != exchange.n:
+            fall_back(f"manifest built for {manifest.n_hosts} hosts, "
+                      f"exchange mesh has {exchange.n}")
+            return None
+        row_bytes = max(manifest.host_bytes) if manifest.host_bytes else 0
+        if row_bytes == 0:
+            fall_back("empty file set")
+            return None
+
+        import jax
+        multi = jax.process_count() > 1
+        my_hosts = ([jax.process_index()] if multi
+                    else list(range(exchange.n)))
+        fhs = [engine.open(p) for p in paths]
+        rows = np.zeros((exchange.n, row_bytes), dtype=np.uint8)
+        read_by_host = {}
+        try:
+            for h in my_hosts:
+                units = manifest.units_for(h)
+                rows[h] = _read_share(engine, paths, fhs, units,
+                                      row_bytes, klass)
+                read_by_host[h] = manifest.host_bytes[h]
+        finally:
+            for fh in fhs:
+                engine.close(fh)
+        gathered = exchange.all_gather(rows)
+        store = ScatterStore(paths, manifest, gathered,
+                             host_bytes_read=read_by_host)
+        local = sum(read_by_host.values())
+        if stats is not None:
+            # received = payload obtained from peers over ICI instead of
+            # local NVMe, summed over the hosts this process emulates
+            stats.add(ici_bytes_read=int(local),
+                      ici_bytes_received=int(
+                          manifest.total_bytes * len(my_hosts) - local))
+        if tracer is not None and getattr(tracer, "enabled", False):
+            tracer.add_span(
+                "strom.ici.scatter", t0, time.monotonic_ns(),
+                category="strom.ici", hosts=exchange.n,
+                files=len(paths), bytes_read=int(local),
+                total_bytes=int(manifest.total_bytes))
+        return ScatterServeEngine(engine, store)
+    except Exception as e:
+        fall_back(f"{type(e).__name__}: {e}")
+        return None

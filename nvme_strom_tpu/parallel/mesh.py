@@ -44,6 +44,33 @@ def make_mesh(axes: Mapping[str, int], devices=None):
     return Mesh(arr, tuple(names))
 
 
+def exchange_mesh(n_hosts: Optional[int] = None, devices=None):
+    """1-axis ``("hosts",)`` mesh for the ICI shard exchange (ops/ici.py).
+
+    One device stands in for each participating host: multi-process runs
+    pick one device per process (axis index == process index, so a
+    host's shard row lands on silicon it addresses); a single process
+    treats each local device as a virtual host — the same emulation
+    contract ``dryrun_multichip(8)`` validates the other collectives
+    under.  ``n_hosts`` caps/pins the axis size (default: every host)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if jax.process_count() > 1:
+        by_proc: dict = {}
+        for d in devs:
+            by_proc.setdefault(d.process_index, d)
+        devs = [by_proc[p] for p in sorted(by_proc)]
+    if n_hosts is not None:
+        if n_hosts < 1 or n_hosts > len(devs):
+            raise ValueError(
+                f"exchange_mesh: {n_hosts} hosts requested, "
+                f"{len(devs)} available")
+        devs = devs[:n_hosts]
+    return Mesh(np.array(devs), ("hosts",))
+
+
 def batch_sharding(mesh, axis: str = "dp", seq_axis=None):
     """NamedSharding splitting dim 0 of a batch across ``axis`` and
     (optionally) dim 1 across ``seq_axis`` — the input layout for

@@ -108,12 +108,22 @@ class LazyCheckpoint:
 
     def load_sharded(self, shardings: Union[Dict, Callable],
                      engine: Optional[StromEngine] = None,
-                     dtype=None) -> Dict[str, object]:
+                     dtype=None, ici_mesh=None) -> Dict[str, object]:
         """Load every tensor as a global jax.Array under its sharding.
 
         ``shardings``: {name: Sharding} or fn(name, shape) -> Sharding.
         ``dtype``: optional on-device cast applied after placement (the
         disk bytes stay in the stored dtype; the cast runs on device).
+
+        Read-once/scatter mode (``STROM_ICI_SCATTER=1``, docs/PERF.md
+        §7): the shard files partition into per-host contiguous byte
+        shares, each host reads only its 1/N from NVMe (``restore``
+        class) and the mesh all-gathers the shares over ICI; every span
+        read below is then served from the gathered bytes — so a
+        replicated tensor costs the MESH one read instead of one per
+        host.  ``ici_mesh`` pins the exchange mesh; any scatter failure
+        browns out to the per-host read path (``ici_fallbacks``).  Off
+        (the default) touches zero code paths.
         """
         import jax
 
@@ -122,6 +132,14 @@ class LazyCheckpoint:
             from nvme_strom_tpu.io.faults import build_engine
             engine = build_engine(EngineConfig())
         eng = engine
+        from nvme_strom_tpu.ops.ici import ici_scatter_enabled
+        if ici_scatter_enabled():
+            from nvme_strom_tpu.ops.ici import scatter_engine
+            served = scatter_engine(
+                engine, [sf.path for sf in self.files], mesh=ici_mesh,
+                klass="restore")
+            if served is not None:
+                eng = served
         out: Dict[str, object] = {}
         try:
             for name in self.keys():

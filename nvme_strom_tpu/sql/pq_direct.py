@@ -1433,8 +1433,11 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
                 v = None
                 if nulls == "forbid" and _raw_dict_only(plans[c]):
                     # whole-column batched dict path: one decode + one
-                    # combine + one sync for ALL row groups (None =
-                    # decode declined → per-chunk walk below)
+                    # combine + one sync for ALL row groups.  It always
+                    # returns the column (a declined device decode is
+                    # retried per-chunk and then host-expanded INSIDE),
+                    # so the per-chunk walk below runs only for columns
+                    # that failed the _raw_dict_only gate above.
                     v = _read_dict_column_batched(scanner, ds, fh,
                                                   plans[c], dev)
                 if v is None:

@@ -107,6 +107,14 @@ CONTRACT = {
     # the same-run store-off baseline, hit/dedupe counters) lives in
     # the metric tag — an attribution row like the other serving rows
     19: ("kv-serving-prefix", "attr"),
+    # overlapped stream pairs with its own same-run serialized +
+    # SQPOLL-off arms (speedup/reduction in the tag is the claim; the
+    # host→HBM hop is pad-emulated on CPU fallback, so no ratio bar)
+    20: ("overlap-stream", "attr"),
+    # read-once/ICI-scatter restore pairs with its own same-run
+    # read-all arm (the N·T→T flash reduction in the tag is the
+    # claim; emulated mesh on CPU fallback, so no ratio bar)
+    21: ("scatter-restore", "attr"),
 }
 
 #: the ONE validity rule set, shared with the watcher's coverage

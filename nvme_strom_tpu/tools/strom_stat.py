@@ -116,6 +116,14 @@ _LEDGER_COUNTERS = (
     "waste_degraded_bytes",
 )
 
+#: read-once/ICI-scatter restore counters (ops/ici.py —
+#: docs/PERF.md §7); own block, shown only when a scatter restore ran
+#: (or fell back): the read/received split is the win made visible —
+#: each host bills its 1/N to flash and the rest to the interconnect
+_ICI_COUNTERS = (
+    "ici_bytes_read", "ici_bytes_received", "ici_fallbacks",
+)
+
 #: every counter block above, in render order — the counter-drift CI
 #: check (tests/test_observability.py) asserts the union covers ALL of
 #: StromStats.COUNTER_FIELDS, so a new counter cannot silently vanish
@@ -124,7 +132,7 @@ ALL_COUNTER_BLOCKS = (
     _COUNTERS, _RESILIENCE_COUNTERS, _INTEGRITY_COUNTERS,
     _BATCH_COUNTERS, _ENGINE_COUNTERS, _SCHED_COUNTERS,
     _HOSTCACHE_COUNTERS, _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
-    _LEDGER_COUNTERS,
+    _LEDGER_COUNTERS, _ICI_COUNTERS,
 )
 
 
@@ -319,6 +327,20 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
             lines.append(
                 "    BROWNED OUT — all fast domains unhealthy; serving "
                 "rides plain preads until a half-open probe recovers")
+    if any(int(snap.get(n, 0)) for n in _ICI_COUNTERS):
+        lines.append("  ici scatter (read-once restore over the "
+                     "interconnect):")
+        for name in _ICI_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if "bytes" in name else str(v)
+            lines.append(f"    {name:<22} {shown:>14}")
+        read = int(snap.get("ici_bytes_read", 0))
+        recv = int(snap.get("ici_bytes_received", 0))
+        if read + recv:
+            lines.append(
+                f"    {'flash share':<22} "
+                f"{read / (read + recv):>14.3f}   "
+                "(local NVMe / restore payload)")
     if any(int(snap.get(n, 0)) for n in _RESILIENCE_COUNTERS):
         lines.append("  resilience (recoveries + degradations):")
         for name in _RESILIENCE_COUNTERS:

@@ -36,6 +36,7 @@ _COMPONENTS = (
     ("hedge", "hedge"),
     ("degraded", "degr"),
     ("bridge", "bridge"),
+    ("ici_scatter", "ici"),
     ("unattributed", "other"),
 )
 

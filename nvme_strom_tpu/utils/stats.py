@@ -239,6 +239,17 @@ class StromStats:
     # be visible, exactly like trace_spans_dropped)
     attrib_requests: int = 0
     attrib_spans_dropped: int = 0
+    # -- read-once/ICI-scatter restore (ops/ici.py, docs/PERF.md §7) ------
+    # restore payload this process pulled off local NVMe as its share of
+    # a scatter-mode restore (its 1/N; read-all would bill the total)
+    ici_bytes_read: int = 0
+    # restore payload obtained from peers over the interconnect instead
+    # of local flash — the bytes the mesh moved so this host didn't
+    ici_bytes_received: int = 0
+    # scatter attempts that fell back to plain local full reads (breaker
+    # open, exchange failure, single-host mesh) — a brown-out, never an
+    # error the consumer sees
+    ici_fallbacks: int = 0
     _lock: threading.Lock = field(
         default_factory=lambda: make_lock("stats.StromStats._lock"),
         repr=False)

@@ -129,7 +129,10 @@ def best_sql_fold(path: str | None = None) -> dict | None:
         if not m:
             continue
         vb = r.get("vs_baseline")
-        if vb is not None and not 0 < vb <= 1.05:
+        if vb is None or not 0 < vb <= 1.05:
+            # same credibility bar as best_probe_config: a row WITHOUT
+            # a ceiling ratio carries no evidence either — it must not
+            # become the adopted default just by posting a big number
             continue
         rate = r.get("value") or 0.0
         if rate > best_rate:

@@ -198,3 +198,23 @@ def test_accum_steps_reuse_slots_within_one_step(store_dir):
         np.testing.assert_allclose(np.asarray(p_off[k]),
                                    np.asarray(p_ref[k]),
                                    rtol=1e-5, atol=1e-7, err_msg=k)
+
+
+def test_nvme_policy_rejects_sharded_inputs():
+    """remat_policy='nvme' is single-device: the store's ordered
+    io_callbacks cannot lower inside a multi-device computation.  The
+    LIBRARY must reject tokens actually sharded across devices (not
+    just examples/train_lm.py's arg parsing) — while unsharded inputs
+    on a many-device host (this very test env) stay accepted."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from nvme_strom_tpu.models.transformer import forward_hidden
+
+    cfg = dataclasses.replace(_f32(tiny_config()), remat_policy="nvme")
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0,
+                                cfg.vocab)
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    sharded = jax.device_put(tokens, NamedSharding(mesh, P("dp")))
+    # guard fires before the store is touched — a stub suffices
+    with pytest.raises(ValueError, match="single-device"):
+        forward_hidden(params, sharded, cfg, act_store=object())

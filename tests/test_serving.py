@@ -514,3 +514,39 @@ def test_lookahead_token_identical(setup, paged):
     for rid, (p, m) in reqs.items():
         assert results[16][rid] == _solo(params, cfg, p, m,
                                          eos_id=7), rid
+
+
+def test_pending_first_drained_on_step_exception(setup):
+    """An exception between admission and the batch readback must not
+    leak ``_pending_first`` into the next call (the first token would
+    replay a full batch LATE, after newer tokens): the except path
+    drains the deferred first tokens in generation order, retirements
+    completed during the drain surface on the next call, and every
+    request's output stays token-identical to its solo run."""
+    cfg, params = setup
+    rng = np.random.default_rng(5)
+    p0 = rng.integers(0, cfg.vocab, 4).tolist()
+    p1 = rng.integers(0, cfg.vocab, 6).tolist()
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64)
+    srv.submit("one", p0, 1)        # retires during the drain itself
+    srv.submit("more", p1, 6)
+    real_run_step = srv._run_step
+
+    def boom():
+        raise RuntimeError("device fault mid-dispatch")
+
+    srv._run_step = boom
+    with pytest.raises(RuntimeError, match="mid-dispatch"):
+        srv.step_many(4)
+    # both admissions' first tokens were drained, none leaked
+    assert srv._pending_first == []
+    assert "one" in srv._finished_carry      # max_new=1: drained full
+    live = [r for r in srv.slots if r is not None]
+    assert len(live) == 1 and len(live[0].out) == 1
+
+    srv._run_step = real_run_step
+    got = {}
+    while not srv.idle:
+        got.update(srv.step_many(4))
+    assert got["one"] == _solo(params, cfg, p0, 1)
+    assert got["more"] == _solo(params, cfg, p1, 6)

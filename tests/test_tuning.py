@@ -149,6 +149,15 @@ def test_best_sql_fold_adoption(tmp_path, monkeypatch):
              "metric": "config5:parquet-groupby-scan (dev=tpu, "
                        "method=matmul window=256MiB)",
              "value": 2.5, "unit": "GiB/s", "vs_baseline": 0.95}]},
+        # faster than the winner but carries NO ceiling ratio: same
+        # credibility bar as best_probe_config — a ratio-less row is
+        # no evidence and must not become the adopted default
+        {"step": "suite_5_noratio", "rc": 0,
+         "device": "tpu TPU v5 lite0",
+         "results": [{
+             "metric": "config5:parquet-groupby-scan (dev=tpu, "
+                       "method=matmul window=32MiB)",
+             "value": 3.1, "unit": "GiB/s", "vs_baseline": None}]},
     ]
     p = tmp_path / "ledger.jsonl"
     p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
