@@ -196,11 +196,12 @@ class ScatterServeEngine:
     Sits where a consumer's engine handle goes: ``open`` tracks which
     file handles name scattered files, ``submit_read``/``submit_readv``
     satisfy covered spans from the store (uncovered spans ride the
-    wrapped engine as ONE vectored batch, order preserved), and every
-    other attribute — ``stats``, ``config``, ``supervisor``,
-    ``tracer``, ``n_buffers``, ``close_all`` — resolves on the wrapped
-    engine, so the QoS scheduler, breakers and ledger govern exactly
-    the engine they always did."""
+    wrapped engine as ONE vectored batch, order preserved),
+    ``close``/``close_all`` drop the handle tracking before delegating,
+    and every other attribute — ``stats``, ``config``, ``supervisor``,
+    ``tracer``, ``n_buffers`` — resolves on the wrapped engine, so the
+    QoS scheduler, breakers and ledger govern exactly the engine they
+    always did."""
 
     def __init__(self, engine, store: ScatterStore):
         self._engine = engine
@@ -220,6 +221,17 @@ class ScatterServeEngine:
         with self._lock:
             self._paths.pop(fh, None)
         self._engine.close(fh)
+
+    def close_all(self) -> None:
+        # intercepted (not left to __getattr__ delegation) so the fh→
+        # path map empties with the handles: a later reuse of the same
+        # fh integer for a DIFFERENT file must ride the wrapped engine,
+        # not be served stale scattered-file bytes.  Handles closed
+        # directly on the wrapped engine (code holding the inner
+        # handle) cannot be tracked — keep opens/closes on the wrapper.
+        with self._lock:
+            self._paths.clear()
+        self._engine.close_all()
 
     # -- the serving read path ----------------------------------------
 

@@ -803,6 +803,7 @@ class DecodeServer:
         # leak those entries into the next call (first tokens would
         # replay a full batch LATE, after newer tokens) — the except
         # path drains them in generation order before re-raising
+        pending = None
         try:
             restored = (self._restore_prefixes(plans)
                         if plans and self.kv_store is not None else {})
@@ -850,6 +851,13 @@ class DecodeServer:
                 [v for _, v in pending],
                 jnp.stack(toks) if toks else None))
         except BaseException:
+            if pending:
+                # the batch readback itself failed AFTER the swap
+                # emptied _pending_first: re-stash the entries so the
+                # drain below still owns them — otherwise the deferred
+                # first tokens would be silently dropped, breaking
+                # _drain_pending_first's restore-on-failure contract
+                self._pending_first = pending
             self._drain_pending_first()
             raise
         self.timings["readback_s"] += time.monotonic() - t0

@@ -250,7 +250,17 @@ class IciExchange:
         sharding = NamedSharding(self.mesh, P(self.axis, None))
         wrows = np.ascontiguousarray(rows).view(np.int32)
         if jax.process_count() > 1:
-            arr = jax.make_array_from_process_local_data(sharding, wrows)
+            # wrows is the FULL (n, words) array with only this
+            # process's row(s) populated, so global_shape must say so
+            # explicitly: with it, each process's addressable row is
+            # sliced from its local copy (row p belongs to process p —
+            # exchange_mesh pins axis index == process index).  Without
+            # it JAX treats the n local rows as this process's SHARD,
+            # infers an (n·n_proc, words) global array, and the gather
+            # silently returns zeros for every peer row instead of
+            # raising.
+            arr = jax.make_array_from_process_local_data(
+                sharding, wrows, global_shape=wrows.shape)
         else:
             arr = jax.device_put(wrows, sharding)
         fn = self._gather_fn(words)
@@ -269,7 +279,15 @@ class IciExchange:
             out = self._gather_fn(words)(arr)
             out.block_until_ready()
         got = np.asarray(jax.device_get(out)).view(np.uint8)
-        got = got.reshape(self.n, -1)[:, :nbytes]
+        if got.shape != rows.shape:
+            # multi-process-semantics guard: a shape drift here means
+            # the gather's global view disagrees with the exchange
+            # contract — fail loudly so scatter_engine browns out to
+            # the read-all path instead of serving corrupt bytes
+            raise RuntimeError(
+                f"ici: gather returned {got.shape}, expected "
+                f"{rows.shape}")
+        got = got[:, :nbytes]
         if self.tracer is not None and getattr(self.tracer, "enabled",
                                                False):
             self.tracer.add_span(
@@ -388,15 +406,27 @@ def scatter_engine(engine, paths: Sequence[str], mesh=None,
             for fh in fhs:
                 engine.close(fh)
         gathered = exchange.all_gather(rows)
+        for h in my_hosts:
+            # cross-row checksum before trusting the store: the rows
+            # this process read itself must round-trip bit-identically
+            # through the exchange; a mismatch means the gather's
+            # process/row mapping drifted, and the same corruption
+            # would hit every peer row we CANNOT check locally
+            if not np.array_equal(gathered[h], rows[h]):
+                raise RuntimeError(
+                    f"ici: exchange corrupted host {h}'s own share row")
         store = ScatterStore(paths, manifest, gathered,
                              host_bytes_read=read_by_host)
         local = sum(read_by_host.values())
         if stats is not None:
-            # received = payload obtained from peers over ICI instead of
-            # local NVMe, summed over the hosts this process emulates
+            # received = payload obtained from peers over ICI instead
+            # of local NVMe.  Single-process emulation has no peers —
+            # every byte came off this host's own flash — so it reports
+            # 0 rather than crediting phantom interconnect savings to
+            # the ledger/dashboards
+            received = (manifest.total_bytes - local) if multi else 0
             stats.add(ici_bytes_read=int(local),
-                      ici_bytes_received=int(
-                          manifest.total_bytes * len(my_hosts) - local))
+                      ici_bytes_received=int(received))
         if tracer is not None and getattr(tracer, "enabled", False):
             tracer.add_span(
                 "strom.ici.scatter", t0, time.monotonic_ns(),

@@ -119,7 +119,9 @@ _LEDGER_COUNTERS = (
 #: read-once/ICI-scatter restore counters (ops/ici.py —
 #: docs/PERF.md §7); own block, shown only when a scatter restore ran
 #: (or fell back): the read/received split is the win made visible —
-#: each host bills its 1/N to flash and the rest to the interconnect
+#: each host bills its 1/N to flash and the rest to the interconnect.
+#: Single-process emulation reports received=0 (no peers; every byte
+#: is a local read), so the flash-share line honestly shows 1.000
 _ICI_COUNTERS = (
     "ici_bytes_read", "ici_bytes_received", "ici_fallbacks",
 )

@@ -244,7 +244,9 @@ class StromStats:
     # a scatter-mode restore (its 1/N; read-all would bill the total)
     ici_bytes_read: int = 0
     # restore payload obtained from peers over the interconnect instead
-    # of local flash — the bytes the mesh moved so this host didn't
+    # of local flash — the bytes the mesh moved so this host didn't.
+    # Stays 0 in single-process emulation: no peers, every byte is a
+    # local NVMe read, and phantom savings would skew the ledger
     ici_bytes_received: int = 0
     # scatter attempts that fell back to plain local full reads (breaker
     # open, exchange failure, single-host mesh) — a brown-out, never an

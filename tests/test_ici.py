@@ -116,7 +116,9 @@ def test_scatter_serves_bit_identical_and_reads_one_nth(tmp_path,
     for h, got in store.host_bytes_read.items():
         assert got <= total / N + len(sizes) * UNIT
     assert engine.stats.ici_bytes_read == total
-    assert engine.stats.ici_bytes_received == (N - 1) * total
+    # single-process emulation has no peers: every byte came off this
+    # host's own flash, so no interconnect savings are credited
+    assert engine.stats.ici_bytes_received == 0
     assert engine.stats.ici_fallbacks == 0
 
     # reads crossing unit AND host-share boundaries serve bit-identical
@@ -148,6 +150,19 @@ def test_scatter_readv_mixes_store_hits_and_misses(tmp_path, engine):
         p.release()
     served.close(fh0)
     served.close(fho)
+
+
+def test_serve_engine_close_all_clears_handle_tracking(tmp_path,
+                                                       engine):
+    """``close_all`` must drop the fh→path map with the handles: a
+    recycled fh integer naming a DIFFERENT file must never be served
+    stale scattered-file bytes."""
+    paths, _ = _write_files(tmp_path, [2 * UNIT])
+    served = scatter_engine(engine, paths, unit_bytes=UNIT)
+    served.open(paths[0])
+    assert served._paths
+    served.close_all()
+    assert served._paths == {}
 
 
 def test_scatter_store_view_outside_files_is_none(tmp_path, engine):
@@ -198,6 +213,25 @@ def test_scatter_declines_on_degraded_engine(tmp_path, engine):
     assert served is None                   # caller keeps plain engine
     assert engine.stats.ici_fallbacks == 1
     assert engine.stats.ici_bytes_read == 0
+
+
+def test_scatter_rejects_corrupted_exchange(tmp_path, engine,
+                                            monkeypatch):
+    """A gather whose process/row mapping drifted (a locally-read row
+    comes back altered) must brown out to read-all, never build a
+    store that serves corrupt bytes."""
+    paths, _ = _write_files(tmp_path, [2 * UNIT])
+    real = ici_mod.IciExchange.all_gather
+
+    def corrupt(self, rows):
+        got = np.array(real(self, rows))
+        got[0, 0] ^= 1
+        return got
+
+    monkeypatch.setattr(ici_mod.IciExchange, "all_gather", corrupt)
+    served = scatter_engine(engine, paths, unit_bytes=UNIT)
+    assert served is None
+    assert engine.stats.ici_fallbacks == 1
 
 
 def test_scatter_falls_back_on_exchange_failure(tmp_path, engine,
@@ -254,10 +288,11 @@ def test_restore_scatter_on_is_bit_identical(tmp_path, engine,
     assert on["step"] == off["step"] == 3
 
     # the counters prove read-once: the mesh read the payload bytes
-    # exactly once, and 7/8 of every virtual host's bytes came off ICI
+    # exactly once (vs N·total under read-all); received stays 0 in
+    # single-process emulation — there are no peers to receive from
     man = build_restore_manifest(str(mgr.step_dir(3)), N, UNIT)
     assert engine.stats.ici_bytes_read == man.total_bytes
-    assert engine.stats.ici_bytes_received == (N - 1) * man.total_bytes
+    assert engine.stats.ici_bytes_received == 0
     assert engine.stats.ici_fallbacks == 0
     for hb in man.host_bytes:
         assert hb <= man.total_bytes / N + len(man.paths) * UNIT

@@ -54,6 +54,22 @@ _WORKER = textwrap.dedent("""
     total = float(jax.jit(jnp.sum)(arr))
     assert total == (1 + 2) * 2 * 4, total    # both processes' rows
 
+    # -- read-once/ICI exchange across REAL processes: each process
+    # populates ONLY its own row (exactly scatter_engine's
+    # multi-process contract) and must get every peer row back intact.
+    # This pins the make_array_from_process_local_data(global_shape=)
+    # semantics the single-process emulation can never reach: without
+    # the explicit global_shape the gather silently returns zeros for
+    # every peer row.
+    from nvme_strom_tpu.ops.ici import IciExchange
+    ex = IciExchange()
+    assert ex.n == 2, ex.n
+    rngx = np.random.default_rng(17)               # SAME seed both procs
+    full = rngx.integers(0, 256, size=(2, 12_345), dtype=np.uint8)
+    mine = np.zeros_like(full)
+    mine[pid] = full[pid]                          # own row ONLY
+    np.testing.assert_array_equal(ex.all_gather(mine), full)
+
     # -- loader multi-host path: per-process shards -> global batch --
     import tempfile
     from nvme_strom_tpu.data.loader import ShardedLoader
@@ -191,6 +207,25 @@ _WORKER = textwrap.dedent("""
         NamedSharding(mesh, P("dp", None))), "step": 0})
     assert int(got["step"]) == 3
     for sh in got["w"].addressable_shards:
+        r0 = sh.index[0].start or 0
+        np.testing.assert_array_equal(
+            np.asarray(sh.data),
+            np.arange(32, dtype=np.float32).reshape(8, 4)[
+                r0:r0 + sh.data.shape[0]])
+
+    # -- read-once/ICI-scatter restore across REAL processes (the
+    # headline deployment): each process NVMe-reads only its byte
+    # share and receives the peer's over the exchange; the restored
+    # tensors must stay bit-identical to the read-all restore above.
+    os.environ["STROM_ICI_SCATTER"] = "1"
+    try:
+        got_sc = mgr.restore({"w": jax.device_put(
+            jnp.zeros((8, 4), jnp.float32),
+            NamedSharding(mesh, P("dp", None))), "step": 0})
+    finally:
+        del os.environ["STROM_ICI_SCATTER"]
+    assert int(got_sc["step"]) == 3
+    for sh in got_sc["w"].addressable_shards:
         r0 = sh.index[0].start or 0
         np.testing.assert_array_equal(
             np.asarray(sh.data),

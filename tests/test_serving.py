@@ -550,3 +550,37 @@ def test_pending_first_drained_on_step_exception(setup):
         got.update(srv.step_many(4))
     assert got["one"] == _solo(params, cfg, p0, 1)
     assert got["more"] == _solo(params, cfg, p1, 6)
+
+
+def test_pending_first_restored_on_readback_failure(setup, monkeypatch):
+    """The batch readback failing AFTER step_many swapped
+    ``_pending_first`` out must not drop the deferred first tokens:
+    they are re-stashed before the drain runs, the drain's own failed
+    readback RESTORES them (its documented contract), and once the
+    device recovers the replay delivers them — late beats lost.
+    max_new=1 requests keep the failed batch dispatch-free (their
+    budget is consumed by the deferred first), so recovery is exact."""
+    cfg, params = setup
+    rng = np.random.default_rng(11)
+    p0 = rng.integers(0, cfg.vocab, 4).tolist()
+    p1 = rng.integers(0, cfg.vocab, 6).tolist()
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64)
+    srv.submit("one", p0, 1)
+    srv.submit("more", p1, 1)
+
+    def boom(x):
+        raise RuntimeError("link wedged at readback")
+
+    with monkeypatch.context() as m:
+        m.setattr(jax, "device_get", boom)
+        with pytest.raises(RuntimeError, match="wedged"):
+            srv.step_many(4)
+    # both admissions' deferred first tokens survived the failed
+    # readback — nothing was silently dropped
+    assert sorted(s for s, _ in srv._pending_first) == [0, 1]
+
+    got = {}
+    while not srv.idle:
+        got.update(srv.step_many(4))
+    assert got["one"] == _solo(params, cfg, p0, 1)
+    assert got["more"] == _solo(params, cfg, p1, 1)
