@@ -766,6 +766,183 @@ def bench_kvserve(path: str) -> dict:
     }
 
 
+def bench_tenants(path: str, trials: int = 1) -> dict:
+    """Multi-tenant isolation storm (docs/RESILIENCE.md "Multi-tenant
+    isolation"): an open-loop, trace-driven replay of concurrent
+    sessions — a well-behaved VICTIM tenant (poisson arrivals,
+    mixed session lengths, a shared system prompt) plus a misbehaving
+    AGGRESSOR (prompt storm: oversized prompts arriving several times
+    faster than its fair share) — served three ways on the same box:
+
+      ``base``      victim alone (the no-aggressor reference)
+      ``tier_off``  victim + aggressor, ``STROM_TENANTS=0`` — today's
+                    stack, every request equal in the admission queue
+      ``tier_on``   victim + aggressor with tenancy on: victim declared
+                    gold, aggressor bronze + rate-limited — under
+                    backlog pressure the admission path sheds bronze
+
+    Open-loop means arrivals follow the trace clock regardless of
+    completions (the production shape: users do not wait for each
+    other), so an admission backlog shows up as queue pressure, not a
+    slower trace.  Reports per-tenant TTFT p50/p99 per arm and the
+    victim-p99 isolation ratio — tier_off/base (the damage) vs
+    tier_on/base (what tenancy buys back) — plus the shed counters
+    proving the aggressor, and only the aggressor, paid.
+    ``STROM_BENCH_TENANT_SESSIONS`` scales the victim session count;
+    ``trials > 1`` runs ALTERNATING tier-off/tier-on storm trials (the
+    bench_mixed discipline — drift hits both arms equally) and reports
+    the median-p99 trial of each arm."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from nvme_strom_tpu.io import StromEngine, tenants as _tn
+    from nvme_strom_tpu.io.resilient import ResilientEngine
+    from nvme_strom_tpu.models.kv_offload import PrefixStore
+    from nvme_strom_tpu.models.serving import DecodeServer
+    from nvme_strom_tpu.models.transformer import (TransformerConfig,
+                                                   init_params,
+                                                   tiny_config)
+    from nvme_strom_tpu.utils.config import EngineConfig, TenantConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32, "max_seq": 1024})
+    params = init_params(jax.random.key(0), cfg)
+    page_tokens = 32
+    n_victim = int(os.environ.get("STROM_BENCH_TENANT_SESSIONS", "12"))
+    n_aggr = n_victim
+    rng = np.random.default_rng(5)
+    prefix_v = rng.integers(0, cfg.vocab, 2 * page_tokens).tolist()
+    prefix_a = rng.integers(0, cfg.vocab, 2 * page_tokens).tolist()
+
+    def make_trace(include_aggr: bool) -> list:
+        """(t_arrive, tenant, rid, prompt, max_new), time-sorted.
+        Victim: ~12 req/s poisson, short mixed sessions on a shared
+        prefix.  Aggressor: 4x the arrival rate, oversized prompts —
+        the prompt storm that used to drag every tenant's p99 down."""
+        ev = []
+        rv = np.random.default_rng(11)
+        t = 0.0
+        for i in range(n_victim):
+            t += float(rv.exponential(0.08))
+            tail = rv.integers(0, cfg.vocab,
+                               1 + int(rv.integers(0, 8))).tolist()
+            ev.append((t, "victim", f"v{i}", prefix_v + tail,
+                       6 + int(rv.integers(0, 6))))
+        if include_aggr:
+            ra = np.random.default_rng(13)
+            t = 0.0
+            for i in range(n_aggr):
+                t += float(ra.exponential(0.02))
+                tail = ra.integers(0, cfg.vocab,
+                                   64 + int(ra.integers(0, 64))).tolist()
+                ev.append((t, "aggr", f"a{i}", prefix_a + tail, 4))
+        ev.sort(key=lambda e: e[0])
+        return ev
+
+    def run(include_aggr: bool, tenants_on: bool) -> dict:
+        spec = ("victim:tier=gold,weight=4;"
+                "aggr:tier=bronze,weight=1,rate=6,burst=2")
+        _tn.configure(TenantConfig(enabled=tenants_on,
+                                   spec=spec if tenants_on else ""))
+        stats = StromStats()
+        eng = ResilientEngine(StromEngine(
+            EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                         buffer_pool_bytes=64 << 20, n_rings=0),
+            stats=stats))
+        store_path = os.path.join(os.path.dirname(path),
+                                  ".bench_tenants.kvstore")
+        store = PrefixStore(cfg, eng, store_path,
+                            page_tokens=page_tokens,
+                            capacity_bytes=32 << 20)
+        srv = DecodeServer(params, cfg, max_batch=4, max_len=512,
+                           kv_store=store)
+        trace = make_trace(include_aggr)
+        try:
+            t0 = time.monotonic()
+            i = 0
+            while i < len(trace) or not srv.idle:
+                now = time.monotonic() - t0
+                while i < len(trace) and trace[i][0] <= now:
+                    _t, tid, rid, prompt, mn = trace[i]
+                    i += 1
+                    srv.submit(rid, prompt, mn, tenant=tid)
+                if srv.idle:
+                    # open-loop: nothing in flight, next arrival not
+                    # due — idle to the trace clock, never spin
+                    time.sleep(min(0.005,
+                                   max(0.0, trace[i][0] - now)))
+                    continue
+                srv.step_many(2)
+                if all(r is None for r in srv.slots):
+                    # every queued request was shed this step (the
+                    # rate-limited aggressor waiting out its bucket):
+                    # pace the retry loop like a real serve loop's
+                    # decode cadence instead of spinning the shed
+                    # counters at MHz
+                    time.sleep(0.002)
+            wall = time.monotonic() - t0
+            store.flush()
+            eng.sync_stats()
+        finally:
+            store.close()
+            eng.close_all()
+            _tn.reset()
+            for suffix in ("", ".kvman.json"):
+                try:
+                    os.unlink(store_path + suffix)
+                except OSError:
+                    pass
+        by_t = {"victim": [], "aggr": []}
+        for rid, m in srv.request_metrics.items():
+            by_t["aggr" if str(rid).startswith("a") else
+                 "victim"].append(m["ttft_ms"])
+        pick = lambda xs, q: (sorted(xs)[min(len(xs) - 1,  # noqa: E731
+                                             int(q * len(xs)))]
+                              if xs else 0.0)
+        out = {
+            "aggressor": bool(include_aggr),
+            "tenants_on": bool(tenants_on),
+            "victim_sessions": len(by_t["victim"]),
+            "aggr_sessions": len(by_t["aggr"]),
+            "wall_s": round(wall, 2),
+            "victim_ttft_p50_ms": round(pick(by_t["victim"], 0.50), 3),
+            "victim_ttft_p99_ms": round(pick(by_t["victim"], 0.99), 3),
+            "aggr_ttft_p99_ms": round(pick(by_t["aggr"], 0.99), 3),
+            "tenant_sheds": dict(srv.tenant_sheds),
+            "tenant_admissions_shed": int(stats.tenant_admissions_shed),
+            "tenant_quota_evictions": int(stats.tenant_quota_evictions),
+            "tenant_borrows": int(stats.tenant_borrows),
+            "tenant_storm_dumps": int(stats.tenant_storm_dumps),
+        }
+        return out
+
+    # explicit warm pass: compiles the admission/step shapes once so
+    # the three measured arms pay trace time, not XLA time
+    run(False, False)
+    base = run(False, False)
+    offs, ons = [], []
+    for _ in range(max(1, trials)):
+        offs.append(run(True, False))
+        ons.append(run(True, True))
+    med = lambda arms: sorted(                      # noqa: E731
+        arms, key=lambda a: a["victim_ttft_p99_ms"])[len(arms) // 2]
+    off, on = med(offs), med(ons)
+    p_base = base["victim_ttft_p99_ms"]
+    p_off, p_on = off["victim_ttft_p99_ms"], on["victim_ttft_p99_ms"]
+    return {
+        "base": base, "tier_off": off, "tier_on": on,
+        "trials": max(1, trials),
+        "victim_p99_degradation_off_pct": round(
+            100.0 * (p_off - p_base) / p_base if p_base else 0.0, 1),
+        "victim_p99_degradation_on_pct": round(
+            100.0 * (p_on - p_base) / p_base if p_base else 0.0, 1),
+        "isolation_win": round(p_off / p_on, 2) if p_on else None,
+    }
+
+
 def bench_overlap(path: str) -> dict:
     """Zero-copy overlap scenario (docs/PERF.md §6) — the two claims of
     the registered-files/SQPOLL/arena/double-buffering arc, measured:
@@ -1498,6 +1675,23 @@ def main() -> int:
              f"tok/s {kvserve['off']['tok_s']:.1f} -> "
              f"{kvserve['on']['tok_s']:.1f}")
 
+    # Multi-tenant isolation storm (docs/RESILIENCE.md "Multi-tenant
+    # isolation"): open-loop victim + aggressor trace, victim TTFT p99
+    # no-aggressor vs tier-off vs tier-on, with the shed counters.
+    # STROM_BENCH_TENANTS=0 skips.
+    tenants = None
+    if os.environ.get("STROM_BENCH_TENANTS", "1") != "0":
+        tenants = bench_tenants(path)
+        _log(f"bench: tenants: victim TTFT p99 "
+             f"{tenants['base']['victim_ttft_p99_ms']:.1f} ms alone, "
+             f"{tenants['tier_off']['victim_ttft_p99_ms']:.1f} under "
+             f"storm tier-off "
+             f"({tenants['victim_p99_degradation_off_pct']:+.1f}%), "
+             f"{tenants['tier_on']['victim_ttft_p99_ms']:.1f} tier-on "
+             f"({tenants['victim_p99_degradation_on_pct']:+.1f}%), "
+             f"sheds={tenants['tier_on']['tenant_sheds']} "
+             f"storm_dumps={tenants['tier_on']['tenant_storm_dumps']}")
+
     # Observability-overhead scenario (docs/OBSERVABILITY.md): the
     # always-on flight recorder and the causal tracer priced against
     # the bare read path, plus the metrics-registry snapshot series.
@@ -1626,6 +1820,12 @@ def main() -> int:
         # prefetch storm, store off vs on, dedupe/hit counters — the
         # one-prefill-fleet-wide evidence (docs/PERF.md §5)
         "kvserve": kvserve,
+        # multi-tenant isolation storm (bench_tenants): victim TTFT p99
+        # alone vs under an aggressor with tiers off vs on, plus the
+        # per-tenant shed/quota counters — the evidence that tenancy
+        # contains a misbehaving tenant's blast radius
+        # (docs/RESILIENCE.md "Multi-tenant isolation")
+        "tenants": tenants,
         # failure-domain supervision (io/health.py): normally all
         # zeros — non-zero means THIS bench run tripped breakers,
         # hot-restarted rings, requeued extents, or browned out to the

@@ -828,6 +828,32 @@ def bench_scatter(nbytes: int) -> tuple[float, str]:
     return out["scatter_gib_s"], tag
 
 
+def bench_tenant_storm(nbytes: int) -> tuple[float, str]:
+    """Config 22: multi-tenant isolation storm (docs/RESILIENCE.md
+    "Multi-tenant isolation") — an open-loop victim + aggressor
+    session trace served with tenancy off vs on, ALTERNATING storm
+    trials with the median-p99 trial per arm (the bench_mixed
+    discipline: clock drift hits both arms equally).  Delegates to
+    ``bench.bench_tenants`` (own engines, own store file).  Headline
+    is the isolation win — victim TTFT p99 tier-off / tier-on under
+    the SAME storm; the tag carries the no-aggressor reference, both
+    degradations, and the shed counters proving only the aggressor's
+    tier paid."""
+    d = _scratch_dir()
+    path = os.path.join(d, "tenants.bin")
+    bench.make_file(path, max(nbytes, 8 << 20))
+    trials = 2 if _tiny_compute() else 3
+    out = bench.bench_tenants(path, trials=trials)
+    tag = (f"victim_p99={out['base']['victim_ttft_p99_ms']} ms alone"
+           f", {out['tier_off']['victim_ttft_p99_ms']} tier-off"
+           f", {out['tier_on']['victim_ttft_p99_ms']} tier-on "
+           f"({out['victim_p99_degradation_on_pct']:+.1f}% vs alone), "
+           f"sheds={out['tier_on']['tenant_sheds']}, "
+           f"storm_dumps={out['tier_on']['tenant_storm_dumps']}, "
+           f"trials={out['trials']}")
+    return float(out["isolation_win"] or 0.0), tag
+
+
 def bench_tar_index(engine, nbytes: int) -> tuple[float, str]:
     """Config 16: WebDataset shard-index rate (members/s), native C
     header walk vs Python tarfile — the first-epoch metadata cost of a
@@ -2127,6 +2153,13 @@ def run(configs: list[int], emit=None) -> list[dict]:
             # read-ceiling ratio applies
             21: ("scatter-restore",
                  lambda: bench_scatter(nbytes), "GiB/s", False),
+            # multi-tenant isolation storm: victim-p99 ratio tier-off /
+            # tier-on under the same aggressor, alternating trials with
+            # medians — paired with its own same-run no-aggressor and
+            # tier-off arms (the containment in the tag is the claim),
+            # so no read-ceiling ratio applies
+            22: ("tenant-isolation-storm",
+                 lambda: bench_tenant_storm(nbytes), "x", False),
         }
         # only configs whose _steady passes move payload ACROSS the
         # link get per-pass pairing: config 8's passes are pure engine
@@ -2201,12 +2234,12 @@ def run(configs: list[int], emit=None) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 22))
+                    choices=range(1, 23))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = list(range(1, 22))
+        configs = list(range(1, 23))
     run(configs, emit=lambda row: print(json.dumps(row), flush=True))
     return 0
 

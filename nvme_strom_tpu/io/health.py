@@ -426,8 +426,15 @@ class EngineSupervisor:
             self._next_probe = now + self.cfg.probe_s
             if stats is not None:
                 stats.add(breaker_trips=1)   # the device breaker's trip
+            # a multi-tenant box's degraded-device post-mortem wants
+            # WHOSE traffic was on the device when it went sick — embed
+            # the per-tenant ledger when one exists (empty = old dump)
+            tenants = (stats.tenant_stats
+                       if stats is not None else {})
             self._flight_dump("device_degraded",
-                              device_errors=self.device_window.count(now))
+                              device_errors=self.device_window.count(now),
+                              **({"tenant_stats": tenants}
+                                 if tenants else {}))
             self._export_gauges(stats)
 
     def _recover(self, stats) -> None:

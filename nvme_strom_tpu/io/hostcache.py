@@ -68,6 +68,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from nvme_strom_tpu.utils.lockwitness import make_lock, make_rlock
+from nvme_strom_tpu.io.tenants import current_tenant
 from nvme_strom_tpu.io.sched import CLASS_ORDER, DEFAULT_CLASS, \
     default_policies
 from nvme_strom_tpu.utils.config import HostCacheConfig
@@ -214,13 +215,19 @@ class _Line:
     """One resident cache line (a valid PREFIX of ``line_bytes``)."""
 
     __slots__ = ("key", "slot", "valid", "klass", "crc", "pins", "ref",
-                 "dead", "sticky", "hits")
+                 "dead", "sticky", "hits", "tenant")
 
     def __init__(self, key: LineKey, slot: int, klass: str):
         self.key = key
         self.slot = slot
         self.valid = 0        # valid bytes from the line start
         self.klass = klass
+        t = current_tenant()
+        #: owning tenant ID, stamped from the fill thread's tenant
+        #: scope (None outside any scope — the whole per-tenant quota
+        #: layer stays inert then); the line counts against this
+        #: owner's residency quota until it leaves the map
+        self.tenant = t.id if t is not None else None
         self.crc: Optional[int] = None
         self.pins = 0         # outstanding hit views
         self.ref = False      # second-chance bit
@@ -405,6 +412,14 @@ class HostCache:
         self._ghost_cap = max(self.capacity * ghost_factor, 16)
         self._clock: Dict[str, deque] = {k: deque() for k in quotas}
         self._class_slots: Dict[str, int] = {k: 0 for k in quotas}
+        # per-tenant residency (multi-tenant isolation, orthogonal to
+        # the class axis): resident slots per owning tenant id, and
+        # each tenant's declared quota fraction (0 = fair share, 1/N of
+        # the tenants seen).  Both stay empty — and every tenant branch
+        # below short-circuits — until a fill runs inside a tenant
+        # scope (STROM_TENANTS=1 serving traffic).
+        self._tenant_slots: Dict[str, int] = {}
+        self._tenant_quota_frac: Dict[str, float] = {}
         # per-LINE invalidation epoch: a fill whose admission verdict
         # predates a write OVERLAPPING THAT LINE is refused, so a read
         # racing a write can never install pre-write bytes — while
@@ -436,6 +451,7 @@ class HostCache:
                 "line_bytes": self.line_bytes,
                 "arena_locked": self.arena.locked,
                 "class_slots": dict(self._class_slots),
+                "tenant_slots": dict(self._tenant_slots),
             }
 
     def _klass(self, klass: Optional[str]) -> str:
@@ -701,6 +717,8 @@ class HostCache:
                 self._ghost.pop(key, None)
                 self._class_slots[kl] = self._class_slots.get(kl, 0) + 1
                 self._clock.setdefault(kl, deque()).append(key)
+                if line.tenant is not None:
+                    self._note_tenant_fill_locked(line, stats)
             if sticky:
                 line.sticky = True
             line.pins += 1              # copy in progress: unevictable
@@ -733,6 +751,95 @@ class HostCache:
         return self._class_slots.get(klass, 0) > \
             self.quota_slots.get(klass, 0.0)
 
+    # -- per-tenant residency quotas (multi-tenant isolation) --------------
+
+    def _note_tenant_fill_locked(self, line: _Line, stats) -> None:
+        """Charge a new line to its owner's residency count; landing
+        past the quota while free space existed is BORROWING (allowed,
+        counted — pressure reclaims it first)."""
+        tid = line.tenant
+        t = current_tenant()
+        if t is not None and t.id == tid:
+            self._tenant_quota_frac[tid] = t.quota_frac
+        else:
+            self._tenant_quota_frac.setdefault(tid, 0.0)
+        self._tenant_slots[tid] = self._tenant_slots.get(tid, 0) + 1
+        if self._tenant_over(tid) and stats is not None:
+            stats.add(tenant_borrows=1)
+            stats.add_tenant_stat(tid, borrows=1)
+
+    def _tenant_quota_slots(self, tid: str) -> float:
+        """One tenant's residency quota in slots: its declared fraction
+        of the arena, or — fraction 0 — a fair share (1/N of the
+        tenants currently resident)."""
+        frac = self._tenant_quota_frac.get(tid, 0.0)
+        if frac <= 0.0:
+            frac = 1.0 / max(1, len(self._tenant_slots))
+        return frac * self.capacity
+
+    def _tenant_over(self, tid: Optional[str]) -> bool:
+        if tid is None or not self._tenant_slots:
+            return False
+        return self._tenant_slots.get(tid, 0) > \
+            self._tenant_quota_slots(tid)
+
+    def _tenant_drop_locked(self, line: _Line) -> None:
+        """Refund a departing line's residency charge (lock held)."""
+        tid = line.tenant
+        if tid is None:
+            return
+        n = self._tenant_slots.get(tid, 0) - 1
+        if n > 0:
+            self._tenant_slots[tid] = n
+        else:
+            # last resident line gone: forget the tenant entirely so
+            # fair-share fractions track tenants actually resident
+            self._tenant_slots.pop(tid, None)
+            self._tenant_quota_frac.pop(tid, None)
+
+    def _tenant_evict_locked(self, stats) -> Optional[int]:
+        """Quota pre-pass: before any class pays, reclaim from the MOST
+        over-quota tenant (largest slot excess) — the borrowing that
+        storm bought is the first residency pressure takes back, so one
+        tenant's storm cannot evict another's hot set.  Prefers lines
+        the second-chance bit marks cold; sticky does not protect an
+        over-quota tenant's lines (mirroring the over-quota class
+        rule).  None when no tenant is over quota."""
+        over = [tid for tid in self._tenant_slots
+                if self._tenant_over(tid)]
+        if not over:
+            return None
+        over.sort(key=lambda tid: (self._tenant_slots.get(tid, 0)
+                                   - self._tenant_quota_slots(tid)),
+                  reverse=True)
+        for tid in over:
+            best = None
+            for line in self._lines.values():
+                if line.tenant != tid or line.pins > 0:
+                    continue
+                if not line.ref:
+                    best = line
+                    break
+                if best is None:
+                    best = line
+            if best is None:
+                continue                    # everything pinned: next
+            del self._lines[best.key]
+            self._class_slots[best.klass] -= 1
+            self.bytes_resident -= best.valid
+            self._tenant_drop_locked(best)
+            if stats is not None:
+                stats.add(cache_evictions=1, tenant_quota_evictions=1)
+                stats.add_tenant_stat(tid, quota_evictions=1)
+                if best.hits == 0 and best.valid:
+                    from nvme_strom_tpu.obs.ledger import charge_waste
+                    charge_waste(stats, "evicted_unused", best.valid)
+                stats.set_gauges(
+                    cache_bytes_resident=self.bytes_resident,
+                    cache_lines_resident=len(self._lines))
+            return best.slot
+        return None
+
     def _evict_one(self, incoming: str, stats) -> Optional[int]:
         """Reclaim one slot (lock held).  Candidate classes: over-quota
         first; then — when none is over quota OR every over-quota line
@@ -743,6 +850,12 @@ class HostCache:
         round of banking, lowest priority first) pick the payer; a
         second-chance clock inside the class picks the line, skipping
         pinned and recently-referenced lines."""
+        if self._tenant_slots:
+            # tenant-quota pre-pass: over-quota tenants' borrowing pays
+            # for pressure before any class-level candidate does
+            slot = self._tenant_evict_locked(stats)
+            if slot is not None:
+                return slot
         over = [k for k in self._rev_order
                 if self._over_quota(k) and self._clock.get(k)]
         every = [k for k in self._rev_order if self._clock.get(k)]
@@ -780,11 +893,13 @@ class HostCache:
             if line.pins > 0:
                 q.rotate(-1)
                 continue
-            if line.sticky and not self._over_quota(klass):
+            if line.sticky and not self._over_quota(klass) \
+                    and not self._tenant_over(line.tenant):
                 # hot-pinned within quota (docs/PERF.md §5): the decode
                 # class's KV-prefix residency survives bulk churn; an
-                # over-quota class's sticky lines pay normally, so the
-                # pin can never wedge the shared budget
+                # over-quota class's — or over-quota TENANT's — sticky
+                # lines pay normally, so the pin can never wedge the
+                # shared budget
                 q.rotate(-1)
                 continue
             if line.ref:
@@ -795,6 +910,7 @@ class HostCache:
             del self._lines[key]
             self._class_slots[klass] -= 1
             self.bytes_resident -= line.valid
+            self._tenant_drop_locked(line)
             if stats is not None:
                 stats.add(cache_evictions=1)
                 if line.hits == 0 and line.valid:
@@ -821,6 +937,7 @@ class HostCache:
         del self._lines[line.key]
         self._class_slots[line.klass] -= 1
         self.bytes_resident -= line.valid
+        self._tenant_drop_locked(line)
         if line.pins > 0:
             line.dead = True
         else:
@@ -889,6 +1006,8 @@ class HostCache:
         with self._lock:
             self._lines.clear()
             self._ghost.clear()
+            self._tenant_slots.clear()
+            self._tenant_quota_frac.clear()
             self.bytes_resident = 0
         self.arena.close()
 

@@ -61,6 +61,7 @@ from typing import Optional
 
 import numpy as np
 
+from nvme_strom_tpu.io.tenants import current_tenant
 from nvme_strom_tpu.utils.config import ResilientConfig
 from nvme_strom_tpu.utils.lockwitness import make_lock
 
@@ -329,6 +330,11 @@ class ResilientRead:
         eng.stats.add(hedges_issued=1)
         if self._klass:
             eng.stats.add_class_stat(self._klass, hedges_issued=1)
+        tenant = current_tenant()
+        if tenant is not None:
+            # hedges are real duplicate I/O on the shared device: the
+            # per-tenant ledger shows WHO is spending the budget
+            eng.stats.add_tenant_stat(tenant.id, hedges_issued=1)
         eng._trace("strom.resilient.hedge", time.monotonic_ns(),
                    ctx=self._ctx, fh=self._fh, offset=self._offset,
                    length=self._length)

@@ -70,6 +70,7 @@ from collections import deque
 from dataclasses import dataclass, replace
 from typing import Callable, Dict, List, Optional, Sequence
 
+from nvme_strom_tpu.io.tenants import current_tenant
 from nvme_strom_tpu.utils.lockwitness import make_condition, make_lock
 
 #: priority order, highest first — the serving decode path outranks
@@ -130,7 +131,7 @@ class _Batch:
     """One planned batch queued for a dispatch grant."""
 
     __slots__ = ("spans", "klass", "rounds", "granted", "ring",
-                 "promoted", "t_enq", "t_enq_ns", "ctx")
+                 "promoted", "t_enq", "t_enq_ns", "ctx", "tenant")
 
     def __init__(self, spans, klass: str, ctx=None):
         self.spans = spans
@@ -145,6 +146,10 @@ class _Batch:
         #: run on ANOTHER thread's dispatch round, so the queue-wait
         #: span carries its causal identity explicitly
         self.ctx = ctx
+        #: owning Tenant, captured from the tenant contextvar exactly
+        #: like the trace context (None outside any tenant scope — the
+        #: whole hierarchical layer below then stays inert)
+        self.tenant = current_tenant()
 
 
 class QoSScheduler:
@@ -185,6 +190,13 @@ class QoSScheduler:
                              key=lambda k: self.policies[k].priority)
         self._queues: Dict[str, deque] = {k: deque() for k in self._order}
         self._deficit: Dict[str, float] = {k: 0.0 for k in self._order}
+        # hierarchical fair-share inner level (class × tenant): per
+        # class, each tenant's accumulated grant cost (1/effective
+        # weight per grant — lowest bank serves next).  Empty, and the
+        # pick short-circuits to exact FIFO, until the first batch that
+        # actually carries a tenant flips _tenant_seen.
+        self._tenant_credit: Dict[str, Dict] = {}
+        self._tenant_seen = False
         self._granted_out: Dict[int, int] = {}  # ring -> spans granted,
         #                                         not yet engine-submitted
         self._closed = False
@@ -221,6 +233,8 @@ class QoSScheduler:
                 raise OSError(errno.ECANCELED,
                               "engine closing: scheduler shut down")
             self._queues[klass].append(b)
+            if b.tenant is not None:
+                self._tenant_seen = True
             self.enqueued += 1
             if self.stats is not None:
                 self.stats.add(sched_enqueued=1)
@@ -311,6 +325,51 @@ class QoSScheduler:
 
     # -- dispatch core -----------------------------------------------------
 
+    def _pick_index_locked(self, klass: str, q: deque) -> int:
+        """Hierarchical DRR, inner (tenant) level: among ONE class's
+        queued batches, grant the tenant with the lowest accumulated
+        cost bank next (each grant costs 1/effective_weight, so under
+        contention tenants split the class's grants by weight ratio —
+        the same deficit discipline the outer class level uses).  Ties
+        break FIFO; batches outside any tenant scope ride a pseudo
+        tenant of weight 1.  Returns the queue index to grant.  With no
+        tenant ever seen (STROM_TENANTS=0) this is index 0 — the exact
+        pre-tenant FIFO.  The aging pass never calls this: a batch past
+        the starvation bound outranks tenant fairness too, which is
+        precisely what keeps the proven bound intact at any weight."""
+        if not self._tenant_seen or len(q) <= 1:
+            return 0
+        credits = self._tenant_credit.setdefault(klass, {})
+        first: Dict = {}           # tenant id -> its oldest batch index
+        for i, b in enumerate(q):
+            tid = b.tenant.id if b.tenant is not None else None
+            if tid not in first:
+                first[tid] = i
+        for tid in list(credits):
+            if tid not in first:   # departed: a returning tenant must
+                del credits[tid]   # not owe (or own) history-old bank
+        pick = min(first,
+                   key=lambda tid: (credits.get(tid, 0.0), first[tid]))
+        return first[pick]
+
+    def _charge_tenant_locked(self, b: _Batch) -> None:
+        """Bank one grant's cost against the batch's tenant (called for
+        EVERY grant, aged promotions included, so the banks stay an
+        honest record of service consumed)."""
+        if not self._tenant_seen:
+            return
+        credits = self._tenant_credit.setdefault(b.klass, {})
+        tid = b.tenant.id if b.tenant is not None else None
+        w = b.tenant.effective_weight if b.tenant is not None else 1.0
+        credits[tid] = credits.get(tid, 0.0) + 1.0 / max(w, 1e-9)
+        if len(credits) > 1:
+            # floor-normalize so banks measure RELATIVE debt and never
+            # grow without bound over a long run
+            base = min(credits.values())
+            if base > 0:
+                for t in credits:
+                    credits[t] -= base
+
     def _drain_locked(self) -> None:
         while any(self._queues.values()):
             if not self._dispatch_round_locked():
@@ -341,6 +400,10 @@ class QoSScheduler:
         #    ring itself, which the bulk caps keep shallow)
         top_q = self._queues[self._order[0]]
         while top_q:
+            # tenant-fair grant ORDER (the ring each batch lands on and
+            # the class's unconditional admission are unchanged)
+            i = self._pick_index_locked(self._order[0], top_q)
+            b = top_q[i]
             # prefer the urgent ring (bulk avoids it, so it is almost
             # always shallow — landing decode anywhere else risks
             # queueing its small reads behind a bulk batch's service
@@ -349,9 +412,10 @@ class QoSScheduler:
             if slots[0] > 0:
                 r = 0
             else:
-                r = max(range(len(slots)), key=lambda i: slots[i])
-            slots[r] -= max(1, len(top_q[0].spans))
-            self._dispatch_one(top_q.popleft(), r)
+                r = max(range(len(slots)), key=lambda j: slots[j])
+            slots[r] -= max(1, len(b.spans))
+            del top_q[i]
+            self._dispatch_one(b, r)
             progress = True
         if not any(s > 0 for s in slots):
             return progress
@@ -410,10 +474,13 @@ class QoSScheduler:
             q = self._queues[klass]
             reserve = 0 if klass == top else bulk_reserve
             while q and self._deficit[klass] >= 1.0:
-                r = pick_ring(len(q[0].spans), reserve)
+                i = self._pick_index_locked(klass, q)
+                b = q[i]
+                r = pick_ring(len(b.spans), reserve)
                 if r is None:
                     break
-                self._dispatch_one(q.popleft(), r)
+                del q[i]
+                self._dispatch_one(b, r)
                 self._deficit[klass] -= 1.0
                 progress = True
             if not q:
@@ -441,6 +508,7 @@ class QoSScheduler:
         b.ring = ring
         b.promoted = promoted
         b.granted = True
+        self._charge_tenant_locked(b)
         self._granted_out[ring] = (self._granted_out.get(ring, 0)
                                    + max(1, len(b.spans)))
         self.dispatches += 1
@@ -461,4 +529,8 @@ class QoSScheduler:
                 b.klass, dispatches=1, spans=len(b.spans),
                 **({"promotions": 1} if promoted else {}))
             self.stats.class_stat_gauges(b.klass, queue_wait_s=wait_s)
+            if b.tenant is not None:
+                self.stats.add_tenant_stat(
+                    b.tenant.id, dispatches=1, spans=len(b.spans),
+                    **({"promotions": 1} if promoted else {}))
         self._cv.notify_all()

@@ -1,0 +1,320 @@
+"""Tenant identity and isolation primitives — fleet-grade multi-tenancy.
+
+PRs 7-10 built single-process QoS (latency classes, host-tier quotas,
+failure domains) for ONE well-behaved client.  Production serving is
+many tenants on one box: a single tenant's prompt storm used to evict
+every other tenant's hot cache lines, flood the shared admission queue,
+and drag every tenant's p99 down together.  This module carries the
+identity that makes isolation enforceable:
+
+  Tenant        descriptor (id, SLO tier, fair-share weight, residency
+                quota fraction, admission rate) every serving request
+                and I/O batch can carry.
+  TIER_ORDER    SLO tiers, best first — ``gold`` > ``silver`` >
+                ``bronze``.  Under overload the admission path sheds
+                worst tier first (models/serving.py), so a bronze storm
+                defers while gold admits.
+  tenant_context / current_tenant
+                contextvar propagation: the serving layer enters a
+                request's tenant scope once and every layer below —
+                the QoS scheduler (io/sched.py stamps batches at
+                enqueue), the host cache (io/hostcache.py stamps lines
+                at fill), the KV prefix store (models/kv_offload.py
+                stamps pages at put) — reads it without signature
+                changes, exactly like trace contexts.
+  TenantRegistry
+                the process's tenant table, parsed from
+                ``STROM_TENANT_SPEC`` and extended on first sight of an
+                unknown id with the ``STROM_TENANT_*`` defaults.  Reads
+                are lock-free dict lookups (the serving hot path);
+                only registration mutates under the lock.
+  TokenBucket   per-tenant admission rate limiting (tokens/s + burst,
+                injectable clock so tests drive time).
+
+Everything is inert while ``STROM_TENANTS=0`` (the default): the
+serving layer never enters a tenant scope, ``current_tenant()`` stays
+None everywhere, and every consumer's tenant branch short-circuits to
+the exact pre-tenant code path (tests/test_tenants.py proves
+bit-for-bit equality).  Semantics: docs/RESILIENCE.md "Multi-tenant
+isolation".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from contextvars import ContextVar
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from nvme_strom_tpu.utils.lockwitness import make_lock
+
+#: SLO tiers, best first — admission sheds from the BACK of this list
+#: under overload (docs/RESILIENCE.md "Multi-tenant isolation")
+TIER_ORDER = ("gold", "silver", "bronze")
+
+#: tier of a tenant that never declared one
+DEFAULT_TIER = "silver"
+
+
+def tier_rank(tier: str) -> int:
+    """Position in TIER_ORDER (lower = better); unknown tiers rank
+    worst so a typo can never outrank a declared gold tenant."""
+    try:
+        return TIER_ORDER.index(tier)
+    except ValueError:
+        return len(TIER_ORDER)
+
+
+@dataclass
+class Tenant:
+    """One tenant's isolation policy (mutable: the SLO governor adjusts
+    ``share_boost`` at runtime; everything else is configuration).
+
+    ``weight``      hierarchical fair-share weight inside each QoS
+                    class (io/sched.py): under contention tenants split
+                    a class's grants by weight ratio; the aging bound
+                    still guarantees no batch starves at any weight.
+    ``quota_frac``  residency quota as a fraction of the host-cache
+                    arena / KV prefix store (0 = fair share, 1/N of the
+                    tenants seen).  Borrowing free space past the quota
+                    is allowed; pressure reclaims over-quota tenants
+                    first, so a storm pays for itself.
+    ``rate``/``burst``
+                    admission token bucket (requests/s, burst depth);
+                    rate 0 = unlimited.
+    ``slo_p99_ms``  per-tenant decode TTFT p99 target; violations boost
+                    only THIS tenant's scheduler share (``share_boost``
+                    notches), never the device-global hedge budget.
+    """
+
+    id: str
+    tier: str = DEFAULT_TIER
+    weight: float = 1.0
+    quota_frac: float = 0.0
+    rate: float = 0.0
+    burst: float = 0.0
+    slo_p99_ms: float = 0.0
+    share_boost: int = 0
+
+    def __post_init__(self):
+        if not self.id:
+            raise ValueError("tenant id must be non-empty")
+        if self.weight <= 0:
+            raise ValueError(
+                f"tenant {self.id!r}: weight ({self.weight}) must be "
+                f"> 0 (the aging bound protects weight-1 tenants; 0 "
+                f"would starve without it)")
+        if not 0.0 <= self.quota_frac <= 1.0:
+            raise ValueError(
+                f"tenant {self.id!r}: quota_frac ({self.quota_frac}) "
+                f"must be in [0, 1]")
+        if self.rate < 0 or self.burst < 0:
+            raise ValueError(
+                f"tenant {self.id!r}: rate/burst must be >= 0")
+        if self.slo_p99_ms < 0:
+            raise ValueError(
+                f"tenant {self.id!r}: slo_p99_ms must be >= 0")
+
+    @property
+    def effective_weight(self) -> float:
+        """Fair-share weight including the SLO governor's boost."""
+        return self.weight * (1 + self.share_boost)
+
+
+def parse_tenant_spec(spec: str) -> Dict[str, Tenant]:
+    """Parse ``STROM_TENANT_SPEC``: ``;``-separated tenants, each
+    ``<id>[:key=value,...]`` with keys ``tier``/``weight``/``quota``/
+    ``rate``/``burst``/``slo_ms``.  Example::
+
+        gold_t:tier=gold,weight=8,quota=0.5,slo_ms=50;batch:tier=bronze,weight=1,rate=10
+
+    Raises ValueError on malformed entries (config-time, loudly)."""
+    out: Dict[str, Tenant] = {}
+    for part in filter(None, (s.strip() for s in spec.split(";"))):
+        tid, _colon, body = part.partition(":")
+        tid = tid.strip()
+        kw: Dict[str, object] = {}
+        for item in filter(None, (s.strip() for s in body.split(","))):
+            key, eq, val = item.partition("=")
+            key = key.strip()
+            if not eq:
+                raise ValueError(
+                    f"STROM_TENANT_SPEC entry {item!r}: expected "
+                    f"key=value")
+            if key == "tier":
+                if val not in TIER_ORDER:
+                    raise ValueError(
+                        f"STROM_TENANT_SPEC tenant {tid!r}: tier "
+                        f"{val!r} not in {TIER_ORDER}")
+                kw["tier"] = val
+            elif key in ("weight", "quota", "rate", "burst", "slo_ms"):
+                field = {"quota": "quota_frac",
+                         "slo_ms": "slo_p99_ms"}.get(key, key)
+                kw[field] = float(val)
+            else:
+                raise ValueError(
+                    f"STROM_TENANT_SPEC tenant {tid!r}: unknown key "
+                    f"{key!r}")
+        if tid in out:
+            raise ValueError(
+                f"STROM_TENANT_SPEC: duplicate tenant id {tid!r}")
+        out[tid] = Tenant(tid, **kw)   # Tenant validates
+    return out
+
+
+class TokenBucket:
+    """Admission rate limiter: ``rate`` tokens/s refill up to ``burst``.
+
+    ``rate <= 0`` means unlimited (every take succeeds).  ``clock`` is
+    injectable so tests drive time deterministically.  Not thread-safe
+    by itself — the serving loop takes from ONE thread; the registry
+    lock covers creation only."""
+
+    __slots__ = ("rate", "burst", "_tokens", "_t", "_clock")
+
+    def __init__(self, rate: float, burst: float, clock=None):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        self._clock = clock if clock is not None else time.monotonic
+        self._tokens = self.burst
+        self._t = self._clock()
+
+    def try_take(self, n: float = 1.0) -> bool:
+        if self.rate <= 0:
+            return True
+        now = self._clock()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t) * self.rate)
+        self._t = now
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+
+class TenantRegistry:
+    """The process's tenant table.
+
+    ``get(id)`` is the hot path: a lock-free dict read (dict access is
+    atomic under the GIL; the dict is replaced, never mutated in place,
+    on registration) — the serving loop resolves a tenant per submit
+    and the scheduler reads ``effective_weight`` per grant, neither may
+    contend.  Unknown ids register on first sight with the
+    ``STROM_TENANT_*`` default rate/burst/quota, so a replayed trace
+    with thousands of tenant ids never needs a spec entry each."""
+
+    def __init__(self, config=None):
+        if config is None:
+            from nvme_strom_tpu.utils.config import TenantConfig
+            config = TenantConfig()
+        self.config = config
+        self._lock = make_lock("tenants.TenantRegistry._lock")
+        self._tenants: Dict[str, Tenant] = dict(
+            parse_tenant_spec(config.spec))
+
+    @property
+    def enabled(self) -> bool:
+        return self.config.enabled
+
+    def get(self, tid) -> Tenant:
+        """Resolve (and lazily register) a tenant by id; a Tenant
+        passes through so callers accept either form."""
+        if isinstance(tid, Tenant):
+            return tid
+        tid = str(tid)
+        t = self._tenants.get(tid)          # lock-free fast path
+        if t is not None:
+            return t
+        with self._lock:
+            t = self._tenants.get(tid)
+            if t is None:
+                cfg = self.config
+                t = Tenant(tid, rate=cfg.default_rate,
+                           burst=cfg.default_burst,
+                           quota_frac=cfg.default_quota_frac)
+                # replace, never mutate: readers hold no lock
+                nxt = dict(self._tenants)
+                nxt[tid] = t
+                self._tenants = nxt
+            return t
+
+    def lookup(self, tid: str) -> Optional[Tenant]:
+        """Read-only resolve: None for unknown ids (no registration)."""
+        return self._tenants.get(str(tid))
+
+    def tenants(self) -> List[Tenant]:
+        return list(self._tenants.values())
+
+
+# ---------------------------------------------------------------------------
+# contextvar propagation (the trace-context pattern)
+# ---------------------------------------------------------------------------
+
+_current: ContextVar[Optional[Tenant]] = ContextVar(
+    "strom_tenant", default=None)
+
+
+def current_tenant() -> Optional[Tenant]:
+    """The tenant the running code is working for (None outside any
+    tenant scope — every consumer's None branch is the exact pre-tenant
+    code path)."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def tenant_context(tenant: Optional[Tenant]):
+    """Enter ``tenant``'s scope: batches the QoS scheduler enqueues,
+    lines the host cache fills, and pages the prefix store puts inside
+    the scope are attributed (and quota-charged) to it."""
+    token = _current.set(tenant)
+    try:
+        yield tenant
+    finally:
+        _current.reset(token)
+
+
+# ---------------------------------------------------------------------------
+# module singleton — ONE registry per process (mirrors hostcache's)
+# ---------------------------------------------------------------------------
+
+_registry: Optional[TenantRegistry] = None
+_registry_lock = make_lock("tenants._registry_lock")
+
+
+def get_registry() -> TenantRegistry:
+    """The process-wide registry, built from the environment on first
+    use (``configure`` overrides; ``reset`` drops it)."""
+    global _registry
+    r = _registry
+    if r is not None:
+        return r
+    with _registry_lock:
+        if _registry is None:
+            _registry = TenantRegistry()
+        return _registry
+
+
+def configure(config) -> TenantRegistry:
+    """Install a registry built from an explicit TenantConfig
+    (tests/bench); returns it."""
+    global _registry
+    with _registry_lock:
+        _registry = TenantRegistry(config)
+        return _registry
+
+
+def reset() -> None:
+    """Drop the singleton (tests) — the next get_registry() re-reads
+    the environment."""
+    global _registry
+    with _registry_lock:
+        _registry = None
+
+
+def tenants_enabled() -> bool:
+    """Master gate: True only when STROM_TENANTS=1 (or an explicit
+    configure() with enabled=True).  EVERY entry point that would set a
+    tenant scope checks this first, so the default-off stack never sees
+    a tenant anywhere."""
+    return get_registry().enabled

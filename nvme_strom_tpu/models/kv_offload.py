@@ -838,6 +838,8 @@ class SloGovernor:
         self._base_budget: Optional[int] = None
         self._base_weight: Optional[float] = None
         self._last = 0.0
+        # per-tenant rate-limit clocks (observe_tenant)
+        self._tenant_last: Dict[str, float] = {}
 
     def observe(self, engine, p99_ms: Optional[float], stats=None) -> None:
         """Feed one restore-p99 sample; applies/decays the boost."""
@@ -892,6 +894,49 @@ class SloGovernor:
                             extra={"p99_ms": p99_ms,
                                    "target_ms": self.target_ms,
                                    "boost": self.boost})
+
+    def observe_tenant(self, engine, tenant, p99_ms, stats=None) -> None:
+        """Per-tenant SLO lane (multi-tenant isolation): feed one
+        tenant's decode-latency p99 against ITS declared target
+        (``Tenant.slo_p99_ms``).  A violation boosts only that tenant's
+        fair-share weight (``share_boost`` notches, read live by the
+        scheduler's hierarchical pick) — NEVER the device-global hedge
+        budget: hedges double real I/O on a device every tenant
+        shares, so one tenant's bad p99 must not buy it the right to
+        press more load into everyone's SSD.  Same bound, decay, and
+        rate limit as the device-level lane; same supervisor gate."""
+        import time
+        if tenant is None or tenant.slo_p99_ms <= 0 or not p99_ms:
+            return
+        now = time.monotonic()
+        if now - self._tenant_last.get(tenant.id, 0.0) \
+                < self._MIN_INTERVAL_S:
+            return
+        step = 0
+        if (p99_ms > tenant.slo_p99_ms
+                and tenant.share_boost < self._MAX_BOOST):
+            step = 1
+        elif p99_ms < 0.5 * tenant.slo_p99_ms and tenant.share_boost > 0:
+            step = -1
+        if step == 0:
+            return
+        sup = getattr(engine, "supervisor", None)
+        if step > 0 and sup is not None and sup.unhealthy():
+            # a sick device, not a scheduling problem (see observe)
+            return
+        self._tenant_last[tenant.id] = now
+        tenant.share_boost += step
+        if step > 0:
+            if stats is not None:
+                stats.add(tenant_slo_boosts=1)
+                stats.add_tenant_stat(tenant.id, slo_boosts=1)
+            flight = getattr(engine, "flight", None)
+            if flight is not None:
+                flight.dump("slo_violation",
+                            extra={"tenant": tenant.id,
+                                   "p99_ms": p99_ms,
+                                   "target_ms": tenant.slo_p99_ms,
+                                   "share_boost": tenant.share_boost})
 
 
 class PrefixStore:
@@ -1002,6 +1047,10 @@ class PrefixStore:
         #: the engine's native histogram; utils/stats percentile walk)
         self._restore_hist = [0] * 40
         self._man_last = 0.0          # throttled manifest-save clock
+        #: tenant id -> declared residency quota fraction, registered
+        #: as puts run inside tenant scopes (multi-tenant isolation;
+        #: empty — and eviction tenant-blind — until one does)
+        self._tenant_quota_frac: Dict[str, float] = {}
         self.slo = SloGovernor(p99_target_ms)
         from nvme_strom_tpu.utils.checksum import VerifyPolicy
         self._verify = VerifyPolicy()
@@ -1419,9 +1468,18 @@ class PrefixStore:
                     if slot is None:
                         continue   # everything pinned: skip, not fail
                 self._seq += 1
+                from nvme_strom_tpu.io.tenants import current_tenant
+                t = current_tenant()
+                if t is not None:
+                    self._tenant_quota_frac[t.id] = t.quota_frac
+                # pages are charged to the tenant whose admission
+                # computed them (pins included — an in-flight restore
+                # still counts against its owner)
                 self._entries[kx] = {"page": slot, "hits": 0,
                                      "seq": self._seq, "crc": None,
-                                     "pins": 0, "ready": False}
+                                     "pins": 0, "ready": False,
+                                     "tenant": (t.id if t is not None
+                                                else None)}
             host = np.empty(self.page_bytes, np.uint8)
             half = self.page_bytes // 2
             host[:half] = np.ascontiguousarray(
@@ -1479,21 +1537,56 @@ class PrefixStore:
         but the formula stays literal so variable-size layouts inherit
         the right policy."""
         cost = self._restore_cost_ms()
-        victim_key = None
-        victim_score = None
-        for kx, e in self._entries.items():
-            if e["pins"] > 0 or not e["ready"]:
-                continue   # in-flight restore or a put still writing
-            score = (e["hits"] * cost, e["seq"])
-            if victim_score is None or score < victim_score:
-                victim_score = score
-                victim_key = kx
-        if victim_key is None:
-            return None
-        e = self._entries.pop(victim_key)
-        if self.stats is not None:
-            self.stats.add(kv_store_evictions=1)
-        return e["page"]
+        # tenant-quota pre-pass (multi-tenant isolation): when any
+        # tenant holds more pages than its quota fraction allows, the
+        # victim scan restricts to THOSE tenants' pages first — one
+        # tenant's prompt storm reclaims its own borrowing before it
+        # can touch another tenant's hot prefixes.  Pinned pages count
+        # against their owner but are never reclaimed.
+        over = self._tenant_over_locked() if self._tenant_quota_frac \
+            else None
+        for restrict in ((over, None) if over else (None,)):
+            victim_key = None
+            victim_score = None
+            for kx, e in self._entries.items():
+                if e["pins"] > 0 or not e["ready"]:
+                    continue   # in-flight restore or a put still writing
+                if restrict is not None \
+                        and e.get("tenant") not in restrict:
+                    continue
+                score = (e["hits"] * cost, e["seq"])
+                if victim_score is None or score < victim_score:
+                    victim_score = score
+                    victim_key = kx
+            if victim_key is None:
+                continue
+            e = self._entries.pop(victim_key)
+            if self.stats is not None:
+                self.stats.add(kv_store_evictions=1)
+                if restrict is not None:
+                    self.stats.add(tenant_quota_evictions=1)
+                    self.stats.add_tenant_stat(e.get("tenant"),
+                                               quota_evictions=1)
+            return e["page"]
+        return None
+
+    def _tenant_over_locked(self) -> set:
+        """Tenant ids holding more resident pages than their quota
+        fraction of the store allows (lock held; fraction 0 = fair
+        share, 1/N of the tenants resident)."""
+        counts: Dict[str, int] = {}
+        for e in self._entries.values():
+            tid = e.get("tenant")
+            if tid is not None:
+                counts[tid] = counts.get(tid, 0) + 1
+        over = set()
+        for tid, n in counts.items():
+            frac = self._tenant_quota_frac.get(tid, 0.0)
+            if frac <= 0.0:
+                frac = 1.0 / max(1, len(counts))
+            if n > frac * self.capacity_pages:
+                over.add(tid)
+        return over
 
     # -- durable manifest (the scrub contract) -----------------------------
 

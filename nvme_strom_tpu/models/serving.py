@@ -28,6 +28,7 @@ tests/test_serving.py); sampled requests are reproducible per
 from __future__ import annotations
 
 import functools
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -35,6 +36,8 @@ from typing import Dict, List, Optional
 import jax
 import jax.numpy as jnp
 
+from nvme_strom_tpu.io.tenants import (
+    TokenBucket, tenant_context, tenants_enabled, tier_rank)
 from nvme_strom_tpu.models import decode as _dec
 from nvme_strom_tpu.models.decode import _mlp_block
 from nvme_strom_tpu.models.transformer import (
@@ -67,6 +70,9 @@ class _Request:
     # correlate under its trace_id
     trace: object = None
     t_submit_ns: int = 0
+    # resolved io/tenants.Tenant — None while STROM_TENANTS=0 (every
+    # tenant branch below short-circuits to the pre-tenant path)
+    tenant: object = None
 
 
 @jax.jit
@@ -309,6 +315,23 @@ class DecodeServer:
         self.request_metrics: Dict[object, Dict[str, float]] = {}
         self._metrics_agg = {"n": 0, "ttft_sum": 0.0, "ttft_max": 0.0,
                              "wait_sum": 0.0, "wait_max": 0.0}
+        #: retained per-request metric entries (STROM_SERVE_METRICS_MAX;
+        #: generous default — entries are two floats, but an unbounded
+        #: dict on a long-lived server is still a leak)
+        self._metrics_keep = int(os.environ.get(
+            "STROM_SERVE_METRICS_MAX", str(self._METRICS_KEEP)))
+        # multi-tenant admission state (docs/RESILIENCE.md "Multi-tenant
+        # isolation") — all empty until a tenant-tagged request arrives,
+        # so the single-tenant stack never pays for any of it
+        self._tenant_cfg = None           # utils.config.TenantConfig
+        self._buckets: Dict[str, TokenBucket] = {}
+        #: cumulative per-tenant sheds (stats())
+        self.tenant_sheds: Dict[str, int] = {}
+        #: sheds since the last tenant_storm flight dump, per tenant
+        self._storm_window: Dict[str, int] = {}
+        #: recent decode TTFTs per tenant (the per-tenant SLO lane's
+        #: p99 window, fed to SloGovernor.observe_tenant at retire)
+        self._tenant_ttft: Dict[str, List[float]] = {}
         self._alloc_storage()
 
     def _alloc_storage(self) -> None:
@@ -323,7 +346,7 @@ class DecodeServer:
     def submit(self, rid, prompt_ids: List[int], max_new: int,
                eos_id: Optional[int] = None,
                temperature: float = 0.0, top_p: float = 1.0,
-               seed: int = 0) -> None:
+               seed: int = 0, tenant=None) -> None:
         if not prompt_ids:
             raise ValueError("empty prompt")
         if max_new < 1:
@@ -347,6 +370,12 @@ class DecodeServer:
                        top_p=top_p,
                        seed=seed & 0xFFFFFFFF,
                        t_submit=time.monotonic())
+        if tenant is not None and tenants_enabled():
+            # resolve (and lazily register) the tenant ONCE at submit;
+            # with STROM_TENANTS=0 the tag is ignored and the request
+            # walks the exact pre-tenant path
+            from nvme_strom_tpu.io.tenants import get_registry
+            req.tenant = get_registry().get(tenant)
         tracer = self._tracer()
         if tracer is not None:
             from nvme_strom_tpu.utils.trace import TraceContext
@@ -386,7 +415,18 @@ class DecodeServer:
         """``_admit_finish`` under the request's trace scope: the
         admission span (prefill + scatter) lands in the request's tree,
         and everything the finish triggers — store puts, engine writes
-        — auto-parents to it via the contextvar."""
+        — auto-parents to it via the contextvar.  A tenant-tagged
+        request additionally finishes under its TENANT scope, so the
+        host-cache lines the prefill touches and the store pages the
+        put writes are quota-charged to their owner (io/tenants.py)."""
+        req = plan["req"]
+        if req.tenant is not None:
+            with tenant_context(req.tenant):
+                self._finish_traced_inner(plan, restored)
+        else:
+            self._finish_traced_inner(plan, restored)
+
+    def _finish_traced_inner(self, plan: dict, restored: dict) -> None:
         tracer = self._tracer()
         req = plan["req"]
         if tracer is None or req.trace is None:
@@ -452,20 +492,28 @@ class DecodeServer:
             store.stats.add(kv_prefix_misses=misses)
         if not wants:
             return {}
+        by_slot = {p["slot"]: p["req"] for p in plans}
+        # tenant scope mirrors the trace scope below: the FIRST
+        # participating tenant owns the batched restore (exact for the
+        # single-request step; a mixed batch is one shared read either
+        # way), so the decode-class batch and the host-cache lines it
+        # fills are quota-charged to an owner instead of nobody
+        ten = next((by_slot[s].tenant for s in wants
+                    if by_slot[s].tenant is not None), None)
         tracer = self._tracer()
         if tracer is None:
-            return store.restore_many(wants)
+            with tenant_context(ten):
+                return store.restore_many(wants)
         # ONE batched restore serves several admitting requests: scope
         # it under the FIRST participating request's tree (the single-
         # request case — the acceptance walkthrough — is exact) and
         # name every trace id so a multi-request step stays attributable
         from nvme_strom_tpu.utils.trace import use_context
-        by_slot = {p["slot"]: p["req"] for p in plans}
         traced = [by_slot[s].trace for s in wants
                   if by_slot[s].trace is not None]
         ctx = traced[0].child() if traced else None
         t0 = time.monotonic_ns()
-        with use_context(ctx):
+        with use_context(ctx), tenant_context(ten):
             restored = store.restore_many(wants)
         tracer.add_span(
             "strom.serve.kv_restore", t0, time.monotonic_ns(),
@@ -632,7 +680,11 @@ class DecodeServer:
             return req.rid, req.out
         return None
 
-    _METRICS_KEEP = 512
+    #: default per-request metric retention — generous (entries are a
+    #: few floats) but BOUNDED: a long-lived server retiring millions
+    #: of requests must not grow ``request_metrics`` without limit.
+    #: ``STROM_SERVE_METRICS_MAX`` overrides per process.
+    _METRICS_KEEP = 4096
 
     def _record_metrics(self, req: _Request) -> None:
         """Retire-time serving metrics: TTFT (submit → first token
@@ -666,7 +718,7 @@ class DecodeServer:
         self.request_metrics[req.rid] = {
             "ttft_ms": round(ttft_ms, 3),
             "admit_wait_ms": round(wait_ms, 3)}
-        while len(self.request_metrics) > self._METRICS_KEEP:
+        while len(self.request_metrics) > self._metrics_keep:
             self.request_metrics.pop(next(iter(self.request_metrics)))
         agg = self._metrics_agg
         agg["n"] += 1
@@ -674,6 +726,36 @@ class DecodeServer:
         agg["ttft_max"] = max(agg["ttft_max"], ttft_ms)
         agg["wait_sum"] += wait_ms
         agg["wait_max"] = max(agg["wait_max"], wait_ms)
+        if req.tenant is not None:
+            self._observe_tenant_ttft(req.tenant, ttft_ms)
+
+    #: TTFT samples kept per tenant for the p99 window, and the fill
+    #: level before the window is trusted to call a violation
+    _TENANT_TTFT_WIN = 64
+    _TENANT_TTFT_MIN = 8
+
+    def _observe_tenant_ttft(self, tenant, ttft_ms: float) -> None:
+        """Feed the per-tenant SLO lane: a sliding TTFT window per
+        tenant; once warm, its p99 goes to the store's SloGovernor,
+        which may notch the tenant's fair-share boost (never the
+        hedge budget — kv_offload.observe_tenant)."""
+        win = self._tenant_ttft.setdefault(tenant.id, [])
+        win.append(ttft_ms)
+        if len(win) > self._TENANT_TTFT_WIN:
+            del win[0]
+        stats = self._engine_stats()
+        if stats is not None:
+            stats.add_tenant_stat(tenant.id, requests_finished=1)
+        if (tenant.slo_p99_ms <= 0 or self.kv_store is None
+                or len(win) < self._TENANT_TTFT_MIN):
+            return
+        slo = getattr(self.kv_store, "slo", None)
+        if slo is None:
+            return
+        w = sorted(win)
+        p99 = w[min(len(w) - 1, int(0.99 * len(w)))]
+        slo.observe_tenant(getattr(self.kv_store, "engine", None),
+                           tenant, p99, stats=stats)
 
     # -- serving ----------------------------------------------------------
 
@@ -689,7 +771,7 @@ class DecodeServer:
         ``request_metrics``)."""
         agg = self._metrics_agg
         n = agg["n"]
-        return {
+        out = {
             "slots_total": self.B,
             "slots_busy": sum(r is not None for r in self.slots),
             "queued": len(self.queue),
@@ -703,6 +785,9 @@ class DecodeServer:
             "admit_wait_ms_max": round(agg["wait_max"], 3),
             "admissions_shed": self.admissions_shed,
         }
+        if self.tenant_sheds:     # key appears only once tenancy acted
+            out["tenant_sheds"] = dict(self.tenant_sheds)
+        return out
 
     def _can_admit(self, req: _Request) -> bool:
         return True            # dense slots carry their own reservation
@@ -724,13 +809,131 @@ class DecodeServer:
         sup.tick()
         return bool(sup.degraded())
 
+    def _engine_stats(self):
+        """The shared StatCounters behind the KV store's engine (None
+        without a store — serving counters then live on the server)."""
+        store = self.kv_store
+        return (getattr(getattr(store, "engine", None), "stats", None)
+                if store is not None else None)
+
     def _note_shed(self, n: int) -> None:
         self.admissions_shed += n
-        store = self.kv_store
-        stats = getattr(getattr(store, "engine", None), "stats",
-                        None) if store is not None else None
+        stats = self._engine_stats()
         if stats is not None:
             stats.add(serve_admissions_shed=n)
+
+    # -- multi-tenant admission (docs/RESILIENCE.md) ----------------------
+
+    def _tenant_config(self):
+        if self._tenant_cfg is None:
+            # the registry's config, not a fresh env read: an explicit
+            # tenants.configure() (tests/bench) must govern here too
+            from nvme_strom_tpu.io.tenants import get_registry
+            self._tenant_cfg = get_registry().config
+        return self._tenant_cfg
+
+    def _bucket(self, tenant) -> TokenBucket:
+        """The tenant's admission token bucket, built on first sight
+        from its own rate/burst (spec) or the STROM_TENANT_* defaults."""
+        b = self._buckets.get(tenant.id)
+        if b is None:
+            cfg = self._tenant_config()
+            rate = tenant.rate if tenant.rate > 0 else cfg.default_rate
+            burst = (tenant.burst if tenant.burst > 0
+                     else cfg.default_burst)
+            b = TokenBucket(rate, burst)
+            self._buckets[tenant.id] = b
+        return b
+
+    def _admit_tenants(self) -> list:
+        """Tier-aware admission: under backlog pressure (more queued
+        than free slots) only the BEST SLO tier present may admit this
+        step — worse tiers are shed (they stay queued, re-checked next
+        step, exactly the degraded-defer semantics) and counted per
+        tenant.  Each admission also spends a token from its tenant's
+        bucket; an empty bucket sheds that request without blocking the
+        tenants behind it.  Within the admissible set the queue stays
+        strict FIFO, and a ``_can_admit`` refusal still STOPS the scan
+        — the paged server's no-starvation order is unchanged."""
+        free = sum(s is None for s in self.slots)
+        plans: list = []
+        if not free:
+            return plans
+        pressure = len(self.queue) > free
+        best = None
+        if pressure:
+            best = min(tier_rank(r.tenant.tier) for r in self.queue
+                       if r.tenant is not None)
+        shed: Dict[str, int] = {}
+        slots = iter([s for s in range(self.B)
+                      if self.slots[s] is None])
+        i = 0
+        while free and i < len(self.queue):
+            req = self.queue[i]
+            t = req.tenant
+            if t is not None:
+                if pressure and tier_rank(t.tier) > best:
+                    shed[t.id] = shed.get(t.id, 0) + 1
+                    i += 1
+                    continue
+                if not self._bucket(t).try_take():
+                    shed[t.id] = shed.get(t.id, 0) + 1
+                    i += 1
+                    continue
+            if not self._can_admit(req):
+                break
+            plans.append(self._admit_plan(next(slots),
+                                          self.queue.pop(i)))
+            free -= 1
+        if shed:
+            self._note_tenant_shed(shed)
+        return plans
+
+    def _note_tenant_shed(self, shed: Dict[str, int]) -> None:
+        """Account one step's tenant sheds: server + engine counters,
+        the per-tenant breakdown, and the storm trigger's window."""
+        n = sum(shed.values())
+        self.admissions_shed += n
+        stats = self._engine_stats()
+        if stats is not None:
+            stats.add(tenant_admissions_shed=n)
+        for tid, k in shed.items():
+            self.tenant_sheds[tid] = self.tenant_sheds.get(tid, 0) + k
+            self._storm_window[tid] = (self._storm_window.get(tid, 0)
+                                       + k)
+            if stats is not None:
+                stats.add_tenant_stat(tid, admissions_shed=k)
+        self._maybe_storm_dump(stats)
+
+    def _maybe_storm_dump(self, stats) -> None:
+        """Flight-record a misbehaving tenant: once a tenant's sheds
+        since the last dump cross ``STROM_TENANT_STORM_SHEDS``, capture
+        the op ring under ``reason=tenant_storm`` with the per-tenant
+        breakdown — the post-mortem wants WHO stormed and who paid,
+        not just that p99 moved.  Per-reason rate limiting inside
+        flightrec keeps a sustained storm from spamming dumps."""
+        thresh = self._tenant_config().storm_sheds
+        hot = [t for t, k in self._storm_window.items() if k >= thresh]
+        if not hot:
+            return
+        for tid in hot:
+            self._storm_window[tid] = 0
+        store = self.kv_store
+        flight = (getattr(getattr(store, "engine", None), "flight",
+                          None) if store is not None else None)
+        if flight is None:
+            return
+        path = flight.dump("tenant_storm",
+                           extra={"tenants": hot,
+                                  "sheds": dict(self.tenant_sheds),
+                                  "queued": len(self.queue)})
+        # count only PUBLISHED dumps: a sustained storm re-arms the
+        # window every few steps, but per-reason rate limiting inside
+        # flightrec swallows most of those triggers
+        if path is not None and stats is not None:
+            stats.add(tenant_storm_dumps=1)
+            for tid in hot:
+                stats.add_tenant_stat(tid, storm_dumps=1)
 
     def _run_step(self):
         """Storage-specific batched step → next-token device array."""
@@ -792,6 +995,12 @@ class DecodeServer:
         if self.queue and self._shed_now():
             self._note_shed(min(sum(s is None for s in self.slots),
                                 len(self.queue)))
+        elif any(r.tenant is not None for r in self.queue):
+            # at least one queued request carries a tenant: tier-aware
+            # admission (sheds by tier under pressure, token buckets);
+            # an all-untagged queue — STROM_TENANTS=0 always — never
+            # reaches this branch and runs the loop below verbatim
+            plans = self._admit_tenants()
         else:
             for slot in range(self.B):
                 if (self.slots[slot] is None and self.queue

@@ -115,6 +115,11 @@ CONTRACT = {
     # read-all arm (the N·T→T flash reduction in the tag is the
     # claim; emulated mesh on CPU fallback, so no ratio bar)
     21: ("scatter-restore", "attr"),
+    # multi-tenant isolation storm pairs with its own same-run
+    # no-aggressor and tier-off arms (the victim-p99 containment and
+    # aggressor-only sheds in the tag are the claim, alternating
+    # trials with medians) — an attribution row, no ratio bar
+    22: ("tenant-isolation-storm", "attr"),
 }
 
 #: the ONE validity rule set, shared with the watcher's coverage

@@ -126,6 +126,15 @@ _ICI_COUNTERS = (
     "ici_bytes_read", "ici_bytes_received", "ici_fallbacks",
 )
 
+#: multi-tenant isolation counters (io/tenants.py carried through
+#: serving admission, hostcache/KV quotas, and the per-tenant SLO lane
+#: — docs/RESILIENCE.md "Multi-tenant isolation"); own block with the
+#: per-tenant breakdown, shown only when tenancy ever acted
+_TENANT_COUNTERS = (
+    "tenant_admissions_shed", "tenant_quota_evictions",
+    "tenant_borrows", "tenant_slo_boosts", "tenant_storm_dumps",
+)
+
 #: every counter block above, in render order — the counter-drift CI
 #: check (tests/test_observability.py) asserts the union covers ALL of
 #: StromStats.COUNTER_FIELDS, so a new counter cannot silently vanish
@@ -134,7 +143,7 @@ ALL_COUNTER_BLOCKS = (
     _COUNTERS, _RESILIENCE_COUNTERS, _INTEGRITY_COUNTERS,
     _BATCH_COUNTERS, _ENGINE_COUNTERS, _SCHED_COUNTERS,
     _HOSTCACHE_COUNTERS, _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
-    _LEDGER_COUNTERS, _ICI_COUNTERS,
+    _LEDGER_COUNTERS, _ICI_COUNTERS, _TENANT_COUNTERS,
 )
 
 
@@ -388,6 +397,25 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
                     shown = " ".join(f"{v:.1f}" for v in vals)
                     lines.append(f"    ring {state + '_s':<21} "
                                  f"{shown:>14}")
+    if (any(int(snap.get(n, 0)) for n in _TENANT_COUNTERS)
+            or snap.get("tenant_stats")):
+        lines.append("  multi-tenant (tier shedding / quotas / SLO "
+                     "boosts — docs/RESILIENCE.md):")
+        for name in _TENANT_COUNTERS:
+            lines.append(f"    {name:<24} {int(snap.get(name, 0)):>14}")
+        ten = snap.get("tenant_stats") or {}
+        for t in sorted(ten, key=lambda t: -ten[t].get(
+                "admissions_shed", 0)):
+            blk = ten[t]
+            lines.append(
+                f"    tenant {t:<12} "
+                f"finished={int(blk.get('requests_finished', 0))} "
+                f"shed={int(blk.get('admissions_shed', 0))} "
+                f"dispatches={int(blk.get('dispatches', 0))} "
+                f"borrows={int(blk.get('borrows', 0))} "
+                f"evicted={int(blk.get('quota_evictions', 0))} "
+                f"boosts={int(blk.get('slo_boosts', 0))} "
+                f"hedges={int(blk.get('hedges_issued', 0))}")
     if any(int(snap.get(n, 0)) for n in _OBS_COUNTERS):
         lines.append("  observability (tracer / flight recorder):")
         for name in _OBS_COUNTERS:

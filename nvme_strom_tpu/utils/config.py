@@ -447,3 +447,62 @@ class LoaderConfig:
     #: record read that follows.  The native wds walker reads O_DIRECT
     #: and needs no cleanup.  Set False to keep pre-warmed files warm.
     drop_index_pollution: bool = True
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """Multi-tenant isolation knobs (io/tenants.py; semantics in
+    docs/RESILIENCE.md "Multi-tenant isolation").
+
+    One gate and one table: ``STROM_TENANTS=1`` turns the tenant layer
+    on (default 0 keeps today's single-tenant stack bit-for-bit), and
+    ``STROM_TENANT_SPEC`` declares the tenants the operator cares about
+    (tier/weight/quota/rate/burst/SLO per id).  Ids not in the spec
+    register on first sight with the ``STROM_TENANT_*`` defaults, so a
+    replayed production trace with thousands of tenant ids needs no
+    spec entry each.  STROM_* environment variables are read at
+    construction time, mirroring EngineConfig.
+    """
+
+    #: master gate; 0 (default) = no tenant is ever attached anywhere —
+    #: the exact pre-tenant stack (proven bit-for-bit by test)
+    enabled: bool = field(
+        default_factory=lambda: os.environ.get("STROM_TENANTS",
+                                               "0") == "1")
+    #: ";"-separated tenant table, each ``<id>[:key=value,...]`` with
+    #: keys tier/weight/quota/rate/burst/slo_ms — e.g.
+    #: ``gold:tier=gold,weight=8,quota=0.5,slo_ms=50;batch:tier=bronze``
+    spec: str = field(
+        default_factory=lambda: os.environ.get("STROM_TENANT_SPEC", ""))
+    #: admission token-bucket refill (requests/s) of a tenant the spec
+    #: does not name; 0 = unlimited
+    default_rate: float = field(
+        default_factory=lambda: _env_float("STROM_TENANT_RATE", 0.0))
+    #: token-bucket burst depth of an unnamed tenant (floored at 1)
+    default_burst: float = field(
+        default_factory=lambda: _env_float("STROM_TENANT_BURST", 8.0))
+    #: residency-quota fraction of an unnamed tenant; 0 = fair share
+    #: (1/N of the tenants the cache has seen)
+    default_quota_frac: float = field(
+        default_factory=lambda: _env_float("STROM_TENANT_QUOTA_FRAC",
+                                           0.0))
+    #: sheds of ONE tenant inside a metrics window that trip the
+    #: ``tenant_storm`` flight-recorder dump
+    storm_sheds: int = field(
+        default_factory=lambda: _env_int("STROM_TENANT_STORM_SHEDS", 32))
+
+    def __post_init__(self):
+        if self.default_rate < 0 or self.default_burst < 0:
+            raise ValueError("tenant default rate/burst must be >= 0")
+        if not 0.0 <= self.default_quota_frac <= 1.0:
+            raise ValueError(
+                f"default_quota_frac ({self.default_quota_frac}) must "
+                f"be in [0, 1]")
+        if self.storm_sheds < 1:
+            raise ValueError("storm_sheds must be >= 1")
+        if self.spec:
+            # validate HERE, like every other knob (HostCacheConfig's
+            # class_quotas pattern): malformed specs fail loudly at
+            # construction, not out of the first serving submit
+            from nvme_strom_tpu.io.tenants import parse_tenant_spec
+            parse_tenant_spec(self.spec)

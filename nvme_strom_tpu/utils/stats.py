@@ -252,6 +252,22 @@ class StromStats:
     # open, exchange failure, single-host mesh) — a brown-out, never an
     # error the consumer sees
     ici_fallbacks: int = 0
+    # -- multi-tenant isolation (io/tenants.py, docs/RESILIENCE.md) -------
+    # serving requests refused admission by the tenant layer (tier shed
+    # under backlog pressure or token-bucket exhaustion); the per-tenant
+    # breakdown rides "tenant_stats"
+    tenant_admissions_shed: int = 0
+    # residency reclaimed FROM an over-quota tenant under pressure (host
+    # cache lines + KV prefix pages) — borrowing paying itself back
+    tenant_quota_evictions: int = 0
+    # admissions a tenant landed past its residency quota while free
+    # space existed (the borrowing the evictions above reclaim)
+    tenant_borrows: int = 0
+    # per-tenant SLO-governor share boosts (the tenant-scoped analogue
+    # of kv_slo_boosts: weight only, never the device hedge budget)
+    tenant_slo_boosts: int = 0
+    # flight-recorder dumps triggered by a tenant's shed/borrow storm
+    tenant_storm_dumps: int = 0
     _lock: threading.Lock = field(
         default_factory=lambda: make_lock("stats.StromStats._lock"),
         repr=False)
@@ -264,6 +280,10 @@ class StromStats:
     # per-latency-class tallies (QoS scheduler + per-class resilience
     # budgets): {class: {counter: value}}; exported as "class_stats"
     _class_stats: dict = field(default_factory=dict, repr=False)
+    # per-tenant tallies (multi-tenant isolation): {tenant id:
+    # {counter: value}}; exported as "tenant_stats" — the {tenant=}
+    # label breakdown behind the flat tenant_* counters above
+    _tenant_stats: dict = field(default_factory=dict, repr=False)
 
     def add(self, **deltas: int) -> None:
         with self._lock:
@@ -293,6 +313,20 @@ class StromStats:
     def class_stats(self) -> dict:
         with self._lock:
             return {k: dict(v) for k, v in self._class_stats.items()}
+
+    def add_tenant_stat(self, tenant: str, **deltas) -> None:
+        """Accumulate per-tenant counters (dispatches, sheds, borrows)
+        under one lock with the flat block — the class_stats mechanism
+        keyed by tenant id instead of latency class."""
+        with self._lock:
+            blk = self._tenant_stats.setdefault(tenant, {})
+            for name, d in deltas.items():
+                blk[name] = blk.get(name, 0) + d
+
+    @property
+    def tenant_stats(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._tenant_stats.items()}
 
     def add_member_bytes(self, members, deltas) -> None:
         """Accumulate per-raid-member payload bytes (parallel lists)."""
@@ -335,6 +369,9 @@ class StromStats:
             if self._class_stats:
                 snap["class_stats"] = {k: dict(v)
                                        for k, v in self._class_stats.items()}
+            if self._tenant_stats:
+                snap["tenant_stats"] = {
+                    k: dict(v) for k, v in self._tenant_stats.items()}
             return snap
 
     def dump_json(self) -> str:
@@ -347,6 +384,7 @@ class StromStats:
             self._gauges.clear()
             self._member_bytes.clear()
             self._class_stats.clear()
+            self._tenant_stats.clear()
             self._t0 = time.monotonic()
 
     def maybe_export(self) -> None:
@@ -663,6 +701,17 @@ def openmetrics_from_snapshot(snap: dict) -> str:
         for k, blk in sorted(cls.items()):
             if n in blk:
                 (m.set if is_gauge else m.inc)(blk[n], klass=k)
+    # per-tenant breakdowns label with {tenant=}; the family name takes
+    # a by_tenant prefix so it can never collide with the flat
+    # tenant_* totals rendered from COUNTER_FIELDS above
+    ten = snap.get("tenant_stats") or {}
+    tnames = sorted({n for blk in ten.values() for n in blk})
+    for n in tnames:
+        m = reg.counter(f"strom_by_tenant_{n}",
+                        f"per-tenant counter {n}", ("tenant",))
+        for t, blk in sorted(ten.items()):
+            if n in blk:
+                m.inc(blk[n], tenant=t)
     depths = snap.get("ring_depths")
     if depths:
         g = reg.gauge("strom_ring_depth",
@@ -708,9 +757,9 @@ def openmetrics_from_snapshot(snap: dict) -> str:
         for m_, v in sorted(members.items()):
             g.inc(int(v), member=m_)
     skip = (set(COUNTER_FIELDS)
-            | {"class_stats", "ring_depths", "ring_health",
-               "member_bytes", "ring_fixed_bufs", "ring_reg_files",
-               "ring_sqpoll", "ring_state_s"})
+            | {"class_stats", "tenant_stats", "ring_depths",
+               "ring_health", "member_bytes", "ring_fixed_bufs",
+               "ring_reg_files", "ring_sqpoll", "ring_state_s"})
     for name in sorted(snap):
         if name in skip or name.startswith("_"):
             continue

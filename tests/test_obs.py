@@ -298,6 +298,11 @@ def test_hostcache_evicted_unused_waste():
 
     class _FakeCache:
         _clock_evict = hc.HostCache._clock_evict
+        # untenanted lines short-circuit both, but the real method
+        # calls them unconditionally
+        _tenant_over = hc.HostCache._tenant_over
+        _tenant_drop_locked = hc.HostCache._tenant_drop_locked
+        _tenant_slots: dict = {}
 
     cache = _FakeCache()
     line = _Line(("fk", 0), 0, "prefetch")
