@@ -943,6 +943,130 @@ def bench_tenants(path: str, trials: int = 1) -> dict:
     }
 
 
+def bench_sql(path: str) -> dict:
+    """Direct SQL scan scenario (docs/PERF.md §8): the partition-
+    parallel, pushdown-planned Parquet scan (sql/scan_plan.py) priced
+    against its own serial arm on one cold wide fact table, across a
+    selectivity sweep.  The predicate band is centered so it STRADDLES
+    the two row groups' boundary — the zone-map worst case where plain
+    row-group pruning (the pre-PR scan) saves nothing and the whole
+    win is page-level late materialization.  Three arms per
+    selectivity: serial (workers=1, pushdown off — bit-for-bit the
+    pre-pushdown stack), parallel (workers=2, pushdown off),
+    parallel+pushdown.  The timed section is the scan stage
+    (iter_scan_columns draining every column to the device); each
+    arm's FULL group-by result is computed untimed and compared
+    bit-for-bit against serial — ``bit_identical`` in the block is
+    that verdict, never assumed.  ``STROM_BENCH_SQL_BYTES`` sizes the
+    table (default 96 MiB)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.sql import scan_plan
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    from nvme_strom_tpu.sql.parquet import ParquetScanner
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    nbytes = int(os.environ.get("STROM_BENCH_SQL_BYTES",
+                                str(96 << 20)))
+    rows = max(8192, nbytes // 40)     # k,ts int32 + v0..v7 float32
+    sql_path = os.path.join(os.path.dirname(path),
+                            ".bench_sql.parquet")
+    meta = sql_path + ".meta"
+    try:
+        fresh = open(meta).read() == f"{rows}/g1"
+    except OSError:
+        fresh = False
+    if not fresh or not os.path.exists(sql_path):
+        rng = np.random.default_rng(7)
+        data = {"k": pa.array(rng.integers(0, 64, rows,
+                                           dtype=np.int32))}
+        for i in range(8):
+            data[f"v{i}"] = pa.array(
+                rng.standard_normal(rows, dtype=np.float32))
+        data["ts"] = pa.array(np.arange(rows, dtype=np.int32))
+        pq.write_table(pa.table(data), sql_path,
+                       row_group_size=(rows + 1) // 2,
+                       compression="none", use_dictionary=False,
+                       data_page_size=256 << 10)
+        with open(meta, "w") as f:
+            f.write(f"{rows}/g1")
+    size = os.path.getsize(sql_path)
+    vcols = [f"v{i}" for i in range(8)]
+    cols = ["k", *vcols, "ts"]
+    window = 32 << 20              # fixed across arms: identical folds
+    skip_counters = ("sql_rowgroups_skipped", "sql_pages_skipped",
+                     "sql_bytes_skipped")
+    knobs = ("STROM_SQL_WORKERS", "STROM_SQL_PUSHDOWN",
+             "STROM_SQL_WINDOW_BYTES")
+    saved = {k: os.environ.get(k) for k in knobs}
+    stats = StromStats()
+    eng = StromEngine(EngineConfig(chunk_bytes=8 << 20, queue_depth=8,
+                                   buffer_pool_bytes=128 << 20),
+                      stats=stats)
+    out = {"table_bytes": size, "rows": rows, "selectivity": {}}
+    try:
+        os.environ["STROM_SQL_WINDOW_BYTES"] = str(window)
+        sc = ParquetScanner(sql_path, eng)
+        for sel in (0.1, 0.5, 1.0):
+            lo = int(rows * (0.5 - sel / 2))
+            hi = int(rows * (0.5 + sel / 2)) - 1
+            wr = [("ts", lo, hi)]
+            arms, results = {}, {}
+            for arm, (wk, push) in (
+                    ("serial", (1, 0)), ("parallel", (2, 0)),
+                    ("parallel_pushdown", (2, 1))):
+                os.environ["STROM_SQL_WORKERS"] = str(wk)
+                os.environ["STROM_SQL_PUSHDOWN"] = str(push)
+                rgs = (list(scan_plan.plan_scan(
+                           sc, cols, wr).row_groups)
+                       if push else sc.prune_row_groups(wr))
+                snap0 = stats.snapshot()
+                ts_s = []
+                for _ in range(3):
+                    evict_file(sql_path)
+                    t0 = time.monotonic()
+                    for got in scan_plan.iter_scan_columns(
+                            sc, cols, None, row_groups=rgs,
+                            where_ranges=wr, window_bytes=window):
+                        for v in got.values():
+                            v.block_until_ready()
+                    ts_s.append(time.monotonic() - t0)
+                res = sql_groupby(sc, "k", vcols, 64,
+                                  aggs=("count", "sum"),
+                                  where_ranges=wr)   # untimed fold
+                results[arm] = {a: np.asarray(v)
+                                for a, v in res.items()}
+                snap1 = stats.snapshot()
+                dt = statistics.median(ts_s)
+                arms[arm] = {
+                    "gib_s": round(size / (1 << 30) / dt, 3),
+                    "mrows_s": round(rows / dt / 1e6, 2),
+                    **{k: int(snap1.get(k, 0)) - int(snap0.get(k, 0))
+                       for k in skip_counters}}
+            base = results["serial"]
+            ident = all(
+                np.array_equal(base[a], r[a], equal_nan=True)
+                for r in results.values() for a in base)
+            t_serial = size / (1 << 30) / arms["serial"]["gib_s"]
+            t_push = (size / (1 << 30)
+                      / arms["parallel_pushdown"]["gib_s"])
+            arms["speedup_pushdown"] = round(t_serial / t_push, 2)
+            arms["bit_identical"] = ident
+            out["selectivity"][f"{sel:.0%}"] = arms
+    finally:
+        eng.close_all()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def bench_overlap(path: str) -> dict:
     """Zero-copy overlap scenario (docs/PERF.md §6) — the two claims of
     the registered-files/SQPOLL/arena/double-buffering arc, measured:
@@ -1692,6 +1816,23 @@ def main() -> int:
              f"sheds={tenants['tier_on']['tenant_sheds']} "
              f"storm_dumps={tenants['tier_on']['tenant_storm_dumps']}")
 
+    # Direct SQL pushdown scan scenario (docs/PERF.md §8): serial vs
+    # partition-parallel vs parallel+pushdown scan rates across a
+    # selectivity sweep, with the zone-map/page skip counters and the
+    # per-selectivity bit-identity verdict.  STROM_BENCH_SQL=0 skips.
+    sqlscan = None
+    if os.environ.get("STROM_BENCH_SQL", "1") != "0":
+        sqlscan = bench_sql(path)
+        s10 = sqlscan["selectivity"]["10%"]
+        _log(f"bench: sql: 10% sel serial "
+             f"{s10['serial']['gib_s']:.3f} -> parallel "
+             f"{s10['parallel']['gib_s']:.3f} -> pushdown "
+             f"{s10['parallel_pushdown']['gib_s']:.3f} GiB/s "
+             f"(speedup {s10['speedup_pushdown']:.2f}x, "
+             f"bytes_skipped="
+             f"{s10['parallel_pushdown']['sql_bytes_skipped']}, "
+             f"identical={s10['bit_identical']})")
+
     # Observability-overhead scenario (docs/OBSERVABILITY.md): the
     # always-on flight recorder and the causal tracer priced against
     # the bare read path, plus the metrics-registry snapshot series.
@@ -1826,6 +1967,11 @@ def main() -> int:
         # contains a misbehaving tenant's blast radius
         # (docs/RESILIENCE.md "Multi-tenant isolation")
         "tenants": tenants,
+        # partition-parallel pushdown SQL scan (bench_sql): scan-stage
+        # GiB/s + rows/s per arm across a selectivity sweep, the
+        # zone-map/page skip counters, and the bit-identity verdict of
+        # every arm's full group-by against serial (docs/PERF.md §8)
+        "sql": sqlscan,
         # failure-domain supervision (io/health.py): normally all
         # zeros — non-zero means THIS bench run tripped breakers,
         # hot-restarted rings, requeued extents, or browned out to the

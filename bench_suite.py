@@ -395,6 +395,41 @@ def make_topk_parquet(path: str, nbytes: int) -> int:
     return os.path.getsize(path)
 
 
+def make_sql_scan_parquet(path: str, nbytes: int,
+                          num_groups: int = 64) -> int:
+    """Table for config 23: a key column, three float32 payload
+    columns, and a monotonically increasing int32 "ts" column (int32,
+    not int64, so the direct page walk stays eligible under x32 JAX)
+    with tight per-row-group AND per-page statistics.  The layout is
+    the zone-map worst case the paper motivates pushdown with: TWO
+    large row groups, so a predicate band straddling their boundary
+    defeats row-group pruning outright — the pre-PR scan reads the
+    whole table — while the late-materializing scan fetches the filter
+    column plus just the 256 KiB payload pages the band touches.  The
+    wide fact-table payload (16 value columns — TPC-DS store_sales
+    width) keeps the filter column a small fraction of the bytes
+    pushdown must still read in full."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    if not _needs_regen("parquet_scan", nbytes, gen=4) \
+            and os.path.exists(path):
+        return os.path.getsize(path)
+    rows = max(8192, nbytes // 72)   # k,ts int32 + v0..v15 float32
+    rng = np.random.default_rng(2)
+    data = {"k": pa.array(rng.integers(0, num_groups, rows,
+                                       dtype=np.int32))}
+    for i in range(16):
+        data[f"v{i}"] = pa.array(
+            rng.standard_normal(rows, dtype=np.float32))
+    data["ts"] = pa.array(np.arange(rows, dtype=np.int32))
+    pq.write_table(pa.table(data), path, row_group_size=(rows + 1) // 2,
+                   compression="none", use_dictionary=False,
+                   data_page_size=256 << 10)
+    _mark_generated("parquet_scan", nbytes, gen=4)
+    return os.path.getsize(path)
+
+
 # ------------------------------ benches --------------------------------
 
 def bench_arrow(engine, nbytes: int, device=None) -> tuple[float, int]:
@@ -592,6 +627,122 @@ def bench_sql(engine, nbytes: int, num_groups: int = 64,
     finally:
         if adopted_window:
             os.environ.pop("STROM_SQL_WINDOW_BYTES", None)
+
+
+def bench_sql_parallel(engine, nbytes: int, num_groups: int = 64,
+                       device=None) -> tuple[float, str]:
+    """Config 23: partition-parallel pushdown scan (sql/scan_plan.py)
+    vs its own same-run serial arm — a ~10% selectivity range predicate
+    on the monotone ts column whose band STRADDLES the two row groups'
+    boundary, so zone-map pruning saves nothing and the whole win is
+    page-level late materialization.  Three arms back to back on the
+    same cold file: serial (workers=1, pushdown off — the exact pre-PR
+    path), parallel (best workers, pushdown off), parallel+pushdown.
+    The TIMED section is the scan stage (iter_scan_columns draining
+    every column to the device) — the stage this engine owns; the
+    group-by fold downstream of it is byte-for-byte the same work in
+    every arm, and each arm's FULL query result is computed untimed
+    and asserted bit-identical to serial every run, so a divergence
+    fails the config loudly rather than benching a wrong answer.
+    Headline is the parallel+pushdown effective table scan rate
+    (surviving-row-group bytes over wall time); the tag stamps
+    ``workers=N`` (utils/tuning.best_sql_workers adopts the ledgered
+    winner as the STROM_SQL_WORKERS=0 auto width), the serial/parallel
+    rates, speedups, rows/s, and the skip counters."""
+    import numpy as np
+    from nvme_strom_tpu.sql import scan_plan
+    from nvme_strom_tpu.sql.groupby import sql_groupby
+    from nvme_strom_tpu.sql.parquet import ParquetScanner
+    path = os.path.join(_scratch_dir(), "scan.parquet")
+    size = make_sql_scan_parquet(path, nbytes, num_groups)
+    scanner = ParquetScanner(path, engine)
+    rows = scanner.num_rows
+    lo, hi = int(rows * 0.45), int(rows * 0.55) - 1    # ~10% survives
+    wr = [("ts", lo, hi)]
+    vcols = [f"v{i}" for i in range(16)]
+    cols = ["k", *vcols, "ts"]
+    window = 32 << 20          # fixed across arms: identical windowing
+    knobs = ("STROM_SQL_WORKERS", "STROM_SQL_PUSHDOWN",
+             "STROM_SQL_WINDOW_BYTES")
+    saved = {k: os.environ.get(k) for k in knobs}
+
+    def query():
+        out = sql_groupby(scanner, "k", vcols, num_groups,
+                          aggs=("count", "sum", "mean"), device=device,
+                          where_ranges=wr)
+        for v in out.values():
+            v.block_until_ready()
+        return {a: np.asarray(v) for a, v in out.items()}
+
+    results = {}
+
+    def arm(tag_, workers, pushdown):
+        os.environ["STROM_SQL_WORKERS"] = str(workers)
+        os.environ["STROM_SQL_PUSHDOWN"] = str(pushdown)
+        rgs = (list(scan_plan.plan_scan(scanner, cols, wr).row_groups)
+               if pushdown and scan_plan.pushdown_enabled()
+               else scanner.prune_row_groups(wr))
+        ts = []
+        for i in range(_RUNS + _STEADY_WARMUPS):
+            bench.evict_file(path)
+            t0 = time.monotonic()
+            for out in scan_plan.iter_scan_columns(
+                    scanner, cols, device, row_groups=rgs,
+                    where_ranges=wr, window_bytes=window):
+                for v in out.values():
+                    v.block_until_ready()
+            if i >= _STEADY_WARMUPS:
+                ts.append(time.monotonic() - t0)
+        results[tag_] = query()        # untimed: fold bit-check
+        dt = statistics.median(ts)
+        _log(f"suite: sql-parallel arm {tag_}: {dt:.3f}s "
+             f"({size / (1 << 30) / dt:.3f} GiB/s)")
+        return dt
+
+    try:
+        os.environ["STROM_SQL_WINDOW_BYTES"] = str(window)
+        env_w = int(saved["STROM_SQL_WORKERS"] or "0")
+        widths = [env_w] if env_w > 1 else [2, 4]
+        t_serial = arm("serial", 1, 0)
+        t_par, best_w = None, widths[0]
+        for w in widths:
+            t = arm(f"par{w}", w, 0)
+            if t_par is None or t < t_par:
+                t_par, best_w = t, w
+        snap0 = engine.stats.snapshot()
+        t_push = arm("push", best_w, 1)
+        snap1 = engine.stats.snapshot()
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    base = results["serial"]
+    for tag_, res in results.items():
+        for a in base:
+            if not np.array_equal(base[a], res[a], equal_nan=True):
+                raise AssertionError(
+                    f"config 23: arm {tag_} diverged from serial on "
+                    f"{a!r} — scan correctness bug, not a perf number")
+    rg_skip = (snap1.get("sql_rowgroups_skipped", 0)
+               - snap0.get("sql_rowgroups_skipped", 0))
+    # push arm: _RUNS + warmup timed scan passes plus the one untimed
+    # bit-check query, each a late-materializing pass over the band
+    by_skip = ((snap1.get("sql_bytes_skipped", 0)
+                - snap0.get("sql_bytes_skipped", 0))
+               // (_RUNS + _STEADY_WARMUPS + 1))
+    gib = size / (1 << 30)
+    rate = gib / t_push
+    tag = (f"workers={best_w} rows={rows} sel=10% "
+           f"serial={gib / t_serial:.3f} par={gib / t_par:.3f} "
+           f"push={rate:.3f} GiB/s "
+           f"speedup_par={t_serial / t_par:.2f}x "
+           f"speedup_push={t_serial / t_push:.2f}x "
+           f"mrows_s={rows / t_push / 1e6:.2f} "
+           f"rg_skipped={rg_skip} bytes_skipped={by_skip}")
+    _log(f"suite: sql-parallel: {tag}")
+    return rate, tag
 
 
 def bench_sql_zstd(engine, nbytes: int, num_groups: int = 64,
@@ -2160,6 +2311,14 @@ def run(configs: list[int], emit=None) -> list[dict]:
             # so no read-ceiling ratio applies
             22: ("tenant-isolation-storm",
                  lambda: bench_tenant_storm(nbytes), "x", False),
+            # partition-parallel pushdown scan: effective table GiB/s
+            # with zone-map skips, paired with its own same-run serial
+            # arm (the speedups in the tag are the claim; the headline
+            # legitimately exceeds the link because skipped bytes never
+            # cross it) — so no read-ceiling ratio applies
+            23: ("sql-parallel-pushdown",
+                 lambda: bench_sql_parallel(engine, nbytes), "GiB/s",
+                 False),
         }
         # only configs whose _steady passes move payload ACROSS the
         # link get per-pass pairing: config 8's passes are pure engine
@@ -2234,12 +2393,12 @@ def run(configs: list[int], emit=None) -> list[dict]:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--config", type=int, action="append",
-                    choices=range(1, 23))
+                    choices=range(1, 24))
     ap.add_argument("--all", action="store_true")
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = list(range(1, 23))
+        configs = list(range(1, 24))
     run(configs, emit=lambda row: print(json.dumps(row), flush=True))
     return 0
 

@@ -376,7 +376,8 @@ def submit_spans(engine, spans: Sequence[Tuple[int, int, int]],
     mid-list failure never strands staging buffers.
 
     ``klass`` tags the batch's latency class (io/sched.py: ``decode`` >
-    ``restore`` > ``prefetch`` > ``scrub``); on a sharded engine the QoS
+    ``restore`` > ``prefetch`` > ``scan`` > ``scrub``); on a sharded
+    engine the QoS
     scheduler dispatches accordingly, and the resilience layer applies
     that class's hedge/retry budgets.  None rides the default class.
 

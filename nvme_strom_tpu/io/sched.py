@@ -8,8 +8,8 @@ The engine now shards into N rings over one global staging pool
 decides WHICH planned batch goes to WHICH ring, and WHEN:
 
   classes     every planned batch carries a latency class —
-              ``decode`` > ``restore`` > ``prefetch`` > ``scrub``
-              (priority order).  Consumers tag their traffic at the
+              ``decode`` > ``restore`` > ``prefetch`` > ``scan`` >
+              ``scrub`` (priority order).  Consumers tag their traffic at the
               ``io/plan.py`` boundary (``plan_and_submit(...,
               klass=...)``); untagged batches ride the default
               ``prefetch`` class so the fair-share always sees the
@@ -74,9 +74,9 @@ from nvme_strom_tpu.io.tenants import current_tenant
 from nvme_strom_tpu.utils.lockwitness import make_condition, make_lock
 
 #: priority order, highest first — the serving decode path outranks
-#: checkpoint/weight restore, which outranks loader/SQL prefetch, which
-#: outranks background scrub
-CLASS_ORDER = ("decode", "restore", "prefetch", "scrub")
+#: checkpoint/weight restore, which outranks loader prefetch, which
+#: outranks analytics scans (sql/), which outrank background scrub
+CLASS_ORDER = ("decode", "restore", "prefetch", "scan", "scrub")
 
 #: class every untagged batch rides (bulk by assumption)
 DEFAULT_CLASS = "prefetch"
@@ -108,13 +108,21 @@ class ClassPolicy:
 
 
 def default_policies(weights: str = "") -> Dict[str, ClassPolicy]:
-    """The four stock policies; ``weights`` ("decode=8,scrub=1")
-    overrides weights per class (SchedConfig.class_weights)."""
+    """The five stock policies; ``weights`` ("decode=8,scrub=1")
+    overrides weights per class (SchedConfig.class_weights).
+
+    ``scan`` is the analytics class (sql/ Direct SQL scans — partition-
+    parallel workers all submit here): same weight as prefetch so a
+    table scan and a loader share bulk bandwidth evenly, but BELOW it
+    in priority — an aggressor scan drains after serving-adjacent
+    prefetch, and far after decode (tests/test_sql_scan.py proves the
+    decode-under-scan-storm bound)."""
     pol = {
         "decode": ClassPolicy("decode", 0, weight=8.0, hedge_budget=8),
         "restore": ClassPolicy("restore", 1, weight=4.0, hedge_budget=4),
         "prefetch": ClassPolicy("prefetch", 2, weight=2.0, hedge_budget=2),
-        "scrub": ClassPolicy("scrub", 3, weight=1.0, hedge_budget=1),
+        "scan": ClassPolicy("scan", 3, weight=2.0, hedge_budget=2),
+        "scrub": ClassPolicy("scrub", 4, weight=1.0, hedge_budget=1),
     }
     for part in filter(None, (s.strip() for s in weights.split(","))):
         name, eq, val = part.partition("=")

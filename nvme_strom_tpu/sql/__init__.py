@@ -9,10 +9,15 @@ from nvme_strom_tpu.sql.multi import (multi_groupby, multi_scalar_agg,
                                       multi_topk, open_dataset)
 from nvme_strom_tpu.sql.dist import dist_groupby, dist_scalar_agg
 from nvme_strom_tpu.sql.cache import DeviceTable
+from nvme_strom_tpu.sql.scan_plan import (ScanPlan, iter_scan_columns,
+                                          plan_scan, pushdown_enabled,
+                                          sql_workers)
 
 __all__ = ["EngineFile", "ParquetScanner", "groupby_aggregate",
            "sql_groupby", "sql_groupby_str", "sql_scalar_agg",
            "top_k_groups", "lookup_unique", "star_join_groupby",
            "sql_topk", "SQLSyntaxError", "parse_select", "sql_query",
            "multi_groupby", "multi_scalar_agg", "multi_topk",
-           "open_dataset", "dist_groupby", "dist_scalar_agg", "DeviceTable"]
+           "open_dataset", "dist_groupby", "dist_scalar_agg", "DeviceTable",
+           "ScanPlan", "iter_scan_columns", "plan_scan",
+           "pushdown_enabled", "sql_workers"]

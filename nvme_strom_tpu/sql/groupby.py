@@ -465,13 +465,23 @@ def _fold_scan(scanner, key_column, vcols, single, num_groups, aggs,
     returns the RAW foldable partials (count/sum/sum2/min/max with
     segment identities) so a multi-file union can keep folding across
     files before one final finalize (sql/multi.py)."""
+    from nvme_strom_tpu.sql import scan_plan
     dev = device or jax.local_devices()[0]
     range_cols = [c for c, _, _ in where_ranges]
     key_cols = [key_column] if key_column is not None else []
     cols_needed = list(dict.fromkeys(
         [*key_cols, *vcols, *where_columns, *range_cols]))
-    rgs = (scanner.prune_row_groups(where_ranges) if where_ranges
-           else None)
+    # pushdown planning: same survivors as prune_row_groups (a plan
+    # failure cannot change results, only what gets counted/skipped),
+    # plus projection-aware byte accounting into the sql_* counters
+    if where_ranges:
+        if scan_plan.pushdown_enabled():
+            rgs = list(scan_plan.plan_scan(
+                scanner, cols_needed, where_ranges).row_groups)
+        else:
+            rgs = scanner.prune_row_groups(where_ranges)
+    else:
+        rgs = None
     full_where = ((lambda cols: _range_mask(cols, where_ranges, where))
                   if (where_ranges or where is not None) else None)
     if rgs is not None and not rgs:    # statistics excluded everything
@@ -501,11 +511,15 @@ def _fold_scan(scanner, key_column, vcols, single, num_groups, aggs,
                        _stack_values(cols, vcols, single), cols, base)
         else:
             # fold consumers are yield-size-agnostic: coalesce row
-            # groups so each concat/view/fold dispatch covers a window
-            for cols in iter_device_columns(scanner, cols_needed, dev,
-                                            narrow_int32=tuple(key_cols),
-                                            row_groups=rgs,
-                                            window_bytes=sql_window_bytes()):
+            # groups so each concat/view/fold dispatch covers a window.
+            # scan_plan routes: late materialization / partition-
+            # parallel / the exact serial iter_device_columns path —
+            # all bit-identical under _stream_fold's spill-group mask
+            for cols in scan_plan.iter_scan_columns(
+                    scanner, cols_needed, dev,
+                    narrow_int32=tuple(key_cols), row_groups=rgs,
+                    where_ranges=where_ranges,
+                    window_bytes=sql_window_bytes()):
                 yield (keys_of(cols),
                        _stack_values(cols, vcols, single), cols, None)
 
@@ -603,9 +617,22 @@ def sql_groupby_str(scanner, key_column: str, value_column,
             "compare dictionary codes, not labels — filter labels "
             "host-side or use a numeric column")
     dev = device or jax.local_devices()[0]
-    rgs = (scanner.prune_row_groups(where_ranges) if where_ranges
-           else None)
     vcols, single = _value_cols(value_column)
+    # the codes iterator and the column stream zip POSITIONALLY per row
+    # group, so this scan stays on the serial iterator — it still gains
+    # the pushdown planner's zone-map accounting (same survivors)
+    if where_ranges:
+        from nvme_strom_tpu.sql import scan_plan
+        if scan_plan.pushdown_enabled():
+            proj = [c for c in dict.fromkeys(
+                [key_column, *vcols, *where_columns,
+                 *(c for c, _, _ in where_ranges)])]
+            rgs = list(scan_plan.plan_scan(
+                scanner, proj, where_ranges).row_groups)
+        else:
+            rgs = scanner.prune_row_groups(where_ranges)
+    else:
+        rgs = None
     labels, iter_codes = pq_direct.read_dict_key_column(
         scanner, key_column, device=dev, row_groups=rgs)
     num_groups = len(labels)

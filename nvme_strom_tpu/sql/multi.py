@@ -68,8 +68,10 @@ def _union_fold(scanners, key_column, vcols, single, num_groups, aggs,
     """THE per-scanner fold loop (raw partials, fully-pruned members
     skipped) shared by the multi-file union and the distributed
     executor — three copies of this loop had started to drift (advisor
-    round-4).  Returns the folded partials, or None when no member
-    produced any row group."""
+    round-4).  Each member's scan rides `_fold_scan`, so pushdown
+    planning, partition-parallel workers, and late materialization
+    (sql/scan_plan.py) apply per file with no code here.  Returns the
+    folded partials, or None when no member produced any row group."""
     from nvme_strom_tpu.sql.groupby import _fold, _fold_scan
     folds = None
     for sc in scanners:

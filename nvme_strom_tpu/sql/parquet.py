@@ -67,8 +67,11 @@ class EngineFile(io.RawIOBase):
             return 0
         eng = self.engine
         chunk = eng.config.chunk_bytes
-        # pipelined chunked read of [pos, pos+n)
-        pend = [eng.submit_read(self._fh, self._pos + o, min(chunk, n - o))
+        # pipelined chunked read of [pos, pos+n), tagged with the sql
+        # scan class (footer/fallback reads are analytics traffic too —
+        # per-class budgets and flight-recorder attribution see them)
+        pend = [eng.submit_read(self._fh, self._pos + o,
+                                min(chunk, n - o), klass="scan")
                 for o in range(0, n, chunk)]
         pos = 0
         mv = memoryview(b)

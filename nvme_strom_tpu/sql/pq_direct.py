@@ -63,6 +63,12 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
+#: the QoS latency class every sql/ payload read rides (io/sched.py):
+#: analytics scans dispatch below serving decode/restore/prefetch and
+#: above scrub, so a partition-parallel table scan is governed by the
+#: scheduler's fair-share instead of competing as anonymous bulk
+SCAN_CLASS = "scan"
+
 # Parquet physical types that are raw fixed-width little-endian under PLAIN
 _WIDTHS = {"INT32": 4, "INT64": 8, "FLOAT": 4, "DOUBLE": 8}
 _NP_DTYPES = {"INT32": "<i4", "INT64": "<i8", "FLOAT": "<f4",
@@ -1228,7 +1234,8 @@ def _iter_span_bytes_pipelined(eng, fh, spans, stall_box):
 
     try:
         for si, (off, n) in zip(span_of, flat):
-            pend.append((si, eng.submit_read(fh, off, n)))
+            pend.append((si, eng.submit_read(fh, off, n,
+                                             klass=SCAN_CLASS)))
             while len(pend) > eng.config.queue_depth:
                 drain_one()
             # FIFO completion: span k's chunks all land before k+1's
@@ -1389,7 +1396,7 @@ def read_plain_columns_to_device(scanner, columns: Sequence[str],
                                   allow_nulls=nulls == "mask")
     depth, drain = tuned_stream_params(scanner.engine)
     ds = DeviceStream(scanner.engine, device=dev, depth=depth,
-                      klass="prefetch",
+                      klass=SCAN_CLASS,
                       drain=drain)
     out = {}
     meta = scanner.metadata
@@ -1712,7 +1719,7 @@ def iter_plain_row_groups_to_device(scanner, columns: Sequence[str],
     # identical link at 0.88-0.91
     depth, drain = tuned_stream_params(scanner.engine)
     ds = DeviceStream(scanner.engine, device=dev, depth=depth,
-                      klass="prefetch",
+                      klass=SCAN_CLASS,
                       drain=drain)
     fh = scanner.engine.open(scanner.path)
     try:
@@ -1772,10 +1779,28 @@ def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups,
     (window, column) drops the gaps ON DEVICE — one put per 8 MiB and
     ~3 device dispatches per window-column, independent of page
     count."""
-    import jax.numpy as jnp
-    import numpy as np
-    from nvme_strom_tpu.ops.bridge import split_ranges
+    flat, counts, windows = [], [], _split_windows(columns, plans,
+                                                   groups, window_bytes)
+    for w in windows:
+        f, cn = _plan_window_ranges(scanner, columns, plans, w)
+        flat.extend(f)
+        counts.extend(cn)
+    it = ds.stream_ranges(fh, flat)
+    ci = iter(counts)
+    try:
+        for w in windows:
+            yield _assemble_window(columns, plans, w, ci, it)
+    finally:
+        it.close()                 # abandoned scan: release staging now
 
+
+def _split_windows(columns, plans, groups,
+                   window_bytes: int | None) -> list:
+    """Row-group ids → consecutive windows of ~``window_bytes`` payload
+    each (one group per window when None/0).  The ONE windowing rule
+    shared by the serial pipelined scan above and the partition-parallel
+    scan (sql/scan_plan.py) — identical windows are what make the
+    parallel merge bit-identical to the serial stream."""
     if window_bytes:
         windows, cur, cur_b = [], [], 0
         for rg in groups:
@@ -1787,79 +1812,94 @@ def _iter_plain_pipelined(scanner, ds, fh, columns, plans, groups,
             cur_b += b
         if cur:
             windows.append(cur)
-    else:
-        windows = [[rg] for rg in groups]
+        return windows
+    return [[rg] for rg in groups]
+
+
+def _plan_window_ranges(scanner, columns, plans, w):
+    """One window's submission plan: ``(flat, counts)`` — every
+    chunk-sized sub-range in submission order, plus the
+    ``(rg, column, n_chunks, spec)`` reassembly records
+    :func:`_assemble_window` consumes.  Pure function of the window:
+    the serial path streams all windows' ranges as one sequence, the
+    parallel path streams each worker's windows independently, and
+    both assemble the same per-window buffers."""
+    from nvme_strom_tpu.ops.bridge import split_ranges
 
     chunk_bytes = scanner.engine.config.chunk_bytes
     flat = []                      # every sub-range, submission order
     counts = []                    # (rg, column, n_chunks, spec)
-    for w in windows:
-        # merge decision per (window, column): the degap program holds
-        # one lax.slice per value span ACROSS the window, so a
-        # small-page layout (4 KiB pages → thousands of spans per
-        # 64 MiB window) would compile a pathological program — cap
-        # the slice count and fall back to exact per-span reads
-        allow = {c: sum(len([s for s in plans[c][rg].spans if s[1]])
-                        for rg in w) <= _COALESCE_MAX_SLICES
-                 for c in columns}
-        for rg in w:
-            for c in columns:
-                spans = plans[c][rg].spans
-                merged = _coalesce_spans(spans) if allow[c] else None
-                if merged is not None:
-                    ranges, _ = split_ranges([merged], chunk_bytes)
-                    # value spans relative to the merged buffer: the
-                    # on-device degap spec
-                    spec = tuple((off - merged[0], ln)
-                                 for off, ln in spans if ln)
-                else:
-                    ranges, _ = split_ranges(spans, chunk_bytes)
-                    spec = None
-                flat.extend(ranges)
-                counts.append((rg, c, len(ranges), spec))
-    it = ds.stream_ranges(fh, flat)
-    ci = iter(counts)
-    try:
-        for w in windows:
-            parts: dict = {c: [] for c in columns}
-            specs: dict = {c: [] for c in columns}
-            merged_any = {c: False for c in columns}
-            sizes = {c: 0 for c in columns}     # buffer bytes so far
-            for rg in w:
-                for c in columns:
-                    _, _, n, spec = next(ci)
-                    got = [next(it) for _ in range(n)]
-                    base = sizes[c]
-                    if spec is not None:
-                        merged_any[c] = True
-                        specs[c].extend((base + o, ln)
-                                        for o, ln in spec)
-                    else:
-                        # unmerged chunks are pure value bytes: they
-                        # enter the buffer verbatim, and the spec keeps
-                        # them in case a SIBLING row group merged
-                        pos = 0
-                        for p in got:
-                            specs[c].append((base + pos,
-                                             int(p.shape[0])))
-                            pos += int(p.shape[0])
-                    parts[c].extend(got)
-                    sizes[c] += sum(int(p.shape[0]) for p in got)
-            out = {}
-            for c in columns:
-                np_dtype = np.dtype(
-                    _NP_DTYPES[plans[c][w[0]].physical_type])
-                ps = parts[c]
-                if not ps:         # zero-row window
-                    out[c] = jnp.zeros((0,), dtype=np_dtype)
-                    continue
-                buf = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
-                if merged_any[c]:
-                    buf = _degap(tuple(specs[c]), int(buf.shape[0]))(buf)
-                out[c] = buf.view(np_dtype)
-            yield out
-    finally:
-        it.close()                 # abandoned scan: release staging now
+    # merge decision per (window, column): the degap program holds
+    # one lax.slice per value span ACROSS the window, so a
+    # small-page layout (4 KiB pages → thousands of spans per
+    # 64 MiB window) would compile a pathological program — cap
+    # the slice count and fall back to exact per-span reads
+    allow = {c: sum(len([s for s in plans[c][rg].spans if s[1]])
+                    for rg in w) <= _COALESCE_MAX_SLICES
+             for c in columns}
+    for rg in w:
+        for c in columns:
+            spans = plans[c][rg].spans
+            merged = _coalesce_spans(spans) if allow[c] else None
+            if merged is not None:
+                ranges, _ = split_ranges([merged], chunk_bytes)
+                # value spans relative to the merged buffer: the
+                # on-device degap spec
+                spec = tuple((off - merged[0], ln)
+                             for off, ln in spans if ln)
+            else:
+                ranges, _ = split_ranges(spans, chunk_bytes)
+                spec = None
+            flat.extend(ranges)
+            counts.append((rg, c, len(ranges), spec))
+    return flat, counts
+
+
+def _assemble_window(columns, plans, w, ci, it):
+    """Reassemble one window's {column: device array} dict from its
+    ``counts`` records (``ci``) and streamed buffers (``it``) — the
+    consumer half of :func:`_plan_window_ranges`, shared by the serial
+    and parallel scans."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    parts: dict = {c: [] for c in columns}
+    specs: dict = {c: [] for c in columns}
+    merged_any = {c: False for c in columns}
+    sizes = {c: 0 for c in columns}     # buffer bytes so far
+    for rg in w:
+        for c in columns:
+            _, _, n, spec = next(ci)
+            got = [next(it) for _ in range(n)]
+            base = sizes[c]
+            if spec is not None:
+                merged_any[c] = True
+                specs[c].extend((base + o, ln)
+                                for o, ln in spec)
+            else:
+                # unmerged chunks are pure value bytes: they
+                # enter the buffer verbatim, and the spec keeps
+                # them in case a SIBLING row group merged
+                pos = 0
+                for p in got:
+                    specs[c].append((base + pos,
+                                     int(p.shape[0])))
+                    pos += int(p.shape[0])
+            parts[c].extend(got)
+            sizes[c] += sum(int(p.shape[0]) for p in got)
+    out = {}
+    for c in columns:
+        np_dtype = np.dtype(
+            _NP_DTYPES[plans[c][w[0]].physical_type])
+        ps = parts[c]
+        if not ps:         # zero-row window
+            out[c] = jnp.zeros((0,), dtype=np_dtype)
+            continue
+        buf = ps[0] if len(ps) == 1 else jnp.concatenate(ps)
+        if merged_any[c]:
+            buf = _degap(tuple(specs[c]), int(buf.shape[0]))(buf)
+        out[c] = buf.view(np_dtype)
+    return out
 
 
 #: tolerated header/gap overhead when streaming a column chunk's

@@ -120,6 +120,12 @@ CONTRACT = {
     # aggressor-only sheds in the tag are the claim, alternating
     # trials with medians) — an attribution row, no ratio bar
     22: ("tenant-isolation-storm", "attr"),
+    # partition-parallel pushdown SQL scan pairs with its own same-run
+    # serial and parallel-only arms (the ≥2× speedup at 10% selectivity
+    # with bytes_skipped>0 and the bit-identity verdict in the tag are
+    # the claim; scan-stage timed, full group-by checked untimed) — an
+    # attribution row, no ratio bar
+    23: ("sql-parallel-pushdown", "attr"),
 }
 
 #: the ONE validity rule set, shared with the watcher's coverage

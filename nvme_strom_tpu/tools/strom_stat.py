@@ -135,6 +135,16 @@ _TENANT_COUNTERS = (
     "tenant_borrows", "tenant_slo_boosts", "tenant_storm_dumps",
 )
 
+#: Direct SQL pushdown-scan counters (sql/scan_plan.py —
+#: docs/PERF.md §8); own block, shown only when a pushdown-planned
+#: scan ran: the zone-map eliminations and never-fetched pages are the
+#: scan's win made visible (bytes_skipped = bytes that never left the
+#: SSD, projection-aware)
+_SQL_COUNTERS = (
+    "sql_scans", "sql_parallel_scans", "sql_rowgroups_scanned",
+    "sql_rowgroups_skipped", "sql_pages_skipped", "sql_bytes_skipped",
+)
+
 #: every counter block above, in render order — the counter-drift CI
 #: check (tests/test_observability.py) asserts the union covers ALL of
 #: StromStats.COUNTER_FIELDS, so a new counter cannot silently vanish
@@ -143,7 +153,7 @@ ALL_COUNTER_BLOCKS = (
     _COUNTERS, _RESILIENCE_COUNTERS, _INTEGRITY_COUNTERS,
     _BATCH_COUNTERS, _ENGINE_COUNTERS, _SCHED_COUNTERS,
     _HOSTCACHE_COUNTERS, _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
-    _LEDGER_COUNTERS, _ICI_COUNTERS, _TENANT_COUNTERS,
+    _LEDGER_COUNTERS, _ICI_COUNTERS, _TENANT_COUNTERS, _SQL_COUNTERS,
 )
 
 
@@ -416,6 +426,19 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
                 f"evicted={int(blk.get('quota_evictions', 0))} "
                 f"boosts={int(blk.get('slo_boosts', 0))} "
                 f"hedges={int(blk.get('hedges_issued', 0))}")
+    if any(int(snap.get(n, 0)) for n in _SQL_COUNTERS):
+        lines.append("  sql scan (pushdown-planned direct scans — "
+                     "docs/PERF.md §8):")
+        for name in _SQL_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if name == "sql_bytes_skipped" else v
+            lines.append(f"    {name:<24} {shown:>14}")
+        scanned = int(snap.get("sql_rowgroups_scanned", 0))
+        skipped = int(snap.get("sql_rowgroups_skipped", 0))
+        if scanned + skipped:
+            lines.append(
+                f"    {'zone-map elimination':<24} "
+                f"{100.0 * skipped / (scanned + skipped):>13.1f}%")
     if any(int(snap.get(n, 0)) for n in _OBS_COUNTERS):
         lines.append("  observability (tracer / flight recorder):")
         for name in _OBS_COUNTERS:

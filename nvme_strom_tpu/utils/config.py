@@ -120,7 +120,7 @@ class SchedConfig:
     #: wedge admission.
     max_inflight_per_ring: int = field(
         default_factory=lambda: _env_int("STROM_SCHED_INFLIGHT", 0))
-    #: "decode=8,restore=4,prefetch=2,scrub=1" — overrides the default
+    #: "decode=8,restore=4,prefetch=2,scan=2,scrub=1" — overrides the default
     #: class weights (io/sched.py DEFAULT_POLICIES)
     class_weights: str = field(
         default_factory=lambda: os.environ.get("STROM_CLASS_WEIGHTS", ""))
@@ -153,7 +153,7 @@ class HostCacheConfig:
     #: that touches the tier); must be a power of two >= 4096
     line_bytes: int = field(
         default_factory=lambda: _env_int("STROM_HOSTCACHE_LINE_BYTES", 0))
-    #: "decode=8,restore=4,prefetch=2,scrub=1" — per-QoS-class residency
+    #: "decode=8,restore=4,prefetch=2,scan=2,scrub=1" — per-QoS-class residency
     #: quota weights (normalized over the budget); empty = the QoS
     #: scheduler's stock class weights, so the two layers agree on
     #: relative generosity by default

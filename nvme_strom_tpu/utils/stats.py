@@ -268,6 +268,23 @@ class StromStats:
     tenant_slo_boosts: int = 0
     # flight-recorder dumps triggered by a tenant's shed/borrow storm
     tenant_storm_dumps: int = 0
+    # -- Direct SQL pushdown scans (sql/scan_plan.py, docs/PERF.md §8) ----
+    # pushdown-planned scans (one per plan_scan call — each WHERE-ranged
+    # sql_groupby/sql_scalar_agg/union scan with pushdown on)
+    sql_scans: int = 0
+    # row groups that survived zone-map planning and were read
+    sql_rowgroups_scanned: int = 0
+    # row groups provably excluded by min/max statistics before any
+    # NVMe command was issued
+    sql_rowgroups_skipped: int = 0
+    # selected-column compressed bytes that never left the SSD: skipped
+    # row groups' chunks plus late-materialization's skipped pages
+    sql_bytes_skipped: int = 0
+    # payload pages never fetched because no row in their range
+    # survived the predicate mask (late materialization)
+    sql_pages_skipped: int = 0
+    # scans that fanned windows across the partition-parallel pool
+    sql_parallel_scans: int = 0
     _lock: threading.Lock = field(
         default_factory=lambda: make_lock("stats.StromStats._lock"),
         repr=False)

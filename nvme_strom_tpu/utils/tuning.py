@@ -143,6 +143,47 @@ def best_sql_fold(path: str | None = None) -> dict | None:
     return best
 
 
+_SQL_WORKERS = re.compile(r"workers=(\d+)")
+
+
+def best_sql_workers(path: str | None = None) -> int | None:
+    """Ledgered best partition-parallel scan worker count, or None.
+
+    bench_suite config 23 stamps every row's metric with ``workers=N``
+    (the sql/scan_plan.py fan-out width it measured); the winner by
+    measured GiB/s among VALID rows with a credible ceiling ratio
+    (≤1.05, same bar as best_sql_fold) becomes the auto operating
+    point of STROM_SQL_WORKERS=0 consumers.  An explicit non-zero
+    STROM_SQL_WORKERS always wins; STROM_BENCH_AUTO_TUNE=0 opts out."""
+    if os.environ.get("STROM_BENCH_AUTO_TUNE", "1") == "0":
+        return None
+    best, best_rate = None, 0.0
+    for r in _iter_results("suite_23", path or _LEDGER):
+        m = _SQL_WORKERS.search(str(r.get("metric", "")))
+        if not m:
+            continue
+        vb = r.get("vs_baseline")
+        if vb is None or not 0 < vb <= 1.05:
+            continue
+        rate = r.get("value") or 0.0
+        if rate > best_rate:
+            best_rate = rate
+            best = int(m.group(1))
+    return best
+
+
+def tuned_sql_workers() -> int:
+    """Resolved partition-parallel scan width for STROM_SQL_WORKERS=0
+    (auto): the best credible ledgered width when config 23 has posted
+    one, else a conservative CPU-derived default — enough workers to
+    keep several QoS-class streams in flight without oversubscribing
+    the submission path on a small box."""
+    best = best_sql_workers()
+    if best is not None and best >= 1:
+        return best
+    return max(1, min(4, (os.cpu_count() or 2) // 2))
+
+
 def best_attn_blocks(q_seq: int, kv_seq: int,
                      path: str | None = None) -> tuple[int, int] | None:
     """Ledgered best flash-attention (block_q, block_k) for the probed

@@ -1,0 +1,375 @@
+"""Partition-parallel, pushdown-planned Direct SQL scan tests
+(sql/scan_plan.py): zone-map row-group skipping, late materialization,
+parallel==serial bit-identity, and the exact pre-pushdown fallback.
+
+The acceptance contract of PR 18: with STROM_SQL_WORKERS=1 and
+STROM_SQL_PUSHDOWN=0 the scan is bit-for-bit the pre-PR stack; every
+other mode must produce byte-identical results while skipping provably
+dead row groups / pages before any NVMe command.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from nvme_strom_tpu.io import StromEngine
+from nvme_strom_tpu.sql import ParquetScanner, sql_groupby
+from nvme_strom_tpu.sql import scan_plan
+from nvme_strom_tpu.utils.config import EngineConfig
+from nvme_strom_tpu.utils.stats import StromStats
+
+
+@pytest.fixture()
+def engine():
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                       buffer_pool_bytes=16 << 20)
+    with StromEngine(cfg, stats=StromStats()) as e:
+        yield e
+
+
+@pytest.fixture()
+def sorted_pq(tmp_path):
+    """Monotone int32 ``ts`` (tight disjoint per-row-group zone maps —
+    provable elimination) + int32 key + float32 payload; uncompressed
+    PLAIN so the direct page-walk path applies end to end."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(7)
+    n = 120_000
+    tbl = pa.table({
+        "k": rng.integers(0, 32, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+        "ts": np.arange(n, dtype=np.int32),
+    })
+    path = tmp_path / "sorted.parquet"
+    pq.write_table(tbl, path, row_group_size=8192, compression="none",
+                   use_dictionary=False)
+    return path, tbl
+
+
+def _groupby(engine, path, wr, aggs=("count", "sum", "min", "max")):
+    sc = ParquetScanner(path, engine)
+    out = sql_groupby(sc, "k", "v", 32, aggs=aggs, where_ranges=wr)
+    return {a: np.asarray(x) for a, x in out.items()}
+
+
+def _run_mode(path, wr, workers, pushdown, window=None):
+    """One scan under explicit knobs on a FRESH engine+stats (so the
+    sql_* counters attribute to exactly this scan)."""
+    env = {"STROM_SQL_WORKERS": str(workers),
+           "STROM_SQL_PUSHDOWN": str(pushdown)}
+    if window is not None:
+        env["STROM_SQL_WINDOW_BYTES"] = str(window)
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        st = StromStats()
+        cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                           buffer_pool_bytes=16 << 20)
+        with StromEngine(cfg, stats=st) as e:
+            res = _groupby(e, path, wr)
+        return res, st.snapshot()
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _assert_same(a, b, ctx=""):
+    assert a.keys() == b.keys()
+    for name in a:
+        assert np.array_equal(a[name], b[name], equal_nan=True), \
+            (ctx, name, a[name], b[name])
+
+
+# -- pushdown planner (zone maps) -------------------------------------------
+
+
+def test_plan_scan_skips_disjoint_row_groups(engine, sorted_pq):
+    path, tbl = sorted_pq
+    sc = ParquetScanner(path, engine)
+    plan = scan_plan.plan_scan(sc, ["k", "v", "ts"],
+                               [("ts", 40_000, 59_999)])
+    n_rg = sc.num_row_groups
+    assert plan.skipped and plan.row_groups
+    assert len(plan.row_groups) + len(plan.skipped) == n_rg
+    # identical survivors to the exact pre-PR statistics pruning
+    assert list(plan.row_groups) == sc.prune_row_groups(
+        [("ts", 40_000, 59_999)])
+    # projection-aware byte accounting: every skipped group billed
+    assert plan.bytes_skipped > 0 and plan.bytes_selected > 0
+    assert plan.selectivity < 1.0
+    s = engine.stats.snapshot()
+    assert s["sql_scans"] == 1
+    assert s["sql_rowgroups_skipped"] == len(plan.skipped)
+    assert s["sql_rowgroups_scanned"] == len(plan.row_groups)
+    assert s["sql_bytes_skipped"] == plan.bytes_skipped
+
+
+def test_plan_scan_keeps_nan_and_statless_row_groups(engine, tmp_path):
+    """Exclusion requires PROOF: a float row group whose min/max went
+    NaN (pyarrow writes NaN stats for all-NaN pages) and a row group
+    with statistics disabled must both survive any range — NaN
+    comparisons are False and absent stats say nothing."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    x = np.full(4096, np.nan, np.float64)
+    x[:2048] = 5.0
+    t = pa.table({"x": x})
+    p1 = tmp_path / "nanstats.parquet"
+    pq.write_table(t, p1, row_group_size=2048, compression="none",
+                   use_dictionary=False)
+    sc = ParquetScanner(p1, engine)
+    plan = scan_plan.plan_scan(sc, ["x"], [("x", 100.0, 200.0)])
+    # rg0 (all 5.0) is provably out; rg1 (all NaN) must be KEPT
+    assert 1 in plan.row_groups
+
+    p2 = tmp_path / "nostats.parquet"
+    pq.write_table(t, p2, row_group_size=2048, compression="none",
+                   use_dictionary=False, write_statistics=False)
+    sc2 = ParquetScanner(p2, engine)
+    plan2 = scan_plan.plan_scan(sc2, ["x"], [("x", 100.0, 200.0)])
+    assert list(plan2.row_groups) == [0, 1]    # nothing skippable
+    assert not plan2.skipped
+
+
+def test_plan_scan_unknown_column_raises(engine, sorted_pq):
+    path, _ = sorted_pq
+    sc = ParquetScanner(path, engine)
+    with pytest.raises(KeyError):
+        scan_plan.plan_scan(sc, ["k"], [("nope", 0, 1)])
+
+
+# -- parallel == serial bit-identity ----------------------------------------
+
+
+def test_parallel_scan_bit_identical_to_serial(sorted_pq):
+    """Same windowing rule, N workers vs 1: the ordered merge must be
+    bit-identical (float32 accumulation order per window is part of the
+    contract — windows are compared like for like)."""
+    path, _ = sorted_pq
+    base, _ = _run_mode(path, [], workers=1, pushdown=0,
+                        window=256 << 10)
+    for W in (2, 4):
+        got, snap = _run_mode(path, [], workers=W, pushdown=1,
+                              window=256 << 10)
+        _assert_same(base, got, f"W={W}")
+        assert snap["sql_parallel_scans"] == 1
+
+
+def test_parallel_scan_with_predicate_bit_identical(sorted_pq):
+    path, _ = sorted_pq
+    wr = [("ts", 30_000, 89_999)]
+    base, _ = _run_mode(path, wr, workers=1, pushdown=0,
+                        window=256 << 10)
+    got, snap = _run_mode(path, wr, workers=4, pushdown=1,
+                          window=256 << 10)
+    _assert_same(base, got, "parallel+pushdown")
+    assert snap["sql_rowgroups_skipped"] > 0
+    assert snap["sql_bytes_skipped"] > 0
+
+
+def test_selectivity_sweep_late_materialization(sorted_pq):
+    """0% / 50% / 100% selectivity, each under every mode, all equal to
+    ground truth computed with numpy from the original table."""
+    path, tbl = sorted_pq
+    k = tbl.column("k").to_numpy()
+    v = tbl.column("v").to_numpy()
+    ts = tbl.column("ts").to_numpy()
+    n = len(ts)
+    for lo, hi, tag in ((n + 1, None, "0%"), (0, n // 2 - 1, "50%"),
+                        (0, n - 1, "100%")):
+        wr = [("ts", lo, hi)]
+        m = (ts >= lo) if hi is None else ((ts >= lo) & (ts <= hi))
+        want_count = np.bincount(k[m], minlength=32)
+        base, _ = _run_mode(path, wr, workers=1, pushdown=0,
+                            window=256 << 10)
+        assert np.array_equal(base["count"], want_count), tag
+        if m.any():
+            want_sum = np.zeros(32, np.float64)
+            np.add.at(want_sum, k[m], v[m].astype(np.float64))
+            np.testing.assert_allclose(base["sum"], want_sum,
+                                       rtol=1e-3, err_msg=tag)
+        for W, P in ((1, 1), (4, 1)):
+            got, _ = _run_mode(path, wr, workers=W, pushdown=P,
+                               window=256 << 10)
+            _assert_same(base, got, f"{tag} W={W} P={P}")
+
+
+def test_late_materialization_skips_pages(tmp_path):
+    """Multi-page column chunks + a narrow predicate: payload pages
+    with no surviving rows are never fetched (sql_pages_skipped), and
+    the aggregates still match the full fetch bit for bit."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    rng = np.random.default_rng(3)
+    n = 120_000
+    tbl = pa.table({
+        "k": rng.integers(0, 32, n).astype(np.int32),
+        "v": rng.standard_normal(n).astype(np.float32),
+        "ts": np.arange(n, dtype=np.int32),
+    })
+    path = tmp_path / "paged.parquet"
+    # one big row group, tiny pages: the zone map can't skip anything,
+    # ONLY the page-level mask can
+    pq.write_table(tbl, path, row_group_size=n, compression="none",
+                   use_dictionary=False, data_page_size=16 << 10,
+                   write_batch_size=4096)
+    wr = [("ts", 10_000, 19_999)]
+    base, _ = _run_mode(path, wr, workers=1, pushdown=0)
+    got, snap = _run_mode(path, wr, workers=1, pushdown=1)
+    _assert_same(base, got, "late-mat")
+    assert snap["sql_rowgroups_skipped"] == 0   # zone maps powerless
+    assert snap["sql_pages_skipped"] > 0        # pages did the saving
+    assert snap["sql_bytes_skipped"] > 0
+
+
+def test_pre_pr_mode_delegates_to_serial_iterator(sorted_pq,
+                                                  monkeypatch):
+    """STROM_SQL_WORKERS=1 + STROM_SQL_PUSHDOWN=0 must route through
+    groupby.iter_device_columns (the exact pre-PR path) — proven by
+    spying the call, not just by equal results."""
+    import nvme_strom_tpu.sql.groupby as gb
+    path, _ = sorted_pq
+    monkeypatch.setenv("STROM_SQL_WORKERS", "1")
+    monkeypatch.setenv("STROM_SQL_PUSHDOWN", "0")
+    calls = []
+    real = gb.iter_device_columns
+
+    def spy(*a, **kw):
+        calls.append((a, kw))
+        return real(*a, **kw)
+
+    monkeypatch.setattr(gb, "iter_device_columns", spy)
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                       buffer_pool_bytes=16 << 20)
+    st = StromStats()
+    with StromEngine(cfg, stats=st) as e:
+        _groupby(e, path, [("ts", 30_000, 89_999)])
+    assert calls, "pre-PR mode must use the serial iterator"
+    snap = st.snapshot()
+    assert snap["sql_scans"] == 0          # planner never invoked
+    assert snap["sql_parallel_scans"] == 0
+
+
+def test_sql_workers_env_validation(monkeypatch):
+    monkeypatch.setenv("STROM_SQL_WORKERS", "3")
+    assert scan_plan.sql_workers() == 3
+    monkeypatch.setenv("STROM_SQL_WORKERS", "-1")
+    with pytest.raises(ValueError):
+        scan_plan.sql_workers()
+    monkeypatch.setenv("STROM_SQL_WORKERS", "0")
+    assert scan_plan.sql_workers() >= 1    # auto resolves to something
+
+
+def test_worker_error_propagates(sorted_pq, monkeypatch):
+    """A worker crash surfaces to the caller as the original exception,
+    and the pool shuts down (no leaked threads wedging the engine)."""
+    from nvme_strom_tpu.sql import pq_direct
+    path, _ = sorted_pq
+    monkeypatch.setenv("STROM_SQL_WORKERS", "4")
+    monkeypatch.setenv("STROM_SQL_PUSHDOWN", "0")
+    monkeypatch.setenv("STROM_SQL_WINDOW_BYTES", str(256 << 10))
+    real = pq_direct._assemble_window
+
+    def boom(columns, plans, w, ci, it):
+        if w[0] != 0:
+            raise RuntimeError("injected worker fault")
+        return real(columns, plans, w, ci, it)
+
+    monkeypatch.setattr(pq_direct, "_assemble_window", boom)
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                       buffer_pool_bytes=16 << 20)
+    with StromEngine(cfg, stats=StromStats()) as e:
+        with pytest.raises(RuntimeError, match="injected worker fault"):
+            _groupby(e, path, [])
+
+
+# -- QoS: the scan class ----------------------------------------------------
+
+
+def test_scan_class_registered_below_prefetch():
+    from nvme_strom_tpu.io.sched import CLASS_ORDER, default_policies
+    pol = default_policies()
+    assert "scan" in CLASS_ORDER
+    assert pol["scan"].priority > pol["prefetch"].priority
+    assert pol["scan"].priority < pol["scrub"].priority
+
+
+def test_scan_storm_cannot_starve_decode():
+    """Satellite (a) chaos bound: a saturating aggressor scan queue
+    never blocks decode — the top class grants immediately even while
+    scan backlog monopolizes bulk capacity."""
+    from nvme_strom_tpu.io.sched import QoSScheduler
+
+    class _Fake:
+        def __init__(self, slots):
+            self.slots = list(slots)
+
+        def submit_ring(self, spans, ring):
+            return ["pend"] * len(spans)
+
+        def ring_free(self):
+            return list(self.slots)
+
+    fake = _Fake([4])
+    s = QoSScheduler(fake.submit_ring, fake.ring_free, ring_cap=4)
+    storm = [s.enqueue([("scan", i, 1)], "scan") for i in range(64)]
+    s.step()
+    assert any(b.granted for b in storm)       # scan IS being served
+    bd = s.enqueue([("decode", 0, 1)], "decode")
+    s.step()
+    assert bd.granted, "decode starved behind an aggressor scan"
+
+
+def test_scan_reads_ride_scan_class(engine, sorted_pq, monkeypatch):
+    """Every payload read of a pushdown scan submits at the dedicated
+    scan class (QoS attribution — satellite (a))."""
+    from nvme_strom_tpu.ops import bridge
+    path, _ = sorted_pq
+    monkeypatch.setenv("STROM_SQL_WORKERS", "1")
+    monkeypatch.setenv("STROM_SQL_PUSHDOWN", "1")
+    seen = []
+    real = bridge.submit_spans_tiered
+
+    def spy(eng, spans, klass=None, **kw):
+        seen.append(klass)
+        return real(eng, spans, klass=klass, **kw)
+
+    monkeypatch.setattr(bridge, "submit_spans_tiered", spy)
+    _groupby(engine, path, [("ts", 30_000, 89_999)])
+    assert seen and all(k == "scan" for k in seen), seen
+
+
+def test_tenant_context_reaches_scan_workers(sorted_pq, monkeypatch):
+    """Satellite (a): workers run under a COPY of the caller's
+    contextvars context, so current_tenant() inside every worker thread
+    is the scan's tenant — per-batch tenant capture in the scheduler
+    sees parallel analytics traffic exactly like serial traffic."""
+    import nvme_strom_tpu.sql.scan_plan as sp
+    from nvme_strom_tpu.io.tenants import (Tenant, current_tenant,
+                                           tenant_context)
+    path, _ = sorted_pq
+    monkeypatch.setenv("STROM_SQL_WORKERS", "4")
+    monkeypatch.setenv("STROM_SQL_PUSHDOWN", "0")
+    monkeypatch.setenv("STROM_SQL_WINDOW_BYTES", str(256 << 10))
+    seen = []
+    real = sp._worker_stream
+
+    def spy(scanner, dev, workers=1):
+        seen.append(current_tenant())          # runs IN the worker
+        return real(scanner, dev, workers)
+
+    monkeypatch.setattr(sp, "_worker_stream", spy)
+    t = Tenant("analytics")
+    cfg = EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                       buffer_pool_bytes=16 << 20)
+    with StromEngine(cfg, stats=StromStats()) as e:
+        with tenant_context(t):
+            _groupby(e, path, [])
+    workers_seen = [x for x in seen]
+    assert len(workers_seen) >= 2              # pool actually fanned
+    assert all(x is t for x in workers_seen), workers_seen
