@@ -63,14 +63,29 @@ def _gc_min_age() -> float:
 
 
 _KVMAN_SUFFIX = ".kvman.json"
+# hostcache warmup-hint sidecars (io/warmup.py) ride the exact same
+# orphan rules: same age gate, same sweeper, a second suffix
+_WARMHINT_SUFFIX = ".warmhints.json"
+_SIDECAR_SUFFIXES = (_KVMAN_SUFFIX, _WARMHINT_SUFFIX)
 
 
-def find_orphan_manifests(root: str, recursive: bool = True) -> list:
-    """Serving KV prefix-store manifests (models/kv_offload.py) whose
-    page file is gone — a deleted or crash-torn store's debris.
+def _is_orphan_sidecar(path: str, name: str, suffixes) -> bool:
+    for suf in suffixes:
+        if name.endswith(suf):
+            return not os.path.exists(path[:-len(suf)])
+    return False
+
+
+def find_orphan_manifests(root: str, recursive: bool = True,
+                          suffixes=_SIDECAR_SUFFIXES) -> list:
+    """Sidecar manifests whose base file is gone — a deleted or
+    crash-torn store's debris.  Covers the serving KV prefix-store
+    manifest (``.kvman.json``, models/kv_offload.py) and the hostcache
+    warmup-hint list (``.warmhints.json``, io/warmup.py): a stale hint
+    file would mis-warm the next boot, so it follows the same rules.
     ``recursive=False`` scans only ``root`` itself (the manager's
     startup scope: cheap on huge checkpoint trees; ``strom-scrub``
-    applies the same missing-page-file verdict inline during its own
+    applies the same missing-base-file verdict inline during its own
     full walk, and both sweepers remove via
     :func:`sweep_orphan_manifests` so the age-gate semantics can never
     diverge)."""
@@ -80,8 +95,7 @@ def find_orphan_manifests(root: str, recursive: bool = True) -> list:
             dirnames[:] = [d for d in dirnames if not _TMP_RE.match(d)]
             for name in filenames:
                 p = os.path.join(dirpath, name)
-                if (name.endswith(_KVMAN_SUFFIX)
-                        and not os.path.exists(p[:-len(_KVMAN_SUFFIX)])):
+                if _is_orphan_sidecar(p, name, suffixes):
                     out.append(p)
     else:
         try:
@@ -90,8 +104,7 @@ def find_orphan_manifests(root: str, recursive: bool = True) -> list:
             return []
         for name in names:
             p = os.path.join(root, name)
-            if (name.endswith(_KVMAN_SUFFIX)
-                    and not os.path.exists(p[:-len(_KVMAN_SUFFIX)])):
+            if _is_orphan_sidecar(p, name, suffixes):
                 out.append(p)
     return sorted(out)
 

@@ -1,0 +1,215 @@
+"""Elastic cold-start coordinator: the boot-phase state machine behind
+serve-while-restoring (docs/RESILIENCE.md "Elastic cold-start").
+
+A replica that crashes, restarts, or scales out should take traffic in
+seconds.  With ``STROM_COLDSTART=1`` the serving stack starts before its
+weights are resident: requests that touch a missing tensor demand-fault
+it at ``decode`` class (ahead of everything else the QoS scheduler
+holds), the bulk of the checkpoint streams in behind them at ``restore``
+class, and warm-state manifests — the ``.kvman.json`` KV prefix index
+plus the ``.warmhints.json`` hostcache hint list (io/warmup.py) — are
+prefetched at ``prefetch`` class.  This module owns the small state
+machine that ties those lanes together and makes the progression
+observable:
+
+    cold ──serving started──▶ faulting ──weights resident──▶ warming
+                                                            │
+                                            warmup drained  ▼
+                                                          steady
+
+* ``cold``     — process up, server not yet accepting work.
+* ``faulting`` — serving; any request may demand-fault weights.  The
+  coldstart_stall flight-recorder trigger is armed only here: if the
+  demand-fault p99 exceeds ``ColdStartConfig.fault_slo_ms`` the
+  coordinator dumps ``reason=coldstart_stall`` with the boot phase and
+  the scheduler's per-class backlog in the extra payload.
+* ``warming``  — all weights resident; background warmup thunks (KV
+  page re-reads, hostcache hint prefetch) drain at ``prefetch`` class.
+* ``steady``   — warmup drained; the replica is indistinguishable from
+  one that never restarted.
+
+The phase is exported as the ``boot_phase`` gauge through StromStats →
+strom_stat/strom-top/debugsrv ``/health``, and a supervisor
+degraded-mode listener counts brown-outs that land mid-cold-start
+(``coldstart_brownouts``) — the evidence that a ring failure during the
+restore stream was absorbed, not surfaced.
+
+Locking: ``coldstart.ColdStartCoordinator._lock`` is a leaf-facing
+coordinator lock (group ``coldstart`` in analysis/lock_order.conf).
+Engine work — flight dumps, scheduler introspection, warmup I/O — runs
+OUTSIDE the lock; only phase/word-size state mutates under it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from nvme_strom_tpu.utils.config import ColdStartConfig
+from nvme_strom_tpu.utils.lockwitness import make_lock
+
+#: boot phases in order; index = numeric gauge code
+PHASES = ("cold", "faulting", "warming", "steady")
+
+
+class ColdStartCoordinator:
+    """Tracks one replica's boot progression and arms the stall dump.
+
+    Thread-safe; every serving/weights/warmup actor calls in from its
+    own thread.  All note_* methods are cheap and safe to call with the
+    feature off (they no-op once ``steady`` is reached).
+    """
+
+    def __init__(self, engine=None,
+                 cfg: Optional[ColdStartConfig] = None) -> None:
+        self.cfg = cfg or ColdStartConfig()
+        self.engine = engine
+        self._lock = make_lock("coldstart.ColdStartCoordinator._lock")
+        self._phase = "cold"
+        self._t0 = time.monotonic()
+        self._t_phase: Dict[str, float] = {"cold": 0.0}
+        # rolling demand-fault latencies (ms), bounded by fault_window
+        self._fault_ms: List[float] = []
+        # warmup thunks registered before warming; drained by _warm_run
+        self._warmups: List[Callable[[], None]] = []
+        self._warm_thread: Optional[threading.Thread] = None
+        self._degraded_seen = False
+        if engine is not None:
+            sup = getattr(engine, "supervisor", None)
+            if sup is not None and hasattr(sup, "add_degraded_listener"):
+                sup.add_degraded_listener(self._on_degraded)
+
+    # -- phase machine -----------------------------------------------------
+
+    @property
+    def phase(self) -> str:
+        with self._lock:
+            return self._phase
+
+    def phase_times(self) -> Dict[str, float]:
+        """Seconds-from-boot each phase was entered (diagnostics)."""
+        with self._lock:
+            return dict(self._t_phase)
+
+    def _advance(self, new: str) -> bool:
+        """Move forward only — a late note from a slow thread never
+        rewinds the machine.  Returns True on a real transition."""
+        with self._lock:
+            if PHASES.index(new) <= PHASES.index(self._phase):
+                return False
+            self._phase = new
+            self._t_phase[new] = round(time.monotonic() - self._t0, 6)
+        self._export_gauge()
+        return True
+
+    def _export_gauge(self) -> None:
+        stats = getattr(self.engine, "stats", None)
+        if stats is not None:
+            ph = self.phase
+            stats.set_gauges(boot_phase=ph,
+                             boot_phase_code=PHASES.index(ph))
+
+    def note_serving_started(self) -> None:
+        """The server is accepting submissions (weights may be cold)."""
+        self._advance("faulting")
+
+    def note_weights_resident(self) -> None:
+        """Every tensor is device-resident (bulk restore + demand
+        faults have fully met); kick the background warmup drain."""
+        if not self._advance("warming"):
+            return
+        with self._lock:
+            thunks, self._warmups = self._warmups, []
+        if not thunks:
+            self._advance("steady")
+            return
+        t = threading.Thread(target=self._warm_run, args=(thunks,),
+                             name="strom-coldstart-warmup", daemon=True)
+        with self._lock:
+            self._warm_thread = t
+        t.start()
+
+    def add_warmup(self, fn: Callable[[], None]) -> None:
+        """Register a warming-phase thunk (KV page re-read, hostcache
+        hint prefetch).  If warming already started, run inline — the
+        caller is late, not wrong."""
+        with self._lock:
+            if self._phase in ("cold", "faulting"):
+                self._warmups.append(fn)
+                return
+        try:
+            fn()
+        except Exception:
+            pass
+
+    def _warm_run(self, thunks: List[Callable[[], None]]) -> None:
+        for fn in thunks:
+            try:
+                fn()
+            except Exception:
+                # warmup is best-effort by definition: a failed hint
+                # prefetch costs future cache hits, never correctness
+                pass
+        self._advance("steady")
+
+    def wait_steady(self, timeout: Optional[float] = None) -> bool:
+        """Block until the warmup drain finishes (tests/benches)."""
+        with self._lock:
+            t = self._warm_thread
+        if t is not None:
+            t.join(timeout)
+        return self.phase == "steady"
+
+    # -- stall trigger -----------------------------------------------------
+
+    def note_fault_ms(self, ms: float) -> None:
+        """Record one demand-fault service time; during the faulting
+        phase a rolling-p99 SLO violation trips the flight recorder."""
+        slo = self.cfg.fault_slo_ms
+        with self._lock:
+            if self._phase != "faulting":
+                return
+            self._fault_ms.append(float(ms))
+            if len(self._fault_ms) > self.cfg.fault_window:
+                del self._fault_ms[:-self.cfg.fault_window]
+            if slo <= 0.0 or len(self._fault_ms) < 8:
+                return
+            window = sorted(self._fault_ms)
+            p99 = window[min(len(window) - 1,
+                             int(0.99 * len(window)))]
+            if p99 <= slo:
+                return
+            degraded = self._degraded_seen
+        self._stall_dump(p99, degraded)
+
+    def _stall_dump(self, p99_ms: float, degraded: bool) -> None:
+        flight = getattr(self.engine, "flight", None)
+        if flight is None:
+            return
+        sched = getattr(self.engine, "scheduler", None)
+        backlog = sched.backlog() if sched is not None else {}
+        path = flight.dump("coldstart_stall", extra={
+            "boot_phase": self.phase,
+            "fault_p99_ms": round(p99_ms, 3),
+            "fault_slo_ms": self.cfg.fault_slo_ms,
+            "backlog": backlog,
+            "browned_out": degraded,
+        })
+        stats = getattr(self.engine, "stats", None)
+        if path is not None and stats is not None:
+            stats.add(coldstart_stall_dumps=1)
+
+    # -- supervisor listener ------------------------------------------------
+
+    def _on_degraded(self, on: bool) -> None:
+        if not on:
+            return
+        count = False
+        with self._lock:
+            self._degraded_seen = True
+            count = self._phase != "steady"
+        if count:
+            stats = getattr(self.engine, "stats", None)
+            if stats is not None:
+                stats.add(coldstart_brownouts=1)

@@ -236,6 +236,12 @@ class EngineSupervisor:
         #: never close its device breaker
         self._probe_hint: Optional[tuple] = None
         self._closed = False
+        #: degraded-transition observers, called OUTSIDE the lock with
+        #: the new state (True = brown-out entered, False = recovered).
+        #: The cold-start coordinator registers here to count brown-outs
+        #: that land mid-restore (io/coldstart.py); listeners must be
+        #: cheap and must not raise into the breaker path.
+        self._degraded_listeners: list = []
 
     # -- cheap queries (hot paths read these without the lock) -------------
 
@@ -436,6 +442,7 @@ class EngineSupervisor:
                               **({"tenant_stats": tenants}
                                  if tenants else {}))
             self._export_gauges(stats)
+            self._notify_degraded(True)
 
     def _recover(self, stats) -> None:
         """A half-open probe succeeded: restore the fast path.  Open
@@ -453,6 +460,19 @@ class EngineSupervisor:
                     rb.half_open_at = now
                 rb.window.clear()
             self._export_gauges(stats)
+        self._notify_degraded(False)
+
+    def add_degraded_listener(self, fn) -> None:
+        """Register an observer of device-breaker transitions (called
+        with True on brown-out entry, False on recovery)."""
+        self._degraded_listeners.append(fn)
+
+    def _notify_degraded(self, on: bool) -> None:
+        for fn in list(self._degraded_listeners):
+            try:
+                fn(on)
+            except Exception:
+                pass   # an observer must never wedge the breaker
 
     def _export_gauges(self, stats) -> None:
         if stats is not None:

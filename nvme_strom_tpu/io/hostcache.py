@@ -454,6 +454,28 @@ class HostCache:
                 "tenant_slots": dict(self._tenant_slots),
             }
 
+    def resident_spans(self, fkey: tuple) -> list:
+        """Merged ``(offset, length)`` spans of one file currently
+        resident in the cache, largest-first — the raw material for a
+        ``.warmhints.json`` warmup manifest (io/warmup.py): the next
+        boot prefetches exactly these byte ranges at ``prefetch`` class
+        and lands at today's hit rate instead of re-learning it."""
+        with self._lock:
+            raw = sorted((key[1], line.valid)
+                         for key, line in self._lines.items()
+                         if key[0] == fkey and line.valid > 0
+                         and not line.dead)
+        merged: list = []
+        for off, ln in raw:
+            if merged and off <= merged[-1][0] + merged[-1][1]:
+                last_off, last_len = merged[-1]
+                merged[-1] = (last_off,
+                              max(last_len, off + ln - last_off))
+            else:
+                merged.append((off, ln))
+        merged.sort(key=lambda s: (-s[1], s[0]))
+        return merged
+
     def _klass(self, klass: Optional[str]) -> str:
         return klass if klass in self.quota_slots else DEFAULT_CLASS
 

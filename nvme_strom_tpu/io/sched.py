@@ -317,6 +317,23 @@ class QoSScheduler:
         with self._lock:
             return {k: len(q) for k, q in self._queues.items()}
 
+    def backlog(self) -> Dict[str, Dict[str, float]]:
+        """Per-class queue depth with span counts and oldest wait — the
+        richer sibling of queued(), built for post-mortem payloads (the
+        coldstart_stall flight dump records which lane was starving)."""
+        now = time.monotonic()
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for k, q in self._queues.items():
+                if not q:
+                    continue
+                out[k] = {
+                    "batches": len(q),
+                    "spans": sum(len(b.spans) for b in q),
+                    "oldest_wait_s": round(now - q[0].t_enq, 6),
+                }
+        return out
+
     def set_weight(self, klass: str, weight: float) -> None:
         """Adjust one class's fair-share weight at runtime — the SLO
         governor's scheduler lever (docs/PERF.md §5): a decode-path p99

@@ -1,0 +1,159 @@
+"""Hostcache warm-state manifests: ``.warmhints.json`` sidecars
+(docs/RESILIENCE.md "Elastic cold-start").
+
+A long-running replica's pinned-DRAM cache (io/hostcache.py) encodes
+hours of learned access pattern — which weight tiles, KV pages, and
+scan windows the workload actually re-reads.  A restart throws that
+away; a scaled-out replica never had it.  This module makes the warm
+state portable: :func:`collect_warm_hints` snapshots one file's
+resident spans into an atomically-published ``<path>.warmhints.json``
+sidecar, and :func:`prefetch_hints` replays the manifest through the
+normal engine read path at ``prefetch`` class with ``hot=True`` during
+the cold-start ``warming`` phase — so the lines are re-filled (and
+hot-pinned) behind live traffic, and the new replica reaches
+steady-state hit rates in minutes, not hours.
+
+Hygiene (the part that makes hints safe to trust):
+
+* The manifest records the base file's size and mtime_ns; a hint list
+  written against yesterday's file loads as empty rather than warming
+  the wrong bytes.
+* Writes go through the one atomic temp+rename primitive
+  (:func:`~nvme_strom_tpu.utils.stats._atomic_write_text`) — a crash
+  mid-publish leaves the old manifest or none, never a torn one.
+* Orphans (hint file outliving its base) are swept by the same
+  age-gated GC as ``.kvman.json`` (checkpoint/manager.py,
+  ``strom-scrub --gc``) so a crashed replica never leaves debris that
+  mis-warms the next boot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from nvme_strom_tpu.io.plan import plan_and_submit
+from nvme_strom_tpu.utils.stats import _atomic_write_text
+
+#: manifest sidecar suffix; checkpoint/manager.py lists it next to
+#: ``.kvman.json`` in its orphan sweep
+WARMHINT_SUFFIX = ".warmhints.json"
+
+_VERSION = 1
+
+
+def hint_path(path: str) -> str:
+    """``<path>.warmhints.json`` — the sidecar location for ``path``."""
+    return path + WARMHINT_SUFFIX
+
+
+def collect_warm_hints(engine, path: str,
+                       max_spans: int = 1024) -> Optional[str]:
+    """Snapshot ``path``'s hostcache-resident spans into its sidecar.
+
+    Returns the manifest path, or None when there is nothing worth
+    writing (cache tier off, file unknown, no resident spans, or a
+    zero budget).  Spans come back from the cache largest-first, so
+    trimming to ``max_spans`` keeps the ranges that buy the most DRAM
+    hits on the next boot.
+    """
+    if max_spans <= 0:
+        return None
+    from nvme_strom_tpu.io import hostcache as _hc
+    cache = _hc._cache
+    if cache is None:
+        return None
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    fkey = (st.st_dev, st.st_ino, st.st_mtime_ns, st.st_size)
+    spans = cache.resident_spans(fkey)[:max_spans]
+    if not spans:
+        return None
+    out = hint_path(path)
+    write_warm_hints(out, spans, size=st.st_size,
+                     mtime_ns=st.st_mtime_ns)
+    return out
+
+
+def write_warm_hints(manifest: str, spans: Sequence[Tuple[int, int]], *,
+                     size: int, mtime_ns: int) -> None:
+    """Atomically publish a hint manifest (temp + rename: readers see
+    the old list or the new one, never a prefix)."""
+    doc = {
+        "version": _VERSION,
+        "size": int(size),
+        "mtime_ns": int(mtime_ns),
+        "spans": [[int(o), int(n)] for o, n in spans],
+    }
+    _atomic_write_text(manifest, json.dumps(doc, sort_keys=True))
+
+
+def load_warm_hints(path: str) -> List[Tuple[int, int]]:
+    """Load ``path``'s hint spans, validating the manifest against the
+    CURRENT file: a missing, corrupt, version-skewed, or stale sidecar
+    (base file rewritten since the snapshot) yields ``[]`` — a cold
+    boot, never a mis-warmed one."""
+    manifest = hint_path(path)
+    try:
+        with open(manifest, "r") as f:
+            doc = json.load(f)
+        st = os.stat(path)
+    except (OSError, ValueError):
+        return []
+    if (not isinstance(doc, dict)
+            or doc.get("version") != _VERSION
+            or doc.get("size") != st.st_size
+            or doc.get("mtime_ns") != st.st_mtime_ns):
+        return []
+    spans = []
+    for item in doc.get("spans", []):
+        try:
+            off, ln = int(item[0]), int(item[1])
+        except (TypeError, ValueError, IndexError):
+            return []
+        if off < 0 or ln <= 0 or off + ln > st.st_size:
+            return []
+        spans.append((off, ln))
+    return spans
+
+
+def prefetch_hints(engine, path: str,
+                   spans: Optional[Sequence[Tuple[int, int]]] = None,
+                   klass: str = "prefetch") -> int:
+    """Replay a hint manifest through the engine at ``prefetch`` class
+    with ``hot=True`` (fills hot-pin their lines, mirroring the KV
+    decode path) and wait for completion.  Returns the span count
+    prefetched; best-effort — any failure warms less, never errors."""
+    if spans is None:
+        spans = load_warm_hints(path)
+    if not spans:
+        return 0
+    warmed = 0
+    try:
+        fh = engine.open(path)
+        try:
+            per_extent = plan_and_submit(
+                engine, [(fh, off, ln) for off, ln in spans],
+                klass=klass, hot=True)
+            for pieces in per_extent:
+                done = True
+                for piece in pieces:
+                    try:
+                        piece.wait()
+                    except Exception:
+                        done = False
+                    finally:
+                        piece.release()
+                if done and pieces:
+                    warmed += 1
+        finally:
+            engine.close(fh)
+    except Exception:
+        pass
+    stats = getattr(engine, "stats", None)
+    if stats is not None and warmed:
+        stats.add(coldstart_warm_spans=warmed)
+    return warmed

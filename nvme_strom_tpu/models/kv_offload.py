@@ -1588,6 +1588,63 @@ class PrefixStore:
                 over.add(tid)
         return over
 
+    # -- cold-start warmup (docs/RESILIENCE.md "Elastic cold-start") -------
+
+    def warm_pages(self, budget_pages: int = 256) -> int:
+        """Re-read the top-benefit resident pages at ``prefetch`` class
+        with ``hot=True`` — the cold-start warming thunk.  A replica
+        that just reattached a manifest has every page on NVMe but
+        nothing in the pinned-DRAM tier; replaying the highest
+        ``hits``-weighted pages fills (and hot-pins) their cache lines
+        behind live traffic, so the first real restore of a popular
+        prefix is a DRAM hit instead of an NVMe read.  Best-effort:
+        failures warm less, never error; returns pages warmed."""
+        if budget_pages <= 0:
+            return 0
+        with self._lock:
+            if self._closed:
+                return 0
+            ranked = sorted(
+                ((e["hits"], e["seq"], kx, e)
+                 for kx, e in self._entries.items() if e["ready"]),
+                reverse=True)[:budget_pages]
+            for _h, _s, _k, e in ranked:
+                e["pins"] += 1
+            self._io_inflight += 1
+        warmed = 0
+        try:
+            from nvme_strom_tpu.io.plan import plan_and_submit
+            self._drain_writes()
+            extents = [(self._fh, e["page"] * self.page_bytes,
+                        self.page_bytes) for _h, _s, _k, e in ranked]
+            if extents:
+                planned = plan_and_submit(self.engine, extents,
+                                          klass="prefetch", hot=True)
+                for pieces in planned:
+                    ok = bool(pieces)
+                    for p in pieces:
+                        try:
+                            p.wait()
+                        except OSError:
+                            ok = False
+                        finally:
+                            p.release()
+                    if ok:
+                        warmed += 1
+        except OSError:
+            pass
+        finally:
+            with self._lock:
+                for _h, _s, _k, e in ranked:
+                    self._unpin_locked(e)
+            with self._io_cv:
+                self._io_inflight -= 1
+                if self._io_inflight == 0:
+                    self._io_cv.notify_all()
+        if warmed and self.stats is not None:
+            self.stats.add(coldstart_warm_pages=warmed)
+        return warmed
+
     # -- durable manifest (the scrub contract) -----------------------------
 
     @property

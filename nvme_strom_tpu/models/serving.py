@@ -250,6 +250,28 @@ class DecodeServer:
     def __init__(self, params: Dict, cfg: TransformerConfig,
                  max_batch: int, max_len: int, cache_attn="auto",
                  kv_store=None, shed_probe=None):
+        #: elastic cold-start (docs/RESILIENCE.md "Elastic cold-start"):
+        #: ``params`` may be a demand-faulting source (anything with a
+        #: ``materialize()`` — parallel/weights.py FaultingCheckpoint)
+        #: instead of a resolved dict.  The server then constructs and
+        #: accepts submissions immediately; the FIRST step resolves the
+        #: params via ``materialize(klass="decode")`` — jit flattens the
+        #: whole dict at trace time, so residency must be total before
+        #: the first dispatch, and the decode class makes those faults
+        #: overtake the background bulk/warmup streams in the QoS
+        #: scheduler.  A plain dict (every existing caller) takes the
+        #: eager path bit-for-bit.
+        self._param_source = None
+        if params is not None and not isinstance(params, dict) \
+                and hasattr(params, "materialize"):
+            self._param_source = params
+            params = None
+            coord = getattr(self._param_source, "coordinator", None)
+            if coord is not None:
+                coord.note_serving_started()
+            start = getattr(self._param_source, "start_bulk", None)
+            if start is not None:
+                start()   # serve-while-restoring from the first moment
         self.params = params
         self.cfg = cfg
         self.B = max_batch
@@ -946,6 +968,16 @@ class DecodeServer:
     def _advanced(self, active_slots: List[int]) -> None:
         """Post-step bookkeeping hook (host-side position mirrors)."""
 
+    def _ensure_params(self) -> None:
+        """Resolve a demand-faulting param source on first use: every
+        tensor not yet resident is faulted at ``decode`` class, ahead
+        of the bulk-restore/warmup streams.  Tensors the background
+        bulk thread already landed are returned from its claim table
+        without touching NVMe again.  No-op (one attribute test) on
+        the eager path."""
+        if self.params is None and self._param_source is not None:
+            self.params = self._param_source.materialize(klass="decode")
+
     def step(self) -> Dict[object, List[int]]:
         """Admit → one batched decode step → retire finished."""
         return self.step_many(1)
@@ -971,6 +1003,7 @@ class DecodeServer:
         the next occupant overwrites-before-attending.  Admission
         happens once per batch, so a freed slot idles at most
         ``k_steps - 1`` sub-steps."""
+        self._ensure_params()
         finished: Dict[object, List[int]] = {}
         if self._finished_carry:
             # retirements completed by _drain_pending_first while a

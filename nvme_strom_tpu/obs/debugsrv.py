@@ -178,6 +178,10 @@ class DebugServer:
             "extents_requeued": int(snap.get("extents_requeued", 0)),
             "degraded_reads": int(snap.get("degraded_reads", 0)),
             "degraded_probes": int(snap.get("degraded_probes", 0)),
+            # elastic cold-start boot phase (io/coldstart.py): absent/
+            # None for ordinary boots, cold/faulting/warming/steady for
+            # a serve-while-restoring replica — strom-top renders it
+            "boot_phase": snap.get("boot_phase"),
         }
         return json.dumps(doc), "application/json"
 

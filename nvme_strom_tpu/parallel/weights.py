@@ -159,7 +159,8 @@ class LazyCheckpoint:
             if own:
                 eng.close_all()
 
-    def _load_tensor(self, eng: StromEngine, name: str, sharding):
+    def _load_tensor(self, eng: StromEngine, name: str, sharding,
+                     klass: str = "restore"):
         import jax
 
         sf = self._by_name[name]
@@ -229,7 +230,8 @@ class LazyCheckpoint:
                 crc = 0
                 parts: Dict[object, list] = {dev: [] for dev, _ in devs}
                 for view, release in self._stream_span(
-                        eng, fh, sf, name, r0, r1, np_dt, gshape):
+                        eng, fh, sf, name, r0, r1, np_dt, gshape,
+                        klass=klass):
                     if check:
                         crc = crc32c(view, crc)
                         eng.stats.add(bytes_verified=int(view.nbytes))
@@ -274,19 +276,26 @@ class LazyCheckpoint:
         return jax.make_array_from_single_device_arrays(
             gshape, sharding, arrays)
 
-    def _stream_span(self, eng, fh, sf, name, r0, r1, np_dt, gshape):
+    def _stream_span(self, eng, fh, sf, name, r0, r1, np_dt, gshape,
+                     klass: str = "restore"):
         """Yield (host view, release_cb | None) per row-chunk of rows
         [r0, r1), each at most one staging buffer; pipelined (several
         reads in flight).  The view is valid until ``release_cb()`` —
         the CONSUMER calls it (via a StagingRetirePool) once transfers
         out of the view complete; None means host-owned memory with
         nothing to retire.  release is idempotent, so generator cleanup
-        can double as a backstop."""
+        can double as a backstop.
+
+        ``klass`` is the QoS class every read of this span rides —
+        ``restore`` for bulk loads (the default, today's behavior);
+        the cold-start demand-fault lane (FaultingCheckpoint) passes
+        ``decode`` so a request-blocking tensor overtakes the bulk
+        stream in the scheduler."""
         if not gshape:
             ent = sf.plan([name]).entries[0]
             (pieces,) = plan_and_submit(eng, [(fh, ent.offset,
                                                ent.length)],
-                                        klass="restore")
+                                        klass=klass)
             # one piece pre-tier; the host tier's hit/miss split can
             # return several — join_pieces keeps one view either way
             p = join_pieces(pieces, eng.stats)
@@ -320,7 +329,7 @@ class LazyCheckpoint:
                 pos = 0
                 (pend,) = plan_and_submit(
                     eng, [(fh, ent.offset, ent.length)],
-                    chunk_bytes=eng.config.chunk_bytes, klass="restore")
+                    chunk_bytes=eng.config.chunk_bytes, klass=klass)
                 for p in pend:
                     # cumulative assembly: a silently short view would
                     # leave a garbage tail that reshapes cleanly
@@ -347,7 +356,7 @@ class LazyCheckpoint:
             slices.append(((fh, ent.offset, ent.length), ent.shape))
         planned = plan_and_submit(eng, [s for s, _ in slices],
                                   chunk_bytes=eng.config.chunk_bytes,
-                                  klass="restore")
+                                  klass=klass)
         pend = []
         for ((_, _, ln), shp), pieces in zip(slices, planned):
             if not pieces:    # zero-element slice: no I/O to wait on
@@ -368,6 +377,216 @@ class LazyCheckpoint:
             for p, _ in pend:  # abandoned mid-span: drain + free
                 if p is not None:
                     p.release()
+
+
+class FaultingCheckpoint:
+    """Demand-faulting front-end over :class:`LazyCheckpoint` — the
+    weights half of elastic cold-start (``STROM_COLDSTART=1``,
+    docs/RESILIENCE.md "Elastic cold-start").
+
+    The serving stack constructs one of these instead of calling
+    ``load_sharded`` and starts taking traffic immediately.  Two lanes
+    then race, on purpose:
+
+    * **demand faults** — :meth:`get`/:meth:`materialize` load any
+      tensor a request needs *now* at ``decode`` class, so the QoS
+      scheduler dispatches it ahead of everything else;
+    * **bulk restore** — :meth:`start_bulk` streams the remaining
+      tensors in a background thread at ``restore`` class, riding the
+      read-once/ICI-scatter path when enabled, exactly like
+      ``load_sharded``.
+
+    Both lanes share one claim table: each tensor is read from NVMe at
+    most once, whichever lane gets there first, and waiters block on
+    the claimant's event instead of re-reading.  A FAILED claim (the
+    bulk lane's ring tripped mid-restore) wakes the waiters and clears
+    the claim so a demand-faulting waiter re-claims and loads the
+    tensor itself at ``decode`` class — this is what lets the PR-10
+    breakers brown out the restore stream with zero consumer errors.
+
+    Locking: ``coldstart.FaultingCheckpoint._lock`` guards only the
+    claim/array tables (group ``coldstart`` in lock_order.conf); all
+    engine I/O runs outside it.
+    """
+
+    def __init__(self, source, shardings: Union[Dict, Callable],
+                 engine: Optional[StromEngine] = None, dtype=None,
+                 ici_mesh=None, coordinator=None):
+        import threading
+
+        from nvme_strom_tpu.utils.lockwitness import make_lock
+        self.ckpt = (source if isinstance(source, LazyCheckpoint)
+                     else LazyCheckpoint(source))
+        self._shardings = shardings
+        self._dtype = dtype
+        self._ici_mesh = ici_mesh
+        self.coordinator = coordinator
+        self._own = engine is None
+        if engine is None:
+            from nvme_strom_tpu.io.faults import build_engine
+            engine = build_engine(EngineConfig())
+        self.engine = engine
+        self._lock = make_lock("coldstart.FaultingCheckpoint._lock")
+        self._arrays: Dict[str, object] = {}
+        self._claims: Dict[str, object] = {}   # name -> threading.Event
+        self._resident_ev = threading.Event()
+        self._bulk_thread: Optional[object] = None
+        self._cast = None
+        if dtype is not None:
+            import jax
+            self._cast = jax.jit(lambda x: x.astype(dtype),
+                                 out_shardings=None)
+
+    # -- introspection ------------------------------------------------------
+
+    def keys(self):
+        return self.ckpt.keys()
+
+    def resident(self) -> bool:
+        """True once every tensor is device-resident."""
+        return self._resident_ev.is_set()
+
+    def wait_resident(self, timeout: Optional[float] = None) -> bool:
+        return self._resident_ev.wait(timeout)
+
+    def _sharding_for(self, name: str):
+        get = (self._shardings.get
+               if isinstance(self._shardings, dict) else None)
+        sh = (get(name) if get
+              else self._shardings(name, self.ckpt.shape(name)))
+        if sh is None:
+            raise KeyError(f"no sharding for tensor {name}")
+        return sh
+
+    # -- the claim protocol -------------------------------------------------
+
+    def _acquire(self, name: str, eng, klass: str):
+        """Load ``name`` under the claim table.  Returns
+        ``(array, loaded_by_me)``; every tensor hits NVMe at most once
+        across both lanes, and a failed claim is re-claimable."""
+        import threading
+
+        while True:
+            with self._lock:
+                arr = self._arrays.get(name)
+                if arr is not None:
+                    return arr, False
+                ev = self._claims.get(name)
+                if ev is None:
+                    ev = self._claims[name] = threading.Event()
+                    mine = True
+                else:
+                    mine = False
+            if not mine:
+                ev.wait()
+                continue   # loaded (return above) or failed (re-claim)
+            try:
+                arr = self.ckpt._load_tensor(eng, name,
+                                             self._sharding_for(name),
+                                             klass=klass)
+                if self._cast is not None:
+                    arr = self._cast(arr)
+            except BaseException:
+                with self._lock:
+                    self._claims.pop(name, None)
+                ev.set()
+                raise
+            with self._lock:
+                self._arrays[name] = arr
+                self._claims.pop(name, None)
+                done = len(self._arrays) == len(self.ckpt._by_name)
+            ev.set()
+            if done:
+                self._resident_ev.set()
+                if self.coordinator is not None:
+                    self.coordinator.note_weights_resident()
+            return arr, True
+
+    def get(self, name: str, klass: str = "decode"):
+        """Return ``name``'s global array, demand-faulting it at
+        ``klass`` (default ``decode``) if not yet resident."""
+        import time
+
+        t0 = time.monotonic()
+        arr, loaded = self._acquire(name, self.engine, klass)
+        if loaded and klass == "decode":
+            ms = (time.monotonic() - t0) * 1e3
+            stats = getattr(self.engine, "stats", None)
+            if stats is not None:
+                nbytes = 0
+                for shard in getattr(arr, "addressable_shards", []):
+                    nbytes += int(
+                        getattr(shard.data, "nbytes", 0))
+                stats.add(coldstart_faults=1,
+                          coldstart_fault_bytes=nbytes)
+            if self.coordinator is not None:
+                self.coordinator.note_fault_ms(ms)
+        return arr
+
+    def materialize(self, klass: str = "decode") -> Dict[str, object]:
+        """Fault every missing tensor at ``klass`` and return the full
+        params dict — the serving stack's first-step hook (jit flattens
+        the whole dict at trace time, so residency must be total before
+        the first dispatch)."""
+        for name in self.ckpt.keys():
+            self.get(name, klass=klass)
+        with self._lock:
+            return dict(self._arrays)
+
+    # -- the bulk lane ------------------------------------------------------
+
+    def start_bulk(self):
+        """Start the background bulk-restore thread (``restore`` class,
+        read-once/ICI-scatter when enabled).  Idempotent; returns the
+        thread."""
+        import threading
+
+        with self._lock:
+            if self._bulk_thread is not None:
+                return self._bulk_thread
+            t = threading.Thread(target=self._bulk_run,
+                                 name="strom-coldstart-bulk",
+                                 daemon=True)
+            self._bulk_thread = t
+        t.start()
+        return t
+
+    def _bulk_run(self):
+        eng = self.engine
+        from nvme_strom_tpu.ops.ici import ici_scatter_enabled
+        if ici_scatter_enabled():
+            from nvme_strom_tpu.ops.ici import scatter_engine
+            try:
+                served = scatter_engine(
+                    eng, [sf.path for sf in self.ckpt.files],
+                    mesh=self._ici_mesh, klass="restore")
+                if served is not None:
+                    eng = served
+            except Exception:
+                eng = self.engine   # brown out to per-host reads
+        stats = getattr(self.engine, "stats", None)
+        for name in self.ckpt.keys():
+            try:
+                _, loaded = self._acquire(name, eng, "restore")
+            except Exception:
+                # ring tripped / transient failure: leave the tensor to
+                # the demand-fault lane (or a later pass) — the bulk
+                # thread must never take the replica down
+                loaded = False
+            if loaded and stats is not None:
+                stats.add(coldstart_bulk_tensors=1)
+
+    def join_bulk(self, timeout: Optional[float] = None) -> None:
+        with self._lock:
+            t = self._bulk_thread
+        if t is not None:
+            t.join(timeout)
+
+    def close(self) -> None:
+        """Release the owned engine (no-op for a borrowed one).  Call
+        only after residency — in-flight lanes need the engine."""
+        if self._own:
+            self.engine.close_all()
 
 
 def save_checkpoint(path, params: Dict[str, object],

@@ -51,6 +51,12 @@ GATES: Tuple[Tuple[str, str, float], ...] = (
     ("observability.flight_overhead_pct", "lower_abs", 3.0),
     ("observability.traced_overhead_pct", "lower_abs", 3.0),
     ("observability.attrib_overhead_pct", "lower_abs", 3.0),
+    # elastic cold-start (docs/RESILIENCE.md): serve-while-restoring
+    # must keep its boot-elasticity step function — a TTFT-from-boot
+    # speedup collapsing toward 1x means the demand-fault lane started
+    # paying for the warm payload again
+    ("coldstart.ttft_boot_speedup", "higher", 0.50),
+    ("coldstart.on.ttft_boot_s", "lower", 0.60),
 )
 
 

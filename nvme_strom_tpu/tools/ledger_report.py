@@ -126,6 +126,12 @@ CONTRACT = {
     # the claim; scan-stage timed, full group-by checked untimed) — an
     # attribution row, no ratio bar
     23: ("sql-parallel-pushdown", "attr"),
+    # elastic cold-start: TTFT-from-boot speedup of serve-while-
+    # restoring over its own same-run restore-then-serve arm, with
+    # time-to-p99-steady and the token-identity verdict in the tag
+    # (pad-emulated service time on a page-cached dev box) — an
+    # attribution row, no ratio bar
+    24: ("cold-start-restore", "attr"),
 }
 
 #: the ONE validity rule set, shared with the watcher's coverage

@@ -255,6 +255,12 @@ def collect_targets(path: str) -> Dict[str, List[str]]:
             base = path[:-len(".kvman.json")]
             (kvstores.append(base) if os.path.exists(base)
              else orphans.append(path))
+        elif path.endswith(".warmhints.json"):
+            # a hostcache warmup-hint sidecar (io/warmup.py) is not
+            # itself scrub-able payload; orphaned (base gone) it is
+            # debris the same GC sweeps — stale hints mis-warm boots
+            if not os.path.exists(path[:-len(".warmhints.json")]):
+                orphans.append(path)
         elif os.path.exists(path + ".kvman.json"):
             kvstores.append(path)
         elif path.endswith(".safetensors"):
@@ -273,6 +279,11 @@ def collect_targets(path: str) -> Dict[str, List[str]]:
                 # verdict as checkpoint.manager.find_orphan_manifests;
                 # detected inline so the tree is walked ONCE)
                 if not os.path.exists(p[:-len(".kvman.json")]):
+                    orphans.append(p)
+                continue
+            if name.endswith(".warmhints.json"):
+                # warmup-hint sidecar: same orphan verdict, same sweep
+                if not os.path.exists(p[:-len(".warmhints.json")]):
                     orphans.append(p)
                 continue
             if os.path.exists(p + ".kvman.json"):

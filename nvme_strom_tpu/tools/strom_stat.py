@@ -145,6 +145,18 @@ _SQL_COUNTERS = (
     "sql_rowgroups_skipped", "sql_pages_skipped", "sql_bytes_skipped",
 )
 
+#: elastic cold-start counters (io/coldstart.py, parallel/weights.py
+#: FaultingCheckpoint — docs/RESILIENCE.md "Elastic cold-start"); own
+#: block with the boot-phase gauge, shown only when a cold start ever
+#: ran: the fault/bulk split is serve-while-restoring made visible —
+#: demand faults are the tensors requests could not wait for
+_COLDSTART_COUNTERS = (
+    "coldstart_faults", "coldstart_fault_bytes",
+    "coldstart_bulk_tensors", "coldstart_warm_spans",
+    "coldstart_warm_pages", "coldstart_stall_dumps",
+    "coldstart_brownouts",
+)
+
 #: every counter block above, in render order — the counter-drift CI
 #: check (tests/test_observability.py) asserts the union covers ALL of
 #: StromStats.COUNTER_FIELDS, so a new counter cannot silently vanish
@@ -154,6 +166,7 @@ ALL_COUNTER_BLOCKS = (
     _BATCH_COUNTERS, _ENGINE_COUNTERS, _SCHED_COUNTERS,
     _HOSTCACHE_COUNTERS, _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
     _LEDGER_COUNTERS, _ICI_COUNTERS, _TENANT_COUNTERS, _SQL_COUNTERS,
+    _COLDSTART_COUNTERS,
 )
 
 
@@ -439,6 +452,17 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
             lines.append(
                 f"    {'zone-map elimination':<24} "
                 f"{100.0 * skipped / (scanned + skipped):>13.1f}%")
+    if (any(int(snap.get(n, 0)) for n in _COLDSTART_COUNTERS)
+            or snap.get("boot_phase")):
+        lines.append("  cold start (serve-while-restoring — "
+                     "docs/RESILIENCE.md):")
+        phase = snap.get("boot_phase")
+        if phase:
+            lines.append(f"    {'boot_phase':<24} {str(phase):>14}")
+        for name in _COLDSTART_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if name == "coldstart_fault_bytes" else v
+            lines.append(f"    {name:<24} {shown:>14}")
     if any(int(snap.get(n, 0)) for n in _OBS_COUNTERS):
         lines.append("  observability (tracer / flight recorder):")
         for name in _OBS_COUNTERS:

@@ -108,6 +108,12 @@ def render_frame(attrib: dict, ledger: dict, health: dict) -> str:
         lines.append(f"  breakers: {tag}"
                      + ("   DEGRADED (buffered brown-out)"
                         if degraded else ""))
+    phase = health.get("boot_phase")
+    if phase and phase != "steady":
+        # a replica mid-cold-start: worth a line until it reaches
+        # steady, invisible afterwards (and for non-coldstart boots)
+        lines.append(f"  boot: {phase} (cold start in progress — "
+                     "serve-while-restoring)")
     return "\n".join(lines)
 
 

@@ -506,3 +506,61 @@ class TenantConfig:
             # construction, not out of the first serving submit
             from nvme_strom_tpu.io.tenants import parse_tenant_spec
             parse_tenant_spec(self.spec)
+
+
+@dataclass(frozen=True)
+class ColdStartConfig:
+    """Elastic cold-start knobs (io/coldstart.py + parallel/weights.py
+    FaultingCheckpoint; semantics in docs/RESILIENCE.md "Elastic
+    cold-start").
+
+    One gate and a small SLO block: ``STROM_COLDSTART=1`` lets a
+    serving replica take traffic immediately — weights the first
+    requests touch are demand-faulted at ``decode`` class ahead of the
+    background bulk restore stream (``restore`` class), and warm-state
+    manifests (KV prefix pages + hostcache warmup hints) prefetch at
+    ``prefetch`` class.  Default 0 keeps today's restore-then-serve
+    stack bit-for-bit (proven by test).  STROM_* environment variables
+    are read at construction time, mirroring EngineConfig.
+    """
+
+    #: master gate; 0 (default) = no faulting front-end, no boot-phase
+    #: machine, no warmup prefetch — the exact pre-coldstart stack
+    enabled: bool = field(
+        default_factory=lambda: os.environ.get("STROM_COLDSTART",
+                                               "0") == "1")
+    #: demand-fault p99 target in ms during the ``faulting`` boot
+    #: phase; a violation trips the ``coldstart_stall`` flight-recorder
+    #: dump (boot phase + per-class backlog in the payload).  0
+    #: (default) = no stall trigger.
+    fault_slo_ms: float = field(
+        default_factory=lambda: _env_float("STROM_COLDSTART_FAULT_SLO_MS",
+                                           0.0))
+    #: demand-fault latencies retained for the stall trigger's rolling
+    #: p99 (bounded — a long faulting phase must not grow a list)
+    fault_window: int = field(
+        default_factory=lambda: _env_int("STROM_COLDSTART_WINDOW", 64))
+    #: hostcache spans retained per ``.warmhints.json`` manifest —
+    #: largest-first, so a truncated hint list still warms the lines
+    #: that buy the most DRAM hits
+    warm_hint_spans: int = field(
+        default_factory=lambda: _env_int("STROM_WARM_HINT_SPANS", 1024))
+    #: KV prefix pages the warming phase re-reads at ``prefetch`` class
+    #: (top benefit score first) so a scaled-out replica's hot prefixes
+    #: restore from DRAM, not NVMe
+    warm_pages: int = field(
+        default_factory=lambda: _env_int("STROM_WARM_PAGES", 256))
+
+    def __post_init__(self):
+        if self.fault_slo_ms < 0:
+            raise ValueError("fault_slo_ms must be >= 0")
+        if self.fault_window < 8:
+            raise ValueError("fault_window must be >= 8")
+        if self.warm_hint_spans < 0 or self.warm_pages < 0:
+            raise ValueError("warm hint/page budgets must be >= 0")
+
+
+def coldstart_enabled() -> bool:
+    """The one gate read (``STROM_COLDSTART``) consumers check before
+    touching any cold-start machinery — mirrors tenants_enabled()."""
+    return os.environ.get("STROM_COLDSTART", "0") == "1"

@@ -285,6 +285,26 @@ class StromStats:
     sql_pages_skipped: int = 0
     # scans that fanned windows across the partition-parallel pool
     sql_parallel_scans: int = 0
+    # -- elastic cold-start (io/coldstart.py, parallel/weights.py
+    # FaultingCheckpoint, docs/RESILIENCE.md "Elastic cold-start") ----
+    # tensors demand-faulted at decode class ahead of the bulk stream
+    # (a request touched them before the background restore arrived)
+    coldstart_faults: int = 0
+    # NVMe bytes moved by those demand faults
+    coldstart_fault_bytes: int = 0
+    # tensors the background bulk-restore thread loaded at restore class
+    coldstart_bulk_tensors: int = 0
+    # hostcache warmup-hint spans prefetched from a .warmhints.json
+    # manifest during the warming phase
+    coldstart_warm_spans: int = 0
+    # KV prefix pages re-read at prefetch class during warming
+    coldstart_warm_pages: int = 0
+    # coldstart_stall flight-recorder dumps actually published (fault
+    # p99 over SLO while still in the faulting phase)
+    coldstart_stall_dumps: int = 0
+    # degraded-mode (brown-out) entries observed while a cold start was
+    # still in flight — the restore stream survived a ring failure
+    coldstart_brownouts: int = 0
     _lock: threading.Lock = field(
         default_factory=lambda: make_lock("stats.StromStats._lock"),
         repr=False)

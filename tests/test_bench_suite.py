@@ -22,13 +22,13 @@ def test_suite_all_configs(tmp_path):
         cwd=str(REPO))
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
-    assert len(lines) == 23, r.stdout
+    assert len(lines) == 24, r.stdout
     units = {1: "GiB/s", 2: "GiB/s", 3: "GiB/s", 4: "GiB/s", 5: "GiB/s",
              6: "tok/s", 7: "TFLOP/s", 8: "GiB/s", 9: "GiB/s",
              10: "tok/s", 11: "tok/s", 12: "GiB/s", 13: "GiB/s",
              14: "GiB/s", 15: "GiB/s", 16: "Mmembers/s",
              17: "TFLOP/s", 18: "GiB/s", 19: "tok/s", 20: "GiB/s",
-             21: "GiB/s", 22: "x", 23: "GiB/s"}
+             21: "GiB/s", 22: "x", 23: "GiB/s", 24: "x"}
     for i, ln in enumerate(lines, start=1):
         rec = json.loads(ln)
         assert set(rec) == {"metric", "value", "unit", "vs_baseline",

@@ -1,0 +1,514 @@
+"""Elastic cold-start: serve-while-restoring (io/coldstart.py,
+io/warmup.py, parallel/weights.py FaultingCheckpoint —
+docs/RESILIENCE.md "Elastic cold-start").
+
+The contract under test, end to end and hardware-free:
+
+* ``STROM_COLDSTART=0`` (default) is bit-for-bit inert — the eager
+  serving path never touches the subsystem, no counter moves, no gauge
+  appears.
+* A server built over a ``FaultingCheckpoint`` takes traffic before its
+  weights are resident and produces TOKEN-IDENTICAL output to the
+  eager server; every tensor is read from NVMe exactly once across the
+  demand-fault and bulk-restore lanes.
+* The ``-m chaos`` drill: wedge a ring while the bulk restore streams —
+  the PR-10 breaker trips, the ring restarts, in-flight extents
+  requeue, and the consumer sees ZERO errors and identical tokens.
+* Warm-state manifests are atomically published, staleness-validated
+  against the CURRENT base file, and orphan-swept by the same age-gated
+  GC as ``.kvman.json`` (strom-scrub --gc).
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nvme_strom_tpu.formats import write_safetensors
+from nvme_strom_tpu.io import hostcache
+from nvme_strom_tpu.io.coldstart import PHASES, ColdStartCoordinator
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.io.faults import set_ring_stall
+from nvme_strom_tpu.io.flightrec import FlightConfig, FlightRecorder
+from nvme_strom_tpu.io.health import EngineSupervisor
+from nvme_strom_tpu.io.plan import plan_and_submit
+from nvme_strom_tpu.io.resilient import ResilientEngine
+from nvme_strom_tpu.io.sched import QoSScheduler
+from nvme_strom_tpu.io.warmup import (WARMHINT_SUFFIX, collect_warm_hints,
+                                      hint_path, load_warm_hints,
+                                      prefetch_hints, write_warm_hints)
+from nvme_strom_tpu.models.serving import DecodeServer
+from nvme_strom_tpu.models.transformer import (TransformerConfig,
+                                               init_params, tiny_config)
+from nvme_strom_tpu.parallel.weights import (FaultingCheckpoint,
+                                             LazyCheckpoint)
+from nvme_strom_tpu.utils.config import (ColdStartConfig, EngineConfig,
+                                         HostCacheConfig, ResilientConfig,
+                                         coldstart_enabled)
+from nvme_strom_tpu.utils.stats import StromStats
+
+MB = 1 << 20
+
+COLDSTART_COUNTERS = (
+    "coldstart_faults", "coldstart_fault_bytes", "coldstart_bulk_tensors",
+    "coldstart_warm_spans", "coldstart_warm_pages",
+    "coldstart_stall_dumps", "coldstart_brownouts")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture()
+def ckpt(setup, tmp_path):
+    _cfg, params = setup
+    path = str(tmp_path / "model.safetensors")
+    write_safetensors(path, {n: np.asarray(a) for n, a in params.items()})
+    return path
+
+
+def _single_shardings():
+    shard = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    return lambda name, shape: shard
+
+
+def _serve(params_or_ckpt, cfg, prompt, max_new):
+    srv = DecodeServer(params_or_ckpt, cfg, max_batch=2, max_len=64)
+    srv.submit("r", prompt, max_new)
+    return srv.run()["r"]
+
+
+# ---------------------------------------------------------------------------
+# config + the off-by-default inertness proof
+# ---------------------------------------------------------------------------
+
+def test_config_defaults_and_validation(monkeypatch):
+    for var in ("STROM_COLDSTART", "STROM_COLDSTART_FAULT_SLO_MS",
+                "STROM_COLDSTART_WINDOW", "STROM_WARM_HINT_SPANS",
+                "STROM_WARM_PAGES"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = ColdStartConfig()
+    assert cfg.enabled is False          # opt-in, never on by surprise
+    assert coldstart_enabled() is False
+    assert cfg.fault_slo_ms == 0.0       # stall trigger disarmed
+    assert cfg.fault_window == 64
+    assert cfg.warm_hint_spans == 1024 and cfg.warm_pages == 256
+    monkeypatch.setenv("STROM_COLDSTART", "1")
+    assert coldstart_enabled() is True
+    with pytest.raises(ValueError):
+        ColdStartConfig(enabled=False, fault_slo_ms=-1.0, fault_window=64,
+                        warm_hint_spans=1, warm_pages=1)
+    with pytest.raises(ValueError):
+        ColdStartConfig(enabled=False, fault_slo_ms=0.0, fault_window=4,
+                        warm_hint_spans=1, warm_pages=1)
+
+
+def test_gate_off_is_bit_for_bit_inert(setup, monkeypatch):
+    """The eager path (plain params dict) must not know the subsystem
+    exists: no lazy source detected, no coldstart counter moves, no
+    boot_phase gauge appears in the snapshot."""
+    monkeypatch.delenv("STROM_COLDSTART", raising=False)
+    cfg, params = setup
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, cfg.vocab, 5).tolist()
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=64)
+    assert srv._param_source is None     # dict params: eager, untouched
+    srv.submit("r", prompt, 6)
+    out = srv.run()["r"]
+    assert len(out) == 6
+    stats = StromStats()
+    snap = stats.snapshot()
+    for name in COLDSTART_COUNTERS:
+        assert getattr(stats, name) == 0
+    assert "boot_phase" not in snap
+
+
+# ---------------------------------------------------------------------------
+# coordinator: phase machine, warmup drain, stall trigger, brown-outs
+# ---------------------------------------------------------------------------
+
+class _FakeFlightEngine:
+    """Just enough engine surface for the coordinator: stats + flight
+    recorder + a scheduler whose backlog is known."""
+
+    class _Sched:
+        def backlog(self):
+            return {"restore": {"batches": 2, "spans": 7,
+                                "oldest_wait_s": 0.5}}
+
+    def __init__(self, tmp_path):
+        self.stats = StromStats()
+        self.flight = FlightRecorder(
+            FlightConfig(enabled=True, ops=16, dir=str(tmp_path),
+                         min_interval_s=0.0), self.stats)
+        self.scheduler = self._Sched()
+        self.supervisor = None
+
+
+def test_phase_machine_is_forward_only_and_exports_gauge(tmp_path):
+    eng = _FakeFlightEngine(tmp_path)
+    coord = ColdStartCoordinator(eng)
+    assert coord.phase == "cold" and PHASES.index("cold") == 0
+    coord.note_serving_started()
+    assert coord.phase == "faulting"
+    snap = eng.stats.snapshot()
+    assert snap["boot_phase"] == "faulting"
+    assert snap["boot_phase_code"] == PHASES.index("faulting")
+    coord.note_weights_resident()        # no warmups -> straight through
+    assert coord.phase == "steady"
+    coord.note_serving_started()         # a late note never rewinds
+    assert coord.phase == "steady"
+    assert eng.stats.snapshot()["boot_phase"] == "steady"
+    times = coord.phase_times()
+    assert set(times) == {"cold", "faulting", "warming", "steady"}
+    assert times["faulting"] <= times["steady"]
+
+
+def test_warmup_thunks_drain_to_steady(tmp_path):
+    eng = _FakeFlightEngine(tmp_path)
+    coord = ColdStartCoordinator(eng)
+    ran = []
+    coord.add_warmup(lambda: ran.append("a"))
+    coord.add_warmup(lambda: 1 / 0)      # best-effort: never propagates
+    coord.add_warmup(lambda: ran.append("b"))
+    coord.note_serving_started()
+    coord.note_weights_resident()
+    assert coord.wait_steady(10.0)
+    assert ran == ["a", "b"]
+    # late registration runs inline (the caller is late, not wrong)
+    coord.add_warmup(lambda: ran.append("late"))
+    assert ran[-1] == "late"
+
+
+def test_stall_trigger_dumps_flight_with_backlog(tmp_path):
+    """Armed only in the faulting phase: a rolling-p99 SLO violation
+    writes reason=coldstart_stall carrying the boot phase and the
+    scheduler's per-class backlog."""
+    eng = _FakeFlightEngine(tmp_path)
+    cfg = ColdStartConfig(enabled=True, fault_slo_ms=1.0, fault_window=16,
+                          warm_hint_spans=1, warm_pages=1)
+    coord = ColdStartCoordinator(eng, cfg=cfg)
+    coord.note_fault_ms(100.0)           # cold phase: trigger disarmed
+    assert eng.stats.coldstart_stall_dumps == 0
+    coord.note_serving_started()
+    for _ in range(8):                   # window floor, all over SLO
+        coord.note_fault_ms(50.0)
+    assert eng.stats.coldstart_stall_dumps == 1
+    dumps = sorted(tmp_path.glob("strom_flight_*coldstart_stall*.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"] == "coldstart_stall"
+    assert doc["extra"]["boot_phase"] == "faulting"
+    assert doc["extra"]["fault_p99_ms"] >= 50.0
+    assert doc["extra"]["fault_slo_ms"] == 1.0
+    assert doc["extra"]["backlog"]["restore"]["spans"] == 7
+    # past faulting the trigger disarms entirely
+    coord.note_weights_resident()
+    coord.note_fault_ms(500.0)
+    assert eng.stats.coldstart_stall_dumps == 1
+
+
+def test_supervisor_degraded_listener_and_brownout_counter(tmp_path):
+    eng = _FakeFlightEngine(tmp_path)
+    sup = EngineSupervisor.__new__(EngineSupervisor)   # listener surface
+    sup._degraded_listeners = []
+    seen = []
+    sup.add_degraded_listener(lambda on: seen.append(on))
+    sup.add_degraded_listener(lambda on: 1 / 0)  # must never wedge
+    sup._notify_degraded(True)
+    sup._notify_degraded(False)
+    assert seen == [True, False]
+    # coordinator counts brown-outs only while still cold-starting
+    coord = ColdStartCoordinator(eng)
+    coord.note_serving_started()
+    coord._on_degraded(True)
+    assert eng.stats.coldstart_brownouts == 1
+    coord._on_degraded(False)            # recovery is not a brown-out
+    assert eng.stats.coldstart_brownouts == 1
+    coord.note_weights_resident()
+    coord._on_degraded(True)             # steady: normal ops, not boot
+    assert eng.stats.coldstart_brownouts == 1
+
+
+def test_scheduler_backlog_shape():
+    """backlog() reports batches/spans/oldest-wait per queued class and
+    omits empty classes — the stall dump's starvation evidence."""
+    sched = QoSScheduler(lambda spans, ring: ["p"] * len(spans),
+                         lambda: [0])    # zero slots: bulk stays queued
+    assert sched.backlog() == {}
+    sched.enqueue([("a", 0, 1), ("b", 0, 1)], "restore")
+    time.sleep(0.01)
+    back = sched.backlog()
+    assert set(back) == {"restore"}
+    assert back["restore"]["batches"] == 1
+    assert back["restore"]["spans"] == 2
+    assert back["restore"]["oldest_wait_s"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# FaultingCheckpoint: token identity, read-once claims, demand faults
+# ---------------------------------------------------------------------------
+
+def test_faulting_checkpoint_tokens_identical_to_eager(setup, ckpt):
+    """The tentpole correctness claim, minus the chaos: a server that
+    starts serving before its weights are resident produces the same
+    tokens as the eager server, every tensor is loaded exactly once
+    across the two lanes, and the boot phases run to steady."""
+    cfg, params = setup
+    rng = np.random.default_rng(11)
+    prompt = rng.integers(0, cfg.vocab, 5).tolist()
+    want = _serve(params, cfg, prompt, 8)
+
+    stats = StromStats()
+    eng = StromEngine(EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                                   buffer_pool_bytes=32 * MB),
+                      stats=stats)
+    try:
+        coord = ColdStartCoordinator(eng)
+        fck = FaultingCheckpoint(ckpt, _single_shardings(), engine=eng,
+                                 coordinator=coord)
+        assert not fck.resident()
+        got = _serve(fck, cfg, prompt, 8)   # serve-while-restoring
+        assert got == want                  # token-identical
+        fck.join_bulk(30.0)
+        assert fck.resident() and fck.wait_resident(1.0)
+        n = len(list(fck.keys()))
+        assert n == len(params)
+        # read-once: the two lanes' loads partition the tensor set
+        assert stats.coldstart_faults + stats.coldstart_bulk_tensors == n
+        assert coord.phase == "steady"
+        assert stats.snapshot()["boot_phase"] == "steady"
+    finally:
+        fck.close()
+        eng.close_all()
+
+
+def test_demand_fault_counts_bytes_and_latency(setup, ckpt):
+    """A direct decode-class fault moves the fault counters and feeds
+    the coordinator's latency window; a second get is a no-op hit."""
+    cfg, _params = setup
+    stats = StromStats()
+    eng = StromEngine(EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                                   buffer_pool_bytes=32 * MB),
+                      stats=stats)
+    try:
+        fck = FaultingCheckpoint(ckpt, _single_shardings(), engine=eng)
+        name = next(iter(fck.keys()))
+        arr = fck.get(name)
+        assert stats.coldstart_faults == 1
+        assert stats.coldstart_fault_bytes > 0
+        assert fck.get(name) is arr          # resident: no second read
+        assert stats.coldstart_faults == 1
+    finally:
+        fck.close()
+        eng.close_all()
+
+
+# ---------------------------------------------------------------------------
+# chaos drill: ring failure mid-bulk-restore, zero consumer errors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_ring_failure_mid_bulk_restore_zero_consumer_errors(
+        setup, ckpt, monkeypatch):
+    """Kill a ring while the bulk restore streams: the breaker trips,
+    the ring hot-restarts, parked extents requeue, the demand-fault
+    lane keeps the server answering — and the output is token-identical
+    to the eager server.  No consumer ever sees an error."""
+    for k, v in {"STROM_BREAKER_STALL_S": "0.1",
+                 "STROM_BREAKER_DRAIN_S": "0.5",
+                 "STROM_BREAKER_RESTART_S": "0",
+                 "STROM_BREAKER_HALF_OPEN_S": "0.05",
+                 "STROM_SCHED": "0"}.items():   # deterministic RR
+        monkeypatch.setenv(k, v)
+    cfg, params = setup
+    rng = np.random.default_rng(23)
+    prompt = rng.integers(0, cfg.vocab, 5).tolist()
+    want = _serve(params, cfg, prompt, 8)
+
+    stats = StromStats()
+    base = StromEngine(EngineConfig(n_rings=2, chunk_bytes=1 << 16,
+                                    queue_depth=4,
+                                    buffer_pool_bytes=16 * MB),
+                       stats=stats)
+    eng = ResilientEngine(base, ResilientConfig(
+        max_retries=6, backoff_base_s=0.0005, backoff_max_s=0.002,
+        hedging=False, stuck_timeout_s=60.0))
+    stop = threading.Event()
+
+    def _tick():
+        # production's supervision heartbeat, sped up: detect the
+        # parked ring, trip, restart, requeue — while serving blocks
+        while not stop.is_set():
+            try:
+                base.supervisor.tick(force=True)
+            except Exception:
+                pass
+            time.sleep(0.05)
+
+    ticker = threading.Thread(target=_tick, daemon=True)
+    fck = None
+    try:
+        set_ring_stall(base, 1, True)    # wedge ring 1 BEFORE the bulk
+        ticker.start()
+        coord = ColdStartCoordinator(base)
+        fck = FaultingCheckpoint(ckpt, _single_shardings(), engine=eng,
+                                 coordinator=coord)
+        got = _serve(fck, cfg, prompt, 8)    # bulk parks on ring 1 here
+        assert got == want               # token-identical, zero errors
+        fck.join_bulk(60.0)
+        assert fck.resident()
+        n = len(list(fck.keys()))
+        assert stats.coldstart_faults + stats.coldstart_bulk_tensors == n
+        assert stats.breaker_trips >= 1      # the drill actually bit
+        assert stats.ring_restarts >= 1
+        assert coord.phase == "steady"
+    finally:
+        stop.set()
+        ticker.join(2.0)
+        if fck is not None:
+            fck.close()
+        eng.close_all()
+
+
+# ---------------------------------------------------------------------------
+# warm-state manifests: hygiene, staleness, orphan GC
+# ---------------------------------------------------------------------------
+
+def test_warm_hints_roundtrip_staleness_and_bounds(tmp_path):
+    base = tmp_path / "w.bin"
+    base.write_bytes(b"x" * 8192)
+    st = os.stat(base)
+    manifest = hint_path(str(base))
+    assert manifest.endswith(WARMHINT_SUFFIX)
+    write_warm_hints(manifest, [(0, 4096), (4096, 4096)],
+                     size=st.st_size, mtime_ns=st.st_mtime_ns)
+    assert load_warm_hints(str(base)) == [(0, 4096), (4096, 4096)]
+    # a rewritten base file invalidates the hints: cold, never mis-warm
+    os.utime(base, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    assert load_warm_hints(str(base)) == []
+    st = os.stat(base)
+    # out-of-bounds spans are rejected wholesale
+    write_warm_hints(manifest, [(4096, 8192)],
+                     size=st.st_size, mtime_ns=st.st_mtime_ns)
+    assert load_warm_hints(str(base)) == []
+    # corrupt JSON loads as a cold boot
+    with open(manifest, "w") as f:
+        f.write("{not json")
+    assert load_warm_hints(str(base)) == []
+    # no manifest at all: same
+    os.unlink(manifest)
+    assert load_warm_hints(str(base)) == []
+
+
+def test_orphan_warmhints_swept_by_age_gated_gc(tmp_path, monkeypatch):
+    """A hint sidecar outliving its base file is debris that would
+    mis-warm the next boot; it is swept by the same age-gated GC as
+    .kvman.json — both from the checkpoint manager and strom-scrub."""
+    from nvme_strom_tpu.checkpoint.manager import (find_orphan_manifests,
+                                                   sweep_orphan_manifests)
+    from nvme_strom_tpu.tools import strom_scrub
+
+    base = tmp_path / "gone.bin"
+    base.write_bytes(b"y" * 4096)
+    write_warm_hints(hint_path(str(base)), [(0, 4096)],
+                     size=4096, mtime_ns=os.stat(base).st_mtime_ns)
+    live = tmp_path / "live.bin"
+    live.write_bytes(b"z" * 4096)
+    write_warm_hints(hint_path(str(live)), [(0, 4096)],
+                     size=4096, mtime_ns=os.stat(live).st_mtime_ns)
+    os.unlink(base)                      # orphan the first sidecar
+    orphans = find_orphan_manifests(str(tmp_path))
+    assert orphans == [hint_path(str(base))]
+    # the age gate protects a freshly-written sidecar (publish race)
+    assert sweep_orphan_manifests(orphans, min_age=3600.0) == []
+    assert os.path.exists(orphans[0])
+    # strom-scrub reports it and --gc --force removes it
+    report = strom_scrub.collect_targets(str(tmp_path))
+    assert orphans[0] in report["orphan_manifests"]
+    rc = strom_scrub.main([str(tmp_path), "--gc", "--force", "--json"])
+    assert rc == 0
+    assert not os.path.exists(orphans[0])
+    assert os.path.exists(hint_path(str(live)))   # live sidecar stays
+
+
+def test_collect_and_prefetch_hints_through_hostcache(tmp_path):
+    """End to end: reads warm the pinned-DRAM tier, collect_warm_hints
+    snapshots the resident spans, and prefetch_hints replays them at
+    prefetch class, counting coldstart_warm_spans."""
+    LINE = 64 << 10
+    cache = hostcache.configure(HostCacheConfig(budget_mb=1,
+                                                line_bytes=LINE))
+    try:
+        path = tmp_path / "hot.bin"
+        path.write_bytes(np.random.default_rng(5).integers(
+            0, 256, 4 * LINE, dtype=np.uint8).tobytes())
+        stats = StromStats()
+        eng = StromEngine(EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                                       buffer_pool_bytes=16 * MB),
+                          stats=stats)
+        try:
+            fh = eng.open(str(path))
+            extents = [(fh, 0, LINE), (fh, 2 * LINE, LINE)]
+            for _ in range(2):           # ghost-note, then admit+fill
+                for pieces in plan_and_submit(eng, extents,
+                                              klass="decode"):
+                    for p in pieces:
+                        p.wait()
+                        p.release()
+            manifest = collect_warm_hints(eng, str(path))
+            assert manifest == hint_path(str(path))
+            spans = load_warm_hints(str(path))
+            assert spans, "resident lines must round-trip into hints"
+            covered = sorted(spans)
+            assert covered[0][0] == 0    # the warmed regions survive
+            warmed = prefetch_hints(eng, str(path))
+            assert warmed == len(spans)
+            assert stats.coldstart_warm_spans == warmed
+            eng.close(fh)
+        finally:
+            eng.close_all()
+    finally:
+        hostcache.reset()
+
+
+def test_prefix_store_warm_pages(setup, tmp_path):
+    """The KV warming thunk re-reads top-benefit resident pages at
+    prefetch class and counts them; a zero budget is a no-op."""
+    from nvme_strom_tpu.models.kv_offload import PrefixStore
+    cfg, params = setup
+    PAGE = 4
+    page_bytes = (2 * cfg.n_layers * cfg.n_kv_heads * PAGE * cfg.head_dim
+                  * jnp.dtype(cfg.dtype).itemsize)
+    stats = StromStats()
+    eng = StromEngine(EngineConfig(chunk_bytes=1 << 20,
+                                   buffer_pool_bytes=16 * MB),
+                      stats=stats)
+    try:
+        store = PrefixStore(cfg, eng, str(tmp_path / "p.kvstore"),
+                            page_tokens=PAGE,
+                            capacity_bytes=64 * page_bytes)
+        srv = DecodeServer(params, cfg, max_batch=2, max_len=64,
+                           kv_store=store)
+        prompt = np.random.default_rng(9).integers(
+            0, cfg.vocab, 2 * PAGE).tolist()
+        srv.submit("r", prompt + [1, 2], 4)
+        srv.run()
+        assert stats.kv_pages_written >= 2
+        assert store.warm_pages(0) == 0
+        warmed = store.warm_pages(8)
+        assert warmed >= 2
+        assert stats.coldstart_warm_pages == warmed
+        store.close()
+    finally:
+        eng.close_all()
