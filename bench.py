@@ -972,6 +972,267 @@ def bench_coldstart(path: str, trials: int = 0) -> dict:
     }
 
 
+def bench_handoff(path: str, trials: int = 0) -> dict:
+    """Rolling replica replacement (docs/RESILIENCE.md "Drain &
+    handoff"): in-flight decode sessions survive a replacement, and the
+    replacement's TTFT-from-boot is measured with vs without a shipped
+    warm-state bundle.
+
+    * **off** (today's stack, abrupt kill): the old replica dies with
+      its sessions; the replacement restores the checkpoint and the
+      warm payload BEFORE serving, then recomputes every session from
+      scratch — the client re-sends and re-pays the whole decode.
+    * **on** (``STROM_HANDOFF=1`` semantics): the old replica drains —
+      admissions defer, in-flight sessions export mid-decode with their
+      prompt chains and NVMe prefix-store page keys — and publishes an
+      atomic ``.handoff.json`` bundle anchored at the store's page
+      file.  The replacement boots elastic (FaultingCheckpoint),
+      consumes the bundle, re-admits the exported sessions first, and
+      finishes their remaining tokens; final output = old replica's
+      delivered tokens + the continuation.
+
+    Both arms decode greedily from the same weights, so outputs must be
+    token-identical; ``dropped_requests`` counts sessions that failed
+    to produce their full budget on EITHER arm and is pinned at 0 by
+    the bench gate."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from nvme_strom_tpu.formats.safetensors import write_safetensors
+    from nvme_strom_tpu.io import StromEngine
+    from nvme_strom_tpu.io.coldstart import ColdStartCoordinator
+    from nvme_strom_tpu.io.handoff import DrainCoordinator
+    from nvme_strom_tpu.io.plan import plan_and_submit
+    from nvme_strom_tpu.io.resilient import ResilientEngine
+    from nvme_strom_tpu.models.kv_offload import PrefixStore
+    from nvme_strom_tpu.models.serving import DecodeServer
+    from nvme_strom_tpu.models.transformer import (TransformerConfig,
+                                                   init_params,
+                                                   tiny_config)
+    from nvme_strom_tpu.parallel.weights import (FaultingCheckpoint,
+                                                 LazyCheckpoint)
+    from nvme_strom_tpu.utils.config import EngineConfig
+    from nvme_strom_tpu.utils.stats import StromStats
+
+    if trials <= 0:
+        trials = int(os.environ.get("STROM_BENCH_HANDOFF_TRIALS", "1"))
+    pad_ms = os.environ.get("STROM_BENCH_HANDOFF_PAD_MS", "2")
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32, "max_seq": 1024})
+    params0 = init_params(jax.random.key(0), cfg)
+    wpath = os.path.join(os.path.dirname(path),
+                         ".bench_handoff.safetensors")
+    write_safetensors(wpath, {n: np.asarray(a)
+                              for n, a in params0.items()})
+    store_path = os.path.join(os.path.dirname(path),
+                              ".bench_handoff.kvstore")
+    shard = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    shardings = lambda name, shape: shard   # noqa: E731
+    chunk = 1 << 20
+    warm_bytes = min(os.path.getsize(path),
+                     int(os.environ.get("STROM_BENCH_HANDOFF_MB",
+                                        "256")) << 20)
+    rng = np.random.default_rng(23)
+    max_new = 24
+    sessions = [(f"s{i}", rng.integers(0, cfg.vocab, 48).tolist())
+                for i in range(3)]
+
+    def engine():
+        stats = StromStats()
+        eng = ResilientEngine(StromEngine(
+            EngineConfig(chunk_bytes=chunk, queue_depth=8,
+                         buffer_pool_bytes=64 << 20, n_rings=0),
+            stats=stats))
+        return eng, stats
+
+    def read_payload(eng, klass):
+        fh = eng.open(path)
+        try:
+            off = 0
+            while off < warm_bytes:
+                exts = []
+                while off < warm_bytes and len(exts) < 8:
+                    n = min(chunk, warm_bytes - off)
+                    exts.append((fh, off, n))
+                    off += n
+                for pieces in plan_and_submit(eng, exts,
+                                              chunk_bytes=chunk,
+                                              klass=klass):
+                    for p in pieces:
+                        p.wait()
+                        p.release()
+        finally:
+            eng.close(fh)
+
+    def serve_all(srv, t0):
+        # run every admitted session to completion; TTFT-from-boot is
+        # marked the first time ANY session's token lands on the host
+        want = {r for r in ("s0", "s1", "s2")
+                if r in {q.rid for q in srv.queue}
+                | {s.rid for s in srv.slots if s is not None}}
+        results, ttft = {}, None
+        while len(results) < len(want):
+            fin = srv.step_many(2)
+            if ttft is None and (fin or any(
+                    s is not None and s.out for s in srv.slots)):
+                ttft = time.monotonic() - t0
+            results.update(fin)
+        return results, (ttft if ttft is not None
+                         else time.monotonic() - t0)
+
+    def run_off():
+        # abrupt kill: nothing survives — the replacement cold-boots
+        # (full restore + warm payload first) and recomputes everything
+        t0 = time.monotonic()
+        eng, stats = engine()
+        try:
+            params = LazyCheckpoint(wpath).load_sharded(shardings,
+                                                        engine=eng)
+            read_payload(eng, "restore")
+            srv = DecodeServer(params, cfg, max_batch=4, max_len=256)
+            for rid, prompt in sessions:
+                srv.submit(rid, prompt, max_new)
+            results, ttft = serve_all(srv, t0)
+            total = time.monotonic() - t0
+        finally:
+            eng.close_all()
+        return {"ttft_boot_s": round(ttft, 4),
+                "total_s": round(total, 4), "final": results}
+
+    def run_on():
+        try:
+            os.unlink(store_path)
+        except OSError:
+            pass
+        try:
+            os.unlink(store_path + ".kvman.json")
+        except OSError:
+            pass
+        # -- the OLD replica: serve partway, then drain & publish
+        eng_a, stats_a = engine()
+        try:
+            params = LazyCheckpoint(wpath).load_sharded(shardings,
+                                                        engine=eng_a)
+            store_a = PrefixStore(cfg, eng_a, store_path,
+                                  page_tokens=16,
+                                  capacity_bytes=32 << 20)
+            srv_a = DecodeServer(params, cfg, max_batch=4,
+                                 max_len=256, kv_store=store_a)
+            for rid, prompt in sessions:
+                srv_a.submit(rid, prompt, max_new)
+            early = {}
+            for _ in range(6):          # mid-decode when the TERM lands
+                early.update(srv_a.step_many(1))
+            coord_a = DrainCoordinator(eng_a, server=srv_a,
+                                       checkpoint=wpath)
+            drained = coord_a.drain(deadline_s=0.0)
+            early.update(drained["results"])
+            bundle = drained["bundle"]
+            snap_a = stats_a.snapshot()
+            store_a.close()
+        finally:
+            eng_a.close_all()
+        # -- the REPLACEMENT: elastic boot + bundle consumption
+        t0 = time.monotonic()
+        eng_b, stats_b = engine()
+        try:
+            coord_b = ColdStartCoordinator(eng_b)
+            coord_b.add_warmup(lambda: read_payload(eng_b, "prefetch"))
+            fck = FaultingCheckpoint(wpath, shardings, engine=eng_b,
+                                     coordinator=coord_b)
+            store_b = PrefixStore(cfg, eng_b, store_path,
+                                  page_tokens=16,
+                                  capacity_bytes=32 << 20)
+            srv_b = DecodeServer(fck, cfg, max_batch=4, max_len=256,
+                                 kv_store=store_b)
+            consumed = coord_b.consume_handoff(store_path,
+                                               server=srv_b,
+                                               checkpoint=fck)
+            results, ttft = serve_all(srv_b, t0)
+            total = time.monotonic() - t0
+            coord_b.wait_steady(timeout=600)
+            fck.join_bulk(timeout=600)
+            pf = (consumed or {}).get("prefault_thread")
+            if pf is not None:
+                pf.join(timeout=600)   # its reads need the live engine
+            snap_b = stats_b.snapshot()
+            store_b.close()
+        finally:
+            eng_b.close_all()
+        emitted = (consumed or {}).get("sessions", {})
+        final = dict(early)
+        for rid, cont in results.items():
+            final[rid] = list(emitted.get(rid, [])) + list(cont)
+        return {"ttft_boot_s": round(ttft, 4),
+                "total_s": round(total, 4),
+                "drain_phase": snap_a.get("drain_phase"),
+                "sessions_exported": int(snap_a.get(
+                    "handoff_sessions_exported", 0)),
+                "sessions_restored": int(snap_b.get(
+                    "handoff_sessions_restored", 0)),
+                "bundle_bytes": int(snap_a.get("handoff_bundle_bytes",
+                                               0)),
+                "brownouts": int(snap_b.get("handoff_brownouts", 0)),
+                "bundle": bool(bundle), "final": final}
+
+    def median(runs, key):
+        xs = sorted(r[key] for r in runs)
+        return xs[len(xs) // 2]
+
+    prev_pad = os.environ.get("STROM_FAULT_READ_DELAY_MS")
+    if pad_ms != "0":
+        os.environ["STROM_FAULT_READ_DELAY_MS"] = pad_ms
+    try:
+        # compile outside the timed arms: one DISCARDED pass of each —
+        # the on arm's re-admitted sessions prefill at prompt+emitted
+        # length, a shape the off arm never runs, so a shared warm pass
+        # cannot cover both
+        run_off()
+        run_on()
+        offs = [run_off() for _ in range(trials)]
+        ons = [run_on() for _ in range(trials)]
+    finally:
+        if prev_pad is None:
+            os.environ.pop("STROM_FAULT_READ_DELAY_MS", None)
+        else:
+            os.environ["STROM_FAULT_READ_DELAY_MS"] = prev_pad
+        for p in (wpath, store_path, store_path + ".kvman.json",
+                  store_path + ".handoff.json"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    ref = offs[0]["final"]
+    dropped = 0
+    identical = True
+    for runs in (offs, ons):
+        for r in runs:
+            for rid, _ in sessions:
+                toks = r["final"].get(rid)
+                if toks is None or len(toks) != max_new:
+                    dropped += 1
+                elif toks != ref[rid]:
+                    identical = False
+    t_off = median(offs, "ttft_boot_s")
+    t_on = median(ons, "ttft_boot_s")
+    off = {**offs[0], "ttft_boot_s": t_off,
+           "total_s": median(offs, "total_s")}
+    on = {**ons[0], "ttft_boot_s": t_on,
+          "total_s": median(ons, "total_s")}
+    for r in (off, on):
+        r.pop("final", None)
+    return {
+        "off": off, "on": on,
+        "trials": trials,
+        "service_pad_ms": float(pad_ms),
+        "warm_payload_mb": warm_bytes >> 20,
+        "ttft_boot_speedup": round(t_off / t_on, 2) if t_on else 0.0,
+        "dropped_requests": dropped,
+        "tokens_identical": identical,
+    }
+
+
 def bench_tenants(path: str, trials: int = 1) -> dict:
     """Multi-tenant isolation storm (docs/RESILIENCE.md "Multi-tenant
     isolation"): an open-loop, trace-driven replay of concurrent
@@ -2112,6 +2373,22 @@ def main() -> int:
              f"{coldstart['on']['coldstart_faults']} tokens_identical="
              f"{coldstart['tokens_identical']}")
 
+    # Drain & warm handoff: rolling replica replacement with vs without
+    # a shipped warm-state bundle — replacement TTFT-from-boot, the
+    # zero-drop ledger, and token identity.  STROM_BENCH_HANDOFF=0
+    # skips.
+    handoff = None
+    if os.environ.get("STROM_BENCH_HANDOFF", "1") != "0":
+        handoff = bench_handoff(path)
+        _log(f"bench: handoff: replacement TTFT-from-boot "
+             f"{handoff['off']['ttft_boot_s']:.3f}s (abrupt kill) vs "
+             f"{handoff['on']['ttft_boot_s']:.3f}s (warm bundle, "
+             f"{handoff['ttft_boot_speedup']:.1f}x), sessions "
+             f"exported={handoff['on']['sessions_exported']} "
+             f"restored={handoff['on']['sessions_restored']}, dropped="
+             f"{handoff['dropped_requests']} tokens_identical="
+             f"{handoff['tokens_identical']}")
+
     direct_ok = info.supports_direct
     bounce = cold_bounce
     if direct_ok and bounce and device_ok:
@@ -2220,6 +2497,7 @@ def main() -> int:
         # token-identity verdict (docs/RESILIENCE.md "Elastic
         # cold-start")
         "coldstart": coldstart,
+        "handoff": handoff,
         "health": {
             "breaker_trips": int(stats.breaker_trips),
             "ring_restarts": int(stats.ring_restarts),

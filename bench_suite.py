@@ -1031,6 +1031,31 @@ def bench_coldstart_suite(nbytes: int) -> tuple[float, str]:
     return float(out["ttft_boot_speedup"]), tag
 
 
+def bench_handoff_suite(nbytes: int) -> tuple[float, str]:
+    """Config 25: drain & warm handoff (docs/RESILIENCE.md "Drain &
+    handoff") — rolling replica replacement, replacement
+    TTFT-from-boot with vs without a shipped warm-state bundle,
+    median over trials, with the zero-drop ledger and token-identity
+    verdict in the tag.  Delegates to ``bench.bench_handoff`` (own
+    engines, own checkpoint/store/bundle files).  Headline is the
+    TTFT-from-boot speedup (off/on); paired with its own same-run off
+    arm, so no read-ceiling ratio applies."""
+    d = _scratch_dir()
+    path = os.path.join(d, "handoff.bin")
+    bench.make_file(path, max(nbytes, 64 << 20))
+    trials = 2 if _tiny_compute() else 3
+    out = bench.bench_handoff(path, trials=trials)
+    tag = (f"ttft_boot={out['off']['ttft_boot_s']}s off"
+           f", {out['on']['ttft_boot_s']}s on"
+           f", exported={out['on']['sessions_exported']}"
+           f", restored={out['on']['sessions_restored']}"
+           f", dropped={out['dropped_requests']}"
+           f", tokens_identical={out['tokens_identical']}"
+           f", pad={out['service_pad_ms']}ms"
+           f", trials={out['trials']}")
+    return float(out["ttft_boot_speedup"]), tag
+
+
 def bench_tar_index(engine, nbytes: int) -> tuple[float, str]:
     """Config 16: WebDataset shard-index rate (members/s), native C
     header walk vs Python tarfile — the first-epoch metadata cost of a
@@ -2353,6 +2378,12 @@ def run(configs: list[int], emit=None) -> list[dict]:
             # dev box) — so no read-ceiling ratio applies
             24: ("cold-start-restore",
                  lambda: bench_coldstart_suite(nbytes), "x", False),
+            # drain & warm handoff: replacement TTFT-from-boot speedup
+            # of a bundle-fed boot over an abrupt-kill cold boot, with
+            # the zero-drop session ledger in the tag — same pairing
+            # rationale as config 24
+            25: ("drain-handoff",
+                 lambda: bench_handoff_suite(nbytes), "x", False),
         }
         # only configs whose _steady passes move payload ACROSS the
         # link get per-pass pairing: config 8's passes are pure engine
@@ -2432,7 +2463,7 @@ def main() -> int:
     args = ap.parse_args()
     configs = sorted(set(args.config or [])) if args.config else []
     if args.all or not configs:
-        configs = list(range(1, 25))
+        configs = list(range(1, 26))
     run(configs, emit=lambda row: print(json.dumps(row), flush=True))
     return 0
 

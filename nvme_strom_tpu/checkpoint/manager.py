@@ -66,7 +66,11 @@ _KVMAN_SUFFIX = ".kvman.json"
 # hostcache warmup-hint sidecars (io/warmup.py) ride the exact same
 # orphan rules: same age gate, same sweeper, a second suffix
 _WARMHINT_SUFFIX = ".warmhints.json"
-_SIDECAR_SUFFIXES = (_KVMAN_SUFFIX, _WARMHINT_SUFFIX)
+# drain & handoff bundles (io/handoff.py): a bundle whose anchor file
+# is gone can never validate, so it is debris under the same gate
+_HANDOFF_SUFFIX = ".handoff.json"
+_SIDECAR_SUFFIXES = (_KVMAN_SUFFIX, _WARMHINT_SUFFIX,
+                     _HANDOFF_SUFFIX)
 
 
 def _is_orphan_sidecar(path: str, name: str, suffixes) -> bool:

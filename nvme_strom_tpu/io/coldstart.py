@@ -153,6 +153,20 @@ class ColdStartCoordinator:
                 pass
         self._advance("steady")
 
+    def consume_handoff(self, base: str, server=None,
+                        checkpoint=None) -> Optional[dict]:
+        """Boot from a retiring replica's handoff bundle
+        (io/handoff.py, docs/RESILIENCE.md "Drain & handoff"):
+        exported sessions re-admit first at decode class, the shipped
+        hot set pre-faults ahead of the bulk stream, and warm-hint
+        replays queue on THIS coordinator's warming phase at prefetch
+        class.  A torn/stale/missing bundle returns None and this boot
+        proceeds as the plain elastic cold start it already is —
+        brown-out, never black-out."""
+        from nvme_strom_tpu.io.handoff import consume_bundle
+        return consume_bundle(base, engine=self.engine, server=server,
+                              coordinator=self, checkpoint=checkpoint)
+
     def wait_steady(self, timeout: Optional[float] = None) -> bool:
         """Block until the warmup drain finishes (tests/benches)."""
         with self._lock:

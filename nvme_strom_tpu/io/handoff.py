@@ -1,0 +1,563 @@
+"""Zero-downtime drain & warm handoff: rolling replica replacement
+with shipped warm-state bundles (docs/RESILIENCE.md "Drain & handoff").
+
+PR 19 made a replica's boot elastic (serve-while-restoring); this
+module makes its RETIREMENT elastic.  Today a replacement is an abrupt
+kill — in-flight decode sessions error out, nothing sheds ahead of
+time, and the replacement boots against whatever stale manifests
+happen to be on disk.  With ``STROM_HANDOFF=1`` the retiring replica
+instead walks a forward-only phase machine mirroring the cold-start
+coordinator's:
+
+    serving ──drain requested──▶ draining ──in-flight done /
+                                           │ deadline hit
+                              bundle built ▼
+              retired ◀──published── handing_off
+
+* ``serving``     — normal operation; the coordinator is passive.
+* ``draining``    — new prefill admissions DEFER (the PR-10/17 shed
+  path's semantics: requests stay queued, nothing fails) while
+  in-flight sessions run to completion under a bounded
+  ``STROM_DRAIN_DEADLINE_S``.  A drain that outlives its deadline with
+  sessions still decoding dumps ``reason=handoff_stall`` with the
+  drain phase and the scheduler's per-class backlog.
+* ``handing_off`` — the warm state ships: fresh ``.warmhints.json``
+  hostcache snapshots, the ``PrefixStore``'s proven-drained flush +
+  clean manifest, the cold-start claim-table residue (tensors the old
+  replica demand-faulted — its measured hot set), per-tenant SLO/
+  ledger state, and — for sessions still queued or decoding past the
+  deadline — exported session state (prompt token chain + KV page
+  keys) so the replacement re-admits them through the PR-9 prefix
+  store instead of recomputing from scratch.  Everything lands in one
+  atomic ``<base>.handoff.json`` bundle (the io/warmup.py temp+rename
+  + staleness-validation discipline).
+* ``retired``     — bundle published; the process may exit.
+
+On the receiving side :func:`consume_bundle` replays a bundle at boot:
+warm hints and the KV manifest at ``prefetch`` class, claim-table
+residue at ``restore`` class ahead of the bulk stream, exported
+sessions re-admitted FIRST at ``decode`` class.  A torn, stale, or
+missing bundle is a brown-out to a plain PR-19 cold start
+(``handoff_brownouts``) — never a black-out, never an error.
+
+The phase is exported as the ``drain_phase`` gauge through StromStats
+→ strom_stat/strom-top/debugsrv ``/health``; every counter lives in
+the ``handoff_*`` block.  ``STROM_HANDOFF=0`` (default) is bit-for-bit
+inert, proven by test.
+
+Locking: ``handoff.DrainCoordinator._lock`` is a leaf-facing
+coordinator lock (group ``handoff`` in analysis/lock_order.conf).
+Engine work — serving steps, store flushes, hint collection, flight
+dumps — runs OUTSIDE the lock; only phase/word-size state mutates
+under it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from nvme_strom_tpu.utils.config import HandoffConfig
+from nvme_strom_tpu.utils.lockwitness import make_lock
+from nvme_strom_tpu.utils.stats import _atomic_write_text
+
+#: drain phases in order; index = numeric gauge code
+DRAIN_PHASES = ("serving", "draining", "handing_off", "retired")
+
+#: bundle sidecar suffix; checkpoint/manager.py lists it next to
+#: ``.kvman.json``/``.warmhints.json`` in its age-gated orphan sweep
+HANDOFF_SUFFIX = ".handoff.json"
+
+_VERSION = 1
+
+
+def bundle_path(base: str) -> str:
+    """``<base>.handoff.json`` — the bundle location anchored to
+    ``base`` (the KV prefix-store page file, normally): the orphan GC's
+    base-file-gone verdict and the staleness validation both key off
+    the anchor, exactly like the warm-hint sidecars."""
+    return base + HANDOFF_SUFFIX
+
+
+def _stat_block(path: str) -> Optional[dict]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return {"size": st.st_size, "mtime_ns": st.st_mtime_ns}
+
+
+def write_handoff_bundle(base: str, doc: dict) -> Optional[str]:
+    """Atomically publish ``doc`` as ``base``'s handoff bundle
+    (temp + rename: a replacement sees the old bundle or the new one,
+    never a prefix).  Stamps the anchor's size/mtime_ns so a bundle
+    outliving a rewritten base file loads as a cold start.  Returns
+    the bundle path, or None when the anchor is gone."""
+    anchor = _stat_block(base)
+    if anchor is None:
+        return None
+    out = bundle_path(base)
+    doc = dict(doc)
+    doc["version"] = _VERSION
+    doc["base"] = anchor
+    _atomic_write_text(out, json.dumps(doc, sort_keys=True))
+    return out
+
+
+def load_handoff_bundle(base: str) -> Optional[dict]:
+    """Load and validate ``base``'s bundle against the CURRENT anchor
+    file: a missing, corrupt, version-skewed, or stale bundle (anchor
+    rewritten since publish) yields ``None`` — the brown-out ladder's
+    first rung, a plain cold start, never an error."""
+    manifest = bundle_path(base)
+    try:
+        with open(manifest, "r") as f:
+            doc = json.load(f)
+        st = os.stat(base)
+    except (OSError, ValueError):
+        return None
+    if (not isinstance(doc, dict)
+            or doc.get("version") != _VERSION
+            or not isinstance(doc.get("base"), dict)
+            or doc["base"].get("size") != st.st_size
+            or doc["base"].get("mtime_ns") != st.st_mtime_ns):
+        return None
+    ck = doc.get("checkpoint")
+    if ck is not None:
+        # the replacement must serve the SAME checkpoint generation:
+        # sessions and hot tensors from yesterday's weights would
+        # restore the wrong model's state
+        if (not isinstance(ck, dict)
+                or _stat_block(str(ck.get("path", ""))) !=
+                {"size": ck.get("size"), "mtime_ns": ck.get("mtime_ns")}):
+            return None
+    sessions = doc.get("sessions", [])
+    if not isinstance(sessions, list):
+        return None
+    for s in sessions:
+        try:
+            if (not s["prompt"] or int(s["max_new"]) < 1
+                    or not all(isinstance(t, int) for t in s["prompt"])
+                    or not all(isinstance(t, int)
+                               for t in s.get("emitted", []))):
+                return None
+        except (TypeError, KeyError, ValueError):
+            return None
+    return doc
+
+
+class DrainCoordinator:
+    """Drives one replica's retirement: the drain phase machine, the
+    deferred-admission gate on the server, the stall dump, and the
+    bundle publish.
+
+    Thread-safe like the cold-start coordinator; construction alone
+    changes nothing — the machine only moves when :meth:`begin_drain`
+    (or a ``STROM_DRAIN_ON_SIGTERM`` handler) fires.  Integrators gate
+    construction on ``handoff_enabled()``; with the gate off nothing
+    builds one and the stack is bit-for-bit the pre-handoff code.
+    """
+
+    def __init__(self, engine=None, server=None,
+                 cfg: Optional[HandoffConfig] = None,
+                 checkpoint: Optional[str] = None,
+                 hint_paths: Optional[Sequence[str]] = None,
+                 bundle: Optional[str] = None) -> None:
+        self.cfg = cfg or HandoffConfig()
+        self.engine = engine
+        self.server = server
+        self.checkpoint = checkpoint
+        self.hint_paths = list(hint_paths or [])
+        self._bundle = bundle
+        self._lock = make_lock("handoff.DrainCoordinator._lock")
+        self._phase = "serving"
+        self._t0 = time.monotonic()
+        self._t_phase: Dict[str, float] = {"serving": 0.0}
+        self._published: Optional[str] = None
+
+    # -- phase machine -----------------------------------------------------
+
+    @property
+    def phase(self) -> str:
+        with self._lock:
+            return self._phase
+
+    def phase_times(self) -> Dict[str, float]:
+        """Seconds-from-construction each phase was entered."""
+        with self._lock:
+            return dict(self._t_phase)
+
+    @property
+    def bundle(self) -> Optional[str]:
+        """Where the bundle goes (anchored to the KV store's page file
+        unless given explicitly); None when nothing anchors it."""
+        if self._bundle is not None:
+            return self._bundle
+        store = getattr(self.server, "kv_store", None)
+        path = getattr(store, "path", None)
+        return bundle_path(path) if path else None
+
+    def _advance(self, new: str) -> bool:
+        """Move forward only — a late drain request from a slow thread
+        never rewinds the machine.  Returns True on a real
+        transition."""
+        with self._lock:
+            if DRAIN_PHASES.index(new) <= DRAIN_PHASES.index(self._phase):
+                return False
+            self._phase = new
+            self._t_phase[new] = round(time.monotonic() - self._t0, 6)
+        self._export_gauge()
+        return True
+
+    def _export_gauge(self) -> None:
+        stats = self._stats()
+        if stats is not None:
+            ph = self.phase
+            stats.set_gauges(drain_phase=ph,
+                             drain_phase_code=DRAIN_PHASES.index(ph))
+
+    def _stats(self):
+        return getattr(self.engine, "stats", None)
+
+    # -- the protocol ------------------------------------------------------
+
+    def begin_drain(self) -> bool:
+        """Enter ``draining``: the server stops admitting new prefills
+        (deferred with the shed path's semantics, never dropped).
+        Idempotent; returns True on the real transition."""
+        if not self._advance("draining"):
+            return False
+        stats = self._stats()
+        if stats is not None:
+            stats.add(handoff_drains=1)
+        srv = self.server
+        if srv is not None and hasattr(srv, "begin_drain"):
+            srv.begin_drain()
+        return True
+
+    def drain(self, lookahead: int = 4,
+              deadline_s: Optional[float] = None) -> Dict[str, object]:
+        """The full retirement: drain in-flight sessions under the
+        deadline (stepping the server so they finish and their tokens
+        are DELIVERED by this replica), then publish the bundle and
+        retire.  Returns ``{"results": {rid: tokens}, "bundle": path}``
+        — ``results`` are the sessions that completed here; everything
+        still live rode the bundle instead.  Zero sessions are ever
+        dropped."""
+        self.begin_drain()
+        deadline = (self.cfg.deadline_s if deadline_s is None
+                    else float(deadline_s))
+        srv = self.server
+        results: Dict[object, List[int]] = {}
+        stalled = False
+        t0 = time.monotonic()
+        while srv is not None and not srv.idle:
+            if time.monotonic() - t0 >= deadline:
+                stalled = True
+                break
+            if all(s is None for s in srv.slots):
+                # only deferred queue entries remain: they export —
+                # stepping again would spin on the closed admission gate
+                break
+            results.update(srv.step_many(lookahead))
+            if self.cfg.poll_ms > 0:
+                time.sleep(0.0)   # yield; decode paces the loop itself
+        if stalled:
+            self._stall_dump(time.monotonic() - t0, deadline)
+        path = self.publish_bundle()
+        self._advance("retired")
+        return {"results": results, "bundle": path}
+
+    def publish_bundle(self) -> Optional[str]:
+        """Build and atomically publish the warm-state bundle
+        (``handing_off`` → the write).  Best-effort per part — a piece
+        that cannot be collected ships as absent, and the replacement's
+        validation decides what it can still use.  Returns the bundle
+        path or None (nothing to anchor to / anchor gone)."""
+        self._advance("handing_off")
+        out = self.bundle
+        if out is None:
+            return None
+        base = out[:-len(HANDOFF_SUFFIX)]
+        srv = self.server
+        store = getattr(srv, "kv_store", None)
+        stats = self._stats()
+
+        # 1) sessions still queued or decoding: exported, then removed
+        # from the retiring server so it can end idle
+        sessions: List[dict] = []
+        if srv is not None and hasattr(srv, "export_sessions"):
+            sessions = srv.export_sessions(self.cfg.max_sessions,
+                                           pop=True)
+
+        # 2) the PrefixStore's proven-drained flush (the PR-13 stamping
+        # — the ONLY flush a clean manifest may come from), plus the
+        # stamped key set so the bundle never references a page whose
+        # write was not proven complete
+        ready: set = set()
+        if store is not None:
+            try:
+                ready = set(store.flush_for_handoff())
+            except Exception:
+                ready = set()
+            for s in sessions:
+                s["kv_keys"] = [k for k in s.get("kv_keys", [])
+                                if k in ready]
+
+        # 3) fresh hostcache warm-hint snapshots for every file the
+        # replica served hot (the store's page file rides implicitly)
+        from nvme_strom_tpu.io.warmup import refresh_hints
+        paths = list(self.hint_paths)
+        if store is not None and getattr(store, "path", None):
+            paths.append(store.path)
+        hints = refresh_hints(self.engine, paths)
+
+        # 4) cold-start claim-table residue: the tensors requests could
+        # not wait for — the old replica's measured hot set
+        hot: List[str] = []
+        src = getattr(srv, "_param_source", None)
+        names = getattr(src, "fault_names", None)
+        if callable(names):
+            try:
+                hot = list(names())
+            except Exception:
+                hot = []
+
+        # 5) per-tenant SLO/ledger state (share_boost notches + the
+        # per-tenant counter ledger) so isolation decisions survive
+        # the replacement
+        tenants = self._tenant_state(stats)
+
+        doc = {
+            "checkpoint": (dict(_stat_block(self.checkpoint) or {},
+                                path=self.checkpoint)
+                           if self.checkpoint else None),
+            "kv_manifest": (store.manifest_path
+                            if store is not None else None),
+            "warm_hints": hints,
+            "hot_tensors": hot,
+            "tenants": tenants,
+            "sessions": sessions,
+        }
+        path = write_handoff_bundle(base, doc)
+        if path is not None and stats is not None:
+            stats.add(handoff_bundles=1,
+                      handoff_bundle_bytes=os.path.getsize(path),
+                      handoff_sessions_exported=len(sessions))
+        return path
+
+    def _tenant_state(self, stats) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        try:
+            from nvme_strom_tpu.io.tenants import get_registry
+            out = get_registry().export_state()
+        except Exception:
+            out = {}
+        ledger = (stats.tenant_stats if stats is not None else {})
+        for tid, counters in ledger.items():
+            out.setdefault(tid, {})["ledger"] = dict(counters)
+        return out
+
+    def _stall_dump(self, waited_s: float, deadline_s: float) -> None:
+        flight = getattr(self.engine, "flight", None)
+        if flight is None:
+            return
+        sched = getattr(self.engine, "scheduler", None)
+        backlog = sched.backlog() if sched is not None else {}
+        srv = self.server
+        path = flight.dump("handoff_stall", extra={
+            "drain_phase": self.phase,
+            "waited_s": round(waited_s, 3),
+            "deadline_s": deadline_s,
+            "slots_busy": (sum(s is not None for s in srv.slots)
+                           if srv is not None else 0),
+            "queued": len(srv.queue) if srv is not None else 0,
+            "backlog": backlog,
+        })
+        stats = self._stats()
+        if path is not None and stats is not None:
+            stats.add(handoff_stall_dumps=1)
+
+    # -- graceful-shutdown exit hook ---------------------------------------
+
+    def final_snapshot(self, reason: str = "exit") -> None:
+        """The exit flush a TERM used to lose: a last metrics snapshot
+        to the export/textfile targets plus a FORCED flight dump of the
+        tail ops."""
+        stats = self._stats()
+        if stats is not None:
+            try:
+                stats.maybe_export()
+            except Exception:
+                pass
+        flight = getattr(self.engine, "flight", None)
+        if flight is not None:
+            try:
+                flight.dump("handoff_exit", extra={
+                    "drain_phase": self.phase,
+                    "reason": reason,
+                }, force=True)
+            except Exception:
+                pass
+
+
+def install_drain_signals(coord: DrainCoordinator, signals=None,
+                          chain: bool = True) -> Optional[dict]:
+    """Install SIGTERM/SIGINT handlers that drain-and-retire before the
+    process dies (``STROM_DRAIN_ON_SIGTERM=1``; a no-op dict-less None
+    when the knob is off, so stock signal semantics survive the gate).
+
+    The handler enters the full drain (bundle publish included), then
+    flushes the final snapshot; with ``chain`` it forwards to the
+    previously-installed handler (or raises ``SystemExit(128+sig)`` for
+    the default action) so supervisors still observe the termination.
+    Returns ``{signum: previous_handler}`` for
+    :func:`uninstall_drain_signals`."""
+    import signal as _signal
+    if not coord.cfg.drain_on_sigterm:
+        return None
+    sigs = tuple(signals or (_signal.SIGTERM, _signal.SIGINT))
+    prev: dict = {}
+
+    def _handler(signum, frame):
+        try:
+            coord.drain()
+        finally:
+            coord.final_snapshot(reason=f"signal {signum}")
+            if chain:
+                p = prev.get(signum)
+                if callable(p):
+                    p(signum, frame)
+                elif p == _signal.SIG_DFL:
+                    raise SystemExit(128 + signum)
+
+    for s in sigs:
+        prev[s] = _signal.signal(s, _handler)
+    return prev
+
+
+def uninstall_drain_signals(prev: Optional[dict]) -> None:
+    """Restore the handlers :func:`install_drain_signals` displaced."""
+    import signal as _signal
+    for s, h in (prev or {}).items():
+        _signal.signal(s, h)
+
+
+# ---------------------------------------------------------------------------
+# the receiving side: bundle consumption at boot
+# ---------------------------------------------------------------------------
+
+def consume_bundle(base: str, engine=None, server=None,
+                   coordinator=None, checkpoint=None,
+                   stats=None) -> Optional[dict]:
+    """Replay ``base``'s handoff bundle into a freshly-booted replica.
+
+    * exported sessions re-admit FIRST (``server.submit`` — the decode
+      class; their prefix pages restore through the PR-9 store instead
+      of re-prefilling) — the returned ``{"sessions": {rid: emitted}}``
+      carries each session's already-delivered tokens so the consumer
+      composes ``emitted + replacement_tokens`` into the full answer;
+    * claim-table residue pre-faults at ``restore`` class ahead of the
+      bulk stream (``checkpoint`` = the FaultingCheckpoint, optional);
+    * warm hints replay at ``prefetch`` class — through the cold-start
+      coordinator's warming phase when one is given, else inline.
+
+    A torn/stale/missing bundle returns None and counts ONE
+    ``handoff_brownouts`` — the replacement then runs a plain PR-19
+    cold start with zero errors (the brown-out ladder)."""
+    stats = stats if stats is not None \
+        else getattr(engine, "stats", None)
+    doc = load_handoff_bundle(base)
+    if doc is None:
+        if stats is not None:
+            stats.add(handoff_brownouts=1)
+        return None
+
+    restored = 0
+    sessions: Dict[object, List[int]] = {}
+    for s in doc.get("sessions", []):
+        emitted = [int(t) for t in s.get("emitted", [])]
+        prompt = [int(t) for t in s["prompt"]] + emitted
+        rid = s.get("rid")
+        if server is not None:
+            try:
+                server.submit(rid, prompt, int(s["max_new"]),
+                              eos_id=s.get("eos_id"),
+                              temperature=float(s.get("temperature",
+                                                      0.0)),
+                              top_p=float(s.get("top_p", 1.0)),
+                              seed=int(s.get("seed", 0)),
+                              tenant=s.get("tenant"))
+            except (ValueError, TypeError):
+                continue   # one bad session never blacks out the rest
+        sessions[rid] = emitted
+        restored += 1
+    if restored and stats is not None:
+        stats.add(handoff_sessions_restored=restored)
+
+    hot = [str(n) for n in doc.get("hot_tensors", [])]
+    prefault_thread = None
+    if hot and checkpoint is not None and hasattr(checkpoint, "get"):
+        def _prefault(names=tuple(hot), ckpt=checkpoint):
+            for name in names:
+                try:
+                    ckpt.get(name, klass="restore")
+                except Exception:
+                    return   # bulk lane still owns completeness
+        prefault_thread = threading.Thread(target=_prefault,
+                                           name="strom-handoff-hot",
+                                           daemon=True)
+        prefault_thread.start()
+
+    hints = [str(p) for p in doc.get("warm_hints", [])]
+    n_hints = 0
+    if engine is not None and hints:
+        from nvme_strom_tpu.io.warmup import prefetch_hints
+        if coordinator is not None \
+                and hasattr(coordinator, "add_warmup"):
+            for p in hints:
+                coordinator.add_warmup(
+                    lambda eng=engine, pp=p: prefetch_hints(eng, pp))
+            n_hints = len(hints)
+        else:
+            for p in hints:
+                n_hints += 1 if prefetch_hints(engine, p) else 0
+
+    _restore_tenants(doc.get("tenants", {}), stats)
+    if stats is not None:
+        stats.set_gauges(handoff_source="bundle")
+    # callers tearing the stack down early must join prefault_thread
+    # BEFORE closing the engine — its reads target live engine state
+    # (the bulk thread has join_bulk for the same reason)
+    return {"sessions": sessions, "restored": restored,
+            "hints": n_hints, "hot_tensors": len(hot),
+            "prefault_thread": prefault_thread,
+            "bundle": bundle_path(base)}
+
+
+def _restore_tenants(state: Dict[str, dict], stats) -> None:
+    """Re-apply per-tenant SLO boosts and fold the shipped ledger into
+    the replacement's stats — isolation pressure and fleet dashboards
+    survive the replacement instead of resetting."""
+    if not state:
+        return
+    try:
+        from nvme_strom_tpu.io.tenants import get_registry, \
+            tenants_enabled
+        if tenants_enabled():
+            get_registry().restore_state(state)
+    except Exception:
+        pass
+    if stats is None:
+        return
+    for tid, st in state.items():
+        ledger = st.get("ledger")
+        if isinstance(ledger, dict):
+            try:
+                stats.add_tenant_stat(tid, **{
+                    k: int(v) for k, v in ledger.items()})
+            except (TypeError, ValueError):
+                pass

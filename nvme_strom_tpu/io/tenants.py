@@ -246,6 +246,40 @@ class TenantRegistry:
     def tenants(self) -> List[Tenant]:
         return list(self._tenants.values())
 
+    # -- drain & handoff (io/handoff.py) ----------------------------------
+
+    def export_state(self) -> Dict[str, dict]:
+        """Per-tenant MUTABLE state worth shipping to a replacement
+        replica: today that is the SLO governor's share_boost notches
+        (the spec itself travels via STROM_TENANT_SPEC, not the
+        bundle).  Zero-boost tenants are omitted — nothing to restore."""
+        out: Dict[str, dict] = {}
+        for t in self._tenants.values():
+            if t.share_boost:
+                out[t.id] = {"share_boost": int(t.share_boost)}
+        return out
+
+    def restore_state(self, state: Dict[str, dict]) -> int:
+        """Re-apply shipped per-tenant state (bounded exactly as the
+        governor bounds live boosts) so isolation pressure survives a
+        replacement instead of resetting to zero.  Returns tenants
+        touched; malformed entries are skipped — a handoff bundle is
+        advisory, never load-bearing."""
+        from nvme_strom_tpu.models.kv_offload import SloGovernor
+        cap = getattr(SloGovernor, "_MAX_BOOST", 3)
+        n = 0
+        for tid, st in (state or {}).items():
+            try:
+                boost = int(st.get("share_boost", 0))
+            except (AttributeError, TypeError, ValueError):
+                continue
+            if boost < 1:
+                continue
+            t = self.get(tid)
+            t.share_boost = max(t.share_boost, min(boost, cap))
+            n += 1
+        return n
+
 
 # ---------------------------------------------------------------------------
 # contextvar propagation (the trace-context pattern)

@@ -78,6 +78,25 @@ def collect_warm_hints(engine, path: str,
     return out
 
 
+def refresh_hints(engine, paths: Sequence[str],
+                  max_spans: int = 1024) -> List[str]:
+    """Re-snapshot warm hints for every path in ``paths`` (drain-time:
+    a handoff bundle ships FRESH ``.warmhints.json`` sidecars, not
+    whatever a periodic snapshot last left behind).  Returns the BASE
+    paths whose sidecars were (re)written — the list a bundle records
+    so the replacement knows which files to replay at prefetch class.
+    Best-effort per path; duplicates collapse."""
+    out: List[str] = []
+    seen = set()
+    for p in paths:
+        if not p or p in seen:
+            continue
+        seen.add(p)
+        if collect_warm_hints(engine, p, max_spans=max_spans):
+            out.append(p)
+    return out
+
+
 def write_warm_hints(manifest: str, spans: Sequence[Tuple[int, int]], *,
                      size: int, mtime_ns: int) -> None:
     """Atomically publish a hint manifest (temp + rename: readers see

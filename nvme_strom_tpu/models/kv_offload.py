@@ -1743,6 +1743,29 @@ class PrefixStore:
             return
         self._save_manifest(clean=True, keys=stamped)
 
+    def flush_for_handoff(self) -> list:
+        """The drain-time flush the handoff path MUST use: exactly
+        :meth:`flush`'s proven-drained stamping — drain all in-flight
+        writes, stamp the clean manifest from the drained snapshot —
+        but returning the stamped key set (hex) so the bundle can be
+        audited to never reference a page whose write was not proven
+        complete.  A re-entrant call returns ``[]`` (the outer flush
+        owns the stamping; shipping keys it hasn't proven would defeat
+        the audit)."""
+        stamped = self._drain_all_and_snapshot()
+        if stamped is None:
+            return []
+        self._save_manifest(clean=True, keys=stamped)
+        return sorted(k.hex() for k in stamped)
+
+    def ready_keys(self) -> list:
+        """Hex keys of pages currently proven complete (ready, crc
+        stamped) — the audit surface tests pin handoff bundles
+        against."""
+        with self._lock:
+            return sorted(k.hex() for k, e in self._entries.items()
+                          if e.get("ready"))
+
     def close(self) -> None:
         if self._fh is not None:
             # gate BEFORE the flush: put() refuses new work once

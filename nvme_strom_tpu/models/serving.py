@@ -288,6 +288,14 @@ class DecodeServer:
         self._shed_probe = shed_probe
         #: admission opportunities deferred by shedding (stats())
         self.admissions_shed = 0
+        #: drain mode (io/handoff.py DrainCoordinator,
+        #: docs/RESILIENCE.md "Drain & handoff"): True closes the
+        #: admission gate with the shed path's DEFER semantics — queued
+        #: requests wait (for export), nothing drops.  Never set unless
+        #: a drain actually begins, so STROM_HANDOFF=0 stays inert.
+        self._draining = False
+        #: admission opportunities deferred by an active drain (stats())
+        self.admissions_deferred = 0
         #: content-addressed NVMe prefix store (models/kv_offload.py
         #: PrefixStore, docs/PERF.md §5) — None (default) is today's
         #: per-session path bit-for-bit.  Shared system prompts across
@@ -809,6 +817,9 @@ class DecodeServer:
         }
         if self.tenant_sheds:     # key appears only once tenancy acted
             out["tenant_sheds"] = dict(self.tenant_sheds)
+        if self._draining:        # and these only once a drain began
+            out["draining"] = True
+            out["admissions_deferred"] = self.admissions_deferred
         return out
 
     def _can_admit(self, req: _Request) -> bool:
@@ -843,6 +854,86 @@ class DecodeServer:
         stats = self._engine_stats()
         if stats is not None:
             stats.add(serve_admissions_shed=n)
+
+    # -- drain & handoff (io/handoff.py, docs/RESILIENCE.md) --------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """Close the admission gate for the remainder of this server's
+        life (drains are forward-only, like the phase machine driving
+        them): queued prefills DEFER — they stay queued for session
+        export, nothing is dropped — while in-flight decode keeps its
+        slots and runs to completion."""
+        self._draining = True
+
+    def _note_drain_defer(self, n: int) -> None:
+        self.admissions_deferred += n
+        stats = self._engine_stats()
+        if stats is not None:
+            stats.add(handoff_deferred=n)
+
+    def export_sessions(self, limit: int = 256,
+                        pop: bool = False) -> List[dict]:
+        """Export live session state for a handoff bundle: in-flight
+        slots first (their decode progress is the expensive part), then
+        the deferred queue, up to ``limit``.  Each entry carries the
+        prompt token chain, the tokens already DELIVERED (``emitted``),
+        the remaining ``max_new`` budget, the sampling params (seeded
+        sampling is position-keyed, so the replacement's continuation
+        is token-identical), and the session's NVMe prefix-store page
+        keys so its KV restores instead of re-prefilling.
+
+        ``pop`` removes exported sessions so the retiring server can
+        reach ``idle`` — their results are now the replacement's to
+        deliver."""
+        out: List[dict] = []
+        taken_slots: List[int] = []
+        taken_q: List[_Request] = []
+        for i, r in enumerate(self.slots):
+            if len(out) >= limit:
+                break
+            if r is None or r.max_new - len(r.out) < 1:
+                continue          # retiring this step anyway
+            out.append(self._export_one(r, emitted=list(r.out)))
+            taken_slots.append(i)
+        for r in self.queue:
+            if len(out) >= limit:
+                break
+            out.append(self._export_one(r, emitted=[]))
+            taken_q.append(r)
+        if pop:
+            for i in taken_slots:
+                self._release_slot(i)
+                self.slots[i] = None
+            self.queue = [r for r in self.queue
+                          if r not in taken_q]
+        return out
+
+    def _export_one(self, r: _Request, emitted: List[int]) -> dict:
+        doc = {
+            "rid": r.rid, "prompt": list(r.prompt),
+            "emitted": emitted,
+            "max_new": r.max_new - len(emitted),
+            "eos_id": r.eos_id, "temperature": r.temperature,
+            "top_p": r.top_p, "seed": int(r.seed),
+            "tenant": (r.tenant.id if r.tenant is not None else None),
+            "kv_keys": [],
+        }
+        store = self.kv_store
+        if store is not None:
+            try:
+                doc["kv_keys"] = [k.hex() for k in store.chain_keys(
+                    list(r.prompt) + emitted)]
+            except Exception:
+                doc["kv_keys"] = []
+        return doc
+
+    def _release_slot(self, slot: int) -> None:
+        """Capacity the slot held beyond the dense row itself — the
+        paged server overrides to free its blocks."""
 
     # -- multi-tenant admission (docs/RESILIENCE.md) ----------------------
 
@@ -1025,7 +1116,14 @@ class DecodeServer:
         # DEFER — they stay queued (re-checked every step; nothing
         # fails) and in-flight decode keeps its slots, so the sick
         # device serves the work it already owes instead of taking more
-        if self.queue and self._shed_now():
+        if self.queue and self._draining:
+            # drain mode (io/handoff.py): the gate is closed for NEW
+            # prefills only — queued requests hold for export to the
+            # replacement's bundle while in-flight slots run out
+            self._note_drain_defer(min(sum(s is None
+                                           for s in self.slots),
+                                       len(self.queue)))
+        elif self.queue and self._shed_now():
             self._note_shed(min(sum(s is None for s in self.slots),
                                 len(self.queue)))
         elif any(r.tenant is not None for r in self.queue):
@@ -1140,6 +1238,12 @@ class DecodeServer:
             raise ValueError(f"lookahead must be >= 1, got {lookahead}")
         results: Dict[object, List[int]] = {}
         while not self.idle:
+            if (self._draining
+                    and all(s is None for s in self.slots)):
+                # only drain-deferred queue entries remain; they belong
+                # to the handoff bundle now — spinning on the closed
+                # admission gate would never converge
+                break
             if (self.queue and all(s is None for s in self.slots)
                     and not self._can_admit(self.queue[0])):
                 raise RuntimeError(
@@ -1445,6 +1549,15 @@ class PagedDecodeServer(DecodeServer):
             self.blocks[slot] = []
             self._table_dev = None
         return ret
+
+    def _release_slot(self, slot: int) -> None:
+        # a drain-time session export vacates the slot without retiring
+        # it — its pool blocks return exactly as a retirement's would
+        for blk in self.blocks[slot]:
+            if not self._pc_release(blk):
+                self.free.append(blk)
+        self.blocks[slot] = []
+        self._table_dev = None
 
     def _run_step(self):
         # write targets from the HOST position mirror — no device sync

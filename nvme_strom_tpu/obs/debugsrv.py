@@ -182,6 +182,9 @@ class DebugServer:
             # None for ordinary boots, cold/faulting/warming/steady for
             # a serve-while-restoring replica — strom-top renders it
             "boot_phase": snap.get("boot_phase"),
+            # drain & handoff phase (io/handoff.py): absent/None until
+            # a drain begins, then serving/draining/handing_off/retired
+            "drain_phase": snap.get("drain_phase"),
         }
         return json.dumps(doc), "application/json"
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Callable, Dict, Optional, Sequence, Union
+from typing import Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -429,6 +429,11 @@ class FaultingCheckpoint:
         self._lock = make_lock("coldstart.FaultingCheckpoint._lock")
         self._arrays: Dict[str, object] = {}
         self._claims: Dict[str, object] = {}   # name -> threading.Event
+        # claim-table residue (io/handoff.py): tensors requests could
+        # not wait for — demand-faulted at decode class, in fault
+        # order.  A handoff bundle ships this measured hot set so the
+        # replacement pre-faults them ahead of its bulk stream.
+        self._fault_names: List[str] = []
         self._resident_ev = threading.Event()
         self._bulk_thread: Optional[object] = None
         self._cast = None
@@ -448,6 +453,13 @@ class FaultingCheckpoint:
 
     def wait_resident(self, timeout: Optional[float] = None) -> bool:
         return self._resident_ev.wait(timeout)
+
+    def fault_names(self) -> List[str]:
+        """Tensors demand-faulted at decode class so far, in fault
+        order — this replica's measured hot set (shipped in handoff
+        bundles as the claim-table residue)."""
+        with self._lock:
+            return list(self._fault_names)
 
     def _sharding_for(self, name: str):
         get = (self._shardings.get
@@ -511,6 +523,8 @@ class FaultingCheckpoint:
         arr, loaded = self._acquire(name, self.engine, klass)
         if loaded and klass == "decode":
             ms = (time.monotonic() - t0) * 1e3
+            with self._lock:
+                self._fault_names.append(name)
             stats = getattr(self.engine, "stats", None)
             if stats is not None:
                 nbytes = 0

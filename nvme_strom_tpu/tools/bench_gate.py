@@ -57,6 +57,11 @@ GATES: Tuple[Tuple[str, str, float], ...] = (
     # paying for the warm payload again
     ("coldstart.ttft_boot_speedup", "higher", 0.50),
     ("coldstart.on.ttft_boot_s", "lower", 0.60),
+    # drain & warm handoff (docs/RESILIENCE.md): a rolling replacement
+    # must keep its warm-boot TTFT win, and the zero-drop invariant is
+    # absolute — one dropped session is a protocol break, not noise
+    ("handoff.ttft_boot_speedup", "higher", 0.50),
+    ("handoff.dropped_requests", "lower_abs", 0.0),
 )
 
 

@@ -261,6 +261,11 @@ def collect_targets(path: str) -> Dict[str, List[str]]:
             # debris the same GC sweeps — stale hints mis-warm boots
             if not os.path.exists(path[:-len(".warmhints.json")]):
                 orphans.append(path)
+        elif path.endswith(".handoff.json"):
+            # a drain/handoff bundle (io/handoff.py) whose anchor is
+            # gone can never validate: debris under the same gate
+            if not os.path.exists(path[:-len(".handoff.json")]):
+                orphans.append(path)
         elif os.path.exists(path + ".kvman.json"):
             kvstores.append(path)
         elif path.endswith(".safetensors"):
@@ -284,6 +289,11 @@ def collect_targets(path: str) -> Dict[str, List[str]]:
             if name.endswith(".warmhints.json"):
                 # warmup-hint sidecar: same orphan verdict, same sweep
                 if not os.path.exists(p[:-len(".warmhints.json")]):
+                    orphans.append(p)
+                continue
+            if name.endswith(".handoff.json"):
+                # handoff bundle: same orphan verdict, same sweep
+                if not os.path.exists(p[:-len(".handoff.json")]):
                     orphans.append(p)
                 continue
             if os.path.exists(p + ".kvman.json"):

@@ -157,6 +157,19 @@ _COLDSTART_COUNTERS = (
     "coldstart_brownouts",
 )
 
+#: drain & warm handoff counters (io/handoff.py — docs/RESILIENCE.md
+#: "Drain & handoff"); own block with the drain-phase gauge, shown only
+#: when a drain or bundle consumption ever ran: deferred admissions are
+#: the closed gate made visible, exported/restored sessions are the
+#: rolling restart's zero-drop ledger, and brown-outs count bundles a
+#: replacement REJECTED (each one a plain cold start, never an error)
+_HANDOFF_COUNTERS = (
+    "handoff_drains", "handoff_deferred",
+    "handoff_sessions_exported", "handoff_sessions_restored",
+    "handoff_bundles", "handoff_bundle_bytes",
+    "handoff_brownouts", "handoff_stall_dumps",
+)
+
 #: every counter block above, in render order — the counter-drift CI
 #: check (tests/test_observability.py) asserts the union covers ALL of
 #: StromStats.COUNTER_FIELDS, so a new counter cannot silently vanish
@@ -166,7 +179,7 @@ ALL_COUNTER_BLOCKS = (
     _BATCH_COUNTERS, _ENGINE_COUNTERS, _SCHED_COUNTERS,
     _HOSTCACHE_COUNTERS, _KV_COUNTERS, _HEALTH_COUNTERS, _OBS_COUNTERS,
     _LEDGER_COUNTERS, _ICI_COUNTERS, _TENANT_COUNTERS, _SQL_COUNTERS,
-    _COLDSTART_COUNTERS,
+    _COLDSTART_COUNTERS, _HANDOFF_COUNTERS,
 )
 
 
@@ -462,6 +475,17 @@ def render(snap: dict, prev: dict | None = None, dt: float | None = None
         for name in _COLDSTART_COUNTERS:
             v = int(snap.get(name, 0))
             shown = _human(v) if name == "coldstart_fault_bytes" else v
+            lines.append(f"    {name:<24} {shown:>14}")
+    if (any(int(snap.get(n, 0)) for n in _HANDOFF_COUNTERS)
+            or snap.get("drain_phase")):
+        lines.append("  handoff (drain & warm handoff — "
+                     "docs/RESILIENCE.md):")
+        phase = snap.get("drain_phase")
+        if phase:
+            lines.append(f"    {'drain_phase':<24} {str(phase):>14}")
+        for name in _HANDOFF_COUNTERS:
+            v = int(snap.get(name, 0))
+            shown = _human(v) if name == "handoff_bundle_bytes" else v
             lines.append(f"    {name:<24} {shown:>14}")
     if any(int(snap.get(n, 0)) for n in _OBS_COUNTERS):
         lines.append("  observability (tracer / flight recorder):")

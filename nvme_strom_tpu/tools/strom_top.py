@@ -114,6 +114,12 @@ def render_frame(attrib: dict, ledger: dict, health: dict) -> str:
         # steady, invisible afterwards (and for non-coldstart boots)
         lines.append(f"  boot: {phase} (cold start in progress — "
                      "serve-while-restoring)")
+    drain = health.get("drain_phase")
+    if drain and drain != "serving":
+        # a replica mid-retirement: admissions defer while in-flight
+        # work runs out, then the warm-state bundle ships (io/handoff)
+        lines.append(f"  drain: {drain} (rolling replacement — "
+                     "warm handoff in progress)")
     return "\n".join(lines)
 
 

@@ -564,3 +564,64 @@ def coldstart_enabled() -> bool:
     """The one gate read (``STROM_COLDSTART``) consumers check before
     touching any cold-start machinery — mirrors tenants_enabled()."""
     return os.environ.get("STROM_COLDSTART", "0") == "1"
+
+
+@dataclass
+class HandoffConfig:
+    """Zero-downtime drain & warm handoff knobs (io/handoff.py;
+    semantics in docs/RESILIENCE.md "Drain & handoff").
+
+    One gate and a small deadline block: ``STROM_HANDOFF=1`` arms the
+    rolling-replacement protocol — a retiring replica stops admitting
+    new prefills (deferred, never dropped), lets in-flight sessions
+    finish under ``STROM_DRAIN_DEADLINE_S``, then publishes an atomic
+    ``.handoff.json`` warm-state bundle the replacement consumes at
+    boot.  Default 0 keeps today's abrupt-kill replacement bit-for-bit
+    (proven by test).  STROM_* environment variables are read at
+    construction time, mirroring ColdStartConfig.
+    """
+
+    #: master gate; 0 (default) = no drain machinery, no bundle
+    #: publish/consume, no drain_phase gauge — the exact pre-handoff
+    #: stack
+    enabled: bool = field(
+        default_factory=lambda: os.environ.get("STROM_HANDOFF",
+                                               "0") == "1")
+    #: seconds the draining phase waits for in-flight sessions before
+    #: exporting the stragglers into the bundle instead (prompt chain +
+    #: KV page keys — the replacement re-admits them through the prefix
+    #: store).  0 = export immediately, no grace decode.
+    deadline_s: float = field(
+        default_factory=lambda: _env_float("STROM_DRAIN_DEADLINE_S",
+                                           30.0))
+    #: 1 = install SIGTERM/SIGINT handlers that enter drain and, on
+    #: exit, flush a final metrics snapshot + force flight dump — a
+    #: TERM mid-decode otherwise loses both the tail ops and the warm
+    #: manifests.  Default 0: signals keep their stock semantics.
+    drain_on_sigterm: bool = field(
+        default_factory=lambda: os.environ.get("STROM_DRAIN_ON_SIGTERM",
+                                               "0") == "1")
+    #: sessions exported into one bundle, newest-submitted first — a
+    #: pathological queue must not grow an unbounded manifest
+    max_sessions: int = field(
+        default_factory=lambda: _env_int("STROM_HANDOFF_MAX_SESSIONS",
+                                         256))
+    #: drain-progress poll cadence in ms (the coordinator's wait loop
+    #: between serving steps; small — drain latency, not throughput)
+    poll_ms: float = field(
+        default_factory=lambda: _env_float("STROM_DRAIN_POLL_MS", 50.0))
+
+    def __post_init__(self):
+        if self.deadline_s < 0:
+            raise ValueError("deadline_s must be >= 0")
+        if self.max_sessions < 0:
+            raise ValueError("max_sessions must be >= 0")
+        if self.poll_ms <= 0:
+            raise ValueError("poll_ms must be > 0")
+
+
+def handoff_enabled() -> bool:
+    """The one gate read (``STROM_HANDOFF``) consumers check before
+    touching any drain/handoff machinery — mirrors
+    coldstart_enabled()."""
+    return os.environ.get("STROM_HANDOFF", "0") == "1"

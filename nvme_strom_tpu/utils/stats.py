@@ -305,6 +305,28 @@ class StromStats:
     # degraded-mode (brown-out) entries observed while a cold start was
     # still in flight — the restore stream survived a ring failure
     coldstart_brownouts: int = 0
+    # -- drain & warm handoff (io/handoff.py, docs/RESILIENCE.md
+    # "Drain & handoff") ----------------------------------------------
+    # drains entered (serving -> draining transitions)
+    handoff_drains: int = 0
+    # prefill admission opportunities deferred while draining (the
+    # requests stay queued and ride the bundle — never dropped)
+    handoff_deferred: int = 0
+    # sessions exported into a bundle (queued + still decoding past
+    # the drain deadline): prompt token chain + KV page keys
+    handoff_sessions_exported: int = 0
+    # exported sessions a replacement re-admitted from a bundle at boot
+    handoff_sessions_restored: int = 0
+    # .handoff.json bundles atomically published
+    handoff_bundles: int = 0
+    # serialized size of those bundles
+    handoff_bundle_bytes: int = 0
+    # bundles a replacement REJECTED at boot (torn/stale/missing) —
+    # each one is a brown-out to a plain cold start, never an error
+    handoff_brownouts: int = 0
+    # handoff_stall flight-recorder dumps actually published (drain
+    # outlived its deadline with sessions still in flight)
+    handoff_stall_dumps: int = 0
     _lock: threading.Lock = field(
         default_factory=lambda: make_lock("stats.StromStats._lock"),
         repr=False)

@@ -1,0 +1,613 @@
+"""Zero-downtime drain & warm handoff (io/handoff.py,
+models/serving.py drain gate — docs/RESILIENCE.md "Drain & handoff").
+
+The contract under test, end to end and hardware-free:
+
+* ``STROM_HANDOFF=0`` (default) is bit-for-bit inert — no drain flag,
+  no counter moves, no ``drain_phase`` gauge appears.
+* A draining server DEFERS new admissions (nothing drops) while
+  in-flight sessions run out; past the deadline they export into an
+  atomic ``.handoff.json`` bundle whose KV page keys are audited
+  against the PrefixStore's proven-drained flush.
+* A replacement consumes the bundle — exported sessions re-admit first
+  and finish TOKEN-IDENTICAL to an undisturbed server; a torn/stale/
+  missing bundle browns out to a plain cold start with zero errors.
+* The ``-m chaos`` rolling-restart drill kills the old replica at
+  every phase of the handoff; the consumer sees zero errors and
+  identical tokens either way.
+* Stale bundles are orphan-swept by the same age-gated GC as
+  ``.kvman.json``/``.warmhints.json`` (strom-scrub --gc).
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from nvme_strom_tpu.formats import write_safetensors
+from nvme_strom_tpu.io.coldstart import ColdStartCoordinator
+from nvme_strom_tpu.io.engine import StromEngine
+from nvme_strom_tpu.io.flightrec import FlightConfig, FlightRecorder
+from nvme_strom_tpu.io.handoff import (DRAIN_PHASES, HANDOFF_SUFFIX,
+                                       DrainCoordinator, bundle_path,
+                                       consume_bundle,
+                                       install_drain_signals,
+                                       load_handoff_bundle,
+                                       uninstall_drain_signals,
+                                       write_handoff_bundle)
+from nvme_strom_tpu.io.resilient import ResilientEngine
+from nvme_strom_tpu.models.kv_offload import PrefixStore
+from nvme_strom_tpu.models.serving import DecodeServer
+from nvme_strom_tpu.models.transformer import (TransformerConfig,
+                                               init_params, tiny_config)
+from nvme_strom_tpu.parallel.weights import (FaultingCheckpoint,
+                                             LazyCheckpoint)
+from nvme_strom_tpu.utils.config import (EngineConfig, HandoffConfig,
+                                         handoff_enabled)
+from nvme_strom_tpu.utils.stats import StromStats
+
+MB = 1 << 20
+
+HANDOFF_COUNTERS = (
+    "handoff_drains", "handoff_deferred", "handoff_sessions_exported",
+    "handoff_sessions_restored", "handoff_bundles",
+    "handoff_bundle_bytes", "handoff_brownouts", "handoff_stall_dumps")
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = TransformerConfig(**{**tiny_config().__dict__,
+                               "dtype": jnp.float32})
+    params = init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture()
+def ckpt(setup, tmp_path):
+    _cfg, params = setup
+    path = str(tmp_path / "model.safetensors")
+    write_safetensors(path, {n: np.asarray(a) for n, a in params.items()})
+    return path
+
+
+def _single_shardings():
+    shard = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+    return lambda name, shape: shard
+
+
+def _engine():
+    stats = StromStats()
+    eng = ResilientEngine(StromEngine(
+        EngineConfig(chunk_bytes=1 << 20, queue_depth=8,
+                     buffer_pool_bytes=16 * MB, n_rings=0),
+        stats=stats))
+    return eng, stats
+
+
+def _sessions(cfg, n=3, plen=40, seed=5):
+    rng = np.random.default_rng(seed)
+    return [(f"s{i}", rng.integers(0, cfg.vocab, plen).tolist())
+            for i in range(n)]
+
+
+MAX_NEW = 10
+
+
+def _reference(params, cfg, sessions):
+    srv = DecodeServer(params, cfg, max_batch=4, max_len=128)
+    for rid, p in sessions:
+        srv.submit(rid, p, MAX_NEW)
+    return srv.run(2)
+
+
+class _FakeFlightEngine:
+    """Just enough engine surface for the coordinator: stats + flight
+    recorder + a scheduler whose backlog is known."""
+
+    class _Sched:
+        def backlog(self):
+            return {"decode": {"batches": 1, "spans": 3,
+                               "oldest_wait_s": 0.2}}
+
+    def __init__(self, tmp_path):
+        self.stats = StromStats()
+        self.flight = FlightRecorder(
+            FlightConfig(enabled=True, ops=16, dir=str(tmp_path),
+                         min_interval_s=0.0), self.stats)
+        self.scheduler = self._Sched()
+        self.supervisor = None
+
+
+# ---------------------------------------------------------------------------
+# config + the off-by-default inertness proof
+# ---------------------------------------------------------------------------
+
+def test_config_defaults_and_validation(monkeypatch):
+    for var in ("STROM_HANDOFF", "STROM_DRAIN_DEADLINE_S",
+                "STROM_DRAIN_ON_SIGTERM", "STROM_HANDOFF_MAX_SESSIONS",
+                "STROM_DRAIN_POLL_MS"):
+        monkeypatch.delenv(var, raising=False)
+    cfg = HandoffConfig()
+    assert cfg.enabled is False          # opt-in, never on by surprise
+    assert handoff_enabled() is False
+    assert cfg.deadline_s == 30.0
+    assert cfg.drain_on_sigterm is False
+    assert cfg.max_sessions == 256
+    assert cfg.poll_ms == 50.0
+    monkeypatch.setenv("STROM_HANDOFF", "1")
+    assert handoff_enabled() is True
+    with pytest.raises(ValueError):
+        HandoffConfig(enabled=False, deadline_s=-1.0)
+    with pytest.raises(ValueError):
+        HandoffConfig(enabled=False, max_sessions=-1)
+    with pytest.raises(ValueError):
+        HandoffConfig(enabled=False, poll_ms=0.0)
+
+
+def test_gate_off_is_bit_for_bit_inert(setup, monkeypatch):
+    """Plain serving with the gate off must not know the subsystem
+    exists: the drain flag never sets, stats() carries no drain keys,
+    no handoff counter moves, no drain_phase gauge appears."""
+    monkeypatch.delenv("STROM_HANDOFF", raising=False)
+    cfg, params = setup
+    sessions = _sessions(cfg, n=2)
+    srv = DecodeServer(params, cfg, max_batch=4, max_len=128)
+    for rid, p in sessions:
+        srv.submit(rid, p, MAX_NEW)
+    out = srv.run(2)
+    assert all(len(out[rid]) == MAX_NEW for rid, _ in sessions)
+    assert srv.draining is False
+    assert srv.admissions_deferred == 0
+    st = srv.stats()
+    assert "draining" not in st and "admissions_deferred" not in st
+    stats = StromStats()
+    snap = stats.snapshot()
+    for name in HANDOFF_COUNTERS:
+        assert getattr(stats, name) == 0
+    assert "drain_phase" not in snap and "handoff_source" not in snap
+
+
+# ---------------------------------------------------------------------------
+# coordinator: phase machine, drain gate, stall dump
+# ---------------------------------------------------------------------------
+
+def test_phase_machine_is_forward_only_and_exports_gauge(tmp_path):
+    eng = _FakeFlightEngine(tmp_path)
+    coord = DrainCoordinator(eng)
+    assert coord.phase == "serving" and DRAIN_PHASES.index("serving") == 0
+    assert coord.begin_drain() is True
+    assert coord.phase == "draining"
+    snap = eng.stats.snapshot()
+    assert snap["drain_phase"] == "draining"
+    assert snap["drain_phase_code"] == DRAIN_PHASES.index("draining")
+    assert eng.stats.handoff_drains == 1
+    assert coord.begin_drain() is False  # idempotent, counted once
+    assert eng.stats.handoff_drains == 1
+    assert coord._advance("retired") is True
+    assert coord._advance("handing_off") is False   # never rewinds
+    assert coord.phase == "retired"
+    assert eng.stats.snapshot()["drain_phase"] == "retired"
+    times = coord.phase_times()
+    assert "serving" in times and "draining" in times
+    assert times["draining"] <= times["retired"]
+
+
+def test_drain_defers_admissions_and_nothing_drops(setup):
+    """Entering drain closes the admission gate with DEFER semantics:
+    queued requests stay queued (for export), in-flight slots keep
+    decoding, and the deferred count is observable."""
+    cfg, params = setup
+    sessions = _sessions(cfg, n=2)
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=128)
+    for rid, p in sessions:
+        srv.submit(rid, p, MAX_NEW)
+    srv.step_many(1)                      # both admitted, one token in
+    srv.begin_drain()
+    srv.submit("late", sessions[0][1], MAX_NEW)   # arrives mid-drain
+    out = {}
+    for _ in range(MAX_NEW + 2):
+        out.update(srv.step_many(1))
+    # in-flight sessions ran to completion; the late one DEFERRED
+    assert all(len(out[rid]) == MAX_NEW for rid, _ in sessions)
+    assert "late" not in out
+    assert [r.rid for r in srv.queue] == ["late"]
+    assert srv.admissions_deferred > 0
+    st = srv.stats()
+    assert st["draining"] is True
+    assert st["admissions_deferred"] == srv.admissions_deferred
+    # run() must not spin on the closed gate
+    assert srv.run(1) == {}
+    exported = srv.export_sessions(8, pop=True)
+    assert [s["rid"] for s in exported] == ["late"]
+    assert srv.idle
+
+
+def test_drain_deadline_stall_dump_carries_backlog(setup, tmp_path):
+    """A drain outliving its deadline with sessions still decoding
+    dumps reason=handoff_stall with the drain phase and the scheduler's
+    per-class backlog — and still publishes (sessions export instead of
+    finishing)."""
+    cfg, params = setup
+    sessions = _sessions(cfg, n=2)
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=128)
+    for rid, p in sessions:
+        srv.submit(rid, p, MAX_NEW)
+    srv.step_many(1)
+    eng = _FakeFlightEngine(tmp_path)
+    coord = DrainCoordinator(eng, server=srv)
+    res = coord.drain(deadline_s=0.0)
+    assert coord.phase == "retired"
+    assert res["bundle"] is None          # no store: nothing to anchor
+    assert eng.stats.handoff_stall_dumps == 1
+    dumps = sorted(tmp_path.glob("strom_flight_*handoff_stall*.json"))
+    assert len(dumps) == 1
+    doc = json.loads(dumps[0].read_text())
+    assert doc["reason"] == "handoff_stall"
+    assert doc["extra"]["drain_phase"] == "draining"
+    assert doc["extra"]["deadline_s"] == 0.0
+    assert doc["extra"]["slots_busy"] == 2
+    assert doc["extra"]["backlog"]["decode"]["spans"] == 3
+
+
+# ---------------------------------------------------------------------------
+# bundle: atomic publish, staleness validation, brown-out ladder
+# ---------------------------------------------------------------------------
+
+def test_bundle_roundtrip_staleness_and_corruption(tmp_path):
+    base = tmp_path / "pages.kvstore"
+    base.write_bytes(b"x" * 8192)
+    sess = [{"rid": "a", "prompt": [1, 2, 3], "emitted": [4],
+             "max_new": 5, "eos_id": None, "temperature": 0.0,
+             "top_p": 1.0, "seed": 0, "tenant": None, "kv_keys": []}]
+    out = write_handoff_bundle(str(base), {"sessions": sess,
+                                           "warm_hints": [],
+                                           "hot_tensors": ["w.a"],
+                                           "tenants": {},
+                                           "checkpoint": None,
+                                           "kv_manifest": None})
+    assert out == bundle_path(str(base))
+    assert out.endswith(HANDOFF_SUFFIX)
+    doc = load_handoff_bundle(str(base))
+    assert doc is not None
+    assert doc["sessions"][0]["rid"] == "a"
+    assert doc["hot_tensors"] == ["w.a"]
+    # a rewritten anchor invalidates the bundle: cold, never mis-warmed
+    st = os.stat(base)
+    os.utime(base, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+    assert load_handoff_bundle(str(base)) is None
+    # re-publish against the new anchor state, then corrupt sessions
+    write_handoff_bundle(str(base), {"sessions": [{"prompt": []}]})
+    assert load_handoff_bundle(str(base)) is None   # empty prompt
+    write_handoff_bundle(str(base), {"sessions": [
+        {"prompt": [1], "max_new": 0}]})
+    assert load_handoff_bundle(str(base)) is None   # no budget left
+    # checkpoint generation skew: the recorded stat must match NOW
+    ck = tmp_path / "w.safetensors"
+    ck.write_bytes(b"w" * 64)
+    cst = os.stat(ck)
+    write_handoff_bundle(str(base), {"sessions": [], "checkpoint": {
+        "path": str(ck), "size": cst.st_size,
+        "mtime_ns": cst.st_mtime_ns}})
+    assert load_handoff_bundle(str(base)) is not None
+    os.utime(ck, ns=(cst.st_atime_ns, cst.st_mtime_ns + 1_000_000))
+    assert load_handoff_bundle(str(base)) is None
+    # torn JSON loads as no bundle at all
+    with open(bundle_path(str(base)), "w") as f:
+        f.write('{"version": 1, "base"')
+    assert load_handoff_bundle(str(base)) is None
+    os.unlink(bundle_path(str(base)))
+    assert load_handoff_bundle(str(base)) is None
+    # missing anchor: write refuses (None), nothing half-published
+    assert write_handoff_bundle(str(tmp_path / "gone"), {}) is None
+
+
+def test_consume_rejects_bad_bundle_counts_one_brownout(tmp_path):
+    base = tmp_path / "pages.kvstore"
+    base.write_bytes(b"x" * 64)
+    with open(bundle_path(str(base)), "w") as f:
+        f.write("{torn")
+    stats = StromStats()
+    assert consume_bundle(str(base), stats=stats) is None
+    assert stats.handoff_brownouts == 1
+    assert stats.handoff_sessions_restored == 0
+
+
+def test_tenant_state_export_restore_bounded(monkeypatch):
+    from nvme_strom_tpu.io import tenants as T
+    monkeypatch.setenv("STROM_TENANTS", "1")
+    T.reset()
+    try:
+        reg = T.get_registry()
+        t = reg.get("bronze")
+        t.share_boost = 2
+        state = reg.export_state()
+        assert state == {"bronze": {"share_boost": 2}}
+        T.reset()
+        reg = T.get_registry()
+        assert reg.get("bronze").share_boost == 0
+        # restore re-applies, bounded, and skips malformed entries
+        n = reg.restore_state({"bronze": {"share_boost": 99},
+                               "junk": "not-a-dict",
+                               "zero": {"share_boost": 0}})
+        assert n == 1
+        from nvme_strom_tpu.models.kv_offload import SloGovernor
+        assert reg.get("bronze").share_boost == SloGovernor._MAX_BOOST
+        assert reg.get("zero").share_boost == 0
+    finally:
+        T.reset()
+
+
+# ---------------------------------------------------------------------------
+# the full protocol: drain -> bundle -> consume, token-identical
+# ---------------------------------------------------------------------------
+
+def _old_replica(cfg, ckpt, store_path, sessions, steps=4):
+    """Boot a replica over a FaultingCheckpoint + PrefixStore, serve
+    ``sessions`` partway, and return (engine, stats, server, store)."""
+    eng, stats = _engine()
+    fck = FaultingCheckpoint(ckpt, _single_shardings(), engine=eng)
+    # demand-fault two tensors BEFORE the bulk lane exists so the
+    # claim-table residue is deterministically non-empty (the serving
+    # materialize races the bulk thread for the rest)
+    for name in sorted(fck.keys())[:2]:
+        fck.get(name)
+    store = PrefixStore(cfg, eng, store_path, page_tokens=16,
+                        capacity_bytes=16 * MB)
+    srv = DecodeServer(fck, cfg, max_batch=4, max_len=128,
+                       kv_store=store)
+    for rid, p in sessions:
+        srv.submit(rid, p, MAX_NEW)
+    early = {}
+    for _ in range(steps):
+        early.update(srv.step_many(1))
+    return eng, stats, srv, store, fck, early
+
+
+def _replacement(cfg, ckpt, store_path, consume=True):
+    eng, stats = _engine()
+    coord = ColdStartCoordinator(eng)
+    fck = FaultingCheckpoint(ckpt, _single_shardings(), engine=eng,
+                             coordinator=coord)
+    store = PrefixStore(cfg, eng, store_path, page_tokens=16,
+                        capacity_bytes=16 * MB)
+    srv = DecodeServer(fck, cfg, max_batch=4, max_len=128,
+                       kv_store=store)
+    consumed = (coord.consume_handoff(store_path, server=srv,
+                                      checkpoint=fck)
+                if consume else None)
+    return eng, stats, srv, store, fck, consumed
+
+
+def test_full_handoff_is_token_identical_and_audited(setup, ckpt,
+                                                     tmp_path):
+    cfg, params = setup
+    sessions = _sessions(cfg)
+    want = _reference(params, cfg, sessions)
+    store_path = str(tmp_path / "pages.kvstore")
+
+    eng_a, stats_a, srv_a, store_a, fck_a, early = _old_replica(
+        cfg, ckpt, store_path, sessions)
+    try:
+        coord = DrainCoordinator(eng_a, server=srv_a, checkpoint=ckpt)
+        res = coord.drain(deadline_s=0.0)   # sessions export mid-decode
+        early.update(res["results"])
+        assert coord.phase == "retired"
+        assert srv_a.idle                   # exported sessions popped
+        bundle = res["bundle"]
+        assert bundle == bundle_path(store_path)
+        snap_a = stats_a.snapshot()
+        assert snap_a["handoff_drains"] == 1
+        assert snap_a["handoff_bundles"] == 1
+        assert snap_a["handoff_sessions_exported"] == len(sessions)
+        assert snap_a["handoff_bundle_bytes"] > 0
+        assert snap_a["drain_phase"] == "retired"
+        doc = load_handoff_bundle(store_path)
+        assert doc is not None
+        # the flush audit: every page key a session ships must be in
+        # the store's proven-drained ready set — a bundle never
+        # references a page whose write was not proven complete
+        ready = set(store_a.ready_keys())
+        for s in doc["sessions"]:
+            assert s["kv_keys"], "sessions must carry their page keys"
+            assert set(s["kv_keys"]) <= ready
+        # the claim-table residue rode along (old replica demand-
+        # faulted its weights at decode class)
+        assert len(doc["hot_tensors"]) >= 2
+        assert doc["hot_tensors"] == fck_a.fault_names()
+        store_a.close()
+    finally:
+        fck_a.join_bulk(60.0)
+        eng_a.close_all()
+
+    eng_b, stats_b, srv_b, store_b, fck_b, consumed = _replacement(
+        cfg, ckpt, store_path)
+    try:
+        assert consumed is not None
+        assert consumed["restored"] == len(sessions)
+        assert consumed["hot_tensors"] == len(doc["hot_tensors"])
+        cont = srv_b.run(2)
+        final = dict(early)
+        for rid, c in cont.items():
+            final[rid] = list(consumed["sessions"][rid]) + list(c)
+        assert final == want               # token-identical, zero drops
+        snap_b = stats_b.snapshot()
+        assert snap_b["handoff_sessions_restored"] == len(sessions)
+        assert snap_b["handoff_brownouts"] == 0
+        assert snap_b["handoff_source"] == "bundle"
+        store_b.close()
+    finally:
+        if consumed and consumed.get("prefault_thread"):
+            consumed["prefault_thread"].join(60.0)
+        fck_b.join_bulk(60.0)
+        eng_b.close_all()
+
+
+def test_flush_for_handoff_is_proven_drained_flush(setup, ckpt,
+                                                   tmp_path):
+    """flush_for_handoff must produce the same clean manifest as the
+    PR-13 flush() and return exactly the stamped (ready) key set."""
+    cfg, _params = setup
+    sessions = _sessions(cfg, n=2)
+    store_path = str(tmp_path / "pages.kvstore")
+    eng, _stats, srv, store, _fck, _early = _old_replica(
+        cfg, ckpt, store_path, sessions, steps=2)
+    try:
+        stamped = store.flush_for_handoff()
+        assert stamped == store.ready_keys()
+        assert stamped                      # prefill wrote prefix pages
+        with open(store.manifest_path) as f:
+            man = json.load(f)
+        assert man["clean"] is True
+        assert {row["key"] for row in man["pages"].values()} \
+            == set(stamped)
+        store.close()
+    finally:
+        _fck.join_bulk(60.0)
+        eng.close_all()
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM graceful-shutdown hook
+# ---------------------------------------------------------------------------
+
+def test_sigterm_hook_drains_and_flushes_final_snapshot(
+        setup, tmp_path, monkeypatch):
+    cfg, params = setup
+    monkeypatch.delenv("STROM_DRAIN_ON_SIGTERM", raising=False)
+    eng = _FakeFlightEngine(tmp_path)
+    srv = DecodeServer(params, cfg, max_batch=2, max_len=128)
+    coord = DrainCoordinator(eng, server=srv)
+    # gate off: nothing installs, stock signal semantics survive
+    assert install_drain_signals(coord) is None
+    monkeypatch.setenv("STROM_DRAIN_ON_SIGTERM", "1")
+    export = tmp_path / "final_stats.json"
+    monkeypatch.setenv("STROM_STATS_EXPORT", str(export))
+    coord2 = DrainCoordinator(eng, server=srv, cfg=HandoffConfig())
+    prev = install_drain_signals(coord2, chain=False)
+    assert prev is not None
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(100):               # handler runs at a bytecode
+            if coord2.phase == "retired":  # boundary in this thread
+                break
+            time.sleep(0.01)
+        assert coord2.phase == "retired"
+        assert eng.stats.handoff_drains == 1
+        # the exit flush: final metrics snapshot + FORCED flight dump
+        assert export.exists()
+        assert json.loads(export.read_text())["handoff_drains"] == 1
+        dumps = sorted(tmp_path.glob("strom_flight_*handoff_exit*"))
+        assert len(dumps) == 1
+        assert json.loads(dumps[0].read_text())["extra"]["reason"] \
+            == f"signal {int(signal.SIGTERM)}"
+    finally:
+        uninstall_drain_signals(prev)
+
+
+# ---------------------------------------------------------------------------
+# chaos: rolling-restart drill — kill the old replica at every phase
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kill_at", ["serving", "draining",
+                                     "handing_off", "retired"])
+def test_rolling_restart_drill_zero_errors_token_identical(
+        setup, ckpt, tmp_path, kill_at):
+    """Kill the old replica mid-handoff at each phase.  Killed before
+    the bundle published (serving/draining) or with the bundle torn
+    (handing_off): the replacement browns out to a plain cold start and
+    the client's retry recomputes from scratch.  Killed after
+    (retired): the replacement boots from the bundle.  Either way: zero
+    consumer errors, token-identical output."""
+    cfg, params = setup
+    sessions = _sessions(cfg)
+    want = _reference(params, cfg, sessions)
+    store_path = str(tmp_path / "pages.kvstore")
+
+    eng_a, stats_a, srv_a, store_a, _fck_a, early = _old_replica(
+        cfg, ckpt, store_path, sessions)
+    try:
+        coord = DrainCoordinator(eng_a, server=srv_a, checkpoint=ckpt)
+        if kill_at == "serving":
+            pass                           # abrupt kill: no drain at all
+        elif kill_at == "draining":
+            coord.begin_drain()            # killed before publishing
+        else:
+            res = coord.drain(deadline_s=0.0)
+            early.update(res["results"])
+            assert res["bundle"]
+            if kill_at == "handing_off":
+                # the kill lands mid-publish: simulate the torn write a
+                # non-atomic publisher would leave (rename is atomic, so
+                # this is the WORST case a real crash can produce)
+                with open(res["bundle"], "w") as f:
+                    f.write('{"version": 1, ')
+        # "kill": the old process goes away without store.close() —
+        # whatever reached disk is all the replacement gets
+    finally:
+        _fck_a.join_bulk(60.0)
+        eng_a.close_all()
+
+    eng_b, stats_b, srv_b, store_b, fck_b, consumed = _replacement(
+        cfg, ckpt, store_path)
+    try:
+        if kill_at == "retired":
+            assert consumed is not None
+            assert stats_b.handoff_brownouts == 0
+            cont = srv_b.run(2)
+            final = dict(early)
+            for rid, c in cont.items():
+                final[rid] = list(consumed["sessions"][rid]) + list(c)
+        else:
+            # brown-out: no usable bundle — plain cold start, the
+            # client re-sends, nothing errors
+            assert consumed is None
+            assert stats_b.handoff_brownouts == (
+                1 if kill_at != "serving" else
+                stats_b.handoff_brownouts)
+            for rid, p in sessions:
+                srv_b.submit(rid, p, MAX_NEW)
+            final = srv_b.run(2)
+        assert final == want               # token-identical either way
+        store_b.close()
+    finally:
+        if consumed and consumed.get("prefault_thread"):
+            consumed["prefault_thread"].join(60.0)
+        fck_b.join_bulk(60.0)
+        eng_b.close_all()
+
+
+# ---------------------------------------------------------------------------
+# orphan GC: stale bundles swept like the other sidecars
+# ---------------------------------------------------------------------------
+
+def test_orphan_handoff_bundles_swept_by_age_gated_gc(tmp_path):
+    from nvme_strom_tpu.checkpoint.manager import (find_orphan_manifests,
+                                                   sweep_orphan_manifests)
+    from nvme_strom_tpu.tools import strom_scrub
+
+    base = tmp_path / "gone.kvstore"
+    base.write_bytes(b"y" * 4096)
+    write_handoff_bundle(str(base), {"sessions": []})
+    live = tmp_path / "live.kvstore"
+    live.write_bytes(b"z" * 4096)
+    write_handoff_bundle(str(live), {"sessions": []})
+    os.unlink(base)                        # orphan the first bundle
+    orphans = find_orphan_manifests(str(tmp_path))
+    assert orphans == [bundle_path(str(base))]
+    # the age gate protects a freshly-published bundle (handoff race)
+    assert sweep_orphan_manifests(orphans, min_age=3600.0) == []
+    assert os.path.exists(orphans[0])
+    # strom-scrub reports it and --gc --force removes it
+    report = strom_scrub.collect_targets(str(tmp_path))
+    assert orphans[0] in report["orphan_manifests"]
+    rc = strom_scrub.main([str(tmp_path), "--gc", "--force", "--json"])
+    assert rc == 0
+    assert not os.path.exists(orphans[0])
+    assert os.path.exists(bundle_path(str(live)))   # live bundle stays
